@@ -1,0 +1,95 @@
+"""ompi_tpu — a TPU-native communication framework with Open MPI's capabilities.
+
+A brand-new framework (not a port) providing the MPI and OpenSHMEM programming
+models, re-designed for TPU hardware: hot-path collectives lower to XLA
+collectives (``jax.lax.psum``/``all_gather``/``ppermute``/``all_to_all``) over
+an ICI device mesh with HBM-resident buffers and zero host staging, while a
+host-side process runtime provides real multi-rank MPI matching semantics
+(tag/source wildcards, unexpected queues) the way the reference's ob1 PML does.
+
+Layer map (mirrors the reference's OPAL/ORTE/OMPI/OSHMEM stack — see
+/root/reference layout and SURVEY.md §1):
+
+- ``ompi_tpu.core``     ≈ OPAL  — component registry (MCA), typed config vars,
+                                   logging/diagnostics, serialization, buffers.
+- ``ompi_tpu.runtime``  ≈ ORTE  — job state machine, resource allocation and
+                                   rank mapping, launcher, failure policy.
+- ``ompi_tpu.mpi``      ≈ OMPI  — communicators, datatypes, ops, requests,
+                                   point-to-point, collectives, RMA, IO.
+- ``ompi_tpu.shmem``    ≈ OSHMEM — symmetric heap, put/get, collectives.
+- ``ompi_tpu.parallel``          — TPU-first sharding/mesh helpers, sequence
+                                   parallelism (ring attention, all-to-all).
+- ``ompi_tpu.models``            — flagship models built on the framework.
+- ``ompi_tpu.ops``               — pallas kernels for hot ops.
+
+Two execution modes share one API:
+
+1. **Device SPMD mode** — ranks are devices of a ``jax.sharding.Mesh``;
+   communicator operations called inside ``shard_map``/``jit`` trace to XLA
+   collectives and compile to ICI transfers (the ``coll/xla`` + ``btl/tpu``
+   path of BASELINE.json's north star).
+2. **Host process mode** — one OS process per rank (launched by ``tpurun``),
+   host buffers move over sockets/shared memory with full MPI matching
+   semantics (the reference's ob1/BTL path, reimagined).
+"""
+
+from ompi_tpu.core.config import var_registry, register_var, get_var
+from ompi_tpu.core.mca import Framework, Component, framework_registry
+
+__version__ = "0.1.0"
+
+# Lazy top-level MPI-like API (heavy imports deferred; jax only loads when the
+# device path is actually used).
+_LAZY = {
+    "init": ("ompi_tpu.mpi.runtime", "init"),
+    "finalize": ("ompi_tpu.mpi.runtime", "finalize"),
+    "initialized": ("ompi_tpu.mpi.runtime", "initialized"),
+    "COMM_WORLD": ("ompi_tpu.mpi.runtime", "COMM_WORLD"),
+    "COMM_SELF": ("ompi_tpu.mpi.runtime", "COMM_SELF"),
+    "Communicator": ("ompi_tpu.mpi.comm", "Communicator"),
+    "Group": ("ompi_tpu.mpi.group", "Group"),
+    "Datatype": ("ompi_tpu.mpi.datatype", "Datatype"),
+    "Op": ("ompi_tpu.mpi.op", "Op"),
+    "Request": ("ompi_tpu.mpi.request", "Request"),
+    "Status": ("ompi_tpu.mpi.request", "Status"),
+    "ANY_SOURCE": ("ompi_tpu.mpi.constants", "ANY_SOURCE"),
+    "ANY_TAG": ("ompi_tpu.mpi.constants", "ANY_TAG"),
+    "PROC_NULL": ("ompi_tpu.mpi.constants", "PROC_NULL"),
+    "UNDEFINED": ("ompi_tpu.mpi.constants", "UNDEFINED"),
+    "IN_PLACE": ("ompi_tpu.mpi.constants", "IN_PLACE"),
+    "SUM": ("ompi_tpu.mpi.op", "SUM"),
+    "PROD": ("ompi_tpu.mpi.op", "PROD"),
+    "MAX": ("ompi_tpu.mpi.op", "MAX"),
+    "MIN": ("ompi_tpu.mpi.op", "MIN"),
+    "LAND": ("ompi_tpu.mpi.op", "LAND"),
+    "LOR": ("ompi_tpu.mpi.op", "LOR"),
+    "BAND": ("ompi_tpu.mpi.op", "BAND"),
+    "BOR": ("ompi_tpu.mpi.op", "BOR"),
+    "MAXLOC": ("ompi_tpu.mpi.op", "MAXLOC"),
+    "MINLOC": ("ompi_tpu.mpi.op", "MINLOC"),
+    "device_world": ("ompi_tpu.mpi.device_comm", "device_world"),
+    "DeviceCommunicator": ("ompi_tpu.mpi.device_comm", "DeviceCommunicator"),
+}
+
+
+# Names that are rebound at runtime (init() replaces them) must be resolved on
+# every access, never cached in this module's globals.
+_MUTABLE = {"COMM_WORLD", "COMM_SELF"}
+
+
+def __getattr__(name):
+    try:
+        mod_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(f"module 'ompi_tpu' has no attribute {name!r}") from None
+    import importlib
+
+    mod = importlib.import_module(mod_name)
+    value = getattr(mod, attr)
+    if name not in _MUTABLE:
+        globals()[name] = value
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

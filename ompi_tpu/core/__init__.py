@@ -1,0 +1,9 @@
+"""Core substrate (≈ the reference's OPAL layer, opal/).
+
+Single-process portability and plumbing: the component/plugin registry
+(``mca``), the typed configuration-variable registry (``config``), structured
+logging and aggregated user diagnostics (``output``), control-message
+serialization (``dss``), and the buffer-location abstraction (``buffer``)
+that threads device/host duality through the whole stack the way the
+reference threads its CUDA convertor flag (opal/datatype/opal_convertor.h:43-59).
+"""

@@ -1,0 +1,75 @@
+"""Buffer-location abstraction: host vs device vs traced.
+
+The reference threads CUDA special cases through its convertor, PML, BTL and
+coll layers via the ``CONVERTOR_CUDA`` flag (opal/datatype/opal_convertor.h:43-59,
+opal_convertor.c:574-614 ``mca_cuda_convertor_init``) — device-ness is
+discovered per-buffer and changes which memcpy/protocol runs.  SURVEY.md §7
+flags this as the abstraction to design *first*, so here it is, as data:
+
+- ``HOST``    — numpy arrays / python buffers; move via the host path
+                (sockets, shared memory, the native convertor).
+- ``DEVICE``  — committed ``jax.Array``s in HBM (or on CPU devices); move via
+                XLA collectives / device-to-device transfer; never serialized.
+- ``TRACED``  — JAX tracers inside ``jit``/``shard_map``; operations MUST
+                lower to XLA ops (ppermute/psum/...), anything host-side is a
+                programming error surfaced here, early, with a good message.
+
+Every layer above (p2p, coll, RMA, SHMEM) dispatches on ``classify()`` instead
+of sprinkling isinstance checks — the single choke point the reference never
+had (its CUDA checks appear in 4 layers).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any
+
+import numpy as np
+
+__all__ = ["BufferKind", "classify", "is_device", "nbytes_of", "BufferLocationError"]
+
+
+class BufferKind(enum.Enum):
+    HOST = "host"
+    DEVICE = "device"
+    TRACED = "traced"
+
+
+class BufferLocationError(TypeError):
+    pass
+
+
+def classify(buf: Any) -> BufferKind:
+    """Classify a user buffer. Cheap for host buffers (no jax import)."""
+    if isinstance(buf, np.ndarray) or np.isscalar(buf):
+        return BufferKind.HOST
+    if isinstance(buf, (bytes, bytearray, memoryview, list)):
+        return BufferKind.HOST
+    # Only now touch jax (keeps host-only processes light).
+    mod = type(buf).__module__ or ""
+    if mod.startswith("jax") or hasattr(buf, "aval"):
+        import jax.core
+
+        if isinstance(buf, jax.core.Tracer):
+            return BufferKind.TRACED
+        import jax
+
+        if isinstance(buf, jax.Array):
+            return BufferKind.DEVICE
+    raise BufferLocationError(
+        f"cannot classify buffer of type {type(buf).__name__}; expected "
+        f"numpy array, jax array, or bytes-like")
+
+
+def is_device(buf: Any) -> bool:
+    k = classify(buf)
+    return k in (BufferKind.DEVICE, BufferKind.TRACED)
+
+
+def nbytes_of(buf: Any) -> int:
+    if isinstance(buf, (bytes, bytearray, memoryview)):
+        return len(buf)
+    nb = getattr(buf, "nbytes", None)
+    if nb is not None:
+        return int(nb)
+    return int(np.asarray(buf).nbytes)

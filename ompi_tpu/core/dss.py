@@ -1,0 +1,180 @@
+"""DSS — self-describing typed serialization for control messages.
+
+Equivalent of the reference's data storage service (opal/dss/dss.h:107,212):
+control-plane messages (launch commands, modex business cards, IOF chunks)
+are packed as a sequence of (type-tag, payload) records into a buffer and
+unpacked with type checking on the far side.  Used by the runtime's RML
+messaging and the host-path p2p bootstrap; *never* on the device data path
+(device buffers move via XLA collectives, not serialization).
+
+Wire format: little-endian; each record is [1B type][payload]; variable-length
+payloads carry a u32 length.  Numpy arrays pack dtype + shape + raw bytes.
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+from typing import Any, Optional
+
+import numpy as np
+
+__all__ = ["Buffer", "pack", "unpack", "DSSError"]
+
+
+class DSSError(ValueError):
+    pass
+
+
+# type tags
+_T_INT64 = 1
+_T_FLOAT64 = 2
+_T_STRING = 3
+_T_BYTES = 4
+_T_BOOL = 5
+_T_NONE = 6
+_T_LIST = 7
+_T_DICT = 8
+_T_NDARRAY = 9
+_T_TUPLE = 10
+
+_NAMES = {
+    _T_INT64: "int", _T_FLOAT64: "float", _T_STRING: "str", _T_BYTES: "bytes",
+    _T_BOOL: "bool", _T_NONE: "none", _T_LIST: "list", _T_DICT: "dict",
+    _T_NDARRAY: "ndarray", _T_TUPLE: "tuple",
+}
+
+
+class Buffer:
+    """An append/consume byte buffer (≈ opal_buffer_t)."""
+
+    def __init__(self, data: bytes = b"") -> None:
+        self._w = io.BytesIO()
+        self._w.write(data)
+        self._r = 0
+
+    # -- pack -----------------------------------------------------------
+
+    def pack(self, value: Any) -> "Buffer":
+        w = self._w
+        if value is None:
+            w.write(bytes([_T_NONE]))
+        elif isinstance(value, bool):  # before int: bool is an int subclass
+            w.write(bytes([_T_BOOL, 1 if value else 0]))
+        elif isinstance(value, int):
+            w.write(bytes([_T_INT64]))
+            w.write(struct.pack("<q", value))
+        elif isinstance(value, float):
+            w.write(bytes([_T_FLOAT64]))
+            w.write(struct.pack("<d", value))
+        elif isinstance(value, str):
+            raw = value.encode()
+            w.write(bytes([_T_STRING]))
+            w.write(struct.pack("<I", len(raw)))
+            w.write(raw)
+        elif isinstance(value, (bytes, bytearray, memoryview)):
+            raw = bytes(value)
+            w.write(bytes([_T_BYTES]))
+            w.write(struct.pack("<I", len(raw)))
+            w.write(raw)
+        elif isinstance(value, np.ndarray):
+            dt = value.dtype.str.encode()
+            # ascontiguousarray promotes 0-d to 1-d; shape metadata must come
+            # from the original value.
+            arr = np.ascontiguousarray(value)
+            w.write(bytes([_T_NDARRAY]))
+            w.write(struct.pack("<B", len(dt)))
+            w.write(dt)
+            w.write(struct.pack("<B", value.ndim))
+            w.write(struct.pack(f"<{value.ndim}q", *value.shape))
+            raw = arr.tobytes()
+            w.write(struct.pack("<Q", len(raw)))
+            w.write(raw)
+        elif isinstance(value, (list, tuple)):
+            w.write(bytes([_T_LIST if isinstance(value, list) else _T_TUPLE]))
+            w.write(struct.pack("<I", len(value)))
+            for item in value:
+                self.pack(item)
+        elif isinstance(value, dict):
+            w.write(bytes([_T_DICT]))
+            w.write(struct.pack("<I", len(value)))
+            for k, v in value.items():
+                self.pack(k)
+                self.pack(v)
+        else:
+            raise DSSError(f"cannot pack value of type {type(value).__name__}")
+        return self
+
+    # -- unpack ---------------------------------------------------------
+
+    def _read(self, n: int) -> bytes:
+        # getbuffer() is a zero-copy view; only the n requested bytes are
+        # copied out (getvalue() would copy the whole buffer per record).
+        with self._w.getbuffer() as view:
+            if self._r + n > len(view):
+                raise DSSError("buffer underrun")
+            out = bytes(view[self._r:self._r + n])
+        self._r += n
+        return out
+
+    def unpack(self, expect: Optional[type] = None) -> Any:
+        tag = self._read(1)[0]
+        if tag == _T_NONE:
+            value: Any = None
+        elif tag == _T_BOOL:
+            value = bool(self._read(1)[0])
+        elif tag == _T_INT64:
+            value = struct.unpack("<q", self._read(8))[0]
+        elif tag == _T_FLOAT64:
+            value = struct.unpack("<d", self._read(8))[0]
+        elif tag == _T_STRING:
+            (n,) = struct.unpack("<I", self._read(4))
+            value = self._read(n).decode()
+        elif tag == _T_BYTES:
+            (n,) = struct.unpack("<I", self._read(4))
+            value = self._read(n)
+        elif tag == _T_NDARRAY:
+            (dn,) = struct.unpack("<B", self._read(1))
+            dt = np.dtype(self._read(dn).decode())
+            (ndim,) = struct.unpack("<B", self._read(1))
+            shape = struct.unpack(f"<{ndim}q", self._read(8 * ndim)) if ndim else ()
+            (nb,) = struct.unpack("<Q", self._read(8))
+            value = np.frombuffer(self._read(nb), dtype=dt).reshape(shape).copy()
+        elif tag in (_T_LIST, _T_TUPLE):
+            (n,) = struct.unpack("<I", self._read(4))
+            items = [self.unpack() for _ in range(n)]
+            value = items if tag == _T_LIST else tuple(items)
+        elif tag == _T_DICT:
+            (n,) = struct.unpack("<I", self._read(4))
+            value = {}
+            for _ in range(n):
+                k = self.unpack()
+                value[k] = self.unpack()
+        else:
+            raise DSSError(f"unknown type tag {tag}")
+        if expect is not None and not isinstance(value, expect):
+            raise DSSError(
+                f"type mismatch: expected {expect.__name__}, "
+                f"got {_NAMES.get(tag, tag)}")
+        return value
+
+    def remaining(self) -> int:
+        return len(self._w.getvalue()) - self._r
+
+    def bytes(self) -> bytes:
+        return self._w.getvalue()
+
+
+def pack(*values: Any) -> bytes:
+    buf = Buffer()
+    for v in values:
+        buf.pack(v)
+    return buf.bytes()
+
+
+def unpack(data: bytes, n: Optional[int] = None) -> list[Any]:
+    buf = Buffer(data)
+    out = []
+    while buf.remaining() and (n is None or len(out) < n):
+        out.append(buf.unpack())
+    return out

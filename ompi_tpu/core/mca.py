@@ -1,0 +1,230 @@
+"""Component/framework registry — the Modular Component Architecture, TPU-native.
+
+The reference's single most load-bearing design idea (opal/mca/mca.h:281-343,
+opal/mca/base/mca_base_framework.h:127-157, mca_base_components_select.c) is a
+uniform plugin system: every subsystem is a *framework* (a fixed interface)
+holding N *components* (implementations), selected at runtime by priority and
+user directives (``--mca coll xla``).
+
+Here a framework is a named registry of ``Component`` subclasses.  Instead of
+dlopen, components register via a decorator at import time; the selection
+algorithm (priority query, include/exclude lists from the ``<framework>``
+config variable, negation with ``^``) is preserved because it is what makes
+behavior-gated substitution (``--mca coll xla`` vs byte-identical fallback)
+possible — the north-star requirement of BASELINE.json.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional, Type
+
+from ompi_tpu.core.config import VarType, register_var, var_registry
+from ompi_tpu.core import output
+
+__all__ = ["Component", "Framework", "framework_registry", "ComponentError"]
+
+
+class ComponentError(RuntimeError):
+    pass
+
+
+class Component:
+    """Base class for all components (≈ mca_base_component_2_1_0_t).
+
+    Subclasses set ``NAME`` and ``PRIORITY`` and may override the lifecycle
+    hooks.  ``query()`` returns (priority, module): a component may decline
+    selection in the current context by returning None (≈ mca_query_component
+    returning OMPI_ERR_NOT_AVAILABLE).
+    """
+
+    NAME: str = ""
+    PRIORITY: int = 0
+    FRAMEWORK: str = ""  # filled in by Framework.component()
+
+    def register_params(self) -> None:
+        """Register this component's config vars (≈ mca_register_component_params)."""
+
+    def open(self) -> None:
+        """Called once when the framework opens (≈ mca_open_component)."""
+
+    def close(self) -> None:
+        """Called at framework close (≈ mca_close_component)."""
+
+    def query(self, **context: Any) -> Optional[int]:
+        """Return selection priority for this context, or None to decline."""
+        return self.PRIORITY
+
+    @property
+    def full_name(self) -> str:
+        return f"{self.FRAMEWORK}/{self.NAME}"
+
+
+class Framework:
+    """A plugin slot: fixed interface, N components, priority selection.
+
+    Selection directives come from the config variable named after the
+    framework (settable via ``--mca <fw> a,b`` / env / file):
+
+    - ``""``        → all components eligible, highest query() wins
+    - ``"xla"``     → only the listed component(s) eligible (error if none)
+    - ``"^xla"``    → all but the listed components eligible
+
+    This mirrors mca_base_components_select.c's include/exclude semantics.
+    """
+
+    def __init__(self, name: str, description: str = "") -> None:
+        self.name = name
+        self.description = description
+        self._components: dict[str, Component] = {}
+        self._lock = threading.RLock()
+        self._opened = False
+        self._opened_components: set[str] = set()
+        register_var(
+            name, "", VarType.STRING, "",
+            description=f"Component selection for the {name} framework "
+                        f"(comma list; prefix with ^ to exclude)",
+            synonyms=(name,),
+        )
+        framework_registry.add(self)
+
+    # -- registration ---------------------------------------------------
+
+    def component(self, cls: Type[Component]) -> Type[Component]:
+        """Class decorator registering a component with this framework."""
+        if not cls.NAME:
+            raise ComponentError(f"component {cls!r} has no NAME")
+        cls.FRAMEWORK = self.name
+        with self._lock:
+            if cls.NAME in self._components:
+                raise ComponentError(
+                    f"duplicate component {self.name}/{cls.NAME}")
+            inst = cls()
+            inst.register_params()
+            self._components[cls.NAME] = inst
+        return cls
+
+    def add_instance(self, inst: Component) -> None:
+        inst.FRAMEWORK = self.name
+        with self._lock:
+            self._components[inst.NAME] = inst
+            inst.register_params()
+            if self._opened:
+                inst.open()
+                self._opened_components.add(inst.NAME)
+
+    # -- lifecycle ------------------------------------------------------
+
+    def open(self) -> None:
+        """Open all currently-eligible components. Idempotent per component:
+        a component newly made eligible by a later directive change is opened
+        on the next open()/select() call; close() only closes what opened."""
+        with self._lock:
+            for comp in self._eligible():
+                if comp.NAME not in self._opened_components:
+                    comp.open()
+                    self._opened_components.add(comp.NAME)
+            self._opened = True
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._opened:
+                return
+            for name in self._opened_components:
+                self._components[name].close()
+            self._opened_components.clear()
+            self._opened = False
+
+    # -- selection ------------------------------------------------------
+
+    def _directive(self) -> tuple[set[str], bool]:
+        """Parse the selection variable → (names, is_exclude)."""
+        raw = (var_registry.get(f"{self.name}_") or "").strip()
+        if not raw:
+            return set(), True  # exclude-nothing == everything eligible
+        if raw.startswith("^"):
+            return {s.strip() for s in raw[1:].split(",") if s.strip()}, True
+        return {s.strip() for s in raw.split(",") if s.strip()}, False
+
+    def _eligible(self) -> list[Component]:
+        names, is_exclude = self._directive()
+        comps = []
+        for name, comp in self._components.items():
+            if is_exclude:
+                if name in names:
+                    continue
+            else:
+                if name not in names:
+                    continue
+            comps.append(comp)
+        if not is_exclude:
+            missing = names - set(self._components)
+            if missing:
+                output.show_help(
+                    "mca", "component-not-found",
+                    framework=self.name, components=", ".join(sorted(missing)),
+                    available=", ".join(sorted(self._components)),
+                )
+                raise ComponentError(
+                    f"requested {self.name} component(s) not found: "
+                    f"{sorted(missing)}")
+        return comps
+
+    def select(self, **context: Any) -> Component:
+        """Pick the single highest-priority component that accepts `context`."""
+        best = self.select_all(**context)
+        if not best:
+            raise ComponentError(
+                f"no {self.name} component available for context {context!r}")
+        return best[0]
+
+    def select_all(self, **context: Any) -> list[Component]:
+        """All accepting components, highest priority first (for stacked
+        frameworks like coll where modules layer per-function)."""
+        self.open()
+        scored = []
+        for comp in self._eligible():
+            pri = comp.query(**context)
+            if pri is None:
+                continue
+            scored.append((pri, comp))
+        scored.sort(key=lambda pc: (-pc[0], pc[1].NAME))
+        return [c for _, c in scored]
+
+    def components(self) -> dict[str, Component]:
+        with self._lock:
+            return dict(self._components)
+
+    def lookup(self, name: str) -> Component:
+        try:
+            return self._components[name]
+        except KeyError:
+            raise ComponentError(f"no component {self.name}/{name}") from None
+
+
+class _FrameworkRegistry:
+    """Global directory of frameworks (for the info tool and tests)."""
+
+    def __init__(self) -> None:
+        self._frameworks: dict[str, Framework] = {}
+        self._lock = threading.Lock()
+
+    def add(self, fw: Framework) -> None:
+        with self._lock:
+            if fw.name in self._frameworks:
+                raise ComponentError(f"duplicate framework {fw.name}")
+            self._frameworks[fw.name] = fw
+
+    def get(self, name: str) -> Framework:
+        return self._frameworks[name]
+
+    def all(self) -> dict[str, Framework]:
+        with self._lock:
+            return dict(self._frameworks)
+
+    def close_all(self) -> None:
+        for fw in self._frameworks.values():
+            fw.close()
+
+
+framework_registry = _FrameworkRegistry()

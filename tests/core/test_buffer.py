@@ -1,0 +1,47 @@
+"""Tests for the buffer-location abstraction."""
+
+import numpy as np
+import pytest
+
+from ompi_tpu.core.buffer import (
+    BufferKind, BufferLocationError, classify, is_device, nbytes_of,
+)
+
+
+def test_host_kinds():
+    assert classify(np.zeros(3)) == BufferKind.HOST
+    assert classify(b"abc") == BufferKind.HOST
+    assert classify(bytearray(2)) == BufferKind.HOST
+    assert classify(3.0) == BufferKind.HOST
+
+
+def test_device_kind():
+    import jax.numpy as jnp
+
+    x = jnp.zeros(4)
+    assert classify(x) == BufferKind.DEVICE
+    assert is_device(x)
+
+
+def test_traced_kind():
+    import jax
+
+    seen = []
+
+    @jax.jit
+    def f(x):
+        seen.append(classify(x))
+        return x
+
+    f(np.zeros(2, np.float32))
+    assert seen == [BufferKind.TRACED]
+
+
+def test_unknown_rejected():
+    with pytest.raises(BufferLocationError):
+        classify(object())
+
+
+def test_nbytes():
+    assert nbytes_of(np.zeros(4, np.float32)) == 16
+    assert nbytes_of(b"12345") == 5

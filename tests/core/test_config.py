@@ -1,0 +1,142 @@
+"""Tests for the typed config-variable registry (≈ mca_base_var tests)."""
+
+import os
+
+import pytest
+
+from ompi_tpu.core import config
+from ompi_tpu.core.config import (
+    InfoLevel, Var, VarRegistry, VarSource, VarType, register_var,
+)
+
+
+def test_register_and_default():
+    v = register_var("testfw", "alpha", VarType.INT, 42, "a test var")
+    assert v.value == 42
+    assert v.source == VarSource.DEFAULT
+    assert config.get_var("testfw_alpha") == 42
+
+
+def test_duplicate_registration_returns_existing():
+    v1 = register_var("testfw", "dup", VarType.INT, 1)
+    v2 = register_var("testfw", "dup", VarType.INT, 999)
+    assert v1 is v2
+    assert v2.value == 1
+
+
+def test_env_overrides_default(monkeypatch):
+    monkeypatch.setenv("OMPI_TPU_MCA_testfw_beta", "7")
+    reg = VarRegistry()
+    v = reg.register(Var("testfw", "beta", VarType.INT, 0))
+    assert v.value == 7
+    assert v.source == VarSource.ENV
+
+
+def test_cli_overrides_env(monkeypatch):
+    monkeypatch.setenv("OMPI_TPU_MCA_testfw_gamma", "7")
+    reg = VarRegistry()
+    reg.load_cli([("testfw_gamma", "9")])
+    v = reg.register(Var("testfw", "gamma", VarType.INT, 0))
+    assert v.value == 9
+    assert v.source == VarSource.COMMAND_LINE
+
+
+def test_cli_after_registration(monkeypatch):
+    reg = VarRegistry()
+    v = reg.register(Var("testfw", "late", VarType.INT, 0))
+    reg.load_cli([("testfw_late", "5")])
+    assert v.value == 5
+
+
+def test_file_source(tmp_path, monkeypatch):
+    conf = tmp_path / "params.conf"
+    conf.write_text("# comment\ntestfw_filed = 13  # trailing\n")
+    monkeypatch.setenv("OMPI_TPU_PARAM_FILE", str(conf))
+    reg = VarRegistry()
+    v = reg.register(Var("testfw", "filed", VarType.INT, 0))
+    assert v.value == 13
+    assert v.source == VarSource.FILE
+
+
+def test_env_beats_file(tmp_path, monkeypatch):
+    conf = tmp_path / "params.conf"
+    conf.write_text("testfw_prec = 1\n")
+    monkeypatch.setenv("OMPI_TPU_PARAM_FILE", str(conf))
+    monkeypatch.setenv("OMPI_TPU_MCA_testfw_prec", "2")
+    reg = VarRegistry()
+    v = reg.register(Var("testfw", "prec", VarType.INT, 0))
+    assert v.value == 2
+
+
+def test_set_wins(monkeypatch):
+    monkeypatch.setenv("OMPI_TPU_MCA_testfw_sv", "2")
+    reg = VarRegistry()
+    v = reg.register(Var("testfw", "sv", VarType.INT, 0))
+    reg.set("testfw_sv", 11)
+    assert v.value == 11
+    assert v.source == VarSource.SET
+
+
+def test_size_parsing():
+    reg = VarRegistry()
+    v = reg.register(Var("testfw", "sz", VarType.SIZE, 0))
+    reg.set("testfw_sz", "64K")
+    assert v.value == 64 * 1024
+    reg.set("testfw_sz", "2M")
+    assert v.value == 2 * 1024 * 1024
+
+
+def test_bool_parsing():
+    reg = VarRegistry()
+    v = reg.register(Var("testfw", "b", VarType.BOOL, False))
+    for raw, want in [("1", True), ("no", False), ("on", True), ("false", False)]:
+        reg.set("testfw_b", raw)
+        assert v.value is want
+    with pytest.raises(ValueError):
+        reg.set("testfw_b", "maybe")
+
+
+def test_string_list():
+    reg = VarRegistry()
+    v = reg.register(Var("testfw", "lst", VarType.STRING_LIST, []))
+    reg.set("testfw_lst", "xla, host ,tuned")
+    assert v.value == ["xla", "host", "tuned"]
+
+
+def test_enumerator_check():
+    reg = VarRegistry()
+    reg.register(Var("testfw", "en", VarType.STRING, "a", enumerator=("a", "b")))
+    with pytest.raises(ValueError):
+        reg.set("testfw_en", "c")
+
+
+def test_read_only():
+    reg = VarRegistry()
+    reg.register(Var("testfw", "ro", VarType.INT, 5, read_only=True))
+    with pytest.raises(ValueError):
+        reg.set("testfw_ro", 6)
+
+
+def test_synonyms(monkeypatch):
+    monkeypatch.setenv("OMPI_TPU_MCA_old_name", "3")
+    reg = VarRegistry()
+    v = reg.register(Var("testfw", "newname", VarType.INT, 0, synonyms=("old_name",)))
+    # env lookup uses canonical name only; synonym works through pending/file/cli
+    reg.load_cli([("old_name", "4")])
+    assert reg.get("old_name") == 4
+    assert v.value == 4
+
+
+def test_dump_contains_vars():
+    reg = VarRegistry()
+    reg.register(Var("testfw", "dumped", VarType.INT, 5, description="hello"))
+    text = reg.dump()
+    assert "testfw_dumped" in text and "hello" in text
+
+
+def test_info_levels_filter_dump():
+    reg = VarRegistry()
+    reg.register(Var("fw", "basic", VarType.INT, 1, info_level=InfoLevel.USER_BASIC))
+    reg.register(Var("fw", "dev", VarType.INT, 1, info_level=InfoLevel.DEV_ALL))
+    text = reg.dump(max_level=InfoLevel.USER_BASIC)
+    assert "fw_basic" in text and "fw_dev" not in text

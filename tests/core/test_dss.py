@@ -1,0 +1,68 @@
+"""Tests for DSS serialization (≈ test/dss/)."""
+
+import numpy as np
+import pytest
+
+from ompi_tpu.core.dss import Buffer, DSSError, pack, unpack
+
+
+def roundtrip(*values):
+    return unpack(pack(*values))
+
+
+def test_scalars():
+    assert roundtrip(42, -7, 3.5, True, False, None) == [42, -7, 3.5, True, False, None]
+
+
+def test_strings_and_bytes():
+    vals = ["hello", "", "üñïçødé", b"\x00\xff raw"]
+    assert roundtrip(*vals) == vals
+
+
+def test_containers():
+    v = {"a": [1, 2, {"n": None}], "t": (1, "x"), "b": b"z"}
+    (out,) = roundtrip(v)
+    assert out == v
+    assert isinstance(out["t"], tuple)
+
+
+def test_ndarray_roundtrip():
+    for dt in (np.float32, np.int64, np.uint8, np.complex64):
+        arr = (np.arange(24).reshape(2, 3, 4) % 7).astype(dt)
+        (out,) = roundtrip(arr)
+        np.testing.assert_array_equal(out, arr)
+        assert out.dtype == arr.dtype
+
+
+def test_ndarray_zero_dim():
+    arr = np.float64(3.25)
+    (out,) = roundtrip(np.asarray(arr))
+    assert out.shape == () and out == 3.25
+
+
+def test_noncontiguous_array_packed_contiguously():
+    arr = np.arange(100).reshape(10, 10)[::2, ::3]
+    (out,) = roundtrip(arr)
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_type_checked_unpack():
+    buf = Buffer(pack(5))
+    with pytest.raises(DSSError):
+        buf.unpack(expect=str)
+
+
+def test_underrun():
+    buf = Buffer(pack(12345)[:-2])
+    with pytest.raises(DSSError):
+        buf.unpack()
+
+
+def test_unpackable_type_rejected():
+    with pytest.raises(DSSError):
+        pack(object())
+
+
+def test_streaming_partial_unpack():
+    data = pack(1, "two", 3.0)
+    assert unpack(data, n=2) == [1, "two"]

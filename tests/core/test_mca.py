@@ -1,0 +1,116 @@
+"""Tests for the component/framework registry (≈ mca_base_components_select)."""
+
+import pytest
+
+from ompi_tpu.core import config
+from ompi_tpu.core.mca import Component, ComponentError, Framework
+
+
+def _mkfw(name):
+    fw = Framework(name, "test framework")
+
+    @fw.component
+    class Low(Component):
+        NAME = "low"
+        PRIORITY = 10
+
+    @fw.component
+    class High(Component):
+        NAME = "high"
+        PRIORITY = 50
+
+    @fw.component
+    class Picky(Component):
+        NAME = "picky"
+        PRIORITY = 90
+
+        def query(self, **ctx):
+            return self.PRIORITY if ctx.get("special") else None
+
+    return fw
+
+
+def test_priority_selection():
+    fw = _mkfw("tfw_sel")
+    assert fw.select().NAME == "high"
+
+
+def test_query_context_gating():
+    fw = _mkfw("tfw_ctx")
+    assert fw.select(special=True).NAME == "picky"
+    assert fw.select(special=False).NAME == "high"
+
+
+def test_select_all_ordering():
+    fw = _mkfw("tfw_all")
+    names = [c.NAME for c in fw.select_all(special=True)]
+    assert names == ["picky", "high", "low"]
+
+
+def test_include_directive():
+    fw = _mkfw("tfw_inc")
+    config.set_var("tfw_inc_", "low")
+    assert fw.select().NAME == "low"
+
+
+def test_exclude_directive():
+    fw = _mkfw("tfw_exc")
+    config.set_var("tfw_exc_", "^high")
+    assert fw.select(special=True).NAME == "picky"
+    config.set_var("tfw_exc_", "^high,picky")
+    assert fw.select(special=True).NAME == "low"
+
+
+def test_missing_requested_component_errors():
+    fw = _mkfw("tfw_miss")
+    config.set_var("tfw_miss_", "nonexistent")
+    with pytest.raises(ComponentError):
+        fw.select()
+
+
+def test_duplicate_component_rejected():
+    fw = Framework("tfw_dup")
+
+    @fw.component
+    class A(Component):
+        NAME = "a"
+
+    with pytest.raises(ComponentError):
+        @fw.component
+        class A2(Component):
+            NAME = "a"
+
+
+def test_lifecycle_hooks():
+    fw = Framework("tfw_life")
+    events = []
+
+    @fw.component
+    class C(Component):
+        NAME = "c"
+        PRIORITY = 1
+
+        def open(self):
+            events.append("open")
+
+        def close(self):
+            events.append("close")
+
+    fw.open()
+    fw.open()  # idempotent
+    fw.close()
+    assert events == ["open", "close"]
+
+
+def test_no_component_available():
+    fw = Framework("tfw_none")
+
+    @fw.component
+    class Decliner(Component):
+        NAME = "d"
+
+        def query(self, **ctx):
+            return None
+
+    with pytest.raises(ComponentError):
+        fw.select()

@@ -1,0 +1,39 @@
+"""Tests for output streams and show_help aggregation."""
+
+from ompi_tpu.core import config, output
+
+
+def test_stream_verbosity_gating(capsys):
+    st = output.get_stream("tst_stream")
+    st.verbose(1, "hidden %d", 1)
+    assert "hidden" not in capsys.readouterr().err
+    config.set_var("output_tst_stream_verbose", 5)
+    st.verbose(1, "shown %d", 2)
+    assert "shown 2" in capsys.readouterr().err
+
+
+def test_stream_identity_cached():
+    assert output.get_stream("tst_same") is output.get_stream("tst_same")
+
+
+def test_help_text_substitution():
+    text = output.help_text(
+        "mca", "component-not-found",
+        framework="coll", components="zzz", available="xla, host")
+    assert "coll" in text and "zzz" in text
+
+
+def test_show_help_dedup(capsys):
+    output.flush_help_counts()
+    for _ in range(3):
+        output.show_help("mca", "framework-no-selection", framework="pml")
+    err = capsys.readouterr().err
+    assert err.count("pml") == 1
+    counts = output.flush_help_counts()
+    assert ("mca", "framework-no-selection", 2) in counts
+
+
+def test_show_help_missing_topic_does_not_raise(capsys):
+    output.flush_help_counts()
+    output.show_help("no-such-topic", "tag")
+    assert "missing help text" in capsys.readouterr().err
