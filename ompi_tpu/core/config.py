@@ -211,10 +211,15 @@ class VarRegistry:
             self._vars[var.full_name] = var
             for syn in var.synonyms:
                 self._synonyms[syn] = var.full_name
-            # precedence: file < env < cli; _pending holds file+cli, env is live
-            pend = self._pending.get(var.full_name)
-            for syn in var.synonyms:
-                pend = pend or self._pending.get(syn)
+            # precedence: file < env < cli; _pending holds file+cli, env is
+            # live.  Among canonical name + synonyms, the highest-precedence
+            # source wins (a CLI setting under a synonym must beat a file
+            # setting under the canonical name).
+            pend = None
+            for cand in (var.full_name, *var.synonyms):
+                p = self._pending.get(cand)
+                if p is not None and (pend is None or p[1].value > pend[1].value):
+                    pend = p
             if pend is not None and pend[1] == VarSource.FILE:
                 self._apply(var, pend[0], VarSource.FILE)
             env_raw = os.environ.get(self.ENV_PREFIX + var.full_name)

@@ -159,7 +159,8 @@ class Buffer:
         return value
 
     def remaining(self) -> int:
-        return len(self._w.getvalue()) - self._r
+        with self._w.getbuffer() as view:  # zero-copy size probe
+            return len(view) - self._r
 
     def bytes(self) -> bytes:
         return self._w.getvalue()

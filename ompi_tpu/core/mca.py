@@ -107,6 +107,9 @@ class Framework:
     def add_instance(self, inst: Component) -> None:
         inst.FRAMEWORK = self.name
         with self._lock:
+            if inst.NAME in self._components:
+                raise ComponentError(
+                    f"duplicate component {self.name}/{inst.NAME}")
             self._components[inst.NAME] = inst
             inst.register_params()
             if self._opened:
@@ -149,7 +152,9 @@ class Framework:
     def _eligible(self) -> list[Component]:
         names, is_exclude = self._directive()
         comps = []
-        for name, comp in self._components.items():
+        with self._lock:
+            components = dict(self._components)
+        for name, comp in components.items():
             if is_exclude:
                 if name in names:
                     continue
@@ -158,12 +163,12 @@ class Framework:
                     continue
             comps.append(comp)
         if not is_exclude:
-            missing = names - set(self._components)
+            missing = names - set(components)
             if missing:
                 output.show_help(
                     "mca", "component-not-found",
                     framework=self.name, components=", ".join(sorted(missing)),
-                    available=", ".join(sorted(self._components)),
+                    available=", ".join(sorted(components)),
                 )
                 raise ComponentError(
                     f"requested {self.name} component(s) not found: "

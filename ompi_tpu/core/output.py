@@ -21,8 +21,6 @@ from __future__ import annotations
 import os
 import sys
 import threading
-import time
-from typing import Optional
 
 __all__ = ["Stream", "get_stream", "show_help", "ShowHelpError", "help_text"]
 
@@ -100,10 +98,14 @@ def help_text(topic: str, tag: str, **subst: object) -> str:
     if not out and not in_section:
         raise ShowHelpError(f"no [{tag}] section in help-{topic}.txt")
     body = "\n".join(out).strip("\n")
-    try:
-        return body % subst if subst else body
-    except (KeyError, ValueError):
+    if not subst:
         return body
+    try:
+        return body % subst
+    except (KeyError, ValueError) as e:
+        # Template/call-site drift must stay visible, not print raw %(x)s.
+        return (body + f"\n[show_help: substitution failed for "
+                       f"help-{topic}.txt [{tag}]: {e!r}; args={subst}]")
 
 
 def show_help(topic: str, tag: str, want_error_header: bool = True,
@@ -126,10 +128,10 @@ def show_help(topic: str, tag: str, want_error_header: bool = True,
         body = help_text(topic, tag, **subst)
     except ShowHelpError:
         body = f"(missing help text: topic={topic} tag={tag} args={subst})"
-    bar = "-" * 76
-    hdr = f"{bar}\n" if want_error_header else ""
-    print(f"{hdr}{body}\n{bar}" if want_error_header else body,
-          file=sys.stderr, flush=True)
+    if want_error_header:
+        bar = "-" * 76
+        body = f"{bar}\n{body}\n{bar}"
+    print(body, file=sys.stderr, flush=True)
 
 
 def flush_help_counts() -> list[tuple[str, str, int]]:

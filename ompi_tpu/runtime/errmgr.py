@@ -1,0 +1,56 @@
+"""Errmgr — failure response policy.
+
+≈ orte/mca/errmgr (errmgr.h:87-136; default_hnp behavior at
+errmgr_default_hnp.c:351-470: on proc abort / comm failure, terminate the
+job).  Components decide what a proc-failure event does:
+
+- ``abort``    — default: first failure kills every remaining proc and the
+  job exits with the failed proc's status (mpirun's default).
+- ``continue`` — log and keep going (the resilient-mapping hook point; a
+  future component can respawn, ≈ rmaps/resilient + errmgr restart paths).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+from ompi_tpu.core import output
+from ompi_tpu.core.mca import Component, Framework
+from ompi_tpu.runtime.job import Job, Proc, ProcState
+
+if TYPE_CHECKING:
+    from ompi_tpu.runtime.launcher import LocalLauncher
+
+__all__ = ["errmgr_framework", "ErrmgrAbort"]
+
+_log = output.get_stream("errmgr")
+
+errmgr_framework = Framework("errmgr", "failure response policy")
+
+
+@errmgr_framework.component
+class ErrmgrAbort(Component):
+    NAME = "abort"
+    PRIORITY = 10
+
+    def proc_failed(self, launcher: "LocalLauncher", job: Job, proc: Proc) -> None:
+        if job.aborted_proc is None:
+            job.aborted_proc = proc
+            job.abort_reason = (
+                f"rank {proc.rank} {proc.state.value} "
+                f"(exit code {proc.exit_code})")
+        _log.verbose(1, "aborting job %d: %s", job.jobid, job.abort_reason)
+        launcher.kill_job(job, exclude=proc)
+
+
+@errmgr_framework.component
+class ErrmgrContinue(Component):
+    NAME = "continue"
+    PRIORITY = 0
+
+    def query(self, **ctx):
+        return self.PRIORITY
+
+    def proc_failed(self, launcher: "LocalLauncher", job: Job, proc: Proc) -> None:
+        _log.verbose(1, "rank %d failed (%s); continuing per policy",
+                     proc.rank, proc.state.value)

@@ -1,0 +1,213 @@
+"""Local process launcher: fork/exec + IOF forwarding + state machine.
+
+The HNP role of the reference, collapsed to one host: orterun's event-driven
+launch DAG (orte/mca/state/hnp/state_hnp.c:74-112:
+INIT→ALLOCATE→MAP→LAUNCH_APPS→RUNNING→TERMINATED), odls's fork/exec with
+error reporting (orte/mca/odls/default/odls_default_module.c:47-56,140), and
+iof's stdout/stderr forwarding with rank tagging (orte/mca/iof).
+
+Multi-host launch (the reference's plm/rsh ssh tree) is out of scope for the
+local launcher; the TPU analog — one launcher per TPU host, coordinated via
+jax.distributed — plugs in as a different plm component later, reusing this
+state machine.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Optional
+
+from ompi_tpu.core import output
+from ompi_tpu.core.config import VarType, register_var, var_registry
+from ompi_tpu.runtime import errmgr as errmgr_mod
+from ompi_tpu.runtime import pmix, ras, rmaps
+from ompi_tpu.runtime.job import AppContext, Job, JobState, Proc, ProcState
+from ompi_tpu.runtime.state import StateMachine
+
+__all__ = ["LocalLauncher", "launch"]
+
+_log = output.get_stream("launcher")
+
+register_var("launcher", "tag_output", VarType.BOOL, True,
+             "prefix forwarded stdout/stderr with [jobid,rank]")
+register_var("launcher", "kill_grace_s", VarType.DOUBLE, 2.0,
+             "seconds between SIGTERM and SIGKILL when aborting a job")
+
+
+class LocalLauncher:
+    """Launches a job's ranks as local OS processes (device-per-rank aware)."""
+
+    def __init__(self, want_tpu: bool = False, **select_ctx) -> None:
+        self.want_tpu = want_tpu
+        self.select_ctx = select_ctx
+        self.sm = StateMachine()
+        self.sm.add_state(JobState.INIT, self._st_init)
+        self.sm.add_state(JobState.ALLOCATE, self._st_allocate)
+        self.sm.add_state(JobState.MAP, self._st_map)
+        self.sm.add_state(JobState.LAUNCH_APPS, self._st_launch)
+        self.sm.add_state(JobState.RUNNING, self._st_running)
+        self.server: Optional[pmix.PMIxServer] = None
+        self._popen: dict[int, subprocess.Popen] = {}
+        self._iof_threads: list[threading.Thread] = []
+        self._errmgr = errmgr_mod.errmgr_framework.select(**select_ctx)
+        self._kill_lock = threading.Lock()
+
+    # -- state handlers (the launch DAG) ---------------------------------
+
+    def _st_init(self, sm: StateMachine, job: Job) -> JobState:
+        return JobState.ALLOCATE
+
+    def _st_allocate(self, sm: StateMachine, job: Job) -> JobState:
+        ras.allocate(job, want_tpu=self.want_tpu, **self.select_ctx)
+        return JobState.MAP
+
+    def _st_map(self, sm: StateMachine, job: Job) -> JobState:
+        rmaps.map_job(job, **self.select_ctx)
+        return JobState.LAUNCH_APPS
+
+    def _st_launch(self, sm: StateMachine, job: Job) -> JobState:
+        self.server = pmix.PMIxServer(
+            size=job.np, on_abort=lambda r, s, m: self._on_abort(job, r, s, m))
+        for proc in job.procs:
+            app = job.apps[proc.app_idx]
+            env = dict(os.environ)
+            env.update(app.env)
+            env[pmix.ENV_URI] = self.server.uri
+            env[pmix.ENV_RANK] = str(proc.rank)
+            env[pmix.ENV_SIZE] = str(job.np)
+            env[pmix.ENV_JOBID] = str(job.jobid)
+            env[pmix.ENV_LOCAL_RANK] = str(proc.local_rank)
+            if proc.chip is not None:
+                env[pmix.ENV_CHIP] = str(proc.chip)
+            try:
+                p = subprocess.Popen(
+                    app.argv, env=env, cwd=app.cwd,
+                    stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                    start_new_session=True)
+            except OSError as e:
+                # ≈ odls error-pipe protocol: exec failure surfaces here
+                proc.state = ProcState.FAILED_TO_START
+                proc.exit_code = 127
+                output.show_help(
+                    "launcher", "failed-to-start",
+                    rank=proc.rank, argv0=app.argv[0], error=str(e))
+                self._errmgr.proc_failed(self, job, proc)
+                return JobState.ABORTED
+            proc.pid = p.pid
+            proc.state = ProcState.RUNNING
+            self._popen[proc.rank] = p
+            self._start_iof(job, proc, p)
+        return JobState.RUNNING
+
+    def _st_running(self, sm: StateMachine, job: Job) -> Optional[JobState]:
+        # Reap children; first abnormal exit triggers the errmgr policy.
+        pending = dict(self._popen)
+        while pending:
+            for rank, p in list(pending.items()):
+                rc = p.poll()
+                if rc is None:
+                    continue
+                proc = job.procs[rank]
+                proc.exit_code = rc
+                if proc.state == ProcState.KILLED_BY_CMD:
+                    pass  # we killed it during abort
+                elif rc == 0:
+                    proc.state = ProcState.TERMINATED
+                else:
+                    proc.state = ProcState.ABORTED
+                    self._errmgr.proc_failed(self, job, proc)
+                del pending[rank]
+            if pending:
+                time.sleep(0.01)
+        for t in self._iof_threads:
+            t.join(timeout=2.0)
+        if self.server is not None:
+            self.server.close()
+        return (JobState.ABORTED if job.aborted_proc is not None
+                else JobState.TERMINATED)
+
+    # -- IOF --------------------------------------------------------------
+
+    def _start_iof(self, job: Job, proc: Proc, p: subprocess.Popen) -> None:
+        tag = var_registry.get("launcher_tag_output")
+
+        def reader(pipe, sink):
+            prefix = f"[{job.jobid},{proc.rank}]" if tag else ""
+            for raw in iter(pipe.readline, b""):
+                line = raw.decode(errors="replace")
+                sink.write(f"{prefix}{line}" if prefix else line)
+                sink.flush()
+            pipe.close()
+
+        for pipe, sink in ((p.stdout, sys.stdout), (p.stderr, sys.stderr)):
+            t = threading.Thread(target=reader, args=(pipe, sink), daemon=True)
+            t.start()
+            self._iof_threads.append(t)
+
+    # -- abort path --------------------------------------------------------
+
+    def _on_abort(self, job: Job, rank: int, status: int, msg: str) -> None:
+        proc = job.procs[rank]
+        if job.aborted_proc is None:
+            job.aborted_proc = proc
+            job.abort_reason = f"rank {rank} called abort: {msg}"
+            job.abort_status = status
+        # The aborting rank asked for job teardown; it gets killed too (its
+        # requested status is preserved via job.abort_status).
+        self.kill_job(job)
+
+    def kill_job(self, job: Job, exclude: Optional[Proc] = None) -> None:
+        """SIGTERM all live ranks, then SIGKILL stragglers after a grace."""
+        with self._kill_lock:
+            victims = []
+            for rank, p in self._popen.items():
+                proc = job.procs[rank]
+                if proc is exclude or p.poll() is not None:
+                    continue
+                proc.state = ProcState.KILLED_BY_CMD
+                try:
+                    os.killpg(p.pid, signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    continue
+                victims.append(p)
+        if not victims:
+            return
+        deadline = time.monotonic() + var_registry.get("launcher_kill_grace_s")
+        for p in victims:
+            remaining = deadline - time.monotonic()
+            try:
+                p.wait(timeout=max(0.0, remaining))
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+
+    # -- entry -------------------------------------------------------------
+
+    def run(self, job: Job) -> int:
+        """Drive the job to completion; return the job exit code."""
+        self.sm.run_to_completion(job, JobState.INIT)
+        if job.aborted_proc is not None:
+            output.show_help(
+                "launcher", "job-aborted",
+                jobid=job.jobid, reason=job.abort_reason or "unknown")
+            if job.abort_status is not None:
+                return job.abort_status or 1
+            rc = job.aborted_proc.exit_code or 1
+            # signal death: report the shell convention 128+signum, not a
+            # negative value that the OS would truncate meaninglessly
+            return 128 - rc if rc < 0 else rc
+        return 0
+
+
+def launch(argv: list[str], np: int, want_tpu: bool = False,
+           env: Optional[dict[str, str]] = None, **select_ctx) -> int:
+    """One-call launch: build the job, run it, return exit code."""
+    job = Job([AppContext(argv=argv, np=np, env=env or {})])
+    return LocalLauncher(want_tpu=want_tpu, **select_ctx).run(job)

@@ -1,0 +1,1 @@
+"""Command-line tools (≈ orte/tools + ompi/tools): tpurun, ompi-tpu-info."""

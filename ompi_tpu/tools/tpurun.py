@@ -1,0 +1,68 @@
+"""tpurun — the mpirun equivalent.
+
+≈ orte/tools/orterun (orterun.c:131-236): parse the command line, apply
+--mca directives, build the job, drive the launch state machine, forward
+output, propagate the first failure's exit code.
+
+    tpurun -np 4 python ring.py
+    tpurun -np 8 --mca coll host --tpu python app.py
+    tpurun -np 4 --hostfile hf --map-by bynode ./a.out args...
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from ompi_tpu.core.config import var_registry
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpurun",
+        description="Launch an ompi_tpu job (mpirun equivalent).")
+    p.add_argument("-np", "-n", type=int, default=1, dest="np",
+                   help="number of ranks to launch")
+    p.add_argument("--mca", nargs=2, action="append", default=[],
+                   metavar=("PARAM", "VALUE"),
+                   help="set a config variable (repeatable)")
+    p.add_argument("--tpu", action="store_true",
+                   help="map ranks 1:1 onto local TPU chips")
+    p.add_argument("--hostfile", default=None, help="hostfile path")
+    p.add_argument("--map-by", default=None, choices=["byslot", "bynode"],
+                   help="round-robin mapping policy")
+    p.add_argument("--tag-output", dest="tag", action="store_true",
+                   default=None, help="tag output lines with [jobid,rank]")
+    p.add_argument("--no-tag-output", dest="tag", action="store_false")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="program and arguments to launch")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if not args.command:
+        print("tpurun: no command given (try: tpurun -np 4 python app.py)",
+              file=sys.stderr)
+        return 2
+    cmd = args.command
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+
+    # CLI --mca pairs get top precedence; framework-selection vars use the
+    # bare framework name (e.g. --mca coll xla → synonym of coll_).
+    var_registry.load_cli([(k, v) for k, v in args.mca])
+    if args.map_by:
+        var_registry.load_cli([("rmaps_rr_policy", args.map_by)])
+    if args.tag is not None:
+        var_registry.load_cli([("launcher_tag_output", "1" if args.tag else "0")])
+    if args.hostfile:
+        var_registry.load_cli([("ras_hostfile", args.hostfile)])
+
+    from ompi_tpu.runtime.launcher import launch
+
+    return launch(cmd, np=args.np, want_tpu=args.tpu)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

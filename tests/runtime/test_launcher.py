@@ -1,0 +1,116 @@
+"""Integration tests: tpurun launching real multi-rank jobs on localhost.
+
+≈ the reference's test/mpi/run_tests + examples-as-smoke-suite approach
+(oversubscribed localhost launch, SURVEY.md §4 mechanism 2).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def tpurun(*args, timeout=60):
+    env = dict(os.environ)
+    env.pop("OMPI_TPU_RANK", None)
+    # keep children light: no jax in these tests
+    return subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.tpurun", *args],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO)
+
+
+def test_hello_4_ranks():
+    r = tpurun("-np", "4", "--", sys.executable, "-c",
+               "import os; print('hello from', os.environ['OMPI_TPU_RANK'])")
+    assert r.returncode == 0, r.stderr
+    for rank in range(4):
+        assert f"hello from {rank}" in r.stdout
+
+
+def test_output_tagged_with_rank():
+    r = tpurun("-np", "2", "--", sys.executable, "-c", "print('x')")
+    assert r.returncode == 0
+    lines = [l for l in r.stdout.splitlines() if "]x" in l or "] x" in l or "x" in l]
+    assert any(l.startswith("[") and ",0]" in l for l in r.stdout.splitlines())
+    assert any(",1]" in l for l in r.stdout.splitlines())
+
+
+def test_no_tag_output():
+    r = tpurun("-np", "1", "--no-tag-output", "--", sys.executable, "-c",
+               "print('plain')")
+    assert r.returncode == 0
+    assert "plain\n" in r.stdout
+    assert "[" not in r.stdout.split("plain")[0]
+
+
+def test_nonzero_exit_propagates():
+    r = tpurun("-np", "3", "--", sys.executable, "-c",
+               "import os, sys, time\n"
+               "rank = int(os.environ['OMPI_TPU_RANK'])\n"
+               "if rank == 1: sys.exit(7)\n"
+               "time.sleep(30)")
+    assert r.returncode == 7
+    assert "aborted" in r.stderr.lower()
+
+
+def test_failed_to_start():
+    r = tpurun("-np", "2", "--", "/nonexistent/binary")
+    assert r.returncode != 0
+    assert "failed to start" in r.stderr.lower() or "could not execute" in r.stderr.lower()
+
+
+def test_modex_through_pmix():
+    prog = (
+        "import os\n"
+        "from ompi_tpu.runtime.pmix import PMIxClient\n"
+        "c = PMIxClient()\n"
+        "c.put('card', f'addr-of-{c.rank}')\n"
+        "data = c.fence(collect=True)\n"
+        "peer = (c.rank + 1) % c.size\n"
+        "assert data[f'card@{peer}'] == f'addr-of-{peer}', data\n"
+        "print(f'rank {c.rank} saw peer {peer}')\n"
+        "c.finalize()\n"
+    )
+    r = tpurun("-np", "4", "--", sys.executable, "-c", prog)
+    assert r.returncode == 0, r.stderr
+    for rank in range(4):
+        assert f"rank {rank} saw peer" in r.stdout
+
+
+def test_app_abort_kills_job():
+    prog = (
+        "import os, time\n"
+        "from ompi_tpu.runtime.pmix import PMIxClient\n"
+        "c = PMIxClient()\n"
+        "if c.rank == 2:\n"
+        "    c.abort('deliberate', status=5)\n"
+        "time.sleep(30)\n"
+    )
+    r = tpurun("-np", "3", "--", sys.executable, "-c", prog, timeout=25)
+    assert r.returncode != 0
+    assert "abort" in r.stderr.lower()
+
+
+def test_mca_directive_reaches_children():
+    prog = (
+        "from ompi_tpu.core.config import register_var\n"
+        "v = register_var('tlnch', 'knob', 'int', 0)\n"
+        "print('knob =', v.value)\n"
+    )
+    env = dict(os.environ)
+    env["OMPI_TPU_MCA_tlnch_knob"] = "5"
+    r = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-np", "1", "--",
+         sys.executable, "-c", prog],
+        capture_output=True, text=True, timeout=60, env=env, cwd=REPO)
+    assert r.returncode == 0, r.stderr
+    assert "knob = 5" in r.stdout
+
+
+def test_no_command_is_usage_error():
+    r = tpurun("-np", "2")
+    assert r.returncode == 2
+    assert "no command" in r.stderr.lower()

@@ -1,0 +1,131 @@
+"""Tests for the rendezvous/modex service (put/get/fence/abort)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from ompi_tpu.runtime.pmix import PMIxClient, PMIxError, PMIxServer
+
+
+@pytest.fixture
+def server():
+    srv = PMIxServer(size=3)
+    yield srv
+    srv.close()
+
+
+def clients(server, n=3):
+    return [PMIxClient(uri=server.uri, rank=r, size=n) for r in range(n)]
+
+
+def test_put_get(server):
+    c0, c1, c2 = clients(server)
+    c0.put("card", {"host": "a", "port": 1})
+    assert c1.get("card", rank=0) == {"host": "a", "port": 1}
+    # local fast path
+    assert c0.get("card", rank=0) == {"host": "a", "port": 1}
+
+
+def test_get_blocks_until_put(server):
+    c0, c1, _ = clients(server)
+    result = {}
+
+    def getter():
+        result["v"] = c1.get("late", rank=0, timeout=5)
+
+    t = threading.Thread(target=getter)
+    t.start()
+    c0.put("late", 42)
+    t.join(timeout=5)
+    assert result["v"] == 42
+
+
+def test_get_timeout(server):
+    (c0, *_ ) = clients(server)
+    with pytest.raises(TimeoutError):
+        c0.get("never", rank=2, timeout=0.2)
+
+
+def test_fence_all_ranks(server):
+    cs = clients(server)
+    arrived = []
+
+    def fencer(c):
+        c.fence()
+        arrived.append(c.rank)
+
+    ts = [threading.Thread(target=fencer, args=(c,)) for c in cs]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=5)
+    assert sorted(arrived) == [0, 1, 2]
+
+
+def test_fence_collect_returns_modex(server):
+    cs = clients(server)
+    for c in cs:
+        c.put("addr", f"host{c.rank}")
+    out = {}
+
+    def fencer(c):
+        out[c.rank] = c.fence(collect=True)
+
+    ts = [threading.Thread(target=fencer, args=(c,)) for c in cs]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=5)
+    assert out[1]["addr@0"] == "host0"
+    assert out[0]["addr@2"] == "host2"
+
+
+def test_two_consecutive_fences(server):
+    cs = clients(server)
+
+    def worker(c):
+        c.fence()
+        c.fence()
+
+    ts = [threading.Thread(target=worker, args=(c,)) for c in cs]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=5)
+    assert all(not t.is_alive() for t in ts)
+
+
+def test_abort_wakes_blocked_get(server):
+    aborts = []
+    server.on_abort = lambda r, s, m: aborts.append((r, s, m))
+    c0, c1, _ = clients(server)
+    errs = []
+
+    def getter():
+        try:
+            c1.get("never", rank=0, timeout=10)
+        except PMIxError as e:
+            errs.append(str(e))
+
+    t = threading.Thread(target=getter)
+    t.start()
+    c0.abort("something broke", status=3)
+    t.join(timeout=5)
+    assert errs and "rank 0" in errs[0]
+    assert aborts == [(0, 3, "something broke")]
+
+
+def test_ndarray_values(server):
+    c0, c1, _ = clients(server)
+    arr = np.arange(1000, dtype=np.float32)
+    c0.put("weights", arr)
+    np.testing.assert_array_equal(c1.get("weights", rank=0), arr)
+
+
+def test_host_side_publish_lookup(server):
+    c0, *_ = clients(server)
+    server.publish("global_key", "from-hnp")
+    assert c0.get("global_key", rank=-1) == "from-hnp"
+    c0.put("k", 9)
+    assert server.lookup("k", rank=0) == 9

@@ -1,0 +1,61 @@
+"""Tests for the data-driven job state machine."""
+
+import pytest
+
+from ompi_tpu.runtime.job import AppContext, Job, JobState
+from ompi_tpu.runtime.state import StateMachine, StateMachineError
+
+
+def mkjob(np=2):
+    return Job([AppContext(argv=["true"], np=np)])
+
+
+def test_linear_dag():
+    sm = StateMachine()
+    sm.add_state(JobState.INIT, lambda s, j: JobState.ALLOCATE)
+    sm.add_state(JobState.ALLOCATE, lambda s, j: JobState.MAP)
+    sm.add_state(JobState.MAP, lambda s, j: JobState.TERMINATED)
+    job = sm.run_to_completion(mkjob())
+    assert job.state == JobState.TERMINATED
+    assert sm.trace == [JobState.INIT, JobState.ALLOCATE, JobState.MAP,
+                        JobState.TERMINATED]
+
+
+def test_handler_pause_and_external_activation():
+    sm = StateMachine()
+    sm.add_state(JobState.INIT, lambda s, j: None)  # pause
+    job = mkjob()
+    sm.run_to_completion(job)
+    assert job.state == JobState.INIT
+    sm.activate(job, JobState.TERMINATED)
+    sm.run_pending()
+    assert job.state == JobState.TERMINATED
+
+
+def test_missing_handler_raises():
+    sm = StateMachine()
+    sm.add_state(JobState.INIT, lambda s, j: JobState.MAP)
+    with pytest.raises(StateMachineError):
+        sm.run_to_completion(mkjob())
+
+
+def test_terminal_states_need_no_handler():
+    sm = StateMachine()
+    sm.add_state(JobState.INIT, lambda s, j: JobState.ABORTED)
+    job = sm.run_to_completion(mkjob())
+    assert job.state == JobState.ABORTED
+
+
+def test_error_transition_is_data():
+    """Splice an error path into the DAG — the launch flow is a table."""
+    sm = StateMachine()
+
+    def alloc_fails(s, j):
+        return JobState.ABORTED
+
+    sm.add_state(JobState.INIT, lambda s, j: JobState.ALLOCATE)
+    sm.add_state(JobState.ALLOCATE, alloc_fails)
+    job = sm.run_to_completion(mkjob())
+    assert job.state == JobState.ABORTED
+    sm.remove_state(JobState.ALLOCATE)
+    assert JobState.ALLOCATE not in sm.states()
