@@ -1,0 +1,16 @@
+"""Hello world (≈ examples/hello_c.c): rank/size + identity print.
+
+Run:  tpurun -np 4 -- python examples/hello.py
+"""
+
+import ompi_tpu
+
+
+def main() -> None:
+    comm = ompi_tpu.init()
+    print(f"Hello, world, I am {comm.rank} of {comm.size}")
+    ompi_tpu.finalize()
+
+
+if __name__ == "__main__":
+    main()

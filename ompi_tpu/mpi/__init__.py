@@ -1,0 +1,8 @@
+"""The MPI layer (≈ the reference's OMPI, ompi/).
+
+MPI-3-style semantics re-designed TPU-first: communicators/groups/datatypes/
+ops/requests as core objects, point-to-point with full matching semantics on
+the host path (≈ pml/ob1 + btl/tcp), and collectives that lower to XLA
+collectives on the device path (≈ the coll framework with the coll/xla
+component BASELINE.json's north star asks for).
+"""

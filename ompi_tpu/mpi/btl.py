@@ -1,0 +1,237 @@
+"""BTL — byte transfer layer for the host path.
+
+≈ opal/mca/btl (btl.h:1170-1228; send :891): moves opaque frames between
+ranks.  The PML above it owns MPI semantics (matching, protocols); a BTL just
+delivers (header, payload) frames reliably and in order per sender.
+
+Components:
+- ``tcp``  — sockets between ranks; addresses exchanged via the PMIx modex
+  (the reference's btl/tcp + business-card flow).  Each rank dials peers
+  lazily and uses dialed connections for sending only; inbound connections
+  (identified by a hello frame) are receive-only.  Two simplex pipes per pair
+  avoid connection races entirely.
+- ``self`` — loopback fast path (≈ btl/self): frames to one's own rank are
+  delivered by direct callback, no sockets.
+
+Device buffers never travel through a BTL: the device path is XLA collectives
+(SURVEY.md §2.6 — the btl/tpu role is played by ICI itself).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Callable, Optional
+
+from ompi_tpu.core import dss, output
+from ompi_tpu.core.config import VarType, register_var, var_registry
+from ompi_tpu.core.mca import Component, Framework
+
+__all__ = ["btl_framework", "TcpBTL", "SelfBTL", "BtlEndpoint"]
+
+_log = output.get_stream("btl")
+
+btl_framework = Framework("btl", "byte transfer layer")
+
+register_var("btl", "tcp_sndbuf", VarType.SIZE, 0,
+             "SO_SNDBUF for btl/tcp sockets (0 = OS default)")
+register_var("btl", "tcp_rcvbuf", VarType.SIZE, 0,
+             "SO_RCVBUF for btl/tcp sockets (0 = OS default)")
+
+# frame = 4B LE total length | DSS(header dict) | raw payload
+# header keys are short strings; payload is raw bytes (not DSS-wrapped, to
+# avoid copying large buffers through the serializer)
+
+OnFrame = Callable[[int, dict, bytes], None]
+
+
+def _send_all(sock: socket.socket, *parts: bytes) -> None:
+    sock.sendall(b"".join(parts))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            return None
+        buf += chunk
+    return bytes(buf)
+
+
+class TcpBTL:
+    """TCP frame transport between the ranks of one job."""
+
+    def __init__(self, rank: int, on_frame: OnFrame,
+                 host: str = "127.0.0.1") -> None:
+        self.rank = rank
+        self.on_frame = on_frame
+        self._listener = socket.create_server((host, 0), backlog=64)
+        self._addr = f"{host}:{self._listener.getsockname()[1]}"
+        self._out: dict[int, socket.socket] = {}
+        self._out_locks: dict[int, threading.Lock] = {}
+        self._peers: dict[int, str] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        t = threading.Thread(target=self._accept_loop, name=f"btl-accept-{rank}",
+                             daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    @property
+    def address(self) -> str:
+        """The business card to publish in the modex."""
+        return self._addr
+
+    def set_peers(self, peers: dict[int, str]) -> None:
+        """Install the modex results: world rank → address."""
+        with self._lock:
+            self._peers.update(peers)
+
+    # -- sending -----------------------------------------------------------
+
+    def send(self, peer: int, header: dict, payload: bytes = b"") -> None:
+        """Deliver one frame to `peer`. Blocking on socket backpressure;
+        in-order per (self → peer)."""
+        sock, lock = self._peer_sock(peer)
+        hdr = dss.pack(header)
+        total = len(hdr) + len(payload)
+        with lock:
+            _send_all(sock, struct.pack("<II", total, len(hdr)), hdr, payload)
+
+    def _peer_sock(self, peer: int) -> tuple[socket.socket, threading.Lock]:
+        with self._lock:
+            sock = self._out.get(peer)
+            if sock is not None:
+                return sock, self._out_locks[peer]
+            addr = self._peers.get(peer)
+        if addr is None:
+            raise ConnectionError(
+                f"btl/tcp: no address for rank {peer} (modex incomplete)")
+        host, port = addr.rsplit(":", 1)
+        sock = socket.create_connection((host, int(port)))
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        for opt, var in ((socket.SO_SNDBUF, "btl_tcp_sndbuf"),
+                         (socket.SO_RCVBUF, "btl_tcp_rcvbuf")):
+            v = var_registry.get(var)
+            if v:
+                sock.setsockopt(socket.SOL_SOCKET, opt, v)
+        # hello frame identifies us to the acceptor
+        hello = dss.pack({"hello": self.rank})
+        _send_all(sock, struct.pack("<II", len(hello), len(hello)), hello)
+        with self._lock:
+            # lost the race with another sender thread? keep the first
+            existing = self._out.get(peer)
+            if existing is not None:
+                sock.close()
+                return existing, self._out_locks[peer]
+            self._out[peer] = sock
+            self._out_locks[peer] = threading.Lock()
+            return sock, self._out_locks[peer]
+
+    # -- receiving ---------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        self._listener.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(target=self._read_loop, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _read_loop(self, conn: socket.socket) -> None:
+        peer = -1
+        with conn:
+            while not self._stop.is_set():
+                hdr8 = _recv_exact(conn, 8)
+                if hdr8 is None:
+                    return
+                total, hdr_len = struct.unpack("<II", hdr8)
+                blob = _recv_exact(conn, total)
+                if blob is None:
+                    return
+                header = dss.unpack(blob[:hdr_len], n=1)[0]
+                payload = blob[hdr_len:]
+                if "hello" in header:
+                    peer = header["hello"]
+                    continue
+                self.on_frame(peer, header, payload)
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            for sock in self._out.values():
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            self._out.clear()
+
+
+class SelfBTL:
+    """Loopback delivery (≈ btl/self): frames to self never touch a socket."""
+
+    def __init__(self, rank: int, on_frame: OnFrame) -> None:
+        self.rank = rank
+        self.on_frame = on_frame
+
+    def send(self, peer: int, header: dict, payload: bytes = b"") -> None:
+        assert peer == self.rank
+        self.on_frame(self.rank, header, payload)
+
+
+@btl_framework.component
+class TcpBTLComponent(Component):
+    NAME = "tcp"
+    PRIORITY = 10
+
+    def create(self, rank: int, on_frame: OnFrame) -> TcpBTL:
+        return TcpBTL(rank, on_frame)
+
+
+@btl_framework.component
+class SelfBTLComponent(Component):
+    NAME = "self"
+    PRIORITY = 90
+
+    def create(self, rank: int, on_frame: OnFrame) -> SelfBTL:
+        return SelfBTL(rank, on_frame)
+
+
+class BtlEndpoint:
+    """Per-job BTL multiplexer (≈ bml/r2, bml.h:220-232): routes a frame to
+    the self BTL for loopback, tcp otherwise."""
+
+    def __init__(self, rank: int, on_frame: OnFrame) -> None:
+        self.rank = rank
+        self.self_btl = SelfBTL(rank, on_frame)
+        self.tcp_btl = TcpBTL(rank, on_frame)
+
+    @property
+    def address(self) -> str:
+        return self.tcp_btl.address
+
+    def set_peers(self, peers: dict[int, str]) -> None:
+        self.tcp_btl.set_peers(peers)
+
+    def send(self, peer: int, header: dict, payload: bytes = b"") -> None:
+        if peer == self.rank:
+            self.self_btl.send(peer, header, payload)
+        else:
+            self.tcp_btl.send(peer, header, payload)
+
+    def close(self) -> None:
+        self.tcp_btl.close()

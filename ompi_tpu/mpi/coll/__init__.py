@@ -1,0 +1,77 @@
+"""coll — the collectives framework.
+
+≈ ompi/mca/coll: a per-communicator function table filled by priority-ordered
+component query (coll.h:426-530, coll_base_comm_select.c:107,270).  Components
+may implement any subset of the collective functions; for each function the
+highest-priority component providing it wins, so e.g. a future accelerated
+component can override just allreduce while ``host`` keeps the rest — the
+exact stacking semantics of the reference.
+
+Components here:
+- ``self``  — size-1 communicators: every collective is a local no-op/copy
+  (≈ coll/self).
+- ``host``  — the full algorithm library over host p2p with a tuned-style
+  decision layer (≈ coll/base + coll/tuned).
+
+The device path (``coll/xla`` lowering to lax.psum/all_gather/ppermute/
+all_to_all) lives on DeviceCommunicator (ompi_tpu.mpi.device_comm) because it
+executes inside jit-traced SPMD programs, not against host buffers.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+from ompi_tpu.core.mca import Component, Framework
+
+if TYPE_CHECKING:
+    from ompi_tpu.mpi.comm import Communicator
+
+__all__ = ["coll_framework", "install", "CollModule"]
+
+coll_framework = Framework("coll", "collective operations")
+
+# the function table slots (≈ mca_coll_base_comm_coll_t)
+COLL_FUNCTIONS = (
+    "barrier", "bcast", "reduce", "allreduce", "gather", "allgather",
+    "scatter", "alltoall", "reduce_scatter", "scan", "gatherv", "scatterv",
+    "allgatherv", "alltoallv",
+)
+
+
+class CollModule:
+    """The per-communicator collective table. Attributes are bound functions
+    chosen per-slot from the winning components."""
+
+    def __init__(self) -> None:
+        self.providers: dict[str, str] = {}  # slot → component name (introspection)
+
+
+def install(comm: "Communicator") -> None:
+    """Fill comm.coll by priority query (≈ coll_base_comm_select)."""
+    # import registers the components
+    from ompi_tpu.mpi.coll import host as _host  # noqa: F401
+    from ompi_tpu.mpi.coll import selfcoll as _selfcoll  # noqa: F401
+
+    module = CollModule()
+    ranked = coll_framework.select_all(comm=comm)
+    for slot in COLL_FUNCTIONS:
+        for comp in ranked:
+            fn = getattr(comp, f"coll_{slot}", None)
+            if fn is not None:
+                setattr(module, slot, fn)
+                module.providers[slot] = comp.NAME
+                break
+        else:
+            setattr(module, slot, _unimplemented(slot))
+    comm.coll = module
+
+
+def _unimplemented(slot: str):
+    def stub(comm, *a, **kw):
+        from ompi_tpu.mpi.constants import MPIException
+
+        raise MPIException(
+            f"no coll component provides {slot} for {comm.name}")
+
+    return stub

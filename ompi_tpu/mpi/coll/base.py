@@ -1,0 +1,414 @@
+"""The host collective algorithm library.
+
+≈ ompi/mca/coll/base/coll_base_*.c — the same algorithm inventory (SURVEY.md
+§2.4 table), reimplemented over this framework's p2p with numpy buffers:
+
+- allreduce: recursive doubling (coll_base_allreduce.c:128), ring (:339),
+  linear fallback (:877)
+- bcast: binomial tree (coll_base_bcast.c:313), linear (:608)
+- reduce: binomial (rank-ordered fold, valid for non-commutative), linear
+- allgather: recursive doubling (:256), bruck (:85), ring (:364), linear
+- alltoall: pairwise (:132), linear
+- reduce_scatter: ring (:455), reduce+scatter fallback (:46)
+- gather/scatter: linear; barrier: dissemination (Bruck) exchange
+- scan: linear chain
+
+All functions are collective over `comm` and exchange equal-shaped arrays
+(MPI's equal-count contract); variable-count (v-) versions take per-rank
+counts along axis 0.
+
+Array convention: pythonic — input array in, result array out (the reference
+mutates out-buffers; on TPU-first design immutability matches jax).  Rank
+ordering for non-commutative ops follows MPI: the fold is always equivalent
+to op(x_0, op(x_1, ... op(x_{p-2}, x_{p-1}))).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ompi_tpu.mpi.op import Op
+from ompi_tpu.mpi.request import wait_all
+
+# reserved collective tags (negative space via comm._coll_isend)
+TAG_BARRIER = 1
+TAG_BCAST = 2
+TAG_REDUCE = 3
+TAG_ALLREDUCE = 4
+TAG_GATHER = 5
+TAG_ALLGATHER = 6
+TAG_SCATTER = 7
+TAG_ALLTOALL = 8
+TAG_REDUCE_SCATTER = 9
+TAG_SCAN = 10
+
+
+def _fold(op: Op, lo: np.ndarray, hi: np.ndarray) -> np.ndarray:
+    """Reduce two blocks where `lo` covers lower ranks than `hi`."""
+    return np.asarray(op.host(lo, hi))
+
+
+# ---------------------------------------------------------------------------
+# barrier — dissemination exchange (≈ coll_base_barrier.c bruck)
+
+def barrier_dissemination(comm) -> None:
+    size, rank = comm.size, comm.rank
+    if size == 1:
+        return
+    token = np.zeros(0, dtype=np.uint8)
+    step = 1
+    while step < size:
+        to = (rank + step) % size
+        frm = (rank - step) % size
+        sreq = comm._coll_isend(token, to, TAG_BARRIER)
+        rreq = comm._coll_irecv(None, frm, TAG_BARRIER,
+                                datatype=None, count=None)
+        wait_all([sreq, rreq])
+        step <<= 1
+
+
+# ---------------------------------------------------------------------------
+# bcast
+
+def bcast_binomial(comm, buf: Optional[np.ndarray], root: int) -> np.ndarray:
+    """Binomial tree broadcast (coll_base_bcast.c:313)."""
+    size, rank = comm.size, comm.rank
+    if size == 1:
+        return np.asarray(buf)
+    vrank = (rank - root) % size
+    # my receive level = lowest set bit of vrank; parent is computable, so
+    # receive from it specifically (ANY_SOURCE would race with the next
+    # bcast's parent on the same tag)
+    recv_mask = 1
+    while recv_mask < size and not (vrank & recv_mask):
+        recv_mask <<= 1
+    if vrank != 0:
+        parent = ((vrank & ~recv_mask) + root) % size
+        buf = comm._coll_irecv(None, parent, TAG_BCAST).wait()
+    arr = np.asarray(buf)
+    mask = 1
+    while mask < size:
+        mask <<= 1
+    mask >>= 1
+    send_mask = recv_mask >> 1 if vrank != 0 else mask
+    reqs = []
+    while send_mask >= 1:
+        vchild = vrank | send_mask
+        if vchild < size and vchild != vrank:
+            child = (vchild + root) % size
+            reqs.append(comm._coll_isend(arr, child, TAG_BCAST))
+        send_mask >>= 1
+    wait_all(reqs)
+    return arr
+
+
+def bcast_linear(comm, buf: Optional[np.ndarray], root: int) -> np.ndarray:
+    size, rank = comm.size, comm.rank
+    if rank == root:
+        arr = np.asarray(buf)
+        wait_all([comm._coll_isend(arr, r, TAG_BCAST)
+                  for r in range(size) if r != rank])
+        return arr
+    return comm._coll_irecv(None, root, TAG_BCAST).wait()
+
+
+# ---------------------------------------------------------------------------
+# reduce
+
+def reduce_binomial(comm, sendbuf, op: Op, root: int) -> Optional[np.ndarray]:
+    """Binomial tree reduce with rank-ordered folding: at every step the
+    receiver holds ranks [vrank, vrank+mask) and receives [vrank+mask, ...),
+    so op(acc, recv) is always in rank order — valid for non-commutative ops
+    when root == 0; other roots rotate, so non-commutative ops reduce at
+    vroot 0 and forward (the reference's approach in coll_base_reduce.c)."""
+    size, rank = comm.size, comm.rank
+    acc = np.asarray(sendbuf)
+    if size == 1:
+        return acc
+    eff_root = root if op.commutative else 0
+    vrank = (rank - eff_root) % size
+    mask = 1
+    while mask < size:
+        if vrank & mask:
+            parent = ((vrank & ~mask) + eff_root) % size
+            comm._coll_isend(acc, parent, TAG_REDUCE).wait()
+            acc = None
+            break
+        else:
+            vchild = vrank | mask
+            if vchild < size:
+                child = (vchild + eff_root) % size
+                recv = comm._coll_irecv(None, child, TAG_REDUCE).wait()
+                recv = recv.reshape(acc.shape).astype(acc.dtype, copy=False)
+                acc = _fold(op, acc, recv)
+        mask <<= 1
+    if eff_root != root:  # forward the result for non-commutative odd roots
+        if rank == eff_root:
+            comm._coll_isend(acc, root, TAG_REDUCE).wait()
+            acc = None
+        elif rank == root:
+            shape = np.asarray(sendbuf).shape
+            acc = comm._coll_irecv(None, eff_root, TAG_REDUCE).wait()
+            acc = acc.reshape(shape)
+    return acc if rank == root else None
+
+
+# ---------------------------------------------------------------------------
+# allreduce
+
+def allreduce_recursive_doubling(comm, sendbuf, op: Op) -> np.ndarray:
+    """coll_base_allreduce.c:128 — lg(p) rounds; non-power-of-2 folds the
+    remainder into the nearest power of 2 first. Rank-ordered folds keep it
+    valid for non-commutative ops."""
+    size, rank = comm.size, comm.rank
+    acc = np.asarray(sendbuf)
+    if size == 1:
+        return acc
+    shape, dtype = acc.shape, acc.dtype
+
+    pof2 = 1
+    while pof2 * 2 <= size:
+        pof2 *= 2
+    rem = size - pof2
+    # fold remainder: ranks >= pof2 send to (rank - pof2) and sit out
+    newrank = rank
+    if rank >= pof2:
+        comm._coll_isend(acc, rank - pof2, TAG_ALLREDUCE).wait()
+        newrank = -1
+    elif rank < rem:
+        recv = comm._coll_irecv(None, rank + pof2, TAG_ALLREDUCE).wait()
+        acc = _fold(op, acc, recv.reshape(shape).astype(dtype, copy=False))
+    if newrank >= 0:
+        mask = 1
+        while mask < pof2:
+            partner = newrank ^ mask
+            sreq = comm._coll_isend(acc, partner, TAG_ALLREDUCE)
+            recv = comm._coll_irecv(None, partner, TAG_ALLREDUCE).wait()
+            sreq.wait()
+            recv = recv.reshape(shape).astype(dtype, copy=False)
+            acc = (_fold(op, recv, acc) if partner < newrank
+                   else _fold(op, acc, recv))
+            mask <<= 1
+    # return results to the remainder ranks
+    if rank < rem:
+        comm._coll_isend(acc, rank + pof2, TAG_ALLREDUCE).wait()
+    elif rank >= pof2:
+        acc = comm._coll_irecv(None, rank - pof2, TAG_ALLREDUCE).wait()
+        acc = acc.reshape(shape).astype(dtype, copy=False)
+    return acc
+
+
+def allreduce_ring(comm, sendbuf, op: Op) -> np.ndarray:
+    """coll_base_allreduce.c:339 — reduce-scatter ring + allgather ring.
+    2(p-1) steps, each moving size/p; bandwidth-optimal. Commutative only."""
+    size, rank = comm.size, comm.rank
+    arr = np.asarray(sendbuf)
+    if size == 1:
+        return arr
+    flat = arr.reshape(-1)
+    chunks = np.array_split(flat, size)
+    chunks = [c.copy() for c in chunks]
+    right = (rank + 1) % size
+    left = (rank - 1) % size
+    # reduce-scatter: after p-1 steps, chunk (rank+1)%size is fully reduced
+    send_idx = rank
+    for _ in range(size - 1):
+        sreq = comm._coll_isend(chunks[send_idx], right, TAG_ALLREDUCE)
+        recv_idx = (send_idx - 1) % size
+        recv = comm._coll_irecv(None, left, TAG_ALLREDUCE).wait()
+        sreq.wait()
+        chunks[recv_idx] = np.asarray(
+            op.host(chunks[recv_idx],
+                    recv.astype(chunks[recv_idx].dtype, copy=False)))
+        send_idx = recv_idx
+    # allgather ring: circulate the reduced chunks
+    send_idx = (rank + 1) % size
+    for _ in range(size - 1):
+        sreq = comm._coll_isend(chunks[send_idx], right, TAG_ALLGATHER)
+        recv_idx = (send_idx - 1) % size
+        recv = comm._coll_irecv(None, left, TAG_ALLGATHER).wait()
+        sreq.wait()
+        chunks[recv_idx] = recv.astype(chunks[recv_idx].dtype, copy=False)
+        send_idx = recv_idx
+    return np.concatenate(chunks).reshape(arr.shape)
+
+
+def allreduce_linear(comm, sendbuf, op: Op) -> np.ndarray:
+    """reduce to 0 + bcast (coll_base_allreduce.c:877 nonoverlapping)."""
+    out = reduce_binomial(comm, sendbuf, op, 0)
+    return bcast_binomial(comm, out, 0)
+
+
+# ---------------------------------------------------------------------------
+# allgather
+
+def allgather_bruck(comm, sendbuf) -> np.ndarray:
+    """coll_base_allgather.c:85 — lg(p) rounds, any p; blocks end rotated."""
+    size, rank = comm.size, comm.rank
+    mine = np.asarray(sendbuf)
+    if size == 1:
+        return mine[None]
+    blocks: list[Optional[np.ndarray]] = [None] * size
+    blocks[0] = mine
+    step = 1
+    filled = 1
+    while step < size:
+        cnt = min(step, size - filled)
+        to = (rank - step) % size
+        frm = (rank + step) % size
+        payload = np.stack(blocks[0:cnt])
+        sreq = comm._coll_isend(payload, to, TAG_ALLGATHER)
+        recv = comm._coll_irecv(None, frm, TAG_ALLGATHER).wait()
+        sreq.wait()
+        recv = recv.reshape((cnt,) + mine.shape).astype(mine.dtype, copy=False)
+        for i in range(cnt):
+            blocks[filled + i] = recv[i]
+        filled += cnt
+        step <<= 1
+    # local rotation: blocks[i] holds rank (rank+i)%size's data
+    out = [None] * size
+    for i in range(size):
+        out[(rank + i) % size] = blocks[i]
+    return np.stack(out)  # type: ignore[arg-type]
+
+
+def allgather_ring(comm, sendbuf) -> np.ndarray:
+    """coll_base_allgather.c:364 — p-1 neighbor exchanges."""
+    size, rank = comm.size, comm.rank
+    mine = np.asarray(sendbuf)
+    if size == 1:
+        return mine[None]
+    out: list[Optional[np.ndarray]] = [None] * size
+    out[rank] = mine
+    right = (rank + 1) % size
+    left = (rank - 1) % size
+    send_idx = rank
+    for _ in range(size - 1):
+        sreq = comm._coll_isend(out[send_idx], right, TAG_ALLGATHER)
+        recv_idx = (send_idx - 1) % size
+        recv = comm._coll_irecv(None, left, TAG_ALLGATHER).wait()
+        sreq.wait()
+        out[recv_idx] = recv.reshape(mine.shape).astype(mine.dtype, copy=False)
+        send_idx = recv_idx
+    return np.stack(out)  # type: ignore[arg-type]
+
+
+# ---------------------------------------------------------------------------
+# gather / scatter (linear, ≈ coll_base_gather/scatter.c basic linear)
+
+def gather_linear(comm, sendbuf, root: int) -> Optional[np.ndarray]:
+    size, rank = comm.size, comm.rank
+    mine = np.asarray(sendbuf)
+    if rank == root:
+        parts: list[Optional[np.ndarray]] = [None] * size
+        parts[rank] = mine
+        reqs = {r: comm._coll_irecv(None, r, TAG_GATHER)
+                for r in range(size) if r != root}
+        for r, req in reqs.items():
+            parts[r] = req.wait().reshape(mine.shape).astype(
+                mine.dtype, copy=False)
+        return np.stack(parts)  # type: ignore[arg-type]
+    comm._coll_isend(mine, root, TAG_GATHER).wait()
+    return None
+
+
+def scatter_linear(comm, sendbuf, root: int) -> np.ndarray:
+    size, rank = comm.size, comm.rank
+    if rank == root:
+        arr = np.asarray(sendbuf)
+        if arr.shape[0] % size:
+            from ompi_tpu.mpi.constants import MPIException
+
+            raise MPIException(
+                f"scatter: axis 0 ({arr.shape[0]}) not divisible by {size}")
+        parts = np.split(arr, size, axis=0)
+        reqs = [comm._coll_isend(parts[r], r, TAG_SCATTER)
+                for r in range(size) if r != root]
+        wait_all(reqs)
+        return parts[rank]
+    return comm._coll_irecv(None, root, TAG_SCATTER).wait()
+
+
+# ---------------------------------------------------------------------------
+# alltoall — pairwise exchange (coll_base_alltoall.c:132)
+
+def alltoall_pairwise(comm, sendbuf) -> np.ndarray:
+    size, rank = comm.size, comm.rank
+    arr = np.asarray(sendbuf)
+    if arr.shape[0] % size:
+        from ompi_tpu.mpi.constants import MPIException
+
+        raise MPIException(
+            f"alltoall: axis 0 ({arr.shape[0]}) not divisible by {size}")
+    parts = np.split(arr, size, axis=0)
+    out: list[Optional[np.ndarray]] = [None] * size
+    out[rank] = parts[rank]
+    for step in range(1, size):
+        to = (rank + step) % size
+        frm = (rank - step) % size
+        sreq = comm._coll_isend(parts[to], to, TAG_ALLTOALL)
+        recv = comm._coll_irecv(None, frm, TAG_ALLTOALL).wait()
+        sreq.wait()
+        out[frm] = recv.reshape(parts[rank].shape).astype(arr.dtype, copy=False)
+    return np.concatenate(out)  # type: ignore[arg-type]
+
+
+# ---------------------------------------------------------------------------
+# reduce_scatter — ring (coll_base_reduce_scatter.c:455)
+
+def reduce_scatter_ring(comm, sendbuf, op: Op) -> np.ndarray:
+    """Each rank ends with its block of the fully-reduced array.
+    Commutative only (ring accumulation order)."""
+    size, rank = comm.size, comm.rank
+    arr = np.asarray(sendbuf)
+    if size == 1:
+        return arr
+    flat = arr.reshape(-1)
+    chunks = [c.copy() for c in np.array_split(flat, size)]
+    right = (rank + 1) % size
+    left = (rank - 1) % size
+    # after p-1 steps the fully-reduced chunk is (start_idx+1) mod p, so
+    # starting at rank-1 leaves rank owning its own chunk
+    send_idx = (rank - 1) % size
+    for _ in range(size - 1):
+        sreq = comm._coll_isend(chunks[send_idx], right, TAG_REDUCE_SCATTER)
+        recv_idx = (send_idx - 1) % size
+        recv = comm._coll_irecv(None, left, TAG_REDUCE_SCATTER).wait()
+        sreq.wait()
+        chunks[recv_idx] = np.asarray(
+            op.host(chunks[recv_idx],
+                    recv.astype(chunks[recv_idx].dtype, copy=False)))
+        send_idx = recv_idx
+    return chunks[rank]
+
+
+def reduce_scatter_basic(comm, sendbuf, op: Op) -> np.ndarray:
+    """reduce + scatter fallback (valid for non-commutative ops)."""
+    size = comm.size
+    reduced = reduce_binomial(comm, sendbuf, op, 0)
+    if comm.rank == 0:
+        flat = reduced.reshape(-1)
+        # pad-free equal split contract: use array_split boundaries
+        parts = np.array_split(flat, size)
+        for r in range(1, size):
+            comm._coll_isend(parts[r], r, TAG_REDUCE_SCATTER).wait()
+        return parts[0]
+    return comm._coll_irecv(None, 0, TAG_REDUCE_SCATTER).wait()
+
+
+# ---------------------------------------------------------------------------
+# scan — linear chain
+
+def scan_linear(comm, sendbuf, op: Op) -> np.ndarray:
+    """Inclusive prefix reduction: result_r = op(x_0, ..., x_r)."""
+    rank, size = comm.rank, comm.size
+    acc = np.asarray(sendbuf)
+    if rank > 0:
+        prev = comm._coll_irecv(None, rank - 1, TAG_SCAN).wait()
+        acc = _fold(op, prev.reshape(acc.shape).astype(acc.dtype, copy=False),
+                    acc)
+    if rank < size - 1:
+        comm._coll_isend(acc, rank + 1, TAG_SCAN).wait()
+    return acc

@@ -1,0 +1,101 @@
+"""coll/host — the tuned host collective component.
+
+≈ ompi/mca/coll/tuned: wraps the base algorithm library with a size×commsize
+decision layer whose crossover points mirror coll_tuned_decision_fixed.c:
+44-87 (allreduce: recursive doubling under the small-message threshold, ring
+for large commutative payloads), overridable per-collective via config vars
+(the reference's coll_tuned_*_algorithm MCA params / dynamic rules file).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ompi_tpu.core.config import VarType, register_var, var_registry
+from ompi_tpu.core.mca import Component
+from ompi_tpu.mpi.coll import base, coll_framework
+from ompi_tpu.mpi.op import Op
+
+__all__ = ["HostColl"]
+
+
+def _nbytes(buf) -> int:
+    return np.asarray(buf).nbytes
+
+
+@coll_framework.component
+class HostColl(Component):
+    NAME = "host"
+    PRIORITY = 40
+
+    def register_params(self) -> None:
+        register_var("coll", "host_allreduce_small", VarType.SIZE, 10 * 1024,
+                     "allreduce: below this use recursive doubling "
+                     "(tuned's 10KB crossover)")
+        register_var("coll", "host_allgather_small", VarType.SIZE, 64 * 1024,
+                     "allgather: below this use bruck, above ring")
+        for name in ("allreduce", "allgather", "bcast", "reduce_scatter"):
+            register_var("coll", f"host_{name}_algorithm", VarType.STRING, "",
+                         f"force a {name} algorithm (empty = decide by size)")
+
+    def query(self, comm=None, **ctx) -> Optional[int]:
+        if comm is not None and comm.size == 1:
+            return None  # coll/self owns size-1
+        return self.PRIORITY
+
+    # -- table slots ------------------------------------------------------
+
+    def coll_barrier(self, comm) -> None:
+        base.barrier_dissemination(comm)
+
+    def coll_bcast(self, comm, buf, root: int):
+        forced = var_registry.get("coll_host_bcast_algorithm")
+        if forced == "linear":
+            return base.bcast_linear(comm, buf, root)
+        return base.bcast_binomial(comm, buf, root)
+
+    def coll_reduce(self, comm, sendbuf, op: Op, root: int):
+        return base.reduce_binomial(comm, sendbuf, op, root)
+
+    def coll_allreduce(self, comm, sendbuf, op: Op):
+        forced = var_registry.get("coll_host_allreduce_algorithm")
+        if forced:
+            return {
+                "recursive_doubling": base.allreduce_recursive_doubling,
+                "ring": base.allreduce_ring,
+                "linear": base.allreduce_linear,
+            }[forced](comm, sendbuf, op)
+        # tuned decision (coll_tuned_decision_fixed.c:65-87)
+        if (_nbytes(sendbuf) < var_registry.get("coll_host_allreduce_small")
+                or not op.commutative):
+            return base.allreduce_recursive_doubling(comm, sendbuf, op)
+        return base.allreduce_ring(comm, sendbuf, op)
+
+    def coll_gather(self, comm, sendbuf, root: int):
+        return base.gather_linear(comm, sendbuf, root)
+
+    def coll_allgather(self, comm, sendbuf):
+        forced = var_registry.get("coll_host_allgather_algorithm")
+        if forced:
+            return {"bruck": base.allgather_bruck,
+                    "ring": base.allgather_ring}[forced](comm, sendbuf)
+        if _nbytes(sendbuf) < var_registry.get("coll_host_allgather_small"):
+            return base.allgather_bruck(comm, sendbuf)
+        return base.allgather_ring(comm, sendbuf)
+
+    def coll_scatter(self, comm, sendbuf, root: int):
+        return base.scatter_linear(comm, sendbuf, root)
+
+    def coll_alltoall(self, comm, sendbuf):
+        return base.alltoall_pairwise(comm, sendbuf)
+
+    def coll_reduce_scatter(self, comm, sendbuf, op: Op):
+        forced = var_registry.get("coll_host_reduce_scatter_algorithm")
+        if forced == "basic" or not op.commutative:
+            return base.reduce_scatter_basic(comm, sendbuf, op)
+        return base.reduce_scatter_ring(comm, sendbuf, op)
+
+    def coll_scan(self, comm, sendbuf, op: Op):
+        return base.scan_linear(comm, sendbuf, op)

@@ -1,0 +1,56 @@
+"""coll/self — collectives on size-1 communicators (≈ ompi/mca/coll/self).
+
+Every collective degenerates to a local identity/copy; stacking rules give it
+top priority only when size == 1.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ompi_tpu.core.mca import Component
+from ompi_tpu.mpi.coll import coll_framework
+from ompi_tpu.mpi.op import Op
+
+
+@coll_framework.component
+class SelfColl(Component):
+    NAME = "self"
+    PRIORITY = 90
+
+    def query(self, comm=None, **ctx) -> Optional[int]:
+        if comm is not None and comm.size == 1:
+            return self.PRIORITY
+        return None
+
+    def coll_barrier(self, comm) -> None:
+        return None
+
+    def coll_bcast(self, comm, buf, root: int):
+        return np.asarray(buf)
+
+    def coll_reduce(self, comm, sendbuf, op: Op, root: int):
+        return np.asarray(sendbuf)
+
+    def coll_allreduce(self, comm, sendbuf, op: Op):
+        return np.asarray(sendbuf)
+
+    def coll_gather(self, comm, sendbuf, root: int):
+        return np.asarray(sendbuf)[None]
+
+    def coll_allgather(self, comm, sendbuf):
+        return np.asarray(sendbuf)[None]
+
+    def coll_scatter(self, comm, sendbuf, root: int):
+        return np.asarray(sendbuf)
+
+    def coll_alltoall(self, comm, sendbuf):
+        return np.asarray(sendbuf)
+
+    def coll_reduce_scatter(self, comm, sendbuf, op: Op):
+        return np.asarray(sendbuf).reshape(-1)
+
+    def coll_scan(self, comm, sendbuf, op: Op):
+        return np.asarray(sendbuf)

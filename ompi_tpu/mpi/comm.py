@@ -1,0 +1,253 @@
+"""Communicators: group + context id + per-communicator collective table.
+
+≈ ompi/communicator (communicator.h:134-189: cid, local/remote groups, the
+c_coll function table) and CID allocation (comm_cid.c:51-124).
+
+CID allocation is redesigned: the reference runs a multi-round allreduce over
+a CID bitmap because independent overlapping communicators may allocate
+concurrently.  Here communicator construction is an explicitly collective,
+deterministically ordered operation (as it must be in SPMD programs anyway),
+so each parent communicator carries a monotonic per-parent counter and the new
+cid is derived deterministically — every member computes the same cid with no
+traffic; an agreement check (max-allreduce over the parent) is kept as a
+debug-mode assertion.
+
+The collective function table (``self.coll``) is installed by
+ompi_tpu.mpi.coll at creation time via priority query, exactly like
+coll_base_comm_select.c:107.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Optional
+
+import numpy as np
+
+from ompi_tpu.mpi import datatype as dt_mod
+from ompi_tpu.mpi.constants import (
+    ANY_TAG, PROC_NULL, UNDEFINED, MPIException,
+)
+from ompi_tpu.mpi.datatype import Datatype
+from ompi_tpu.mpi.group import Group
+from ompi_tpu.mpi.request import CompletedRequest, Request, Status
+
+__all__ = ["Communicator"]
+
+# tag space: user tags ≥ 0; negative tags reserved for internal collectives
+# (≈ the reference's MCA_COLL_BASE_TAG_* negative tag range)
+_INTERNAL_TAG_BASE = -1000
+
+
+class Communicator:
+    """A group of ranks sharing an isolated message context."""
+
+    def __init__(self, group: Group, cid: int, pml, my_world_rank: int,
+                 name: str = "comm") -> None:
+        self.group = group
+        self.cid = cid
+        self.pml = pml
+        self._world_rank = my_world_rank
+        self.name = name
+        self.rank = group.rank_of(my_world_rank)
+        self._cid_counter = itertools.count(cid * 1024 + 1)
+        self._lock = threading.Lock()
+        self.coll = None  # installed by ompi_tpu.mpi.coll.install()
+        self.attrs: dict[Any, Any] = {}  # ≈ MPI attribute caching
+        self._install_coll()
+
+    def _install_coll(self) -> None:
+        from ompi_tpu.mpi import coll
+
+        coll.install(self)
+
+    # -- basics ------------------------------------------------------------
+
+    @property
+    def size(self) -> int:
+        return self.group.size
+
+    def world_rank(self, rank: int) -> int:
+        return self.group.world_rank(rank)
+
+    def _check_rank(self, rank: int, what: str = "rank") -> None:
+        if rank == PROC_NULL:
+            return
+        if not 0 <= rank < self.size:
+            raise MPIException(
+                f"{what} {rank} out of range for {self.name} "
+                f"(size {self.size})", error_class=6)
+
+    # -- point-to-point ----------------------------------------------------
+
+    def isend(self, buf: Any, dest: int, tag: int = 0,
+              datatype: Optional[Datatype] = None,
+              count: Optional[int] = None) -> Request:
+        self._check_rank(dest, "dest")
+        if tag < 0:
+            raise MPIException(f"negative tag {tag} is reserved", error_class=4)
+        if dest == PROC_NULL:
+            return CompletedRequest()
+        return self._isend(buf, dest, tag, datatype, count)
+
+    def _isend(self, buf, dest, tag, datatype=None, count=None) -> Request:
+        return self.pml.isend(buf, self.world_rank(dest), tag, self.cid,
+                              datatype, count)
+
+    def send(self, buf: Any, dest: int, tag: int = 0,
+             datatype: Optional[Datatype] = None,
+             count: Optional[int] = None) -> None:
+        self.isend(buf, dest, tag, datatype, count).wait()
+
+    def irecv(self, buf: Optional[np.ndarray] = None, source: int = 0,
+              tag: int = ANY_TAG, datatype: Optional[Datatype] = None,
+              count: Optional[int] = None) -> Request:
+        self._check_rank(source, "source") if source >= 0 else None
+        if source == PROC_NULL:
+            return CompletedRequest(
+                np.empty(0, dtype=(datatype or dt_mod.BYTE).base_np))
+        src = source if source < 0 else self.world_rank(source)
+        return self.pml.irecv(buf, src, tag, self.cid, datatype, count)
+
+    def recv(self, buf: Optional[np.ndarray] = None, source: int = 0,
+             tag: int = ANY_TAG, datatype: Optional[Datatype] = None,
+             count: Optional[int] = None,
+             status: Optional[Status] = None) -> np.ndarray:
+        req = self.irecv(buf, source, tag, datatype, count)
+        out = req.wait()
+        if status is not None:
+            status.__dict__.update(req.status.__dict__)
+            if status.source >= 0:
+                status.source = self.group.rank_of(status.source)
+        return out
+
+    def sendrecv(self, sendbuf: Any, dest: int, recvbuf=None,
+                 source: int = 0, sendtag: int = 0, recvtag: int = ANY_TAG,
+                 status: Optional[Status] = None) -> np.ndarray:
+        rreq = self.irecv(recvbuf, source, recvtag)
+        sreq = self.isend(sendbuf, dest, sendtag)
+        out = rreq.wait()
+        sreq.wait()
+        if status is not None:
+            status.__dict__.update(rreq.status.__dict__)
+            if status.source >= 0:
+                status.source = self.group.rank_of(status.source)
+        return out
+
+    def probe(self, source: int = -1, tag: int = ANY_TAG,
+              timeout: Optional[float] = None) -> Status:
+        src = source if source < 0 else self.world_rank(source)
+        st = self.pml.probe(src, tag, self.cid, timeout=timeout)
+        if st.source >= 0:
+            st.source = self.group.rank_of(st.source)
+        return st
+
+    def iprobe(self, source: int = -1, tag: int = ANY_TAG) -> Optional[Status]:
+        src = source if source < 0 else self.world_rank(source)
+        st = self.pml.iprobe(src, tag, self.cid)
+        if st is not None and st.source >= 0:
+            st.source = self.group.rank_of(st.source)
+        return st
+
+    # internal p2p on the reserved tag space (collectives use these)
+
+    def _coll_isend(self, buf, dest: int, coll_tag: int) -> Request:
+        return self.pml.isend(np.asarray(buf), self.world_rank(dest),
+                              _INTERNAL_TAG_BASE - coll_tag, self.cid)
+
+    def _coll_irecv(self, buf, source: int, coll_tag: int,
+                    datatype=None, count=None) -> Request:
+        return self.pml.irecv(buf, self.world_rank(source),
+                              _INTERNAL_TAG_BASE - coll_tag, self.cid,
+                              datatype, count)
+
+    # -- collectives (delegate to the installed coll table) ----------------
+
+    def barrier(self) -> None:
+        self.coll.barrier(self)
+
+    def bcast(self, buf, root: int = 0):
+        return self.coll.bcast(self, buf, root)
+
+    def reduce(self, sendbuf, op=None, root: int = 0):
+        from ompi_tpu.mpi import op as op_mod
+
+        return self.coll.reduce(self, sendbuf, op or op_mod.SUM, root)
+
+    def allreduce(self, sendbuf, op=None):
+        from ompi_tpu.mpi import op as op_mod
+
+        return self.coll.allreduce(self, sendbuf, op or op_mod.SUM)
+
+    def gather(self, sendbuf, root: int = 0):
+        return self.coll.gather(self, sendbuf, root)
+
+    def allgather(self, sendbuf):
+        return self.coll.allgather(self, sendbuf)
+
+    def scatter(self, sendbuf, root: int = 0):
+        return self.coll.scatter(self, sendbuf, root)
+
+    def alltoall(self, sendbuf):
+        return self.coll.alltoall(self, sendbuf)
+
+    def reduce_scatter(self, sendbuf, op=None):
+        from ompi_tpu.mpi import op as op_mod
+
+        return self.coll.reduce_scatter(self, sendbuf, op or op_mod.SUM)
+
+    def scan(self, sendbuf, op=None):
+        from ompi_tpu.mpi import op as op_mod
+
+        return self.coll.scan(self, sendbuf, op or op_mod.SUM)
+
+    # -- construction ------------------------------------------------------
+
+    def _next_cid(self) -> int:
+        """Deterministic collective CID (see module docstring)."""
+        with self._lock:
+            return next(self._cid_counter)
+
+    def dup(self, name: Optional[str] = None) -> "Communicator":
+        """≈ MPI_Comm_dup — collective over this communicator."""
+        return Communicator(self.group, self._next_cid(), self.pml,
+                            self._world_rank, name or f"{self.name}.dup")
+
+    def create(self, group: Group, name: Optional[str] = None
+               ) -> Optional["Communicator"]:
+        """≈ MPI_Comm_create — collective; returns None on non-members."""
+        cid = self._next_cid()
+        if group.rank_of(self._world_rank) == UNDEFINED:
+            return None
+        return Communicator(group, cid, self.pml, self._world_rank,
+                            name or f"{self.name}.sub")
+
+    def split(self, color: int, key: int = 0,
+              name: Optional[str] = None) -> Optional["Communicator"]:
+        """≈ MPI_Comm_split — collective over this communicator.
+
+        Implemented as an allgather of (color, key, world_rank) triples over
+        the parent (the reference does the same inside comm_split), then a
+        deterministic local partition.
+        """
+        mine = np.array([color, key, self._world_rank], dtype=np.int64)
+        gathered = self.coll.allgather(self, mine)  # (size, 3)
+        rows = [tuple(int(x) for x in row) for row in np.asarray(gathered)]
+        # distinct colors get distinct cids; every rank (members and
+        # UNDEFINED alike) burns the same count to keep counters aligned
+        colors = sorted({c for c, _, _ in rows if c != UNDEFINED})
+        cid_base = self._next_cid()
+        for _ in range(max(0, len(colors) - 1)):
+            self._next_cid()
+        if color == UNDEFINED:
+            return None
+        members = sorted((k, wr) for c, k, wr in rows if c == color)
+        cid = cid_base + colors.index(color)
+        grp = Group([wr for _, wr in members])
+        return Communicator(grp, cid, self.pml, self._world_rank,
+                            name or f"{self.name}.split({color})")
+
+    def __repr__(self) -> str:
+        return (f"Communicator({self.name}, rank={self.rank}/{self.size}, "
+                f"cid={self.cid})")

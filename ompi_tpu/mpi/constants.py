@@ -1,0 +1,45 @@
+"""MPI-style constants (≈ mpi.h values; semantics, not numeric parity)."""
+
+from __future__ import annotations
+
+ANY_SOURCE = -1  # MPI_ANY_SOURCE: match a message from any rank
+ANY_TAG = -2     # MPI_ANY_TAG: match any tag
+PROC_NULL = -3   # MPI_PROC_NULL: send/recv to nowhere completes immediately
+ROOT = -4        # MPI_ROOT (intercomm collectives)
+UNDEFINED = -32766  # MPI_UNDEFINED (e.g. split color, no-group rank)
+
+
+class _InPlace:
+    """Singleton marker for MPI_IN_PLACE."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self) -> str:
+        return "IN_PLACE"
+
+
+IN_PLACE = _InPlace()
+
+# Error classes (subset of MPI_ERR_*)
+SUCCESS = 0
+ERR_COMM = 5
+ERR_RANK = 6
+ERR_TAG = 4
+ERR_COUNT = 2
+ERR_TYPE = 3
+ERR_TRUNCATE = 15
+ERR_PENDING = 18
+ERR_IN_STATUS = 19
+
+
+class MPIException(RuntimeError):
+    """Raised by MPI-layer operations (≈ error handler MPI_ERRORS_RETURN path)."""
+
+    def __init__(self, msg: str, error_class: int = 13) -> None:
+        super().__init__(msg)
+        self.error_class = error_class

@@ -1,0 +1,100 @@
+"""Reduction operations: the op table + user-defined ops.
+
+≈ ompi/op (op.h:139,386 and the per-(op × type) function table in
+ompi/mca/op/base/op_base_functions.c).  Each Op carries BOTH a host
+implementation (numpy ufunc) and a device implementation (jax) so the same Op
+object works in host collectives and inside jit-compiled device collectives —
+the dual the reference approximates with its op MCA framework for
+SIMD-accelerated overrides (ompi/mca/op/example).
+
+MAXLOC/MINLOC operate on the (val, loc) pair types, as in MPI.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ompi_tpu.mpi.constants import MPIException
+
+__all__ = ["Op", "SUM", "PROD", "MAX", "MIN", "LAND", "LOR", "LXOR",
+           "BAND", "BOR", "BXOR", "MAXLOC", "MINLOC", "REPLACE", "NO_OP",
+           "create_op"]
+
+
+class Op:
+    """A reduction operator with host and device callables.
+
+    ``host(a, b)`` reduces two numpy arrays elementwise; ``device(a, b)``
+    does the same for jax arrays inside a trace.  ``commutative`` gates
+    algorithm choice (ring allreduce requires commutativity, as in
+    coll_tuned_decision_fixed.c:65-87).
+    """
+
+    def __init__(self, name: str, host: Callable, device: Optional[Callable],
+                 commutative: bool = True,
+                 jax_reduce_name: Optional[str] = None) -> None:
+        self.name = name
+        self.host = host
+        self._device = device
+        self.commutative = commutative
+        # name of the fused XLA collective, e.g. "psum" — lets coll/xla use
+        # the native fused collective instead of pairwise application
+        self.jax_reduce_name = jax_reduce_name
+
+    def device(self, a: Any, b: Any) -> Any:
+        if self._device is None:
+            raise MPIException(
+                f"op {self.name} has no device implementation; reduce on host")
+        return self._device(a, b)
+
+    def __call__(self, a, b):
+        return self.host(a, b)
+
+    def __repr__(self) -> str:
+        return f"Op({self.name})"
+
+
+def _pair_extreme(cmp):
+    """MAXLOC/MINLOC on structured (val, loc) arrays: pick extreme value,
+    lowest loc on ties (the MPI rule)."""
+
+    def host(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        take_b = cmp(b["val"], a["val"]) | (
+            (b["val"] == a["val"]) & (b["loc"] < a["loc"]))
+        return np.where(take_b, b, a)
+
+    return host
+
+
+def _jax_op(fn_name):
+    def device(a, b):
+        import jax.numpy as jnp
+
+        return getattr(jnp, fn_name)(a, b)
+
+    return device
+
+
+SUM = Op("sum", np.add, _jax_op("add"), jax_reduce_name="psum")
+PROD = Op("prod", np.multiply, _jax_op("multiply"))
+MAX = Op("max", np.maximum, _jax_op("maximum"), jax_reduce_name="pmax")
+MIN = Op("min", np.minimum, _jax_op("minimum"), jax_reduce_name="pmin")
+LAND = Op("land", np.logical_and, _jax_op("logical_and"))
+LOR = Op("lor", np.logical_or, _jax_op("logical_or"))
+LXOR = Op("lxor", np.logical_xor, _jax_op("logical_xor"))
+BAND = Op("band", np.bitwise_and, _jax_op("bitwise_and"))
+BOR = Op("bor", np.bitwise_or, _jax_op("bitwise_or"))
+BXOR = Op("bxor", np.bitwise_xor, _jax_op("bitwise_xor"))
+MAXLOC = Op("maxloc", _pair_extreme(np.greater), None)
+MINLOC = Op("minloc", _pair_extreme(np.less), None)
+REPLACE = Op("replace", lambda a, b: b, lambda a, b: b, commutative=False)
+NO_OP = Op("no_op", lambda a, b: a, lambda a, b: a, commutative=False)
+
+
+def create_op(fn: Callable, commutative: bool = False,
+              device_fn: Optional[Callable] = None, name: str = "user") -> Op:
+    """MPI_Op_create: user-defined reduction (host fn mandatory; pass
+    device_fn — a jax-traceable function — to use it in device collectives)."""
+    return Op(name, fn, device_fn, commutative=commutative)

@@ -1,0 +1,384 @@
+"""PML — point-to-point messaging logic: matching, protocols, progress.
+
+≈ ompi/mca/pml/ob1: MPI send/recv semantics over the BTL —
+- tag/source matching with wildcards, posted-recv + unexpected queues
+  (≈ pml_ob1_recvfrag.c:143-173),
+- eager vs rendezvous protocol selection by message size
+  (≈ pml_ob1_sendreq.h:382-413),
+- fragmentation/pipelining of large transfers (≈ the RDMA pipeline).
+
+Threading model (replaces the reference's opal_progress polling): BTL reader
+threads ONLY read and match; all payload writes go through a single send
+worker thread per process, so readers can never block on socket backpressure
+— the classic two-sided rendezvous deadlock (both readers stuck in sendall)
+is structurally impossible.
+
+MPI ordering guarantee (per sender-receiver pair, per communicator, in tag
+order of posting) holds because each direction of a pair is one TCP stream
+processed by one reader, and the send worker is FIFO.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import queue
+import threading
+from typing import Any, Optional
+
+import numpy as np
+
+from ompi_tpu.core import output
+from ompi_tpu.core.config import VarType, register_var, var_registry
+from ompi_tpu.core.mca import Component, Framework
+from ompi_tpu.mpi import datatype as dt_mod
+from ompi_tpu.mpi.btl import BtlEndpoint
+from ompi_tpu.mpi.constants import ANY_SOURCE, ANY_TAG, ERR_TRUNCATE, MPIException
+from ompi_tpu.mpi.datatype import Datatype
+from ompi_tpu.mpi.request import Request, Status
+
+__all__ = ["pml_framework", "PmlOb1", "RecvRequest"]
+
+_log = output.get_stream("pml")
+
+pml_framework = Framework("pml", "point-to-point messaging logic")
+
+register_var("pml", "eager_limit", VarType.SIZE, 64 * 1024,
+             "max payload bytes sent eagerly (larger goes rendezvous)")
+register_var("pml", "frag_size", VarType.SIZE, 1 << 20,
+             "fragment size for rendezvous pipelines")
+
+
+class RecvRequest(Request):
+    def __init__(self, buf: Optional[np.ndarray], datatype: Optional[Datatype],
+                 count: Optional[int], source: int, tag: int, cid: int) -> None:
+        super().__init__(kind="recv")
+        self.buf = buf
+        self.datatype = datatype  # None → take element dtype from the wire
+        self.count = count        # None → no truncation check (alloc to fit)
+        self.source = source
+        self.tag = tag
+        self.cid = cid
+        self.rid = -1  # receiver-side id for rendezvous
+
+
+def _dtype_to_wire(dt: np.dtype):
+    if dt.fields:
+        return dt.descr
+    # extended dtypes (bfloat16, float8_*) stringify as raw void ('<V2');
+    # their registered name ('bfloat16') reconstructs correctly
+    if dt.kind == "V":
+        return dt.name
+    return dt.str
+
+
+def _wire_to_dtype(spec) -> np.dtype:
+    if isinstance(spec, (list, tuple)):
+        return np.dtype([tuple(f) for f in spec])
+    if isinstance(spec, str) and not spec[:1].isalpha():
+        return np.dtype(spec)
+    # name form needs ml_dtypes registered for the extended types
+    import ml_dtypes  # noqa: F401
+
+    return np.dtype(spec)
+
+
+class _SendState:
+    """Sender-side rendezvous bookkeeping (awaiting CTS)."""
+
+    def __init__(self, req: Request, peer: int, payload: bytes) -> None:
+        self.req = req
+        self.peer = peer
+        self.payload = payload
+
+
+class _RecvState:
+    """Receiver-side rendezvous accumulation."""
+
+    def __init__(self, req: RecvRequest, size: int, src_hdr: dict,
+                 peer: int) -> None:
+        self.req = req
+        self.data = bytearray(size)
+        self.received = 0
+        self.src_hdr = src_hdr
+        self.peer = peer
+
+
+class _Matching:
+    """Per-communicator matching engine (posted + unexpected queues)."""
+
+    def __init__(self) -> None:
+        self.posted: collections.deque[RecvRequest] = collections.deque()
+        self.unexpected: collections.deque[tuple[int, dict, bytes]] = \
+            collections.deque()
+
+
+def _hdr_matches(req: RecvRequest, peer: int, hdr: dict) -> bool:
+    if req.source != ANY_SOURCE and req.source != peer:
+        return False
+    if req.tag != ANY_TAG and req.tag != hdr["tag"]:
+        return False
+    return True
+
+
+class PmlOb1:
+    """The default PML: matching + eager/rendezvous over the BTL."""
+
+    def __init__(self, rank: int) -> None:
+        self.rank = rank
+        self.endpoint = BtlEndpoint(rank, self._on_frame)
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)  # probe waiters
+        self._matching: dict[int, _Matching] = {}
+        self._send_states: dict[int, _SendState] = {}
+        self._recv_states: dict[int, _RecvState] = {}
+        self._ids = itertools.count(1)
+        self._seq: dict[tuple[int, int], int] = {}
+        self._sendq: "queue.Queue[Optional[tuple]]" = queue.Queue()
+        self._worker = threading.Thread(
+            target=self._send_loop, name=f"pml-send-{rank}", daemon=True)
+        self._worker.start()
+        self._closed = False
+
+    # -- wiring ------------------------------------------------------------
+
+    @property
+    def address(self) -> str:
+        return self.endpoint.address
+
+    def set_peers(self, peers: dict[int, str]) -> None:
+        self.endpoint.set_peers(peers)
+
+    def close(self) -> None:
+        self._closed = True
+        self._sendq.put(None)
+        self._worker.join(timeout=2.0)
+        self.endpoint.close()
+
+    def _matching_for(self, cid: int) -> _Matching:
+        m = self._matching.get(cid)
+        if m is None:
+            m = self._matching[cid] = _Matching()
+        return m
+
+    # -- send side ---------------------------------------------------------
+
+    def isend(self, buf: Any, peer: int, tag: int, cid: int,
+              datatype: Optional[Datatype] = None,
+              count: Optional[int] = None) -> Request:
+        arr = np.asarray(buf)
+        if datatype is None:
+            datatype = dt_mod.from_numpy(arr.dtype)
+        if count is None:
+            count = arr.size // max(1, datatype.elements_per_item)
+        payload = datatype.pack(arr, count)
+        req = Request(kind="send")
+        with self._lock:
+            seq_key = (peer, cid)
+            seq = self._seq.get(seq_key, 0)
+            self._seq[seq_key] = seq + 1
+        hdr = {"tag": tag, "cid": cid, "seq": seq,
+               "dt": _dtype_to_wire(datatype.base_np),
+               "elems": len(payload) // datatype.base_np.itemsize}
+        if len(payload) <= var_registry.get("pml_eager_limit"):
+            hdr["t"] = "eager"
+            self._sendq.put(("frame", peer, hdr, payload, req))
+        else:
+            sid = next(self._ids)
+            hdr.update(t="rndv", size=len(payload), sid=sid)
+            with self._lock:
+                self._send_states[sid] = _SendState(req, peer, payload)
+            self._sendq.put(("frame", peer, hdr, b"", None))
+        return req
+
+    def send(self, buf: Any, peer: int, tag: int, cid: int, **kw) -> None:
+        self.isend(buf, peer, tag, cid, **kw).wait()
+
+    # -- recv side ---------------------------------------------------------
+
+    def irecv(self, buf: Optional[np.ndarray], source: int, tag: int,
+              cid: int, datatype: Optional[Datatype] = None,
+              count: Optional[int] = None) -> RecvRequest:
+        if buf is not None:
+            buf = np.asarray(buf)
+            if datatype is None:
+                datatype = dt_mod.from_numpy(buf.dtype)
+            if count is None:
+                count = buf.size // max(1, datatype.elements_per_item)
+        # buf=None with datatype/count=None is the allocate-on-match path:
+        # the element dtype travels in the wire header
+        req = RecvRequest(buf, datatype, count, source, tag, cid)
+        req.rid = next(self._ids)
+        with self._lock:
+            m = self._matching_for(cid)
+            # try the unexpected queue first, in arrival order
+            for i, (peer, hdr, payload) in enumerate(m.unexpected):
+                if _hdr_matches(req, peer, hdr):
+                    del m.unexpected[i]
+                    self._match(req, peer, hdr, payload)
+                    return req
+            m.posted.append(req)
+        return req
+
+    def recv(self, buf: Optional[np.ndarray], source: int, tag: int, cid: int,
+             datatype: Optional[Datatype] = None, count: Optional[int] = None,
+             status: Optional[Status] = None) -> np.ndarray:
+        req = self.irecv(buf, source, tag, cid, datatype, count)
+        out = req.wait()
+        if status is not None:
+            status.__dict__.update(req.status.__dict__)
+        return out
+
+    # -- probe -------------------------------------------------------------
+
+    def iprobe(self, source: int, tag: int, cid: int) -> Optional[Status]:
+        with self._lock:
+            return self._iprobe_locked(source, tag, cid)
+
+    def probe(self, source: int, tag: int, cid: int,
+              timeout: Optional[float] = None) -> Status:
+        with self._cv:
+            while True:
+                st = self._iprobe_locked(source, tag, cid)
+                if st is not None:
+                    return st
+                if not self._cv.wait(timeout=timeout):
+                    raise TimeoutError("probe timed out")
+
+    def _iprobe_locked(self, source: int, tag: int, cid: int) -> Optional[Status]:
+        probe = RecvRequest(None, dt_mod.BYTE, 0, source, tag, cid)
+        for peer, hdr, payload in self._matching_for(cid).unexpected:
+            if _hdr_matches(probe, peer, hdr):
+                st = Status()
+                st.source = peer
+                st.tag = hdr["tag"]
+                st.count = hdr.get("elems", hdr.get("size", len(payload)))
+                return st
+        return None
+
+    # -- frame handling (reader threads; NEVER blocking-send here) ---------
+
+    def _on_frame(self, peer: int, hdr: dict, payload: bytes) -> None:
+        t = hdr["t"]
+        if t in ("eager", "rndv"):
+            with self._lock:
+                m = self._matching_for(hdr["cid"])
+                req = None
+                for i, cand in enumerate(m.posted):
+                    if _hdr_matches(cand, peer, hdr):
+                        del m.posted[i]
+                        req = cand
+                        break
+                if req is None:
+                    m.unexpected.append((peer, hdr, payload))
+                    self._cv.notify_all()
+                    return
+                self._match(req, peer, hdr, payload)
+        elif t == "cts":
+            with self._lock:
+                state = self._send_states.pop(hdr["sid"], None)
+            if state is not None:
+                self._sendq.put(("rndv_data", state, hdr["rid"]))
+        elif t == "data":
+            self._on_data(hdr, payload)
+        else:
+            _log.error("unknown frame type %r from %d", t, peer)
+
+    def _match(self, req: RecvRequest, peer: int, hdr: dict,
+               payload: bytes) -> None:
+        """Called with self._lock held. Eager: deliver now. Rndv: send CTS."""
+        if hdr["t"] == "eager":
+            self._deliver(req, peer, hdr, payload)
+        else:  # rndv
+            self._recv_states[req.rid] = _RecvState(req, hdr["size"], hdr, peer)
+            # CTS is a tiny control frame; safe to enqueue (never inline-send
+            # from a reader thread)
+            self._sendq.put(("frame", peer,
+                             {"t": "cts", "sid": hdr["sid"], "rid": req.rid},
+                             b"", None))
+
+    def _on_data(self, hdr: dict, payload: bytes) -> None:
+        with self._lock:
+            state = self._recv_states.get(hdr["rid"])
+            if state is None:
+                return
+            off = hdr["off"]
+            state.data[off:off + len(payload)] = payload
+            state.received += len(payload)
+            done = state.received >= len(state.data)
+            if done:
+                del self._recv_states[hdr["rid"]]
+        if done:
+            self._deliver(state.req, state.peer, state.src_hdr,
+                          bytes(state.data))
+
+    def _deliver(self, req: RecvRequest, peer: int, hdr: dict,
+                 payload: bytes) -> None:
+        """Unpack payload into the request's buffer and complete it."""
+        datatype = req.datatype
+        if datatype is not None and req.count is not None:
+            expected = req.count * datatype.size
+            if len(payload) > expected:
+                req.status.source = peer
+                req.status.tag = hdr["tag"]
+                req.fail(MPIException(
+                    f"message truncated: {len(payload)}B arrived, recv "
+                    f"posted for {expected}B", error_class=ERR_TRUNCATE))
+                return
+        if req.buf is None:
+            elem_np = (datatype.base_np if datatype is not None
+                       else _wire_to_dtype(hdr["dt"]))
+            n_elems = len(payload) // elem_np.itemsize
+            out = np.frombuffer(
+                bytearray(payload[:n_elems * elem_np.itemsize]),
+                dtype=elem_np)
+        else:
+            out = req.buf
+            items = len(payload) // max(1, datatype.size)
+            datatype.unpack(payload, out, items)
+        req.status.source = peer
+        req.status.tag = hdr["tag"]
+        elem_size = (datatype.base_np.itemsize if datatype is not None
+                     else _wire_to_dtype(hdr["dt"]).itemsize)
+        req.status.count = len(payload) // elem_size
+        req.complete(out)
+
+    # -- send worker (the only thread that writes payloads) ----------------
+
+    def _send_loop(self) -> None:
+        frag = var_registry.get("pml_frag_size")
+        while True:
+            job = self._sendq.get()
+            if job is None:
+                return
+            try:
+                if job[0] == "frame":
+                    _, peer, hdr, payload, req = job
+                    self.endpoint.send(peer, hdr, payload)
+                    if req is not None:
+                        req.complete(None)
+                elif job[0] == "rndv_data":
+                    _, state, rid = job
+                    data = state.payload
+                    for off in range(0, len(data), frag):
+                        self.endpoint.send(
+                            state.peer,
+                            {"t": "data", "rid": rid, "off": off},
+                            data[off:off + frag])
+                    state.req.complete(None)
+            except Exception as e:
+                req = job[4] if job[0] == "frame" else job[1].req
+                if req is not None:
+                    req.fail(e if isinstance(e, MPIException)
+                             else MPIException(f"send failed: {e}"))
+
+
+@pml_framework.component
+class Ob1Component(Component):
+    """Default PML (named for its ancestor, ompi/mca/pml/ob1)."""
+
+    NAME = "ob1"
+    PRIORITY = 50
+
+    def create(self, rank: int) -> PmlOb1:
+        return PmlOb1(rank)

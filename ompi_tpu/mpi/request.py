@@ -1,0 +1,145 @@
+"""Requests: completion objects for nonblocking operations.
+
+≈ ompi/request (request.h:124-177): a request completes exactly once; waiters
+block on a completion primitive (the reference's wait_sync, here a
+threading.Event).  Status carries (source, tag, count) like MPI_Status.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional, Sequence
+
+from ompi_tpu.mpi.constants import MPIException
+
+__all__ = ["Request", "Status", "wait_all", "wait_any", "test_all"]
+
+
+class Status:
+    """≈ MPI_Status: source/tag/error + received element count."""
+
+    def __init__(self) -> None:
+        self.source: int = -1
+        self.tag: int = -1
+        self.error: int = 0
+        self.count: int = 0
+
+    def __repr__(self) -> str:
+        return (f"Status(source={self.source}, tag={self.tag}, "
+                f"count={self.count}, error={self.error})")
+
+
+class Request:
+    """A completion object. Thread-safe; completes exactly once."""
+
+    def __init__(self, kind: str = "generic") -> None:
+        self.kind = kind
+        self._done = threading.Event()
+        self._lock = threading.Lock()
+        self.status = Status()
+        self._result: Any = None
+        self._exc: Optional[BaseException] = None
+        self._on_complete: list[Callable[["Request"], None]] = []
+        self.cancelled = False
+
+    # -- completion (called by the progress side) -------------------------
+
+    def complete(self, result: Any = None) -> None:
+        with self._lock:
+            if self._done.is_set():
+                return
+            self._result = result
+            self._done.set()
+            callbacks = list(self._on_complete)
+        for cb in callbacks:
+            cb(self)
+
+    def fail(self, exc: BaseException) -> None:
+        with self._lock:
+            if self._done.is_set():
+                return
+            self._exc = exc
+            self.status.error = getattr(exc, "error_class", 13)
+            self._done.set()
+            callbacks = list(self._on_complete)
+        for cb in callbacks:
+            cb(self)
+
+    def add_completion_callback(self, cb: Callable[["Request"], None]) -> None:
+        with self._lock:
+            if not self._done.is_set():
+                self._on_complete.append(cb)
+                return
+        cb(self)
+
+    # -- user side --------------------------------------------------------
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def test(self) -> bool:
+        """≈ MPI_Test (no progress side effects needed: progress is threaded)."""
+        return self._done.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> Any:
+        """≈ MPI_Wait: block until complete; return the operation's result
+        (received array for recvs, None for sends)."""
+        if not self._done.wait(timeout=timeout):
+            raise TimeoutError(f"{self.kind} request did not complete")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+    def cancel(self) -> None:
+        """≈ MPI_Cancel (only meaningful for unmatched recvs)."""
+        self.cancelled = True
+
+
+class CompletedRequest(Request):
+    """Pre-completed request (PROC_NULL ops, zero-byte fast paths)."""
+
+    def __init__(self, result: Any = None, kind: str = "null") -> None:
+        super().__init__(kind)
+        self.complete(result)
+
+
+def wait_all(requests: Sequence[Request],
+             timeout: Optional[float] = None) -> list[Any]:
+    """≈ MPI_Waitall (raises the first failure, after waiting for all)."""
+    results = []
+    first_exc: Optional[BaseException] = None
+    for r in requests:
+        try:
+            results.append(r.wait(timeout=timeout))
+        except TimeoutError:
+            raise
+        except BaseException as e:
+            first_exc = first_exc or e
+            results.append(None)
+    if first_exc is not None:
+        raise first_exc
+    return results
+
+
+def wait_any(requests: Sequence[Request],
+             timeout: Optional[float] = None) -> tuple[int, Any]:
+    """≈ MPI_Waitany: (index, result) of the first completed request."""
+    if not requests:
+        raise MPIException("wait_any on empty request list")
+    event = threading.Event()
+
+    def poke(_r):
+        event.set()
+
+    for r in requests:
+        r.add_completion_callback(poke)
+    if not event.wait(timeout=timeout):
+        raise TimeoutError("wait_any timed out")
+    for i, r in enumerate(requests):
+        if r.done():
+            return i, r.wait()
+    raise AssertionError("unreachable: event set but no request done")
+
+
+def test_all(requests: Sequence[Request]) -> bool:
+    return all(r.test() for r in requests)

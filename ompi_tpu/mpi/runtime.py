@@ -1,0 +1,117 @@
+"""MPI init/finalize and the world communicator.
+
+≈ ompi/runtime/ompi_mpi_init.c:375 — the bring-up sequence (:482-941):
+identity from the environment (≈ ess/env reading PMIx), PML selection (:655),
+the modex business-card exchange (:673-703), world communicator construction
+with the collective table (:934), and the final fence.
+
+Outside tpurun (no rendezvous URI) init degenerates to a singleton world,
+like mpirun-less ./a.out singleton init in the reference.
+"""
+
+from __future__ import annotations
+
+import atexit
+import threading
+from typing import Optional
+
+from ompi_tpu.core import output
+from ompi_tpu.mpi.comm import Communicator
+from ompi_tpu.mpi.constants import MPIException
+from ompi_tpu.mpi.group import Group
+from ompi_tpu.mpi.pml import pml_framework
+from ompi_tpu.runtime import pmix
+
+__all__ = ["init", "finalize", "initialized", "COMM_WORLD", "COMM_SELF",
+           "get_world"]
+
+_log = output.get_stream("mpi")
+_lock = threading.Lock()
+_state: dict = {"world": None, "self": None, "client": None, "pml": None}
+
+COMM_WORLD: Optional[Communicator] = None
+COMM_SELF: Optional[Communicator] = None
+
+
+def initialized() -> bool:
+    return _state["world"] is not None
+
+
+def init() -> Communicator:
+    """Bring up MPI; returns COMM_WORLD. Idempotent."""
+    global COMM_WORLD, COMM_SELF
+    with _lock:
+        if _state["world"] is not None:
+            return _state["world"]
+
+        import os
+
+        under_launcher = pmix.ENV_URI in os.environ
+        if under_launcher:
+            client = pmix.PMIxClient()
+            rank, size = client.rank, client.size
+        else:
+            client, rank, size = None, 0, 1
+
+        pml = pml_framework.select().create(rank)
+
+        if size > 1:
+            assert client is not None
+            # modex: publish my BTL business card, fence, learn everyone's
+            # (≈ ompi_mpi_init.c:673-703)
+            client.put("btl.addr", pml.address)
+            cards = client.fence(collect=True)
+            peers = {
+                r: cards[f"btl.addr@{r}"] for r in range(size) if r != rank
+            }
+            pml.set_peers(peers)
+
+        world = Communicator(Group(range(size)), cid=0, pml=pml,
+                             my_world_rank=rank, name="WORLD")
+        selfc = Communicator(Group([rank]), cid=1, pml=pml,
+                             my_world_rank=rank, name="SELF")
+        _state.update(world=world, self=selfc, client=client, pml=pml)
+        COMM_WORLD, COMM_SELF = world, selfc
+        _log.verbose(1, "init complete: rank %d/%d", rank, size)
+
+        # final fence: everyone reachable before user code runs
+        if size > 1:
+            world.barrier()
+        atexit.register(_atexit_finalize)
+        return world
+
+
+def get_world() -> Communicator:
+    if _state["world"] is None:
+        raise MPIException("MPI not initialized (call ompi_tpu.init())")
+    return _state["world"]
+
+
+def finalize() -> None:
+    """Tear down: final barrier, close transports (≈ ompi_mpi_finalize)."""
+    global COMM_WORLD, COMM_SELF
+    with _lock:
+        world = _state["world"]
+        if world is None:
+            return
+        try:
+            if world.size > 1:
+                world.barrier()
+        finally:
+            if _state["pml"] is not None:
+                _state["pml"].close()
+            client = _state["client"]
+            if client is not None:
+                try:
+                    client.finalize()
+                except Exception:
+                    pass
+            _state.update(world=None, self=None, client=None, pml=None)
+            COMM_WORLD = COMM_SELF = None
+
+
+def _atexit_finalize() -> None:
+    try:
+        finalize()
+    except Exception:
+        pass

@@ -48,9 +48,6 @@ class ErrmgrContinue(Component):
     NAME = "continue"
     PRIORITY = 0
 
-    def query(self, **ctx):
-        return self.PRIORITY
-
     def proc_failed(self, launcher: "LocalLauncher", job: Job, proc: Proc) -> None:
         _log.verbose(1, "rank %d failed (%s); continuing per policy",
                      proc.rank, proc.state.value)

@@ -90,23 +90,32 @@ class LocalLauncher:
                     stdout=subprocess.PIPE, stderr=subprocess.PIPE,
                     start_new_session=True)
             except OSError as e:
-                # ≈ odls error-pipe protocol: exec failure surfaces here
+                # ≈ odls error-pipe protocol: exec failure surfaces here.
+                # Failure to start is fatal regardless of errmgr policy (the
+                # job never assembled), so record the abort and reap whatever
+                # already launched.
                 proc.state = ProcState.FAILED_TO_START
                 proc.exit_code = 127
                 output.show_help(
                     "launcher", "failed-to-start",
                     rank=proc.rank, argv0=app.argv[0], error=str(e))
                 self._errmgr.proc_failed(self, job, proc)
-                return JobState.ABORTED
+                if job.aborted_proc is None:
+                    job.aborted_proc = proc
+                    job.abort_reason = f"rank {proc.rank} failed to start"
+                self.kill_job(job, exclude=proc)
+                return JobState.RUNNING  # reap launched ranks, then ABORTED
             proc.pid = p.pid
             proc.state = ProcState.RUNNING
-            self._popen[proc.rank] = p
+            with self._kill_lock:  # kill_job may iterate concurrently
+                self._popen[proc.rank] = p
             self._start_iof(job, proc, p)
         return JobState.RUNNING
 
     def _st_running(self, sm: StateMachine, job: Job) -> Optional[JobState]:
         # Reap children; first abnormal exit triggers the errmgr policy.
-        pending = dict(self._popen)
+        with self._kill_lock:
+            pending = dict(self._popen)
         while pending:
             for rank, p in list(pending.items()):
                 rc = p.poll()
@@ -120,6 +129,10 @@ class LocalLauncher:
                     proc.state = ProcState.TERMINATED
                 else:
                     proc.state = ProcState.ABORTED
+                    # wake fence/get waiters so surviving ranks don't hang
+                    # on a dead peer (matters under errmgr/continue)
+                    if self.server is not None:
+                        self.server.proc_died(rank)
                     self._errmgr.proc_failed(self, job, proc)
                 del pending[rank]
             if pending:
@@ -165,7 +178,7 @@ class LocalLauncher:
         """SIGTERM all live ranks, then SIGKILL stragglers after a grace."""
         with self._kill_lock:
             victims = []
-            for rank, p in self._popen.items():
+            for rank, p in list(self._popen.items()):
                 proc = job.procs[rank]
                 if proc is exclude or p.poll() is not None:
                     continue

@@ -73,6 +73,7 @@ class PMIxServer:
         self._fence_counts: dict[int, int] = {}
         self._fence_done: set[int] = set()
         self._client_epoch: dict[int, int] = {}
+        self._dead: set[int] = set()
         self._aborted: Optional[tuple[int, int, str]] = None
         self._listener = socket.create_server((host, 0))
         self._port = self._listener.getsockname()[1]
@@ -143,9 +144,7 @@ class PMIxServer:
                 epoch = self._client_epoch.get(rank, 0)
                 self._client_epoch[rank] = epoch + 1
                 self._fence_counts[epoch] = self._fence_counts.get(epoch, 0) + 1
-                if self._fence_counts[epoch] >= self.size:
-                    self._fence_done.add(epoch)
-                    self._cv.notify_all()
+                self._check_fence_done(epoch)
                 self._cv.wait_for(
                     lambda: epoch in self._fence_done or self._aborted is not None)
                 if self._aborted is not None:
@@ -165,6 +164,23 @@ class PMIxServer:
         if cmd == "fin":
             return ("ok",)
         raise PMIxError(f"unknown command {cmd!r}")
+
+    def _check_fence_done(self, epoch: int) -> None:
+        """With _cv held: a fence completes when every *live* rank arrived."""
+        live = self.size - len(self._dead)
+        if self._fence_counts.get(epoch, 0) >= live:
+            self._fence_done.add(epoch)
+            self._cv.notify_all()
+
+    def proc_died(self, rank: int) -> None:
+        """Launcher notification: rank exited abnormally. Re-evaluates every
+        pending fence so survivors don't block on a dead peer forever."""
+        with self._cv:
+            self._dead.add(rank)
+            for epoch in list(self._fence_counts):
+                if epoch not in self._fence_done:
+                    self._check_fence_done(epoch)
+            self._cv.notify_all()
 
     # -- host-side access (launcher uses these directly) ------------------
 

@@ -37,7 +37,7 @@ class LocalhostRAS(Component):
         register_var("ras", "localhost_slots", VarType.INT, 0,
                      "slots on localhost (0 = cpu count)")
 
-    def allocate(self, job: Job) -> list[Node]:
+    def allocate(self, job: Job, **ctx) -> list[Node]:
         slots = var_registry.get("ras_localhost_slots") or os.cpu_count() or 1
         # mpirun-style oversubscription: never under-allocate the job
         slots = max(slots, job.np)
@@ -62,7 +62,7 @@ class SimulatorRAS(Component):
     def query(self, **ctx):
         return self.PRIORITY if ctx.get("allow_simulator", True) else None
 
-    def allocate(self, job: Job) -> list[Node]:
+    def allocate(self, job: Job, **ctx) -> list[Node]:
         n = var_registry.get("ras_sim_num_nodes")
         slots = var_registry.get("ras_sim_slots_per_node")
         chips = var_registry.get("ras_sim_chips_per_node")
@@ -95,7 +95,7 @@ class TpuRAS(Component):
             pass
         return None
 
-    def allocate(self, job: Job) -> list[Node]:
+    def allocate(self, job: Job, **ctx) -> list[Node]:
         import jax
 
         chips = [d for d in jax.devices() if d.platform == "tpu"]
@@ -116,7 +116,7 @@ class HostfileRAS(Component):
         path = ctx.get("hostfile") or var_registry.get("ras_hostfile")
         return self.PRIORITY if path else None
 
-    def allocate(self, job: Job, hostfile: Optional[str] = None) -> list[Node]:
+    def allocate(self, job: Job, hostfile: Optional[str] = None, **ctx) -> list[Node]:
         path = hostfile or var_registry.get("ras_hostfile")
         nodes = []
         with open(path) as fh:
@@ -136,7 +136,7 @@ class HostfileRAS(Component):
 def allocate(job: Job, **context) -> Job:
     """Run the allocation phase: fill job.nodes (≈ orte_ras_base_allocate)."""
     comp = ras_framework.select(**context)
-    job.nodes = comp.allocate(job)
+    job.nodes = comp.allocate(job, **context)
     if not job.nodes or sum(n.slots for n in job.nodes) == 0:
         raise RuntimeError("allocation produced no usable slots")
     return job

@@ -50,8 +50,14 @@ def main(argv: list[str] | None = None) -> int:
         cmd = cmd[1:]
 
     # CLI --mca pairs get top precedence; framework-selection vars use the
-    # bare framework name (e.g. --mca coll xla → synonym of coll_).
+    # bare framework name (e.g. --mca coll xla → synonym of coll_).  They are
+    # also exported to the environment so app processes inherit them — most
+    # frameworks (pml/coll/...) select inside the app, not the launcher.
+    import os
+
     var_registry.load_cli([(k, v) for k, v in args.mca])
+    for k, v in args.mca:
+        os.environ[var_registry.ENV_PREFIX + k] = v
     if args.map_by:
         var_registry.load_cli([("rmaps_rr_policy", args.map_by)])
     if args.tag is not None:

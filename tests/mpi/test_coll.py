@@ -1,0 +1,257 @@
+"""Collective correctness across algorithms and communicator sizes.
+
+≈ validating the reference's coll_base algorithm inventory; every algorithm is
+cross-checked against a numpy reference result (the reference cross-checks
+coll/tuned against basic the same way).
+"""
+
+import numpy as np
+import pytest
+
+from ompi_tpu.core.config import var_registry
+from ompi_tpu.mpi import op as op_mod
+from ompi_tpu.mpi.constants import UNDEFINED
+from tests.mpi.harness import run_ranks
+
+SIZES = [1, 2, 3, 4, 5]
+
+
+def _data(rank, n=8, dtype=np.float64):
+    return (np.arange(n, dtype=dtype) + rank * 100)
+
+
+@pytest.mark.parametrize("n", SIZES)
+def test_barrier(n):
+    run_ranks(n, lambda c: c.barrier())
+
+
+@pytest.mark.parametrize("n", SIZES)
+@pytest.mark.parametrize("root", [0, "last"])
+def test_bcast(n, root):
+    root = n - 1 if root == "last" else 0
+
+    def fn(comm):
+        buf = _data(7) if comm.rank == root else None
+        return comm.bcast(buf, root=root)
+
+    for out in run_ranks(n, fn):
+        np.testing.assert_array_equal(out, _data(7))
+
+
+@pytest.mark.parametrize("n", SIZES)
+def test_reduce_sum(n):
+    def fn(comm):
+        return comm.reduce(_data(comm.rank), op=op_mod.SUM, root=0)
+
+    res = run_ranks(n, fn)
+    want = sum(_data(r) for r in range(n))
+    np.testing.assert_allclose(res[0], want)
+    assert all(r is None for r in res[1:])
+
+
+def _rank_matrix(r):
+    return np.array([[1.0, r + 1], [0.0, 1.0]])
+
+
+@pytest.mark.parametrize("n", SIZES)
+@pytest.mark.parametrize("root", [0, "last"])
+def test_reduce_noncommutative_rank_order(n, root):
+    """Matrix product: associative but NOT commutative — result must equal
+    the rank-ordered product x_0 @ x_1 @ ... @ x_{n-1} (the MPI rule)."""
+    root = n - 1 if root == "last" else 0
+    matmul = op_mod.create_op(lambda a, b: a @ b, commutative=False)
+
+    def fn(comm):
+        return comm.reduce(_rank_matrix(comm.rank), op=matmul, root=root)
+
+    res = run_ranks(n, fn)
+    want = _rank_matrix(0)
+    for r in range(1, n):
+        want = want @ _rank_matrix(r)
+    np.testing.assert_allclose(res[root], want)
+
+
+@pytest.mark.parametrize("n", SIZES)
+@pytest.mark.parametrize("algo", ["recursive_doubling", "ring", "linear"])
+def test_allreduce_algorithms(n, algo):
+    var_registry.set("coll_host_allreduce_algorithm", algo)
+    try:
+        def fn(comm):
+            return comm.allreduce(_data(comm.rank), op=op_mod.SUM)
+
+        res = run_ranks(n, fn)
+        want = sum(_data(r) for r in range(n))
+        for out in res:
+            np.testing.assert_allclose(out, want)
+    finally:
+        var_registry.set("coll_host_allreduce_algorithm", "")
+
+
+@pytest.mark.parametrize("op,npop", [(op_mod.MAX, np.maximum),
+                                     (op_mod.MIN, np.minimum),
+                                     (op_mod.PROD, np.multiply)])
+def test_allreduce_ops(op, npop):
+    def fn(comm):
+        return comm.allreduce(_data(comm.rank, 5) + 1, op=op)
+
+    res = run_ranks(3, fn)
+    want = _data(0, 5) + 1
+    for r in range(1, 3):
+        want = npop(want, _data(r, 5) + 1)
+    for out in res:
+        np.testing.assert_allclose(out, want)
+
+
+def test_allreduce_large_ring_path():
+    """> 10KB commutative triggers the tuned ring decision."""
+    def fn(comm):
+        big = np.full(5000, comm.rank + 1, dtype=np.float64)
+        return comm.allreduce(big)
+
+    for out in run_ranks(4, fn):
+        np.testing.assert_allclose(out, np.full(5000, 1 + 2 + 3 + 4.0))
+
+
+@pytest.mark.parametrize("n", SIZES)
+@pytest.mark.parametrize("algo", ["bruck", "ring"])
+def test_allgather_algorithms(n, algo):
+    var_registry.set("coll_host_allgather_algorithm", algo)
+    try:
+        def fn(comm):
+            return comm.allgather(_data(comm.rank, 4))
+
+        res = run_ranks(n, fn)
+        want = np.stack([_data(r, 4) for r in range(n)])
+        for out in res:
+            np.testing.assert_array_equal(out, want)
+    finally:
+        var_registry.set("coll_host_allgather_algorithm", "")
+
+
+@pytest.mark.parametrize("n", SIZES)
+def test_gather_scatter(n):
+    def fn(comm):
+        gathered = comm.gather(np.array([comm.rank], np.int32), root=0)
+        if comm.rank == 0:
+            assert (gathered.ravel() == np.arange(n)).all()
+            scattered = comm.scatter(np.arange(2 * n, dtype=np.int64), root=0)
+        else:
+            scattered = comm.scatter(None, root=0)
+        return scattered
+
+    res = run_ranks(n, fn)
+    for r, out in enumerate(res):
+        np.testing.assert_array_equal(out, [2 * r, 2 * r + 1])
+
+
+@pytest.mark.parametrize("n", SIZES)
+def test_alltoall(n):
+    def fn(comm):
+        # row j goes to rank j
+        send = np.arange(n, dtype=np.int64) * 10 + comm.rank
+        return comm.alltoall(send)
+
+    res = run_ranks(n, fn)
+    for r, out in enumerate(res):
+        np.testing.assert_array_equal(out, np.arange(n) + 10 * r)
+
+
+@pytest.mark.parametrize("n", SIZES)
+def test_reduce_scatter(n):
+    def fn(comm):
+        return comm.reduce_scatter(np.arange(n * 3, dtype=np.float64)
+                                   + comm.rank)
+
+    res = run_ranks(n, fn)
+    full = sum(np.arange(n * 3, dtype=np.float64) + r for r in range(n))
+    chunks = np.array_split(full, n)
+    for r, out in enumerate(res):
+        np.testing.assert_allclose(out, chunks[r])
+
+
+@pytest.mark.parametrize("n", SIZES)
+def test_scan(n):
+    def fn(comm):
+        return comm.scan(np.array([comm.rank + 1.0]))
+
+    res = run_ranks(n, fn)
+    for r, out in enumerate(res):
+        assert out[0] == sum(range(1, r + 2))
+
+
+def test_bfloat16_allreduce():
+    import ml_dtypes
+
+    def fn(comm):
+        x = np.full(16, comm.rank + 1, dtype=ml_dtypes.bfloat16)
+        return comm.allreduce(x)
+
+    for out in run_ranks(2, fn):
+        assert out.dtype == ml_dtypes.bfloat16
+        np.testing.assert_array_equal(out.astype(np.float32), np.full(16, 3.0))
+
+
+# -- communicator construction over collectives -----------------------------
+
+def test_comm_dup_isolated_context():
+    def fn(comm):
+        dup = comm.dup()
+        # a message on the dup must not match a recv on the parent
+        if comm.rank == 0:
+            dup.send(np.array([5]), dest=1, tag=1)
+            comm.send(np.array([6]), dest=1, tag=1)
+            return None
+        parent_val = int(comm.recv(source=0, tag=1)[0])
+        dup_val = int(dup.recv(source=0, tag=1)[0])
+        return parent_val, dup_val
+
+    assert run_ranks(2, fn)[1] == (6, 5)
+
+
+def test_comm_split_colors():
+    def fn(comm):
+        color = comm.rank % 2
+        sub = comm.split(color, key=comm.rank)
+        total = sub.allreduce(np.array([comm.rank]))
+        return sub.size, int(total[0])
+
+    res = run_ranks(4, fn)
+    assert res[0] == (2, 0 + 2) and res[2] == (2, 0 + 2)
+    assert res[1] == (2, 1 + 3) and res[3] == (2, 1 + 3)
+
+
+def test_comm_split_undefined():
+    def fn(comm):
+        color = UNDEFINED if comm.rank == 1 else 0
+        sub = comm.split(color)
+        if comm.rank == 1:
+            assert sub is None
+            return "none"
+        return sub.size
+
+    assert run_ranks(3, fn) == [2, "none", 2]
+
+
+def test_comm_create_from_group():
+    def fn(comm):
+        sub_group = comm.group.incl([0, 2])
+        sub = comm.create(sub_group)
+        if comm.rank in (0, 2):
+            assert sub is not None
+            return int(sub.allreduce(np.array([comm.rank]))[0])
+        assert sub is None
+        return None
+
+    res = run_ranks(3, fn)
+    assert res[0] == 2 and res[2] == 2 and res[1] is None
+
+
+def test_coll_providers_introspection():
+    def fn(comm):
+        return dict(comm.coll.providers)
+
+    provs = run_ranks(2, fn)[0]
+    assert provs["allreduce"] == "host"
+
+    provs1 = run_ranks(1, fn)[0]
+    assert provs1["allreduce"] == "self"

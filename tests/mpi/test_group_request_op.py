@@ -1,0 +1,145 @@
+"""Tests for Group set ops, Request completion, and the Op table."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ompi_tpu.mpi import op as op_mod
+from ompi_tpu.mpi.constants import UNDEFINED, MPIException
+from ompi_tpu.mpi.group import Group
+from ompi_tpu.mpi.request import Request, wait_all, wait_any
+
+
+# -- groups ----------------------------------------------------------------
+
+def test_group_basics():
+    g = Group([4, 2, 7])
+    assert g.size == 3
+    assert g.rank_of(2) == 1
+    assert g.rank_of(5) == UNDEFINED
+    assert g.world_rank(2) == 7
+
+
+def test_group_duplicates_rejected():
+    with pytest.raises(MPIException):
+        Group([1, 1])
+
+
+def test_group_set_ops():
+    a, b = Group([0, 1, 2, 3]), Group([2, 3, 4])
+    assert a.union(b).ranks == (0, 1, 2, 3, 4)
+    assert a.intersection(b).ranks == (2, 3)
+    assert a.difference(b).ranks == (0, 1)
+
+
+def test_group_incl_excl():
+    g = Group([10, 11, 12, 13])
+    assert g.incl([3, 0]).ranks == (13, 10)
+    assert g.excl([1, 2]).ranks == (10, 13)
+    with pytest.raises(MPIException):
+        g.excl([9])
+
+
+def test_translate_ranks():
+    a, b = Group([5, 6, 7]), Group([7, 5])
+    assert a.translate_ranks([0, 1, 2], b) == [1, UNDEFINED, 0]
+
+
+def test_group_compare():
+    assert Group([0, 1]).compare(Group([0, 1])) == "ident"
+    assert Group([0, 1]).compare(Group([1, 0])) == "similar"
+    assert Group([0, 1]).compare(Group([0, 2])) == "unequal"
+
+
+# -- requests --------------------------------------------------------------
+
+def test_request_complete_and_wait():
+    r = Request()
+    threading.Timer(0.05, lambda: r.complete("val")).start()
+    assert r.wait(timeout=5) == "val"
+    assert r.done() and r.test()
+
+
+def test_request_fail_propagates():
+    r = Request()
+    r.fail(MPIException("boom", error_class=15))
+    with pytest.raises(MPIException, match="boom"):
+        r.wait()
+    assert r.status.error == 15
+
+
+def test_request_completes_once():
+    r = Request()
+    r.complete(1)
+    r.complete(2)
+    assert r.wait() == 1
+
+
+def test_completion_callback_after_done():
+    r = Request()
+    r.complete("x")
+    seen = []
+    r.add_completion_callback(lambda req: seen.append(req))
+    assert seen == [r]
+
+
+def test_wait_all_collects_first_error():
+    ok, bad = Request(), Request()
+    ok.complete(1)
+    bad.fail(MPIException("nope"))
+    with pytest.raises(MPIException, match="nope"):
+        wait_all([ok, bad])
+
+
+def test_wait_any_returns_first():
+    a, b = Request(), Request()
+    threading.Timer(0.05, lambda: b.complete("b")).start()
+    idx, val = wait_any([a, b], timeout=5)
+    assert (idx, val) == (1, "b")
+
+
+def test_wait_timeout():
+    with pytest.raises(TimeoutError):
+        Request().wait(timeout=0.05)
+
+
+# -- ops -------------------------------------------------------------------
+
+def test_basic_ops_host():
+    a = np.array([1, 2, 3])
+    b = np.array([4, 1, 3])
+    assert (op_mod.SUM(a, b) == [5, 3, 6]).all()
+    assert (op_mod.MAX(a, b) == [4, 2, 3]).all()
+    assert (op_mod.BAND(a, b) == [0, 0, 3]).all()
+
+
+def test_maxloc_tie_takes_lowest_loc():
+    from ompi_tpu.mpi.datatype import FLOAT_INT
+
+    x = np.zeros(2, FLOAT_INT.base_np)
+    y = np.zeros(2, FLOAT_INT.base_np)
+    x["val"], x["loc"] = [5.0, 1.0], [3, 0]
+    y["val"], y["loc"] = [5.0, 2.0], [1, 1]
+    out = op_mod.MAXLOC(x, y)
+    assert out["loc"][0] == 1  # tie on val=5 → lower loc wins
+    assert out["val"][1] == 2.0 and out["loc"][1] == 1
+
+
+def test_device_op():
+    import jax.numpy as jnp
+
+    out = op_mod.SUM.device(jnp.ones(3), jnp.ones(3))
+    np.testing.assert_array_equal(np.asarray(out), [2, 2, 2])
+
+
+def test_maxloc_has_no_device_impl():
+    with pytest.raises(MPIException):
+        op_mod.MAXLOC.device(None, None)
+
+
+def test_user_op():
+    myop = op_mod.create_op(lambda a, b: a + 2 * b, commutative=False)
+    assert (myop(np.array([1]), np.array([2])) == [5]).all()
+    assert not myop.commutative

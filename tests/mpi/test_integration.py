@@ -1,0 +1,55 @@
+"""Full-stack integration: examples under tpurun (≈ test/mpi/run_tests +
+examples-as-smoke-suite, SURVEY.md §4)."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def tpurun(*args, timeout=90):
+    env = dict(os.environ)
+    env.pop("OMPI_TPU_RANK", None)
+    env.setdefault("JAX_PLATFORMS", "cpu")  # keep children light
+    return subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.tpurun", *args],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO)
+
+
+def test_hello_example():
+    r = tpurun("-np", "3", "--", sys.executable, "examples/hello.py")
+    assert r.returncode == 0, r.stderr
+    for rank in range(3):
+        assert f"I am {rank} of 3" in r.stdout
+
+
+def test_ring_example():
+    r = tpurun("-np", "4", "--", sys.executable, "examples/ring.py")
+    assert r.returncode == 0, r.stderr
+    assert "Process 0 decremented value: 0" in r.stdout
+    for rank in range(4):
+        assert f"Process {rank} exiting" in r.stdout
+
+
+def test_connectivity_example():
+    r = tpurun("-np", "4", "--", sys.executable, "examples/connectivity.py")
+    assert r.returncode == 0, r.stderr
+    assert "Connectivity test on 4 processes PASSED." in r.stdout
+
+
+def test_allreduce_across_processes():
+    prog = (
+        "import numpy as np\n"
+        "import ompi_tpu\n"
+        "comm = ompi_tpu.init()\n"
+        "out = comm.allreduce(np.full(1000, comm.rank + 1.0))\n"
+        "expected = float(sum(r + 1 for r in range(comm.size)))\n"
+        "assert np.allclose(out, expected), out[:4]\n"
+        "print(f'rank {comm.rank}: allreduce ok ({out[0]:.0f})')\n"
+        "ompi_tpu.finalize()\n"
+    )
+    r = tpurun("-np", "4", "--", sys.executable, "-c", prog)
+    assert r.returncode == 0, r.stderr
+    for rank in range(4):
+        assert f"rank {rank}: allreduce ok (10)" in r.stdout

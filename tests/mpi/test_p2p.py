@@ -1,0 +1,206 @@
+"""p2p matching/protocol tests over the in-process harness (≈ the matching
+and protocol behaviors of pml_ob1: eager vs rendezvous, wildcards, unexpected
+queue, ordering, truncation)."""
+
+import numpy as np
+import pytest
+
+from ompi_tpu.core.config import var_registry
+from ompi_tpu.mpi import datatype as dt
+from ompi_tpu.mpi.constants import ANY_SOURCE, ANY_TAG, PROC_NULL, MPIException
+from ompi_tpu.mpi.request import Status
+from tests.mpi.harness import run_ranks
+
+
+def test_basic_send_recv():
+    def fn(comm):
+        if comm.rank == 0:
+            comm.send(np.arange(10, dtype=np.float32), dest=1, tag=7)
+            return None
+        st = Status()
+        out = comm.recv(source=0, tag=7, status=st)
+        assert st.source == 0 and st.tag == 7 and st.count == 10
+        return out
+
+    res = run_ranks(2, fn)
+    np.testing.assert_array_equal(res[1], np.arange(10, dtype=np.float32))
+
+
+def test_rendezvous_large_message():
+    # force rendezvous with a tiny eager limit
+    var_registry.set("pml_eager_limit", 1024)
+    var_registry.set("pml_frag_size", 4096)
+    try:
+        data = np.random.default_rng(0).normal(size=100_000).astype(np.float32)
+
+        def fn(comm):
+            if comm.rank == 0:
+                comm.send(data, dest=1)
+                return None
+            return comm.recv(source=0)
+
+        res = run_ranks(2, fn)
+        np.testing.assert_array_equal(res[1], data)
+    finally:
+        var_registry.set("pml_eager_limit", 64 * 1024)
+        var_registry.set("pml_frag_size", 1 << 20)
+
+
+def test_any_source_any_tag():
+    def fn(comm):
+        if comm.rank == 0:
+            st = Status()
+            out = comm.recv(source=ANY_SOURCE, tag=ANY_TAG, status=st)
+            assert st.source in (1, 2)
+            return int(out[0]), st.source
+        comm.send(np.array([comm.rank]), dest=0, tag=comm.rank)
+        return None
+
+    val, src = run_ranks(3, fn)[0]
+    assert val == src
+
+
+def test_unexpected_queue_order():
+    """Messages sent before the recv is posted must match in arrival order."""
+    def fn(comm):
+        if comm.rank == 0:
+            for i in range(5):
+                comm.send(np.array([i]), dest=1, tag=3)
+            return None
+        import time
+
+        time.sleep(0.2)  # let all 5 land in the unexpected queue
+        return [int(comm.recv(source=0, tag=3)[0]) for _ in range(5)]
+
+    assert run_ranks(2, fn)[1] == [0, 1, 2, 3, 4]
+
+
+def test_tag_selectivity():
+    def fn(comm):
+        if comm.rank == 0:
+            comm.send(np.array([1]), dest=1, tag=10)
+            comm.send(np.array([2]), dest=1, tag=20)
+            return None
+        second = comm.recv(source=0, tag=20)
+        first = comm.recv(source=0, tag=10)
+        return int(first[0]), int(second[0])
+
+    assert run_ranks(2, fn)[1] == (1, 2)
+
+
+def test_pair_ordering_same_tag():
+    def fn(comm):
+        n = 50
+        if comm.rank == 0:
+            for i in range(n):
+                comm.send(np.array([i]), dest=1, tag=1)
+            return None
+        return [int(comm.recv(source=0, tag=1)[0]) for _ in range(n)]
+
+    assert run_ranks(2, fn)[1] == list(range(50))
+
+
+def test_proc_null():
+    def fn(comm):
+        comm.send(np.array([1.0]), dest=PROC_NULL)
+        out = comm.recv(source=PROC_NULL)
+        return out.size
+
+    assert run_ranks(2, fn) == [0, 0]
+
+
+def test_truncation_error():
+    def fn(comm):
+        if comm.rank == 0:
+            comm.send(np.arange(100, dtype=np.float64), dest=1)
+            return "sent"
+        buf = np.zeros(10, dtype=np.float64)
+        with pytest.raises(MPIException, match="truncated"):
+            comm.recv(buf, source=0)
+        return "ok"
+
+    assert run_ranks(2, fn) == ["sent", "ok"]
+
+
+def test_recv_into_buffer():
+    def fn(comm):
+        if comm.rank == 0:
+            comm.send(np.arange(6, dtype=np.int32), dest=1)
+            return None
+        buf = np.zeros(6, dtype=np.int32)
+        out = comm.recv(buf, source=0)
+        assert out is buf
+        return buf.copy()
+
+    np.testing.assert_array_equal(run_ranks(2, fn)[1], np.arange(6))
+
+
+def test_derived_datatype_roundtrip():
+    """Send a strided column; receive it into a different strided layout."""
+    def fn(comm):
+        if comm.rank == 0:
+            m = np.arange(16, dtype=np.float32).reshape(4, 4)
+            col = dt.FLOAT32.vector(4, 1, 4).commit()  # column 0
+            comm.send(m, dest=1, datatype=col, count=1)
+            return None
+        target = np.full(8, -1.0, dtype=np.float32)
+        row = dt.FLOAT32.vector(4, 1, 2).commit()  # every other slot
+        out = comm.recv(target, source=0, datatype=row, count=1)
+        return out.copy()
+
+    got = run_ranks(2, fn)[1]
+    np.testing.assert_array_equal(got, [0, -1, 4, -1, 8, -1, 12, -1])
+
+
+def test_isend_irecv_overlap():
+    def fn(comm):
+        peer = 1 - comm.rank
+        rreq = comm.irecv(source=peer, tag=5)
+        sreq = comm.isend(np.array([comm.rank * 10]), dest=peer, tag=5)
+        out = rreq.wait()
+        sreq.wait()
+        return int(out[0])
+
+    assert run_ranks(2, fn) == [10, 0]
+
+
+def test_probe_and_iprobe():
+    def fn(comm):
+        if comm.rank == 0:
+            comm.send(np.arange(4, dtype=np.int64), dest=1, tag=9)
+            return None
+        st = comm.probe(source=0, tag=9, timeout=10)
+        assert st.count == 4 and st.source == 0 and st.tag == 9
+        out = comm.recv(source=0, tag=9)
+        assert comm.iprobe(source=0, tag=9) is None
+        return out.sum()
+
+    assert run_ranks(2, fn)[1] == 6
+
+
+def test_send_to_self():
+    def fn(comm):
+        req = comm.isend(np.array([42]), dest=comm.rank, tag=2)
+        out = comm.recv(source=comm.rank, tag=2)
+        req.wait()
+        return int(out[0])
+
+    assert run_ranks(2, fn) == [42, 42]
+
+
+def test_negative_user_tag_rejected():
+    def fn(comm):
+        with pytest.raises(MPIException):
+            comm.send(np.array([1]), dest=comm.rank, tag=-5)
+        return "ok"
+
+    assert run_ranks(1, fn) == ["ok"]
+
+
+def test_bad_rank_rejected():
+    def fn(comm):
+        with pytest.raises(MPIException):
+            comm.send(np.array([1]), dest=99)
+        return "ok"
+
+    assert run_ranks(2, fn) == ["ok", "ok"]

@@ -33,7 +33,6 @@ def test_hello_4_ranks():
 def test_output_tagged_with_rank():
     r = tpurun("-np", "2", "--", sys.executable, "-c", "print('x')")
     assert r.returncode == 0
-    lines = [l for l in r.stdout.splitlines() if "]x" in l or "] x" in l or "x" in l]
     assert any(l.startswith("[") and ",0]" in l for l in r.stdout.splitlines())
     assert any(",1]" in l for l in r.stdout.splitlines())
 
