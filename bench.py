@@ -1,0 +1,127 @@
+"""Benchmark driver — prints ONE JSON line.
+
+Mode is chosen by available hardware:
+
+- **multi-device** (≥2 chips): the north-star metric — MPI_Allreduce busbw
+  over ICI (BASELINE.json): float32 allreduce through the framework's
+  device path (DeviceCommunicator.allreduce → lax.psum), busbw =
+  2·(n-1)/n · bytes / time.
+- **single chip**: flagship-model train-step throughput (tokens/s) with
+  bfloat16 compute (MXU path) vs the same model in float32 — vs_baseline is
+  the bf16/fp32 speedup, since the reference publishes no absolute numbers
+  (BASELINE.md: "published: {}").
+
+All diagnostics go to stderr; stdout carries exactly one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def bench_allreduce_busbw(devices) -> dict:
+    import jax
+
+    from ompi_tpu.mpi.device_comm import device_world
+    from ompi_tpu.parallel.mesh import make_mesh
+
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    n = len(devices)
+    mesh = make_mesh(devices=devices)
+    comm = device_world(mesh)
+    per_device = 1 << 28  # 256 MiB per device
+    x = np.ones((n * (per_device // 4),), np.float32)
+
+    # build ONE jitted program and reuse it — retracing would dominate
+    fn = jax.jit(jax.shard_map(
+        lambda s: comm.allreduce(s), mesh=mesh,
+        in_specs=P("world"), out_specs=P("world"), check_vma=False))
+    jax.block_until_ready(fn(x))  # compile + warm ICI
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(x)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    shard_bytes = x.nbytes / n
+    busbw = 2 * (n - 1) / n * shard_bytes / dt
+    log(f"allreduce {shard_bytes/2**20:.0f}MiB/dev over {n} devices: "
+        f"{dt*1e3:.2f}ms → busbw {busbw/2**30:.2f} GiB/s")
+    return {
+        "metric": f"MPI_Allreduce busbw over ICI ({n} chips, fp32)",
+        "value": round(busbw / 2**30, 3),
+        "unit": "GiB/s",
+        "vs_baseline": 1.0,  # reference publishes no number (BASELINE.md)
+    }
+
+
+def _throughput(cfg, mesh, tokens, steps=8):
+    import jax
+
+    from ompi_tpu.models import transformer as tfm
+
+    params = tfm.init_params(cfg)
+    step, init_opt = tfm.make_train_step(cfg, mesh, lr=1e-3)
+    opt_state = init_opt(params)
+    params, opt_state, loss = step(params, opt_state, tokens)  # compile
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, tokens)
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / steps
+    toks = tokens.size
+    return toks / dt, float(loss)
+
+
+def bench_flagship_single_chip() -> dict:
+    import jax
+
+    from ompi_tpu.models.transformer import TransformerConfig
+    from ompi_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh({"dp": 1, "sp": 1, "tp": 1}, devices=jax.devices()[:1])
+    base = dict(vocab=32_000, d_model=1024, n_heads=16, n_layers=8,
+                d_ff=4096, seq=1024, attention="ring")
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, base["vocab"], size=(4, base["seq"])).astype(np.int32)
+
+    bf16, loss_b = _throughput(
+        TransformerConfig(**base, compute_dtype="bfloat16"), mesh, tokens)
+    log(f"bf16 train step: {bf16:,.0f} tok/s (loss {loss_b:.3f})")
+    fp32, loss_f = _throughput(
+        TransformerConfig(**base, compute_dtype="float32"), mesh, tokens)
+    log(f"fp32 train step: {fp32:,.0f} tok/s (loss {loss_f:.3f})")
+    return {
+        "metric": "flagship transformer train-step throughput "
+                  "(1 chip, bf16, 110M params, seq 1024)",
+        "value": round(bf16, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(bf16 / fp32, 3),  # speedup over fp32 same model
+    }
+
+
+def main() -> None:
+    import jax
+
+    devices = jax.devices()
+    log(f"devices: {devices}")
+    if len(devices) >= 2:
+        result = bench_allreduce_busbw(devices)
+    else:
+        result = bench_flagship_single_chip()
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

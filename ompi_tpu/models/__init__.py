@@ -1,0 +1,1 @@
+"""Flagship models built on the framework's parallel primitives."""

@@ -1,0 +1,305 @@
+"""DeviceCommunicator — the coll/xla + btl/tpu path: collectives on
+HBM-resident buffers, lowered to XLA collectives over an ICI mesh.
+
+This is BASELINE.json's north star realized TPU-first.  Where the reference
+stages device buffers through host bounce buffers and runs the CPU algorithms
+(ompi/mca/coll/cuda/coll_cuda_allreduce.c:30-69), here a communicator IS a
+set of mesh axes: its collectives trace to ``lax.psum`` / ``psum_scatter`` /
+``all_gather`` / ``all_to_all`` / ``ppermute``, compile into the surrounding
+jit program, and move data purely over ICI with zero host copies.  "Ranks"
+are devices; a sub-communicator is a subset of mesh axes (so comm "split by
+color" along hardware dimensions costs nothing — it is how the mesh is
+addressed).
+
+Two usage modes:
+
+- **traced** (the hot path): call the methods inside ``shard_map``/``jit``
+  over the communicator's axes.  Everything is compiled; XLA overlaps and
+  fuses the collectives with surrounding compute.
+- **driver**: ``comm.run(fn, *arrays)`` wraps ``shard_map`` with
+  fully-sharded in/out specs for quick use and tests.
+
+The host algorithm inventory maps as (SURVEY.md §2.6):
+  allreduce ring/recursive-doubling → psum (XLA picks the ICI algorithm)
+  reduce_scatter ring               → psum_scatter
+  allgather bruck/ring              → all_gather
+  alltoall pairwise                 → all_to_all
+  sendrecv ring shifts              → ppermute
+  barrier                           → optimization_barrier + ppermute token
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+
+from ompi_tpu.mpi.constants import MPIException
+from ompi_tpu.mpi.op import MAX, MIN, SUM, Op
+
+__all__ = ["DeviceCommunicator", "device_world"]
+
+
+class DeviceCommunicator:
+    """A communicator over one or more mesh axes.
+
+    ``axes`` is an ordered tuple of axis names; the rank is the row-major
+    flat index over those axes (matching MPI rank order for a cartesian
+    communicator, ≈ MPI_Cart_create semantics).
+    """
+
+    def __init__(self, mesh, axes: Optional[Sequence[str]] = None,
+                 name: str = "device") -> None:
+        import jax
+
+        self.mesh = mesh
+        self.axes: tuple[str, ...] = tuple(axes if axes is not None
+                                           else mesh.axis_names)
+        for ax in self.axes:
+            if ax not in mesh.axis_names:
+                raise MPIException(f"axis {ax!r} not in mesh {mesh.axis_names}")
+        self.name = name
+        self._jax = jax
+
+    # -- shape -------------------------------------------------------------
+
+    @property
+    def size(self) -> int:
+        return int(np.prod([self.mesh.shape[a] for a in self.axes]))
+
+    @property
+    def axis_sizes(self) -> tuple[int, ...]:
+        return tuple(int(self.mesh.shape[a]) for a in self.axes)
+
+    def rank(self):
+        """Traced: my flat rank over the axes (row-major)."""
+        from jax import lax
+
+        r = lax.axis_index(self.axes[0])
+        for ax in self.axes[1:]:
+            r = r * self.mesh.shape[ax] + lax.axis_index(ax)
+        return r
+
+    def coords(self):
+        """Traced: my coordinates along each axis (≈ MPI_Cart_coords)."""
+        from jax import lax
+
+        return tuple(lax.axis_index(ax) for ax in self.axes)
+
+    def sub(self, axes: Sequence[str], name: Optional[str] = None
+            ) -> "DeviceCommunicator":
+        """Sub-communicator over a subset of my axes (≈ MPI_Cart_sub: free
+        the other dimensions). Zero-cost: just re-addresses the mesh."""
+        return DeviceCommunicator(self.mesh, axes,
+                                  name or f"{self.name}.sub{tuple(axes)}")
+
+    @property
+    def _ax(self):
+        """Axis argument for lax collectives (name or tuple of names)."""
+        return self.axes if len(self.axes) > 1 else self.axes[0]
+
+    # -- collectives (traced) ---------------------------------------------
+
+    def allreduce(self, x, op: Op = SUM):
+        """≈ MPI_Allreduce → fused XLA collective (psum/pmax/pmin), falling
+        back to all_gather + ordered tree fold for ops without one."""
+        from jax import lax
+
+        if op is SUM or op.jax_reduce_name == "psum":
+            return lax.psum(x, self._ax)
+        if op is MAX:
+            return lax.pmax(x, self._ax)
+        if op is MIN:
+            return lax.pmin(x, self._ax)
+        return self._allreduce_generic(x, op)
+
+    def _allreduce_generic(self, x, op: Op):
+        """Any associative op: all_gather then rank-ordered fold (compiled;
+        fine for small payloads, which is what exotic ops are in practice)."""
+        import jax.numpy as jnp
+
+        from jax import lax
+
+        stacked = lax.all_gather(x, self._ax, tiled=False)
+        stacked = stacked.reshape((self.size,) + x.shape)
+        # rank-ordered left fold (MPI's non-commutative contract)
+        acc = stacked[0]
+        for r in range(1, self.size):
+            acc = op.device(acc, stacked[r])
+        return acc
+
+    def reduce(self, x, op: Op = SUM, root: int = 0):
+        """≈ MPI_Reduce. SPMD note: every device computes the value (psum is
+        already allreduce on ICI); non-roots receive zeros to keep the MPI
+        shape contract while letting XLA DCE unused branches."""
+        import jax.numpy as jnp
+
+        full = self.allreduce(x, op)
+        return jnp.where(self.rank() == root, full,
+                         jnp.zeros_like(full))
+
+    def bcast(self, x, root: int = 0):
+        """≈ MPI_Bcast: select root's contribution via masked psum."""
+        import jax.numpy as jnp
+
+        from jax import lax
+
+        contrib = jnp.where(self.rank() == root, x, jnp.zeros_like(x))
+        return lax.psum(contrib, self._ax)
+
+    def reduce_scatter(self, x, op: Op = SUM, axis: int = 0):
+        """≈ MPI_Reduce_scatter → psum_scatter (the ring lives in XLA/ICI)."""
+        from jax import lax
+
+        if op is not SUM:
+            # psum_scatter is sum-only; generic path reduces then slices
+            full = self.allreduce(x, op)
+            return _my_block(self, full, axis)
+        return lax.psum_scatter(x, self._ax, scatter_dimension=axis,
+                                tiled=True)
+
+    def allgather(self, x, axis: int = 0):
+        """≈ MPI_Allgather → all_gather, concatenated along `axis`."""
+        from jax import lax
+
+        return lax.all_gather(x, self._ax, axis=axis, tiled=True)
+
+    def alltoall(self, x, split_axis: int = 0, concat_axis: int = 0):
+        """≈ MPI_Alltoall → all_to_all over the axes."""
+        from jax import lax
+
+        return lax.all_to_all(x, self._ax, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)
+
+    def gather(self, x, root: int = 0, axis: int = 0):
+        """≈ MPI_Gather: allgather + zero on non-roots (see reduce note)."""
+        import jax.numpy as jnp
+
+        full = self.allgather(x, axis=axis)
+        return jnp.where(self.rank() == root, full, jnp.zeros_like(full))
+
+    def scatter(self, x, root: int = 0, axis: int = 0):
+        """≈ MPI_Scatter: bcast root's buffer, slice my block."""
+        return _my_block(self, self.bcast(x, root), axis)
+
+    def scan(self, x, op: Op = SUM):
+        """≈ MPI_Scan (inclusive prefix): allgather + masked ordered fold."""
+        import jax.numpy as jnp
+
+        from jax import lax
+
+        stacked = lax.all_gather(x, self._ax, tiled=False)
+        stacked = stacked.reshape((self.size,) + x.shape)
+        if op is SUM:
+            prefix = jnp.cumsum(stacked, axis=0)
+            return prefix[self.rank()]
+        acc = stacked[0]
+        outs = [acc]
+        for r in range(1, self.size):
+            acc = op.device(acc, stacked[r])
+            outs.append(acc)
+        return jnp.stack(outs)[self.rank()]
+
+    def barrier(self, token=None):
+        """SPMD barrier: a zero-byte psum forces cross-device sync ordering.
+        Returns a token to thread through data dependencies."""
+        import jax.numpy as jnp
+
+        from jax import lax
+
+        t = token if token is not None else jnp.zeros((), jnp.int32)
+        return lax.psum(t, self._ax) * 0
+
+    # -- point-to-point as permutation (the TPU-native shape of send/recv) -
+
+    def shift(self, x, displacement: int = 1, axis: Optional[str] = None):
+        """Cyclic ring shift (≈ MPI_Cart_shift + Sendrecv): every device
+        sends to (i+displacement) mod n along `axis` → one ICI hop."""
+        from jax import lax
+
+        ax = axis or self.axes[-1]
+        n = self.mesh.shape[ax]
+        perm = [(i, (i + displacement) % n) for i in range(n)]
+        return lax.ppermute(x, ax, perm)
+
+    def permute(self, x, perm: Sequence[tuple[int, int]],
+                axis: Optional[str] = None):
+        """General (src, dst) permutation → lax.ppermute. Pairs not covered
+        receive zeros (lax semantics; matches one-sided put into a zeroed
+        window)."""
+        from jax import lax
+
+        return lax.ppermute(x, axis or self.axes[-1], list(perm))
+
+    def sendrecv(self, x, dest_disp: int, source_disp: Optional[int] = None,
+                 axis: Optional[str] = None):
+        """Cyclic exchange by *displacement* (SPMD: every device passes the
+        same arguments, so peers are displacements, not absolute ranks —
+        exactly MPI_Cart_shift + MPI_Sendrecv semantics).  ``source_disp``,
+        if given, must be the matching -dest_disp pattern; anything else is
+        not a permutation and is rejected."""
+        from jax import lax
+
+        ax = axis or self.axes[-1]
+        n = int(self.mesh.shape[ax])
+        off = dest_disp % n
+        if source_disp is not None and (source_disp % n) != (-dest_disp) % n:
+            raise MPIException(
+                f"sendrecv: source_disp {source_disp} does not match "
+                f"dest_disp {dest_disp} (need source ≡ -dest mod {n} for a "
+                f"cyclic pattern; use permute() for general patterns)")
+        perm = [(i, (i + off) % n) for i in range(n)]
+        return lax.ppermute(x, ax, perm)
+
+    # -- driver-mode helper ------------------------------------------------
+
+    def run(self, fn: Callable, *arrays, out_specs: Any = None):
+        """Run fn(self, *shards) under shard_map over my axes, splitting each
+        input along axis 0. Convenience for tests/small jobs; real programs
+        write their own shard_map/jit with explicit specs."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        axes = self.axes
+        spec = P(axes if len(axes) > 1 else axes[0])
+        in_specs = tuple(spec for _ in arrays)
+        out_sp = out_specs if out_specs is not None else spec
+
+        @functools.partial(
+            jax.shard_map, mesh=self.mesh, in_specs=in_specs,
+            out_specs=out_sp, check_vma=False)
+        def shmapped(*shards):
+            return fn(self, *shards)
+
+        return jax.jit(shmapped)(*arrays)
+
+    def __repr__(self) -> str:
+        return (f"DeviceCommunicator({self.name}, axes={self.axes}, "
+                f"size={self.size})")
+
+
+def _my_block(comm: DeviceCommunicator, full, axis: int):
+    """Slice this rank's equal block along `axis` (traced)."""
+    from jax import lax
+
+    n = comm.size
+    block = full.shape[axis] // n
+    start = comm.rank() * block
+    sizes = list(full.shape)
+    sizes[axis] = block
+    starts = [0] * full.ndim
+    starts[axis] = start
+    return lax.dynamic_slice(full, starts, sizes)
+
+
+def device_world(mesh=None, axes=None) -> DeviceCommunicator:
+    """The device-side COMM_WORLD: all chips of the mesh (default: one mesh
+    over every local device)."""
+    if mesh is None:
+        import jax
+        from jax.sharding import Mesh
+
+        devs = np.array(jax.devices())
+        mesh = Mesh(devs, axis_names=("world",))
+    return DeviceCommunicator(mesh, axes, name="DEVICE_WORLD")

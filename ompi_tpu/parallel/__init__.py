@@ -1,0 +1,12 @@
+"""Parallelism layer: mesh construction, sharding helpers, and the
+long-context/sequence-parallel primitives (ring attention, all-to-all head
+parallelism) built on the framework's device collectives.
+
+These are the TPU-native expression of the reference's communication
+patterns (SURVEY.md §5): ring attention is the segmented-ring allreduce
+shape (coll_base_allreduce.c:615) with double buffering; Ulysses-style
+sequence parallelism is the pairwise alltoall (coll_base_alltoall.c:132)
+over the head dimension.
+"""
+
+from ompi_tpu.parallel.mesh import make_mesh, mesh_shape_for

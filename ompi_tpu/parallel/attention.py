@@ -1,0 +1,140 @@
+"""Sequence-parallel attention: ring attention and all-to-all (Ulysses-style).
+
+Long-context support is first-class (SURVEY.md §5): a sequence longer than
+one chip's HBM is sharded over a mesh axis, and attention runs either as
+
+- **ring attention** — K/V blocks rotate around the ``sp`` ring via
+  ``ppermute`` while each device accumulates its queries' attention with an
+  online (flash-style) softmax.  Communication shape = the reference's
+  segmented-ring allreduce (coll_base_allreduce.c:615): p-1 neighbor hops of
+  1/p of the data, overlapped with compute by XLA. O(T_local²·sp) FLOPs,
+  O(T_local) memory.
+- **all-to-all (Ulysses)** — one ``all_to_all`` re-shards from
+  sequence-sharded to head-sharded, full attention runs locally, and a
+  second ``all_to_all`` restores sequence sharding.  Communication shape =
+  pairwise alltoall (coll_base_alltoall.c:132). Needs heads % sp == 0.
+
+Both are exact (not approximations) and differentiable; tests cross-check
+them against gathered full attention on the virtual CPU mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["local_attention", "ring_attention", "ulysses_attention",
+           "gathered_attention"]
+
+_NEG = -1e30
+
+
+def local_attention(q, k, v, causal: bool = True,
+                    q_offset=0, k_offset=0, scale: Optional[float] = None):
+    """Plain attention over local blocks; offsets give global positions for
+    causal masking when the blocks are slices of a longer sequence.
+
+    Shapes: q (B, Tq, H, D), k/v (B, Tk, H, D) → (B, Tq, H, D).
+    """
+    import jax.numpy as jnp
+
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        qpos = q_offset + jnp.arange(q.shape[1])
+        kpos = k_offset + jnp.arange(k.shape[1])
+        mask = qpos[:, None] >= kpos[None, :]
+        scores = jnp.where(mask[None, None], scores, _NEG)
+    w = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+    w = w / w.sum(axis=-1, keepdims=True)
+    return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+
+
+def ring_attention(comm, q, k, v, axis: Optional[str] = None,
+                   causal: bool = True, scale: Optional[float] = None):
+    """Exact attention over a sequence sharded along ``axis`` of
+    ``comm.mesh``; call inside shard_map.
+
+    Each step attends my queries against the currently-held K/V block, then
+    rotates K/V one hop around the ring (device r → r+1), so after sp steps
+    every (query, key) pair has met.  Accumulation is the numerically-stable
+    online softmax (running max m, normalizer l, weighted value sum acc) in
+    float32.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    ax = axis or comm.axes[-1]
+    sp = int(comm.mesh.shape[ax])
+    if sp == 1:  # degenerate ring: skip the loop machinery entirely
+        return local_attention(q, k, v, causal=causal, scale=scale)
+    my = lax.axis_index(ax)
+    B, T, H, D = q.shape
+    scale = scale if scale is not None else D ** -0.5
+
+    qf = q.astype(jnp.float32)
+    qpos = my * T + jnp.arange(T)
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    def step(i, carry):
+        m, l, acc, k_cur, v_cur = carry
+        src = (my - i) % sp  # whose block I currently hold
+        scores = jnp.einsum("bqhd,bkhd->bhqk", qf,
+                            k_cur.astype(jnp.float32)) * scale
+        if causal:
+            kpos = src * T + jnp.arange(T)
+            keep = qpos[:, None] >= kpos[None, :]
+            scores = jnp.where(keep[None, None], scores, _NEG)
+        s_max = scores.max(axis=-1)                       # (B,H,Tq)
+        m_new = jnp.maximum(m, s_max)
+        p = jnp.exp(scores - m_new[..., None])            # (B,H,Tq,Tk)
+        if causal:
+            p = jnp.where(keep[None, None], p, 0.0)
+        corr = jnp.exp(m - m_new)                         # (B,H,Tq)
+        l_new = l * corr + p.sum(axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bhqd", p, v_cur.astype(jnp.float32))
+        acc_new = acc * corr[..., None] + pv
+        k_nxt = lax.ppermute(k_cur, ax, perm)
+        v_nxt = lax.ppermute(v_cur, ax, perm)
+        return (m_new, l_new, acc_new, k_nxt, v_nxt)
+
+    m0 = jnp.full((B, H, T), _NEG, jnp.float32)
+    l0 = jnp.zeros((B, H, T), jnp.float32)
+    acc0 = jnp.zeros((B, H, T, D), jnp.float32)
+    m, l, acc, _, _ = lax.fori_loop(0, sp, step, (m0, l0, acc0, k, v))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]          # (B,H,Tq,D)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)      # (B,Tq,H,D)
+
+
+def ulysses_attention(comm, q, k, v, axis: Optional[str] = None,
+                      causal: bool = True, scale: Optional[float] = None):
+    """All-to-all sequence parallelism: re-shard seq→heads, attend fully
+    locally, re-shard back.  Exact; one alltoall each way."""
+    from jax import lax
+
+    ax = axis or comm.axes[-1]
+    sp = int(comm.mesh.shape[ax])
+    H = q.shape[2]
+    if H % sp:
+        raise ValueError(f"ulysses needs heads ({H}) divisible by sp ({sp})")
+    # (B, T/sp, H, D) → (B, T, H/sp, D)
+    q2, k2, v2 = (lax.all_to_all(t, ax, split_axis=2, concat_axis=1,
+                                 tiled=True) for t in (q, k, v))
+    o = local_attention(q2, k2, v2, causal=causal, scale=scale)
+    # (B, T, H/sp, D) → (B, T/sp, H, D)
+    return lax.all_to_all(o, ax, split_axis=1, concat_axis=2, tiled=True)
+
+
+def gathered_attention(comm, q, k, v, axis: Optional[str] = None,
+                       causal: bool = True, scale: Optional[float] = None):
+    """Reference implementation: allgather K/V and attend (O(T) memory per
+    device — the thing ring attention exists to avoid). Used for testing."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    ax = axis or comm.axes[-1]
+    my = lax.axis_index(ax)
+    T = q.shape[1]
+    k_all = lax.all_gather(k, ax, axis=1, tiled=True)
+    v_all = lax.all_gather(v, ax, axis=1, tiled=True)
+    return local_attention(q, k_all, v_all, causal=causal,
+                           q_offset=my * T, k_offset=0, scale=scale)

@@ -1,0 +1,74 @@
+"""Mesh construction and sharding helpers.
+
+The device-mesh analog of ras/rmaps (SURVEY.md §2.6): where the reference
+maps ranks onto nodes, the TPU build addresses chips as a
+``jax.sharding.Mesh`` whose axes carry parallelism roles (dp/sp/tp/...).
+``make_mesh`` respects hardware order (jax.devices() enumerates ICI
+neighbors adjacently on TPU, so the innermost mesh axis rides the
+fastest links — the latency/bandwidth ranking knob of btl.h:1181-1183,
+decided by layout instead of parameters).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import numpy as np
+
+__all__ = ["make_mesh", "mesh_shape_for"]
+
+
+def mesh_shape_for(n_devices: int, axis_names: Sequence[str]) -> dict[str, int]:
+    """Factor n_devices over the axes, largest factors innermost (the last
+    axis gets the largest factor → tensor-parallel on the fastest links).
+
+    Outer axes take the largest divisor ≤ the remaining geometric mean
+    (rounded down), so the leftover — always ≥ the mean — lands innermost.
+    """
+    names = list(axis_names)
+    shape = {name: 1 for name in names}
+    remaining = n_devices
+    for i, name in enumerate(names[:-1]):
+        axes_left = len(names) - i
+        target = int(math.floor(remaining ** (1 / axes_left)))
+        f = 1
+        for cand in range(max(1, target), 0, -1):
+            if remaining % cand == 0:
+                f = cand
+                break
+        shape[name] = f
+        remaining //= f
+    shape[names[-1]] = remaining
+    return shape
+
+
+def make_mesh(axes: Optional[dict[str, int] | Sequence[str]] = None,
+              devices=None):
+    """Build a Mesh.
+
+    - ``make_mesh()`` → 1-D mesh ("world") over all devices.
+    - ``make_mesh({"dp": 2, "tp": 4})`` → explicit shape (must multiply to
+      the device count; a -1 entry is inferred).
+    - ``make_mesh(["dp", "tp"])`` → auto-factored shape.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devs = np.array(devices if devices is not None else jax.devices())
+    n = devs.size
+    if axes is None:
+        return Mesh(devs.reshape(n), axis_names=("world",))
+    if not isinstance(axes, dict):
+        axes = mesh_shape_for(n, list(axes))
+    names = list(axes)
+    sizes = [axes[a] for a in names]
+    if sizes.count(-1) == 1:
+        known = -int(np.prod(sizes))
+        sizes[sizes.index(-1)] = n // known
+    total = int(np.prod(sizes))
+    if total != n:
+        raise ValueError(
+            f"mesh shape {dict(zip(names, sizes))} needs {total} devices, "
+            f"have {n}")
+    return Mesh(devs.reshape(sizes), axis_names=tuple(names))

@@ -8,9 +8,23 @@ plus oversubscribed localhost launch).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force the virtual mesh even when the ambient environment points JAX at a
+# real accelerator (JAX_PLATFORMS=axon/tpu); OMPI_TPU_TEST_REAL=1 opts out.
+if os.environ.get("OMPI_TPU_TEST_REAL") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# Pytest plugins (jaxtyping) import jax before this conftest runs, so the
+# env vars above may be too late for jax's config snapshot; push the platform
+# choice through the live config instead (backends are not yet instantiated
+# at collection time, so this is still safe).
+import sys  # noqa: E402
+
+if "jax" in sys.modules and os.environ.get("OMPI_TPU_TEST_REAL") != "1":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

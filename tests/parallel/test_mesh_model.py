@@ -1,0 +1,93 @@
+"""Mesh helpers + flagship model: 3D-parallel (dp×sp×tp) train step on the
+virtual 8-device mesh; ring vs gathered attention parity; loss decreases."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from ompi_tpu.models import transformer as tfm
+from ompi_tpu.parallel.mesh import make_mesh, mesh_shape_for
+
+
+def test_mesh_shape_factoring():
+    # the innermost (last) axis always gets the largest factor
+    assert mesh_shape_for(8, ["dp", "tp"]) == {"dp": 2, "tp": 4}
+    assert mesh_shape_for(8, ["dp", "sp", "tp"]) == {"dp": 2, "sp": 2, "tp": 2}
+    assert mesh_shape_for(6, ["dp", "sp", "tp"]) == {"dp": 1, "sp": 2, "tp": 3}
+    assert mesh_shape_for(1, ["dp", "tp"]) == {"dp": 1, "tp": 1}
+    for n in (2, 3, 4, 5, 6, 8, 12, 16):
+        s = mesh_shape_for(n, ["a", "b", "c"])
+        assert int(np.prod(list(s.values()))) == n
+        assert s["c"] == max(s.values())
+
+
+def test_make_mesh_variants():
+    m = make_mesh()
+    assert m.axis_names == ("world",) and m.size == 8
+    m2 = make_mesh({"dp": 2, "tp": -1})
+    assert m2.shape == {"dp": 2, "tp": 4}
+    with pytest.raises(ValueError):
+        make_mesh({"dp": 3, "tp": 3})
+
+
+CFG = tfm.TransformerConfig(
+    vocab=128, d_model=64, n_heads=4, n_layers=2, d_ff=128, seq=32,
+    attention="ring", compute_dtype="float32")
+
+
+def _mesh222():
+    return make_mesh({"dp": 2, "sp": 2, "tp": 2})
+
+
+def _tokens(cfg, batch=4, seed=1):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, cfg.vocab, size=(batch, cfg.seq)).astype(np.int32)
+
+
+def test_forward_shapes():
+    mesh = _mesh222()
+    params = tfm.init_params(CFG)
+    fwd = jax.jit(tfm.make_forward(CFG, mesh))
+    logits = fwd(params, _tokens(CFG))
+    assert logits.shape == (4, CFG.seq, CFG.vocab)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_ring_equals_gathered_loss():
+    import dataclasses
+
+    mesh = _mesh222()
+    params = tfm.init_params(CFG)
+    toks = _tokens(CFG)
+    l_ring = jax.jit(tfm.make_loss_fn(CFG, mesh))(params, toks)
+    cfg_g = dataclasses.replace(CFG, attention="gathered")
+    l_gath = jax.jit(tfm.make_loss_fn(cfg_g, mesh))(params, toks)
+    np.testing.assert_allclose(float(l_ring), float(l_gath), rtol=1e-5)
+
+
+def test_train_step_decreases_loss():
+    mesh = _mesh222()
+    params = tfm.init_params(CFG)
+    step, init_opt = tfm.make_train_step(CFG, mesh, lr=1e-2)
+    opt_state = init_opt(params)
+    toks = _tokens(CFG)
+    losses = []
+    for _ in range(5):
+        params, opt_state, loss = step(params, opt_state, toks)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+
+
+def test_tp_sharding_is_real():
+    """The compiled train step must actually shard tp weights (not silently
+    replicate): check the output sharding of the updated params."""
+    mesh = _mesh222()
+    params = tfm.init_params(CFG)
+    step, init_opt = tfm.make_train_step(CFG, mesh, lr=1e-3)
+    opt_state = init_opt(params)
+    new_params, _, _ = step(params, opt_state, _tokens(CFG))
+    shard_shape = new_params["w1"].sharding.shard_shape(
+        new_params["w1"].shape)
+    assert shard_shape[-1] == CFG.d_ff // 2  # tp=2
