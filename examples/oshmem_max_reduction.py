@@ -1,0 +1,30 @@
+"""Max reduction across PEs (≈ examples/oshmem_max_reduction.c):
+every PE fills a symmetric array with rank-dependent values; max_to_all
+leaves the elementwise maximum on every PE.
+
+Run:  tpurun -np 4 -- python examples/oshmem_max_reduction.py
+"""
+
+import numpy as np
+
+from ompi_tpu import shmem
+from ompi_tpu.mpi import op as op_mod
+
+N = 8
+
+
+def main() -> None:
+    shmem.init()
+    me, n = shmem.my_pe(), shmem.n_pes()
+    src = shmem.array((N,), dtype=np.int64)
+    src[:] = me + np.arange(N)
+    shmem.barrier_all()
+    shmem.to_all(src, op=op_mod.MAX)
+    expected = (n - 1) + np.arange(N)
+    assert (src[:] == expected).all(), (src[:], expected)
+    print(f"PE {me}: max reduction ok: {src[:].tolist()}")
+    shmem.finalize()
+
+
+if __name__ == "__main__":
+    main()

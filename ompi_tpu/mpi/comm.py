@@ -158,7 +158,8 @@ class Communicator:
 
     def _coll_irecv(self, buf, source: int, coll_tag: int,
                     datatype=None, count=None) -> Request:
-        return self.pml.irecv(buf, self.world_rank(source),
+        src = source if source < 0 else self.world_rank(source)
+        return self.pml.irecv(buf, src,
                               _INTERNAL_TAG_BASE - coll_tag, self.cid,
                               datatype, count)
 

@@ -284,6 +284,10 @@ def _my_block(comm: DeviceCommunicator, full, axis: int):
     from jax import lax
 
     n = comm.size
+    if full.shape[axis] % n:
+        raise MPIException(
+            f"dimension {axis} ({full.shape[axis]}) not divisible by "
+            f"communicator size {n}")
     block = full.shape[axis] // n
     start = comm.rank() * block
     sizes = list(full.shape)

@@ -1,0 +1,357 @@
+"""OSC — one-sided communication (MPI RMA windows).
+
+≈ ompi/mca/osc (osc.h:370-408).  The reference has two strategies: map
+windows onto RDMA put/get (osc/rdma, osc_rdma_comm.c:418,539) or emulate
+over p2p (osc/pt2pt).  Host-path windows here are the pt2pt strategy
+re-designed around an **active-message service**: each window runs a service
+thread on a private dup of the communicator; PUT/GET/ACC/FETCH/LOCK requests
+are applied atomically against the local buffer.  Synchronization:
+
+- ``fence``  — active-target: an allreduce of sent-op counts tells each rank
+  how many incoming ops to wait for, then a barrier (the standard
+  counting-fence; the reference's pt2pt fence does the same bookkeeping).
+- ``lock/unlock`` — passive-target: queued exclusive/shared locks at the
+  target service; unlock flushes (waits until the target applied all my
+  ops) before releasing.
+
+Device-path RMA needs none of this machinery: a "window" on TPU is an
+identically-sharded array and put/get are ``ppermute``/gather collectives —
+see DeviceCommunicator.permute and the shmem device docs (SURVEY.md §3.5
+TPU mapping).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Optional
+
+import numpy as np
+
+from ompi_tpu.core import dss
+from ompi_tpu.mpi import op as op_mod
+from ompi_tpu.mpi.constants import ANY_SOURCE, MPIException
+from ompi_tpu.mpi.request import Request
+
+__all__ = ["Window"]
+
+# Reserved tags on the window's private comm, in a range disjoint from the
+# collective tags (coll/base.py TAG_* 1..10) — the service thread's
+# ANY_SOURCE receive must never match a collective running on the same comm.
+_TAG_REQ = 500
+_TAG_REPLY = 501
+
+
+def _ctrl_send(comm, dest: int, obj: Any, tag: int) -> Request:
+    payload = np.frombuffer(dss.pack(obj), dtype=np.uint8)
+    return comm._coll_isend(payload, dest, tag)
+
+
+def _ctrl_recv(comm, source: int, tag: int) -> Any:
+    arr = comm._coll_irecv(None, source, tag).wait()
+    return dss.unpack(arr.tobytes(), n=1)[0]
+
+
+def _check_predefined(op) -> None:
+    """MPI rule: accumulate/fetch ops must be predefined (MPI-3.1 §11.3.4);
+    the target rehydrates them by name, so user ops cannot travel."""
+    if getattr(op_mod, op.name.upper(), None) is not op:
+        raise MPIException(
+            f"RMA accumulate requires a predefined op, got {op!r} "
+            f"(user-defined ops are not valid for MPI_Accumulate)")
+
+
+class _LockState:
+    def __init__(self) -> None:
+        self.holder: Optional[int] = None  # origin rank holding exclusive
+        self.shared: set[int] = set()
+        self.queue: list[tuple[int, bool]] = []  # (origin, exclusive)
+
+
+class Window:
+    """An RMA window over a local numpy buffer (collective constructor)."""
+
+    def __init__(self, comm, size: Optional[int] = None,
+                 buffer: Optional[np.ndarray] = None,
+                 dtype=np.uint8, name: str = "win") -> None:
+        if buffer is None:
+            if size is None:
+                raise MPIException("Window needs size= or buffer=")
+            buffer = np.zeros(size, dtype=dtype)
+        self.buf = np.ascontiguousarray(buffer)
+        self.comm = comm.dup(name=f"{name}.osc")
+        self.name = name
+        self._buf_lock = threading.RLock()
+        self._lock_state = _LockState()
+        self._applied_from: dict[int, int] = {}   # origin → ops applied
+        self._applied_total = 0
+        self._sent_to = [0] * comm.size           # my ops per target
+        self._cv = threading.Condition(self._buf_lock)
+        self._epoch_reqs: list[Request] = []
+        self._origin_lock = threading.Lock()      # serializes blocking ops
+        self._ids = itertools.count(1)
+        self._service = threading.Thread(
+            target=self._serve, name=f"osc-{name}-{comm.rank}", daemon=True)
+        self._service.start()
+
+    # -- origin side -------------------------------------------------------
+
+    def _track(self, target: int, req: Optional[Request] = None) -> None:
+        """Count an issued op toward fence/flush totals; reap finished
+        requests so passive-target-only programs don't accumulate them."""
+        self._sent_to[target] += 1
+        self._epoch_reqs = [r for r in self._epoch_reqs if not r.done()]
+        if req is not None:
+            self._epoch_reqs.append(req)
+
+    def put(self, target: int, data: np.ndarray, offset: int = 0) -> None:
+        """≈ MPI_Put: completes locally at the next sync (fence/unlock)."""
+        data = np.ascontiguousarray(data)
+        if target == self.comm.rank:
+            self._track(target)
+            self._apply_put(self.comm.rank, offset, data)
+            return
+        req = _ctrl_send(self.comm, target,
+                         ("put", self.comm.rank, offset, data), _TAG_REQ)
+        self._track(target, req)
+
+    def get(self, target: int, count: int, offset: int = 0) -> np.ndarray:
+        """≈ MPI_Get (blocking convenience: data returns immediately)."""
+        if target == self.comm.rank:
+            with self._buf_lock:
+                return self.buf[offset:offset + count].copy()
+        with self._origin_lock:
+            _ctrl_send(self.comm, target,
+                       ("get", self.comm.rank, offset, count), _TAG_REQ).wait()
+            return np.asarray(_ctrl_recv(self.comm, target, _TAG_REPLY))
+
+    def accumulate(self, target: int, data: np.ndarray, op=op_mod.SUM,
+                   offset: int = 0) -> None:
+        """≈ MPI_Accumulate: elementwise op applied atomically at target."""
+        _check_predefined(op)
+        data = np.ascontiguousarray(data)
+        if target == self.comm.rank:
+            self._track(target)
+            self._apply_acc(self.comm.rank, offset, data, op.name)
+            return
+        req = _ctrl_send(self.comm, target,
+                         ("acc", self.comm.rank, offset, data, op.name),
+                         _TAG_REQ)
+        self._track(target, req)
+
+    def fetch_op(self, target: int, value, op=op_mod.SUM,
+                 offset: int = 0) -> np.ndarray:
+        """≈ MPI_Fetch_and_op: atomic read-modify-write, returns old value."""
+        _check_predefined(op)
+        value = np.ascontiguousarray(value)
+        if target == self.comm.rank:
+            self._track(target)
+            return self._apply_fetch(self.comm.rank, offset, value, op.name)
+        with self._origin_lock:
+            self._track(target)
+            _ctrl_send(self.comm, target,
+                       ("fetch", self.comm.rank, offset, value, op.name),
+                       _TAG_REQ).wait()
+            return np.asarray(_ctrl_recv(self.comm, target, _TAG_REPLY))
+
+    def compare_swap(self, target: int, compare, value,
+                     offset: int = 0) -> np.ndarray:
+        """≈ MPI_Compare_and_swap (single element)."""
+        if target == self.comm.rank:
+            self._track(target)
+            return self._apply_cswap(self.comm.rank, offset, compare, value)
+        with self._origin_lock:
+            self._track(target)
+            _ctrl_send(self.comm, target,
+                       ("cswap", self.comm.rank, offset,
+                        np.asarray(compare), np.asarray(value)), _TAG_REQ).wait()
+            return np.asarray(_ctrl_recv(self.comm, target, _TAG_REPLY))
+
+    # -- synchronization ---------------------------------------------------
+
+    def fence(self) -> None:
+        """Active-target epoch boundary (≈ MPI_Win_fence)."""
+        for r in self._epoch_reqs:
+            r.wait()
+        self._epoch_reqs.clear()
+        # every rank learns how many ops target it: column sums of the
+        # sent-counts matrix
+        sent = np.array(self._sent_to, dtype=np.int64)
+        incoming = self.comm.allreduce(sent, op=op_mod.SUM)
+        expected = int(incoming[self.comm.rank])
+        with self._cv:
+            self._cv.wait_for(lambda: self._applied_total >= expected)
+        self.comm.barrier()
+
+    def lock(self, target: int, exclusive: bool = True) -> None:
+        """≈ MPI_Win_lock (passive target). A local target still goes
+        through the service, keeping lock fairness uniform."""
+        with self._origin_lock:
+            _ctrl_send(self.comm, target,
+                       ("lock", self.comm.rank, bool(exclusive)),
+                       _TAG_REQ).wait()
+            _ctrl_recv(self.comm, target, _TAG_REPLY)  # grant
+
+    def unlock(self, target: int) -> None:
+        """≈ MPI_Win_unlock: flush my ops at target, release the lock."""
+        with self._origin_lock:
+            _ctrl_send(self.comm, target,
+                       ("unlock", self.comm.rank, self._sent_to[target]),
+                       _TAG_REQ).wait()
+            _ctrl_recv(self.comm, target, _TAG_REPLY)  # flushed + released
+
+    def flush(self, target: int) -> None:
+        """≈ MPI_Win_flush: wait until target applied all my ops."""
+        if target == self.comm.rank:
+            return
+        with self._origin_lock:
+            _ctrl_send(self.comm, target,
+                       ("flush", self.comm.rank, self._sent_to[target]),
+                       _TAG_REQ).wait()
+            _ctrl_recv(self.comm, target, _TAG_REPLY)
+
+    def free(self) -> None:
+        """Collective destructor (≈ MPI_Win_free)."""
+        self.comm.barrier()
+        _ctrl_send(self.comm, self.comm.rank, ("stop",), _TAG_REQ).wait()
+        self._service.join(timeout=5)
+
+    # -- target side (service thread) --------------------------------------
+
+    def _serve(self) -> None:
+        while True:
+            try:
+                msg = _ctrl_recv(self.comm, ANY_SOURCE, _TAG_REQ)
+            except Exception:
+                return
+            kind = msg[0]
+            if kind == "stop":
+                return
+            try:
+                self._dispatch(kind, msg)
+            except Exception as e:  # pragma: no cover - defensive
+                import sys
+
+                print(f"osc[{self.name}] service error: {e!r}",
+                      file=sys.stderr)
+
+    def _dispatch(self, kind: str, msg: tuple) -> None:
+        if kind == "put":
+            _, origin, offset, data = msg
+            self._apply_put(origin, offset, data)
+        elif kind == "acc":
+            _, origin, offset, data, opname = msg
+            self._apply_acc(origin, offset, data, opname)
+        elif kind == "get":
+            _, origin, offset, count = msg
+            with self._buf_lock:
+                out = self.buf[offset:offset + count].copy()
+            _ctrl_send(self.comm, origin, out, _TAG_REPLY)
+        elif kind == "fetch":
+            _, origin, offset, value, opname = msg
+            old = self._apply_fetch(origin, offset, value, opname)
+            _ctrl_send(self.comm, origin, old, _TAG_REPLY)
+        elif kind == "cswap":
+            _, origin, offset, compare, value = msg
+            old = self._apply_cswap(origin, offset, compare, value)
+            _ctrl_send(self.comm, origin, old, _TAG_REPLY)
+        elif kind == "lock":
+            _, origin, exclusive = msg
+            self._handle_lock(origin, exclusive)
+        elif kind == "unlock":
+            _, origin, expected = msg
+            self._wait_applied(origin, expected)
+            self._handle_unlock(origin)
+            _ctrl_send(self.comm, origin, ("ok",), _TAG_REPLY)
+        elif kind == "flush":
+            _, origin, expected = msg
+            self._wait_applied(origin, expected)
+            _ctrl_send(self.comm, origin, ("ok",), _TAG_REPLY)
+        else:
+            raise MPIException(f"osc: unknown request {kind!r}")
+
+    # -- local application (atomic under _buf_lock) ------------------------
+
+    def _bump(self, origin: int) -> None:
+        self._applied_from[origin] = self._applied_from.get(origin, 0) + 1
+        self._applied_total += 1
+        self._cv.notify_all()
+
+    def _apply_put(self, origin: int, offset: int, data: np.ndarray) -> None:
+        with self._cv:
+            self.buf[offset:offset + len(data)] = data.astype(
+                self.buf.dtype, copy=False)
+            self._bump(origin)
+
+    def _apply_acc(self, origin: int, offset: int, data: np.ndarray,
+                   opname: str) -> None:
+        op = getattr(op_mod, opname.upper())
+        with self._cv:
+            seg = self.buf[offset:offset + len(data)]
+            self.buf[offset:offset + len(data)] = op.host(
+                seg, data.astype(seg.dtype, copy=False))
+            self._bump(origin)
+
+    def _apply_fetch(self, origin: int, offset: int, value: np.ndarray,
+                     opname: str) -> np.ndarray:
+        op = getattr(op_mod, opname.upper())
+        with self._cv:
+            n = max(1, np.asarray(value).size)
+            old = self.buf[offset:offset + n].copy()
+            self.buf[offset:offset + n] = op.host(
+                old, np.asarray(value).astype(old.dtype, copy=False))
+            self._bump(origin)
+            return old
+
+    def _apply_cswap(self, origin: int, offset: int, compare,
+                     value) -> np.ndarray:
+        with self._cv:
+            old = self.buf[offset:offset + 1].copy()
+            if old[0] == np.asarray(compare).reshape(-1)[0]:
+                self.buf[offset] = np.asarray(value).reshape(-1)[0]
+            self._bump(origin)
+            return old
+
+    def _wait_applied(self, origin: int, expected: int) -> None:
+        with self._cv:
+            self._cv.wait_for(
+                lambda: self._applied_from.get(origin, 0) >= expected)
+
+    # -- lock queueing -----------------------------------------------------
+
+    def _handle_lock(self, origin: int, exclusive: bool) -> None:
+        with self._cv:
+            st = self._lock_state
+            grantable = (st.holder is None and
+                         (exclusive is False or not st.shared))
+            if grantable:
+                if exclusive:
+                    st.holder = origin
+                else:
+                    st.shared.add(origin)
+            else:
+                st.queue.append((origin, exclusive))
+                return
+        _ctrl_send(self.comm, origin, ("granted",), _TAG_REPLY)
+
+    def _handle_unlock(self, origin: int) -> None:
+        grants = []
+        with self._cv:
+            st = self._lock_state
+            if st.holder == origin:
+                st.holder = None
+            st.shared.discard(origin)
+            while st.queue and st.holder is None:
+                nxt, excl = st.queue[0]
+                if excl:
+                    if st.shared:
+                        break
+                    st.queue.pop(0)
+                    st.holder = nxt
+                    grants.append(nxt)
+                    break
+                st.queue.pop(0)
+                st.shared.add(nxt)
+                grants.append(nxt)
+        for g in grants:
+            _ctrl_send(self.comm, g, ("granted",), _TAG_REPLY)

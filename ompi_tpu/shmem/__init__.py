@@ -1,0 +1,16 @@
+"""OSHMEM — the OpenSHMEM 1.3 programming model (≈ the reference's oshmem/).
+
+PGAS over the framework: a symmetric heap of identically-shaped arrays on
+every PE, one-sided put/get/atomics, and the SHMEM collective set.  The host
+path layers on MPI exactly as the reference does (oshmem requires MPI init;
+scoll/mpi delegates collectives — SURVEY.md §2.5); windows provide the spml
+transport.  On device, the symmetric heap is the natural object: an
+identically-sharded jax array IS a symmetric allocation, and put/get become
+``ppermute``/collectives (SURVEY.md §3.5 TPU mapping).
+"""
+
+from ompi_tpu.shmem.api import (
+    init, finalize, my_pe, n_pes, barrier_all, array, free,
+    put, get, broadcast, collect, to_all, atomic_add, atomic_fetch_add,
+    atomic_cswap, fence, quiet,
+)

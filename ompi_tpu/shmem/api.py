@@ -1,0 +1,213 @@
+"""OpenSHMEM host API (≈ oshmem/shmem/c/: shmem_init, shmem_put,
+shmem_long_max_to_all, ...).
+
+The symmetric heap (≈ oshmem/mca/memheap) is a registry of collectively
+allocated SymmetricArrays; allocation order is the "symmetric address":
+every PE's Nth allocation refers to the same logical object, so a PE can
+name remote memory by (array, offset) exactly as SHMEM names it by
+symmetric address.  The transport (≈ oshmem/mca/spml) is an RMA window per
+allocation; collectives (≈ oshmem/mca/scoll/mpi) delegate to the MPI coll
+framework.  Atomics (≈ oshmem/mca/atomic) ride the window's fetch/cswap
+service.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ompi_tpu.mpi import op as op_mod
+from ompi_tpu.mpi.constants import MPIException
+from ompi_tpu.mpi.osc import Window
+
+__all__ = [
+    "init", "finalize", "my_pe", "n_pes", "barrier_all", "array", "free",
+    "put", "get", "broadcast", "collect", "to_all", "atomic_add",
+    "atomic_fetch_add", "atomic_cswap", "fence", "quiet", "SymmetricArray",
+]
+
+_state: dict = {"comm": None, "heap": []}
+_lock = threading.Lock()
+
+
+def init():
+    """shmem_init: brings up MPI underneath (the reference requires the
+    same — oshmem layers on ompi)."""
+    import ompi_tpu
+
+    with _lock:
+        if _state["comm"] is None:
+            world = ompi_tpu.init()
+            _state["comm"] = world.dup(name="SHMEM")
+    return _state["comm"]
+
+
+def _comm():
+    if _state["comm"] is None:
+        raise MPIException("shmem not initialized (call shmem.init())")
+    return _state["comm"]
+
+
+def finalize() -> None:
+    with _lock:
+        comm = _state["comm"]
+        if comm is None:
+            return
+        for arr in list(_state["heap"]):
+            if arr is not None:
+                arr._win.free()
+        _state["heap"].clear()
+        _state["comm"] = None
+    import ompi_tpu
+
+    ompi_tpu.finalize()
+
+
+def my_pe() -> int:
+    return _comm().rank
+
+
+def n_pes() -> int:
+    return _comm().size
+
+
+def barrier_all() -> None:
+    _comm().barrier()
+
+
+class SymmetricArray:
+    """A symmetric-heap allocation: same shape/dtype on every PE.
+
+    ``arr[:]`` is the local data (numpy view); remote access goes through
+    put/get/atomics with a target PE.
+    """
+
+    def __init__(self, shape, dtype, heap_idx: int) -> None:
+        self.local = np.zeros(shape, dtype=dtype)
+        self.heap_idx = heap_idx
+        self._win = Window(_comm(), buffer=self.local.reshape(-1),
+                           name=f"sym{heap_idx}")
+
+    @property
+    def shape(self):
+        return self.local.shape
+
+    @property
+    def dtype(self):
+        return self.local.dtype
+
+    def __getitem__(self, idx):
+        return self.local[idx]
+
+    def __setitem__(self, idx, value):
+        self.local[idx] = value
+
+    # -- one-sided ops (≈ shmem_put/get/atomics) --------------------------
+
+    def put(self, target_pe: int, data, offset: int = 0) -> None:
+        self._win.put(target_pe, np.asarray(data).reshape(-1), offset)
+
+    def iput(self, target_pe: int, data, target_stride: int,
+             offset: int = 0) -> None:
+        """Strided put (≈ shmem_iput): element i lands at
+        ``offset + i*target_stride``.  Implemented as one window put per
+        element (each counted toward fence/flush totals); batching into a
+        single strided message is a host-path optimization for later."""
+        data = np.asarray(data).reshape(-1)
+        for i, v in enumerate(data):
+            self._win.put(target_pe, np.asarray([v]),
+                          offset + i * target_stride)
+
+    def get(self, target_pe: int, count: Optional[int] = None,
+            offset: int = 0) -> np.ndarray:
+        count = count if count is not None else self.local.size - offset
+        return self._win.get(target_pe, count, offset)
+
+    def quiet(self) -> None:
+        """≈ shmem_quiet: my outstanding puts to all PEs are complete."""
+        for pe in range(n_pes()):
+            if pe != my_pe():
+                self._win.flush(pe)
+
+    def barrier(self) -> None:
+        """Window-level fence (completes all pending ops everywhere)."""
+        self._win.fence()
+
+
+def array(shape, dtype=np.float64) -> SymmetricArray:
+    """shmem_malloc: collective allocation on every PE."""
+    with _lock:
+        idx = len(_state["heap"])
+        arr = SymmetricArray(shape, dtype, idx)
+        _state["heap"].append(arr)
+    return arr
+
+
+def free(arr: SymmetricArray) -> None:
+    """shmem_free (collective)."""
+    arr._win.free()
+    with _lock:
+        _state["heap"][arr.heap_idx] = None
+
+
+# -- flat-API conveniences (the C-style spelling) ---------------------------
+
+def put(arr: SymmetricArray, target_pe: int, data, offset: int = 0) -> None:
+    arr.put(target_pe, data, offset)
+
+
+def get(arr: SymmetricArray, target_pe: int, count=None, offset: int = 0):
+    return arr.get(target_pe, count, offset)
+
+
+def fence() -> None:
+    """shmem_fence: ordering of puts per target — our transport is FIFO per
+    pair, so fence is a no-op (documented ordering guarantee)."""
+
+
+def quiet() -> None:
+    """shmem_quiet across the whole heap."""
+    for arr in _state["heap"]:
+        if arr is not None:
+            arr.quiet()
+
+
+# -- collectives (≈ scoll; delegate to MPI coll like scoll/mpi) -------------
+
+def broadcast(arr: SymmetricArray, root: int = 0) -> None:
+    """shmem_broadcast: root's local data replaces everyone's."""
+    out = _comm().bcast(arr.local.copy(), root=root)
+    arr.local[...] = out.reshape(arr.shape)
+
+
+def collect(arr: SymmetricArray) -> np.ndarray:
+    """shmem_collect / fcollect: concatenation of every PE's data."""
+    return _comm().allgather(arr.local).reshape(
+        (n_pes() * arr.local.shape[0],) + arr.local.shape[1:])
+
+
+def to_all(arr: SymmetricArray, op=op_mod.MAX) -> None:
+    """shmem_*_to_all reductions (max/min/sum/prod/and/or): elementwise
+    reduce across PEs, result replacing every PE's local data."""
+    out = _comm().allreduce(arr.local, op=op)
+    arr.local[...] = out.reshape(arr.shape)
+
+
+# -- atomics (≈ oshmem/mca/atomic) ------------------------------------------
+
+def atomic_add(arr: SymmetricArray, target_pe: int, value,
+               offset: int = 0) -> None:
+    arr._win.accumulate(target_pe, np.asarray([value]), op_mod.SUM, offset)
+
+
+def atomic_fetch_add(arr: SymmetricArray, target_pe: int, value,
+                     offset: int = 0):
+    return arr._win.fetch_op(target_pe, np.asarray([value]), op_mod.SUM,
+                             offset)[0]
+
+
+def atomic_cswap(arr: SymmetricArray, target_pe: int, compare, value,
+                 offset: int = 0):
+    return arr._win.compare_swap(target_pe, compare, value, offset)[0]

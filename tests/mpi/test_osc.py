@@ -1,0 +1,120 @@
+"""RMA window tests over the in-process harness (≈ osc/pt2pt behaviors:
+fence counting, passive-target lock/unlock, atomics)."""
+
+import numpy as np
+import pytest
+
+from ompi_tpu.mpi import op as op_mod
+from ompi_tpu.mpi.osc import Window
+from tests.mpi.harness import run_ranks
+
+
+def test_put_fence_get():
+    def fn(comm):
+        win = Window(comm, size=8, dtype=np.float64)
+        # everyone puts its rank into slot `rank` of the right neighbor
+        right = (comm.rank + 1) % comm.size
+        win.put(right, np.array([comm.rank + 1.0]), offset=comm.rank)
+        win.fence()
+        left = (comm.rank - 1) % comm.size
+        val = win.buf[left]
+        win.free()
+        return float(val)
+
+    res = run_ranks(3, fn)
+    assert res == [3.0, 1.0, 2.0]
+
+
+def test_get_remote():
+    def fn(comm):
+        win = Window(comm, buffer=np.full(4, comm.rank, dtype=np.int64))
+        win.fence()
+        peer = (comm.rank + 1) % comm.size
+        out = win.get(peer, count=4)
+        win.fence()
+        win.free()
+        return out.tolist()
+
+    res = run_ranks(3, fn)
+    assert res[0] == [1, 1, 1, 1] and res[2] == [0, 0, 0, 0]
+
+
+def test_accumulate_concurrent():
+    def fn(comm):
+        win = Window(comm, size=1, dtype=np.int64)
+        win.fence()
+        for _ in range(10):
+            win.accumulate(0, np.array([1]), op_mod.SUM)
+        win.fence()
+        total = int(win.buf[0])
+        win.free()
+        return total
+
+    res = run_ranks(4, fn)
+    assert res[0] == 40
+
+
+def test_fetch_add_is_atomic():
+    def fn(comm):
+        win = Window(comm, size=1, dtype=np.int64)
+        win.fence()
+        olds = [int(win.fetch_op(0, np.array([1]), op_mod.SUM)[0])
+                for _ in range(5)]
+        win.fence()
+        final = int(win.buf[0])
+        win.free()
+        return olds, final
+
+    res = run_ranks(3, fn)
+    all_olds = sorted(sum((r[0] for r in res), []))
+    assert all_olds == list(range(15))  # every ticket unique → atomic
+    assert res[0][1] == 15
+
+
+def test_compare_swap():
+    def fn(comm):
+        win = Window(comm, size=1, dtype=np.int64)
+        win.fence()
+        old = win.compare_swap(0, compare=0, value=comm.rank + 1)
+        win.fence()
+        final = int(win.buf[0])
+        win.free()
+        return int(old[0]), final
+
+    res = run_ranks(3, fn)
+    winners = [r for r in res if r[0] == 0]
+    assert len(winners) == 1  # exactly one CAS succeeded
+    assert res[0][1] in (1, 2, 3)
+
+
+def test_lock_unlock_mutual_exclusion():
+    def fn(comm):
+        win = Window(comm, size=2, dtype=np.int64)
+        win.fence()
+        for _ in range(5):
+            win.lock(0, exclusive=True)
+            # read-modify-write that would race without the lock
+            cur = int(win.get(0, count=1)[0])
+            win.put(0, np.array([cur + 1]), offset=0)
+            win.unlock(0)
+        win.fence()
+        total = int(win.buf[0])
+        win.free()
+        return total
+
+    res = run_ranks(3, fn)
+    assert res[0] == 15
+
+
+def test_local_window_ops():
+    def fn(comm):
+        win = Window(comm, size=4, dtype=np.float32)
+        win.put(comm.rank, np.array([7.0, 8.0]), offset=1)
+        got = win.get(comm.rank, count=2, offset=1)
+        old = win.fetch_op(comm.rank, np.array([1.0]), op_mod.SUM, offset=1)
+        win.fence()
+        win.free()
+        return got.tolist(), float(old[0]), float(win.buf[1])
+
+    got, old, after = run_ranks(2, fn)[0]
+    assert got == [7.0, 8.0] and old == 7.0 and after == 8.0

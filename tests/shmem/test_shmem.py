@@ -1,0 +1,39 @@
+"""OSHMEM integration through tpurun (the reference's oshmem examples double
+as its SHMEM smoke suite — SURVEY.md §4)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def tpurun(np_, script, timeout=90):
+    env = dict(os.environ)
+    env.pop("OMPI_TPU_RANK", None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-np", str(np_),
+         "--", sys.executable, script],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO)
+
+
+@pytest.mark.parametrize("script,np_,needle", [
+    ("examples/oshmem_max_reduction.py", 4, "max reduction ok"),
+    ("examples/oshmem_circular_shift.py", 4, "circular shift ok"),
+    ("examples/oshmem_strided_puts.py", 2, "strided put ok"),
+    ("examples/oshmem_symmetric_data.py", 4, "verified symmetric data"),
+])
+def test_oshmem_examples(script, np_, needle):
+    r = tpurun(np_, script)
+    assert r.returncode == 0, f"{script}:\n{r.stderr}"
+    assert needle in r.stdout
+
+
+def test_atomics_across_pes():
+    prog = os.path.join(REPO, "tests", "shmem", "_atomic_prog.py")
+    r = tpurun(4, prog)
+    assert r.returncode == 0, r.stderr
+    assert "fetch_add tickets unique" in r.stdout
