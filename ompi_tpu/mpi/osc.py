@@ -28,12 +28,14 @@ from typing import Any, Optional
 
 import numpy as np
 
-from ompi_tpu.core import dss
+from ompi_tpu.core import dss, output
 from ompi_tpu.mpi import op as op_mod
 from ompi_tpu.mpi.constants import ANY_SOURCE, MPIException
 from ompi_tpu.mpi.request import Request
 
 __all__ = ["Window"]
+
+_log = output.get_stream("osc")
 
 # Reserved tags on the window's private comm, in a range disjoint from the
 # collective tags (coll/base.py TAG_* 1..10) — the service thread's
@@ -78,7 +80,16 @@ class Window:
             if size is None:
                 raise MPIException("Window needs size= or buffer=")
             buffer = np.zeros(size, dtype=dtype)
-        self.buf = np.ascontiguousarray(buffer)
+        buffer = np.asarray(buffer)
+        if not buffer.flags.c_contiguous:
+            # a copy would silently decouple the window from the caller's
+            # array (remote puts landing somewhere the caller never sees)
+            raise MPIException(
+                "Window buffer must be C-contiguous; pass a contiguous "
+                "array (np.ascontiguousarray) and keep a reference to it")
+        # flat VIEW (never a copy, given contiguity): RMA offsets address
+        # elements in row-major order and range checks agree with indexing
+        self.buf = buffer.reshape(-1)
         self.comm = comm.dup(name=f"{name}.osc")
         self.name = name
         self._buf_lock = threading.RLock()
@@ -87,6 +98,8 @@ class Window:
         self._applied_total = 0
         self._sent_to = [0] * comm.size           # my ops per target
         self._cv = threading.Condition(self._buf_lock)
+        self._errors: list[str] = []          # failed incoming put/acc ops
+        self._service_dead = False
         self._epoch_reqs: list[Request] = []
         self._origin_lock = threading.Lock()      # serializes blocking ops
         self._ids = itertools.count(1)
@@ -98,18 +111,34 @@ class Window:
 
     def _track(self, target: int, req: Optional[Request] = None) -> None:
         """Count an issued op toward fence/flush totals; reap finished
-        requests so passive-target-only programs don't accumulate them."""
+        requests (amortized — a scan per op would be quadratic when the
+        send worker lags the issue rate)."""
         self._sent_to[target] += 1
-        self._epoch_reqs = [r for r in self._epoch_reqs if not r.done()]
         if req is not None:
             self._epoch_reqs.append(req)
+            if len(self._epoch_reqs) > 256:
+                self._epoch_reqs = [
+                    r for r in self._epoch_reqs if not r.done()]
+
+    def _check_range(self, offset: int, count: int) -> None:
+        if offset < 0 or count < 0 or offset + count > self.buf.size:
+            raise MPIException(
+                f"RMA access [{offset}:{offset + count}] outside window "
+                f"of {self.buf.size} elements")
+
+    def _recv_reply(self, source: int) -> Any:
+        status, payload = _ctrl_recv(self.comm, source, _TAG_REPLY)
+        if status == "err":
+            raise MPIException(
+                f"RMA op failed at rank {source}: {payload}")
+        return payload
 
     def put(self, target: int, data: np.ndarray, offset: int = 0) -> None:
         """≈ MPI_Put: completes locally at the next sync (fence/unlock)."""
         data = np.ascontiguousarray(data)
         if target == self.comm.rank:
+            self._apply_put(self.comm.rank, offset, data)  # raises pre-track
             self._track(target)
-            self._apply_put(self.comm.rank, offset, data)
             return
         req = _ctrl_send(self.comm, target,
                          ("put", self.comm.rank, offset, data), _TAG_REQ)
@@ -119,11 +148,12 @@ class Window:
         """≈ MPI_Get (blocking convenience: data returns immediately)."""
         if target == self.comm.rank:
             with self._buf_lock:
+                self._check_range(offset, count)
                 return self.buf[offset:offset + count].copy()
         with self._origin_lock:
             _ctrl_send(self.comm, target,
                        ("get", self.comm.rank, offset, count), _TAG_REQ).wait()
-            return np.asarray(_ctrl_recv(self.comm, target, _TAG_REPLY))
+            return np.asarray(self._recv_reply(target))
 
     def accumulate(self, target: int, data: np.ndarray, op=op_mod.SUM,
                    offset: int = 0) -> None:
@@ -131,8 +161,8 @@ class Window:
         _check_predefined(op)
         data = np.ascontiguousarray(data)
         if target == self.comm.rank:
-            self._track(target)
             self._apply_acc(self.comm.rank, offset, data, op.name)
+            self._track(target)
             return
         req = _ctrl_send(self.comm, target,
                          ("acc", self.comm.rank, offset, data, op.name),
@@ -145,27 +175,29 @@ class Window:
         _check_predefined(op)
         value = np.ascontiguousarray(value)
         if target == self.comm.rank:
+            old = self._apply_fetch(self.comm.rank, offset, value, op.name)
             self._track(target)
-            return self._apply_fetch(self.comm.rank, offset, value, op.name)
+            return old
         with self._origin_lock:
             self._track(target)
             _ctrl_send(self.comm, target,
                        ("fetch", self.comm.rank, offset, value, op.name),
                        _TAG_REQ).wait()
-            return np.asarray(_ctrl_recv(self.comm, target, _TAG_REPLY))
+            return np.asarray(self._recv_reply(target))
 
     def compare_swap(self, target: int, compare, value,
                      offset: int = 0) -> np.ndarray:
         """≈ MPI_Compare_and_swap (single element)."""
         if target == self.comm.rank:
+            old = self._apply_cswap(self.comm.rank, offset, compare, value)
             self._track(target)
-            return self._apply_cswap(self.comm.rank, offset, compare, value)
+            return old
         with self._origin_lock:
             self._track(target)
             _ctrl_send(self.comm, target,
                        ("cswap", self.comm.rank, offset,
                         np.asarray(compare), np.asarray(value)), _TAG_REQ).wait()
-            return np.asarray(_ctrl_recv(self.comm, target, _TAG_REPLY))
+            return np.asarray(self._recv_reply(target))
 
     # -- synchronization ---------------------------------------------------
 
@@ -180,8 +212,18 @@ class Window:
         incoming = self.comm.allreduce(sent, op=op_mod.SUM)
         expected = int(incoming[self.comm.rank])
         with self._cv:
-            self._cv.wait_for(lambda: self._applied_total >= expected)
+            self._cv.wait_for(lambda: self._applied_total >= expected
+                              or self._service_dead)
+            if self._service_dead and self._applied_total < expected:
+                raise MPIException(
+                    f"window {self.name!r}: service stopped with "
+                    f"{expected - self._applied_total} incoming ops pending")
+            errors, self._errors = self._errors, []
         self.comm.barrier()
+        if errors:
+            raise MPIException(
+                "RMA ops failed at this target during the epoch: "
+                + "; ".join(errors))
 
     def lock(self, target: int, exclusive: bool = True) -> None:
         """≈ MPI_Win_lock (passive target). A local target still goes
@@ -190,7 +232,7 @@ class Window:
             _ctrl_send(self.comm, target,
                        ("lock", self.comm.rank, bool(exclusive)),
                        _TAG_REQ).wait()
-            _ctrl_recv(self.comm, target, _TAG_REPLY)  # grant
+            self._recv_reply(target)  # grant
 
     def unlock(self, target: int) -> None:
         """≈ MPI_Win_unlock: flush my ops at target, release the lock."""
@@ -198,17 +240,17 @@ class Window:
             _ctrl_send(self.comm, target,
                        ("unlock", self.comm.rank, self._sent_to[target]),
                        _TAG_REQ).wait()
-            _ctrl_recv(self.comm, target, _TAG_REPLY)  # flushed + released
+            self._recv_reply(target)  # flushed + released
 
     def flush(self, target: int) -> None:
         """≈ MPI_Win_flush: wait until target applied all my ops."""
-        if target == self.comm.rank:
+        if target == self.comm.rank or self._sent_to[target] == 0:
             return
         with self._origin_lock:
             _ctrl_send(self.comm, target,
                        ("flush", self.comm.rank, self._sent_to[target]),
                        _TAG_REQ).wait()
-            _ctrl_recv(self.comm, target, _TAG_REPLY)
+            self._recv_reply(target)
 
     def free(self) -> None:
         """Collective destructor (≈ MPI_Win_free)."""
@@ -222,18 +264,40 @@ class Window:
         while True:
             try:
                 msg = _ctrl_recv(self.comm, ANY_SOURCE, _TAG_REQ)
-            except Exception:
+            except Exception as e:
+                # a failed receive (peer death, transport teardown before
+                # free()) must not leave waiters hanging silently: flag the
+                # service as gone and wake them so fence() can raise
+                with self._cv:
+                    self._service_dead = True
+                    self._cv.notify_all()
+                _log.verbose(1, "window %r service stopped: %r",
+                             self.name, e)
                 return
             kind = msg[0]
             if kind == "stop":
                 return
             try:
                 self._dispatch(kind, msg)
-            except Exception as e:  # pragma: no cover - defensive
-                import sys
+            except Exception as e:
+                self._dispatch_failed(kind, msg, e)
 
-                print(f"osc[{self.name}] service error: {e!r}",
-                      file=sys.stderr)
+    def _dispatch_failed(self, kind: str, msg: tuple, e: Exception) -> None:
+        """A bad op must not wedge the job: counted ops still bump the
+        applied counter (so fences/flushes terminate) and reply-carrying
+        ops turn the failure into the origin's exception."""
+        origin = msg[1] if len(msg) > 1 else -1
+        if kind in ("put", "acc", "fetch", "cswap"):
+            with self._cv:
+                if kind in ("put", "acc"):
+                    # no reply channel: surface at this rank's next fence
+                    self._errors.append(f"{kind} from rank {origin}: {e}")
+                self._bump(origin)
+        if kind in ("get", "fetch", "cswap", "lock", "unlock", "flush"):
+            try:
+                _ctrl_send(self.comm, origin, ("err", str(e)), _TAG_REPLY)
+            except Exception:
+                pass
 
     def _dispatch(self, kind: str, msg: tuple) -> None:
         if kind == "put":
@@ -245,16 +309,17 @@ class Window:
         elif kind == "get":
             _, origin, offset, count = msg
             with self._buf_lock:
+                self._check_range(offset, count)
                 out = self.buf[offset:offset + count].copy()
-            _ctrl_send(self.comm, origin, out, _TAG_REPLY)
+            _ctrl_send(self.comm, origin, ("ok", out), _TAG_REPLY)
         elif kind == "fetch":
             _, origin, offset, value, opname = msg
             old = self._apply_fetch(origin, offset, value, opname)
-            _ctrl_send(self.comm, origin, old, _TAG_REPLY)
+            _ctrl_send(self.comm, origin, ("ok", old), _TAG_REPLY)
         elif kind == "cswap":
             _, origin, offset, compare, value = msg
             old = self._apply_cswap(origin, offset, compare, value)
-            _ctrl_send(self.comm, origin, old, _TAG_REPLY)
+            _ctrl_send(self.comm, origin, ("ok", old), _TAG_REPLY)
         elif kind == "lock":
             _, origin, exclusive = msg
             self._handle_lock(origin, exclusive)
@@ -262,11 +327,11 @@ class Window:
             _, origin, expected = msg
             self._wait_applied(origin, expected)
             self._handle_unlock(origin)
-            _ctrl_send(self.comm, origin, ("ok",), _TAG_REPLY)
+            _ctrl_send(self.comm, origin, ("ok", None), _TAG_REPLY)
         elif kind == "flush":
             _, origin, expected = msg
             self._wait_applied(origin, expected)
-            _ctrl_send(self.comm, origin, ("ok",), _TAG_REPLY)
+            _ctrl_send(self.comm, origin, ("ok", None), _TAG_REPLY)
         else:
             raise MPIException(f"osc: unknown request {kind!r}")
 
@@ -279,6 +344,7 @@ class Window:
 
     def _apply_put(self, origin: int, offset: int, data: np.ndarray) -> None:
         with self._cv:
+            self._check_range(offset, len(data))
             self.buf[offset:offset + len(data)] = data.astype(
                 self.buf.dtype, copy=False)
             self._bump(origin)
@@ -287,6 +353,7 @@ class Window:
                    opname: str) -> None:
         op = getattr(op_mod, opname.upper())
         with self._cv:
+            self._check_range(offset, len(data))
             seg = self.buf[offset:offset + len(data)]
             self.buf[offset:offset + len(data)] = op.host(
                 seg, data.astype(seg.dtype, copy=False))
@@ -297,6 +364,7 @@ class Window:
         op = getattr(op_mod, opname.upper())
         with self._cv:
             n = max(1, np.asarray(value).size)
+            self._check_range(offset, n)
             old = self.buf[offset:offset + n].copy()
             self.buf[offset:offset + n] = op.host(
                 old, np.asarray(value).astype(old.dtype, copy=False))
@@ -306,6 +374,7 @@ class Window:
     def _apply_cswap(self, origin: int, offset: int, compare,
                      value) -> np.ndarray:
         with self._cv:
+            self._check_range(offset, 1)
             old = self.buf[offset:offset + 1].copy()
             if old[0] == np.asarray(compare).reshape(-1)[0]:
                 self.buf[offset] = np.asarray(value).reshape(-1)[0]
@@ -322,7 +391,10 @@ class Window:
     def _handle_lock(self, origin: int, exclusive: bool) -> None:
         with self._cv:
             st = self._lock_state
-            grantable = (st.holder is None and
+            # new requests queue behind ANY waiter (even shared behind a
+            # queued exclusive) — otherwise a stream of shared lockers
+            # starves exclusive waiters forever
+            grantable = (st.holder is None and not st.queue and
                          (exclusive is False or not st.shared))
             if grantable:
                 if exclusive:
@@ -332,7 +404,7 @@ class Window:
             else:
                 st.queue.append((origin, exclusive))
                 return
-        _ctrl_send(self.comm, origin, ("granted",), _TAG_REPLY)
+        _ctrl_send(self.comm, origin, ("ok", None), _TAG_REPLY)
 
     def _handle_unlock(self, origin: int) -> None:
         grants = []
@@ -354,4 +426,4 @@ class Window:
                 st.shared.add(nxt)
                 grants.append(nxt)
         for g in grants:
-            _ctrl_send(self.comm, g, ("granted",), _TAG_REPLY)
+            _ctrl_send(self.comm, g, ("ok", None), _TAG_REPLY)

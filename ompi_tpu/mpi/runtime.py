@@ -87,7 +87,7 @@ def get_world() -> Communicator:
     return _state["world"]
 
 
-def finalize() -> None:
+def finalize(_collective: bool = True) -> None:
     """Tear down: final barrier, close transports (≈ ompi_mpi_finalize)."""
     global COMM_WORLD, COMM_SELF
     with _lock:
@@ -95,7 +95,7 @@ def finalize() -> None:
         if world is None:
             return
         try:
-            if world.size > 1:
+            if world.size > 1 and _collective:
                 world.barrier()
         finally:
             if _state["pml"] is not None:
@@ -111,7 +111,16 @@ def finalize() -> None:
 
 
 def _atexit_finalize() -> None:
+    # Exiting without MPI_Finalize is erroneous (MPI-3.1 §8.7); the
+    # reference warns and lets mpirun's reaper handle the fallout. A
+    # collective barrier here would block this process forever (peers may
+    # be dead or in a different epoch), pinning the whole job — close
+    # transports non-collectively so the launcher sees the exit and its
+    # errmgr policy can act.
+    if _state["world"] is None:
+        return
+    _log.verbose(0, "process exiting without finalize(); closing transports")
     try:
-        finalize()
+        finalize(_collective=False)
     except Exception:
         pass

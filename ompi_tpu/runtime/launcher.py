@@ -73,10 +73,19 @@ class LocalLauncher:
     def _st_launch(self, sm: StateMachine, job: Job) -> JobState:
         self.server = pmix.PMIxServer(
             size=job.np, on_abort=lambda r, s, m: self._on_abort(job, r, s, m))
+        # ≈ plm_rsh prefixing PATH/LD_LIBRARY_PATH with its install prefix
+        # (orte/mca/plm/rsh/plm_rsh_module.c): make this framework importable
+        # in children no matter their cwd.
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
         for proc in job.procs:
             app = job.apps[proc.app_idx]
             env = dict(os.environ)
             env.update(app.env)
+            pypath = env.get("PYTHONPATH", "")
+            if pkg_root not in pypath.split(os.pathsep):
+                env["PYTHONPATH"] = (
+                    pkg_root + (os.pathsep + pypath if pypath else ""))
             env[pmix.ENV_URI] = self.server.uri
             env[pmix.ENV_RANK] = str(proc.rank)
             env[pmix.ENV_SIZE] = str(job.np)

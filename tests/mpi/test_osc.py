@@ -118,3 +118,56 @@ def test_local_window_ops():
 
     got, old, after = run_ranks(2, fn)[0]
     assert got == [7.0, 8.0] and old == 7.0 and after == 8.0
+
+
+def test_noncontiguous_buffer_rejected():
+    from ompi_tpu.mpi.constants import MPIException
+
+    def fn(comm):
+        arr = np.zeros(16, dtype=np.int64)
+        with pytest.raises(MPIException, match="contiguous"):
+            Window(comm, buffer=arr[::2])
+        return True
+
+    assert all(run_ranks(1, fn))
+
+
+def test_get_out_of_range_raises():
+    from ompi_tpu.mpi.constants import MPIException
+
+    def fn(comm):
+        win = Window(comm, size=4, dtype=np.int64)
+        win.fence()
+        peer = (comm.rank + 1) % comm.size
+        try:
+            with pytest.raises(MPIException, match="outside window"):
+                win.get(peer, count=4, offset=2)      # remote over-read
+            with pytest.raises(MPIException, match="outside window"):
+                win.get(comm.rank, count=9, offset=0)  # local over-read
+        finally:
+            win.fence()
+            win.free()
+        return True
+
+    assert all(run_ranks(2, fn))
+
+
+def test_bad_put_surfaces_at_fence_without_hanging():
+    from ompi_tpu.mpi.constants import MPIException
+
+    def fn(comm):
+        win = Window(comm, size=4, dtype=np.int64)
+        win.fence()
+        failed = False
+        if comm.rank == 1:
+            win.put(0, np.arange(4), offset=3)  # overruns target window
+        try:
+            win.fence()  # must terminate; rank 0 sees the error
+        except MPIException as e:
+            failed = "outside window" in str(e)
+        win.free()
+        return comm.rank, failed
+
+    res = dict(run_ranks(2, fn))
+    assert res[0] is True      # target rank observed the failure
+    assert res[1] is False     # origin's fence completed cleanly
