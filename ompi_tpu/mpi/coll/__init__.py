@@ -34,8 +34,8 @@ coll_framework = Framework("coll", "collective operations")
 # the function table slots (≈ mca_coll_base_comm_coll_t)
 COLL_FUNCTIONS = (
     "barrier", "bcast", "reduce", "allreduce", "gather", "allgather",
-    "scatter", "alltoall", "reduce_scatter", "scan", "gatherv", "scatterv",
-    "allgatherv", "alltoallv",
+    "scatter", "alltoall", "reduce_scatter", "reduce_scatter_block", "scan",
+    "exscan", "gatherv", "scatterv", "allgatherv", "alltoallv",
 )
 
 

@@ -43,6 +43,11 @@ TAG_SCATTER = 7
 TAG_ALLTOALL = 8
 TAG_REDUCE_SCATTER = 9
 TAG_SCAN = 10
+TAG_GATHERV = 11
+TAG_SCATTERV = 12
+TAG_ALLGATHERV = 13
+TAG_ALLTOALLV = 14
+TAG_EXSCAN = 15
 
 
 def _fold(op: Op, lo: np.ndarray, hi: np.ndarray) -> np.ndarray:
@@ -399,7 +404,7 @@ def reduce_scatter_basic(comm, sendbuf, op: Op) -> np.ndarray:
 
 
 # ---------------------------------------------------------------------------
-# scan — linear chain
+# scan / exscan — linear chain
 
 def scan_linear(comm, sendbuf, op: Op) -> np.ndarray:
     """Inclusive prefix reduction: result_r = op(x_0, ..., x_r)."""
@@ -412,3 +417,224 @@ def scan_linear(comm, sendbuf, op: Op) -> np.ndarray:
     if rank < size - 1:
         comm._coll_isend(acc, rank + 1, TAG_SCAN).wait()
     return acc
+
+
+def exscan_linear(comm, sendbuf, op: Op) -> Optional[np.ndarray]:
+    """Exclusive prefix reduction: result_r = op(x_0, ..., x_{r-1}); rank 0's
+    result is undefined per MPI (returned as None)."""
+    rank, size = comm.rank, comm.size
+    mine = np.asarray(sendbuf)
+    prev: Optional[np.ndarray] = None
+    if rank > 0:
+        prev = comm._coll_irecv(None, rank - 1, TAG_EXSCAN).wait()
+        prev = prev.reshape(mine.shape).astype(mine.dtype, copy=False)
+    if rank < size - 1:
+        fwd = mine if prev is None else _fold(op, prev, mine)
+        comm._coll_isend(fwd, rank + 1, TAG_EXSCAN).wait()
+    return prev
+
+
+# ---------------------------------------------------------------------------
+# variable-count (v-) collectives: per-rank blocks of differing axis-0 length
+# (same trailing shape/dtype).  Pythonic contract: lists of arrays in/out
+# preserve the block boundaries that MPI expresses as count/displacement
+# vectors.  Linear exchange, like the basic components in the reference.
+
+def gatherv_linear(comm, sendbuf, root: int) -> Optional[list]:
+    size, rank = comm.size, comm.rank
+    mine = np.asarray(sendbuf)
+    if rank == root:
+        parts: list[Optional[np.ndarray]] = [None] * size
+        parts[rank] = mine
+        reqs = {r: comm._coll_irecv(None, r, TAG_GATHERV)
+                for r in range(size) if r != root}
+        for r, req in reqs.items():
+            parts[r] = req.wait()
+        return parts  # type: ignore[return-value]
+    comm._coll_isend(mine, root, TAG_GATHERV).wait()
+    return None
+
+
+def scatterv_linear(comm, sendparts, root: int) -> np.ndarray:
+    size, rank = comm.size, comm.rank
+    if rank == root:
+        if len(sendparts) != size:
+            from ompi_tpu.mpi.constants import MPIException
+
+            raise MPIException(
+                f"scatterv: {len(sendparts)} blocks for {size} ranks")
+        wait_all([comm._coll_isend(np.asarray(sendparts[r]), r, TAG_SCATTERV)
+                  for r in range(size) if r != root])
+        return np.asarray(sendparts[rank])
+    return comm._coll_irecv(None, root, TAG_SCATTERV).wait()
+
+
+def allgatherv_ring(comm, sendbuf) -> list:
+    """Each rank's block circulates p-1 hops (coll_base_allgatherv ring)."""
+    size, rank = comm.size, comm.rank
+    mine = np.asarray(sendbuf)
+    out: list[Optional[np.ndarray]] = [None] * size
+    out[rank] = mine
+    if size == 1:
+        return out  # type: ignore[return-value]
+    right = (rank + 1) % size
+    left = (rank - 1) % size
+    send_idx = rank
+    for _ in range(size - 1):
+        sreq = comm._coll_isend(out[send_idx], right, TAG_ALLGATHERV)
+        recv_idx = (send_idx - 1) % size
+        recv = comm._coll_irecv(None, left, TAG_ALLGATHERV).wait()
+        sreq.wait()
+        out[recv_idx] = recv
+        send_idx = recv_idx
+    return out  # type: ignore[return-value]
+
+
+def alltoallv_pairwise(comm, sendparts) -> list:
+    """sendparts[i] goes to rank i; returns out[i] = block from rank i."""
+    size, rank = comm.size, comm.rank
+    if len(sendparts) != size:
+        from ompi_tpu.mpi.constants import MPIException
+
+        raise MPIException(
+            f"alltoallv: {len(sendparts)} blocks for {size} ranks")
+    out: list[Optional[np.ndarray]] = [None] * size
+    out[rank] = np.asarray(sendparts[rank])
+    for step in range(1, size):
+        to = (rank + step) % size
+        frm = (rank - step) % size
+        sreq = comm._coll_isend(np.asarray(sendparts[to]), to, TAG_ALLTOALLV)
+        out[frm] = comm._coll_irecv(None, frm, TAG_ALLTOALLV).wait()
+        sreq.wait()
+    return out  # type: ignore[return-value]
+
+
+# ---------------------------------------------------------------------------
+# extra algorithms from the reference inventory
+
+def alltoall_bruck(comm, sendbuf) -> np.ndarray:
+    """coll_base_alltoall.c:191 — lg(p) rounds moving half the blocks each;
+    latency-optimal for small messages."""
+    size, rank = comm.size, comm.rank
+    arr = np.asarray(sendbuf)
+    if arr.shape[0] % size:
+        from ompi_tpu.mpi.constants import MPIException
+
+        raise MPIException(
+            f"alltoall: axis 0 ({arr.shape[0]}) not divisible by {size}")
+    if size == 1:
+        return arr
+    parts = np.split(arr, size, axis=0)
+    # phase 1: local rotation so blocks[i] targets (rank+i)%size
+    blocks = [parts[(rank + i) % size] for i in range(size)]
+    # phase 2: lg(p) exchange rounds — round k moves blocks whose index has
+    # bit k set, to rank+2^k (they travel toward their target in binary)
+    pof = 1
+    while pof < size:
+        idxs = [i for i in range(size) if i & pof]
+        to = (rank + pof) % size
+        frm = (rank - pof) % size
+        payload = np.concatenate([blocks[i] for i in idxs], axis=0)
+        sreq = comm._coll_isend(payload, to, TAG_ALLTOALL)
+        recv = comm._coll_irecv(None, frm, TAG_ALLTOALL).wait()
+        sreq.wait()
+        recv = recv.reshape((len(idxs),) + blocks[0].shape).astype(
+            arr.dtype, copy=False)
+        for j, i in enumerate(idxs):
+            blocks[i] = recv[j]
+        pof <<= 1
+    # phase 3: inverse rotation — block i holds data *from* (rank-i)%size
+    out: list[Optional[np.ndarray]] = [None] * size
+    for i in range(size):
+        out[(rank - i) % size] = blocks[i]
+    return np.concatenate(out, axis=0)  # type: ignore[arg-type]
+
+
+def allreduce_segmented_ring(comm, sendbuf, op: Op,
+                             segsize: int = 1 << 20) -> np.ndarray:
+    """coll_base_allreduce.c:615 — the ring with each step's payload split
+    into ~segsize-byte segments sent as independent messages, so folding an
+    arrived segment overlaps the transfer of the next (the same
+    double-buffered overlap pattern as ring attention).  Latency is the same
+    2(p-1) steps as the plain ring.  Commutative only."""
+    size, rank = comm.size, comm.rank
+    arr = np.asarray(sendbuf)
+    if size == 1:
+        return arr
+    flat = arr.reshape(-1)
+    seg_elems = max(1, segsize // max(1, arr.dtype.itemsize))
+    nseg = -(-flat.size // (seg_elems * size)) if flat.size else 1
+    if nseg <= 1:
+        return allreduce_ring(comm, sendbuf, op)
+    # segs[s] = per-rank chunk list for segment s; per-pair ordering makes
+    # the s-th posted irecv match the s-th segment sent each step
+    bounds = [min(s * seg_elems * size, flat.size) for s in range(nseg + 1)]
+    segs = [[c.copy() for c in np.array_split(flat[bounds[s]:bounds[s + 1]],
+                                              size)]
+            for s in range(nseg)]
+    right = (rank + 1) % size
+    left = (rank - 1) % size
+
+    def ring_phase(tag, fold):
+        nonlocal segs
+        send_idx = rank if fold else (rank + 1) % size
+        for _ in range(size - 1):
+            recv_idx = (send_idx - 1) % size
+            sreqs = [comm._coll_isend(segs[s][send_idx], right, tag)
+                     for s in range(nseg)]
+            rreqs = [comm._coll_irecv(None, left, tag) for _ in range(nseg)]
+            for s in range(nseg):  # fold segment s while s+1 is in flight
+                recv = rreqs[s].wait().reshape(-1)
+                cur = segs[s][recv_idx]
+                recv = recv.astype(cur.dtype, copy=False)
+                segs[s][recv_idx] = (np.asarray(op.host(cur, recv)) if fold
+                                     else recv)
+            wait_all(sreqs)
+            send_idx = recv_idx
+
+    ring_phase(TAG_ALLREDUCE, fold=True)    # reduce-scatter phase
+    ring_phase(TAG_ALLGATHER, fold=False)   # allgather phase
+    out = np.concatenate([c for s in range(nseg) for c in segs[s]])
+    return out.reshape(arr.shape)
+
+
+def bcast_pipeline(comm, buf: Optional[np.ndarray], root: int,
+                   segsize: int = 128 * 1024) -> np.ndarray:
+    """coll_base_bcast.c:257 — chain pipeline: ranks form a chain rooted at
+    root; the message moves in segments so all links stream concurrently."""
+    size, rank = comm.size, comm.rank
+    if size == 1:
+        return np.asarray(buf)
+    vrank = (rank - root) % size
+    prev = ((vrank - 1) + root) % size
+    nxt = ((vrank + 1) + root) % size
+    last = vrank == size - 1
+    if vrank == 0:
+        arr = np.asarray(buf)
+        flat = arr.reshape(-1)
+        seg_elems = max(1, segsize // max(1, arr.dtype.itemsize))
+        nseg = max(1, -(-flat.size // seg_elems))
+        # ship a tiny header so receivers know segmentation + final shape
+        hdr = np.array([seg_elems] + list(arr.shape), dtype=np.int64)
+        comm._coll_isend(hdr, nxt, TAG_BCAST).wait()
+        reqs = [comm._coll_isend(flat[i * seg_elems:(i + 1) * seg_elems],
+                                 nxt, TAG_BCAST) for i in range(nseg)]
+        wait_all(reqs)
+        return arr
+    hdr = comm._coll_irecv(None, prev, TAG_BCAST).wait()
+    seg_elems = int(hdr[0])
+    shape = tuple(int(x) for x in hdr[1:])
+    total = int(np.prod(shape)) if shape else 1
+    nseg = max(1, -(-total // seg_elems))
+    if not last:
+        comm._coll_isend(hdr, nxt, TAG_BCAST).wait()
+    segs = []
+    fwd = []
+    for _ in range(nseg):
+        seg = comm._coll_irecv(None, prev, TAG_BCAST).wait()
+        segs.append(seg)
+        if not last:
+            fwd.append(comm._coll_isend(seg, nxt, TAG_BCAST))
+    wait_all(fwd)
+    flat = np.concatenate([s.reshape(-1) for s in segs])
+    return flat.reshape(shape)

@@ -3,8 +3,10 @@
 ≈ ompi/mca/coll/tuned: wraps the base algorithm library with a size×commsize
 decision layer whose crossover points mirror coll_tuned_decision_fixed.c:
 44-87 (allreduce: recursive doubling under the small-message threshold, ring
-for large commutative payloads), overridable per-collective via config vars
-(the reference's coll_tuned_*_algorithm MCA params / dynamic rules file).
+for large commutative payloads, segmented ring with 1MB segments for very
+large ones), overridable per-collective via config vars (the reference's
+coll_tuned_*_algorithm MCA params) or a dynamic rules file
+(coll_tuned_dynamic_file.c → ompi_tpu.mpi.coll.rules).
 """
 
 from __future__ import annotations
@@ -15,7 +17,7 @@ import numpy as np
 
 from ompi_tpu.core.config import VarType, register_var, var_registry
 from ompi_tpu.core.mca import Component
-from ompi_tpu.mpi.coll import base, coll_framework
+from ompi_tpu.mpi.coll import base, coll_framework, rules
 from ompi_tpu.mpi.op import Op
 
 __all__ = ["HostColl"]
@@ -25,18 +27,65 @@ def _nbytes(buf) -> int:
     return np.asarray(buf).nbytes
 
 
+class HostCollBase(Component):
+    """Decision plumbing shared by host-collective components."""
+
+    ALGORITHMS: dict[str, tuple[str, ...]] = {}
+
+    def _decide(self, coll: str, comm, nbytes: int) -> Optional[str]:
+        """forced config var > dynamic rules file > None (fixed decision)."""
+        alg = var_registry.get(f"coll_host_{coll}_algorithm")
+        src = f"config var coll_host_{coll}_algorithm"
+        if not alg:
+            path = var_registry.get("coll_host_dynamic_rules")
+            if not path:
+                return None
+            alg = rules.load_rules(path).lookup(coll, comm.size, nbytes)
+            src = f"rules file {path}"
+            if alg is None:
+                return None
+        valid = self.ALGORITHMS.get(coll, ())
+        if alg not in valid:
+            from ompi_tpu.mpi.constants import MPIException
+
+            raise MPIException(
+                f"unknown {coll} algorithm {alg!r} (from {src}); "
+                f"valid: {', '.join(valid)}")
+        return alg
+
+
 @coll_framework.component
-class HostColl(Component):
+class HostColl(HostCollBase):
     NAME = "host"
     PRIORITY = 40
+
+    # what _decide may name, per collective (also validation + introspection)
+    ALGORITHMS = {
+        "bcast": ("binomial", "linear", "pipeline"),
+        "allreduce": ("recursive_doubling", "ring", "segmented_ring",
+                      "linear"),
+        "allgather": ("bruck", "ring"),
+        "alltoall": ("pairwise", "bruck"),
+        "reduce_scatter": ("ring", "basic"),
+    }
 
     def register_params(self) -> None:
         register_var("coll", "host_allreduce_small", VarType.SIZE, 10 * 1024,
                      "allreduce: below this use recursive doubling "
                      "(tuned's 10KB crossover)")
+        register_var("coll", "host_allreduce_segment", VarType.SIZE,
+                     1 << 20,
+                     "allreduce: above this pipeline the ring in 1MB "
+                     "segments (tuned's segmented-ring crossover)")
         register_var("coll", "host_allgather_small", VarType.SIZE, 64 * 1024,
                      "allgather: below this use bruck, above ring")
-        for name in ("allreduce", "allgather", "bcast", "reduce_scatter"):
+        register_var("coll", "host_alltoall_small", VarType.SIZE, 4 * 1024,
+                     "alltoall: below this use bruck (lg p rounds), "
+                     "above pairwise")
+        register_var("coll", "host_dynamic_rules", VarType.STRING, "",
+                     "path to a dynamic collective-selection rules file "
+                     "(see ompi_tpu.mpi.coll.rules)")
+        for name in self.ALGORITHMS:
             register_var("coll", f"host_{name}_algorithm", VarType.STRING, "",
                          f"force a {name} algorithm (empty = decide by size)")
 
@@ -51,8 +100,14 @@ class HostColl(Component):
         base.barrier_dissemination(comm)
 
     def coll_bcast(self, comm, buf, root: int):
-        forced = var_registry.get("coll_host_bcast_algorithm")
-        if forced == "linear":
+        # the algorithm choice must agree on every rank, but only the root
+        # knows the message size — so unlike the reference (whose receivers
+        # learn sizes from fragment headers) the decision here uses only
+        # globally-visible config: forced var or a rules entry at msg size 0
+        alg = self._decide("bcast", comm, 0)
+        if alg == "pipeline":
+            return base.bcast_pipeline(comm, buf, root)
+        if alg == "linear":
             return base.bcast_linear(comm, buf, root)
         return base.bcast_binomial(comm, buf, root)
 
@@ -60,27 +115,32 @@ class HostColl(Component):
         return base.reduce_binomial(comm, sendbuf, op, root)
 
     def coll_allreduce(self, comm, sendbuf, op: Op):
-        forced = var_registry.get("coll_host_allreduce_algorithm")
-        if forced:
-            return {
-                "recursive_doubling": base.allreduce_recursive_doubling,
-                "ring": base.allreduce_ring,
-                "linear": base.allreduce_linear,
-            }[forced](comm, sendbuf, op)
-        # tuned decision (coll_tuned_decision_fixed.c:65-87)
-        if (_nbytes(sendbuf) < var_registry.get("coll_host_allreduce_small")
+        nbytes = _nbytes(sendbuf)
+        alg = self._decide("allreduce", comm, nbytes)
+        if alg:
+            fn = {"recursive_doubling": base.allreduce_recursive_doubling,
+                  "ring": base.allreduce_ring,
+                  "segmented_ring": base.allreduce_segmented_ring,
+                  "linear": base.allreduce_linear}[alg]
+            if not op.commutative and fn is not base.allreduce_linear:
+                fn = base.allreduce_recursive_doubling
+            return fn(comm, sendbuf, op)
+        # tuned fixed decision (coll_tuned_decision_fixed.c:65-87)
+        if (nbytes < var_registry.get("coll_host_allreduce_small")
                 or not op.commutative):
             return base.allreduce_recursive_doubling(comm, sendbuf, op)
+        if nbytes >= var_registry.get("coll_host_allreduce_segment"):
+            return base.allreduce_segmented_ring(comm, sendbuf, op)
         return base.allreduce_ring(comm, sendbuf, op)
 
     def coll_gather(self, comm, sendbuf, root: int):
         return base.gather_linear(comm, sendbuf, root)
 
     def coll_allgather(self, comm, sendbuf):
-        forced = var_registry.get("coll_host_allgather_algorithm")
-        if forced:
+        alg = self._decide("allgather", comm, _nbytes(sendbuf))
+        if alg:
             return {"bruck": base.allgather_bruck,
-                    "ring": base.allgather_ring}[forced](comm, sendbuf)
+                    "ring": base.allgather_ring}[alg](comm, sendbuf)
         if _nbytes(sendbuf) < var_registry.get("coll_host_allgather_small"):
             return base.allgather_bruck(comm, sendbuf)
         return base.allgather_ring(comm, sendbuf)
@@ -89,13 +149,47 @@ class HostColl(Component):
         return base.scatter_linear(comm, sendbuf, root)
 
     def coll_alltoall(self, comm, sendbuf):
+        alg = self._decide("alltoall", comm, _nbytes(sendbuf))
+        if alg:
+            return {"pairwise": base.alltoall_pairwise,
+                    "bruck": base.alltoall_bruck}[alg](comm, sendbuf)
+        if _nbytes(sendbuf) < var_registry.get("coll_host_alltoall_small"):
+            return base.alltoall_bruck(comm, sendbuf)
         return base.alltoall_pairwise(comm, sendbuf)
 
     def coll_reduce_scatter(self, comm, sendbuf, op: Op):
-        forced = var_registry.get("coll_host_reduce_scatter_algorithm")
-        if forced == "basic" or not op.commutative:
+        alg = self._decide("reduce_scatter", comm, _nbytes(sendbuf))
+        if alg == "basic" or not op.commutative:
             return base.reduce_scatter_basic(comm, sendbuf, op)
         return base.reduce_scatter_ring(comm, sendbuf, op)
 
+    def coll_reduce_scatter_block(self, comm, sendbuf, op: Op):
+        arr = np.asarray(sendbuf)
+        if arr.shape[0] % comm.size:
+            from ompi_tpu.mpi.constants import MPIException
+
+            raise MPIException(
+                f"reduce_scatter_block: axis 0 ({arr.shape[0]}) not "
+                f"divisible by {comm.size}")
+        block = arr.shape[0] // comm.size
+        out = self.coll_reduce_scatter(comm, arr.reshape(arr.shape[0], -1),
+                                       op)
+        return out.reshape((block,) + arr.shape[1:])
+
     def coll_scan(self, comm, sendbuf, op: Op):
         return base.scan_linear(comm, sendbuf, op)
+
+    def coll_exscan(self, comm, sendbuf, op: Op):
+        return base.exscan_linear(comm, sendbuf, op)
+
+    def coll_gatherv(self, comm, sendbuf, root: int):
+        return base.gatherv_linear(comm, sendbuf, root)
+
+    def coll_scatterv(self, comm, sendparts, root: int):
+        return base.scatterv_linear(comm, sendparts, root)
+
+    def coll_allgatherv(self, comm, sendbuf):
+        return base.allgatherv_ring(comm, sendbuf)
+
+    def coll_alltoallv(self, comm, sendparts):
+        return base.alltoallv_pairwise(comm, sendparts)

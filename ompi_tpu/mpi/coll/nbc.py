@@ -1,0 +1,510 @@
+"""Nonblocking collectives: round-based schedules.
+
+≈ ompi/mca/coll/libnbc (nbc_internal.h:146-155): each nonblocking collective
+is compiled, at call time, into a *schedule* — an ordered list of rounds,
+each holding sends, receives, and an end-of-round local computation.  The
+schedule progresses without a helper thread: every ``test()``/``wait()`` on
+the returned request advances whatever rounds have completed (the reference
+progresses schedules from ``opal_progress``; here the request itself is the
+progress hook, which matches MPI's weak progress guarantee).
+
+Tag isolation: every operation draws a fresh tag from the communicator's
+nbc sequence counter — collective calls are ordered identically on all ranks
+(an MPI-mandated property the reference also leans on, nbc_internal.h's
+schedule tags), so concurrently-outstanding collectives never cross-match.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ompi_tpu.mpi.op import Op
+from ompi_tpu.mpi.request import Request
+
+__all__ = [
+    "NbcRequest", "ibarrier", "ibcast", "ireduce", "iallreduce", "igather",
+    "iallgather", "iscatter", "ialltoall", "ireduce_scatter", "iscan",
+    "iexscan", "ialltoallv", "iallgatherv",
+]
+
+# offset into the reserved collective tag space (blocking collectives use
+# low coll-tags; nbc draws from 64 upward, one per outstanding op)
+_NBC_TAG_BASE = 64
+
+
+class Round:
+    """One schedule round: post sends+recvs, await all, then compute."""
+
+    __slots__ = ("sends", "recvs", "compute")
+
+    def __init__(self,
+                 sends: tuple = (),
+                 recvs: tuple = (),
+                 compute: Optional[Callable[[dict], None]] = None) -> None:
+        self.sends = sends    # ((buf_fn(state) -> array, peer), ...)
+        self.recvs = recvs    # ((peer, state_key), ...)
+        self.compute = compute
+
+
+class NbcRequest(Request):
+    """A collective request progressed by test()/wait() (libnbc schedule)."""
+
+    def __init__(self, comm, rounds: list[Round],
+                 result: Callable[[dict], Any], tag: int,
+                 kind: str = "nbc", state: Optional[dict] = None) -> None:
+        super().__init__(kind=kind)
+        self._comm = comm
+        self._rounds = rounds
+        self._result_fn = result
+        self._tag = tag
+        self._state: dict = state if state is not None else {}
+        self._ridx = 0
+        self._pending: Optional[list] = None  # [(req, key|None), ...]
+        self._nbc_lock = threading.Lock()
+        self._progress(block=False)
+
+    # -- progress engine --------------------------------------------------
+
+    def _start_round(self) -> None:
+        rnd = self._rounds[self._ridx]
+        pending = []
+        # post receives first (the reference posts recvs before sends in a
+        # round to keep the unexpected queue short)
+        for peer, key in rnd.recvs:
+            pending.append(
+                (self._comm._coll_irecv(None, peer, self._tag), key))
+        for buf_fn, peer in rnd.sends:
+            buf = np.asarray(buf_fn(self._state))
+            pending.append((self._comm._coll_isend(buf, peer, self._tag),
+                            None))
+        self._pending = pending
+
+    def _finish_round(self) -> None:
+        rnd = self._rounds[self._ridx]
+        for req, key in self._pending:  # type: ignore[union-attr]
+            if key is not None:
+                self._state[key] = req.wait()  # already complete
+        if rnd.compute is not None:
+            rnd.compute(self._state)
+        self._pending = None
+        self._ridx += 1
+
+    def _progress(self, block: bool) -> bool:
+        """Advance as far as possible; True when the schedule is done."""
+        with self._nbc_lock:
+            if self.done():
+                return True
+            while self._ridx < len(self._rounds):
+                if self._pending is None:
+                    self._start_round()
+                assert self._pending is not None
+                if block:
+                    for req, _ in self._pending:
+                        req.wait()
+                elif not all(req.test() for req, _ in self._pending):
+                    return False
+                self._finish_round()
+            self.complete(self._result_fn(self._state))
+            return True
+
+    # -- Request interface ------------------------------------------------
+
+    def test(self) -> bool:
+        return self._progress(block=False)
+
+    def wait(self, timeout: Optional[float] = None) -> Any:
+        self._progress(block=True)
+        return super().wait(timeout=timeout)
+
+
+def _next_tag(comm) -> int:
+    with comm._lock:
+        seq = comm._nbc_seq = getattr(comm, "_nbc_seq", 0) + 1
+    return _NBC_TAG_BASE + seq
+
+
+def _launch(comm, rounds, result, kind, state=None) -> NbcRequest:
+    return NbcRequest(comm, rounds, result, _next_tag(comm), kind=kind,
+                      state=state)
+
+
+def _const(x):
+    return lambda state: x
+
+
+# ---------------------------------------------------------------------------
+# schedule builders (one per collective)
+
+def ibarrier(comm) -> NbcRequest:
+    """Dissemination barrier, one round per step."""
+    size, rank = comm.size, comm.rank
+    token = np.zeros(0, dtype=np.uint8)
+    rounds = []
+    step = 1
+    while step < size:
+        to = (rank + step) % size
+        frm = (rank - step) % size
+        rounds.append(Round(sends=((_const(token), to),),
+                            recvs=((frm, f"t{step}"),)))
+        step <<= 1
+    return _launch(comm, rounds, lambda s: None, "ibarrier")
+
+
+def ibcast(comm, buf, root: int = 0) -> NbcRequest:
+    """Binomial tree: one recv round (non-root), one send round."""
+    size, rank = comm.size, comm.rank
+    if size == 1:
+        return _launch(comm, [], _const(np.asarray(buf)), "ibcast")
+    vrank = (rank - root) % size
+    recv_mask = 1
+    while recv_mask < size and not (vrank & recv_mask):
+        recv_mask <<= 1
+    rounds = []
+    if vrank != 0:
+        parent = ((vrank & ~recv_mask) + root) % size
+        rounds.append(Round(recvs=((parent, "buf"),)))
+        get = lambda s: s["buf"]  # noqa: E731
+    else:
+        arr = np.asarray(buf)
+        get = _const(arr)
+    mask = 1
+    while mask < size:
+        mask <<= 1
+    mask >>= 1
+    send_mask = recv_mask >> 1 if vrank != 0 else mask
+    sends = []
+    while send_mask >= 1:
+        vchild = vrank | send_mask
+        if vchild < size and vchild != vrank:
+            sends.append((get, (vchild + root) % size))
+        send_mask >>= 1
+    if sends:
+        rounds.append(Round(sends=tuple(sends)))
+    return _launch(comm, rounds, get, "ibcast")
+
+
+def _reduce_rounds(comm, mine: np.ndarray, op: Op,
+                   root: int) -> tuple[list[Round], dict]:
+    """Binomial-fold rounds leaving the reduction in state['acc'] on `root`.
+    Children cover disjoint ascending vrank ranges, so folding in ascending
+    mask order preserves rank order (valid for non-commutative when the
+    effective root is 0, mirroring reduce_binomial)."""
+    size, rank = comm.size, comm.rank
+    rounds: list[Round] = []
+    state = {"acc": mine}
+    if size == 1:
+        return rounds, state
+    eff_root = root if op.commutative else 0
+    vrank = (rank - eff_root) % size
+    children = []
+    parent = None
+    mask = 1
+    while mask < size:
+        if vrank & mask:
+            parent = ((vrank & ~mask) + eff_root) % size
+            break
+        vchild = vrank | mask
+        if vchild < size:
+            children.append((vchild + eff_root) % size)
+        mask <<= 1
+
+    if children:
+        def fold(state, keys=tuple(f"c{i}" for i in range(len(children)))):
+            acc = state["acc"]
+            for k in keys:
+                recv = state[k].reshape(acc.shape).astype(acc.dtype,
+                                                          copy=False)
+                acc = np.asarray(op.host(acc, recv))
+            state["acc"] = acc
+
+        rounds.append(Round(
+            recvs=tuple((c, f"c{i}") for i, c in enumerate(children)),
+            compute=fold))
+    if parent is not None:
+        rounds.append(Round(sends=(((lambda s: s["acc"]), parent),)))
+    # odd-root forwarding for non-commutative ops
+    if eff_root != root:
+        if rank == eff_root:
+            rounds.append(Round(sends=(((lambda s: s["acc"]), root),)))
+        elif rank == root:
+            rounds.append(Round(recvs=((eff_root, "fwd"),),
+                                compute=lambda s: s.__setitem__(
+                                    "acc", s["fwd"].reshape(mine.shape))))
+    return rounds, state
+
+
+def ireduce(comm, sendbuf, op: Op, root: int = 0) -> NbcRequest:
+    mine = np.asarray(sendbuf)
+    rounds, state = _reduce_rounds(comm, mine, op, root)
+    result = (lambda s: s["acc"]) if comm.rank == root else _const(None)
+    return _launch(comm, rounds, result, "ireduce", state=state)
+
+
+def iallreduce(comm, sendbuf, op: Op) -> NbcRequest:
+    """Recursive doubling, one round per step (non-pof2 folds the remainder
+    in pre/post rounds, as in allreduce_recursive_doubling)."""
+    size, rank = comm.size, comm.rank
+    mine = np.asarray(sendbuf)
+    if size == 1:
+        return _launch(comm, [], _const(mine), "iallreduce")
+    shape, dtype = mine.shape, mine.dtype
+    pof2 = 1
+    while pof2 * 2 <= size:
+        pof2 *= 2
+    rem = size - pof2
+    rounds = []
+
+    def as_acc(state, key):
+        return state[key].reshape(shape).astype(dtype, copy=False)
+
+    if rank >= pof2:
+        rounds.append(Round(sends=(((lambda s: s["acc"]), rank - pof2),)))
+        rounds.append(Round(recvs=((rank - pof2, "fin"),),
+                            compute=lambda s: s.__setitem__(
+                                "acc", as_acc(s, "fin"))))
+    else:
+        if rank < rem:
+            rounds.append(Round(
+                recvs=((rank + pof2, "r0"),),
+                compute=lambda s: s.__setitem__(
+                    "acc", np.asarray(op.host(s["acc"], as_acc(s, "r0"))))))
+        newrank = rank
+        mask = 1
+        while mask < pof2:
+            partner = newrank ^ mask
+
+            def fold(state, partner=partner, key=f"m{mask}"):
+                recv = as_acc(state, key)
+                acc = state["acc"]
+                state["acc"] = np.asarray(
+                    op.host(recv, acc) if partner < newrank
+                    else op.host(acc, recv))
+
+            rounds.append(Round(sends=(((lambda s: s["acc"]), partner),),
+                                recvs=((partner, f"m{mask}"),),
+                                compute=fold))
+            mask <<= 1
+        if rank < rem:
+            rounds.append(Round(sends=(((lambda s: s["acc"]), rank + pof2),)))
+    return _launch(comm, rounds, lambda s: s["acc"], "iallreduce",
+                   state={"acc": mine})
+
+
+def igather(comm, sendbuf, root: int = 0) -> NbcRequest:
+    size, rank = comm.size, comm.rank
+    mine = np.asarray(sendbuf)
+    if size == 1:
+        return _launch(comm, [], _const(mine[None]), "igather")
+    if rank == root:
+        def assemble(state):
+            parts = [state[f"p{r}"].reshape(mine.shape).astype(
+                mine.dtype, copy=False) if r != root else mine
+                for r in range(size)]
+            state["out"] = np.stack(parts)
+
+        rounds = [Round(recvs=tuple((r, f"p{r}") for r in range(size)
+                                    if r != root),
+                        compute=assemble)]
+        return _launch(comm, rounds, lambda s: s["out"], "igather")
+    rounds = [Round(sends=((_const(mine), root),))]
+    return _launch(comm, rounds, _const(None), "igather")
+
+
+def iscatter(comm, sendbuf, root: int = 0) -> NbcRequest:
+    size, rank = comm.size, comm.rank
+    if size == 1:
+        return _launch(comm, [], _const(np.asarray(sendbuf)), "iscatter")
+    if rank == root:
+        arr = np.asarray(sendbuf)
+        if arr.shape[0] % size:
+            from ompi_tpu.mpi.constants import MPIException
+
+            raise MPIException(
+                f"iscatter: axis 0 ({arr.shape[0]}) not divisible by {size}")
+        parts = np.split(arr, size, axis=0)
+        rounds = [Round(sends=tuple((_const(parts[r]), r)
+                                    for r in range(size) if r != root))]
+        return _launch(comm, rounds, _const(parts[root]), "iscatter")
+    rounds = [Round(recvs=((root, "p"),))]
+    return _launch(comm, rounds, lambda s: s["p"], "iscatter")
+
+
+def iallgather(comm, sendbuf) -> NbcRequest:
+    """Ring: p-1 rounds of neighbor sendrecv."""
+    size, rank = comm.size, comm.rank
+    mine = np.asarray(sendbuf)
+    if size == 1:
+        return _launch(comm, [], _const(mine[None]), "iallgather")
+    right = (rank + 1) % size
+    left = (rank - 1) % size
+    rounds = []
+    send_idx = rank
+    for _ in range(size - 1):
+        recv_idx = (send_idx - 1) % size
+
+        def store(state, recv_idx=recv_idx):
+            state[f"b{recv_idx}"] = state.pop("_r").reshape(
+                mine.shape).astype(mine.dtype, copy=False)
+
+        rounds.append(Round(
+            sends=(((lambda s, i=send_idx: s[f"b{i}"]), right),),
+            recvs=((left, "_r"),),
+            compute=store))
+        send_idx = recv_idx
+
+    def result(state):
+        return np.stack([state[f"b{r}"] for r in range(size)])
+
+    return _launch(comm, rounds, result, "iallgather",
+                   state={f"b{rank}": mine})
+
+
+def ialltoall(comm, sendbuf) -> NbcRequest:
+    """Pairwise: p-1 rounds."""
+    size, rank = comm.size, comm.rank
+    arr = np.asarray(sendbuf)
+    if arr.shape[0] % size:
+        from ompi_tpu.mpi.constants import MPIException
+
+        raise MPIException(
+            f"ialltoall: axis 0 ({arr.shape[0]}) not divisible by {size}")
+    if size == 1:
+        return _launch(comm, [], _const(arr), "ialltoall")
+    parts = np.split(arr, size, axis=0)
+    rounds = []
+    for step in range(1, size):
+        to = (rank + step) % size
+        frm = (rank - step) % size
+
+        def store(state, frm=frm):
+            state[f"b{frm}"] = state.pop("_r").reshape(
+                parts[0].shape).astype(arr.dtype, copy=False)
+
+        rounds.append(Round(sends=((_const(parts[to]), to),),
+                            recvs=((frm, "_r"),), compute=store))
+
+    def result(state):
+        return np.concatenate([state[f"b{r}"] for r in range(size)])
+
+    return _launch(comm, rounds, result, "ialltoall",
+                   state={f"b{rank}": parts[rank]})
+
+
+def ireduce_scatter(comm, sendbuf, op: Op) -> NbcRequest:
+    """Ring reduce-scatter: p-1 rounds (commutative; non-commutative ops
+    fall back to reduce+scatter rounds)."""
+    size, rank = comm.size, comm.rank
+    arr = np.asarray(sendbuf)
+    if size == 1:
+        return _launch(comm, [], _const(arr), "ireduce_scatter")
+    if not op.commutative:
+        # rank order must be preserved (the ring below folds out of order):
+        # one schedule = binomial-reduce rounds + a scatter round
+        rounds, state = _reduce_rounds(comm, arr, op, 0)
+        if rank == 0:
+            def part(s, r):
+                return np.array_split(s["acc"].reshape(-1), size)[r]
+
+            rounds.append(Round(sends=tuple(
+                ((lambda s, r=r: part(s, r)), r) for r in range(1, size))))
+            return _launch(comm, rounds, lambda s: part(s, 0),
+                           "ireduce_scatter", state=state)
+        rounds.append(Round(recvs=((0, "p"),)))
+        return _launch(comm, rounds, lambda s: s["p"], "ireduce_scatter",
+                       state=state)
+    flat = arr.reshape(-1)
+    chunks = [c.copy() for c in np.array_split(flat, size)]
+    right = (rank + 1) % size
+    left = (rank - 1) % size
+    rounds = []
+    send_idx = (rank - 1) % size
+    for _ in range(size - 1):
+        recv_idx = (send_idx - 1) % size
+
+        def fold(state, recv_idx=recv_idx):
+            cur = state[f"c{recv_idx}"]
+            recv = state.pop("_r").astype(cur.dtype, copy=False)
+            state[f"c{recv_idx}"] = np.asarray(op.host(cur, recv))
+
+        rounds.append(Round(
+            sends=(((lambda s, i=send_idx: s[f"c{i}"]), right),),
+            recvs=((left, "_r"),), compute=fold))
+        send_idx = recv_idx
+    return _launch(comm, rounds, lambda s: s[f"c{rank}"], "ireduce_scatter",
+                   state={f"c{i}": c for i, c in enumerate(chunks)})
+
+
+def _chain_scan(comm, sendbuf, op: Op, exclusive: bool,
+                kind: str) -> NbcRequest:
+    rank, size = comm.rank, comm.size
+    mine = np.asarray(sendbuf)
+    rounds = []
+    if rank > 0:
+        rounds.append(Round(recvs=((rank - 1, "prev"),)))
+    if rank < size - 1:
+        def fwd(state):
+            prev = state.get("prev")
+            if prev is None:
+                return mine
+            prev = prev.reshape(mine.shape).astype(mine.dtype, copy=False)
+            return np.asarray(op.host(prev, mine))
+
+        rounds.append(Round(sends=((fwd, rank + 1),)))
+
+    def result(state):
+        prev = state.get("prev")
+        if prev is not None:
+            prev = prev.reshape(mine.shape).astype(mine.dtype, copy=False)
+        if exclusive:
+            return prev  # None on rank 0 (undefined per MPI)
+        return mine if prev is None else np.asarray(op.host(prev, mine))
+
+    return _launch(comm, rounds, result, kind)
+
+
+def iscan(comm, sendbuf, op: Op) -> NbcRequest:
+    return _chain_scan(comm, sendbuf, op, exclusive=False, kind="iscan")
+
+
+def iexscan(comm, sendbuf, op: Op) -> NbcRequest:
+    return _chain_scan(comm, sendbuf, op, exclusive=True, kind="iexscan")
+
+
+def iallgatherv(comm, sendbuf) -> NbcRequest:
+    """Linear: everyone sends to everyone (variable block sizes)."""
+    size, rank = comm.size, comm.rank
+    mine = np.asarray(sendbuf)
+    if size == 1:
+        return _launch(comm, [], _const([mine]), "iallgatherv")
+    rounds = [Round(
+        sends=tuple((_const(mine), r) for r in range(size) if r != rank),
+        recvs=tuple((r, f"b{r}") for r in range(size) if r != rank))]
+
+    def result(state):
+        return [state[f"b{r}"] if r != rank else mine for r in range(size)]
+
+    return _launch(comm, rounds, result, "iallgatherv")
+
+
+def ialltoallv(comm, sendparts) -> NbcRequest:
+    size, rank = comm.size, comm.rank
+    if len(sendparts) != size:
+        from ompi_tpu.mpi.constants import MPIException
+
+        raise MPIException(
+            f"ialltoallv: {len(sendparts)} blocks for {size} ranks")
+    mine = np.asarray(sendparts[rank])
+    if size == 1:
+        return _launch(comm, [], _const([mine]), "ialltoallv")
+    rounds = [Round(
+        sends=tuple((_const(np.asarray(sendparts[r])), r)
+                    for r in range(size) if r != rank),
+        recvs=tuple((r, f"b{r}") for r in range(size) if r != rank))]
+
+    def result(state):
+        return [state[f"b{r}"] if r != rank else mine for r in range(size)]
+
+    return _launch(comm, rounds, result, "ialltoallv")

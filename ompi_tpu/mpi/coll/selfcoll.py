@@ -52,5 +52,23 @@ class SelfColl(Component):
     def coll_reduce_scatter(self, comm, sendbuf, op: Op):
         return np.asarray(sendbuf).reshape(-1)
 
+    def coll_reduce_scatter_block(self, comm, sendbuf, op: Op):
+        return np.asarray(sendbuf)
+
     def coll_scan(self, comm, sendbuf, op: Op):
         return np.asarray(sendbuf)
+
+    def coll_exscan(self, comm, sendbuf, op: Op):
+        return None  # rank 0's exscan result is undefined per MPI
+
+    def coll_gatherv(self, comm, sendbuf, root: int):
+        return [np.asarray(sendbuf)]
+
+    def coll_scatterv(self, comm, sendparts, root: int):
+        return np.asarray(sendparts[0])
+
+    def coll_allgatherv(self, comm, sendbuf):
+        return [np.asarray(sendbuf)]
+
+    def coll_alltoallv(self, comm, sendparts):
+        return [np.asarray(sendparts[0])]

@@ -198,10 +198,104 @@ class Communicator:
 
         return self.coll.reduce_scatter(self, sendbuf, op or op_mod.SUM)
 
+    def reduce_scatter_block(self, sendbuf, op=None):
+        from ompi_tpu.mpi import op as op_mod
+
+        return self.coll.reduce_scatter_block(self, sendbuf, op or op_mod.SUM)
+
     def scan(self, sendbuf, op=None):
         from ompi_tpu.mpi import op as op_mod
 
         return self.coll.scan(self, sendbuf, op or op_mod.SUM)
+
+    def exscan(self, sendbuf, op=None):
+        from ompi_tpu.mpi import op as op_mod
+
+        return self.coll.exscan(self, sendbuf, op or op_mod.SUM)
+
+    def gatherv(self, sendbuf, root: int = 0):
+        return self.coll.gatherv(self, sendbuf, root)
+
+    def scatterv(self, sendparts, root: int = 0):
+        return self.coll.scatterv(self, sendparts, root)
+
+    def allgatherv(self, sendbuf):
+        return self.coll.allgatherv(self, sendbuf)
+
+    def alltoallv(self, sendparts):
+        return self.coll.alltoallv(self, sendparts)
+
+    # -- nonblocking collectives (libnbc-style schedules) ------------------
+
+    def ibarrier(self) -> Request:
+        from ompi_tpu.mpi.coll import nbc
+
+        return nbc.ibarrier(self)
+
+    def ibcast(self, buf, root: int = 0) -> Request:
+        from ompi_tpu.mpi.coll import nbc
+
+        return nbc.ibcast(self, buf, root)
+
+    def ireduce(self, sendbuf, op=None, root: int = 0) -> Request:
+        from ompi_tpu.mpi import op as op_mod
+        from ompi_tpu.mpi.coll import nbc
+
+        return nbc.ireduce(self, sendbuf, op or op_mod.SUM, root)
+
+    def iallreduce(self, sendbuf, op=None) -> Request:
+        from ompi_tpu.mpi import op as op_mod
+        from ompi_tpu.mpi.coll import nbc
+
+        return nbc.iallreduce(self, sendbuf, op or op_mod.SUM)
+
+    def igather(self, sendbuf, root: int = 0) -> Request:
+        from ompi_tpu.mpi.coll import nbc
+
+        return nbc.igather(self, sendbuf, root)
+
+    def iscatter(self, sendbuf, root: int = 0) -> Request:
+        from ompi_tpu.mpi.coll import nbc
+
+        return nbc.iscatter(self, sendbuf, root)
+
+    def iallgather(self, sendbuf) -> Request:
+        from ompi_tpu.mpi.coll import nbc
+
+        return nbc.iallgather(self, sendbuf)
+
+    def ialltoall(self, sendbuf) -> Request:
+        from ompi_tpu.mpi.coll import nbc
+
+        return nbc.ialltoall(self, sendbuf)
+
+    def ireduce_scatter(self, sendbuf, op=None) -> Request:
+        from ompi_tpu.mpi import op as op_mod
+        from ompi_tpu.mpi.coll import nbc
+
+        return nbc.ireduce_scatter(self, sendbuf, op or op_mod.SUM)
+
+    def iscan(self, sendbuf, op=None) -> Request:
+        from ompi_tpu.mpi import op as op_mod
+        from ompi_tpu.mpi.coll import nbc
+
+        return nbc.iscan(self, sendbuf, op or op_mod.SUM)
+
+    def iexscan(self, sendbuf, op=None) -> Request:
+        from ompi_tpu.mpi import op as op_mod
+        from ompi_tpu.mpi.coll import nbc
+
+        return nbc.iexscan(self, sendbuf, op or op_mod.SUM)
+
+    def iallgatherv(self, sendbuf) -> Request:
+        from ompi_tpu.mpi.coll import nbc
+
+        return nbc.iallgatherv(self, sendbuf)
+
+    def ialltoallv(self, sendparts) -> Request:
+        from ompi_tpu.mpi.coll import nbc
+
+        return nbc.ialltoallv(self, sendparts)
 
     # -- construction ------------------------------------------------------
 

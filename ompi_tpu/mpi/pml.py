@@ -179,7 +179,8 @@ class PmlOb1:
             self._seq[seq_key] = seq + 1
         hdr = {"tag": tag, "cid": cid, "seq": seq,
                "dt": _dtype_to_wire(datatype.base_np),
-               "elems": len(payload) // datatype.base_np.itemsize}
+               "elems": len(payload) // datatype.base_np.itemsize,
+               "shp": list(arr.shape)}
         if len(payload) <= var_registry.get("pml_eager_limit"):
             hdr["t"] = "eager"
             self._sendq.put(("frame", peer, hdr, payload, req))
@@ -332,6 +333,13 @@ class PmlOb1:
             out = np.frombuffer(
                 bytearray(payload[:n_elems * elem_np.itemsize]),
                 dtype=elem_np)
+            # allocate-on-match receives recover the sender's array shape
+            # from the header (predefined contiguous dtypes only; derived
+            # datatypes keep the flat element stream)
+            shp = hdr.get("shp")
+            if (datatype is None and shp is not None
+                    and int(np.prod(shp)) == n_elems):
+                out = out.reshape(shp)
         else:
             out = req.buf
             items = len(payload) // max(1, datatype.size)

@@ -1,0 +1,227 @@
+"""v-collectives, exscan, reduce_scatter_block, extra algorithms, dynamic
+rules (≈ the reference's coll_base + tuned dynamic-file coverage)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ompi_tpu.core.config import var_registry
+from ompi_tpu.mpi import op as op_mod
+from ompi_tpu.mpi.coll import base, rules
+from tests.mpi.harness import run_ranks
+
+
+N = 4
+
+
+def test_gatherv_scatterv_roundtrip():
+    def body(comm):
+        r = comm.rank
+        mine = np.arange(r + 1, dtype=np.float64) + 10 * r
+        parts = comm.gatherv(mine, root=1)
+        if comm.rank == 1:
+            assert len(parts) == N
+            for i, p in enumerate(parts):
+                np.testing.assert_array_equal(
+                    p, np.arange(i + 1, dtype=np.float64) + 10 * i)
+            back = comm.scatterv(parts, root=1)
+        else:
+            assert parts is None
+            back = comm.scatterv(None, root=1)
+        np.testing.assert_array_equal(back, mine)
+
+    run_ranks(N, body)
+
+
+def test_allgatherv():
+    def body(comm):
+        mine = np.full(comm.rank + 2, float(comm.rank))
+        out = comm.allgatherv(mine)
+        assert len(out) == N
+        for i, p in enumerate(out):
+            np.testing.assert_array_equal(p, np.full(i + 2, float(i)))
+
+    run_ranks(N, body)
+
+
+def test_alltoallv():
+    def body(comm):
+        r = comm.rank
+        # rank r sends an array of length (r + dest + 1) valued r*100+dest
+        parts = [np.full(r + d + 1, r * 100 + d) for d in range(N)]
+        out = comm.alltoallv(parts)
+        for src in range(N):
+            np.testing.assert_array_equal(
+                out[src], np.full(src + r + 1, src * 100 + r))
+
+    run_ranks(N, body)
+
+
+def test_exscan():
+    def body(comm):
+        mine = np.array([float(comm.rank + 1)])
+        out = comm.exscan(mine, op_mod.SUM)
+        if comm.rank == 0:
+            assert out is None
+        else:
+            expect = sum(range(1, comm.rank + 1))
+            np.testing.assert_allclose(out, [expect])
+
+    run_ranks(N, body)
+
+
+def test_reduce_scatter_block():
+    def body(comm):
+        arr = np.arange(N * 3, dtype=np.float64).reshape(N, 3) + comm.rank
+        out = comm.reduce_scatter_block(arr, op_mod.SUM)
+        base_row = np.arange(N * 3, dtype=np.float64).reshape(N, 3)[comm.rank]
+        expect = base_row * N + sum(range(N))
+        assert out.shape == (1, 3)
+        np.testing.assert_allclose(out.reshape(3), expect)
+
+    run_ranks(N, body)
+
+
+@pytest.mark.parametrize("alg", ["pairwise", "bruck"])
+def test_alltoall_algorithms(alg):
+    def body(comm):
+        fn = {"pairwise": base.alltoall_pairwise,
+              "bruck": base.alltoall_bruck}[alg]
+        arr = np.arange(N * 2, dtype=np.int64) + 100 * comm.rank
+        out = fn(comm, arr)
+        expect = np.concatenate(
+            [np.arange(comm.rank * 2, comm.rank * 2 + 2) + 100 * src
+             for src in range(N)])
+        np.testing.assert_array_equal(out, expect)
+
+    run_ranks(N, body)
+
+
+def test_alltoall_bruck_nonpof2():
+    def body(comm):
+        arr = np.arange(3 * 5, dtype=np.int64).reshape(3, 5) + 100 * comm.rank
+        out = base.alltoall_bruck(comm, arr)
+        expect = np.concatenate(
+            [arr[comm.rank:comm.rank + 1] - 100 * comm.rank + 100 * s
+             for s in range(3)])
+        np.testing.assert_array_equal(out, expect)
+
+    run_ranks(3, body)
+
+
+def test_allreduce_segmented_ring():
+    def body(comm):
+        arr = np.arange(1000, dtype=np.float64) + comm.rank
+        out = base.allreduce_segmented_ring(comm, arr, op_mod.SUM,
+                                            segsize=256 * 8)
+        expect = np.arange(1000, dtype=np.float64) * N + sum(range(N))
+        np.testing.assert_allclose(out, expect)
+
+    run_ranks(N, body)
+
+
+def test_bcast_pipeline():
+    def body(comm):
+        if comm.rank == 2:
+            arr = np.arange(777, dtype=np.float32).reshape(7, 111)
+        else:
+            arr = None
+        out = base.bcast_pipeline(comm, arr, root=2, segsize=400)
+        assert out.shape == (7, 111)
+        np.testing.assert_array_equal(
+            out.reshape(-1), np.arange(777, dtype=np.float32))
+
+    run_ranks(N, body)
+
+
+def test_dynamic_rules_parse_and_lookup():
+    rs = rules.parse("""
+# comments ignored
+allreduce 0 0 recursive_doubling
+allreduce 0 10240 ring
+allreduce 8 1048576 segmented_ring
+alltoall  0 0 pairwise
+""")
+    assert len(rs) == 4
+    assert rs.lookup("allreduce", 4, 100) == "recursive_doubling"
+    assert rs.lookup("allreduce", 4, 20000) == "ring"
+    assert rs.lookup("allreduce", 4, 2 << 20) == "ring"  # commsize < 8
+    assert rs.lookup("allreduce", 8, 2 << 20) == "segmented_ring"
+    assert rs.lookup("bcast", 4, 0) is None
+    assert rs.lookup("alltoall", 64, 1) == "pairwise"
+
+
+def test_dynamic_rules_file_drives_decision(tmp_path):
+    path = tmp_path / "rules.conf"
+    path.write_text("allreduce 0 0 linear\n")
+    var_registry.set("coll_host_dynamic_rules", str(path))
+    try:
+        def body(comm):
+            out = comm.allreduce(np.array([1.0 + comm.rank]))
+            np.testing.assert_allclose(out, [sum(1.0 + r for r in range(N))])
+
+        run_ranks(N, body)
+    finally:
+        var_registry.set("coll_host_dynamic_rules", "")
+
+
+def test_allgatherv_multidim_blocks_keep_shape():
+    """Remote v-blocks must arrive with their N-D shape (wire shp header)."""
+
+    def body(comm):
+        mine = np.full((comm.rank + 1, 3), float(comm.rank))
+        out = comm.allgatherv(mine)
+        for i, p in enumerate(out):
+            assert p.shape == (i + 1, 3)
+        stacked = np.concatenate(out, axis=0)
+        assert stacked.shape == (sum(range(1, N + 1)), 3)
+
+    run_ranks(N, body)
+
+
+def test_unknown_algorithm_from_rules_raises(tmp_path):
+    from ompi_tpu.mpi.constants import MPIException
+
+    path = tmp_path / "rules.conf"
+    path.write_text("allreduce 0 0 rings\n")  # typo
+    var_registry.set("coll_host_dynamic_rules", str(path))
+    try:
+        def body(comm):
+            try:
+                comm.allreduce(np.ones(4))
+            except MPIException as e:
+                assert "rings" in str(e) and "valid" in str(e)
+                return "raised"
+            return "no-raise"
+
+        assert run_ranks(2, body) == ["raised", "raised"]
+    finally:
+        var_registry.set("coll_host_dynamic_rules", "")
+
+
+def test_unknown_forced_algorithm_raises():
+    from ompi_tpu.mpi.constants import MPIException
+
+    var_registry.set("coll_host_alltoall_algorithm", "hypercube")
+    try:
+        def body(comm):
+            try:
+                comm.alltoall(np.arange(2.0))
+            except MPIException as e:
+                assert "hypercube" in str(e)
+                return "raised"
+            return "no-raise"
+
+        assert run_ranks(2, body) == ["raised", "raised"]
+    finally:
+        var_registry.set("coll_host_alltoall_algorithm", "")
+
+
+def test_dynamic_rules_bad_line():
+    from ompi_tpu.mpi.constants import MPIException
+
+    with pytest.raises(MPIException):
+        rules.parse("allreduce 0 ring\n")
+    with pytest.raises(MPIException):
+        rules.parse("allreduce zero 0 ring\n")

@@ -1,0 +1,182 @@
+"""Nonblocking collectives (≈ ompi/mca/coll/libnbc test coverage): schedule
+progression via test()/wait(), overlap of multiple outstanding collectives,
+and result parity with the blocking algorithms."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ompi_tpu.mpi import op as op_mod
+from tests.mpi.harness import run_ranks
+
+
+N = 4
+
+
+def test_ibarrier():
+    def body(comm):
+        req = comm.ibarrier()
+        assert req.wait() is None
+
+    run_ranks(N, body)
+
+
+def test_ibcast():
+    def body(comm):
+        buf = np.arange(10.0) if comm.rank == 1 else None
+        out = comm.ibcast(buf, root=1).wait()
+        np.testing.assert_array_equal(out, np.arange(10.0))
+
+    run_ranks(N, body)
+
+
+def test_ireduce_both_kinds_of_root():
+    def body(comm):
+        mine = np.array([1.0 * (comm.rank + 1), 2.0])
+        out = comm.ireduce(mine, op_mod.SUM, root=2).wait()
+        if comm.rank == 2:
+            np.testing.assert_allclose(out, [sum(range(1, N + 1)), 2.0 * N])
+        else:
+            assert out is None
+
+    run_ranks(N, body)
+
+
+def test_iallreduce_matches_blocking():
+    def body(comm):
+        mine = np.arange(50.0) + comm.rank
+        nb = comm.iallreduce(mine, op_mod.SUM)
+        blocking = comm.allreduce(mine, op_mod.SUM)
+        np.testing.assert_allclose(nb.wait(), blocking)
+
+    run_ranks(N, body)
+
+
+def test_iallreduce_nonpof2():
+    def body(comm):
+        out = comm.iallreduce(np.array([float(comm.rank)]), op_mod.MAX).wait()
+        np.testing.assert_allclose(out, [2.0])
+
+    run_ranks(3, body)
+
+
+def test_igather_iscatter():
+    def body(comm):
+        mine = np.array([comm.rank, comm.rank * 2])
+        g = comm.igather(mine, root=0).wait()
+        if comm.rank == 0:
+            np.testing.assert_array_equal(
+                g, np.array([[r, 2 * r] for r in range(N)]))
+            s = comm.iscatter(g * 10, root=0).wait()
+        else:
+            assert g is None
+            s = comm.iscatter(None, root=0).wait()
+        np.testing.assert_array_equal(
+            s.reshape(-1), [comm.rank * 10, comm.rank * 20])
+
+    run_ranks(N, body)
+
+
+def test_iallgather_ialltoall():
+    def body(comm):
+        out = comm.iallgather(np.array([comm.rank + 0.5])).wait()
+        np.testing.assert_allclose(out.reshape(-1),
+                                   np.arange(N) + 0.5)
+        a2a = comm.ialltoall(np.arange(N) + 100 * comm.rank).wait()
+        np.testing.assert_array_equal(
+            a2a, np.array([comm.rank + 100 * s for s in range(N)]))
+
+    run_ranks(N, body)
+
+
+def test_ireduce_scatter():
+    def body(comm):
+        arr = np.arange(float(N * 2)) + comm.rank
+        out = comm.ireduce_scatter(arr, op_mod.SUM).wait()
+        full = np.arange(float(N * 2)) * N + sum(range(N))
+        np.testing.assert_allclose(out, full[comm.rank * 2:(comm.rank + 1) * 2])
+
+    run_ranks(N, body)
+
+
+def test_iscan_iexscan():
+    def body(comm):
+        mine = np.array([float(comm.rank + 1)])
+        inc = comm.iscan(mine, op_mod.SUM).wait()
+        np.testing.assert_allclose(inc, [sum(range(1, comm.rank + 2))])
+        exc = comm.iexscan(mine, op_mod.SUM).wait()
+        if comm.rank == 0:
+            assert exc is None
+        else:
+            np.testing.assert_allclose(exc, [sum(range(1, comm.rank + 1))])
+
+    run_ranks(N, body)
+
+
+def test_iallgatherv_ialltoallv():
+    def body(comm):
+        r = comm.rank
+        out = comm.iallgatherv(np.full(r + 1, float(r))).wait()
+        for i, p in enumerate(out):
+            np.testing.assert_array_equal(p, np.full(i + 1, float(i)))
+        parts = [np.full(r + d + 1, r * 10 + d) for d in range(N)]
+        a2av = comm.ialltoallv(parts).wait()
+        for src in range(N):
+            np.testing.assert_array_equal(
+                a2av[src], np.full(src + r + 1, src * 10 + r))
+
+    run_ranks(N, body)
+
+
+def test_overlapping_outstanding_collectives():
+    """Two collectives in flight at once must not cross-match (per-op tags)."""
+
+    def body(comm):
+        r1 = comm.iallreduce(np.array([1.0]), op_mod.SUM)
+        r2 = comm.iallreduce(np.array([10.0 * (comm.rank + 1)]), op_mod.MAX)
+        r3 = comm.ibarrier()
+        # complete deliberately out of issue order
+        np.testing.assert_allclose(r2.wait(), [10.0 * N])
+        np.testing.assert_allclose(r1.wait(), [float(N)])
+        r3.wait()
+
+    run_ranks(N, body)
+
+
+def test_ireduce_scatter_noncommutative_is_nonblocking():
+    """The non-commutative path must not run its reduce phase eagerly:
+    issuing the op on every rank and only then waiting must succeed even
+    when ranks interleave other traffic between issue and wait."""
+    from ompi_tpu.mpi.op import create_op
+
+    def body(comm):
+        op = create_op(lambda a, b: a + b, commutative=False)
+        arr = np.arange(float(N * 2)) + comm.rank
+        req = comm.ireduce_scatter(arr, op)
+        # a blocking exchange between issue and wait would deadlock if the
+        # constructor had blocked on the reduce phase
+        nxt = (comm.rank + 1) % N
+        prv = (comm.rank - 1) % N
+        got = comm.sendrecv(np.array([comm.rank]), nxt, source=prv)
+        assert int(got[0]) == prv
+        out = req.wait()
+        full = np.arange(float(N * 2)) * N + sum(range(N))
+        np.testing.assert_allclose(out, full[comm.rank * 2:(comm.rank + 1) * 2])
+
+    run_ranks(N, body, timeout=30)
+
+
+def test_progress_via_test():
+    """test() alone must eventually complete the schedule (weak progress)."""
+
+    def body(comm):
+        req = comm.iallreduce(np.array([float(comm.rank)]), op_mod.SUM)
+        import time
+        deadline = time.time() + 30
+        while not req.test():
+            if time.time() > deadline:
+                raise TimeoutError("nbc made no progress")
+            time.sleep(0.001)
+        np.testing.assert_allclose(req.wait(), [sum(range(N))])
+
+    run_ranks(N, body)
