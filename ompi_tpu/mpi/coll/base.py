@@ -164,8 +164,9 @@ def reduce_binomial(comm, sendbuf, op: Op, root: int) -> Optional[np.ndarray]:
 # allreduce
 
 def allreduce_recursive_doubling(comm, sendbuf, op: Op) -> np.ndarray:
-    """coll_base_allreduce.c:128 — lg(p) rounds; non-power-of-2 folds the
-    remainder into the nearest power of 2 first. Rank-ordered folds keep it
+    """coll_base_allreduce.c:128 — lg(p) rounds; non-power-of-2 folds
+    *adjacent pairs* (rank 2r into 2r+1) first so every surviving rank holds
+    a rank-contiguous block and the doubling folds stay rank-ordered —
     valid for non-commutative ops."""
     size, rank = comm.size, comm.rank
     acc = np.asarray(sendbuf)
@@ -177,31 +178,42 @@ def allreduce_recursive_doubling(comm, sendbuf, op: Op) -> np.ndarray:
     while pof2 * 2 <= size:
         pof2 *= 2
     rem = size - pof2
-    # fold remainder: ranks >= pof2 send to (rank - pof2) and sit out
-    newrank = rank
-    if rank >= pof2:
-        comm._coll_isend(acc, rank - pof2, TAG_ALLREDUCE).wait()
-        newrank = -1
-    elif rank < rem:
-        recv = comm._coll_irecv(None, rank + pof2, TAG_ALLREDUCE).wait()
-        acc = _fold(op, acc, recv.reshape(shape).astype(dtype, copy=False))
+    # pre-fold: among the first 2*rem ranks, even ranks fold into their odd
+    # neighbor (keeps combined data rank-contiguous: d_{2r} ∘ d_{2r+1})
+    if rank < 2 * rem:
+        if rank % 2 == 0:
+            comm._coll_isend(acc, rank + 1, TAG_ALLREDUCE).wait()
+            newrank = -1
+        else:
+            recv = comm._coll_irecv(None, rank - 1, TAG_ALLREDUCE).wait()
+            acc = _fold(op, recv.reshape(shape).astype(dtype, copy=False),
+                        acc)
+            newrank = rank // 2
+    else:
+        newrank = rank - rem
     if newrank >= 0:
+        # newrank order == rank order of the contiguous blocks, so
+        # partner<newrank decides the fold direction correctly
+        def real_rank(nr: int) -> int:
+            return 2 * nr + 1 if nr < rem else nr + rem
+
         mask = 1
         while mask < pof2:
-            partner = newrank ^ mask
+            partner = real_rank(newrank ^ mask)
             sreq = comm._coll_isend(acc, partner, TAG_ALLREDUCE)
             recv = comm._coll_irecv(None, partner, TAG_ALLREDUCE).wait()
             sreq.wait()
             recv = recv.reshape(shape).astype(dtype, copy=False)
-            acc = (_fold(op, recv, acc) if partner < newrank
+            acc = (_fold(op, recv, acc) if (newrank ^ mask) < newrank
                    else _fold(op, acc, recv))
             mask <<= 1
-    # return results to the remainder ranks
-    if rank < rem:
-        comm._coll_isend(acc, rank + pof2, TAG_ALLREDUCE).wait()
-    elif rank >= pof2:
-        acc = comm._coll_irecv(None, rank - pof2, TAG_ALLREDUCE).wait()
-        acc = acc.reshape(shape).astype(dtype, copy=False)
+    # return results to the folded-out even ranks
+    if rank < 2 * rem:
+        if rank % 2:
+            comm._coll_isend(acc, rank - 1, TAG_ALLREDUCE).wait()
+        else:
+            acc = comm._coll_irecv(None, rank + 1, TAG_ALLREDUCE).wait()
+            acc = acc.reshape(shape).astype(dtype, copy=False)
     return acc
 
 

@@ -92,8 +92,11 @@ class NbcRequest(Request):
         self._pending = None
         self._ridx += 1
 
-    def _progress(self, block: bool) -> bool:
+    def _progress(self, block: bool,
+                  deadline: Optional[float] = None) -> bool:
         """Advance as far as possible; True when the schedule is done."""
+        import time
+
         with self._nbc_lock:
             if self.done():
                 return True
@@ -103,7 +106,15 @@ class NbcRequest(Request):
                 assert self._pending is not None
                 if block:
                     for req, _ in self._pending:
-                        req.wait()
+                        if deadline is None:
+                            req.wait()
+                        else:
+                            remaining = deadline - time.monotonic()
+                            if remaining <= 0:
+                                raise TimeoutError(
+                                    f"{self.kind} timed out in round "
+                                    f"{self._ridx}/{len(self._rounds)}")
+                            req.wait(timeout=remaining)
                 elif not all(req.test() for req, _ in self._pending):
                     return False
                 self._finish_round()
@@ -116,14 +127,23 @@ class NbcRequest(Request):
         return self._progress(block=False)
 
     def wait(self, timeout: Optional[float] = None) -> Any:
-        self._progress(block=True)
+        import time
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        self._progress(block=True, deadline=deadline)
         return super().wait(timeout=timeout)
+
+
+# nbc tags live in [64, 500) — below the OSC (500s) and neighbor-collective
+# (700-891) blocks; the sequence wraps within the window (collision would
+# need 436 simultaneously-outstanding nbc ops on one communicator)
+_NBC_TAG_SPAN = 436
 
 
 def _next_tag(comm) -> int:
     with comm._lock:
         seq = comm._nbc_seq = getattr(comm, "_nbc_seq", 0) + 1
-    return _NBC_TAG_BASE + seq
+    return _NBC_TAG_BASE + (seq % _NBC_TAG_SPAN)
 
 
 def _launch(comm, rounds, result, kind, state=None) -> NbcRequest:
@@ -244,8 +264,10 @@ def ireduce(comm, sendbuf, op: Op, root: int = 0) -> NbcRequest:
 
 
 def iallreduce(comm, sendbuf, op: Op) -> NbcRequest:
-    """Recursive doubling, one round per step (non-pof2 folds the remainder
-    in pre/post rounds, as in allreduce_recursive_doubling)."""
+    """Recursive doubling, one round per step.  Non-pof2 folds *adjacent
+    pairs* (rank 2r into 2r+1) in pre/post rounds, exactly as the blocking
+    allreduce_recursive_doubling, keeping every surviving rank's block
+    rank-contiguous — valid for non-commutative ops."""
     size, rank = comm.size, comm.rank
     mine = np.asarray(sendbuf)
     if size == 1:
@@ -260,35 +282,42 @@ def iallreduce(comm, sendbuf, op: Op) -> NbcRequest:
     def as_acc(state, key):
         return state[key].reshape(shape).astype(dtype, copy=False)
 
-    if rank >= pof2:
-        rounds.append(Round(sends=(((lambda s: s["acc"]), rank - pof2),)))
-        rounds.append(Round(recvs=((rank - pof2, "fin"),),
+    if rank < 2 * rem and rank % 2 == 0:
+        # folded-out even rank: contribute, then wait for the result
+        rounds.append(Round(sends=(((lambda s: s["acc"]), rank + 1),)))
+        rounds.append(Round(recvs=((rank + 1, "fin"),),
                             compute=lambda s: s.__setitem__(
                                 "acc", as_acc(s, "fin"))))
     else:
-        if rank < rem:
+        if rank < 2 * rem:  # odd pre-fold rank: op(d_{rank-1}, d_rank)
             rounds.append(Round(
-                recvs=((rank + pof2, "r0"),),
+                recvs=((rank - 1, "r0"),),
                 compute=lambda s: s.__setitem__(
-                    "acc", np.asarray(op.host(s["acc"], as_acc(s, "r0"))))))
-        newrank = rank
+                    "acc", np.asarray(op.host(as_acc(s, "r0"), s["acc"])))))
+            newrank = rank // 2
+        else:
+            newrank = rank - rem
+
+        def real_rank(nr: int) -> int:
+            return 2 * nr + 1 if nr < rem else nr + rem
+
         mask = 1
         while mask < pof2:
-            partner = newrank ^ mask
+            partner = real_rank(newrank ^ mask)
 
-            def fold(state, partner=partner, key=f"m{mask}"):
+            def fold(state, lower=(newrank ^ mask) < newrank,
+                     key=f"m{mask}"):
                 recv = as_acc(state, key)
                 acc = state["acc"]
                 state["acc"] = np.asarray(
-                    op.host(recv, acc) if partner < newrank
-                    else op.host(acc, recv))
+                    op.host(recv, acc) if lower else op.host(acc, recv))
 
             rounds.append(Round(sends=(((lambda s: s["acc"]), partner),),
                                 recvs=((partner, f"m{mask}"),),
                                 compute=fold))
             mask <<= 1
-        if rank < rem:
-            rounds.append(Round(sends=(((lambda s: s["acc"]), rank + pof2),)))
+        if rank < 2 * rem:
+            rounds.append(Round(sends=(((lambda s: s["acc"]), rank - 1),)))
     return _launch(comm, rounds, lambda s: s["acc"], "iallreduce",
                    state={"acc": mine})
 

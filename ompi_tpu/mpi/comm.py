@@ -343,6 +343,55 @@ class Communicator:
         return Communicator(grp, cid, self.pml, self._world_rank,
                             name or f"{self.name}.split({color})")
 
+    # -- topologies (≈ ompi_communicator_t.c_topo; see ompi_tpu.mpi.topo) --
+
+    def cart_create(self, dims, periods=None, reorder: bool = False,
+                    mesh_shape=None) -> Optional["Communicator"]:
+        from ompi_tpu.mpi import topo
+
+        return topo.cart_create(self, dims, periods, reorder, mesh_shape)
+
+    def cart_sub(self, remain_dims) -> Optional["Communicator"]:
+        from ompi_tpu.mpi import topo
+
+        return topo.cart_sub(self, remain_dims)
+
+    def graph_create(self, index, edges,
+                     reorder: bool = False) -> Optional["Communicator"]:
+        from ompi_tpu.mpi import topo
+
+        return topo.graph_create(self, index, edges, reorder)
+
+    def dist_graph_create_adjacent(self, sources, destinations,
+                                   source_weights=None, dest_weights=None
+                                   ) -> "Communicator":
+        from ompi_tpu.mpi import topo
+
+        return topo.dist_graph_create_adjacent(
+            self, sources, destinations, source_weights, dest_weights)
+
+    def dist_graph_create(self, sources, degrees, destinations,
+                          weights=None) -> "Communicator":
+        from ompi_tpu.mpi import topo
+
+        return topo.dist_graph_create(self, sources, degrees, destinations,
+                                      weights)
+
+    def neighbor_allgather(self, sendbuf) -> list:
+        from ompi_tpu.mpi import topo
+
+        return topo.neighbor_allgather(self, sendbuf)
+
+    def neighbor_alltoall(self, sendparts) -> list:
+        from ompi_tpu.mpi import topo
+
+        return topo.neighbor_alltoall(self, sendparts)
+
+    def neighbor_alltoallv(self, sendparts) -> list:
+        from ompi_tpu.mpi import topo
+
+        return topo.neighbor_alltoallv(self, sendparts)
+
     def __repr__(self) -> str:
         return (f"Communicator({self.name}, rank={self.rank}/{self.size}, "
                 f"cid={self.cid})")

@@ -335,9 +335,10 @@ class PmlOb1:
                 dtype=elem_np)
             # allocate-on-match receives recover the sender's array shape
             # from the header (predefined contiguous dtypes only; derived
-            # datatypes keep the flat element stream)
+            # datatypes keep the flat element stream; 0-d sends stay 1-D —
+            # recv() has always returned at least a 1-element vector)
             shp = hdr.get("shp")
-            if (datatype is None and shp is not None
+            if (datatype is None and shp
                     and int(np.prod(shp)) == n_elems):
                 out = out.reshape(shp)
         else:

@@ -87,6 +87,24 @@ def test_allreduce_algorithms(n, algo):
         var_registry.set("coll_host_allreduce_algorithm", "")
 
 
+@pytest.mark.parametrize("n", [2, 3, 5, 6, 7])
+def test_allreduce_noncommutative_rank_order(n):
+    """Non-pof2 sizes exercise the adjacent-pair pre-fold: the result must
+    still be the rank-ordered product (regression: the old remainder fold
+    combined rank r with rank r+pof2, breaking order on sizes 3/5/6/7)."""
+    matmul = op_mod.create_op(lambda a, b: a @ b, commutative=False)
+
+    def fn(comm):
+        return comm.allreduce(_rank_matrix(comm.rank), op=matmul)
+
+    res = run_ranks(n, fn)
+    want = _rank_matrix(0)
+    for r in range(1, n):
+        want = want @ _rank_matrix(r)
+    for out in res:
+        np.testing.assert_allclose(out, want)
+
+
 @pytest.mark.parametrize("op,npop", [(op_mod.MAX, np.maximum),
                                      (op_mod.MIN, np.minimum),
                                      (op_mod.PROD, np.multiply)])

@@ -52,6 +52,26 @@ def test_iallreduce_matches_blocking():
     run_ranks(N, body)
 
 
+def test_iallreduce_noncommutative_nonpof2():
+    """Regression: the remainder pre-fold must keep rank order (sizes 3/5/6
+    previously folded rank r with r+pof2, breaking non-commutative ops)."""
+    matmul = op_mod.create_op(lambda a, b: a @ b, commutative=False)
+
+    def mat(r):
+        return np.array([[1.0, r + 1], [0.0, 1.0]])
+
+    for n in (3, 5, 6):
+        def body(comm):
+            return comm.iallreduce(mat(comm.rank), op=matmul).wait()
+
+        res = run_ranks(n, body)
+        want = mat(0)
+        for r in range(1, n):
+            want = want @ mat(r)
+        for out in res:
+            np.testing.assert_allclose(out, want)
+
+
 def test_iallreduce_nonpof2():
     def body(comm):
         out = comm.iallreduce(np.array([float(comm.rank)]), op_mod.MAX).wait()
