@@ -1,0 +1,664 @@
+"""MPI-IO: file handles, views, individual/collective/shared access.
+
+≈ the reference's ``io`` framework — the native OMPIO implementation
+(ompi/mca/io/ompio + ompi/mca/common/ompio's file-view and read/write
+engine) with its sub-frameworks collapsed into one module:
+
+- fs (open/close/delete; fs/ufs)            → :meth:`File.open` etc.
+- fbtl (posix data movement)                → pread/pwrite on the fd
+- fcoll (collective two-phase;
+  fcoll/two_phase + dynamic)                → :meth:`File.write_at_all`
+- sharedfp (shared file pointer;
+  sharedfp/lockedfile + sm)                 → :meth:`File.write_shared`
+
+File *views* (MPI_File_set_view: displacement + etype + filetype) reuse the
+datatype engine: a filetype's compiled byte segments tile the file, and the
+view maps a contiguous etype stream onto the holes — the same descriptor
+walk the reference's common_ompio file-view engine does, vectorized over
+runs instead of a per-byte loop.
+
+Device arrays are accepted everywhere and staged through host memory
+(``np.asarray``); sharded-array checkpoint IO has its own orbax-style fast
+path in ompi_tpu.ckpt, which is the TPU-native answer to parallel IO of
+array data.
+
+Two-phase collective IO: every rank is an aggregator for an equal
+contiguous file domain (the reference's default: one aggregator per node,
+cb_buffer_size domains).  Requests are exchanged with alltoallv, aggregated
+into large contiguous pread/pwrite calls, and routed back — turning N
+small strided accesses into a few big sequential ones.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from ompi_tpu.core.config import VarType, register_var
+from ompi_tpu.mpi import datatype as dt_mod
+from ompi_tpu.mpi.constants import MPIException
+from ompi_tpu.mpi.datatype import Datatype
+from ompi_tpu.mpi.request import CompletedRequest, Request
+
+__all__ = [
+    "File", "FileView",
+    "MODE_RDONLY", "MODE_WRONLY", "MODE_RDWR", "MODE_CREATE", "MODE_EXCL",
+    "MODE_APPEND", "MODE_DELETE_ON_CLOSE", "SEEK_SET", "SEEK_CUR", "SEEK_END",
+]
+
+# amode flags (values mirror MPI's spirit, not its ABI)
+MODE_RDONLY = 0x01
+MODE_WRONLY = 0x02
+MODE_RDWR = 0x04
+MODE_CREATE = 0x08
+MODE_EXCL = 0x10
+MODE_APPEND = 0x20
+MODE_DELETE_ON_CLOSE = 0x40
+
+SEEK_SET = 0
+SEEK_CUR = 1
+SEEK_END = 2
+
+register_var("io", "twophase", VarType.BOOL, True,
+             "use two-phase aggregation for collective IO "
+             "(False: collective calls run as independent IO + barrier)")
+register_var("io", "twophase_min_bytes", VarType.SIZE, 1,
+             "minimum total bytes before two-phase aggregation kicks in")
+
+# shared-file-pointer serialization for in-process ranks (threads share the
+# process, so fcntl locks alone can't order them); keyed by realpath
+_shfp_locks: dict[str, threading.Lock] = {}
+_shfp_registry_lock = threading.Lock()
+
+
+def _shfp_lock(path: str) -> threading.Lock:
+    with _shfp_registry_lock:
+        return _shfp_locks.setdefault(path, threading.Lock())
+
+
+class FileView:
+    """displacement + etype + filetype (MPI_File_set_view).
+
+    The filetype tiles the file starting at ``disp``; its payload byte runs
+    (``segments()``) are the accessible holes.  Positions/counts are in
+    etype units, as the MPI spec requires.
+    """
+
+    def __init__(self, disp: int = 0,
+                 etype: Datatype = dt_mod.BYTE,
+                 filetype: Optional[Datatype] = None) -> None:
+        if filetype is None:
+            filetype = etype
+        if filetype.size % etype.size:
+            raise MPIException(
+                f"filetype size {filetype.size} not a multiple of etype "
+                f"size {etype.size}", error_class=3)
+        self.disp = int(disp)
+        self.etype = etype
+        self.filetype = filetype
+        self._runs = filetype.segments()     # payload runs per tile
+        self._tile_bytes = filetype.size     # payload bytes per tile
+        self._tile_extent = filetype.extent  # file bytes spanned per tile
+        # prefix sums of run lengths for payload→file mapping
+        self._run_starts = np.array([r[0] for r in self._runs], np.int64)
+        self._run_lens = np.array([r[1] for r in self._runs], np.int64)
+        self._run_cum = np.concatenate(
+            [[0], np.cumsum(self._run_lens)]).astype(np.int64)
+
+    @property
+    def contiguous(self) -> bool:
+        return (len(self._runs) == 1 and self._runs[0][0] == 0
+                and self._tile_bytes == self._tile_extent)
+
+    def payload_bytes_up_to(self, file_size: int) -> int:
+        """How many payload bytes the view exposes below `file_size` — the
+        inverse mapping needed by SEEK_END."""
+        avail = file_size - self.disp
+        if avail <= 0:
+            return 0
+        if self.contiguous:
+            return avail
+        tiles, within = divmod(avail, self._tile_extent)
+        pay = tiles * self._tile_bytes
+        for off, ln in self._runs:
+            if within <= off:
+                break
+            pay += min(ln, within - off)
+        return pay
+
+    def byte_runs(self, offset_etypes: int, nbytes: int
+                  ) -> list[tuple[int, int]]:
+        """File (offset, length) runs covering `nbytes` of payload starting
+        at view position `offset_etypes` — the descriptor walk."""
+        start = offset_etypes * self.etype.size
+        if nbytes <= 0:
+            return []
+        if self.contiguous:
+            return [(self.disp + start, nbytes)]
+        out: list[tuple[int, int]] = []
+        pos = start                      # payload byte cursor
+        end = start + nbytes
+        while pos < end:
+            tile, within = divmod(pos, self._tile_bytes)
+            # find the run containing payload byte `within`
+            ri = int(np.searchsorted(self._run_cum, within, "right")) - 1
+            run_off = within - int(self._run_cum[ri])
+            take = min(int(self._run_lens[ri]) - run_off, end - pos)
+            fpos = (self.disp + tile * self._tile_extent
+                    + int(self._run_starts[ri]) + run_off)
+            if out and out[-1][0] + out[-1][1] == fpos:
+                out[-1] = (out[-1][0], out[-1][1] + take)
+            else:
+                out.append((fpos, take))
+            pos += take
+        return out
+
+
+def _coalesce(runs: list[tuple[int, int, bytes]]
+              ) -> list[tuple[int, bytes]]:
+    """Merge byte runs into maximal contiguous writes (stable sort keeps
+    rank order on equal offsets; overlapping writes without atomicity are
+    erroneous in MPI, so adjacency is the only case that matters)."""
+    runs = sorted(runs, key=lambda r: r[0])
+    out: list[tuple[int, bytearray]] = []
+    for off, ln, data in runs:
+        if out and out[-1][0] + len(out[-1][1]) == off:
+            out[-1][1].extend(data[:ln])
+        else:
+            out.append((off, bytearray(data[:ln])))
+    return [(o, bytes(b)) for o, b in out]
+
+
+class File:
+    """An open MPI file handle (≈ ompi_file_t + the ompio module state)."""
+
+    def __init__(self, comm, path: str, amode: int) -> None:
+        self.comm = comm
+        self.path = os.path.abspath(path)
+        self.amode = amode
+        self.view = FileView()
+        self._pos = 0                    # individual pointer, etype units
+        self._atomicity = False
+        self._closed = False
+        self._io_lock = threading.Lock()
+        flags = os.O_RDWR if amode & (MODE_RDWR | MODE_WRONLY) else os.O_RDONLY
+        # MPI_MODE_WRONLY still needs reads for read-modify on views; POSIX
+        # O_WRONLY would break pread — open RDWR and gate in software
+        if amode & MODE_CREATE:
+            flags |= os.O_CREAT
+        if amode & MODE_EXCL:
+            # EXCL is a *collective* exists-check: rank 0 does the
+            # exclusive create and broadcasts the outcome (a plain barrier
+            # would hang the others if rank 0's open fails), then the rest
+            # open the now-existing file
+            err = ""
+            if comm.rank == 0:
+                try:
+                    self._fd = os.open(self.path, flags | os.O_EXCL, 0o644)
+                except OSError as e:
+                    err = str(e)
+            ok = comm.bcast(np.array([0 if err else 1], np.int8), root=0)
+            if not int(np.asarray(ok)[0]):
+                raise MPIException(
+                    f"MPI_File_open({path}): "
+                    f"{err or 'exclusive create failed on rank 0'}",
+                    error_class=38)
+            if comm.rank != 0:
+                try:
+                    self._fd = os.open(self.path, flags & ~os.O_CREAT)
+                except OSError as e:
+                    raise MPIException(f"MPI_File_open({path}): {e}",
+                                       error_class=38) from None
+        else:
+            try:
+                self._fd = os.open(self.path, flags, 0o644)
+            except OSError as e:
+                raise MPIException(f"MPI_File_open({path}): {e}",
+                                   error_class=38) from None
+        if amode & MODE_APPEND:
+            self._pos = os.fstat(self._fd).st_size // self.view.etype.size
+        # shared pointer sidecar: rank 0 resets it (to EOF under APPEND —
+        # MPI requires *all* pointers to start at end of file), then sync
+        self._shfp_path = self.path + ".ompi_tpu_shfp"
+        if comm.rank == 0:
+            with open(self._shfp_path, "wb") as f:
+                f.write(int(self._pos if amode & MODE_APPEND else 0
+                            ).to_bytes(8, "big"))
+        comm.barrier()
+
+    # -- fs framework ------------------------------------------------------
+
+    @classmethod
+    def open(cls, comm, path: str, amode: int = MODE_RDONLY) -> "File":
+        """≈ MPI_File_open — collective over comm."""
+        if amode & MODE_RDONLY and amode & (MODE_WRONLY | MODE_RDWR):
+            raise MPIException("RDONLY combined with write mode",
+                               error_class=3)
+        return cls(comm, path, amode)
+
+    def close(self) -> None:
+        """≈ MPI_File_close — collective."""
+        if self._closed:
+            return
+        self.sync()
+        self.comm.barrier()
+        os.close(self._fd)
+        self._closed = True
+        if self.comm.rank == 0:
+            try:
+                os.unlink(self._shfp_path)
+            except OSError:
+                pass
+            if self.amode & MODE_DELETE_ON_CLOSE:
+                try:
+                    os.unlink(self.path)
+                except OSError:
+                    pass
+        self.comm.barrier()
+
+    @staticmethod
+    def delete(path: str) -> None:
+        """≈ MPI_File_delete — local."""
+        try:
+            os.unlink(path)
+        except OSError as e:
+            raise MPIException(f"MPI_File_delete({path}): {e}",
+                               error_class=38) from None
+
+    def set_size(self, size: int) -> None:
+        """≈ MPI_File_set_size — collective."""
+        self._check_open()
+        if self.comm.rank == 0:
+            os.ftruncate(self._fd, size)
+        self.comm.barrier()
+
+    def preallocate(self, size: int) -> None:
+        """≈ MPI_File_preallocate — collective (grow-only truncate)."""
+        self._check_open()
+        if self.comm.rank == 0 and os.fstat(self._fd).st_size < size:
+            os.ftruncate(self._fd, size)
+        self.comm.barrier()
+
+    def get_size(self) -> int:
+        self._check_open()
+        return os.fstat(self._fd).st_size
+
+    def sync(self) -> None:
+        """≈ MPI_File_sync."""
+        self._check_open()
+        os.fsync(self._fd)
+
+    def set_atomicity(self, flag: bool) -> None:
+        self._atomicity = bool(flag)
+
+    def get_atomicity(self) -> bool:
+        return self._atomicity
+
+    # -- view --------------------------------------------------------------
+
+    def set_view(self, disp: int = 0, etype: Datatype = dt_mod.BYTE,
+                 filetype: Optional[Datatype] = None) -> None:
+        """≈ MPI_File_set_view — collective; resets both file pointers."""
+        self._check_open()
+        self.view = FileView(disp, etype, filetype)
+        self._pos = 0
+        self._shfp_store(0)
+        self.comm.barrier()
+
+    def get_view(self) -> tuple[int, Datatype, Datatype]:
+        return self.view.disp, self.view.etype, self.view.filetype
+
+    # -- individual IO (fbtl/posix equivalent) -----------------------------
+
+    def _check_open(self) -> None:
+        if self._closed:
+            raise MPIException("file is closed", error_class=38)
+
+    def _check_read(self) -> None:
+        self._check_open()
+        if self.amode & MODE_WRONLY:
+            raise MPIException("file opened write-only", error_class=38)
+
+    def _check_write(self) -> None:
+        self._check_open()
+        if not self.amode & (MODE_WRONLY | MODE_RDWR):
+            raise MPIException("file opened read-only", error_class=38)
+
+    def _as_bytes(self, data: Any) -> bytes:
+        arr = np.asarray(data)
+        want = self.view.etype.base_np
+        if arr.dtype != want:
+            arr = arr.astype(want)
+        return np.ascontiguousarray(arr).tobytes()
+
+    def _from_bytes(self, raw: bytes) -> np.ndarray:
+        et = self.view.etype.base_np
+        n = len(raw) // et.itemsize
+        return np.frombuffer(bytearray(raw[:n * et.itemsize]),
+                             dtype=et).copy()
+
+    def read_at(self, offset: int, count: int) -> np.ndarray:
+        """≈ MPI_File_read_at — offset/count in etype units of the view."""
+        self._check_read()
+        runs = self.view.byte_runs(offset, count * self.view.etype.size)
+        chunks = [os.pread(self._fd, ln, off) for off, ln in runs]
+        return self._from_bytes(b"".join(chunks))
+
+    def write_at(self, offset: int, data: Any) -> int:
+        """≈ MPI_File_write_at — returns etypes written."""
+        self._check_write()
+        return self._write_raw_at(offset, self._as_bytes(data))
+
+    def _write_raw_at(self, offset: int, raw: bytes) -> int:
+        runs = self.view.byte_runs(offset, len(raw))
+        pos = 0
+        for off, ln in runs:
+            os.pwrite(self._fd, raw[pos:pos + ln], off)
+            pos += ln
+        return len(raw) // self.view.etype.size
+
+    def _etypes_of(self, out: np.ndarray) -> int:
+        """Etype count of a just-read element array (pointers advance in
+        etype units, not base elements — they differ for derived etypes)."""
+        return out.nbytes // self.view.etype.size
+
+    def read(self, count: int) -> np.ndarray:
+        """≈ MPI_File_read — individual pointer."""
+        with self._io_lock:
+            out = self.read_at(self._pos, count)
+            self._pos += self._etypes_of(out)
+        return out
+
+    def write(self, data: Any) -> int:
+        """≈ MPI_File_write — individual pointer."""
+        with self._io_lock:
+            n = self.write_at(self._pos, data)
+            self._pos += n
+        return n
+
+    def seek(self, offset: int, whence: int = SEEK_SET) -> None:
+        """≈ MPI_File_seek (etype units)."""
+        with self._io_lock:
+            if whence == SEEK_SET:
+                self._pos = offset
+            elif whence == SEEK_CUR:
+                self._pos += offset
+            elif whence == SEEK_END:
+                self._pos = self.view.payload_bytes_up_to(
+                    self.get_size()) // self.view.etype.size + offset
+            else:
+                raise MPIException(f"bad whence {whence}", error_class=3)
+
+    def get_position(self) -> int:
+        return self._pos
+
+    # nonblocking variants: IO here is host-side and synchronous; MPI allows
+    # immediate completion, so these return pre-completed requests (the
+    # reference's ompio equally runs most iread/iwrite inline via progress)
+
+    def iread_at(self, offset: int, count: int) -> Request:
+        return CompletedRequest(self.read_at(offset, count), kind="iread")
+
+    def iwrite_at(self, offset: int, data: Any) -> Request:
+        return CompletedRequest(self.write_at(offset, data), kind="iwrite")
+
+    def iread(self, count: int) -> Request:
+        return CompletedRequest(self.read(count), kind="iread")
+
+    def iwrite(self, data: Any) -> Request:
+        return CompletedRequest(self.write(data), kind="iwrite")
+
+    # -- collective IO (fcoll/two_phase equivalent) ------------------------
+
+    def _two_phase_enabled(self, nbytes: int) -> bool:
+        from ompi_tpu.core.config import var_registry
+
+        if not var_registry.get("io_twophase"):
+            return False
+        total = self.comm.allreduce(np.array([nbytes], np.int64))
+        return int(np.asarray(total)[0]) >= int(
+            var_registry.get("io_twophase_min_bytes"))
+
+    def write_at_all(self, offset: int, data: Any) -> int:
+        """≈ MPI_File_write_at_all — two-phase collective write."""
+        self._check_write()
+        raw = self._as_bytes(data)
+        my_runs = self.view.byte_runs(offset, len(raw))
+        if not self._two_phase_enabled(len(raw)):
+            n = self.write_at(offset, data)
+            self.comm.barrier()
+            return n
+        comm = self.comm
+        size = comm.size
+        # phase 0: agree on the global byte extent → aggregator domains
+        lo = my_runs[0][0] if my_runs else np.iinfo(np.int64).max
+        hi = my_runs[-1][0] + my_runs[-1][1] if my_runs else 0
+        ext = np.asarray(comm.allgather(np.array([lo, hi], np.int64)))
+        glo = int(ext[:, 0].min())
+        ghi = int(ext[:, 1].max())
+        if ghi <= glo:
+            comm.barrier()
+            return 0
+        dom = -(-(ghi - glo) // size)  # ceil: bytes per aggregator domain
+
+        def owner(off: int) -> int:
+            return min((off - glo) // dom, size - 1)
+
+        # phase 1: split my runs at domain boundaries, route to aggregators
+        meta = [[] for _ in range(size)]   # (file_off, len) per dest
+        payload = [[] for _ in range(size)]
+        pos = 0
+        for off, ln in my_runs:
+            while ln > 0:
+                o = owner(off)
+                dom_end = glo + (o + 1) * dom
+                take = min(ln, dom_end - off)
+                meta[o].append((off, take))
+                payload[o].append(raw[pos:pos + take])
+                pos += take
+                off += take
+                ln -= take
+        meta_arrs = [np.array(m, np.int64).reshape(-1, 2).ravel()
+                     for m in meta]
+        pay_arrs = [np.frombuffer(b"".join(p), np.uint8) for p in payload]
+        got_meta = comm.alltoallv(meta_arrs)
+        got_pay = comm.alltoallv(pay_arrs)
+        # phase 2: aggregate into maximal contiguous writes, rank order wins
+        agg: list[tuple[int, int, bytes]] = []
+        for r in range(size):
+            m = np.asarray(got_meta[r]).reshape(-1, 2)
+            p = np.asarray(got_pay[r], np.uint8).tobytes()
+            cur = 0
+            for foff, fln in m:
+                agg.append((int(foff), int(fln), p[cur:cur + int(fln)]))
+                cur += int(fln)
+        for off, buf in _coalesce(agg):
+            os.pwrite(self._fd, buf, off)
+        comm.barrier()
+        return len(raw) // self.view.etype.size
+
+    def read_at_all(self, offset: int, count: int) -> np.ndarray:
+        """≈ MPI_File_read_at_all — two-phase collective read."""
+        self._check_read()
+        nbytes = count * self.view.etype.size
+        my_runs = self.view.byte_runs(offset, nbytes)
+        if not self._two_phase_enabled(nbytes):
+            out = self.read_at(offset, count)
+            self.comm.barrier()
+            return out
+        comm = self.comm
+        size = comm.size
+        lo = my_runs[0][0] if my_runs else np.iinfo(np.int64).max
+        hi = my_runs[-1][0] + my_runs[-1][1] if my_runs else 0
+        ext = np.asarray(comm.allgather(np.array([lo, hi], np.int64)))
+        glo = int(ext[:, 0].min())
+        ghi = int(ext[:, 1].max())
+        if ghi <= glo:
+            comm.barrier()
+            return self._from_bytes(b"")
+        dom = -(-(ghi - glo) // size)
+
+        def owner(off: int) -> int:
+            return min((off - glo) // dom, size - 1)
+
+        # phase 1: send my run *requests* to the domain aggregators
+        meta = [[] for _ in range(size)]
+        for off, ln in my_runs:
+            while ln > 0:
+                o = owner(off)
+                dom_end = glo + (o + 1) * dom
+                take = min(ln, dom_end - off)
+                meta[o].append((off, take))
+                off += take
+                ln -= take
+        meta_arrs = [np.array(m, np.int64).reshape(-1, 2).ravel()
+                     for m in meta]
+        got_meta = comm.alltoallv(meta_arrs)
+        # phase 2: aggregators read each requested run once (coalesced
+        # pread over their domain slice), reply per requester
+        replies = []
+        for r in range(size):
+            m = np.asarray(got_meta[r]).reshape(-1, 2)
+            if len(m):
+                span_lo = int(m[:, 0].min())
+                span_hi = int((m[:, 0] + m[:, 1]).max())
+                blob = os.pread(self._fd, span_hi - span_lo, span_lo)
+                parts = [blob[int(o) - span_lo:int(o) - span_lo + int(l)]
+                         for o, l in m]
+                replies.append(np.frombuffer(b"".join(parts), np.uint8))
+            else:
+                replies.append(np.empty(0, np.uint8))
+        got_pay = comm.alltoallv(replies)
+        # reassemble in my original run order (requests were split in
+        # ascending file order per aggregator, and aggregators preserve
+        # request order, so concatenation by aggregator sequence works)
+        blobs = [np.asarray(got_pay[r], np.uint8).tobytes()
+                 for r in range(size)]
+        cursors = [0] * size
+        out = bytearray()
+        for off, ln in my_runs:
+            o_off, o_ln = off, ln
+            while o_ln > 0:
+                o = owner(o_off)
+                dom_end = glo + (o + 1) * dom
+                take = min(o_ln, dom_end - o_off)
+                out += blobs[o][cursors[o]:cursors[o] + take]
+                cursors[o] += take
+                o_off += take
+                o_ln -= take
+        comm.barrier()
+        return self._from_bytes(bytes(out))
+
+    def write_all(self, data: Any) -> int:
+        """≈ MPI_File_write_all (individual pointer + collective)."""
+        with self._io_lock:
+            n = self.write_at_all(self._pos, data)
+            self._pos += n
+        return n
+
+    def read_all(self, count: int) -> np.ndarray:
+        """≈ MPI_File_read_all."""
+        with self._io_lock:
+            out = self.read_at_all(self._pos, count)
+            self._pos += self._etypes_of(out)
+        return out
+
+    # -- shared file pointer (sharedfp/lockedfile equivalent) --------------
+
+    def _shfp_load(self) -> int:
+        with open(self._shfp_path, "rb") as f:
+            return int.from_bytes(f.read(8), "big")
+
+    def _shfp_store(self, val: int) -> None:
+        with open(self._shfp_path, "wb") as f:
+            f.write(int(val).to_bytes(8, "big"))
+
+    def _shfp_fetch_add(self, n: int) -> int:
+        """Atomically reserve n etypes of the shared pointer."""
+        import fcntl
+
+        with _shfp_lock(self._shfp_path):
+            with open(self._shfp_path, "r+b") as f:
+                fcntl.lockf(f, fcntl.LOCK_EX)
+                try:
+                    cur = int.from_bytes(f.read(8), "big")
+                    f.seek(0)
+                    f.write((cur + n).to_bytes(8, "big"))
+                    f.flush()
+                finally:
+                    fcntl.lockf(f, fcntl.LOCK_UN)
+        return cur
+
+    def read_shared(self, count: int) -> np.ndarray:
+        """≈ MPI_File_read_shared."""
+        self._check_read()  # before reserving: a failed call must not
+        start = self._shfp_fetch_add(count)  # advance the shared pointer
+        return self.read_at(start, count)
+
+    def write_shared(self, data: Any) -> int:
+        """≈ MPI_File_write_shared."""
+        self._check_write()
+        raw = self._as_bytes(data)
+        n = len(raw) // self.view.etype.size
+        start = self._shfp_fetch_add(n)
+        self._write_raw_at(start, raw)
+        return n
+
+    def seek_shared(self, offset: int, whence: int = SEEK_SET) -> None:
+        """≈ MPI_File_seek_shared — collective (all must give same args)."""
+        self._check_open()
+        if whence == SEEK_CUR:
+            offset += self._shfp_load()
+        elif whence == SEEK_END:
+            offset += self.view.payload_bytes_up_to(
+                self.get_size()) // self.view.etype.size
+        elif whence != SEEK_SET:
+            raise MPIException(f"bad whence {whence}", error_class=3)
+        if self.comm.rank == 0:
+            self._shfp_store(offset)
+        self.comm.barrier()
+
+    def get_position_shared(self) -> int:
+        return self._shfp_load()
+
+    # ordered mode: rank-ordered slots computed with an exscan of sizes
+
+    def write_ordered(self, data: Any) -> int:
+        """≈ MPI_File_write_ordered — collective, rank order in file."""
+        self._check_write()
+        raw = self._as_bytes(data)
+        n = len(raw) // self.view.etype.size
+        sizes = np.asarray(self.comm.allgather(np.array([n], np.int64)))
+        base = self._shfp_load()
+        my_off = base + int(sizes[:self.comm.rank].sum())
+        self._write_raw_at(my_off, raw)
+        self.comm.barrier()
+        if self.comm.rank == 0:
+            self._shfp_store(base + int(sizes.sum()))
+        self.comm.barrier()
+        return n
+
+    def read_ordered(self, count: int) -> np.ndarray:
+        """≈ MPI_File_read_ordered."""
+        self._check_read()
+        sizes = np.asarray(self.comm.allgather(np.array([count], np.int64)))
+        base = self._shfp_load()
+        my_off = base + int(sizes[:self.comm.rank].sum())
+        out = self.read_at(my_off, count)
+        self.comm.barrier()
+        if self.comm.rank == 0:
+            self._shfp_store(base + int(sizes.sum()))
+        self.comm.barrier()
+        return out
+
+    def __enter__(self) -> "File":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __repr__(self) -> str:
+        return f"File({self.path!r}, amode={self.amode:#x})"

@@ -1,0 +1,416 @@
+"""MPI-IO tests — views, individual/collective/shared/ordered access.
+
+≈ the reference's OMPIO coverage (file views via datatypes, two-phase
+collective IO, shared file pointers) validated against plain-file ground
+truth, the way test/datatype validates pack/unpack against memcpy.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ompi_tpu.core.config import var_registry
+from ompi_tpu.mpi import datatype as dt
+from ompi_tpu.mpi import io as mio
+from ompi_tpu.mpi.constants import MPIException
+from tests.mpi.harness import run_ranks
+
+
+# ---------------------------------------------------------------------------
+# FileView (pure mapping logic)
+# ---------------------------------------------------------------------------
+
+def test_view_contiguous_bytes():
+    v = mio.FileView(disp=10)
+    assert v.contiguous
+    assert v.byte_runs(5, 7) == [(15, 7)]
+
+
+def test_view_etype_units():
+    v = mio.FileView(disp=0, etype=dt.FLOAT64)
+    assert v.byte_runs(2, 16) == [(16, 16)]
+
+
+def test_view_strided_filetype():
+    """vector(2 blocks of 2 int32, stride 4) resized to 32B tiles the file:
+    payload runs [0,8) and [16,24) per tile."""
+    ft = dt.INT32.vector(2, 2, 4).commit()
+    v = mio.FileView(disp=0, etype=dt.INT32, filetype=ft)
+    assert not v.contiguous
+    # first tile: 4 etypes → bytes 0-8 (run 1) and 16-24 (run 2)
+    assert v.byte_runs(0, 8) == [(0, 8)]
+    assert v.byte_runs(0, 16) == [(0, 8), (16, 8)]
+    # natural extent is 24B, so tile 2's first run (24,8) merges with (16,8)
+    assert v.byte_runs(2, 16) == [(16, 16)]
+    # an explicit resize to 32B keeps the tiles apart
+    v32 = mio.FileView(disp=0, etype=dt.INT32,
+                       filetype=ft.resized(32).commit())
+    assert v32.byte_runs(2, 16) == [(16, 8), (32, 8)]
+
+
+def test_view_rejects_partial_etype():
+    ft = dt.INT32.contiguous(3).commit()
+    with pytest.raises(MPIException):
+        mio.FileView(etype=dt.FLOAT64, filetype=ft)  # 12 % 8 != 0
+
+
+# ---------------------------------------------------------------------------
+# individual IO
+# ---------------------------------------------------------------------------
+
+def test_open_write_read_roundtrip(tmp_path):
+    path = str(tmp_path / "a.dat")
+
+    def body(comm):
+        f = mio.File.open(comm, path,
+                          mio.MODE_RDWR | mio.MODE_CREATE)
+        f.set_view(0, dt.FLOAT64)
+        f.write_at(comm.rank * 4, np.full(4, float(comm.rank)))
+        f.close()
+        f2 = mio.File.open(comm, path)
+        f2.set_view(0, dt.FLOAT64)
+        out = f2.read_at(0, 4 * comm.size)
+        f2.close()
+        return out
+
+    for out in run_ranks(3, body):
+        want = np.repeat(np.arange(3.0), 4)
+        np.testing.assert_array_equal(out, want)
+
+
+def test_individual_pointer_and_seek(tmp_path):
+    path = str(tmp_path / "b.dat")
+
+    def body(comm):
+        f = mio.File.open(comm, path, mio.MODE_RDWR | mio.MODE_CREATE)
+        f.set_view(0, dt.INT32)
+        if comm.rank == 0:
+            f.write(np.arange(10, dtype=np.int32))
+            assert f.get_position() == 10
+            f.seek(2)
+            got = f.read(3)
+            np.testing.assert_array_equal(got, [2, 3, 4])
+            f.seek(-2, mio.SEEK_CUR)
+            assert f.get_position() == 3
+            f.seek(0, mio.SEEK_END)
+            assert f.get_position() == 10
+        comm.barrier()
+        f.close()
+
+    run_ranks(2, body)
+
+
+def test_strided_view_write_read(tmp_path):
+    """Each rank writes its own interleaved column through a strided view
+    (the canonical MPI-IO pattern: rank r owns every size-th block)."""
+    path = str(tmp_path / "c.dat")
+    n = 3      # ranks
+    bl = 2     # ints per block
+
+    def body(comm):
+        ft = dt.INT32.vector(1, bl, bl * n).resized(
+            bl * n * 4).commit()
+        f = mio.File.open(comm, path, mio.MODE_RDWR | mio.MODE_CREATE)
+        f.set_view(comm.rank * bl * 4, dt.INT32, ft)
+        data = np.arange(4 * bl, dtype=np.int32) + 100 * comm.rank
+        f.write_at(0, data)
+        f.close()
+        return None
+
+    run_ranks(n, body)
+    # ground truth: blocks interleave rank-major
+    raw = np.fromfile(path, dtype=np.int32)
+    want = []
+    for blk in range(4):
+        for r in range(n):
+            want.extend(np.arange(blk * bl, blk * bl + bl) + 100 * r)
+    np.testing.assert_array_equal(raw, np.array(want, dtype=np.int32))
+
+
+def test_read_write_mode_guards(tmp_path):
+    path = str(tmp_path / "d.dat")
+
+    def body(comm):
+        f = mio.File.open(comm, path, mio.MODE_CREATE | mio.MODE_WRONLY)
+        try:
+            f.read_at(0, 1)
+        except MPIException:
+            ok1 = True
+        else:
+            ok1 = False
+        f.close()
+        f2 = mio.File.open(comm, path, mio.MODE_RDONLY)
+        try:
+            f2.write_at(0, np.zeros(1, np.uint8))
+        except MPIException:
+            ok2 = True
+        else:
+            ok2 = False
+        f2.close()
+        return ok1 and ok2
+
+    assert all(run_ranks(2, body))
+
+
+def test_excl_create(tmp_path):
+    path = str(tmp_path / "e.dat")
+
+    def body(comm):
+        f = mio.File.open(comm, path, mio.MODE_CREATE | mio.MODE_EXCL
+                          | mio.MODE_RDWR)
+        f.close()
+        # second EXCL open must fail collectively
+        try:
+            mio.File.open(comm, path, mio.MODE_CREATE | mio.MODE_EXCL
+                          | mio.MODE_RDWR)
+        except MPIException:
+            return True
+        return False
+
+    assert all(run_ranks(3, body))
+
+
+def test_delete_on_close_and_set_size(tmp_path):
+    import os
+
+    path = str(tmp_path / "f.dat")
+
+    def body(comm):
+        f = mio.File.open(comm, path, mio.MODE_CREATE | mio.MODE_RDWR
+                          | mio.MODE_DELETE_ON_CLOSE)
+        f.set_size(128)
+        assert f.get_size() == 128
+        f.preallocate(64)            # grow-only: no shrink
+        assert f.get_size() == 128
+        f.close()
+        return os.path.exists(path)
+
+    assert not any(run_ranks(2, body))
+
+
+# ---------------------------------------------------------------------------
+# collective two-phase IO
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("twophase", [True, False])
+def test_write_at_all_interleaved(tmp_path, twophase):
+    path = str(tmp_path / f"g{twophase}.dat")
+    var_registry.set("io_twophase", twophase)
+    try:
+        n = 4
+
+        def body(comm):
+            ft = dt.FLOAT32.vector(1, 2, 2 * n).resized(2 * n * 4).commit()
+            f = mio.File.open(comm, path, mio.MODE_RDWR | mio.MODE_CREATE)
+            f.set_view(comm.rank * 8, dt.FLOAT32, ft)
+            data = (np.arange(6, dtype=np.float32)
+                    + 10 * comm.rank)
+            f.write_at_all(0, data)
+            out = f.read_at_all(0, 6)
+            f.close()
+            return out
+
+        results = run_ranks(n, body)
+        for r, out in enumerate(results):
+            np.testing.assert_array_equal(
+                out, np.arange(6, dtype=np.float32) + 10 * r)
+        raw = np.fromfile(path, dtype=np.float32)
+        want = []
+        for blk in range(3):
+            for r in range(n):
+                want.extend(np.arange(blk * 2, blk * 2 + 2) + 10 * r)
+        np.testing.assert_array_equal(raw, np.array(want, np.float32))
+    finally:
+        var_registry.set("io_twophase", True)
+
+
+def test_write_all_with_pointer(tmp_path):
+    path = str(tmp_path / "h.dat")
+
+    def body(comm):
+        f = mio.File.open(comm, path, mio.MODE_RDWR | mio.MODE_CREATE)
+        f.set_view(0, dt.INT64)
+        f.seek(comm.rank * 3)
+        f.write_all(np.arange(3, dtype=np.int64) + 100 * comm.rank)
+        f.close()
+
+    run_ranks(3, body)
+    raw = np.fromfile(path, dtype=np.int64)
+    want = np.concatenate([np.arange(3) + 100 * r for r in range(3)])
+    np.testing.assert_array_equal(raw, want)
+
+
+def test_collective_read_uneven(tmp_path):
+    """Ranks request different, partially empty extents collectively."""
+    path = str(tmp_path / "i.dat")
+    base = np.arange(32, dtype=np.float64)
+    base.tofile(path)
+
+    def body(comm):
+        f = mio.File.open(comm, path)
+        f.set_view(0, dt.FLOAT64)
+        count = [0, 5, 27][comm.rank]
+        off = [0, 0, 5][comm.rank]
+        out = f.read_at_all(off, count)
+        f.close()
+        return out
+
+    r0, r1, r2 = run_ranks(3, body)
+    assert len(r0) == 0
+    np.testing.assert_array_equal(r1, base[:5])
+    np.testing.assert_array_equal(r2, base[5:])
+
+
+# ---------------------------------------------------------------------------
+# shared / ordered pointers
+# ---------------------------------------------------------------------------
+
+def test_write_shared_disjoint(tmp_path):
+    path = str(tmp_path / "j.dat")
+
+    def body(comm):
+        f = mio.File.open(comm, path, mio.MODE_RDWR | mio.MODE_CREATE)
+        f.set_view(0, dt.INT32)
+        f.write_shared(np.full(4, comm.rank, np.int32))
+        comm.barrier()
+        pos = f.get_position_shared()
+        f.close()
+        return pos
+
+    results = run_ranks(4, body)
+    assert all(p == 16 for p in results)
+    raw = np.fromfile(path, dtype=np.int32)
+    # every rank's block lands somewhere, intact and disjoint
+    assert sorted(raw.reshape(4, 4)[:, 0]) == [0, 1, 2, 3]
+    for row in raw.reshape(4, 4):
+        assert (row == row[0]).all()
+
+
+def test_write_ordered_rank_order(tmp_path):
+    path = str(tmp_path / "k.dat")
+
+    def body(comm):
+        f = mio.File.open(comm, path, mio.MODE_RDWR | mio.MODE_CREATE)
+        f.set_view(0, dt.INT32)
+        f.write_ordered(np.full(2 + comm.rank, comm.rank, np.int32))
+        out = None
+        if comm.rank == 0:
+            out = f.read_at(0, 2 + 3 + 4)
+        f.close()
+        return out
+
+    results = run_ranks(3, body)
+    want = np.array([0, 0, 1, 1, 1, 2, 2, 2, 2], np.int32)
+    np.testing.assert_array_equal(results[0], want)
+
+
+def test_read_ordered(tmp_path):
+    path = str(tmp_path / "l.dat")
+    np.arange(9, dtype=np.int32).tofile(path)
+
+    def body(comm):
+        f = mio.File.open(comm, path)
+        f.set_view(0, dt.INT32)
+        out = f.read_ordered(3)
+        f.close()
+        return out
+
+    results = run_ranks(3, body)
+    for r, out in enumerate(results):
+        np.testing.assert_array_equal(out, np.arange(r * 3, r * 3 + 3))
+
+
+def test_derived_etype_pointer_advance(tmp_path):
+    """Pointers advance in *etype* units: a 2-int32 etype read of 2 etypes
+    returns 4 base elements but moves the pointer by 2 (regression)."""
+    path = str(tmp_path / "n.dat")
+    np.arange(12, dtype=np.int32).tofile(path)
+
+    def body(comm):
+        et = dt.INT32.contiguous(2).commit()
+        f = mio.File.open(comm, path)
+        f.set_view(0, et)
+        out = f.read(2)
+        pos = f.get_position()
+        out2 = f.read(1)
+        f.close()
+        return out, pos, out2
+
+    out, pos, out2 = run_ranks(1, body)[0]
+    np.testing.assert_array_equal(out, [0, 1, 2, 3])
+    assert pos == 2
+    np.testing.assert_array_equal(out2, [4, 5])
+
+
+def test_seek_end_strided_view(tmp_path):
+    """SEEK_END maps file size through the view: a 96-byte file with 8
+    payload bytes per 24-byte tile holds 8 etypes, not 24 (regression)."""
+    path = str(tmp_path / "o.dat")
+    np.zeros(24, dtype=np.int32).tofile(path)  # 96 bytes
+
+    def body(comm):
+        ft = dt.INT32.vector(1, 2, 6).resized(24).commit()
+        f = mio.File.open(comm, path)
+        f.set_view(0, dt.INT32, ft)
+        f.seek(0, mio.SEEK_END)
+        pos = f.get_position()
+        f.close()
+        return pos
+
+    assert run_ranks(1, body)[0] == 8
+
+
+def test_append_starts_pointers_at_eof(tmp_path):
+    """MODE_APPEND: individual AND shared pointers start at EOF, so the
+    first write_shared appends instead of overwriting (regression)."""
+    path = str(tmp_path / "p.dat")
+    np.arange(4, dtype=np.uint8).tofile(path)
+
+    def body(comm):
+        f = mio.File.open(comm, path, mio.MODE_RDWR | mio.MODE_APPEND)
+        assert f.get_position() == 4          # default byte view: EOF = 4
+        assert f.get_position_shared() == 4
+        f.write_shared(np.array([99], np.uint8))
+        f.close()
+
+    run_ranks(1, body)
+    np.testing.assert_array_equal(np.fromfile(path, dtype=np.uint8),
+                                  [0, 1, 2, 3, 99])
+
+
+def test_failed_shared_access_does_not_advance(tmp_path):
+    path = str(tmp_path / "q.dat")
+
+    def body(comm):
+        f = mio.File.open(comm, path, mio.MODE_CREATE | mio.MODE_WRONLY)
+        f.set_view(0, dt.INT32)
+        try:
+            f.read_shared(5)
+        except MPIException:
+            pass
+        pos = f.get_position_shared()
+        try:
+            f.seek_shared(3, whence=7)
+        except MPIException:
+            pass
+        pos2 = f.get_position_shared()
+        f.close()
+        return pos, pos2
+
+    assert run_ranks(1, body)[0] == (0, 0)
+
+
+def test_seek_shared(tmp_path):
+    path = str(tmp_path / "m.dat")
+    np.arange(8, dtype=np.int64).tofile(path)
+
+    def body(comm):
+        f = mio.File.open(comm, path)
+        f.set_view(0, dt.INT64)
+        f.seek_shared(4)
+        comm.barrier()
+        assert f.get_position_shared() == 4
+        f.close()
+
+    run_ranks(2, body)
