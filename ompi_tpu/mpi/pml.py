@@ -121,6 +121,16 @@ def _hdr_matches(req: RecvRequest, peer: int, hdr: dict) -> bool:
     return True
 
 
+# request-lifecycle events (≈ the PERUSE spec, ompi/peruse/peruse.h:55-76:
+# queue/xfer event hooks on the matching engine) — listeners receive
+# (event, info_dict); pml/coll/osc monitoring components subscribe here
+EVT_SEND_POST = "send_post"        # isend issued
+EVT_RECV_POST = "recv_post"        # irecv posted
+EVT_MATCH = "match"                # incoming frame matched a posted recv
+EVT_UNEXPECTED = "unexpected"      # incoming frame queued unmatched
+EVT_DELIVER = "deliver"            # payload delivered, request complete
+
+
 class PmlOb1:
     """The default PML: matching + eager/rendezvous over the BTL."""
 
@@ -135,10 +145,38 @@ class PmlOb1:
         self._ids = itertools.count(1)
         self._seq: dict[tuple[int, int], int] = {}
         self._sendq: "queue.Queue[Optional[tuple]]" = queue.Queue()
+        self._listeners: list = []   # peruse/monitoring subscribers
+        self._events: "collections.deque[tuple]" = collections.deque()
         self._worker = threading.Thread(
             target=self._send_loop, name=f"pml-send-{rank}", daemon=True)
         self._worker.start()
         self._closed = False
+
+    # -- event hooks (PERUSE equivalent) -----------------------------------
+    #
+    # _emit only enqueues; _drain_events dispatches OUTSIDE the PML lock so
+    # listeners may safely call back into the PML (and a racing
+    # remove_listener can't skip a concurrent subscriber: dispatch iterates
+    # a snapshot).  Every path that can enqueue drains before returning.
+
+    def add_listener(self, cb) -> None:
+        """Subscribe cb(event, info) to request-lifecycle events."""
+        self._listeners.append(cb)
+
+    def remove_listener(self, cb) -> None:
+        self._listeners.remove(cb)
+
+    def _emit(self, event: str, **info) -> None:
+        self._events.append((event, info))
+
+    def _drain_events(self) -> None:
+        while True:
+            try:
+                event, info = self._events.popleft()
+            except IndexError:
+                return
+            for cb in list(self._listeners):
+                cb(event, info)
 
     # -- wiring ------------------------------------------------------------
 
@@ -181,6 +219,9 @@ class PmlOb1:
                "dt": _dtype_to_wire(datatype.base_np),
                "elems": len(payload) // datatype.base_np.itemsize,
                "shp": list(arr.shape)}
+        if self._listeners:
+            self._emit(EVT_SEND_POST, peer=peer, tag=tag, cid=cid,
+                       nbytes=len(payload))
         if len(payload) <= var_registry.get("pml_eager_limit"):
             hdr["t"] = "eager"
             self._sendq.put(("frame", peer, hdr, payload, req))
@@ -190,6 +231,7 @@ class PmlOb1:
             with self._lock:
                 self._send_states[sid] = _SendState(req, peer, payload)
             self._sendq.put(("frame", peer, hdr, b"", None))
+        self._drain_events()
         return req
 
     def send(self, buf: Any, peer: int, tag: int, cid: int, **kw) -> None:
@@ -210,15 +252,22 @@ class PmlOb1:
         # the element dtype travels in the wire header
         req = RecvRequest(buf, datatype, count, source, tag, cid)
         req.rid = next(self._ids)
+        if self._listeners:
+            self._emit(EVT_RECV_POST, source=source, tag=tag, cid=cid)
         with self._lock:
             m = self._matching_for(cid)
             # try the unexpected queue first, in arrival order
             for i, (peer, hdr, payload) in enumerate(m.unexpected):
                 if _hdr_matches(req, peer, hdr):
                     del m.unexpected[i]
+                    if self._listeners:
+                        self._emit(EVT_MATCH, peer=peer, tag=hdr["tag"],
+                                   cid=hdr["cid"])
                     self._match(req, peer, hdr, payload)
-                    return req
-            m.posted.append(req)
+                    break
+            else:
+                m.posted.append(req)
+        self._drain_events()
         return req
 
     def recv(self, buf: Optional[np.ndarray], source: int, tag: int, cid: int,
@@ -273,8 +322,15 @@ class PmlOb1:
                 if req is None:
                     m.unexpected.append((peer, hdr, payload))
                     self._cv.notify_all()
-                    return
-                self._match(req, peer, hdr, payload)
+                    if self._listeners:
+                        self._emit(EVT_UNEXPECTED, peer=peer,
+                                   tag=hdr["tag"], cid=hdr["cid"])
+                else:
+                    if self._listeners:
+                        self._emit(EVT_MATCH, peer=peer, tag=hdr["tag"],
+                                   cid=hdr["cid"])
+                    self._match(req, peer, hdr, payload)
+            self._drain_events()
         elif t == "cts":
             with self._lock:
                 state = self._send_states.pop(hdr["sid"], None)
@@ -312,6 +368,7 @@ class PmlOb1:
         if done:
             self._deliver(state.req, state.peer, state.src_hdr,
                           bytes(state.data))
+            self._drain_events()
 
     def _deliver(self, req: RecvRequest, peer: int, hdr: dict,
                  payload: bytes) -> None:
@@ -345,6 +402,9 @@ class PmlOb1:
             out = req.buf
             items = len(payload) // max(1, datatype.size)
             datatype.unpack(payload, out, items)
+        if self._listeners:
+            self._emit(EVT_DELIVER, peer=peer, tag=hdr["tag"],
+                       cid=hdr["cid"], nbytes=len(payload))
         req.status.source = peer
         req.status.tag = hdr["tag"]
         elem_size = (datatype.base_np.itemsize if datatype is not None

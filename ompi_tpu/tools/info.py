@@ -24,6 +24,7 @@ _REGISTERING_MODULES = [
     "ompi_tpu.mpi.coll",
     "ompi_tpu.mpi.pml",
     "ompi_tpu.mpi.op",
+    "ompi_tpu.mpi.io",
     "ompi_tpu.shmem.api",
 ]
 
@@ -68,6 +69,17 @@ def main(argv: list[str] | None = None) -> int:
         print(f"  {var.full_name} = {var.value!r} "
               f"[{var.vtype.value}, {var.source.name.lower()}]"
               + (f"  # {var.description}" if var.description else ""))
+    from ompi_tpu.mpi.mpit import pvar_registry
+
+    names = pvar_registry.names()
+    if names:
+        print()
+        print("Performance variables (MPI_T pvars):")
+        for n in names:
+            pv = pvar_registry.lookup(n)
+            print(f"  {n} [{pv.klass.value}"
+                  + (f", {pv.unit}" if pv.unit else "") + "]"
+                  + (f"  # {pv.description}" if pv.description else ""))
     if failures:
         print("\nmodules not loaded:", file=sys.stderr)
         for f in failures:

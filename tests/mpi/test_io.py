@@ -262,6 +262,29 @@ def test_collective_read_uneven(tmp_path):
     np.testing.assert_array_equal(r2, base[5:])
 
 
+def test_collective_read_past_eof(tmp_path):
+    """Short preads at EOF must not shift later runs' bytes into earlier
+    requests (regression): ranks request beyond the end of the file and get
+    exactly the available prefix."""
+    path = str(tmp_path / "r.dat")
+    base = np.arange(10, dtype=np.float64)
+    base.tofile(path)
+
+    def body(comm):
+        f = mio.File.open(comm, path)
+        f.set_view(0, dt.FLOAT64)
+        # rank 0 asks for [0, 8), rank 1 for [8, 20) — 10 exist
+        off = [0, 8][comm.rank]
+        count = [8, 12][comm.rank]
+        out = f.read_at_all(off, count)
+        f.close()
+        return out
+
+    r0, r1 = run_ranks(2, body)
+    np.testing.assert_array_equal(r0, base[:8])
+    np.testing.assert_array_equal(r1, base[8:10])
+
+
 # ---------------------------------------------------------------------------
 # shared / ordered pointers
 # ---------------------------------------------------------------------------

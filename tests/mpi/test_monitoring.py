@@ -1,0 +1,278 @@
+"""MPI_T + monitoring tests — ≈ the reference's test/monitoring suite
+(check_monitoring.c: per-class message counts; test_pvar_access.c: pvar
+session/handle semantics) on the TPU build's event-hook design.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ompi_tpu.mpi import io as _io  # noqa: F401 — registers io_* cvars
+from ompi_tpu.mpi import monitoring as mon
+from ompi_tpu.mpi import mpit
+from ompi_tpu.mpi.constants import MPIException
+from tests.mpi.harness import run_ranks
+
+
+# ---------------------------------------------------------------------------
+# MPI_T cvars
+# ---------------------------------------------------------------------------
+
+def test_cvar_enumeration_and_read():
+    names = mpit.cvar_names()
+    assert mpit.cvar_num() == len(names) > 0
+    assert "pml_eager_limit" in names
+    info = mpit.cvar_get_info("pml_eager_limit")
+    assert info["type"] == "size"
+    assert mpit.cvar_read("pml_eager_limit") == info["default"]
+
+
+def test_cvar_write_roundtrip():
+    old = mpit.cvar_read("io_twophase")
+    try:
+        mpit.cvar_write("io_twophase", False)
+        assert mpit.cvar_read("io_twophase") is False
+    finally:
+        mpit.cvar_write("io_twophase", old)
+
+
+def test_cvar_unknown_raises():
+    with pytest.raises(MPIException):
+        mpit.cvar_get_info("no_such_var")
+
+
+# ---------------------------------------------------------------------------
+# pvars
+# ---------------------------------------------------------------------------
+
+def test_pvar_counter_and_session_baseline():
+    pv = mpit.pvar_registry.register_or_get(
+        mpit.Pvar("test_counter_a", mpit.PvarClass.COUNTER, unit="ops"))
+    try:
+        pv.inc(5)
+        s = mpit.PvarSession()
+        h = s.handle_alloc("test_counter_a")
+        h.reset()                      # baseline at 5
+        pv.inc(3)
+        assert h.read() == 3           # session sees only its delta
+        assert pv.read() == 8          # raw value unaffected
+        s.free()
+    finally:
+        mpit.pvar_registry.unregister("test_counter_a")
+
+
+def test_pvar_watermark():
+    pv = mpit.Pvar("test_hwm", mpit.PvarClass.HIGHWATERMARK)
+    pv.watermark(4)
+    pv.watermark(2)
+    pv.watermark(9)
+    assert pv.read() == 9
+
+
+def test_pvar_low_watermark_zero_sample():
+    """A recorded low watermark of 0 must stick (regression: falsy check
+    treated it as 'no sample')."""
+    pv = mpit.Pvar("test_lwm", mpit.PvarClass.LOWWATERMARK)
+    pv.watermark(0)
+    pv.watermark(7)
+    assert pv.read() == 0
+    pv2 = mpit.Pvar("test_lwm2", mpit.PvarClass.LOWWATERMARK)
+    pv2.watermark(5)
+    pv2.watermark(-3)
+    assert pv2.read() == -3
+
+
+def test_second_exporting_monitor_conflicts_loudly():
+    def body(comm):
+        m = mon.Monitor(comm.pml, comm.size, register_pvars=True)
+        try:
+            try:
+                mon.Monitor(comm.pml, comm.size, register_pvars=True)
+            except MPIException:
+                ok = True
+            else:
+                ok = False
+            # the first monitor's pvars survive the failed registration
+            name = f"pml_monitoring_messages_count_{comm.pml.rank}"
+            mpit.pvar_registry.lookup(name)
+            return ok
+        finally:
+            m.detach()
+
+    assert all(run_ranks(2, body))
+
+
+def test_pvar_timer_handle():
+    import time
+
+    pv = mpit.pvar_registry.register_or_get(
+        mpit.Pvar("test_timer_a", mpit.PvarClass.TIMER, unit="s"))
+    try:
+        s = mpit.PvarSession()
+        h = s.handle_alloc("test_timer_a")
+        h.start()
+        time.sleep(0.02)
+        h.stop()
+        assert 0.01 < h.read() < 1.0
+        h.reset()
+        assert h.read() == 0.0
+    finally:
+        mpit.pvar_registry.unregister("test_timer_a")
+
+
+def test_pvar_duplicate_register_raises():
+    pv = mpit.Pvar("test_dup", mpit.PvarClass.COUNTER)
+    mpit.pvar_registry.register(pv)
+    try:
+        with pytest.raises(MPIException):
+            mpit.pvar_registry.register(
+                mpit.Pvar("test_dup", mpit.PvarClass.COUNTER))
+    finally:
+        mpit.pvar_registry.unregister("test_dup")
+
+
+# ---------------------------------------------------------------------------
+# tag classification
+# ---------------------------------------------------------------------------
+
+def test_classify_tag():
+    assert mon.classify_tag(0) == "pt2pt"
+    assert mon.classify_tag(42) == "pt2pt"
+    assert mon.classify_tag(-1001) == "coll"       # blocking coll tag 1
+    assert mon.classify_tag(-1064) == "coll"       # nbc window
+    assert mon.classify_tag(-1500) == "osc"        # osc req
+    assert mon.classify_tag(-1501) == "osc"
+    assert mon.classify_tag(-1700) == "coll"       # neighbor window
+
+
+# ---------------------------------------------------------------------------
+# monitoring end-to-end
+# ---------------------------------------------------------------------------
+
+def test_monitor_counts_pt2pt_and_coll():
+    def body(comm):
+        with mon.Monitor(comm.pml, comm.size) as m:
+            peer = (comm.rank + 1) % comm.size
+            data = np.arange(100, dtype=np.float64)
+            rreq = comm.irecv(source=(comm.rank - 1) % comm.size, tag=7)
+            comm.send(data, dest=peer, tag=7)
+            rreq.wait()
+            comm.allreduce(np.ones(4))
+            comm.barrier()
+            t = m.totals()
+        return t
+
+    for t in run_ranks(3, body):
+        assert t["sent_count"]["pt2pt"] == 1
+        assert t["sent_bytes"]["pt2pt"] == 800
+        assert t["recv_count"]["pt2pt"] == 1
+        assert t["sent_count"]["coll"] > 0       # allreduce+barrier traffic
+        assert t["sent_count"]["osc"] == 0
+
+
+def test_monitor_per_peer_rows_and_matrix():
+    def body(comm):
+        with mon.Monitor(comm.pml, comm.size) as m:
+            # rank 0 sends 10 doubles to every other rank
+            if comm.rank == 0:
+                reqs = [comm.isend(np.zeros(10), dest=d, tag=1)
+                        for d in range(1, comm.size)]
+                for r in reqs:
+                    r.wait()
+            else:
+                comm.recv(source=0, tag=1)
+            comm.barrier()
+            mat = mon.gather_matrix(comm, m, "sent_bytes")
+            row = m.row("sent_bytes", cls="pt2pt")
+        return mat, row
+
+    results = run_ranks(3, body)
+    mat = results[0][0]
+    assert mat is not None
+    # rank 0's pt2pt bytes to 1 and 2 (plus coll traffic in the full matrix)
+    assert results[0][1][1] == 80 and results[0][1][2] == 80
+    assert all(r[0] is None for r in results[1:])
+    # matrix row 0 includes at least the pt2pt payloads
+    assert mat[0, 1] >= 80 and mat[0, 2] >= 80
+
+
+def test_monitor_unexpected_vs_matched():
+    def body(comm):
+        with mon.Monitor(comm.pml, comm.size) as m:
+            if comm.rank == 0:
+                comm.send(np.ones(1), dest=1, tag=3)   # arrives unmatched
+                comm.recv(source=1, tag=4)
+            else:
+                import time
+
+                time.sleep(0.05)                        # let it sit
+                comm.recv(source=0, tag=3)
+                comm.send(np.ones(1), dest=0, tag=4)
+            return m.totals()
+
+    t0, t1 = run_ranks(2, body)
+    assert t1["unexpected"] >= 1       # rank 1 saw the early send
+    assert t0["matched"] + t0["unexpected"] >= 1
+
+
+def test_monitor_detach_stops_counting():
+    def body(comm):
+        m = mon.Monitor(comm.pml, comm.size).attach()
+        comm.barrier()
+        m.detach()
+        before = m.totals()["sent_count"]["coll"]
+        comm.barrier()
+        return before, m.totals()["sent_count"]["coll"]
+
+    for before, after in run_ranks(2, body):
+        assert before == after
+
+
+def test_monitor_pvar_export():
+    def body(comm):
+        m = mon.Monitor(comm.pml, comm.size, register_pvars=True).attach()
+        try:
+            comm.send(np.zeros(4), dest=(comm.rank + 1) % comm.size, tag=1)
+            comm.recv(source=(comm.rank - 1) % comm.size, tag=1)
+            name = f"pml_monitoring_messages_count_{comm.pml.rank}"
+            s = mpit.PvarSession()
+            h = s.handle_alloc(name, bound=m)
+            return h.read()
+        finally:
+            m.detach()
+
+    for v in run_ranks(2, body):
+        assert v == 1
+
+
+def test_monitor_dump_format():
+    def body(comm):
+        with mon.Monitor(comm.pml, comm.size) as m:
+            comm.send(np.zeros(2), dest=(comm.rank + 1) % comm.size, tag=1)
+            comm.recv(source=(comm.rank - 1) % comm.size, tag=1)
+            return m.dump()
+
+    out = run_ranks(2, body)[0]
+    assert "# monitoring rank 0" in out
+    assert "pt2pt -> 1: 1 msgs 16 B" in out
+
+
+# ---------------------------------------------------------------------------
+# PMPI-style profiler
+# ---------------------------------------------------------------------------
+
+def test_profiler_counts_and_times():
+    def body(comm):
+        p = mon.Profiler(comm)
+        p.allreduce(np.ones(4))
+        p.allreduce(np.ones(4))
+        p.barrier()
+        # non-callable attributes pass through untouched
+        assert p.rank == comm.rank and p.size == comm.size
+        return p.report()
+
+    for rep in run_ranks(2, body):
+        assert rep["allreduce"][0] == 2
+        assert rep["barrier"][0] == 1
+        assert rep["allreduce"][1] > 0.0
