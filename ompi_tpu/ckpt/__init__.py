@@ -1,0 +1,26 @@
+"""Checkpoint/restart: snapshot stores, job-level coordination, message log.
+
+≈ the reference's four cooperating FT layers (SURVEY §5):
+
+- opal/mca/crs  (single-process image)   → per-rank state-dict serialization
+  (a Python/JAX process's checkpointable state IS its arrays + a pytree of
+  scalars; BLCR-style whole-process images are replaced by orbax-style
+  array snapshots, which is also why no message draining is needed when
+  checkpoints align with step boundaries)
+- ompi/mca/crcp/bkmrk (quiesce/drain)    → a barrier at the step boundary
+  (snapc.checkpoint is collective; SPMD programs have no in-flight
+  user messages at a step boundary by construction)
+- orte/mca/snapc/full (global coordination) → ckpt.snapc two-phase commit
+- orte/mca/sstore/{central,stage} + filem/raw (storage/staging)
+  → ckpt.store SnapshotStore / StagedStore
+- ompi/mca/vprotocol/pessimist (message logging) → ckpt.msglog
+"""
+
+from ompi_tpu.ckpt.msglog import MessageLog
+from ompi_tpu.ckpt.snapc import CheckpointManager, checkpoint, restart
+from ompi_tpu.ckpt.store import SnapshotStore, StagedStore
+
+__all__ = [
+    "SnapshotStore", "StagedStore", "checkpoint", "restart",
+    "CheckpointManager", "MessageLog",
+]

@@ -1,0 +1,174 @@
+"""Snapshot coordination: collective checkpoint/restart + manager.
+
+≈ orte/mca/snapc/full (snapc.h:47-166): the coordinator that quiesces the
+job, has every process dump its image, collects success reports, and marks
+the global snapshot valid.  The TPU redesign runs the whole protocol over
+the collective layer:
+
+    checkpoint(comm, state):
+      barrier            — quiesce ≈ crcp/bkmrk drain (step boundary: SPMD
+                           programs have no in-flight user traffic here)
+      write_rank         — ≈ crs checkpoint of this process
+      allreduce(MIN ok)  — every rank's success report
+      rank0 commit       — the snapc "global snapshot valid" record
+      barrier            — restart-safety: nobody races ahead of the commit
+
+Device arrays are pulled to host by the store; on restart, pass
+``restore_fn`` (e.g. a jax.device_put with the right sharding) to place
+arrays back on the mesh — the checkpoint layer is deliberately ignorant of
+shardings, exactly as sstore is ignorant of what's in an image.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ompi_tpu.ckpt.store import SnapshotStore
+from ompi_tpu.mpi.constants import MPIException
+
+__all__ = ["checkpoint", "restart", "CheckpointManager"]
+
+
+def checkpoint(comm, store: SnapshotStore, state: dict[str, Any],
+               seq: Optional[int] = None,
+               keep_last: Optional[int] = None,
+               extra_meta: Optional[dict] = None) -> int:
+    """Collective: snapshot every rank's `state` dict; returns the seq.
+
+    All-or-nothing: if any rank fails to write, no commit record is
+    created and the snapshot is invisible to restart.
+    """
+    if seq is None:
+        latest = store.latest()
+        # all ranks compute the same next seq from the committed history,
+        # then agree on the max (defensive against stale directory listings
+        # on shared filesystems)
+        mine = (latest + 1) if latest is not None else 0
+        agreed = comm.allreduce(np.array([mine], np.int64), op=_MAX())
+        seq = int(np.asarray(agreed)[0])
+    comm.barrier()                      # quiesce at the step boundary
+    ok = 1
+    err = ""
+    try:
+        store.write_rank(seq, comm.rank, state)
+    except Exception as e:  # noqa: BLE001 — must still participate below
+        ok = 0
+        err = str(e)
+    agreed = comm.allreduce(np.array([ok], np.int64), op=_MIN())
+    if not int(np.asarray(agreed)[0]):
+        raise MPIException(
+            f"checkpoint {seq} failed"
+            + (f" on this rank: {err}" if err else " on a peer rank"),
+            error_class=5)
+    if comm.rank == 0:
+        store.commit(seq, comm.size, extra_meta)
+        if keep_last is not None:
+            store.gc(keep_last)
+    comm.barrier()                      # commit visible before anyone moves
+    return seq
+
+
+def restart(comm, store: SnapshotStore, seq: Optional[int] = None,
+            restore_fn: Optional[Callable[[str, np.ndarray], Any]] = None,
+            ) -> tuple[int, dict[str, Any]]:
+    """Collective: load the latest (or given) committed snapshot.
+
+    ``restore_fn(name, host_array)`` re-places each array (device_put with
+    a sharding, dtype cast, ...); default returns the host array.
+    """
+    if seq is None:
+        # rank 0 decides (directory listings may race GC on shared fs)
+        mine = store.latest()
+        chosen = comm.bcast(
+            np.array([mine if mine is not None else -1], np.int64), root=0)
+        seq = int(np.asarray(chosen)[0])
+        if seq < 0:
+            raise MPIException("no committed snapshot to restart from",
+                               error_class=5)
+    state = store.load_rank(seq, comm.rank)
+    if restore_fn is not None:
+        state = {k: restore_fn(k, v) for k, v in state.items()}
+    comm.barrier()
+    return seq, state
+
+
+class CheckpointManager:
+    """Step-driven convenience (≈ orbax CheckpointManager, carrying the
+    snapc policy knobs): checkpoint every `interval` steps, keep the last
+    `keep_last`, optionally writing in a background thread (async save —
+    the barrier cost stays, the serialization cost moves off the step
+    path)."""
+
+    def __init__(self, comm, store: SnapshotStore, interval: int = 1,
+                 keep_last: int = 2, async_save: bool = False) -> None:
+        if interval < 1:
+            raise MPIException("interval must be >= 1")
+        # private communicator (MPI library idiom): async saves run their
+        # collectives from a background thread, which would cross-match
+        # with the application's traffic on the same cid
+        self.comm = comm.dup(name=f"{comm.name}.ckpt")
+        self.store = store
+        self.interval = interval
+        self.keep_last = keep_last
+        self.async_save = async_save
+        self._pending: Optional[threading.Thread] = None
+        self._pending_err: list[BaseException] = []
+
+    def should_checkpoint(self, step: int) -> bool:
+        return step % self.interval == 0
+
+    def maybe_checkpoint(self, step: int,
+                         state: dict[str, Any]) -> Optional[int]:
+        if not self.should_checkpoint(step):
+            return None
+        return self.save(step, state)
+
+    def save(self, step: int, state: dict[str, Any]) -> int:
+        self.wait()                      # one outstanding async save max
+        if not self.async_save:
+            return checkpoint(self.comm, self.store, state, seq=step,
+                              keep_last=self.keep_last)
+        # snapshot the host copies NOW (the caller may mutate/donate the
+        # arrays right after), then serialize in the background
+        host = {k: np.asarray(v).copy() for k, v in state.items()}
+
+        def work() -> None:
+            try:
+                checkpoint(self.comm, self.store, host, seq=step,
+                           keep_last=self.keep_last)
+            except BaseException as e:  # noqa: BLE001 — reported at wait()
+                self._pending_err.append(e)
+
+        self._pending = threading.Thread(target=work, daemon=True)
+        self._pending.start()
+        return step
+
+    def wait(self) -> None:
+        """Block until the outstanding async save (if any) lands; re-raise
+        its failure here."""
+        if self._pending is not None:
+            self._pending.join()
+            self._pending = None
+        if self._pending_err:
+            raise self._pending_err.pop(0)
+
+    def restore(self, seq: Optional[int] = None,
+                restore_fn: Optional[Callable] = None
+                ) -> tuple[int, dict[str, Any]]:
+        self.wait()
+        return restart(self.comm, self.store, seq, restore_fn)
+
+
+def _MAX():
+    from ompi_tpu.mpi import op as op_mod
+
+    return op_mod.MAX
+
+
+def _MIN():
+    from ompi_tpu.mpi import op as op_mod
+
+    return op_mod.MIN

@@ -1,0 +1,203 @@
+"""Snapshot storage: central and staged layouts.
+
+≈ orte/mca/sstore — the `central` component (every rank writes straight
+into the shared snapshot root) and the `stage` component (ranks write to
+fast node-local storage first; a filem/raw-equivalent *stage* step then
+moves the file into the central root).
+
+Layout (one job root, monotonically numbered snapshots):
+
+    <base>/<job>/snapshot_<seq>/rank_<r>.npz      per-rank array shards
+    <base>/<job>/snapshot_<seq>/metadata.json     written LAST by rank 0
+
+The metadata file is the commit record (two-phase: a snapshot without it
+is garbage and is ignored/cleaned) — the same "all ranks report, then the
+coordinator marks the snapshot valid" protocol snapc/full runs over its
+RML channels, here carried by the collective layer instead.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from ompi_tpu.mpi.constants import MPIException
+
+__all__ = ["SnapshotStore", "StagedStore"]
+
+_META = "metadata.json"
+
+
+def _to_host(v: Any) -> np.ndarray:
+    """Materialize any array-like (jax arrays included) on host."""
+    return np.asarray(v)
+
+
+class SnapshotStore:
+    """sstore/central: ranks write directly into the shared root."""
+
+    def __init__(self, base_dir: str, job: str = "job") -> None:
+        self.base = os.path.join(os.path.abspath(base_dir), job)
+        os.makedirs(self.base, exist_ok=True)
+
+    # -- paths -------------------------------------------------------------
+
+    def snapshot_dir(self, seq: int) -> str:
+        return os.path.join(self.base, f"snapshot_{seq}")
+
+    def _rank_file(self, seq: int, rank: int) -> str:
+        return os.path.join(self.snapshot_dir(seq), f"rank_{rank}.npz")
+
+    # -- write path --------------------------------------------------------
+
+    def write_rank(self, seq: int, rank: int,
+                   state: dict[str, Any]) -> str:
+        """Serialize one rank's state dict (atomic: tmp file + rename)."""
+        d = self.snapshot_dir(seq)
+        os.makedirs(d, exist_ok=True)
+        arrays = {k: _to_host(v) for k, v in state.items()}
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                np.savez(f, **arrays)
+            dst = self._rank_file(seq, rank)
+            os.replace(tmp, dst)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return self._rank_file(seq, rank)
+
+    def commit(self, seq: int, nranks: int,
+               extra: Optional[dict] = None) -> None:
+        """The coordinator's commit record — written only after every rank
+        has reported success (two-phase; ≈ snapc marking the global
+        snapshot valid)."""
+        missing = [r for r in range(nranks)
+                   if not os.path.exists(self._rank_file(seq, r))]
+        if missing:
+            raise MPIException(
+                f"commit of snapshot {seq}: rank files missing for "
+                f"{missing}", error_class=5)
+        meta = {"seq": seq, "nranks": nranks, "time": time.time(),
+                "status": "committed"}
+        if extra:
+            meta.update(extra)
+        tmp = os.path.join(self.snapshot_dir(seq), _META + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, os.path.join(self.snapshot_dir(seq), _META))
+
+    # -- read path ---------------------------------------------------------
+
+    def metadata(self, seq: int) -> Optional[dict]:
+        try:
+            with open(os.path.join(self.snapshot_dir(seq), _META)) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    def snapshots(self) -> list[int]:
+        """All *committed* snapshot seqs, ascending."""
+        out = []
+        try:
+            names = os.listdir(self.base)
+        except OSError:
+            return []
+        for n in names:
+            if n.startswith("snapshot_"):
+                try:
+                    seq = int(n.split("_", 1)[1])
+                except ValueError:
+                    continue
+                if self.metadata(seq) is not None:
+                    out.append(seq)
+        return sorted(out)
+
+    def latest(self) -> Optional[int]:
+        s = self.snapshots()
+        return s[-1] if s else None
+
+    def load_rank(self, seq: int, rank: int) -> dict[str, np.ndarray]:
+        meta = self.metadata(seq)
+        if meta is None:
+            raise MPIException(
+                f"snapshot {seq} is not committed", error_class=5)
+        path = self._rank_file(seq, rank)
+        try:
+            with np.load(path) as z:
+                return {k: z[k] for k in z.files}
+        except OSError as e:
+            raise MPIException(
+                f"loading snapshot {seq} rank {rank}: {e}",
+                error_class=5) from None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def gc(self, keep_last: int) -> list[int]:
+        """Drop old committed snapshots (and any uncommitted debris) —
+        keep the newest `keep_last`. Returns removed seqs."""
+        committed = self.snapshots()
+        drop = committed[:-keep_last] if keep_last > 0 else committed
+        removed = []
+        for seq in drop:
+            shutil.rmtree(self.snapshot_dir(seq), ignore_errors=True)
+            removed.append(seq)
+        # uncommitted debris older than the newest committed snapshot
+        newest = committed[-1] if committed else None
+        try:
+            names = os.listdir(self.base)
+        except OSError:
+            return removed
+        for n in names:
+            if not n.startswith("snapshot_"):
+                continue
+            try:
+                seq = int(n.split("_", 1)[1])
+            except ValueError:
+                continue
+            if (self.metadata(seq) is None and newest is not None
+                    and seq < newest):
+                shutil.rmtree(self.snapshot_dir(seq), ignore_errors=True)
+                removed.append(seq)
+        return removed
+
+
+class StagedStore(SnapshotStore):
+    """sstore/stage + filem/raw: write node-local first, then stage the
+    finished file into the central root with an atomic move (same-fs) or
+    copy+rename (cross-fs)."""
+
+    def __init__(self, base_dir: str, local_dir: str,
+                 job: str = "job") -> None:
+        super().__init__(base_dir, job)
+        self.local = os.path.abspath(local_dir)
+        os.makedirs(self.local, exist_ok=True)
+
+    def write_rank(self, seq: int, rank: int,
+                   state: dict[str, Any]) -> str:
+        arrays = {k: _to_host(v) for k, v in state.items()}
+        local_path = os.path.join(self.local,
+                                  f"stage_{seq}_rank_{rank}.npz")
+        with open(local_path, "wb") as f:
+            np.savez(f, **arrays)
+        # filem/raw stage: move into the central snapshot dir
+        d = self.snapshot_dir(seq)
+        os.makedirs(d, exist_ok=True)
+        dst = self._rank_file(seq, rank)
+        try:
+            os.replace(local_path, dst)
+        except OSError:  # cross-filesystem: copy then atomic rename
+            tmp = dst + ".tmp"
+            shutil.copyfile(local_path, tmp)
+            os.replace(tmp, dst)
+            os.unlink(local_path)
+        return dst

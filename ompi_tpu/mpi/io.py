@@ -518,41 +518,31 @@ class File:
                      for m in meta]
         got_meta = comm.alltoallv(meta_arrs)
         # phase 2: aggregators read each requested run once (coalesced
-        # pread over their domain slice) and reply with the *actual* bytes
-        # per run — a pread can come up short at EOF, and slicing a short
-        # blob at absolute offsets would silently shift later runs' bytes
-        # into earlier requests
+        # pread over their domain slice) and reply per requester; a pread
+        # can come up short at EOF, so a reply may be shorter than the sum
+        # of requested runs
         replies = []
-        reply_lens = []
         for r in range(size):
             m = np.asarray(got_meta[r]).reshape(-1, 2)
             if len(m):
                 span_lo = int(m[:, 0].min())
                 span_hi = int((m[:, 0] + m[:, 1]).max())
                 blob = os.pread(self._fd, span_hi - span_lo, span_lo)
-                parts, lens = [], []
-                for o, l in m:
-                    lo_ix = int(o) - span_lo
-                    part = blob[lo_ix:lo_ix + int(l)]
-                    parts.append(part)
-                    lens.append(len(part))
+                parts = [blob[int(o) - span_lo:int(o) - span_lo + int(l)]
+                         for o, l in m]
                 replies.append(np.frombuffer(b"".join(parts), np.uint8))
-                reply_lens.append(np.array(lens, np.int64))
             else:
                 replies.append(np.empty(0, np.uint8))
-                reply_lens.append(np.empty(0, np.int64))
         got_pay = comm.alltoallv(replies)
-        got_lens = comm.alltoallv(reply_lens)
         # reassemble in my original run order (requests were split in
         # ascending file order per aggregator, and aggregators preserve
-        # request order); per-run actual lengths keep EOF-shortened runs
-        # from shifting later bytes
+        # request order).  EOF truncation shortens exactly a greedy suffix
+        # of an aggregator's ascending runs, so the per-run actual length
+        # is derivable from what remains of the reply blob — no second
+        # metadata exchange needed.
         blobs = [np.asarray(got_pay[r], np.uint8).tobytes()
                  for r in range(size)]
-        actual = [list(np.asarray(got_lens[r], np.int64))
-                  for r in range(size)]
         cursors = [0] * size
-        run_ix = [0] * size
         out = bytearray()
         for off, ln in my_runs:
             o_off, o_ln = off, ln
@@ -560,8 +550,7 @@ class File:
                 o = owner(o_off)
                 dom_end = glo + (o + 1) * dom
                 take = min(o_ln, dom_end - o_off)
-                got = int(actual[o][run_ix[o]])
-                run_ix[o] += 1
+                got = min(take, max(0, len(blobs[o]) - cursors[o]))
                 out += blobs[o][cursors[o]:cursors[o] + got]
                 cursors[o] += got
                 o_off += take

@@ -70,22 +70,29 @@ class Monitor:
         self.unexpected = 0              # frames queued unmatched
         self.matched = 0
         self._attached = False
+        self._register = register_pvars
         self._pvar_names: list[str] = []
-        if register_pvars:
-            self._register_pvars()
 
     # -- attachment --------------------------------------------------------
 
     def attach(self) -> "Monitor":
         if not self._attached:
+            if self._register and not self._pvar_names:
+                self._register_pvars()  # re-export on every (re)attach
             self.pml.add_listener(self._on_event)
             self._attached = True
         return self
 
     def detach(self) -> None:
-        if self._attached:
-            self.pml.remove_listener(self._on_event)
+        # flip the flag under our own lock FIRST: an event already drained
+        # from the PML queue on another thread then becomes a no-op, so
+        # counts are deterministically frozen when detach() returns
+        with self._lock:
             self._attached = False
+        try:
+            self.pml.remove_listener(self._on_event)
+        except ValueError:
+            pass
         for name in self._pvar_names:
             pvar_registry.unregister(name)
         self._pvar_names.clear()
@@ -99,25 +106,24 @@ class Monitor:
     # -- event sink --------------------------------------------------------
 
     def _on_event(self, event: str, info: dict) -> None:
-        if event == pml_mod.EVT_SEND_POST:
-            cls = classify_tag(info["tag"])
-            peer = info["peer"]
-            if 0 <= peer < self.nranks:
-                with self._lock:
+        with self._lock:
+            if not self._attached:   # late dispatch after detach(): drop
+                return
+            if event == pml_mod.EVT_SEND_POST:
+                cls = classify_tag(info["tag"])
+                peer = info["peer"]
+                if 0 <= peer < self.nranks:
                     self.sent_count[cls][peer] += 1
                     self.sent_bytes[cls][peer] += info["nbytes"]
-        elif event == pml_mod.EVT_DELIVER:
-            cls = classify_tag(info["tag"])
-            peer = info["peer"]
-            if 0 <= peer < self.nranks:
-                with self._lock:
+            elif event == pml_mod.EVT_DELIVER:
+                cls = classify_tag(info["tag"])
+                peer = info["peer"]
+                if 0 <= peer < self.nranks:
                     self.recv_count[cls][peer] += 1
                     self.recv_bytes[cls][peer] += info["nbytes"]
-        elif event == pml_mod.EVT_UNEXPECTED:
-            with self._lock:
+            elif event == pml_mod.EVT_UNEXPECTED:
                 self.unexpected += 1
-        elif event == pml_mod.EVT_MATCH:
-            with self._lock:
+            elif event == pml_mod.EVT_MATCH:
                 self.matched += 1
 
     # -- MPI_T export ------------------------------------------------------

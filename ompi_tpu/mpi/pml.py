@@ -170,7 +170,7 @@ class PmlOb1:
         self._events.append((event, info))
 
     def _drain_events(self) -> None:
-        while True:
+        while self._events:
             try:
                 event, info = self._events.popleft()
             except IndexError:
@@ -253,7 +253,7 @@ class PmlOb1:
         req = RecvRequest(buf, datatype, count, source, tag, cid)
         req.rid = next(self._ids)
         if self._listeners:
-            self._emit(EVT_RECV_POST, source=source, tag=tag, cid=cid)
+            self._emit(EVT_RECV_POST, peer=source, tag=tag, cid=cid)
         with self._lock:
             m = self._matching_for(cid)
             # try the unexpected queue first, in arrival order

@@ -85,10 +85,11 @@ def test_pvar_low_watermark_zero_sample():
 
 def test_second_exporting_monitor_conflicts_loudly():
     def body(comm):
-        m = mon.Monitor(comm.pml, comm.size, register_pvars=True)
+        m = mon.Monitor(comm.pml, comm.size, register_pvars=True).attach()
         try:
             try:
-                mon.Monitor(comm.pml, comm.size, register_pvars=True)
+                mon.Monitor(comm.pml, comm.size,
+                            register_pvars=True).attach()
             except MPIException:
                 ok = True
             else:
@@ -227,6 +228,23 @@ def test_monitor_detach_stops_counting():
 
     for before, after in run_ranks(2, body):
         assert before == after
+
+
+def test_monitor_reattach_reexports_pvars():
+    def body(comm):
+        m = mon.Monitor(comm.pml, comm.size, register_pvars=True)
+        name = f"pml_monitoring_messages_count_{comm.pml.rank}"
+        m.attach()
+        m.detach()
+        m.attach()                     # pvars must come back
+        try:
+            mpit.pvar_registry.lookup(name)
+            comm.barrier()
+            return m.totals()["sent_count"]["coll"] > 0
+        finally:
+            m.detach()
+
+    assert all(run_ranks(2, body))
 
 
 def test_monitor_pvar_export():
