@@ -63,11 +63,25 @@ def checkpoint(comm, store: SnapshotStore, state: dict[str, Any],
             f"checkpoint {seq} failed"
             + (f" on this rank: {err}" if err else " on a peer rank"),
             error_class=5)
+    # commit success must be agreed too: if rank 0's commit throws (e.g. a
+    # peer's file not yet visible on a laggy shared fs), a bare barrier
+    # would strand every other rank — broadcast the outcome instead
+    commit_ok = 1
+    commit_err = ""
     if comm.rank == 0:
-        store.commit(seq, comm.size, extra_meta)
-        if keep_last is not None:
-            store.gc(keep_last)
-    comm.barrier()                      # commit visible before anyone moves
+        try:
+            store.commit(seq, comm.size, extra_meta)
+            if keep_last is not None:
+                store.gc(keep_last)
+        except Exception as e:  # noqa: BLE001 — reported collectively
+            commit_ok = 0
+            commit_err = str(e)
+    flag = comm.bcast(np.array([commit_ok], np.int8), root=0)
+    if not int(np.asarray(flag)[0]):
+        raise MPIException(
+            f"checkpoint {seq} commit failed on rank 0"
+            + (f": {commit_err}" if commit_err else ""),
+            error_class=5)
     return seq
 
 

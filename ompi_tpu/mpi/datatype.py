@@ -18,12 +18,37 @@ the reference for obvious reasons).
 
 from __future__ import annotations
 
+import ctypes
 import threading
 from typing import Optional, Sequence
 
 import numpy as np
 
 from ompi_tpu.mpi.constants import MPIException
+
+# native C++ convertor (ompi_tpu/_native): used above this payload size;
+# below it, ctypes call overhead beats the numpy gather it would replace
+_NATIVE_MIN_BYTES = 256
+
+_U8P = ctypes.POINTER(ctypes.c_uint8)
+_I64P = ctypes.POINTER(ctypes.c_int64)
+
+
+def _native_convertor(nbytes: int):
+    if nbytes < _NATIVE_MIN_BYTES:
+        return None
+    from ompi_tpu import _native  # cheap after first import (sys.modules)
+
+    return _native.lib()
+
+
+def _u8p(arr: np.ndarray):
+    return arr.ctypes.data_as(_U8P)
+
+
+def _i64p(arr: np.ndarray):
+    return arr.ctypes.data_as(_I64P)
+
 
 __all__ = [
     "Datatype", "PredefinedDatatype", "DerivedDatatype",
@@ -80,14 +105,41 @@ class Datatype:
         base = np.arange(count, dtype=np.int64)[:, None] * self.extent
         return (base + idx1[None, :]).ravel()
 
+    @property
+    def is_contiguous(self) -> bool:
+        """One gap-free run per item, items abutting — memcpy territory."""
+        segs = self.segments()
+        return (len(segs) == 1 and segs[0] == (0, self.size)
+                and self.extent == self.size)
+
+    def _seg_arrays(self) -> tuple[np.ndarray, np.ndarray]:
+        """Segment (offsets, lengths) as int64 arrays for the native path
+        (cached — the compiled descriptor of the opal convertor)."""
+        arrs = getattr(self, "_seg_arrs", None)
+        if arrs is None:
+            segs = self.segments()
+            arrs = (np.array([s[0] for s in segs], np.int64),
+                    np.array([s[1] for s in segs], np.int64))
+            self._seg_arrs = arrs
+        return arrs
+
     def pack(self, buf: np.ndarray, count: int) -> bytes:
         """Gather `count` items from `buf` into contiguous bytes."""
         raw = np.ascontiguousarray(buf).view(np.uint8).ravel()
-        need = (count - 1) * self.extent + self.size if count else 0
         if raw.nbytes < min_span(self, count):
             raise MPIException(
                 f"pack: buffer has {raw.nbytes}B, datatype needs "
                 f"{min_span(self, count)}B for count={count}")
+        if count and self.is_contiguous:   # single-memcpy fast path
+            return raw[:count * self.size].tobytes()
+        native = _native_convertor(count * self.size)
+        if native is not None:
+            offs, lens = self._seg_arrays()
+            out = np.empty(count * self.size, np.uint8)
+            native.ompi_tpu_pack(
+                _u8p(out), _u8p(raw), count, self.extent,
+                _i64p(offs), _i64p(lens), len(offs))
+            return out.tobytes()
         return raw[self._byte_index(count)].tobytes()
 
     def unpack(self, data: bytes, buf: np.ndarray, count: int) -> None:
@@ -96,11 +148,27 @@ class Datatype:
             raise MPIException("unpack requires a C-contiguous target buffer")
         raw = buf.view(np.uint8).reshape(-1)
         src = np.frombuffer(data, dtype=np.uint8)
-        idx = self._byte_index(count)
-        if len(src) < len(idx):
+        if len(src) < count * self.size:
             raise MPIException(
-                f"unpack: got {len(src)}B, layout expects {len(idx)}B",
+                f"unpack: got {len(src)}B, layout expects "
+                f"{count * self.size}B", error_class=15)
+        if raw.nbytes < min_span(self, count):
+            raise MPIException(
+                f"unpack: target buffer has {raw.nbytes}B, layout spans "
+                f"{min_span(self, count)}B for count={count}",
                 error_class=15)
+        if count and self.is_contiguous:
+            raw[:count * self.size] = src[:count * self.size]
+            return
+        native = _native_convertor(count * self.size)
+        if native is not None:
+            offs, lens = self._seg_arrays()
+            src_c = np.ascontiguousarray(src[:count * self.size])
+            native.ompi_tpu_unpack(
+                _u8p(src_c), _u8p(raw), count, self.extent,
+                _i64p(offs), _i64p(lens), len(offs))
+            return
+        idx = self._byte_index(count)
         raw[idx] = src[:len(idx)]
 
     # -- constructors (≈ ompi_datatype.h:178-189) -------------------------

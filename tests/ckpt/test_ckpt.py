@@ -120,6 +120,26 @@ def test_checkpoint_failure_is_collective(tmp_path):
     assert run_ranks(2, body) == [None, None]
 
 
+def test_commit_failure_raises_on_all_ranks(tmp_path):
+    """rank 0's commit throwing must not strand peers in a barrier —
+    everyone gets the MPIException (regression)."""
+    base = str(tmp_path)
+
+    class CommitBroken(ckpt.SnapshotStore):
+        def commit(self, seq, nranks, extra=None):
+            raise OSError("metadata write failed")
+
+    def body(comm):
+        st = CommitBroken(base)
+        try:
+            ckpt.checkpoint(comm, st, {"x": np.zeros(1)})
+        except MPIException as e:
+            return "commit failed" in str(e)
+        return False
+
+    assert all(run_ranks(3, body, timeout=20.0))
+
+
 def test_restart_with_restore_fn(tmp_path):
     base = str(tmp_path)
 
@@ -263,7 +283,7 @@ def test_msglog_replay_redelivers():
     assert res[0] == 2
 
 
-def test_msglog_byte_cap_evicts_oldest():
+def test_msglog_byte_cap_evicts_oldest_and_blocks_replay():
     def body(comm):
         if comm.rank == 0:
             log = ckpt.MessageLog(comm, max_bytes=100).attach()
@@ -271,13 +291,40 @@ def test_msglog_byte_cap_evicts_oldest():
                 for i in range(5):
                     comm.send(np.full(5, i), dest=1, tag=2)  # 40 B each
                 pend = log.pending()
-                return [int(p[2][0]) for p in pend], log.nbytes
+                try:
+                    log.replay(to_rank=1)   # incomplete → must refuse
+                except MPIException:
+                    refused = True
+                else:
+                    refused = False
+                vals = [int(p[2][0]) for p in pend]
+                nbytes = log.nbytes
+                log.mark()
+                return (vals, nbytes, True, refused, log.complete)
             finally:
                 log.detach()
         for _ in range(5):
             comm.recv(source=0, tag=2)
         return None
 
-    res = run_ranks(2, body)[0]
-    vals, nbytes = res
+    vals, nbytes, _, refused, marked = run_ranks(2, body)[0]
     assert vals == [3, 4] and nbytes == 80
+    assert refused            # partial replay is an error, not silence
+    assert marked             # mark() resets completeness
+
+
+def test_msglog_failed_send_not_logged():
+    def body(comm):
+        if comm.rank != 0:
+            return None
+        log = ckpt.MessageLog(comm).attach()
+        try:
+            try:
+                comm.isend(np.zeros(1), dest=99, tag=1)   # bad dest
+            except MPIException:
+                pass
+            return len(log.pending())
+        finally:
+            log.detach()
+
+    assert run_ranks(2, body)[0] == 0
