@@ -32,8 +32,22 @@ def _so_path() -> str:
     return os.path.join(_DIR, f"_convertor-{digest}.so")
 
 
+_LOCK_STALE_S = 150.0   # > the 120 s compile timeout: a lock this old
+# belongs to a builder that was killed mid-compile
+
+
+def _lock_age(lock: str) -> float:
+    try:
+        return time.time() - os.path.getmtime(lock)
+    except OSError:
+        return 0.0
+
+
 def _build(so: str) -> bool:
-    """Compile once across concurrent ranks (O_EXCL lock + wait)."""
+    """Compile once across concurrent ranks (O_EXCL lock + wait).  A lock
+    older than the compile timeout is debris from a killed builder — it is
+    removed and the build retried, instead of every later process stalling
+    30 s and silently degrading to the numpy path forever."""
     lock = so + ".lock"
     try:
         fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
@@ -44,6 +58,12 @@ def _build(so: str) -> bool:
                 return True
             if not os.path.exists(lock):      # builder gave up
                 return os.path.exists(so)
+            if _lock_age(lock) > _LOCK_STALE_S:
+                try:
+                    os.unlink(lock)           # stale: take over
+                except OSError:
+                    pass
+                return _build(so)
             time.sleep(0.1)
         return os.path.exists(so)
     except OSError:

@@ -71,11 +71,14 @@ def checkpoint(comm, store: SnapshotStore, state: dict[str, Any],
     if comm.rank == 0:
         try:
             store.commit(seq, comm.size, extra_meta)
-            if keep_last is not None:
-                store.gc(keep_last)
         except Exception as e:  # noqa: BLE001 — reported collectively
             commit_ok = 0
             commit_err = str(e)
+        if commit_ok and keep_last is not None:
+            try:
+                store.gc(keep_last)   # best-effort: a failed cleanup must
+            except Exception:         # not report a durable commit as
+                pass                  # failed (restart would load it)
     flag = comm.bcast(np.array([commit_ok], np.int8), root=0)
     if not int(np.asarray(flag)[0]):
         raise MPIException(

@@ -32,7 +32,9 @@ class TransformerConfig:
     n_layers: int = 4
     d_ff: int = 2048
     seq: int = 512
-    attention: str = "ring"  # ring | ulysses | gathered
+    attention: str = "ring"  # ring | ulysses | flash | gathered
+    # ("flash" = ulysses resharding + the pallas flash kernel for the
+    # local attention — offsets are static there, so the kernel applies)
     compute_dtype: Any = "bfloat16"
     remat: bool = True  # jax.checkpoint each layer: HBM ↔ FLOPs trade
 
@@ -134,6 +136,9 @@ def _local_forward(cfg: TransformerConfig, comm, params, tokens):
             o = attn_mod.ring_attention(comm, q, k, v, axis="sp")
         elif cfg.attention == "ulysses":
             o = attn_mod.ulysses_attention(comm, q, k, v, axis="sp")
+        elif cfg.attention == "flash":
+            o = attn_mod.ulysses_attention(comm, q, k, v, axis="sp",
+                                           impl="flash")
         else:
             o = attn_mod.gathered_attention(comm, q, k, v, axis="sp")
         o = o.reshape(B, t, h_local * hd)

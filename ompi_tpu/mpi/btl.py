@@ -72,6 +72,7 @@ class TcpBTL:
         self._out: dict[int, socket.socket] = {}
         self._out_locks: dict[int, threading.Lock] = {}
         self._peers: dict[int, str] = {}
+        self._alias: dict[int, int] = {}  # peer → my id in peer's namespace
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -89,6 +90,18 @@ class TcpBTL:
         """Install the modex results: world rank → address."""
         with self._lock:
             self._peers.update(peers)
+
+    def set_alias(self, peer: int, my_id: int) -> None:
+        """Announce myself to `peer` as `my_id` instead of my own rank.
+
+        Needed by dynamic process management: two independently-launched
+        jobs each number their ranks from 0, so a connected job's procs
+        are installed under translated ids (offset past the local world)
+        — and must introduce themselves under that translated id when
+        dialing (the hello frame is what the acceptor keys frames by).
+        """
+        with self._lock:
+            self._alias[peer] = my_id
 
     # -- sending -----------------------------------------------------------
 
@@ -118,8 +131,11 @@ class TcpBTL:
             v = var_registry.get(var)
             if v:
                 sock.setsockopt(socket.SOL_SOCKET, opt, v)
-        # hello frame identifies us to the acceptor
-        hello = dss.pack({"hello": self.rank})
+        # hello frame identifies us to the acceptor (under the alias the
+        # acceptor knows us by, for cross-job connections)
+        with self._lock:
+            my_id = self._alias.get(peer, self.rank)
+        hello = dss.pack({"hello": my_id})
         _send_all(sock, struct.pack("<II", len(hello), len(hello)), hello)
         with self._lock:
             # lost the race with another sender thread? keep the first
@@ -226,6 +242,14 @@ class BtlEndpoint:
 
     def set_peers(self, peers: dict[int, str]) -> None:
         self.tcp_btl.set_peers(peers)
+
+    def set_alias(self, peer: int, my_id: int) -> None:
+        self.tcp_btl.set_alias(peer, my_id)
+
+    def max_peer_id(self) -> int:
+        """Highest peer id this endpoint knows (for dpm namespace bases)."""
+        with self.tcp_btl._lock:
+            return max(self.tcp_btl._peers, default=-1)
 
     def send(self, peer: int, header: dict, payload: bytes = b"") -> None:
         if peer == self.rank:

@@ -55,6 +55,11 @@ class Communicator:
         self._lock = threading.Lock()
         self.coll = None  # installed by ompi_tpu.mpi.coll.install()
         self.attrs: dict[Any, Any] = {}  # ≈ MPI attribute caching
+        # error policy (≈ ompi_errhandler; default mirrors ERRORS_RETURN —
+        # the MPIException propagating IS the returned error code here)
+        from ompi_tpu.mpi import errhandler as _eh
+
+        self.errhandler = _eh.ERRORS_RETURN
         self._install_coll()
 
     def _install_coll(self) -> None:
@@ -71,22 +76,43 @@ class Communicator:
     def world_rank(self, rank: int) -> int:
         return self.group.world_rank(rank)
 
-    def _check_rank(self, rank: int, what: str = "rank") -> None:
+    def _raise(self, exc: MPIException) -> None:
+        """Route an error through the installed errhandler (which raises
+        unless a user handler swallows it)."""
+        self.errhandler.invoke(self, exc)
+
+    def set_errhandler(self, eh) -> None:
+        """≈ MPI_Comm_set_errhandler."""
+        self.errhandler = eh
+
+    def get_errhandler(self):
+        return self.errhandler
+
+    def _check_rank(self, rank: int, what: str = "rank") -> bool:
+        """True when the op may proceed.  A user errhandler that swallows
+        the error turns the operation into a no-op (proceeding with an
+        invalid rank would negative-index into the group)."""
         if rank == PROC_NULL:
-            return
+            return True
         if not 0 <= rank < self.size:
-            raise MPIException(
+            self._raise(MPIException(
                 f"{what} {rank} out of range for {self.name} "
-                f"(size {self.size})", error_class=6)
+                f"(size {self.size})", error_class=6))
+            return False
+        return True
 
     # -- point-to-point ----------------------------------------------------
 
     def isend(self, buf: Any, dest: int, tag: int = 0,
               datatype: Optional[Datatype] = None,
               count: Optional[int] = None) -> Request:
-        self._check_rank(dest, "dest")
+        if not self._check_rank(dest, "dest"):
+            return CompletedRequest()
         if tag < 0:
-            raise MPIException(f"negative tag {tag} is reserved", error_class=4)
+            self._raise(MPIException(f"negative tag {tag} is reserved",
+                                     error_class=4))
+            return CompletedRequest()  # swallowed: must not hit the
+            # reserved internal tag space
         if dest == PROC_NULL:
             return CompletedRequest()
         return self._isend(buf, dest, tag, datatype, count)
@@ -103,7 +129,15 @@ class Communicator:
     def irecv(self, buf: Optional[np.ndarray] = None, source: int = 0,
               tag: int = ANY_TAG, datatype: Optional[Datatype] = None,
               count: Optional[int] = None) -> Request:
-        self._check_rank(source, "source") if source >= 0 else None
+        bad_negative = source < 0 and source not in (ANY_SOURCE, PROC_NULL)
+        if bad_negative:
+            self._raise(MPIException(
+                f"source {source} is neither a rank nor "
+                f"ANY_SOURCE/PROC_NULL", error_class=6))
+        if (bad_negative
+                or (source >= 0 and not self._check_rank(source, "source"))):
+            return CompletedRequest(
+                np.empty(0, dtype=(datatype or dt_mod.BYTE).base_np))
         if source == PROC_NULL:
             return CompletedRequest(
                 np.empty(0, dtype=(datatype or dt_mod.BYTE).base_np))
@@ -304,10 +338,49 @@ class Communicator:
         with self._lock:
             return next(self._cid_counter)
 
+    # -- attribute caching (≈ ompi/attribute: keyvals w/ callbacks) --------
+
+    def set_attr(self, keyval, value: Any) -> None:
+        """≈ MPI_Comm_set_attr."""
+        self.attrs[keyval] = value
+
+    def get_attr(self, keyval) -> Any:
+        """≈ MPI_Comm_get_attr — None when not cached."""
+        return self.attrs.get(keyval)
+
+    def delete_attr(self, keyval) -> None:
+        """≈ MPI_Comm_delete_attr — runs the delete callback."""
+        if keyval in self.attrs:
+            value = self.attrs.pop(keyval)
+            if getattr(keyval, "delete_fn", None) is not None:
+                keyval.delete_fn(self, value)
+
+    def free(self) -> None:
+        """≈ MPI_Comm_free: run attribute delete callbacks.  (Transport
+        teardown belongs to the runtime, not individual communicators.)"""
+        for kv in list(self.attrs):
+            self.delete_attr(kv)
+
+    def _copy_attrs(self, new: "Communicator") -> None:
+        from ompi_tpu.mpi.info import Keyval
+
+        for kv, value in self.attrs.items():
+            if isinstance(kv, Keyval):
+                if kv.copy_fn is None:
+                    continue        # MPI default: do NOT propagate
+                keep, newval = kv.copy_fn(self, value)
+                if keep:
+                    new.attrs[kv] = newval
+            # plain (non-Keyval) keys are internal; not propagated
+
     def dup(self, name: Optional[str] = None) -> "Communicator":
-        """≈ MPI_Comm_dup — collective over this communicator."""
-        return Communicator(self.group, self._next_cid(), self.pml,
-                            self._world_rank, name or f"{self.name}.dup")
+        """≈ MPI_Comm_dup — collective over this communicator.  Attributes
+        propagate through their keyvals' copy callbacks."""
+        new = Communicator(self.group, self._next_cid(), self.pml,
+                           self._world_rank, name or f"{self.name}.dup")
+        self._copy_attrs(new)
+        new.errhandler = self.errhandler
+        return new
 
     def create(self, group: Group, name: Optional[str] = None
                ) -> Optional["Communicator"]:

@@ -1,0 +1,376 @@
+"""Dynamic process management: connect/accept, spawn, intercommunicators.
+
+≈ ompi/dpm/dpm.c (MPI_Comm_connect/accept/spawn over ORTE+PMIx) and the
+intercommunicator core (ompi/communicator).  Redesign for this stack:
+
+- A *port* (MPI_Open_port) is a plain TCP rendezvous socket on the
+  accepting leader; the connect/accept handshake exchanges each job's
+  size and per-rank BTL addresses through it.
+- Two independently-launched jobs both number ranks from 0, so each side
+  installs the other's procs under *translated ids* (offset by its own
+  world size) and registers a BTL alias so its frames arrive under the id
+  the other side knows it by (btl.py set_alias).
+- The resulting :class:`Intercomm` does p2p against the remote group,
+  rooted bcast/barrier, and ``merge()`` into a plain intracommunicator
+  (MPI_Intercomm_merge) — the merged communicator works because both
+  sides agree on member *order* (low group first) while each process
+  addresses members through its own namespace ids.
+- ``spawn()`` launches a child job via the tpurun launcher with the
+  parent's port in the environment; children find it with
+  :func:`get_parent` (≈ MPI_Comm_get_parent).
+
+CID agreement: the handshake carries both sides' DPM sequence numbers;
+the intercomm cid is drawn from a reserved high window (1<<20) offset by
+their max, so it can't collide with either side's intra-comm cids.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import subprocess
+import sys
+import threading
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from ompi_tpu.core import dss
+from ompi_tpu.mpi.comm import Communicator
+from ompi_tpu.mpi.constants import ANY_TAG, PROC_NULL, MPIException
+from ompi_tpu.mpi.group import Group
+from ompi_tpu.mpi.request import Request, Status
+
+__all__ = ["Intercomm", "open_port", "close_port", "accept", "connect",
+           "spawn", "get_parent", "ENV_PARENT_PORT"]
+
+ENV_PARENT_PORT = "OMPI_TPU_PARENT_PORT"
+
+_DPM_CID_BASE = 1 << 20
+_dpm_seq_lock = threading.Lock()
+_dpm_seq = 0
+
+
+def _next_dpm_seq() -> int:
+    global _dpm_seq
+    with _dpm_seq_lock:
+        _dpm_seq += 1
+        return _dpm_seq
+
+
+# ---------------------------------------------------------------------------
+# ports (≈ MPI_Open_port / MPI_Close_port)
+# ---------------------------------------------------------------------------
+
+class _Port:
+    """A listening rendezvous socket on the accepting leader."""
+
+    def __init__(self) -> None:
+        self.sock = socket.create_server(("127.0.0.1", 0), backlog=8)
+        host, port = self.sock.getsockname()
+        self.name = f"{host}:{port}"
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+_ports: dict[str, _Port] = {}
+
+
+def open_port() -> str:
+    """≈ MPI_Open_port — returns the port name to hand to connectors."""
+    p = _Port()
+    _ports[p.name] = p
+    return p.name
+
+
+def close_port(name: str) -> None:
+    p = _ports.pop(name, None)
+    if p is not None:
+        p.close()
+
+
+def _send_blob(sock: socket.socket, obj: Any) -> None:
+    blob = dss.pack(obj)
+    sock.sendall(struct.pack("<I", len(blob)) + blob)
+
+
+def _recv_blob(sock: socket.socket) -> Any:
+    raw = b""
+    while len(raw) < 4:
+        chunk = sock.recv(4 - len(raw))
+        if not chunk:
+            raise MPIException("dpm handshake: connection closed")
+        raw += chunk
+    (n,) = struct.unpack("<I", raw)
+    blob = b""
+    while len(blob) < n:
+        chunk = sock.recv(n - len(blob))
+        if not chunk:
+            raise MPIException("dpm handshake: connection closed")
+        blob += chunk
+    return dss.unpack(blob, n=1)[0]
+
+
+# ---------------------------------------------------------------------------
+# intercommunicator
+# ---------------------------------------------------------------------------
+
+class Intercomm:
+    """Two disjoint groups sharing a message context (≈ MPI
+    intercommunicator): ranks in p2p calls refer to the REMOTE group."""
+
+    def __init__(self, local_comm: Communicator, remote_ids: Sequence[int],
+                 cid: int, low: bool, name: str = "intercomm") -> None:
+        self.local_comm = local_comm
+        self.remote_ids = list(remote_ids)   # namespace ids, remote order
+        self.cid = cid
+        self.low = low                       # my group orders first
+        self.name = name
+        self.pml = local_comm.pml
+        self.rank = local_comm.rank
+
+    @property
+    def size(self) -> int:
+        return self.local_comm.size
+
+    @property
+    def remote_size(self) -> int:
+        return len(self.remote_ids)
+
+    # -- p2p against the remote group -------------------------------------
+
+    def isend(self, buf: Any, dest: int, tag: int = 0) -> Request:
+        if dest == PROC_NULL:
+            from ompi_tpu.mpi.request import CompletedRequest
+
+            return CompletedRequest()
+        return self.pml.isend(np.asarray(buf), self.remote_ids[dest], tag,
+                              self.cid)
+
+    def send(self, buf: Any, dest: int, tag: int = 0) -> None:
+        self.isend(buf, dest, tag).wait()
+
+    def irecv(self, source: int = 0, tag: int = ANY_TAG) -> Request:
+        src = self.remote_ids[source] if source >= 0 else source
+        return self.pml.irecv(None, src, tag, self.cid)
+
+    def recv(self, source: int = 0, tag: int = ANY_TAG,
+             status: Optional[Status] = None) -> np.ndarray:
+        req = self.irecv(source, tag)
+        out = req.wait()
+        if status is not None:
+            status.__dict__.update(req.status.__dict__)
+            if status.source >= 0:
+                status.source = self.remote_ids.index(status.source)
+        return out
+
+    # -- rooted collectives (the MPI intercomm flavor) ---------------------
+
+    def barrier(self) -> None:
+        """Both groups synchronized: local barriers + leader exchange."""
+        self.local_comm.barrier()
+        if self.rank == 0:
+            sreq = self.isend(np.zeros(0, np.uint8), 0, tag=0)
+            self.recv(0, tag=0)
+            sreq.wait()
+        self.local_comm.barrier()
+
+    def bcast(self, buf: Any = None, root: Any = None):
+        """≈ intercomm MPI_Bcast: ``root='root'`` on the sending rank,
+        an int (remote root rank) on the receiving group, PROC_NULL on the
+        sending group's non-roots."""
+        if root == "root":
+            self.send(np.asarray(buf), 0, tag=1)
+            return np.asarray(buf)
+        if root == PROC_NULL or root is None:
+            return None
+        if not 0 <= root < self.remote_size:
+            raise MPIException(
+                f"intercomm bcast root {root} out of remote range "
+                f"(use 'root' on the sending rank, PROC_NULL on its "
+                f"group-mates)", error_class=6)
+        if self.rank == 0:
+            out = self.recv(root, tag=1)
+        else:
+            out = None
+        return self.local_comm.bcast(out, root=0)
+
+    # -- merge (≈ MPI_Intercomm_merge) -------------------------------------
+
+    def merge(self, high: Optional[bool] = None) -> Communicator:
+        """Collective on both groups: one intracommunicator, low group's
+        ranks first (each process addresses members via its own namespace
+        ids, but the ORDER is agreed, so rank numbering is global)."""
+        high = (not self.low) if high is None else high
+        local_ids = [self.local_comm.world_rank(r)
+                     for r in range(self.size)]
+        mine_first = not high
+        ordered = (local_ids + self.remote_ids if mine_first
+                   else self.remote_ids + local_ids)
+        merged = Communicator(Group(ordered), self.cid + 1, self.pml,
+                              local_ids[self.rank],
+                              name=f"{self.name}.merged")
+        return merged
+
+    def __repr__(self) -> str:
+        return (f"Intercomm({self.name}, local={self.size}, "
+                f"remote={self.remote_size}, cid={self.cid})")
+
+
+# ---------------------------------------------------------------------------
+# connect / accept (collective over each side's communicator)
+# ---------------------------------------------------------------------------
+
+def _exchange_over_port(sock: socket.socket, mine: dict,
+                        first: bool) -> dict:
+    if first:
+        _send_blob(sock, mine)
+        return _recv_blob(sock)
+    theirs = _recv_blob(sock)
+    _send_blob(sock, mine)
+    return theirs
+
+
+def _wire_remote(comm: Communicator, info: dict, my_info: dict
+                 ) -> tuple[list[int], int]:
+    """Install remote addresses + aliases; return (remote ids, cid)."""
+    my_ns = my_info["ns_size"]           # my namespace base for them
+    their_ns = info["ns_size"]
+    remote_ids = [my_ns + i for i in range(info["size"])]
+    peers = {my_ns + i: addr for i, addr in enumerate(info["addrs"])}
+    comm.pml.set_peers(peers)
+    for rid in remote_ids:
+        # my id in THEIR namespace: their base + my rank in this comm
+        # (the index they assign me from my position in the addrs list)
+        comm.pml.endpoint.set_alias(rid, their_ns + comm.rank)
+    cid = _DPM_CID_BASE + 2 * max(info["seq"], my_info["seq"])
+    return remote_ids, cid
+
+
+def _job_info(comm: Communicator) -> dict:
+    """Collect this job's business cards on the leader and agree on the
+    namespace base: one past every id this job's endpoints already know
+    (world ranks AND ids installed by earlier connect/accept calls, so
+    repeated dpm operations never collide)."""
+    addr_rows = comm.gather(
+        np.frombuffer(comm.pml.address.encode().ljust(64), np.uint8),
+        root=0)
+    addrs = None
+    if comm.rank == 0:
+        addrs = [bytes(np.asarray(r)).decode().strip() for r in addr_rows]
+    known = max(comm.world_rank(comm.rank),
+                comm.pml.endpoint.max_peer_id())
+    ns = int(np.asarray(comm.allreduce(
+        np.array([known + 1], np.int64), op=_max_op()))[0])
+    return {"size": comm.size, "addrs": addrs, "ns_size": ns,
+            "seq": _next_dpm_seq()}
+
+
+def _max_op():
+    from ompi_tpu.mpi import op as op_mod
+
+    return op_mod.MAX
+
+
+def _finish_side(comm: Communicator, port_sock: Optional[socket.socket],
+                 my_info: dict, low: bool, name: str) -> Intercomm:
+    """Leader exchanged info; broadcast to the group and wire up."""
+    if comm.rank == 0:
+        theirs = _exchange_over_port(port_sock, my_info, first=not low)
+        blob = dss.pack(theirs)
+        arr = np.frombuffer(blob, np.uint8)
+        comm.bcast(np.array([len(arr)], np.int64), root=0)
+        comm.bcast(arr, root=0)
+    else:
+        n = int(np.asarray(comm.bcast(None, root=0))[0])
+        arr = np.asarray(comm.bcast(None, root=0))[:n]
+        theirs = dss.unpack(bytes(arr), n=1)[0]
+    # seq agreement: every rank must derive the same cid — leaders' seqs
+    # rode along in the exchanged dicts
+    my_info = dict(my_info)
+    my_info["seq"] = int(np.asarray(comm.bcast(
+        np.array([my_info["seq"]], np.int64), root=0))[0])
+    remote_ids, cid = _wire_remote(comm, theirs, my_info)
+    ic = Intercomm(comm, remote_ids, cid, low=low, name=name)
+    ic.barrier()     # both sides reachable before user traffic
+    return ic
+
+
+_spawned: list = []   # Popen handles of spawned launchers (not reaped here)
+
+
+def accept(comm: Communicator, port_name: Optional[str]) -> Intercomm:
+    """≈ MPI_Comm_accept — collective; leader owns the port (non-leaders
+    may pass None)."""
+    my_info = _job_info(comm)
+    sock = None
+    if comm.rank == 0:
+        port = _ports.get(port_name)
+        if port is None:
+            raise MPIException(f"unknown port {port_name}", error_class=38)
+        conn, _ = port.sock.accept()
+        sock = conn
+    try:
+        return _finish_side(comm, sock, my_info, low=True,
+                            name=f"{comm.name}.accept")
+    finally:
+        if sock is not None:
+            sock.close()
+
+
+def connect(comm: Communicator, port_name: str,
+            timeout: float = 30.0) -> Intercomm:
+    """≈ MPI_Comm_connect — collective; leader dials the port."""
+    my_info = _job_info(comm)
+    sock = None
+    if comm.rank == 0:
+        host, port = port_name.rsplit(":", 1)
+        sock = socket.create_connection((host, int(port)), timeout=timeout)
+    try:
+        return _finish_side(comm, sock, my_info, low=False,
+                            name=f"{comm.name}.connect")
+    finally:
+        if sock is not None:
+            sock.close()
+
+
+# ---------------------------------------------------------------------------
+# spawn (≈ MPI_Comm_spawn) + get_parent
+# ---------------------------------------------------------------------------
+
+def spawn(comm: Communicator, argv: Sequence[str], maxprocs: int = 1,
+          env: Optional[dict] = None, timeout: float = 120.0) -> Intercomm:
+    """Launch `maxprocs` child procs running ``argv`` under the tpurun
+    launcher; returns the parent↔children intercommunicator.  Children
+    reach us via :func:`get_parent`."""
+    port_name = None
+    proc = None
+    if comm.rank == 0:
+        port_name = open_port()
+        child_env = dict(os.environ)
+        child_env[ENV_PARENT_PORT] = port_name
+        if env:
+            child_env.update(env)
+        cmd = [sys.executable, "-m", "ompi_tpu.tools.tpurun",
+               "-np", str(maxprocs), "--"] + list(argv)
+        proc = subprocess.Popen(cmd, env=child_env)
+        _spawned.append(proc)   # keep the handle; launcher owns lifetime
+    try:
+        return accept(comm, port_name)
+    finally:
+        if port_name is not None:
+            close_port(port_name)
+
+
+def get_parent(comm: Communicator) -> Optional[Intercomm]:
+    """≈ MPI_Comm_get_parent — in a spawned job, the intercomm to the
+    parent; None when not spawned.  Collective over the child world."""
+    port = os.environ.get(ENV_PARENT_PORT)
+    if not port:
+        return None
+    return connect(comm, port)

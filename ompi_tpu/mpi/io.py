@@ -183,6 +183,12 @@ class File:
         self._pos = 0                    # individual pointer, etype units
         self._atomicity = False
         self._closed = False
+        from ompi_tpu.mpi.errhandler import ERRORS_RETURN
+        from ompi_tpu.mpi.info import Info
+
+        self.errhandler = ERRORS_RETURN  # note: MPI's File default IS
+        # ERRORS_RETURN (unlike comms) — here they agree
+        self.info = Info()
         self._io_lock = threading.Lock()
         flags = os.O_RDWR if amode & (MODE_RDWR | MODE_WRONLY) else os.O_RDONLY
         # MPI_MODE_WRONLY still needs reads for read-modify on views; POSIX
@@ -232,12 +238,29 @@ class File:
     # -- fs framework ------------------------------------------------------
 
     @classmethod
-    def open(cls, comm, path: str, amode: int = MODE_RDONLY) -> "File":
-        """≈ MPI_File_open — collective over comm."""
+    def open(cls, comm, path: str, amode: int = MODE_RDONLY,
+             info=None) -> "File":
+        """≈ MPI_File_open — collective over comm.  ``info`` hints are
+        accepted and retrievable (MPI_File_get_info); none are currently
+        interpreted (the two-phase knobs live in the MCA registry)."""
         if amode & MODE_RDONLY and amode & (MODE_WRONLY | MODE_RDWR):
             raise MPIException("RDONLY combined with write mode",
                                error_class=3)
-        return cls(comm, path, amode)
+        f = cls(comm, path, amode)
+        if info is not None:
+            f.info = info
+        return f
+
+    def get_info(self):
+        """≈ MPI_File_get_info."""
+        return self.info
+
+    def set_errhandler(self, eh) -> None:
+        """≈ MPI_File_set_errhandler."""
+        self.errhandler = eh
+
+    def get_errhandler(self):
+        return self.errhandler
 
     def close(self) -> None:
         """≈ MPI_File_close — collective."""
@@ -313,19 +336,28 @@ class File:
 
     # -- individual IO (fbtl/posix equivalent) -----------------------------
 
+    def _err(self, exc: MPIException) -> None:
+        """Route through the file's errhandler (≈ invoking the handler
+        installed by MPI_File_set_errhandler; raises unless swallowed)."""
+        self.errhandler.invoke(self, exc)
+        raise exc  # a swallowed file error still cannot proceed: the
+        # access-mode/closed-fd condition persists
+
     def _check_open(self) -> None:
         if self._closed:
-            raise MPIException("file is closed", error_class=38)
+            self._err(MPIException("file is closed", error_class=38))
 
     def _check_read(self) -> None:
         self._check_open()
         if self.amode & MODE_WRONLY:
-            raise MPIException("file opened write-only", error_class=38)
+            self._err(MPIException("file opened write-only",
+                                   error_class=38))
 
     def _check_write(self) -> None:
         self._check_open()
         if not self.amode & (MODE_WRONLY | MODE_RDWR):
-            raise MPIException("file opened read-only", error_class=38)
+            self._err(MPIException("file opened read-only",
+                                   error_class=38))
 
     def _as_bytes(self, data: Any) -> bytes:
         arr = np.asarray(data)

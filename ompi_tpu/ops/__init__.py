@@ -1,0 +1,12 @@
+"""Hand-written TPU kernels (pallas) for the framework's hot ops.
+
+The reference keeps its hot loops in C (the convertor, the coll algorithm
+library); the TPU analog of "hand-tuned native hot path" is a pallas
+kernel feeding the MXU directly from VMEM.  Everything here has a pure-XLA
+fallback — kernels are accelerators, never requirements (same policy as
+ompi_tpu/_native).
+"""
+
+from ompi_tpu.ops.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

@@ -1,0 +1,225 @@
+"""Flash attention: blockwise online-softmax attention as a pallas kernel.
+
+The framework's densest compute op.  The jnp path in
+ompi_tpu.parallel.attention materializes the full (Tq, Tk) score matrix in
+HBM; this kernel streams K/V blocks through VMEM and keeps only the
+running (max, normalizer, accumulator) per query row — O(Tq·D) memory,
+MXU-fed matmuls, no HBM round-trip for the scores.  It is the per-chip
+building block under ring/Ulysses sequence parallelism (the ring supplies
+one K/V block per hop; this kernel handles the within-block math).
+
+Autodiff: wrapped in jax.custom_vjp; the backward pass recomputes
+attention weights in pure XLA from the saved (q, k, v, out, logsumexp)
+residuals — the standard flash-attention recompute strategy (no O(T²)
+activation storage).
+
+Fallback policy: non-TPU backends run the kernel in pallas interpret mode
+(tests on the virtual CPU mesh); shapes that don't tile (T % block != 0)
+fall back to the jnp reference implementation.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["flash_attention", "flash_tiles"]
+
+_NEG = -1e30
+
+
+def flash_tiles(t_q: int, t_k: int, block_q: int = 128,
+                block_k: int = 128) -> bool:
+    """True when these sequence lengths tile for :func:`flash_attention`
+    (the single source of the tiling rule — callers deciding between the
+    kernel and the jnp fallback use this, not a re-derived check)."""
+    return (t_q % min(block_q, t_q) == 0 and t_k % min(block_k, t_k) == 0
+            and t_q > 0 and t_k > 0)
+
+
+# ---------------------------------------------------------------------------
+# kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref,
+                *, scale: float, causal: bool, block_q: int, block_k: int,
+                t_k: int):
+    """One (batch·head, q-block) grid cell: stream K/V blocks, online
+    softmax in float32, write the normalized output.  (No logsumexp
+    output: the TPU lowering disallows a (1, block_q) block, and the
+    backward recomputes scores anyway — it rederives lse there.)"""
+    from jax import lax
+    from jax.experimental import pallas as pl
+
+    iq = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale                 # (bq, D)
+    d = q.shape[-1]
+    qpos = (qoff_ref[0] + iq * block_q
+            + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0))
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(
+            jnp.float32)                                     # (bk, D)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(
+            jnp.float32)
+        s = jax.lax.dot_general(                             # (bq, bk)
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if causal:
+            kpos = (koff_ref[0] + j * block_k
+                    + lax.broadcasted_iota(jnp.int32,
+                                           (block_q, block_k), 1))
+            s = jnp.where(qpos >= kpos, s, _NEG)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        if causal:
+            p = jnp.where(qpos >= kpos, p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=-1)
+        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((block_q,), _NEG, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m, l, acc = lax.fori_loop(0, t_k // block_k, body, (m0, l0, acc0))
+    safe_l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / safe_l[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd_raw(q3, k3, v3, q_offset, k_offset, scale: float,
+                   causal: bool, block_q: int, block_k: int,
+                   interpret: bool):
+    """(BH, Tq, D) × (BH, Tk, D) → (BH, Tq, D)."""
+    from jax.experimental import pallas as pl
+
+    bh, t_q, d = q3.shape
+    t_k = k3.shape[1]
+    grid = (bh, t_q // block_q)
+    kern = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, t_k=t_k)
+    qoff = jnp.asarray(q_offset, jnp.int32).reshape(1)
+    koff = jnp.asarray(k_offset, jnp.int32).reshape(1)
+    return pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=_smem()),
+            pl.BlockSpec(memory_space=_smem()),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, t_k, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, t_k, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t_q, d), q3.dtype),
+        interpret=interpret,
+    )(qoff, koff, q3, k3, v3)
+
+
+def _smem():
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.SMEM
+
+
+# ---------------------------------------------------------------------------
+# public op with recompute backward
+# ---------------------------------------------------------------------------
+
+def _to3(x):
+    """(B, T, H, D) → (B·H, T, D)."""
+    b, t, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _from3(x3, b, h):
+    bh, t, d = x3.shape
+    return x3.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, q_offset, k_offset, blocks):
+    return _flash_core(q, k, v, scale, causal, q_offset, k_offset, blocks)
+
+
+def _flash_core(q, k, v, scale, causal, q_offset, k_offset, blocks):
+    b, t_q, h, d = q.shape
+    block_q, block_k = blocks
+    o3 = _flash_fwd_raw(_to3(q), _to3(k), _to3(v), q_offset, k_offset,
+                        scale, causal, block_q, block_k,
+                        _use_interpret())
+    return _from3(o3, b, h)
+
+
+def _flash_fwd(q, k, v, scale, causal, q_offset, k_offset, blocks):
+    out = _flash_core(q, k, v, scale, causal, q_offset, k_offset, blocks)
+    return out, (q, k, v, out)
+
+
+def _flash_bwd(scale, causal, q_offset, k_offset, blocks, res, g):
+    """Recompute backward (pure XLA): rebuilding s and its logsumexp
+    reproduces the forward's weights exactly (same f32 math); standard
+    flash-attention gradient algebra."""
+    q, k, v, out = res
+    t_q = q.shape[1]
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    of = out.astype(jnp.float32)
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
+    if causal:
+        qpos = q_offset + jnp.arange(t_q)
+        kpos = k_offset + jnp.arange(k.shape[1])
+        keep = (qpos[:, None] >= kpos[None, :])[None, None]
+        s = jnp.where(keep, s, _NEG)
+    m = s.max(axis=-1, keepdims=True)
+    l = jnp.sum(jnp.exp(s - m), axis=-1, keepdims=True)
+    p = jnp.exp(s - m) / jnp.maximum(l, 1e-30)       # fwd weights
+    if causal:
+        p = jnp.where(keep, p, 0.0)
+    dv = jnp.einsum("bhqk,bqhd->bkhd", p, gf)
+    dp = jnp.einsum("bqhd,bkhd->bhqk", gf, vf)
+    delta = jnp.einsum("bqhd,bqhd->bqh", gf, of).transpose(0, 2, 1)
+    ds = p * (dp - delta[..., None]) * scale
+    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, kf)
+    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = True,
+                    q_offset: int = 0, k_offset: int = 0,
+                    scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128):
+    """Blockwise-streamed exact attention (pallas; MXU matmuls, O(T·D)
+    memory).  Same contract as parallel.attention.local_attention:
+    q (B, Tq, H, D), k/v (B, Tk, H, D) → (B, Tq, H, D); offsets give
+    global positions for causal masking of sequence slices.
+
+    Shapes must tile (Tq % block_q == 0, Tk % block_k == 0) — callers
+    (local_attention) fall back to the jnp path otherwise.
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    t_q, t_k = q.shape[1], k.shape[1]
+    if not flash_tiles(t_q, t_k, block_q, block_k):
+        raise ValueError(
+            f"flash_attention: T ({t_q},{t_k}) must tile by blocks "
+            f"({block_q},{block_k})")
+    block_q = min(block_q, t_q)
+    block_k = min(block_k, t_k)
+    return _flash(q, k, v, float(scale), bool(causal), int(q_offset),
+                  int(k_offset), (block_q, block_k))

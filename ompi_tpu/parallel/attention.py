@@ -29,15 +29,34 @@ _NEG = -1e30
 
 
 def local_attention(q, k, v, causal: bool = True,
-                    q_offset=0, k_offset=0, scale: Optional[float] = None):
+                    q_offset=0, k_offset=0, scale: Optional[float] = None,
+                    impl: str = "jnp"):
     """Plain attention over local blocks; offsets give global positions for
     causal masking when the blocks are slices of a longer sequence.
 
     Shapes: q (B, Tq, H, D), k/v (B, Tk, H, D) → (B, Tq, H, D).
+
+    ``impl``: "flash" = the pallas blockwise kernel (ompi_tpu.ops),
+    "jnp" = materialized scores, "auto" = flash when the shape tiles and
+    the offsets are static (traced offsets — e.g. a traced ring source
+    index — need the jnp path).
     """
     import jax.numpy as jnp
 
     scale = scale if scale is not None else q.shape[-1] ** -0.5
+    if impl != "jnp":
+        from ompi_tpu.ops import flash_attention
+        from ompi_tpu.ops.flash_attention import flash_tiles
+
+        static_offsets = isinstance(q_offset, int) and isinstance(
+            k_offset, int)
+        if static_offsets and flash_tiles(q.shape[1], k.shape[1]):
+            return flash_attention(q, k, v, causal=causal,
+                                   q_offset=q_offset, k_offset=k_offset,
+                                   scale=scale)
+        if impl == "flash":
+            raise ValueError(
+                "flash impl needs static offsets and block-tiling shapes")
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if causal:
         qpos = q_offset + jnp.arange(q.shape[1])
@@ -106,9 +125,12 @@ def ring_attention(comm, q, k, v, axis: Optional[str] = None,
 
 
 def ulysses_attention(comm, q, k, v, axis: Optional[str] = None,
-                      causal: bool = True, scale: Optional[float] = None):
+                      causal: bool = True, scale: Optional[float] = None,
+                      impl: str = "jnp"):
     """All-to-all sequence parallelism: re-shard seq→heads, attend fully
-    locally, re-shard back.  Exact; one alltoall each way."""
+    locally, re-shard back.  Exact; one alltoall each way.  The local
+    attention runs the pallas flash kernel with ``impl='flash'`` (static
+    offsets by construction — the canonical place to use it)."""
     from jax import lax
 
     ax = axis or comm.axes[-1]
@@ -119,7 +141,7 @@ def ulysses_attention(comm, q, k, v, axis: Optional[str] = None,
     # (B, T/sp, H, D) → (B, T, H/sp, D)
     q2, k2, v2 = (lax.all_to_all(t, ax, split_axis=2, concat_axis=1,
                                  tiled=True) for t in (q, k, v))
-    o = local_attention(q2, k2, v2, causal=causal, scale=scale)
+    o = local_attention(q2, k2, v2, causal=causal, scale=scale, impl=impl)
     # (B, T, H/sp, D) → (B, T/sp, H, D)
     return lax.all_to_all(o, ax, split_axis=1, concat_axis=2, tiled=True)
 

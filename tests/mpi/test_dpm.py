@@ -1,0 +1,214 @@
+"""Dynamic process management tests: connect/accept between two
+independently-built jobs, intercomm p2p/collectives/merge, and spawn.
+
+The two-jobs fixture builds two disjoint in-process worlds (separate PML
+sets, each with ranks 0..n-1 — exactly the id-collision scenario dpm's
+namespace translation exists for) and connects them over a real port.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from ompi_tpu.mpi import dpm
+from ompi_tpu.mpi.comm import Communicator
+from ompi_tpu.mpi.group import Group
+from ompi_tpu.mpi.pml import PmlOb1
+
+
+def _make_world(n: int, name: str) -> list[Communicator]:
+    pmls = [PmlOb1(r) for r in range(n)]
+    addrs = {r: p.address for r, p in enumerate(pmls)}
+    for p in pmls:
+        p.set_peers(addrs)
+    return [Communicator(Group(range(n)), cid=0, pml=pmls[r],
+                         my_world_rank=r, name=name) for r in range(n)]
+
+
+def _run_two_jobs(na: int, nb: int, job_a, job_b, timeout: float = 30.0):
+    """Run job_a(comm) on world A's ranks and job_b(comm) on world B's,
+    all on threads; returns (results_a, results_b)."""
+    wa, wb = _make_world(na, "A"), _make_world(nb, "B")
+    res_a: list = [None] * na
+    res_b: list = [None] * nb
+    errors: list = []
+
+    def runner(fn, comms, res, rank):
+        try:
+            res[rank] = fn(comms[rank])
+        except BaseException as e:  # noqa: BLE001
+            errors.append((fn.__name__, rank, e))
+
+    threads = [threading.Thread(target=runner, args=(job_a, wa, res_a, r),
+                                daemon=True) for r in range(na)]
+    threads += [threading.Thread(target=runner, args=(job_b, wb, res_b, r),
+                                 daemon=True) for r in range(nb)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout)
+    alive = [t for t in threads if t.is_alive()]
+    try:
+        if alive:
+            raise TimeoutError(f"{len(alive)} job threads hung "
+                               f"(errors: {errors})")
+        if errors:
+            name, rank, exc = errors[0]
+            raise AssertionError(
+                f"{name} rank {rank} failed: {exc!r}") from exc
+    finally:
+        if not alive:
+            for c in wa + wb:
+                c.pml.close()
+    return res_a, res_b
+
+
+def _with_port(job_a, job_b, na=2, nb=2):
+    port = dpm.open_port()
+    try:
+        return _run_two_jobs(na, nb,
+                             lambda c: job_a(c, port),
+                             lambda c: job_b(c, port))
+    finally:
+        dpm.close_port(port)
+
+
+def test_connect_accept_p2p():
+    def server(comm, port):
+        ic = dpm.accept(comm, port if comm.rank == 0 else None)
+        assert ic.remote_size == 2
+        # my rank r talks to remote rank r
+        sreq = ic.isend(np.array([100 + comm.rank]), dest=comm.rank, tag=3)
+        got = ic.recv(source=comm.rank, tag=3)
+        sreq.wait()
+        return int(np.asarray(got)[0])
+
+    def client(comm, port):
+        ic = dpm.connect(comm, port)
+        assert ic.remote_size == 2
+        sreq = ic.isend(np.array([200 + comm.rank]), dest=comm.rank, tag=3)
+        got = ic.recv(source=comm.rank, tag=3)
+        sreq.wait()
+        return int(np.asarray(got)[0])
+
+    res_a, res_b = _with_port(server, client)
+    assert res_a == [200, 201]
+    assert res_b == [100, 101]
+
+
+def test_intercomm_bcast_rooted():
+    def server(comm, port):
+        ic = dpm.accept(comm, port if comm.rank == 0 else None)
+        # server rank 1 is the bcast root toward the client group
+        if comm.rank == 1:
+            ic.bcast(np.arange(5.0), root="root")
+            return None
+        from ompi_tpu.mpi.constants import PROC_NULL
+
+        ic.bcast(root=PROC_NULL)  # non-root on the root side
+        return None
+
+    def client(comm, port):
+        ic = dpm.connect(comm, port)
+        out = ic.bcast(root=1)   # receive from remote rank 1
+        return np.asarray(out)
+
+    _, res_b = _with_port(server, client)
+    for out in res_b:
+        np.testing.assert_array_equal(out, np.arange(5.0))
+
+
+def test_intercomm_merge_allreduce():
+    """The merged intracomm must agree on rank order (low group first)
+    and run collectives across both original jobs."""
+    def server(comm, port):
+        ic = dpm.accept(comm, port if comm.rank == 0 else None)
+        m = ic.merge()
+        out = m.allreduce(np.array([m.rank], dtype=np.int64))
+        return m.rank, int(np.asarray(out)[0])
+
+    def client(comm, port):
+        ic = dpm.connect(comm, port)
+        m = ic.merge()
+        out = m.allreduce(np.array([m.rank], dtype=np.int64))
+        return m.rank, int(np.asarray(out)[0])
+
+    res_a, res_b = _with_port(server, client)
+    # 4 merged ranks → sum 0+1+2+3 = 6; server (low) ranks 0,1
+    assert [r for r, _ in res_a] == [0, 1]
+    assert [r for r, _ in res_b] == [2, 3]
+    assert all(s == 6 for _, s in res_a + res_b)
+
+
+def test_intercomm_barrier_and_repeated_connects():
+    """Two successive connect/accept pairs between the same jobs must get
+    distinct namespaces and cids (regression guard for id collisions)."""
+    def server(comm, port):
+        ic1 = dpm.accept(comm, port if comm.rank == 0 else None)
+        ic1.barrier()
+        ic2 = dpm.accept(comm, port if comm.rank == 0 else None)
+        ic2.barrier()
+        assert ic1.cid != ic2.cid
+        got1 = ic1.recv(source=0, tag=9)
+        got2 = ic2.recv(source=0, tag=9)
+        return int(np.asarray(got1)[0]), int(np.asarray(got2)[0])
+
+    def client(comm, port):
+        ic1 = dpm.connect(comm, port)
+        ic1.barrier()
+        ic2 = dpm.connect(comm, port)
+        ic2.barrier()
+        if comm.rank == 0:
+            ic1.send(np.array([11]), dest=comm.rank, tag=9)
+            ic2.send(np.array([22]), dest=comm.rank, tag=9)
+        return None
+
+    res_a, _ = _with_port(server, client, na=1, nb=1)
+    assert res_a == [(11, 22)]
+
+
+def test_unknown_port_raises():
+    def server(comm):
+        from ompi_tpu.mpi.constants import MPIException
+
+        try:
+            dpm.accept(comm, "no-such-port:0")
+        except MPIException:
+            return True
+        return False
+
+    res, _ = _run_two_jobs(1, 1, server, lambda c: None)
+    assert res == [True]
+
+
+def test_spawn_parent_child(tmp_path):
+    """Full spawn path through the real launcher: parent spawns 2 children,
+    exchanges a token over the parent intercomm."""
+    child = tmp_path / "child.py"
+    child.write_text(
+        "import numpy as np\n"
+        "import ompi_tpu\n"
+        "from ompi_tpu.mpi import dpm\n"
+        "comm = ompi_tpu.init()\n"
+        "parent = dpm.get_parent(comm)\n"
+        "assert parent is not None\n"
+        "tok = parent.recv(source=0, tag=7)\n"
+        "parent.send(tok * 2, dest=0, tag=8)\n"
+        "ompi_tpu.finalize()\n")
+
+    import sys
+
+    world = _make_world(1, "parent")
+    try:
+        ic = dpm.spawn(world[0], [sys.executable, str(child)], maxprocs=2)
+        assert ic.remote_size == 2
+        for r in range(2):
+            ic.send(np.array([10 + r]), dest=r, tag=7)
+        vals = sorted(int(np.asarray(ic.recv(source=r, tag=8))[0])
+                      for r in range(2))
+        assert vals == [20, 22]
+    finally:
+        world[0].pml.close()
