@@ -1,15 +1,29 @@
-"""Benchmark driver — prints ONE JSON line.
+"""Benchmark driver — prints ONE JSON line on stdout, always.
 
-Mode is chosen by available hardware:
+Crash-proof by construction (round-1 failure mode: ``jax.devices()`` raised
+when the TPU tunnel was down and the traceback landed on stdout):
 
-- **multi-device** (≥2 chips): the north-star metric — MPI_Allreduce busbw
-  over ICI (BASELINE.json): float32 allreduce through the framework's
-  device path (DeviceCommunicator.allreduce → lax.psum), busbw =
-  2·(n-1)/n · bytes / time.
-- **single chip**: flagship-model train-step throughput (tokens/s) with
-  bfloat16 compute (MXU path) vs the same model in float32 — vs_baseline is
-  the bf16/fp32 speedup, since the reference publishes no absolute numbers
-  (BASELINE.md: "published: {}").
+- The accelerator backend is probed in a **subprocess with a timeout**; if
+  it is unreachable the bench re-points jax at a virtual 8-device CPU
+  platform and still produces a valid JSON record (tagged ``"backend"``).
+- Everything runs under a top-level try/except that emits a JSON error
+  record rather than a traceback.
+
+Primary metric:
+
+- **multi-device** (≥2 chips): MPI_Allreduce busbw over ICI (BASELINE.json
+  north star) — float32 allreduce through the device path
+  (DeviceCommunicator.allreduce → lax.psum), busbw = 2·(n-1)/n·bytes/time.
+- **single chip**: flagship-model **MFU** — model FLOPs/step ÷ step time ÷
+  chip peak FLOPs (bf16). ``vs_baseline`` is MFU as a fraction of the 40%
+  MFU a well-tuned reference-class training stack reaches on this hardware
+  class; tokens/s is carried alongside.
+
+The full BASELINE.md config matrix (ring p50, 2D-mesh bcast/allgather,
+7B-param reduce_scatter+allgather gradient harness, oshmem max-reduction /
+circular-shift on the device path) runs after the primary metric; every
+config emits a JSON row into ``BENCH_MATRIX.json`` even on 1 chip, with
+per-row error capture.
 
 All diagnostics go to stderr; stdout carries exactly one JSON line.
 """
@@ -17,24 +31,93 @@ All diagnostics go to stderr; stdout carries exactly one JSON line.
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
 import numpy as np
+
+_PROBE_TIMEOUT_S = 150  # real TPU init can take ~40s; runaway retry loops far longer
+_MATRIX_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_MATRIX.json")
+
+# Peak dense bf16 FLOP/s by device kind (public figures); cpu has no
+# meaningful peak → MFU reported as 0 and flagged.
+_PEAK_FLOPS = [
+    ("v6", 918e12), ("trillium", 918e12),
+    ("v5p", 459e12),
+    ("v5 lite", 197e12), ("v5e", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
 
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def _probe_backend() -> dict | None:
+    """Ask a subprocess what jax.devices() sees, with a hard timeout.
+
+    Returns {"n", "platform", "kind"} or None if the backend is unreachable
+    (round 1: axon init blocked in a socket retry loop — a timeout is the
+    only safe way to detect that without wedging the bench itself).
+    """
+    code = ("import jax, json; ds = jax.devices(); "
+            "print(json.dumps({'n': len(ds), 'platform': ds[0].platform, "
+            "'kind': ds[0].device_kind}))")
+    try:
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True,
+                             timeout=_PROBE_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        log(f"backend probe timed out after {_PROBE_TIMEOUT_S}s")
+        return None
+    if out.returncode != 0:
+        log(f"backend probe failed rc={out.returncode}: {out.stderr[-500:]}")
+        return None
+    try:
+        return json.loads(out.stdout.strip().splitlines()[-1])
+    except Exception as e:  # noqa: BLE001
+        log(f"backend probe unparseable ({e}): {out.stdout[-200:]}")
+        return None
+
+
+def _force_cpu(n: int = 8) -> None:
+    """Re-point jax at a virtual n-device CPU platform.
+
+    Must go through ``jax.config`` (not env vars): the ambient site
+    customization re-registers the accelerator plugin and updates
+    ``jax_platforms`` at interpreter startup, which beats JAX_PLATFORMS
+    from the environment.  A config update after import wins.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses we spawn
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", n)
+
+
+def _peak_flops(kind: str) -> float | None:
+    k = kind.lower()
+    for needle, peak in _PEAK_FLOPS:
+        if needle in k:
+            return peak
+    return None
+
+
+# ---------------------------------------------------------------------------
+# primary metrics
+# ---------------------------------------------------------------------------
+
 def bench_allreduce_busbw(devices) -> dict:
     import jax
+    from jax.sharding import PartitionSpec as P
 
     from ompi_tpu.mpi.device_comm import device_world
     from ompi_tpu.parallel.mesh import make_mesh
-
-    import jax
-    from jax.sharding import PartitionSpec as P
 
     n = len(devices)
     mesh = make_mesh(devices=devices)
@@ -65,12 +148,19 @@ def bench_allreduce_busbw(devices) -> dict:
     }
 
 
-def _throughput(cfg, mesh, tokens, steps=8):
+def _count_params(params) -> int:
+    import jax
+
+    return int(sum(np.prod(x.shape) for x in jax.tree_util.tree_leaves(params)))
+
+
+def _time_train_step(cfg, mesh, tokens, steps=8):
     import jax
 
     from ompi_tpu.models import transformer as tfm
 
     params = tfm.init_params(cfg)
+    n_params = _count_params(params)
     step, init_opt = tfm.make_train_step(cfg, mesh, lr=1e-3)
     opt_state = init_opt(params)
     params, opt_state, loss = step(params, opt_state, tokens)  # compile
@@ -80,48 +170,280 @@ def _throughput(cfg, mesh, tokens, steps=8):
         params, opt_state, loss = step(params, opt_state, tokens)
     jax.block_until_ready(loss)
     dt = (time.perf_counter() - t0) / steps
-    toks = tokens.size
-    return toks / dt, float(loss)
+    return dt, n_params, float(loss)
 
 
-def bench_flagship_single_chip() -> dict:
+def bench_flagship_mfu(kind: str) -> dict:
+    """Single-chip flagship train step → MFU (PaLM-style accounting:
+    6·N FLOPs/token for the dense path + 12·L·D·S for attention)."""
     import jax
 
     from ompi_tpu.models.transformer import TransformerConfig
     from ompi_tpu.parallel.mesh import make_mesh
 
+    on_cpu = jax.devices()[0].platform == "cpu"
     mesh = make_mesh({"dp": 1, "sp": 1, "tp": 1}, devices=jax.devices()[:1])
     base = dict(vocab=32_000, d_model=1024, n_heads=16, n_layers=8,
                 d_ff=4096, seq=1024, attention="ring")
+    if on_cpu:  # fallback mode: keep the gate fast; MFU is 0 here anyway
+        base.update(d_model=256, n_heads=8, n_layers=2, d_ff=1024, seq=256)
     rng = np.random.default_rng(0)
-    tokens = rng.integers(0, base["vocab"], size=(4, base["seq"])).astype(np.int32)
+    batch = 4
+    tokens = rng.integers(0, base["vocab"],
+                          size=(batch, base["seq"])).astype(np.int32)
 
-    bf16, loss_b = _throughput(
-        TransformerConfig(**base, compute_dtype="bfloat16"), mesh, tokens)
-    log(f"bf16 train step: {bf16:,.0f} tok/s (loss {loss_b:.3f})")
-    fp32, loss_f = _throughput(
-        TransformerConfig(**base, compute_dtype="float32"), mesh, tokens)
-    log(f"fp32 train step: {fp32:,.0f} tok/s (loss {loss_f:.3f})")
+    dt, n_params, loss = _time_train_step(
+        TransformerConfig(**base, compute_dtype="bfloat16"), mesh, tokens,
+        steps=2 if on_cpu else 8)
+    n_tokens = tokens.size
+    flops_per_token = 6 * n_params + 12 * base["n_layers"] * base["d_model"] * base["seq"]
+    model_flops = flops_per_token * n_tokens
+    toks_per_s = n_tokens / dt
+    peak = _peak_flops(kind)
+    mfu = (model_flops / dt / peak) if peak else 0.0
+    log(f"bf16 train step: {dt*1e3:.1f}ms, {toks_per_s:,.0f} tok/s, "
+        f"{n_params/1e6:.0f}M params, model {model_flops/1e9:.1f} GFLOP/step, "
+        f"peak={peak}, MFU={mfu*100:.1f}% (loss {loss:.3f})")
     return {
-        "metric": "flagship transformer train-step throughput "
-                  "(1 chip, bf16, 110M params, seq 1024)",
-        "value": round(bf16, 1),
-        "unit": "tokens/s",
-        "vs_baseline": round(bf16 / fp32, 3),  # speedup over fp32 same model
+        "metric": f"flagship transformer train-step MFU (1 chip {kind}, "
+                  f"bf16, {n_params/1e6:.0f}M params, seq {base['seq']})",
+        "value": round(mfu * 100, 2),
+        "unit": "% MFU",
+        # no reference number published (BASELINE.md); 40% MFU is the
+        # well-tuned-training-stack bar on this hardware class
+        "vs_baseline": round(mfu / 0.40, 3) if peak else 0.0,
+        "tokens_per_s": round(toks_per_s, 1),
+        "step_ms": round(dt * 1e3, 2),
+        "params": n_params,
     }
 
 
+# ---------------------------------------------------------------------------
+# BASELINE.md config matrix → BENCH_MATRIX.json
+# ---------------------------------------------------------------------------
+
+def matrix_ring_latency() -> dict:
+    """Config 1: 4-rank send/recv ring (host path, real sockets), p50 lap."""
+    from tests.mpi.harness import run_ranks
+
+    laps = 200
+    msg = np.array([0], np.int32)
+
+    def ring(comm):
+        rank, size = comm.rank, comm.size
+        nxt, prv = (rank + 1) % size, (rank - 1) % size
+        times = []
+        for i in range(20 + laps):
+            if rank == 0:
+                t0 = time.perf_counter()
+                comm.send(msg, dest=nxt, tag=1)
+                comm.recv(source=prv, tag=1)
+                if i >= 20:
+                    times.append(time.perf_counter() - t0)
+            else:
+                m = comm.recv(source=prv, tag=1)
+                comm.send(m, dest=nxt, tag=1)
+        return times
+
+    results = run_ranks(4, ring, timeout=120.0)
+    p50 = float(np.percentile(np.array(results[0]) * 1e6, 50))
+    return {
+        "metric": "ring_c 4-rank lap latency p50 (host path)",
+        "value": round(p50, 1), "unit": "us", "vs_baseline": 1.0,
+        "per_hop_us": round(p50 / 4, 2),
+    }
+
+
+def matrix_mesh_bcast_allgather(devices) -> dict:
+    """Config 3: Bcast + Allgather over a 2D mesh, mixed dtypes."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ompi_tpu.mpi.device_comm import DeviceCommunicator
+    from ompi_tpu.parallel.mesh import make_mesh, mesh_shape_for
+
+    n = len(devices)
+    shape = mesh_shape_for(n, ["x", "y"])
+    mesh = make_mesh(shape, devices=devices)
+    comm = DeviceCommunicator(mesh, ("x", "y"))
+    nbytes = 0
+    dts = []
+    for dtype in (np.float32, np.bfloat16 if hasattr(np, "bfloat16")
+                  else np.float16, np.int32):
+        x = np.ones((n * (1 << 22),), dtype=np.float32).astype(dtype)
+
+        def kernel(s):
+            b = comm.bcast(s, root=0)
+            return comm.allgather(b)
+
+        fn = jax.jit(jax.shard_map(
+            kernel, mesh=mesh, in_specs=P(("x", "y")), out_specs=P(),
+            check_vma=False))
+        jax.block_until_ready(fn(x))
+        t0 = time.perf_counter()
+        for _ in range(5):
+            out = fn(x)
+        jax.block_until_ready(out)
+        dts.append((time.perf_counter() - t0) / 5)
+        nbytes += x.nbytes
+    total_dt = sum(dts)
+    gbps = nbytes / total_dt / 2**30
+    return {
+        "metric": f"Bcast+Allgather 2D mesh {tuple(shape.values())}, "
+                  "mixed dtypes",
+        "value": round(gbps, 3), "unit": "GiB/s", "vs_baseline": 1.0,
+    }
+
+
+def matrix_grad_reduce_scatter(devices) -> dict:
+    """Config 4: data-parallel gradient reduce_scatter + allgather on
+    float32 buffers, sized to HBM (7B params when it fits)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ompi_tpu.mpi.device_comm import device_world
+    from ompi_tpu.parallel.mesh import make_mesh
+
+    n = len(devices)
+    if devices[0].platform == "cpu":
+        limit = 128 << 20  # virtual cpu devices share host RAM — stay small
+    else:
+        try:
+            limit = devices[0].memory_stats()["bytes_limit"]
+        except Exception:  # noqa: BLE001 — backend without memory_stats
+            limit = 8 << 30
+    # grad shard + scattered output + slack must fit per device
+    params = min(7_000_000_000, int(limit * 0.15 / 4) * n)
+    params -= params % (n * 1024)
+    x = np.ones((params,), np.float32)
+
+    def kernel(s):
+        scattered = jax.lax.psum_scatter(s, "world", tiled=True)
+        return jax.lax.all_gather(scattered, "world", tiled=True)
+
+    mesh = make_mesh(devices=devices)
+    fn = jax.jit(jax.shard_map(kernel, mesh=mesh, in_specs=P("world"),
+                               out_specs=P("world"), check_vma=False))
+    jax.block_until_ready(fn(x))
+    t0 = time.perf_counter()
+    for _ in range(3):
+        out = fn(x)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / 3
+    gbps = 2 * x.nbytes / dt / 2**30  # RS + AG each move ~the buffer once
+    return {
+        "metric": f"grad reduce_scatter+allgather ({params/1e9:.2f}B fp32 "
+                  f"params, {n} dev)",
+        "value": round(gbps, 3), "unit": "GiB/s", "vs_baseline": 1.0,
+        "params": params, "step_ms": round(dt * 1e3, 2),
+    }
+
+
+def matrix_oshmem_device(devices) -> dict:
+    """Config 5: oshmem max-reduction + circular shift on the device path
+    (symmetric-heap semantics: every device holds an identically-shaped
+    shard; max_to_all = pmax, circular shift = ppermute)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ompi_tpu.mpi.device_comm import device_world
+    from ompi_tpu.mpi.op import MAX
+    from ompi_tpu.parallel.mesh import make_mesh
+
+    n = len(devices)
+    mesh = make_mesh(devices=devices)
+    comm = device_world(mesh)
+    x = np.arange(n * (1 << 22), dtype=np.float32)
+
+    def kernel(s):
+        m = comm.allreduce(s, MAX)       # shmem_float_max_to_all
+        return comm.shift(m, 1, axis="world")  # circular shift, 1 ICI hop
+
+    fn = jax.jit(jax.shard_map(kernel, mesh=mesh, in_specs=P("world"),
+                               out_specs=P("world"), check_vma=False))
+    jax.block_until_ready(fn(x))
+    t0 = time.perf_counter()
+    for _ in range(5):
+        out = fn(x)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / 5
+    return {
+        "metric": f"oshmem max_to_all + circular shift ({n} dev, "
+                  f"{x.nbytes/n/2**20:.0f}MiB/dev)",
+        "value": round(x.nbytes / dt / 2**30, 3), "unit": "GiB/s",
+        "vs_baseline": 1.0,
+    }
+
+
+def run_matrix(devices, backend: str) -> None:
+    rows = []
+    for name, fn in (
+            ("ring_latency", matrix_ring_latency),
+            ("mesh_bcast_allgather",
+             lambda: matrix_mesh_bcast_allgather(devices)),
+            ("grad_reduce_scatter",
+             lambda: matrix_grad_reduce_scatter(devices)),
+            ("oshmem_device", lambda: matrix_oshmem_device(devices))):
+        t0 = time.perf_counter()
+        try:
+            row = fn()
+        except Exception as e:  # noqa: BLE001 — every row must land
+            row = {"metric": name, "value": 0, "unit": "error",
+                   "vs_baseline": 0, "error": f"{type(e).__name__}: {e}"}
+        row["config"] = name
+        row["backend"] = backend
+        row["wall_s"] = round(time.perf_counter() - t0, 2)
+        log(f"matrix[{name}]: {json.dumps(row)}")
+        rows.append(row)
+    try:
+        with open(_MATRIX_PATH, "w") as f:
+            json.dump(rows, f, indent=1)
+        log(f"matrix written to {_MATRIX_PATH}")
+    except OSError as e:
+        log(f"matrix write failed: {e}")
+
+
+# ---------------------------------------------------------------------------
+
+
 def main() -> None:
+    t_start = time.perf_counter()
+    probe = _probe_backend()
+    if probe is None:
+        _force_cpu(8)
+        backend = "cpu-fallback"
+        kind = "cpu"
+    else:
+        backend = probe["platform"]
+        kind = probe.get("kind", backend)
+        log(f"backend: {probe}")
+
     import jax
 
     devices = jax.devices()
     log(f"devices: {devices}")
-    if len(devices) >= 2:
+    if probe is not None and len(devices) >= 2:
         result = bench_allreduce_busbw(devices)
     else:
-        result = bench_flagship_single_chip()
+        result = bench_flagship_mfu(kind)
+    result["backend"] = backend
+    try:
+        run_matrix(devices, backend)
+    except Exception as e:  # noqa: BLE001 — matrix must not kill the primary
+        log(f"matrix failed: {type(e).__name__}: {e}")
+    result["wall_s"] = round(time.perf_counter() - t_start, 1)
     print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except BaseException as e:  # noqa: BLE001 — stdout must stay one JSON line
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        print(json.dumps({
+            "metric": "bench error", "value": 0, "unit": "error",
+            "vs_baseline": 0, "error": f"{type(e).__name__}: {e}"}),
+            flush=True)
+        raise SystemExit(0)

@@ -100,6 +100,7 @@ def lib() -> Optional[ctypes.CDLL]:
         return None
     try:
         cdll = ctypes.CDLL(so)
+        cdll.ompi_tpu_native_abi.restype = ctypes.c_int64
         if cdll.ompi_tpu_native_abi() != _ABI:
             return None
         u8p = ctypes.POINTER(ctypes.c_uint8)

@@ -257,9 +257,18 @@ def _job_info(comm: Communicator) -> dict:
     namespace base: one past every id this job's endpoints already know
     (world ranks AND ids installed by earlier connect/accept calls, so
     repeated dpm operations never collide)."""
-    addr_rows = comm.gather(
-        np.frombuffer(comm.pml.address.encode().ljust(64), np.uint8),
-        root=0)
+    addr = comm.pml.address.encode()
+    # outcome must be collective: a rank-local raise here would leave the
+    # other ranks blocked in the gather below
+    too_long = int(np.asarray(comm.allreduce(
+        np.array([1 if len(addr) > 64 else 0], np.int32),
+        op=_max_op()))[0])
+    if too_long:
+        raise MPIException(
+            f"a BTL address exceeds the 64-byte business-card slot "
+            f"(mine: {comm.pml.address!r}); cannot exchange over "
+            f"fixed-width gather")
+    addr_rows = comm.gather(np.frombuffer(addr.ljust(64), np.uint8), root=0)
     addrs = None
     if comm.rank == 0:
         addrs = [bytes(np.asarray(r)).decode().strip() for r in addr_rows]

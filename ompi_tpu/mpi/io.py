@@ -183,6 +183,7 @@ class File:
         self._pos = 0                    # individual pointer, etype units
         self._atomicity = False
         self._closed = False
+        self._fd: Optional[int] = None
         from ompi_tpu.mpi.errhandler import ERRORS_RETURN
         from ompi_tpu.mpi.info import Info
 
@@ -195,12 +196,12 @@ class File:
         # O_WRONLY would break pread — open RDWR and gate in software
         if amode & MODE_CREATE:
             flags |= os.O_CREAT
+        err = ""
         if amode & MODE_EXCL:
             # EXCL is a *collective* exists-check: rank 0 does the
             # exclusive create and broadcasts the outcome (a plain barrier
             # would hang the others if rank 0's open fails), then the rest
             # open the now-existing file
-            err = ""
             if comm.rank == 0:
                 try:
                     self._fd = os.open(self.path, flags | os.O_EXCL, 0o644)
@@ -216,14 +217,25 @@ class File:
                 try:
                     self._fd = os.open(self.path, flags & ~os.O_CREAT)
                 except OSError as e:
-                    raise MPIException(f"MPI_File_open({path}): {e}",
-                                       error_class=38) from None
+                    err = str(e)
         else:
             try:
                 self._fd = os.open(self.path, flags, 0o644)
             except OSError as e:
-                raise MPIException(f"MPI_File_open({path}): {e}",
-                                   error_class=38) from None
+                err = str(e)
+        # collective outcome check: a per-rank open failure (perms / path
+        # visible on only some ranks / EXCL non-root open racing a delete)
+        # must raise on EVERY rank — otherwise the survivors proceed to the
+        # barrier below and the job hangs
+        nfail = int(np.asarray(comm.allreduce(
+            np.array([0 if not err else 1], np.int32)))[0])
+        if nfail:
+            if self._fd is not None and not err:
+                os.close(self._fd)
+                self._fd = None
+            raise MPIException(
+                f"MPI_File_open({path}): failed on {nfail} rank(s)"
+                + (f": {err}" if err else ""), error_class=38)
         if amode & MODE_APPEND:
             self._pos = os.fstat(self._fd).st_size // self.view.etype.size
         # shared pointer sidecar: rank 0 resets it (to EOF under APPEND —
