@@ -41,10 +41,16 @@ class BufferLocationError(TypeError):
 
 def classify(buf: Any) -> BufferKind:
     """Classify a user buffer. Cheap for host buffers (no jax import)."""
+    if buf is None:  # "no data on this rank" placeholder (non-root scatter)
+        return BufferKind.HOST
     if isinstance(buf, np.ndarray) or np.isscalar(buf):
         return BufferKind.HOST
-    if isinstance(buf, (bytes, bytearray, memoryview, list)):
+    if isinstance(buf, (bytes, bytearray, memoryview)):
         return BufferKind.HOST
+    if isinstance(buf, (list, tuple)):
+        # v-collective part lists: the parts share a location; classify the
+        # first (an empty list is a host no-op)
+        return classify(buf[0]) if buf else BufferKind.HOST
     # Only now touch jax (keeps host-only processes light).
     mod = type(buf).__module__ or ""
     if mod.startswith("jax") or hasattr(buf, "aval"):
@@ -56,6 +62,15 @@ def classify(buf: Any) -> BufferKind:
 
         if isinstance(buf, jax.Array):
             return BufferKind.DEVICE
+    # any other array-like the host path already accepts (array.array,
+    # pandas Series, objects with __array__ / the buffer protocol)
+    if hasattr(buf, "__array__") or hasattr(buf, "__array_interface__"):
+        return BufferKind.HOST
+    try:
+        memoryview(buf)
+        return BufferKind.HOST
+    except TypeError:
+        pass
     raise BufferLocationError(
         f"cannot classify buffer of type {type(buf).__name__}; expected "
         f"numpy array, jax array, or bytes-like")

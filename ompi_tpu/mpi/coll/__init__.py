@@ -3,7 +3,7 @@
 ≈ ompi/mca/coll: a per-communicator function table filled by priority-ordered
 component query (coll.h:426-530, coll_base_comm_select.c:107,270).  Components
 may implement any subset of the collective functions; for each function the
-highest-priority component providing it wins, so e.g. a future accelerated
+highest-priority component providing it wins, so e.g. an accelerated
 component can override just allreduce while ``host`` keeps the rest — the
 exact stacking semantics of the reference.
 
@@ -12,16 +12,23 @@ Components here:
   (≈ coll/self).
 - ``host``  — the full algorithm library over host p2p with a tuned-style
   decision layer (≈ coll/base + coll/tuned).
+- ``xla``   — the device path (≈ the coll/cuda slot, inverted): collectives
+  on jax arrays lower to lax.psum/all_gather/all_to_all/ppermute over the
+  communicator's bound DeviceCommunicator — zero host copies.
 
-The device path (``coll/xla`` lowering to lax.psum/all_gather/ppermute/
-all_to_all) lives on DeviceCommunicator (ompi_tpu.mpi.device_comm) because it
-executes inside jit-traced SPMD programs, not against host buffers.
+Buffer-location dispatch: each table slot is a dispatcher that routes by
+``core.buffer.classify()`` — HOST buffers to the best host-capable
+component, DEVICE/TRACED buffers to the best device-capable one.  This is
+the single choke point the reference never had (its CUDA checks are
+sprinkled through convertor/pml/btl/coll); a device buffer reaching a
+host-only table raises ``BufferLocationError`` instead of silently staging.
 """
 
 from __future__ import annotations
 
-from typing import TYPE_CHECKING
+from typing import TYPE_CHECKING, Optional
 
+from ompi_tpu.core.buffer import BufferKind, BufferLocationError, classify
 from ompi_tpu.core.mca import Component, Framework
 
 if TYPE_CHECKING:
@@ -38,13 +45,46 @@ COLL_FUNCTIONS = (
     "exscan", "gatherv", "scatterv", "allgatherv", "alltoallv",
 )
 
+# slots whose first argument is a data buffer (everything but barrier)
+_BUFFER_SLOTS = frozenset(COLL_FUNCTIONS) - {"barrier"}
+
 
 class CollModule:
-    """The per-communicator collective table. Attributes are bound functions
-    chosen per-slot from the winning components."""
+    """The per-communicator collective table. Attributes are bound
+    dispatchers choosing host vs device providers per buffer location."""
 
     def __init__(self) -> None:
-        self.providers: dict[str, str] = {}  # slot → component name (introspection)
+        # slot → component name serving host buffers (introspection)
+        self.providers: dict[str, str] = {}
+        # slot → component name serving device/traced buffers
+        self.device_providers: dict[str, str] = {}
+
+
+def _handles(comp: Component) -> frozenset:
+    return getattr(comp, "HANDLES", frozenset({"host"}))
+
+
+def _make_dispatch(slot: str, host_fn, host_name: Optional[str],
+                   dev_fn, dev_name: Optional[str]):
+    def dispatch(comm, buf, *args, **kw):
+        if classify(buf) is BufferKind.HOST:
+            if host_fn is None:
+                raise BufferLocationError(
+                    f"{slot}: host buffer but no host-capable coll "
+                    f"component selected (directive excludes "
+                    f"host/self; device path [{dev_name}] needs jax "
+                    f"arrays)")
+            return host_fn(comm, buf, *args, **kw)
+        if dev_fn is None:
+            raise BufferLocationError(
+                f"{slot}: device/traced buffer but no device-capable coll "
+                f"component selected (have [{host_name}]; enable coll/xla "
+                f"and comm.bind_device(...) for the device path, or "
+                f"np.asarray() the buffer if host staging is intended)")
+        return dev_fn(comm, buf, *args, **kw)
+
+    dispatch.__name__ = f"coll_{slot}_dispatch"
+    return dispatch
 
 
 def install(comm: "Communicator") -> None:
@@ -52,18 +92,34 @@ def install(comm: "Communicator") -> None:
     # import registers the components
     from ompi_tpu.mpi.coll import host as _host  # noqa: F401
     from ompi_tpu.mpi.coll import selfcoll as _selfcoll  # noqa: F401
+    from ompi_tpu.mpi.coll import xla as _xla  # noqa: F401
 
     module = CollModule()
     ranked = coll_framework.select_all(comm=comm)
     for slot in COLL_FUNCTIONS:
+        host_fn = host_name = dev_fn = dev_name = None
         for comp in ranked:
             fn = getattr(comp, f"coll_{slot}", None)
-            if fn is not None:
-                setattr(module, slot, fn)
-                module.providers[slot] = comp.NAME
-                break
-        else:
+            if fn is None:
+                continue
+            caps = _handles(comp)
+            if host_fn is None and "host" in caps:
+                host_fn, host_name = fn, comp.NAME
+            if dev_fn is None and ("device" in caps or "traced" in caps):
+                dev_fn, dev_name = fn, comp.NAME
+        if host_fn is None and dev_fn is None:
             setattr(module, slot, _unimplemented(slot))
+            continue
+        if slot in _BUFFER_SLOTS:
+            setattr(module, slot,
+                    _make_dispatch(slot, host_fn, host_name, dev_fn,
+                                   dev_name))
+        else:  # barrier: no buffer to classify; host provider wins
+            setattr(module, slot, host_fn or dev_fn)
+        if host_name:
+            module.providers[slot] = host_name
+        if dev_name:
+            module.device_providers[slot] = dev_name
     comm.coll = module
 
 

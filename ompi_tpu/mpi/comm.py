@@ -27,7 +27,7 @@ import numpy as np
 
 from ompi_tpu.mpi import datatype as dt_mod
 from ompi_tpu.mpi.constants import (
-    ANY_TAG, PROC_NULL, UNDEFINED, MPIException,
+    ANY_SOURCE, ANY_TAG, PROC_NULL, UNDEFINED, MPIException,
 )
 from ompi_tpu.mpi.datatype import Datatype
 from ompi_tpu.mpi.group import Group
@@ -54,6 +54,7 @@ class Communicator:
         self._cid_counter = itertools.count(cid * 1024 + 1)
         self._lock = threading.Lock()
         self.coll = None  # installed by ompi_tpu.mpi.coll.install()
+        self.device = None  # bound DeviceCommunicator (coll/xla path)
         self.attrs: dict[Any, Any] = {}  # ≈ MPI attribute caching
         # error policy (≈ ompi_errhandler; default mirrors ERRORS_RETURN —
         # the MPIException propagating IS the returned error code here)
@@ -331,6 +332,17 @@ class Communicator:
 
         return nbc.ialltoallv(self, sendparts)
 
+    # -- device path binding (coll/xla) ------------------------------------
+
+    def bind_device(self, device_comm) -> "Communicator":
+        """Bind a DeviceCommunicator: collectives on jax arrays then route
+        through coll/xla over its mesh axes (zero host copies).  Returns
+        self for chaining.  ≈ installing coll/cuda's module on the comm —
+        except the device path replaces the host algorithms instead of
+        bounce-buffering into them."""
+        self.device = device_comm
+        return self
+
     # -- construction ------------------------------------------------------
 
     def _next_cid(self) -> int:
@@ -380,6 +392,7 @@ class Communicator:
                            self._world_rank, name or f"{self.name}.dup")
         self._copy_attrs(new)
         new.errhandler = self.errhandler
+        new.device = self.device  # same group ⇒ same mesh binding
         return new
 
     def create(self, group: Group, name: Optional[str] = None

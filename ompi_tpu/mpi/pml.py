@@ -29,6 +29,7 @@ from typing import Any, Optional
 import numpy as np
 
 from ompi_tpu.core import output
+from ompi_tpu.core.buffer import BufferKind, BufferLocationError, classify
 from ompi_tpu.core.config import VarType, register_var, var_registry
 from ompi_tpu.core.mca import Component, Framework
 from ompi_tpu.mpi import datatype as dt_mod
@@ -38,6 +39,22 @@ from ompi_tpu.mpi.datatype import Datatype
 from ompi_tpu.mpi.request import Request, Status
 
 __all__ = ["pml_framework", "PmlOb1", "RecvRequest"]
+
+
+def _reject_device(buf: Any, what: str) -> None:
+    """Device/traced buffers must NEVER silently host-stage through the PML
+    (the reference's coll/cuda bounce-buffer anti-pattern this design
+    forbids).  They belong on the device path: a comm with a bound
+    DeviceCommunicator (comm.bind_device), or lax collectives inside jit."""
+    kind = classify(buf)
+    if kind is not BufferKind.HOST:
+        raise BufferLocationError(
+            f"pml.{what}: got a {kind.value} buffer; the host PML would "
+            f"stage it through host memory. Use the device path instead "
+            f"(comm.bind_device(DeviceCommunicator(...)) routes collectives "
+            f"over XLA/ICI; for p2p use DeviceCommunicator.shift/permute "
+            f"inside jit), or np.asarray() the buffer explicitly if host "
+            f"staging is intended.")
 
 _log = output.get_stream("pml")
 
@@ -204,6 +221,7 @@ class PmlOb1:
     def isend(self, buf: Any, peer: int, tag: int, cid: int,
               datatype: Optional[Datatype] = None,
               count: Optional[int] = None) -> Request:
+        _reject_device(buf, "isend")
         arr = np.asarray(buf)
         if datatype is None:
             datatype = dt_mod.from_numpy(arr.dtype)
@@ -243,6 +261,7 @@ class PmlOb1:
               cid: int, datatype: Optional[Datatype] = None,
               count: Optional[int] = None) -> RecvRequest:
         if buf is not None:
+            _reject_device(buf, "irecv")
             buf = np.asarray(buf)
             if datatype is None:
                 datatype = dt_mod.from_numpy(buf.dtype)

@@ -22,6 +22,9 @@ _REGISTERING_MODULES = [
     "ompi_tpu.runtime.errmgr",
     "ompi_tpu.runtime.launcher",
     "ompi_tpu.mpi.coll",
+    "ompi_tpu.mpi.coll.host",
+    "ompi_tpu.mpi.coll.selfcoll",
+    "ompi_tpu.mpi.coll.xla",
     "ompi_tpu.mpi.pml",
     "ompi_tpu.mpi.op",
     "ompi_tpu.mpi.io",
