@@ -52,6 +52,16 @@ _LAZY = {
     "Op": ("ompi_tpu.mpi.op", "Op"),
     "Request": ("ompi_tpu.mpi.request", "Request"),
     "Status": ("ompi_tpu.mpi.request", "Status"),
+    "PersistentRequest": ("ompi_tpu.mpi.request", "PersistentRequest"),
+    "wait_all": ("ompi_tpu.mpi.request", "wait_all"),
+    "wait_any": ("ompi_tpu.mpi.request", "wait_any"),
+    "wait_some": ("ompi_tpu.mpi.request", "wait_some"),
+    "test_all": ("ompi_tpu.mpi.request", "test_all"),
+    "test_any": ("ompi_tpu.mpi.request", "test_any"),
+    "test_some": ("ompi_tpu.mpi.request", "test_some"),
+    "start_all": ("ompi_tpu.mpi.request", "start_all"),
+    "buffer_attach": ("ompi_tpu.mpi.pml", "buffer_attach"),
+    "buffer_detach": ("ompi_tpu.mpi.pml", "buffer_detach"),
     "ANY_SOURCE": ("ompi_tpu.mpi.constants", "ANY_SOURCE"),
     "ANY_TAG": ("ompi_tpu.mpi.constants", "ANY_TAG"),
     "PROC_NULL": ("ompi_tpu.mpi.constants", "PROC_NULL"),

@@ -107,16 +107,7 @@ class Communicator:
     def isend(self, buf: Any, dest: int, tag: int = 0,
               datatype: Optional[Datatype] = None,
               count: Optional[int] = None) -> Request:
-        if not self._check_rank(dest, "dest"):
-            return CompletedRequest()
-        if tag < 0:
-            self._raise(MPIException(f"negative tag {tag} is reserved",
-                                     error_class=4))
-            return CompletedRequest()  # swallowed: must not hit the
-            # reserved internal tag space
-        if dest == PROC_NULL:
-            return CompletedRequest()
-        return self._isend(buf, dest, tag, datatype, count)
+        return self._isend_mode("standard", buf, dest, tag, datatype, count)
 
     def _isend(self, buf, dest, tag, datatype=None, count=None) -> Request:
         return self.pml.isend(buf, self.world_rank(dest), tag, self.cid,
@@ -126,6 +117,95 @@ class Communicator:
              datatype: Optional[Datatype] = None,
              count: Optional[int] = None) -> None:
         self.isend(buf, dest, tag, datatype, count).wait()
+
+    # send modes (≈ MPI_Ssend/Bsend/Rsend and their nonblocking forms)
+
+    def _send_args_ok(self, dest: int, tag: int) -> bool:
+        """Shared dest/tag validation for every send flavor. False ⇒ the
+        caller should return a no-op request (error was routed through the
+        errhandler, or dest is PROC_NULL)."""
+        if not self._check_rank(dest, "dest"):
+            return False
+        if tag < 0:
+            self._raise(MPIException(f"negative tag {tag} is reserved",
+                                     error_class=4))
+            return False  # swallowed: must not hit the internal tag space
+        return dest != PROC_NULL
+
+    def _isend_mode(self, mode: str, buf, dest, tag, datatype, count
+                    ) -> Request:
+        if not self._send_args_ok(dest, tag):
+            return CompletedRequest()
+        return self.pml.isend(buf, self.world_rank(dest), tag, self.cid,
+                              datatype, count, mode=mode)
+
+    def issend(self, buf, dest: int, tag: int = 0, datatype=None,
+               count=None) -> Request:
+        """≈ MPI_Issend: completes once the matching recv is posted."""
+        return self._isend_mode("sync", buf, dest, tag, datatype, count)
+
+    def ssend(self, buf, dest: int, tag: int = 0, **kw) -> None:
+        self.issend(buf, dest, tag, **kw).wait()
+
+    def ibsend(self, buf, dest: int, tag: int = 0, datatype=None,
+               count=None) -> Request:
+        """≈ MPI_Ibsend: local completion against the attached buffer
+        (ompi_tpu.mpi.pml.buffer_attach)."""
+        return self._isend_mode("buffered", buf, dest, tag, datatype, count)
+
+    def bsend(self, buf, dest: int, tag: int = 0, **kw) -> None:
+        self.ibsend(buf, dest, tag, **kw).wait()
+
+    def irsend(self, buf, dest: int, tag: int = 0, datatype=None,
+               count=None) -> Request:
+        """≈ MPI_Irsend: erroneous (fails) unless the recv is posted."""
+        return self._isend_mode("ready", buf, dest, tag, datatype, count)
+
+    def rsend(self, buf, dest: int, tag: int = 0, **kw) -> None:
+        self.irsend(buf, dest, tag, **kw).wait()
+
+    # persistent requests (≈ MPI_Send_init/Recv_init, pml.h:502-505)
+
+    def send_init(self, buf, dest: int, tag: int = 0, datatype=None,
+                  count=None, mode: str = "standard"):
+        """≈ MPI_Send_init: inactive persistent send; arm with .start().
+        The buffer is re-read at each start."""
+        from ompi_tpu.mpi.request import PersistentRequest
+
+        if not self._send_args_ok(dest, tag):
+            return PersistentRequest(CompletedRequest,
+                                     kind="persistent-send")
+        return PersistentRequest(
+            lambda: self.pml.isend(buf, self.world_rank(dest), tag,
+                                   self.cid, datatype, count, mode=mode),
+            kind="persistent-send")
+
+    def recv_init(self, buf=None, source: int = 0, tag: int = ANY_TAG,
+                  datatype=None, count=None):
+        """≈ MPI_Recv_init: inactive persistent recv; arm with .start()."""
+        from ompi_tpu.mpi.request import PersistentRequest
+
+        def _null():
+            return CompletedRequest(
+                np.empty(0, dtype=(datatype or dt_mod.BYTE).base_np))
+
+        # same source validation as irecv: bad sources route through the
+        # errhandler instead of crashing (IndexError) or hanging (a recv
+        # that can never match)
+        if source < 0 and source not in (ANY_SOURCE, PROC_NULL):
+            self._raise(MPIException(
+                f"source {source} is neither a rank nor "
+                f"ANY_SOURCE/PROC_NULL", error_class=6))
+            return PersistentRequest(_null, kind="persistent-recv")
+        if source == PROC_NULL or (source >= 0
+                                   and not self._check_rank(source,
+                                                            "source")):
+            return PersistentRequest(_null, kind="persistent-recv")
+        src = source if source < 0 else self.world_rank(source)
+        return PersistentRequest(
+            lambda: self.pml.irecv(buf, src, tag, self.cid, datatype,
+                                   count),
+            kind="persistent-recv")
 
     def irecv(self, buf: Optional[np.ndarray] = None, source: int = 0,
               tag: int = ANY_TAG, datatype: Optional[Datatype] = None,

@@ -77,6 +77,25 @@ class RecvRequest(Request):
         self.tag = tag
         self.cid = cid
         self.rid = -1  # receiver-side id for rendezvous
+        self._pml = None  # set by PmlOb1.irecv; enables real cancel
+
+    def cancel(self) -> None:
+        """≈ MPI_Cancel on a recv: dequeue the posted request if (and only
+        if) nothing has matched it yet; a matched/completed recv proceeds
+        (MPI's 'cancel either succeeds or the operation succeeds')."""
+        pml = self._pml
+        if pml is None or self.done():
+            return
+        with pml._lock:
+            m = pml._matching.get(self.cid)
+            if m is None:
+                return
+            try:
+                m.posted.remove(self)
+            except ValueError:
+                return  # already matched — delivery wins
+        self.cancelled = True
+        self.complete(None)
 
 
 def _dtype_to_wire(dt: np.dtype):
@@ -101,24 +120,129 @@ def _wire_to_dtype(spec) -> np.dtype:
 
 
 class _SendState:
-    """Sender-side rendezvous bookkeeping (awaiting CTS)."""
+    """Sender-side bookkeeping for sends awaiting a peer event (rendezvous
+    CTS, sync-mode ack, ready-mode nack)."""
 
-    def __init__(self, req: Request, peer: int, payload: bytes) -> None:
+    def __init__(self, req: Request, peer: int, payload,
+                 on_done=None) -> None:
         self.req = req
         self.peer = peer
-        self.payload = payload
+        self.payload = payload   # bytes or zero-copy memoryview of user buf
+        self.on_done = on_done   # e.g. bsend-pool release
 
 
 class _RecvState:
-    """Receiver-side rendezvous accumulation."""
+    """Receiver-side rendezvous accumulation.
+
+    ``direct=True`` ⇒ ``data`` is a uint8 view of the user's posted buffer
+    and fragments land in place — no intermediate copy (the reference
+    pipelines straight into the receive convertor the same way,
+    pml_ob1_recvreq.c).  Otherwise ``data`` is a staging bytearray that
+    ``_deliver`` unpacks through the datatype engine.
+    """
 
     def __init__(self, req: RecvRequest, size: int, src_hdr: dict,
-                 peer: int) -> None:
+                 peer: int, direct: bool = False) -> None:
         self.req = req
-        self.data = bytearray(size)
+        self.direct = direct
+        if direct:
+            self.data = req.buf.reshape(-1).view(np.uint8)[:size]
+        else:
+            self.data = bytearray(size)
         self.received = 0
         self.src_hdr = src_hdr
         self.peer = peer
+
+
+class BsendPool:
+    """The attached MPI_Buffer_attach pool (per process, ≈ ompi/mpi/c/
+    buffer_attach.c + pml bsend accounting).  Byte-counted, not an
+    allocator: payloads are Python objects; the pool enforces the MPI
+    contract that buffered sends beyond the attached capacity fail."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.capacity = 0
+        self.used = 0
+
+    def attach(self, nbytes: int) -> None:
+        with self._lock:
+            if self.capacity:
+                raise MPIException(
+                    "a bsend buffer is already attached", error_class=1)
+            self.capacity = int(nbytes)
+
+    def detach(self) -> int:
+        """Blocks until pending buffered sends drain (MPI semantics), then
+        returns the detached capacity."""
+        while True:
+            with self._lock:
+                if self.used == 0:
+                    cap, self.capacity = self.capacity, 0
+                    return cap
+            import time as _t
+
+            _t.sleep(0.001)
+
+    def reserve(self, nbytes: int) -> None:
+        with self._lock:
+            if self.used + nbytes > self.capacity:
+                raise MPIException(
+                    f"bsend of {nbytes}B exceeds attached buffer "
+                    f"({self.used}/{self.capacity}B in use); "
+                    f"MPI_Buffer_attach more", error_class=1)
+            self.used += nbytes
+
+    def release(self, nbytes: int) -> None:
+        with self._lock:
+            self.used -= nbytes
+
+
+def buffer_attach(nbytes: int) -> None:
+    """≈ MPI_Buffer_attach — attaches to this process's (world) PML.
+    The pool is per-PML so in-process multi-rank harnesses keep ranks'
+    buffers independent, exactly like separate MPI processes."""
+    _world_pml().bsend_pool.attach(nbytes)
+
+
+def buffer_detach() -> int:
+    """≈ MPI_Buffer_detach — blocks until buffered sends complete."""
+    return _world_pml().bsend_pool.detach()
+
+
+def _world_pml() -> "PmlOb1":
+    from ompi_tpu.mpi import runtime
+
+    world = runtime.COMM_WORLD
+    if world is None or not hasattr(world, "pml"):
+        raise MPIException(
+            "buffer_attach/detach need an initialized runtime "
+            "(ompi_tpu.init()); in harness code use comm.pml.bsend_pool")
+    return world.pml
+
+
+class _WireWatch(Request):
+    """Tracks the wire write of a frame whose *logical* completion comes
+    from a later peer event (sack for sync/ready, CTS→data for rndv).
+    Success is a no-op; a transport failure must tear down the pending
+    send state and fail the real request — otherwise the caller's wait()
+    hangs forever on a dead connection."""
+
+    def __init__(self, pml: "PmlOb1", sid: int) -> None:
+        super().__init__(kind="wire")
+        self._pml = pml
+        self._sid = sid
+
+    def complete(self, result: Any = None) -> None:
+        pass  # the real request completes on sack / after rndv data
+
+    def fail(self, exc: BaseException) -> None:
+        with self._pml._lock:
+            state = self._pml._send_states.pop(self._sid, None)
+        if state is not None:
+            if state.on_done:
+                state.on_done()
+            state.req.fail(exc)
 
 
 class _Matching:
@@ -161,9 +285,12 @@ class PmlOb1:
         self._recv_states: dict[int, _RecvState] = {}
         self._ids = itertools.count(1)
         self._seq: dict[tuple[int, int], int] = {}
+        self._recv_seq: dict[tuple[int, int], int] = {}
+        self._held: dict[tuple[int, int], dict[int, tuple]] = {}
         self._sendq: "queue.Queue[Optional[tuple]]" = queue.Queue()
         self._listeners: list = []   # peruse/monitoring subscribers
         self._events: "collections.deque[tuple]" = collections.deque()
+        self.bsend_pool = BsendPool()  # per-PML, like every other send state
         self._worker = threading.Thread(
             target=self._send_loop, name=f"pml-send-{rank}", daemon=True)
         self._worker.start()
@@ -220,15 +347,35 @@ class PmlOb1:
 
     def isend(self, buf: Any, peer: int, tag: int, cid: int,
               datatype: Optional[Datatype] = None,
-              count: Optional[int] = None) -> Request:
+              count: Optional[int] = None, mode: str = "standard") -> Request:
+        """mode ∈ standard | sync (ssend) | ready (rsend) | buffered (bsend)
+        — the four MPI send modes (≈ pml.h:211 MCA_PML_BASE_SEND_*)."""
         _reject_device(buf, "isend")
         arr = np.asarray(buf)
         if datatype is None:
             datatype = dt_mod.from_numpy(arr.dtype)
         if count is None:
             count = arr.size // max(1, datatype.elements_per_item)
-        payload = datatype.pack(arr, count)
+        nbytes = count * datatype.size
+        # zero-copy path: a contiguous send of the whole buffer rides a
+        # memoryview of the user's array — no sender-side staging copy (the
+        # MPI contract forbids touching the buffer until completion anyway;
+        # ≈ pml_ob1_sendreq.h:382-413 sending from the user iovec).
+        # Buffered mode always copies: the user may reuse immediately.
+        if (mode != "buffered" and datatype.is_contiguous
+                and arr.flags["C_CONTIGUOUS"] and nbytes == arr.nbytes):
+            payload = arr.reshape(-1).view(np.uint8).data
+        else:
+            payload = datatype.pack(arr, count)
         req = Request(kind="send")
+        on_done = None
+        if mode == "buffered":
+            # reserve BEFORE allocating a wire seq: a failed reserve must
+            # not burn a sequence number (the peer would hold back every
+            # later frame waiting for it)
+            self.bsend_pool.reserve(len(payload))
+            on_done = (lambda n=len(payload):  # noqa: E731
+                       self.bsend_pool.release(n))
         with self._lock:
             seq_key = (peer, cid)
             seq = self._seq.get(seq_key, 0)
@@ -240,17 +387,57 @@ class PmlOb1:
         if self._listeners:
             self._emit(EVT_SEND_POST, peer=peer, tag=tag, cid=cid,
                        nbytes=len(payload))
-        if len(payload) <= var_registry.get("pml_eager_limit"):
+        eager = len(payload) <= var_registry.get("pml_eager_limit")
+        if eager and mode in ("sync", "ready"):
+            # matched-ack protocol: the frame carries a sync id; the peer
+            # acks on match (sync) or nacks when nothing was posted (ready)
+            sid = next(self._ids)
+            hdr.update(t="eager", sid=sid, sm=mode[0])  # sm: "s" | "r"
+            with self._lock:
+                self._send_states[sid] = _SendState(req, peer, None, on_done)
+            self._sendq.put(("frame", peer, hdr, payload,
+                             _WireWatch(self, sid)))
+        elif eager:
             hdr["t"] = "eager"
-            self._sendq.put(("frame", peer, hdr, payload, req))
+            if mode == "buffered":
+                wire = Request(kind="send")
+                wire.add_completion_callback(lambda _r: on_done())
+                self._sendq.put(("frame", peer, hdr, payload, wire))
+                req.complete(None)  # local completion
+            else:
+                self._sendq.put(("frame", peer, hdr, payload, req))
         else:
             sid = next(self._ids)
             hdr.update(t="rndv", size=len(payload), sid=sid)
+            if mode == "ready":
+                hdr["sm"] = "r"  # peer nacks instead of queueing unexpected
+            state_req = req
+            if mode == "buffered":
+                wire = Request(kind="send")
+                wire.add_completion_callback(lambda _r: on_done())
+                state_req = wire
+                req.complete(None)  # local completion; pool holds the copy
             with self._lock:
-                self._send_states[sid] = _SendState(req, peer, payload)
-            self._sendq.put(("frame", peer, hdr, b"", None))
+                self._send_states[sid] = _SendState(
+                    state_req, peer, payload,
+                    None if mode == "buffered" else on_done)
+            self._sendq.put(("frame", peer, hdr, b"",
+                             _WireWatch(self, sid)))
         self._drain_events()
         return req
+
+    def issend(self, buf, peer, tag, cid, **kw) -> Request:
+        """≈ MPI_Issend: completes only once the matching recv is posted."""
+        return self.isend(buf, peer, tag, cid, mode="sync", **kw)
+
+    def ibsend(self, buf, peer, tag, cid, **kw) -> Request:
+        """≈ MPI_Ibsend: completes locally against the attached buffer."""
+        return self.isend(buf, peer, tag, cid, mode="buffered", **kw)
+
+    def irsend(self, buf, peer, tag, cid, **kw) -> Request:
+        """≈ MPI_Irsend: erroneous unless the recv is already posted — the
+        peer nacks and the request fails."""
+        return self.isend(buf, peer, tag, cid, mode="ready", **kw)
 
     def send(self, buf: Any, peer: int, tag: int, cid: int, **kw) -> None:
         self.isend(buf, peer, tag, cid, **kw).wait()
@@ -271,6 +458,7 @@ class PmlOb1:
         # the element dtype travels in the wire header
         req = RecvRequest(buf, datatype, count, source, tag, cid)
         req.rid = next(self._ids)
+        req._pml = self
         if self._listeners:
             self._emit(EVT_RECV_POST, peer=source, tag=tag, cid=cid)
         with self._lock:
@@ -331,24 +519,27 @@ class PmlOb1:
         t = hdr["t"]
         if t in ("eager", "rndv"):
             with self._lock:
-                m = self._matching_for(hdr["cid"])
-                req = None
-                for i, cand in enumerate(m.posted):
-                    if _hdr_matches(cand, peer, hdr):
-                        del m.posted[i]
-                        req = cand
-                        break
-                if req is None:
-                    m.unexpected.append((peer, hdr, payload))
-                    self._cv.notify_all()
-                    if self._listeners:
-                        self._emit(EVT_UNEXPECTED, peer=peer,
-                                   tag=hdr["tag"], cid=hdr["cid"])
-                else:
-                    if self._listeners:
-                        self._emit(EVT_MATCH, peer=peer, tag=hdr["tag"],
-                                   cid=hdr["cid"])
-                    self._match(req, peer, hdr, payload)
+                # per-(peer, cid) sequence enforcement: TCP + one reader
+                # already guarantee order, but a future non-FIFO BTL (shm
+                # rings, multi-rail) must not break matching order — frames
+                # arriving early are held back until their turn
+                key = (peer, hdr["cid"])
+                seq, expected = hdr["seq"], self._recv_seq.get(key, 0)
+                if seq != expected:
+                    # held frames outlive the sender's call: own the bytes
+                    # (a zero-copy self-BTL payload aliases the user buffer)
+                    if isinstance(payload, memoryview):
+                        payload = bytes(payload)
+                    self._held.setdefault(key, {})[seq] = (hdr, payload)
+                    return
+                self._match_incoming(peer, hdr, payload)
+                nxt = expected + 1
+                held = self._held.get(key)
+                while held and nxt in held:
+                    h2, p2 = held.pop(nxt)
+                    self._match_incoming(peer, h2, p2)
+                    nxt += 1
+                self._recv_seq[key] = nxt
             self._drain_events()
         elif t == "cts":
             with self._lock:
@@ -357,16 +548,77 @@ class PmlOb1:
                 self._sendq.put(("rndv_data", state, hdr["rid"]))
         elif t == "data":
             self._on_data(hdr, payload)
+        elif t == "sack":   # sync/ready send matched on the peer
+            with self._lock:
+                state = self._send_states.pop(hdr["sid"], None)
+            if state is not None:
+                if state.on_done:
+                    state.on_done()
+                state.req.complete(None)
+        elif t == "rnack":  # ready send found no posted recv
+            with self._lock:
+                state = self._send_states.pop(hdr["sid"], None)
+            if state is not None:
+                if state.on_done:
+                    state.on_done()
+                state.req.fail(MPIException(
+                    "rsend: no matching receive was posted at the peer",
+                    error_class=4))
         else:
             _log.error("unknown frame type %r from %d", t, peer)
+
+    def _match_incoming(self, peer: int, hdr: dict, payload: bytes) -> None:
+        """Called with self._lock held: match one in-order frame."""
+        m = self._matching_for(hdr["cid"])
+        req = None
+        for i, cand in enumerate(m.posted):
+            if _hdr_matches(cand, peer, hdr):
+                del m.posted[i]
+                req = cand
+                break
+        if req is None:
+            if hdr.get("sm") == "r":  # ready-mode: erroneous, nack sender
+                self._sendq.put(("frame", peer,
+                                 {"t": "rnack", "sid": hdr["sid"]}, b"",
+                                 None))
+                return
+            # zero-copy self-BTL payloads alias the sender's live buffer —
+            # an unexpected frame must own its bytes (the sender is free to
+            # modify once its request completes)
+            if isinstance(payload, memoryview):
+                payload = bytes(payload)
+            m.unexpected.append((peer, hdr, payload))
+            self._cv.notify_all()
+            if self._listeners:
+                self._emit(EVT_UNEXPECTED, peer=peer,
+                           tag=hdr["tag"], cid=hdr["cid"])
+        else:
+            if self._listeners:
+                self._emit(EVT_MATCH, peer=peer, tag=hdr["tag"],
+                           cid=hdr["cid"])
+            self._match(req, peer, hdr, payload)
 
     def _match(self, req: RecvRequest, peer: int, hdr: dict,
                payload: bytes) -> None:
         """Called with self._lock held. Eager: deliver now. Rndv: send CTS."""
         if hdr["t"] == "eager":
+            if "sm" in hdr:  # sync/ready sender waits for the matched-ack
+                self._sendq.put(("frame", peer,
+                                 {"t": "sack", "sid": hdr["sid"]}, b"",
+                                 None))
             self._deliver(req, peer, hdr, payload)
         else:  # rndv
-            self._recv_states[req.rid] = _RecvState(req, hdr["size"], hdr, peer)
+            # fragments land directly in the user buffer when it is posted,
+            # contiguous, and large enough (no intermediate staging buffer)
+            direct = (req.buf is not None
+                      and req.datatype is not None
+                      and req.datatype.is_contiguous
+                      and req.buf.flags["C_CONTIGUOUS"]
+                      and req.buf.nbytes >= hdr["size"]
+                      and (req.count is None
+                           or req.count * req.datatype.size >= hdr["size"]))
+            self._recv_states[req.rid] = _RecvState(
+                req, hdr["size"], hdr, peer, direct=direct)
             # CTS is a tiny control frame; safe to enqueue (never inline-send
             # from a reader thread)
             self._sendq.put(("frame", peer,
@@ -379,15 +631,34 @@ class PmlOb1:
             if state is None:
                 return
             off = hdr["off"]
-            state.data[off:off + len(payload)] = payload
+            if state.direct:
+                state.data[off:off + len(payload)] = \
+                    np.frombuffer(payload, np.uint8)
+            else:
+                state.data[off:off + len(payload)] = payload
             state.received += len(payload)
             done = state.received >= len(state.data)
             if done:
                 del self._recv_states[hdr["rid"]]
         if done:
-            self._deliver(state.req, state.peer, state.src_hdr,
-                          bytes(state.data))
+            if state.direct:
+                self._complete_direct(state)
+            else:
+                self._deliver(state.req, state.peer, state.src_hdr,
+                              bytes(state.data))
             self._drain_events()
+
+    def _complete_direct(self, state: _RecvState) -> None:
+        """Fragments already landed in the user buffer; just finish."""
+        req, hdr = state.req, state.src_hdr
+        nbytes = len(state.data)
+        if self._listeners:
+            self._emit(EVT_DELIVER, peer=state.peer, tag=hdr["tag"],
+                       cid=hdr["cid"], nbytes=nbytes)
+        req.status.source = state.peer
+        req.status.tag = hdr["tag"]
+        req.status.count = nbytes // req.datatype.base_np.itemsize
+        req.complete(req.buf)
 
     def _deliver(self, req: RecvRequest, peer: int, hdr: dict,
                  payload: bytes) -> None:

@@ -12,7 +12,8 @@ from typing import Any, Callable, Optional, Sequence
 
 from ompi_tpu.mpi.constants import MPIException
 
-__all__ = ["Request", "Status", "wait_all", "wait_any", "test_all"]
+__all__ = ["Request", "Status", "PersistentRequest", "wait_all", "wait_any",
+           "wait_some", "test_all", "test_any", "test_some", "start_all"]
 
 
 class Status:
@@ -95,6 +96,69 @@ class Request:
         self.cancelled = True
 
 
+class PersistentRequest(Request):
+    """≈ MPI persistent communication request (pml.h:502-505 send/recv_init):
+    created inactive, (re)armed by start(); wait/test apply to the current
+    incarnation and a waited-on request returns to inactive, ready for the
+    next start().  The factory re-reads the bound buffer each start, so the
+    classic use (fixed buffer, restart every iteration) works unchanged."""
+
+    def __init__(self, factory: Callable[[], Request],
+                 kind: str = "persistent") -> None:
+        super().__init__(kind=kind)
+        self._factory = factory
+        self._inner: Optional[Request] = None
+
+    @property
+    def active(self) -> bool:
+        return self._inner is not None and not self._inner.done()
+
+    def start(self) -> "PersistentRequest":
+        if self.active:
+            raise MPIException(
+                "MPI_Start on an already-active persistent request")
+        self._inner = self._factory()
+        return self
+
+    # wait/test on an inactive persistent request return immediately (MPI
+    # semantics for inactive requests)
+
+    def wait(self, timeout: Optional[float] = None) -> Any:
+        if self._inner is None:
+            return None
+        out = self._inner.wait(timeout=timeout)
+        self.status = self._inner.status
+        self._inner = None  # back to inactive
+        return out
+
+    def test(self) -> bool:
+        return self._inner is None or self._inner.test()
+
+    def done(self) -> bool:
+        return self.test()
+
+    def add_completion_callback(self, cb: Callable[["Request"], None]) -> None:
+        if self._inner is None:
+            cb(self)
+        else:
+            self._inner.add_completion_callback(lambda _r: cb(self))
+
+    def cancel(self) -> None:
+        if self._inner is not None:
+            self._inner.cancel()
+            self.cancelled = self._inner.cancelled
+
+    def free(self) -> None:
+        """≈ MPI_Request_free."""
+        self._inner = None
+
+
+def start_all(requests: Sequence[PersistentRequest]) -> None:
+    """≈ MPI_Startall."""
+    for r in requests:
+        r.start()
+
+
 class CompletedRequest(Request):
     """Pre-completed request (PROC_NULL ops, zero-byte fast paths)."""
 
@@ -141,5 +205,48 @@ def wait_any(requests: Sequence[Request],
     raise AssertionError("unreachable: event set but no request done")
 
 
+def wait_some(requests: Sequence[Request],
+              timeout: Optional[float] = None) -> tuple[list[int], list[Any]]:
+    """≈ MPI_Waitsome: block until ≥1 completes; return (indices, results)
+    of every request complete at that moment."""
+    if not requests:
+        raise MPIException("wait_some on empty request list")
+    event = threading.Event()
+
+    def poke(_r):
+        event.set()
+
+    for r in requests:
+        r.add_completion_callback(poke)
+    if not event.wait(timeout=timeout):
+        raise TimeoutError("wait_some timed out")
+    idx, results = [], []
+    for i, r in enumerate(requests):
+        if r.done():
+            idx.append(i)
+            results.append(r.wait())
+    return idx, results
+
+
 def test_all(requests: Sequence[Request]) -> bool:
     return all(r.test() for r in requests)
+
+
+def test_any(requests: Sequence[Request]) -> tuple[Optional[int], Any]:
+    """≈ MPI_Testany: (index, result) of one completed request, or
+    (None, None) when none has completed yet."""
+    for i, r in enumerate(requests):
+        if r.test():
+            return i, r.wait()
+    return None, None
+
+
+def test_some(requests: Sequence[Request]) -> tuple[list[int], list[Any]]:
+    """≈ MPI_Testsome: (indices, results) of all currently-complete
+    requests (both empty when none)."""
+    idx, results = [], []
+    for i, r in enumerate(requests):
+        if r.test():
+            idx.append(i)
+            results.append(r.wait())
+    return idx, results
