@@ -185,44 +185,39 @@ class Communicator:
         """≈ MPI_Recv_init: inactive persistent recv; arm with .start()."""
         from ompi_tpu.mpi.request import PersistentRequest
 
-        def _null():
-            return CompletedRequest(
-                np.empty(0, dtype=(datatype or dt_mod.BYTE).base_np))
-
-        # same source validation as irecv: bad sources route through the
-        # errhandler instead of crashing (IndexError) or hanging (a recv
-        # that can never match)
-        if source < 0 and source not in (ANY_SOURCE, PROC_NULL):
-            self._raise(MPIException(
-                f"source {source} is neither a rank nor "
-                f"ANY_SOURCE/PROC_NULL", error_class=6))
-            return PersistentRequest(_null, kind="persistent-recv")
-        if source == PROC_NULL or (source >= 0
-                                   and not self._check_rank(source,
-                                                            "source")):
-            return PersistentRequest(_null, kind="persistent-recv")
-        src = source if source < 0 else self.world_rank(source)
+        ok, src = self._recv_args_ok(source)
+        if not ok:
+            return PersistentRequest(
+                lambda: CompletedRequest(
+                    np.empty(0, dtype=(datatype or dt_mod.BYTE).base_np)),
+                kind="persistent-recv")
         return PersistentRequest(
             lambda: self.pml.irecv(buf, src, tag, self.cid, datatype,
                                    count),
             kind="persistent-recv")
 
-    def irecv(self, buf: Optional[np.ndarray] = None, source: int = 0,
-              tag: int = ANY_TAG, datatype: Optional[Datatype] = None,
-              count: Optional[int] = None) -> Request:
-        bad_negative = source < 0 and source not in (ANY_SOURCE, PROC_NULL)
-        if bad_negative:
+    def _recv_args_ok(self, source: int) -> tuple[bool, int]:
+        """Shared source validation for every recv flavor → (ok, src).
+        ok=False ⇒ return an empty completed request (error routed through
+        the errhandler, or source is PROC_NULL)."""
+        if source < 0 and source not in (ANY_SOURCE, PROC_NULL):
             self._raise(MPIException(
                 f"source {source} is neither a rank nor "
                 f"ANY_SOURCE/PROC_NULL", error_class=6))
-        if (bad_negative
-                or (source >= 0 and not self._check_rank(source, "source"))):
+            return False, source
+        if source == PROC_NULL or (source >= 0
+                                   and not self._check_rank(source,
+                                                            "source")):
+            return False, source
+        return True, source if source < 0 else self.world_rank(source)
+
+    def irecv(self, buf: Optional[np.ndarray] = None, source: int = 0,
+              tag: int = ANY_TAG, datatype: Optional[Datatype] = None,
+              count: Optional[int] = None) -> Request:
+        ok, src = self._recv_args_ok(source)
+        if not ok:
             return CompletedRequest(
                 np.empty(0, dtype=(datatype or dt_mod.BYTE).base_np))
-        if source == PROC_NULL:
-            return CompletedRequest(
-                np.empty(0, dtype=(datatype or dt_mod.BYTE).base_np))
-        src = source if source < 0 else self.world_rank(source)
         return self.pml.irecv(buf, src, tag, self.cid, datatype, count)
 
     def recv(self, buf: Optional[np.ndarray] = None, source: int = 0,

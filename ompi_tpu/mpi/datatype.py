@@ -51,7 +51,11 @@ def _i64p(arr: np.ndarray):
 
 
 __all__ = [
-    "Datatype", "PredefinedDatatype", "DerivedDatatype",
+    "Datatype", "PredefinedDatatype", "DerivedDatatype", "StructDatatype",
+    "create_struct", "create_subarray", "create_darray",
+    "pack_external", "unpack_external",
+    "DISTRIBUTE_NONE", "DISTRIBUTE_BLOCK", "DISTRIBUTE_CYCLIC",
+    "DISTRIBUTE_DFLT_DARG",
     "from_numpy", "BYTE", "INT8", "UINT8", "INT16", "UINT16", "INT32",
     "UINT32", "INT64", "UINT64", "FLOAT16", "BFLOAT16", "FLOAT32", "FLOAT64",
     "COMPLEX64", "COMPLEX128", "BOOL", "FLOAT", "DOUBLE", "INT", "LONG",
@@ -171,7 +175,53 @@ class Datatype:
         idx = self._byte_index(count)
         raw[idx] = src[:len(idx)]
 
-    # -- constructors (≈ ompi_datatype.h:178-189) -------------------------
+    # -- device path (the jnp.take lowering the module docstring names) ---
+
+    def pack_device(self, arr, count: int = 1):
+        """Device-side pack: gather this layout's elements from a jax array
+        with ONE ``jnp.take`` — the XLA-native form of the convertor's
+        gather loop (noncontiguous sends become a fused gather op instead
+        of a host byte loop).  Returns a flat device array of
+        ``count * elements_per_item`` elements."""
+        import jax.numpy as jnp
+
+        idx1 = self.element_indices()
+        stride = self._elem_stride()
+        if count == 1:
+            idx = idx1
+        else:
+            idx = (jnp.arange(count)[:, None] * stride
+                   + jnp.asarray(idx1)[None, :]).ravel()
+        return jnp.take(arr.reshape(-1), jnp.asarray(idx), axis=0)
+
+    def _elem_stride(self) -> int:
+        isz = self.base_np.itemsize
+        if self.extent % isz:
+            raise MPIException(
+                f"datatype {getattr(self, 'name', '?')}: extent "
+                f"{self.extent}B is not a multiple of the base dtype "
+                f"({self.base_np}, {isz}B); the device gather cannot "
+                f"stride it — use the host pack/unpack path")
+        return self.extent // isz
+
+    def unpack_device(self, data, count: int = 1, total_elems: Optional[int] = None):
+        """Device-side unpack: scatter a flat element stream into a new
+        array of ``total_elems`` elements (default: count*extent worth)
+        via ``.at[idx].set`` — one XLA scatter."""
+        import jax.numpy as jnp
+
+        idx1 = self.element_indices()
+        stride = self._elem_stride()
+        if count == 1:
+            idx = jnp.asarray(idx1)
+        else:
+            idx = (jnp.arange(count)[:, None] * stride
+                   + jnp.asarray(idx1)[None, :]).ravel()
+        n = total_elems if total_elems is not None else count * stride
+        out = jnp.zeros((n,), data.dtype)
+        return out.at[idx].set(data.reshape(-1))
+
+    # -- constructors (≈ ompi_datatype.h:178-197) -------------------------
 
     def contiguous(self, count: int) -> "DerivedDatatype":
         return DerivedDatatype._mk_contiguous(count, self)
@@ -179,12 +229,61 @@ class Datatype:
     def vector(self, count: int, blocklength: int, stride: int) -> "DerivedDatatype":
         return DerivedDatatype._mk_vector(count, blocklength, stride, self)
 
+    def hvector(self, count: int, blocklength: int,
+                byte_stride: int) -> "DerivedDatatype":
+        """≈ MPI_Type_create_hvector: stride in BYTES."""
+        return DerivedDatatype(
+            self, [(i * byte_stride, blocklength) for i in range(count)],
+            pattern_unit="bytes",
+            name=f"hvector({count},{blocklength},{byte_stride}B)")
+
     def indexed(self, blocklengths: Sequence[int],
                 displacements: Sequence[int]) -> "DerivedDatatype":
         return DerivedDatatype._mk_indexed(blocklengths, displacements, self)
 
+    def indexed_block(self, blocklength: int,
+                      displacements: Sequence[int]) -> "DerivedDatatype":
+        """≈ MPI_Type_create_indexed_block: one blocklength for all."""
+        return DerivedDatatype(
+            self, [(d, blocklength) for d in displacements],
+            name=f"indexed_block({blocklength},{len(displacements)})")
+
+    def hindexed(self, blocklengths: Sequence[int],
+                 byte_displacements: Sequence[int]) -> "DerivedDatatype":
+        """≈ MPI_Type_create_hindexed: displacements in BYTES."""
+        if len(blocklengths) != len(byte_displacements):
+            raise MPIException(
+                "hindexed: blocklengths/displacements mismatch")
+        return DerivedDatatype(
+            self, list(zip(byte_displacements, blocklengths)),
+            pattern_unit="bytes", name=f"hindexed({len(blocklengths)})")
+
+    def hindexed_block(self, blocklength: int,
+                       byte_displacements: Sequence[int]) -> "DerivedDatatype":
+        """≈ MPI_Type_create_hindexed_block."""
+        return DerivedDatatype(
+            self, [(d, blocklength) for d in byte_displacements],
+            pattern_unit="bytes",
+            name=f"hindexed_block({blocklength},{len(byte_displacements)})")
+
     def resized(self, extent: int) -> "DerivedDatatype":
         return DerivedDatatype._mk_resized(self, extent)
+
+    def subarray(self, sizes: Sequence[int], subsizes: Sequence[int],
+                 starts: Sequence[int], order: str = "C") -> "DerivedDatatype":
+        """≈ MPI_Type_create_subarray (C or Fortran order)."""
+        return create_subarray(sizes, subsizes, starts, self, order)
+
+
+def _merge_runs(segs: list[tuple[int, int]]) -> list[tuple[int, int]]:
+    """Merge byte runs that abut in declaration order (order preserved)."""
+    merged: list[tuple[int, int]] = []
+    for off, ln in segs:
+        if merged and merged[-1][0] + merged[-1][1] == off:
+            merged[-1] = (merged[-1][0], merged[-1][1] + ln)
+        else:
+            merged.append((off, ln))
+    return merged
 
 
 def min_span(dt: Datatype, count: int) -> int:
@@ -218,18 +317,33 @@ class PredefinedDatatype(Datatype):
 
 
 class DerivedDatatype(Datatype):
-    """A constructed layout, compiled to byte segments at commit."""
+    """A constructed layout, compiled to byte segments at commit.
+
+    The pattern is held as (byte_offset, item_count) runs — byte granular
+    so the h-constructors (hvector/hindexed, ompi_datatype.h:181-197) fall
+    out of the same machinery as the element-offset ones.
+    """
 
     def __init__(self, base: Datatype, pattern: list[tuple[int, int]],
-                 extent: Optional[int] = None, name: str = "derived") -> None:
-        # pattern: (element_offset, element_count) runs in units of base items
+                 extent: Optional[int] = None, name: str = "derived",
+                 pattern_unit: str = "items") -> None:
+        # pattern: (offset, item_count) runs; offset is in base items
+        # ("items") or raw bytes ("bytes" — the MPI h* constructors)
         self.base = base
-        self.pattern = list(pattern)
+        if pattern_unit == "items":
+            self.byte_pattern = [(off * base.extent, cnt)
+                                 for off, cnt in pattern]
+        elif pattern_unit == "bytes":
+            self.byte_pattern = [(int(off), int(cnt))
+                                 for off, cnt in pattern]
+        else:
+            raise MPIException(f"bad pattern_unit {pattern_unit!r}")
         self.base_np = base.base_np
         self.name = name
-        n_items = sum(c for _, c in pattern)
+        n_items = sum(c for _, c in self.byte_pattern)
         self.size = n_items * base.size
-        natural = max(((off + cnt) for off, cnt in pattern), default=0) * base.extent
+        natural = max((boff + cnt * base.extent
+                       for boff, cnt in self.byte_pattern), default=0)
         self.extent = extent if extent is not None else natural
         self._lock = threading.RLock()  # element_indices() nests segments()
         self._segs: Optional[list[tuple[int, int]]] = None
@@ -272,21 +386,17 @@ class DerivedDatatype(Datatype):
             if self._segs is None:
                 segs: list[tuple[int, int]] = []
                 bsegs = self.base.segments()
-                for eoff, ecount in self.pattern:
+                for boff0, ecount in self.byte_pattern:
                     for i in range(ecount):
-                        origin = (eoff + i) * self.base.extent
+                        origin = boff0 + i * self.base.extent
                         for boff, blen in bsegs:
                             segs.append((origin + boff, blen))
-                # merge adjacent runs (contiguity optimization, ≈ the
-                # reference's descriptor optimizer)
-                segs.sort()
-                merged: list[tuple[int, int]] = []
-                for off, ln in segs:
-                    if merged and merged[-1][0] + merged[-1][1] == off:
-                        merged[-1] = (merged[-1][0], merged[-1][1] + ln)
-                    else:
-                        merged.append((off, ln))
-                self._segs = merged
+                # merge adjacent-in-declaration-order runs (≈ the
+                # reference's descriptor optimizer). Deliberately NOT
+                # sorted: MPI pack order is declaration order, so an
+                # indexed type with decreasing displacements packs blocks
+                # exactly as declared (the unpack_ooo.c contract).
+                self._segs = _merge_runs(segs)
             return self._segs
 
     def element_indices(self) -> np.ndarray:
@@ -307,6 +417,255 @@ class DerivedDatatype(Datatype):
 
     def __repr__(self) -> str:
         return f"Datatype({self.name}, size={self.size}, extent={self.extent})"
+
+
+class StructDatatype(Datatype):
+    """≈ MPI_Type_create_struct (ompi_datatype.h:187): blocks of DIFFERENT
+    base datatypes at byte displacements — the fully general constructor.
+
+    Heterogeneous layouts have no single element dtype, so the wire/typing
+    granularity is the byte (``base_np = uint8``); reductions over struct
+    types are rejected the same way the reference rejects non-predefined
+    op/type pairs.  The device gather (element_indices) is undefined for
+    mixed dtypes — struct stays a host-path type.
+    """
+
+    def __init__(self, blocklengths: Sequence[int],
+                 byte_displacements: Sequence[int],
+                 datatypes: Sequence[Datatype],
+                 name: Optional[str] = None) -> None:
+        if not (len(blocklengths) == len(byte_displacements)
+                == len(datatypes)):
+            raise MPIException(
+                "struct: blocklengths/displacements/datatypes length "
+                "mismatch")
+        self.fields = [(int(d), int(b), t) for d, b, t in
+                       zip(byte_displacements, blocklengths, datatypes)]
+        self.base_np = np.dtype(np.uint8)
+        self.size = sum(b * t.size for _, b, t in self.fields)
+        self.extent = max((d + b * t.extent for d, b, t in self.fields),
+                          default=0)
+        self.name = name or f"struct({len(self.fields)})"
+        self._lock = threading.RLock()
+        self._segs: Optional[list[tuple[int, int]]] = None
+
+    def segments(self) -> list[tuple[int, int]]:
+        with self._lock:
+            if self._segs is None:
+                segs: list[tuple[int, int]] = []
+                for disp, cnt, t in self.fields:
+                    for i in range(cnt):
+                        origin = disp + i * t.extent
+                        for boff, blen in t.segments():
+                            segs.append((origin + boff, blen))
+                self._segs = _merge_runs(segs)
+            return self._segs
+
+    def element_indices(self) -> np.ndarray:
+        raise MPIException(
+            f"{self.name}: struct datatypes mix base dtypes; the device "
+            f"gather path needs a uniform element type (host path only)")
+
+    def commit(self) -> "StructDatatype":
+        self.segments()
+        self._committed = True
+        return self
+
+    def resized(self, extent: int) -> "DerivedDatatype":
+        return DerivedDatatype._mk_resized(self, extent)
+
+    def __repr__(self) -> str:
+        return f"Datatype({self.name}, size={self.size}, extent={self.extent})"
+
+
+def create_struct(blocklengths: Sequence[int],
+                  byte_displacements: Sequence[int],
+                  datatypes: Sequence[Datatype]) -> StructDatatype:
+    """≈ MPI_Type_create_struct."""
+    return StructDatatype(blocklengths, byte_displacements, datatypes)
+
+
+def create_subarray(sizes: Sequence[int], subsizes: Sequence[int],
+                    starts: Sequence[int], base: Datatype,
+                    order: str = "C") -> DerivedDatatype:
+    """≈ MPI_Type_create_subarray: an n-d sub-block of an n-d array.
+    Extent spans the WHOLE array (MPI semantics), so count>1 tiles whole
+    arrays."""
+    nd = len(sizes)
+    if not (len(subsizes) == len(starts) == nd):
+        raise MPIException("subarray: sizes/subsizes/starts rank mismatch")
+    for d in range(nd):
+        if subsizes[d] < 0 or starts[d] < 0 or \
+                starts[d] + subsizes[d] > sizes[d]:
+            raise MPIException(
+                f"subarray: dim {d} out of bounds "
+                f"(start {starts[d]} + sub {subsizes[d]} > {sizes[d]})")
+    if order.upper() not in ("C", "F"):
+        raise MPIException(f"subarray: order must be C or F, got {order!r}")
+    if order.upper() == "F":  # mirror: first dimension varies fastest
+        sizes, subsizes, starts = sizes[::-1], subsizes[::-1], starts[::-1]
+    # item strides, last dim fastest
+    strides = [1] * nd
+    for d in range(nd - 2, -1, -1):
+        strides[d] = strides[d + 1] * sizes[d + 1]
+    import itertools as _it
+
+    run = subsizes[-1]  # innermost contiguous run, in items
+    pattern: list[tuple[int, int]] = []
+    for idx in _it.product(*(range(s) for s in subsizes[:-1])):
+        off = starts[-1]
+        for d, i in enumerate(idx):
+            off += (starts[d] + i) * strides[d]
+        pattern.append((off, run))
+    dt = DerivedDatatype(
+        base, pattern, extent=int(np.prod(sizes)) * base.extent,
+        name=f"subarray({tuple(subsizes)}/{tuple(sizes)})")
+    return dt
+
+
+# distribution constants (≈ mpi.h MPI_DISTRIBUTE_*)
+DISTRIBUTE_NONE = "none"
+DISTRIBUTE_BLOCK = "block"
+DISTRIBUTE_CYCLIC = "cyclic"
+DISTRIBUTE_DFLT_DARG = -1
+
+
+def _darray_dim_indices(gsize: int, distrib: str, darg: int, psize: int,
+                        coord: int) -> list[int]:
+    """Global indices along one dimension owned by process `coord`."""
+    if distrib == DISTRIBUTE_NONE:
+        if psize != 1:
+            raise MPIException("darray: DISTRIBUTE_NONE needs psize 1")
+        return list(range(gsize))
+    if distrib == DISTRIBUTE_BLOCK:
+        if darg == DISTRIBUTE_DFLT_DARG:
+            darg = (gsize + psize - 1) // psize
+        if darg * psize < gsize:
+            raise MPIException(
+                f"darray: block size {darg} × {psize} procs < {gsize}")
+        start = coord * darg
+        return list(range(start, min(start + darg, gsize)))
+    if distrib == DISTRIBUTE_CYCLIC:
+        if darg == DISTRIBUTE_DFLT_DARG:
+            darg = 1
+        out: list[int] = []
+        for blk in range(coord * darg, gsize, psize * darg):
+            out.extend(range(blk, min(blk + darg, gsize)))
+        return out
+    raise MPIException(f"darray: unknown distribution {distrib!r}")
+
+
+def create_darray(size: int, rank: int, gsizes: Sequence[int],
+                  distribs: Sequence[str], dargs: Sequence[int],
+                  psizes: Sequence[int], base: Datatype,
+                  order: str = "C") -> DerivedDatatype:
+    """≈ MPI_Type_create_darray: this process's piece of a block/cyclic
+    distributed n-d array (HPF rules).  Process grid is row-major over
+    psizes (MPI order)."""
+    nd = len(gsizes)
+    if not (len(distribs) == len(dargs) == len(psizes) == nd):
+        raise MPIException("darray: argument rank mismatch")
+    if int(np.prod(psizes)) != size:
+        raise MPIException(
+            f"darray: psizes {tuple(psizes)} ≠ comm size {size}")
+    # my coordinates in the process grid: ALWAYS row-major over psizes as
+    # given (MPI mandates this regardless of array storage order)
+    coords = []
+    rem = rank
+    for d in range(nd):
+        below = int(np.prod(psizes[d + 1:])) if d + 1 < nd else 1
+        coords.append(rem // below)
+        rem %= below
+    if order.upper() == "F":  # mirror ONLY the array/dim description
+        gsizes, distribs = gsizes[::-1], distribs[::-1]
+        dargs, psizes = dargs[::-1], psizes[::-1]
+        coords = coords[::-1]
+    elif order.upper() != "C":
+        raise MPIException(f"darray: order must be C or F, got {order!r}")
+    dim_idx = [
+        _darray_dim_indices(gsizes[d], distribs[d], dargs[d], psizes[d],
+                            coords[d])
+        for d in range(nd)
+    ]
+    strides = [1] * nd
+    for d in range(nd - 2, -1, -1):
+        strides[d] = strides[d + 1] * gsizes[d + 1]
+    import itertools as _it
+
+    # flat item offsets in local (canonical) order: last dim fastest
+    offsets: list[int] = []
+    for combo in _it.product(*dim_idx):
+        off = 0
+        for d, g in enumerate(combo):
+            off += g * strides[d]
+        offsets.append(off)
+    # run-length compress consecutive offsets into (offset, length) blocks
+    pattern: list[tuple[int, int]] = []
+    for off in offsets:
+        if pattern and pattern[-1][0] + pattern[-1][1] == off:
+            pattern[-1] = (pattern[-1][0], pattern[-1][1] + 1)
+        else:
+            pattern.append((off, 1))
+    return DerivedDatatype(
+        base, pattern, extent=int(np.prod(gsizes)) * base.extent,
+        name=f"darray(rank {rank}/{size}, {tuple(gsizes)})")
+
+
+# -- external32: the canonical big-endian interchange format ---------------
+# ≈ ompi external32 (opal_convertor heterogeneous path + test/datatype/
+# external32.c): pack to a byte-order-independent stream so heterogeneous
+# peers (or files) interoperate.
+
+
+def _packed_elem_dtypes(dt: Datatype) -> list[tuple[np.dtype, int]]:
+    """The packed stream of ONE item as (element dtype, n_elements) runs,
+    in pack order — the byteswap map for external32."""
+    if isinstance(dt, StructDatatype):
+        out: list[tuple[np.dtype, int]] = []
+        for _disp, cnt, t in dt.fields:
+            sub = _packed_elem_dtypes(t)
+            out.extend(sub * cnt)
+        return out
+    if isinstance(dt, DerivedDatatype):
+        # recurse: the base may itself be heterogeneous (resized/contiguous
+        # struct) — its byteswap map must survive the wrapper
+        n_items = sum(c for _, c in dt.byte_pattern)
+        return _packed_elem_dtypes(dt.base) * n_items
+    return [(dt.base_np, dt.size // dt.base_np.itemsize)]
+
+
+def _swap_stream(dt: Datatype, data: bytes, count: int) -> bytes:
+    runs = _packed_elem_dtypes(dt) * count
+    out = bytearray(len(data))
+    pos = 0
+    src = np.frombuffer(data, np.uint8)
+    for elem_dt, n in runs:
+        nb = elem_dt.itemsize * n
+        chunk = src[pos:pos + nb].view(elem_dt)
+        out[pos:pos + nb] = chunk.byteswap().tobytes()
+        pos += nb
+    return bytes(out)
+
+
+def pack_external(dt: Datatype, buf, count: int = 1) -> bytes:
+    """≈ MPI_Pack_external("external32"): pack then canonicalize to
+    big-endian."""
+    import sys as _sys
+
+    data = dt.pack(np.asarray(buf), count)
+    if _sys.byteorder == "little":
+        data = _swap_stream(dt, data, count)
+    return data
+
+
+def unpack_external(dt: Datatype, data: bytes, buf: np.ndarray,
+                    count: int = 1) -> None:
+    """≈ MPI_Unpack_external: big-endian stream → native layout."""
+    import sys as _sys
+
+    if _sys.byteorder == "little":
+        data = _swap_stream(dt, data, count)
+    dt.unpack(data, buf, count)
 
 
 def _bf16():

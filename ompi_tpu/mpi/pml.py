@@ -161,12 +161,12 @@ class BsendPool:
     contract that buffered sends beyond the attached capacity fail."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._cv = threading.Condition()
         self.capacity = 0
         self.used = 0
 
     def attach(self, nbytes: int) -> None:
-        with self._lock:
+        with self._cv:
             if self.capacity:
                 raise MPIException(
                     "a bsend buffer is already attached", error_class=1)
@@ -175,17 +175,13 @@ class BsendPool:
     def detach(self) -> int:
         """Blocks until pending buffered sends drain (MPI semantics), then
         returns the detached capacity."""
-        while True:
-            with self._lock:
-                if self.used == 0:
-                    cap, self.capacity = self.capacity, 0
-                    return cap
-            import time as _t
-
-            _t.sleep(0.001)
+        with self._cv:
+            self._cv.wait_for(lambda: self.used == 0)
+            cap, self.capacity = self.capacity, 0
+            return cap
 
     def reserve(self, nbytes: int) -> None:
-        with self._lock:
+        with self._cv:
             if self.used + nbytes > self.capacity:
                 raise MPIException(
                     f"bsend of {nbytes}B exceeds attached buffer "
@@ -194,8 +190,10 @@ class BsendPool:
             self.used += nbytes
 
     def release(self, nbytes: int) -> None:
-        with self._lock:
+        with self._cv:
             self.used -= nbytes
+            if self.used == 0:
+                self._cv.notify_all()
 
 
 def buffer_attach(nbytes: int) -> None:
@@ -350,6 +348,9 @@ class PmlOb1:
               count: Optional[int] = None, mode: str = "standard") -> Request:
         """mode ∈ standard | sync (ssend) | ready (rsend) | buffered (bsend)
         — the four MPI send modes (≈ pml.h:211 MCA_PML_BASE_SEND_*)."""
+        if mode not in ("standard", "sync", "ready", "buffered"):
+            raise MPIException(
+                f"unknown send mode {mode!r} (standard/sync/ready/buffered)")
         _reject_device(buf, "isend")
         arr = np.asarray(buf)
         if datatype is None:

@@ -121,18 +121,25 @@ class PersistentRequest(Request):
         return self
 
     # wait/test on an inactive persistent request return immediately (MPI
-    # semantics for inactive requests)
+    # semantics for inactive requests); both deactivate on completion and
+    # transfer the inner status/result (MPI_Test must fill status too)
 
     def wait(self, timeout: Optional[float] = None) -> Any:
         if self._inner is None:
-            return None
+            return self._result
         out = self._inner.wait(timeout=timeout)
         self.status = self._inner.status
+        self._result = out
         self._inner = None  # back to inactive
         return out
 
     def test(self) -> bool:
-        return self._inner is None or self._inner.test()
+        if self._inner is None:
+            return True
+        if not self._inner.test():
+            return False
+        self.wait()  # completed: non-blocking transfer + deactivate
+        return True
 
     def done(self) -> bool:
         return self.test()
