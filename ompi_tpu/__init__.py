@@ -78,6 +78,9 @@ _LAZY = {
     "MAXLOC": ("ompi_tpu.mpi.op", "MAXLOC"),
     "MINLOC": ("ompi_tpu.mpi.op", "MINLOC"),
     "device_world": ("ompi_tpu.mpi.device_comm", "device_world"),
+    "Window": ("ompi_tpu.mpi.osc", "Window"),
+    "REPLACE": ("ompi_tpu.mpi.op", "REPLACE"),
+    "NO_OP": ("ompi_tpu.mpi.op", "NO_OP"),
     "DeviceCommunicator": ("ompi_tpu.mpi.device_comm", "DeviceCommunicator"),
 }
 

@@ -42,6 +42,10 @@ _log = output.get_stream("osc")
 # ANY_SOURCE receive must never match a collective running on the same comm.
 _TAG_REQ = 500
 _TAG_REPLY = 501
+# request-returning ops (rget/rget_accumulate) carry a unique reply tag so
+# several can be outstanding to the same target without reply cross-matching
+_TAG_RDYN_BASE = 1000
+_TAG_RDYN_SPAN = 1_000_000
 
 
 def _ctrl_send(comm, dest: int, obj: Any, tag: int) -> Request:
@@ -71,12 +75,24 @@ class _LockState:
 
 
 class Window:
-    """An RMA window over a local numpy buffer (collective constructor)."""
+    """An RMA window over a local numpy buffer (collective constructor).
+
+    ``create_dynamic`` builds a window with no initial memory; local regions
+    are exposed with :meth:`attach` (local op, ≈ MPI_Win_attach) and remote
+    ranks address them by the base offset attach returned — the analog of
+    exchanging attached addresses out-of-band in MPI (MPI-3.1 §11.2.4).
+    """
 
     def __init__(self, comm, size: Optional[int] = None,
                  buffer: Optional[np.ndarray] = None,
-                 dtype=np.uint8, name: str = "win") -> None:
-        if buffer is None:
+                 dtype=np.uint8, name: str = "win",
+                 _dynamic: bool = False) -> None:
+        self._dynamic = _dynamic
+        self._regions: dict[int, np.ndarray] = {}   # base offset → flat view
+        self._next_base = 0
+        if _dynamic:
+            buffer = np.zeros(0, dtype=dtype)
+        elif buffer is None:
             if size is None:
                 raise MPIException("Window needs size= or buffer=")
             buffer = np.zeros(size, dtype=dtype)
@@ -103,9 +119,63 @@ class Window:
         self._epoch_reqs: list[Request] = []
         self._origin_lock = threading.Lock()      # serializes blocking ops
         self._ids = itertools.count(1)
+        # PSCW epoch state (≈ osc.h:391-394 post/start/complete/wait)
+        self._posts: set[int] = set()             # targets that posted to me
+        self._pscw_done: set[int] = set()         # origins that completed
+        self._access_group: Optional[list[int]] = None
+        self._exposure_group: Optional[set[int]] = None
         self._service = threading.Thread(
             target=self._serve, name=f"osc-{name}-{comm.rank}", daemon=True)
         self._service.start()
+
+    # -- dynamic windows ---------------------------------------------------
+
+    @classmethod
+    def create_dynamic(cls, comm, dtype=np.uint8,
+                       name: str = "dynwin") -> "Window":
+        """≈ MPI_Win_create_dynamic: a window with no memory attached;
+        expose regions later with :meth:`attach` (collective constructor,
+        local attach)."""
+        return cls(comm, name=name, dtype=dtype, _dynamic=True)
+
+    def attach(self, array: np.ndarray) -> int:
+        """≈ MPI_Win_attach (local): expose ``array`` through this dynamic
+        window and return its base offset — the "address" remote ranks use.
+        A one-element guard gap separates regions so an access can never
+        silently span two attachments (MPI forbids spanning)."""
+        if not self._dynamic:
+            raise MPIException("attach is only valid on a dynamic window")
+        array = np.asarray(array)
+        if not array.flags.c_contiguous:
+            raise MPIException("attach needs a C-contiguous array")
+        flat = array.reshape(-1)
+        with self._cv:
+            base = self._next_base
+            self._regions[base] = flat
+            self._next_base = base + flat.size + 1
+        return base
+
+    def detach(self, base: int) -> None:
+        """≈ MPI_Win_detach (local)."""
+        with self._cv:
+            if self._regions.pop(base, None) is None:
+                raise MPIException(f"detach: no region attached at {base}")
+
+    def _locate(self, offset: int, count: int) -> np.ndarray:
+        """Resolve [offset, offset+count) to a writable flat view — the
+        window buffer itself, or the containing attached region of a
+        dynamic window.  Caller holds ``_buf_lock``."""
+        if not self._dynamic:
+            self._check_range(offset, count)
+            return self.buf[offset:offset + count]
+        if count < 0:
+            raise MPIException(f"negative RMA count {count}")
+        for base, arr in self._regions.items():
+            if base <= offset and offset + count <= base + arr.size:
+                return arr[offset - base:offset - base + count]
+        raise MPIException(
+            f"RMA access [{offset}:{offset + count}] hits no attached "
+            f"region of dynamic window {self.name!r}")
 
     # -- origin side -------------------------------------------------------
 
@@ -148,8 +218,7 @@ class Window:
         """≈ MPI_Get (blocking convenience: data returns immediately)."""
         if target == self.comm.rank:
             with self._buf_lock:
-                self._check_range(offset, count)
-                return self.buf[offset:offset + count].copy()
+                return self._locate(offset, count).copy()
         with self._origin_lock:
             _ctrl_send(self.comm, target,
                        ("get", self.comm.rank, offset, count), _TAG_REQ).wait()
@@ -184,6 +253,109 @@ class Window:
                        ("fetch", self.comm.rank, offset, value, op.name),
                        _TAG_REQ).wait()
             return np.asarray(self._recv_reply(target))
+
+    def _reply_tag(self) -> int:
+        return _TAG_RDYN_BASE + (next(self._ids) % _TAG_RDYN_SPAN)
+
+    def _async_reply(self, target: int, rtag: int) -> Request:
+        """Post the reply receive for a request-returning op; the returned
+        request completes with the decoded payload (or the target's error)."""
+        inner = self.comm._coll_irecv(None, target, rtag)
+        outer = Request(kind="rma")
+
+        def _finish(r: Request) -> None:
+            try:
+                status, payload = dss.unpack(r.wait().tobytes(), n=1)[0]
+            except BaseException as e:          # transport failure
+                outer.fail(e)
+                return
+            if status == "err":
+                outer.fail(MPIException(
+                    f"RMA op failed at rank {target}: {payload}"))
+            else:
+                outer.complete(np.asarray(payload))
+
+        inner.add_completion_callback(_finish)
+        return outer
+
+    def get_accumulate(self, target: int, data: np.ndarray, op=op_mod.SUM,
+                       offset: int = 0) -> np.ndarray:
+        """≈ MPI_Get_accumulate: atomically fetch the target range and
+        combine ``data`` into it; returns the pre-op contents.  ``NO_OP``
+        gives an atomic get, ``REPLACE`` an atomic fetching put."""
+        return self.rget_accumulate(target, data, op, offset).wait()
+
+    # -- request-returning ops (≈ MPI_Rput/Rget/Raccumulate, MPI-3.1 §11.3.5;
+    # completion of the request = local completion; remote completion still
+    # needs flush/unlock/fence, exactly as in MPI) ------------------------
+
+    def rput(self, target: int, data: np.ndarray, offset: int = 0) -> Request:
+        """≈ MPI_Rput: the request completes when the origin buffer is
+        reusable (the data is packed at issue, so that is immediate for the
+        local case and send-completion otherwise)."""
+        data = np.ascontiguousarray(data)
+        if target == self.comm.rank:
+            self._apply_put(self.comm.rank, offset, data)
+            self._track(target)
+            done = Request(kind="rma")
+            done.complete(None)
+            return done
+        req = _ctrl_send(self.comm, target,
+                         ("put", self.comm.rank, offset, data), _TAG_REQ)
+        self._track(target, req)
+        return req
+
+    def raccumulate(self, target: int, data: np.ndarray, op=op_mod.SUM,
+                    offset: int = 0) -> Request:
+        """≈ MPI_Raccumulate."""
+        _check_predefined(op)
+        data = np.ascontiguousarray(data)
+        if target == self.comm.rank:
+            self._apply_acc(self.comm.rank, offset, data, op.name)
+            self._track(target)
+            done = Request(kind="rma")
+            done.complete(None)
+            return done
+        req = _ctrl_send(self.comm, target,
+                         ("acc", self.comm.rank, offset, data, op.name),
+                         _TAG_REQ)
+        self._track(target, req)
+        return req
+
+    def rget(self, target: int, count: int, offset: int = 0) -> Request:
+        """≈ MPI_Rget: ``request.wait()`` returns the fetched array.
+        Several rgets may be outstanding to the same target (each reply
+        rides a unique tag)."""
+        if target == self.comm.rank:
+            with self._buf_lock:
+                out = self._locate(offset, count).copy()
+            done = Request(kind="rma")
+            done.complete(out)
+            return done
+        rtag = self._reply_tag()
+        reply = self._async_reply(target, rtag)
+        _ctrl_send(self.comm, target,
+                   ("get2", self.comm.rank, offset, count, rtag), _TAG_REQ)
+        return reply
+
+    def rget_accumulate(self, target: int, data: np.ndarray, op=op_mod.SUM,
+                        offset: int = 0) -> Request:
+        """≈ MPI_Rget_accumulate: wait() returns the pre-op target range."""
+        _check_predefined(op)
+        data = np.ascontiguousarray(data)
+        if target == self.comm.rank:
+            old = self._apply_fetch(self.comm.rank, offset, data, op.name)
+            self._track(target)
+            done = Request(kind="rma")
+            done.complete(old)
+            return done
+        rtag = self._reply_tag()
+        reply = self._async_reply(target, rtag)
+        self._track(target)
+        _ctrl_send(self.comm, target,
+                   ("fetch2", self.comm.rank, offset, data, op.name, rtag),
+                   _TAG_REQ)
+        return reply
 
     def compare_swap(self, target: int, compare, value,
                      offset: int = 0) -> np.ndarray:
@@ -224,6 +396,102 @@ class Window:
             raise MPIException(
                 "RMA ops failed at this target during the epoch: "
                 + "; ".join(errors))
+
+    # -- PSCW (generalized active target, ≈ osc.h:391-394) ----------------
+
+    def post(self, origins: list[int]) -> None:
+        """≈ MPI_Win_post: expose this window to ``origins`` (nonblocking).
+        Matching ``start`` calls at the origins unblock once this arrives."""
+        if self._exposure_group is not None:
+            raise MPIException("MPI_Win_post while an exposure epoch is open")
+        self._exposure_group = set(origins)
+        for o in origins:
+            _ctrl_send(self.comm, o, ("post", self.comm.rank), _TAG_REQ)
+
+    def start(self, targets: list[int]) -> None:
+        """≈ MPI_Win_start: open an access epoch to ``targets``; blocks until
+        every target's post arrived (the reference may defer this wait to the
+        first op — blocking here keeps the semantics strict and simple)."""
+        if self._access_group is not None:
+            raise MPIException("MPI_Win_start while an access epoch is open")
+        want = set(targets)
+        with self._cv:
+            self._cv.wait_for(lambda: want <= self._posts
+                              or self._service_dead)
+            if not want <= self._posts:
+                raise MPIException(
+                    f"window {self.name!r}: service stopped while waiting "
+                    f"for posts from {sorted(want - self._posts)}")
+            self._posts -= want
+        self._access_group = list(targets)
+
+    def complete(self) -> None:
+        """≈ MPI_Win_complete: end the access epoch — all my ops to the
+        targets are locally complete and a completion marker is on the wire
+        behind them (FIFO per channel ⇒ ordered after every op)."""
+        if self._access_group is None:
+            raise MPIException("MPI_Win_complete without MPI_Win_start")
+        for r in self._epoch_reqs:
+            r.wait()
+        self._epoch_reqs.clear()
+        for t in self._access_group:
+            _ctrl_send(self.comm, t,
+                       ("pscw_done", self.comm.rank, self._sent_to[t]),
+                       _TAG_REQ)
+        self._access_group = None
+
+    def wait(self) -> None:
+        """≈ MPI_Win_wait: end the exposure epoch — blocks until every origin
+        in the post group completed (hence all their ops are applied here)."""
+        if self._exposure_group is None:
+            raise MPIException("MPI_Win_wait without MPI_Win_post")
+        want = self._exposure_group
+        with self._cv:
+            self._cv.wait_for(lambda: want <= self._pscw_done
+                              or self._service_dead)
+            if not want <= self._pscw_done:
+                raise MPIException(
+                    f"window {self.name!r}: service stopped with "
+                    f"incomplete origins {sorted(want - self._pscw_done)}")
+            self._pscw_done -= want
+            errors, self._errors = self._errors, []
+        self._exposure_group = None
+        if errors:
+            raise MPIException(
+                "RMA ops failed at this target during the PSCW epoch: "
+                + "; ".join(errors))
+
+    def test_epoch(self) -> bool:
+        """≈ MPI_Win_test: nonblocking wait(); True ⇒ epoch closed."""
+        if self._exposure_group is None:
+            raise MPIException("MPI_Win_test without MPI_Win_post")
+        with self._cv:
+            if not self._exposure_group <= self._pscw_done:
+                return False
+        self.wait()
+        return True
+
+    def lock_all(self) -> None:
+        """≈ MPI_Win_lock_all: shared lock on every rank."""
+        for t in range(self.comm.size):
+            self.lock(t, exclusive=False)
+
+    def unlock_all(self) -> None:
+        """≈ MPI_Win_unlock_all."""
+        for t in range(self.comm.size):
+            self.unlock(t)
+
+    def flush_all(self) -> None:
+        """≈ MPI_Win_flush_all: my ops are applied at every target."""
+        for t in range(self.comm.size):
+            self.flush(t)
+
+    def flush_local(self, target: int) -> None:
+        """≈ MPI_Win_flush_local: origin buffers reusable.  Ops here pack at
+        issue, so local completion only needs the sends drained."""
+        for r in self._epoch_reqs:
+            r.wait()
+        self._epoch_reqs.clear()
 
     def lock(self, target: int, exclusive: bool = True) -> None:
         """≈ MPI_Win_lock (passive target). A local target still goes
@@ -287,7 +555,7 @@ class Window:
         applied counter (so fences/flushes terminate) and reply-carrying
         ops turn the failure into the origin's exception."""
         origin = msg[1] if len(msg) > 1 else -1
-        if kind in ("put", "acc", "fetch", "cswap"):
+        if kind in ("put", "acc", "fetch", "cswap", "fetch2"):
             with self._cv:
                 if kind in ("put", "acc"):
                     # no reply channel: surface at this rank's next fence
@@ -296,6 +564,11 @@ class Window:
         if kind in ("get", "fetch", "cswap", "lock", "unlock", "flush"):
             try:
                 _ctrl_send(self.comm, origin, ("err", str(e)), _TAG_REPLY)
+            except Exception:
+                pass
+        if kind in ("get2", "fetch2"):
+            try:
+                _ctrl_send(self.comm, origin, ("err", str(e)), msg[-1])
             except Exception:
                 pass
 
@@ -309,9 +582,31 @@ class Window:
         elif kind == "get":
             _, origin, offset, count = msg
             with self._buf_lock:
-                self._check_range(offset, count)
-                out = self.buf[offset:offset + count].copy()
+                out = self._locate(offset, count).copy()
             _ctrl_send(self.comm, origin, ("ok", out), _TAG_REPLY)
+        elif kind == "get2":
+            _, origin, offset, count, rtag = msg
+            with self._buf_lock:
+                out = self._locate(offset, count).copy()
+            _ctrl_send(self.comm, origin, ("ok", out), rtag)
+        elif kind == "fetch2":
+            _, origin, offset, value, opname, rtag = msg
+            old = self._apply_fetch(origin, offset, value, opname)
+            _ctrl_send(self.comm, origin, ("ok", old), rtag)
+        elif kind == "post":
+            _, target = msg
+            with self._cv:
+                self._posts.add(target)
+                self._cv.notify_all()
+        elif kind == "pscw_done":
+            # FIFO per (origin → me) channel on _TAG_REQ means every op the
+            # origin issued this epoch was dispatched before this marker —
+            # no applied-count handshake needed (checked by the assert)
+            _, origin, expected = msg
+            with self._cv:
+                assert self._applied_from.get(origin, 0) >= expected
+                self._pscw_done.add(origin)
+                self._cv.notify_all()
         elif kind == "fetch":
             _, origin, offset, value, opname = msg
             old = self._apply_fetch(origin, offset, value, opname)
@@ -344,19 +639,16 @@ class Window:
 
     def _apply_put(self, origin: int, offset: int, data: np.ndarray) -> None:
         with self._cv:
-            self._check_range(offset, len(data))
-            self.buf[offset:offset + len(data)] = data.astype(
-                self.buf.dtype, copy=False)
+            seg = self._locate(offset, len(data))
+            seg[:] = data.astype(seg.dtype, copy=False)
             self._bump(origin)
 
     def _apply_acc(self, origin: int, offset: int, data: np.ndarray,
                    opname: str) -> None:
         op = getattr(op_mod, opname.upper())
         with self._cv:
-            self._check_range(offset, len(data))
-            seg = self.buf[offset:offset + len(data)]
-            self.buf[offset:offset + len(data)] = op.host(
-                seg, data.astype(seg.dtype, copy=False))
+            seg = self._locate(offset, len(data))
+            seg[:] = op.host(seg.copy(), data.astype(seg.dtype, copy=False))
             self._bump(origin)
 
     def _apply_fetch(self, origin: int, offset: int, value: np.ndarray,
@@ -364,9 +656,9 @@ class Window:
         op = getattr(op_mod, opname.upper())
         with self._cv:
             n = max(1, np.asarray(value).size)
-            self._check_range(offset, n)
-            old = self.buf[offset:offset + n].copy()
-            self.buf[offset:offset + n] = op.host(
+            seg = self._locate(offset, n)
+            old = seg.copy()
+            seg[:] = op.host(
                 old, np.asarray(value).astype(old.dtype, copy=False))
             self._bump(origin)
             return old
@@ -374,10 +666,10 @@ class Window:
     def _apply_cswap(self, origin: int, offset: int, compare,
                      value) -> np.ndarray:
         with self._cv:
-            self._check_range(offset, 1)
-            old = self.buf[offset:offset + 1].copy()
+            seg = self._locate(offset, 1)
+            old = seg.copy()
             if old[0] == np.asarray(compare).reshape(-1)[0]:
-                self.buf[offset] = np.asarray(value).reshape(-1)[0]
+                seg[0] = np.asarray(value).reshape(-1)[0]
             self._bump(origin)
             return old
 

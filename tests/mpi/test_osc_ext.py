@@ -1,0 +1,248 @@
+"""RMA extensions: PSCW epochs, get_accumulate, request-returning ops,
+dynamic windows (≈ osc.h:391-394 and MPI-3.1 §11.2.4/§11.3.5 semantics,
+mirroring the reference's osc/pt2pt behaviors)."""
+
+import numpy as np
+import pytest
+
+from ompi_tpu.mpi import op as op_mod
+from ompi_tpu.mpi.constants import MPIException
+from ompi_tpu.mpi.osc import Window
+from tests.mpi.harness import run_ranks
+
+
+def test_pscw_put_ordering():
+    """Odd ranks put into even targets under a PSCW epoch; wait() on the
+    target must observe every origin's data (the ordering guarantee)."""
+    def fn(comm):
+        win = Window(comm, size=comm.size, dtype=np.int64)
+        half = comm.size // 2
+        if comm.rank < half:            # targets: expose to the top half
+            origins = list(range(half, comm.size))
+            win.post(origins)
+            win.wait()
+            out = win.buf.copy()
+        else:                           # origins: access the bottom half
+            targets = list(range(half))
+            win.start(targets)
+            for t in targets:
+                win.put(t, np.array([comm.rank + 100]), offset=comm.rank % half)
+            win.complete()
+            out = None
+        win.comm.barrier()
+        win.free()
+        return None if out is None else out.tolist()
+
+    res = run_ranks(4, fn)
+    assert res[0] == [102, 103, 0, 0]
+    assert res[1] == [102, 103, 0, 0]
+    assert res[2] is None and res[3] is None
+
+
+def test_pscw_two_epochs_and_test():
+    def fn(comm):
+        win = Window(comm, size=1, dtype=np.int64)
+        vals = []
+        for epoch in range(2):
+            if comm.rank == 0:
+                win.post([1])
+                while not win.test_epoch():
+                    pass
+                vals.append(int(win.buf[0]))
+            else:
+                win.start([0])
+                win.put(0, np.array([epoch + 7]))
+                win.complete()
+        win.comm.barrier()
+        win.free()
+        return vals
+
+    res = run_ranks(2, fn)
+    assert res[0] == [7, 8]
+
+
+def test_pscw_misuse_raises():
+    def fn(comm):
+        win = Window(comm, size=1)
+        try:
+            win.complete()
+        except MPIException:
+            ok1 = True
+        else:
+            ok1 = False
+        try:
+            win.wait()
+        except MPIException:
+            ok2 = True
+        else:
+            ok2 = False
+        win.free()
+        return ok1 and ok2
+
+    assert run_ranks(2, fn) == [True, True]
+
+
+def test_get_accumulate_sum_and_noop():
+    def fn(comm):
+        win = Window(comm, buffer=np.arange(4, dtype=np.int64) * 0 + 10)
+        win.fence()
+        old = None
+        if comm.rank == 1:
+            old = win.get_accumulate(0, np.array([5, 5]), op_mod.SUM)
+            # NO_OP = atomic get: must see the accumulated values
+            now = win.get_accumulate(0, np.zeros(2, np.int64), op_mod.NO_OP)
+        win.fence()
+        buf = win.buf.copy()
+        win.free()
+        if comm.rank == 1:
+            return old.tolist(), now.tolist()
+        return buf.tolist()
+
+    res = run_ranks(2, fn)
+    assert res[1] == ([10, 10], [15, 15])
+    assert res[0][:2] == [15, 15]
+
+
+def test_get_accumulate_concurrent_atomic():
+    """All ranks get_accumulate(+1) on the same slot: the fetched values
+    must be distinct (atomicity), summing to a permutation of 0..N-1."""
+    def fn(comm):
+        win = Window(comm, size=1, dtype=np.int64)
+        win.fence()
+        old = int(win.get_accumulate(0, np.array([1]), op_mod.SUM)[0])
+        win.fence()
+        final = int(win.buf[0])
+        win.free()
+        return old, final
+
+    res = run_ranks(4, fn)
+    olds = sorted(r[0] for r in res)
+    assert olds == [0, 1, 2, 3]
+    assert res[0][1] == 4
+
+
+def test_rput_rget_outstanding():
+    def fn(comm):
+        win = Window(comm, buffer=np.full(8, comm.rank, dtype=np.int64))
+        win.fence()
+        reqs = []
+        if comm.rank == 0:
+            r1 = win.rput(1, np.array([42, 43]), offset=0)
+            r2 = win.rget(1, count=4, offset=4)
+            r3 = win.rget(1, count=2, offset=4)   # two rgets outstanding
+            reqs = [r1]
+            got4 = r2.wait().tolist()
+            got2 = r3.wait().tolist()
+        for r in reqs:
+            r.wait()
+        win.fence()
+        buf = win.buf.copy()
+        win.free()
+        if comm.rank == 0:
+            return got4, got2
+        return buf.tolist()
+
+    res = run_ranks(2, fn)
+    assert res[0] == ([1, 1, 1, 1], [1, 1])
+    assert res[1][:2] == [42, 43]
+
+
+def test_raccumulate_and_flush():
+    def fn(comm):
+        win = Window(comm, size=1, dtype=np.int64)
+        win.fence()
+        if comm.rank != 0:
+            win.lock(0, exclusive=False)
+            win.raccumulate(0, np.array([comm.rank]), op_mod.SUM).wait()
+            win.unlock(0)
+        win.fence()
+        total = int(win.buf[0])
+        win.free()
+        return total
+
+    res = run_ranks(4, fn)
+    assert res[0] == 1 + 2 + 3
+
+
+def test_lock_all_flush_all():
+    def fn(comm):
+        win = Window(comm, size=comm.size, dtype=np.int64)
+        win.fence()
+        win.lock_all()
+        for t in range(comm.size):
+            win.put(t, np.array([comm.rank + 1]), offset=comm.rank)
+        win.flush_all()
+        win.unlock_all()
+        win.fence()
+        buf = win.buf.copy()
+        win.free()
+        return buf.tolist()
+
+    res = run_ranks(3, fn)
+    assert res[0] == [1, 2, 3] and res[2] == [1, 2, 3]
+
+
+def test_dynamic_window_attach_put_get():
+    def fn(comm):
+        win = Window.create_dynamic(comm, dtype=np.int64)
+        region = np.zeros(4, dtype=np.int64)
+        base = win.attach(region)
+        # exchange bases (the MPI idiom: addresses travel out-of-band)
+        bases = comm.allgather(np.array([base], np.int64))
+        win.fence()
+        peer = (comm.rank + 1) % comm.size
+        win.put(peer, np.array([comm.rank + 1] * 4),
+                offset=int(np.asarray(bases[peer])[0]))
+        win.fence()
+        got = win.get(peer, count=4, offset=int(np.asarray(bases[peer])[0]))
+        win.fence()
+        local = region.copy()
+        win.detach(base)
+        win.free()
+        return local.tolist(), got.tolist()
+
+    res = run_ranks(3, fn)
+    # rank r's region was written by its left neighbor (r-1)+1 = r
+    assert res[0][0] == [3, 3, 3, 3]
+    assert res[1][0] == [1, 1, 1, 1]
+    # got = what the right neighbor's region holds = (rank+1)'s writer value
+    assert res[0][1] == [1, 1, 1, 1]
+
+
+def test_dynamic_window_unattached_access_fails():
+    def fn(comm):
+        win = Window.create_dynamic(comm)
+        region = np.zeros(2, dtype=np.uint8)
+        base = win.attach(region)
+        win.fence()
+        err = None
+        if comm.rank == 0:
+            try:
+                win.get(1, count=64, offset=base)  # spans past the region
+            except MPIException as e:
+                err = str(e)
+        win.fence()
+        win.free()
+        return err
+
+    res = run_ranks(2, fn)
+    assert res[0] is not None and "region" in res[0]
+
+
+def test_dynamic_detach_then_access_fails():
+    def fn(comm):
+        win = Window.create_dynamic(comm, dtype=np.int64)
+        region = np.zeros(2, dtype=np.int64)
+        base = win.attach(region)
+        win.fence()
+        win.detach(base)
+        err = None
+        try:
+            win.get(comm.rank, count=1, offset=base)  # local resolve fails
+        except MPIException as e:
+            err = str(e)
+        win.fence()
+        win.free()
+        return err is not None
+
+    assert run_ranks(2, fn) == [True, True]
