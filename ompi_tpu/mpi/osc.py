@@ -214,6 +214,25 @@ class Window:
                          ("put", self.comm.rank, offset, data), _TAG_REQ)
         self._track(target, req)
 
+    def put_strided(self, target: int, data: np.ndarray, offset: int = 0,
+                    stride: int = 1) -> None:
+        """Strided put: element i lands at ``offset + i*stride`` — one wire
+        message and one counted op (the shmem_iput transport; the reference
+        expresses this as a vector datatype over MPI_Put)."""
+        data = np.ascontiguousarray(data).reshape(-1)
+        if stride == 1:
+            return self.put(target, data, offset)
+        if stride < 1:
+            raise MPIException(f"put_strided needs stride >= 1, got {stride}")
+        if target == self.comm.rank:
+            self._apply_put_strided(self.comm.rank, offset, stride, data)
+            self._track(target)
+            return
+        req = _ctrl_send(self.comm, target,
+                         ("puts", self.comm.rank, offset, stride, data),
+                         _TAG_REQ)
+        self._track(target, req)
+
     def get(self, target: int, count: int, offset: int = 0) -> np.ndarray:
         """≈ MPI_Get (blocking convenience: data returns immediately)."""
         if target == self.comm.rank:
@@ -555,9 +574,9 @@ class Window:
         applied counter (so fences/flushes terminate) and reply-carrying
         ops turn the failure into the origin's exception."""
         origin = msg[1] if len(msg) > 1 else -1
-        if kind in ("put", "acc", "fetch", "cswap", "fetch2"):
+        if kind in ("put", "puts", "acc", "fetch", "cswap", "fetch2"):
             with self._cv:
-                if kind in ("put", "acc"):
+                if kind in ("put", "puts", "acc"):
                     # no reply channel: surface at this rank's next fence
                     self._errors.append(f"{kind} from rank {origin}: {e}")
                 self._bump(origin)
@@ -576,6 +595,9 @@ class Window:
         if kind == "put":
             _, origin, offset, data = msg
             self._apply_put(origin, offset, data)
+        elif kind == "puts":
+            _, origin, offset, stride, data = msg
+            self._apply_put_strided(origin, offset, stride, data)
         elif kind == "acc":
             _, origin, offset, data, opname = msg
             self._apply_acc(origin, offset, data, opname)
@@ -641,6 +663,14 @@ class Window:
         with self._cv:
             seg = self._locate(offset, len(data))
             seg[:] = data.astype(seg.dtype, copy=False)
+            self._bump(origin)
+
+    def _apply_put_strided(self, origin: int, offset: int, stride: int,
+                           data: np.ndarray) -> None:
+        with self._cv:
+            span = (len(data) - 1) * stride + 1 if len(data) else 0
+            seg = self._locate(offset, span)
+            seg[::stride] = data.astype(seg.dtype, copy=False)
             self._bump(origin)
 
     def _apply_acc(self, origin: int, offset: int, data: np.ndarray,

@@ -12,5 +12,7 @@ identically-sharded jax array IS a symmetric allocation, and put/get become
 from ompi_tpu.shmem.api import (
     init, finalize, my_pe, n_pes, barrier_all, array, free,
     put, get, broadcast, collect, to_all, atomic_add, atomic_fetch_add,
-    atomic_cswap, fence, quiet,
+    atomic_cswap, fence, quiet, SymmetricArray,
+    Lock, set_lock, test_lock, clear_lock,
+    broadcast_active, collect_active, to_all_active,
 )

@@ -14,6 +14,7 @@ service.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Optional
 
 import numpy as np
@@ -26,10 +27,21 @@ __all__ = [
     "init", "finalize", "my_pe", "n_pes", "barrier_all", "array", "free",
     "put", "get", "broadcast", "collect", "to_all", "atomic_add",
     "atomic_fetch_add", "atomic_cswap", "fence", "quiet", "SymmetricArray",
+    "Lock", "set_lock", "test_lock", "clear_lock",
+    "broadcast_active", "collect_active", "to_all_active",
 ]
 
 _state: dict = {"comm": None, "heap": []}
 _lock = threading.Lock()
+
+_CMP = {
+    "eq": lambda a, b: a == b,
+    "ne": lambda a, b: a != b,
+    "gt": lambda a, b: a > b,
+    "ge": lambda a, b: a >= b,
+    "lt": lambda a, b: a < b,
+    "le": lambda a, b: a <= b,
+}
 
 
 def init():
@@ -59,6 +71,7 @@ def finalize() -> None:
             if arr is not None:
                 arr._win.free()
         _state["heap"].clear()
+        _state.pop("lock_slabs", None)
         _state["comm"] = None
     import ompi_tpu
 
@@ -112,18 +125,50 @@ class SymmetricArray:
     def iput(self, target_pe: int, data, target_stride: int,
              offset: int = 0) -> None:
         """Strided put (≈ shmem_iput): element i lands at
-        ``offset + i*target_stride``.  Implemented as one window put per
-        element (each counted toward fence/flush totals); batching into a
-        single strided message is a host-path optimization for later."""
-        data = np.asarray(data).reshape(-1)
-        for i, v in enumerate(data):
-            self._win.put(target_pe, np.asarray([v]),
-                          offset + i * target_stride)
+        ``offset + i*target_stride`` — one wire message, one counted op."""
+        self._win.put_strided(target_pe, np.asarray(data).reshape(-1),
+                              offset, target_stride)
 
     def get(self, target_pe: int, count: Optional[int] = None,
             offset: int = 0) -> np.ndarray:
         count = count if count is not None else self.local.size - offset
         return self._win.get(target_pe, count, offset)
+
+    def iget(self, target_pe: int, count: int, source_stride: int,
+             offset: int = 0) -> np.ndarray:
+        """Strided get (≈ shmem_iget): element i comes from
+        ``offset + i*source_stride`` — one covering-range round trip,
+        strided locally."""
+        if source_stride < 1:
+            raise MPIException(f"iget needs stride >= 1, got {source_stride}")
+        if count == 0:
+            return np.zeros(0, dtype=self.dtype)
+        span = (count - 1) * source_stride + 1
+        return self._win.get(target_pe, span, offset)[::source_stride].copy()
+
+    def wait_until(self, cmp: str, value, offset: int = 0,
+                   timeout: Optional[float] = None) -> None:
+        """≈ shmem_wait_until: block until the *local* element at ``offset``
+        satisfies ``cmp`` against ``value``.  Remote puts/atomics land via
+        the window service, which signals the same condition variable —
+        so this is a real sleep, not a spin."""
+        pred = _CMP.get(cmp)
+        if pred is None:
+            raise MPIException(
+                f"wait_until cmp must be one of {sorted(_CMP)}, got {cmp!r}")
+        win = self._win
+        flat = self.local.reshape(-1)
+        with win._cv:
+            ok = win._cv.wait_for(
+                lambda: pred(flat[offset], value) or win._service_dead,
+                timeout=timeout)
+            if not ok:
+                raise TimeoutError(
+                    f"wait_until({cmp}, {value}) timed out at offset {offset}")
+            if not pred(flat[offset], value):
+                raise MPIException(
+                    "wait_until: window service stopped before the "
+                    "condition held")
 
     def quiet(self) -> None:
         """≈ shmem_quiet: my outstanding puts to all PEs are complete."""
@@ -211,3 +256,172 @@ def atomic_fetch_add(arr: SymmetricArray, target_pe: int, value,
 def atomic_cswap(arr: SymmetricArray, target_pe: int, compare, value,
                  offset: int = 0):
     return arr._win.compare_swap(target_pe, compare, value, offset)[0]
+
+
+# -- distributed locks (≈ oshmem/shmem/c/shmem_lock.c) ----------------------
+#
+# The reference implements an MCS-style queue lock over remote atomics; the
+# same fairness comes cheaper here as a ticket lock: two symmetric int64
+# slots (next-ticket, now-serving) on a home PE.  set_lock draws a ticket
+# with fetch_add and sleeps on the serving counter via wait_until on the
+# home PE (remote waiters poll with backoff); clear_lock quiets my
+# outstanding puts (the OpenSHMEM release guarantee) then advances serving.
+#
+# Locks share chunked slabs of the symmetric heap (64 locks per slab) so a
+# thousand locks cost one window, not a thousand service threads.
+
+_LOCKS_PER_SLAB = 64
+
+
+def _lock_slot() -> tuple["SymmetricArray", int]:
+    with _lock:
+        slabs = _state.setdefault("lock_slabs", [])
+        if not slabs or slabs[-1][1] >= _LOCKS_PER_SLAB:
+            slabs.append([None, 0])   # allocated outside _lock (collective)
+            need_alloc = True
+        else:
+            need_alloc = False
+        slab = slabs[-1]
+        slot = slab[1]
+        slab[1] += 1
+    if need_alloc:
+        slab[0] = array(2 * _LOCKS_PER_SLAB, dtype=np.int64)
+    return slab[0], 2 * slot
+
+
+class Lock:
+    """A symmetric distributed lock (collective constructor: every PE must
+    create its locks in the same order, like any heap allocation)."""
+
+    def __init__(self) -> None:
+        self._arr, base = _lock_slot()
+        self._next = base          # next-ticket slot
+        self._serving = base + 1   # now-serving slot
+        self._home = (base // 2) % n_pes()
+
+    def set_lock(self) -> None:
+        """≈ shmem_set_lock: fair (FIFO by ticket), blocking."""
+        ticket = int(atomic_fetch_add(self._arr, self._home, 1,
+                                      offset=self._next))
+        if self._home == my_pe():
+            self._arr.wait_until("ge", ticket, offset=self._serving)
+            return
+        delay = 1e-4
+        while int(self._arr.get(self._home, 1, self._serving)[0]) < ticket:
+            time.sleep(delay)
+            delay = min(delay * 2, 0.01)
+
+    def test_lock(self) -> bool:
+        """≈ shmem_test_lock: one attempt; True ⇒ acquired."""
+        serving = int(self._arr.get(self._home, 1, self._serving)[0])
+        old = int(atomic_cswap(self._arr, self._home, serving, serving + 1,
+                               offset=self._next))
+        return old == serving
+
+    def clear_lock(self) -> None:
+        """≈ shmem_clear_lock: embeds a quiet — my puts are applied at
+        their targets before the next holder can observe the release."""
+        quiet()
+        atomic_add(self._arr, self._home, 1, offset=self._serving)
+
+    def __enter__(self) -> "Lock":
+        self.set_lock()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.clear_lock()
+
+
+def set_lock(lock: Lock) -> None:
+    lock.set_lock()
+
+
+def test_lock(lock: Lock) -> bool:
+    return lock.test_lock()
+
+
+def clear_lock(lock: Lock) -> None:
+    lock.clear_lock()
+
+
+# -- active-set collectives (PE_start, logPE_stride, PE_size) ---------------
+#
+# ≈ the reference's scoll active-set signatures (oshmem/mca/scoll/scoll.h):
+# only the member PEs call, so these cannot ride MPI communicators (whose
+# construction is collective over the parent); they run directly over the
+# SHMEM comm's internal p2p on reserved tags, the way scoll/basic runs over
+# put+flags.  Linear algorithms: active sets are small by construction.
+
+_TAG_AS_BCAST, _TAG_AS_COLLECT, _TAG_AS_REDUCE = 600, 601, 602
+
+
+def _active_pes(active_set) -> list[int]:
+    start, logstride, size = active_set
+    pes = [start + (i << logstride) for i in range(size)]
+    if my_pe() not in pes:
+        raise MPIException(
+            f"PE {my_pe()} called an active-set collective for {pes}")
+    if pes[-1] >= n_pes():
+        raise MPIException(f"active set {pes} exceeds n_pes {n_pes()}")
+    return pes
+
+
+def _as_sendrecv(tag):
+    comm = _comm()
+    return (lambda buf, pe: comm._coll_isend(buf, pe, tag),
+            lambda pe: comm._coll_irecv(None, pe, tag).wait())
+
+
+def broadcast_active(arr: SymmetricArray, root_pe: int,
+                     active_set) -> None:
+    """shmem_broadcast over an active set; root's data replaces members'."""
+    pes = _active_pes(active_set)
+    if root_pe not in pes:
+        raise MPIException(f"root {root_pe} not in active set {pes}")
+    send, recv = _as_sendrecv(_TAG_AS_BCAST)
+    if my_pe() == root_pe:
+        reqs = [send(arr.local.reshape(-1), pe)
+                for pe in pes if pe != root_pe]
+        for r in reqs:
+            r.wait()
+    else:
+        arr.local[...] = recv(root_pe).reshape(arr.shape)
+
+
+def collect_active(arr: SymmetricArray, active_set) -> np.ndarray:
+    """shmem_collect over an active set: concatenation in PE order."""
+    pes = _active_pes(active_set)
+    send, recv = _as_sendrecv(_TAG_AS_COLLECT)
+    root = pes[0]
+    if my_pe() == root:
+        parts = {root: arr.local.reshape(-1)}
+        for pe in pes[1:]:
+            parts[pe] = np.asarray(recv(pe))
+        full = np.concatenate([parts[pe] for pe in pes])
+        reqs = [send(full, pe) for pe in pes[1:]]
+        for r in reqs:
+            r.wait()
+    else:
+        send(arr.local.reshape(-1), root).wait()
+        full = np.asarray(recv(root))
+    return full.reshape((len(pes) * arr.local.shape[0],)
+                        + arr.local.shape[1:])
+
+
+def to_all_active(arr: SymmetricArray, active_set, op=op_mod.MAX) -> None:
+    """shmem_*_to_all over an active set: elementwise reduction, result
+    replacing every member's local data."""
+    pes = _active_pes(active_set)
+    send, recv = _as_sendrecv(_TAG_AS_REDUCE)
+    root = pes[0]
+    if my_pe() == root:
+        acc = arr.local.reshape(-1).copy()
+        for pe in pes[1:]:
+            acc = op.host(acc, np.asarray(recv(pe)).astype(acc.dtype))
+        reqs = [send(acc, pe) for pe in pes[1:]]
+        for r in reqs:
+            r.wait()
+        arr.local[...] = acc.reshape(arr.shape)
+    else:
+        send(arr.local.reshape(-1), root).wait()
+        arr.local[...] = np.asarray(recv(root)).reshape(arr.shape)

@@ -1,0 +1,146 @@
+"""Device-mode symmetric heap: OpenSHMEM on HBM over an ICI mesh.
+
+≈ oshmem/mca/sshmem + spml re-imagined per SURVEY.md §3.5: a symmetric
+allocation is an **identically-sharded jax array** — one equal block per PE
+(device), resident in HBM.  Remote access is what the hardware is good at:
+
+    shmem_put/get to a neighbor   →  lax.ppermute over the mesh axis
+    circular shift (cshift)       →  ppermute ring (oshmem_circular_shift.c)
+    shmem_*_to_all reductions     →  psum/pmax/pmin over the axis
+    shmem_broadcast               →  psum of a masked block
+    shmem_collect/fcollect        →  all_gather
+    shmem_alltoall                →  all_to_all
+
+There is no per-message matching or remote-key directory (the spml/mkey
+machinery): symmetric addressing *is* the sharding — every PE's block of
+allocation N is the same slice of the same global array, so "the address of
+x on PE p" needs no translation.  Ops are SPMD: every PE in the active axis
+participates (traced under ``shard_map``/``jit``), which is exactly how the
+hardware moves data; a lone PE cannot interrupt another — the classic
+"asynchronous put" becomes a compiled collective exchange, with zero host
+staging.
+
+Usage::
+
+    heap = DeviceSymmetricHeap(device_world(mesh))
+    x = heap.array((4,), jnp.float32)          # one (4,) block per PE
+    def step(c, x):
+        y = heap.cshift(x, 1)                  # put to right neighbor
+        return heap.to_all(y, op=MAX)          # max-reduction to all
+    out = heap.run(step, x)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+
+from ompi_tpu.mpi.constants import MPIException
+from ompi_tpu.mpi.device_comm import DeviceCommunicator
+from ompi_tpu.mpi.op import MAX, SUM, Op
+
+__all__ = ["DeviceSymmetricHeap"]
+
+
+class DeviceSymmetricHeap:
+    """A symmetric heap over a :class:`DeviceCommunicator`'s PEs.
+
+    Allocations are global jax arrays whose leading dimension is sharded
+    over the communicator's axes — block ``p`` is PE ``p``'s local part,
+    the way every PE's Nth shmem_malloc names the same object.
+    """
+
+    def __init__(self, comm: DeviceCommunicator) -> None:
+        self.comm = comm
+        self._allocs = 0
+
+    @property
+    def n_pes(self) -> int:
+        return self.comm.size
+
+    # -- allocation (collective, ≈ shmem_malloc) --------------------------
+
+    def array(self, local_shape: Sequence[int], dtype=np.float32,
+              fill=0):
+        """Allocate one ``local_shape`` block per PE in HBM: a global array
+        of shape ``(n_pes, *local_shape)`` sharded over the PE axis."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        local_shape = tuple(int(s) for s in local_shape)
+        spec = P(self.comm.axes)      # leading dim over all comm axes
+        sharding = NamedSharding(self.comm.mesh, spec)
+        self._allocs += 1
+        # materialize directly into the sharded layout: each PE's block is
+        # created on its own device (no full-size host/device-0 staging)
+        shape = (self.n_pes,) + local_shape
+        return jax.jit(lambda: jnp.full(shape, fill, dtype=dtype),
+                       out_shardings=sharding)()
+
+    def run(self, fn: Callable, *arrays, out_specs: Any = None):
+        """Run ``fn(comm, *local_blocks)`` SPMD over the PEs (shard_map +
+        jit): inside, each PE sees its block with the leading PE dim
+        dropped and the heap's traced ops are available."""
+        import jax.numpy as jnp
+
+        squeeze = lambda fn_: (
+            lambda c, *blocks: fn_(c, *[jnp.squeeze(b, 0) for b in blocks]))
+        wrapped = lambda c, *blocks: jnp.expand_dims(
+            squeeze(fn)(c, *blocks), 0)
+        return self.comm.run(wrapped, *arrays, out_specs=out_specs)
+
+    # -- traced one-sided ops (call inside run/shard_map) -----------------
+
+    def cshift(self, x, displacement: int = 1):
+        """Circular shift: my block lands at PE (me+displacement) — the
+        oshmem_circular_shift.c pattern, one ppermute over ICI."""
+        return self.comm.shift(x, displacement)
+
+    def put_to(self, x, pairs: Sequence[tuple[int, int]], fill=0):
+        """Explicit-pair put: ``pairs`` is (src_pe, dst_pe); PEs not
+        receiving get ``fill``.  SPMD: all PEs call (a compiled exchange —
+        the shape the "asynchronous put" takes on ICI)."""
+        import jax.numpy as jnp
+
+        out = self.comm.permute(x, pairs)
+        if fill == 0:
+            return out          # ppermute already zero-fills non-receivers
+        me = self.comm.rank()
+        received = jnp.zeros((), dtype=bool)
+        for _, dst in pairs:
+            received = received | (me == dst)
+        return jnp.where(received, out, jnp.full_like(out, fill))
+
+    def get_from(self, x, src_pe: int):
+        """Every PE reads PE ``src_pe``'s block (shmem_get with a single
+        source = a broadcast from that PE)."""
+        return self.comm.bcast(x, root=int(src_pe))
+
+    # -- traced collectives (≈ scoll on device) ---------------------------
+
+    def broadcast(self, x, root: int = 0):
+        return self.comm.bcast(x, root=root)
+
+    def collect(self, x, axis: int = 0):
+        """fcollect: concatenation of every PE's block (all_gather)."""
+        return self.comm.allgather(x, axis=axis)
+
+    def to_all(self, x, op: Op = MAX):
+        """shmem_*_to_all: elementwise reduction, result on every PE."""
+        return self.comm.allreduce(x, op=op)
+
+    def alltoall(self, x, split_axis: int = 0, concat_axis: int = 0):
+        return self.comm.alltoall(x, split_axis, concat_axis)
+
+    def barrier_all(self, token=None):
+        return self.comm.barrier(token)
+
+    def my_pe(self):
+        """Traced: the calling PE's index."""
+        return self.comm.rank()
+
+    def __repr__(self) -> str:
+        return (f"DeviceSymmetricHeap(pes={self.n_pes}, "
+                f"axes={self.comm.axes}, allocs={self._allocs})")

@@ -1,0 +1,88 @@
+"""Device-mode symmetric heap on the 8-device virtual CPU mesh
+(SURVEY.md §3.5: symmetric allocation = identically-sharded HBM array;
+put/get = ppermute; reductions = psum/pmax)."""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from ompi_tpu.mpi import op as op_mod
+from ompi_tpu.mpi.device_comm import device_world
+from ompi_tpu.shmem.device import DeviceSymmetricHeap
+
+
+@pytest.fixture(scope="module")
+def heap():
+    devs = np.array(jax.devices())
+    assert devs.size == 8
+    return DeviceSymmetricHeap(device_world(Mesh(devs, axis_names=("pe",))))
+
+
+def test_alloc_shape_and_sharding(heap):
+    x = heap.array((4,), np.float32, fill=7)
+    assert x.shape == (8, 4)
+    assert float(np.asarray(x).sum()) == 8 * 4 * 7
+    # one block per device
+    assert len(x.sharding.device_set) == 8
+
+
+def test_cshift_circular(heap):
+    x = heap.array((2,), np.float32)
+    x = x.at[:, 0].set(np.arange(8, dtype=np.float32))
+    out = heap.run(lambda c, b: heap.cshift(b, 1), x)
+    got = np.asarray(out)[:, 0]
+    # PE p's block moved to PE p+1
+    np.testing.assert_allclose(got, np.roll(np.arange(8), 1))
+
+
+def test_to_all_max_reduction(heap):
+    x = heap.array((3,), np.float32)
+    vals = np.arange(24, dtype=np.float32).reshape(8, 3)
+    x = x + vals
+    out = heap.run(lambda c, b: heap.to_all(b, op=op_mod.MAX), x)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.tile(vals.max(axis=0), (8, 1)))
+
+
+def test_get_from_and_broadcast(heap):
+    x = heap.array((2,), np.float32)
+    x = x.at[:, :].set(np.arange(16, dtype=np.float32).reshape(8, 2))
+    out = heap.run(lambda c, b: heap.get_from(b, 5), x)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.tile([10.0, 11.0], (8, 1)))
+
+
+def test_put_to_pairs(heap):
+    x = heap.array((1,), np.float32)
+    x = x.at[:, 0].set(np.arange(8, dtype=np.float32) + 1)
+    # PE 0 puts to PE 7; everyone else keeps fill
+    out = heap.run(lambda c, b: heap.put_to(b, [(0, 7)], fill=-1), x)
+    got = np.asarray(out)[:, 0]
+    assert got[7] == 1.0
+    assert all(v == -1.0 for v in got[:7])
+
+
+def test_collect_fcollect(heap):
+    x = heap.array((2,), np.float32)
+    x = x.at[:, :].set(np.arange(16, dtype=np.float32).reshape(8, 2))
+    out = heap.run(lambda c, b: heap.collect(b), x)
+    # every PE holds the full concatenation
+    np.testing.assert_allclose(np.asarray(out)[0],
+                               np.arange(16, dtype=np.float32))
+    np.testing.assert_allclose(np.asarray(out)[7],
+                               np.arange(16, dtype=np.float32))
+
+
+def test_jit_composes_compute_and_heap_ops(heap):
+    """The point of the device path: heap ops fuse into a jitted program."""
+    x = heap.array((4,), np.float32, fill=1)
+
+    def step(c, b):
+        y = b * 2.0
+        z = heap.cshift(y, 1)
+        return heap.to_all(z, op=op_mod.SUM)
+
+    out = heap.run(step, x)
+    np.testing.assert_allclose(np.asarray(out), np.full((8, 4), 16.0))

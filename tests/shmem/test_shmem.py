@@ -37,3 +37,15 @@ def test_atomics_across_pes():
     r = tpurun(4, prog)
     assert r.returncode == 0, r.stderr
     assert "fetch_add tickets unique" in r.stdout
+
+
+def test_shmem_extensions():
+    """Locks, wait_until, strided iput/iget, active-set collectives
+    (≈ oshmem/shmem/c/shmem_lock.c + scoll active-set signatures)."""
+    prog = os.path.join(REPO, "tests", "shmem", "_ext_prog.py")
+    r = tpurun(4, prog, timeout=120)
+    assert r.returncode == 0, r.stderr
+    for needle in ("wait_until ok", "lock mutual exclusion ok",
+                   "test_lock single winner ok", "iput/iget strided ok",
+                   "active-set collectives ok"):
+        assert needle in r.stdout, (needle, r.stdout, r.stderr)
