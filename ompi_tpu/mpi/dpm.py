@@ -37,9 +37,10 @@ from typing import Any, Optional, Sequence
 import numpy as np
 
 from ompi_tpu.core import dss
-from ompi_tpu.mpi.comm import Communicator
+from ompi_tpu.mpi.comm import Communicator, _INTERNAL_TAG_BASE as _ITAG_BASE
 from ompi_tpu.mpi.constants import ANY_TAG, PROC_NULL, MPIException
 from ompi_tpu.mpi.group import Group
+from ompi_tpu.mpi import op as op_mod
 from ompi_tpu.mpi.request import Request, Status
 
 __all__ = ["Intercomm", "open_port", "close_port", "accept", "connect",
@@ -169,14 +170,31 @@ class Intercomm:
                 status.source = self.remote_ids.index(status.source)
         return out
 
-    # -- rooted collectives (the MPI intercomm flavor) ---------------------
+    # -- internal p2p on the reserved (negative) tag space ----------------
+    # ≈ the reference's MCA_COLL_BASE_TAG_* range: intercomm collectives
+    # must never match user p2p on the same context id.
+
+    _CTAG_BARRIER, _CTAG_BCAST, _CTAG_REDUCE = 700, 701, 702
+    _CTAG_GATHER, _CTAG_SCATTER, _CTAG_XCHG = 703, 704, 705
+
+    def _coll_isend(self, buf, dest: int, ctag: int) -> Request:
+        return self.pml.isend(np.asarray(buf), self.remote_ids[dest],
+                              _ITAG_BASE - ctag, self.cid)
+
+    def _coll_recv(self, source: int, ctag: int) -> np.ndarray:
+        return self.pml.irecv(None, self.remote_ids[source],
+                              _ITAG_BASE - ctag, self.cid).wait()
+
+    # -- collectives (≈ ompi/mca/coll/inter/: each op is local-group
+    # collectives stitched by a leader exchange) ---------------------------
 
     def barrier(self) -> None:
         """Both groups synchronized: local barriers + leader exchange."""
         self.local_comm.barrier()
         if self.rank == 0:
-            sreq = self.isend(np.zeros(0, np.uint8), 0, tag=0)
-            self.recv(0, tag=0)
+            sreq = self._coll_isend(np.zeros(0, np.uint8),
+                                    0, self._CTAG_BARRIER)
+            self._coll_recv(0, self._CTAG_BARRIER)
             sreq.wait()
         self.local_comm.barrier()
 
@@ -185,7 +203,7 @@ class Intercomm:
         an int (remote root rank) on the receiving group, PROC_NULL on the
         sending group's non-roots."""
         if root == "root":
-            self.send(np.asarray(buf), 0, tag=1)
+            self._coll_isend(np.asarray(buf), 0, self._CTAG_BCAST).wait()
             return np.asarray(buf)
         if root == PROC_NULL or root is None:
             return None
@@ -195,10 +213,82 @@ class Intercomm:
                 f"(use 'root' on the sending rank, PROC_NULL on its "
                 f"group-mates)", error_class=6)
         if self.rank == 0:
-            out = self.recv(root, tag=1)
+            out = self._coll_recv(root, self._CTAG_BCAST)
         else:
             out = None
         return self.local_comm.bcast(out, root=0)
+
+    def reduce(self, sendbuf, op=None, root: Any = None):
+        """≈ intercomm MPI_Reduce: the reduction of the OTHER group's data
+        arrives at ``root='root'``; the contributing group passes the
+        receiving rank's remote index as ``root`` (PROC_NULL on the root
+        group's non-roots, which contribute nothing and get None)."""
+        op = op if op is not None else op_mod.SUM
+        if root == "root":
+            # the contributing group's local rank 0 = my remote index 0
+            return np.asarray(self._coll_recv(0, self._CTAG_REDUCE))
+        if root == PROC_NULL or root is None:
+            return None
+        partial = self.local_comm.reduce(np.asarray(sendbuf), op=op, root=0)
+        if self.rank == 0:
+            self._coll_isend(partial, root, self._CTAG_REDUCE).wait()
+        return None
+
+    def allreduce(self, sendbuf, op=None):
+        """≈ intercomm MPI_Allreduce: group A's reduction lands on every
+        rank of group B and vice versa (MPI-3.1 §5.2.3 swap semantics)."""
+        op = op if op is not None else op_mod.SUM
+        partial = self.local_comm.reduce(np.asarray(sendbuf), op=op, root=0)
+        if self.rank == 0:
+            sreq = self._coll_isend(partial, 0, self._CTAG_XCHG)
+            theirs = self._coll_recv(0, self._CTAG_XCHG)
+            sreq.wait()
+        else:
+            theirs = None
+        return self.local_comm.bcast(theirs, root=0)
+
+    def allgather(self, sendbuf):
+        """≈ intercomm MPI_Allgather: every rank receives the REMOTE
+        group's contributions, stacked in remote rank order
+        (shape ``(remote_size, *part_shape)``)."""
+        mine = self.local_comm.gather(np.asarray(sendbuf), root=0)
+        if self.rank == 0:
+            stacked = np.stack([np.asarray(p) for p in mine])
+            sreq = self._coll_isend(stacked, 0, self._CTAG_XCHG)
+            theirs = self._coll_recv(0, self._CTAG_XCHG)
+            sreq.wait()
+        else:
+            theirs = None
+        return np.asarray(self.local_comm.bcast(theirs, root=0))
+
+    def gather(self, sendbuf=None, root: Any = None):
+        """≈ intercomm MPI_Gather: ``root='root'`` receives a list of the
+        remote group's contributions in remote rank order."""
+        if root == "root":
+            return [np.asarray(self._coll_recv(r, self._CTAG_GATHER))
+                    for r in range(self.remote_size)]
+        if root == PROC_NULL or root is None:
+            return None
+        self._coll_isend(np.asarray(sendbuf), root,
+                         self._CTAG_GATHER).wait()
+        return None
+
+    def scatter(self, sendparts=None, root: Any = None):
+        """≈ intercomm MPI_Scatter: ``root='root'`` sends part i to remote
+        rank i; receiving-group ranks pass the root's remote index."""
+        if root == "root":
+            if len(sendparts) != self.remote_size:
+                raise MPIException(
+                    f"intercomm scatter needs {self.remote_size} parts, "
+                    f"got {len(sendparts)}", error_class=6)
+            reqs = [self._coll_isend(np.asarray(p), r, self._CTAG_SCATTER)
+                    for r, p in enumerate(sendparts)]
+            for r in reqs:
+                r.wait()
+            return None
+        if root == PROC_NULL or root is None:
+            return None
+        return np.asarray(self._coll_recv(root, self._CTAG_SCATTER))
 
     # -- merge (≈ MPI_Intercomm_merge) -------------------------------------
 

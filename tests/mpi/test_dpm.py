@@ -212,3 +212,82 @@ def test_spawn_parent_child(tmp_path):
         assert vals == [20, 22]
     finally:
         world[0].pml.close()
+
+
+def test_intercomm_allreduce_swap():
+    """≈ coll/inter allreduce: group A's sum lands on B and vice versa."""
+    def server(comm, port):
+        ic = dpm.accept(comm, port if comm.rank == 0 else None)
+        out = ic.allreduce(np.array([10.0 + comm.rank]))
+        return float(np.asarray(out)[0])
+
+    def client(comm, port):
+        ic = dpm.connect(comm, port)
+        out = ic.allreduce(np.array([1.0 + comm.rank]))
+        return float(np.asarray(out)[0])
+
+    res_a, res_b = _with_port(server, client)
+    assert res_a == [3.0, 3.0]      # client sum: 1 + 2
+    assert res_b == [21.0, 21.0]    # server sum: 10 + 11
+
+
+def test_intercomm_reduce_rooted():
+    def server(comm, port):
+        ic = dpm.accept(comm, port if comm.rank == 0 else None)
+        from ompi_tpu.mpi.constants import PROC_NULL
+
+        if comm.rank == 1:
+            out = ic.reduce(None, root="root")
+            return float(np.asarray(out)[0])
+        ic.reduce(None, root=PROC_NULL)
+        return None
+
+    def client(comm, port):
+        ic = dpm.connect(comm, port)
+        # contribute toward remote rank 1
+        ic.reduce(np.array([5.0 * (comm.rank + 1)]), root=1)
+        return None
+
+    res_a, _ = _with_port(server, client)
+    assert res_a[1] == 15.0         # 5 + 10
+
+
+def test_intercomm_allgather():
+    def server(comm, port):
+        ic = dpm.accept(comm, port if comm.rank == 0 else None)
+        out = ic.allgather(np.array([100 + comm.rank], dtype=np.int64))
+        return np.asarray(out).reshape(-1).tolist()
+
+    def client(comm, port):
+        ic = dpm.connect(comm, port)
+        out = ic.allgather(np.array([comm.rank], dtype=np.int64))
+        return np.asarray(out).reshape(-1).tolist()
+
+    res_a, res_b = _with_port(server, client)
+    assert all(r == [0, 1] for r in res_a)        # remote = client data
+    assert all(r == [100, 101] for r in res_b)    # remote = server data
+
+
+def test_intercomm_gather_scatter_rooted():
+    def server(comm, port):
+        ic = dpm.accept(comm, port if comm.rank == 0 else None)
+        from ompi_tpu.mpi.constants import PROC_NULL
+
+        if comm.rank == 0:
+            parts = ic.gather(root="root")
+            got = [int(np.asarray(p)[0]) for p in parts]
+            ic.scatter([np.array([p * 2]) for p in got], root="root")
+            return got
+        ic.gather(root=PROC_NULL)
+        ic.scatter(root=PROC_NULL)
+        return None
+
+    def client(comm, port):
+        ic = dpm.connect(comm, port)
+        ic.gather(np.array([7 + comm.rank]), root=0)
+        back = ic.scatter(root=0)
+        return int(np.asarray(back)[0])
+
+    res_a, res_b = _with_port(server, client)
+    assert res_a[0] == [7, 8]
+    assert res_b == [14, 16]
