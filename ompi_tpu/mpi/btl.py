@@ -27,8 +27,10 @@ from typing import Callable, Optional
 from ompi_tpu.core import dss, output
 from ompi_tpu.core.config import VarType, register_var, var_registry
 from ompi_tpu.core.mca import Component, Framework
+from ompi_tpu.mpi.constants import MPIException
 
-__all__ = ["btl_framework", "TcpBTL", "SelfBTL", "BtlEndpoint"]
+__all__ = ["btl_framework", "TcpBTL", "SelfBTL", "ShmBTLComponent",
+           "BtlEndpoint"]
 
 _log = output.get_stream("btl")
 
@@ -150,7 +152,10 @@ class TcpBTL:
     # -- receiving ---------------------------------------------------------
 
     def _accept_loop(self) -> None:
-        self._listener.settimeout(0.2)
+        try:
+            self._listener.settimeout(0.2)
+        except OSError:
+            return   # close() won the race before the thread started
         while not self._stop.is_set():
             try:
                 conn, _ = self._listener.accept()
@@ -227,35 +232,108 @@ class SelfBTLComponent(Component):
         return SelfBTL(rank, on_frame)
 
 
+@btl_framework.component
+class ShmBTLComponent(Component):
+    """Shared-memory rings for same-host ranks (≈ btl/vader — priority
+    between self and tcp, exactly the reference's exclusivity ordering:
+    btl_vader_component.c:61-69)."""
+
+    NAME = "shm"
+    PRIORITY = 50
+
+    def create(self, rank: int, on_frame: OnFrame):
+        from ompi_tpu.mpi.btl_shm import ShmBTL
+
+        return ShmBTL(rank, on_frame)
+
+
 class BtlEndpoint:
-    """Per-job BTL multiplexer (≈ bml/r2, bml.h:220-232): routes a frame to
-    the self BTL for loopback, tcp otherwise."""
+    """Per-job BTL multiplexer (≈ bml/r2, bml.h:220-232): routes each frame
+    to the best reachable BTL — self for loopback, shm rings for same-host
+    peers, tcp otherwise.  MCA selection on the btl framework (``--mca btl
+    ^shm``, ``--mca btl self,tcp``) gates which transports are built; the
+    self BTL is always on (loopback is load-bearing for COMM_SELF and
+    collective self-sends, like coll/self in the reference)."""
 
     def __init__(self, rank: int, on_frame: OnFrame) -> None:
         self.rank = rank
+        enabled = {c.NAME for c in btl_framework._eligible()}
         self.self_btl = SelfBTL(rank, on_frame)
-        self.tcp_btl = TcpBTL(rank, on_frame)
+        self.tcp_btl = TcpBTL(rank, on_frame) if "tcp" in enabled else None
+        self.shm_btl = None
+        if "shm" in enabled:
+            from ompi_tpu.mpi.btl_shm import ShmBTL
+
+            self.shm_btl = ShmBTL(rank, on_frame)
+        if self.tcp_btl is None and self.shm_btl is None:
+            raise MPIException(
+                "btl selection leaves no transport for remote peers "
+                "(need tcp and/or shm)")
+        self._cards: dict[int, str] = {}   # peer → full business card
+        self._shm_ok: set[int] = set()     # peers with a live shm route
 
     @property
     def address(self) -> str:
-        return self.tcp_btl.address
+        """The combined business card: tcp address (``-`` when tcp is
+        disabled), plus the shm card when that transport is enabled."""
+        tcp = self.tcp_btl.address if self.tcp_btl is not None else "-"
+        if self.shm_btl is None:
+            return tcp
+        return f"{tcp};shm={self.shm_btl.address}"
+
+    @staticmethod
+    def _split_card(card: str) -> tuple[str, Optional[str]]:
+        tcp, _, rest = card.partition(";shm=")
+        return tcp, (rest or None)
 
     def set_peers(self, peers: dict[int, str]) -> None:
-        self.tcp_btl.set_peers(peers)
+        self._cards.update(peers)
+        if self.tcp_btl is not None:
+            self.tcp_btl.set_peers(
+                {p: self._split_card(c)[0] for p, c in peers.items()})
 
     def set_alias(self, peer: int, my_id: int) -> None:
-        self.tcp_btl.set_alias(peer, my_id)
+        if self.tcp_btl is not None:
+            self.tcp_btl.set_alias(peer, my_id)
+        if self.shm_btl is not None:
+            self.shm_btl.set_alias(peer, my_id)
 
     def max_peer_id(self) -> int:
         """Highest peer id this endpoint knows (for dpm namespace bases)."""
+        if self.tcp_btl is None:
+            return max(self._cards, default=-1)
         with self.tcp_btl._lock:
             return max(self.tcp_btl._peers, default=-1)
 
     def send(self, peer: int, header: dict, payload: bytes = b"") -> None:
         if peer == self.rank:
             self.self_btl.send(peer, header, payload)
-        else:
-            self.tcp_btl.send(peer, header, payload)
+            return
+        if self.shm_btl is not None:
+            # steady state: one set lookup, then straight into the ring
+            if peer in self._shm_ok or self._shm_route(peer):
+                from ompi_tpu.mpi.btl_shm import FrameTooBig
+
+                try:
+                    self.shm_btl.send(peer, header, payload)
+                    return
+                except FrameTooBig:
+                    pass   # oversize frame rides tcp; PML seq reorders
+        if self.tcp_btl is None:
+            raise MPIException(
+                f"no btl route to rank {peer}: tcp is disabled and the "
+                f"peer is not shm-reachable")
+        self.tcp_btl.send(peer, header, payload)
+
+    def _shm_route(self, peer: int) -> bool:
+        shm_card = self._split_card(self._cards.get(peer, ""))[1]
+        if shm_card and self.shm_btl.connect(peer, shm_card):
+            self._shm_ok.add(peer)
+            return True
+        return False
 
     def close(self) -> None:
-        self.tcp_btl.close()
+        if self.tcp_btl is not None:
+            self.tcp_btl.close()
+        if self.shm_btl is not None:
+            self.shm_btl.close()

@@ -49,6 +49,10 @@ __all__ = ["Intercomm", "open_port", "close_port", "accept", "connect",
 ENV_PARENT_PORT = "OMPI_TPU_PARENT_PORT"
 
 _DPM_CID_BASE = 1 << 20
+# combined tcp+shm business cards carry a filesystem path; 192B covers the
+# longest inbox path tempfile generates (the reference's modex equivalently
+# grows its byte-object values)
+_CARD_BYTES = 192
 _dpm_seq_lock = threading.Lock()
 _dpm_seq = 0
 
@@ -181,6 +185,15 @@ class Intercomm:
         return self.pml.isend(np.asarray(buf), self.remote_ids[dest],
                               _ITAG_BASE - ctag, self.cid)
 
+    def _check_remote_root(self, root, what: str) -> None:
+        """Integer roots name a REMOTE rank; anything out of range (notably
+        other negative constants) must raise, not wrap around remote_ids."""
+        if not 0 <= root < self.remote_size:
+            raise MPIException(
+                f"intercomm {what} root {root} out of remote range "
+                f"0..{self.remote_size - 1} (use 'root' on the receiving "
+                f"rank, PROC_NULL on its group-mates)", error_class=6)
+
     def _coll_recv(self, source: int, ctag: int) -> np.ndarray:
         return self.pml.irecv(None, self.remote_ids[source],
                               _ITAG_BASE - ctag, self.cid).wait()
@@ -229,6 +242,7 @@ class Intercomm:
             return np.asarray(self._coll_recv(0, self._CTAG_REDUCE))
         if root == PROC_NULL or root is None:
             return None
+        self._check_remote_root(root, "reduce")
         partial = self.local_comm.reduce(np.asarray(sendbuf), op=op, root=0)
         if self.rank == 0:
             self._coll_isend(partial, root, self._CTAG_REDUCE).wait()
@@ -269,6 +283,7 @@ class Intercomm:
                     for r in range(self.remote_size)]
         if root == PROC_NULL or root is None:
             return None
+        self._check_remote_root(root, "gather")
         self._coll_isend(np.asarray(sendbuf), root,
                          self._CTAG_GATHER).wait()
         return None
@@ -288,6 +303,7 @@ class Intercomm:
             return None
         if root == PROC_NULL or root is None:
             return None
+        self._check_remote_root(root, "scatter")
         return np.asarray(self._coll_recv(root, self._CTAG_SCATTER))
 
     # -- merge (≈ MPI_Intercomm_merge) -------------------------------------
@@ -351,14 +367,15 @@ def _job_info(comm: Communicator) -> dict:
     # outcome must be collective: a rank-local raise here would leave the
     # other ranks blocked in the gather below
     too_long = int(np.asarray(comm.allreduce(
-        np.array([1 if len(addr) > 64 else 0], np.int32),
+        np.array([1 if len(addr) > _CARD_BYTES else 0], np.int32),
         op=_max_op()))[0])
     if too_long:
         raise MPIException(
-            f"a BTL address exceeds the 64-byte business-card slot "
-            f"(mine: {comm.pml.address!r}); cannot exchange over "
+            f"a BTL address exceeds the {_CARD_BYTES}-byte business-card "
+            f"slot (mine: {comm.pml.address!r}); cannot exchange over "
             f"fixed-width gather")
-    addr_rows = comm.gather(np.frombuffer(addr.ljust(64), np.uint8), root=0)
+    addr_rows = comm.gather(
+        np.frombuffer(addr.ljust(_CARD_BYTES), np.uint8), root=0)
     addrs = None
     if comm.rank == 0:
         addrs = [bytes(np.asarray(r)).decode().strip() for r in addr_rows]
