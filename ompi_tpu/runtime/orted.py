@@ -1,0 +1,199 @@
+"""orted — the per-host runtime daemon.
+
+≈ orte/orted/orted_main.c:223: launched by the plm on every host of the
+job, it phones home to the HNP, joins the routed tree, and runs the local
+half of the runtime: fork/exec of its ranks (odls), IOF up-forwarding,
+stdin down-delivery, exit reporting, and kill-on-command.
+
+Run as ``python -m ompi_tpu.runtime.orted --hnp <uri> --vpid <n> ...``.
+``--fake-host`` gives the daemon a simulated host identity (exported as
+``OMPI_TPU_FAKE_HOST``) so multi-host paths are testable on one machine —
+the process-level analog of ras/simulator's fake nodes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Optional
+
+from ompi_tpu.core import output
+from ompi_tpu.runtime import pmix, rml
+
+_log = output.get_stream("orted")
+
+
+class Orted:
+    def __init__(self, hnp_uri: str, vpid: int, ndaemons: int,
+                 fake_host: Optional[str] = None) -> None:
+        self.vpid = vpid
+        self.ndaemons = ndaemons
+        self.fake_host = fake_host
+        self.hostname = fake_host or os.uname().nodename
+        self.node = rml.RmlNode(vpid)
+        self._popen: dict[int, subprocess.Popen] = {}
+        self._stdin_pipes: dict[int, object] = {}
+        self._lock = threading.Lock()
+        self._done = threading.Event()
+        self._wired = threading.Event()
+        self.node.register_recv(rml.TAG_WIRE, self._on_wire)
+        self.node.register_recv(rml.TAG_LAUNCH, self._on_launch)
+        self.node.register_recv(rml.TAG_KILL, self._on_kill)
+        self.node.register_recv(rml.TAG_STDIN, self._on_stdin)
+        self.node.register_recv(rml.TAG_SHUTDOWN,
+                                lambda o, p: self._done.set())
+        self._boot = self.node.dial_bootstrap(hnp_uri)
+        self.node.send_direct(self._boot, rml.TAG_REGISTER,
+                              (vpid, self.node.uri, self.hostname))
+
+    # -- tree wiring -------------------------------------------------------
+
+    def _on_wire(self, origin: int, payload) -> None:
+        children = payload  # [(vpid, uri), ...]
+        try:
+            self.node.dial_children([tuple(c) for c in children])
+        except OSError as e:
+            _log.error("orted %d: wiring children failed: %r", self.vpid, e)
+            os._exit(1)
+        self._wired.set()
+        self.node.send_up(rml.TAG_DAEMON_READY, self.vpid)
+
+    # -- odls: local launch ------------------------------------------------
+
+    def _on_launch(self, origin: int, payload) -> None:
+        # payload: {"by_daemon": [(vpid, [(rank, local_rank, chip)...])...],
+        #           "argv", "env", "cwd", "stdin_rank"} — the whole map is
+        # xcast once; each daemon picks its own rows (≈ the launch msg
+        # grpcomm floods down the tree)
+        threading.Thread(target=self._launch_local, args=(payload,),
+                         daemon=True).start()
+
+    def _launch_local(self, spec: dict) -> None:
+        mine: list = []
+        for vpid, rows in spec["by_daemon"]:
+            if vpid == self.vpid:
+                mine = rows
+                break
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        for rank, local_rank, chip in mine:
+            env = dict(os.environ)
+            env.update(spec["env"])
+            pypath = env.get("PYTHONPATH", "")
+            if pkg_root not in pypath.split(os.pathsep):
+                env["PYTHONPATH"] = (
+                    pkg_root + (os.pathsep + pypath if pypath else ""))
+            env[pmix.ENV_RANK] = str(rank)
+            env[pmix.ENV_LOCAL_RANK] = str(local_rank)
+            if chip is not None:
+                env[pmix.ENV_CHIP] = str(chip)
+            if self.fake_host:
+                env["OMPI_TPU_FAKE_HOST"] = self.fake_host
+            want_stdin = spec.get("stdin_rank") in ("all", rank)
+            try:
+                p = subprocess.Popen(
+                    spec["argv"], env=env, cwd=spec.get("cwd"),
+                    stdin=subprocess.PIPE if want_stdin
+                    else subprocess.DEVNULL,
+                    stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                    start_new_session=True)
+            except OSError as e:
+                # ≈ odls error-pipe: report the exec failure as an exit
+                self.node.send_up(rml.TAG_PROC_EXIT, (rank, 127, str(e)))
+                continue
+            with self._lock:
+                self._popen[rank] = p
+                if want_stdin:
+                    self._stdin_pipes[rank] = p.stdin
+            self._start_iof(rank, p)
+            threading.Thread(target=self._waiter, args=(rank, p),
+                             daemon=True).start()
+
+    def _start_iof(self, rank: int, p: subprocess.Popen) -> None:
+        def reader(pipe, stream: str) -> None:
+            for raw in iter(pipe.readline, b""):
+                try:
+                    self.node.send_up(rml.TAG_IOF, (rank, stream, raw))
+                except ConnectionError:
+                    return
+            pipe.close()
+
+        for pipe, stream in ((p.stdout, "out"), (p.stderr, "err")):
+            threading.Thread(target=reader, args=(pipe, stream),
+                             daemon=True).start()
+
+    def _waiter(self, rank: int, p: subprocess.Popen) -> None:
+        rc = p.wait()
+        # let IOF readers drain the tail before the exit report races them
+        time.sleep(0.05)
+        try:
+            self.node.send_up(rml.TAG_PROC_EXIT, (rank, rc, ""))
+        except ConnectionError:
+            pass
+
+    # -- control -----------------------------------------------------------
+
+    def _on_kill(self, origin: int, payload) -> None:
+        with self._lock:
+            victims = list(self._popen.values())
+        for p in victims:
+            if p.poll() is None:
+                try:
+                    os.killpg(p.pid, signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        deadline = time.monotonic() + 2.0
+        for p in victims:
+            try:
+                p.wait(timeout=max(0.0, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+
+    def _on_stdin(self, origin: int, payload) -> None:
+        rank, chunk = payload
+        with self._lock:
+            pipes = (list(self._stdin_pipes.items()) if rank == "all"
+                     else [(rank, self._stdin_pipes.get(rank))])
+        for r, pipe in pipes:
+            if pipe is None:
+                continue
+            try:
+                if chunk is None:
+                    pipe.close()
+                    with self._lock:
+                        self._stdin_pipes.pop(r, None)
+                else:
+                    pipe.write(chunk)
+                    pipe.flush()
+            except (BrokenPipeError, ValueError, OSError):
+                with self._lock:
+                    self._stdin_pipes.pop(r, None)
+
+    def run(self) -> int:
+        self._done.wait()
+        self._on_kill(0, None)   # stragglers die with the daemon
+        self.node.close()
+        return 0
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="ompi-tpu-orted")
+    ap.add_argument("--hnp", required=True, help="HNP rml uri host:port")
+    ap.add_argument("--vpid", type=int, required=True)
+    ap.add_argument("--ndaemons", type=int, required=True)
+    ap.add_argument("--fake-host", default=None)
+    args = ap.parse_args(argv)
+    return Orted(args.hnp, args.vpid, args.ndaemons,
+                 fake_host=args.fake_host).run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

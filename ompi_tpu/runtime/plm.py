@@ -1,0 +1,347 @@
+"""PLM — process lifecycle management: launching the daemon VM.
+
+≈ orte/mca/plm (plm_rsh_module.c:102,697: the ssh tree-spawn) plus the HNP
+launch logic of plm_base_launch_support.c.  Components start one orted per
+allocated node; the :class:`MultiHostLauncher` drives the full job DAG
+(clone of state_hnp.c:74-112):
+
+    INIT → ALLOCATE → MAP → LAUNCH_DAEMONS → VM_READY → LAUNCH_APPS
+         → RUNNING → TERMINATED/ABORTED
+
+Components:
+
+- ``sim`` — daemons are local child processes with simulated host
+  identities (``--fake-host sim-host-N``): the multi-host control plane,
+  modex routing, IOF tree, and cross-"host" data paths all run for real on
+  one machine (ranks on different sim-hosts refuse shm and ride tcp).
+  This is the test fixture the reference gets from ras_sim + rsh on
+  localhost.
+- ``ssh`` — real remote spawn over ssh (non-interactive auth assumed,
+  exactly plm/rsh's contract).  The TPU-pod analog of the rsh tree: one
+  daemon per TPU host; app procs then bind their local chips.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import subprocess
+import sys
+import threading
+import time
+from typing import Optional
+
+from ompi_tpu.core import output
+from ompi_tpu.core.config import VarType, register_var, var_registry
+from ompi_tpu.core.mca import Component, Framework
+from ompi_tpu.runtime import errmgr as errmgr_mod
+from ompi_tpu.runtime import pmix, ras, rmaps, rml
+from ompi_tpu.runtime.job import AppContext, Job, JobState, Proc, ProcState
+from ompi_tpu.runtime.state import StateMachine
+
+__all__ = ["plm_framework", "MultiHostLauncher"]
+
+_log = output.get_stream("plm")
+
+plm_framework = Framework("plm", "process lifecycle management")
+
+register_var("plm", "daemon_timeout", VarType.DOUBLE, 30.0,
+             "seconds to wait for daemons to phone home / wire up")
+register_var("plm", "ssh_args", VarType.STRING,
+             "-o BatchMode=yes -o StrictHostKeyChecking=no",
+             "extra arguments for the ssh transport")
+
+
+def _orted_argv(hnp_uri: str, vpid: int, ndaemons: int,
+                fake_host: Optional[str] = None) -> list[str]:
+    argv = [sys.executable, "-m", "ompi_tpu.runtime.orted",
+            "--hnp", hnp_uri, "--vpid", str(vpid),
+            "--ndaemons", str(ndaemons)]
+    if fake_host:
+        argv += ["--fake-host", fake_host]
+    return argv
+
+
+@plm_framework.component
+class SimPlm(Component):
+    """Local daemon processes with simulated host identities."""
+
+    NAME = "sim"
+    PRIORITY = 10
+
+    def spawn_daemons(self, job: Job, hnp_uri: str) -> list[subprocess.Popen]:
+        procs = []
+        for i, node in enumerate(job.nodes):
+            argv = _orted_argv(hnp_uri, i + 1, len(job.nodes) + 1,
+                               fake_host=node.name)
+            procs.append(subprocess.Popen(
+                argv, env=dict(os.environ), start_new_session=True))
+        return procs
+
+
+@plm_framework.component
+class SshPlm(Component):
+    """≈ plm/rsh: 'ssh <node> orted ...' per allocated host."""
+
+    NAME = "ssh"
+    PRIORITY = 20
+
+    def query(self, **ctx):
+        return self.PRIORITY if ctx.get("remote_hosts") else None
+
+    def spawn_daemons(self, job: Job, hnp_uri: str) -> list[subprocess.Popen]:
+        ssh_args = shlex.split(var_registry.get("plm_ssh_args") or "")
+        procs = []
+        for i, node in enumerate(job.nodes):
+            remote = " ".join(shlex.quote(a) for a in _orted_argv(
+                hnp_uri, i + 1, len(job.nodes) + 1))
+            argv = ["ssh", *ssh_args, node.name, remote]
+            procs.append(subprocess.Popen(
+                argv, env=dict(os.environ), start_new_session=True))
+        return procs
+
+
+class MultiHostLauncher:
+    """The HNP for a daemon-tree launch (≈ orterun driving state_hnp)."""
+
+    def __init__(self, plm_name: str = "sim", want_tpu: bool = False,
+                 stdin_target: str = "none", **select_ctx) -> None:
+        self.want_tpu = want_tpu
+        self.stdin_target = stdin_target
+        self.select_ctx = select_ctx
+        self.plm = plm_framework.lookup(plm_name)
+        self.sm = StateMachine()
+        self.sm.add_state(JobState.INIT, lambda sm, job: JobState.ALLOCATE)
+        self.sm.add_state(JobState.ALLOCATE, self._st_allocate)
+        self.sm.add_state(JobState.MAP, self._st_map)
+        self.sm.add_state(JobState.LAUNCH_APPS, self._st_launch)
+        self.sm.add_state(JobState.RUNNING, self._st_running)
+        self._errmgr = errmgr_mod.errmgr_framework.select(**select_ctx)
+        self.rml: Optional[rml.RmlNode] = None
+        self.server: Optional[pmix.PMIxServer] = None
+        self._daemon_popen: list[subprocess.Popen] = []
+        self._registered: dict[int, tuple[str, str]] = {}  # vpid→(uri,host)
+        self._ready: set[int] = set()
+        self._cv = threading.Condition()
+        self._exited: dict[int, int] = {}                  # rank → rc
+        self._killed = False
+
+    # -- state handlers ----------------------------------------------------
+
+    def _st_allocate(self, sm: StateMachine, job: Job) -> JobState:
+        ras.allocate(job, want_tpu=self.want_tpu, **self.select_ctx)
+        return JobState.MAP
+
+    def _st_map(self, sm: StateMachine, job: Job) -> JobState:
+        rmaps.map_job(job, **self.select_ctx)
+        return JobState.LAUNCH_APPS
+
+    def _st_launch(self, sm: StateMachine, job: Job) -> Optional[JobState]:
+        n_daemons = len(job.nodes)
+        self.rml = rml.RmlNode(0)
+        self.rml.register_recv(rml.TAG_REGISTER, self._on_register)
+        self.rml.register_recv(rml.TAG_DAEMON_READY, self._on_ready)
+        self.rml.register_recv(rml.TAG_IOF, self._on_iof)
+        self.rml.register_recv(rml.TAG_PROC_EXIT,
+                               lambda o, p: self._on_proc_exit(job, p))
+        # pmix rendezvous reachable from every host
+        self.server = pmix.PMIxServer(
+            size=job.np, host="0.0.0.0",
+            on_abort=lambda r, s, m: self._on_abort(job, r, s, m))
+
+        # LAUNCH_DAEMONS: plm spawns one orted per node; they phone home
+        self._daemon_popen = self.plm.spawn_daemons(job, self.rml.uri)
+        timeout = var_registry.get("plm_daemon_timeout")
+        with self._cv:
+            ok = self._cv.wait_for(
+                lambda: len(self._registered) >= n_daemons, timeout=timeout)
+        if not ok:
+            job.abort_reason = (
+                f"only {len(self._registered)}/{n_daemons} daemons "
+                f"reported within {timeout}s")
+            job.aborted_proc = job.procs[0]
+            self.kill_job(job)
+            return JobState.ABORTED
+
+        # VM_READY: wire the routed tree (vpid 0 = me, 1..N = daemons)
+        total = n_daemons + 1
+        uris = {0: self.rml.uri}
+        uris.update({v: u for v, (u, _h) in self._registered.items()})
+        for v in range(1, total):
+            children = [(c, uris[c]) for c in rml.tree_children(v, total)]
+            self.rml.send_direct(self.rml.boot_socks[v], rml.TAG_WIRE,
+                                 children)
+        self.rml.dial_children(
+            [(c, uris[c]) for c in rml.tree_children(0, total)])
+        with self._cv:
+            ok = self._cv.wait_for(
+                lambda: len(self._ready) >= n_daemons, timeout=timeout)
+        if not ok:
+            job.abort_reason = "daemon tree wiring timed out"
+            job.aborted_proc = job.procs[0]
+            self.kill_job(job)
+            return JobState.ABORTED
+
+        # LAUNCH_APPS: one xcast with the whole map; daemons pick their rows
+        app = job.apps[0]
+        env = dict(app.env)
+        env[pmix.ENV_URI] = self.server.uri.replace("0.0.0.0",
+                                                    self._my_address())
+        env[pmix.ENV_SIZE] = str(job.np)
+        env[pmix.ENV_JOBID] = str(job.jobid)
+        env.update(self._jax_coord_env(job))
+        by_daemon = []
+        for i, node in enumerate(job.nodes):
+            rows = [(p.rank, p.local_rank,
+                     None if p.chip is None else str(p.chip))
+                    for p in job.procs_on(node)]
+            by_daemon.append((i + 1, rows))
+        stdin_rank = (self.stdin_target if self.stdin_target in ("all",)
+                      else None if self.stdin_target == "none"
+                      else int(self.stdin_target))
+        self.rml.xcast(rml.TAG_LAUNCH, {
+            "by_daemon": by_daemon, "argv": app.argv, "env": env,
+            "cwd": app.cwd, "stdin_rank": stdin_rank})
+        for p in job.procs:
+            p.state = ProcState.RUNNING
+        if stdin_rank is not None:
+            self._start_stdin_pump(stdin_rank)
+        return JobState.RUNNING
+
+    def _st_running(self, sm: StateMachine, job: Job) -> JobState:
+        with self._cv:
+            self._cv.wait_for(lambda: len(self._exited) >= job.np)
+        self.rml.xcast(rml.TAG_SHUTDOWN, None)
+        deadline = time.monotonic() + 5.0
+        for p in self._daemon_popen:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+        if self.server is not None:
+            self.server.close()
+        self.rml.close()
+        return (JobState.ABORTED if job.aborted_proc is not None
+                else JobState.TERMINATED)
+
+    # -- rml handlers ------------------------------------------------------
+
+    def _on_register(self, origin: int, payload) -> None:
+        vpid, uri, hostname = payload
+        with self._cv:
+            self._registered[vpid] = (uri, hostname)
+            self._cv.notify_all()
+
+    def _on_ready(self, origin: int, payload) -> None:
+        with self._cv:
+            self._ready.add(payload)
+            self._cv.notify_all()
+
+    def _on_iof(self, origin: int, payload) -> None:
+        rank, stream, raw = payload
+        sink = sys.stdout if stream == "out" else sys.stderr
+        line = bytes(raw).decode(errors="replace")
+        if var_registry.get("launcher_tag_output"):
+            line = f"[mh,{rank}]{line}"
+        sink.write(line)
+        sink.flush()
+
+    def _on_proc_exit(self, job: Job, payload) -> None:
+        rank, rc, errmsg = payload
+        proc = job.procs[rank]
+        proc.exit_code = rc
+        if proc.state == ProcState.KILLED_BY_CMD:
+            pass
+        elif rc == 0:
+            proc.state = ProcState.TERMINATED
+        else:
+            proc.state = (ProcState.FAILED_TO_START if errmsg
+                          else ProcState.ABORTED)
+            if self.server is not None:
+                self.server.proc_died(rank)
+            self._errmgr.proc_failed(self, job, proc)
+        with self._cv:
+            self._exited[rank] = rc
+            self._cv.notify_all()
+
+    def _on_abort(self, job: Job, rank: int, status: int, msg: str) -> None:
+        proc = job.procs[rank]
+        if job.aborted_proc is None:
+            job.aborted_proc = proc
+            job.abort_reason = f"rank {rank} called abort: {msg}"
+            job.abort_status = status
+        self.kill_job(job)
+
+    # -- control -----------------------------------------------------------
+
+    def kill_job(self, job: Job, exclude: Optional[Proc] = None) -> None:
+        """errmgr entry point: xcast a kill; daemons SIGTERM/SIGKILL."""
+        if self._killed or self.rml is None:
+            return
+        self._killed = True
+        for p in job.procs:
+            if p.state == ProcState.RUNNING and p is not exclude:
+                p.state = ProcState.KILLED_BY_CMD
+        self.rml.xcast(rml.TAG_KILL, None)
+
+    def _start_stdin_pump(self, target) -> None:
+        """IOF stdin forwarding (≈ iof.h:27-43; default target rank 0)."""
+        def pump() -> None:
+            stdin = sys.stdin.buffer
+            try:
+                while True:
+                    chunk = stdin.read1(1 << 16)
+                    if not chunk:
+                        break
+                    self.rml.xcast(rml.TAG_STDIN, (target, chunk))
+            except (OSError, ValueError):
+                pass
+            try:
+                self.rml.xcast(rml.TAG_STDIN, (target, None))  # EOF
+            except Exception:
+                pass
+
+        threading.Thread(target=pump, daemon=True).start()
+
+    def _my_address(self) -> str:
+        """An address remote hosts can dial (sim: loopback is fine)."""
+        if self.plm.NAME == "sim":
+            return "127.0.0.1"
+        import socket as _s
+
+        try:
+            probe = _s.socket(_s.AF_INET, _s.SOCK_DGRAM)
+            probe.connect(("8.8.8.8", 80))
+            addr = probe.getsockname()[0]
+            probe.close()
+            return addr
+        except OSError:
+            return _s.gethostbyname(_s.gethostname())
+
+    def _jax_coord_env(self, job: Job) -> dict[str, str]:
+        """jax.distributed coordination: rank 0's host runs the coordinator
+        on a port the HNP picks; every rank learns (coord, nprocs, my id)
+        and multihost.initialize_from_env() does the rest."""
+        import socket as _s
+
+        with _s.socket() as s:   # free-port probe on the HNP host
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+        host0 = ("127.0.0.1" if self.plm.NAME == "sim"
+                 else job.procs[0].node.name)
+        return {"OMPI_TPU_COORD": f"{host0}:{port}",
+                "OMPI_TPU_NHOSTS": str(len(job.nodes))}
+
+    # -- entry -------------------------------------------------------------
+
+    def run(self, job: Job) -> int:
+        self.sm.run_to_completion(job, JobState.INIT)
+        if job.aborted_proc is not None:
+            output.show_help("launcher", "job-aborted",
+                             jobid=job.jobid,
+                             reason=job.abort_reason or "unknown")
+            if job.abort_status is not None:
+                return job.abort_status or 1
+            rc = job.aborted_proc.exit_code or 1
+            return 128 - rc if rc < 0 else rc
+        return 0

@@ -1,0 +1,244 @@
+"""RML — the runtime's tagged messaging bus over a routed daemon tree.
+
+≈ orte/mca/rml (rml.h:373,412 send/recv_buffer_nb) + orte/mca/oob/tcp +
+orte/mca/routed/binomial (routed.h:123) + grpcomm xcast (grpcomm.h:110),
+collapsed into one module sized for TPU pods (tens of hosts, not tens of
+thousands):
+
+- Every runtime node (the HNP = vpid 0, one daemon per host = vpid 1..N)
+  is an :class:`RmlNode` with a TCP listener and tag→handler registry.
+- **Bootstrap** is the reference's phone-home: each daemon dials the HNP
+  and registers (vpid, uri).  When all have reported, the HNP computes a
+  binary routing tree and sends each daemon a WIRE message naming its
+  children; every parent then dials its children (the routed overlay).
+- **xcast(tag, payload)** floods down the tree: each node delivers
+  locally and relays to its children — O(log n) fan-out from the HNP,
+  exactly grpcomm/xcast's job.
+- **send_up(tag, payload)** relays toward vpid 0 through parents — the
+  daemons' report channel (IOF, proc exits, registrations).
+
+Messages are DSS-framed ``(kind, tag, origin, payload)`` tuples; handlers
+run on the link reader thread (keep them short or hand off, the same
+contract as the reference's event-loop callbacks).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Any, Callable, Optional
+
+from ompi_tpu.core import dss, output
+
+__all__ = ["RmlNode", "tree_children", "tree_parent"]
+
+_log = output.get_stream("rml")
+
+# well-known tags (≈ orte/mca/rml/rml_types.h:59-69)
+TAG_REGISTER = "register"       # daemon → HNP: (vpid, uri, hostname)
+TAG_WIRE = "wire"               # HNP → daemon: children to dial
+TAG_LAUNCH = "launch"           # xcast: proc table
+TAG_KILL = "kill"               # xcast: tear the job down
+TAG_SHUTDOWN = "shutdown"       # xcast: daemons exit
+TAG_IOF = "iof"                 # up: (rank, stream, chunk)
+TAG_STDIN = "stdin"             # xcast: (target_rank, chunk | None=EOF)
+TAG_PROC_EXIT = "proc_exit"     # up: (rank, exit_code)
+TAG_DAEMON_READY = "ready"      # up: daemon wired + children connected
+
+
+def tree_parent(vpid: int) -> Optional[int]:
+    """Binary routing tree over vpids 0..N (0 = HNP)."""
+    return None if vpid == 0 else (vpid - 1) // 2
+
+def tree_children(vpid: int, n: int) -> list[int]:
+    """Children of ``vpid`` among vpids 0..n-1."""
+    return [c for c in (2 * vpid + 1, 2 * vpid + 2) if c < n]
+
+
+def _send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(struct.pack("<I", len(payload)) + payload)
+
+
+def _recv_frame(sock: socket.socket) -> Optional[bytes]:
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = sock.recv(4 - len(hdr))
+        if not chunk:
+            return None
+        hdr += chunk
+    (n,) = struct.unpack("<I", hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 16, n - len(buf)))
+        if not chunk:
+            return None
+        buf += chunk
+    return bytes(buf)
+
+
+class RmlNode:
+    """One runtime node on the bus (HNP or daemon)."""
+
+    def __init__(self, vpid: int, host: str = "127.0.0.1") -> None:
+        self.vpid = vpid
+        self._handlers: dict[str, Callable[[int, Any], None]] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._parent_sock: Optional[socket.socket] = None
+        self._child_socks: dict[int, socket.socket] = {}
+        self.boot_socks: dict[int, socket.socket] = {}  # HNP: vpid → link
+        self._listener = socket.create_server((host, 0), backlog=32)
+        self.uri = f"{host}:{self._listener.getsockname()[1]}"
+        self._threads: list[threading.Thread] = []
+        t = threading.Thread(target=self._accept_loop,
+                             name=f"rml-accept-{vpid}", daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    # -- wiring -----------------------------------------------------------
+
+    def register_recv(self, tag: str,
+                      cb: Callable[[int, Any], None]) -> None:
+        """Register cb(origin_vpid, payload) for a tag (≈ rml.h:412)."""
+        with self._lock:
+            self._handlers[tag] = cb
+
+    def dial_bootstrap(self, hnp_uri: str) -> socket.socket:
+        """Daemon side phone-home: a direct link to the HNP used ONLY for
+        registration and the WIRE reply (the tree does not exist yet —
+        ≈ orted's callback to mpirun, orted_main.c)."""
+        host, port = hnp_uri.rsplit(":", 1)
+        sock = socket.create_connection((host, int(port)))
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        _send_frame(sock, dss.pack(("hello", self.vpid)))
+        self._spawn_reader(sock, 0)
+        return sock
+
+    def dial_children(self, children: list[tuple[int, str]]) -> None:
+        """Parent side: connect the down-links (the routed overlay edges)."""
+        for cvpid, curi in children:
+            host, port = curi.rsplit(":", 1)
+            sock = socket.create_connection((host, int(port)))
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            _send_frame(sock, dss.pack(("hello", self.vpid)))
+            with self._lock:
+                self._child_socks[cvpid] = sock
+            self._spawn_reader(sock, cvpid)
+
+    # -- traffic ----------------------------------------------------------
+
+    def xcast(self, tag: str, payload: Any) -> None:
+        """Deliver everywhere below me (incl. locally) — grpcomm xcast."""
+        self._deliver(tag, self.vpid, payload)
+        self._relay_down(tag, self.vpid, payload)
+
+    def send_up(self, tag: str, payload: Any) -> None:
+        """Deliver at the HNP, relaying through the tree."""
+        if self.vpid == 0:
+            self._deliver(tag, 0, payload)
+            return
+        if self._parent_sock is None:
+            raise ConnectionError("rml: no parent link (not wired yet)")
+        _send_frame(self._parent_sock,
+                    dss.pack(("up", tag, self.vpid, payload)))
+
+    def send_direct(self, sock: socket.socket, tag: str,
+                    payload: Any) -> None:
+        """Bootstrap-only: a message over an explicit link (HNP replies to
+        a registration before the tree exists)."""
+        _send_frame(sock, dss.pack(("direct", tag, self.vpid, payload)))
+
+    def _relay_down(self, tag: str, origin: int, payload: Any) -> None:
+        with self._lock:
+            socks = list(self._child_socks.values())
+        blob = dss.pack(("xcast", tag, origin, payload))
+        for sock in socks:
+            try:
+                _send_frame(sock, blob)
+            except OSError as e:
+                _log.error("rml %d: xcast relay failed: %r", self.vpid, e)
+
+    def _deliver(self, tag: str, origin: int, payload: Any) -> None:
+        with self._lock:
+            cb = self._handlers.get(tag)
+        if cb is None:
+            _log.verbose(1, "rml %d: no handler for tag %r", self.vpid, tag)
+            return
+        try:
+            cb(origin, payload)
+        except Exception as e:
+            _log.error("rml %d: handler %r failed: %r", self.vpid, tag, e)
+
+    # -- link management --------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        try:
+            self._listener.settimeout(0.2)
+        except OSError:
+            return
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._spawn_reader(conn, None)
+
+    def _spawn_reader(self, sock: socket.socket, peer: Optional[int]) -> None:
+        t = threading.Thread(target=self._read_loop, args=(sock, peer),
+                             daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _read_loop(self, sock: socket.socket,
+                   peer: Optional[int]) -> None:
+        with sock:
+            while not self._stop.is_set():
+                blob = _recv_frame(sock)
+                if blob is None:
+                    return
+                msg = dss.unpack(blob, n=1)[0]
+                kind = msg[0]
+                if kind == "hello":
+                    peer = msg[1]
+                    # an accepted hello from my tree parent IS my up-link;
+                    # at the HNP an accepted hello is a bootstrap link
+                    if tree_parent(self.vpid) == peer:
+                        self._parent_sock = sock
+                    if self.vpid == 0:
+                        with self._lock:
+                            self.boot_socks[peer] = sock
+                    continue
+                _, tag, origin, payload = msg
+                if kind == "xcast":
+                    self._deliver(tag, origin, payload)
+                    self._relay_down(tag, origin, payload)
+                elif kind == "up":
+                    if self.vpid == 0:
+                        self._deliver(tag, origin, payload)
+                    elif self._parent_sock is not None:
+                        _send_frame(self._parent_sock, blob)
+                    else:
+                        _log.error("rml %d: up msg with no parent", self.vpid)
+                elif kind == "direct":
+                    self._deliver(tag, origin, payload)
+                else:
+                    _log.error("rml %d: unknown kind %r", self.vpid, kind)
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            socks = list(self._child_socks.values())
+            self._child_socks.clear()
+        for s in socks + ([self._parent_sock] if self._parent_sock else []):
+            try:
+                s.close()
+            except OSError:
+                pass
