@@ -53,6 +53,20 @@ def init() -> Communicator:
         else:
             client, rank, size = None, 0, 1
 
+        # multi-host device plane: join the job-wide jax.distributed view
+        # while no JAX backend is live yet (≈ the modex feeding transport
+        # bring-up, pmix.h:384-407). MPI itself works without it, so a
+        # bootstrap failure degrades to host-only with a warning.
+        from ompi_tpu.core.config import var_registry as _vars
+        from ompi_tpu.parallel import multihost
+
+        if multihost.is_multihost_env() and _vars.get("multihost_auto_init"):
+            try:
+                multihost.initialize_from_env()
+            except Exception as e:  # pragma: no cover - env-dependent
+                _log.error("multihost bootstrap failed (device plane "
+                           "degraded to host-only): %r", e)
+
         pml = pml_framework.select().create(rank)
 
         if size > 1:
@@ -94,10 +108,19 @@ def finalize(_collective: bool = True) -> None:
         world = _state["world"]
         if world is None:
             return
+        from ompi_tpu.parallel import multihost
+
         try:
             if world.size > 1 and _collective:
                 world.barrier()
+                # leave the device view while every rank is still alive
+                # (post-barrier). jax.distributed.shutdown() synchronizes
+                # across tasks internally, so all ranks must call it
+                # concurrently — staggering it (workers first, then the
+                # coordinator) deadlocks against that internal barrier.
+                multihost.shutdown()
         finally:
+            multihost.shutdown()  # no-op if already left; atexit path
             if _state["pml"] is not None:
                 _state["pml"].close()
             client = _state["client"]

@@ -42,8 +42,12 @@ register_var("launcher", "kill_grace_s", VarType.DOUBLE, 2.0,
 class LocalLauncher:
     """Launches a job's ranks as local OS processes (device-per-rank aware)."""
 
-    def __init__(self, want_tpu: bool = False, **select_ctx) -> None:
+    def __init__(self, want_tpu: bool = False,
+                 stdin_target: Optional[str] = None, **select_ctx) -> None:
         self.want_tpu = want_tpu
+        # ≈ iof.h:27-43: launcher stdin goes to rank 0 by default;
+        # "all" duplicates it to every rank, "none" gives ranks /dev/null.
+        self.stdin_target = "0" if stdin_target is None else str(stdin_target)
         self.select_ctx = select_ctx
         self.sm = StateMachine()
         self.sm.add_state(JobState.INIT, self._st_init)
@@ -56,6 +60,7 @@ class LocalLauncher:
         self._iof_threads: list[threading.Thread] = []
         self._errmgr = errmgr_mod.errmgr_framework.select(**select_ctx)
         self._kill_lock = threading.Lock()
+        self._stdin_sinks: list = []
 
     # -- state handlers (the launch DAG) ---------------------------------
 
@@ -93,9 +98,13 @@ class LocalLauncher:
             env[pmix.ENV_LOCAL_RANK] = str(proc.local_rank)
             if proc.chip is not None:
                 env[pmix.ENV_CHIP] = str(proc.chip)
+            want_stdin = (self.stdin_target == "all"
+                          or self.stdin_target == str(proc.rank))
             try:
                 p = subprocess.Popen(
                     app.argv, env=env, cwd=app.cwd,
+                    stdin=(subprocess.PIPE if want_stdin
+                           else subprocess.DEVNULL),
                     stdout=subprocess.PIPE, stderr=subprocess.PIPE,
                     start_new_session=True)
             except OSError as e:
@@ -118,7 +127,13 @@ class LocalLauncher:
             proc.state = ProcState.RUNNING
             with self._kill_lock:  # kill_job may iterate concurrently
                 self._popen[proc.rank] = p
+            if want_stdin:
+                from ompi_tpu.runtime.orted import _StdinWriter
+
+                self._stdin_sinks.append(_StdinWriter(proc.rank, p.stdin))
             self._start_iof(job, proc, p)
+        if self._stdin_sinks:
+            self._start_stdin_pump()
         return JobState.RUNNING
 
     def _st_running(self, sm: StateMachine, job: Job) -> Optional[JobState]:
@@ -170,6 +185,32 @@ class LocalLauncher:
             t = threading.Thread(target=reader, args=(pipe, sink), daemon=True)
             t.start()
             self._iof_threads.append(t)
+
+    def _start_stdin_pump(self) -> None:
+        """Forward launcher stdin to the target rank(s) (≈ iof hnp stdin).
+
+        Each sink is a bounded-queue ``_StdinWriter`` (shared with orted),
+        so one rank that never drains stdin cannot head-of-line block the
+        others under ``--stdin all``.
+        """
+        def pump() -> None:
+            try:
+                src = sys.stdin.buffer
+            except AttributeError:
+                src = None  # stdin replaced (pytest capture) — nothing to do
+            try:
+                while src is not None:
+                    chunk = src.read1(1 << 16)
+                    if not chunk:
+                        break
+                    for w in self._stdin_sinks:
+                        w.feed(chunk)
+            except (OSError, ValueError):
+                pass
+            for w in self._stdin_sinks:
+                w.feed(None)  # EOF
+
+        threading.Thread(target=pump, daemon=True).start()
 
     # -- abort path --------------------------------------------------------
 
@@ -229,7 +270,9 @@ class LocalLauncher:
 
 
 def launch(argv: list[str], np: int, want_tpu: bool = False,
-           env: Optional[dict[str, str]] = None, **select_ctx) -> int:
+           env: Optional[dict[str, str]] = None,
+           stdin_target: Optional[str] = None, **select_ctx) -> int:
     """One-call launch: build the job, run it, return exit code."""
     job = Job([AppContext(argv=argv, np=np, env=env or {})])
-    return LocalLauncher(want_tpu=want_tpu, **select_ctx).run(job)
+    return LocalLauncher(want_tpu=want_tpu, stdin_target=stdin_target,
+                         **select_ctx).run(job)

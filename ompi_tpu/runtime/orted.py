@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import queue
 import signal
 import subprocess
 import sys
@@ -28,6 +29,37 @@ from ompi_tpu.runtime import pmix, rml
 _log = output.get_stream("orted")
 
 
+class _StdinWriter:
+    """Per-rank stdin pump: a bounded queue + writer thread, so blocking
+    pipe writes (rank not draining stdin) never stall an RML reader."""
+
+    def __init__(self, rank: int, pipe) -> None:
+        self.rank = rank
+        self._q: queue.Queue = queue.Queue(maxsize=64)
+        self._t = threading.Thread(target=self._run, args=(pipe,),
+                                   daemon=True)
+        self._t.start()
+
+    def feed(self, chunk: Optional[bytes]) -> None:
+        try:
+            self._q.put(chunk, timeout=1.0)
+        except queue.Full:
+            _log.error("stdin to rank %d backed up; dropping %d bytes",
+                       self.rank, 0 if chunk is None else len(chunk))
+
+    def _run(self, pipe) -> None:
+        while True:
+            chunk = self._q.get()
+            try:
+                if chunk is None:
+                    pipe.close()
+                    return
+                pipe.write(chunk)
+                pipe.flush()
+            except (BrokenPipeError, ValueError, OSError):
+                return
+
+
 class Orted:
     def __init__(self, hnp_uri: str, vpid: int, ndaemons: int,
                  fake_host: Optional[str] = None) -> None:
@@ -37,7 +69,9 @@ class Orted:
         self.hostname = fake_host or os.uname().nodename
         self.node = rml.RmlNode(vpid)
         self._popen: dict[int, subprocess.Popen] = {}
-        self._stdin_pipes: dict[int, object] = {}
+        self._stdin_writers: dict[int, _StdinWriter] = {}
+        self._launched = False
+        self._pending_stdin: list = []  # stdin xcasts that beat the launch
         self._lock = threading.Lock()
         self._done = threading.Event()
         self._wired = threading.Event()
@@ -47,6 +81,10 @@ class Orted:
         self.node.register_recv(rml.TAG_STDIN, self._on_stdin)
         self.node.register_recv(rml.TAG_SHUTDOWN,
                                 lambda o, p: self._done.set())
+        # lifeline: if the HNP or my tree parent vanishes, my ranks'
+        # reports have nowhere to go — kill them and die rather than leak
+        # (≈ orted treating a lost lifeline as job abort, orted_main.c)
+        self.node.on_peer_lost = self._on_lifeline_lost
         self._boot = self.node.dial_bootstrap(hnp_uri)
         self.node.send_direct(self._boot, rml.TAG_REGISTER,
                               (vpid, self.node.uri, self.hostname))
@@ -60,8 +98,25 @@ class Orted:
         except OSError as e:
             _log.error("orted %d: wiring children failed: %r", self.vpid, e)
             os._exit(1)
+        # WIRE arrives on the bootstrap link, but DAEMON_READY rides the
+        # tree — the parent's dial may still be in flight.  Gate the reply
+        # on the up-link actually existing (this runs on the bootstrap
+        # reader thread; the parent's hello arrives on its own thread).
+        if not self.node.wait_parent(timeout=30.0):
+            _log.error("orted %d: parent never dialed in", self.vpid)
+            os._exit(1)
         self._wired.set()
         self.node.send_up(rml.TAG_DAEMON_READY, self.vpid)
+
+    def _on_lifeline_lost(self, peer: int) -> None:
+        if peer not in (0, rml.tree_parent(self.vpid)):
+            return  # a child daemon died; the HNP handles that
+        if self._done.is_set():
+            return  # normal teardown: SHUTDOWN already processed
+        _log.error("orted %d: lifeline to %d lost; tearing down", self.vpid,
+                   peer)
+        self._on_kill(peer, None)
+        os._exit(1)
 
     # -- odls: local launch ------------------------------------------------
 
@@ -109,10 +164,26 @@ class Orted:
             with self._lock:
                 self._popen[rank] = p
                 if want_stdin:
-                    self._stdin_pipes[rank] = p.stdin
+                    self._stdin_writers[rank] = _StdinWriter(rank, p.stdin)
             self._start_iof(rank, p)
             threading.Thread(target=self._waiter, args=(rank, p),
                              daemon=True).start()
+        # replay stdin that raced ahead of the launch xcast.  The replay
+        # must happen under the lock that gates _launched: otherwise a
+        # chunk arriving on the RML thread right after the flag flips
+        # could be written before the buffered chunks (reordered stream).
+        # feed() is non-blocking (bounded queue), so holding the lock
+        # across it is safe.
+        with self._lock:
+            pending, self._pending_stdin = self._pending_stdin, []
+            for rank, chunk in pending:
+                writers = (list(self._stdin_writers.values())
+                           if rank == "all"
+                           else [w for w in (self._stdin_writers.get(rank),)
+                                 if w is not None])
+                for w in writers:
+                    w.feed(chunk)
+            self._launched = True
 
     def _start_iof(self, rank: int, p: subprocess.Popen) -> None:
         def reader(pipe, stream: str) -> None:
@@ -158,24 +229,20 @@ class Orted:
                     pass
 
     def _on_stdin(self, origin: int, payload) -> None:
+        # Runs on the RML link reader thread: never write the pipe here —
+        # a rank that doesn't drain stdin would fill the OS pipe, block
+        # this thread, and stall TAG_KILL/TAG_SHUTDOWN on the same link.
+        # Hand the chunk to the per-rank writer thread instead.
         rank, chunk = payload
         with self._lock:
-            pipes = (list(self._stdin_pipes.items()) if rank == "all"
-                     else [(rank, self._stdin_pipes.get(rank))])
-        for r, pipe in pipes:
-            if pipe is None:
-                continue
-            try:
-                if chunk is None:
-                    pipe.close()
-                    with self._lock:
-                        self._stdin_pipes.pop(r, None)
-                else:
-                    pipe.write(chunk)
-                    pipe.flush()
-            except (BrokenPipeError, ValueError, OSError):
-                with self._lock:
-                    self._stdin_pipes.pop(r, None)
+            if not self._launched:
+                self._pending_stdin.append(payload)
+                return
+            writers = (list(self._stdin_writers.values()) if rank == "all"
+                       else [w for w in (self._stdin_writers.get(rank),)
+                             if w is not None])
+        for w in writers:
+            w.feed(chunk)
 
     def run(self) -> int:
         self._done.wait()

@@ -35,6 +35,7 @@ from ompi_tpu.core import output
 from ompi_tpu.core.config import VarType, register_var, var_registry
 from ompi_tpu.core.mca import Component, Framework
 from ompi_tpu.runtime import errmgr as errmgr_mod
+from ompi_tpu.runtime import launcher as _launcher  # registers launcher_* vars
 from ompi_tpu.runtime import pmix, ras, rmaps, rml
 from ompi_tpu.runtime.job import AppContext, Job, JobState, Proc, ProcState
 from ompi_tpu.runtime.state import StateMachine
@@ -107,7 +108,13 @@ class MultiHostLauncher:
     def __init__(self, plm_name: str = "sim", want_tpu: bool = False,
                  stdin_target: str = "none", **select_ctx) -> None:
         self.want_tpu = want_tpu
-        self.stdin_target = stdin_target
+        # validate before any daemon exists: a bad --stdin must fail the
+        # CLI, not blow up the state machine mid-launch
+        if stdin_target not in ("all", "none") and not str(stdin_target).isdigit():
+            raise ValueError(
+                f"--stdin must be a rank number, 'all' or 'none' "
+                f"(got {stdin_target!r})")
+        self.stdin_target = str(stdin_target)
         self.select_ctx = select_ctx
         self.plm = plm_framework.lookup(plm_name)
         self.sm = StateMachine()
@@ -125,6 +132,8 @@ class MultiHostLauncher:
         self._cv = threading.Condition()
         self._exited: dict[int, int] = {}                  # rank → rc
         self._killed = False
+        self._lost_daemon: Optional[int] = None            # vpid, if died
+        self._np_hint = 1 << 30                            # set at launch
 
     # -- state handlers ----------------------------------------------------
 
@@ -138,6 +147,7 @@ class MultiHostLauncher:
 
     def _st_launch(self, sm: StateMachine, job: Job) -> Optional[JobState]:
         n_daemons = len(job.nodes)
+        self._np_hint = job.np
         self.rml = rml.RmlNode(0)
         self.rml.register_recv(rml.TAG_REGISTER, self._on_register)
         self.rml.register_recv(rml.TAG_DAEMON_READY, self._on_ready)
@@ -149,35 +159,49 @@ class MultiHostLauncher:
             size=job.np, host="0.0.0.0",
             on_abort=lambda r, s, m: self._on_abort(job, r, s, m))
 
+        self.rml.on_peer_lost = self._on_daemon_lost
+
         # LAUNCH_DAEMONS: plm spawns one orted per node; they phone home
         self._daemon_popen = self.plm.spawn_daemons(job, self.rml.uri)
+        threading.Thread(target=self._daemon_monitor, args=(job,),
+                         daemon=True).start()
         timeout = var_registry.get("plm_daemon_timeout")
         with self._cv:
             ok = self._cv.wait_for(
-                lambda: len(self._registered) >= n_daemons, timeout=timeout)
-        if not ok:
+                lambda: (len(self._registered) >= n_daemons
+                         or self._lost_daemon is not None), timeout=timeout)
+        if not ok or self._lost_daemon is not None:
             job.abort_reason = (
+                f"daemon {self._lost_daemon} died during launch"
+                if self._lost_daemon is not None else
                 f"only {len(self._registered)}/{n_daemons} daemons "
                 f"reported within {timeout}s")
             job.aborted_proc = job.procs[0]
             self.kill_job(job)
             return JobState.ABORTED
 
-        # VM_READY: wire the routed tree (vpid 0 = me, 1..N = daemons)
+        # VM_READY: wire the routed tree (vpid 0 = me, 1..N = daemons).
+        # Dial my own children BEFORE sending any WIRE: a daemon replies
+        # DAEMON_READY up the tree, so its up-link must exist (orted also
+        # gates the reply on wait_parent — belt and suspenders).
         total = n_daemons + 1
         uris = {0: self.rml.uri}
         uris.update({v: u for v, (u, _h) in self._registered.items()})
-        for v in range(1, total):
-            children = [(c, uris[c]) for c in rml.tree_children(v, total)]
-            self.rml.send_direct(self.rml.boot_socks[v], rml.TAG_WIRE,
-                                 children)
         self.rml.dial_children(
             [(c, uris[c]) for c in rml.tree_children(0, total)])
+        for v in range(1, total):
+            children = [(c, uris[c]) for c in rml.tree_children(v, total)]
+            self.rml.send_direct(self.rml.boot_links[v], rml.TAG_WIRE,
+                                 children)
         with self._cv:
             ok = self._cv.wait_for(
-                lambda: len(self._ready) >= n_daemons, timeout=timeout)
-        if not ok:
-            job.abort_reason = "daemon tree wiring timed out"
+                lambda: (len(self._ready) >= n_daemons
+                         or self._lost_daemon is not None), timeout=timeout)
+        if not ok or self._lost_daemon is not None:
+            job.abort_reason = (
+                f"daemon {self._lost_daemon} died during tree wiring"
+                if self._lost_daemon is not None
+                else "daemon tree wiring timed out")
             job.aborted_proc = job.procs[0]
             self.kill_job(job)
             return JobState.ABORTED
@@ -209,8 +233,32 @@ class MultiHostLauncher:
         return JobState.RUNNING
 
     def _st_running(self, sm: StateMachine, job: Job) -> JobState:
+        # A lost daemon is a lost lifeline (≈ ORTE aborting the job when an
+        # orted dies): its ranks' PROC_EXIT reports are gone forever, so
+        # waiting only on rank exits would hang.
         with self._cv:
-            self._cv.wait_for(lambda: len(self._exited) >= job.np)
+            self._cv.wait_for(lambda: (len(self._exited) >= job.np
+                                       or self._lost_daemon is not None))
+            lost = self._lost_daemon
+        if lost is not None and len(self._exited) < job.np:
+            if job.aborted_proc is None:
+                job.abort_reason = (
+                    f"daemon {lost} (host "
+                    f"{self._registered.get(lost, ('?', '?'))[1]}) died "
+                    f"before its ranks reported")
+                job.aborted_proc = job.procs[0]
+            self.kill_job(job)
+            # best effort: wait only for ranks whose daemon still lives —
+            # the dead daemon's ranks can never report
+            lost_node = (job.nodes[lost - 1]
+                         if 0 < lost <= len(job.nodes) else None)
+            dead = ({p.rank for p in job.procs_on(lost_node)}
+                    if lost_node is not None else set())
+            alive = [p.rank for p in job.procs if p.rank not in dead]
+            with self._cv:
+                self._cv.wait_for(
+                    lambda: all(r in self._exited for r in alive),
+                    timeout=3.0)
         self.rml.xcast(rml.TAG_SHUTDOWN, None)
         deadline = time.monotonic() + 5.0
         for p in self._daemon_popen:
@@ -264,6 +312,27 @@ class MultiHostLauncher:
             self._exited[rank] = rc
             self._cv.notify_all()
 
+    def _on_daemon_lost(self, vpid: int) -> None:
+        """RML link EOF from a daemon (crash/SIGKILL/host death)."""
+        with self._cv:
+            if self._killed or len(self._exited) >= self._np_hint:
+                return  # normal teardown, not a failure
+            if self._lost_daemon is None:
+                self._lost_daemon = vpid
+            self._cv.notify_all()
+
+    def _daemon_monitor(self, job: Job) -> None:
+        """Poll orted Popen handles: a dead daemon before job end = abort."""
+        while True:
+            with self._cv:
+                if self._killed or len(self._exited) >= job.np:
+                    return
+            for i, p in enumerate(self._daemon_popen):
+                if p.poll() is not None:
+                    self._on_daemon_lost(i + 1)
+                    return
+            time.sleep(0.25)
+
     def _on_abort(self, job: Job, rank: int, status: int, msg: str) -> None:
         proc = job.procs[rank]
         if job.aborted_proc is None:
@@ -287,8 +356,13 @@ class MultiHostLauncher:
     def _start_stdin_pump(self, target) -> None:
         """IOF stdin forwarding (≈ iof.h:27-43; default target rank 0)."""
         def pump() -> None:
-            stdin = sys.stdin.buffer
             try:
+                stdin = sys.stdin.buffer
+            except AttributeError:
+                stdin = None  # stdin replaced (pytest capture)
+            try:
+                if stdin is None:
+                    raise OSError
                 while True:
                     chunk = stdin.read1(1 << 16)
                     if not chunk:
@@ -324,11 +398,19 @@ class MultiHostLauncher:
         and multihost.initialize_from_env() does the rest."""
         import socket as _s
 
-        with _s.socket() as s:   # free-port probe on the HNP host
-            s.bind(("", 0))
-            port = s.getsockname()[1]
-        host0 = ("127.0.0.1" if self.plm.NAME == "sim"
-                 else job.procs[0].node.name)
+        if self.plm.NAME == "sim":
+            # coordinator binds on this host: a real free-port probe works
+            with _s.socket() as s:
+                s.bind(("", 0))
+                port = s.getsockname()[1]
+            host0 = "127.0.0.1"
+        else:
+            # the coordinator binds on rank 0's (remote) host, which the
+            # HNP cannot probe — derive a port from the jobid in the
+            # dynamic range to make collisions unlikely (the reference's
+            # oob/tcp static-port story has the same limitation)
+            port = 49152 + (job.jobid * 211 + os.getpid()) % 16000
+            host0 = job.procs[0].node.name
         return {"OMPI_TPU_COORD": f"{host0}:{port}",
                 "OMPI_TPU_NHOSTS": str(len(job.nodes))}
 

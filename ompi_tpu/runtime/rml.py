@@ -20,6 +20,12 @@ thousands):
 Messages are DSS-framed ``(kind, tag, origin, payload)`` tuples; handlers
 run on the link reader thread (keep them short or hand off, the same
 contract as the reference's event-loop callbacks).
+
+Every link is a :class:`_Link` — (socket, send-lock) — because frames are
+written by many threads (IOF readers, exit waiters, relays) and
+``sendall`` is not atomic under backpressure: without the lock, partial
+sends interleave and corrupt the length-prefixed stream (the same reason
+TcpBTL keeps a per-socket lock).
 """
 
 from __future__ import annotations
@@ -56,8 +62,25 @@ def tree_children(vpid: int, n: int) -> list[int]:
     return [c for c in (2 * vpid + 1, 2 * vpid + 2) if c < n]
 
 
-def _send_frame(sock: socket.socket, payload: bytes) -> None:
-    sock.sendall(struct.pack("<I", len(payload)) + payload)
+class _Link:
+    """One framed TCP link with a serialized writer side."""
+
+    __slots__ = ("sock", "_wlock")
+
+    def __init__(self, sock: socket.socket) -> None:
+        self.sock = sock
+        self._wlock = threading.Lock()
+
+    def send(self, payload: bytes) -> None:
+        frame = struct.pack("<I", len(payload)) + payload
+        with self._wlock:
+            self.sock.sendall(frame)
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
 
 
 def _recv_frame(sock: socket.socket) -> Optional[bytes]:
@@ -85,9 +108,13 @@ class RmlNode:
         self._handlers: dict[str, Callable[[int, Any], None]] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
-        self._parent_sock: Optional[socket.socket] = None
-        self._child_socks: dict[int, socket.socket] = {}
-        self.boot_socks: dict[int, socket.socket] = {}  # HNP: vpid → link
+        self._parent_link: Optional[_Link] = None
+        self.parent_wired = threading.Event()  # set when the up-link exists
+        self._child_links: dict[int, _Link] = {}
+        self.boot_links: dict[int, _Link] = {}  # HNP: vpid → link
+        # Called with the peer vpid when a known link hits EOF — the
+        # lifeline-lost signal (≈ ORTE aborting on a lost daemon lifeline).
+        self.on_peer_lost: Optional[Callable[[int], None]] = None
         self._listener = socket.create_server((host, 0), backlog=32)
         self.uri = f"{host}:{self._listener.getsockname()[1]}"
         self._threads: list[threading.Thread] = []
@@ -104,16 +131,17 @@ class RmlNode:
         with self._lock:
             self._handlers[tag] = cb
 
-    def dial_bootstrap(self, hnp_uri: str) -> socket.socket:
+    def dial_bootstrap(self, hnp_uri: str) -> _Link:
         """Daemon side phone-home: a direct link to the HNP used ONLY for
         registration and the WIRE reply (the tree does not exist yet —
         ≈ orted's callback to mpirun, orted_main.c)."""
         host, port = hnp_uri.rsplit(":", 1)
         sock = socket.create_connection((host, int(port)))
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        _send_frame(sock, dss.pack(("hello", self.vpid)))
-        self._spawn_reader(sock, 0)
-        return sock
+        link = _Link(sock)
+        link.send(dss.pack(("hello", self.vpid)))
+        self._spawn_reader(link, 0)
+        return link
 
     def dial_children(self, children: list[tuple[int, str]]) -> None:
         """Parent side: connect the down-links (the routed overlay edges)."""
@@ -121,41 +149,55 @@ class RmlNode:
             host, port = curi.rsplit(":", 1)
             sock = socket.create_connection((host, int(port)))
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            _send_frame(sock, dss.pack(("hello", self.vpid)))
+            link = _Link(sock)
+            link.send(dss.pack(("hello", self.vpid)))
             with self._lock:
-                self._child_socks[cvpid] = sock
-            self._spawn_reader(sock, cvpid)
+                self._child_links[cvpid] = link
+            self._spawn_reader(link, cvpid)
+
+    def wait_parent(self, timeout: float) -> bool:
+        """Block until the tree parent has dialed in (the up-link exists).
+
+        The WIRE handler must call this before replying DAEMON_READY: WIRE
+        arrives over the bootstrap link, but the reply rides the tree —
+        and the parent's dial may still be in flight.
+        """
+        return self.parent_wired.wait(timeout)
 
     # -- traffic ----------------------------------------------------------
 
     def xcast(self, tag: str, payload: Any) -> None:
-        """Deliver everywhere below me (incl. locally) — grpcomm xcast."""
-        self._deliver(tag, self.vpid, payload)
+        """Deliver everywhere below me (incl. locally) — grpcomm xcast.
+
+        Relay BEFORE local delivery: a handler may tear this node down
+        (SHUTDOWN sets _done → close()), and relaying first guarantees the
+        children got the message before our links can vanish.
+        """
         self._relay_down(tag, self.vpid, payload)
+        self._deliver(tag, self.vpid, payload)
 
     def send_up(self, tag: str, payload: Any) -> None:
         """Deliver at the HNP, relaying through the tree."""
         if self.vpid == 0:
             self._deliver(tag, 0, payload)
             return
-        if self._parent_sock is None:
+        link = self._parent_link
+        if link is None:
             raise ConnectionError("rml: no parent link (not wired yet)")
-        _send_frame(self._parent_sock,
-                    dss.pack(("up", tag, self.vpid, payload)))
+        link.send(dss.pack(("up", tag, self.vpid, payload)))
 
-    def send_direct(self, sock: socket.socket, tag: str,
-                    payload: Any) -> None:
+    def send_direct(self, link: _Link, tag: str, payload: Any) -> None:
         """Bootstrap-only: a message over an explicit link (HNP replies to
         a registration before the tree exists)."""
-        _send_frame(sock, dss.pack(("direct", tag, self.vpid, payload)))
+        link.send(dss.pack(("direct", tag, self.vpid, payload)))
 
     def _relay_down(self, tag: str, origin: int, payload: Any) -> None:
         with self._lock:
-            socks = list(self._child_socks.values())
+            links = list(self._child_links.values())
         blob = dss.pack(("xcast", tag, origin, payload))
-        for sock in socks:
+        for link in links:
             try:
-                _send_frame(sock, blob)
+                link.send(blob)
             except OSError as e:
                 _log.error("rml %d: xcast relay failed: %r", self.vpid, e)
 
@@ -185,21 +227,21 @@ class RmlNode:
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._spawn_reader(conn, None)
+            self._spawn_reader(_Link(conn), None)
 
-    def _spawn_reader(self, sock: socket.socket, peer: Optional[int]) -> None:
-        t = threading.Thread(target=self._read_loop, args=(sock, peer),
+    def _spawn_reader(self, link: _Link, peer: Optional[int]) -> None:
+        t = threading.Thread(target=self._read_loop, args=(link, peer),
                              daemon=True)
         t.start()
         self._threads.append(t)
 
-    def _read_loop(self, sock: socket.socket,
-                   peer: Optional[int]) -> None:
+    def _read_loop(self, link: _Link, peer: Optional[int]) -> None:
+        sock = link.sock
         with sock:
             while not self._stop.is_set():
                 blob = _recv_frame(sock)
                 if blob is None:
-                    return
+                    break
                 msg = dss.unpack(blob, n=1)[0]
                 kind = msg[0]
                 if kind == "hello":
@@ -207,26 +249,39 @@ class RmlNode:
                     # an accepted hello from my tree parent IS my up-link;
                     # at the HNP an accepted hello is a bootstrap link
                     if tree_parent(self.vpid) == peer:
-                        self._parent_sock = sock
+                        self._parent_link = link
+                        self.parent_wired.set()
                     if self.vpid == 0:
                         with self._lock:
-                            self.boot_socks[peer] = sock
+                            self.boot_links[peer] = link
                     continue
                 _, tag, origin, payload = msg
                 if kind == "xcast":
-                    self._deliver(tag, origin, payload)
+                    # relay first — see xcast() on the SHUTDOWN/close race
                     self._relay_down(tag, origin, payload)
+                    self._deliver(tag, origin, payload)
                 elif kind == "up":
                     if self.vpid == 0:
                         self._deliver(tag, origin, payload)
-                    elif self._parent_sock is not None:
-                        _send_frame(self._parent_sock, blob)
                     else:
-                        _log.error("rml %d: up msg with no parent", self.vpid)
+                        parent = self._parent_link
+                        if parent is not None:
+                            parent.send(blob)
+                        else:
+                            _log.error("rml %d: up msg with no parent",
+                                       self.vpid)
                 elif kind == "direct":
                     self._deliver(tag, origin, payload)
                 else:
                     _log.error("rml %d: unknown kind %r", self.vpid, kind)
+        if peer is not None and not self._stop.is_set():
+            cb = self.on_peer_lost
+            if cb is not None:
+                try:
+                    cb(peer)
+                except Exception as e:
+                    _log.error("rml %d: peer-lost cb failed: %r",
+                               self.vpid, e)
 
     def close(self) -> None:
         self._stop.set()
@@ -235,10 +290,11 @@ class RmlNode:
         except OSError:
             pass
         with self._lock:
-            socks = list(self._child_socks.values())
-            self._child_socks.clear()
-        for s in socks + ([self._parent_sock] if self._parent_sock else []):
-            try:
-                s.close()
-            except OSError:
-                pass
+            links = list(self._child_links.values())
+            self._child_links.clear()
+            links += list(self.boot_links.values())
+            self.boot_links.clear()
+        if self._parent_link is not None:
+            links.append(self._parent_link)
+        for link in links:
+            link.close()

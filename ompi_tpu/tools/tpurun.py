@@ -7,6 +7,8 @@ output, propagate the first failure's exit code.
     tpurun -np 4 python ring.py
     tpurun -np 8 --mca coll host --tpu python app.py
     tpurun -np 4 --hostfile hf --map-by bynode ./a.out args...
+    tpurun -np 4 --plm sim --hosts 2 python ring.py   # multi-host (simulated)
+    tpurun -np 8 --plm ssh --hostfile hf python app.py
 """
 
 from __future__ import annotations
@@ -31,6 +33,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--hostfile", default=None, help="hostfile path")
     p.add_argument("--map-by", default=None, choices=["byslot", "bynode"],
                    help="round-robin mapping policy")
+    p.add_argument("--plm", default=None, choices=["sim", "ssh"],
+                   help="multi-host launch via a daemon tree: 'sim' runs "
+                        "one daemon per simulated host on this machine, "
+                        "'ssh' spawns daemons over ssh (≈ plm/rsh)")
+    p.add_argument("--hosts", type=int, default=2,
+                   help="number of simulated hosts for --plm sim")
+    p.add_argument("--stdin", default=None, metavar="RANK|all|none",
+                   help="forward launcher stdin to this rank (default 0)")
     p.add_argument("--tag-output", dest="tag", action="store_true",
                    default=None, help="tag output lines with [jobid,rank]")
     p.add_argument("--no-tag-output", dest="tag", action="store_false")
@@ -65,9 +75,31 @@ def main(argv: list[str] | None = None) -> int:
     if args.hostfile:
         var_registry.load_cli([("ras_hostfile", args.hostfile)])
 
+    if args.plm:
+        # multi-host path: one orted per host, routed tree, IOF up the tree
+        if args.plm == "sim" and not args.hostfile:
+            import math
+
+            var_registry.load_cli([
+                ("ras", "simulator"),
+                ("ras_sim_num_nodes", str(args.hosts)),
+                ("ras_sim_slots_per_node",
+                 str(math.ceil(args.np / max(1, args.hosts)))),
+            ])
+        from ompi_tpu.runtime.job import AppContext, Job
+        from ompi_tpu.runtime.plm import MultiHostLauncher
+
+        job = Job([AppContext(argv=cmd, np=args.np)])
+        return MultiHostLauncher(
+            plm_name=args.plm, want_tpu=args.tpu,
+            stdin_target=args.stdin if args.stdin is not None else "0",
+            remote_hosts=args.plm == "ssh",
+        ).run(job)
+
     from ompi_tpu.runtime.launcher import launch
 
-    return launch(cmd, np=args.np, want_tpu=args.tpu)
+    return launch(cmd, np=args.np, want_tpu=args.tpu,
+                  stdin_target=args.stdin)
 
 
 if __name__ == "__main__":

@@ -113,3 +113,39 @@ def test_no_command_is_usage_error():
     r = tpurun("-np", "2")
     assert r.returncode == 2
     assert "no command" in r.stderr.lower()
+
+
+def test_stdin_forwarded_to_rank0():
+    prog = (
+        "import os, sys\n"
+        "rank = int(os.environ['OMPI_TPU_RANK'])\n"
+        "print(f'rank {rank} stdin: {sys.stdin.read()!r}')\n"
+    )
+    env = dict(os.environ)
+    env.pop("OMPI_TPU_RANK", None)
+    r = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-np", "2", "--",
+         sys.executable, "-c", prog],
+        capture_output=True, text=True, timeout=60, env=env, cwd=REPO,
+        input="hello-stdin\n")
+    assert r.returncode == 0, r.stderr
+    assert "rank 0 stdin: 'hello-stdin\\n'" in r.stdout
+    assert "rank 1 stdin: ''" in r.stdout  # non-target ranks get /dev/null
+
+
+def test_stdin_all_duplicates():
+    prog = (
+        "import os, sys\n"
+        "rank = int(os.environ['OMPI_TPU_RANK'])\n"
+        "print(f'rank {rank} got {sys.stdin.read()!r}')\n"
+    )
+    env = dict(os.environ)
+    env.pop("OMPI_TPU_RANK", None)
+    r = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-np", "2",
+         "--stdin", "all", "--", sys.executable, "-c", prog],
+        capture_output=True, text=True, timeout=60, env=env, cwd=REPO,
+        input="x\n")
+    assert r.returncode == 0, r.stderr
+    assert "rank 0 got 'x\\n'" in r.stdout
+    assert "rank 1 got 'x\\n'" in r.stdout
