@@ -123,17 +123,21 @@ def bench_allreduce_busbw(devices) -> dict:
     mesh = make_mesh(devices=devices)
     comm = device_world(mesh)
     per_device = 1 << 28  # 256 MiB per device
-    x = np.ones((n * (per_device // 4),), np.float32)
+    x = _device_put(np.ones((n * (per_device // 4),), np.float32),
+                    mesh, P("world"))
 
-    # build ONE jitted program and reuse it — retracing would dominate
+    # ONE jitted program, device-resident donated buffer fed back to
+    # itself — the timed loop must move bytes over ICI, not host↔device
     fn = jax.jit(jax.shard_map(
         lambda s: comm.allreduce(s), mesh=mesh,
-        in_specs=P("world"), out_specs=P("world"), check_vma=False))
-    jax.block_until_ready(fn(x))  # compile + warm ICI
+        in_specs=P("world"), out_specs=P("world"), check_vma=False),
+        donate_argnums=0)
+    out = fn(x)
+    jax.block_until_ready(out)  # compile + warm ICI
     iters = 10
     t0 = time.perf_counter()
     for _ in range(iters):
-        out = fn(x)
+        out = fn(out)
     jax.block_until_ready(out)
     dt = (time.perf_counter() - t0) / iters
     shard_bytes = x.nbytes / n
@@ -148,29 +152,64 @@ def bench_allreduce_busbw(devices) -> dict:
     }
 
 
+def _device_put(x, mesh, spec):
+    """Place a host array on the mesh BEFORE any timing loop — feeding
+    numpy into a jitted fn pays a full H2D transfer per call, which
+    swamps the collective being measured (round-2 verdict: the matrix
+    reported 0.07 GiB/s on hardware that moves ~800)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+# Any device-path row below this on real TPU measures overhead, not the
+# data plane (HBM ~800 GiB/s, single-chip "collectives" are copies).
+_DEVICE_ROW_FLOOR_GIBPS = 10.0
+
+
+def _flag_suspect(row: dict, backend: str) -> dict:
+    if (backend == "tpu" and row.get("unit") == "GiB/s"
+            and row.get("value", 0) < _DEVICE_ROW_FLOOR_GIBPS):
+        row["suspect"] = ("below sanity floor "
+                          f"({_DEVICE_ROW_FLOOR_GIBPS} GiB/s): likely "
+                          "measuring dispatch/transfer, not the data plane")
+    return row
+
+
 def _count_params(params) -> int:
     import jax
 
     return int(sum(np.prod(x.shape) for x in jax.tree_util.tree_leaves(params)))
 
 
-def _time_train_step(cfg, mesh, tokens, steps=8):
+def _time_train_loop(cfg, mesh, tokens, chain: int, outer: int):
+    """Time `outer` dispatches of a `chain`-step compiled train loop.
+
+    All state lives on device (params/opt donated and fed back — feeding
+    numpy in would time the H2D transfer, round-2 weak #3) and the clock
+    is closed by a VALUE readback: on remote/tunneled runtimes
+    ``block_until_ready`` can return before the device work completes, so
+    only fetching a result truly fences (round-2's 3% "MFU" was partly
+    this artifact in reverse — per-step dispatch stalls).
+    """
     import jax
 
     from ompi_tpu.models import transformer as tfm
 
-    params = tfm.init_params(cfg)
+    params = jax.device_put(tfm.init_params(cfg))
     n_params = _count_params(params)
-    step, init_opt = tfm.make_train_step(cfg, mesh, lr=1e-3)
-    opt_state = init_opt(params)
-    params, opt_state, loss = step(params, opt_state, tokens)  # compile
-    jax.block_until_ready(loss)
+    loop, init_opt = tfm.make_train_loop(cfg, mesh, lr=1e-3, steps=chain)
+    opt_state = jax.device_put(init_opt(params))
+    tokens = jax.device_put(tokens)
+    params, opt_state, losses = loop(params, opt_state, tokens)  # compile
+    _ = float(losses[-1])                                        # full sync
     t0 = time.perf_counter()
-    for _ in range(steps):
-        params, opt_state, loss = step(params, opt_state, tokens)
-    jax.block_until_ready(loss)
-    dt = (time.perf_counter() - t0) / steps
-    return dt, n_params, float(loss)
+    for _ in range(outer):
+        params, opt_state, losses = loop(params, opt_state, tokens)
+    loss = float(losses[-1])                                     # fences all
+    dt = (time.perf_counter() - t0) / (outer * chain)
+    return dt, n_params, loss
 
 
 def bench_flagship_mfu(kind: str) -> dict:
@@ -183,18 +222,21 @@ def bench_flagship_mfu(kind: str) -> dict:
 
     on_cpu = jax.devices()[0].platform == "cpu"
     mesh = make_mesh({"dp": 1, "sp": 1, "tp": 1}, devices=jax.devices()[:1])
-    base = dict(vocab=32_000, d_model=1024, n_heads=16, n_layers=8,
-                d_ff=4096, seq=1024, attention="ring")
+    # flagship: 468M params, head_dim 128 (full MXU lane tile in the
+    # flash kernel), batch sized to fill HBM alongside fp32 Adam state
+    base = dict(vocab=32_000, d_model=2048, n_heads=16, n_layers=8,
+                d_ff=8192, seq=1024, attention="ring")
+    batch, chain, outer = 16, 16, 2
     if on_cpu:  # fallback mode: keep the gate fast; MFU is 0 here anyway
         base.update(d_model=256, n_heads=8, n_layers=2, d_ff=1024, seq=256)
+        batch, chain, outer = 2, 2, 1
     rng = np.random.default_rng(0)
-    batch = 4
     tokens = rng.integers(0, base["vocab"],
                           size=(batch, base["seq"])).astype(np.int32)
 
-    dt, n_params, loss = _time_train_step(
-        TransformerConfig(**base, compute_dtype="bfloat16"), mesh, tokens,
-        steps=2 if on_cpu else 8)
+    dt, n_params, loss = _time_train_loop(
+        TransformerConfig(**base, compute_dtype="bfloat16", remat="full"),
+        mesh, tokens, chain, outer)
     n_tokens = tokens.size
     flops_per_token = 6 * n_params + 12 * base["n_layers"] * base["d_model"] * base["seq"]
     model_flops = flops_per_token * n_tokens
@@ -270,7 +312,9 @@ def matrix_mesh_bcast_allgather(devices) -> dict:
     dts = []
     for dtype in (np.float32, np.bfloat16 if hasattr(np, "bfloat16")
                   else np.float16, np.int32):
-        x = np.ones((n * (1 << 22),), dtype=np.float32).astype(dtype)
+        x = _device_put(
+            np.ones((n * (1 << 22),), dtype=np.float32).astype(dtype),
+            mesh, P(("x", "y")))
 
         def kernel(s):
             b = comm.bcast(s, root=0)
@@ -315,22 +359,27 @@ def matrix_grad_reduce_scatter(devices) -> dict:
     # grad shard + scattered output + slack must fit per device
     params = min(7_000_000_000, int(limit * 0.15 / 4) * n)
     params -= params % (n * 1024)
-    x = np.ones((params,), np.float32)
+    mesh = make_mesh(devices=devices)
+    x = _device_put(np.ones((params,), np.float32), mesh, P("world"))
+    nbytes = x.nbytes
 
     def kernel(s):
         scattered = jax.lax.psum_scatter(s, "world", tiled=True)
         return jax.lax.all_gather(scattered, "world", tiled=True)
 
-    mesh = make_mesh(devices=devices)
+    # device-resident + donated, output fed back as next input (the
+    # realistic grad-buffer reuse pattern; also zero H2D inside the loop)
     fn = jax.jit(jax.shard_map(kernel, mesh=mesh, in_specs=P("world"),
-                               out_specs=P("world"), check_vma=False))
-    jax.block_until_ready(fn(x))
+                               out_specs=P("world"), check_vma=False),
+                 donate_argnums=0)
+    out = fn(x)
+    jax.block_until_ready(out)
     t0 = time.perf_counter()
     for _ in range(3):
-        out = fn(x)
+        out = fn(out)
     jax.block_until_ready(out)
     dt = (time.perf_counter() - t0) / 3
-    gbps = 2 * x.nbytes / dt / 2**30  # RS + AG each move ~the buffer once
+    gbps = 2 * nbytes / dt / 2**30  # RS + AG each move ~the buffer once
     return {
         "metric": f"grad reduce_scatter+allgather ({params/1e9:.2f}B fp32 "
                   f"params, {n} dev)",
@@ -353,24 +402,28 @@ def matrix_oshmem_device(devices) -> dict:
     n = len(devices)
     mesh = make_mesh(devices=devices)
     comm = device_world(mesh)
-    x = np.arange(n * (1 << 22), dtype=np.float32)
+    x = _device_put(np.arange(n * (1 << 22), dtype=np.float32),
+                    mesh, P("world"))
+    nbytes = x.nbytes
 
     def kernel(s):
         m = comm.allreduce(s, MAX)       # shmem_float_max_to_all
         return comm.shift(m, 1, axis="world")  # circular shift, 1 ICI hop
 
     fn = jax.jit(jax.shard_map(kernel, mesh=mesh, in_specs=P("world"),
-                               out_specs=P("world"), check_vma=False))
-    jax.block_until_ready(fn(x))
+                               out_specs=P("world"), check_vma=False),
+                 donate_argnums=0)
+    out = fn(x)
+    jax.block_until_ready(out)
     t0 = time.perf_counter()
     for _ in range(5):
-        out = fn(x)
+        out = fn(out)
     jax.block_until_ready(out)
     dt = (time.perf_counter() - t0) / 5
     return {
         "metric": f"oshmem max_to_all + circular shift ({n} dev, "
-                  f"{x.nbytes/n/2**20:.0f}MiB/dev)",
-        "value": round(x.nbytes / dt / 2**30, 3), "unit": "GiB/s",
+                  f"{nbytes/n/2**20:.0f}MiB/dev)",
+        "value": round(nbytes / dt / 2**30, 3), "unit": "GiB/s",
         "vs_baseline": 1.0,
     }
 
@@ -393,6 +446,7 @@ def run_matrix(devices, backend: str) -> None:
         row["config"] = name
         row["backend"] = backend
         row["wall_s"] = round(time.perf_counter() - t0, 2)
+        _flag_suspect(row, backend)
         log(f"matrix[{name}]: {json.dumps(row)}")
         rows.append(row)
     try:

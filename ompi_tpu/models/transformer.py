@@ -21,7 +21,7 @@ from typing import Any, Optional
 import numpy as np
 
 __all__ = ["TransformerConfig", "init_params", "param_specs", "make_loss_fn",
-           "make_train_step", "make_forward"]
+           "make_train_step", "make_train_loop", "make_forward"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -36,7 +36,12 @@ class TransformerConfig:
     # ("flash" = ulysses resharding + the pallas flash kernel for the
     # local attention — offsets are static there, so the kernel applies)
     compute_dtype: Any = "bfloat16"
-    remat: bool = True  # jax.checkpoint each layer: HBM ↔ FLOPs trade
+    # jax.checkpoint policy per layer — HBM ↔ FLOPs trade:
+    #   True/"full" = save only layer inputs (max recompute, min HBM);
+    #   "dots"      = save matmul outputs, recompute elementwise (cheap
+    #                 recompute, still drops the big attention temporaries);
+    #   False/None  = no remat (fastest when activations fit).
+    remat: Any = "dots"
 
     @property
     def head_dim(self) -> int:
@@ -151,11 +156,19 @@ def _local_forward(cfg: TransformerConfig, comm, params, tokens):
 
     layer_params = {k: params[k] for k in
                     ("wq", "wk", "wv", "wo", "w1", "w2", "ln1", "ln2")}
-    layer_fn = jax.checkpoint(layer) if cfg.remat else layer
+    if cfg.remat in (True, "full"):
+        layer_fn = jax.checkpoint(layer)
+    elif cfg.remat == "dots":
+        layer_fn = jax.checkpoint(
+            layer, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    else:
+        layer_fn = layer
     h, _ = lax.scan(layer_fn, h, layer_params)
     h = _rmsnorm(h, params["lnf"])
-    logits = jnp.einsum("btd,vd->btv", h.astype(jnp.float32),
-                        params["emb"].astype(jnp.float32))
+    # unembed on the MXU in compute dtype, f32 accumulation — a f32×f32
+    # matmul here would run at a fraction of the bf16 rate
+    logits = jnp.einsum("btd,vd->btv", h, params["emb"].astype(cdt),
+                        preferred_element_type=jnp.float32)
     return logits
 
 
@@ -221,6 +234,25 @@ def make_forward(cfg: TransformerConfig, mesh):
         out_specs=P("dp", "sp", None), check_vma=False)
 
 
+def _make_step_body(cfg: TransformerConfig, mesh, lr: float):
+    """Shared optimizer-step body: (params, opt_state, tokens) →
+    (params, opt_state, loss) — the single definition both the one-step
+    and the scanned-loop entry points compile."""
+    import jax
+    import optax
+
+    loss_fn = make_loss_fn(cfg, mesh)
+    opt = optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=0.01)
+
+    def body(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return body, opt
+
+
 def make_train_step(cfg: TransformerConfig, mesh, lr: float = 3e-4):
     """jitted (params, opt_state, tokens) → (params, opt_state, loss).
 
@@ -229,19 +261,38 @@ def make_train_step(cfg: TransformerConfig, mesh, lr: float = 3e-4):
     local slice only — exactly ZeRO-0 + Megatron semantics).
     """
     import jax
-    import optax
 
-    loss_fn = make_loss_fn(cfg, mesh)
-    opt = optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=0.01)
+    body, opt = _make_step_body(cfg, mesh, lr)
+    # params/opt_state are donated: the updated trees reuse their HBM
+    # in place of a second full copy (≈1.6 GiB at 133M params with Adam)
+    step = functools.partial(jax.jit, donate_argnums=(0, 1))(body)
+    return step, opt.init
 
-    @jax.jit
-    def step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
-        updates, opt_state = opt.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
 
-    def init_opt(params):
-        return opt.init(params)
+def make_train_loop(cfg: TransformerConfig, mesh, lr: float = 3e-4,
+                    steps: int = 8):
+    """jitted (params, opt_state, tokens) → (params, opt_state, losses):
+    ``steps`` optimizer steps inside ONE compiled program (lax.scan over
+    the step), donated carry.
 
-    return step, init_opt
+    One dispatch per K steps is how real training loops run — and the only
+    honest way to time the device when the host link has per-call latency
+    (a remote/tunneled runtime stalls between dispatches; chaining keeps
+    the chip busy back-to-back).
+    """
+    import jax
+    from jax import lax
+
+    body, opt = _make_step_body(cfg, mesh, lr)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def run(params, opt_state, tokens):
+        def scan_body(carry, _):
+            p, s, loss = body(*carry, tokens)
+            return (p, s), loss
+
+        (params, opt_state), losses = lax.scan(
+            scan_body, (params, opt_state), None, length=steps)
+        return params, opt_state, losses
+
+    return run, opt.init

@@ -7,6 +7,6 @@ fallback — kernels are accelerators, never requirements (same policy as
 ompi_tpu/_native).
 """
 
-from ompi_tpu.ops.flash_attention import flash_attention
+from ompi_tpu.ops.flash_attention import flash_attention, flash_attention_lse
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_attention_lse"]

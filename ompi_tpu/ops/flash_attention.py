@@ -25,8 +25,9 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-__all__ = ["flash_attention", "flash_tiles"]
+__all__ = ["flash_attention", "flash_attention_lse", "flash_tiles"]
 
 _NEG = -1e30
 
@@ -44,31 +45,34 @@ def flash_tiles(t_q: int, t_k: int, block_q: int = 128,
 # kernel
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref,
+def _fwd_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                 *, scale: float, causal: bool, block_q: int, block_k: int,
                 t_k: int):
     """One (batch·head, q-block) grid cell: stream K/V blocks, online
-    softmax in float32, write the normalized output.  (No logsumexp
-    output: the TPU lowering disallows a (1, block_q) block, and the
-    backward recomputes scores anyway — it rederives lse there.)"""
+    softmax in float32, write the normalized output + per-row logsumexp
+    (lse is laid out (bh, n_q_blocks, block_q) so its last dim is a full
+    128 lane tile — the TPU lowering disallows a (1, block_q) block).
+
+    Matmul inputs stay in the storage dtype (bf16 feeds the MXU natively;
+    bf16 values are exactly representable in f32, so bf16×bf16→f32 equals
+    the f32 product) with float32 accumulation via preferred_element_type.
+    """
     from jax import lax
     from jax.experimental import pallas as pl
 
     iq = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale                 # (bq, D)
+    q = q_ref[0]                                             # (bq, D)
     d = q.shape[-1]
     qpos = (qoff_ref[0] + iq * block_q
             + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0))
 
     def body(j, carry):
         m, l, acc = carry
-        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(
-            jnp.float32)                                     # (bk, D)
-        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(
-            jnp.float32)
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :]     # (bk, D)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :]
         s = jax.lax.dot_general(                             # (bq, bk)
             q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32) * scale
         if causal:
             kpos = (koff_ref[0] + j * block_k
                     + lax.broadcasted_iota(jnp.int32,
@@ -80,8 +84,9 @@ def _fwd_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref,
             p = jnp.where(qpos >= kpos, p, 0.0)
         corr = jnp.exp(m - m_new)
         l_new = l * corr + p.sum(axis=-1)
+        # p→storage dtype for the MXU; accumulation stays f32
         acc_new = acc * corr[:, None] + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
@@ -91,23 +96,28 @@ def _fwd_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref,
     m, l, acc = lax.fori_loop(0, t_k // block_k, body, (m0, l0, acc0))
     safe_l = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / safe_l[:, None]).astype(o_ref.dtype)
+    # lse broadcast over 8 sublanes: the TPU lowering needs the block's
+    # last two dims (8, block_q)-tileable; callers read sublane 0
+    lse_ref[0, 0] = jnp.broadcast_to((m + jnp.log(safe_l))[None, :],
+                                     (8, block_q))
 
 
 def _flash_fwd_raw(q3, k3, v3, q_offset, k_offset, scale: float,
                    causal: bool, block_q: int, block_k: int,
                    interpret: bool):
-    """(BH, Tq, D) × (BH, Tk, D) → (BH, Tq, D)."""
+    """(BH, Tq, D) × (BH, Tk, D) → ((BH, Tq, D), (BH, Tq) lse f32)."""
     from jax.experimental import pallas as pl
 
     bh, t_q, d = q3.shape
     t_k = k3.shape[1]
-    grid = (bh, t_q // block_q)
+    nq = t_q // block_q
+    grid = (bh, nq)
     kern = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, t_k=t_k)
     qoff = jnp.asarray(q_offset, jnp.int32).reshape(1)
     koff = jnp.asarray(k_offset, jnp.int32).reshape(1)
-    return pl.pallas_call(
+    o3, lse3 = pl.pallas_call(
         kern,
         grid=grid,
         in_specs=[
@@ -117,10 +127,17 @@ def _flash_fwd_raw(q3, k3, v3, q_offset, k_offset, scale: float,
             pl.BlockSpec((1, t_k, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, t_k, d), lambda b, i: (b, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, t_q, d), q3.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, 8, block_q), lambda b, i: (b, i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t_q, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, nq, 8, block_q), jnp.float32),
+        ],
         interpret=interpret,
     )(qoff, koff, q3, k3, v3)
+    return o3, lse3[:, :, 0, :].reshape(bh, t_q)
 
 
 def _smem():
@@ -148,40 +165,42 @@ def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, scale, causal, q_offset, k_offset, blocks):
-    return _flash_core(q, k, v, scale, causal, q_offset, k_offset, blocks)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _flash(q, k, v, qoff, koff, scale, causal, blocks):
+    return _flash_core(q, k, v, qoff, koff, scale, causal, blocks)
 
 
-def _flash_core(q, k, v, scale, causal, q_offset, k_offset, blocks):
+def _flash_core(q, k, v, qoff, koff, scale, causal, blocks):
     b, t_q, h, d = q.shape
     block_q, block_k = blocks
-    o3 = _flash_fwd_raw(_to3(q), _to3(k), _to3(v), q_offset, k_offset,
-                        scale, causal, block_q, block_k,
-                        _use_interpret())
-    return _from3(o3, b, h)
+    o3, lse3 = _flash_fwd_raw(_to3(q), _to3(k), _to3(v), qoff, koff,
+                              scale, causal, block_q, block_k,
+                              _use_interpret())
+    return _from3(o3, b, h), lse3.reshape(b, h, t_q)
 
 
-def _flash_fwd(q, k, v, scale, causal, q_offset, k_offset, blocks):
-    out = _flash_core(q, k, v, scale, causal, q_offset, k_offset, blocks)
-    return out, (q, k, v, out)
+def _flash_fwd(q, k, v, qoff, koff, scale, causal, blocks):
+    out, lse = _flash_core(q, k, v, qoff, koff, scale, causal, blocks)
+    return (out, lse), (q, k, v, qoff, koff, out)
 
 
-def _flash_bwd(scale, causal, q_offset, k_offset, blocks, res, g):
+def _flash_bwd(scale, causal, blocks, res, cts):
     """Recompute backward (pure XLA): rebuilding s and its logsumexp
-    reproduces the forward's weights exactly (same f32 math); standard
-    flash-attention gradient algebra."""
-    q, k, v, out = res
+    reproduces the forward's weights exactly (matmul inputs are the same
+    bf16 values, accumulated in f32); standard flash-attention gradient
+    algebra plus the lse cotangent (d lse/d s = p, so it folds into ds).
+    Matmuls keep storage-dtype inputs + f32 accumulation so the MXU runs
+    them at native rate."""
+    q, k, v, qoff, koff, out = res
+    g, g_lse = cts
     t_q = q.shape[1]
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    gf = g.astype(jnp.float32)
-    of = out.astype(jnp.float32)
-    s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
+    f32 = jnp.float32
+    gf32 = g.astype(f32)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=f32) * scale
     if causal:
-        qpos = q_offset + jnp.arange(t_q)
-        kpos = k_offset + jnp.arange(k.shape[1])
+        qpos = qoff + jnp.arange(t_q)
+        kpos = koff + jnp.arange(k.shape[1])
         keep = (qpos[:, None] >= kpos[None, :])[None, None]
         s = jnp.where(keep, s, _NEG)
     m = s.max(axis=-1, keepdims=True)
@@ -189,37 +208,64 @@ def _flash_bwd(scale, causal, q_offset, k_offset, blocks, res, g):
     p = jnp.exp(s - m) / jnp.maximum(l, 1e-30)       # fwd weights
     if causal:
         p = jnp.where(keep, p, 0.0)
-    dv = jnp.einsum("bhqk,bqhd->bkhd", p, gf)
-    dp = jnp.einsum("bqhd,bkhd->bhqk", gf, vf)
-    delta = jnp.einsum("bqhd,bqhd->bqh", gf, of).transpose(0, 2, 1)
-    ds = p * (dp - delta[..., None]) * scale
-    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, kf)
-    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    pc = p.astype(q.dtype)
+    dv = jnp.einsum("bhqk,bqhd->bkhd", pc, g, preferred_element_type=f32)
+    dp = jnp.einsum("bqhd,bkhd->bhqk", g, v, preferred_element_type=f32)
+    delta = jnp.einsum("bqhd,bqhd->bqh", gf32,
+                       out.astype(f32)).transpose(0, 2, 1)
+    resid = dp - delta[..., None]
+    if g_lse is not None:
+        resid = resid + g_lse.astype(f32)[..., None]  # (B,H,Tq,1)
+    ds = (p * resid * scale).astype(q.dtype)
+    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, k, preferred_element_type=f32)
+    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, q, preferred_element_type=f32)
+    zoff = np.zeros((1,), dtype=jax.dtypes.float0)  # int args: no tangent
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            zoff, zoff)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, causal: bool = True,
-                    q_offset: int = 0, k_offset: int = 0,
-                    scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128):
-    """Blockwise-streamed exact attention (pallas; MXU matmuls, O(T·D)
-    memory).  Same contract as parallel.attention.local_attention:
-    q (B, Tq, H, D), k/v (B, Tk, H, D) → (B, Tq, H, D); offsets give
-    global positions for causal masking of sequence slices.
-
-    Shapes must tile (Tq % block_q == 0, Tk % block_k == 0) — callers
-    (local_attention) fall back to the jnp path otherwise.
-    """
-    scale = scale if scale is not None else q.shape[-1] ** -0.5
+def _check_blocks(q, k, block_q, block_k):
     t_q, t_k = q.shape[1], k.shape[1]
     if not flash_tiles(t_q, t_k, block_q, block_k):
         raise ValueError(
             f"flash_attention: T ({t_q},{t_k}) must tile by blocks "
             f"({block_q},{block_k})")
-    block_q = min(block_q, t_q)
-    block_k = min(block_k, t_k)
-    return _flash(q, k, v, float(scale), bool(causal), int(q_offset),
-                  int(k_offset), (block_q, block_k))
+    return min(block_q, t_q), min(block_k, t_k)
+
+
+def flash_attention(q, k, v, causal: bool = True,
+                    q_offset=0, k_offset=0,
+                    scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128):
+    """Blockwise-streamed exact attention (pallas; MXU matmuls, O(T·D)
+    memory).  Same contract as parallel.attention.local_attention:
+    q (B, Tq, H, D), k/v (B, Tk, H, D) → (B, Tq, H, D); offsets give
+    global positions for causal masking of sequence slices and may be
+    **traced** int32 scalars (the ring-attention hop index feeds one in).
+
+    Shapes must tile (Tq % block_q == 0, Tk % block_k == 0) — callers
+    (local_attention) fall back to the jnp path otherwise.
+    """
+    out, _ = flash_attention_lse(q, k, v, causal=causal, q_offset=q_offset,
+                                 k_offset=k_offset, scale=scale,
+                                 block_q=block_q, block_k=block_k)
+    return out
+
+
+def flash_attention_lse(q, k, v, causal: bool = True,
+                        q_offset=0, k_offset=0,
+                        scale: Optional[float] = None,
+                        block_q: int = 128, block_k: int = 128):
+    """:func:`flash_attention` that also returns the per-row logsumexp
+    ((B, H, Tq) float32) — the merge state ring attention needs to combine
+    this block's contribution with other hops' (≈ the reference's segmented
+    ring allreduce partial, coll_base_allreduce.c:615)."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    block_q, block_k = _check_blocks(q, k, block_q, block_k)
+    qoff = jnp.asarray(q_offset, jnp.int32).reshape(1)
+    koff = jnp.asarray(k_offset, jnp.int32).reshape(1)
+    return _flash(q, k, v, qoff, koff, float(scale), bool(causal),
+                  (block_q, block_k))

@@ -22,62 +22,96 @@ from __future__ import annotations
 
 from typing import Optional
 
-__all__ = ["local_attention", "ring_attention", "ulysses_attention",
-           "gathered_attention"]
+__all__ = ["local_attention", "local_attention_lse", "ring_attention",
+           "ulysses_attention", "gathered_attention"]
 
 _NEG = -1e30
 
 
+def _flash_wanted(impl: str, t_q: int, t_k: int) -> bool:
+    """Route to the pallas kernel?  "auto" = yes on TPU when the shape
+    tiles (CPU test meshes keep the cheap jnp path — interpret-mode pallas
+    is orders of magnitude slower and tests cross-check both paths
+    explicitly); "flash" = required, raise if untileable."""
+    import jax
+
+    from ompi_tpu.ops.flash_attention import flash_tiles
+
+    if impl == "jnp":
+        return False
+    tiles = flash_tiles(t_q, t_k)
+    if impl == "flash":
+        if not tiles:
+            raise ValueError("flash impl needs block-tiling shapes")
+        return True
+    return tiles and jax.default_backend() == "tpu"
+
+
 def local_attention(q, k, v, causal: bool = True,
                     q_offset=0, k_offset=0, scale: Optional[float] = None,
-                    impl: str = "jnp"):
+                    impl: str = "auto"):
     """Plain attention over local blocks; offsets give global positions for
-    causal masking when the blocks are slices of a longer sequence.
+    causal masking when the blocks are slices of a longer sequence (they
+    may be traced int32 scalars — e.g. a ring hop's source index).
 
     Shapes: q (B, Tq, H, D), k/v (B, Tk, H, D) → (B, Tq, H, D).
 
     ``impl``: "flash" = the pallas blockwise kernel (ompi_tpu.ops),
-    "jnp" = materialized scores, "auto" = flash when the shape tiles and
-    the offsets are static (traced offsets — e.g. a traced ring source
-    index — need the jnp path).
+    "jnp" = materialized scores, "auto" = flash on TPU when the shape
+    tiles, jnp otherwise.
     """
+    o, _ = local_attention_lse(q, k, v, causal=causal, q_offset=q_offset,
+                               k_offset=k_offset, scale=scale, impl=impl)
+    return o.astype(q.dtype)
+
+
+def local_attention_lse(q, k, v, causal: bool = True,
+                        q_offset=0, k_offset=0,
+                        scale: Optional[float] = None, impl: str = "auto"):
+    """:func:`local_attention` that also returns the (B, H, Tq) f32
+    logsumexp — the merge state for combining partial attention blocks
+    (ring hops).  Output dtype follows q for flash, f32 for jnp."""
     import jax.numpy as jnp
 
     scale = scale if scale is not None else q.shape[-1] ** -0.5
-    if impl != "jnp":
-        from ompi_tpu.ops import flash_attention
-        from ompi_tpu.ops.flash_attention import flash_tiles
+    if _flash_wanted(impl, q.shape[1], k.shape[1]):
+        from ompi_tpu.ops.flash_attention import flash_attention_lse
 
-        static_offsets = isinstance(q_offset, int) and isinstance(
-            k_offset, int)
-        if static_offsets and flash_tiles(q.shape[1], k.shape[1]):
-            return flash_attention(q, k, v, causal=causal,
+        return flash_attention_lse(q, k, v, causal=causal,
                                    q_offset=q_offset, k_offset=k_offset,
                                    scale=scale)
-        if impl == "flash":
-            raise ValueError(
-                "flash impl needs static offsets and block-tiling shapes")
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
     if causal:
         qpos = q_offset + jnp.arange(q.shape[1])
         kpos = k_offset + jnp.arange(k.shape[1])
         mask = qpos[:, None] >= kpos[None, :]
         scores = jnp.where(mask[None, None], scores, _NEG)
-    w = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
-    w = w / w.sum(axis=-1, keepdims=True)
-    return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+    m = scores.max(axis=-1)                                   # (B,H,Tq)
+    w = jnp.exp(scores - m[..., None])
+    if causal:
+        w = jnp.where(mask[None, None], w, 0.0)
+    l = w.sum(axis=-1)
+    safe_l = jnp.maximum(l, 1e-30)
+    o = jnp.einsum("bhqk,bkhd->bqhd", w.astype(q.dtype), v,
+                   preferred_element_type=jnp.float32)
+    o = o / safe_l.transpose(0, 2, 1)[..., None]
+    return o, m + jnp.log(safe_l)
 
 
 def ring_attention(comm, q, k, v, axis: Optional[str] = None,
-                   causal: bool = True, scale: Optional[float] = None):
+                   causal: bool = True, scale: Optional[float] = None,
+                   impl: str = "auto"):
     """Exact attention over a sequence sharded along ``axis`` of
     ``comm.mesh``; call inside shard_map.
 
-    Each step attends my queries against the currently-held K/V block, then
-    rotates K/V one hop around the ring (device r → r+1), so after sp steps
-    every (query, key) pair has met.  Accumulation is the numerically-stable
-    online softmax (running max m, normalizer l, weighted value sum acc) in
-    float32.
+    Each step attends my queries against the currently-held K/V block —
+    through the pallas flash kernel on TPU (``impl="auto"``; the hop's
+    traced source index feeds the kernel's k_offset) — then rotates K/V
+    one hop around the ring (device r → r+1), so after sp steps every
+    (query, key) pair has met.  Hop results are merged by their logsumexp
+    (out' = out·σ(lse) + out_i·σ(lse_i), σ = softmax over hop lse), the
+    blockwise-attention identity; everything accumulates in float32.
     """
     import jax.numpy as jnp
     from jax import lax
@@ -85,48 +119,39 @@ def ring_attention(comm, q, k, v, axis: Optional[str] = None,
     ax = axis or comm.axes[-1]
     sp = int(comm.mesh.shape[ax])
     if sp == 1:  # degenerate ring: skip the loop machinery entirely
-        return local_attention(q, k, v, causal=causal, scale=scale)
+        return local_attention(q, k, v, causal=causal, scale=scale,
+                               impl=impl)
     my = lax.axis_index(ax)
     B, T, H, D = q.shape
     scale = scale if scale is not None else D ** -0.5
-
-    qf = q.astype(jnp.float32)
-    qpos = my * T + jnp.arange(T)
     perm = [(i, (i + 1) % sp) for i in range(sp)]
 
     def step(i, carry):
-        m, l, acc, k_cur, v_cur = carry
+        out, lse, k_cur, v_cur = carry
         src = (my - i) % sp  # whose block I currently hold
-        scores = jnp.einsum("bqhd,bkhd->bhqk", qf,
-                            k_cur.astype(jnp.float32)) * scale
-        if causal:
-            kpos = src * T + jnp.arange(T)
-            keep = qpos[:, None] >= kpos[None, :]
-            scores = jnp.where(keep[None, None], scores, _NEG)
-        s_max = scores.max(axis=-1)                       # (B,H,Tq)
-        m_new = jnp.maximum(m, s_max)
-        p = jnp.exp(scores - m_new[..., None])            # (B,H,Tq,Tk)
-        if causal:
-            p = jnp.where(keep[None, None], p, 0.0)
-        corr = jnp.exp(m - m_new)                         # (B,H,Tq)
-        l_new = l * corr + p.sum(axis=-1)
-        pv = jnp.einsum("bhqk,bkhd->bhqd", p, v_cur.astype(jnp.float32))
-        acc_new = acc * corr[..., None] + pv
+        o_i, lse_i = local_attention_lse(
+            q, k_cur, v_cur, causal=causal, q_offset=my * T,
+            k_offset=src * T, scale=scale, impl=impl)
+        lse_new = jnp.logaddexp(lse, lse_i)               # (B,H,Tq)
+        c_old = jnp.exp(lse - lse_new)
+        c_new = jnp.exp(lse_i - lse_new)
+        # (B,H,Tq) coefficients against (B,Tq,H,D) outputs
+        out = (out * c_old.transpose(0, 2, 1)[..., None]
+               + o_i.astype(jnp.float32)
+               * c_new.transpose(0, 2, 1)[..., None])
         k_nxt = lax.ppermute(k_cur, ax, perm)
         v_nxt = lax.ppermute(v_cur, ax, perm)
-        return (m_new, l_new, acc_new, k_nxt, v_nxt)
+        return (out, lse_new, k_nxt, v_nxt)
 
-    m0 = jnp.full((B, H, T), _NEG, jnp.float32)
-    l0 = jnp.zeros((B, H, T), jnp.float32)
-    acc0 = jnp.zeros((B, H, T, D), jnp.float32)
-    m, l, acc, _, _ = lax.fori_loop(0, sp, step, (m0, l0, acc0, k, v))
-    out = acc / jnp.maximum(l, 1e-30)[..., None]          # (B,H,Tq,D)
-    return out.transpose(0, 2, 1, 3).astype(q.dtype)      # (B,Tq,H,D)
+    out0 = jnp.zeros((B, T, H, D), jnp.float32)
+    lse0 = jnp.full((B, H, T), _NEG, jnp.float32)
+    out, _, _, _ = lax.fori_loop(0, sp, step, (out0, lse0, k, v))
+    return out.astype(q.dtype)
 
 
 def ulysses_attention(comm, q, k, v, axis: Optional[str] = None,
                       causal: bool = True, scale: Optional[float] = None,
-                      impl: str = "jnp"):
+                      impl: str = "auto"):
     """All-to-all sequence parallelism: re-shard seq→heads, attend fully
     locally, re-shard back.  Exact; one alltoall each way.  The local
     attention runs the pallas flash kernel with ``impl='flash'`` (static

@@ -92,10 +92,12 @@ def test_local_attention_impl_dispatch():
     out_jnp = attn.local_attention(q, k, v, impl="jnp")
     np.testing.assert_allclose(np.asarray(out_flash), np.asarray(out_jnp),
                                atol=2e-5, rtol=2e-5)
-    # traced offsets force the jnp path; impl="flash" must refuse
-    with pytest.raises((ValueError, TypeError)):
-        jax.jit(lambda off: attn.local_attention(
-            q, k, v, q_offset=off, impl="flash"))(jnp.int32(0))
+    # traced offsets run through the kernel (the ring hop feeds one in)
+    out_traced = jax.jit(lambda off: attn.local_attention(
+        q, k, v, q_offset=off, impl="flash"))(jnp.int32(64))
+    ref = attn.local_attention(q, k, v, q_offset=64, impl="jnp")
+    np.testing.assert_allclose(np.asarray(out_traced), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
 
 
 def test_ulysses_flash_parity():
