@@ -29,12 +29,24 @@ from __future__ import annotations
 
 from typing import Optional
 
+import numpy as np
+
 from ompi_tpu.core.buffer import BufferKind, BufferLocationError, classify
+from ompi_tpu.core.config import VarType, register_var, var_registry
 from ompi_tpu.core.mca import Component
-from ompi_tpu.mpi.coll import coll_framework
+from ompi_tpu.mpi.coll import coll_framework, rules
 from ompi_tpu.mpi.op import Op
 
 __all__ = ["XlaColl"]
+
+
+def _dev_nbytes(buf) -> int:
+    """Static byte size of a jax array OR tracer (shape/dtype are always
+    static under jit — no materialization)."""
+    try:
+        return int(np.prod(buf.shape)) * buf.dtype.itemsize
+    except Exception:  # noqa: BLE001 — unshaped input: decide as "small"
+        return 0
 
 
 def _device_comm(comm):
@@ -53,43 +65,126 @@ def _run(comm, method: str, buf, *args, **kw):
     fn = getattr(dc, method)
     if classify(buf) is BufferKind.TRACED:
         return fn(buf, *args, **kw)
-    return dc.run(lambda c, shard: getattr(c, method)(shard, *args, **kw),
-                  buf)
+    # driver mode rides the compiled-program cache: repeated collectives
+    # with the same (method, args, shapes) reuse one jitted shard_map
+    return dc.run_method(method, buf, margs=args,
+                         mkw=tuple(sorted(kw.items())))
 
 
 @coll_framework.component
 class XlaColl(Component):
+    """Device collectives with a tuned-style decision layer.
+
+    ≈ coll/tuned's fixed decision (coll_tuned_decision_fixed.c:44-87)
+    transposed to the device path: per collective the choice is between the
+    XLA-native lowering (psum / all_gather — latency-optimal, lets XLA pick
+    the ICI algorithm) and an explicit ppermute/2-phase form whose
+    communication shape favors bandwidth or a DCN-crossing axis (the
+    btl.h:1181-1183 latency/bandwidth ranking axis, SURVEY §2.6).  The
+    selection is (bytes × comm size × axis kind), overridable per
+    collective by config var or the same dynamic rules file the host path
+    honors."""
+
     NAME = "xla"
     PRIORITY = 60        # above host (40); the dispatcher routes by buffer
     HANDLES = frozenset({"device", "traced"})
 
+    ALGORITHMS = {
+        "allreduce": ("psum", "rs_ag"),
+        "allgather": ("all_gather", "ring"),
+        "bcast": ("psum_mask", "ring"),
+    }
+    # collective → algorithm → DeviceCommunicator method
+    _IMPL = {
+        "allreduce": {"psum": "allreduce", "rs_ag": "allreduce_rs_ag"},
+        "allgather": {"all_gather": "allgather", "ring": "allgather_ring"},
+        "bcast": {"psum_mask": "bcast", "ring": "bcast_ring"},
+    }
+
+    def register_params(self) -> None:
+        register_var("coll", "xla_dcn_axes", VarType.STRING, "",
+                     "comma-separated mesh axis names that cross DCN "
+                     "(inter-slice); collectives over them prefer "
+                     "neighbor-shaped algorithms (ring/2-phase)")
+        register_var("coll", "xla_allreduce_large", VarType.SIZE, 32 << 20,
+                     "allreduce: at/above this switch to the 2-phase "
+                     "reduce_scatter+all_gather form (bandwidth-optimal "
+                     "ring shape; below, XLA's fused psum wins on latency)")
+        register_var("coll", "xla_dynamic_rules", VarType.STRING, "",
+                     "path to a dynamic rules file for the DEVICE path "
+                     "(same format as coll_host_dynamic_rules)")
+        for name in self.ALGORITHMS:
+            register_var("coll", f"xla_{name}_algorithm", VarType.STRING, "",
+                         f"force a device {name} algorithm (empty = decide "
+                         f"by size/axis kind)")
+
     def query(self, comm=None, **ctx) -> Optional[int]:
         return self.PRIORITY
+
+    # -- decision layer ----------------------------------------------------
+
+    def _crosses_dcn(self, dc) -> bool:
+        spec = var_registry.get("coll_xla_dcn_axes") or ""
+        dcn = {a.strip() for a in spec.split(",") if a.strip()}
+        return bool(dcn.intersection(dc.axes))
+
+    def _decide(self, coll: str, comm, dc, nbytes: int) -> str:
+        """forced var > rules file > fixed (bytes × size × axis kind)."""
+        valid = self.ALGORITHMS[coll]
+        alg = var_registry.get(f"coll_xla_{coll}_algorithm")
+        src = f"config var coll_xla_{coll}_algorithm"
+        if not alg:
+            path = var_registry.get("coll_xla_dynamic_rules")
+            if path:
+                alg = rules.load_rules(path).lookup(coll, dc.size, nbytes)
+                src = f"rules file {path}"
+        if alg:
+            if alg not in valid:
+                from ompi_tpu.mpi.constants import MPIException
+
+                raise MPIException(
+                    f"unknown device {coll} algorithm {alg!r} (from {src}); "
+                    f"valid: {', '.join(valid)}")
+            return alg
+        # fixed decision: neighbor-shaped on DCN axes or huge payloads;
+        # XLA-native (fused, ICI-aware) otherwise
+        dcn = self._crosses_dcn(dc)
+        if coll == "allreduce":
+            large = var_registry.get("coll_xla_allreduce_large")
+            return "rs_ag" if (dcn or nbytes >= large) else "psum"
+        if coll == "allgather":
+            return "ring" if dcn else "all_gather"
+        return "ring" if dcn else "psum_mask"
+
+    def _run_decided(self, coll: str, comm, buf, *args, **kw):
+        dc = _device_comm(comm)
+        alg = self._decide(coll, comm, dc, _dev_nbytes(buf))
+        return _run(comm, self._IMPL[coll][alg], buf, *args, **kw)
 
     # -- table slots (device implementations) ------------------------------
 
     def coll_barrier(self, comm) -> None:
         # host-driven barrier semantics: an empty psum over the mesh,
-        # blocking the driver until every device participated
+        # blocking the driver until every device participated (compiled
+        # once per mesh via the run_method cache — round-2 weak #5)
         dc = _device_comm(comm)
-        import numpy as np
-
-        dc.run(lambda c, t: c.barrier(t), np.zeros((dc.size,), "int32"))
+        dc.run_method("barrier", np.zeros((dc.size,), "int32"))
 
     def coll_bcast(self, comm, buf, root: int):
-        return _run(comm, "bcast", buf, root)
+        return self._run_decided("bcast", comm, buf, root)
 
     def coll_reduce(self, comm, sendbuf, op: Op, root: int):
         return _run(comm, "reduce", sendbuf, op, root)
 
     def coll_allreduce(self, comm, sendbuf, op: Op):
-        return _run(comm, "allreduce", sendbuf, op)
+        # both impls take (x, op); rs_ag falls back to psum for non-SUM
+        return self._run_decided("allreduce", comm, sendbuf, op)
 
     def coll_gather(self, comm, sendbuf, root: int):
         return _run(comm, "gather", sendbuf, root)
 
     def coll_allgather(self, comm, sendbuf):
-        return _run(comm, "allgather", sendbuf)
+        return self._run_decided("allgather", comm, sendbuf)
 
     def coll_scatter(self, comm, sendbuf, root: int):
         return _run(comm, "scatter", sendbuf, root)
@@ -105,3 +200,23 @@ class XlaColl(Component):
 
     def coll_scan(self, comm, sendbuf, op: Op):
         return _run(comm, "scan", sendbuf, op)
+
+    def coll_exscan(self, comm, sendbuf, op: Op):
+        return _run(comm, "exscan", sendbuf, op)
+
+    # v-collectives: through the MPI API the device path sees one uniform
+    # shard per rank (SPMD programs are single-shape), so these lower to
+    # the dense forms; ragged counts are first-class on DeviceCommunicator
+    # (allgatherv/scatterv/alltoallv with a static counts vector → pad+mask)
+
+    def coll_gatherv(self, comm, sendbuf, root: int):
+        return _run(comm, "gatherv", sendbuf, None, root)
+
+    def coll_scatterv(self, comm, sendparts, root: int):
+        return _run(comm, "scatterv", sendparts, None, root)
+
+    def coll_allgatherv(self, comm, sendbuf):
+        return _run(comm, "allgatherv", sendbuf)
+
+    def coll_alltoallv(self, comm, sendparts):
+        return _run(comm, "alltoallv", sendparts)

@@ -61,6 +61,10 @@ class DeviceCommunicator:
                 raise MPIException(f"axis {ax!r} not in mesh {mesh.axis_names}")
         self.name = name
         self._jax = jax
+        # driver-mode compiled-program cache: (method, static args, avals)
+        # → jitted callable.  Without it every driver-mode collective pays
+        # a fresh shard_map trace + jit dispatch setup (round-2 weak #5).
+        self._method_cache: dict = {}
 
     # -- shape -------------------------------------------------------------
 
@@ -201,6 +205,210 @@ class DeviceCommunicator:
             outs.append(acc)
         return jnp.stack(outs)[self.rank()]
 
+    def exscan(self, x, op: Op = SUM):
+        """≈ MPI_Exscan (exclusive prefix): rank r gets op-fold of ranks
+        < r; rank 0 gets zeros (MPI leaves it undefined — zeros is the
+        identity-friendly choice)."""
+        import jax.numpy as jnp
+
+        from jax import lax
+
+        stacked = lax.all_gather(x, self._ax, tiled=False)
+        stacked = stacked.reshape((self.size,) + x.shape)
+        if op is SUM:
+            prefix = jnp.cumsum(stacked, axis=0)
+            incl = prefix[self.rank()]
+            return incl - x  # exclusive = inclusive − own contribution
+        acc = jnp.zeros_like(stacked[0])
+        outs = [acc]
+        run = stacked[0]
+        for r in range(1, self.size):
+            outs.append(run)
+            run = op.device(run, stacked[r])
+        return jnp.stack(outs)[self.rank()]
+
+    # -- alternative algorithm implementations (the decision layer's menu) -
+
+    def allreduce_rs_ag(self, x, op: Op = SUM, axis: Optional[int] = None):
+        """Bandwidth-optimal 2-phase allreduce: reduce_scatter then
+        all_gather (≈ the reference's ring allreduce,
+        coll_base_allreduce.c:339 — same 2·(n-1)/n bytes on the wire,
+        expressed as the two XLA collectives so ICI runs both phases).
+        Scatters along ``axis`` (default: first n-divisible dim; falls back
+        to plain psum when no dim divides — shapes are static, so the
+        choice compiles away)."""
+        from jax import lax
+
+        if op is not SUM:
+            return self.allreduce(x, op)
+        n = self.size
+        if axis is None:
+            axis = next((i for i, d in enumerate(x.shape) if d % n == 0),
+                        None)
+            if axis is None:
+                return self.allreduce(x, op)
+        scattered = lax.psum_scatter(x, self._ax, scatter_dimension=axis,
+                                     tiled=True)
+        return lax.all_gather(scattered, self._ax, axis=axis, tiled=True)
+
+    def allgather_ring(self, x, axis: int = 0):
+        """Explicit ring allgather over ppermute hops (≈
+        coll_base_allgather.c:364).  n-1 neighbor hops; each hop moves 1/n
+        of the result — the shape DCN-spanning axes prefer (one peer at a
+        time) over the all-to-one fan-in XLA may pick for all_gather."""
+        import jax.numpy as jnp
+
+        from jax import lax
+
+        n = self.size
+        ax = self._ax
+        if isinstance(ax, tuple):  # ring over the flattened multi-axis
+            return self.allgather(x, axis=axis)  # fall back to native
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        blocks = [x]
+        cur = x
+        for _ in range(n - 1):
+            cur = lax.ppermute(cur, ax, perm)
+            blocks.append(cur)
+        # blocks[j] is the block of rank (my - j) mod n, so rank p's block
+        # sits at index (my - p) mod n — the permutation is self-inverse
+        my = self.rank()
+        stacked = jnp.stack(blocks)                    # (n, ...)
+        ordered = stacked[(my - jnp.arange(n)) % n]    # rank-ordered blocks
+        return jnp.concatenate([ordered[i] for i in range(n)], axis=axis)
+
+    def bcast_ring(self, x, root: int = 0):
+        """Pipeline/chain broadcast via n-1 ppermute hops (≈
+        coll_base_bcast.c:257 chain) — each hop touches one neighbor link
+        instead of the masked-psum tree."""
+        import jax.numpy as jnp
+
+        from jax import lax
+
+        n = self.size
+        ax = self._ax
+        if isinstance(ax, tuple):
+            return self.bcast(x, root)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        cur = jnp.where(self.rank() == root, x, jnp.zeros_like(x))
+        acc = cur
+        for _ in range(n - 1):
+            cur = lax.ppermute(cur, ax, perm)
+            acc = acc + cur
+        return acc.astype(x.dtype)
+
+    # -- v-collectives (ragged → pad + static counts) ----------------------
+    #
+    # SPMD/XLA needs one static-shape program on every device, so ragged
+    # counts are carried as a *static* per-rank tuple and buffers are
+    # padded to max(counts); the valid prefix of each block is the payload
+    # (≈ MPI_*v displacement arrays, with padding playing the role of
+    # displacements).  Uniform counts (the common case reaching coll/xla
+    # through the MPI API) lower to the dense collectives unchanged.
+
+    def _counts(self, counts, x, axis: int) -> tuple[int, ...]:
+        if counts is None:
+            return (x.shape[axis],) * self.size
+        counts = tuple(int(c) for c in counts)
+        if len(counts) != self.size:
+            raise MPIException(
+                f"counts {counts} must have one entry per rank ({self.size})")
+        return counts
+
+    def allgatherv(self, x, counts=None, axis: int = 0):
+        """≈ MPI_Allgatherv: x is my block padded to max(counts) along
+        `axis` (exactly counts[r] valid rows on rank r); returns the
+        concatenation of every rank's valid rows (static shape
+        sum(counts))."""
+        import jax.numpy as jnp
+
+        from jax import lax
+
+        counts = self._counts(counts, x, axis)
+        if len(set(counts)) == 1 and counts[0] == x.shape[axis]:
+            return self.allgather(x, axis=axis)     # dense fast path
+        stacked = lax.all_gather(x, self._ax, tiled=False)
+        stacked = stacked.reshape((self.size,) + x.shape)
+        parts = [jnp.take(stacked[r], jnp.arange(c), axis=axis)
+                 for r, c in enumerate(counts)]
+        return jnp.concatenate(parts, axis=axis)
+
+    def gatherv(self, x, counts=None, root: int = 0, axis: int = 0):
+        """≈ MPI_Gatherv: allgatherv + zeros on non-roots (reduce note)."""
+        import jax.numpy as jnp
+
+        full = self.allgatherv(x, counts, axis=axis)
+        return jnp.where(self.rank() == root, full, jnp.zeros_like(full))
+
+    def scatterv(self, x, counts=None, root: int = 0, axis: int = 0):
+        """≈ MPI_Scatterv: x holds sum(counts) rows along `axis` on every
+        device (root's value is authoritative — it is broadcast); returns
+        my block padded with zeros to max(counts) (counts[my] valid)."""
+        import jax.numpy as jnp
+
+        from jax import lax
+
+        n = self.size
+        if counts is None:
+            return self.scatter(x, root, axis=axis)
+        counts = tuple(int(c) for c in counts)
+        if len(counts) != n:
+            raise MPIException(
+                f"counts {counts} must have one entry per rank ({n})")
+        full = self.bcast(x, root)
+        maxc = max(counts)
+        offs = np.concatenate([[0], np.cumsum(counts)]).astype(np.int32)
+        starts = jnp.asarray(offs[:-1])[self.rank()]
+        cnt = jnp.asarray(np.array(counts, np.int32))[self.rank()]
+        # pad the tail so a maxc-row slice at any offset stays in bounds,
+        # then slice my window and zero rows past my count
+        pad = [(0, 0)] * full.ndim
+        pad[axis] = (0, maxc)
+        fullp = jnp.pad(full, pad)
+        start_vec = [0] * full.ndim
+        start_vec[axis] = starts
+        sizes = list(full.shape)
+        sizes[axis] = maxc
+        blk = lax.dynamic_slice(fullp, start_vec, sizes)
+        shape = [1] * full.ndim
+        shape[axis] = maxc
+        mask = (jnp.arange(maxc) < cnt).reshape(shape)
+        return jnp.where(mask, blk, jnp.zeros_like(blk))
+
+    def alltoallv(self, x, send_counts=None, axis: int = 0):
+        """≈ MPI_Alltoallv: x is (n, maxc, ...) — one padded segment per
+        destination (send_counts[my][d] valid rows in segment d; static
+        n×n matrix).  Returns (n, maxc', ...): one padded segment per
+        source, maxc' = max over the transposed counts, zeros beyond the
+        valid prefix."""
+        import jax.numpy as jnp
+
+        from jax import lax
+
+        n = self.size
+        if x.shape[0] != n:
+            raise MPIException(
+                f"alltoallv: leading dim {x.shape[0]} must equal "
+                f"communicator size {n}")
+        if send_counts is None:
+            return self.alltoall(x, split_axis=0, concat_axis=0)
+        m = np.asarray(send_counts, np.int64)
+        if m.shape != (n, n):
+            raise MPIException(
+                f"alltoallv: send_counts must be {n}x{n}, got {m.shape}")
+        # exchange padded segments: all_to_all over the destination dim
+        out = lax.all_to_all(x, self._ax, split_axis=0, concat_axis=0,
+                             tiled=True)
+        out = out.reshape((n,) + x.shape[1:])
+        # mask each received segment to its true (recv) count: segment s
+        # holds send_counts[s][my] valid rows
+        recv = jnp.asarray(m.T.astype(np.int32))[self.rank()]   # (n,)
+        idx = jnp.arange(x.shape[1])
+        shape = [n] + [1] * (x.ndim - 1)
+        shape[1] = x.shape[1]
+        mask = (idx[None, :] < recv[:, None]).reshape(shape)
+        return jnp.where(mask, out, jnp.zeros_like(out))
+
     def barrier(self, token=None):
         """SPMD barrier: a zero-byte psum forces cross-device sync ordering.
         Returns a token to thread through data dependencies."""
@@ -273,6 +481,38 @@ class DeviceCommunicator:
             return fn(self, *shards)
 
         return jax.jit(shmapped)(*arrays)
+
+    def run_method(self, method: str, *arrays, margs: tuple = (),
+                   mkw: tuple = (), out_specs: Any = None):
+        """Driver-mode dispatch of one named collective, cached: the
+        shard_map+jit program is built once per (method, static args,
+        input avals) and reused — a driver barrier/allreduce costs a dict
+        lookup + dispatch, not a retrace (round-2 weak #5)."""
+        import jax
+
+        from jax.sharding import PartitionSpec as P
+
+        key = (method, margs, mkw,
+               tuple((a.shape, str(getattr(a, "dtype", "?")))
+                     for a in arrays),
+               out_specs if out_specs is None else str(out_specs))
+        cached = self._method_cache.get(key)
+        if cached is None:
+            kw = dict(mkw)
+            axes = self.axes
+            spec = P(axes if len(axes) > 1 else axes[0])
+            in_specs = tuple(spec for _ in arrays)
+            out_sp = out_specs if out_specs is not None else spec
+
+            @functools.partial(
+                jax.shard_map, mesh=self.mesh, in_specs=in_specs,
+                out_specs=out_sp, check_vma=False)
+            def shmapped(*shards):
+                return getattr(self, method)(*shards, *margs, **kw)
+
+            cached = jax.jit(shmapped)
+            self._method_cache[key] = cached
+        return cached(*arrays)
 
     def __repr__(self) -> str:
         return (f"DeviceCommunicator({self.name}, axes={self.axes}, "
