@@ -214,6 +214,68 @@ class SelfBTL:
         self.on_frame(self.rank, header, payload)
 
 
+class ProcBTL:
+    """Same-process direct delivery — the degenerate single-copy case of
+    vader's xpmem mode (btl_vader_component.c:61-69): when two ranks share
+    an address space (threads-as-ranks harness, in-process jobs) a frame
+    is ONE direct call into the peer's frame handler — no ring, no poller
+    wakeup, no serialization of the payload.  The PML's per-(peer, cid)
+    sequence numbers keep ordering correct when mixed with other BTLs.
+
+    Endpoints register in a process-global table under a unique token;
+    the business card is ``pid:token`` and reachability is pid equality.
+    """
+
+    _registry: dict[int, "ProcBTL"] = {}
+    _next_token = iter(range(1, 1 << 62))
+    _reg_lock = threading.Lock()
+
+    def __init__(self, rank: int, on_frame: OnFrame) -> None:
+        import os
+
+        self.rank = rank
+        self.on_frame = on_frame
+        self._alias: dict[int, int] = {}
+        self._peer_tokens: dict[int, int] = {}
+        # honor simulated host identities: sim-plm ranks on different
+        # fake hosts must NOT short-circuit through the address space
+        self.hostname = (os.environ.get("OMPI_TPU_FAKE_HOST")
+                         or os.uname().nodename)
+        with ProcBTL._reg_lock:
+            self.token = next(ProcBTL._next_token)
+            ProcBTL._registry[self.token] = self
+        self.address = f"{os.getpid()}:{self.token}:{self.hostname}"
+
+    def set_alias(self, peer: int, my_id: int) -> None:
+        self._alias[peer] = my_id
+
+    def can_reach(self, card: str) -> bool:
+        import os
+
+        try:
+            pid, token, host = card.split(":", 2)
+        except ValueError:
+            return False
+        return (pid == str(os.getpid()) and host == self.hostname
+                and int(token) in ProcBTL._registry)
+
+    def connect(self, peer: int, card: str) -> bool:
+        if not self.can_reach(card):
+            return False
+        self._peer_tokens[peer] = int(card.split(":", 2)[1])
+        return True
+
+    def send(self, peer: int, header: dict, payload: bytes = b"") -> None:
+        target = ProcBTL._registry.get(self._peer_tokens[peer])
+        if target is None:
+            raise ConnectionError(f"btl/proc: peer {peer} endpoint closed")
+        target.on_frame(self._alias.get(peer, self.rank), header, payload)
+
+    def close(self) -> None:
+        with ProcBTL._reg_lock:
+            ProcBTL._registry.pop(self.token, None)
+
+
 @btl_framework.component
 class TcpBTLComponent(Component):
     NAME = "tcp"
@@ -230,6 +292,19 @@ class SelfBTLComponent(Component):
 
     def create(self, rank: int, on_frame: OnFrame) -> SelfBTL:
         return SelfBTL(rank, on_frame)
+
+
+@btl_framework.component
+class ProcBTLComponent(Component):
+    """Same-address-space direct delivery (≈ vader's xpmem single-copy
+    mode degenerated to zero-copy calls) — priority above shm: when ranks
+    share a process, a function call beats a ring."""
+
+    NAME = "proc"
+    PRIORITY = 70
+
+    def create(self, rank: int, on_frame: OnFrame) -> ProcBTL:
+        return ProcBTL(rank, on_frame)
 
 
 @btl_framework.component
@@ -265,26 +340,37 @@ class BtlEndpoint:
             from ompi_tpu.mpi.btl_shm import ShmBTL
 
             self.shm_btl = ShmBTL(rank, on_frame)
+        self.proc_btl = ProcBTL(rank, on_frame) if "proc" in enabled else None
         if self.tcp_btl is None and self.shm_btl is None:
             raise MPIException(
                 "btl selection leaves no transport for remote peers "
                 "(need tcp and/or shm)")
         self._cards: dict[int, str] = {}   # peer → full business card
         self._shm_ok: set[int] = set()     # peers with a live shm route
+        self._proc_ok: set[int] = set()    # peers in my address space
 
     @property
     def address(self) -> str:
         """The combined business card: tcp address (``-`` when tcp is
-        disabled), plus the shm card when that transport is enabled."""
-        tcp = self.tcp_btl.address if self.tcp_btl is not None else "-"
-        if self.shm_btl is None:
-            return tcp
-        return f"{tcp};shm={self.shm_btl.address}"
+        disabled), plus a segment per enabled same-host transport."""
+        card = self.tcp_btl.address if self.tcp_btl is not None else "-"
+        if self.shm_btl is not None:
+            card += f";shm={self.shm_btl.address}"
+        if self.proc_btl is not None:
+            card += f";proc={self.proc_btl.address}"
+        return card
 
     @staticmethod
-    def _split_card(card: str) -> tuple[str, Optional[str]]:
-        tcp, _, rest = card.partition(";shm=")
-        return tcp, (rest or None)
+    def _split_card(card: str) -> tuple[str, Optional[str], Optional[str]]:
+        """→ (tcp, shm segment, proc segment)."""
+        parts = card.split(";")
+        tcp, shm, proc = parts[0], None, None
+        for p in parts[1:]:
+            if p.startswith("shm="):
+                shm = p[4:]
+            elif p.startswith("proc="):
+                proc = p[5:]
+        return tcp, shm, proc
 
     def set_peers(self, peers: dict[int, str]) -> None:
         self._cards.update(peers)
@@ -297,6 +383,8 @@ class BtlEndpoint:
             self.tcp_btl.set_alias(peer, my_id)
         if self.shm_btl is not None:
             self.shm_btl.set_alias(peer, my_id)
+        if self.proc_btl is not None:
+            self.proc_btl.set_alias(peer, my_id)
 
     def max_peer_id(self) -> int:
         """Highest peer id this endpoint knows (for dpm namespace bases)."""
@@ -305,10 +393,39 @@ class BtlEndpoint:
         with self.tcp_btl._lock:
             return max(self.tcp_btl._peers, default=-1)
 
+    def try_send_inline(self, peer: int, header: dict,
+                        payload: bytes = b"") -> bool:
+        """Inline fast path (≈ mca_bml_base_sendi → btl_sendi,
+        pml_ob1_isend.c:89-119): deliver the frame on the CALLER's thread
+        when it cannot block — self loopback always, shm when the ring has
+        room.  False ⇒ caller enqueues for the send worker.  Safe to mix
+        with queued sends: the PML reorders by per-(peer,cid) sequence."""
+        if peer == self.rank:
+            self.self_btl.send(peer, header, payload)
+            return True
+        if self.proc_btl is not None and (peer in self._proc_ok
+                                          or self._proc_route(peer)):
+            self.proc_btl.send(peer, header, payload)
+            return True
+        if self.shm_btl is not None and (peer in self._shm_ok
+                                         or self._shm_route(peer)):
+            from ompi_tpu.mpi.btl_shm import FrameTooBig
+
+            try:
+                return self.shm_btl.try_send(peer, header, payload)
+            except FrameTooBig:
+                return False   # worker path reroutes oversize over tcp
+        return False
+
     def send(self, peer: int, header: dict, payload: bytes = b"") -> None:
         if peer == self.rank:
             self.self_btl.send(peer, header, payload)
             return
+        if self.proc_btl is not None:
+            if peer in self._proc_ok or self._proc_route(peer):
+                self.proc_btl.send(peer, header, payload)
+                return
+        oversize: Optional[BaseException] = None
         if self.shm_btl is not None:
             # steady state: one set lookup, then straight into the ring
             if peer in self._shm_ok or self._shm_route(peer):
@@ -317,9 +434,15 @@ class BtlEndpoint:
                 try:
                     self.shm_btl.send(peer, header, payload)
                     return
-                except FrameTooBig:
-                    pass   # oversize frame rides tcp; PML seq reorders
+                except FrameTooBig as e:
+                    oversize = e   # oversize frame rides tcp; PML reorders
         if self.tcp_btl is None:
+            if oversize is not None:
+                raise MPIException(
+                    f"frame to rank {peer} exceeds the shm ring's "
+                    f"single-frame limit ({oversize}) and tcp is disabled "
+                    f"— raise --mca btl_shm_ring_size or re-enable tcp "
+                    f"for oversize fallback") from oversize
             raise MPIException(
                 f"no btl route to rank {peer}: tcp is disabled and the "
                 f"peer is not shm-reachable")
@@ -332,8 +455,44 @@ class BtlEndpoint:
             return True
         return False
 
+    def _proc_route(self, peer: int) -> bool:
+        proc_card = self._split_card(self._cards.get(peer, ""))[2]
+        if proc_card and self.proc_btl.connect(peer, proc_card):
+            self._proc_ok.add(peer)
+            return True
+        return False
+
+    def rebind(self, peer: int, card: str) -> None:
+        """Re-point every transport at a peer's NEW business card (the
+        peer was respawned by errmgr/respawn and re-announced itself).
+        Stale sockets/rings are dropped; the next send redials lazily."""
+        self._cards[peer] = card
+        tcp_addr, _, _ = self._split_card(card)
+        if self.tcp_btl is not None:
+            with self.tcp_btl._lock:
+                self.tcp_btl._peers[peer] = tcp_addr
+                sock = self.tcp_btl._out.pop(peer, None)
+                self.tcp_btl._out_locks.pop(peer, None)
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+        if self.shm_btl is not None:
+            self._shm_ok.discard(peer)
+            with self.shm_btl._lock:
+                self.shm_btl._unreachable.discard(peer)
+                w = self.shm_btl._writers.pop(peer, None)
+            if w is not None:
+                w.close()
+        if self.proc_btl is not None:
+            self._proc_ok.discard(peer)
+            self.proc_btl._peer_tokens.pop(peer, None)
+
     def close(self) -> None:
         if self.tcp_btl is not None:
             self.tcp_btl.close()
         if self.shm_btl is not None:
             self.shm_btl.close()
+        if self.proc_btl is not None:
+            self.proc_btl.close()

@@ -110,12 +110,36 @@ class ShmRingWriter:
         except OSError:
             pass   # no doorbell (older inbox / test rig): receiver spins
 
-    def send(self, header: dict, payload: bytes) -> None:
+    def _frame(self, header: dict, payload: bytes):
         hdr = dss.pack(header)
         body = struct.pack("<II", len(hdr) + len(payload), len(hdr))
         need = 8 + len(hdr) + len(payload)
         if need > self.capacity // 2:
             raise FrameTooBig(f"{need}B frame vs {self.capacity}B ring")
+        return body, hdr, need
+
+    def _publish(self, body, hdr, payload) -> None:
+        """Write one frame and publish it (call with self._lock held and
+        space verified)."""
+        self._write(body)
+        self._write(hdr)
+        if payload:
+            self._write(payload)
+        # publish AFTER the data is in place (x86 TSO store order)
+        self._ctr[_OFF_HEAD // 8] = self._head
+        # doorbell: only when the receiver armed its sleep flag (or on
+        # our very first frame — a sleeping receiver must discover a
+        # brand-new ring)
+        if (self._first or self._ctr[_OFF_SLEEP // 8]) \
+                and self._db_fd is not None:
+            self._first = False
+            try:
+                os.write(self._db_fd, b"\x01")
+            except (BlockingIOError, BrokenPipeError, OSError):
+                pass
+
+    def send(self, header: dict, payload: bytes) -> None:
+        body, hdr, need = self._frame(header, payload)
         with self._lock:
             delay, waited = 0.0, 0.0
             timeout = float(var_registry.get("btl_shm_send_timeout") or 0)
@@ -134,22 +158,19 @@ class ShmRingWriter:
                 time.sleep(delay)
                 waited += delay
                 delay = min(delay + 2e-5, 1e-3)
-            self._write(body)
-            self._write(hdr)
-            if payload:
-                self._write(payload)
-            # publish AFTER the data is in place (x86 TSO store order)
-            self._ctr[_OFF_HEAD // 8] = self._head
-            # doorbell: only when the receiver armed its sleep flag (or on
-            # our very first frame — a sleeping receiver must discover a
-            # brand-new ring)
-            if (self._first or self._ctr[_OFF_SLEEP // 8]) \
-                    and self._db_fd is not None:
-                self._first = False
-                try:
-                    os.write(self._db_fd, b"\x01")
-                except (BlockingIOError, BrokenPipeError, OSError):
-                    pass
+            self._publish(body, hdr, payload)
+
+    def try_send(self, header: dict, payload: bytes) -> bool:
+        """Nonblocking send (≈ btl sendi, btl.h:926): publish the frame iff
+        the ring has room NOW; False ⇒ the caller takes the queued path.
+        Still raises FrameTooBig for frames no amount of draining fits."""
+        body, hdr, need = self._frame(header, payload)
+        with self._lock:
+            tail = self._ctr[_OFF_TAIL // 8]
+            if self._head - tail + need > self.capacity:
+                return False
+            self._publish(body, hdr, payload)
+        return True
 
     def _write(self, data) -> None:
         data = memoryview(data).cast("B")
@@ -306,6 +327,14 @@ class ShmBTL:
         """Deliver one frame; raises FrameTooBig for oversized frames and
         KeyError if connect() was never called for this peer."""
         self._writers[peer].send(header, payload)
+
+    def try_send(self, peer: int, header: dict,
+                 payload: bytes = b"") -> bool:
+        """Nonblocking delivery on the caller's thread; False when the
+        ring is full or unconnected (caller falls back to the send
+        worker).  FrameTooBig propagates — no queueing fixes that."""
+        w = self._writers.get(peer)
+        return w.try_send(header, payload) if w is not None else False
 
     # -- receive side ------------------------------------------------------
 

@@ -623,10 +623,22 @@ class Window:
         elif kind == "pscw_done":
             # FIFO per (origin → me) channel on _TAG_REQ means every op the
             # origin issued this epoch was dispatched before this marker —
-            # no applied-count handshake needed (checked by the assert)
+            # no applied-count handshake needed.  Validated explicitly (a
+            # bare assert vanishes under -O, and an AssertionError swallowed
+            # by the dispatch loop would hang the peer's Win_wait silently);
+            # the epoch still completes so wait() returns with the error on
+            # the record rather than deadlocking.
             _, origin, expected = msg
             with self._cv:
-                assert self._applied_from.get(origin, 0) >= expected
+                applied = self._applied_from.get(origin, 0)
+                if applied < expected:
+                    # recorded on the epoch: the waiting Win_wait returns
+                    # (no silent hang) but raises with this error
+                    self._errors.append(
+                        f"pscw_done from {origin} before its ops were "
+                        f"applied ({applied} < {expected}) — per-channel "
+                        f"FIFO violated")
+                    _log.error("osc: %s", self._errors[-1])
                 self._pscw_done.add(origin)
                 self._cv.notify_all()
         elif kind == "fetch":

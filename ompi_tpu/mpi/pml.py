@@ -274,6 +274,8 @@ class PmlOb1:
     """The default PML: matching + eager/rendezvous over the BTL."""
 
     def __init__(self, rank: int) -> None:
+        import os
+
         self.rank = rank
         self.endpoint = BtlEndpoint(rank, self._on_frame)
         self._lock = threading.Lock()
@@ -285,6 +287,16 @@ class PmlOb1:
         self._seq: dict[tuple[int, int], int] = {}
         self._recv_seq: dict[tuple[int, int], int] = {}
         self._held: dict[tuple[int, int], dict[int, tuple]] = {}
+        # errmgr/respawn epoch fencing: my incarnation number (restarted
+        # ranks reject frames stamped for a previous life of theirs),
+        # each peer's incarnation (learned from its rebind announce OR
+        # from the "si" stamp on its first post-restart frame — whichever
+        # transport wins the race), and a re-announce guard so a lost
+        # rebind announce heals instead of dropping frames forever
+        self.incarnation = int(os.environ.get("OMPI_TPU_RESTART") or 0)
+        self._peer_epoch: dict[int, int] = {}   # what I stamp TOWARD peer
+        self._peer_inc: dict[int, int] = {}     # peer's own incarnation
+        self._reannounce_at: dict[int, float] = {}  # rate-limited heal
         self._sendq: "queue.Queue[Optional[tuple]]" = queue.Queue()
         self._listeners: list = []   # peruse/monitoring subscribers
         self._events: "collections.deque[tuple]" = collections.deque()
@@ -328,6 +340,18 @@ class PmlOb1:
 
     def set_peers(self, peers: dict[int, str]) -> None:
         self.endpoint.set_peers(peers)
+
+    def announce_rebind(self, peers: dict[int, str]) -> None:
+        """Respawned-rank hello (errmgr/respawn): tell every peer my NEW
+        business card so they drop stale routes and reset the wire-seq
+        space toward me (≈ endpoint re-establishment in the reference's
+        failover pml, pml/bfo).  Rides the send worker like every other
+        control frame — safe to call from BTL reader threads; a failed
+        send is retried by the rate-limited heal in _on_frame."""
+        for peer in peers:
+            self._sendq.put(("frame", peer,
+                             {"t": "rebind", "card": self.address,
+                              "inc": self.incarnation}, b"", None))
 
     def close(self) -> None:
         self._closed = True
@@ -381,10 +405,15 @@ class PmlOb1:
             seq_key = (peer, cid)
             seq = self._seq.get(seq_key, 0)
             self._seq[seq_key] = seq + 1
+            epoch = self._peer_epoch.get(peer, 0)
         hdr = {"tag": tag, "cid": cid, "seq": seq,
                "dt": _dtype_to_wire(datatype.base_np),
                "elems": len(payload) // datatype.base_np.itemsize,
                "shp": list(arr.shape)}
+        if epoch:  # frames for a revived peer carry its incarnation
+            hdr["ep"] = epoch
+        if self.incarnation:  # revived senders stamp their own life number
+            hdr["si"] = self.incarnation
         if self._listeners:
             self._emit(EVT_SEND_POST, peer=peer, tag=tag, cid=cid,
                        nbytes=len(payload))
@@ -396,11 +425,20 @@ class PmlOb1:
             hdr.update(t="eager", sid=sid, sm=mode[0])  # sm: "s" | "r"
             with self._lock:
                 self._send_states[sid] = _SendState(req, peer, None, on_done)
-            self._sendq.put(("frame", peer, hdr, payload,
-                             _WireWatch(self, sid)))
+            # inline wire write when possible (completion still via sack)
+            if not self.endpoint.try_send_inline(peer, hdr, payload):
+                self._sendq.put(("frame", peer, hdr, payload,
+                                 _WireWatch(self, sid)))
         elif eager:
             hdr["t"] = "eager"
-            if mode == "buffered":
+            # sendi fast path (≈ pml_ob1_isend.c:89-119): the frame goes
+            # out on this thread — no send-worker handoff, which on small
+            # hosts is the dominant per-message cost
+            if self.endpoint.try_send_inline(peer, hdr, payload):
+                if mode == "buffered":
+                    on_done()
+                req.complete(None)
+            elif mode == "buffered":
                 wire = Request(kind="send")
                 wire.add_completion_callback(lambda _r: on_done())
                 self._sendq.put(("frame", peer, hdr, payload, wire))
@@ -516,10 +554,52 @@ class PmlOb1:
 
     # -- frame handling (reader threads; NEVER blocking-send here) ---------
 
+    def _adopt_incarnation(self, peer: int, inc: int) -> None:
+        """With self._lock held: reset the wire-seq space toward a peer
+        whose new incarnation we just learned about (idempotent; called
+        from the rebind frame AND from the 'si' stamp on data frames, so
+        a data frame outrunning the rebind across transports still lands
+        in the fresh seq space instead of the stale one)."""
+        if self._peer_inc.get(peer, 0) >= inc:
+            return
+        self._peer_inc[peer] = inc
+        for key in [k for k in self._seq if k[0] == peer]:
+            del self._seq[key]
+        for key in [k for k in self._recv_seq if k[0] == peer]:
+            del self._recv_seq[key]
+        for key in [k for k in self._held if k[0] == peer]:
+            del self._held[key]
+
     def _on_frame(self, peer: int, hdr: dict, payload: bytes) -> None:
         t = hdr["t"]
         if t in ("eager", "rndv"):
+            if hdr.get("ep", 0) < self.incarnation:
+                # a frame addressed to a previous life of this rank (it
+                # was queued before the sender processed our rebind) —
+                # lost with the old incarnation, like any in-flight data
+                # at the failure point; holding it would park it forever.
+                # Re-announce (rate-limited, via the send worker — a
+                # blocking send would stall this reader thread) so a lost
+                # rebind announce heals instead of fencing the peer out.
+                _log.verbose(1, "dropping pre-restart frame from %d "
+                             "(ep %d < %d)", peer, hdr.get("ep", 0),
+                             self.incarnation)
+                import time as _time
+
+                now = _time.monotonic()
+                with self._lock:
+                    need = now >= self._reannounce_at.get(peer, 0.0)
+                    if need:
+                        self._reannounce_at[peer] = now + 1.0
+                if need:
+                    self.announce_rebind({peer: ""})
+                return
             with self._lock:
+                si = hdr.get("si", 0)
+                if si:
+                    if si < self._peer_inc.get(peer, 0):
+                        return  # residual frame from a dead incarnation
+                    self._adopt_incarnation(peer, si)
                 # per-(peer, cid) sequence enforcement: TCP + one reader
                 # already guarantee order, but a future non-FIFO BTL (shm
                 # rings, multi-rail) must not break matching order — frames
@@ -556,6 +636,18 @@ class PmlOb1:
                 if state.on_done:
                     state.on_done()
                 state.req.complete(None)
+        elif t == "rebind":  # peer was respawned; adopt its new identity
+            with self._lock:
+                self.endpoint.rebind(peer, hdr["card"])
+                # restart the wire-sequence space toward the revived peer
+                # (idempotent with the 'si' fast path — whichever frame
+                # arrives first wins).  Frames already stamped with old
+                # seqs (sitting in the send queue) carry ep < the peer's
+                # new incarnation and are DROPPED by its receiver —
+                # without the epoch fence they would park forever.
+                inc = hdr.get("inc", 1)
+                self._peer_epoch[peer] = inc
+                self._adopt_incarnation(peer, inc)
         elif t == "rnack":  # ready send found no posted recv
             with self._lock:
                 state = self._send_states.pop(hdr["sid"], None)

@@ -69,6 +69,7 @@ def init() -> Communicator:
 
         pml = pml_framework.select().create(rank)
 
+        restarted = bool(os.environ.get("OMPI_TPU_RESTART"))
         if size > 1:
             assert client is not None
             # modex: publish my BTL business card, fence, learn everyone's
@@ -79,6 +80,11 @@ def init() -> Communicator:
                 r: cards[f"btl.addr@{r}"] for r in range(size) if r != rank
             }
             pml.set_peers(peers)
+            if restarted:
+                # errmgr/respawn revival: survivors hold my DEAD
+                # incarnation's card — re-announce so they re-route and
+                # reset the wire-seq space toward me
+                pml.announce_rebind(peers)
 
         world = Communicator(Group(range(size)), cid=0, pml=pml,
                              my_world_rank=rank, name="WORLD")
@@ -88,8 +94,11 @@ def init() -> Communicator:
         COMM_WORLD, COMM_SELF = world, selfc
         _log.verbose(1, "init complete: rank %d/%d", rank, size)
 
-        # final fence: everyone reachable before user code runs
-        if size > 1:
+        # final fence: everyone reachable before user code runs.  A
+        # RESPAWNED rank skips it — the survivors passed this barrier in a
+        # previous epoch and will not pair it again (they rendezvous with
+        # the revived rank at the finalize barrier instead).
+        if size > 1 and not restarted:
             world.barrier()
         atexit.register(_atexit_finalize)
         return world

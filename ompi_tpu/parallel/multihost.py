@@ -87,10 +87,13 @@ def initialize_from_env() -> bool:
             initialization_timeout=timeout,
         )
         _state["initialized"] = True
-        _log.verbose(1, "multihost: rank %d/%d joined %s "
-                     "(%d processes, %d global devices)",
-                     rank, size, coord,
-                     jax.process_count(), jax.device_count())
+        # NOTE: do NOT call jax.process_count()/device_count() here — they
+        # force accelerator-backend initialization, and a rank whose chip
+        # tunnel is down would hang inside MPI init (the join itself is
+        # pure coordination-service gRPC).  The device view materializes
+        # lazily on first backend use.
+        _log.verbose(1, "multihost: rank %d/%d joined %s",
+                     rank, size, coord)
         return True
 
 

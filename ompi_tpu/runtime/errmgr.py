@@ -6,8 +6,13 @@ job).  Components decide what a proc-failure event does:
 
 - ``abort``    — default: first failure kills every remaining proc and the
   job exits with the failed proc's status (mpirun's default).
-- ``continue`` — log and keep going (the resilient-mapping hook point; a
-  future component can respawn, ≈ rmaps/resilient + errmgr restart paths).
+- ``continue`` — log and keep going.
+- ``respawn``  — revive the failed rank in place up to
+  ``errmgr_max_restarts`` times (≈ rmaps/resilient + the errmgr restart
+  paths): same rank and env plus ``OMPI_TPU_RESTART=<n>`` so the app can
+  restore from its last ``ckpt`` snapshot (+ msglog replay for in-flight
+  p2p) instead of recomputing from step 0.  Select with
+  ``--mca errmgr respawn``.
 """
 
 from __future__ import annotations
@@ -15,17 +20,22 @@ from __future__ import annotations
 from typing import TYPE_CHECKING
 
 from ompi_tpu.core import output
+from ompi_tpu.core.config import VarType, register_var, var_registry
 from ompi_tpu.core.mca import Component, Framework
 from ompi_tpu.runtime.job import Job, Proc, ProcState
 
 if TYPE_CHECKING:
     from ompi_tpu.runtime.launcher import LocalLauncher
 
-__all__ = ["errmgr_framework", "ErrmgrAbort"]
+__all__ = ["errmgr_framework", "ErrmgrAbort", "ErrmgrRespawn"]
 
 _log = output.get_stream("errmgr")
 
 errmgr_framework = Framework("errmgr", "failure response policy")
+
+register_var("errmgr", "max_restarts", VarType.SIZE, 2,
+             "errmgr/respawn: revive a failed rank at most this many times "
+             "before falling back to job abort")
 
 
 @errmgr_framework.component
@@ -40,6 +50,45 @@ class ErrmgrAbort(Component):
                 f"rank {proc.rank} {proc.state.value} "
                 f"(exit code {proc.exit_code})")
         _log.verbose(1, "aborting job %d: %s", job.jobid, job.abort_reason)
+        launcher.kill_job(job, exclude=proc)
+
+
+@errmgr_framework.component
+class ErrmgrRespawn(Component):
+    """Revive failed ranks in place (≈ errmgr restart + rmaps/resilient,
+    errmgr_default_hnp.c:351-470's ORTE_PROC_STATE_RESTART arm)."""
+
+    NAME = "respawn"
+    PRIORITY = 0    # opt-in via --mca errmgr respawn
+
+    def proc_failed(self, launcher: "LocalLauncher", job: Job,
+                    proc: Proc) -> None:
+        from ompi_tpu.runtime.notifier import Severity, notify
+
+        limit = var_registry.get("errmgr_max_restarts")
+        # launchers without a revive hook (the multi-host daemon tree, for
+        # now) degrade to abort instead of raising into the rml dispatch
+        respawn = getattr(launcher, "respawn_proc", None)
+        if respawn is None:
+            _log.error("errmgr/respawn: %s cannot revive ranks; aborting",
+                       type(launcher).__name__)
+        elif proc.restarts < limit:
+            _log.verbose(1, "rank %d failed (exit %s); respawn %d/%d",
+                         proc.rank, proc.exit_code, proc.restarts + 1, limit)
+            notify(Severity.WARN, "rank-respawn",
+                   f"job {job.jobid} rank {proc.rank} exit "
+                   f"{proc.exit_code}; restart {proc.restarts + 1}/{limit}")
+            if respawn(job, proc):
+                return
+            _log.error("rank %d respawn failed to start", proc.rank)
+        else:
+            _log.verbose(1, "rank %d exhausted %d restarts; aborting job",
+                         proc.rank, limit)
+        if job.aborted_proc is None:
+            job.aborted_proc = proc
+            job.abort_reason = (
+                f"rank {proc.rank} {proc.state.value} after "
+                f"{proc.restarts} restart(s) (exit code {proc.exit_code})")
         launcher.kill_job(job, exclude=proc)
 
 

@@ -71,6 +71,7 @@ class Proc:
     pid: Optional[int] = None
     exit_code: Optional[int] = None
     local_rank: int = 0  # rank among procs on the same node
+    restarts: int = 0    # times errmgr/respawn revived this rank
 
 
 @dataclasses.dataclass

@@ -60,7 +60,8 @@ class LocalLauncher:
         self._iof_threads: list[threading.Thread] = []
         self._errmgr = errmgr_mod.errmgr_framework.select(**select_ctx)
         self._kill_lock = threading.Lock()
-        self._stdin_sinks: list = []
+        self._stdin_sinks: dict[int, object] = {}   # rank → _StdinWriter
+        self._respawned: set[int] = set()  # ranks revived since last reap
 
     # -- state handlers (the launch DAG) ---------------------------------
 
@@ -75,63 +76,96 @@ class LocalLauncher:
         rmaps.map_job(job, **self.select_ctx)
         return JobState.LAUNCH_APPS
 
-    def _st_launch(self, sm: StateMachine, job: Job) -> JobState:
-        self.server = pmix.PMIxServer(
-            size=job.np, on_abort=lambda r, s, m: self._on_abort(job, r, s, m))
+    def _proc_env(self, job: Job, proc: Proc) -> dict:
         # ≈ plm_rsh prefixing PATH/LD_LIBRARY_PATH with its install prefix
         # (orte/mca/plm/rsh/plm_rsh_module.c): make this framework importable
         # in children no matter their cwd.
-        pkg_root = os.path.dirname(os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__))))
+        from ompi_tpu.core import pkg_root as _pkg_root
+
+        root = _pkg_root()
+        app = job.apps[proc.app_idx]
+        env = dict(os.environ)
+        env.update(app.env)
+        pypath = env.get("PYTHONPATH", "")
+        if root not in pypath.split(os.pathsep):
+            env["PYTHONPATH"] = (
+                root + (os.pathsep + pypath if pypath else ""))
+        env[pmix.ENV_URI] = self.server.uri
+        env[pmix.ENV_RANK] = str(proc.rank)
+        env[pmix.ENV_SIZE] = str(job.np)
+        env[pmix.ENV_JOBID] = str(job.jobid)
+        env[pmix.ENV_LOCAL_RANK] = str(proc.local_rank)
+        if proc.chip is not None:
+            env[pmix.ENV_CHIP] = str(proc.chip)
+        if proc.restarts:
+            env["OMPI_TPU_RESTART"] = str(proc.restarts)
+        return env
+
+    def _launch_proc(self, job: Job, proc: Proc) -> bool:
+        """Fork/exec one rank (first launch or errmgr respawn); False on
+        failure to start (proc.state records why)."""
+        app = job.apps[proc.app_idx]
+        want_stdin = (self.stdin_target == "all"
+                      or self.stdin_target == str(proc.rank))
+        try:
+            p = subprocess.Popen(
+                app.argv, env=self._proc_env(job, proc), cwd=app.cwd,
+                stdin=(subprocess.PIPE if want_stdin
+                       else subprocess.DEVNULL),
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                start_new_session=True)
+        except OSError as e:
+            # ≈ odls error-pipe protocol: exec failure surfaces here.
+            proc.state = ProcState.FAILED_TO_START
+            proc.exit_code = 127
+            output.show_help(
+                "launcher", "failed-to-start",
+                rank=proc.rank, argv0=app.argv[0], error=str(e))
+            return False
+        proc.pid = p.pid
+        proc.state = ProcState.RUNNING
+        with self._kill_lock:  # kill_job may iterate concurrently
+            self._popen[proc.rank] = p
+        if want_stdin:
+            from ompi_tpu.runtime.orted import _StdinWriter
+
+            # a respawned rank replaces its dead incarnation's writer —
+            # retire the old one (its pipe is broken anyway) so sinks and
+            # threads don't accumulate per restart
+            old = self._stdin_sinks.pop(proc.rank, None)
+            if old is not None:
+                old.feed(None)
+            self._stdin_sinks[proc.rank] = _StdinWriter(proc.rank, p.stdin)
+        self._start_iof(job, proc, p)
+        return True
+
+    def respawn_proc(self, job: Job, proc: Proc) -> bool:
+        """errmgr/respawn hook: revive a failed rank in place (same rank,
+        same env plus OMPI_TPU_RESTART=<n>).  The running reap loop picks
+        the new child up; the PMIx server counts the rank live again."""
+        proc.restarts += 1
+        proc.exit_code = None
+        if not self._launch_proc(job, proc):
+            return False
+        if self.server is not None:
+            self.server.proc_revived(proc.rank)
+        with self._kill_lock:
+            self._respawned.add(proc.rank)
+        return True
+
+    def _st_launch(self, sm: StateMachine, job: Job) -> JobState:
+        self.server = pmix.PMIxServer(
+            size=job.np, on_abort=lambda r, s, m: self._on_abort(job, r, s, m))
         for proc in job.procs:
-            app = job.apps[proc.app_idx]
-            env = dict(os.environ)
-            env.update(app.env)
-            pypath = env.get("PYTHONPATH", "")
-            if pkg_root not in pypath.split(os.pathsep):
-                env["PYTHONPATH"] = (
-                    pkg_root + (os.pathsep + pypath if pypath else ""))
-            env[pmix.ENV_URI] = self.server.uri
-            env[pmix.ENV_RANK] = str(proc.rank)
-            env[pmix.ENV_SIZE] = str(job.np)
-            env[pmix.ENV_JOBID] = str(job.jobid)
-            env[pmix.ENV_LOCAL_RANK] = str(proc.local_rank)
-            if proc.chip is not None:
-                env[pmix.ENV_CHIP] = str(proc.chip)
-            want_stdin = (self.stdin_target == "all"
-                          or self.stdin_target == str(proc.rank))
-            try:
-                p = subprocess.Popen(
-                    app.argv, env=env, cwd=app.cwd,
-                    stdin=(subprocess.PIPE if want_stdin
-                           else subprocess.DEVNULL),
-                    stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                    start_new_session=True)
-            except OSError as e:
-                # ≈ odls error-pipe protocol: exec failure surfaces here.
-                # Failure to start is fatal regardless of errmgr policy (the
-                # job never assembled), so record the abort and reap whatever
-                # already launched.
-                proc.state = ProcState.FAILED_TO_START
-                proc.exit_code = 127
-                output.show_help(
-                    "launcher", "failed-to-start",
-                    rank=proc.rank, argv0=app.argv[0], error=str(e))
-                self._errmgr.proc_failed(self, job, proc)
+            if not self._launch_proc(job, proc):
+                # Failure to start is fatal regardless of errmgr policy —
+                # the job never assembled, so no policy (not even respawn)
+                # is consulted: record the abort and reap what launched.
                 if job.aborted_proc is None:
                     job.aborted_proc = proc
                     job.abort_reason = f"rank {proc.rank} failed to start"
                 self.kill_job(job, exclude=proc)
                 return JobState.RUNNING  # reap launched ranks, then ABORTED
-            proc.pid = p.pid
-            proc.state = ProcState.RUNNING
-            with self._kill_lock:  # kill_job may iterate concurrently
-                self._popen[proc.rank] = p
-            if want_stdin:
-                from ompi_tpu.runtime.orted import _StdinWriter
-
-                self._stdin_sinks.append(_StdinWriter(proc.rank, p.stdin))
-            self._start_iof(job, proc, p)
         if self._stdin_sinks:
             self._start_stdin_pump()
         return JobState.RUNNING
@@ -159,6 +193,12 @@ class LocalLauncher:
                         self.server.proc_died(rank)
                     self._errmgr.proc_failed(self, job, proc)
                 del pending[rank]
+            # adopt ranks the errmgr revived (≈ rmaps/resilient re-map +
+            # relaunch: same rank, fresh pid, reap continues seamlessly)
+            with self._kill_lock:
+                while self._respawned:
+                    r = self._respawned.pop()
+                    pending[r] = self._popen[r]
             if pending:
                 time.sleep(0.01)
         for t in self._iof_threads:
@@ -203,11 +243,11 @@ class LocalLauncher:
                     chunk = src.read1(1 << 16)
                     if not chunk:
                         break
-                    for w in self._stdin_sinks:
+                    for w in list(self._stdin_sinks.values()):
                         w.feed(chunk)
             except (OSError, ValueError):
                 pass
-            for w in self._stdin_sinks:
+            for w in list(self._stdin_sinks.values()):
                 w.feed(None)  # EOF
 
         threading.Thread(target=pump, daemon=True).start()
@@ -257,6 +297,10 @@ class LocalLauncher:
         """Drive the job to completion; return the job exit code."""
         self.sm.run_to_completion(job, JobState.INIT)
         if job.aborted_proc is not None:
+            from ompi_tpu.runtime.notifier import Severity, notify
+
+            notify(Severity.ERROR, "job-abort",
+                   f"job {job.jobid}: {job.abort_reason or 'unknown'}")
             output.show_help(
                 "launcher", "job-aborted",
                 jobid=job.jobid, reason=job.abort_reason or "unknown")

@@ -36,26 +36,41 @@ class _StdinWriter:
     def __init__(self, rank: int, pipe) -> None:
         self.rank = rank
         self._q: queue.Queue = queue.Queue(maxsize=64)
+        self._eof = threading.Event()  # survives a full queue: EOF is a
         self._t = threading.Thread(target=self._run, args=(pipe,),
                                    daemon=True)
         self._t.start()
 
     def feed(self, chunk: Optional[bytes]) -> None:
+        if chunk is None:
+            # the close sentinel must NEVER be lost (a rank blocked in
+            # read() would wait for EOF forever) — it rides a flag the
+            # writer checks between chunks, not a droppable queue slot
+            self._eof.set()
+            try:
+                self._q.put_nowait(b"")   # wake the writer if it is idle
+            except queue.Full:
+                pass                      # writer is busy; it checks _eof
+            return
         try:
             self._q.put(chunk, timeout=1.0)
         except queue.Full:
             _log.error("stdin to rank %d backed up; dropping %d bytes",
-                       self.rank, 0 if chunk is None else len(chunk))
+                       self.rank, len(chunk))
 
     def _run(self, pipe) -> None:
         while True:
-            chunk = self._q.get()
             try:
-                if chunk is None:
+                chunk = self._q.get(timeout=0.5)
+            except queue.Empty:
+                chunk = b""
+            try:
+                if chunk:
+                    pipe.write(chunk)
+                    pipe.flush()
+                if self._eof.is_set() and self._q.empty():
                     pipe.close()
                     return
-                pipe.write(chunk)
-                pipe.flush()
             except (BrokenPipeError, ValueError, OSError):
                 return
 

@@ -182,6 +182,16 @@ class PMIxServer:
                     self._check_fence_done(epoch)
             self._cv.notify_all()
 
+    def proc_revived(self, rank: int) -> None:
+        """errmgr/respawn notification: the rank is back.  Future fences
+        count it again; its fence-epoch counter restarts (already-completed
+        epochs return immediately, so a restarted rank fast-forwards
+        through barriers the survivors already passed)."""
+        with self._cv:
+            self._dead.discard(rank)
+            self._client_epoch[rank] = 0
+            self._cv.notify_all()
+
     # -- host-side access (launcher uses these directly) ------------------
 
     def lookup(self, key: str, rank: int = -1) -> Any:

@@ -201,6 +201,7 @@ def test_monitor_per_peer_rows_and_matrix():
 def test_monitor_unexpected_vs_matched():
     def body(comm):
         with mon.Monitor(comm.pml, comm.size) as m:
+            comm.barrier()   # both monitors attached before the early send
             if comm.rank == 0:
                 comm.send(np.ones(1), dest=1, tag=3)   # arrives unmatched
                 comm.recv(source=1, tag=4)

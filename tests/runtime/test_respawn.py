@@ -1,0 +1,91 @@
+"""errmgr/respawn: kill a rank mid-run, revive it, recover from its ckpt
+snapshot, and keep talking to it (endpoint rebind) — ≈ the reference's
+errmgr restart paths + rmaps/resilient
+(orte/mca/errmgr/default_hnp/errmgr_default_hnp.c:351-470).
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def tpurun(*args, timeout=120, env_extra=None):
+    env = dict(os.environ)
+    env.pop("OMPI_TPU_RANK", None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    if env_extra:
+        env.update(env_extra)
+    return subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.tpurun", *args],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO)
+
+
+RESPAWN_APP = r"""
+import os, sys
+import numpy as np
+import ompi_tpu
+from ompi_tpu.ckpt.store import SnapshotStore
+
+comm = ompi_tpu.init()
+rank = comm.rank
+store = SnapshotStore(os.environ["CKPT_DIR"], job=f"rank{rank}")
+restarted = int(os.environ.get("OMPI_TPU_RESTART", "0"))
+
+start, acc = 0, 0.0
+if restarted:
+    seq = store.latest()
+    state = store.load_rank(seq, 0)
+    start, acc = int(state["step"]) + 1, float(state["acc"])
+    print(f"rank {rank} resumed at step {start} from snapshot {seq}",
+          flush=True)
+
+for step in range(start, 5):
+    acc += rank * 10 + step
+    store.write_rank(step, 0, {"step": np.int64(step), "acc": np.float64(acc)})
+    store.commit(step, 1)
+    if rank == 1 and not restarted and step == 2:
+        os._exit(9)   # die AFTER committing snapshot 2
+
+# post-restart p2p both ways: revived 1 -> 0, then 0 -> revived 1 over
+# the REBOUND route
+if rank == 1:
+    comm.send(np.array([acc]), dest=0, tag=7)
+    ack = comm.recv(source=0, tag=8)
+    print(f"rank 1 got ack {float(ack[0]):.0f}", flush=True)
+elif rank == 0:
+    peer_acc = comm.recv(source=1, tag=7)
+    comm.send(peer_acc + 1, dest=1, tag=8)
+
+print(f"rank {rank} acc={acc:.0f}", flush=True)
+ompi_tpu.finalize()
+"""
+
+
+def test_respawn_recovers_rank_with_ckpt(tmp_path):
+    r = tpurun("-np", "3", "--mca", "errmgr", "respawn", "--",
+               sys.executable, "-c", RESPAWN_APP,
+               env_extra={"CKPT_DIR": str(tmp_path)})
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    # rank 1 died after step 2, revived, resumed at 3, recomputed nothing
+    assert "rank 1 resumed at step 3 from snapshot 2" in r.stdout
+    # acc for rank 1 = sum(10+s for s in 0..4) = 60; rank 0 = 0+1+2+3+4=10
+    assert "rank 1 acc=60" in r.stdout
+    assert "rank 0 acc=10" in r.stdout
+    assert "rank 2 acc=110" in r.stdout
+    # the rebound 0→1 route delivered the ack (61)
+    assert "rank 1 got ack 61" in r.stdout
+
+
+def test_respawn_exhausted_aborts(tmp_path):
+    prog = ("import os, ompi_tpu\n"
+            "comm = ompi_tpu.init()\n"
+            "os._exit(3) if comm.rank == 1 else None\n"
+            "import time; time.sleep(30)\n")
+    r = tpurun("-np", "2", "--mca", "errmgr", "respawn",
+               "--mca", "errmgr_max_restarts", "1", "--",
+               sys.executable, "-c", prog,
+               env_extra={"CKPT_DIR": str(tmp_path)})
+    assert r.returncode != 0
+    assert "restart" in (r.stdout + r.stderr).lower()
