@@ -7,3 +7,16 @@ serialization (``dss``), and the buffer-location abstraction (``buffer``)
 that threads device/host duality through the whole stack the way the
 reference threads its CUDA convertor flag (opal/datatype/opal_convertor.h:43-59).
 """
+
+import os as _os
+
+__all__ = ["pkg_root"]
+
+
+def pkg_root() -> str:
+    """Directory CONTAINING the ompi_tpu package — what a child process
+    needs on PYTHONPATH to import this framework (≈ plm_rsh prefixing its
+    install dirs, plm_rsh_module.c).  One definition so local and remote
+    launch paths cannot drift."""
+    return _os.path.dirname(_os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))))

@@ -1,0 +1,87 @@
+"""MPIX extensions — the non-standard-but-supported API surface.
+
+≈ ompi/mpiext (the MPIX_ mechanism; its flagship is
+``MPIX_Query_cuda_support`` in ompi/mpiext/cuda): a registry of named
+extensions a program can probe at runtime instead of guessing from
+version strings.  The TPU build's equivalents report on the device data
+plane.
+
+    >>> import ompi_tpu.mpi.mpiext as mpix
+    >>> mpix.query_tpu_support()        # is the XLA device path usable?
+    >>> mpix.extensions()               # {"tpu", "device_heap", ...}
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+__all__ = ["extensions", "has_extension", "register_extension",
+           "query_tpu_support", "query_device_heap_support",
+           "query_sequence_parallel_support"]
+
+_registry: dict[str, Callable[[], bool]] = {}
+
+
+def register_extension(name: str, probe: Callable[[], bool]) -> None:
+    """Register an MPIX extension (≈ dropping a dir under ompi/mpiext)."""
+    _registry[name] = probe
+
+
+def extensions() -> set[str]:
+    """Names of every registered extension (probed or not)."""
+    return set(_registry)
+
+
+def has_extension(name: str) -> bool:
+    """Probe one extension; unknown names are False, probes never raise."""
+    probe = _registry.get(name)
+    if probe is None:
+        return False
+    try:
+        return bool(probe())
+    except Exception:  # noqa: BLE001 — a probe failure means "not usable"
+        return False
+
+
+def query_tpu_support() -> bool:
+    """≈ MPIX_Query_cuda_support, inverted to this build's accelerator:
+    True when jax sees at least one non-CPU device (the coll/xla data
+    plane has somewhere to run)."""
+    return has_extension("tpu")
+
+
+def query_device_heap_support() -> bool:
+    """True when the OSHMEM device symmetric heap (shmem/device.py) can
+    host identically-sharded arrays — i.e. a live device mesh exists."""
+    return has_extension("device_heap")
+
+
+def query_sequence_parallel_support() -> bool:
+    """True when ring/Ulysses sequence-parallel attention is importable
+    (pallas flash kernel or jnp fallback)."""
+    return has_extension("sequence_parallel")
+
+
+def _probe_tpu() -> bool:
+    import jax
+
+    return any(d.platform != "cpu" for d in jax.devices())
+
+
+def _probe_device_heap() -> bool:
+    import jax  # noqa: F401
+
+    from ompi_tpu.shmem import device as _dev  # noqa: F401
+
+    return True
+
+
+def _probe_seq_parallel() -> bool:
+    from ompi_tpu.parallel import attention as _attn  # noqa: F401
+
+    return True
+
+
+register_extension("tpu", _probe_tpu)
+register_extension("device_heap", _probe_device_heap)
+register_extension("sequence_parallel", _probe_seq_parallel)

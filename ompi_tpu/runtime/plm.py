@@ -51,6 +51,9 @@ register_var("plm", "daemon_timeout", VarType.DOUBLE, 30.0,
 register_var("plm", "ssh_args", VarType.STRING,
              "-o BatchMode=yes -o StrictHostKeyChecking=no",
              "extra arguments for the ssh transport")
+register_var("plm", "ssh_python", VarType.STRING, "",
+             "python interpreter to exec on remote hosts (empty = same "
+             "path as the HNP's sys.executable)")
 
 
 def _orted_argv(hnp_uri: str, vpid: int, ndaemons: int,
@@ -92,10 +95,20 @@ class SshPlm(Component):
 
     def spawn_daemons(self, job: Job, hnp_uri: str) -> list[subprocess.Popen]:
         ssh_args = shlex.split(var_registry.get("plm_ssh_args") or "")
+        # ≈ plm_rsh prefixing PATH/LD_LIBRARY_PATH on the remote command
+        # (plm_rsh_module.c): env does NOT travel over ssh, so the remote
+        # python must be told where this framework lives (same-path
+        # assumption for the interpreter itself — shared-filesystem
+        # clusters; override the interpreter via plm_ssh_python).
+        from ompi_tpu.core import pkg_root
+
         procs = []
         for i, node in enumerate(job.nodes):
-            remote = " ".join(shlex.quote(a) for a in _orted_argv(
-                hnp_uri, i + 1, len(job.nodes) + 1))
+            orted = _orted_argv(hnp_uri, i + 1, len(job.nodes) + 1)
+            py = var_registry.get("plm_ssh_python") or orted[0]
+            remote = (f"PYTHONPATH={shlex.quote(pkg_root())}"
+                      "${PYTHONPATH:+:$PYTHONPATH} "
+                      + " ".join(shlex.quote(a) for a in [py, *orted[1:]]))
             argv = ["ssh", *ssh_args, node.name, remote]
             procs.append(subprocess.Popen(
                 argv, env=dict(os.environ), start_new_session=True))

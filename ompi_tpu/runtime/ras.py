@@ -35,10 +35,18 @@ class LocalhostRAS(Component):
 
     def register_params(self) -> None:
         register_var("ras", "localhost_slots", VarType.INT, 0,
-                     "slots on localhost (0 = cpu count)")
+                     "slots on localhost (0 = discovered topology: "
+                     "cpus this process may schedule on)")
 
     def allocate(self, job: Job, **ctx) -> list[Node]:
-        slots = var_registry.get("ras_localhost_slots") or os.cpu_count() or 1
+        slots = var_registry.get("ras_localhost_slots")
+        if not slots:
+            # topology-derived default (≈ hwloc feeding ras): the cpuset
+            # width, not raw cpu count — a containerized launcher sees its
+            # quota, not the whole machine
+            from ompi_tpu.core.hwtopo import discover
+
+            slots = discover().allowed_cpus
         # mpirun-style oversubscription: never under-allocate the job
         slots = max(slots, job.np)
         return [Node(name="localhost", slots=slots)]

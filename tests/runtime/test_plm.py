@@ -204,3 +204,46 @@ def test_ssh_plm_localhost():
         assert "ssh rank 0" in r.stdout and "ssh rank 1" in r.stdout
     finally:
         os.unlink(hf)
+
+
+def _ssh_localhost_ok() -> bool:
+    import shutil
+
+    if shutil.which("ssh") is None:
+        return False
+    try:
+        r = subprocess.run(
+            ["ssh", "-o", "BatchMode=yes", "-o", "StrictHostKeyChecking=no",
+             "-o", "ConnectTimeout=3", "localhost", "true"],
+            capture_output=True, timeout=10)
+        return r.returncode == 0
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+
+
+def test_ssh_plm_localhost_real():
+    """Opt-in real exercise of plm/ssh: 2 ranks over `ssh localhost`
+    (≈ plm_rsh_module.c:697 tree-spawn degenerated to one remote).
+
+    The probe runs INSIDE the test (not in a skipif decorator) so plain
+    collection of this module never pays the multi-second ssh attempt.
+    """
+    if not _ssh_localhost_ok():
+        pytest.skip("passwordless ssh to localhost unavailable")
+    prog = ("import ompi_tpu\n"
+            "comm = ompi_tpu.init()\n"
+            "out = comm.allreduce(__import__('numpy').ones(4))\n"
+            "print(f'rank {comm.rank} ssh-ok {float(out[0]):.0f}')\n"
+            "ompi_tpu.finalize()\n")
+    import os as _os
+    hf = os.path.join(REPO, ".pytest-ssh-hostfile")
+    with open(hf, "w") as f:
+        f.write("localhost\nlocalhost\n")
+    try:
+        r = tpurun("-np", "2", "--plm", "ssh", "--hostfile", hf, "--",
+                   sys.executable, "-c", prog, timeout=90)
+        assert r.returncode == 0, (r.stdout, r.stderr)
+        for rank in range(2):
+            assert f"rank {rank} ssh-ok 2" in r.stdout
+    finally:
+        _os.unlink(hf)

@@ -1,0 +1,39 @@
+"""Clock-sync tool (≈ ompi/tools/mpisync): offsets near zero in-process,
+sane output under tpurun."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+from ompi_tpu.tools.sync import clock_offsets
+from tests.mpi.harness import run_ranks
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def test_clock_offsets_in_process():
+    results = run_ranks(3, lambda comm: clock_offsets(comm, samples=8))
+    offs = results[0]
+    assert results[1] is None and results[2] is None
+    assert set(offs) == {0, 1, 2}
+    for rank, (off, rtt) in offs.items():
+        if rank == 0:
+            assert off == 0.0
+            continue
+        assert rtt > 0
+        # same host, same clock: measured offset bounded by the rtt
+        assert abs(off) <= rtt
+
+
+def test_sync_tool_under_tpurun():
+    env = dict(os.environ)
+    env.pop("OMPI_TPU_RANK", None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-np", "2", "--",
+         sys.executable, "-m", "ompi_tpu.tools.sync", "-n", "4"],
+        capture_output=True, text=True, timeout=90, env=env, cwd=REPO)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "clock offsets vs rank 0" in r.stdout
