@@ -58,6 +58,12 @@ class Stream:
     def error(self, msg: str, *args: object) -> None:
         self._emit("ERROR: " + (msg % args if args else msg))
 
+    def emit(self, msg: str, *args: object) -> None:
+        """Unconditional output (no verbosity gate, no ERROR prefix) — for
+        messages that already passed their own filter (e.g. the notifier's
+        severity threshold)."""
+        self._emit(msg % args if args else msg)
+
     def _emit(self, text: str) -> None:
         rank = os.environ.get("OMPI_TPU_RANK")
         prefix = f"[{self.name}" + (f":{rank}" if rank is not None else "") + "] "

@@ -69,11 +69,13 @@ def _probe_tpu() -> bool:
 
 
 def _probe_device_heap() -> bool:
-    import jax  # noqa: F401
+    # contract: "a live device mesh exists" — the heap needs actual
+    # devices to shard over (CPU meshes included), not mere importability
+    import jax
 
     from ompi_tpu.shmem import device as _dev  # noqa: F401
 
-    return True
+    return len(jax.devices()) >= 1
 
 
 def _probe_seq_parallel() -> bool:

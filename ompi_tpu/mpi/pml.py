@@ -376,6 +376,10 @@ class PmlOb1:
             raise MPIException(
                 f"unknown send mode {mode!r} (standard/sync/ready/buffered)")
         _reject_device(buf, "isend")
+        from ompi_tpu.core import memchecker
+
+        if memchecker.enabled():
+            memchecker.check_send(buf, "isend")
         arr = np.asarray(buf)
         if datatype is None:
             datatype = dt_mod.from_numpy(arr.dtype)
@@ -489,6 +493,10 @@ class PmlOb1:
         if buf is not None:
             _reject_device(buf, "irecv")
             buf = np.asarray(buf)
+            from ompi_tpu.core import memchecker
+
+            if memchecker.enabled():
+                memchecker.prepare_recv(buf, "irecv")
             if datatype is None:
                 datatype = dt_mod.from_numpy(buf.dtype)
             if count is None:

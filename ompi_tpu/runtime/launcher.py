@@ -107,13 +107,16 @@ class LocalLauncher:
         app = job.apps[proc.app_idx]
         want_stdin = (self.stdin_target == "all"
                       or self.stdin_target == str(proc.rank))
+        from ompi_tpu.runtime.rtc import bind_hook
+
         try:
             p = subprocess.Popen(
                 app.argv, env=self._proc_env(job, proc), cwd=app.cwd,
                 stdin=(subprocess.PIPE if want_stdin
                        else subprocess.DEVNULL),
                 stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                start_new_session=True)
+                start_new_session=True,
+                preexec_fn=bind_hook(proc.local_rank))
         except OSError as e:
             # ≈ odls error-pipe protocol: exec failure surfaces here.
             proc.state = ProcState.FAILED_TO_START

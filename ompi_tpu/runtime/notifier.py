@@ -49,10 +49,13 @@ class LogNotifier(Component):
     PRIORITY = 10
 
     def notify(self, severity: Severity, event: str, detail: str) -> None:
+        # the severity threshold already filtered — everything arriving
+        # here must be VISIBLE (a verbosity gate on top would hide the
+        # 'admin must see this' events the framework exists for)
         if severity >= Severity.ERROR:
             _log.error("[%s] %s: %s", severity.name, event, detail)
         else:
-            _log.verbose(1, "[%s] %s: %s", severity.name, event, detail)
+            _log.emit("[%s] %s: %s", severity.name, event, detail)
 
 
 @notifier_framework.component

@@ -149,15 +149,17 @@ class Orted:
             if vpid == self.vpid:
                 mine = rows
                 break
-        pkg_root = os.path.dirname(os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__))))
+        from ompi_tpu.core import pkg_root as _pkg_root
+        from ompi_tpu.runtime.rtc import bind_hook
+
+        root = _pkg_root()
         for rank, local_rank, chip in mine:
             env = dict(os.environ)
             env.update(spec["env"])
             pypath = env.get("PYTHONPATH", "")
-            if pkg_root not in pypath.split(os.pathsep):
+            if root not in pypath.split(os.pathsep):
                 env["PYTHONPATH"] = (
-                    pkg_root + (os.pathsep + pypath if pypath else ""))
+                    root + (os.pathsep + pypath if pypath else ""))
             env[pmix.ENV_RANK] = str(rank)
             env[pmix.ENV_LOCAL_RANK] = str(local_rank)
             if chip is not None:
@@ -171,7 +173,8 @@ class Orted:
                     stdin=subprocess.PIPE if want_stdin
                     else subprocess.DEVNULL,
                     stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                    start_new_session=True)
+                    start_new_session=True,
+                    preexec_fn=bind_hook(local_rank))
             except OSError as e:
                 # ≈ odls error-pipe: report the exec failure as an exit
                 self.node.send_up(rml.TAG_PROC_EXIT, (rank, 127, str(e)))

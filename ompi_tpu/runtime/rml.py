@@ -54,12 +54,17 @@ TAG_DAEMON_READY = "ready"      # up: daemon wired + children connected
 
 
 def tree_parent(vpid: int) -> Optional[int]:
-    """Binary routing tree over vpids 0..N (0 = HNP)."""
-    return None if vpid == 0 else (vpid - 1) // 2
+    """Binary routing tree over vpids 0..N (0 = HNP) — the k=2 case of
+    the shared netpatterns k-ary tree (≈ routed/binomial's role)."""
+    from ompi_tpu.core.netpatterns import kary_parent
+
+    return kary_parent(vpid, k=2)
 
 def tree_children(vpid: int, n: int) -> list[int]:
     """Children of ``vpid`` among vpids 0..n-1."""
-    return [c for c in (2 * vpid + 1, 2 * vpid + 2) if c < n]
+    from ompi_tpu.core.netpatterns import kary_children
+
+    return kary_children(vpid, n, k=2)
 
 
 class _Link:

@@ -1,0 +1,56 @@
+"""RTC — runtime control: apply CPU binding to launched ranks.
+
+≈ orte/mca/rtc/hwloc: the reference's rtc framework applies the binding
+rmaps computed (cpuset per rank) at fork time.  Here the policy is
+``--mca rtc_bind core|none`` (default none — oversubscribed test rigs and
+single-core hosts must not serialize on one cpu): with ``core``, rank r
+on a host is pinned to allowed-cpu ``local_rank mod n_allowed`` via
+``sched_setaffinity`` in the child before exec, exactly the
+one-core-per-rank default ``mpirun --bind-to core`` applies.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional
+
+from ompi_tpu.core import output
+from ompi_tpu.core.config import VarType, register_var, var_registry
+
+__all__ = ["bind_hook"]
+
+_log = output.get_stream("rtc")
+
+register_var("rtc", "bind", VarType.STRING, "none",
+             "cpu binding applied to launched ranks: none | core "
+             "(rank pinned to one allowed cpu, round-robin)",
+             enumerator=("none", "core"))
+
+
+def bind_hook(local_rank: int) -> Optional[Callable[[], None]]:
+    """A ``preexec_fn`` pinning the child to one cpu, or None when binding
+    is off/unsupported.  Runs in the forked child before exec (the same
+    window the reference's odls applies rtc bindings in,
+    odls_default_module.c:47-56)."""
+    if var_registry.get("rtc_bind") != "core":
+        return None
+    if not hasattr(os, "sched_setaffinity"):
+        return None
+    try:
+        allowed = sorted(os.sched_getaffinity(0))
+    except OSError:
+        return None
+    if len(allowed) < 2:
+        # one schedulable cpu: pinning is a no-op that only removes the
+        # scheduler's freedom — skip, like the reference's overload check
+        return None
+    cpu = allowed[local_rank % len(allowed)]
+
+    def _apply() -> None:  # pragma: no cover — runs post-fork, pre-exec
+        try:
+            os.sched_setaffinity(0, {cpu})
+        except OSError:
+            pass
+
+    _log.verbose(1, "rtc: local rank %d → cpu %d", local_rank, cpu)
+    return _apply

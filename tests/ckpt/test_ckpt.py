@@ -328,3 +328,77 @@ def test_msglog_failed_send_not_logged():
             log.detach()
 
     assert run_ranks(2, body)[0] == 0
+
+
+def test_event_log_records_wildcard_order():
+    from ompi_tpu.ckpt.msglog import EventLog
+    from ompi_tpu.mpi.constants import ANY_SOURCE, ANY_TAG
+
+    def body(comm):
+        if comm.rank == 0:
+            with EventLog(comm) as ev:
+                a = comm.recv(source=ANY_SOURCE, tag=ANY_TAG)   # ANY_SOURCE/ANY_TAG
+                b = comm.recv(source=ANY_SOURCE, tag=ANY_TAG)
+                order = ev.events()
+            assert len(order) == 2
+            assert {o[0] for o in order} == {1, 2}
+            # recorded order matches payload arrival order
+            assert int(a[0]) == order[0][0] and int(b[0]) == order[1][0]
+            return order
+        else:
+            import time
+            time.sleep(0.02 * comm.rank)           # stagger arrivals
+            comm.send(np.array([comm.rank]), dest=0, tag=comm.rank)
+        return None
+
+    from tests.mpi.harness import run_ranks
+    order = run_ranks(3, body)[0]
+    assert order is not None
+
+
+def test_event_log_replay_forces_recorded_order():
+    from ompi_tpu.ckpt.msglog import EventLog
+    from ompi_tpu.mpi.constants import ANY_SOURCE, ANY_TAG
+
+    recorded = [(2, 7), (1, 7)]                    # 2 first, then 1
+
+    def body(comm):
+        if comm.rank == 0:
+            with EventLog(comm, replay=recorded) as ev:
+                assert ev.replaying
+                a = comm.recv(source=ANY_SOURCE, tag=ANY_TAG)   # rewritten → (2, 7)
+                b = comm.recv(source=ANY_SOURCE, tag=ANY_TAG)   # rewritten → (1, 7)
+                assert not ev.replaying
+            # forced order 2-then-1 even though rank 1 sent FIRST
+            return int(a[0]), int(b[0])
+        else:
+            import time
+            if comm.rank == 2:
+                time.sleep(0.05)                   # 1 races ahead of 2
+            comm.send(np.array([comm.rank]), dest=0, tag=7)
+        return None
+
+    from tests.mpi.harness import run_ranks
+    assert run_ranks(3, body)[0] == (2, 1)
+
+
+def test_event_log_incomplete_history_raises():
+    from ompi_tpu.ckpt.msglog import EventLog
+    from ompi_tpu.mpi.constants import ANY_SOURCE, ANY_TAG, MPIException
+
+    def body(comm):
+        if comm.rank == 0:
+            ev = EventLog(comm).attach()
+            req = comm.irecv(source=ANY_SOURCE, tag=ANY_TAG)    # never completes yet
+            try:
+                with pytest.raises(MPIException):
+                    ev.events()
+            finally:
+                comm.send(np.array([0.0]), dest=0, tag=3)  # self-satisfy
+                req.wait()
+                ev.detach()
+        comm.barrier()
+        return True
+
+    from tests.mpi.harness import run_ranks
+    assert all(run_ranks(2, body))

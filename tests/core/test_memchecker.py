@@ -1,0 +1,58 @@
+"""Memchecker: buffer-validity checks at PML boundaries
+(≈ opal/mca/memchecker/valgrind annotations, SURVEY.md §5)."""
+
+import numpy as np
+import pytest
+
+from ompi_tpu.core.config import var_registry
+from ompi_tpu.core.memchecker import (MemcheckError, check_send, enabled,
+                                      prepare_recv)
+from tests.mpi.harness import run_ranks
+
+
+@pytest.fixture
+def memcheck_on():
+    var_registry.set("memchecker_enable", True)
+    yield
+    var_registry.set("memchecker_enable", False)
+
+
+def test_disabled_by_default():
+    assert not enabled()
+
+
+def test_nan_send_rejected(memcheck_on):
+    with pytest.raises(MemcheckError):
+        check_send(np.array([1.0, np.nan]))
+    check_send(np.array([1.0, 2.0]))          # clean floats pass
+    check_send(np.array([1, 2], np.int32))    # ints never NaN-scan
+
+
+def test_readonly_recv_rejected(memcheck_on):
+    buf = np.zeros(4)
+    buf.flags.writeable = False
+    with pytest.raises(MemcheckError):
+        prepare_recv(buf)
+
+
+def test_recv_poisoned(memcheck_on):
+    f = np.zeros(4)
+    prepare_recv(f)
+    assert np.isnan(f).all()
+    i = np.zeros(4, np.int32)
+    prepare_recv(i)
+    assert (i.view(np.uint8) == 0xCC).all()
+
+
+def test_end_to_end_via_pml(memcheck_on):
+    def body(comm):
+        if comm.rank == 0:
+            with pytest.raises(Exception):
+                comm.send(np.array([np.nan]), dest=1, tag=1)
+            comm.send(np.array([1.0]), dest=1, tag=2)   # clean send works
+        else:
+            got = comm.recv(source=0, tag=2)
+            assert float(got[0]) == 1.0
+        return True
+
+    assert all(run_ranks(2, body))

@@ -1,0 +1,73 @@
+"""netpatterns: shared comm-topology helpers (≈ ompi/patterns/net)."""
+
+import pytest
+
+from ompi_tpu.core.netpatterns import (binomial_children, binomial_parent,
+                                       bruck_peers, kary_children,
+                                       kary_parent, recursive_doubling_peers,
+                                       tree_depth)
+
+
+@pytest.mark.parametrize("k", [1, 2, 3, 4])
+@pytest.mark.parametrize("n", [1, 2, 5, 16, 33])
+def test_kary_tree_consistent(n, k):
+    # every non-root has exactly one parent, and parent/child agree
+    seen = set()
+    for r in range(n):
+        p = kary_parent(r, k)
+        if r == 0:
+            assert p is None
+        else:
+            assert 0 <= p < r
+            assert r in kary_children(p, n, k)
+        for c in kary_children(r, n, k):
+            assert c not in seen
+            seen.add(c)
+    assert seen == set(range(1, n))
+
+
+@pytest.mark.parametrize("n", [1, 2, 7, 8, 20])
+def test_binomial_tree_consistent(n):
+    seen = set()
+    for r in range(n):
+        p = binomial_parent(r)
+        if r == 0:
+            assert p is None
+        else:
+            assert p == r & (r - 1)
+            assert r in binomial_children(p, n)
+        for c in binomial_children(r, n):
+            assert c not in seen
+            seen.add(c)
+    assert seen == set(range(1, n))
+
+
+def test_binomial_known_shape():
+    assert binomial_children(0, 8) == [1, 2, 4]
+    assert binomial_children(4, 8) == [5, 6]
+    assert binomial_children(6, 8) == [7]
+    assert binomial_children(1, 8) == []
+
+
+def test_recursive_doubling_and_bruck():
+    assert recursive_doubling_peers(0, 8) == [1, 2, 4]
+    assert recursive_doubling_peers(5, 8) == [4, 7, 1]
+    # bruck rounds: log2-many (send, recv) pairs, distinct distances
+    rounds = bruck_peers(3, 8)
+    assert rounds == [(2, 4), (1, 5), ((3 - 4) % 8, 7)]
+
+
+def test_tree_depth():
+    assert tree_depth(1) == 0
+    assert tree_depth(3, 2) == 1
+    assert tree_depth(7, 2) == 2
+    assert tree_depth(8, 2) == 3
+    assert tree_depth(13, 3) == 2
+
+
+def test_rml_tree_rides_netpatterns():
+    from ompi_tpu.runtime.rml import tree_children, tree_parent
+
+    assert tree_parent(0) is None
+    assert tree_parent(5) == 2
+    assert tree_children(1, 6) == [3, 4]

@@ -129,3 +129,22 @@ def test_ras_localhost_uses_topology():
     job = Job([AppContext(argv=["true"], np=1)])
     ras.allocate(job)
     assert job.nodes[0].slots >= max(1, discover().allowed_cpus)
+
+
+def test_rtc_bind_hook():
+    import os
+
+    from ompi_tpu.core.config import var_registry
+    from ompi_tpu.runtime.rtc import bind_hook
+
+    assert bind_hook(0) is None          # default: none
+    var_registry.set("rtc_bind", "core")
+    try:
+        hook = bind_hook(1)
+        allowed = sorted(os.sched_getaffinity(0))
+        if len(allowed) < 2:
+            assert hook is None          # single-cpu host: no-op
+        else:
+            assert callable(hook)
+    finally:
+        var_registry.set("rtc_bind", "none")
