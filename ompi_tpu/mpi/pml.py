@@ -297,6 +297,12 @@ class PmlOb1:
         self._peer_epoch: dict[int, int] = {}   # what I stamp TOWARD peer
         self._peer_inc: dict[int, int] = {}     # peer's own incarnation
         self._reannounce_at: dict[int, float] = {}  # rate-limited heal
+        # memchecker gate read ONCE (off-by-default debug feature — the
+        # hot path must not pay a registry lookup per message; toggle it
+        # before creating communicators, like the reference's build flag)
+        from ompi_tpu.core import memchecker
+
+        self._memcheck = memchecker.enabled()
         self._sendq: "queue.Queue[Optional[tuple]]" = queue.Queue()
         self._listeners: list = []   # peruse/monitoring subscribers
         self._events: "collections.deque[tuple]" = collections.deque()
@@ -376,9 +382,9 @@ class PmlOb1:
             raise MPIException(
                 f"unknown send mode {mode!r} (standard/sync/ready/buffered)")
         _reject_device(buf, "isend")
-        from ompi_tpu.core import memchecker
+        if self._memcheck:
+            from ompi_tpu.core import memchecker
 
-        if memchecker.enabled():
             memchecker.check_send(buf, "isend")
         arr = np.asarray(buf)
         if datatype is None:
@@ -493,9 +499,9 @@ class PmlOb1:
         if buf is not None:
             _reject_device(buf, "irecv")
             buf = np.asarray(buf)
-            from ompi_tpu.core import memchecker
+            if self._memcheck:
+                from ompi_tpu.core import memchecker
 
-            if memchecker.enabled():
                 memchecker.prepare_recv(buf, "irecv")
             if datatype is None:
                 datatype = dt_mod.from_numpy(buf.dtype)

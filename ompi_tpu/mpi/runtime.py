@@ -57,10 +57,19 @@ def init() -> Communicator:
         # while no JAX backend is live yet (≈ the modex feeding transport
         # bring-up, pmix.h:384-407). MPI itself works without it, so a
         # bootstrap failure degrades to host-only with a warning.
+        # A RESPAWNED rank must NOT rejoin: the coordination service does
+        # not accept a process id reconnecting with a new incarnation —
+        # the attempt crashes the coordinator's host process (taking rank
+        # 0 down with it).  The revived rank runs host-only; the device
+        # plane heals at the next job (or full-job restart from ckpt).
         from ompi_tpu.core.config import var_registry as _vars
         from ompi_tpu.parallel import multihost
 
-        if multihost.is_multihost_env() and _vars.get("multihost_auto_init"):
+        if os.environ.get("OMPI_TPU_RESTART"):
+            if multihost.is_multihost_env():
+                _log.verbose(1, "respawned rank: skipping jax.distributed "
+                             "rejoin (device plane host-only this life)")
+        elif multihost.is_multihost_env() and _vars.get("multihost_auto_init"):
             try:
                 multihost.initialize_from_env()
             except Exception as e:  # pragma: no cover - env-dependent
@@ -119,6 +128,17 @@ def finalize(_collective: bool = True) -> None:
             return
         from ompi_tpu.parallel import multihost
 
+        # a respawn anywhere in the job means one coordination-service
+        # task never rejoined — the synchronized shutdown would hang.
+        # Evaluated AFTER the final barrier: the barrier itself is the
+        # traffic that delivers a revived peer's incarnation stamp, so an
+        # earlier read could split the ranks between graceful/skip paths.
+        pml = _state["pml"]
+
+        def respawned_job() -> bool:
+            return bool(getattr(pml, "incarnation", 0)
+                        or any(getattr(pml, "_peer_inc", {}).values()))
+
         try:
             if world.size > 1 and _collective:
                 world.barrier()
@@ -127,9 +147,10 @@ def finalize(_collective: bool = True) -> None:
                 # across tasks internally, so all ranks must call it
                 # concurrently — staggering it (workers first, then the
                 # coordinator) deadlocks against that internal barrier.
-                multihost.shutdown()
+                multihost.shutdown(graceful=not respawned_job())
         finally:
-            multihost.shutdown()  # no-op if already left; atexit path
+            # no-op if already left; atexit path
+            multihost.shutdown(graceful=not respawned_job())
             if _state["pml"] is not None:
                 _state["pml"].close()
             client = _state["client"]

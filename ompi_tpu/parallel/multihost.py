@@ -101,13 +101,23 @@ def is_initialized() -> bool:
     return _state["initialized"]
 
 
-def shutdown() -> None:
+def shutdown(graceful: bool = True) -> None:
     """Leave the coordination service (call after the final barrier, so
-    every rank disconnects before rank 0's coordinator goes away)."""
+    every rank disconnects before rank 0's coordinator goes away).
+
+    ``graceful=False`` skips the synchronized jax.distributed.shutdown —
+    required when a rank was respawned mid-job: its coordination-service
+    task never rejoined (a new incarnation is rejected), so the shutdown
+    barrier would wait on it forever.  Process exit reclaims everything.
+    """
     with _lock:
         if not _state["initialized"]:
             return
         _state["initialized"] = False
+    if not graceful:
+        _log.verbose(1, "multihost: skipping synchronized shutdown "
+                     "(respawned rank in the job)")
+        return
     try:
         import jax
 

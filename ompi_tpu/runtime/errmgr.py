@@ -12,7 +12,15 @@ job).  Components decide what a proc-failure event does:
   paths): same rank and env plus ``OMPI_TPU_RESTART=<n>`` so the app can
   restore from its last ``ckpt`` snapshot (+ msglog replay for in-flight
   p2p) instead of recomputing from step 0.  Select with
-  ``--mca errmgr respawn``.
+  ``--mca errmgr respawn``.  Works in both launchers (local fork/exec and
+  the orted daemon tree via TAG_RESPAWN).
+
+  Scope: respawn is a HOST-plane recovery.  A job using the multi-host
+  DEVICE plane (jax.distributed) cannot revive a member in place — the
+  coordination service rejects a reconnecting incarnation and its
+  heartbeat failure poisons every surviving task — so device-plane jobs
+  recover by full-job restart from the ``ckpt`` snapshots (run respawn
+  jobs with ``--mca multihost_auto_init 0``).
 """
 
 from __future__ import annotations

@@ -107,7 +107,7 @@ class LocalLauncher:
         app = job.apps[proc.app_idx]
         want_stdin = (self.stdin_target == "all"
                       or self.stdin_target == str(proc.rank))
-        from ompi_tpu.runtime.rtc import bind_hook
+        from ompi_tpu.runtime.rtc import bind_child
 
         try:
             p = subprocess.Popen(
@@ -115,8 +115,7 @@ class LocalLauncher:
                 stdin=(subprocess.PIPE if want_stdin
                        else subprocess.DEVNULL),
                 stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                start_new_session=True,
-                preexec_fn=bind_hook(proc.local_rank))
+                start_new_session=True)
         except OSError as e:
             # ≈ odls error-pipe protocol: exec failure surfaces here.
             proc.state = ProcState.FAILED_TO_START
@@ -127,6 +126,7 @@ class LocalLauncher:
             return False
         proc.pid = p.pid
         proc.state = ProcState.RUNNING
+        bind_child(p.pid, proc.local_rank)
         with self._kill_lock:  # kill_job may iterate concurrently
             self._popen[proc.rank] = p
         if want_stdin:

@@ -94,6 +94,9 @@ class Orted:
         self.node.register_recv(rml.TAG_LAUNCH, self._on_launch)
         self.node.register_recv(rml.TAG_KILL, self._on_kill)
         self.node.register_recv(rml.TAG_STDIN, self._on_stdin)
+        self.node.register_recv(rml.TAG_RESPAWN, self._on_respawn)
+        self._spec: Optional[dict] = None
+        self._my_rows: dict[int, tuple[int, Optional[int]]] = {}
         self.node.register_recv(rml.TAG_SHUTDOWN,
                                 lambda o, p: self._done.set())
         # lifeline: if the HNP or my tree parent vanishes, my ranks'
@@ -143,49 +146,62 @@ class Orted:
         threading.Thread(target=self._launch_local, args=(payload,),
                          daemon=True).start()
 
+    def _spawn_rank(self, spec: dict, rank: int, local_rank: int,
+                    chip, restarts: int = 0) -> None:
+        """Fork/exec one rank (first launch or TAG_RESPAWN revival)."""
+        from ompi_tpu.core import pkg_root as _pkg_root
+        from ompi_tpu.runtime.rtc import bind_child
+
+        root = _pkg_root()
+        env = dict(os.environ)
+        env.update(spec["env"])
+        pypath = env.get("PYTHONPATH", "")
+        if root not in pypath.split(os.pathsep):
+            env["PYTHONPATH"] = (
+                root + (os.pathsep + pypath if pypath else ""))
+        env[pmix.ENV_RANK] = str(rank)
+        env[pmix.ENV_LOCAL_RANK] = str(local_rank)
+        if chip is not None:
+            env[pmix.ENV_CHIP] = str(chip)
+        if self.fake_host:
+            env["OMPI_TPU_FAKE_HOST"] = self.fake_host
+        if restarts:
+            env["OMPI_TPU_RESTART"] = str(restarts)
+        want_stdin = spec.get("stdin_rank") in ("all", rank)
+        try:
+            p = subprocess.Popen(
+                spec["argv"], env=env, cwd=spec.get("cwd"),
+                stdin=subprocess.PIPE if want_stdin
+                else subprocess.DEVNULL,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                start_new_session=True)
+        except OSError as e:
+            # ≈ odls error-pipe: report the exec failure as an exit
+            self.node.send_up(rml.TAG_PROC_EXIT, (rank, 127, str(e)))
+            return
+        bind_child(p.pid, local_rank)
+        with self._lock:
+            self._popen[rank] = p
+            if want_stdin:
+                old = self._stdin_writers.pop(rank, None)
+                if old is not None:
+                    old.feed(None)
+                self._stdin_writers[rank] = _StdinWriter(rank, p.stdin)
+        self._start_iof(rank, p)
+        threading.Thread(target=self._waiter, args=(rank, p),
+                         daemon=True).start()
+
     def _launch_local(self, spec: dict) -> None:
         mine: list = []
         for vpid, rows in spec["by_daemon"]:
             if vpid == self.vpid:
                 mine = rows
                 break
-        from ompi_tpu.core import pkg_root as _pkg_root
-        from ompi_tpu.runtime.rtc import bind_hook
-
-        root = _pkg_root()
+        with self._lock:
+            self._spec = spec
+            self._my_rows = {r: (lr, ch) for r, lr, ch in mine}
         for rank, local_rank, chip in mine:
-            env = dict(os.environ)
-            env.update(spec["env"])
-            pypath = env.get("PYTHONPATH", "")
-            if root not in pypath.split(os.pathsep):
-                env["PYTHONPATH"] = (
-                    root + (os.pathsep + pypath if pypath else ""))
-            env[pmix.ENV_RANK] = str(rank)
-            env[pmix.ENV_LOCAL_RANK] = str(local_rank)
-            if chip is not None:
-                env[pmix.ENV_CHIP] = str(chip)
-            if self.fake_host:
-                env["OMPI_TPU_FAKE_HOST"] = self.fake_host
-            want_stdin = spec.get("stdin_rank") in ("all", rank)
-            try:
-                p = subprocess.Popen(
-                    spec["argv"], env=env, cwd=spec.get("cwd"),
-                    stdin=subprocess.PIPE if want_stdin
-                    else subprocess.DEVNULL,
-                    stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                    start_new_session=True,
-                    preexec_fn=bind_hook(local_rank))
-            except OSError as e:
-                # ≈ odls error-pipe: report the exec failure as an exit
-                self.node.send_up(rml.TAG_PROC_EXIT, (rank, 127, str(e)))
-                continue
-            with self._lock:
-                self._popen[rank] = p
-                if want_stdin:
-                    self._stdin_writers[rank] = _StdinWriter(rank, p.stdin)
-            self._start_iof(rank, p)
-            threading.Thread(target=self._waiter, args=(rank, p),
-                             daemon=True).start()
+            self._spawn_rank(spec, rank, local_rank, chip)
         # replay stdin that raced ahead of the launch xcast.  The replay
         # must happen under the lock that gates _launched: otherwise a
         # chunk arriving on the RML thread right after the flag flips
@@ -245,6 +261,23 @@ class Orted:
                     os.killpg(p.pid, signal.SIGKILL)
                 except (ProcessLookupError, PermissionError):
                     pass
+
+    def _on_respawn(self, origin: int, payload) -> None:
+        """errmgr/respawn xcast: the daemon owning the rank revives it
+        (≈ the odls relaunch arm of the errmgr restart path)."""
+        rank, restarts = payload
+        with self._lock:
+            row = self._my_rows.get(rank)
+            spec = self._spec
+        if row is None or spec is None:
+            return  # another daemon's rank
+        local_rank, chip = row
+        _log.verbose(1, "orted %d: respawning rank %d (restart %d)",
+                     self.vpid, rank, restarts)
+        # spawn off the RML reader thread (fork/exec + iof setup)
+        threading.Thread(
+            target=self._spawn_rank, args=(spec, rank, local_rank, chip),
+            kwargs={"restarts": restarts}, daemon=True).start()
 
     def _on_stdin(self, origin: int, payload) -> None:
         # Runs on the RML link reader thread: never write the pipe here —

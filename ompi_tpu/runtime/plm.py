@@ -307,6 +307,26 @@ class MultiHostLauncher:
         sink.write(line)
         sink.flush()
 
+    def respawn_proc(self, job: Job, proc) -> bool:
+        """errmgr/respawn hook for the daemon tree: xcast a revival order;
+        the daemon owning the rank relaunches it with OMPI_TPU_RESTART.
+        Spawn failure on the daemon surfaces as another TAG_PROC_EXIT
+        (exit 127), which re-enters the errmgr until restarts exhaust."""
+        proc.restarts += 1
+        try:
+            self.rml.xcast(rml.TAG_RESPAWN, (proc.rank, proc.restarts))
+        except Exception as e:  # noqa: BLE001 — tree may be tearing down
+            _log.error("respawn xcast for rank %d failed: %r", proc.rank, e)
+            return False
+        # only a successful revival order flips the state — a failed xcast
+        # must leave ABORTED so _on_proc_exit records the exit (the job
+        # would otherwise wait forever on a rank nobody revived)
+        proc.exit_code = None
+        proc.state = ProcState.RUNNING
+        if self.server is not None:
+            self.server.proc_revived(proc.rank)
+        return True
+
     def _on_proc_exit(self, job: Job, payload) -> None:
         rank, rc, errmsg = payload
         proc = job.procs[rank]
@@ -321,6 +341,8 @@ class MultiHostLauncher:
             if self.server is not None:
                 self.server.proc_died(rank)
             self._errmgr.proc_failed(self, job, proc)
+            if proc.state == ProcState.RUNNING:
+                return  # errmgr revived the rank; its exit is yet to come
         with self._cv:
             self._exited[rank] = rc
             self._cv.notify_all()

@@ -51,6 +51,7 @@ TAG_IOF = "iof"                 # up: (rank, stream, chunk)
 TAG_STDIN = "stdin"             # xcast: (target_rank, chunk | None=EOF)
 TAG_PROC_EXIT = "proc_exit"     # up: (rank, exit_code)
 TAG_DAEMON_READY = "ready"      # up: daemon wired + children connected
+TAG_RESPAWN = "respawn"         # xcast: (rank, restarts) — owner revives
 
 
 def tree_parent(vpid: int) -> Optional[int]:

@@ -17,7 +17,7 @@ from typing import Callable, Optional
 from ompi_tpu.core import output
 from ompi_tpu.core.config import VarType, register_var, var_registry
 
-__all__ = ["bind_hook"]
+__all__ = ["bind_child"]
 
 _log = output.get_stream("rtc")
 
@@ -27,11 +27,15 @@ register_var("rtc", "bind", VarType.STRING, "none",
              enumerator=("none", "core"))
 
 
-def bind_hook(local_rank: int) -> Optional[Callable[[], None]]:
-    """A ``preexec_fn`` pinning the child to one cpu, or None when binding
-    is off/unsupported.  Runs in the forked child before exec (the same
-    window the reference's odls applies rtc bindings in,
-    odls_default_module.c:47-56)."""
+def bind_child(pid: int, local_rank: int) -> Optional[int]:
+    """Pin a freshly-spawned child to one allowed cpu; returns the cpu or
+    None when binding is off/unsupported/pointless.
+
+    Applied from the PARENT right after Popen (affinity survives exec) —
+    NOT via preexec_fn, which is fork-unsafe in the multithreaded
+    launcher/orted (inherited locks can deadlock the child) and disables
+    the posix_spawn fast path.  Same effect as the reference's rtc/hwloc
+    binding applied in the odls fork window."""
     if var_registry.get("rtc_bind") != "core":
         return None
     if not hasattr(os, "sched_setaffinity"):
@@ -45,12 +49,11 @@ def bind_hook(local_rank: int) -> Optional[Callable[[], None]]:
         # scheduler's freedom — skip, like the reference's overload check
         return None
     cpu = allowed[local_rank % len(allowed)]
-
-    def _apply() -> None:  # pragma: no cover — runs post-fork, pre-exec
-        try:
-            os.sched_setaffinity(0, {cpu})
-        except OSError:
-            pass
-
-    _log.verbose(1, "rtc: local rank %d → cpu %d", local_rank, cpu)
-    return _apply
+    try:
+        os.sched_setaffinity(pid, {cpu})
+    except OSError as e:
+        _log.verbose(1, "rtc: binding pid %d failed: %r", pid, e)
+        return None
+    _log.verbose(1, "rtc: local rank %d (pid %d) → cpu %d",
+                 local_rank, pid, cpu)
+    return cpu

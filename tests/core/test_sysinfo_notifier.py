@@ -131,20 +131,22 @@ def test_ras_localhost_uses_topology():
     assert job.nodes[0].slots >= max(1, discover().allowed_cpus)
 
 
-def test_rtc_bind_hook():
+def test_rtc_bind_child():
     import os
 
     from ompi_tpu.core.config import var_registry
-    from ompi_tpu.runtime.rtc import bind_hook
+    from ompi_tpu.runtime.rtc import bind_child
 
-    assert bind_hook(0) is None          # default: none
+    assert bind_child(os.getpid(), 0) is None     # default: none
     var_registry.set("rtc_bind", "core")
     try:
-        hook = bind_hook(1)
         allowed = sorted(os.sched_getaffinity(0))
+        cpu = bind_child(os.getpid(), 1)
         if len(allowed) < 2:
-            assert hook is None          # single-cpu host: no-op
+            assert cpu is None            # single-cpu host: no-op
         else:
-            assert callable(hook)
+            assert cpu == allowed[1 % len(allowed)]
+            assert os.sched_getaffinity(0) == {cpu}
+            os.sched_setaffinity(0, set(allowed))  # restore
     finally:
         var_registry.set("rtc_bind", "none")

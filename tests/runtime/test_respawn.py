@@ -89,3 +89,24 @@ def test_respawn_exhausted_aborts(tmp_path):
                env_extra={"CKPT_DIR": str(tmp_path)})
     assert r.returncode != 0
     assert "restart" in (r.stdout + r.stderr).lower()
+
+
+def test_respawn_across_daemon_tree(tmp_path):
+    """Multi-host (sim) respawn: the daemon owning the failed rank revives
+    it; the job completes with snapshot recovery.
+
+    The device plane is off (multihost_auto_init 0): respawn is a
+    HOST-plane feature — a jax.distributed member that dies poisons the
+    coordination service for every surviving task (heartbeat timeout
+    kills them), so device-plane jobs recover by full-job restart from
+    ckpt instead (runtime.init docs).
+    """
+    r = tpurun("-np", "3", "--plm", "sim", "--hosts", "2",
+               "--mca", "errmgr", "respawn",
+               "--mca", "multihost_auto_init", "0", "--",
+               sys.executable, "-c", RESPAWN_APP,
+               env_extra={"CKPT_DIR": str(tmp_path)})
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "rank 1 resumed at step 3 from snapshot 2" in r.stdout
+    assert "rank 1 acc=60" in r.stdout
+    assert "rank 1 got ack 61" in r.stdout
