@@ -409,12 +409,15 @@ class BtlEndpoint:
             return True
         if self.shm_btl is not None and (peer in self._shm_ok
                                          or self._shm_route(peer)):
-            from ompi_tpu.mpi.btl_shm import FrameTooBig
+            from ompi_tpu.mpi.btl_shm import FrameTooBig, PeerDeadError
 
             try:
                 return self.shm_btl.try_send(peer, header, payload)
             except FrameTooBig:
                 return False   # worker path reroutes oversize over tcp
+            except PeerDeadError:
+                self._drop_shm(peer)
+                return False   # worker path surfaces/retries it
         return False
 
     def send(self, peer: int, header: dict, payload: bytes = b"") -> None:
@@ -429,13 +432,21 @@ class BtlEndpoint:
         if self.shm_btl is not None:
             # steady state: one set lookup, then straight into the ring
             if peer in self._shm_ok or self._shm_route(peer):
-                from ompi_tpu.mpi.btl_shm import FrameTooBig
+                from ompi_tpu.mpi.btl_shm import FrameTooBig, PeerDeadError
 
                 try:
                     self.shm_btl.send(peer, header, payload)
                     return
                 except FrameTooBig as e:
                     oversize = e   # oversize frame rides tcp; PML reorders
+                except PeerDeadError:
+                    # stale ring of a dead/respawning peer: drop the route
+                    # and surface a retryable failure — the frame must NOT
+                    # be silently lost in the orphaned mapping
+                    self._drop_shm(peer)
+                    raise ConnectionError(
+                        f"rank {peer} died (shm ring orphaned); routes "
+                        f"dropped pending rebind")
         if self.tcp_btl is None:
             if oversize is not None:
                 raise MPIException(
@@ -454,6 +465,10 @@ class BtlEndpoint:
             self._shm_ok.add(peer)
             return True
         return False
+
+    def _drop_shm(self, peer: int) -> None:
+        self._shm_ok.discard(peer)
+        self.shm_btl.drop_peer(peer)
 
     def _proc_route(self, peer: int) -> bool:
         proc_card = self._split_card(self._cards.get(peer, ""))[2]
@@ -479,12 +494,7 @@ class BtlEndpoint:
                 except OSError:
                     pass
         if self.shm_btl is not None:
-            self._shm_ok.discard(peer)
-            with self.shm_btl._lock:
-                self.shm_btl._unreachable.discard(peer)
-                w = self.shm_btl._writers.pop(peer, None)
-            if w is not None:
-                w.close()
+            self._drop_shm(peer)
         if self.proc_btl is not None:
             self._proc_ok.discard(peer)
             self.proc_btl._peer_tokens.pop(peer, None)

@@ -78,6 +78,13 @@ class FrameTooBig(Exception):
     """Frame exceeds the ring's single-frame limit; send it another way."""
 
 
+class PeerDeadError(ConnectionError):
+    """The ring's receiver process no longer exists — a write would land
+    in an orphaned mapping and vanish 'successfully'.  Surfaced instead
+    of silently losing the frame (the respawn/retransmit path needs to
+    KNOW; ≈ the RST a dead tcp peer would produce)."""
+
+
 def _shm_dir() -> Optional[str]:
     return "/dev/shm" if os.path.isdir("/dev/shm") else None
 
@@ -274,6 +281,8 @@ class ShmBTL:
                               os.O_RDONLY | os.O_NONBLOCK)
         self._writers: dict[int, ShmRingWriter] = {}
         self._readers: dict[int, ShmRingReader] = {}
+        self._peer_pid: dict[int, Optional[int]] = {}
+        self._alive_until: dict[int, float] = {}   # liveness-probe cache
         self._unreachable: set[int] = set()
         self._alias: dict[int, int] = {}
         self._lock = threading.Lock()
@@ -287,18 +296,27 @@ class ShmBTL:
 
     @property
     def address(self) -> str:
-        """The business-card fragment: host identity + inbox path."""
-        return f"{self.hostname}|{self.inbox}"
+        """The business-card fragment: host identity + inbox + pid (the
+        pid lets writers detect a dead receiver — an orphaned ring accepts
+        writes 'successfully' forever)."""
+        return f"{self.hostname}|{self.inbox}|{os.getpid()}"
 
     def set_alias(self, peer: int, my_id: int) -> None:
         with self._lock:
             self._alias[peer] = my_id
 
+    @staticmethod
+    def _parse_card(card: str) -> tuple[str, str, Optional[int]]:
+        parts = card.split("|")
+        host, inbox = parts[0], parts[1] if len(parts) > 1 else ""
+        pid = int(parts[2]) if len(parts) > 2 and parts[2].isdigit() else None
+        return host, inbox, pid
+
     def can_reach(self, card: str) -> bool:
         """Same host (by name) and the inbox is visible on my filesystem —
         ≈ the BTL reachability query (btl.h add_procs) vader answers with
         same-node-ness."""
-        host, _, inbox = card.partition("|")
+        host, inbox, _ = self._parse_card(card)
         return host == self.hostname and os.path.isdir(inbox)
 
     def connect(self, peer: int, card: str) -> bool:
@@ -312,29 +330,69 @@ class ShmBTL:
                 self._unreachable.add(peer)
                 return False
             my_id = self._alias.get(peer, self.rank)
+            host, inbox, pid = self._parse_card(card)
             try:
                 self._writers[peer] = ShmRingWriter(
-                    card.partition("|")[2], my_id,
+                    inbox, my_id,
                     int(var_registry.get("btl_shm_ring_size")))
             except OSError as e:
                 _log.verbose(1, "btl/shm: cannot reach %d (%s); tcp fallback",
                              peer, e)
                 self._unreachable.add(peer)
                 return False
+            self._peer_pid[peer] = pid
             return True
 
+    def _check_alive(self, peer: int) -> None:
+        """Receiver-liveness probe, time-bounded: the kill(2) syscall runs
+        at most once per peer per 50ms, so the inline sendi fast path pays
+        a dict lookup in steady state (death detection is delayed by at
+        most the bound — the park/heal layer absorbs that)."""
+        pid = self._peer_pid.get(peer)
+        if pid is None or pid == os.getpid():
+            return
+        now = time.monotonic()
+        if now < self._alive_until.get(peer, 0.0):
+            return
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            raise PeerDeadError(
+                f"btl/shm: rank {peer} (pid {pid}) is gone — dropping the "
+                f"orphaned ring") from None
+        except PermissionError:
+            pass   # alive under another uid
+        self._alive_until[peer] = now + 0.05
+
+    def drop_peer(self, peer: int) -> None:
+        """Forget a peer's (stale) ring so the next send reconnects from
+        its current card (respawn/rebind path)."""
+        with self._lock:
+            self._unreachable.discard(peer)
+            self._peer_pid.pop(peer, None)
+            self._alive_until.pop(peer, None)
+            w = self._writers.pop(peer, None)
+        if w is not None:
+            w.close()
+
     def send(self, peer: int, header: dict, payload: bytes = b"") -> None:
-        """Deliver one frame; raises FrameTooBig for oversized frames and
-        KeyError if connect() was never called for this peer."""
+        """Deliver one frame; raises FrameTooBig for oversized frames,
+        PeerDeadError for a dead receiver, and KeyError if connect() was
+        never called for this peer."""
+        self._check_alive(peer)
         self._writers[peer].send(header, payload)
 
     def try_send(self, peer: int, header: dict,
                  payload: bytes = b"") -> bool:
         """Nonblocking delivery on the caller's thread; False when the
         ring is full or unconnected (caller falls back to the send
-        worker).  FrameTooBig propagates — no queueing fixes that."""
+        worker).  FrameTooBig/PeerDeadError propagate — no queueing fixes
+        those."""
         w = self._writers.get(peer)
-        return w.try_send(header, payload) if w is not None else False
+        if w is None:
+            return False
+        self._check_alive(peer)
+        return w.try_send(header, payload)
 
     # -- receive side ------------------------------------------------------
 

@@ -90,13 +90,14 @@ class XlaColl(Component):
     HANDLES = frozenset({"device", "traced"})
 
     ALGORITHMS = {
-        "allreduce": ("psum", "rs_ag"),
+        "allreduce": ("psum", "rs_ag", "segmented"),
         "allgather": ("all_gather", "ring"),
         "bcast": ("psum_mask", "ring"),
     }
     # collective → algorithm → DeviceCommunicator method
     _IMPL = {
-        "allreduce": {"psum": "allreduce", "rs_ag": "allreduce_rs_ag"},
+        "allreduce": {"psum": "allreduce", "rs_ag": "allreduce_rs_ag",
+                      "segmented": "allreduce_segmented"},
         "allgather": {"all_gather": "allgather", "ring": "allgather_ring"},
         "bcast": {"psum_mask": "bcast", "ring": "bcast_ring"},
     }

@@ -251,6 +251,40 @@ class DeviceCommunicator:
                                      tiled=True)
         return lax.all_gather(scattered, self._ax, axis=axis, tiled=True)
 
+    def allreduce_segmented(self, x, op: Op = SUM,
+                            segment_elems: int = 1 << 20):
+        """Segmented 2-phase allreduce (≈ the reference's segmented ring,
+        coll_base_allreduce.c:615): the buffer is processed in fixed
+        segments via lax.scan, bounding the per-step collective working
+        set — the form very large buffers want when a monolithic psum
+        would stage the whole array through collective scratch."""
+        import jax.numpy as jnp
+
+        from jax import lax
+
+        if op is not SUM:
+            return self.allreduce(x, op)
+        n = self.size
+        flat = x.reshape(-1)
+        seg = max(n, min(segment_elems, flat.shape[0]))
+        seg -= seg % n                       # scatter needs n-divisible
+        if seg <= 0 or flat.shape[0] <= seg:
+            return self.allreduce_rs_ag(x, op)
+        nseg, rem = divmod(flat.shape[0], seg)
+        head, tail = flat[: nseg * seg], flat[nseg * seg:]
+
+        def step(_, chunk):
+            scattered = lax.psum_scatter(chunk, self._ax,
+                                         scatter_dimension=0, tiled=True)
+            return None, lax.all_gather(scattered, self._ax, axis=0,
+                                        tiled=True)
+
+        _, out = lax.scan(step, None, head.reshape(nseg, seg))
+        parts = [out.reshape(-1)]
+        if rem:
+            parts.append(lax.psum(tail, self._ax))
+        return jnp.concatenate(parts).reshape(x.shape)
+
     def allgather_ring(self, x, axis: int = 0):
         """Explicit ring allgather over ppermute hops (≈
         coll_base_allgather.c:364).  n-1 neighbor hops; each hop moves 1/n

@@ -24,6 +24,7 @@ import collections
 import itertools
 import queue
 import threading
+import time
 from typing import Any, Optional
 
 import numpy as np
@@ -62,6 +63,10 @@ pml_framework = Framework("pml", "point-to-point messaging logic")
 
 register_var("pml", "eager_limit", VarType.SIZE, 64 * 1024,
              "max payload bytes sent eagerly (larger goes rendezvous)")
+register_var("pml", "retry_window", VarType.DOUBLE, 30.0,
+             "seconds a transiently-unroutable frame (peer dead or "
+             "mid-respawn) is retried before the send fails (0 = fail "
+             "fast); ≈ the failover PML's retransmit bound")
 register_var("pml", "frag_size", VarType.SIZE, 1 << 20,
              "fragment size for rendezvous pipelines")
 
@@ -297,6 +302,9 @@ class PmlOb1:
         self._peer_epoch: dict[int, int] = {}   # what I stamp TOWARD peer
         self._peer_inc: dict[int, int] = {}     # peer's own incarnation
         self._reannounce_at: dict[int, float] = {}  # rate-limited heal
+        # per-peer ordered frames awaiting a route heal (park-and-heal
+        # retransmit; see _deliver_frame)
+        self._parked: dict[int, list] = {}
         # memchecker gate read ONCE (off-by-default debug feature — the
         # hot path must not pay a registry lookup per message; toggle it
         # before creating communicators, like the reference's build flag)
@@ -416,6 +424,9 @@ class PmlOb1:
             seq = self._seq.get(seq_key, 0)
             self._seq[seq_key] = seq + 1
             epoch = self._peer_epoch.get(peer, 0)
+            # frames parked for this peer (route mid-heal): inline would
+            # overtake them — everything rides the worker's ordered path
+            can_inline = peer not in self._parked
         hdr = {"tag": tag, "cid": cid, "seq": seq,
                "dt": _dtype_to_wire(datatype.base_np),
                "elems": len(payload) // datatype.base_np.itemsize,
@@ -436,7 +447,8 @@ class PmlOb1:
             with self._lock:
                 self._send_states[sid] = _SendState(req, peer, None, on_done)
             # inline wire write when possible (completion still via sack)
-            if not self.endpoint.try_send_inline(peer, hdr, payload):
+            if not (can_inline
+                    and self.endpoint.try_send_inline(peer, hdr, payload)):
                 self._sendq.put(("frame", peer, hdr, payload,
                                  _WireWatch(self, sid)))
         elif eager:
@@ -444,7 +456,8 @@ class PmlOb1:
             # sendi fast path (≈ pml_ob1_isend.c:89-119): the frame goes
             # out on this thread — no send-worker handoff, which on small
             # hosts is the dominant per-message cost
-            if self.endpoint.try_send_inline(peer, hdr, payload):
+            if can_inline and self.endpoint.try_send_inline(peer, hdr,
+                                                            payload):
                 if mode == "buffered":
                     on_done()
                 req.complete(None)
@@ -577,12 +590,28 @@ class PmlOb1:
         if self._peer_inc.get(peer, 0) >= inc:
             return
         self._peer_inc[peer] = inc
+        # frames toward the revived peer must carry ep >= its incarnation
+        # (its receiver fences lower epochs) — learned here even when the
+        # 'si' stamp outran the rebind frame that also updates the card
+        self._peer_epoch[peer] = max(self._peer_epoch.get(peer, 0), inc)
         for key in [k for k in self._seq if k[0] == peer]:
             del self._seq[key]
         for key in [k for k in self._recv_seq if k[0] == peer]:
             del self._recv_seq[key]
         for key in [k for k in self._held if k[0] == peer]:
             del self._held[key]
+        # re-stamp parked frames NOW, under the same lock that reset the
+        # counters: they are the oldest traffic to the new incarnation and
+        # must hold the FRONT of the fresh seq space — a later isend
+        # drawing seq 0 before the heal flush restamped would deliver
+        # newer data first (non-overtaking violation)
+        epoch = self._peer_epoch.get(peer, 0) or inc
+        for hdr, _payload, _req in self._parked.get(peer, []):
+            if "seq" in hdr:
+                key = (peer, hdr["cid"])
+                hdr["seq"] = self._seq.get(key, 0)
+                self._seq[key] = hdr["seq"] + 1
+                hdr["ep"] = epoch
 
     def _on_frame(self, peer: int, hdr: dict, payload: bytes) -> None:
         t = hdr["t"]
@@ -820,23 +849,125 @@ class PmlOb1:
             try:
                 if job[0] == "frame":
                     _, peer, hdr, payload, req = job
-                    self.endpoint.send(peer, hdr, payload)
-                    if req is not None:
-                        req.complete(None)
+                    self._deliver_frame(peer, hdr, payload, req)
                 elif job[0] == "rndv_data":
                     _, state, rid = job
                     data = state.payload
-                    for off in range(0, len(data), frag):
-                        self.endpoint.send(
+                    offs = list(range(0, len(data), frag))
+                    for i, off in enumerate(offs):
+                        last = i == len(offs) - 1
+                        out = self._deliver_frame(
                             state.peer,
                             {"t": "data", "rid": rid, "off": off},
-                            data[off:off + frag])
-                    state.req.complete(None)
-            except Exception as e:
-                req = job[4] if job[0] == "frame" else job[1].req
-                if req is not None:
-                    req.fail(e if isinstance(e, MPIException)
-                             else MPIException(f"send failed: {e}"))
+                            data[off:off + frag],
+                            state.req if last else None)
+                        if out == "failed":
+                            # a hole in the stream: the request must FAIL,
+                            # not complete on a later fragment
+                            if not last:
+                                self._fail_req(state.req, MPIException(
+                                    "rendezvous fragment could not be "
+                                    "delivered"))
+                            break
+            except Exception:  # noqa: BLE001 — the worker must survive
+                _log.error("send worker: unexpected error\n%s",
+                           __import__("traceback").format_exc())
+
+    def _deliver_frame(self, peer, hdr, payload, req) -> str:
+        """Send-worker delivery with park-and-heal (≈ pml/bfo's failover
+        retransmit): a frame that cannot be routed (peer dead or
+        mid-respawn) parks in a per-peer ordered list; a healer retries
+        within ``pml_retry_window``; once routes heal (the revived peer's
+        rebind reset the seq space and re-stamped the parked frames) the
+        healer flushes them in order.  Returns "sent" | "parked" |
+        "failed" so multi-fragment callers can react to holes."""
+        with self._lock:
+            if peer in self._parked:     # keep order behind parked frames
+                self._parked[peer].append((hdr, payload, req))
+                return "parked"
+        try:
+            self.endpoint.send(peer, hdr, payload)
+        except ConnectionError as e:
+            window = float(var_registry.get("pml_retry_window") or 0)
+            if window <= 0 or self._closed:
+                self._fail_req(req, e)
+                return "failed"
+            _log.verbose(1, "route to %d failed (%s); parking %r for "
+                         "up to %.0fs", peer, e,
+                         {k: hdr[k] for k in ("t", "tag", "seq", "cid")
+                          if k in hdr}, window)
+            with self._lock:
+                self._parked.setdefault(peer, []).append(
+                    (hdr, payload, req))
+            self._schedule_heal(peer, time.monotonic() + window)
+            return "parked"
+        except Exception as e:  # noqa: BLE001 — must not kill the loop
+            self._fail_req(req, e)
+            return "failed"
+        self._complete_safely(req)
+        return "sent"
+
+    def _complete_safely(self, req) -> None:
+        """Completion callbacks are user-extensible — an exception there
+        must not kill the singleton send worker or a healer thread."""
+        if req is None:
+            return
+        try:
+            req.complete(None)
+        except Exception:  # noqa: BLE001
+            _log.error("send-completion callback raised\n%s",
+                       __import__("traceback").format_exc())
+
+    def _schedule_heal(self, peer: int, deadline: float) -> None:
+        t = threading.Timer(0.1, self._heal_peer, args=(peer, deadline))
+        t.daemon = True
+        t.start()
+
+    def _heal_peer(self, peer: int, deadline: float) -> None:
+        while True:
+            with self._lock:
+                parked = self._parked.get(peer)
+                if not parked:
+                    self._parked.pop(peer, None)
+                    return
+                # seq re-stamping happened in _adopt_incarnation (under
+                # the lock that reset the counters) — here we only deliver
+                hdr, payload, req = parked[0]
+            try:
+                self.endpoint.send(peer, hdr, payload)
+            except ConnectionError as e:
+                _log.verbose(1, "heal tick for %d failed: %s", peer, e)
+                if time.monotonic() > deadline or self._closed:
+                    with self._lock:
+                        dead = self._parked.pop(peer, [])
+                    for _h, _p, r in dead:
+                        self._fail_req(r, MPIException(
+                            f"no route to rank {peer} within the retry "
+                            f"window: {e}"))
+                    return
+                self._schedule_heal(peer, deadline)
+                return
+            except Exception as e:  # noqa: BLE001
+                with self._lock:
+                    parked = self._parked.get(peer)
+                    if parked and parked[0][2] is req:
+                        parked.pop(0)
+                self._fail_req(req, e)
+                continue
+            with self._lock:
+                parked = self._parked.get(peer)
+                if parked:
+                    parked.pop(0)
+            self._complete_safely(req)
+
+    def _fail_req(self, req, e) -> None:
+        if req is not None:
+            try:
+                req.fail(e if isinstance(e, MPIException)
+                         else MPIException(f"send failed: {e}"))
+            except Exception:  # noqa: BLE001 — callbacks may raise
+                _log.error("send-failure callback raised\n%s",
+                           __import__("traceback").format_exc())
 
 
 @pml_framework.component

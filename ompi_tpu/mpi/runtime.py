@@ -130,12 +130,13 @@ def finalize(_collective: bool = True) -> None:
 
         # a respawn anywhere in the job means one coordination-service
         # task never rejoined — the synchronized shutdown would hang.
-        # Evaluated AFTER the final barrier: the barrier itself is the
-        # traffic that delivers a revived peer's incarnation stamp, so an
-        # earlier read could split the ranks between graceful/skip paths.
+        # Decided AFTER the final barrier (whose frames carry a revived
+        # peer's incarnation stamp).  Ranks can still disagree in narrow
+        # races — multihost.shutdown bounds that with a watchdog, so the
+        # worst case is a logged delay, not a hang.
         pml = _state["pml"]
 
-        def respawned_job() -> bool:
+        def respawn_seen() -> bool:
             return bool(getattr(pml, "incarnation", 0)
                         or any(getattr(pml, "_peer_inc", {}).values()))
 
@@ -147,10 +148,10 @@ def finalize(_collective: bool = True) -> None:
                 # across tasks internally, so all ranks must call it
                 # concurrently — staggering it (workers first, then the
                 # coordinator) deadlocks against that internal barrier.
-                multihost.shutdown(graceful=not respawned_job())
+                multihost.shutdown(graceful=not respawn_seen())
         finally:
             # no-op if already left; atexit path
-            multihost.shutdown(graceful=not respawned_job())
+            multihost.shutdown(graceful=not respawn_seen())
             if _state["pml"] is not None:
                 _state["pml"].close()
             client = _state["client"]

@@ -27,7 +27,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ompi_tpu.core.config import VarType, register_var
+
 __all__ = ["flash_attention", "flash_attention_lse", "flash_tiles"]
+
+register_var("ops", "flash_bwd_kernel", VarType.BOOL, False,
+             "use the pallas backward kernels for flash attention "
+             "(recompute-from-lse, O(T·D) memory) instead of the "
+             "materialized pure-XLA backward")
 
 _NEG = -1e30
 
@@ -147,6 +154,162 @@ def _smem():
 
 
 # ---------------------------------------------------------------------------
+# backward kernels (opt-in: --mca ops flash_bwd_kernel 1)
+#
+# The pure-XLA backward materializes (B,H,Tq,Tk) f32 score/weight tensors —
+# HBM-bound at scale.  These kernels recompute p blockwise from the saved
+# lse (the standard flash strategy): dq streams k/v blocks per q block;
+# dk/dv streams q/g blocks per k block.  delta' = rowsum(g·out) − g_lse is
+# precomputed in XLA (cheap elementwise) and folds the lse cotangent into
+# the same ds term.
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, g_ref, lse_ref,
+                   dm_ref, dq_ref, *, scale: float, causal: bool,
+                   block_q: int, block_k: int, t_k: int):
+    from jax import lax
+    from jax.experimental import pallas as pl
+
+    iq = pl.program_id(1)
+    q = q_ref[0]                                             # (bq, D)
+    g = g_ref[0]
+    lse = lse_ref[0, :, 0]                                   # (bq,)
+    dm = dm_ref[0, :, 0]                                     # (bq,)
+    qpos = (qoff_ref[0] + iq * block_q
+            + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0))
+
+    def body(j, acc):
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            kpos = (koff_ref[0] + j * block_k
+                    + lax.broadcasted_iota(jnp.int32,
+                                           (block_q, block_k), 1))
+            s = jnp.where(qpos >= kpos, s, _NEG)
+        p = jnp.exp(s - lse[:, None])                        # (bq, bk)
+        if causal:
+            p = jnp.where(qpos >= kpos, p, 0.0)
+        dp = lax.dot_general(g, v_blk, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = (p * (dp - dm[:, None]) * scale).astype(q.dtype)
+        return acc + lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    acc0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+    dq = lax.fori_loop(0, t_k // block_k, body, acc0)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, g_ref,
+                    lse_ref, dm_ref, dk_ref, dv_ref, *, scale: float,
+                    causal: bool, block_q: int, block_k: int, t_q: int):
+    from jax import lax
+    from jax.experimental import pallas as pl
+
+    jk = pl.program_id(1)
+    k_blk = k_ref[0]                                         # (bk, D)
+    v_blk = v_ref[0]
+    kpos = (koff_ref[0] + jk * block_k
+            + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1))
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :]         # (bq, D)
+        g = g_ref[0, pl.ds(i * block_q, block_q), :]
+        lse = lse_ref[0, pl.ds(i * block_q, block_q), 0]     # (bq,)
+        dm = dm_ref[0, pl.ds(i * block_q, block_q), 0]
+        s = lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = (qoff_ref[0] + i * block_q
+                    + lax.broadcasted_iota(jnp.int32,
+                                           (block_q, block_k), 0))
+            s = jnp.where(qpos >= kpos, s, _NEG)
+        p = jnp.exp(s - lse[:, None])
+        if causal:
+            p = jnp.where(qpos >= kpos, p, 0.0)
+        pc = p.astype(g.dtype)
+        dv = dv + lax.dot_general(pc, g, (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        dp = lax.dot_general(g, v_blk, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = (p * (dp - dm[:, None]) * scale).astype(q.dtype)
+        dk = dk + lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        return dk, dv
+
+    d = k_blk.shape[-1]
+    z = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = lax.fori_loop(0, t_q // block_q, body, (z, z))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_raw(q3, k3, v3, g3, lse3, dm3, qoff, koff, scale: float,
+                   causal: bool, block_q: int, block_k: int,
+                   interpret: bool):
+    """(BH,·,D) inputs → (dq3, dk3, dv3)."""
+    from jax.experimental import pallas as pl
+
+    bh, t_q, d = q3.shape
+    t_k = k3.shape[1]
+    lse_c = lse3.reshape(bh, t_q, 1)       # (…, 1) last dim: full-dim tile
+    dm_c = dm3.reshape(bh, t_q, 1)
+    row = [
+        pl.BlockSpec(memory_space=_smem()),
+        pl.BlockSpec(memory_space=_smem()),
+    ]
+    dq3 = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, t_k=t_k),
+        grid=(bh, t_q // block_q),
+        in_specs=row + [
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),   # q
+            pl.BlockSpec((1, t_k, d), lambda b, i: (b, 0, 0)),       # k
+            pl.BlockSpec((1, t_k, d), lambda b, i: (b, 0, 0)),       # v
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),   # g
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),   # lse
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),   # dm
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t_q, d), q3.dtype),
+        interpret=interpret,
+    )(qoff, koff, q3, k3, v3, g3, lse_c, dm_c)
+    dk3, dv3 = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, t_q=t_q),
+        grid=(bh, t_k // block_k),
+        in_specs=row + [
+            pl.BlockSpec((1, t_q, d), lambda b, j: (b, 0, 0)),       # q
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),   # k
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),   # v
+            pl.BlockSpec((1, t_q, d), lambda b, j: (b, 0, 0)),       # g
+            pl.BlockSpec((1, t_q, 1), lambda b, j: (b, 0, 0)),       # lse
+            pl.BlockSpec((1, t_q, 1), lambda b, j: (b, 0, 0)),       # dm
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t_k, d), k3.dtype),
+            jax.ShapeDtypeStruct((bh, t_k, d), v3.dtype),
+        ],
+        interpret=interpret,
+    )(qoff, koff, q3, k3, v3, g3, lse_c, dm_c)
+    return dq3, dk3, dv3
+
+
+def _bwd_kernel_wanted() -> bool:
+    from ompi_tpu.core.config import var_registry
+
+    return bool(var_registry.get("ops_flash_bwd_kernel"))
+
+
+# ---------------------------------------------------------------------------
 # public op with recompute backward
 # ---------------------------------------------------------------------------
 
@@ -181,19 +344,34 @@ def _flash_core(q, k, v, qoff, koff, scale, causal, blocks):
 
 def _flash_fwd(q, k, v, qoff, koff, scale, causal, blocks):
     out, lse = _flash_core(q, k, v, qoff, koff, scale, causal, blocks)
-    return (out, lse), (q, k, v, qoff, koff, out)
+    return (out, lse), (q, k, v, qoff, koff, out, lse)
 
 
 def _flash_bwd(scale, causal, blocks, res, cts):
-    """Recompute backward (pure XLA): rebuilding s and its logsumexp
-    reproduces the forward's weights exactly (matmul inputs are the same
-    bf16 values, accumulated in f32); standard flash-attention gradient
-    algebra plus the lse cotangent (d lse/d s = p, so it folds into ds).
-    Matmuls keep storage-dtype inputs + f32 accumulation so the MXU runs
-    them at native rate."""
-    q, k, v, qoff, koff, out = res
+    """Backward via recompute.  Default: pure XLA (rebuild s + logsumexp —
+    same bf16 matmul inputs with f32 accumulation, so the weights match
+    the forward exactly) with the lse cotangent folded into ds
+    (d lse/d s = p).  With ``--mca ops flash_bwd_kernel 1``: the pallas
+    dq and dk/dv kernels recompute p blockwise from the SAVED lse —
+    O(T·D) memory instead of materialized (B,H,Tq,Tk) tensors."""
+    q, k, v, qoff, koff, out, lse = res
     g, g_lse = cts
-    t_q = q.shape[1]
+    zoff = np.zeros((1,), dtype=jax.dtypes.float0)  # int args: no tangent
+    b, t_q, h, d = q.shape
+    if _bwd_kernel_wanted():
+        block_q, block_k = blocks
+        f32 = jnp.float32
+        g3, o3, q3 = _to3(g), _to3(out), _to3(q)
+        delta = jnp.sum(g3.astype(f32) * o3.astype(f32), axis=-1)  # (BH,T)
+        dm = delta
+        if g_lse is not None:
+            # fold the lse cotangent: ds = p·(dp − (delta − g_lse))·scale
+            dm = delta - g_lse.reshape(b * h, t_q).astype(f32)
+        dq3, dk3, dv3 = _flash_bwd_raw(
+            q3, _to3(k), _to3(v), g3, lse.reshape(b * h, t_q), dm,
+            qoff, koff, scale, causal, block_q, block_k, _use_interpret())
+        return (_from3(dq3, b, h), _from3(dk3, b, h), _from3(dv3, b, h),
+                zoff, zoff)
     f32 = jnp.float32
     gf32 = g.astype(f32)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
@@ -219,7 +397,6 @@ def _flash_bwd(scale, causal, blocks, res, cts):
     ds = (p * resid * scale).astype(q.dtype)
     dq = jnp.einsum("bhqk,bkhd->bqhd", ds, k, preferred_element_type=f32)
     dk = jnp.einsum("bhqk,bqhd->bkhd", ds, q, preferred_element_type=f32)
-    zoff = np.zeros((1,), dtype=jax.dtypes.float0)  # int args: no tangent
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
             zoff, zoff)
 

@@ -118,12 +118,25 @@ def shutdown(graceful: bool = True) -> None:
         _log.verbose(1, "multihost: skipping synchronized shutdown "
                      "(respawned rank in the job)")
         return
-    try:
-        import jax
 
-        jax.distributed.shutdown()
-    except Exception as e:  # pragma: no cover - teardown best-effort
-        _log.verbose(1, "multihost shutdown: %r", e)
+    def _do() -> None:
+        try:
+            import jax
+
+            jax.distributed.shutdown()
+        except Exception as e:  # pragma: no cover - teardown best-effort
+            _log.verbose(1, "multihost shutdown: %r", e)
+
+    # watchdog: the synchronized shutdown blocks on every task arriving.
+    # If ranks DISAGREE about graceful (a respawn raced the decision) the
+    # barrier would never fill — bound the wait so the worst case is a
+    # delay, not a hang; process exit reclaims the service either way.
+    t = threading.Thread(target=_do, daemon=True)
+    t.start()
+    t.join(timeout=10.0)
+    if t.is_alive():  # pragma: no cover - requires a raced respawn
+        _log.error("multihost: synchronized shutdown did not complete "
+                   "in 10s (peer skipped it?); abandoning the wait")
 
 
 def global_mesh(axes: Optional[dict | list] = None):

@@ -74,8 +74,9 @@ class ErrmgrRespawn(Component):
         from ompi_tpu.runtime.notifier import Severity, notify
 
         limit = var_registry.get("errmgr_max_restarts")
-        # launchers without a revive hook (the multi-host daemon tree, for
-        # now) degrade to abort instead of raising into the rml dispatch
+        # both shipped launchers revive (local fork/exec + daemon tree via
+        # TAG_RESPAWN); a custom launcher without the hook degrades to
+        # abort instead of raising into its event dispatch
         respawn = getattr(launcher, "respawn_proc", None)
         if respawn is None:
             _log.error("errmgr/respawn: %s cannot revive ranks; aborting",

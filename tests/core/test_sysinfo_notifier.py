@@ -139,14 +139,16 @@ def test_rtc_bind_child():
 
     assert bind_child(os.getpid(), 0) is None     # default: none
     var_registry.set("rtc_bind", "core")
+    allowed = sorted(os.sched_getaffinity(0))
     try:
-        allowed = sorted(os.sched_getaffinity(0))
         cpu = bind_child(os.getpid(), 1)
         if len(allowed) < 2:
             assert cpu is None            # single-cpu host: no-op
         else:
             assert cpu == allowed[1 % len(allowed)]
             assert os.sched_getaffinity(0) == {cpu}
-            os.sched_setaffinity(0, set(allowed))  # restore
     finally:
+        # restore INSIDE finally: a failed assert must not leave the
+        # whole pytest process pinned to one cpu
+        os.sched_setaffinity(0, set(allowed))
         var_registry.set("rtc_bind", "none")

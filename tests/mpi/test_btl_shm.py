@@ -152,3 +152,30 @@ def test_large_rndv_through_shm_fragments():
         return bool(np.array_equal(out, np.arange(n, dtype=np.float64)))
 
     assert run_ranks(2, fn) == [True, True]
+
+
+def test_dead_receiver_detected_not_silently_lost():
+    """A ring whose receiver pid is gone must raise PeerDeadError instead
+    of accepting writes into the orphaned mapping (the respawn/retransmit
+    path depends on the failure being VISIBLE)."""
+    from ompi_tpu.mpi.btl_shm import PeerDeadError, ShmBTL
+
+    a = ShmBTL(0, lambda *x: None)
+    b = ShmBTL(1, lambda *x: None)
+    try:
+        # forge b's card with a pid that cannot exist
+        host, inbox, _ = b.address.split("|")
+        dead_card = f"{host}|{inbox}|{2**22 + 12345}"
+        assert a.connect(1, dead_card)
+        with pytest.raises(PeerDeadError):
+            a.send(1, {"t": "eager", "seq": 0}, b"x")
+        with pytest.raises(PeerDeadError):
+            a.try_send(1, {"t": "eager", "seq": 1}, b"y")
+        # a live pid (ours) passes
+        a.drop_peer(1)
+        live_card = f"{host}|{inbox}|{__import__('os').getpid()}"
+        assert a.connect(1, live_card)
+        a.send(1, {"t": "eager", "seq": 0}, b"x")
+    finally:
+        a.close()
+        b.close()

@@ -223,3 +223,22 @@ def test_xla_decision_rules_file(tmp_path):
         assert comp._decide("allreduce", None, FakeDC(), 8192) == "rs_ag"
     finally:
         var_registry.set("coll_xla_dynamic_rules", "")
+
+
+def test_allreduce_segmented_matches_psum(mesh8):
+    comm = device_world(mesh8)
+    # 3000 elems/shard, segment 1024 → several segments + ragged tail
+    x = np.arange(8 * 3000, dtype=np.float32).reshape(8, 3000)
+    a = np.asarray(comm.run(lambda c, s: c.allreduce(s), x))
+    b = np.asarray(comm.run(
+        lambda c, s: c.allreduce_segmented(s, segment_elems=1024), x))
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_allreduce_segmented_small_falls_back(mesh8):
+    comm = device_world(mesh8)
+    x = _global(64)
+    a = np.asarray(comm.run(lambda c, s: c.allreduce(s), x))
+    b = np.asarray(comm.run(
+        lambda c, s: c.allreduce_segmented(s, segment_elems=1 << 20), x))
+    np.testing.assert_allclose(a, b, rtol=1e-6)

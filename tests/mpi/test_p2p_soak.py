@@ -1,0 +1,81 @@
+"""P2p ordering soak: randomized sizes/modes across ranks must preserve
+MPI's non-overtaking guarantee (messages from one sender matching the
+same receive pattern complete in send order) — exercising the seq
+reorderer across every transport mix (inline sendi, queued sends, shm
+rings, rendezvous frames riding tcp fallback)."""
+
+import numpy as np
+import pytest
+
+from tests.mpi.harness import run_ranks
+
+N_MSGS = 40
+
+
+def test_nonovertaking_mixed_sizes_and_modes():
+    rng_global = np.random.default_rng(7)
+    # pre-generate per-sender size/mode schedules (same view on all ranks)
+    sizes = rng_global.choice([1, 64, 1 << 12, 1 << 17], size=(3, N_MSGS))
+    modes = rng_global.choice(["standard", "standard", "sync", "buffered"],
+                              size=(3, N_MSGS))
+
+    def body(comm):
+        rank, size = comm.rank, comm.size
+        peers = [r for r in range(size) if r != rank]
+        comm.pml.bsend_pool.attach(64 << 20)   # room for buffered mode
+        reqs = []
+        # every rank sends N_MSGS to each peer, tag = sender's rank;
+        # payload head = sequence number, rest = filler
+        for i in range(N_MSGS):
+            n = int(sizes[rank][i])
+            payload = np.full(n, i, dtype=np.int64)
+            send = {"standard": comm.isend, "sync": comm.issend,
+                    "buffered": comm.ibsend}[str(modes[rank][i])]
+            for peer in peers:
+                reqs.append(send(payload, dest=peer, tag=rank))
+        # receive: one wildcard-source stream per expected message slot
+        got: dict[int, list[int]] = {p: [] for p in peers}
+        for _ in range(N_MSGS * len(peers)):
+            from ompi_tpu.mpi.constants import ANY_SOURCE, ANY_TAG
+            from ompi_tpu.mpi.request import Status
+
+            st = Status()
+            out = comm.recv(source=ANY_SOURCE, tag=ANY_TAG, status=st)
+            got[st.tag].append(int(out[0]))  # tag == sender rank
+        for r in reqs:
+            r.wait()
+        # non-overtaking: per sender, sequence numbers arrive in order
+        for sender, seqs in got.items():
+            assert seqs == sorted(seqs), (rank, sender, seqs[:10])
+            assert len(seqs) == N_MSGS
+        return True
+
+    assert all(run_ranks(3, body, timeout=120.0))
+
+
+def test_wildcard_and_specific_interleave():
+    """Specific-source recvs posted among wildcards must steal only their
+    sender's stream, leaving the wildcard order intact for the rest."""
+    def body(comm):
+        if comm.rank == 0:
+            from ompi_tpu.mpi.constants import ANY_SOURCE
+            from ompi_tpu.mpi.request import Status
+
+            seq1, seq2 = [], []
+            for i in range(30):
+                if i % 3 == 0:
+                    out = comm.recv(source=2, tag=9)      # specific
+                    seq2.append(int(out[0]))
+                else:
+                    st = Status()
+                    out = comm.recv(source=ANY_SOURCE, tag=9, status=st)
+                    (seq1 if st.source == 1 else seq2).append(int(out[0]))
+            assert seq1 == sorted(seq1) and seq2 == sorted(seq2), (seq1,
+                                                                   seq2)
+            assert len(seq1) + len(seq2) == 30
+        else:
+            for i in range(15):
+                comm.send(np.array([i]), dest=0, tag=9)
+        return True
+
+    assert all(run_ranks(3, body, timeout=60.0))
