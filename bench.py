@@ -297,6 +297,69 @@ def matrix_ring_latency() -> dict:
     }
 
 
+def matrix_allreduce_sweep(devices) -> dict:
+    """Config 2: OSU-style MPI_Allreduce size sweep — the device path
+    (coll/xla → psum) per size, with the host path (coll/tuned algorithms
+    over in-process ranks) alongside for the crossover picture."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ompi_tpu.mpi.device_comm import device_world
+    from ompi_tpu.parallel.mesh import make_mesh
+
+    n = len(devices)
+    mesh = make_mesh(devices=devices)
+    comm = device_world(mesh)
+    dev_rows = {}
+    for label, elems in (("4KiB", 1024), ("1MiB", 1 << 18),
+                         ("64MiB", 1 << 24)):
+        x = _device_put(np.ones((n * elems,), np.float32), mesh, P("world"))
+        fn = jax.jit(jax.shard_map(
+            lambda s: comm.allreduce(s), mesh=mesh, in_specs=P("world"),
+            out_specs=P("world"), check_vma=False), donate_argnums=0)
+        out = fn(x)
+        jax.block_until_ready(out)
+        iters = 20 if elems <= (1 << 18) else 5
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(out)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / iters
+        shard = elems * 4
+        dev_rows[label] = {
+            "us": round(dt * 1e6, 1),
+            "busbw_gibps": round(2 * (n - 1) / n * shard / dt / 2**30, 3),
+        }
+
+    # host path: 4 in-process ranks through coll/tuned's decision layer
+    from tests.mpi.harness import run_ranks
+
+    host_rows = {}
+    for label, elems in (("4B", 1), ("4KiB", 1024), ("1MiB", 1 << 18)):
+        payload = np.ones(elems, np.float32)
+        iters = 30 if elems <= 1024 else 10
+
+        def body(comm_):
+            import time as _t
+
+            comm_.allreduce(payload)          # warm routes
+            t0 = _t.perf_counter()
+            for _ in range(iters):
+                comm_.allreduce(payload)
+            return (_t.perf_counter() - t0) / iters
+
+        dts = run_ranks(4, body, timeout=120.0)
+        dt = max(dts)
+        host_rows[label] = {"us": round(dt * 1e6, 1)}
+
+    return {
+        "metric": f"MPI_Allreduce sweep ({n} dev psum | 4-rank host tuned)",
+        "value": dev_rows["64MiB"]["busbw_gibps"], "unit": "GiB/s",
+        "vs_baseline": 1.0,
+        "device_path": dev_rows, "host_path_4rank": host_rows,
+    }
+
+
 def matrix_mesh_bcast_allgather(devices) -> dict:
     """Config 3: Bcast + Allgather over a 2D mesh, mixed dtypes."""
     import jax
@@ -433,6 +496,7 @@ def run_matrix(devices, backend: str) -> None:
     rows = []
     for name, fn in (
             ("ring_latency", matrix_ring_latency),
+            ("allreduce_sweep", lambda: matrix_allreduce_sweep(devices)),
             ("mesh_bcast_allgather",
              lambda: matrix_mesh_bcast_allgather(devices)),
             ("grad_reduce_scatter",

@@ -303,8 +303,21 @@ class PmlOb1:
         self._peer_inc: dict[int, int] = {}     # peer's own incarnation
         self._reannounce_at: dict[int, float] = {}  # rate-limited heal
         # per-peer ordered frames awaiting a route heal (park-and-heal
-        # retransmit; see _deliver_frame)
+        # retransmit; see _deliver_frame) + MPI_T observability for the
+        # FT path (≈ the monitoring pvar discipline for p2p counters)
         self._parked: dict[int, list] = {}
+        self._route_gen: dict[int, int] = {}   # bumped per adopted incarnation
+        from ompi_tpu.mpi.mpit import Pvar, PvarClass, pvar_registry
+
+        self.pvar_parked = pvar_registry.register_or_get(Pvar(
+            f"pml_parked_frames_rank{rank}", PvarClass.COUNTER, "frames",
+            "frames parked for a route heal (peer dead or mid-respawn)"))
+        self.pvar_healed = pvar_registry.register_or_get(Pvar(
+            f"pml_healed_frames_rank{rank}", PvarClass.COUNTER, "frames",
+            "parked frames delivered after their peer's route healed"))
+        self.pvar_fenced = pvar_registry.register_or_get(Pvar(
+            f"pml_fenced_frames_rank{rank}", PvarClass.COUNTER, "frames",
+            "pre-restart frames dropped by the incarnation fence"))
         # memchecker gate read ONCE (off-by-default debug feature — the
         # hot path must not pay a registry lookup per message; toggle it
         # before creating communicators, like the reference's build flag)
@@ -604,14 +617,25 @@ class PmlOb1:
         # counters: they are the oldest traffic to the new incarnation and
         # must hold the FRONT of the fresh seq space — a later isend
         # drawing seq 0 before the heal flush restamped would deliver
-        # newer data first (non-overtaking violation)
-        epoch = self._peer_epoch.get(peer, 0) or inc
+        # newer data first (non-overtaking violation).  The generation
+        # bump tells an in-flight heal delivery that its (stale-stamped)
+        # copy was fenced by the receiver and the frame must be re-sent.
+        self._route_gen[peer] = self._route_gen.get(peer, 0) + 1
         for hdr, _payload, _req in self._parked.get(peer, []):
-            if "seq" in hdr:
-                key = (peer, hdr["cid"])
-                hdr["seq"] = self._seq.get(key, 0)
-                self._seq[key] = hdr["seq"] + 1
-                hdr["ep"] = epoch
+            self._restamp_if_stale(peer, hdr)
+
+    def _restamp_if_stale(self, peer: int, hdr: dict) -> None:
+        """With self._lock held: a seq-carrying frame stamped for an older
+        incarnation of ``peer`` gets a fresh seq + the current epoch (its
+        old stamp would be fenced by the revived receiver).  Idempotent —
+        a frame whose epoch already matches is left alone."""
+        epoch = self._peer_epoch.get(peer, 0)
+        if "seq" not in hdr or not epoch or hdr.get("ep", 0) == epoch:
+            return
+        key = (peer, hdr["cid"])
+        hdr["seq"] = self._seq.get(key, 0)
+        self._seq[key] = hdr["seq"] + 1
+        hdr["ep"] = epoch
 
     def _on_frame(self, peer: int, hdr: dict, payload: bytes) -> None:
         t = hdr["t"]
@@ -627,6 +651,7 @@ class PmlOb1:
                 _log.verbose(1, "dropping pre-restart frame from %d "
                              "(ep %d < %d)", peer, hdr.get("ep", 0),
                              self.incarnation)
+                self.pvar_fenced.inc()
                 import time as _time
 
                 now = _time.monotonic()
@@ -883,7 +908,11 @@ class PmlOb1:
         "failed" so multi-fragment callers can react to holes."""
         with self._lock:
             if peer in self._parked:     # keep order behind parked frames
+                # a frame stamped before an adopt but queued after it
+                # would carry a fenced epoch — restamp on arrival
+                self._restamp_if_stale(peer, hdr)
                 self._parked[peer].append((hdr, payload, req))
+                self.pvar_parked.inc()
                 return "parked"
         try:
             self.endpoint.send(peer, hdr, payload)
@@ -897,8 +926,10 @@ class PmlOb1:
                          {k: hdr[k] for k in ("t", "tag", "seq", "cid")
                           if k in hdr}, window)
             with self._lock:
+                self._restamp_if_stale(peer, hdr)
                 self._parked.setdefault(peer, []).append(
                     (hdr, payload, req))
+            self.pvar_parked.inc()
             self._schedule_heal(peer, time.monotonic() + window)
             return "parked"
         except Exception as e:  # noqa: BLE001 — must not kill the loop
@@ -931,10 +962,17 @@ class PmlOb1:
                     self._parked.pop(peer, None)
                     return
                 # seq re-stamping happened in _adopt_incarnation (under
-                # the lock that reset the counters) — here we only deliver
+                # the lock that reset the counters).  Serialize a COPY of
+                # the header and remember the route generation: an adopt
+                # racing this delivery restamps the in-list dict and the
+                # stale copy is fenced by the receiver — the generation
+                # check below detects that and re-sends instead of
+                # completing a lost frame.
                 hdr, payload, req = parked[0]
+                wire_hdr = dict(hdr)
+                gen = self._route_gen.get(peer, 0)
             try:
-                self.endpoint.send(peer, hdr, payload)
+                self.endpoint.send(peer, wire_hdr, payload)
             except ConnectionError as e:
                 _log.verbose(1, "heal tick for %d failed: %s", peer, e)
                 if time.monotonic() > deadline or self._closed:
@@ -955,9 +993,15 @@ class PmlOb1:
                 self._fail_req(req, e)
                 continue
             with self._lock:
+                if self._route_gen.get(peer, 0) != gen:
+                    # the peer re-incarnated mid-send: the copy we just
+                    # delivered carried the fenced epoch — keep the frame
+                    # (already restamped by the adopt) and go around
+                    continue
                 parked = self._parked.get(peer)
                 if parked:
                     parked.pop(0)
+            self.pvar_healed.inc()
             self._complete_safely(req)
 
     def _fail_req(self, req, e) -> None:
