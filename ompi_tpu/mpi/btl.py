@@ -48,8 +48,23 @@ register_var("btl", "tcp_rcvbuf", VarType.SIZE, 0,
 OnFrame = Callable[[int, dict, bytes], None]
 
 
-def _send_all(sock: socket.socket, *parts: bytes) -> None:
-    sock.sendall(b"".join(parts))
+def _send_all(sock: socket.socket, *parts) -> None:
+    """Scatter-gather send: no join copy of the payload (a rendezvous
+    fragment is ~1MiB — the old b''.join doubled its memory traffic).
+    Falls back across partial sends by re-slicing the iovec."""
+    iov = [memoryview(p).cast("B") for p in parts if len(p)]
+    while iov:
+        try:
+            sent = sock.sendmsg(iov)
+        except AttributeError:  # platform without sendmsg
+            sock.sendall(b"".join(iov))
+            return
+        # drop fully-sent buffers, trim the partial one
+        while iov and sent >= len(iov[0]):
+            sent -= len(iov[0])
+            iov.pop(0)
+        if iov and sent:
+            iov[0] = iov[0][sent:]
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:

@@ -44,7 +44,6 @@ hosts, where pure spinning loses the core the sender needs.
 
 from __future__ import annotations
 
-import mmap
 import os
 import struct
 import tempfile
@@ -93,24 +92,26 @@ class ShmRingWriter:
     """The sender's end: creates the ring file and appends frames."""
 
     def __init__(self, inbox: str, my_id: int, capacity: int) -> None:
+        from ompi_tpu.core import shmseg
+
         capacity = (capacity + 7) & ~7      # counter view needs 8B multiple
         self.capacity = capacity
-        fd, tmp = tempfile.mkstemp(prefix=".ring-", dir=inbox)
-        try:
-            os.ftruncate(fd, _HDR + capacity)
-            self._mm = mmap.mmap(fd, _HDR + capacity)
-        finally:
-            os.close(fd)
+        # segment lifecycle rides the generic shmem framework
+        # (≈ opal/mca/shmem/mmap), UNPUBLISHED until the ring header is
+        # initialized: the receiver's inbox scan must never observe a
+        # ring without its magic/capacity in place
+        self._seg = shmseg.create(f"ring_{my_id}", _HDR + capacity,
+                                  dir=inbox, publish=False)
+        self._mm = self._seg.buf
         # counters as a u64 view: single native load/store per access
-        self._ctr = memoryview(self._mm).cast("Q")
+        self._ctr = self._mm[:_HDR].cast("Q")
         self._ctr[_OFF_CAP // 8] = capacity
         struct.pack_into("<I", self._mm, _OFF_MAGIC, _MAGIC)
+        self._seg.publish()       # ring header complete: now visible
         self._head = 0            # local mirror: we are the only writer
         self._lock = threading.Lock()
         self._db_fd: Optional[int] = None   # receiver's doorbell FIFO
         self._first = True
-        # atomic publish: the receiver never sees a half-initialized ring
-        os.rename(tmp, os.path.join(inbox, f"ring_{my_id}"))
         try:
             self._db_fd = os.open(os.path.join(inbox, "doorbell"),
                                   os.O_WRONLY | os.O_NONBLOCK)
@@ -197,29 +198,27 @@ class ShmRingWriter:
             self._db_fd = None
         try:
             self._ctr.release()
-            self._mm.close()
         except (BufferError, ValueError):
             pass
+        self._seg.detach()
 
 
 class ShmRingReader:
     """The receiver's end: maps a discovered ring and drains frames."""
 
     def __init__(self, path: str, peer: int) -> None:
+        from ompi_tpu.core import shmseg
+
         self.peer = peer
-        fd = os.open(path, os.O_RDWR)
-        try:
-            size = os.fstat(fd).st_size
-            self._mm = mmap.mmap(fd, size)
-        finally:
-            os.close(fd)
+        self._seg = shmseg.attach(path)
+        self._mm = self._seg.buf
         if struct.unpack_from("<I", self._mm, _OFF_MAGIC)[0] != _MAGIC:
-            self._mm.close()
+            self._seg.detach()
             raise OSError(f"bad ring magic in {path}")
-        self._ctr = memoryview(self._mm).cast("Q")
+        self._ctr = self._mm[:_HDR].cast("Q")
         self.capacity = self._ctr[_OFF_CAP // 8]
         self._tail = self._ctr[_OFF_TAIL // 8]
-        os.unlink(path)   # mapping survives; crash cleanup is automatic
+        self._seg.unlink()  # mapping survives; crash cleanup is automatic
 
     def poll(self, on_frame: OnFrame, limit: int = 64) -> int:
         """Drain up to ``limit`` frames; returns how many were delivered."""
@@ -242,9 +241,12 @@ class ShmRingReader:
     def _read(self, n: int) -> bytes:
         pos = self._tail % self.capacity
         first = min(n, self.capacity - pos)
-        out = self._mm[_HDR + pos:_HDR + pos + first]
+        # bytes() copy: _mm is a memoryview into the live ring — the
+        # returned data must own its bytes (the slot is recycled once the
+        # tail advances)
+        out = bytes(self._mm[_HDR + pos:_HDR + pos + first])
         if first < n:
-            out += self._mm[_HDR:_HDR + (n - first)]
+            out += bytes(self._mm[_HDR:_HDR + (n - first)])
         self._tail += n
         return out
 
@@ -258,9 +260,9 @@ class ShmRingReader:
     def close(self) -> None:
         try:
             self._ctr.release()
-            self._mm.close()
         except (BufferError, ValueError):
             pass
+        self._seg.detach()
 
 
 class ShmBTL:

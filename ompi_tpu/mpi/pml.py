@@ -307,6 +307,9 @@ class PmlOb1:
         # FT path (≈ the monitoring pvar discipline for p2p counters)
         self._parked: dict[int, list] = {}
         self._route_gen: dict[int, int] = {}   # bumped per adopted incarnation
+        self._queued: dict[int, int] = {}      # frames in _sendq per peer
+        self._qlock = threading.Lock()         # _queued has its own lock:
+        # _enqueue_frame runs from handlers that already hold self._lock
         from ompi_tpu.mpi.mpit import Pvar, PvarClass, pvar_registry
 
         self.pvar_parked = pvar_registry.register_or_get(Pvar(
@@ -376,9 +379,9 @@ class PmlOb1:
         control frame — safe to call from BTL reader threads; a failed
         send is retried by the rate-limited heal in _on_frame."""
         for peer in peers:
-            self._sendq.put(("frame", peer,
+            self._enqueue_frame(peer,
                              {"t": "rebind", "card": self.address,
-                              "inc": self.incarnation}, b"", None))
+                              "inc": self.incarnation}, b"", None)
 
     def close(self) -> None:
         self._closed = True
@@ -437,9 +440,10 @@ class PmlOb1:
             seq = self._seq.get(seq_key, 0)
             self._seq[seq_key] = seq + 1
             epoch = self._peer_epoch.get(peer, 0)
-            # frames parked for this peer (route mid-heal): inline would
+            # frames parked OR still queued for this peer: inline would
             # overtake them — everything rides the worker's ordered path
-            can_inline = peer not in self._parked
+            can_inline = (peer not in self._parked
+                          and not self._queued.get(peer, 0))
         hdr = {"tag": tag, "cid": cid, "seq": seq,
                "dt": _dtype_to_wire(datatype.base_np),
                "elems": len(payload) // datatype.base_np.itemsize,
@@ -462,8 +466,8 @@ class PmlOb1:
             # inline wire write when possible (completion still via sack)
             if not (can_inline
                     and self.endpoint.try_send_inline(peer, hdr, payload)):
-                self._sendq.put(("frame", peer, hdr, payload,
-                                 _WireWatch(self, sid)))
+                self._enqueue_frame(peer, hdr, payload,
+                                    _WireWatch(self, sid))
         elif eager:
             hdr["t"] = "eager"
             # sendi fast path (≈ pml_ob1_isend.c:89-119): the frame goes
@@ -477,10 +481,10 @@ class PmlOb1:
             elif mode == "buffered":
                 wire = Request(kind="send")
                 wire.add_completion_callback(lambda _r: on_done())
-                self._sendq.put(("frame", peer, hdr, payload, wire))
+                self._enqueue_frame(peer, hdr, payload, wire)
                 req.complete(None)  # local completion
             else:
-                self._sendq.put(("frame", peer, hdr, payload, req))
+                self._enqueue_frame(peer, hdr, payload, req)
         else:
             sid = next(self._ids)
             hdr.update(t="rndv", size=len(payload), sid=sid)
@@ -496,8 +500,7 @@ class PmlOb1:
                 self._send_states[sid] = _SendState(
                     state_req, peer, payload,
                     None if mode == "buffered" else on_done)
-            self._sendq.put(("frame", peer, hdr, b"",
-                             _WireWatch(self, sid)))
+            self._enqueue_frame(peer, hdr, b"", _WireWatch(self, sid))
         self._drain_events()
         return req
 
@@ -739,9 +742,9 @@ class PmlOb1:
                 break
         if req is None:
             if hdr.get("sm") == "r":  # ready-mode: erroneous, nack sender
-                self._sendq.put(("frame", peer,
+                self._enqueue_frame(peer,
                                  {"t": "rnack", "sid": hdr["sid"]}, b"",
-                                 None))
+                                 None)
                 return
             # zero-copy self-BTL payloads alias the sender's live buffer —
             # an unexpected frame must own its bytes (the sender is free to
@@ -764,9 +767,9 @@ class PmlOb1:
         """Called with self._lock held. Eager: deliver now. Rndv: send CTS."""
         if hdr["t"] == "eager":
             if "sm" in hdr:  # sync/ready sender waits for the matched-ack
-                self._sendq.put(("frame", peer,
+                self._enqueue_frame(peer,
                                  {"t": "sack", "sid": hdr["sid"]}, b"",
-                                 None))
+                                 None)
             self._deliver(req, peer, hdr, payload)
         else:  # rndv
             # fragments land directly in the user buffer when it is posted,
@@ -782,9 +785,9 @@ class PmlOb1:
                 req, hdr["size"], hdr, peer, direct=direct)
             # CTS is a tiny control frame; safe to enqueue (never inline-send
             # from a reader thread)
-            self._sendq.put(("frame", peer,
+            self._enqueue_frame(peer,
                              {"t": "cts", "sid": hdr["sid"], "rid": req.rid},
-                             b"", None))
+                             b"", None)
 
     def _on_data(self, hdr: dict, payload: bytes) -> None:
         with self._lock:
@@ -865,6 +868,16 @@ class PmlOb1:
 
     # -- send worker (the only thread that writes payloads) ----------------
 
+    def _enqueue_frame(self, peer, hdr, payload, req) -> None:
+        """Queue one frame for the send worker, tracking the per-peer
+        in-queue count: inline sendi must not run while ANY frame for the
+        peer is still queued, or it would overtake (the queued frame may
+        be restamped into a later seq at delivery).  Uses its own lock —
+        several callers already hold self._lock."""
+        with self._qlock:
+            self._queued[peer] = self._queued.get(peer, 0) + 1
+        self._sendq.put(("frame", peer, hdr, payload, req))
+
     def _send_loop(self) -> None:
         frag = var_registry.get("pml_frag_size")
         while True:
@@ -906,11 +919,19 @@ class PmlOb1:
         rebind reset the seq space and re-stamped the parked frames) the
         healer flushes them in order.  Returns "sent" | "parked" |
         "failed" so multi-fragment callers can react to holes."""
+        with self._qlock:
+            n = self._queued.get(peer, 0)
+            if n > 1:
+                self._queued[peer] = n - 1
+            else:
+                self._queued.pop(peer, None)
         with self._lock:
+            # a frame stamped before an adopt (still queued while the
+            # peer re-incarnated) carries a fenced epoch — restamp at
+            # delivery, in queue order, so seqs stay monotone with the
+            # frames the adopt already restamped in the parked list
+            self._restamp_if_stale(peer, hdr)
             if peer in self._parked:     # keep order behind parked frames
-                # a frame stamped before an adopt but queued after it
-                # would carry a fenced epoch — restamp on arrival
-                self._restamp_if_stale(peer, hdr)
                 self._parked[peer].append((hdr, payload, req))
                 self.pvar_parked.inc()
                 return "parked"

@@ -355,6 +355,11 @@ class MultiHostLauncher:
             if self._lost_daemon is None:
                 self._lost_daemon = vpid
             self._cv.notify_all()
+        from ompi_tpu.runtime.notifier import Severity, notify
+
+        notify(Severity.CRITICAL, "daemon-lost",
+               f"orted vpid {vpid} vanished (host death/crash); "
+               f"aborting the job")
 
     def _daemon_monitor(self, job: Job) -> None:
         """Poll orted Popen handles: a dead daemon before job end = abort."""

@@ -1,0 +1,44 @@
+"""Shared-segment framework (≈ opal/mca/shmem mmap component)."""
+
+import os
+
+import pytest
+
+from ompi_tpu.core import shmseg
+
+
+def test_create_attach_roundtrip():
+    with shmseg.create("test_seg_rt", 4096) as seg:
+        assert seg.size == 4096
+        seg.buf[:5] = b"hello"
+        att = shmseg.attach(seg.path)
+        try:
+            assert att.size == 4096
+            assert bytes(att.buf[:5]) == b"hello"
+            att.buf[5:7] = b"!!"          # both directions
+            assert bytes(seg.buf[:7]) == b"hello!!"
+        finally:
+            att.detach()
+    assert not os.path.exists(seg.path)    # creator unlinked
+
+
+def test_attach_survives_unlink():
+    seg = shmseg.create("test_seg_unlink", 128)
+    att = shmseg.attach(seg.path)
+    seg.buf[:3] = b"abc"
+    seg.close()                            # unlink + detach
+    # the attached mapping stays valid after the name is gone
+    assert bytes(att.buf[:3]) == b"abc"
+    att.detach()
+
+
+def test_attach_rejects_garbage(tmp_path):
+    p = tmp_path / "junk"
+    p.write_bytes(b"\x00" * 64)
+    with pytest.raises(OSError):
+        shmseg.attach(str(p))
+
+
+def test_attach_missing_raises():
+    with pytest.raises(OSError):
+        shmseg.attach(os.path.join(shmseg.backing_dir(), "no-such-seg"))

@@ -47,8 +47,8 @@ def _random_type(rng, base, depth=0):
             disps.append(cur)
             cur += bl
         return base.indexed_block(bl, disps)
-    # nested: derived over a derived
-    inner = _random_type(rng, base, depth + 2)
+    # nested: derived over a derived (up to two levels of derivation)
+    inner = _random_type(rng, base, depth + 1)
     return inner.contiguous(int(rng.integers(1, 3)))
 
 
