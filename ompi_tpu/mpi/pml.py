@@ -308,6 +308,7 @@ class PmlOb1:
         self._parked: dict[int, list] = {}
         self._route_gen: dict[int, int] = {}   # bumped per adopted incarnation
         self._queued: dict[int, int] = {}      # frames in _sendq per peer
+        self._healing: set[int] = set()        # peers with a live healer
         self._qlock = threading.Lock()         # _queued has its own lock:
         # _enqueue_frame runs from handlers that already hold self._lock
         from ompi_tpu.mpi.mpit import Pvar, PvarClass, pvar_registry
@@ -971,9 +972,29 @@ class PmlOb1:
                        __import__("traceback").format_exc())
 
     def _schedule_heal(self, peer: int, deadline: float) -> None:
-        t = threading.Timer(0.1, self._heal_peer, args=(peer, deadline))
+        # singleton healer per peer: two concurrent heal loops would
+        # interleave their sends (the receiver's seq reorder absorbs it,
+        # but there is no reason to create the race)
+        with self._qlock:
+            if peer in self._healing:
+                return
+            self._healing.add(peer)
+        t = threading.Timer(0.1, self._run_heal, args=(peer, deadline))
         t.daemon = True
         t.start()
+
+    def _run_heal(self, peer: int, deadline: float) -> None:
+        try:
+            self._heal_peer(peer, deadline)
+        finally:
+            with self._qlock:
+                self._healing.discard(peer)
+            # frames parked between the healer draining and the discard
+            # need a new healer
+            with self._lock:
+                leftovers = bool(self._parked.get(peer))
+            if leftovers:
+                self._schedule_heal(peer, deadline)
 
     def _heal_peer(self, peer: int, deadline: float) -> None:
         while True:
@@ -1004,7 +1025,10 @@ class PmlOb1:
                             f"no route to rank {peer} within the retry "
                             f"window: {e}"))
                     return
-                self._schedule_heal(peer, deadline)
+                t = threading.Timer(0.1, self._heal_peer,
+                                    args=(peer, deadline))
+                t.daemon = True
+                t.start()
                 return
             except Exception as e:  # noqa: BLE001
                 with self._lock:
