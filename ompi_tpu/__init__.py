@@ -44,6 +44,8 @@ _LAZY = {
     "init": ("ompi_tpu.mpi.runtime", "init"),
     "finalize": ("ompi_tpu.mpi.runtime", "finalize"),
     "initialized": ("ompi_tpu.mpi.runtime", "initialized"),
+    "wtime": ("ompi_tpu.mpi.runtime", "wtime"),
+    "wtick": ("ompi_tpu.mpi.runtime", "wtick"),
     "COMM_WORLD": ("ompi_tpu.mpi.runtime", "COMM_WORLD"),
     "COMM_SELF": ("ompi_tpu.mpi.runtime", "COMM_SELF"),
     "Communicator": ("ompi_tpu.mpi.comm", "Communicator"),

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import atexit
 import threading
+import time
 from typing import Optional
 
 from ompi_tpu.core import output
@@ -23,7 +24,7 @@ from ompi_tpu.mpi.pml import pml_framework
 from ompi_tpu.runtime import pmix
 
 __all__ = ["init", "finalize", "initialized", "COMM_WORLD", "COMM_SELF",
-           "get_world"]
+           "get_world", "wtime", "wtick"]
 
 _log = output.get_stream("mpi")
 _lock = threading.Lock()
@@ -178,3 +179,17 @@ def _atexit_finalize() -> None:
         finalize(_collective=False)
     except Exception:
         pass
+
+
+def wtime() -> float:
+    """≈ MPI_Wtime: seconds from an arbitrary epoch, monotonic — the
+    clock choice lives in the sysinfo timer facade (one definition of
+    'the platform's best monotonic clock' for the whole framework)."""
+    from ompi_tpu.core.sysinfo import Timer
+
+    return Timer.cycles() / 1e9
+
+
+def wtick() -> float:
+    """≈ MPI_Wtick: resolution of :func:`wtime` in seconds."""
+    return time.get_clock_info("perf_counter").resolution
