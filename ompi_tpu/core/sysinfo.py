@@ -36,6 +36,12 @@ class Timer:
     def usec() -> float:
         return time.perf_counter_ns() / 1e3
 
+    @staticmethod
+    def resolution_s() -> float:
+        """Resolution of :meth:`cycles` in seconds (the underlying clock's
+        resolution, floored at the 1ns integer truncation)."""
+        return max(time.get_clock_info("perf_counter").resolution, 1e-9)
+
     def __init__(self) -> None:
         self._t0 = time.perf_counter_ns()
 

@@ -176,6 +176,15 @@ class DeviceCommunicator:
         return lax.all_to_all(x, self._ax, split_axis=split_axis,
                               concat_axis=concat_axis, tiled=True)
 
+    def alltoall_stacked(self, x, axis: Optional[str] = None):
+        """Leading-dim exchange (tiled=False all_to_all): x's axis 0 must
+        equal the mesh axis size; entry j of the result is what device j
+        sent me.  The dispatch shape expert/pipeline parallelism uses."""
+        from jax import lax
+
+        return lax.all_to_all(x, axis or self.axes[-1], split_axis=0,
+                              concat_axis=0, tiled=False)
+
     def gather(self, x, root: int = 0, axis: int = 0):
         """≈ MPI_Gather: allgather + zero on non-roots (see reduce note)."""
         import jax.numpy as jnp

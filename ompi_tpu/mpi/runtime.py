@@ -191,5 +191,8 @@ def wtime() -> float:
 
 
 def wtick() -> float:
-    """≈ MPI_Wtick: resolution of :func:`wtime` in seconds."""
-    return time.get_clock_info("perf_counter").resolution
+    """≈ MPI_Wtick: resolution of :func:`wtime` in seconds (from the same
+    sysinfo facade wtime reads its clock through)."""
+    from ompi_tpu.core.sysinfo import Timer
+
+    return Timer.resolution_s()

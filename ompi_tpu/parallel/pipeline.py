@@ -1,0 +1,84 @@
+"""Pipeline parallelism: a GPipe microbatch schedule over a ``pp`` axis.
+
+The fifth parallelism dimension (dp/sp/tp/ep/pp): layers shard into
+stages over ``pp``; activations flow stage→stage through single-hop
+``ppermute`` (the neighbor-exchange wire pattern of the reference's
+chain/pipeline broadcast, coll_base_bcast.c:257), and M microbatches keep
+every stage busy outside the (pp−1)-tick fill/drain bubbles.
+
+SPMD formulation (everything static for XLA): all devices run the same
+``lax.fori_loop`` of M+pp−1 ticks; at tick t device d computes microbatch
+``m = t − d`` (garbage outside [0, M) — discarded by masking, the
+standard bubble cost), then the activations rotate one hop while stage 0
+injects the next microbatch.  Outputs accumulate on the last stage and a
+final masked psum replicates them (one collective, for a clean return
+contract).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+__all__ = ["gpipe"]
+
+
+def gpipe(comm, stage_fn: Callable, stage_params, x, microbatches: int,
+          axis: str = "pp"):
+    """Run ``stage_fn(stage_params, h)`` as a pp-deep pipeline inside
+    shard_map.
+
+    - ``stage_params``: THIS device's stage weights (shard the stacked
+      per-stage pytree with ``P('pp')`` in the enclosing shard_map).
+    - ``x``: (B, ...) input, same on every device (or valid on stage 0 —
+      others' copies are ignored); B must divide by ``microbatches``.
+    - returns (B, ...) output of the full stage chain, replicated.
+
+    Activations must keep the same shape through every stage (uniform
+    pipelines — the GPipe assumption).
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    if axis not in comm.axes:
+        raise ValueError(f"axis {axis!r} not bound to this communicator "
+                         f"(axes {comm.axes})")
+    pp = int(comm.mesh.shape[axis])
+    d = lax.axis_index(axis)
+    B = x.shape[0]
+    M = microbatches
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    mb = B // M
+    x_mb = x.reshape((M, mb) + x.shape[1:])
+    if pp == 1:
+        y = stage_fn(stage_params, x)
+        return y
+
+    perm = [(i, i + 1) for i in range(pp - 1)]  # stage d → d+1 (no wrap)
+    last = pp - 1
+
+    def tick(t, carry):
+        cur, out = carry
+        y = stage_fn(stage_params, cur)          # bubbles compute garbage
+        m = t - d                                # my microbatch this tick
+        # last stage: write finished microbatch m into its output slot
+        m_clamp = jnp.clip(m, 0, M - 1)
+        valid_out = (d == last) & (m >= 0) & (m < M)
+        slot = lax.dynamic_index_in_dim(out, m_clamp, 0, keepdims=False)
+        out = lax.dynamic_update_index_in_dim(
+            out, jnp.where(valid_out, y, slot), m_clamp, 0)
+        # rotate activations one stage forward; stage 0 injects the next
+        shifted = comm.permute(y, perm, axis=axis)
+        nxt_idx = jnp.clip(t + 1, 0, M - 1)
+        inject = lax.dynamic_index_in_dim(x_mb, nxt_idx, 0,
+                                          keepdims=False)
+        cur = jnp.where(d == 0, inject, shifted)
+        return cur, out
+
+    cur0 = jnp.where(d == 0, x_mb[0], jnp.zeros_like(x_mb[0]))
+    out0 = jnp.zeros_like(x_mb)
+    _, out = lax.fori_loop(0, M + pp - 1, tick, (cur0, out0))
+    # replicate: every slot was written exactly once, on the last stage
+    out = comm.sub((axis,)).allreduce(
+        jnp.where(d == last, out, jnp.zeros_like(out)))
+    return out.reshape((B,) + x.shape[1:])
