@@ -49,14 +49,19 @@ for step in range(start, 5):
         os._exit(9)   # die AFTER committing snapshot 2
 
 # post-restart p2p both ways: revived 1 -> 0, then 0 -> revived 1 over
-# the REBOUND route
+# the REBOUND route — eager first, then a rendezvous-sized buffer (the
+# fragment pipeline must also ride the healed route)
 if rank == 1:
     comm.send(np.array([acc]), dest=0, tag=7)
     ack = comm.recv(source=0, tag=8)
     print(f"rank 1 got ack {float(ack[0]):.0f}", flush=True)
+    big = comm.recv(source=0, tag=9)
+    assert big.shape == (50_000,) and float(big[0]) == 42.0, big[:3]
+    print("rank 1 got rndv payload", flush=True)
 elif rank == 0:
     peer_acc = comm.recv(source=1, tag=7)
     comm.send(peer_acc + 1, dest=1, tag=8)
+    comm.send(np.full(50_000, 42.0), dest=1, tag=9)   # > eager limit
 
 print(f"rank {rank} acc={acc:.0f}", flush=True)
 ompi_tpu.finalize()
@@ -76,6 +81,7 @@ def test_respawn_recovers_rank_with_ckpt(tmp_path):
     assert "rank 2 acc=110" in r.stdout
     # the rebound 0→1 route delivered the ack (61)
     assert "rank 1 got ack 61" in r.stdout
+    assert "rank 1 got rndv payload" in r.stdout
 
 
 def test_respawn_exhausted_aborts(tmp_path):
