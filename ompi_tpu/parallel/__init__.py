@@ -1,12 +1,19 @@
 """Parallelism layer: mesh construction, sharding helpers, and the
-long-context/sequence-parallel primitives (ring attention, all-to-all head
-parallelism) built on the framework's device collectives.
+long-context/parallelism primitives built on the framework's device
+collectives — all five dimensions:
+
+- **dp/sp/tp** — data, sequence (ring/Ulysses attention), and Megatron
+  tensor parallelism (``attention``, ``layers``, the flagship model);
+- **ep** — switch-MoE expert parallelism over all_to_all (``moe``);
+- **pp** — GPipe pipeline schedule over ppermute (``pipeline``).
 
 These are the TPU-native expression of the reference's communication
 patterns (SURVEY.md §5): ring attention is the segmented-ring allreduce
-shape (coll_base_allreduce.c:615) with double buffering; Ulysses-style
-sequence parallelism is the pairwise alltoall (coll_base_alltoall.c:132)
-over the head dimension.
+shape (coll_base_allreduce.c:615) with double buffering; Ulysses and MoE
+dispatch are the pairwise alltoall (coll_base_alltoall.c:132); the
+pipeline handoff is the chain bcast's neighbor hop (coll_base_bcast.c:257).
 """
 
 from ompi_tpu.parallel.mesh import make_mesh, mesh_shape_for
+from ompi_tpu.parallel.moe import moe_params, switch_moe
+from ompi_tpu.parallel.pipeline import gpipe
