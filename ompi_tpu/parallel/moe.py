@@ -50,10 +50,16 @@ def moe_params(rng, d_model: int, d_ff: int, n_experts: int,
 
 def switch_moe(comm, x, params, axis: str = "ep",
                capacity_factor: float = 1.25,
-               capacity: Optional[int] = None):
+               capacity: Optional[int] = None,
+               with_aux: bool = False):
     """Top-1 MoE layer inside shard_map: x (B, T, D) local tokens →
     (B, T, D).  ``params['w1']/['w2']`` hold the LOCAL experts
     (E_local = E / ep_size rows on each device); ``wg`` is replicated.
+
+    ``with_aux=True`` additionally returns the switch load-balancing
+    loss ``E · Σ_e f_e · p_e`` (fraction routed × mean gate prob per
+    expert, over THIS device's tokens) — add it to the training loss
+    scaled by ~1e-2 or experts collapse onto one device.
 
     Call with ``axis=None`` (or an absent axis) for the single-device
     degenerate case — routing and capacity behave identically, only the
@@ -128,4 +134,12 @@ def switch_moe(comm, x, params, axis: str = "ep",
     # tokens contribute zero (their residual path carries them)
     y = jnp.einsum("tec,ecd->td", dis, out)
     y = y * gate[:, None].astype(x.dtype)
-    return y.reshape(B, T, D)
+    y = y.reshape(B, T, D)
+    if not with_aux:
+        return y
+    # switch load-balancing loss (Fedus et al.): differentiable through
+    # the mean gate prob; the routed fraction is the (stop-grad) signal
+    frac = jnp.mean(onehot.astype(jnp.float32), axis=0)      # (E,)
+    mean_p = jnp.mean(probs, axis=0)                         # (E,)
+    aux = E * jnp.sum(frac * mean_p)
+    return y, aux

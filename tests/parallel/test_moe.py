@@ -94,3 +94,36 @@ def test_switch_moe_differentiable(mesh_ep):
         x, params["wg"], params["w1"], params["w2"])
     for g in grads:
         assert np.isfinite(np.asarray(g)).all()
+
+
+def test_switch_moe_aux_loss(mesh_ep):
+    """Load-balancing loss: 1.0 at perfect balance, larger when skewed,
+    and differentiable w.r.t. the gate weights."""
+    rng = np.random.default_rng(3)
+    B, T, D, F, E = 1, 16, 16, 32, 8
+    x = rng.normal(size=(B, T, D)).astype(np.float32)
+    params = moe_params(rng, D, F, E)
+    mesh = Mesh(np.array(jax.devices()[:1]), axis_names=("one",))
+    comm = DeviceCommunicator(mesh, ("one",))
+
+    def run(wg):
+        fn = jax.shard_map(
+            lambda a, g: switch_moe(comm, a, {"wg": g, "w1": params["w1"],
+                                              "w2": params["w2"]},
+                                    axis="one", capacity=T,
+                                    with_aux=True),
+            mesh=mesh, in_specs=(P(), P()), out_specs=(P(), P()),
+            check_vma=False)
+        return fn(x, wg)
+
+    y, aux = run(params["wg"])
+    assert y.shape == (B, T, D)
+    # aux >= 1 always (Cauchy-Schwarz: E·Σ f_e·p_e minimized at balance)
+    assert float(aux) >= 0.99
+    # an extreme gate bias toward one expert drives aux toward E
+    skew = params["wg"].copy()
+    skew[:, 0] += 100.0
+    _, aux_skew = run(skew)
+    assert float(aux_skew) > float(aux)
+    g = jax.grad(lambda wg: run(wg)[1])(params["wg"])
+    assert np.isfinite(np.asarray(g)).all() and np.abs(np.asarray(g)).sum() > 0
