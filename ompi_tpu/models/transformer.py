@@ -35,6 +35,13 @@ class TransformerConfig:
     attention: str = "ring"  # ring | ulysses | flash | gathered
     # ("flash" = ulysses resharding + the pallas flash kernel for the
     # local attention — offsets are static there, so the kernel applies)
+    # MoE model family: >0 replaces every layer's dense FFN with a
+    # switch-MoE of this many experts, sharded over the mesh's "ep" axis
+    # (experts % ep == 0); the load-balancing aux loss joins the training
+    # loss with weight moe_aux_weight.
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
     compute_dtype: Any = "bfloat16"
     # jax.checkpoint policy per layer — HBM ↔ FLOPs trade:
     #   True/"full" = save only layer inputs (max recompute, min HBM);
@@ -57,27 +64,45 @@ def init_params(cfg: TransformerConfig, seed: int = 0) -> dict:
         scale = scale if scale is not None else (shape[-2] ** -0.5)
         return rng.normal(0, scale, size=shape).astype(np.float32)
 
-    return {
+    params = {
         "emb": w(V, D, scale=0.02),
         "wq": w(L, D, D), "wk": w(L, D, D), "wv": w(L, D, D),
         "wo": w(L, D, D, scale=(D ** -0.5) / max(1, 2 * L) ** 0.5),
-        "w1": w(L, D, F),
-        "w2": w(L, F, D, scale=(F ** -0.5) / max(1, 2 * L) ** 0.5),
         "ln1": np.ones((L, D), np.float32),
         "ln2": np.ones((L, D), np.float32),
         "lnf": np.ones((D,), np.float32),
     }
+    if cfg.moe_experts:
+        E = cfg.moe_experts
+        params["wg"] = w(L, D, E, scale=0.02)
+        params["w1"] = w(L, E, D, F)
+        params["w2"] = w(L, E, F, D,
+                         scale=(F ** -0.5) / max(1, 2 * L) ** 0.5)
+    else:
+        params["w1"] = w(L, D, F)
+        params["w2"] = w(L, F, D, scale=(F ** -0.5) / max(1, 2 * L) ** 0.5)
+    return params
 
 
-def param_specs(P):
-    """PartitionSpecs: attention/MLP weights tp-sharded Megatron-style,
-    everything else replicated (grad-synced over dp/sp by the AD transpose)."""
-    return {
+def param_specs(P, cfg: Optional[TransformerConfig] = None, mesh=None):
+    """PartitionSpecs: attention weights tp-sharded Megatron-style, dense
+    FFN tp-sharded, MoE experts ep-sharded (replicated when the mesh has
+    no "ep" axis), everything else replicated (grad-synced over dp/sp by
+    the AD transpose)."""
+    specs = {
         "emb": P(), "lnf": P(), "ln1": P(), "ln2": P(),
         "wq": P(None, None, "tp"), "wk": P(None, None, "tp"),
         "wv": P(None, None, "tp"), "wo": P(None, "tp", None),
-        "w1": P(None, None, "tp"), "w2": P(None, "tp", None),
     }
+    if cfg is not None and cfg.moe_experts:
+        has_ep = mesh is not None and "ep" in mesh.axis_names
+        specs["wg"] = P()
+        specs["w1"] = P(None, "ep", None, None) if has_ep else P()
+        specs["w2"] = P(None, "ep", None, None) if has_ep else P()
+    else:
+        specs["w1"] = P(None, None, "tp")
+        specs["w2"] = P(None, "tp", None)
+    return specs
 
 
 def _rmsnorm(x, scale):
@@ -108,7 +133,8 @@ def _rope(x, positions):
 def _local_forward(cfg: TransformerConfig, comm, params, tokens):
     """Per-device forward inside shard_map.
 
-    tokens: (B/dp, S/sp) int32.  Returns logits (B/dp, S/sp, V) float32.
+    tokens: (B/dp, S/sp) int32.  Returns (logits (B/dp, S/sp, V) float32,
+    aux) — aux is the summed MoE load-balancing loss (0.0 for dense).
     """
     import jax
     import jax.numpy as jnp
@@ -116,6 +142,7 @@ def _local_forward(cfg: TransformerConfig, comm, params, tokens):
 
     from ompi_tpu.parallel import attention as attn_mod
     from ompi_tpu.parallel.layers import column_parallel, row_parallel
+    from ompi_tpu.parallel.moe import switch_moe
 
     cdt = jnp.dtype(cfg.compute_dtype)
     tp = int(comm.mesh.shape["tp"])
@@ -149,13 +176,27 @@ def _local_forward(cfg: TransformerConfig, comm, params, tokens):
         o = o.reshape(B, t, h_local * hd)
         h = h + row_parallel(o, lp["wo"].astype(cdt), comm, axis="tp")
         x = _rmsnorm(h, lp["ln2"])
-        y = column_parallel(x, lp["w1"].astype(cdt))
-        y = jax.nn.gelu(y)
-        h = h + row_parallel(y, lp["w2"].astype(cdt), comm, axis="tp")
-        return h, None
+        if cfg.moe_experts:
+            # MoE family: expert-parallel switch FFN over the "ep" axis
+            # (tp ranks replicate the expert compute — activations are
+            # identical across tp after the row_parallel psum)
+            mo, aux = switch_moe(
+                comm, x, {"wg": lp["wg"], "w1": lp["w1"],
+                          "w2": lp["w2"]},
+                axis="ep", capacity_factor=cfg.moe_capacity_factor,
+                with_aux=True)
+            h = h + mo
+        else:
+            y = column_parallel(x, lp["w1"].astype(cdt))
+            y = jax.nn.gelu(y)
+            h = h + row_parallel(y, lp["w2"].astype(cdt), comm, axis="tp")
+            aux = jnp.zeros((), jnp.float32)
+        return h, aux
 
-    layer_params = {k: params[k] for k in
-                    ("wq", "wk", "wv", "wo", "w1", "w2", "ln1", "ln2")}
+    keys = ["wq", "wk", "wv", "wo", "w1", "w2", "ln1", "ln2"]
+    if cfg.moe_experts:
+        keys.append("wg")
+    layer_params = {k: params[k] for k in keys}
     if cfg.remat in (True, "full"):
         layer_fn = jax.checkpoint(layer)
     elif cfg.remat == "dots":
@@ -163,13 +204,13 @@ def _local_forward(cfg: TransformerConfig, comm, params, tokens):
             layer, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
     else:
         layer_fn = layer
-    h, _ = lax.scan(layer_fn, h, layer_params)
+    h, aux = lax.scan(layer_fn, h, layer_params)
     h = _rmsnorm(h, params["lnf"])
     # unembed on the MXU in compute dtype, f32 accumulation — a f32×f32
     # matmul here would run at a fraction of the bf16 rate
     logits = jnp.einsum("btd,vd->btv", h, params["emb"].astype(cdt),
                         preferred_element_type=jnp.float32)
-    return logits
+    return logits, aux.sum()
 
 
 def _local_loss(cfg: TransformerConfig, comm, params, tokens):
@@ -182,7 +223,7 @@ def _local_loss(cfg: TransformerConfig, comm, params, tokens):
     sp = int(comm.mesh.shape["sp"])
     T = tokens.shape[1]
     sp_idx = lax.axis_index("sp")
-    logits = _local_forward(cfg, comm, params, tokens)
+    logits, aux = _local_forward(cfg, comm, params, tokens)
 
     # labels: tokens shifted left by one *global* position
     first_col = tokens[:, :1]
@@ -200,7 +241,13 @@ def _local_loss(cfg: TransformerConfig, comm, params, tokens):
     local_cnt = weight.sum() * tokens.shape[0]
     total = lax.psum(local_sum, ("dp", "sp"))
     count = lax.psum(local_cnt, ("dp", "sp"))
-    return total / count
+    loss = total / count
+    if cfg.moe_experts:
+        # average the per-device balance loss over the whole mesh (tp/ep
+        # ranks see replicated tokens, so the mean is layout-invariant)
+        aux_mean = lax.psum(aux, comm.axes) / comm.size
+        loss = loss + cfg.moe_aux_weight * aux_mean
+    return loss
 
 
 def make_loss_fn(cfg: TransformerConfig, mesh):
@@ -210,12 +257,14 @@ def make_loss_fn(cfg: TransformerConfig, mesh):
 
     from ompi_tpu.mpi.device_comm import DeviceCommunicator
 
-    comm = DeviceCommunicator(mesh, ("dp", "sp", "tp"))
+    axes = tuple(a for a in ("dp", "sp", "tp", "ep")
+                 if a in mesh.axis_names)
+    comm = DeviceCommunicator(mesh, axes)
 
     local = functools.partial(_local_loss, cfg, comm)
     return jax.shard_map(
         local, mesh=mesh,
-        in_specs=(param_specs(P), P("dp", "sp")),
+        in_specs=(param_specs(P, cfg, mesh), P("dp", "sp")),
         out_specs=P(), check_vma=False)
 
 
@@ -226,11 +275,16 @@ def make_forward(cfg: TransformerConfig, mesh):
 
     from ompi_tpu.mpi.device_comm import DeviceCommunicator
 
-    comm = DeviceCommunicator(mesh, ("dp", "sp", "tp"))
-    local = functools.partial(_local_forward, cfg, comm)
+    axes = tuple(a for a in ("dp", "sp", "tp", "ep")
+                 if a in mesh.axis_names)
+    comm = DeviceCommunicator(mesh, axes)
+
+    def local(params, tokens):
+        return _local_forward(cfg, comm, params, tokens)[0]  # drop aux
+
     return jax.shard_map(
         local, mesh=mesh,
-        in_specs=(param_specs(P), P("dp", "sp")),
+        in_specs=(param_specs(P, cfg, mesh), P("dp", "sp")),
         out_specs=P("dp", "sp", None), check_vma=False)
 
 

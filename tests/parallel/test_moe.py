@@ -96,7 +96,7 @@ def test_switch_moe_differentiable(mesh_ep):
         assert np.isfinite(np.asarray(g)).all()
 
 
-def test_switch_moe_aux_loss(mesh_ep):
+def test_switch_moe_aux_loss():
     """Load-balancing loss: 1.0 at perfect balance, larger when skewed,
     and differentiable w.r.t. the gate weights."""
     rng = np.random.default_rng(3)
