@@ -21,6 +21,9 @@ _REGISTERING_MODULES = [
     "ompi_tpu.runtime.rmaps",
     "ompi_tpu.runtime.errmgr",
     "ompi_tpu.runtime.launcher",
+    "ompi_tpu.runtime.notifier",
+    "ompi_tpu.runtime.rtc",
+    "ompi_tpu.runtime.plm",
     "ompi_tpu.mpi.coll",
     "ompi_tpu.mpi.coll.host",
     "ompi_tpu.mpi.coll.selfcoll",
@@ -28,6 +31,9 @@ _REGISTERING_MODULES = [
     "ompi_tpu.mpi.pml",
     "ompi_tpu.mpi.op",
     "ompi_tpu.mpi.io",
+    "ompi_tpu.mpi.btl_shm",
+    "ompi_tpu.core.memchecker",
+    "ompi_tpu.parallel.multihost",
     "ompi_tpu.shmem.api",
 ]
 
