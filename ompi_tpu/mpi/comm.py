@@ -260,6 +260,54 @@ class Communicator:
             st.source = self.group.rank_of(st.source)
         return st
 
+    # -- matched probe (≈ MPI_Mprobe/Improbe/Mrecv/Imrecv, mprobe.c:1) -----
+
+    def _msg_no_proc(self):
+        from ompi_tpu.mpi.pml import MESSAGE_NO_PROC
+
+        st = Status()
+        st.source = PROC_NULL
+        st.tag = ANY_TAG
+        st.count = 0
+        return MESSAGE_NO_PROC, st
+
+    def mprobe(self, source: int = -1, tag: int = ANY_TAG,
+               timeout: Optional[float] = None):
+        """Blocking match-and-detach → (Message, Status).  The returned
+        handle is consumed by exactly one mrecv/imrecv; no other recv or
+        probe can see the message once detached."""
+        if source == PROC_NULL:
+            return self._msg_no_proc()
+        src = source if source < 0 else self.world_rank(source)
+        msg, st = self.pml.mprobe(src, tag, self.cid, timeout=timeout)
+        if st.source >= 0:
+            st.source = self.group.rank_of(st.source)
+        return msg, st
+
+    def improbe(self, source: int = -1, tag: int = ANY_TAG):
+        """Nonblocking match-and-detach → (Message, Status) or None."""
+        if source == PROC_NULL:
+            return self._msg_no_proc()
+        src = source if source < 0 else self.world_rank(source)
+        out = self.pml.improbe(src, tag, self.cid)
+        if out is None:
+            return None
+        msg, st = out
+        if st.source >= 0:
+            st.source = self.group.rank_of(st.source)
+        return msg, st
+
+    def imrecv(self, buf=None, message=None, datatype=None,
+               count=None) -> Request:
+        return self.pml.imrecv(buf, message, datatype, count)
+
+    def mrecv(self, buf=None, message=None, datatype=None, count=None,
+              status: Optional[Status] = None) -> np.ndarray:
+        out = self.pml.mrecv(buf, message, datatype, count, status)
+        if status is not None and status.source >= 0:
+            status.source = self.group.rank_of(status.source)
+        return out
+
     # internal p2p on the reserved tag space (collectives use these)
 
     def _coll_isend(self, buf, dest: int, coll_tag: int) -> Request:

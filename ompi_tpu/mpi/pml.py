@@ -35,11 +35,14 @@ from ompi_tpu.core.config import VarType, register_var, var_registry
 from ompi_tpu.core.mca import Component, Framework
 from ompi_tpu.mpi import datatype as dt_mod
 from ompi_tpu.mpi.btl import BtlEndpoint
-from ompi_tpu.mpi.constants import ANY_SOURCE, ANY_TAG, ERR_TRUNCATE, MPIException
+from ompi_tpu.mpi.constants import (
+    ANY_SOURCE, ANY_TAG, ERR_TRUNCATE, PROC_NULL, MPIException,
+)
 from ompi_tpu.mpi.datatype import Datatype
 from ompi_tpu.mpi.request import Request, Status
 
-__all__ = ["pml_framework", "PmlOb1", "RecvRequest"]
+__all__ = ["pml_framework", "PmlOb1", "RecvRequest", "Message",
+           "MESSAGE_NO_PROC"]
 
 
 def _reject_device(buf: Any, what: str) -> None:
@@ -101,6 +104,33 @@ class RecvRequest(Request):
                 return  # already matched — delivery wins
         self.cancelled = True
         self.complete(None)
+
+
+class Message:
+    """≈ MPI_Message: one matched-and-detached incoming message
+    (ompi/mpi/c/mprobe.c:1, imrecv.c:1).  Once mprobe/improbe returns a
+    handle, the message can no longer match any other recv or probe;
+    exactly one mrecv/imrecv consumes it.  This is the only thread-safe
+    probe-then-receive with wildcards: the match and the detach happen
+    atomically under the PML lock."""
+
+    __slots__ = ("pml", "peer", "hdr", "payload", "consumed")
+
+    def __init__(self, pml, peer: int, hdr: dict, payload) -> None:
+        self.pml = pml
+        self.peer = peer
+        self.hdr = hdr
+        self.payload = payload
+        self.consumed = False
+
+    @property
+    def no_proc(self) -> bool:
+        return self.pml is None
+
+
+#: ≈ MPI_MESSAGE_NO_PROC — what a matched probe of PROC_NULL returns;
+#: mrecv on it completes immediately with an empty buffer.
+MESSAGE_NO_PROC = Message(None, -1, {}, b"")
 
 
 def _dtype_to_wire(dt: np.dtype):
@@ -260,9 +290,13 @@ class _Matching:
 def _hdr_matches(req: RecvRequest, peer: int, hdr: dict) -> bool:
     if req.source != ANY_SOURCE and req.source != peer:
         return False
-    if req.tag != ANY_TAG and req.tag != hdr["tag"]:
-        return False
-    return True
+    if req.tag == ANY_TAG:
+        # ANY_TAG never matches the reserved negative tag space (internal
+        # collective traffic) — same guard as the reference's ob1 matching;
+        # without it a user wildcard recv posted before a barrier would
+        # steal the barrier's control frames
+        return hdr["tag"] >= 0
+    return req.tag == hdr["tag"]
 
 
 # request-lifecycle events (≈ the PERUSE spec, ompi/peruse/peruse.h:55-76:
@@ -308,6 +342,11 @@ class PmlOb1:
         self._parked: dict[int, list] = {}
         self._route_gen: dict[int, int] = {}   # bumped per adopted incarnation
         self._queued: dict[int, int] = {}      # frames in _sendq per peer
+        # header refs of frames still in _sendq, FIFO per peer: an adopt
+        # must restamp these in queue order (parked first, then these) so
+        # an isend issued after the adopt draws a LATER seq than every
+        # frame queued before it — queue order and seq order stay aligned
+        self._inqueue: dict[int, collections.deque] = {}
         self._healing: set[int] = set()        # peers with a live healer
         self._qlock = threading.Lock()         # _queued has its own lock:
         # _enqueue_frame runs from handlers that already hold self._lock
@@ -577,13 +616,20 @@ class PmlOb1:
 
     def probe(self, source: int, tag: int, cid: int,
               timeout: Optional[float] = None) -> Status:
+        # deadline computed ONCE: every unexpected frame notifies the cv,
+        # so restarting the full timeout per wakeup would never expire
+        # under unrelated traffic
+        deadline = None if timeout is None else time.monotonic() + timeout
         with self._cv:
             while True:
                 st = self._iprobe_locked(source, tag, cid)
                 if st is not None:
                     return st
-                if not self._cv.wait(timeout=timeout):
+                left = (None if deadline is None
+                        else deadline - time.monotonic())
+                if left is not None and left <= 0:
                     raise TimeoutError("probe timed out")
+                self._cv.wait(timeout=left)
 
     def _iprobe_locked(self, source: int, tag: int, cid: int) -> Optional[Status]:
         probe = RecvRequest(None, dt_mod.BYTE, 0, source, tag, cid)
@@ -595,6 +641,105 @@ class PmlOb1:
                 st.count = hdr.get("elems", hdr.get("size", len(payload)))
                 return st
         return None
+
+    # -- matched probe (≈ ompi/mpi/c/mprobe.c, improbe.c, mrecv.c) ---------
+
+    def improbe(self, source: int, tag: int,
+                cid: int) -> Optional[tuple[Message, Status]]:
+        """Match-and-detach: the matched frame leaves the unexpected
+        queue atomically under the PML lock, so a racing recv or probe in
+        another thread can never see it — the race MPI_Mprobe exists to
+        close (a plain probe's status can be stolen by another thread's
+        wildcard recv before this thread posts its own)."""
+        with self._lock:
+            return self._improbe_locked(source, tag, cid)
+
+    def _improbe_locked(self, source: int, tag: int,
+                        cid: int) -> Optional[tuple[Message, Status]]:
+        probe = RecvRequest(None, dt_mod.BYTE, 0, source, tag, cid)
+        m = self._matching_for(cid)
+        for i, (peer, hdr, payload) in enumerate(m.unexpected):
+            if _hdr_matches(probe, peer, hdr):
+                del m.unexpected[i]
+                if hdr.get("sm") == "s":
+                    # matching happens HERE: a sync-mode sender completes
+                    # at match time (the MPI ssend contract — the recv
+                    # has "started"), not when mrecv later drains it
+                    self._enqueue_frame(
+                        peer, {"t": "sack", "sid": hdr["sid"]}, b"", None)
+                    hdr = {k: v for k, v in hdr.items()
+                           if k not in ("sm", "sid")}
+                st = Status()
+                st.source = peer
+                st.tag = hdr["tag"]
+                st.count = hdr.get("elems", hdr.get("size", len(payload)))
+                return Message(self, peer, hdr, payload), st
+        return None
+
+    def mprobe(self, source: int, tag: int, cid: int,
+               timeout: Optional[float] = None) -> tuple[Message, Status]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while True:
+                out = self._improbe_locked(source, tag, cid)
+                if out is not None:
+                    return out
+                left = (None if deadline is None
+                        else deadline - time.monotonic())
+                if left is not None and left <= 0:
+                    raise TimeoutError("mprobe timed out")
+                self._cv.wait(timeout=left)
+
+    def imrecv(self, buf: Optional[np.ndarray], message: Message,
+               datatype: Optional[Datatype] = None,
+               count: Optional[int] = None) -> RecvRequest:
+        """Receive the detached message; consumes the handle.  Eager
+        payloads deliver immediately; a detached rendezvous replies with
+        its CTS now, exactly as a matching irecv would have."""
+        if message.no_proc:
+            req = RecvRequest(None, dt_mod.BYTE, 0, -1, -1, -1)
+            req.status.source = PROC_NULL
+            req.status.tag = ANY_TAG
+            req.status.count = 0
+            req.complete(np.empty(0, dtype=np.uint8))
+            return req
+        if message.consumed:
+            raise MPIException("message handle was already received")
+        message.consumed = True
+        if buf is not None:
+            _reject_device(buf, "imrecv")
+            buf = np.asarray(buf)
+            if self._memcheck:
+                from ompi_tpu.core import memchecker
+
+                memchecker.prepare_recv(buf, "imrecv")
+            if datatype is None:
+                datatype = dt_mod.from_numpy(buf.dtype)
+            if count is None:
+                count = buf.size // max(1, datatype.elements_per_item)
+        req = RecvRequest(buf, datatype, count, message.peer,
+                          message.hdr["tag"], message.hdr["cid"])
+        req.rid = next(self._ids)
+        req._pml = self
+        if self._listeners:  # balanced post/match pair, like irecv's path
+            self._emit(EVT_RECV_POST, peer=message.peer,
+                       tag=message.hdr["tag"], cid=message.hdr["cid"])
+            self._emit(EVT_MATCH, peer=message.peer,
+                       tag=message.hdr["tag"], cid=message.hdr["cid"])
+        with self._lock:
+            self._match(req, message.peer, message.hdr, message.payload)
+        self._drain_events()
+        return req
+
+    def mrecv(self, buf: Optional[np.ndarray], message: Message,
+              datatype: Optional[Datatype] = None,
+              count: Optional[int] = None,
+              status: Optional[Status] = None) -> np.ndarray:
+        req = self.imrecv(buf, message, datatype, count)
+        out = req.wait()
+        if status is not None:
+            status.__dict__.update(req.status.__dict__)
+        return out
 
     # -- frame handling (reader threads; NEVER blocking-send here) ---------
 
@@ -627,6 +772,15 @@ class PmlOb1:
         self._route_gen[peer] = self._route_gen.get(peer, 0) + 1
         for hdr, _payload, _req in self._parked.get(peer, []):
             self._restamp_if_stale(peer, hdr)
+        # ...then the frames still sitting in the send queue, in FIFO
+        # order (they are younger than every parked frame — parked frames
+        # left the queue earlier).  Without this, a frame queued before
+        # the adopt would draw its fresh seq only at delivery time, AFTER
+        # a newer isend already took an earlier seq: non-overtaking
+        # violated in the respawn race window.
+        with self._qlock:
+            for qhdr in self._inqueue.get(peer, ()):
+                self._restamp_if_stale(peer, qhdr)
 
     def _restamp_if_stale(self, peer: int, hdr: dict) -> None:
         """With self._lock held: a seq-carrying frame stamped for an older
@@ -877,7 +1031,11 @@ class PmlOb1:
         several callers already hold self._lock."""
         with self._qlock:
             self._queued[peer] = self._queued.get(peer, 0) + 1
-        self._sendq.put(("frame", peer, hdr, payload, req))
+            self._inqueue.setdefault(peer, collections.deque()).append(hdr)
+            # the put stays inside _qlock so _inqueue's FIFO order matches
+            # _sendq's consumption order (the worker popleft must see the
+            # same hdr it just dequeued)
+            self._sendq.put(("frame", peer, hdr, payload, req))
 
     def _send_loop(self) -> None:
         frag = var_registry.get("pml_frag_size")
@@ -899,7 +1057,8 @@ class PmlOb1:
                             state.peer,
                             {"t": "data", "rid": rid, "off": off},
                             data[off:off + frag],
-                            state.req if last else None)
+                            state.req if last else None,
+                            tracked=False)
                         if out == "failed":
                             # a hole in the stream: the request must FAIL,
                             # not complete on a later fragment
@@ -912,26 +1071,51 @@ class PmlOb1:
                 _log.error("send worker: unexpected error\n%s",
                            __import__("traceback").format_exc())
 
-    def _deliver_frame(self, peer, hdr, payload, req) -> str:
+    def _dequeue_tracking(self, peer, hdr) -> None:
+        """With self._qlock held: retire one frame from the per-peer
+        in-queue accounting (count + the restampable header list)."""
+        n = self._queued.get(peer, 0)
+        if n > 1:
+            self._queued[peer] = n - 1
+        else:
+            self._queued.pop(peer, None)
+        q = self._inqueue.get(peer)
+        if q:
+            head = q.popleft()
+            if head is not hdr:  # defensive: FIFO invariant broken
+                q.appendleft(head)
+                try:
+                    q.remove(hdr)
+                except ValueError:
+                    pass
+            if not q:
+                self._inqueue.pop(peer, None)
+
+    def _deliver_frame(self, peer, hdr, payload, req, tracked=True) -> str:
         """Send-worker delivery with park-and-heal (≈ pml/bfo's failover
         retransmit): a frame that cannot be routed (peer dead or
         mid-respawn) parks in a per-peer ordered list; a healer retries
         within ``pml_retry_window``; once routes heal (the revived peer's
         rebind reset the seq space and re-stamped the parked frames) the
         healer flushes them in order.  Returns "sent" | "parked" |
-        "failed" so multi-fragment callers can react to holes."""
-        with self._qlock:
-            n = self._queued.get(peer, 0)
-            if n > 1:
-                self._queued[peer] = n - 1
-            else:
-                self._queued.pop(peer, None)
+        "failed" so multi-fragment callers can react to holes.
+
+        ``tracked`` is False for rendezvous data fragments: they never
+        passed through _enqueue_frame, so they must not decrement the
+        per-peer queued count (which would let an inline sendi overtake
+        frames that ARE still queued)."""
         with self._lock:
             # a frame stamped before an adopt (still queued while the
             # peer re-incarnated) carries a fenced epoch — restamp at
             # delivery, in queue order, so seqs stay monotone with the
-            # frames the adopt already restamped in the parked list
+            # frames the adopt already restamped in the parked list.
+            # Restamp BEFORE retiring the frame from _inqueue (both under
+            # self._lock) so an adopt either restamps it in the queue or
+            # observes it already restamped — never neither.
             self._restamp_if_stale(peer, hdr)
+            if tracked:
+                with self._qlock:
+                    self._dequeue_tracking(peer, hdr)
             if peer in self._parked:     # keep order behind parked frames
                 self._parked[peer].append((hdr, payload, req))
                 self.pvar_parked.inc()
@@ -985,24 +1169,40 @@ class PmlOb1:
 
     def _run_heal(self, peer: int, deadline: float) -> None:
         try:
-            self._heal_peer(peer, deadline)
-        finally:
-            with self._qlock:
-                self._healing.discard(peer)
-            # frames parked between the healer draining and the discard
-            # need a new healer
-            with self._lock:
-                leftovers = bool(self._parked.get(peer))
-            if leftovers:
-                self._schedule_heal(peer, deadline)
+            retry = self._heal_peer(peer, deadline)
+        except Exception:  # noqa: BLE001 — healer must not die holding the guard
+            _log.error("healer for %d raised\n%s", peer,
+                       __import__("traceback").format_exc())
+            retry = False
+        if retry:
+            # Chain the continuation WITHOUT leaving _healing: exactly
+            # one healer chain may exist per peer.  Two concurrent loops
+            # would both read parked[0] (duplicate frame on the wire)
+            # and each pop one entry, silently dropping a never-sent
+            # frame.
+            t = threading.Timer(0.1, self._run_heal, args=(peer, deadline))
+            t.daemon = True
+            t.start()
+            return
+        with self._qlock:
+            self._healing.discard(peer)
+        # frames parked between the healer draining and the discard
+        # need a new healer
+        with self._lock:
+            leftovers = bool(self._parked.get(peer))
+        if leftovers:
+            self._schedule_heal(peer, deadline)
 
-    def _heal_peer(self, peer: int, deadline: float) -> None:
+    def _heal_peer(self, peer: int, deadline: float) -> bool:
+        """Drain peer's parked frames.  Returns True when the caller
+        (_run_heal) should chain another attempt after a backoff — the
+        route is still down but the retry window is open."""
         while True:
             with self._lock:
                 parked = self._parked.get(peer)
                 if not parked:
                     self._parked.pop(peer, None)
-                    return
+                    return False
                 # seq re-stamping happened in _adopt_incarnation (under
                 # the lock that reset the counters).  Serialize a COPY of
                 # the header and remember the route generation: an adopt
@@ -1024,12 +1224,8 @@ class PmlOb1:
                         self._fail_req(r, MPIException(
                             f"no route to rank {peer} within the retry "
                             f"window: {e}"))
-                    return
-                t = threading.Timer(0.1, self._heal_peer,
-                                    args=(peer, deadline))
-                t.daemon = True
-                t.start()
-                return
+                    return False
+                return True
             except Exception as e:  # noqa: BLE001
                 with self._lock:
                     parked = self._parked.get(peer)

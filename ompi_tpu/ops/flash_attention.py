@@ -173,8 +173,11 @@ def _bwd_dq_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, g_ref, lse_ref,
     iq = pl.program_id(1)
     q = q_ref[0]                                             # (bq, D)
     g = g_ref[0]
-    lse = lse_ref[0, :, 0]                                   # (bq,)
-    dm = dm_ref[0, :, 0]                                     # (bq,)
+    # lse/dm ride the forward's (…, 8, block_q) sublane-broadcast layout
+    # (a (block_q, 1) trailing-dim block does not lower on TPU); read
+    # sublane 0
+    lse = lse_ref[0, 0, 0]                                   # (bq,)
+    dm = dm_ref[0, 0, 0]                                     # (bq,)
     qpos = (qoff_ref[0] + iq * block_q
             + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0))
 
@@ -219,8 +222,8 @@ def _bwd_dkv_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, g_ref,
         dk, dv = carry
         q = q_ref[0, pl.ds(i * block_q, block_q), :]         # (bq, D)
         g = g_ref[0, pl.ds(i * block_q, block_q), :]
-        lse = lse_ref[0, pl.ds(i * block_q, block_q), 0]     # (bq,)
-        dm = dm_ref[0, pl.ds(i * block_q, block_q), 0]
+        lse = lse_ref[0, i, 0]                               # (bq,)
+        dm = dm_ref[0, i, 0]
         s = lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
         if causal:
@@ -256,8 +259,15 @@ def _flash_bwd_raw(q3, k3, v3, g3, lse3, dm3, qoff, koff, scale: float,
 
     bh, t_q, d = q3.shape
     t_k = k3.shape[1]
-    lse_c = lse3.reshape(bh, t_q, 1)       # (…, 1) last dim: full-dim tile
-    dm_c = dm3.reshape(bh, t_q, 1)
+    nq = t_q // block_q
+    # same layout the forward emits: (bh, nq, 8, block_q) with the value
+    # broadcast over the 8 sublanes — the last two block dims form a full
+    # (8, block_q) tile, which the TPU lowering accepts (a trailing-dim-1
+    # block does not lower; ADVICE r3)
+    lse_c = jnp.broadcast_to(lse3.reshape(bh, nq, 1, block_q),
+                             (bh, nq, 8, block_q))
+    dm_c = jnp.broadcast_to(dm3.reshape(bh, nq, 1, block_q),
+                            (bh, nq, 8, block_q))
     row = [
         pl.BlockSpec(memory_space=_smem()),
         pl.BlockSpec(memory_space=_smem()),
@@ -271,8 +281,10 @@ def _flash_bwd_raw(q3, k3, v3, g3, lse3, dm3, qoff, koff, scale: float,
             pl.BlockSpec((1, t_k, d), lambda b, i: (b, 0, 0)),       # k
             pl.BlockSpec((1, t_k, d), lambda b, i: (b, 0, 0)),       # v
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),   # g
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),   # lse
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),   # dm
+            pl.BlockSpec((1, 1, 8, block_q),
+                         lambda b, i: (b, i, 0, 0)),                 # lse
+            pl.BlockSpec((1, 1, 8, block_q),
+                         lambda b, i: (b, i, 0, 0)),                 # dm
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t_q, d), q3.dtype),
@@ -287,8 +299,10 @@ def _flash_bwd_raw(q3, k3, v3, g3, lse3, dm3, qoff, koff, scale: float,
             pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),   # k
             pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),   # v
             pl.BlockSpec((1, t_q, d), lambda b, j: (b, 0, 0)),       # g
-            pl.BlockSpec((1, t_q, 1), lambda b, j: (b, 0, 0)),       # lse
-            pl.BlockSpec((1, t_q, 1), lambda b, j: (b, 0, 0)),       # dm
+            pl.BlockSpec((1, nq, 8, block_q),
+                         lambda b, j: (b, 0, 0, 0)),                 # lse
+            pl.BlockSpec((1, nq, 8, block_q),
+                         lambda b, j: (b, 0, 0, 0)),                 # dm
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),

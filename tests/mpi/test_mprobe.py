@@ -1,0 +1,195 @@
+"""Matched probe: MPI_Mprobe/Improbe/Mrecv/Imrecv.
+
+≈ the reference's ompi/mpi/c/mprobe.c, improbe.c, mrecv.c, imrecv.c —
+the MPI-3 thread-safe probe-then-receive: the probe atomically detaches
+the matched message from the unexpected queue, so no other thread's recv
+or probe can steal it between the probe and the receive.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from ompi_tpu.mpi.constants import ANY_SOURCE, ANY_TAG, PROC_NULL, MPIException
+from tests.mpi.harness import run_ranks
+
+
+def test_mprobe_mrecv_eager():
+    def body(comm):
+        if comm.rank == 0:
+            comm.send(np.arange(8, dtype=np.int32), dest=1, tag=7)
+            return None
+        msg, st = comm.mprobe(source=0, tag=7, timeout=30)
+        assert st.source == 0
+        assert st.tag == 7
+        assert st.count == 8
+        out = comm.mrecv(message=msg)
+        np.testing.assert_array_equal(out, np.arange(8, dtype=np.int32))
+        return out
+
+    run_ranks(2, body)
+
+
+def test_improbe_none_then_match():
+    def body(comm):
+        if comm.rank == 0:
+            comm.barrier()          # rank 1 improbes before anything sent
+            comm.send(np.float64(3.25), dest=1, tag=1)
+            return None
+        assert comm.improbe(source=0, tag=1) is None
+        comm.barrier()
+        # poll with a deadline until the frame lands (delivery is async)
+        import time
+
+        out = None
+        deadline = time.monotonic() + 30
+        while out is None and time.monotonic() < deadline:
+            out = comm.improbe(source=0, tag=1)
+            if out is None:
+                time.sleep(0.001)
+        assert out is not None
+        msg, st = out
+        assert st.source == 0
+        val = comm.mrecv(message=msg)
+        assert float(val) == 3.25
+        return None
+
+    run_ranks(2, body)
+
+
+def test_detached_message_invisible_to_recv_and_probe():
+    """Once detached, the message must not match any other recv/probe."""
+
+    def body(comm):
+        if comm.rank == 0:
+            comm.send(np.int32(111), dest=1, tag=5)
+            comm.barrier()
+            comm.send(np.int32(222), dest=1, tag=5)
+            return None
+        msg, _st = comm.mprobe(source=0, tag=5, timeout=30)
+        # same-signature probe/recv must NOT see the detached message
+        assert comm.iprobe(source=0, tag=5) is None
+        rreq = comm.irecv(source=ANY_SOURCE, tag=ANY_TAG)
+        assert not rreq.done()
+        comm.barrier()
+        second = rreq.wait()        # matches the SECOND send only
+        assert int(second) == 222
+        first = comm.mrecv(message=msg)
+        assert int(first) == 111
+        return None
+
+    run_ranks(2, body)
+
+
+def test_mprobe_rendezvous():
+    """A detached rendezvous message pulls its data at mrecv time."""
+
+    def body(comm):
+        n = 1 << 18                 # 1 MiB of float32 — well past eager
+        if comm.rank == 0:
+            comm.send(np.arange(n, dtype=np.float32), dest=1, tag=9)
+            return None
+        msg, st = comm.mprobe(source=ANY_SOURCE, tag=9, timeout=30)
+        assert st.count == n
+        out = comm.mrecv(message=msg)
+        np.testing.assert_array_equal(out, np.arange(n, dtype=np.float32))
+        return None
+
+    run_ranks(2, body)
+
+
+def test_ssend_completes_at_mprobe():
+    """A sync-mode send is 'matched' when mprobe detaches it — the sender
+    must complete even if mrecv is delayed."""
+
+    def body(comm):
+        if comm.rank == 0:
+            req = comm.issend(np.int32(5), dest=1, tag=3)
+            req.wait(timeout=30)    # must complete on the mprobe alone
+            comm.barrier()
+            return None
+        msg, _ = comm.mprobe(source=0, tag=3, timeout=30)
+        comm.barrier()              # sender already completed by now
+        assert int(comm.mrecv(message=msg)) == 5
+        return None
+
+    run_ranks(2, body)
+
+
+def test_two_thread_mprobe_race():
+    """Two receiver threads mprobe(ANY_SOURCE) concurrently: each message
+    is delivered to exactly one thread, none duplicated, none lost —
+    the guarantee plain probe cannot give."""
+
+    def body(comm):
+        if comm.rank in (0, 1):
+            payload = np.full(4, 100 + comm.rank, dtype=np.int64)
+            comm.send(payload, dest=2, tag=77)
+            return None
+        got = []
+        lock = threading.Lock()
+
+        def receiver():
+            msg, _st = comm.pml.mprobe(ANY_SOURCE, 77, comm.cid,
+                                       timeout=30)
+            out = comm.pml.mrecv(None, msg)
+            with lock:
+                got.append(int(out[0]))
+
+        ts = [threading.Thread(target=receiver) for _ in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        assert sorted(got) == [100, 101]
+        return None
+
+    run_ranks(3, body)
+
+
+def test_mprobe_proc_null():
+    def body(comm):
+        msg, st = comm.mprobe(source=PROC_NULL)
+        assert msg.no_proc
+        assert st.source == PROC_NULL
+        assert st.count == 0
+        out = comm.mrecv(message=msg)
+        assert out.size == 0
+        return None
+
+    run_ranks(1, body)
+
+
+def test_message_double_consume_raises():
+    def body(comm):
+        if comm.rank == 0:
+            comm.send(np.int32(1), dest=1, tag=2)
+            return None
+        msg, _ = comm.mprobe(source=0, tag=2, timeout=30)
+        comm.mrecv(message=msg)
+        with pytest.raises(MPIException):
+            comm.mrecv(message=msg)
+        return None
+
+    run_ranks(2, body)
+
+
+def test_imrecv_into_posted_buffer():
+    def body(comm):
+        if comm.rank == 0:
+            comm.send(np.arange(16, dtype=np.float32), dest=1, tag=4)
+            return None
+        msg, _ = comm.mprobe(source=0, tag=4, timeout=30)
+        buf = np.zeros(16, dtype=np.float32)
+        req = comm.imrecv(buf, message=msg)
+        req.wait(timeout=30)
+        np.testing.assert_array_equal(buf, np.arange(16, dtype=np.float32))
+        assert req.status.source == 0
+        assert req.status.tag == 4
+        assert req.status.count == 16
+        return None
+
+    run_ranks(2, body)
