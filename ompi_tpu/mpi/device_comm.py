@@ -503,6 +503,37 @@ class DeviceCommunicator:
         perm = [(i, (i + off) % n) for i in range(n)]
         return lax.ppermute(x, ax, perm)
 
+    # -- one-sided (remote DMA — ≈ btl.h:970/1007 put/get) -----------------
+    #
+    # Unlike everything above, these are NOT collectives: bytes move only
+    # src→dst over ICI via a pallas make_async_remote_copy kernel
+    # (ops/remote_dma).  The other devices run the same compiled SPMD
+    # program but issue no traffic.
+
+    def _flat_axis(self, what: str) -> str:
+        if len(self.axes) != 1 or len(self.mesh.axis_names) != 1:
+            raise MPIException(
+                f"{what}: one-sided remote DMA addresses devices by their "
+                f"logical index, which requires a flat single-axis mesh "
+                f"(got axes {self.axes} of mesh {self.mesh.axis_names}); "
+                f"use device_world(make_mesh(devices=...))")
+        return self.axes[0]
+
+    def put(self, win, value, src: int, dst: int):
+        """Traced one-sided put: device ``src`` writes ``value`` into
+        ``dst``'s window shard; returns the new window.  Completes before
+        the kernel returns (implicit quiet per op)."""
+        from ompi_tpu.ops.remote_dma import window_put
+
+        return window_put(win, value, src, dst, self._flat_axis("put"))
+
+    def get(self, win, src: int, dst: int):
+        """Traced one-sided get: device ``dst`` fetches ``src``'s window
+        shard (everyone else sees its own shard)."""
+        from ompi_tpu.ops.remote_dma import window_get
+
+        return window_get(win, src, dst, self._flat_axis("get"))
+
     # -- driver-mode helper ------------------------------------------------
 
     def run(self, fn: Callable, *arrays, out_specs: Any = None):
@@ -526,11 +557,14 @@ class DeviceCommunicator:
         return jax.jit(shmapped)(*arrays)
 
     def run_method(self, method: str, *arrays, margs: tuple = (),
-                   mkw: tuple = (), out_specs: Any = None):
+                   mkw: tuple = (), out_specs: Any = None,
+                   donate: tuple = ()):
         """Driver-mode dispatch of one named collective, cached: the
         shard_map+jit program is built once per (method, static args,
         input avals) and reused — a driver barrier/allreduce costs a dict
-        lookup + dispatch, not a retrace (round-2 weak #5)."""
+        lookup + dispatch, not a retrace (round-2 weak #5).  ``donate``
+        names array positions whose buffers the caller hands over (e.g. a
+        window being replaced by the op's result)."""
         import jax
 
         from jax.sharding import PartitionSpec as P
@@ -538,7 +572,8 @@ class DeviceCommunicator:
         key = (method, margs, mkw,
                tuple((a.shape, str(getattr(a, "dtype", "?")))
                      for a in arrays),
-               out_specs if out_specs is None else str(out_specs))
+               out_specs if out_specs is None else str(out_specs),
+               donate)
         cached = self._method_cache.get(key)
         if cached is None:
             kw = dict(mkw)
@@ -553,7 +588,7 @@ class DeviceCommunicator:
             def shmapped(*shards):
                 return getattr(self, method)(*shards, *margs, **kw)
 
-            cached = jax.jit(shmapped)
+            cached = jax.jit(shmapped, donate_argnums=donate)
             self._method_cache[key] = cached
         return cached(*arrays)
 

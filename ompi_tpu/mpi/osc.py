@@ -33,7 +33,7 @@ from ompi_tpu.mpi import op as op_mod
 from ompi_tpu.mpi.constants import ANY_SOURCE, MPIException
 from ompi_tpu.mpi.request import Request
 
-__all__ = ["Window"]
+__all__ = ["Window", "DeviceWindow"]
 
 _log = output.get_stream("osc")
 
@@ -761,3 +761,84 @@ class Window:
                 grants.append(nxt)
         for g in grants:
             _ctrl_send(self.comm, g, ("ok", None), _TAG_REPLY)
+
+
+class DeviceWindow:
+    """Device-resident RMA window: the osc/rdma strategy on ICI.
+
+    ≈ ompi/mca/osc/rdma (osc_rdma_comm.c:418 put → btl_put, :539 get →
+    btl_get): where the host Window above emulates RMA over p2p messages
+    (the pt2pt strategy), a DeviceWindow maps put/get straight onto the
+    one-sided remote-DMA kernels (ops/remote_dma) — bytes cross ICI once,
+    origin→target, no service thread, no active messages.
+
+    The window is a functional value: an identically-sharded jax array,
+    one shard per rank, mutated by returning the new array (XLA donates
+    the old buffer via the cached jit).  Epochs: ``fence()`` is a device
+    barrier; per-op completion is implicit (each kernel drains its DMA
+    before returning — the flush/quiet the reference must issue
+    explicitly, osc_rdma_sync.c).
+    """
+
+    def __init__(self, dcomm, local_shape, dtype=np.float32, fill=0):
+        self.comm = dcomm
+        self.local_shape = tuple(int(s) for s in local_shape)
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        spec = P(dcomm.axes if len(dcomm.axes) > 1 else dcomm.axes[0])
+        shape = (dcomm.size,) + self.local_shape
+        self.array = jax.jit(
+            lambda: jnp.full(shape, fill, dtype=dtype),
+            out_shardings=NamedSharding(dcomm.mesh, spec))()
+
+    @property
+    def dtype(self):
+        return self.array.dtype
+
+    def _origin_value(self, data) -> "Any":
+        """Lift origin-local data (local_shape) to the sharded global
+        layout run_method expects (every rank passes the same program —
+        only the origin's shard is read by the kernel)."""
+        import jax.numpy as jnp
+
+        data = jnp.asarray(data, dtype=self.array.dtype)
+        if data.shape != self.local_shape:
+            raise MPIException(
+                f"DeviceWindow: data shape {data.shape} must match the "
+                f"window's local shape {self.local_shape}")
+        return jnp.broadcast_to(data[None], self.array.shape)
+
+    def put(self, data, origin: int, target: int) -> None:
+        """origin's ``data`` lands in target's window shard (one-sided:
+        only the origin→target ICI path moves bytes).  The old window
+        buffer is donated to the kernel (no 2× window residency).
+
+        Driver-mode convenience has a cost the traced path doesn't:
+        ``data`` is replicated to every shard on the way in (run_method's
+        uniform specs).  Hot paths should trace DeviceCommunicator.put
+        inside their own shard_map instead."""
+        self.array = self.comm.run_method(
+            "put", self.array, self._origin_value(data),
+            margs=(int(origin), int(target)), donate=(0,))
+
+    def get(self, origin: int, target: int):
+        """origin fetches target's window shard one-sided; returns the
+        host value of that shard."""
+        fetched = self.comm.run_method(
+            "get", self.array, margs=(int(target), int(origin)))
+        return np.asarray(fetched[int(origin)])
+
+    def local(self, rank: int):
+        """Host copy of ``rank``'s current window shard."""
+        return np.asarray(self.array[int(rank)])
+
+    def fence(self) -> None:
+        """Active-target epoch boundary: device barrier (ops already
+        completed per-kernel; the fence orders epochs)."""
+        self.comm.run_method("barrier", np.zeros((self.comm.size,),
+                                                 np.int32))
+
+    def free(self) -> None:
+        self.array = None

@@ -118,6 +118,31 @@ class DeviceSymmetricHeap:
         source = a broadcast from that PE)."""
         return self.comm.bcast(x, root=int(src_pe))
 
+    # -- true one-sided (remote DMA, not a permutation/collective) --------
+    #
+    # put_to/get_from above are *exchange-shaped*: ppermute/psum move
+    # bytes on every PE.  These move bytes on exactly one ICI path —
+    # shmem_put's real contract (oshmem/spml put over btl put) — via the
+    # pallas remote-copy kernel in ops/remote_dma.
+
+    def put(self, sym, value, src_pe: int, dst_pe: int):
+        """Traced: PE ``src_pe`` writes ``value`` (its local block shape)
+        into PE ``dst_pe``'s block of symmetric allocation ``sym``;
+        returns the updated allocation.  All PEs call (SPMD), only the
+        src→dst ICI path carries traffic."""
+        return self.comm.put(sym, value, int(src_pe), int(dst_pe))
+
+    def get(self, sym, src_pe: int, dst_pe: int):
+        """Traced: PE ``dst_pe`` fetches PE ``src_pe``'s block of ``sym``
+        one-sided; other PEs keep their own block."""
+        return self.comm.get(sym, int(src_pe), int(dst_pe))
+
+    def quiet(self, token=None):
+        """shmem_quiet: remote-DMA puts complete inside their kernel
+        (implicit per-op quiet), so this only orders the program — a
+        barrier-token no-op kept for API parity with the host heap."""
+        return token
+
     # -- traced collectives (≈ scoll on device) ---------------------------
 
     def broadcast(self, x, root: int = 0):

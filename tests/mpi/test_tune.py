@@ -1,0 +1,109 @@
+"""Measured collective-crossover tuner (ompi_tpu.tools.tune) and the
+coll/xla measured-rules consumption path.
+
+≈ the reference's measured fixed-decision discipline
+(coll_tuned_decision_fixed.c:56-74) + dynamic rules file
+(coll_tuned_dynamic_file.c): the tuner reproduces the measurement, the
+component consumes the result — but only when the provenance platform
+matches the running backend.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from ompi_tpu.mpi.coll import rules, xla  # noqa: E402
+from ompi_tpu.tools.tune import tune_device_colls  # noqa: E402
+
+
+def test_tune_emits_rules_with_provenance(tmp_path):
+    out = tmp_path / "measured.conf"
+    text, table = tune_device_colls(
+        jax.devices(), sizes=(1 << 10, 1 << 14), out_path=str(out),
+        iters=2)
+    rs = rules.load_rules(str(out))
+    assert rs.meta["platform"] == jax.default_backend()
+    assert int(rs.meta["n_devices"]) == len(jax.devices())
+    # 8 virtual devices: every collective must have at least a base rule
+    assert len(rs) >= 3
+    for coll in ("allreduce", "allgather", "bcast"):
+        alg = rs.lookup(coll, len(jax.devices()), 4096)
+        assert alg in xla.XlaColl.ALGORITHMS[coll]
+        assert table[coll], f"no measurements for {coll}"
+
+
+def test_tune_single_device_withholds_rules(tmp_path):
+    out = tmp_path / "solo.conf"
+    text, _ = tune_device_colls(
+        jax.devices()[:1], sizes=(1 << 10,), out_path=str(out), iters=1)
+    rs = rules.load_rules(str(out))
+    assert len(rs) == 0                   # provenance only, no rules
+    assert rs.meta["n_devices"] == "1"
+
+
+def test_provenance_lines_parse():
+    rs = rules.parse("#! platform=tpu\n#! n_devices=8\n"
+                     "allreduce 0 0 psum\n")
+    assert rs.meta == {"platform": "tpu", "n_devices": "8"}
+    assert rs.lookup("allreduce", 4, 1) == "psum"
+
+
+def test_measured_rules_platform_gate(tmp_path, monkeypatch):
+    """A shipped file measured on another platform must be ignored."""
+    foreign = tmp_path / "foreign.conf"
+    foreign.write_text("#! platform=notreal\nallreduce 0 0 rs_ag\n")
+    monkeypatch.setattr(xla, "_MEASURED_PATH", str(foreign))
+    xla._measured_cache.clear()
+    assert xla._measured_rules() is None
+
+    native = tmp_path / "native.conf"
+    native.write_text(f"#! platform={jax.default_backend()}\n"
+                      "allreduce 0 0 segmented\n")
+    monkeypatch.setattr(xla, "_MEASURED_PATH", str(native))
+    xla._measured_cache.clear()
+    rs = xla._measured_rules()
+    assert rs is not None
+    assert rs.lookup("allreduce", 8, 123) == "segmented"
+    xla._measured_cache.clear()
+
+
+def test_decide_consults_measured_rules(tmp_path, monkeypatch):
+    """_decide: forced var > user rules > measured rules > fixed."""
+    from ompi_tpu.parallel.mesh import make_mesh
+    from ompi_tpu.mpi.device_comm import device_world
+
+    mesh = make_mesh(devices=jax.devices())
+    dc = device_world(mesh)
+    comp = xla.XlaColl()
+    native = tmp_path / "m.conf"
+    native.write_text(f"#! platform={jax.default_backend()}\n"
+                      f"#! n_devices={dc.size}\n"
+                      "allreduce 0 0 psum\n"
+                      "allreduce 0 8192 segmented\n")
+    monkeypatch.setattr(xla, "_MEASURED_PATH", str(native))
+    xla._measured_cache.clear()
+    assert comp._decide("allreduce", None, dc, 1024) == "psum"
+    assert comp._decide("allreduce", None, dc, 1 << 20) == "segmented"
+    xla._measured_cache.clear()
+
+
+def test_measured_rules_size_gate(tmp_path, monkeypatch):
+    """Crossovers measured on an 8× larger mesh must not steer a small
+    communicator (> 2× size mismatch falls back to the fixed decision)."""
+    from ompi_tpu.parallel.mesh import make_mesh
+    from ompi_tpu.mpi.device_comm import device_world
+
+    mesh = make_mesh(devices=jax.devices())
+    dc = device_world(mesh)              # size 8
+    comp = xla.XlaColl()
+    big = tmp_path / "big.conf"
+    big.write_text(f"#! platform={jax.default_backend()}\n"
+                   f"#! n_devices={dc.size * 8}\n"
+                   "allreduce 0 0 segmented\n")
+    monkeypatch.setattr(xla, "_MEASURED_PATH", str(big))
+    xla._measured_cache.clear()
+    # 64-device rules ignored for a size-8 comm → fixed decision (psum
+    # below the large-message threshold)
+    assert comp._decide("allreduce", None, dc, 1024) == "psum"
+    xla._measured_cache.clear()
