@@ -44,7 +44,8 @@ import numpy as np
 # transiently slow tunnel init now gets three chances to come up.
 _PROBE_BUDGETS_S = tuple(
     int(x) for x in os.environ.get("OMPI_TPU_BENCH_PROBE_BUDGETS",
-                                   "90,150,240").split(","))
+                                   "90,150,240").split(",")
+    if x.strip()) or (90, 150, 240)
 _PROBE_PAUSE_S = int(os.environ.get("OMPI_TPU_BENCH_PROBE_PAUSE", "30"))
 _MATRIX_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "BENCH_MATRIX.json")

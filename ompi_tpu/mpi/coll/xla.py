@@ -49,7 +49,10 @@ def _dev_nbytes(buf) -> int:
         return 0
 
 
-_MEASURED_PATH = __file__.rsplit("/", 1)[0] + "/xla_measured_rules.conf"
+import os as _os
+
+_MEASURED_PATH = _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                               "xla_measured_rules.conf")
 _measured_cache: list = []  # [(mtime|None, RuleSet|None)] — len-1 memo
 
 
@@ -138,9 +141,10 @@ class XlaColl(Component):
                      "(inter-slice); collectives over them prefer "
                      "neighbor-shaped algorithms (ring/2-phase)")
         register_var("coll", "xla_allreduce_large", VarType.SIZE, 32 << 20,
-                     "allreduce: at/above this switch to the 2-phase "
-                     "reduce_scatter+all_gather form (bandwidth-optimal "
-                     "ring shape; below, XLA's fused psum wins on latency)")
+                     "allreduce: at/above this PER-SHARD byte size switch "
+                     "to the 2-phase reduce_scatter+all_gather form "
+                     "(bandwidth-optimal ring shape; below, XLA's fused "
+                     "psum wins on latency)")
         register_var("coll", "xla_dynamic_rules", VarType.STRING, "",
                      "path to a dynamic rules file for the DEVICE path "
                      "(same format as coll_host_dynamic_rules)")
@@ -209,7 +213,15 @@ class XlaColl(Component):
 
     def _run_decided(self, coll: str, comm, buf, *args, **kw):
         dc = _device_comm(comm)
-        alg = self._decide(coll, comm, dc, _dev_nbytes(buf))
+        nbytes = _dev_nbytes(buf)
+        # canonical decision unit: PER-SHARD bytes (what each ICI link
+        # moves).  A traced call sees the per-shard tracer already; a
+        # driver-mode call sees the committed global array — normalize so
+        # both modes look up the same rule boundary (and the tuner's
+        # measured crossovers, recorded per-shard, apply uniformly).
+        if classify(buf) is BufferKind.DEVICE:
+            nbytes //= max(1, dc.size)
+        alg = self._decide(coll, comm, dc, nbytes)
         return _run(comm, self._IMPL[coll][alg], buf, *args, **kw)
 
     # -- table slots (device implementations) ------------------------------

@@ -299,15 +299,16 @@ class Communicator:
 
     def imrecv(self, buf=None, message=None, datatype=None,
                count=None) -> Request:
-        req = self.pml.imrecv(buf, message, datatype, count)
-        # translate status.source world→group rank on completion, so a
-        # later req.status read matches what mrecv reports (they must
-        # agree on sub-communicators whose group order differs)
-        def _translate(_r):
-            if _r.status.source >= 0:
-                _r.status.source = self.group.rank_of(_r.status.source)
-        req.add_completion_callback(_translate)
-        return req
+        # status.source must be the GROUP rank (as mrecv reports); the
+        # detached message pins the sender, so the translation is known
+        # up front and rides the request into delivery — a
+        # post-completion callback would race a waiter reading status
+        src = None
+        if message is not None and not message.no_proc \
+                and message.peer >= 0:
+            src = self.group.rank_of(message.peer)
+        return self.pml.imrecv(buf, message, datatype, count,
+                               status_source=src)
 
     def mrecv(self, buf=None, message=None, datatype=None, count=None,
               status: Optional[Status] = None) -> np.ndarray:

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import collections
 import itertools
+import math
 import queue
 import threading
 import time
@@ -86,6 +87,11 @@ class RecvRequest(Request):
         self.cid = cid
         self.rid = -1  # receiver-side id for rendezvous
         self._pml = None  # set by PmlOb1.irecv; enables real cancel
+        # set BEFORE delivery can complete the request: the status.source
+        # value _deliver should report instead of the wire peer (a
+        # communicator's group rank when it differs from the world rank).
+        # A post-completion translation callback would race the waiter.
+        self.source_override: Optional[int] = None
 
     def cancel(self) -> None:
         """≈ MPI_Cancel on a recv: dequeue the posted request if (and only
@@ -133,25 +139,48 @@ class Message:
 MESSAGE_NO_PROC = Message(None, -1, {}, b"")
 
 
+_wire_memo: dict = {}  # np.dtype → wire spec (hot-path cache)
+
+
 def _dtype_to_wire(dt: np.dtype):
+    try:
+        return _wire_memo[dt]
+    except (KeyError, TypeError):
+        pass
     if dt.fields:
-        return dt.descr
-    # extended dtypes (bfloat16, float8_*) stringify as raw void ('<V2');
-    # their registered name ('bfloat16') reconstructs correctly
-    if dt.kind == "V":
-        return dt.name
-    return dt.str
+        spec = dt.descr
+    elif dt.kind == "V":
+        # extended dtypes (bfloat16, float8_*) stringify as raw void
+        # ('<V2'); their registered name ('bfloat16') reconstructs
+        spec = dt.name
+    else:
+        spec = dt.str
+    try:
+        _wire_memo[dt] = spec
+    except TypeError:
+        pass
+    return spec
+
+
+_dtype_memo: dict[str, np.dtype] = {}  # hot-path cache (str specs only)
 
 
 def _wire_to_dtype(spec) -> np.dtype:
+    if isinstance(spec, str):
+        dt = _dtype_memo.get(spec)
+        if dt is not None:
+            return dt
     if isinstance(spec, (list, tuple)):
         return np.dtype([tuple(f) for f in spec])
     if isinstance(spec, str) and not spec[:1].isalpha():
-        return np.dtype(spec)
-    # name form needs ml_dtypes registered for the extended types
-    import ml_dtypes  # noqa: F401
+        dt = np.dtype(spec)
+    else:
+        # name form needs ml_dtypes registered for the extended types
+        import ml_dtypes  # noqa: F401
 
-    return np.dtype(spec)
+        dt = np.dtype(spec)
+    _dtype_memo[spec] = dt
+    return dt
 
 
 class _SendState:
@@ -692,10 +721,13 @@ class PmlOb1:
 
     def imrecv(self, buf: Optional[np.ndarray], message: Message,
                datatype: Optional[Datatype] = None,
-               count: Optional[int] = None) -> RecvRequest:
+               count: Optional[int] = None,
+               status_source: Optional[int] = None) -> RecvRequest:
         """Receive the detached message; consumes the handle.  Eager
         payloads deliver immediately; a detached rendezvous replies with
-        its CTS now, exactly as a matching irecv would have."""
+        its CTS now, exactly as a matching irecv would have.
+        ``status_source``: value to report as status.source instead of
+        the wire peer (the comm layer passes the group rank)."""
         if message.no_proc:
             req = RecvRequest(None, dt_mod.BYTE, 0, -1, -1, -1)
             req.status.source = PROC_NULL
@@ -721,6 +753,8 @@ class PmlOb1:
                           message.hdr["tag"], message.hdr["cid"])
         req.rid = next(self._ids)
         req._pml = self
+        if status_source is not None:
+            req.source_override = status_source
         if self._listeners:  # balanced post/match pair, like irecv's path
             self._emit(EVT_RECV_POST, peer=message.peer,
                        tag=message.hdr["tag"], cid=message.hdr["cid"])
@@ -1005,7 +1039,7 @@ class PmlOb1:
             # recv() has always returned at least a 1-element vector)
             shp = hdr.get("shp")
             if (datatype is None and shp
-                    and int(np.prod(shp)) == n_elems):
+                    and math.prod(shp) == n_elems):
                 out = out.reshape(shp)
         else:
             out = req.buf
@@ -1014,7 +1048,8 @@ class PmlOb1:
         if self._listeners:
             self._emit(EVT_DELIVER, peer=peer, tag=hdr["tag"],
                        cid=hdr["cid"], nbytes=len(payload))
-        req.status.source = peer
+        ov = req.source_override
+        req.status.source = peer if ov is None else ov
         req.status.tag = hdr["tag"]
         elem_size = (datatype.base_np.itemsize if datatype is not None
                      else _wire_to_dtype(hdr["dt"]).itemsize)

@@ -1,16 +1,30 @@
 """Requests: completion objects for nonblocking operations.
 
-≈ ompi/request (request.h:124-177): a request completes exactly once; waiters
-block on a completion primitive (the reference's wait_sync, here a
-threading.Event).  Status carries (source, tag, count) like MPI_Status.
+≈ ompi/request (request.h:124-177): a request completes exactly once;
+completion is a plain flag (GIL-atomic reads) plus an Event created lazily
+by the first waiter that actually blocks.  Requests that complete before
+anyone waits — every inline-delivered send, and recvs matched from the
+unexpected queue — never allocate an Event/Condition pair at all, which is
+a measurable share of small-message hop latency.  A vader-style pre-block
+spin was tried and measured COUNTERPRODUCTIVE here (36→58µs/hop): under
+the GIL the waiter's polling steals cycles from the very thread doing the
+completing; the reference's opal_progress spin works because its progress
+runs in the waiting thread, ours runs in the sender's.  Status carries
+(source, tag, count) like MPI_Status.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, Optional, Sequence
 
 from ompi_tpu.mpi.constants import MPIException
+
+# Optional bounded GIL-yielding poll before the futex wait; 0 = disabled
+# (measured best on GIL builds — see module docstring).  Kept as a knob
+# for free-threaded interpreters where the tradeoff flips.
+_SPIN_S = 0.0
 
 __all__ = ["Request", "Status", "PersistentRequest", "wait_all", "wait_any",
            "wait_some", "test_all", "test_any", "test_some", "start_all"]
@@ -35,7 +49,8 @@ class Request:
 
     def __init__(self, kind: str = "generic") -> None:
         self.kind = kind
-        self._done = threading.Event()
+        self._flag = False            # GIL-atomic completion flag
+        self._event: Optional[threading.Event] = None  # lazy: first blocker
         self._lock = threading.Lock()
         self.status = Status()
         self._result: Any = None
@@ -47,28 +62,34 @@ class Request:
 
     def complete(self, result: Any = None) -> None:
         with self._lock:
-            if self._done.is_set():
+            if self._flag:
                 return
             self._result = result
-            self._done.set()
+            self._flag = True
+            ev = self._event
             callbacks = list(self._on_complete)
+        if ev is not None:
+            ev.set()
         for cb in callbacks:
             cb(self)
 
     def fail(self, exc: BaseException) -> None:
         with self._lock:
-            if self._done.is_set():
+            if self._flag:
                 return
             self._exc = exc
             self.status.error = getattr(exc, "error_class", 13)
-            self._done.set()
+            self._flag = True
+            ev = self._event
             callbacks = list(self._on_complete)
+        if ev is not None:
+            ev.set()
         for cb in callbacks:
             cb(self)
 
     def add_completion_callback(self, cb: Callable[["Request"], None]) -> None:
         with self._lock:
-            if not self._done.is_set():
+            if not self._flag:
                 self._on_complete.append(cb)
                 return
         cb(self)
@@ -76,20 +97,39 @@ class Request:
     # -- user side --------------------------------------------------------
 
     def done(self) -> bool:
-        return self._done.is_set()
+        return self._flag
 
     def test(self) -> bool:
         """≈ MPI_Test (no progress side effects needed: progress is threaded)."""
-        return self._done.is_set()
+        return self._flag
 
     def wait(self, timeout: Optional[float] = None) -> Any:
         """≈ MPI_Wait: block until complete; return the operation's result
         (received array for recvs, None for sends)."""
-        if not self._done.wait(timeout=timeout):
-            raise TimeoutError(f"{self.kind} request did not complete")
+        if not self._flag:
+            self._block(timeout)
         if self._exc is not None:
             raise self._exc
         return self._result
+
+    def _block(self, timeout: Optional[float]) -> None:
+        # no-lost-wakeup invariant: the event is created and re-checked
+        # under self._lock — the same lock complete() reads self._event
+        # under before setting it
+        if _SPIN_S > 0:
+            deadline = time.perf_counter() + _SPIN_S
+            while time.perf_counter() < deadline:
+                if self._flag:
+                    return
+                time.sleep(0)     # yield the GIL to the completing thread
+        with self._lock:
+            if self._flag:
+                return
+            if self._event is None:
+                self._event = threading.Event()
+            ev = self._event
+        if not ev.wait(timeout=timeout):
+            raise TimeoutError(f"{self.kind} request did not complete")
 
     def cancel(self) -> None:
         """≈ MPI_Cancel (only meaningful for unmatched recvs)."""

@@ -150,6 +150,32 @@ def test_two_thread_mprobe_race():
     run_ranks(3, body)
 
 
+def test_imrecv_status_group_rank():
+    """On a communicator whose group order differs from world order,
+    imrecv must report the GROUP rank in status.source (same as mrecv) —
+    and the value must be translated before the waiter can observe it."""
+    from ompi_tpu.mpi.comm import Communicator
+    from ompi_tpu.mpi.group import Group
+
+    def body(world):
+        # reversed group: world rank 0 ↔ group rank 1 and vice versa
+        rev = Communicator(Group([1, 0]), cid=77, pml=world.pml,
+                           my_world_rank=world.pml.rank,
+                           name="reversed")
+        me = rev.rank                      # group rank
+        if me == 1:                        # world rank 0
+            rev.send(np.arange(4, dtype=np.int32), dest=0, tag=6)
+            return None
+        msg, st = rev.mprobe(source=1, tag=6, timeout=30)
+        assert st.source == 1              # group rank of the sender
+        req = rev.imrecv(np.zeros(4, np.int32), message=msg)
+        req.wait(timeout=30)
+        assert req.status.source == 1      # translated, not world rank 0
+        return None
+
+    run_ranks(2, body)
+
+
 def test_mprobe_proc_null():
     def body(comm):
         msg, st = comm.mprobe(source=PROC_NULL)
