@@ -18,9 +18,12 @@
 
 from ompi_tpu.ckpt.msglog import MessageLog
 from ompi_tpu.ckpt.snapc import CheckpointManager, checkpoint, restart
-from ompi_tpu.ckpt.store import SnapshotStore, StagedStore
+from ompi_tpu.ckpt.store import (
+    ShardedSnapshotStore, SnapshotStore, StagedStore,
+)
 
 __all__ = [
+    "ShardedSnapshotStore",
     "SnapshotStore", "StagedStore", "checkpoint", "restart",
     "CheckpointManager", "MessageLog",
 ]

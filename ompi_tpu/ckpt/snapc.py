@@ -50,6 +50,17 @@ def checkpoint(comm, store: SnapshotStore, state: dict[str, Any],
         agreed = comm.allreduce(np.array([mine], np.int64), op=_MAX())
         seq = int(np.asarray(agreed)[0])
     comm.barrier()                      # quiesce at the step boundary
+    if hasattr(store, "save"):
+        # collective single-file store (ShardedSnapshotStore): save() is
+        # the whole write+commit protocol — per-rank write_rank/commit
+        # do not apply to the shared-file layout
+        store.save(seq, state, extra=extra_meta)
+        if comm.rank == 0 and keep_last is not None:
+            try:
+                store.gc(keep_last)
+            except Exception:  # noqa: BLE001 — best-effort, like below
+                pass
+        return seq
     ok = 1
     err = ""
     try:

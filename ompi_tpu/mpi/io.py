@@ -67,6 +67,14 @@ register_var("io", "twophase", VarType.BOOL, True,
              "(False: collective calls run as independent IO + barrier)")
 register_var("io", "twophase_min_bytes", VarType.SIZE, 1,
              "minimum total bytes before two-phase aggregation kicks in")
+register_var("io", "fcoll", VarType.STRING, "",
+             "force a collective-IO component: individual | two_phase | "
+             "dynamic (empty = auto-decide from the access pattern, like "
+             "the reference's fcoll query/priority selection)")
+register_var("io", "cb_aggregators_per_host", VarType.INT, 1,
+             "collective-buffering aggregators per host (aggregators are "
+             "the lowest ranks of each host in the job mapping, like "
+             "OMPIO's one-per-node cb_nodes default)")
 
 # shared-file-pointer serialization for in-process ranks (threads share the
 # process, so fcntl locks alone can't order them); keyed by realpath
@@ -239,12 +247,25 @@ class File:
         if amode & MODE_APPEND:
             self._pos = os.fstat(self._fd).st_size // self.view.etype.size
         # shared pointer sidecar: rank 0 resets it (to EOF under APPEND —
-        # MPI requires *all* pointers to start at end of file), then sync
+        # MPI requires *all* pointers to start at end of file), then sync.
+        # A read-only mount (archived snapshot dir) cannot host the
+        # sidecar — record the failure and raise only if shared-pointer
+        # ops are actually used, so plain reads of immutable files work.
         self._shfp_path = self.path + ".ompi_tpu_shfp"
+        self._shfp_err = ""
         if comm.rank == 0:
-            with open(self._shfp_path, "wb") as f:
-                f.write(int(self._pos if amode & MODE_APPEND else 0
-                            ).to_bytes(8, "big"))
+            try:
+                with open(self._shfp_path, "wb") as f:
+                    f.write(int(self._pos if amode & MODE_APPEND else 0
+                                ).to_bytes(8, "big"))
+            except OSError as e:
+                self._shfp_err = str(e)
+        # every rank must agree whether the sidecar exists (shared ops
+        # are collective-adjacent); broadcast rank 0's outcome
+        flag = comm.bcast(np.array(
+            [1 if not self._shfp_err else 0], np.int8), root=0)
+        if not int(np.asarray(flag)[0]) and comm.rank != 0:
+            self._shfp_err = "sidecar creation failed on rank 0"
         comm.barrier()
 
     # -- fs framework ------------------------------------------------------
@@ -252,9 +273,12 @@ class File:
     @classmethod
     def open(cls, comm, path: str, amode: int = MODE_RDONLY,
              info=None) -> "File":
-        """≈ MPI_File_open — collective over comm.  ``info`` hints are
-        accepted and retrievable (MPI_File_get_info); none are currently
-        interpreted (the two-phase knobs live in the MCA registry)."""
+        """≈ MPI_File_open — collective over comm.  Consulted ``info``
+        hints: ``collective_buffering`` / ``romio_cb_write`` ("false"
+        disables collective aggregation), ``cb_nodes`` (caps the
+        aggregator count), ``fcoll`` (pins the collective component for
+        this file).  Other hints are retrievable (MPI_File_get_info) but
+        inert; global knobs live in the MCA registry (io_*)."""
         if amode & MODE_RDONLY and amode & (MODE_WRONLY | MODE_RDWR):
             raise MPIException("RDONLY combined with write mode",
                                error_class=3)
@@ -455,62 +479,210 @@ class File:
     def iwrite(self, data: Any) -> Request:
         return CompletedRequest(self.write(data), kind="iwrite")
 
-    # -- collective IO (fcoll/two_phase equivalent) ------------------------
+    # -- collective IO (the fcoll framework) -------------------------------
+    #
+    # ≈ ompi/mca/fcoll: selectable collective algorithms (individual /
+    # two_phase / dynamic — the reference's fcoll components of the same
+    # names) + OMPIO-style aggregator selection (one per host from the job
+    # mapping, like cb_nodes defaulting to one aggregator per node).
+    # Component choice: info hints > io_fcoll var > auto decision from the
+    # allgathered access pattern (every rank computes the same answer from
+    # the same collective data).
 
-    def _two_phase_enabled(self, nbytes: int) -> bool:
+    def _my_host_key(self) -> int:
+        """Stable host identity for aggregator grouping — the same
+        identity the shm BTL groups by (OMPI_TPU_FAKE_HOST under the sim
+        plm, the real nodename otherwise).  Tests may override per-comm
+        via ``comm._io_host_override`` (os.environ is process-wide, so
+        threads-as-ranks cannot vary the env var)."""
+        import zlib
+
+        name = getattr(self.comm, "_io_host_override", None) \
+            or os.environ.get("OMPI_TPU_FAKE_HOST") or os.uname().nodename
+        return zlib.crc32(str(name).encode()) & 0x7FFFFFFF
+
+    def _aggregators(self) -> list[int]:
+        """Aggregator ranks: the lowest ``io_cb_aggregators_per_host``
+        ranks of each host (≈ OMPIO's one-aggregator-per-node default,
+        mca_io_ompio_num_aggregators / cb_nodes).  The ``cb_nodes`` info
+        hint caps the total.  Cached: the rank→host mapping is invariant
+        for the communicator's lifetime, so the allgather runs once per
+        file, not once per collective call."""
+        cached = getattr(self, "_aggs_cache", None)
+        if cached is not None:
+            return cached
         from ompi_tpu.core.config import var_registry
 
+        comm = self.comm
+        keys = np.asarray(comm.allgather(
+            np.array([self._my_host_key()], np.int64))).ravel()
+        per_host = int(var_registry.get("io_cb_aggregators_per_host") or 1)
+        by_host: dict[int, list[int]] = {}
+        for rank, k in enumerate(keys):
+            by_host.setdefault(int(k), []).append(rank)
+        aggs = sorted(r for ranks in by_host.values()
+                      for r in ranks[:max(1, per_host)])
+        cap = self.info.get("cb_nodes") if self.info else None
+        if cap:
+            try:
+                aggs = aggs[:max(1, int(cap))]
+            except ValueError:
+                pass
+        self._aggs_cache = aggs
+        return aggs
+
+    def _fcoll_component(self, my_nbytes: int, my_runs) -> str:
+        """Pick individual | two_phase | dynamic — identically on every
+        rank (decision inputs are allgathered).  Precedence: info hint
+        (collective_buffering/romio_cb_write=disable → individual) >
+        io_fcoll var > auto (≈ OMPIO's fcoll query: small or contiguous
+        per-rank patterns go individual; strided balanced loads use
+        static domains; skewed loads use payload-weighted domains)."""
+        from ompi_tpu.core.config import var_registry
+
+        hint = ""
+        if self.info:
+            hint = (self.info.get("collective_buffering")
+                    or self.info.get("romio_cb_write") or "")
+        if str(hint).lower() in ("false", "disable", "0"):
+            return "individual"
+        forced = ""
+        if self.info:
+            forced = self.info.get("fcoll") or ""   # per-file pin
+        forced = forced or var_registry.get("io_fcoll") or ""
+        if forced:
+            if forced not in ("individual", "two_phase", "dynamic"):
+                raise MPIException(
+                    f"unknown fcoll component {forced!r} "
+                    f"(individual/two_phase/dynamic)", error_class=3)
+            return forced
         if not var_registry.get("io_twophase"):
-            return False
-        total = self.comm.allreduce(np.array([nbytes], np.int64))
-        return int(np.asarray(total)[0]) >= int(
-            var_registry.get("io_twophase_min_bytes"))
+            return "individual"
+        contig = 1 if (len(my_runs) <= 1) else 0
+        stats = np.asarray(self.comm.allgather(np.array(
+            [my_nbytes, contig], np.int64))).reshape(-1, 2)
+        total = int(stats[:, 0].sum())
+        if total < int(var_registry.get("io_twophase_min_bytes")):
+            return "individual"
+        if int(stats[:, 1].min()) == 1:
+            return "individual"   # everyone contiguous: direct IO wins
+        nz = stats[:, 0][stats[:, 0] > 0]
+        if len(nz) and int(nz.max()) > 4 * int(nz.min()):
+            return "dynamic"      # skewed payloads → balance by bytes
+        return "two_phase"
+
+    def _domain_bounds(self, mode: str, my_runs, naggs: int
+                       ) -> Optional[list[int]]:
+        """Collective: ascending byte offsets b[0..naggs] partitioning
+        the global extent into aggregator file domains.  two_phase =
+        equal spans (fcoll/two_phase's static assignment); dynamic =
+        equal *payload* per aggregator, boundaries derived from the
+        allgathered run lists (fcoll/dynamic's data-driven domains).
+        None ⇒ empty global extent."""
+        comm = self.comm
+        lo = my_runs[0][0] if my_runs else np.iinfo(np.int64).max
+        hi = my_runs[-1][0] + my_runs[-1][1] if my_runs else 0
+        ext = np.asarray(comm.allgather(np.array([lo, hi], np.int64)))
+        glo, ghi = int(ext[:, 0].min()), int(ext[:, 1].max())
+        if ghi <= glo:
+            return None
+        if mode != "dynamic":
+            dom = -(-(ghi - glo) // naggs)
+            return [glo + i * dom for i in range(naggs)] + [ghi]
+        # dynamic: payload-weighted boundaries need every rank's run
+        # list — a ragged allgather (pad to the max count, like the
+        # v-collectives' static-counts convention)
+        flat = np.array([v for run in my_runs for v in run], np.int64)
+        counts = np.asarray(comm.allgather(
+            np.array([len(flat)], np.int64))).ravel()
+        maxc = max(2, int(counts.max()))
+        padded = np.zeros(maxc, np.int64)
+        padded[:len(flat)] = flat
+        stacked = np.asarray(comm.allgather(padded)).reshape(
+            comm.size, maxc)
+        runs: list[tuple[int, int]] = []
+        for r in range(comm.size):
+            arr = stacked[r, :int(counts[r])].reshape(-1, 2)
+            runs.extend((int(o), int(ln)) for o, ln in arr)
+        runs.sort()
+        total = sum(ln for _, ln in runs)
+        if total <= 0:
+            return None
+        share = -(-total // naggs)   # payload bytes per aggregator
+        bounds = [glo]
+        acc = 0
+        for off, ln in runs:
+            # place a boundary wherever cumulative payload crosses the
+            # next share multiple (possibly several inside one long run)
+            while acc + ln >= share * len(bounds) and len(bounds) < naggs:
+                bounds.append(off + (share * len(bounds) - acc))
+            acc += ln
+        while len(bounds) < naggs:
+            bounds.append(ghi)
+        bounds.append(ghi)
+        for i in range(1, len(bounds)):   # keep monotone under overlap
+            bounds[i] = max(bounds[i], bounds[i - 1])
+        return bounds
+
+    def _route_to_aggregators(self, my_runs, bounds, aggs,
+                              raw: Optional[bytes]):
+        """Split my runs at domain boundaries and bucket (meta, payload)
+        per destination rank.  raw=None ⇒ request-only (read path).
+
+        Also returns the ordered split sequence [(dest, take), …] — the
+        read path's reassembly MUST walk the identical splits the
+        requests were routed by, so the algorithm lives here once."""
+        import bisect
+
+        size = self.comm.size
+        naggs = len(aggs)
+        meta = [[] for _ in range(size)]
+        payload = [[] for _ in range(size)] if raw is not None else None
+        order: list[tuple[int, int]] = []
+        pos = 0
+        for off, ln in my_runs:
+            while ln > 0:
+                i = min(max(bisect.bisect_right(bounds, off) - 1, 0),
+                        naggs - 1)
+                dom_end = bounds[i + 1] if i + 1 < len(bounds) else off + ln
+                take = min(ln, max(dom_end - off, 1))
+                dest = aggs[i]
+                meta[dest].append((off, take))
+                order.append((dest, take))
+                if raw is not None:
+                    payload[dest].append(raw[pos:pos + take])
+                    pos += take
+                off += take
+                ln -= take
+        return meta, payload, order
 
     def write_at_all(self, offset: int, data: Any) -> int:
-        """≈ MPI_File_write_at_all — two-phase collective write."""
+        """≈ MPI_File_write_at_all — collective write through the
+        selected fcoll component (ref: fcoll/two_phase/
+        fcoll_two_phase_file_write_all.c, fcoll/dynamic)."""
         self._check_write()
         raw = self._as_bytes(data)
         my_runs = self.view.byte_runs(offset, len(raw))
-        if not self._two_phase_enabled(len(raw)):
+        comp = self._fcoll_component(len(raw), my_runs)
+        if comp == "individual":
             n = self._write_raw_at(offset, raw)
             self.comm.barrier()
             return n
         comm = self.comm
         size = comm.size
-        # phase 0: agree on the global byte extent → aggregator domains
-        lo = my_runs[0][0] if my_runs else np.iinfo(np.int64).max
-        hi = my_runs[-1][0] + my_runs[-1][1] if my_runs else 0
-        ext = np.asarray(comm.allgather(np.array([lo, hi], np.int64)))
-        glo = int(ext[:, 0].min())
-        ghi = int(ext[:, 1].max())
-        if ghi <= glo:
+        aggs = self._aggregators()
+        bounds = self._domain_bounds(comp, my_runs, len(aggs))
+        if bounds is None:
             comm.barrier()
             return 0
-        dom = -(-(ghi - glo) // size)  # ceil: bytes per aggregator domain
-
-        def owner(off: int) -> int:
-            return min((off - glo) // dom, size - 1)
-
-        # phase 1: split my runs at domain boundaries, route to aggregators
-        meta = [[] for _ in range(size)]   # (file_off, len) per dest
-        payload = [[] for _ in range(size)]
-        pos = 0
-        for off, ln in my_runs:
-            while ln > 0:
-                o = owner(off)
-                dom_end = glo + (o + 1) * dom
-                take = min(ln, dom_end - off)
-                meta[o].append((off, take))
-                payload[o].append(raw[pos:pos + take])
-                pos += take
-                off += take
-                ln -= take
+        meta, payload, _order = self._route_to_aggregators(
+            my_runs, bounds, aggs, raw)
         meta_arrs = [np.array(m, np.int64).reshape(-1, 2).ravel()
                      for m in meta]
         pay_arrs = [np.frombuffer(b"".join(p), np.uint8) for p in payload]
         got_meta = comm.alltoallv(meta_arrs)
         got_pay = comm.alltoallv(pay_arrs)
-        # phase 2: aggregate into maximal contiguous writes, rank order wins
+        # aggregation phase: maximal contiguous writes, rank order wins
         agg: list[tuple[int, int, bytes]] = []
         for r in range(size):
             m = np.asarray(got_meta[r]).reshape(-1, 2)
@@ -525,46 +697,31 @@ class File:
         return len(raw) // self.view.etype.size
 
     def read_at_all(self, offset: int, count: int) -> np.ndarray:
-        """≈ MPI_File_read_at_all — two-phase collective read."""
+        """≈ MPI_File_read_at_all — collective read through the selected
+        fcoll component."""
         self._check_read()
         nbytes = count * self.view.etype.size
         my_runs = self.view.byte_runs(offset, nbytes)
-        if not self._two_phase_enabled(nbytes):
+        comp = self._fcoll_component(nbytes, my_runs)
+        if comp == "individual":
             out = self.read_at(offset, count)
             self.comm.barrier()
             return out
         comm = self.comm
         size = comm.size
-        lo = my_runs[0][0] if my_runs else np.iinfo(np.int64).max
-        hi = my_runs[-1][0] + my_runs[-1][1] if my_runs else 0
-        ext = np.asarray(comm.allgather(np.array([lo, hi], np.int64)))
-        glo = int(ext[:, 0].min())
-        ghi = int(ext[:, 1].max())
-        if ghi <= glo:
+        aggs = self._aggregators()
+        bounds = self._domain_bounds(comp, my_runs, len(aggs))
+        if bounds is None:
             comm.barrier()
             return self._from_bytes(b"")
-        dom = -(-(ghi - glo) // size)
-
-        def owner(off: int) -> int:
-            return min((off - glo) // dom, size - 1)
-
-        # phase 1: send my run *requests* to the domain aggregators
-        meta = [[] for _ in range(size)]
-        for off, ln in my_runs:
-            while ln > 0:
-                o = owner(off)
-                dom_end = glo + (o + 1) * dom
-                take = min(ln, dom_end - off)
-                meta[o].append((off, take))
-                off += take
-                ln -= take
+        meta, _pay, order = self._route_to_aggregators(
+            my_runs, bounds, aggs, None)
         meta_arrs = [np.array(m, np.int64).reshape(-1, 2).ravel()
                      for m in meta]
         got_meta = comm.alltoallv(meta_arrs)
-        # phase 2: aggregators read each requested run once (coalesced
-        # pread over their domain slice) and reply per requester; a pread
-        # can come up short at EOF, so a reply may be shorter than the sum
-        # of requested runs
+        # aggregators read each requested run once (coalesced pread over
+        # their domain slice) and reply per requester; a pread can come
+        # up short at EOF, so a reply may be shorter than requested
         replies = []
         for r in range(size):
             m = np.asarray(got_meta[r]).reshape(-1, 2)
@@ -578,27 +735,19 @@ class File:
             else:
                 replies.append(np.empty(0, np.uint8))
         got_pay = comm.alltoallv(replies)
-        # reassemble in my original run order (requests were split in
-        # ascending file order per aggregator, and aggregators preserve
-        # request order).  EOF truncation shortens exactly a greedy suffix
-        # of an aggregator's ascending runs, so the per-run actual length
-        # is derivable from what remains of the reply blob — no second
-        # metadata exchange needed.
+        # reassemble in my original run order by replaying the SAME split
+        # sequence the requests were routed by (aggregators preserve
+        # request order).  EOF truncation shortens exactly a greedy
+        # suffix of an aggregator's ascending runs, so the per-run actual
+        # length is derivable from what remains of the reply blob.
         blobs = [np.asarray(got_pay[r], np.uint8).tobytes()
                  for r in range(size)]
         cursors = [0] * size
         out = bytearray()
-        for off, ln in my_runs:
-            o_off, o_ln = off, ln
-            while o_ln > 0:
-                o = owner(o_off)
-                dom_end = glo + (o + 1) * dom
-                take = min(o_ln, dom_end - o_off)
-                got = min(take, max(0, len(blobs[o]) - cursors[o]))
-                out += blobs[o][cursors[o]:cursors[o] + got]
-                cursors[o] += got
-                o_off += take
-                o_ln -= take
+        for dest, take in order:
+            got = min(take, max(0, len(blobs[dest]) - cursors[dest]))
+            out += blobs[dest][cursors[dest]:cursors[dest] + got]
+            cursors[dest] += got
         comm.barrier()
         return self._from_bytes(bytes(out))
 
@@ -619,6 +768,10 @@ class File:
     # -- shared file pointer (sharedfp/lockedfile equivalent) --------------
 
     def _shfp_load(self) -> int:
+        if self._shfp_err:
+            raise MPIException(
+                f"shared file pointer unavailable: the sidecar could not "
+                f"be created at open ({self._shfp_err})", error_class=38)
         with open(self._shfp_path, "rb") as f:
             return int.from_bytes(f.read(8), "big")
 

@@ -1,0 +1,192 @@
+"""fcoll framework: selectable collective-IO components + job-aware
+aggregator selection.
+
+≈ ompi/mca/fcoll — two_phase (static equal file domains,
+fcoll_two_phase_file_write_all.c), dynamic (payload-weighted domains),
+individual; aggregators one-per-host from the job mapping like OMPIO's
+cb_nodes default; the collective_buffering/cb_nodes info hints.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from ompi_tpu.core import config
+from ompi_tpu.mpi import io as mio
+from ompi_tpu.mpi.constants import MPIException
+from ompi_tpu.mpi.datatype import FLOAT
+from ompi_tpu.mpi.info import Info
+from tests.mpi.harness import run_ranks
+
+
+@pytest.fixture
+def fcoll_var():
+    old = config.var_registry.get("io_fcoll")
+    yield lambda v: config.var_registry.set("io_fcoll", v)
+    config.var_registry.set("io_fcoll", old or "")
+
+
+def _strided_write(comm, path, fcoll=None, hosts=None, info=None):
+    """Each rank writes its column of a (16, size) f32 matrix through a
+    strided view; returns the file contents as a matrix."""
+    if hosts is not None:
+        comm._io_host_override = hosts[comm.rank]
+    size = comm.size
+    f = mio.File.open(comm, path,
+                      mio.MODE_RDWR | mio.MODE_CREATE, info=info)
+    ft = FLOAT.vector(16, 1, size)     # one float column of 16 rows
+    f.set_view(disp=4 * comm.rank, etype=FLOAT, filetype=ft)
+    data = np.full(16, comm.rank, np.float32)
+    n = f.write_at_all(0, data)
+    assert n == 16
+    f.close()
+    comm.barrier()
+    return np.fromfile(path, np.float32).reshape(16, size)
+
+
+def _check(mat, size):
+    for c in range(size):
+        np.testing.assert_array_equal(mat[:, c], np.full(16, c, np.float32))
+
+
+@pytest.mark.parametrize("comp", ["two_phase", "dynamic", "individual"])
+def test_forced_components_correct(tmp_path, fcoll_var, comp):
+    path = str(tmp_path / f"m_{comp}.bin")
+    fcoll_var(comp)
+
+    def body(comm):
+        return _strided_write(comm, path)
+
+    run_ranks(4, body)
+    _check(np.fromfile(path, np.float32).reshape(16, 4), 4)
+
+
+def test_unknown_component_raises(tmp_path, fcoll_var):
+    fcoll_var("bogus")
+    path = str(tmp_path / "x.bin")
+
+    def body(comm):
+        with pytest.raises(MPIException, match="bogus"):
+            _strided_write(comm, path)
+        return None
+
+    run_ranks(2, body)
+
+
+def test_host_aware_aggregators(tmp_path):
+    """Two fake hosts → exactly one aggregator per host (ranks 0 and 2);
+    the write must still land correctly through the 2-aggregator plan."""
+    path = str(tmp_path / "hosts.bin")
+    hosts = ["nodeA", "nodeA", "nodeB", "nodeB"]
+    seen = {}
+
+    def body(comm):
+        comm._io_host_override = hosts[comm.rank]
+        f = mio.File.open(comm, path, mio.MODE_RDWR | mio.MODE_CREATE)
+        seen[comm.rank] = f._aggregators()
+        f.close()
+        return _strided_write(comm, path, hosts=hosts)
+
+    run_ranks(4, body)
+    assert seen[0] == [0, 2]            # lowest rank of each host
+    assert all(v == [0, 2] for v in seen.values())
+    _check(np.fromfile(path, np.float32).reshape(16, 4), 4)
+
+
+def test_cb_nodes_hint_caps_aggregators(tmp_path):
+    path = str(tmp_path / "cap.bin")
+    hosts = ["a", "b", "c", "d"]
+
+    def body(comm):
+        comm._io_host_override = hosts[comm.rank]
+        f = mio.File.open(comm, path, mio.MODE_RDWR | mio.MODE_CREATE,
+                          info=Info({"cb_nodes": "2"}))
+        aggs = f._aggregators()
+        f.close()
+        return aggs
+
+    out = run_ranks(4, body)
+    assert all(a == [0, 1] for a in out)
+
+
+def test_collective_buffering_hint_disables(tmp_path):
+    """collective_buffering=false must route through individual IO (and
+    still produce a correct file)."""
+    path = str(tmp_path / "nobuf.bin")
+
+    def body(comm):
+        f = mio.File.open(comm, path, mio.MODE_RDWR | mio.MODE_CREATE,
+                          info=Info({"collective_buffering": "false"}))
+        comp = f._fcoll_component(64, [(0, 4), (8, 4)])
+        f.close()
+        return comp
+
+    out = run_ranks(2, body)
+    assert out == ["individual", "individual"]
+
+
+def test_auto_decision_skew_picks_dynamic(tmp_path):
+    """4x payload skew between ranks → the auto decision goes dynamic."""
+    path = str(tmp_path / "skew.bin")
+
+    def body(comm):
+        f = mio.File.open(comm, path, mio.MODE_RDWR | mio.MODE_CREATE)
+        nbytes = 8192 if comm.rank == 0 else 512
+        runs = [(comm.rank * 64, 32), (4096 + comm.rank * 64, 32)]
+        comp = f._fcoll_component(nbytes, runs)
+        f.close()
+        return comp
+
+    out = run_ranks(4, body)
+    assert out == ["dynamic"] * 4
+
+
+def test_dynamic_domain_bounds_balance(tmp_path):
+    """dynamic bounds put ~equal payload per aggregator even when the
+    file extent is wildly skewed toward one region."""
+    path = str(tmp_path / "bal.bin")
+
+    def body(comm):
+        f = mio.File.open(comm, path, mio.MODE_RDWR | mio.MODE_CREATE)
+        # rank r owns a dense 1KiB run at offset r*1024 plus rank 0 has a
+        # huge sparse tail run at 1MiB
+        runs = [(comm.rank * 1024, 1024)]
+        if comm.rank == 0:
+            runs.append((1 << 20, 1024))
+        bounds = f._domain_bounds("dynamic", runs, 2)
+        f.close()
+        return bounds
+
+    out = run_ranks(2, body)
+    b = out[0]
+    assert b[0] == 0 and b[-1] == (1 << 20) + 1024
+    # payload = 3 KiB total → the midpoint boundary must fall inside the
+    # dense head region (equal-span bounds would put it at ~512 KiB)
+    assert b[1] <= 2048
+
+
+def test_large_strided_roundtrip_all_components(tmp_path, fcoll_var):
+    """Write with one component, read back with another — the file is
+    component-independent."""
+    path = str(tmp_path / "mix.bin")
+    fcoll_var("dynamic")
+
+    def wr(comm):
+        return _strided_write(comm, path)
+
+    run_ranks(4, wr)
+    fcoll_var("two_phase")
+
+    def rd(comm):
+        size = comm.size
+        f = mio.File.open(comm, path, mio.MODE_RDONLY)
+        ft = FLOAT.vector(16, 1, size)
+        f.set_view(disp=4 * comm.rank, etype=FLOAT, filetype=ft)
+        out = f.read_at_all(0, 16)
+        f.close()
+        np.testing.assert_array_equal(
+            out, np.full(16, comm.rank, np.float32))
+        return None
+
+    run_ranks(4, rd)
