@@ -1,0 +1,323 @@
+"""Persistent distributed VM + live-job control plane.
+
+≈ orte/tools/orte-dvm/orte-dvm.c:1 (a standing daemon VM that runs many
+jobs without re-launching), orte/mca/state/dvm/state_dvm.c:1 (the job
+lifecycle on a persistent VM: jobs come and go, daemons stay wired), and
+orte/tools/orte-ps/orte-ps.c:1 (query a live VM's job/proc table).
+
+The DVM HNP brings the daemon tree up ONCE (the expensive part — on real
+pods that includes TPU runtime warm-up), writes its control URI to a
+file, then serves job submissions over a line-JSON TCP control channel:
+
+    tpurun --dvm-start --plm sim --hosts 2 --slots 8      # terminal 1
+    tpurun --dvm-submit -np 4 python app.py               # terminal 2 (fast)
+    tpurun --dvm-ps                                       # live proc table
+    tpurun --dvm-stop
+
+Jobs run sequentially (one at a time, like orte-dvm's default): each gets
+a fresh PMIx rendezvous sized to its np, a map over the standing nodes,
+and its IOF streamed back to the submitting client.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from typing import Any, Optional
+
+from ompi_tpu.core import output
+from ompi_tpu.runtime import rmaps, rml
+from ompi_tpu.runtime.job import AppContext, Job, ProcState
+from ompi_tpu.runtime.plm import MultiHostLauncher
+
+__all__ = ["DvmHnp", "submit", "ps", "stop", "default_uri_path"]
+
+_log = output.get_stream("dvm")
+
+
+def default_uri_path() -> str:
+    return os.path.join(
+        os.environ.get("TMPDIR", "/tmp"),
+        f"ompi_tpu-dvm-{os.getuid()}.uri")
+
+
+def _read_uri(path: str) -> str:
+    with open(path, encoding="utf-8") as f:
+        return f.read().strip()
+
+
+class DvmHnp(MultiHostLauncher):
+    """The standing-VM HNP: daemon tree up once, jobs on demand."""
+
+    def __init__(self, plm_name: str = "sim", want_tpu: bool = False,
+                 uri_path: Optional[str] = None, **select_ctx) -> None:
+        super().__init__(plm_name=plm_name, want_tpu=want_tpu,
+                         stdin_target="none", **select_ctx)
+        self._persistent = True
+        self.uri_path = uri_path or default_uri_path()
+        self._job_lock = threading.Lock()     # one job at a time
+        self._stopped = threading.Event()
+        self._ctrl: Optional[socket.socket] = None
+        self._client_sink = None              # active job's IOF stream
+        self.vm_job: Optional[Job] = None
+        self._history: list[dict] = []        # completed-job records
+
+    # -- VM lifecycle ------------------------------------------------------
+
+    def start(self, np_slots: int) -> None:
+        """Allocate nodes, spawn + wire the daemon tree, open the control
+        channel, write the URI file."""
+        from ompi_tpu.runtime import ras
+
+        vm = Job([AppContext(argv=["-"], np=np_slots)])
+        ras.allocate(vm, want_tpu=self.want_tpu, **self.select_ctx)
+        rmaps.map_job(vm, **self.select_ctx)
+        self.vm_job = vm
+        if not self._vm_up(vm):
+            raise RuntimeError(
+                f"DVM bring-up failed: {vm.abort_reason}")
+        self._ctrl = socket.create_server(("127.0.0.1", 0))
+        port = self._ctrl.getsockname()[1]
+        with open(self.uri_path, "w", encoding="utf-8") as f:
+            f.write(f"127.0.0.1:{port}\n")
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+        _log.verbose(1, "DVM up: %d daemons, ctrl 127.0.0.1:%d (uri %s)",
+                     len(vm.nodes), port, self.uri_path)
+
+    def serve_forever(self) -> int:
+        self._stopped.wait()
+        return 0
+
+    def shutdown(self) -> None:
+        if self._stopped.is_set():
+            return
+        self._stopped.set()
+        try:
+            self._teardown_vm()
+        finally:
+            if self._ctrl is not None:
+                self._ctrl.close()
+            try:
+                os.unlink(self.uri_path)
+            except OSError:
+                pass
+
+    # -- control channel ---------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                conn, _addr = self._ctrl.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            rfile = conn.makefile("r", encoding="utf-8")
+            wfile = conn.makefile("w", encoding="utf-8")
+            line = rfile.readline()
+            if not line:
+                return
+            req = json.loads(line)
+            cmd = req.get("cmd")
+            if cmd == "run":
+                self._cmd_run(req, wfile)
+            elif cmd == "ps":
+                self._reply(wfile, {"ps": self._ps_table()})
+            elif cmd == "stop":
+                self._reply(wfile, {"ok": True})
+                wfile.flush()
+                self.shutdown()
+            else:
+                self._reply(wfile, {"error": f"unknown cmd {cmd!r}"})
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            _log.verbose(1, "control connection error: %r", e)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def _reply(wfile, obj: dict) -> None:
+        wfile.write(json.dumps(obj) + "\n")
+        wfile.flush()
+
+    # -- job execution on the warm VM --------------------------------------
+
+    def _cmd_run(self, req: dict, wfile) -> None:
+        argv = req.get("argv") or []
+        np_ = int(req.get("np") or 1)
+        if not argv:
+            self._reply(wfile, {"error": "no argv"})
+            return
+        with self._job_lock:                  # sequential, like orte-dvm
+            t0 = time.perf_counter()
+            rc = self._run_one(argv, np_, req.get("env") or {},
+                               req.get("cwd"), wfile)
+            self._reply(wfile, {"exit": rc,
+                                "wall_s": round(time.perf_counter() - t0,
+                                                3)})
+
+    def _run_one(self, argv, np_: int, env: dict, cwd, wfile) -> int:
+        job = Job([AppContext(argv=list(argv), np=np_,
+                              env=dict(env), cwd=cwd)])
+        job.nodes = self.vm_job.nodes         # the standing allocation
+        for n in job.nodes:
+            n.slots_inuse = 0
+        try:
+            rmaps.map_job(job, **self.select_ctx)
+        except Exception as e:  # noqa: BLE001 — report, keep the VM alive
+            self._reply(wfile, {"error": f"map failed: {e}"})
+            return 1
+        # fresh per-job bookkeeping on the standing VM
+        with self._cv:
+            self._exited.clear()
+            self._killed = False
+            job_lost = self._lost_daemon
+        if job_lost is not None:
+            self._reply(wfile, {"error": f"daemon {job_lost} is down"})
+            return 1
+        self._client_sink = wfile
+        try:
+            self._launch_apps(job)
+            self._wait_ranks(job)
+        finally:
+            self._client_sink = None
+            if self.server is not None:
+                self.server.close()
+                self.server = None
+        rcs = [self._exited.get(p.rank, 1) for p in job.procs]
+        rc = (job.abort_status if job.abort_status
+              else next((r for r in rcs if r), 0))
+        if rc < 0:
+            rc = 128 - rc   # signal exit, same mapping as the non-DVM path
+        self._history.append({
+            "jobid": job.jobid, "argv": argv, "np": np_, "rc": rc,
+            "finished": time.time()})
+        return rc
+
+    def _on_iof(self, origin: int, payload) -> None:
+        """Route a running job's output to the submitting client; fall
+        back to the DVM's own stdout when no client is attached."""
+        sink = self._client_sink
+        if sink is None:
+            return super()._on_iof(origin, payload)
+        rank, stream, raw = payload
+        try:
+            self._reply(sink, {
+                "iof": [rank, stream,
+                        bytes(raw).decode(errors="replace")]})
+        except (OSError, ValueError):
+            self._client_sink = None          # client went away; drop
+
+    # -- introspection (≈ orte-ps) -----------------------------------------
+
+    def _ps_table(self) -> dict:
+        vm = self.vm_job
+        job = self._cur_job
+        nodes = [{"vpid": i + 1, "host": n.name, "slots": n.slots,
+                  "chips": (len(n.chips) if n.chips else 0),
+                  "pid": (self._daemon_popen[i].pid
+                          if i < len(self._daemon_popen) else None)}
+                 for i, n in enumerate(vm.nodes)] if vm else []
+        procs = []
+        if job is not None and job is not vm:
+            for p in job.procs:
+                procs.append({
+                    "rank": p.rank, "state": p.state.value,
+                    "host": p.node.name if p.node else "?",
+                    "local_rank": p.local_rank,
+                    "restarts": p.restarts,
+                    "exit_code": p.exit_code,
+                })
+        return {"daemons": nodes,
+                "current_job": (None if job is None or job is vm else {
+                    "jobid": job.jobid,
+                    "argv": job.apps[0].argv,
+                    "np": job.np,
+                    "procs": procs}),
+                "history": self._history[-20:]}
+
+
+# -- client side -----------------------------------------------------------
+
+def _connect(uri_or_path: Optional[str]) -> socket.socket:
+    target = uri_or_path or default_uri_path()
+    if os.path.exists(target):
+        target = _read_uri(target)
+    if ":" not in target:
+        raise RuntimeError(
+            f"no DVM running (uri file {target!r} not found — start one "
+            f"with: tpurun --dvm-start)")
+    host, port = target.rsplit(":", 1)
+    try:
+        return socket.create_connection((host, int(port)), timeout=30)
+    except OSError as e:
+        raise RuntimeError(
+            f"cannot reach the DVM at {target} ({e}) — is it still "
+            f"running?") from e
+
+
+def submit(argv: list[str], np_: int = 1,
+           env: Optional[dict] = None, cwd: Optional[str] = None,
+           uri: Optional[str] = None, sink=None) -> int:
+    """Run a job on a standing DVM; streams IOF to ``sink`` (default:
+    this process's stdout/stderr).  Returns the job's exit code."""
+    import sys
+
+    conn = _connect(uri)
+    try:
+        wfile = conn.makefile("w", encoding="utf-8")
+        rfile = conn.makefile("r", encoding="utf-8")
+        wfile.write(json.dumps({
+            "cmd": "run", "argv": argv, "np": np_,
+            "env": env or {}, "cwd": cwd or os.getcwd()}) + "\n")
+        wfile.flush()
+        conn.settimeout(None)                 # jobs may run long
+        for line in rfile:
+            msg = json.loads(line)
+            if "iof" in msg:
+                rank, stream, text = msg["iof"]
+                if sink is not None:
+                    sink(rank, stream, text)
+                else:
+                    out = sys.stdout if stream == "out" else sys.stderr
+                    out.write(f"[dvm,{rank}]{text}")
+                    out.flush()
+            elif "exit" in msg:
+                return int(msg["exit"])
+            elif "error" in msg:
+                raise RuntimeError(f"dvm: {msg['error']}")
+        raise RuntimeError("dvm: connection closed before job completion")
+    finally:
+        conn.close()
+
+
+def ps(uri: Optional[str] = None) -> dict:
+    """Live VM/job table (≈ orte-ps)."""
+    conn = _connect(uri)
+    try:
+        wfile = conn.makefile("w", encoding="utf-8")
+        rfile = conn.makefile("r", encoding="utf-8")
+        wfile.write(json.dumps({"cmd": "ps"}) + "\n")
+        wfile.flush()
+        return json.loads(rfile.readline())["ps"]
+    finally:
+        conn.close()
+
+
+def stop(uri: Optional[str] = None) -> None:
+    conn = _connect(uri)
+    try:
+        wfile = conn.makefile("w", encoding="utf-8")
+        wfile.write(json.dumps({"cmd": "stop"}) + "\n")
+        wfile.flush()
+        conn.makefile("r", encoding="utf-8").readline()
+    finally:
+        conn.close()

@@ -147,6 +147,9 @@ class MultiHostLauncher:
         self._killed = False
         self._lost_daemon: Optional[int] = None            # vpid, if died
         self._np_hint = 1 << 30                            # set at launch
+        self._cur_job: Optional[Job] = None
+        self._persistent = False          # DVM mode: VM outlives jobs
+        self._vm_stop = threading.Event()
 
     # -- state handlers ----------------------------------------------------
 
@@ -159,22 +162,27 @@ class MultiHostLauncher:
         return JobState.LAUNCH_APPS
 
     def _st_launch(self, sm: StateMachine, job: Job) -> Optional[JobState]:
+        if not self._vm_up(job):
+            return JobState.ABORTED
+        self._launch_apps(job)
+        return JobState.RUNNING
+
+    def _vm_up(self, job: Job) -> bool:
+        """LAUNCH_DAEMONS + VM_READY: spawn one orted per node and wire
+        the routed tree.  The VM outlives a single job in DVM mode (≈
+        orte-dvm), which is why this phase is separate from app launch."""
         n_daemons = len(job.nodes)
         self._np_hint = job.np
+        self._cur_job = job
         self.rml = rml.RmlNode(0)
         self.rml.register_recv(rml.TAG_REGISTER, self._on_register)
         self.rml.register_recv(rml.TAG_DAEMON_READY, self._on_ready)
         self.rml.register_recv(rml.TAG_IOF, self._on_iof)
-        self.rml.register_recv(rml.TAG_PROC_EXIT,
-                               lambda o, p: self._on_proc_exit(job, p))
-        # pmix rendezvous reachable from every host
-        self.server = pmix.PMIxServer(
-            size=job.np, host="0.0.0.0",
-            on_abort=lambda r, s, m: self._on_abort(job, r, s, m))
-
+        self.rml.register_recv(
+            rml.TAG_PROC_EXIT,
+            lambda o, p: self._on_proc_exit(self._cur_job, p))
         self.rml.on_peer_lost = self._on_daemon_lost
 
-        # LAUNCH_DAEMONS: plm spawns one orted per node; they phone home
         self._daemon_popen = self.plm.spawn_daemons(job, self.rml.uri)
         threading.Thread(target=self._daemon_monitor, args=(job,),
                          daemon=True).start()
@@ -191,7 +199,7 @@ class MultiHostLauncher:
                 f"reported within {timeout}s")
             job.aborted_proc = job.procs[0]
             self.kill_job(job)
-            return JobState.ABORTED
+            return False
 
         # VM_READY: wire the routed tree (vpid 0 = me, 1..N = daemons).
         # Dial my own children BEFORE sending any WIRE: a daemon replies
@@ -217,9 +225,17 @@ class MultiHostLauncher:
                 else "daemon tree wiring timed out")
             job.aborted_proc = job.procs[0]
             self.kill_job(job)
-            return JobState.ABORTED
+            return False
+        return True
 
-        # LAUNCH_APPS: one xcast with the whole map; daemons pick their rows
+    def _launch_apps(self, job: Job) -> None:
+        """LAUNCH_APPS: fresh pmix rendezvous sized to this job, then one
+        xcast with the whole map; daemons pick their rows."""
+        self._cur_job = job
+        self._np_hint = job.np
+        self.server = pmix.PMIxServer(
+            size=job.np, host="0.0.0.0",
+            on_abort=lambda r, s, m: self._on_abort(self._cur_job, r, s, m))
         app = job.apps[0]
         env = dict(app.env)
         env[pmix.ENV_URI] = self.server.uri.replace("0.0.0.0",
@@ -243,16 +259,30 @@ class MultiHostLauncher:
             p.state = ProcState.RUNNING
         if stdin_rank is not None:
             self._start_stdin_pump(stdin_rank)
-        return JobState.RUNNING
 
-    def _st_running(self, sm: StateMachine, job: Job) -> JobState:
+    def _wait_ranks(self, job: Job) -> None:
+        """Block until every rank reported (or the VM lost a daemon)."""
         # A lost daemon is a lost lifeline (≈ ORTE aborting the job when an
         # orted dies): its ranks' PROC_EXIT reports are gone forever, so
         # waiting only on rank exits would hang.
         with self._cv:
-            self._cv.wait_for(lambda: (len(self._exited) >= job.np
-                                       or self._lost_daemon is not None))
+            self._cv.wait_for(
+                lambda: (len(self._exited) >= job.np
+                         or self._lost_daemon is not None
+                         or self._vm_stop.is_set()),
+                )
             lost = self._lost_daemon
+        if self._vm_stop.is_set() and len(self._exited) < job.np:
+            # VM shutdown ordered mid-job (DVM stop): ranks were killed
+            # with the daemons; give their exit reports a moment, then
+            # account the job as aborted rather than hanging forever
+            with self._cv:
+                self._cv.wait_for(lambda: len(self._exited) >= job.np,
+                                  timeout=3.0)
+            if job.aborted_proc is None and len(self._exited) < job.np:
+                job.abort_reason = "VM shut down while the job was running"
+                job.aborted_proc = job.procs[0]
+            return
         if lost is not None and len(self._exited) < job.np:
             if job.aborted_proc is None:
                 job.abort_reason = (
@@ -272,6 +302,11 @@ class MultiHostLauncher:
                 self._cv.wait_for(
                     lambda: all(r in self._exited for r in alive),
                     timeout=3.0)
+
+    def _teardown_vm(self) -> None:
+        with self._cv:
+            self._vm_stop.set()
+            self._cv.notify_all()   # wake a _wait_ranks blocked mid-job
         self.rml.xcast(rml.TAG_SHUTDOWN, None)
         deadline = time.monotonic() + 5.0
         for p in self._daemon_popen:
@@ -282,6 +317,10 @@ class MultiHostLauncher:
         if self.server is not None:
             self.server.close()
         self.rml.close()
+
+    def _st_running(self, sm: StateMachine, job: Job) -> JobState:
+        self._wait_ranks(job)
+        self._teardown_vm()
         return (JobState.ABORTED if job.aborted_proc is not None
                 else JobState.TERMINATED)
 
@@ -350,7 +389,9 @@ class MultiHostLauncher:
     def _on_daemon_lost(self, vpid: int) -> None:
         """RML link EOF from a daemon (crash/SIGKILL/host death)."""
         with self._cv:
-            if self._killed or len(self._exited) >= self._np_hint:
+            if self._killed or self._vm_stop.is_set() or (
+                    not self._persistent
+                    and len(self._exited) >= self._np_hint):
                 return  # normal teardown, not a failure
             if self._lost_daemon is None:
                 self._lost_daemon = vpid
@@ -362,10 +403,16 @@ class MultiHostLauncher:
                f"aborting the job")
 
     def _daemon_monitor(self, job: Job) -> None:
-        """Poll orted Popen handles: a dead daemon before job end = abort."""
+        """Poll orted Popen handles: a dead daemon before job end = abort.
+        In DVM mode the monitor runs for the VM's lifetime."""
         while True:
+            if self._vm_stop.is_set():
+                return
             with self._cv:
-                if self._killed or len(self._exited) >= job.np:
+                # _killed is job-scoped on a persistent VM (reset per
+                # submission): the monitor must outlive an aborted job
+                if (not self._persistent
+                        and (self._killed or len(self._exited) >= job.np)):
                     return
             for i, p in enumerate(self._daemon_popen):
                 if p.poll() is not None:

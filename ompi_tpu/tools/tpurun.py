@@ -41,6 +41,24 @@ def build_parser() -> argparse.ArgumentParser:
                    help="number of simulated hosts for --plm sim")
     p.add_argument("--stdin", default=None, metavar="RANK|all|none",
                    help="forward launcher stdin to this rank (default 0)")
+    # persistent DVM (≈ orte-dvm / orte-submit / orte-ps)
+    p.add_argument("--dvm-start", action="store_true",
+                   help="bring up a persistent daemon VM and serve job "
+                        "submissions (≈ orte-dvm)")
+    p.add_argument("--dvm-submit", action="store_true",
+                   help="run the command on a standing DVM (fast: skips "
+                        "VM bring-up; ≈ orte-submit)")
+    p.add_argument("--dvm-ps", action="store_true",
+                   help="print a standing DVM's daemon/job/proc table "
+                        "(≈ orte-ps)")
+    p.add_argument("--dvm-stop", action="store_true",
+                   help="shut a standing DVM down")
+    p.add_argument("--dvm-uri", default=None, metavar="FILE|HOST:PORT",
+                   help="DVM control URI or the file holding it "
+                        "(default: the per-user uri file in TMPDIR)")
+    p.add_argument("--slots", type=int, default=None,
+                   help="total rank slots the DVM allocates at start "
+                        "(--dvm-start; default: np or hosts*ceil)")
     p.add_argument("--tag-output", dest="tag", action="store_true",
                    default=None, help="tag output lines with [jobid,rank]")
     p.add_argument("--no-tag-output", dest="tag", action="store_false")
@@ -51,7 +69,28 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
-    if not args.command:
+    if args.dvm_ps:
+        import json as _json
+
+        from ompi_tpu.runtime import dvm
+
+        try:
+            print(_json.dumps(dvm.ps(args.dvm_uri), indent=1))
+        except RuntimeError as e:
+            print(f"tpurun: {e}", file=sys.stderr)
+            return 1
+        return 0
+    if args.dvm_stop:
+        from ompi_tpu.runtime import dvm
+
+        try:
+            dvm.stop(args.dvm_uri)
+        except RuntimeError as e:
+            print(f"tpurun: {e}", file=sys.stderr)
+            return 1
+        print("dvm: stopped", file=sys.stderr)
+        return 0
+    if not args.command and not args.dvm_start:
         print("tpurun: no command given (try: tpurun -np 4 python app.py)",
               file=sys.stderr)
         return 2
@@ -75,17 +114,56 @@ def main(argv: list[str] | None = None) -> int:
     if args.hostfile:
         var_registry.load_cli([("ras_hostfile", args.hostfile)])
 
+    def _configure_sim_ras(total_slots: int) -> None:
+        """Shared sim-RAS setup for --plm sim and --dvm-start."""
+        import math
+
+        var_registry.load_cli([
+            ("ras", "simulator"),
+            ("ras_sim_num_nodes", str(args.hosts)),
+            ("ras_sim_slots_per_node",
+             str(math.ceil(total_slots / max(1, args.hosts)))),
+        ])
+
+    if args.dvm_submit:
+        from ompi_tpu.runtime import dvm
+
+        # --mca (and friends) configure the APP processes, which run
+        # under the DVM server — ship them as per-job env, not local env
+        job_env = {var_registry.ENV_PREFIX + k: v for k, v in args.mca}
+        if args.tag is not None:
+            job_env[var_registry.ENV_PREFIX + "launcher_tag_output"] = \
+                "1" if args.tag else "0"
+        try:
+            return dvm.submit(cmd, np_=args.np, uri=args.dvm_uri,
+                              env=job_env)
+        except RuntimeError as e:
+            print(f"tpurun: {e}", file=sys.stderr)
+            return 1
+
+    if args.dvm_start:
+        from ompi_tpu.runtime import dvm
+
+        slots = args.slots or max(args.np, args.hosts)
+        plm_name = args.plm or "sim"
+        if plm_name == "sim" and not args.hostfile:
+            _configure_sim_ras(slots)
+        hnp = dvm.DvmHnp(plm_name=plm_name, want_tpu=args.tpu,
+                         uri_path=args.dvm_uri,
+                         remote_hosts=plm_name == "ssh")
+        hnp.start(np_slots=slots)
+        print(f"dvm: up ({args.hosts} hosts, {slots} slots); "
+              f"uri file {hnp.uri_path}", file=sys.stderr)
+        try:
+            return hnp.serve_forever()
+        except KeyboardInterrupt:
+            hnp.shutdown()
+            return 0
+
     if args.plm:
         # multi-host path: one orted per host, routed tree, IOF up the tree
         if args.plm == "sim" and not args.hostfile:
-            import math
-
-            var_registry.load_cli([
-                ("ras", "simulator"),
-                ("ras_sim_num_nodes", str(args.hosts)),
-                ("ras_sim_slots_per_node",
-                 str(math.ceil(args.np / max(1, args.hosts)))),
-            ])
+            _configure_sim_ras(args.np)
         from ompi_tpu.runtime.job import AppContext, Job
         from ompi_tpu.runtime.plm import MultiHostLauncher
 
