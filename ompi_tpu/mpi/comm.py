@@ -245,6 +245,36 @@ class Communicator:
                 status.source = self.group.rank_of(status.source)
         return out
 
+    def sendrecv_replace(self, buf: Any, dest: int, source: int = 0,
+                         sendtag: int = 0, recvtag: int = ANY_TAG,
+                         status: Optional[Status] = None) -> np.ndarray:
+        """≈ MPI_Sendrecv_replace (sendrecv_replace.c): send ``buf`` to
+        ``dest`` and receive into the SAME buffer from ``source``.  The
+        wire copy is made before the receive can land (the reference
+        stages through a temporary pack buffer for the same reason), and
+        the received data is written back into ``buf`` in place when it
+        is a writable ndarray — the in-place contract the name promises."""
+        arr = np.asarray(buf)
+        staged = arr.copy()                  # sender-side staging copy
+        rreq = self.irecv(None, source, recvtag)
+        sreq = self.isend(staged, dest, sendtag)
+        out = rreq.wait()
+        sreq.wait()
+        if status is not None:
+            status.__dict__.update(rreq.status.__dict__)
+            if status.source >= 0:
+                status.source = self.group.rank_of(status.source)
+        got = np.asarray(out)
+        if got.size == 0 and arr.size != 0:
+            # PROC_NULL source (the edge rank of a non-periodic cart
+            # shift): the receive is a no-op and buf stays unchanged
+            return buf if isinstance(buf, np.ndarray) else arr
+        got = got.reshape(arr.shape).astype(arr.dtype, copy=False)
+        if isinstance(buf, np.ndarray) and buf.flags.writeable:
+            buf[...] = got
+            return buf
+        return got
+
     def probe(self, source: int = -1, tag: int = ANY_TAG,
               timeout: Optional[float] = None) -> Status:
         src = source if source < 0 else self.world_rank(source)
@@ -526,6 +556,20 @@ class Communicator:
         new.errhandler = self.errhandler
         new.device = self.device  # same group ⇒ same mesh binding
         return new
+
+    def idup(self, name: Optional[str] = None) -> tuple[Request,
+                                                        "Communicator"]:
+        """≈ MPI_Comm_idup (comm_idup.c): nonblocking dup — returns
+        (request, newcomm); the new communicator must not be USED until
+        the request completes.  CID agreement here is deterministic (the
+        per-parent counter — see the module docstring), so the returned
+        handle is fully formed and the request completes immediately;
+        the shape of the API (handle now, usable at completion) is what
+        MPI specifies, and callers written against slower allocators
+        stay correct."""
+        new = self.dup(name)
+        req = CompletedRequest(new, kind="idup")
+        return req, new
 
     def create(self, group: Group, name: Optional[str] = None
                ) -> Optional["Communicator"]:

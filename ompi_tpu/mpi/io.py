@@ -75,6 +75,40 @@ register_var("io", "cb_aggregators_per_host", VarType.INT, 1,
              "collective-buffering aggregators per host (aggregators are "
              "the lowest ranks of each host in the job mapping, like "
              "OMPIO's one-per-node cb_nodes default)")
+register_var("io", "fs_adaptive", VarType.BOOL, True,
+             "adapt collective-IO defaults to the filesystem backing the "
+             "file (the fs framework's job, ompi/mca/fs: fs/lustre tunes "
+             "stripe-aware defaults; here: memory-backed fs prefer "
+             "individual IO, network fs aggregate aggressively)")
+
+# memory-backed: aggregation only adds exchange hops (no seek to amortize)
+_FS_MEMORY = {"tmpfs", "ramfs", "devtmpfs"}
+# network: per-client streams are expensive — aggregate aggressively
+_FS_NETWORK = {"nfs", "nfs4", "lustre", "gpfs", "cifs", "smb2", "9p",
+               "fuse.sshfs", "glusterfs", "beegfs"}
+
+
+def _fs_type(path: str) -> str:
+    """Filesystem type backing ``path`` (longest mount-prefix match in
+    /proc/mounts; '' when undeterminable).  ≈ the detection the fs
+    framework components do with statfs magic (fs_lustre.c checks the
+    LL_SUPER_MAGIC the same way)."""
+    try:
+        real = os.path.realpath(path)
+        best, best_type = "", ""
+        with open("/proc/mounts", encoding="utf-8") as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) < 3:
+                    continue
+                mnt, typ = parts[1], parts[2]
+                if real.startswith(mnt.rstrip("/") + "/") or real == mnt \
+                        or mnt == "/":
+                    if len(mnt) > len(best):
+                        best, best_type = mnt, typ
+        return best_type
+    except OSError:
+        return ""
 
 # shared-file-pointer serialization for in-process ranks (threads share the
 # process, so fcntl locks alone can't order them); keyed by realpath
@@ -199,6 +233,8 @@ class File:
         # ERRORS_RETURN (unlike comms) — here they agree
         self.info = Info()
         self._io_lock = threading.Lock()
+        # fs framework: the filesystem kind steers collective-IO defaults
+        self.fs_type = _fs_type(os.path.dirname(self.path) or ".")
         flags = os.O_RDWR if amode & (MODE_RDWR | MODE_WRONLY) else os.O_RDONLY
         # MPI_MODE_WRONLY still needs reads for read-modify on views; POSIX
         # O_WRONLY would break pread — open RDWR and gate in software
@@ -562,7 +598,21 @@ class File:
         stats = np.asarray(self.comm.allgather(np.array(
             [my_nbytes, contig], np.int64))).reshape(-1, 2)
         total = int(stats[:, 0].sum())
-        if total < int(var_registry.get("io_twophase_min_bytes")):
+        # fs adaptation (≈ the fs framework's per-filesystem tuning,
+        # fs_lustre.c): same answer on every rank — fs_type comes from
+        # the shared path, and a split mount view would already break
+        # shared-file IO in deeper ways
+        adaptive = bool(var_registry.get("io_fs_adaptive"))
+        if adaptive and self.fs_type in _FS_MEMORY:
+            # memory-backed: every write is a memcpy — there is no seek
+            # cost for aggregation to amortize, and the alltoallv
+            # exchange costs more than the extra pwrite syscalls it
+            # saves; individual IO wins for strided patterns too
+            return "individual"
+        min_bytes = int(var_registry.get("io_twophase_min_bytes"))
+        if adaptive and self.fs_type in _FS_NETWORK:
+            min_bytes = 1    # network fs: aggregate even small strided IO
+        if total < min_bytes:
             return "individual"
         if int(stats[:, 1].min()) == 1:
             return "individual"   # everyone contiguous: direct IO wins

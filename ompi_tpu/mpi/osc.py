@@ -86,8 +86,15 @@ class Window:
     def __init__(self, comm, size: Optional[int] = None,
                  buffer: Optional[np.ndarray] = None,
                  dtype=np.uint8, name: str = "win",
-                 _dynamic: bool = False) -> None:
+                 info=None, _dynamic: bool = False) -> None:
         self._dynamic = _dynamic
+        # consulted info hints (≈ osc_rdma/osc_pt2pt reading win info):
+        # no_locks=true promises the app never uses passive-target sync —
+        # lock/unlock/lock_all then fail fast instead of running a
+        # pointless lock service protocol
+        self.info = info
+        self._no_locks = bool(info) and str(
+            info.get("no_locks") or "").lower() in ("true", "1")
         self._regions: dict[int, np.ndarray] = {}   # base offset → flat view
         self._next_base = 0
         if _dynamic:
@@ -515,6 +522,11 @@ class Window:
     def lock(self, target: int, exclusive: bool = True) -> None:
         """≈ MPI_Win_lock (passive target). A local target still goes
         through the service, keeping lock fairness uniform."""
+        if self._no_locks:
+            raise MPIException(
+                "MPI_Win_lock on a window created with the no_locks=true "
+                "info hint (the app promised no passive-target sync)",
+                error_class=51)
         with self._origin_lock:
             _ctrl_send(self.comm, target,
                        ("lock", self.comm.rank, bool(exclusive)),

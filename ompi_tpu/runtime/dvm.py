@@ -62,6 +62,10 @@ class DvmHnp(MultiHostLauncher):
         self._stopped = threading.Event()
         self._ctrl: Optional[socket.socket] = None
         self._client_sink = None              # active job's IOF stream
+        # serializes writes to the client connection: IOF callbacks run
+        # on per-daemon RML reader threads and would otherwise interleave
+        # partial lines with each other and with the final exit reply
+        self._sink_lock = threading.Lock()
         self.vm_job: Optional[Job] = None
         self._history: list[dict] = []        # completed-job records
 
@@ -143,10 +147,10 @@ class DvmHnp(MultiHostLauncher):
             except OSError:
                 pass
 
-    @staticmethod
-    def _reply(wfile, obj: dict) -> None:
-        wfile.write(json.dumps(obj) + "\n")
-        wfile.flush()
+    def _reply(self, wfile, obj: dict) -> None:
+        with self._sink_lock:
+            wfile.write(json.dumps(obj) + "\n")
+            wfile.flush()
 
     # -- job execution on the warm VM --------------------------------------
 

@@ -1,0 +1,99 @@
+"""Minor API parity: MPI_Sendrecv_replace, MPI_Comm_idup, window info
+hints (≈ ompi/mpi/c/sendrecv_replace.c, comm_idup.c; osc info reading).
+"""
+
+import numpy as np
+import pytest
+
+from ompi_tpu.mpi.constants import MPIException
+from ompi_tpu.mpi.info import Info
+from tests.mpi.harness import run_ranks
+
+
+def test_sendrecv_replace_ring():
+    """Classic ring rotation: every rank's buffer is replaced in place
+    by its left neighbor's."""
+
+    def body(comm):
+        n = comm.size
+        nxt, prv = (comm.rank + 1) % n, (comm.rank - 1) % n
+        buf = np.full(4, comm.rank, np.int32)
+        out = comm.sendrecv_replace(buf, dest=nxt, source=prv,
+                                    sendtag=9, recvtag=9)
+        assert out is buf                    # replaced IN PLACE
+        np.testing.assert_array_equal(buf, np.full(4, prv, np.int32))
+        return None
+
+    run_ranks(4, body)
+
+
+def test_sendrecv_replace_status():
+    def body(comm):
+        from ompi_tpu.mpi.request import Status
+
+        peer = 1 - comm.rank
+        buf = np.array([10.0 * (comm.rank + 1)], np.float64)
+        st = Status()
+        comm.sendrecv_replace(buf, dest=peer, source=peer, status=st)
+        assert st.source == peer
+        assert float(buf[0]) == 10.0 * (peer + 1)
+        return None
+
+    run_ranks(2, body)
+
+
+def test_sendrecv_replace_proc_null_edge():
+    """Non-periodic cart-shift boundary: source=PROC_NULL leaves the
+    buffer untouched (the recv is a no-op), send still goes out."""
+    from ompi_tpu.mpi.constants import PROC_NULL
+
+    def body(comm):
+        buf = np.full(3, comm.rank + 5, np.int32)
+        if comm.rank == 0:
+            # sends to 1, receives from nobody
+            out = comm.sendrecv_replace(buf, dest=1, source=PROC_NULL,
+                                        sendtag=2)
+            np.testing.assert_array_equal(out, np.full(3, 5, np.int32))
+        else:
+            # receives 0's data, sends to nobody
+            out = comm.sendrecv_replace(buf, dest=PROC_NULL, source=0,
+                                        recvtag=2)
+            np.testing.assert_array_equal(out, np.full(3, 5, np.int32))
+        return None
+
+    run_ranks(2, body)
+
+
+def test_comm_idup():
+    def body(comm):
+        req, new = comm.idup()
+        got = req.wait(timeout=30)
+        assert got is new
+        assert new.cid != comm.cid
+        assert new.size == comm.size
+        # the dup'd comm is a working communicator
+        vals = new.allgather(np.array([new.rank], np.int64))
+        assert [int(v) for v in np.asarray(vals).ravel()] == [0, 1]
+        return None
+
+    run_ranks(2, body)
+
+
+def test_window_no_locks_hint():
+    from ompi_tpu.mpi.osc import Window
+
+    def body(comm):
+        win = Window(comm, size=8, info=Info({"no_locks": "true"}))
+        comm.barrier()
+        with pytest.raises(MPIException, match="no_locks"):
+            win.lock(0)
+        comm.barrier()
+        # active-target sync still works fine
+        win.fence()
+        win.put(1 - comm.rank, np.array([7], np.uint8), offset=0)
+        win.fence()
+        assert int(win.buf[0]) == 7
+        win.free()
+        return None
+
+    run_ranks(2, body)

@@ -1,0 +1,99 @@
+"""O(shard)-memory large-payload forms of the generic device collectives
+(round-3 verdict weak #4: the allgather+fold forms allocate n×shard on
+every device).  The Hillis-Steele ppermute prefix must agree exactly
+with the small-payload forms — including for non-commutative ops, whose
+rank-order contract the segment-joining proof relies on.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from ompi_tpu.core import config  # noqa: E402
+from ompi_tpu.mpi.device_comm import device_world  # noqa: E402
+from ompi_tpu.mpi.op import create_op  # noqa: E402
+from ompi_tpu.parallel.mesh import make_mesh  # noqa: E402
+
+N = 8
+
+# associative but NON-commutative: 2x2 matrix product over the last dims
+MATMUL = create_op(lambda a, b: a @ b, commutative=False,
+                   device_fn=lambda a, b: a @ b, name="matmul")
+
+
+@pytest.fixture(scope="module")
+def dc():
+    return device_world(make_mesh(devices=jax.devices()))
+
+
+@pytest.fixture
+def force_large():
+    old = config.var_registry.get("coll_device_generic_large_bytes")
+    config.var_registry.set("coll_device_generic_large_bytes", 1)
+    yield
+    config.var_registry.set("coll_device_generic_large_bytes", old)
+
+
+def _run(dc, fn, x):
+    mesh = dc.mesh
+    g = jax.device_put(x, NamedSharding(mesh, P("world")))
+    out = jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=P("world"),
+                                out_specs=P("world"), check_vma=False))(g)
+    return np.asarray(out)
+
+
+def _rank_mats(seed=0):
+    rng = np.random.default_rng(seed)
+    # well-conditioned near-identity factors keep the product stable
+    return (np.eye(2)[None] + 0.1 * rng.normal(
+        size=(N, 2, 2))).astype(np.float32)
+
+
+def test_large_scan_matches_small_noncommutative(dc, force_large):
+    mats = _rank_mats()
+    large = _run(dc, lambda s: dc.scan(s[0], MATMUL)[None], mats)
+    config.var_registry.set("coll_device_generic_large_bytes", 1 << 30)
+    small = _run(dc, lambda s: dc.scan(s[0], MATMUL)[None], mats)
+    np.testing.assert_allclose(large, small, rtol=2e-5, atol=2e-5)
+    # cross-check rank N-1 against the plain ordered product
+    expect = np.eye(2, dtype=np.float32)
+    for r in range(N):
+        expect = expect @ mats[r]
+    np.testing.assert_allclose(large[N - 1], expect, rtol=2e-5, atol=2e-5)
+
+
+def test_large_exscan_matches_small(dc, force_large):
+    mats = _rank_mats(1)
+    large = _run(dc, lambda s: dc.exscan(s[0], MATMUL)[None], mats)
+    config.var_registry.set("coll_device_generic_large_bytes", 1 << 30)
+    small = _run(dc, lambda s: dc.exscan(s[0], MATMUL)[None], mats)
+    np.testing.assert_allclose(large, small, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(large[0], np.zeros((2, 2)), atol=0)
+
+
+def test_large_allreduce_generic_matches_small(dc, force_large):
+    mats = _rank_mats(2)
+    large = _run(dc, lambda s: dc.allreduce(s[0], MATMUL)[None], mats)
+    config.var_registry.set("coll_device_generic_large_bytes", 1 << 30)
+    small = _run(dc, lambda s: dc.allreduce(s[0], MATMUL)[None], mats)
+    np.testing.assert_allclose(large, small, rtol=2e-5, atol=2e-5)
+    # every rank holds the same full ordered product
+    for r in range(1, N):
+        np.testing.assert_allclose(large[r], large[0], rtol=1e-6)
+
+
+def test_large_scan_sum_path(dc, force_large):
+    x = np.arange(N * 4, dtype=np.float32).reshape(N, 4)
+    large = _run(dc, lambda s: dc.scan(s[0])[None], x)
+    np.testing.assert_allclose(large, np.cumsum(x, axis=0), rtol=1e-6)
+
+
+def test_large_exscan_sum_path(dc, force_large):
+    x = np.ones((N, 4), np.float32)
+    large = _run(dc, lambda s: dc.exscan(s[0])[None], x)
+    expect = np.concatenate([np.zeros((1, 4)),
+                             np.cumsum(x, axis=0)[:-1]]).astype(np.float32)
+    np.testing.assert_allclose(large, expect, rtol=1e-6)

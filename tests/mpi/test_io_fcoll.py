@@ -166,6 +166,48 @@ def test_dynamic_domain_bounds_balance(tmp_path):
     assert b[1] <= 2048
 
 
+def test_fs_type_detection(tmp_path):
+    """/proc/mounts longest-prefix detection (≈ the statfs-magic checks
+    of ompi/mca/fs components)."""
+    t = mio._fs_type("/dev/shm") if os.path.isdir("/dev/shm") else None
+    if t is not None:
+        assert t in ("tmpfs", "ramfs"), t
+    # any resolvable path yields a string, never raises
+    assert isinstance(mio._fs_type(str(tmp_path)), str)
+
+
+def test_fs_adaptive_memory_backed_prefers_individual():
+    """On tmpfs even a STRIDED pattern (which would normally pick
+    two_phase) goes individual: memory-backed writes have no seek cost
+    for aggregation to amortize."""
+    if not os.path.isdir("/dev/shm"):
+        pytest.skip("no /dev/shm")
+
+    import tempfile
+
+    d = tempfile.mkdtemp(dir="/dev/shm")
+    path = os.path.join(d, "m.bin")
+
+    def body(comm):
+        f = mio.File.open(comm, path, mio.MODE_RDWR | mio.MODE_CREATE)
+        assert f.fs_type in ("tmpfs", "ramfs")
+        strided = [(comm.rank * 64 + i * 256, 64) for i in range(16)]
+        comp = f._fcoll_component(1024, strided)
+        # the identical strided pattern on a non-memory fs picks a
+        # collective component — the adaptation is doing the deciding
+        f.fs_type = "ext4"
+        comp_disk = f._fcoll_component(1024, strided)
+        f.close()
+        return comp, comp_disk
+
+    out = run_ranks(2, body)
+    assert all(c == "individual" for c, _ in out)
+    assert all(cd == "two_phase" for _, cd in out)
+    import shutil
+
+    shutil.rmtree(d, ignore_errors=True)
+
+
 def test_large_strided_roundtrip_all_components(tmp_path, fcoll_var):
     """Write with one component, read back with another — the file is
     component-independent."""
