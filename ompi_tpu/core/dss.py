@@ -166,16 +166,150 @@ class Buffer:
         return self._w.getvalue()
 
 
+# -- fast module-level codecs -------------------------------------------
+#
+# Every shm/tcp frame and RML message pays one pack + one unpack of a
+# small header dict; the Buffer class's per-record BytesIO getbuffer()
+# export made that ~9µs/33µs per header.  These standalone codecs emit
+# the identical wire format with prebound structs and a single cursor
+# (measured ~8× faster on a 7-key header); Buffer remains for
+# incremental append/consume use.
+
+_Sq = struct.Struct("<q")
+_Sd = struct.Struct("<d")
+_SI = struct.Struct("<I")
+_SQ8 = struct.Struct("<Q")
+_B_NONE = bytes([_T_NONE])
+_B_TRUE = bytes([_T_BOOL, 1])
+_B_FALSE = bytes([_T_BOOL, 0])
+_B_INT = bytes([_T_INT64])
+_B_FLOAT = bytes([_T_FLOAT64])
+_B_STR = bytes([_T_STRING])
+_B_BYTES = bytes([_T_BYTES])
+_B_LIST = bytes([_T_LIST])
+_B_TUPLE = bytes([_T_TUPLE])
+_B_DICT = bytes([_T_DICT])
+
+
+def _pack_into(parts: list, value: Any) -> None:
+    t = type(value)
+    if t is int:
+        parts.append(_B_INT)
+        parts.append(_Sq.pack(value))
+    elif t is str:
+        raw = value.encode()
+        parts.append(_B_STR)
+        parts.append(_SI.pack(len(raw)))
+        parts.append(raw)
+    elif value is None:
+        parts.append(_B_NONE)
+    elif t is bool:
+        parts.append(_B_TRUE if value else _B_FALSE)
+    elif t is float:
+        parts.append(_B_FLOAT)
+        parts.append(_Sd.pack(value))
+    elif t is bytes or t is bytearray or t is memoryview:
+        raw = bytes(value)
+        parts.append(_B_BYTES)
+        parts.append(_SI.pack(len(raw)))
+        parts.append(raw)
+    elif t is list or t is tuple:
+        parts.append(_B_LIST if t is list else _B_TUPLE)
+        parts.append(_SI.pack(len(value)))
+        for item in value:
+            _pack_into(parts, item)
+    elif t is dict:
+        parts.append(_B_DICT)
+        parts.append(_SI.pack(len(value)))
+        for k, v in value.items():
+            _pack_into(parts, k)
+            _pack_into(parts, v)
+    else:
+        # subclasses and ndarrays take the general Buffer path (identical
+        # wire format; just not the single-isinstance fast lane)
+        b = Buffer()
+        b.pack(value)
+        parts.append(b.bytes())
+
+
 def pack(*values: Any) -> bytes:
-    buf = Buffer()
+    parts: list = []
     for v in values:
-        buf.pack(v)
-    return buf.bytes()
+        _pack_into(parts, v)
+    return b"".join(parts)
+
+
+def _unpack_one(data: bytes, pos: int) -> tuple[Any, int]:
+    tag = data[pos]
+    pos += 1
+    if tag == _T_INT64:
+        return _Sq.unpack_from(data, pos)[0], pos + 8
+    if tag == _T_STRING:
+        n = _SI.unpack_from(data, pos)[0]
+        pos += 4
+        if pos + n > len(data):   # slicing would silently truncate
+            raise DSSError("buffer underrun in string")
+        return data[pos:pos + n].decode(), pos + n
+    if tag == _T_NONE:
+        return None, pos
+    if tag == _T_BOOL:
+        return bool(data[pos]), pos + 1
+    if tag == _T_FLOAT64:
+        return _Sd.unpack_from(data, pos)[0], pos + 8
+    if tag == _T_BYTES:
+        n = _SI.unpack_from(data, pos)[0]
+        pos += 4
+        if pos + n > len(data):
+            raise DSSError("buffer underrun in bytes")
+        return data[pos:pos + n], pos + n
+    if tag == _T_LIST or tag == _T_TUPLE:
+        n = _SI.unpack_from(data, pos)[0]
+        pos += 4
+        items = []
+        for _ in range(n):
+            v, pos = _unpack_one(data, pos)
+            items.append(v)
+        return (items if tag == _T_LIST else tuple(items)), pos
+    if tag == _T_DICT:
+        n = _SI.unpack_from(data, pos)[0]
+        pos += 4
+        out = {}
+        for _ in range(n):
+            k, pos = _unpack_one(data, pos)
+            out[k], pos = _unpack_one(data, pos)
+        return out, pos
+    if tag == _T_NDARRAY:
+        dn = data[pos]
+        pos += 1
+        dt = np.dtype(data[pos:pos + dn].decode())
+        pos += dn
+        ndim = data[pos]
+        pos += 1
+        shape = struct.unpack_from(f"<{ndim}q", data, pos) if ndim else ()
+        pos += 8 * ndim
+        nb = _SQ8.unpack_from(data, pos)[0]
+        pos += 8
+        if pos + nb > len(data):
+            raise DSSError("buffer underrun in ndarray")
+        value = np.frombuffer(data[pos:pos + nb],
+                              dtype=dt).reshape(shape).copy()
+        return value, pos + nb
+    raise DSSError(f"unknown type tag {tag}")
 
 
 def unpack(data: bytes, n: Optional[int] = None) -> list[Any]:
-    buf = Buffer(data)
-    out = []
-    while buf.remaining() and (n is None or len(out) < n):
-        out.append(buf.unpack())
+    if not isinstance(data, bytes):
+        data = bytes(data)
+    out: list[Any] = []
+    pos = 0
+    end = len(data)
+    try:
+        while pos < end and (n is None or len(out) < n):
+            v, pos = _unpack_one(data, pos)
+            out.append(v)
+    except (IndexError, struct.error, ValueError, TypeError) as e:
+        # TypeError: np.dtype on a truncated descriptor string
+        if isinstance(e, DSSError):
+            raise
+        raise DSSError(f"buffer underrun: {e}") from None
     return out

@@ -64,6 +64,54 @@ register_var("btl", "shm_send_timeout", VarType.SIZE, 60,
              "seconds a full ring blocks a send before the peer is declared "
              "dead (0 = wait forever); a crashed receiver leaves its rings "
              "full, and unlike tcp there is no RST to surface it")
+register_var("btl", "shm_native", VarType.BOOL, False,
+             "use the native (C++) ring framing (ompi_tpu_ring_write/read "
+             "in _native/convertor.cpp). MEASURED SLOWER than the python "
+             "ring ops through ctypes (pointer marshalling + a scratch "
+             "copy cost more than the slice work saved: 27 vs 16µs per "
+             "small frame, 2.2 vs 4.5 GiB/s large, after the dss codec "
+             "rewrite removed the real hot spot) — default off; the C "
+             "functions stand as the layout-contract reference for a "
+             "CPython-C-API extension where call overhead is ~10× lower")
+
+
+def _native_ring():
+    """The native helper lib, or None (gated by var + build success)."""
+    if not var_registry.get("btl_shm_native"):
+        return None
+    from ompi_tpu import _native
+
+    return _native.lib()
+
+
+def _mm_ptr(mm) -> "ctypes.POINTER":
+    import ctypes
+
+    return ctypes.cast(
+        ctypes.addressof(ctypes.c_char.from_buffer(mm)),
+        ctypes.POINTER(ctypes.c_uint8))
+
+
+def _bytes_ptr(b: bytes):
+    import ctypes
+
+    return ctypes.cast(b, ctypes.POINTER(ctypes.c_uint8))
+
+
+def _buf_ptr(data):
+    """(pointer, keepalive) for bytes OR a (possibly read-only)
+    memoryview — the zero-copy eager path sends a view of the user's
+    array, and ctypes.from_buffer rejects read-only buffers; a zero-copy
+    numpy frombuffer supplies the address instead."""
+    import ctypes
+
+    if isinstance(data, bytes):
+        return ctypes.cast(data, ctypes.POINTER(ctypes.c_uint8)), data
+    import numpy as _np
+
+    a = _np.frombuffer(data, _np.uint8)
+    return ctypes.cast(a.ctypes.data,
+                       ctypes.POINTER(ctypes.c_uint8)), a
 
 _HDR = 64                 # ring header bytes
 _OFF_HEAD, _OFF_TAIL, _OFF_CAP, _OFF_MAGIC = 0, 8, 16, 24
@@ -112,6 +160,8 @@ class ShmRingWriter:
         self._lock = threading.Lock()
         self._db_fd: Optional[int] = None   # receiver's doorbell FIFO
         self._first = True
+        self._native = _native_ring()
+        self._mm_p = _mm_ptr(self._mm) if self._native is not None else None
         try:
             self._db_fd = os.open(os.path.join(inbox, "doorbell"),
                                   os.O_WRONLY | os.O_NONBLOCK)
@@ -129,12 +179,24 @@ class ShmRingWriter:
     def _publish(self, body, hdr, payload) -> None:
         """Write one frame and publish it (call with self._lock held and
         space verified)."""
-        self._write(body)
-        self._write(hdr)
-        if payload:
-            self._write(payload)
-        # publish AFTER the data is in place (x86 TSO store order)
-        self._ctr[_OFF_HEAD // 8] = self._head
+        if self._native is not None:
+            # one C call: frame + wraparound copies + release-store of
+            # the head counter (≈ vader's fifo write hot loop); the
+            # payload pointer is zero-copy even for the eager path's
+            # read-only memoryview of the user buffer
+            plen = len(payload) if payload else 0
+            pptr, keep = _buf_ptr(payload) if plen else (None, None)
+            self._head = self._native.ompi_tpu_ring_write(
+                self._mm_p, self.capacity, self._head,
+                _bytes_ptr(hdr), len(hdr), pptr, plen)
+            del keep
+        else:
+            self._write(body)
+            self._write(hdr)
+            if payload:
+                self._write(payload)
+            # publish AFTER the data is in place (x86 TSO store order)
+            self._ctr[_OFF_HEAD // 8] = self._head
         # doorbell: only when the receiver armed its sleep flag (or on
         # our very first frame — a sleeping receiver must discover a
         # brand-new ring)
@@ -219,9 +281,21 @@ class ShmRingReader:
         self.capacity = self._ctr[_OFF_CAP // 8]
         self._tail = self._ctr[_OFF_TAIL // 8]
         self._seg.unlink()  # mapping survives; crash cleanup is automatic
+        self._native = _native_ring()
+        self._mm_p = _mm_ptr(self._mm) if self._native is not None else None
+        self._scratch = None
+        self._scratch_p = None
+        if self._native is not None:
+            self._grow_scratch(64 << 10)
+
+    def _grow_scratch(self, size: int) -> None:
+        self._scratch = bytearray(size)
+        self._scratch_p = _mm_ptr(self._scratch)
 
     def poll(self, on_frame: OnFrame, limit: int = 64) -> int:
         """Drain up to ``limit`` frames; returns how many were delivered."""
+        if self._native is not None:
+            return self._poll_native(on_frame, limit)
         n = 0
         while n < limit:
             head = self._ctr[_OFF_HEAD // 8]
@@ -235,6 +309,31 @@ class ShmRingReader:
             header = dss.unpack(blob[:hdr_len], n=1)[0]
             on_frame(self.peer, header, blob[hdr_len:])
             self._ctr[_OFF_TAIL // 8] = self._tail
+            n += 1
+        return n
+
+    def _poll_native(self, on_frame: OnFrame, limit: int) -> int:
+        """One C call drains each frame into a reusable scratch buffer
+        (wraparound copies + acquire/release counter handling in C)."""
+        n = 0
+        while n < limit:
+            r = self._native.ompi_tpu_ring_read(
+                self._mm_p, self.capacity, self._tail, self._scratch_p,
+                len(self._scratch))
+            if r == 0:
+                break
+            if r < -1:
+                self._grow_scratch(-r + 1024)   # too small: grow, retry
+                continue
+            if r == -1:
+                raise OSError(
+                    f"btl/shm: corrupt ring from peer {self.peer}")
+            self._tail += r
+            total, hdr_len = struct.unpack_from("<II", self._scratch, 0)
+            header = dss.unpack(
+                bytes(self._scratch[8:8 + hdr_len]), n=1)[0]
+            on_frame(self.peer, header,
+                     bytes(self._scratch[8 + hdr_len:8 + total]))
             n += 1
         return n
 

@@ -256,14 +256,8 @@ class Communicator:
         is a writable ndarray — the in-place contract the name promises."""
         arr = np.asarray(buf)
         staged = arr.copy()                  # sender-side staging copy
-        rreq = self.irecv(None, source, recvtag)
-        sreq = self.isend(staged, dest, sendtag)
-        out = rreq.wait()
-        sreq.wait()
-        if status is not None:
-            status.__dict__.update(rreq.status.__dict__)
-            if status.source >= 0:
-                status.source = self.group.rank_of(status.source)
+        out = self.sendrecv(staged, dest, None, source, sendtag, recvtag,
+                            status)
         got = np.asarray(out)
         if got.size == 0 and arr.size != 0:
             # PROC_NULL source (the edge rank of a non-periodic cart

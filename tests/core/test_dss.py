@@ -66,3 +66,36 @@ def test_unpackable_type_rejected():
 def test_streaming_partial_unpack():
     data = pack(1, "two", 3.0)
     assert unpack(data, n=2) == [1, "two"]
+
+
+def test_fast_unpack_truncation_raises():
+    """The fast codecs must fail as loudly as the Buffer path on torn
+    frames (a short tcp read / truncated shm frame must never yield a
+    silently-truncated value)."""
+    import numpy as np
+    import pytest
+
+    from ompi_tpu.core import dss
+
+    for v in ("hello world, a long string", b"\x01" * 64,
+              {"k": "a long enough value"}, [1, 2, "tail string"],
+              np.arange(32)):
+        blob = dss.pack(v)
+        for cut in (len(blob) // 2, len(blob) - 1, 3):
+            with pytest.raises(dss.DSSError):
+                dss.unpack(blob[:cut])
+
+
+def test_fast_codec_wire_identical_to_buffer():
+    import numpy as np
+
+    from ompi_tpu.core import dss
+
+    vals = [None, True, 7, -1, 2.5, "s", b"b", [1, [2]], (3,),
+            {"a": 1, "b": [None, "x"]}]
+    fast = dss.pack(*vals)
+    buf = dss.Buffer()
+    for v in vals:
+        buf.pack(v)
+    assert fast == buf.bytes()
+    assert dss.unpack(fast) == vals

@@ -121,3 +121,43 @@ def test_native_beats_numpy_on_large_strided():
         _numpy_pack(v, buf, count)
     numpy_t = time.perf_counter() - t0
     assert native_t < numpy_t * 1.5, (native_t, numpy_t)
+
+
+def test_native_ring_parity():
+    """The native ring framing (C) and the python ring ops produce and
+    consume the identical wire layout — frames written by one side are
+    readable by the other in both directions."""
+    import os
+    import tempfile
+
+    import pytest
+
+    from ompi_tpu import _native
+    from ompi_tpu.core.config import var_registry
+    from ompi_tpu.mpi.btl_shm import ShmRingReader, ShmRingWriter
+
+    if not _native.available():
+        pytest.skip("native helper did not build")
+    old = var_registry.get("btl_shm_native")
+    hdr = {"t": "eager", "tag": 3, "cid": 1, "seq": 7, "dt": "<f4",
+           "elems": 2, "shp": [2]}
+    payloads = [b"", b"xy" * 40, os.urandom(5000)]
+    try:
+        for wn, rn in ((1, 0), (0, 1), (1, 1)):
+            got = []
+            var_registry.set("btl_shm_native", wn)
+            inbox = tempfile.mkdtemp(dir="/dev/shm")
+            w = ShmRingWriter(inbox, 2, 1 << 16)
+            var_registry.set("btl_shm_native", rn)
+            r = ShmRingReader(os.path.join(inbox, "ring_2"), 2)
+            for p in payloads * 20:   # force wraparound of the 64KB ring
+                w.send(hdr, p)
+                r.poll(lambda pr, h, b: got.append((h, b)))
+            assert len(got) == len(payloads) * 20
+            for i, (h, b) in enumerate(got):
+                assert h == hdr
+                assert b == payloads[i % len(payloads)]
+            w.close()
+            r.close()
+    finally:
+        var_registry.set("btl_shm_native", old)
