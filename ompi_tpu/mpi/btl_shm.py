@@ -84,18 +84,19 @@ def _native_ring():
     return _native.lib()
 
 
-def _mm_ptr(mm) -> "ctypes.POINTER":
-    import ctypes
+import ctypes as _ct  # noqa: E402 — hot-path helpers below
 
-    return ctypes.cast(
-        ctypes.addressof(ctypes.c_char.from_buffer(mm)),
-        ctypes.POINTER(ctypes.c_uint8))
+import numpy as _np  # noqa: E402
+
+_U8P = _ct.POINTER(_ct.c_uint8)
+
+
+def _mm_ptr(mm):
+    return _ct.cast(_ct.addressof(_ct.c_char.from_buffer(mm)), _U8P)
 
 
 def _bytes_ptr(b: bytes):
-    import ctypes
-
-    return ctypes.cast(b, ctypes.POINTER(ctypes.c_uint8))
+    return _ct.cast(b, _U8P)
 
 
 def _buf_ptr(data):
@@ -103,15 +104,10 @@ def _buf_ptr(data):
     memoryview — the zero-copy eager path sends a view of the user's
     array, and ctypes.from_buffer rejects read-only buffers; a zero-copy
     numpy frombuffer supplies the address instead."""
-    import ctypes
-
     if isinstance(data, bytes):
-        return ctypes.cast(data, ctypes.POINTER(ctypes.c_uint8)), data
-    import numpy as _np
-
+        return _ct.cast(data, _U8P), data
     a = _np.frombuffer(data, _np.uint8)
-    return ctypes.cast(a.ctypes.data,
-                       ctypes.POINTER(ctypes.c_uint8)), a
+    return _ct.cast(a.ctypes.data, _U8P), a
 
 _HDR = 64                 # ring header bytes
 _OFF_HEAD, _OFF_TAIL, _OFF_CAP, _OFF_MAGIC = 0, 8, 16, 24
@@ -330,10 +326,11 @@ class ShmRingReader:
                     f"btl/shm: corrupt ring from peer {self.peer}")
             self._tail += r
             total, hdr_len = struct.unpack_from("<II", self._scratch, 0)
-            header = dss.unpack(
-                bytes(self._scratch[8:8 + hdr_len]), n=1)[0]
+            view = memoryview(self._scratch)   # single-copy slices
+            header = dss.unpack(view[8:8 + hdr_len], n=1)[0]
             on_frame(self.peer, header,
-                     bytes(self._scratch[8 + hdr_len:8 + total]))
+                     bytes(view[8 + hdr_len:8 + total]))
+            view.release()
             n += 1
         return n
 

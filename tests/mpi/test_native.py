@@ -142,11 +142,13 @@ def test_native_ring_parity():
     hdr = {"t": "eager", "tag": 3, "cid": 1, "seq": 7, "dt": "<f4",
            "elems": 2, "shp": [2]}
     payloads = [b"", b"xy" * 40, os.urandom(5000)]
+    inboxes = []
     try:
         for wn, rn in ((1, 0), (0, 1), (1, 1)):
             got = []
             var_registry.set("btl_shm_native", wn)
             inbox = tempfile.mkdtemp(dir="/dev/shm")
+            inboxes.append(inbox)
             w = ShmRingWriter(inbox, 2, 1 << 16)
             var_registry.set("btl_shm_native", rn)
             r = ShmRingReader(os.path.join(inbox, "ring_2"), 2)
@@ -160,4 +162,8 @@ def test_native_ring_parity():
             w.close()
             r.close()
     finally:
+        import shutil
+
         var_registry.set("btl_shm_native", old)
+        for d in inboxes:
+            shutil.rmtree(d, ignore_errors=True)
