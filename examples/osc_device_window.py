@@ -31,6 +31,9 @@ def main() -> None:
     mesh = make_mesh(devices=jax.devices())
     comm = device_world(mesh)
     n = comm.size
+    if n < 2:
+        raise SystemExit("need >= 2 devices (origin and target differ); "
+                         "unset OMPI_TPU_EXAMPLE_TPU for the CPU mesh")
     print(f"{n}-device window over {jax.default_backend()}")
 
     win = DeviceWindow(comm, local_shape=(4, 128), dtype=np.float32)

@@ -1,0 +1,99 @@
+"""Park-and-heal retransmit: single healer chain, exactly-once delivery.
+
+Regression for the round-3 ADVICE high finding: the healer's
+ConnectionError retry path used to self-schedule a continuation WHILE
+_run_heal's cleanup also rescheduled — two concurrent heal loops for one
+peer could send parked[0] twice and pop two entries (one frame
+duplicated on the wire, another silently dropped).  This test drives a
+flapping route through several failed heal ticks and asserts (a) at most
+ONE healer chain is ever alive for the peer and (b) every message is
+delivered exactly once, in order.
+"""
+
+import threading
+import time
+
+import numpy as np
+
+from ompi_tpu.core.config import var_registry
+from ompi_tpu.mpi.comm import Communicator
+from ompi_tpu.mpi.group import Group
+from ompi_tpu.mpi.pml import PmlOb1
+
+
+def test_heal_chain_exactly_once_in_order():
+    old_window = var_registry.get("pml_retry_window")
+    var_registry.set("pml_retry_window", 20)
+    pmls = [PmlOb1(r) for r in range(2)]
+    try:
+        addrs = {r: p.address for r, p in enumerate(pmls)}
+        for p in pmls:
+            p.set_peers(addrs)
+        comms = [Communicator(Group(range(2)), cid=0, pml=pmls[r],
+                              my_world_rank=r) for r in range(2)]
+        sender = pmls[0]
+
+        # force every frame through the send worker + heal machinery
+        sender.endpoint.try_send_inline = lambda *a, **k: False
+        orig_send = sender.endpoint.send
+        flaky = {"fails": 0}
+        lock = threading.Lock()
+
+        def send(peer, hdr, payload=b""):
+            with lock:
+                if flaky["fails"] > 0:
+                    flaky["fails"] -= 1
+                    raise ConnectionError("synthetic route outage")
+            return orig_send(peer, hdr, payload)
+
+        sender.endpoint.send = send
+
+        # instrument the healer: count concurrently-alive chains
+        orig_run = sender._run_heal
+        alive = []
+        peak = [0]
+
+        def run_heal(peer, deadline):
+            with lock:
+                alive.append(peer)
+                peak[0] = max(peak[0], alive.count(peer))
+            try:
+                orig_run(peer, deadline)
+            finally:
+                with lock:
+                    alive.remove(peer)
+
+        sender._run_heal = run_heal
+
+        # outage spans the initial delivery AND several heal ticks — the
+        # chained-retry path (where the double-schedule lived) must run
+        with lock:
+            flaky["fails"] = 6
+        n_msgs = 8
+        reqs = [comms[0].isend(np.array([i], np.int64), dest=1, tag=4)
+                for i in range(n_msgs)]
+
+        got = [comms[1].recv(source=0, tag=4)
+               for _ in range(n_msgs)]
+        values = [int(np.asarray(g)[0]) for g in got]
+        assert values == list(range(n_msgs)), values   # in order, no dup/loss
+        for r in reqs:
+            r.wait(timeout=30)
+
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            with sender._lock:
+                parked = dict(sender._parked)
+            with sender._qlock:
+                healing = set(sender._healing)
+            if not parked and not healing:
+                break
+            time.sleep(0.05)
+        assert not parked and not healing, (parked, healing)
+        assert peak[0] <= 1, f"{peak[0]} concurrent healer chains for one peer"
+        # sanity: the outage actually exercised the heal path
+        assert sender.pvar_healed._value > 0
+    finally:
+        var_registry.set("pml_retry_window", old_window)
+        for p in pmls:
+            p.close()
