@@ -21,15 +21,22 @@ from typing import Optional
 _ABI = 2
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "convertor.cpp")
+_FASTDSS_SRC = os.path.join(_DIR, "fastdss.c")
 
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
+_fastdss = None
+_fastdss_tried = False
+
+
+def _hash_name(src: str, stem: str) -> str:
+    with open(src, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    return os.path.join(_DIR, f"{stem}-{digest}.so")
 
 
 def _so_path() -> str:
-    with open(_SRC, "rb") as f:
-        digest = hashlib.sha256(f.read()).hexdigest()[:16]
-    return os.path.join(_DIR, f"_convertor-{digest}.so")
+    return _hash_name(_SRC, "_convertor")
 
 
 _LOCK_STALE_S = 150.0   # > the 120 s compile timeout: a lock this old
@@ -43,7 +50,8 @@ def _lock_age(lock: str) -> float:
         return 0.0
 
 
-def _build(so: str) -> bool:
+def _build(so: str, src: str = _SRC,
+           extra_flags: tuple = ()) -> bool:
     """Compile once across concurrent ranks (O_EXCL lock + wait).  A lock
     older than the compile timeout is debris from a killed builder — it is
     removed and the build retried, instead of every later process stalling
@@ -63,7 +71,7 @@ def _build(so: str) -> bool:
                     os.unlink(lock)           # stale: take over
                 except OSError:
                     pass
-                return _build(so)
+                return _build(so, src, extra_flags)
             time.sleep(0.1)
         return os.path.exists(so)
     except OSError:
@@ -72,7 +80,8 @@ def _build(so: str) -> bool:
         os.close(fd)
         tmp = so + ".tmp"
         proc = subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC],
+            ["g++", "-O3", "-shared", "-fPIC", *extra_flags,
+             "-o", tmp, src],
             capture_output=True, timeout=120)
         if proc.returncode != 0:
             return False
@@ -125,3 +134,49 @@ def lib() -> Optional[ctypes.CDLL]:
 
 def available() -> bool:
     return lib() is not None
+
+
+def fastdss():
+    """The compiled DSS codec extension module, or None.
+
+    A real CPython extension (not ctypes): the codec is called once per
+    control-plane frame, where ctypes marshalling was measured to cost
+    more than the work saved — the C API's ~100 ns call overhead is what
+    makes native pay at this granularity."""
+    global _fastdss, _fastdss_tried
+    if _fastdss is not None or _fastdss_tried:
+        return _fastdss
+    _fastdss_tried = True
+    if os.environ.get("OMPI_TPU_NO_NATIVE") == "1":
+        return None
+    import sysconfig
+
+    # the name must carry the interpreter ABI: unlike the plain-C ctypes
+    # helpers, this is a real CPython extension — loading a .so built for
+    # another Python version would dlopen mismatched object layouts
+    soabi = sysconfig.get_config_var("SOABI") or "abi-unknown"
+    so = _hash_name(_FASTDSS_SRC, f"_fastdss-{soabi}")
+    inc = sysconfig.get_paths().get("include")
+    if not inc or not os.path.exists(os.path.join(inc, "Python.h")):
+        return None
+    if not os.path.exists(so) and not _build(
+            so, src=_FASTDSS_SRC, extra_flags=("-I" + inc,)):
+        return None
+    try:
+        import importlib.machinery
+        import importlib.util
+
+        loader = importlib.machinery.ExtensionFileLoader("_fastdss", so)
+        spec = importlib.util.spec_from_file_location(
+            "_fastdss", so, loader=loader)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        # self-check against a known vector before trusting it
+        probe = {"t": "x", "n": 1, "f": 1.5, "l": [1, "a"], "b": b"\x00",
+                 "none": None, "tt": (True, False)}
+        if mod.unpack(mod.pack((probe,)), 1) != [probe]:
+            return None
+        _fastdss = mod
+    except Exception:  # noqa: BLE001 — any load failure → python codec
+        _fastdss = None
+    return _fastdss

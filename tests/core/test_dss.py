@@ -99,3 +99,73 @@ def test_fast_codec_wire_identical_to_buffer():
         buf.pack(v)
     assert fast == buf.bytes()
     assert dss.unpack(fast) == vals
+
+
+def test_fastdss_parity_fuzz():
+    """The compiled codec and the python codec must agree byte-for-byte
+    on random nested structures, and decode each other's output."""
+    import random
+
+    import pytest
+
+    from ompi_tpu import _native
+    from ompi_tpu.core import dss
+
+    fast = _native.fastdss()
+    if fast is None:
+        pytest.skip("fastdss did not build")
+    rng = random.Random(7)
+
+    def gen(depth=0):
+        kinds = ["int", "str", "bytes", "float", "bool", "none"]
+        if depth < 3:
+            kinds += ["list", "tuple", "dict"] * 2
+        k = rng.choice(kinds)
+        if k == "int":
+            return rng.randint(-2**62, 2**62)
+        if k == "str":
+            return "".join(chr(rng.randint(32, 0x2FA0))
+                           for _ in range(rng.randint(0, 12)))
+        if k == "bytes":
+            return bytes(rng.getrandbits(8) for _ in range(rng.randint(0, 20)))
+        if k == "float":
+            return rng.uniform(-1e12, 1e12)
+        if k == "bool":
+            return rng.random() < 0.5
+        if k == "none":
+            return None
+        if k in ("list", "tuple"):
+            items = [gen(depth + 1) for _ in range(rng.randint(0, 5))]
+            return items if k == "list" else tuple(items)
+        return {f"k{i}": gen(depth + 1) for i in range(rng.randint(0, 5))}
+
+    for _ in range(300):
+        v = gen()
+        ref = dss.Buffer()
+        ref.pack(v)
+        wire_ref = ref.bytes()
+        wire_fast = fast.pack((v,))
+        assert wire_fast == wire_ref, v
+        assert fast.unpack(wire_ref, 1) == [v]
+        assert dss.unpack(wire_fast, n=1) == [v]
+
+
+def test_fastdss_hostile_lengths():
+    """Hostile declared lengths must raise, never over-allocate or
+    silently truncate."""
+    import struct as _s
+
+    import pytest
+
+    from ompi_tpu import _native
+    from ompi_tpu.core import dss
+
+    fast = _native.fastdss()
+    if fast is None:
+        pytest.skip("fastdss did not build")
+    # string claiming 4GB, list claiming 1e9 items, dict likewise
+    for blob in (bytes([3]) + _s.pack("<I", 0xFFFFFFF0) + b"xy",
+                 bytes([7]) + _s.pack("<I", 10**9),
+                 bytes([8]) + _s.pack("<I", 10**9)):
+        with pytest.raises(dss.DSSError):
+            dss.unpack(blob)
