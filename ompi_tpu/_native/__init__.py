@@ -18,7 +18,7 @@ import subprocess
 import time
 from typing import Optional
 
-_ABI = 2
+_ABI = 1
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "convertor.cpp")
 _FASTDSS_SRC = os.path.join(_DIR, "fastdss.c")
@@ -120,12 +120,6 @@ def lib() -> Optional[ctypes.CDLL]:
         cdll.ompi_tpu_unpack.argtypes = [u8p, u8p, i64, i64, i64p, i64p,
                                          i64]
         cdll.ompi_tpu_unpack.restype = None
-        # shm-ring framing (vader-style native data plane)
-        cdll.ompi_tpu_ring_write.argtypes = [u8p, i64, i64, u8p, i64,
-                                             u8p, i64]
-        cdll.ompi_tpu_ring_write.restype = i64
-        cdll.ompi_tpu_ring_read.argtypes = [u8p, i64, i64, u8p, i64]
-        cdll.ompi_tpu_ring_read.restype = i64
         _lib = cdll
     except OSError:
         _lib = None

@@ -46,91 +46,7 @@ void ompi_tpu_unpack(const uint8_t *src, uint8_t *dst, int64_t count,
     }
 }
 
-// ---------------------------------------------------------------------------
-// Native shm-ring framing (the vader-BTL data plane's hot loop).
-//
-// ≈ opal/mca/btl/vader's fast-box/fifo writes: one C call frames and
-// publishes a message into the per-pair shared-memory ring (or drains
-// one), replacing several Python slice writes, struct packs, and counter
-// stores per frame.  Memory layout matches btl_shm.py:
-//   [0]  u64 head   (writer-owned; release-store publishes)
-//   [8]  u64 tail   (reader-owned; release-store frees space)
-//   [16] u64 capacity
-//   [24] u32 magic
-//   [32] u64 sleep flag
-//   [64] data area of `capacity` bytes, byte-addressed modulo capacity
-// Frame: [u32 total][u32 hdr_len][hdr][payload], total = hdr_len+pay_len.
-// ---------------------------------------------------------------------------
-
-static const int64_t kRingHdr = 64;
-
-static inline void ring_copy_in(uint8_t *mm, int64_t cap, int64_t pos,
-                                const uint8_t *src, int64_t len) {
-    int64_t off = pos % cap;
-    int64_t first = cap - off < len ? cap - off : len;
-    std::memcpy(mm + kRingHdr + off, src, static_cast<size_t>(first));
-    if (first < len)
-        std::memcpy(mm + kRingHdr, src + first,
-                    static_cast<size_t>(len - first));
-}
-
-static inline void ring_copy_out(const uint8_t *mm, int64_t cap, int64_t pos,
-                                 uint8_t *dst, int64_t len) {
-    int64_t off = pos % cap;
-    int64_t first = cap - off < len ? cap - off : len;
-    std::memcpy(dst, mm + kRingHdr + off, static_cast<size_t>(first));
-    if (first < len)
-        std::memcpy(dst + first, mm + kRingHdr,
-                    static_cast<size_t>(len - first));
-}
-
-// Frame + publish one message.  Caller verified capacity under its lock.
-// Returns the new head (also release-stored into the ring header, which
-// is what makes the frame visible to the reader).
-int64_t ompi_tpu_ring_write(uint8_t *mm, int64_t cap, int64_t head,
-                            const uint8_t *hdr, int64_t hdr_len,
-                            const uint8_t *pay, int64_t pay_len) {
-    uint32_t lens[2] = {static_cast<uint32_t>(hdr_len + pay_len),
-                        static_cast<uint32_t>(hdr_len)};
-    ring_copy_in(mm, cap, head, reinterpret_cast<uint8_t *>(lens), 8);
-    ring_copy_in(mm, cap, head + 8, hdr, hdr_len);
-    if (pay_len)
-        ring_copy_in(mm, cap, head + 8 + hdr_len, pay, pay_len);
-    int64_t new_head = head + 8 + hdr_len + pay_len;
-    __atomic_store_n(reinterpret_cast<uint64_t *>(mm),
-                     static_cast<uint64_t>(new_head), __ATOMIC_RELEASE);
-    return new_head;
-}
-
-// Drain one frame into `out` ([u32 total][u32 hdr_len][hdr][payload]).
-// Returns the consumed byte count (8+total) with the tail release-stored;
-// 0 when the ring is empty; -(8+total) when `out` is too small (nothing
-// consumed — the caller grows its scratch and retries); -1 when the
-// published region is corrupt.
-int64_t ompi_tpu_ring_read(uint8_t *mm, int64_t cap, int64_t tail,
-                           uint8_t *out, int64_t out_cap) {
-    uint64_t head = __atomic_load_n(reinterpret_cast<uint64_t *>(mm),
-                                    __ATOMIC_ACQUIRE);
-    int64_t avail = static_cast<int64_t>(head) - tail;
-    if (avail == 0)
-        return 0;
-    if (avail < 8 || avail > cap)
-        return -1;
-    uint32_t lens[2];
-    ring_copy_out(mm, cap, tail, reinterpret_cast<uint8_t *>(lens), 8);
-    int64_t total = static_cast<int64_t>(lens[0]);
-    if (total < static_cast<int64_t>(lens[1]) || 8 + total > avail)
-        return -1;
-    if (8 + total > out_cap)
-        return -(8 + total);
-    ring_copy_out(mm, cap, tail, out, 8 + total);
-    __atomic_store_n(reinterpret_cast<uint64_t *>(mm) + 1,
-                     static_cast<uint64_t>(tail + 8 + total),
-                     __ATOMIC_RELEASE);
-    return 8 + total;
-}
-
 // version tag so the loader can detect stale cached builds
-int64_t ompi_tpu_native_abi(void) { return 2; }
+int64_t ompi_tpu_native_abi(void) { return 1; }
 
 }  // extern "C"

@@ -32,6 +32,7 @@
 #define T_TUPLE 10
 
 static PyObject *Unsupported;
+static PyObject *RingFull;
 
 /* -- growable output buffer -------------------------------------------- */
 
@@ -326,11 +327,18 @@ static PyObject *fastdss_unpack(PyObject *self, PyObject *args) {
 
 /* -- module ------------------------------------------------------------ */
 
+static PyObject *fastdss_ring_send(PyObject *self, PyObject *args);
+static PyObject *fastdss_ring_recv(PyObject *self, PyObject *args);
+
 static PyMethodDef methods[] = {
     {"pack", fastdss_pack, METH_O,
      "pack(tuple_of_values) -> bytes (DSS wire format)"},
     {"unpack", fastdss_unpack, METH_VARARGS,
      "unpack(data[, n]) -> list of values"},
+    {"ring_send", fastdss_ring_send, METH_VARARGS,
+     "ring_send(mm, head, header, payload) -> (new_head, sleep_flag)"},
+    {"ring_recv", fastdss_ring_recv, METH_VARARGS,
+     "ring_recv(mm, tail) -> None | (header, payload, new_tail)"},
     {NULL, NULL, 0, NULL},
 };
 
@@ -349,5 +357,174 @@ PyMODINIT_FUNC PyInit__fastdss(void) {
         Py_DECREF(m);
         return NULL;
     }
+    RingFull = PyErr_NewException("_fastdss.RingFull", NULL, NULL);
+    if (!RingFull || PyModule_AddObject(m, "RingFull", RingFull) < 0) {
+        Py_XDECREF(RingFull);
+        Py_DECREF(m);
+        return NULL;
+    }
     return m;
+}
+
+/* -- fused ring framing -------------------------------------------------
+ * Encode a header dict + payload DIRECTLY into the shm ring mapping and
+ * publish, or decode a frame straight out of it — one C call per frame,
+ * no intermediate bytes object (the shm BTL's vader-class data plane).
+ * Ring layout matches btl_shm.py / convertor.cpp: u64 head @0 (writer,
+ * release-store publishes), u64 tail @8 (reader), u64 capacity @16,
+ * u32 magic @24, u64 sleep flag @32, data @64 modulo capacity.
+ */
+
+#define RING_HDR 64
+
+static void ring_out(uint8_t *mm, Py_ssize_t cap, Py_ssize_t pos,
+                     const uint8_t *src, Py_ssize_t len) {
+    Py_ssize_t off = pos % cap;
+    Py_ssize_t first = cap - off < len ? cap - off : len;
+    memcpy(mm + RING_HDR + off, src, (size_t)first);
+    if (first < len)
+        memcpy(mm + RING_HDR, src + first, (size_t)(len - first));
+}
+
+static void ring_in(const uint8_t *mm, Py_ssize_t cap, Py_ssize_t pos,
+                    uint8_t *dst, Py_ssize_t len) {
+    Py_ssize_t off = pos % cap;
+    Py_ssize_t first = cap - off < len ? cap - off : len;
+    memcpy(dst, mm + RING_HDR + off, (size_t)first);
+    if (first < len)
+        memcpy(dst + first, mm + RING_HDR, (size_t)(len - first));
+}
+
+
+/* ring_send(mm, head, header, payload) -> (new_head, sleep_flag)
+ * Raises RingFull when the frame does not fit right now (caller sleeps
+ * and retries), ValueError when it can never fit (> capacity/2), and
+ * Unsupported when the header needs the python codec. */
+static PyObject *fastdss_ring_send(PyObject *self, PyObject *args) {
+    Py_buffer mm, pay;
+    Py_ssize_t head;
+    PyObject *header;
+    if (!PyArg_ParseTuple(args, "w*nOy*", &mm, &head, &header, &pay))
+        return NULL;
+    Out o = {NULL, 0, 0};
+    PyObject *res = NULL;
+    if (mm.len < RING_HDR) {
+        PyErr_SetString(PyExc_ValueError, "ring mapping too small");
+        goto done;
+    }
+    if (pack_obj_rec(&o, header) < 0)
+        goto done;
+    {
+        uint8_t *base = (uint8_t *)mm.buf;
+        Py_ssize_t cap = (Py_ssize_t)((uint64_t *)base)[2];
+        if (cap <= 0 || RING_HDR + cap > mm.len) {
+            PyErr_SetString(PyExc_ValueError, "bad ring capacity");
+            goto done;
+        }
+        Py_ssize_t need = 8 + o.len + pay.len;
+        if (need > cap / 2) {
+            PyErr_Format(PyExc_ValueError,
+                         "frame of %zd bytes exceeds the %zd-byte ring's "
+                         "single-frame limit", need, cap);
+            goto done;
+        }
+        uint64_t tail = __atomic_load_n((uint64_t *)base + 1,
+                                        __ATOMIC_ACQUIRE);
+        if ((uint64_t)head - tail + (uint64_t)need > (uint64_t)cap) {
+            PyErr_SetString(RingFull, "ring full");
+            goto done;
+        }
+        uint32_t lens[2] = {(uint32_t)(o.len + pay.len), (uint32_t)o.len};
+        ring_out(base, cap, head, (const uint8_t *)lens, 8);
+        ring_out(base, cap, head + 8, o.buf, o.len);
+        if (pay.len)
+            ring_out(base, cap, head + 8 + o.len,
+                     (const uint8_t *)pay.buf, pay.len);
+        uint64_t new_head = (uint64_t)head + (uint64_t)need;
+        __atomic_store_n((uint64_t *)base, new_head, __ATOMIC_RELEASE);
+        uint64_t sleeping = ((uint64_t *)base)[4];
+        res = Py_BuildValue("(Ln)", (long long)new_head,
+                            (Py_ssize_t)(sleeping ? 1 : 0));
+    }
+done:
+    PyMem_Free(o.buf);
+    PyBuffer_Release(&mm);
+    PyBuffer_Release(&pay);
+    return res;
+}
+
+/* ring_recv(mm, tail) -> None | (header, payload_bytes, new_tail)
+ * Decodes the header straight from the ring (wraparound staged through
+ * a stack/heap buffer only when the frame wraps); release-stores the
+ * new tail.  Raises ValueError on corruption, Unsupported when the
+ * header carries a tag only the python codec knows (caller drains via
+ * the python path). */
+static PyObject *fastdss_ring_recv(PyObject *self, PyObject *args) {
+    Py_buffer mm;
+    Py_ssize_t tail;
+    if (!PyArg_ParseTuple(args, "w*n", &mm, &tail))
+        return NULL;
+    PyObject *res = NULL;
+    uint8_t *staged = NULL;
+    if (mm.len < RING_HDR) {
+        PyErr_SetString(PyExc_ValueError, "ring mapping too small");
+        goto out;
+    }
+    {
+        uint8_t *base = (uint8_t *)mm.buf;
+        Py_ssize_t cap = (Py_ssize_t)((uint64_t *)base)[2];
+        if (cap <= 0 || RING_HDR + cap > mm.len) {
+            PyErr_SetString(PyExc_ValueError, "bad ring capacity");
+            goto out;
+        }
+        uint64_t head = __atomic_load_n((uint64_t *)base, __ATOMIC_ACQUIRE);
+        int64_t avail = (int64_t)(head - (uint64_t)tail);
+        if (avail == 0) {
+            res = Py_None;
+            Py_INCREF(res);
+            goto out;
+        }
+        if (avail < 8 || avail > cap) {
+            PyErr_SetString(PyExc_ValueError, "corrupt ring state");
+            goto out;
+        }
+        uint32_t lens[2];
+        ring_in(base, cap, tail, (uint8_t *)lens, 8);
+        Py_ssize_t total = (Py_ssize_t)lens[0];
+        Py_ssize_t hdr_len = (Py_ssize_t)lens[1];
+        if (total < hdr_len || 8 + total > avail) {
+            PyErr_SetString(PyExc_ValueError, "corrupt ring frame");
+            goto out;
+        }
+        /* frame body: contiguous in the mapping unless it wraps */
+        Py_ssize_t body_off = (tail + 8) % cap;
+        const uint8_t *body;
+        if (body_off + total <= cap) {
+            body = base + RING_HDR + body_off;
+        } else {
+            staged = (uint8_t *)PyMem_Malloc((size_t)total);
+            if (!staged) { PyErr_NoMemory(); goto out; }
+            ring_in(base, cap, tail + 8, staged, total);
+            body = staged;
+        }
+        In in = {body, hdr_len, 0};
+        PyObject *header = unpack_obj_rec(&in);
+        if (!header)
+            goto out;
+        if (in.pos != hdr_len) {
+            Py_DECREF(header);
+            PyErr_SetString(PyExc_ValueError, "trailing header bytes");
+            goto out;
+        }
+        PyObject *payload = PyBytes_FromStringAndSize(
+            (const char *)(body + hdr_len), total - hdr_len);
+        if (!payload) { Py_DECREF(header); goto out; }
+        uint64_t new_tail = (uint64_t)tail + 8 + (uint64_t)total;
+        __atomic_store_n((uint64_t *)base + 1, new_tail, __ATOMIC_RELEASE);
+        res = Py_BuildValue("(NNL)", header, payload, (long long)new_tail);
+    }
+out:
+    PyMem_Free(staged);
+    PyBuffer_Release(&mm);
+    return res;
 }

@@ -64,50 +64,22 @@ register_var("btl", "shm_send_timeout", VarType.SIZE, 60,
              "seconds a full ring blocks a send before the peer is declared "
              "dead (0 = wait forever); a crashed receiver leaves its rings "
              "full, and unlike tcp there is no RST to surface it")
-register_var("btl", "shm_native", VarType.BOOL, False,
-             "use the native (C++) ring framing (ompi_tpu_ring_write/read "
-             "in _native/convertor.cpp). MEASURED SLOWER than the python "
-             "ring ops through ctypes (pointer marshalling + a scratch "
-             "copy cost more than the slice work saved: 27 vs 16µs per "
-             "small frame, 2.2 vs 4.5 GiB/s large, after the dss codec "
-             "rewrite removed the real hot spot) — default off; the C "
-             "functions stand as the layout-contract reference for a "
-             "CPython-C-API extension where call overhead is ~10× lower")
+register_var("btl", "shm_native", VarType.BOOL, True,
+             "fuse header encode + ring publish (and decode + drain) into "
+             "one CPython-C-API call per frame (_native/fastdss.c "
+             "ring_send/ring_recv — the vader-class native data plane). "
+             "An earlier ctypes route measured SLOWER than python (call "
+             "marshalling exceeded the work saved); the C-API route wins. "
+             "Off, or a failed build, → pure-python framing")
 
 
 def _native_ring():
-    """The native helper lib, or None (gated by var + build success)."""
+    """The compiled frame engine (fastdss module), or None."""
     if not var_registry.get("btl_shm_native"):
         return None
     from ompi_tpu import _native
 
-    return _native.lib()
-
-
-import ctypes as _ct  # noqa: E402 — hot-path helpers below
-
-import numpy as _np  # noqa: E402
-
-_U8P = _ct.POINTER(_ct.c_uint8)
-
-
-def _mm_ptr(mm):
-    return _ct.cast(_ct.addressof(_ct.c_char.from_buffer(mm)), _U8P)
-
-
-def _bytes_ptr(b: bytes):
-    return _ct.cast(b, _U8P)
-
-
-def _buf_ptr(data):
-    """(pointer, keepalive) for bytes OR a (possibly read-only)
-    memoryview — the zero-copy eager path sends a view of the user's
-    array, and ctypes.from_buffer rejects read-only buffers; a zero-copy
-    numpy frombuffer supplies the address instead."""
-    if isinstance(data, bytes):
-        return _ct.cast(data, _U8P), data
-    a = _np.frombuffer(data, _np.uint8)
-    return _ct.cast(a.ctypes.data, _U8P), a
+    return _native.fastdss()
 
 _HDR = 64                 # ring header bytes
 _OFF_HEAD, _OFF_TAIL, _OFF_CAP, _OFF_MAGIC = 0, 8, 16, 24
@@ -156,8 +128,7 @@ class ShmRingWriter:
         self._lock = threading.Lock()
         self._db_fd: Optional[int] = None   # receiver's doorbell FIFO
         self._first = True
-        self._native = _native_ring()
-        self._mm_p = _mm_ptr(self._mm) if self._native is not None else None
+        self._fast = _native_ring()
         try:
             self._db_fd = os.open(os.path.join(inbox, "doorbell"),
                                   os.O_WRONLY | os.O_NONBLOCK)
@@ -175,36 +146,76 @@ class ShmRingWriter:
     def _publish(self, body, hdr, payload) -> None:
         """Write one frame and publish it (call with self._lock held and
         space verified)."""
-        if self._native is not None:
-            # one C call: frame + wraparound copies + release-store of
-            # the head counter (≈ vader's fifo write hot loop); the
-            # payload pointer is zero-copy even for the eager path's
-            # read-only memoryview of the user buffer
-            plen = len(payload) if payload else 0
-            pptr, keep = _buf_ptr(payload) if plen else (None, None)
-            self._head = self._native.ompi_tpu_ring_write(
-                self._mm_p, self.capacity, self._head,
-                _bytes_ptr(hdr), len(hdr), pptr, plen)
-            del keep
-        else:
-            self._write(body)
-            self._write(hdr)
-            if payload:
-                self._write(payload)
-            # publish AFTER the data is in place (x86 TSO store order)
-            self._ctr[_OFF_HEAD // 8] = self._head
-        # doorbell: only when the receiver armed its sleep flag (or on
-        # our very first frame — a sleeping receiver must discover a
-        # brand-new ring)
-        if (self._first or self._ctr[_OFF_SLEEP // 8]) \
-                and self._db_fd is not None:
+        self._write(body)
+        self._write(hdr)
+        if payload:
+            self._write(payload)
+        # publish AFTER the data is in place (x86 TSO store order)
+        self._ctr[_OFF_HEAD // 8] = self._head
+        self._ring_doorbell(bool(self._ctr[_OFF_SLEEP // 8]))
+
+    @staticmethod
+    def _backoff(waited: float, delay: float, timeout: float
+                 ) -> tuple[float, float]:
+        """One backpressure tick: the receiver is behind; yield then
+        sleep, bounded.  A receiver that died without close() leaves the
+        ring full forever — the timeout surfaces that as an error (the
+        tcp path gets the equivalent from the kernel via RST)."""
+        if timeout and waited > timeout:
+            raise ConnectionError(
+                f"btl/shm: ring full for {waited:.0f}s — receiver "
+                f"appears dead (btl_shm_send_timeout)")
+        time.sleep(delay)
+        return waited + delay, min(delay + 2e-5, 1e-3)
+
+    def _ring_doorbell(self, armed: bool) -> None:
+        """Wake a sleeping receiver (or announce a brand-new ring: the
+        very first frame always rings — a sleeping receiver must
+        discover it)."""
+        if (self._first or armed) and self._db_fd is not None:
             self._first = False
             try:
                 os.write(self._db_fd, b"\x01")
             except (BlockingIOError, BrokenPipeError, OSError):
                 pass
 
-    def send(self, header: dict, payload: bytes) -> None:
+    def _send_fast(self, header: dict, payload, block: bool) -> bool:
+        """One fused C call per frame: encode the header straight into
+        the mapped ring + publish (fastdss.ring_send).  Returns False
+        when nonblocking and full; raises FrameTooBig / ConnectionError
+        like the python path.  Headers the C codec cannot encode fall
+        back to the python framing (wire format is identical)."""
+        fast = self._fast
+        fallback = False
+        with self._lock:
+            delay, waited = 0.0, 0.0
+            timeout = float(var_registry.get("btl_shm_send_timeout") or 0)
+            while True:
+                try:
+                    self._head, ring_db = fast.ring_send(
+                        self._mm, self._head, header, payload)
+                except fast.RingFull:
+                    if not block:
+                        return False
+                    waited, delay = self._backoff(waited, delay, timeout)
+                    continue
+                except fast.Unsupported:
+                    fallback = True   # exotic header: python framing,
+                    break             # OUTSIDE the (non-reentrant) lock
+                except ValueError as e:
+                    # only the single-frame size limit maps to
+                    # FrameTooBig; corrupt ring headers / encode errors
+                    # must surface as what they are
+                    if "single-frame limit" in str(e):
+                        raise FrameTooBig(str(e)) from None
+                    raise
+                break
+        if fallback:
+            return self._send_py(header, payload, block)
+        self._ring_doorbell(bool(ring_db))
+        return True
+
+    def _send_py(self, header: dict, payload, block: bool) -> bool:
         body, hdr, need = self._frame(header, payload)
         with self._lock:
             delay, waited = 0.0, 0.0
@@ -213,30 +224,25 @@ class ShmRingWriter:
                 tail = self._ctr[_OFF_TAIL // 8]
                 if self._head - tail + need <= self.capacity:
                     break
-                # backpressure: the receiver is behind; yield then sleep.
-                # A receiver that died without close() leaves the ring full
-                # forever — bound the wait so the failure surfaces as an
-                # error (the tcp path gets this from the kernel via RST).
-                if timeout and waited > timeout:
-                    raise ConnectionError(
-                        f"btl/shm: ring full for {waited:.0f}s — receiver "
-                        f"appears dead (btl_shm_send_timeout)")
-                time.sleep(delay)
-                waited += delay
-                delay = min(delay + 2e-5, 1e-3)
+                if not block:
+                    return False
+                waited, delay = self._backoff(waited, delay, timeout)
             self._publish(body, hdr, payload)
+        return True
+
+    def send(self, header: dict, payload: bytes) -> None:
+        if self._fast is not None:
+            self._send_fast(header, payload, block=True)
+        else:
+            self._send_py(header, payload, block=True)
 
     def try_send(self, header: dict, payload: bytes) -> bool:
         """Nonblocking send (≈ btl sendi, btl.h:926): publish the frame iff
         the ring has room NOW; False ⇒ the caller takes the queued path.
         Still raises FrameTooBig for frames no amount of draining fits."""
-        body, hdr, need = self._frame(header, payload)
-        with self._lock:
-            tail = self._ctr[_OFF_TAIL // 8]
-            if self._head - tail + need > self.capacity:
-                return False
-            self._publish(body, hdr, payload)
-        return True
+        if self._fast is not None:
+            return self._send_fast(header, payload, block=False)
+        return self._send_py(header, payload, block=False)
 
     def _write(self, data) -> None:
         data = memoryview(data).cast("B")
@@ -277,22 +283,43 @@ class ShmRingReader:
         self.capacity = self._ctr[_OFF_CAP // 8]
         self._tail = self._ctr[_OFF_TAIL // 8]
         self._seg.unlink()  # mapping survives; crash cleanup is automatic
-        self._native = _native_ring()
-        self._mm_p = _mm_ptr(self._mm) if self._native is not None else None
-        self._scratch = None
-        self._scratch_p = None
-        if self._native is not None:
-            self._grow_scratch(64 << 10)
-
-    def _grow_scratch(self, size: int) -> None:
-        self._scratch = bytearray(size)
-        self._scratch_p = _mm_ptr(self._scratch)
+        self._fast = _native_ring()
 
     def poll(self, on_frame: OnFrame, limit: int = 64) -> int:
         """Drain up to ``limit`` frames; returns how many were delivered."""
-        if self._native is not None:
-            return self._poll_native(on_frame, limit)
+        fast = self._fast
         n = 0
+        while fast is not None and n < limit:
+            # fused decode: header is unpacked straight from the mapped
+            # ring (fastdss.ring_recv), tail release-stored in C
+            try:
+                out = fast.ring_recv(self._mm, self._tail)
+            except fast.Unsupported:
+                # a header tag only the python codec knows: drain the
+                # rest of this batch through the python path
+                fast = None
+                break
+            except ValueError as e:
+                # corrupt frame: the C decoder did NOT advance the tail
+                # (nothing trustworthy to advance by) — retrying would
+                # livelock on the same bytes forever.  The stream is
+                # unrecoverable; discard everything published and
+                # surface the fault loudly (the python path would have
+                # decoded garbage instead — this is the stricter cure).
+                head = int(self._ctr[_OFF_HEAD // 8])
+                dropped = head - self._tail
+                self._tail = head
+                self._ctr[_OFF_TAIL // 8] = self._tail
+                raise OSError(
+                    f"btl/shm: corrupt ring from peer {self.peer} "
+                    f"({e}); {dropped} pending bytes discarded") from None
+            if out is None:
+                return n
+            header, payload, self._tail = out
+            on_frame(self.peer, header, payload)
+            n += 1
+        if n >= limit:
+            return n
         while n < limit:
             head = self._ctr[_OFF_HEAD // 8]
             avail = head - self._tail
@@ -305,32 +332,6 @@ class ShmRingReader:
             header = dss.unpack(blob[:hdr_len], n=1)[0]
             on_frame(self.peer, header, blob[hdr_len:])
             self._ctr[_OFF_TAIL // 8] = self._tail
-            n += 1
-        return n
-
-    def _poll_native(self, on_frame: OnFrame, limit: int) -> int:
-        """One C call drains each frame into a reusable scratch buffer
-        (wraparound copies + acquire/release counter handling in C)."""
-        n = 0
-        while n < limit:
-            r = self._native.ompi_tpu_ring_read(
-                self._mm_p, self.capacity, self._tail, self._scratch_p,
-                len(self._scratch))
-            if r == 0:
-                break
-            if r < -1:
-                self._grow_scratch(-r + 1024)   # too small: grow, retry
-                continue
-            if r == -1:
-                raise OSError(
-                    f"btl/shm: corrupt ring from peer {self.peer}")
-            self._tail += r
-            total, hdr_len = struct.unpack_from("<II", self._scratch, 0)
-            view = memoryview(self._scratch)   # single-copy slices
-            header = dss.unpack(view[8:8 + hdr_len], n=1)[0]
-            on_frame(self.peer, header,
-                     bytes(view[8 + hdr_len:8 + total]))
-            view.release()
             n += 1
         return n
 
