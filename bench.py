@@ -534,6 +534,75 @@ def matrix_oshmem_device(devices) -> dict:
     }
 
 
+def matrix_shm_pingpong() -> dict:
+    """Two real PROCESSES ping-ponging raw frames over the shm BTL rings
+    — the deployment-shape same-host data-plane number (the reference's
+    vader BTL benchmark shape), exercising the fused native frame engine
+    (fastdss.ring_send/ring_recv) without GIL sharing between ranks."""
+    import multiprocessing as mp
+
+    def child(c2p, p2c, result_q):
+        from ompi_tpu.mpi.btl_shm import ShmBTL
+
+        frames = []
+        btl = ShmBTL(1, lambda p, h, b: frames.append((h, b)))
+        c2p.put(btl.address)
+        peer_card = p2c.get()
+        btl.connect(0, peer_card)
+        # echo every frame back until the stop marker
+        seen = 0
+        deadline = time.perf_counter() + 60
+        while time.perf_counter() < deadline:
+            if len(frames) > seen:
+                h, b = frames[seen]
+                if h.get("t") == "stop":
+                    break
+                seen += 1
+                btl.send(0, h, b)
+            else:
+                time.sleep(0)
+        result_q.put(seen)
+        btl.close()
+
+    from ompi_tpu.mpi.btl_shm import ShmBTL
+
+    ctx = mp.get_context("fork")
+    c2p, p2c, result_q = ctx.Queue(), ctx.Queue(), ctx.Queue()
+    proc = ctx.Process(target=child, args=(c2p, p2c, result_q),
+                       daemon=True)
+    proc.start()
+    frames = []
+    btl = ShmBTL(0, lambda p, h, b: frames.append((h, b)))
+    peer_card = c2p.get(timeout=30)
+    p2c.put(btl.address)
+    btl.connect(1, peer_card)
+    hdr = {"t": "eager", "tag": 1, "cid": 0, "seq": 0, "dt": "<i4",
+           "elems": 16, "shp": [16]}
+    payload = b"\x01" * 64
+    laps = []
+    warm, iters = 50, 400
+    for i in range(warm + iters):
+        target = len(frames) + 1   # BEFORE the send: the echo can land
+        t0 = time.perf_counter()    # before this line otherwise
+        btl.send(1, hdr, payload)
+        deadline = t0 + 10
+        while len(frames) < target and time.perf_counter() < deadline:
+            time.sleep(0)   # yield: the poller thread appends frames
+        if i >= warm:
+            laps.append(time.perf_counter() - t0)
+    btl.send(1, {"t": "stop"}, b"")
+    echoed = result_q.get(timeout=30)
+    proc.join(timeout=10)
+    btl.close()
+    p50 = float(np.percentile(np.array(laps) * 1e6, 50))
+    return {
+        "metric": "shm BTL 2-process ping-pong p50 (64B frames, fused "
+                  "native ring)",
+        "value": round(p50, 2), "unit": "us", "vs_baseline": 1.0,
+        "one_way_us": round(p50 / 2, 2), "echoed": echoed,
+    }
+
+
 def matrix_remote_dma(devices) -> dict:
     """One-sided put (pallas remote DMA, ≈ btl_put) — on ≥2 chips a true
     cross-chip put timing the single ICI path; on 1 chip the self-put
@@ -653,6 +722,7 @@ def run_matrix(devices, backend: str) -> None:
     rows = []
     for name, fn in (
             ("ring_latency", matrix_ring_latency),
+            ("shm_pingpong", matrix_shm_pingpong),
             ("allreduce_sweep", lambda: matrix_allreduce_sweep(devices)),
             ("mesh_bcast_allgather",
              lambda: matrix_mesh_bcast_allgather(devices)),

@@ -33,6 +33,7 @@
 
 static PyObject *Unsupported;
 static PyObject *RingFull;
+static PyObject *TooBig;
 
 /* -- growable output buffer -------------------------------------------- */
 
@@ -363,6 +364,12 @@ PyMODINIT_FUNC PyInit__fastdss(void) {
         Py_DECREF(m);
         return NULL;
     }
+    TooBig = PyErr_NewException("_fastdss.FrameTooBig", NULL, NULL);
+    if (!TooBig || PyModule_AddObject(m, "FrameTooBig", TooBig) < 0) {
+        Py_XDECREF(TooBig);
+        Py_DECREF(m);
+        return NULL;
+    }
     return m;
 }
 
@@ -423,7 +430,7 @@ static PyObject *fastdss_ring_send(PyObject *self, PyObject *args) {
         }
         Py_ssize_t need = 8 + o.len + pay.len;
         if (need > cap / 2) {
-            PyErr_Format(PyExc_ValueError,
+            PyErr_Format(TooBig,
                          "frame of %zd bytes exceeds the %zd-byte ring's "
                          "single-frame limit", need, cap);
             goto done;
@@ -519,9 +526,24 @@ static PyObject *fastdss_ring_recv(PyObject *self, PyObject *args) {
         PyObject *payload = PyBytes_FromStringAndSize(
             (const char *)(body + hdr_len), total - hdr_len);
         if (!payload) { Py_DECREF(header); goto out; }
+        /* build the python result BEFORE the tail store publishes the
+         * slot back to the writer: an allocation failure here must not
+         * desync the shm tail from the reader's python-side mirror */
         uint64_t new_tail = (uint64_t)tail + 8 + (uint64_t)total;
+        PyObject *tup = PyTuple_New(3);
+        PyObject *nt = PyLong_FromLongLong((long long)new_tail);
+        if (!tup || !nt) {
+            Py_XDECREF(tup);
+            Py_XDECREF(nt);
+            Py_DECREF(header);
+            Py_DECREF(payload);
+            goto out;
+        }
+        PyTuple_SET_ITEM(tup, 0, header);
+        PyTuple_SET_ITEM(tup, 1, payload);
+        PyTuple_SET_ITEM(tup, 2, nt);
         __atomic_store_n((uint64_t *)base + 1, new_tail, __ATOMIC_RELEASE);
-        res = Py_BuildValue("(NNL)", header, payload, (long long)new_tail);
+        res = tup;
     }
 out:
     PyMem_Free(staged);

@@ -64,6 +64,11 @@ register_var("btl", "shm_send_timeout", VarType.SIZE, 60,
              "seconds a full ring blocks a send before the peer is declared "
              "dead (0 = wait forever); a crashed receiver leaves its rings "
              "full, and unlike tcp there is no RST to surface it")
+register_var("btl", "shm_spin", VarType.INT, 512,
+             "poller idle iterations (GIL-yielding) before arming the "
+             "doorbell and sleeping — a wider window keeps ping-pong "
+             "latency off the fifo-wake path on multi-core hosts; "
+             "ignored (0) on 1-2 core hosts")
 register_var("btl", "shm_native", VarType.BOOL, True,
              "fuse header encode + ring publish (and decode + drain) into "
              "one CPython-C-API call per frame (_native/fastdss.c "
@@ -202,13 +207,8 @@ class ShmRingWriter:
                 except fast.Unsupported:
                     fallback = True   # exotic header: python framing,
                     break             # OUTSIDE the (non-reentrant) lock
-                except ValueError as e:
-                    # only the single-frame size limit maps to
-                    # FrameTooBig; corrupt ring headers / encode errors
-                    # must surface as what they are
-                    if "single-frame limit" in str(e):
-                        raise FrameTooBig(str(e)) from None
-                    raise
+                except fast.FrameTooBig as e:
+                    raise FrameTooBig(str(e)) from None
                 break
         if fallback:
             return self._send_py(header, payload, block)
@@ -388,7 +388,8 @@ class ShmBTL:
         self._stop = threading.Event()
         # spinning only pays when the sender runs on another core; on a
         # 1-2 core host every spin iteration steals the sender's quantum
-        self._spin = 64 if (os.cpu_count() or 1) > 2 else 0
+        self._spin = (int(var_registry.get("btl_shm_spin") or 0)
+                      if (os.cpu_count() or 1) > 2 else 0)
         self._poller = threading.Thread(
             target=self._poll_loop, name=f"btl-shm-{rank}", daemon=True)
         self._poller.start()
