@@ -326,6 +326,62 @@ static PyObject *fastdss_unpack(PyObject *self, PyObject *args) {
     return out;
 }
 
+/* -- shared-memory atomics (sharedfp/sm, host-side counters) ----------- */
+
+static int atomic_slot(Py_buffer *mm, Py_ssize_t off, uint64_t **slot) {
+    if (off < 0 || off % 8 || off + 8 > mm->len) {
+        PyErr_SetString(PyExc_ValueError, "bad atomic slot offset");
+        return -1;
+    }
+    *slot = (uint64_t *)((uint8_t *)mm->buf + off);
+    return 0;
+}
+
+static PyObject *fastdss_atomic_add(PyObject *self, PyObject *args) {
+    Py_buffer mm;
+    Py_ssize_t off;
+    long long delta;
+    if (!PyArg_ParseTuple(args, "w*nL", &mm, &off, &delta)) return NULL;
+    uint64_t *slot;
+    PyObject *res = NULL;
+    if (atomic_slot(&mm, off, &slot) == 0) {
+        uint64_t old = __atomic_fetch_add(slot, (uint64_t)(int64_t)delta,
+                                          __ATOMIC_ACQ_REL);
+        res = PyLong_FromUnsignedLongLong(old);
+    }
+    PyBuffer_Release(&mm);
+    return res;
+}
+
+static PyObject *fastdss_atomic_load(PyObject *self, PyObject *args) {
+    Py_buffer mm;
+    Py_ssize_t off;
+    if (!PyArg_ParseTuple(args, "w*n", &mm, &off)) return NULL;
+    uint64_t *slot;
+    PyObject *res = NULL;
+    if (atomic_slot(&mm, off, &slot) == 0)
+        res = PyLong_FromUnsignedLongLong(
+            __atomic_load_n(slot, __ATOMIC_ACQUIRE));
+    PyBuffer_Release(&mm);
+    return res;
+}
+
+static PyObject *fastdss_atomic_store(PyObject *self, PyObject *args) {
+    Py_buffer mm;
+    Py_ssize_t off;
+    unsigned long long v;
+    if (!PyArg_ParseTuple(args, "w*nK", &mm, &off, &v)) return NULL;
+    uint64_t *slot;
+    PyObject *res = NULL;
+    if (atomic_slot(&mm, off, &slot) == 0) {
+        __atomic_store_n(slot, (uint64_t)v, __ATOMIC_RELEASE);
+        res = Py_None;
+        Py_INCREF(res);
+    }
+    PyBuffer_Release(&mm);
+    return res;
+}
+
 /* -- module ------------------------------------------------------------ */
 
 static PyObject *fastdss_ring_send(PyObject *self, PyObject *args);
@@ -340,6 +396,12 @@ static PyMethodDef methods[] = {
      "ring_send(mm, head, header, payload) -> (new_head, sleep_flag)"},
     {"ring_recv", fastdss_ring_recv, METH_VARARGS,
      "ring_recv(mm, tail) -> None | (header, payload, new_tail)"},
+    {"atomic_add", fastdss_atomic_add, METH_VARARGS,
+     "atomic_add(mm, offset, delta) -> old (u64 fetch-add, acq_rel)"},
+    {"atomic_load", fastdss_atomic_load, METH_VARARGS,
+     "atomic_load(mm, offset) -> value (u64, acquire)"},
+    {"atomic_store", fastdss_atomic_store, METH_VARARGS,
+     "atomic_store(mm, offset, value) (u64, release)"},
     {NULL, NULL, 0, NULL},
 };
 

@@ -33,11 +33,12 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Any, Optional, Sequence
 
 import numpy as np
 
-from ompi_tpu.core.config import VarType, register_var
+from ompi_tpu.core.config import VarType, register_var, var_registry
 from ompi_tpu.mpi import datatype as dt_mod
 from ompi_tpu.mpi.constants import MPIException
 from ompi_tpu.mpi.datatype import Datatype
@@ -119,6 +120,144 @@ _shfp_registry_lock = threading.Lock()
 def _shfp_lock(path: str) -> threading.Lock:
     with _shfp_registry_lock:
         return _shfp_locks.setdefault(path, threading.Lock())
+
+
+# -- sharedfp strategies (≈ ompi/mca/sharedfp components) -----------------
+
+register_var("io", "sharedfp", VarType.STRING, "",
+             "shared-file-pointer component: lockedfile | sm (empty = "
+             "auto: sm when every rank shares the host and the native "
+             "atomics built, else lockedfile — the reference's "
+             "sharedfp/sm vs sharedfp/lockedfile split)")
+
+
+class _LockedFileSharedFp:
+    """sharedfp/lockedfile: an 8-byte sidecar file guarded by a fcntl
+    range lock (+ a thread lock for in-process ranks) — works on any
+    shared filesystem, multi-host included."""
+
+    name = "lockedfile"
+
+    def __init__(self, path: str) -> None:
+        self.path = path + ".ompi_tpu_shfp"
+
+    def create(self, initial: int) -> None:
+        self.store(initial)
+
+    def attach(self) -> None:
+        pass                     # the filesystem is the rendezvous
+
+    def load(self) -> int:
+        with open(self.path, "rb") as f:
+            return int.from_bytes(f.read(8), "big")
+
+    def store(self, val: int) -> None:
+        with open(self.path, "wb") as f:
+            f.write(int(val).to_bytes(8, "big"))
+
+    def fetch_add(self, n: int) -> int:
+        import fcntl
+
+        with _shfp_lock(self.path):
+            with open(self.path, "r+b") as f:
+                fcntl.lockf(f, fcntl.LOCK_EX)
+                try:
+                    cur = int.from_bytes(f.read(8), "big")
+                    f.seek(0)
+                    f.write((cur + n).to_bytes(8, "big"))
+                    f.flush()
+                finally:
+                    fcntl.lockf(f, fcntl.LOCK_UN)
+        return cur
+
+    def close(self, root: bool) -> None:
+        if root:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+
+
+class _SmSharedFp:
+    """sharedfp/sm: the pointer is an 8-byte counter in a shared-memory
+    segment, advanced with native u64 atomics (fastdss.atomic_add) —
+    lock-free fetch-add for same-host jobs, the reference's
+    sharedfp/sm strategy."""
+
+    name = "sm"
+
+    def __init__(self, path: str) -> None:
+        import zlib
+
+        self._name = f"otpu-shfp-{os.getuid()}-{zlib.crc32(path.encode()):08x}"
+        self._seg = None
+        self._fast = None
+
+    @staticmethod
+    def usable() -> bool:
+        from ompi_tpu import _native
+
+        return (os.path.isdir("/dev/shm")
+                and _native.fastdss() is not None)
+
+    def _path(self) -> str:
+        return os.path.join("/dev/shm", self._name)
+
+    def create(self, initial: int) -> None:
+        from ompi_tpu import _native
+        from ompi_tpu.core import shmseg
+
+        self._fast = _native.fastdss()
+        try:
+            os.unlink(self._path())   # stale segment from a crashed job
+        except OSError:
+            pass
+        # initialize BEFORE publishing: an attacher must never observe
+        # the counter without its initial value
+        self._seg = shmseg.create(self._name, 8, dir="/dev/shm",
+                                  publish=False)
+        self._fast.atomic_store(self._seg.buf, 0, int(initial))
+        self._seg.publish()
+
+    def attach(self) -> None:
+        from ompi_tpu import _native
+        from ompi_tpu.core import shmseg
+
+        self._fast = _native.fastdss()
+        deadline = time.monotonic() + 10
+        while True:
+            try:
+                self._seg = shmseg.attach(self._path())
+                return
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.01)
+
+    def load(self) -> int:
+        return int(self._fast.atomic_load(self._seg.buf, 0))
+
+    def store(self, val: int) -> None:
+        self._fast.atomic_store(self._seg.buf, 0, int(val))
+
+    def fetch_add(self, n: int) -> int:
+        return int(self._fast.atomic_add(self._seg.buf, 0, int(n)))
+
+    def close(self, root: bool) -> None:
+        """EVERY rank detaches its mapping (a rank-0-only teardown would
+        leak one live tmpfs mapping per open on every other rank); the
+        root also unlinks the segment name."""
+        if root:
+            try:
+                os.unlink(self._path())
+            except OSError:
+                pass
+        if self._seg is not None:
+            try:
+                self._seg.detach()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+            self._seg = None
 
 
 class FileView:
@@ -282,27 +421,73 @@ class File:
                 + (f": {err}" if err else ""), error_class=38)
         if amode & MODE_APPEND:
             self._pos = os.fstat(self._fd).st_size // self.view.etype.size
-        # shared pointer sidecar: rank 0 resets it (to EOF under APPEND —
-        # MPI requires *all* pointers to start at end of file), then sync.
+        # shared file pointer: pick a sharedfp component collectively,
+        # rank 0 creates/resets it (to EOF under APPEND — MPI requires
+        # *all* pointers to start at end of file), everyone attaches.
         # A read-only mount (archived snapshot dir) cannot host the
-        # sidecar — record the failure and raise only if shared-pointer
-        # ops are actually used, so plain reads of immutable files work.
-        self._shfp_path = self.path + ".ompi_tpu_shfp"
+        # lockedfile sidecar — record the failure and raise only if
+        # shared-pointer ops are actually used, so plain reads of
+        # immutable files work.
         self._shfp_err = ""
+        self._shfp = self._select_sharedfp()
+        initial = int(self._pos if amode & MODE_APPEND else 0)
         if comm.rank == 0:
             try:
-                with open(self._shfp_path, "wb") as f:
-                    f.write(int(self._pos if amode & MODE_APPEND else 0
-                                ).to_bytes(8, "big"))
+                self._shfp.create(initial)
             except OSError as e:
                 self._shfp_err = str(e)
-        # every rank must agree whether the sidecar exists (shared ops
-        # are collective-adjacent); broadcast rank 0's outcome
+        # every rank must agree whether the pointer exists (shared ops
+        # are collective-adjacent): broadcast the create outcome, attach,
+        # then agree on the attach outcomes too — a single rank with a
+        # broken pointer would otherwise raise mid-collective while its
+        # peers block in the matching barrier
         flag = comm.bcast(np.array(
             [1 if not self._shfp_err else 0], np.int8), root=0)
-        if not int(np.asarray(flag)[0]) and comm.rank != 0:
-            self._shfp_err = "sidecar creation failed on rank 0"
+        if not int(np.asarray(flag)[0]):
+            if comm.rank != 0:
+                self._shfp_err = "shared-pointer creation failed on rank 0"
+        elif comm.rank != 0:
+            try:
+                self._shfp.attach()
+            except OSError as e:
+                self._shfp_err = str(e)
+        from ompi_tpu.mpi import op as op_mod
+
+        ok_everywhere = int(np.asarray(comm.allreduce(np.array(
+            [0 if self._shfp_err else 1], np.int32),
+            op=op_mod.MIN))[0])
+        if not ok_everywhere and not self._shfp_err:
+            self._shfp_err = "shared-pointer setup failed on a peer rank"
         comm.barrier()
+
+    def _select_sharedfp(self):
+        """Component choice, identical on every rank: forced var > auto
+        (sm when every rank shares the host and the native atomics
+        built — the sm/lockedfile split of ompi/mca/sharedfp).  The
+        usable/host check is COLLECTIVE even when forced: a partially
+        usable sm must fail uniformly, not strand peers in the open's
+        bcast."""
+        forced = var_registry.get("io_sharedfp") or ""
+        if forced and forced not in ("sm", "lockedfile"):
+            raise MPIException(
+                f"unknown sharedfp component {forced!r} "
+                f"(lockedfile/sm)", error_class=3)
+        keys = np.asarray(self.comm.allgather(np.array(
+            [self._my_host_key(), 1 if _SmSharedFp.usable() else 0],
+            np.int64))).reshape(-1, 2)
+        sm_ok = (len(set(int(k) for k in keys[:, 0])) == 1
+                 and int(keys[:, 1].min()) == 1)
+        if forced == "sm":
+            if not sm_ok:
+                raise MPIException(
+                    "io_sharedfp=sm forced but unusable (ranks span "
+                    "hosts, or the native atomics did not build on "
+                    "every rank)", error_class=3)
+            return _SmSharedFp(self.path)
+        if forced == "lockedfile":
+            return _LockedFileSharedFp(self.path)
+        return _SmSharedFp(self.path) if sm_ok \
+            else _LockedFileSharedFp(self.path)
 
     # -- fs framework ------------------------------------------------------
 
@@ -342,11 +527,8 @@ class File:
         self.comm.barrier()
         os.close(self._fd)
         self._closed = True
+        self._shfp.close(root=self.comm.rank == 0)
         if self.comm.rank == 0:
-            try:
-                os.unlink(self._shfp_path)
-            except OSError:
-                pass
             if self.amode & MODE_DELETE_ON_CLOSE:
                 try:
                     os.unlink(self.path)
@@ -400,8 +582,9 @@ class File:
         self._check_open()
         self.view = FileView(disp, etype, filetype)
         self._pos = 0
-        self._shfp_store(0)
-        self.comm.barrier()
+        if not self._shfp_err:   # pointer unavailable (read-only mount):
+            self._shfp_store(0)  # the reset is moot — only shared ops
+        self.comm.barrier()      # would need it, and they raise anyway
 
     def get_view(self) -> tuple[int, Datatype, Datatype]:
         return self.view.disp, self.view.etype, self.view.filetype
@@ -817,33 +1000,25 @@ class File:
 
     # -- shared file pointer (sharedfp/lockedfile equivalent) --------------
 
-    def _shfp_load(self) -> int:
+    def _shfp_guard(self) -> None:
         if self._shfp_err:
             raise MPIException(
-                f"shared file pointer unavailable: the sidecar could not "
-                f"be created at open ({self._shfp_err})", error_class=38)
-        with open(self._shfp_path, "rb") as f:
-            return int.from_bytes(f.read(8), "big")
+                f"shared file pointer unavailable: the "
+                f"{self._shfp.name} component could not be set up at "
+                f"open ({self._shfp_err})", error_class=38)
+
+    def _shfp_load(self) -> int:
+        self._shfp_guard()
+        return self._shfp.load()
 
     def _shfp_store(self, val: int) -> None:
-        with open(self._shfp_path, "wb") as f:
-            f.write(int(val).to_bytes(8, "big"))
+        self._shfp_guard()
+        self._shfp.store(val)
 
     def _shfp_fetch_add(self, n: int) -> int:
         """Atomically reserve n etypes of the shared pointer."""
-        import fcntl
-
-        with _shfp_lock(self._shfp_path):
-            with open(self._shfp_path, "r+b") as f:
-                fcntl.lockf(f, fcntl.LOCK_EX)
-                try:
-                    cur = int.from_bytes(f.read(8), "big")
-                    f.seek(0)
-                    f.write((cur + n).to_bytes(8, "big"))
-                    f.flush()
-                finally:
-                    fcntl.lockf(f, fcntl.LOCK_UN)
-        return cur
+        self._shfp_guard()
+        return self._shfp.fetch_add(n)
 
     def read_shared(self, count: int) -> np.ndarray:
         """≈ MPI_File_read_shared."""

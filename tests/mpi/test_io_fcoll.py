@@ -232,3 +232,73 @@ def test_large_strided_roundtrip_all_components(tmp_path, fcoll_var):
         return None
 
     run_ranks(4, rd)
+
+
+@pytest.mark.parametrize("comp", ["sm", "lockedfile"])
+def test_sharedfp_components(tmp_path, comp):
+    """Both sharedfp strategies (native shared-memory atomics vs fcntl
+    lockedfile) implement the same ordered-reservation contract."""
+    from ompi_tpu import _native
+
+    if comp == "sm" and _native.fastdss() is None:
+        pytest.skip("native atomics unavailable")
+    old = config.var_registry.get("io_sharedfp")
+    config.var_registry.set("io_sharedfp", comp)
+    path = str(tmp_path / f"sh_{comp}.bin")
+
+    def body(comm):
+        from ompi_tpu.mpi.datatype import INT32
+
+        f = mio.File.open(comm, path, mio.MODE_RDWR | mio.MODE_CREATE)
+        assert f._shfp.name == comp
+        f.set_view(0, INT32)
+        # every rank appends its stamp through the shared pointer; the
+        # fetch-add contract → all 4 blocks land disjoint
+        data = np.full(8, comm.rank, np.int32)
+        f.write_shared(data)
+        comm.barrier()
+        assert f.get_position_shared() == 32   # 4 ranks x 8 etypes
+        f.close()
+        return None
+
+    try:
+        run_ranks(4, body)
+    finally:
+        config.var_registry.set("io_sharedfp", old or "")
+    blocks = np.fromfile(path, np.int32).reshape(4, 8)
+    # each rank's block is uniform, and all ranks appear exactly once
+    assert sorted(int(b[0]) for b in blocks) == [0, 1, 2, 3]
+    for b in blocks:
+        assert (b == b[0]).all()
+
+
+def test_sharedfp_auto_picks_sm_same_host(tmp_path):
+    from ompi_tpu import _native
+
+    if _native.fastdss() is None:
+        pytest.skip("native atomics unavailable")
+    path = str(tmp_path / "auto.bin")
+
+    def body(comm):
+        f = mio.File.open(comm, path, mio.MODE_RDWR | mio.MODE_CREATE)
+        name = f._shfp.name
+        f.close()
+        return name
+
+    assert run_ranks(2, body) == ["sm", "sm"]
+
+
+def test_sharedfp_auto_lockedfile_cross_host(tmp_path):
+    """Ranks on different (fake) hosts cannot share /dev/shm: auto must
+    fall back to the lockedfile strategy."""
+    path = str(tmp_path / "xhost.bin")
+    hosts = ["hostA", "hostB"]
+
+    def body(comm):
+        comm._io_host_override = hosts[comm.rank]
+        f = mio.File.open(comm, path, mio.MODE_RDWR | mio.MODE_CREATE)
+        name = f._shfp.name
+        f.close()
+        return name
+
+    assert run_ranks(2, body) == ["lockedfile", "lockedfile"]
