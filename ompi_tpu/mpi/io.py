@@ -122,6 +122,11 @@ def _shfp_lock(path: str) -> threading.Lock:
         return _shfp_locks.setdefault(path, threading.Lock())
 
 
+import itertools as _it  # noqa: E402
+
+_shfp_nonce = _it.count(1)   # per-process component of the sm open nonce
+
+
 # -- sharedfp strategies (≈ ompi/mca/sharedfp components) -----------------
 
 register_var("io", "sharedfp", VarType.STRING, "",
@@ -189,9 +194,16 @@ class _SmSharedFp:
     def __init__(self, path: str) -> None:
         import zlib
 
-        self._name = f"otpu-shfp-{os.getuid()}-{zlib.crc32(path.encode()):08x}"
+        self._base = f"otpu-shfp-{os.getuid()}-{zlib.crc32(path.encode()):08x}"
+        self._name = self._base
         self._seg = None
         self._fast = None
+
+    def set_nonce(self, nonce: int) -> None:
+        """Per-OPEN disambiguation (agreed collectively): MPI shared
+        pointers belong to the open, so two concurrent opens of the same
+        path must not share — or unlink — each other's counter."""
+        self._name = f"{self._base}-{nonce:x}"
 
     @staticmethod
     def usable() -> bool:
@@ -208,10 +220,18 @@ class _SmSharedFp:
         from ompi_tpu.core import shmseg
 
         self._fast = _native.fastdss()
-        try:
-            os.unlink(self._path())   # stale segment from a crashed job
-        except OSError:
-            pass
+        # nonce names never collide with a crashed job's, so stale
+        # segments need active GC: sweep siblings of this path older
+        # than 10 min (their jobs are gone; live opens are short-lived)
+        import glob
+
+        for old in glob.glob(os.path.join("/dev/shm",
+                                          self._base + "-*")):
+            try:
+                if time.time() - os.path.getmtime(old) > 600:
+                    os.unlink(old)
+            except OSError:
+                pass
         # initialize BEFORE publishing: an attacher must never observe
         # the counter without its initial value
         self._seg = shmseg.create(self._name, 8, dir="/dev/shm",
@@ -220,19 +240,15 @@ class _SmSharedFp:
         self._seg.publish()
 
     def attach(self) -> None:
+        # no retry needed: the create outcome was broadcast before any
+        # attacher runs, so the published segment already exists — and
+        # retrying would stretch permanent errors (EACCES, corrupt
+        # segment) into long stalls
         from ompi_tpu import _native
         from ompi_tpu.core import shmseg
 
         self._fast = _native.fastdss()
-        deadline = time.monotonic() + 10
-        while True:
-            try:
-                self._seg = shmseg.attach(self._path())
-                return
-            except OSError:
-                if time.monotonic() > deadline:
-                    raise
-                time.sleep(0.01)
+        self._seg = shmseg.attach(self._path())
 
     def load(self) -> int:
         return int(self._fast.atomic_load(self._seg.buf, 0))
@@ -429,7 +445,19 @@ class File:
         # shared-pointer ops are actually used, so plain reads of
         # immutable files work.
         self._shfp_err = ""
-        self._shfp = self._select_sharedfp()
+        try:
+            self._shfp = self._select_sharedfp()
+        except MPIException:
+            os.close(self._fd)   # the raise is uniform across ranks
+            self._fd = None      # (collectively agreed) — don't leak fd
+            raise
+        if self._shfp.name == "sm":
+            # per-open nonce, rank 0's choice broadcast: concurrent
+            # opens of one path must not collide on the segment name
+            nonce = int(np.asarray(comm.bcast(np.array(
+                [os.getpid() << 16 | (next(_shfp_nonce) & 0xFFFF)],
+                np.int64), root=0))[0])
+            self._shfp.set_nonce(nonce)
         initial = int(self._pos if amode & MODE_APPEND else 0)
         if comm.rank == 0:
             try:

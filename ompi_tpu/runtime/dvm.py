@@ -66,6 +66,10 @@ class DvmHnp(MultiHostLauncher):
         # on per-daemon RML reader threads and would otherwise interleave
         # partial lines with each other and with the final exit reply
         self._sink_lock = threading.Lock()
+        self._stats: dict[int, list] = {}     # vpid → latest stat rows
+        self._stats_cv = threading.Condition()
+        self._stats_epoch = 0                 # fences late replies
+        self._stats_lock = threading.Lock()   # one collection at a time
         self.vm_job: Optional[Job] = None
         self._history: list[dict] = []        # completed-job records
 
@@ -83,6 +87,7 @@ class DvmHnp(MultiHostLauncher):
         if not self._vm_up(vm):
             raise RuntimeError(
                 f"DVM bring-up failed: {vm.abort_reason}")
+        self.rml.register_recv(rml.TAG_STATS_REPLY, self._on_stats_reply)
         self._ctrl = socket.create_server(("127.0.0.1", 0))
         port = self._ctrl.getsockname()[1]
         with open(self.uri_path, "w", encoding="utf-8") as f:
@@ -220,7 +225,44 @@ class DvmHnp(MultiHostLauncher):
         except (OSError, ValueError):
             self._client_sink = None          # client went away; drop
 
-    # -- introspection (≈ orte-ps) -----------------------------------------
+    # -- introspection (≈ orte-ps / orte-top) ------------------------------
+
+    def _on_stats_reply(self, origin: int, payload) -> None:
+        vpid, epoch, rows = payload
+        with self._stats_cv:
+            if epoch != self._stats_epoch:
+                return                # late reply from an earlier round
+            self._stats[vpid] = [tuple(r) for r in rows]
+            self._stats_cv.notify_all()
+
+    def _collect_stats(self, timeout: float = 1.0) -> dict[int, tuple]:
+        """Pull live per-rank resource usage from every daemon
+        (≈ orte-top's resusage sample): xcast the request, wait briefly
+        for the tree to reply; late/dead daemons just contribute
+        nothing.  Serialized + epoch-fenced: concurrent ps clients must
+        not clear each other's reply set, and a straggler reply from a
+        timed-out round must not pass as fresh."""
+        with self._stats_lock:
+            n = len(self.vm_job.nodes) if self.vm_job else 0
+            with self._stats_cv:
+                self._stats.clear()
+                self._stats_epoch += 1
+                epoch = self._stats_epoch
+            try:
+                self.rml.xcast(rml.TAG_STATS, epoch)
+            except Exception:  # noqa: BLE001 — tree tearing down
+                return {}
+            deadline = time.monotonic() + timeout
+            with self._stats_cv:
+                self._stats_cv.wait_for(
+                    lambda: len(self._stats) >= n,
+                    timeout=max(0.0, deadline - time.monotonic()))
+                merged: dict[int, tuple] = {}
+                for rows in self._stats.values():
+                    for rank, pid, rss, cpu_s in rows:
+                        merged[int(rank)] = (int(pid), int(rss),
+                                             float(cpu_s))
+            return merged
 
     def _ps_table(self) -> dict:
         vm = self.vm_job
@@ -232,14 +274,21 @@ class DvmHnp(MultiHostLauncher):
                  for i, n in enumerate(vm.nodes)] if vm else []
         procs = []
         if job is not None and job is not vm:
+            usage = self._collect_stats() if any(
+                p.state == ProcState.RUNNING for p in job.procs) else {}
             for p in job.procs:
-                procs.append({
+                row = {
                     "rank": p.rank, "state": p.state.value,
                     "host": p.node.name if p.node else "?",
                     "local_rank": p.local_rank,
                     "restarts": p.restarts,
                     "exit_code": p.exit_code,
-                })
+                }
+                if p.rank in usage:      # orte-top columns, live ranks
+                    pid, rss, cpu_s = usage[p.rank]
+                    row.update(pid=pid, rss_mb=round(rss / 2**20, 1),
+                               cpu_s=round(cpu_s, 2))
+                procs.append(row)
         return {"daemons": nodes,
                 "current_job": (None if job is None or job is vm else {
                     "jobid": job.jobid,

@@ -95,6 +95,7 @@ class Orted:
         self.node.register_recv(rml.TAG_KILL, self._on_kill)
         self.node.register_recv(rml.TAG_STDIN, self._on_stdin)
         self.node.register_recv(rml.TAG_RESPAWN, self._on_respawn)
+        self.node.register_recv(rml.TAG_STATS, self._on_stats)
         self._spec: Optional[dict] = None
         self._my_rows: dict[int, tuple[int, Optional[int]]] = {}
         self.node.register_recv(rml.TAG_SHUTDOWN,
@@ -278,6 +279,35 @@ class Orted:
         threading.Thread(
             target=self._spawn_rank, args=(spec, rank, local_rank, chip),
             kwargs={"restarts": restarts}, daemon=True).start()
+
+    def _on_stats(self, origin: int, payload) -> None:
+        """≈ the sensor/resusage sampling orte-top pulls: per-rank
+        rss + cpu time from /proc for my live ranks, replied up the
+        tree (runs on the RML reader thread — /proc reads don't block)."""
+        page = os.sysconf("SC_PAGE_SIZE")
+        tick = os.sysconf("SC_CLK_TCK")
+        rows = []
+        with self._lock:
+            procs = list(self._popen.items())
+        for rank, p in procs:
+            if p.poll() is not None:
+                continue
+            try:
+                with open(f"/proc/{p.pid}/statm") as f:
+                    rss = int(f.read().split()[1]) * page
+                with open(f"/proc/{p.pid}/stat") as f:
+                    parts = f.read().rsplit(")", 1)[1].split()
+                    cpu_s = (int(parts[11]) + int(parts[12])) / tick
+            except (OSError, IndexError, ValueError):
+                continue
+            rows.append((rank, p.pid, rss, cpu_s))
+        try:
+            # payload is the requester's epoch — echoed so a late reply
+            # from an earlier round cannot satisfy a newer collection
+            self.node.send_up(rml.TAG_STATS_REPLY,
+                              (self.vpid, payload, rows))
+        except ConnectionError:
+            pass
 
     def _on_stdin(self, origin: int, payload) -> None:
         # Runs on the RML link reader thread: never write the pipe here —

@@ -52,6 +52,8 @@ TAG_STDIN = "stdin"             # xcast: (target_rank, chunk | None=EOF)
 TAG_PROC_EXIT = "proc_exit"     # up: (rank, exit_code)
 TAG_DAEMON_READY = "ready"      # up: daemon wired + children connected
 TAG_RESPAWN = "respawn"         # xcast: (rank, restarts) — owner revives
+TAG_STATS = "stats"             # xcast: request per-rank resource usage
+TAG_STATS_REPLY = "stats_reply"  # up: (vpid, [(rank, pid, rss, cpu_s)...])
 
 
 def tree_parent(vpid: int) -> Optional[int]:

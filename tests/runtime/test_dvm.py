@@ -135,6 +135,11 @@ def test_dvm_ps_live_job(dvm):
         assert live is not None, "never observed a running job via ps"
         assert live["np"] == 2
         assert {p["host"] for p in live["procs"]} <= {"sim000", "sim001"}
+        # orte-top columns: running ranks report live resource usage
+        running = [p for p in live["procs"] if p["state"] == "running"]
+        with_usage = [p for p in running if "rss_mb" in p]
+        assert with_usage, live
+        assert all(p["rss_mb"] > 0 and p["pid"] > 0 for p in with_usage)
     finally:
         slow.wait(timeout=60)
 
