@@ -27,7 +27,8 @@ import numpy as np
 
 from ompi_tpu.mpi import datatype as dt_mod
 from ompi_tpu.mpi.constants import (
-    ANY_SOURCE, ANY_TAG, PROC_NULL, UNDEFINED, MPIException,
+    ANY_SOURCE, ANY_TAG, COMM_TYPE_SHARED, PROC_NULL, UNDEFINED,
+    MPIException,
 )
 from ompi_tpu.mpi.datatype import Datatype
 from ompi_tpu.mpi.group import Group
@@ -52,6 +53,7 @@ class Communicator:
         self.name = name
         self.rank = group.rank_of(my_world_rank)
         self._cid_counter = itertools.count(cid * 1024 + 1)
+        self._cg_seq: dict = {}   # create_group per-key call sequence
         self._lock = threading.Lock()
         self.coll = None  # installed by ompi_tpu.mpi.coll.install()
         self.device = None  # bound DeviceCommunicator (coll/xla path)
@@ -573,6 +575,66 @@ class Communicator:
             return None
         return Communicator(group, cid, self.pml, self._world_rank,
                             name or f"{self.name}.sub")
+
+    def create_group(self, group: Group, tag: int = 0,
+                     name: Optional[str] = None
+                     ) -> Optional["Communicator"]:
+        """≈ MPI_Comm_create_group (comm_create_group.c): collective
+        ONLY over the members of ``group`` — non-members do not
+        participate at all (the API exists for exactly that: forming a
+        recovery/sub communicator without a dead or busy peer).
+
+        The cid therefore cannot come from the parent's shared counter
+        (non-members would desync).  It is derived deterministically
+        from (parent cid, member world ranks, tag, call sequence):
+        every member computes the same value with zero traffic.  The
+        per-key call sequence keeps REPEATED identical calls on
+        distinct contexts (the call is collective over the group, so
+        every member's counter advances in lockstep), and the value
+        lands in the NEGATIVE cid namespace, which the positive
+        counter-derived cids can never reach; two different hash cids
+        collide with probability ~2^-31 per pair (the reference instead
+        runs an agreement protocol over the group — the deterministic
+        design trades that traffic for the hash)."""
+        if group.rank_of(self._world_rank) == UNDEFINED:
+            return None
+        import zlib
+
+        key = (self.cid, group.ranks, int(tag))
+        seq = self._cg_seq.get(key, 0) + 1
+        self._cg_seq[key] = seq
+        desc = f"{self.cid}:{','.join(map(str, group.ranks))}:{tag}:{seq}"
+        cid = -(1 + (zlib.crc32(desc.encode()) & 0x7FFFFFFF))
+        return Communicator(group, cid, self.pml, self._world_rank,
+                            name or f"{self.name}.grp")
+
+    def _my_host_key(self) -> int:
+        """Shared-memory-domain identity (the single source the shm BTL,
+        the IO aggregators, and split_type all group by); tests may
+        override per-comm via ``comm._io_host_override`` (threads share
+        os.environ, so the env var cannot vary per in-process rank)."""
+        import os
+        import zlib
+
+        name = getattr(self, "_io_host_override", None) \
+            or os.environ.get("OMPI_TPU_FAKE_HOST") or os.uname().nodename
+        return zlib.crc32(str(name).encode()) & 0x7FFFFFFF
+
+    def split_type(self, split_type: int = COMM_TYPE_SHARED, key: int = 0,
+                   name: Optional[str] = None) -> Optional["Communicator"]:
+        """≈ MPI_Comm_split_type(COMM_TYPE_SHARED): one communicator per
+        shared-memory domain (host) — the standard prelude to
+        MPI_Win_allocate_shared / on-node hierarchies.  UNDEFINED
+        returns None, like split."""
+        if split_type == UNDEFINED:
+            # still collective: peers' allgather inside split needs us
+            return self.split(UNDEFINED, key, name)
+        if split_type != COMM_TYPE_SHARED:
+            raise MPIException(
+                f"unknown split_type {split_type} (COMM_TYPE_SHARED)",
+                error_class=3)
+        return self.split(self._my_host_key(), key,
+                          name or f"{self.name}.shared")
 
     def split(self, color: int, key: int = 0,
               name: Optional[str] = None) -> Optional["Communicator"]:

@@ -8,6 +8,9 @@ PROC_NULL = -3   # MPI_PROC_NULL: send/recv to nowhere completes immediately
 ROOT = -4        # MPI_ROOT (intercomm collectives)
 UNDEFINED = -32766  # MPI_UNDEFINED (e.g. split color, no-group rank)
 
+# MPI_Comm_split_type types
+COMM_TYPE_SHARED = 1   # ranks that share a memory domain (same host)
+
 
 class _InPlace:
     """Singleton marker for MPI_IN_PLACE."""

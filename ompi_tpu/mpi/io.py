@@ -737,16 +737,11 @@ class File:
     # the same collective data).
 
     def _my_host_key(self) -> int:
-        """Stable host identity for aggregator grouping — the same
-        identity the shm BTL groups by (OMPI_TPU_FAKE_HOST under the sim
-        plm, the real nodename otherwise).  Tests may override per-comm
-        via ``comm._io_host_override`` (os.environ is process-wide, so
-        threads-as-ranks cannot vary the env var)."""
-        import zlib
-
-        name = getattr(self.comm, "_io_host_override", None) \
-            or os.environ.get("OMPI_TPU_FAKE_HOST") or os.uname().nodename
-        return zlib.crc32(str(name).encode()) & 0x7FFFFFFF
+        """Stable host identity for aggregator grouping — THE single
+        source (Communicator._my_host_key: shm BTL / split_type / IO all
+        group by the same identity; tests override per-comm via
+        ``comm._io_host_override``)."""
+        return self.comm._my_host_key()
 
     def _aggregators(self) -> list[int]:
         """Aggregator ranks: the lowest ``io_cb_aggregators_per_host``

@@ -97,3 +97,49 @@ def test_window_no_locks_hint():
         return None
 
     run_ranks(2, body)
+
+
+def test_split_type_shared():
+    """MPI_Comm_split_type(COMM_TYPE_SHARED): one comm per host."""
+    from ompi_tpu.mpi.constants import COMM_TYPE_SHARED
+
+    hosts = ["hostA", "hostA", "hostB", "hostB"]
+
+    def body(comm):
+        comm._io_host_override = hosts[comm.rank]
+        node = comm.split_type(COMM_TYPE_SHARED)
+        assert node.size == 2
+        peers = node.allgather(np.array([comm.rank], np.int64))
+        got = sorted(int(x) for x in np.asarray(peers).ravel())
+        expect = [0, 1] if comm.rank < 2 else [2, 3]
+        assert got == expect, (comm.rank, got)
+        return None
+
+    run_ranks(4, body)
+
+
+def test_comm_create_group_excludes_nonmembers():
+    """MPI_Comm_create_group: only members participate — non-members do
+    NOT call it at all, and the members' comm still works."""
+    from ompi_tpu.mpi.group import Group
+
+    def body(comm):
+        if comm.rank == 3:
+            return None              # non-member: no call, no collective
+        sub = comm.create_group(Group([0, 1, 2]), tag=9)
+        assert sub is not None and sub.size == 3
+        v = sub.allreduce(np.array([comm.rank], np.int64))
+        assert int(np.asarray(v)[0]) == 0 + 1 + 2
+        # the derived cid lives in the negative namespace the positive
+        # counter scheme can never reach, and members agree on it
+        assert sub.cid < 0
+        cids = sub.allgather(np.array([sub.cid], np.int64))
+        assert len(set(int(c) for c in np.asarray(cids).ravel())) == 1
+        # a REPEATED identical call yields a distinct context
+        sub2 = comm.create_group(Group([0, 1, 2]), tag=9)
+        assert sub2.cid != sub.cid and sub2.cid < 0
+        v2 = sub2.allreduce(np.array([1], np.int64))
+        assert int(np.asarray(v2)[0]) == 3
+        return None
+
+    run_ranks(4, body)
