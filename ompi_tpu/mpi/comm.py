@@ -601,8 +601,9 @@ class Communicator:
         import zlib
 
         key = (self.cid, group.ranks, int(tag))
-        seq = self._cg_seq.get(key, 0) + 1
-        self._cg_seq[key] = seq
+        with self._lock:   # THREAD_MULTIPLE: concurrent same-key calls
+            seq = self._cg_seq.get(key, 0) + 1
+            self._cg_seq[key] = seq
         desc = f"{self.cid}:{','.join(map(str, group.ranks))}:{tag}:{seq}"
         cid = -(1 + (zlib.crc32(desc.encode()) & 0x7FFFFFFF))
         return Communicator(group, cid, self.pml, self._world_rank,

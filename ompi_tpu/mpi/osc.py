@@ -23,6 +23,7 @@ TPU mapping).
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 from typing import Any, Optional
 
@@ -33,9 +34,11 @@ from ompi_tpu.mpi import op as op_mod
 from ompi_tpu.mpi.constants import ANY_SOURCE, MPIException
 from ompi_tpu.mpi.request import Request
 
-__all__ = ["Window", "DeviceWindow"]
+__all__ = ["Window", "DeviceWindow", "SharedWindow"]
 
 _log = output.get_stream("osc")
+
+_shwin_nonce = itertools.count(1)  # SharedWindow segment disambiguation
 
 # Reserved tags on the window's private comm, in a range disjoint from the
 # collective tags (coll/base.py TAG_* 1..10) — the service thread's
@@ -854,3 +857,100 @@ class DeviceWindow:
 
     def free(self) -> None:
         self.array = None
+
+
+class SharedWindow:
+    """≈ MPI_Win_allocate_shared + the osc/sm component: every rank of a
+    shared-memory-domain communicator (MPI_Comm_split_type(
+    COMM_TYPE_SHARED) — enforced) owns a contiguous slice of ONE shared
+    segment, and any rank may load/store any slice directly — no
+    messages, the memory IS the window (osc_sm_component.c's model).
+
+    ``shared_query(rank)`` returns a numpy view of that rank's slice
+    (zero-copy into the mapping).  ``sync()`` is the WIN_SYNC memory
+    barrier + a communicator barrier; direct stores are visible to peers
+    after it (x86 TSO + the mmap being literally the same pages).
+    ``fetch_add(rank, offset8, delta)`` exposes the native u64 atomics
+    on any aligned slot, the lock-free counter pattern osc/sm serves.
+    """
+
+    def __init__(self, comm, local_size: int, dtype=np.uint8,
+                 name: str = "shwin") -> None:
+        self.comm = comm
+        self.name = name
+        self.dtype = np.dtype(dtype)
+        keys = np.asarray(comm.allgather(np.array(
+            [comm._my_host_key()], np.int64))).ravel()
+        if len(set(int(k) for k in keys)) != 1:
+            raise MPIException(
+                "SharedWindow requires a single-host communicator "
+                "(split_type(COMM_TYPE_SHARED) first)", error_class=3)
+        # per-rank slices padded to 8 bytes so every slice start is a
+        # valid atomic slot (fetch_add's alignment contract)
+        nbytes = (int(local_size) * self.dtype.itemsize + 7) & ~7
+        sizes = np.asarray(comm.allgather(np.array(
+            [nbytes], np.int64))).ravel()
+        self._local_bytes = int(local_size) * self.dtype.itemsize
+        self._offsets = np.concatenate([[0], np.cumsum(sizes)])
+        total = int(self._offsets[-1])
+        # rank 0 creates (nonce'd name — concurrent windows must not
+        # collide), everyone attaches; same discipline as sharedfp/sm.
+        # backing_dir() falls back when /dev/shm is absent — it resolves
+        # identically in every same-host process.
+        from ompi_tpu.core import shmseg
+
+        base_dir = shmseg.backing_dir()
+        safe = "".join(c for c in name if c.isalnum())[:16] or "shwin"
+        if comm.rank == 0:
+            nonce = os.getpid() << 16 | (next(_shwin_nonce) & 0xFFFF)
+            seg_name = f"otpu-shwin-{safe}-{os.getuid()}-{nonce:x}"
+            self._seg = shmseg.create(seg_name, max(total, 8),
+                                      dir=base_dir, publish=False)
+            np.frombuffer(self._seg.buf, np.uint8)[:] = 0
+            self._seg.publish()
+            comm.bcast(np.frombuffer(
+                seg_name.encode().ljust(96), np.uint8).copy(), root=0)
+        else:
+            raw = np.asarray(comm.bcast(np.zeros(96, np.uint8), root=0))
+            seg_name = bytes(raw).rstrip(b"\x00").rstrip().decode()
+            self._seg = shmseg.attach(os.path.join(base_dir, seg_name))
+        comm.barrier()
+
+    def shared_query(self, rank: int) -> np.ndarray:
+        """Zero-copy view of ``rank``'s slice (≈ MPI_Win_shared_query) —
+        the REQUESTED extent (padding bytes are not exposed)."""
+        lo = int(self._offsets[rank])
+        return np.frombuffer(self._seg.buf, np.uint8,
+                             count=self._local_bytes,
+                             offset=lo).view(self.dtype)
+
+    @property
+    def local(self) -> np.ndarray:
+        return self.shared_query(self.comm.rank)
+
+    def sync(self) -> None:
+        """≈ MPI_Win_sync + barrier: order my stores before peers read."""
+        self.comm.barrier()
+
+    def fetch_add(self, rank: int, offset8: int, delta: int) -> int:
+        """Native u64 atomic fetch-add on an 8-byte-aligned slot of
+        ``rank``'s slice (lock-free cross-process counters)."""
+        from ompi_tpu import _native
+
+        fast = _native.fastdss()
+        if fast is None:
+            raise MPIException("native atomics unavailable",
+                               error_class=16)
+        return int(fast.atomic_add(
+            self._seg.buf, int(self._offsets[rank]) + int(offset8) * 8, 
+            int(delta)))
+
+    def free(self) -> None:
+        self.comm.barrier()
+        if self.comm.rank == 0:
+            self._seg.unlink()
+        try:
+            self._seg.detach()
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
+

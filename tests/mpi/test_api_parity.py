@@ -143,3 +143,37 @@ def test_comm_create_group_excludes_nonmembers():
         return None
 
     run_ranks(4, body)
+
+
+def test_win_allocate_shared():
+    """MPI_Win_allocate_shared (osc/sm): direct load/store into peers'
+    slices of one shared segment + native atomic counters."""
+    from ompi_tpu import _native
+    from ompi_tpu.mpi.constants import COMM_TYPE_SHARED
+    from ompi_tpu.mpi.osc import SharedWindow
+
+    def body(comm):
+        node = comm.split_type(COMM_TYPE_SHARED)
+        win = SharedWindow(node, local_size=16, dtype=np.int32)
+        win.local[:] = node.rank + 1         # direct store to my slice
+        win.sync()
+        # direct load from every peer's slice — no messages
+        for r in range(node.size):
+            view = win.shared_query(r)
+            assert view.shape == (16,)
+            assert (view == r + 1).all(), (node.rank, r, view[:4])
+        if _native.fastdss() is not None:
+            # lock-free cross-rank counter on rank 0's first slot
+            win.sync()
+            if node.rank == 0:
+                win.local[:] = 0
+            win.sync()
+            win.fetch_add(0, 0, 1)           # every rank increments
+            win.sync()
+            cnt = int(np.frombuffer(win.shared_query(0).tobytes(),
+                                    np.int64)[0])
+            assert cnt == node.size, cnt
+        win.free()
+        return None
+
+    run_ranks(4, body)
