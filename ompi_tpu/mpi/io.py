@@ -130,10 +130,11 @@ _shfp_nonce = _it.count(1)   # per-process component of the sm open nonce
 # -- sharedfp strategies (≈ ompi/mca/sharedfp components) -----------------
 
 register_var("io", "sharedfp", VarType.STRING, "",
-             "shared-file-pointer component: lockedfile | sm (empty = "
-             "auto: sm when every rank shares the host and the native "
-             "atomics built, else lockedfile — the reference's "
-             "sharedfp/sm vs sharedfp/lockedfile split)")
+             "shared-file-pointer component: lockedfile | sm | individual "
+             "(empty = auto: sm when every rank shares the host and the "
+             "native atomics built, else lockedfile — the reference's "
+             "sharedfp component split; individual is opt-in only, it "
+             "relaxes the shared-pointer semantics)")
 
 
 class _LockedFileSharedFp:
@@ -274,6 +275,74 @@ class _SmSharedFp:
             except Exception:  # noqa: BLE001 — teardown best-effort
                 pass
             self._seg = None
+
+
+class _IndividualSharedFp:
+    """sharedfp/individual: the reference's third strategy
+    (ompi/mca/sharedfp/individual) — RELAXED shared-pointer semantics.
+    Each rank spools its ``write_shared`` payloads to a local temp file
+    with a timestamp per record; the global interleaving is reconstructed
+    collectively at sync/close (and before any ordered op) by merging
+    every rank's records in timestamp order.  Zero inter-process
+    coordination per write — the fastest strategy when the program only
+    ever *writes* through the shared pointer and can live with the order
+    materializing at sync points.  ``read_shared``/``seek_shared`` are
+    erroneous, exactly as in the reference (it implements only the write
+    side).  Opt-in only (``--mca io sharedfp individual``): auto-selection
+    must never silently weaken MPI semantics."""
+
+    name = "individual"
+    local_log = True      # File routes write_shared through log_write()
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._spool = None              # local payload spool (tempfile)
+        self._recs: list[tuple[int, int]] = []   # (t_ns, nbytes)
+        self.merged_end = 0             # etype units; agreed at each merge
+        # record append + spool write must be ONE step: under
+        # THREAD_MULTIPLE two interleaved write_shared calls would
+        # otherwise desync _recs order from spool byte order and the
+        # merge would write the wrong bytes at each record's offset
+        self._lock = threading.Lock()
+
+    def create(self, initial: int) -> None:
+        """LOCAL setup — every rank runs this (there is no shared state
+        to rendezvous on; that is the point of the strategy)."""
+        import tempfile
+
+        self._spool = tempfile.TemporaryFile(prefix="otpu-shfp-ind-")
+        self.merged_end = int(initial)
+
+    def attach(self) -> None:
+        pass   # nothing shared to attach to
+
+    def log_write(self, raw: bytes) -> None:
+        with self._lock:
+            self._recs.append((time.time_ns(), len(raw)))
+            self._spool.write(raw)
+
+    def _unsupported(self) -> MPIException:
+        return MPIException(
+            "sharedfp/individual supports only write_shared and the "
+            "ordered collectives; shared-pointer reads/seeks need the "
+            "sm or lockedfile component", error_class=38)
+
+    def load(self) -> int:
+        raise self._unsupported()
+
+    def store(self, val: int) -> None:
+        raise self._unsupported()
+
+    def fetch_add(self, n: int) -> int:
+        raise self._unsupported()
+
+    def close(self, root: bool) -> None:
+        if self._spool is not None:
+            try:
+                self._spool.close()
+            except OSError:
+                pass
+            self._spool = None
 
 
 class FileView:
@@ -451,34 +520,44 @@ class File:
             os.close(self._fd)   # the raise is uniform across ranks
             self._fd = None      # (collectively agreed) — don't leak fd
             raise
-        if self._shfp.name == "sm":
-            # per-open nonce, rank 0's choice broadcast: concurrent
-            # opens of one path must not collide on the segment name
-            nonce = int(np.asarray(comm.bcast(np.array(
-                [os.getpid() << 16 | (next(_shfp_nonce) & 0xFFFF)],
-                np.int64), root=0))[0])
-            self._shfp.set_nonce(nonce)
         initial = int(self._pos if amode & MODE_APPEND else 0)
-        if comm.rank == 0:
+        if getattr(self._shfp, "local_log", False):
+            # sharedfp/individual: per-rank local spool, nothing shared —
+            # every rank creates its own (initial is identical: same
+            # fstat of the same file); agreement happens below
             try:
                 self._shfp.create(initial)
             except OSError as e:
                 self._shfp_err = str(e)
-        # every rank must agree whether the pointer exists (shared ops
-        # are collective-adjacent): broadcast the create outcome, attach,
-        # then agree on the attach outcomes too — a single rank with a
-        # broken pointer would otherwise raise mid-collective while its
-        # peers block in the matching barrier
-        flag = comm.bcast(np.array(
-            [1 if not self._shfp_err else 0], np.int8), root=0)
-        if not int(np.asarray(flag)[0]):
-            if comm.rank != 0:
-                self._shfp_err = "shared-pointer creation failed on rank 0"
-        elif comm.rank != 0:
-            try:
-                self._shfp.attach()
-            except OSError as e:
-                self._shfp_err = str(e)
+        else:
+            if self._shfp.name == "sm":
+                # per-open nonce, rank 0's choice broadcast: concurrent
+                # opens of one path must not collide on the segment name
+                nonce = int(np.asarray(comm.bcast(np.array(
+                    [os.getpid() << 16 | (next(_shfp_nonce) & 0xFFFF)],
+                    np.int64), root=0))[0])
+                self._shfp.set_nonce(nonce)
+            if comm.rank == 0:
+                try:
+                    self._shfp.create(initial)
+                except OSError as e:
+                    self._shfp_err = str(e)
+            # every rank must agree whether the pointer exists (shared ops
+            # are collective-adjacent): broadcast the create outcome,
+            # attach, then agree on the attach outcomes too — a single
+            # rank with a broken pointer would otherwise raise
+            # mid-collective while its peers block in the matching barrier
+            flag = comm.bcast(np.array(
+                [1 if not self._shfp_err else 0], np.int8), root=0)
+            if not int(np.asarray(flag)[0]):
+                if comm.rank != 0:
+                    self._shfp_err = \
+                        "shared-pointer creation failed on rank 0"
+            elif comm.rank != 0:
+                try:
+                    self._shfp.attach()
+                except OSError as e:
+                    self._shfp_err = str(e)
         from ompi_tpu.mpi import op as op_mod
 
         ok_everywhere = int(np.asarray(comm.allreduce(np.array(
@@ -496,15 +575,17 @@ class File:
         usable sm must fail uniformly, not strand peers in the open's
         bcast."""
         forced = var_registry.get("io_sharedfp") or ""
-        if forced and forced not in ("sm", "lockedfile"):
+        if forced and forced not in ("sm", "lockedfile", "individual"):
             raise MPIException(
                 f"unknown sharedfp component {forced!r} "
-                f"(lockedfile/sm)", error_class=3)
+                f"(lockedfile/sm/individual)", error_class=3)
         keys = np.asarray(self.comm.allgather(np.array(
             [self._my_host_key(), 1 if _SmSharedFp.usable() else 0],
             np.int64))).reshape(-1, 2)
         sm_ok = (len(set(int(k) for k in keys[:, 0])) == 1
                  and int(keys[:, 1].min()) == 1)
+        if forced == "individual":
+            return _IndividualSharedFp(self.path)
         if forced == "sm":
             if not sm_ok:
                 raise MPIException(
@@ -592,8 +673,12 @@ class File:
         return os.fstat(self._fd).st_size
 
     def sync(self) -> None:
-        """≈ MPI_File_sync."""
+        """≈ MPI_File_sync.  With sharedfp/individual this is where the
+        spooled shared-pointer writes land (collective merge) — callers
+        of the individual component must treat sync as collective, which
+        MPI requires of MPI_File_sync anyway."""
         self._check_open()
+        self._shfp_merge()
         os.fsync(self._fd)
 
     def set_atomicity(self, flag: bool) -> None:
@@ -608,11 +693,14 @@ class File:
                  filetype: Optional[Datatype] = None) -> None:
         """≈ MPI_File_set_view — collective; resets both file pointers."""
         self._check_open()
+        self._shfp_merge()       # pending individual writes use the OLD view
         self.view = FileView(disp, etype, filetype)
         self._pos = 0
-        if not self._shfp_err:   # pointer unavailable (read-only mount):
-            self._shfp_store(0)  # the reset is moot — only shared ops
-        self.comm.barrier()      # would need it, and they raise anyway
+        if getattr(self._shfp, "local_log", False):
+            self._shfp.merged_end = 0
+        elif not self._shfp_err:  # pointer unavailable (read-only mount):
+            self._shfp_store(0)   # the reset is moot — only shared ops
+        self.comm.barrier()       # would need it, and they raise anyway
 
     def get_view(self) -> tuple[int, Datatype, Datatype]:
         return self.view.disp, self.view.etype, self.view.filetype
@@ -1043,6 +1131,46 @@ class File:
         self._shfp_guard()
         return self._shfp.fetch_add(n)
 
+    def _shfp_merge(self) -> None:
+        """COLLECTIVE: the 'collaborate' step of sharedfp/individual —
+        reconstruct the global shared-pointer order of the individually
+        spooled writes (timestamp order, rank breaking ties) and land
+        them in the file.  Runs at sync/close, before ordered ops, and
+        before a view change (pending writes belong to the OLD view).
+        No-op for the coordinated components."""
+        sh = self._shfp
+        if not getattr(sh, "local_log", False) or self._shfp_err:
+            return
+        recs = sh._recs
+        mine = (np.array(recs, np.int64) if recs
+                else np.zeros((0, 2), np.int64))
+        allrecs = self.comm.allgatherv(mine)
+        entries = []   # (t_ns, rank, local_idx, nbytes)
+        for r, arr in enumerate(allrecs):
+            a = np.asarray(arr).reshape(-1, 2)
+            for i in range(a.shape[0]):
+                entries.append((int(a[i, 0]), r, i, int(a[i, 1])))
+        if not entries:
+            return
+        entries.sort()
+        es = self.view.etype.size
+        pos = sh.merged_end
+        my_offsets = {}
+        for _t, r, i, nb in entries:
+            if r == self.comm.rank:
+                my_offsets[i] = pos
+            pos += nb // es
+        if recs:
+            sh._spool.seek(0)
+            for i, (_t, nb) in enumerate(recs):
+                raw = sh._spool.read(nb)
+                self._write_raw_at(my_offsets[i], raw)
+            sh._spool.seek(0)
+            sh._spool.truncate()
+            sh._recs = []
+        sh.merged_end = pos
+        self.comm.barrier()
+
     def read_shared(self, count: int) -> np.ndarray:
         """≈ MPI_File_read_shared."""
         self._check_read()  # before reserving: a failed call must not
@@ -1054,6 +1182,10 @@ class File:
         self._check_write()
         raw = self._as_bytes(data)
         n = len(raw) // self.view.etype.size
+        if getattr(self._shfp, "local_log", False):
+            self._shfp_guard()
+            self._shfp.log_write(raw)   # local spool; lands at the merge
+            return n
         start = self._shfp_fetch_add(n)
         self._write_raw_at(start, raw)
         return n
@@ -1061,6 +1193,11 @@ class File:
     def seek_shared(self, offset: int, whence: int = SEEK_SET) -> None:
         """≈ MPI_File_seek_shared — collective (all must give same args)."""
         self._check_open()
+        if getattr(self._shfp, "local_log", False):
+            # raise UNIFORMLY before any collective step: with
+            # sharedfp/individual a rank-0-only raise inside the body
+            # would strand the other ranks in the closing barrier
+            raise self._shfp._unsupported()
         if whence == SEEK_CUR:
             offset += self._shfp_load()
         elif whence == SEEK_END:
@@ -1077,17 +1214,30 @@ class File:
 
     # ordered mode: rank-ordered slots computed with an exscan of sizes
 
+    def _ordered_base(self) -> tuple[int, bool]:
+        """Start position for an ordered op: the coordinated components
+        read the live pointer; sharedfp/individual first lands its
+        pending spooled writes (the op is collective, so the merge is
+        safe here) and uses the agreed merged end."""
+        if getattr(self._shfp, "local_log", False):
+            self._shfp_merge()
+            self._shfp_guard()
+            return self._shfp.merged_end, True
+        return self._shfp_load(), False
+
     def write_ordered(self, data: Any) -> int:
         """≈ MPI_File_write_ordered — collective, rank order in file."""
         self._check_write()
         raw = self._as_bytes(data)
         n = len(raw) // self.view.etype.size
         sizes = np.asarray(self.comm.allgather(np.array([n], np.int64)))
-        base = self._shfp_load()
+        base, individual = self._ordered_base()
         my_off = base + int(sizes[:self.comm.rank].sum())
         self._write_raw_at(my_off, raw)
         self.comm.barrier()
-        if self.comm.rank == 0:
+        if individual:
+            self._shfp.merged_end = base + int(sizes.sum())
+        elif self.comm.rank == 0:
             self._shfp_store(base + int(sizes.sum()))
         self.comm.barrier()
         return n
@@ -1096,11 +1246,13 @@ class File:
         """≈ MPI_File_read_ordered."""
         self._check_read()
         sizes = np.asarray(self.comm.allgather(np.array([count], np.int64)))
-        base = self._shfp_load()
+        base, individual = self._ordered_base()
         my_off = base + int(sizes[:self.comm.rank].sum())
         out = self.read_at(my_off, count)
         self.comm.barrier()
-        if self.comm.rank == 0:
+        if individual:
+            self._shfp.merged_end = base + int(sizes.sum())
+        elif self.comm.rank == 0:
             self._shfp_store(base + int(sizes.sum()))
         self.comm.barrier()
         return out

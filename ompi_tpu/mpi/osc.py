@@ -888,9 +888,15 @@ class SharedWindow:
         # per-rank slices padded to 8 bytes so every slice start is a
         # valid atomic slot (fetch_add's alignment contract)
         nbytes = (int(local_size) * self.dtype.itemsize + 7) & ~7
-        sizes = np.asarray(comm.allgather(np.array(
-            [nbytes], np.int64))).ravel()
         self._local_bytes = int(local_size) * self.dtype.itemsize
+        # padded slice sizes AND unpadded extents: shared_query(rank) must
+        # report rank's OWN requested extent (heterogeneous local_size —
+        # e.g. rank 0 owns the whole node buffer, everyone else passes 0 —
+        # is the core MPI_Win_allocate_shared use case)
+        both = np.asarray(comm.allgather(np.array(
+            [nbytes, self._local_bytes], np.int64))).reshape(-1, 2)
+        sizes = both[:, 0]
+        self._extents = both[:, 1]
         self._offsets = np.concatenate([[0], np.cumsum(sizes)])
         total = int(self._offsets[-1])
         # rank 0 creates (nonce'd name — concurrent windows must not
@@ -901,19 +907,53 @@ class SharedWindow:
 
         base_dir = shmseg.backing_dir()
         safe = "".join(c for c in name if c.isalnum())[:16] or "shwin"
+        self._seg = None
+        err = ""
+        # the create/attach outcome is AGREED collectively (the sharedfp
+        # discipline): a rank-0 ENOSPC must raise on every rank, not
+        # strand the others in the bcast/barrier below.  The name bcast
+        # doubles as the outcome flag — empty name ⇒ create failed.
         if comm.rank == 0:
             nonce = os.getpid() << 16 | (next(_shwin_nonce) & 0xFFFF)
             seg_name = f"otpu-shwin-{safe}-{os.getuid()}-{nonce:x}"
-            self._seg = shmseg.create(seg_name, max(total, 8),
-                                      dir=base_dir, publish=False)
-            np.frombuffer(self._seg.buf, np.uint8)[:] = 0
-            self._seg.publish()
+            try:
+                self._seg = shmseg.create(seg_name, max(total, 8),
+                                          dir=base_dir, publish=False)
+                np.frombuffer(self._seg.buf, np.uint8)[:] = 0
+                self._seg.publish()
+            except OSError as e:
+                err = str(e)
+                seg_name = ""
             comm.bcast(np.frombuffer(
                 seg_name.encode().ljust(96), np.uint8).copy(), root=0)
         else:
             raw = np.asarray(comm.bcast(np.zeros(96, np.uint8), root=0))
             seg_name = bytes(raw).rstrip(b"\x00").rstrip().decode()
-            self._seg = shmseg.attach(os.path.join(base_dir, seg_name))
+            if not seg_name:
+                err = "segment creation failed on rank 0"
+            else:
+                try:
+                    self._seg = shmseg.attach(
+                        os.path.join(base_dir, seg_name))
+                except OSError as e:
+                    err = str(e)
+        from ompi_tpu.mpi import op as op_mod
+
+        ok = int(np.asarray(comm.allreduce(np.array(
+            [0 if err else 1], np.int32), op=op_mod.MIN))[0])
+        if not ok:
+            if self._seg is not None:   # my attach worked; a peer's didn't
+                try:
+                    if comm.rank == 0:
+                        self._seg.unlink()
+                    self._seg.detach()
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
+                self._seg = None
+            raise MPIException(
+                "MPI_Win_allocate_shared: segment setup failed"
+                + (f": {err}" if err else " on a peer rank"),
+                error_class=16)
         comm.barrier()
 
     def shared_query(self, rank: int) -> np.ndarray:
@@ -921,7 +961,7 @@ class SharedWindow:
         the REQUESTED extent (padding bytes are not exposed)."""
         lo = int(self._offsets[rank])
         return np.frombuffer(self._seg.buf, np.uint8,
-                             count=self._local_bytes,
+                             count=int(self._extents[rank]),
                              offset=lo).view(self.dtype)
 
     @property

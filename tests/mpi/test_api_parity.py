@@ -177,3 +177,29 @@ def test_win_allocate_shared():
         return None
 
     run_ranks(4, body)
+
+
+def test_win_allocate_shared_heterogeneous():
+    """Heterogeneous local_size — the canonical osc/sm pattern: one rank
+    owns the whole node buffer, everyone else allocates 0 bytes; every
+    rank's shared_query(r) must report r's OWN extent (regression:
+    the caller's extent was used for every peer)."""
+    from ompi_tpu.mpi.constants import COMM_TYPE_SHARED
+    from ompi_tpu.mpi.osc import SharedWindow
+
+    def body(comm):
+        node = comm.split_type(COMM_TYPE_SHARED)
+        mine = 32 if node.rank == 0 else 0
+        win = SharedWindow(node, local_size=mine, dtype=np.int32)
+        if node.rank == 0:
+            win.local[:] = np.arange(32, dtype=np.int32)
+        win.sync()
+        owner = win.shared_query(0)
+        assert owner.shape == (32,)
+        assert (owner == np.arange(32, dtype=np.int32)).all()
+        for r in range(1, node.size):
+            assert win.shared_query(r).size == 0
+        win.free()
+        return None
+
+    run_ranks(3, body)

@@ -437,3 +437,130 @@ def test_seek_shared(tmp_path):
         f.close()
 
     run_ranks(2, body)
+
+# ---------------------------------------------------------------------------
+# sharedfp/individual (relaxed shared-pointer semantics, opt-in)
+# ---------------------------------------------------------------------------
+
+def _forced_individual():
+    var_registry.set("io_sharedfp", "individual")
+
+
+def _restore_sharedfp():
+    var_registry.set("io_sharedfp", "")
+
+
+def test_sharedfp_individual_merge_order(tmp_path):
+    """Writes spool locally; the close-time merge lands them in global
+    timestamp order (barriers between writes make the order exact)."""
+    path = str(tmp_path / "ind.dat")
+    _forced_individual()
+    try:
+        def body(comm):
+            f = mio.File.open(comm, path, mio.MODE_RDWR | mio.MODE_CREATE)
+            f.set_view(0, dt.INT32)
+            # two rounds, rank order enforced by barriers: the global
+            # timestamp sort must reproduce exactly this interleaving
+            for round_ in range(2):
+                for r in range(comm.size):
+                    if comm.rank == r:
+                        f.write_shared(np.full(
+                            2, 10 * round_ + r, np.int32))
+                    comm.barrier()
+            # nothing on disk yet: the spool is local until the merge
+            before = os.path.getsize(path) if comm.rank == 0 else -1
+            comm.barrier()
+            f.close()
+            return before
+
+        import os
+
+        sizes = run_ranks(3, body)
+        assert sizes[0] == 0            # pre-merge: file still empty
+        raw = np.fromfile(path, dtype=np.int32)
+        want = []
+        for round_ in range(2):
+            for r in range(3):
+                want.extend([10 * round_ + r] * 2)
+        np.testing.assert_array_equal(raw, np.array(want, np.int32))
+    finally:
+        _restore_sharedfp()
+
+
+def test_sharedfp_individual_reads_erroneous(tmp_path):
+    path = str(tmp_path / "ind2.dat")
+    _forced_individual()
+    try:
+        def body(comm):
+            f = mio.File.open(comm, path, mio.MODE_RDWR | mio.MODE_CREATE)
+            errs = 0
+            for fn in (lambda: f.read_shared(1),
+                       lambda: f.seek_shared(0),
+                       lambda: f.get_position_shared()):
+                try:
+                    fn()
+                except MPIException:
+                    errs += 1
+            comm.barrier()
+            f.close()
+            return errs
+
+        assert run_ranks(2, body) == [3, 3]
+    finally:
+        _restore_sharedfp()
+
+
+def test_sharedfp_individual_ordered_after_shared(tmp_path):
+    """write_ordered is collective: it first lands the pending spooled
+    writes, then appends in rank order after them."""
+    path = str(tmp_path / "ind3.dat")
+    _forced_individual()
+    try:
+        def body(comm):
+            f = mio.File.open(comm, path, mio.MODE_RDWR | mio.MODE_CREATE)
+            f.set_view(0, dt.INT32)
+            for r in range(comm.size):    # deterministic global order
+                if comm.rank == r:
+                    f.write_shared(np.full(1, 100 + r, np.int32))
+                comm.barrier()
+            f.write_ordered(np.full(2, comm.rank, np.int32))
+            out = f.read_at(0, comm.size + 2 * comm.size) \
+                if comm.rank == 0 else None
+            f.close()
+            return out
+
+        results = run_ranks(2, body)
+        want = np.array([100, 101, 0, 0, 1, 1], np.int32)
+        np.testing.assert_array_equal(results[0], want)
+    finally:
+        _restore_sharedfp()
+
+
+def test_sharedfp_individual_sync_lands_pending(tmp_path):
+    """An explicit (collective) sync materializes the spool without
+    closing; later writes merge after the earlier ones."""
+    path = str(tmp_path / "ind4.dat")
+    _forced_individual()
+    try:
+        def body(comm):
+            f = mio.File.open(comm, path, mio.MODE_RDWR | mio.MODE_CREATE)
+            f.set_view(0, dt.INT32)
+            f.write_shared(np.full(1, comm.rank, np.int32))
+            comm.barrier()
+            f.sync()
+            mid = np.fromfile(path, dtype=np.int32).size \
+                if comm.rank == 0 else -1
+            comm.barrier()
+            f.write_shared(np.full(1, 10 + comm.rank, np.int32))
+            comm.barrier()
+            f.close()
+            return mid
+
+        mids = run_ranks(2, body)
+        assert mids[0] == 2             # first round landed at sync
+        raw = np.fromfile(path, dtype=np.int32)
+        assert raw.size == 4
+        assert sorted(raw[:2]) == [0, 1]      # round 1 before round 2
+        assert sorted(raw[2:]) == [10, 11]
+    finally:
+        _restore_sharedfp()
