@@ -265,11 +265,14 @@ def bench_flagship_mfu(kind: str) -> dict:
     on_cpu = jax.devices()[0].platform == "cpu"
     mesh = make_mesh({"dp": 1, "sp": 1, "tp": 1}, devices=jax.devices()[:1])
     # flagship: 468M params, head_dim 128 (full MXU lane tile in the
-    # flash kernel), batch/remat as measured on v5e (8×1024 tokens,
-    # matmul-output remat — 24.7% on the bring-up sweep)
+    # flash kernel).  Config picked by the measured v5e sweep (r4):
+    # flash-class attention beats the 1-hop ring form 29.3% vs 19.7%
+    # MFU at batch 16, and batch 16 beats 8 (16.1%); ring stays the
+    # sp>1 long-context path — on one chip ulysses+flash IS the
+    # degenerate ring with none of its permute scaffolding.
     base = dict(vocab=32_000, d_model=2048, n_heads=16, n_layers=8,
-                d_ff=8192, seq=1024, attention="ring")
-    batch, chain, outer = 8, 16, 2
+                d_ff=8192, seq=1024, attention="flash")
+    batch, chain, outer = 16, 8, 2
     if on_cpu:  # fallback mode: keep the gate fast; MFU is 0 here anyway
         base.update(d_model=256, n_heads=8, n_layers=2, d_ff=1024, seq=256)
         batch, chain, outer = 2, 2, 1
@@ -603,6 +606,68 @@ def matrix_shm_pingpong() -> dict:
     }
 
 
+def matrix_shm_msgrate() -> dict:
+    """Two real PROCESSES, PML-level small-message rate over the shm BTL
+    — total CPU work per message (send prologue + C ring publish + fused
+    drain + match + deliver).  On small hosts this is the honest
+    same-host data-plane number: ping-pong latency there measures the
+    scheduler, not the stack (1 core ⇒ every hop is a context switch)."""
+    import multiprocessing as mp
+
+    n_msgs = 20_000
+
+    def child(c2p, p2c):
+        from ompi_tpu.mpi.comm import Communicator
+        from ompi_tpu.mpi.group import Group
+        from ompi_tpu.mpi.pml import PmlOb1
+
+        pml = PmlOb1(1)
+        c2p.put(pml.address)
+        peers = p2c.get()
+        pml.set_peers(peers)
+        comm = Communicator(Group(range(2)), cid=0, pml=pml,
+                            my_world_rank=1)
+        buf = np.zeros(16, np.int32)
+        for _ in range(n_msgs):
+            comm.recv(buf=buf, source=0, tag=1)
+        comm.send(buf, dest=0, tag=2)   # ack closes the clock
+        pml.close()
+
+    from ompi_tpu.mpi.comm import Communicator
+    from ompi_tpu.mpi.group import Group
+    from ompi_tpu.mpi.pml import PmlOb1
+
+    ctx = mp.get_context("fork")
+    c2p, p2c = ctx.Queue(), ctx.Queue()
+    proc = ctx.Process(target=child, args=(c2p, p2c), daemon=True)
+    proc.start()
+    pml = PmlOb1(0)
+    try:
+        peers = {0: pml.address, 1: c2p.get(timeout=30)}
+        p2c.put(peers)
+        pml.set_peers(peers)
+        comm = Communicator(Group(range(2)), cid=0, pml=pml,
+                            my_world_rank=0)
+        msg = np.arange(16, dtype=np.int32)
+        comm.send(msg, dest=1, tag=1)   # warm the route + ring
+        t0 = time.perf_counter()
+        for _ in range(n_msgs - 1):
+            comm.send(msg, dest=1, tag=1)
+        comm.recv(source=1, tag=2)
+        dt = time.perf_counter() - t0
+        proc.join(timeout=10)
+    finally:
+        pml.close()
+    return {
+        "metric": "shm PML 2-process message rate (64B, fused native "
+                  "engine)",
+        "value": round(n_msgs / dt),
+        "unit": "msg/s", "vs_baseline": 1.0,
+        "us_per_msg": round(dt / n_msgs * 1e6, 2),
+        "n_cores": os.cpu_count(),
+    }
+
+
 def matrix_remote_dma(devices) -> dict:
     """One-sided put (pallas remote DMA, ≈ btl_put) — on ≥2 chips a true
     cross-chip put timing the single ICI path; on 1 chip the self-put
@@ -723,6 +788,7 @@ def run_matrix(devices, backend: str) -> None:
     for name, fn in (
             ("ring_latency", matrix_ring_latency),
             ("shm_pingpong", matrix_shm_pingpong),
+            ("shm_msgrate", matrix_shm_msgrate),
             ("allreduce_sweep", lambda: matrix_allreduce_sweep(devices)),
             ("mesh_bcast_allgather",
              lambda: matrix_mesh_bcast_allgather(devices)),

@@ -250,6 +250,10 @@ class ProcBTL:
 
         self.rank = rank
         self.on_frame = on_frame
+        # optional compiled fast lane: (peer, tag, cid, seq, payload) →
+        # bool, installed by the owning PML when its matching engine is
+        # native — delivers with no header object at all
+        self.on_fast = None
         self._alias: dict[int, int] = {}
         self._peer_tokens: dict[int, int] = {}
         # honor simulated host identities: sim-plm ranks on different
@@ -285,6 +289,19 @@ class ProcBTL:
         if target is None:
             raise ConnectionError(f"btl/proc: peer {peer} endpoint closed")
         target.on_frame(self._alias.get(peer, self.rank), header, payload)
+
+    def send_fast(self, peer: int, tag: int, cid: int, seq: int,
+                  payload, dt, elems: int, shp) -> bool:
+        """Header-free delivery into the peer's compiled engine; False ⇒
+        the peer declined (no engine, fencing active, out-of-order) and
+        the caller re-sends the same frame via the header path.  dt/
+        elems/shp are the scalar header fields the engine materializes
+        only when it must (unexpected storage, allocate-on-match)."""
+        target = ProcBTL._registry.get(self._peer_tokens.get(peer, -1))
+        if target is None or target.on_fast is None:
+            return False
+        return target.on_fast(self._alias.get(peer, self.rank),
+                              tag, cid, seq, payload, dt, elems, shp)
 
     def close(self) -> None:
         with ProcBTL._reg_lock:
@@ -363,6 +380,7 @@ class BtlEndpoint:
         self._cards: dict[int, str] = {}   # peer → full business card
         self._shm_ok: set[int] = set()     # peers with a live shm route
         self._proc_ok: set[int] = set()    # peers in my address space
+        self._proc_no: set[int] = set()    # known peers that are NOT
 
     @property
     def address(self) -> str:
@@ -490,6 +508,11 @@ class BtlEndpoint:
         if proc_card and self.proc_btl.connect(peer, proc_card):
             self._proc_ok.add(peer)
             return True
+        if peer in self._cards:
+            # a known peer that is NOT in my address space stays that
+            # way — cache the miss so per-send fast-lane checks are one
+            # set lookup (a respawn rebind clears it via drop routes)
+            self._proc_no.add(peer)
         return False
 
     def rebind(self, peer: int, card: str) -> None:
@@ -512,6 +535,7 @@ class BtlEndpoint:
             self._drop_shm(peer)
         if self.proc_btl is not None:
             self._proc_ok.discard(peer)
+            self._proc_no.discard(peer)
             self.proc_btl._peer_tokens.pop(peer, None)
 
     def close(self) -> None:

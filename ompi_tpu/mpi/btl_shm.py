@@ -236,6 +236,23 @@ class ShmRingWriter:
         else:
             self._send_py(header, payload, block=True)
 
+    def try_send_eager(self, tag: int, cid: int, seq: int, dt: str,
+                       elems: int, shp: tuple, payload) -> bool:
+        """Nonblocking plain-eager publish with the header BUILT IN C
+        (fastdss.ring_send_fast) — no dict, no python codec; the
+        receiver's engine fast-scans the same seven fields.  False when
+        the ring is full NOW (caller falls back to the header path);
+        requires the native engine (callers check)."""
+        with self._lock:
+            try:
+                self._head, ring_db = self._fast.ring_send_fast(
+                    self._mm, self._head, tag, cid, seq, dt, elems, shp,
+                    payload)
+            except self._fast.RingFull:
+                return False
+        self._ring_doorbell(bool(ring_db))
+        return True
+
     def try_send(self, header: dict, payload: bytes) -> bool:
         """Nonblocking send (≈ btl sendi, btl.h:926): publish the frame iff
         the ring has room NOW; False ⇒ the caller takes the queued path.
@@ -380,6 +397,16 @@ class ShmBTL:
                               os.O_RDONLY | os.O_NONBLOCK)
         self._writers: dict[int, ShmRingWriter] = {}
         self._readers: dict[int, ShmRingReader] = {}
+        # optional fused drain: reader → frames-delivered, installed by
+        # the PML when its compiled matching engine is live.  When set,
+        # EVERY ring read goes through it (the hook serializes reads
+        # under the PML lock, which also lets a blocked receiver drain
+        # its own rings — receiver-pull progress)
+        self.drain_hook = None
+        # >0 ⇒ a blocked receiver is actively pulling: the poller backs
+        # off (sleep, don't spin) instead of fighting the waiter for the
+        # GIL and the PML lock on every frame
+        self.pull_depth = 0
         self._peer_pid: dict[int, Optional[int]] = {}
         self._alive_until: dict[int, float] = {}   # liveness-probe cache
         self._unreachable: set[int] = set()
@@ -494,6 +521,16 @@ class ShmBTL:
         self._check_alive(peer)
         return w.try_send(header, payload)
 
+    def try_send_eager(self, peer: int, tag: int, cid: int, seq: int,
+                      dt: str, elems: int, shp: tuple, payload) -> bool:
+        """Header-free eager publish (see ShmRingWriter.try_send_eager);
+        False ⇒ unconnected / no native engine / ring full."""
+        w = self._writers.get(peer)
+        if w is None or w._fast is None:
+            return False
+        self._check_alive(peer)
+        return w.try_send_eager(tag, cid, seq, dt, elems, shp, payload)
+
     # -- receive side ------------------------------------------------------
 
     def _scan_inbox(self) -> int:
@@ -526,16 +563,26 @@ class ShmBTL:
         idle = 0
         last_scan = time.monotonic()
         while not self._stop.is_set():
+            if self.pull_depth:
+                # a blocked receiver is draining on its own thread —
+                # stay out of its way (it covers every frame, punts
+                # included); wake periodically for new-ring discovery
+                time.sleep(0.002)
+                self._scan_inbox()
+                idle = 0
+                continue
             with self._lock:
                 readers = list(self._readers.values())
             n = 0
+            hook = self.drain_hook
             for r in readers:
                 try:
                     # NOTE: an exception out of on_frame consumes the frame
                     # (tail already advanced) — same loss semantics as a tcp
                     # reader thread dying mid-delivery; the log below is the
                     # only trace, so keep it loud
-                    n += r.poll(self.on_frame)
+                    n += hook(r) if hook is not None \
+                        else r.poll(self.on_frame)
                 except Exception as e:   # a bad frame must not kill polling
                     _log.error("btl/shm poll from %d failed: %r", r.peer, e)
             if n:
@@ -578,6 +625,11 @@ class ShmBTL:
             for r in readers:
                 r.set_sleeping(False)
             idle = 0
+
+    def reader_list(self) -> list["ShmRingReader"]:
+        """Snapshot of the attached rings (receiver-pull callers)."""
+        with self._lock:
+            return list(self._readers.values())
 
     def close(self) -> None:
         self._stop.set()

@@ -227,7 +227,11 @@ class Communicator:
              count: Optional[int] = None,
              status: Optional[Status] = None) -> np.ndarray:
         req = self.irecv(buf, source, tag, datatype, count)
-        out = req.wait()
+        # receiver-pull progress when the PML offers it: the blocked
+        # thread drains its own shm rings instead of waiting for the
+        # poller's futex handoff
+        waiter = getattr(self.pml, "_progress_wait", None)
+        out = waiter(req) if waiter is not None else req.wait()
         if status is not None:
             status.__dict__.update(req.status.__dict__)
             if status.source >= 0:

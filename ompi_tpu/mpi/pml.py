@@ -63,6 +63,11 @@ def _reject_device(buf: Any, what: str) -> None:
 
 _log = output.get_stream("pml")
 
+# 1-2 core hosts flip the receiver-pull spin style (see _progress_wait)
+import os as _os_mod  # noqa: E402
+
+_SMALL_HOST = (_os_mod.cpu_count() or 1) <= 2
+
 pml_framework = Framework("pml", "point-to-point messaging logic")
 
 register_var("pml", "eager_limit", VarType.SIZE, 64 * 1024,
@@ -73,6 +78,11 @@ register_var("pml", "retry_window", VarType.DOUBLE, 30.0,
              "fast); ≈ the failover PML's retransmit bound")
 register_var("pml", "frag_size", VarType.SIZE, 1 << 20,
              "fragment size for rendezvous pipelines")
+register_var("pml", "native_match", VarType.BOOL, True,
+             "run the matching engine (posted/unexpected queues, wire-seq "
+             "gate, held frames) in the compiled extension "
+             "(_native/fastdss.c Engine — ob1's recvfrag matcher in C); "
+             "off, or a failed native build, → the pure-python matcher")
 
 
 class RecvRequest(Request):
@@ -101,13 +111,17 @@ class RecvRequest(Request):
         if pml is None or self.done():
             return
         with pml._lock:
-            m = pml._matching.get(self.cid)
-            if m is None:
-                return
-            try:
-                m.posted.remove(self)
-            except ValueError:
-                return  # already matched — delivery wins
+            if pml._eng is not None:
+                if not pml._eng.cancel(self.cid, self):
+                    return  # already matched — delivery wins
+            else:
+                m = pml._matching.get(self.cid)
+                if m is None:
+                    return
+                try:
+                    m.posted.remove(self)
+                except ValueError:
+                    return  # already matched — delivery wins
         self.cancelled = True
         self.complete(None)
 
@@ -396,6 +410,19 @@ class PmlOb1:
         from ompi_tpu.core import memchecker
 
         self._memcheck = memchecker.enabled()
+        # compiled matching engine: owns posted/unexpected queues + the
+        # wire-seq gate when available; every call happens under
+        # self._lock (the engine replaces the structures that lock
+        # guarded, it does not add its own)
+        self._eng = None
+        self._fast = None
+        if var_registry.get("pml_native_match"):
+            from ompi_tpu import _native
+
+            fast = _native.fastdss()
+            if fast is not None and hasattr(fast, "Engine"):
+                self._eng = fast.Engine()
+                self._fast = fast
         self._sendq: "queue.Queue[Optional[tuple]]" = queue.Queue()
         self._listeners: list = []   # peruse/monitoring subscribers
         self._events: "collections.deque[tuple]" = collections.deque()
@@ -404,6 +431,13 @@ class PmlOb1:
             target=self._send_loop, name=f"pml-send-{rank}", daemon=True)
         self._worker.start()
         self._closed = False
+        if self._eng is not None and self.endpoint.proc_btl is not None:
+            # same-address-space fast lane: peers deliver into my engine
+            self.endpoint.proc_btl.on_fast = self._on_frame_fast
+        if self._eng is not None and self.endpoint.shm_btl is not None:
+            # fused shm drain: ring decode + matching in one C call per
+            # batch; also enables receiver-pull progress (_progress_wait)
+            self.endpoint.shm_btl.drain_hook = self._drain_shm
 
     # -- event hooks (PERUSE equivalent) -----------------------------------
     #
@@ -474,6 +508,21 @@ class PmlOb1:
         if mode not in ("standard", "sync", "ready", "buffered"):
             raise MPIException(
                 f"unknown send mode {mode!r} (standard/sync/ready/buffered)")
+        # compiled fast lane (same-address-space peers): a plain eager
+        # contiguous send delivers straight into the peer's posted buffer
+        # through its engine — no header object at all on the hot path
+        if (mode == "standard"
+                and self._eng is not None
+                and peer != self.rank
+                and not self._listeners
+                and self.incarnation == 0
+                and datatype is None and count is None
+                and isinstance(buf, np.ndarray)
+                and buf.flags["C_CONTIGUOUS"]
+                and not self._memcheck):
+            req = self._isend_fast(buf, peer, tag, cid)
+            if req is not None:
+                return req
         _reject_device(buf, "isend")
         if self._memcheck:
             from ompi_tpu.core import memchecker
@@ -573,6 +622,78 @@ class PmlOb1:
         self._drain_events()
         return req
 
+    def _isend_fast(self, arr: np.ndarray, peer: int, tag: int,
+                    cid: int) -> Optional[Request]:
+        """Fast lane for plain eager contiguous sends: deliver through
+        the same-address-space peer's compiled engine (proc BTL) with no
+        header dict.  None ⇒ precondition missed, caller runs the
+        general isend.  If the receiver punts (no posted contiguous
+        buffer, out-of-order, listeners attached mid-flight) the frame
+        falls back to the header path WITH the already-drawn seq — the
+        wire order is unaffected."""
+        if arr.nbytes > var_registry.get("pml_eager_limit"):
+            return None
+        ep = self.endpoint
+        proc_ok = ep.proc_btl is not None and (
+            peer in ep._proc_ok
+            or (peer not in ep._proc_no and ep._proc_route(peer)))
+        if not proc_ok:
+            # cross-process: the lane still applies over shm rings
+            if ep.shm_btl is None or not (
+                    peer in ep._shm_ok or ep._shm_route(peer)):
+                return None
+        with self._lock:
+            if (peer in self._parked or self._queued.get(peer, 0)
+                    or self._peer_epoch.get(peer, 0)):
+                return None
+            seq_key = (peer, cid)
+            seq = self._seq.get(seq_key, 0)
+            self._seq[seq_key] = seq + 1
+        payload = arr.reshape(-1).view(np.uint8).data
+        req = Request(kind="send")
+        dt = _dtype_to_wire(arr.dtype)
+        if proc_ok and ep.proc_btl.send_fast(peer, tag, cid, seq, payload,
+                                             dt, arr.size, arr.shape):
+            req.complete(None)
+            return req
+        if (not proc_ok and isinstance(dt, str)
+                and self.endpoint.shm_btl is not None):
+            # cross-process same-host: publish with the C-built header
+            try:
+                if self.endpoint.shm_btl.try_send_eager(
+                        peer, tag, cid, seq, dt, arr.size, arr.shape,
+                        payload):
+                    req.complete(None)
+                    return req
+            except Exception:  # noqa: BLE001 — dead peer/oversize: the
+                pass           # header path surfaces it properly
+        # receiver declined the fast path — same frame, header route
+        hdr = {"tag": tag, "cid": cid, "seq": seq, "dt": dt,
+               "elems": arr.size, "shp": list(arr.shape), "t": "eager"}
+        if self.endpoint.try_send_inline(peer, hdr, payload):
+            req.complete(None)
+        else:
+            self._enqueue_frame(peer, hdr, payload, req)
+        return req
+
+    def _on_frame_fast(self, peer: int, tag: int, cid: int, seq: int,
+                       payload, dt, elems: int, shp) -> bool:
+        """Receiver half of the fast lane (installed as the proc BTL's
+        on_fast hook).  False ⇒ sender must re-send via the header
+        path — the engine consumed NOTHING."""
+        eng = self._eng
+        if eng is None or self.incarnation:
+            return False   # post-restart fencing needs the header path
+        with self._lock:
+            acts = eng.incoming_fast(peer, tag, cid, seq, payload,
+                                     dt, elems, shp)
+            if acts is None:
+                return False
+            for act in acts:
+                self._apply_action(act)
+        self._drain_events()
+        return True
+
     def issend(self, buf, peer, tag, cid, **kw) -> Request:
         """≈ MPI_Issend: completes only once the matching recv is posted."""
         return self.isend(buf, peer, tag, cid, mode="sync", **kw)
@@ -613,18 +734,38 @@ class PmlOb1:
         if self._listeners:
             self._emit(EVT_RECV_POST, peer=source, tag=tag, cid=cid)
         with self._lock:
-            m = self._matching_for(cid)
-            # try the unexpected queue first, in arrival order
-            for i, (peer, hdr, payload) in enumerate(m.unexpected):
-                if _hdr_matches(req, peer, hdr):
-                    del m.unexpected[i]
+            if self._eng is not None:
+                barr = None
+                if (buf is not None and datatype is not None
+                        and datatype.is_contiguous
+                        and buf.flags["C_CONTIGUOUS"]):
+                    barr = buf   # registered for native fast delivery
+                hit = self._eng.post(
+                    cid, req.source, req.tag, req, barr,
+                    datatype.base_np.itemsize if datatype is not None
+                    else 1,
+                    count * datatype.size
+                    if (count is not None and datatype is not None)
+                    else -1)
+                if hit is not None:
+                    peer, hdr, payload = hit
                     if self._listeners:
                         self._emit(EVT_MATCH, peer=peer, tag=hdr["tag"],
                                    cid=hdr["cid"])
                     self._match(req, peer, hdr, payload)
-                    break
             else:
-                m.posted.append(req)
+                m = self._matching_for(cid)
+                # try the unexpected queue first, in arrival order
+                for i, (peer, hdr, payload) in enumerate(m.unexpected):
+                    if _hdr_matches(req, peer, hdr):
+                        del m.unexpected[i]
+                        if self._listeners:
+                            self._emit(EVT_MATCH, peer=peer,
+                                       tag=hdr["tag"], cid=hdr["cid"])
+                        self._match(req, peer, hdr, payload)
+                        break
+                else:
+                    m.posted.append(req)
         self._drain_events()
         return req
 
@@ -632,10 +773,101 @@ class PmlOb1:
              datatype: Optional[Datatype] = None, count: Optional[int] = None,
              status: Optional[Status] = None) -> np.ndarray:
         req = self.irecv(buf, source, tag, cid, datatype, count)
-        out = req.wait()
+        out = self._progress_wait(req)
         if status is not None:
             status.__dict__.update(req.status.__dict__)
         return out
+
+    def _progress_wait(self, req: Request):
+        """Receiver-pull progress (≈ opal_progress running in the waiting
+        thread): while blocked on a recv, THIS thread drains its own shm
+        rings through the engine — the frame that completes the request
+        is matched and copied here, with no poller-thread futex handoff
+        on the critical path.  Only engages when shm rings exist (frames
+        from another process): for in-process peers the sender's thread
+        delivers directly, and a GIL-holding spin would steal exactly
+        the cycles it is waiting for (measured, see request.py)."""
+        shm = self.endpoint.shm_btl
+        if self._eng is None or shm is None or req.done():
+            return req.wait()
+        readers = shm.reader_list()
+        if not readers:
+            return req.wait()
+        # spin style by core count: on a 1-2 core host the frame we are
+        # waiting for is PRODUCED by the process we'd be starving, so
+        # yield every iteration (stay runnable, let the sender run — the
+        # doorbell path would pay a double futex wake: doorbell→poller→
+        # event→us); on bigger hosts yield rarely (a sched_yield per
+        # iteration invites the kernel to deschedule us right when the
+        # frame lands)
+        yield_every = _SMALL_HOST
+        shm.pull_depth += 1   # poller backs off while we drain
+        try:
+            spins = 0
+            while not req.done():
+                progressed = 0
+                for r in readers:
+                    try:
+                        progressed += self._drain_shm(r)
+                    except OSError as e:  # corrupt ring already recovered
+                        _log.error("receiver-pull drain: %r", e)
+                if progressed:
+                    spins = 0
+                    continue
+                spins += 1
+                if spins > 4000:   # a few ms of spinning, then sleep
+                    break
+                if yield_every:
+                    time.sleep(0)
+                if not spins % 64:
+                    readers = shm.reader_list()   # new rings mid-wait
+                    if not yield_every:
+                        time.sleep(0)
+        finally:
+            shm.pull_depth -= 1
+        return req.wait()
+
+    def _drain_shm(self, reader) -> int:
+        """The shm BTL's drain hook: decode + seq-gate + match a batch of
+        ring frames in one C call under the PML lock.  Control frames
+        (cts/sack/rebind/…) and respawn-stamped data frames come back as
+        punts and re-enter the full _on_frame after the lock drops — a
+        ring never mixes incarnations, so fast frames and punted ones
+        cannot be reordered against each other within a stream."""
+        eng = self._eng
+        punts = None
+        try:
+            with self._lock:
+                new_tail, n, acts = eng.drain_ring(
+                    reader.peer, reader._mm, reader._tail, 64)
+                reader._tail = new_tail
+                for act in acts:
+                    if act[0] == "frame":
+                        if punts is None:
+                            punts = []
+                        punts.append(act)
+                    else:
+                        self._apply_action(act)
+        except self._fast.Unsupported:
+            # a header tag only the python codec knows: drain this batch
+            # through the python framing path instead
+            return reader.poll(self._on_frame)
+        except ValueError as e:
+            # corrupt stream: same recovery as ShmRingReader.poll —
+            # nothing trustworthy to advance by; discard and surface
+            head = int(reader._ctr[0])
+            dropped = head - reader._tail
+            reader._tail = head
+            reader._ctr[1] = head
+            raise OSError(
+                f"btl/shm: corrupt ring from peer {reader.peer} "
+                f"({e}); {dropped} pending bytes discarded") from None
+        if punts:
+            for _k, hdr, payload in punts:
+                self._on_frame(reader.peer, hdr, payload)
+        if n:
+            self._drain_events()
+        return n
 
     # -- probe -------------------------------------------------------------
 
@@ -661,6 +893,16 @@ class PmlOb1:
                 self._cv.wait(timeout=left)
 
     def _iprobe_locked(self, source: int, tag: int, cid: int) -> Optional[Status]:
+        if self._eng is not None:
+            hit = self._eng.iprobe(cid, source, tag)
+            if hit is None:
+                return None
+            peer, hdr = hit
+            st = Status()
+            st.source = peer
+            st.tag = hdr["tag"]
+            st.count = hdr.get("elems", hdr.get("size", 0))
+            return st
         probe = RecvRequest(None, dt_mod.BYTE, 0, source, tag, cid)
         for peer, hdr, payload in self._matching_for(cid).unexpected:
             if _hdr_matches(probe, peer, hdr):
@@ -685,25 +927,37 @@ class PmlOb1:
 
     def _improbe_locked(self, source: int, tag: int,
                         cid: int) -> Optional[tuple[Message, Status]]:
+        if self._eng is not None:
+            hit = self._eng.improbe(cid, source, tag)
+            if hit is None:
+                return None
+            peer, hdr, payload = hit
+            return self._detach_message(peer, hdr, payload)
         probe = RecvRequest(None, dt_mod.BYTE, 0, source, tag, cid)
         m = self._matching_for(cid)
         for i, (peer, hdr, payload) in enumerate(m.unexpected):
             if _hdr_matches(probe, peer, hdr):
                 del m.unexpected[i]
-                if hdr.get("sm") == "s":
-                    # matching happens HERE: a sync-mode sender completes
-                    # at match time (the MPI ssend contract — the recv
-                    # has "started"), not when mrecv later drains it
-                    self._enqueue_frame(
-                        peer, {"t": "sack", "sid": hdr["sid"]}, b"", None)
-                    hdr = {k: v for k, v in hdr.items()
-                           if k not in ("sm", "sid")}
-                st = Status()
-                st.source = peer
-                st.tag = hdr["tag"]
-                st.count = hdr.get("elems", hdr.get("size", len(payload)))
-                return Message(self, peer, hdr, payload), st
+                return self._detach_message(peer, hdr, payload)
         return None
+
+    def _detach_message(self, peer: int, hdr: dict,
+                        payload) -> tuple[Message, Status]:
+        """With self._lock held: finish a match-and-detach on an
+        unexpected frame just removed from the queue."""
+        if hdr.get("sm") == "s":
+            # matching happens HERE: a sync-mode sender completes
+            # at match time (the MPI ssend contract — the recv
+            # has "started"), not when mrecv later drains it
+            self._enqueue_frame(
+                peer, {"t": "sack", "sid": hdr["sid"]}, b"", None)
+            hdr = {k: v for k, v in hdr.items()
+                   if k not in ("sm", "sid")}
+        st = Status()
+        st.source = peer
+        st.tag = hdr["tag"]
+        st.count = hdr.get("elems", hdr.get("size", len(payload)))
+        return Message(self, peer, hdr, payload), st
 
     def mprobe(self, source: int, tag: int, cid: int,
                timeout: Optional[float] = None) -> tuple[Message, Status]:
@@ -796,6 +1050,8 @@ class PmlOb1:
             del self._recv_seq[key]
         for key in [k for k in self._held if k[0] == peer]:
             del self._held[key]
+        if self._eng is not None:   # the engine owns the recv-side gate
+            self._eng.reset_peer(peer)
         # re-stamp parked frames NOW, under the same lock that reset the
         # counters: they are the oldest traffic to the new incarnation and
         # must hold the FRONT of the fresh seq space — a later isend
@@ -860,27 +1116,34 @@ class PmlOb1:
                     if si < self._peer_inc.get(peer, 0):
                         return  # residual frame from a dead incarnation
                     self._adopt_incarnation(peer, si)
-                # per-(peer, cid) sequence enforcement: TCP + one reader
-                # already guarantee order, but a future non-FIFO BTL (shm
-                # rings, multi-rail) must not break matching order — frames
-                # arriving early are held back until their turn
-                key = (peer, hdr["cid"])
-                seq, expected = hdr["seq"], self._recv_seq.get(key, 0)
-                if seq != expected:
-                    # held frames outlive the sender's call: own the bytes
-                    # (a zero-copy self-BTL payload aliases the user buffer)
-                    if isinstance(payload, memoryview):
-                        payload = bytes(payload)
-                    self._held.setdefault(key, {})[seq] = (hdr, payload)
-                    return
-                self._match_incoming(peer, hdr, payload)
-                nxt = expected + 1
-                held = self._held.get(key)
-                while held and nxt in held:
-                    h2, p2 = held.pop(nxt)
-                    self._match_incoming(peer, h2, p2)
-                    nxt += 1
-                self._recv_seq[key] = nxt
+                if self._eng is not None:
+                    # seq gate + matching in the compiled engine; the
+                    # protocol actions come back for this thread to run
+                    for act in self._eng.incoming(peer, hdr, payload):
+                        self._apply_action(act)
+                else:
+                    # per-(peer, cid) sequence enforcement: TCP + one
+                    # reader already guarantee order, but a non-FIFO BTL
+                    # (shm rings, multi-rail) must not break matching
+                    # order — frames arriving early are held back
+                    key = (peer, hdr["cid"])
+                    seq, expected = hdr["seq"], self._recv_seq.get(key, 0)
+                    if seq != expected:
+                        # held frames outlive the sender's call: own the
+                        # bytes (a zero-copy self-BTL payload aliases the
+                        # user buffer)
+                        if isinstance(payload, memoryview):
+                            payload = bytes(payload)
+                        self._held.setdefault(key, {})[seq] = (hdr, payload)
+                        return
+                    self._match_incoming(peer, hdr, payload)
+                    nxt = expected + 1
+                    held = self._held.get(key)
+                    while held and nxt in held:
+                        h2, p2 = held.pop(nxt)
+                        self._match_incoming(peer, h2, p2)
+                        nxt += 1
+                    self._recv_seq[key] = nxt
             self._drain_events()
         elif t == "cts":
             with self._lock:
@@ -919,6 +1182,51 @@ class PmlOb1:
                     error_class=4))
         else:
             _log.error("unknown frame type %r from %d", t, peer)
+
+    def _apply_action(self, act: tuple) -> None:
+        """With self._lock held: execute one engine action — the
+        protocol step the compiled matcher handed back."""
+        kind = act[0]
+        if kind == "match":
+            _, req, peer, hdr, payload = act
+            if self._listeners:
+                self._emit(EVT_MATCH, peer=peer, tag=hdr["tag"],
+                           cid=hdr["cid"])
+            self._match(req, peer, hdr, payload)
+        elif kind == "unexpected":
+            _, peer, hdr = act
+            self._cv.notify_all()
+            if self._listeners:
+                self._emit(EVT_UNEXPECTED, peer=peer,
+                           tag=hdr["tag"], cid=hdr["cid"])
+        elif kind == "done":
+            # native fast delivery: payload already memcpy'd into the
+            # posted buffer — only status + completion remain
+            _, req, peer, tag, count, nbytes = act
+            if self._listeners:
+                self._emit(EVT_MATCH, peer=peer, tag=tag, cid=req.cid)
+                self._emit(EVT_DELIVER, peer=peer, tag=tag, cid=req.cid,
+                           nbytes=nbytes)
+            ov = req.source_override
+            req.status.source = peer if ov is None else ov
+            req.status.tag = tag
+            req.status.count = count
+            req.complete(req.buf)
+        elif kind == "adeliver":
+            # fast-lane frame matched an allocate-on-match recv: build
+            # the array from the C-owned bytes via the normal deliver
+            _, req, peer, tag, payload, dtspec, shp = act
+            if self._listeners:
+                self._emit(EVT_MATCH, peer=peer, tag=tag, cid=req.cid)
+            self._deliver(req, peer,
+                          {"tag": tag, "dt": dtspec, "shp": list(shp)},
+                          payload)
+        elif kind == "rnack":  # ready-mode send found no posted recv
+            _, peer, hdr = act
+            self._enqueue_frame(peer, {"t": "rnack", "sid": hdr["sid"]},
+                                b"", None)
+        else:
+            _log.error("unknown engine action %r", kind)
 
     def _match_incoming(self, peer: int, hdr: dict, payload: bytes) -> None:
         """Called with self._lock held: match one in-order frame."""

@@ -34,7 +34,11 @@ def test_heal_chain_exactly_once_in_order():
         sender = pmls[0]
 
         # force every frame through the send worker + heal machinery
+        # (the engine fast lane and inline sendi are both same-thread
+        # shortcuts that would bypass the flaky route below)
         sender.endpoint.try_send_inline = lambda *a, **k: False
+        if sender.endpoint.proc_btl is not None:
+            sender.endpoint.proc_btl.send_fast = lambda *a, **k: False
         orig_send = sender.endpoint.send
         flaky = {"fails": 0}
         lock = threading.Lock()
