@@ -59,6 +59,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--slots", type=int, default=None,
                    help="total rank slots the DVM allocates at start "
                         "(--dvm-start; default: np or hosts*ceil)")
+    p.add_argument("--clean", action="store_true",
+                   help="remove stale job debris (shm inboxes/segments "
+                        "of dead ranks, dead DVM uri) — ≈ orte-clean; "
+                        "liveness-checked unless --clean-age is given")
+    p.add_argument("--clean-age", type=float, default=0.0, metavar="SECS",
+                   help="with --clean: also remove ANY artifact older "
+                        "than SECS (use when none of your jobs run)")
+    p.add_argument("--clean-dry-run", action="store_true",
+                   help="with --clean: report, remove nothing")
     p.add_argument("--tag-output", dest="tag", action="store_true",
                    default=None, help="tag output lines with [jobid,rank]")
     p.add_argument("--no-tag-output", dest="tag", action="store_false")
@@ -69,6 +78,17 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.clean:
+        from ompi_tpu.runtime import clean as clean_mod
+
+        removed = clean_mod.clean(age=args.clean_age,
+                                  dry_run=args.clean_dry_run,
+                                  report=lambda s: print(f"tpurun: {s}",
+                                                         file=sys.stderr))
+        verb = "would remove" if args.clean_dry_run else "removed"
+        print(f"tpurun: {verb} {len(removed)} stale artifact(s)",
+              file=sys.stderr)
+        return 0
     if args.dvm_ps:
         import json as _json
 

@@ -167,3 +167,55 @@ def test_no_dvm_running_clear_error(tmp_path):
     assert r.returncode != 0
     combined = r.stderr + r.stdout
     assert "no DVM running" in combined or "cannot reach" in combined
+
+
+def test_clean_sweeps_dead_inboxes(tmp_path, monkeypatch):
+    """≈ orte-clean: a dead rank's shm inbox (doorbell with no reader)
+    and an unmapped old segment go; a LIVE inbox and a MAPPED segment
+    stay.  Hermetic: the sweep roots and the DVM-uri probe are pinned
+    into tmp_path (the real per-user uri file must never be touched)."""
+    import mmap
+    import os
+
+    from ompi_tpu.runtime import clean as clean_mod
+    from ompi_tpu.runtime import dvm as dvm_mod
+
+    base = str(tmp_path)
+    monkeypatch.setattr(clean_mod, "_dirs", lambda: [base])
+    monkeypatch.setattr(dvm_mod, "default_uri_path",
+                        lambda: os.path.join(base, "no-such-uri"))
+    # dead inbox: fifo exists, nobody reads it
+    dead = os.path.join(base, "otpu-shm-dead1")
+    os.mkdir(dead)
+    os.mkfifo(os.path.join(dead, "doorbell"))
+    # live inbox: hold the read end open like a running poller
+    live = os.path.join(base, "otpu-shm-live1")
+    os.mkdir(live)
+    os.mkfifo(os.path.join(live, "doorbell"))
+    rd = os.open(os.path.join(live, "doorbell"),
+                 os.O_RDONLY | os.O_NONBLOCK)
+    # old UNMAPPED segment: swept by the no-process-maps-it rule
+    seg = os.path.join(base, "otpu-shfp-0-deadbeef-1")
+    open(seg, "wb").write(b"\0" * 8)
+    os.utime(seg, (1, 1))
+    # old but MAPPED segment: a live job's shared window — must stay
+    mapped = os.path.join(base, "otpu-shwin-x-0-2")
+    with open(mapped, "wb") as f:
+        f.write(b"\0" * 4096)
+    os.utime(mapped, (1, 1))
+    mfd = os.open(mapped, os.O_RDWR)
+    mem = mmap.mmap(mfd, 4096)
+    try:
+        removed = clean_mod.clean()
+        assert dead in removed and seg in removed
+        assert os.path.isdir(live) and os.path.exists(mapped)
+        # dry run reports without removing
+        would = clean_mod.clean(age=0.0001, dry_run=True)
+        assert mapped in would and os.path.exists(mapped)
+        # the big hammer takes everything of mine
+        mem.close()
+        os.close(mfd)
+        removed = clean_mod.clean(age=0.0001)
+        assert mapped in removed and not os.path.exists(mapped)
+    finally:
+        os.close(rd)
