@@ -46,6 +46,12 @@ _LAZY = {
     "initialized": ("ompi_tpu.mpi.runtime", "initialized"),
     "wtime": ("ompi_tpu.mpi.runtime", "wtime"),
     "wtick": ("ompi_tpu.mpi.runtime", "wtick"),
+    "abort": ("ompi_tpu.mpi.runtime", "abort"),
+    "get_processor_name": ("ompi_tpu.mpi.runtime", "get_processor_name"),
+    "get_version": ("ompi_tpu.mpi.runtime", "get_version"),
+    "get_library_version": ("ompi_tpu.mpi.runtime",
+                            "get_library_version"),
+    "error_string": ("ompi_tpu.mpi.constants", "error_string"),
     "COMM_WORLD": ("ompi_tpu.mpi.runtime", "COMM_WORLD"),
     "COMM_SELF": ("ompi_tpu.mpi.runtime", "COMM_SELF"),
     "Communicator": ("ompi_tpu.mpi.comm", "Communicator"),

@@ -110,3 +110,11 @@ def install_backtrace_handlers(all_threads: bool = True) -> bool:
     except Exception:  # noqa: BLE001 — e.g. no stderr in embedded use
         return False
     return True
+
+
+def host_identity() -> str:
+    """The canonical host identity — what reachability decisions, host
+    keys, and MPI_Get_processor_name all report.  ``OMPI_TPU_FAKE_HOST``
+    (set by the sim plm) overrides the nodename so co-located simulated
+    hosts are genuinely distinct to every consumer at once."""
+    return os.environ.get("OMPI_TPU_FAKE_HOST") or os.uname().nodename

@@ -258,8 +258,9 @@ class ProcBTL:
         self._peer_tokens: dict[int, int] = {}
         # honor simulated host identities: sim-plm ranks on different
         # fake hosts must NOT short-circuit through the address space
-        self.hostname = (os.environ.get("OMPI_TPU_FAKE_HOST")
-                         or os.uname().nodename)
+        from ompi_tpu.core.sysinfo import host_identity
+
+        self.hostname = host_identity()
         with ProcBTL._reg_lock:
             self.token = next(ProcBTL._next_token)
             ProcBTL._registry[self.token] = self

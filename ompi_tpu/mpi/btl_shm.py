@@ -388,8 +388,9 @@ class ShmBTL:
         # OMPI_TPU_FAKE_HOST gives ranks a simulated host identity (set by
         # the sim plm): ranks on different sim-hosts must NOT shm-reach
         # each other, so the cross-host data path runs for real in tests
-        self.hostname = (os.environ.get("OMPI_TPU_FAKE_HOST")
-                         or os.uname().nodename)
+        from ompi_tpu.core.sysinfo import host_identity
+
+        self.hostname = host_identity()
         self.inbox = tempfile.mkdtemp(prefix="otpu-shm-", dir=_shm_dir())
         os.mkfifo(os.path.join(self.inbox, "doorbell"))
         # read end first (a writer's nonblocking open needs a reader)

@@ -621,8 +621,9 @@ class Communicator:
         import os
         import zlib
 
-        name = getattr(self, "_io_host_override", None) \
-            or os.environ.get("OMPI_TPU_FAKE_HOST") or os.uname().nodename
+        from ompi_tpu.core.sysinfo import host_identity
+
+        name = getattr(self, "_io_host_override", None) or host_identity()
         return zlib.crc32(str(name).encode()) & 0x7FFFFFFF
 
     def split_type(self, split_type: int = COMM_TYPE_SHARED, key: int = 0,

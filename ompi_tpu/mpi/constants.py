@@ -30,14 +30,41 @@ IN_PLACE = _InPlace()
 
 # Error classes (subset of MPI_ERR_*)
 SUCCESS = 0
+ERR_BUFFER = 1
 ERR_COMM = 5
 ERR_RANK = 6
 ERR_TAG = 4
 ERR_COUNT = 2
 ERR_TYPE = 3
 ERR_TRUNCATE = 15
+ERR_OTHER = 16
 ERR_PENDING = 18
 ERR_IN_STATUS = 19
+ERR_INTERN = 13
+ERR_IO = 38
+
+_ERROR_STRINGS = {
+    SUCCESS: "no error",
+    ERR_BUFFER: "invalid buffer",
+    ERR_COUNT: "invalid count argument",
+    ERR_TYPE: "invalid datatype argument",
+    ERR_TAG: "invalid tag argument",
+    ERR_COMM: "invalid communicator",
+    ERR_RANK: "invalid rank",
+    ERR_TRUNCATE: "message truncated on receive",
+    ERR_OTHER: "known error not in this list",
+    ERR_INTERN: "internal error",
+    ERR_PENDING: "pending request",
+    ERR_IN_STATUS: "error code in status",
+    ERR_IO: "I/O error",
+}
+
+
+def error_string(error_class: int) -> str:
+    """≈ MPI_Error_string: human text for an error class (the values
+    MPIException.error_class carries)."""
+    return _ERROR_STRINGS.get(int(error_class),
+                              f"unknown error class {error_class}")
 
 
 class MPIException(RuntimeError):

@@ -181,6 +181,59 @@ def _atexit_finalize() -> None:
         pass
 
 
+def abort(errorcode: int = 1, msg: str = "") -> None:
+    """≈ MPI_Abort: terminate ALL ranks of the job, not just this one.
+
+    Under a launcher the abort rides the PMIx control plane (the HNP
+    tears the job down, ≈ orterun's response to PMIx_Abort); a singleton
+    simply exits with the code.  Does not return.
+    """
+    import os
+    import sys
+
+    client = _state.get("client")
+    _log.error("MPI_Abort(%d)%s", errorcode, f": {msg}" if msg else "")
+    if client is not None:
+        try:
+            client.abort(msg or f"MPI_Abort({errorcode})",
+                         status=int(errorcode))
+        except Exception:  # noqa: BLE001 — the exit below still happens
+            pass
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(int(errorcode) & 0xFF or 1)
+
+
+def get_processor_name() -> str:
+    """≈ MPI_Get_processor_name — the host identity the transports use
+    (honors the sim-plm's fake host, so co-located "hosts" report
+    distinct names exactly as reachability sees them)."""
+    import os
+
+    return os.environ.get("OMPI_TPU_FAKE_HOST") or os.uname().nodename
+
+
+#: the MPI standard generation whose semantics this API follows
+_MPI_VERSION = (3, 1)
+
+
+def get_version() -> tuple[int, int]:
+    """≈ MPI_Get_version: (version, subversion) of the MPI semantics."""
+    return _MPI_VERSION
+
+
+def get_library_version() -> str:
+    """≈ MPI_Get_library_version."""
+    from importlib.metadata import PackageNotFoundError, version
+
+    try:
+        v = version("ompi-tpu")
+    except PackageNotFoundError:
+        v = "unknown"
+    return (f"ompi_tpu {v} (MPI {_MPI_VERSION[0]}.{_MPI_VERSION[1]} "
+            f"semantics, TPU-native)")
+
+
 def wtime() -> float:
     """≈ MPI_Wtime: seconds from an arbitrary epoch, monotonic — the
     clock choice lives in the sysinfo timer facade (one definition of

@@ -58,23 +58,33 @@ def _inbox_alive(path: str) -> bool:
     return True
 
 
-def _mapped_somewhere(path: str) -> bool:
-    """True if ANY live process still maps the segment file — the
-    precise liveness signal for mmap-backed artifacts (their mtime never
-    advances after creation, so age alone would hit live windows)."""
+def _mapped_paths() -> Optional[set]:
+    """ONE pass over /proc/*/maps collecting every mapped path that
+    carries our prefixes — the precise liveness signal for mmap-backed
+    artifacts (their mtime never advances after creation, so age alone
+    would hit live windows).  None ⇒ procfs unreadable: prove nothing,
+    keep everything."""
     try:
         pids = [n for n in os.listdir("/proc") if n.isdigit()]
     except OSError:
-        return True   # can't prove anything: keep the file
+        return None
+    mapped: set = set()
     for pid in pids:
         try:
             with open(f"/proc/{pid}/maps", encoding="utf-8",
                       errors="replace") as f:
-                if any(path in line for line in f):
-                    return True
+                for line in f:
+                    if "otpu-" not in line and "/.seg-" not in line:
+                        continue
+                    # path starts at the 6th field; spaces in our names
+                    # never occur (prefix + hex)
+                    idx = line.find("/")
+                    if idx >= 0:
+                        mapped.add(line[idx:].rstrip("\n").rstrip(
+                            " (deleted)"))
         except OSError:
             continue   # other-uid / vanished process
-    return False
+    return mapped
 
 
 def _dead_dvm_uri() -> Optional[str]:
@@ -112,6 +122,7 @@ def scan(age: float = 0.0) -> list[tuple[str, str]]:
     me = os.getuid()
     now = time.time()
     victims: list[tuple[str, str]] = []
+    mapped: Optional[set] = ()   # lazily computed on first segment
     for base in _dirs():
         try:
             names = os.listdir(base)
@@ -138,9 +149,13 @@ def scan(age: float = 0.0) -> list[tuple[str, str]]:
                 # mmap-backed segments: mtime never advances after
                 # creation, so "old" ≠ "idle" — only sweep when no live
                 # process maps the file (plus a short grace for the
-                # create→mmap window)
-                if (now - st.st_mtime > 60
-                        and not _mapped_somewhere(path)):
+                # create→mmap window).  The /proc sweep runs ONCE for
+                # the whole scan, not per candidate.
+                if now - st.st_mtime <= 60:
+                    continue
+                if mapped == ():
+                    mapped = _mapped_paths()
+                if mapped is not None and path not in mapped:
                     victims.append((path, "segment mapped by no process"))
     dead_uri = _dead_dvm_uri()
     if dead_uri is not None:
@@ -159,6 +174,7 @@ def clean(age: float = 0.0, dry_run: bool = False,
     without touching anything.
     """
     removed = []
+    failed = []
     for path, reason in scan(age):
         if report:
             report(f"{'would remove' if dry_run else 'removing'} "
@@ -173,5 +189,12 @@ def clean(age: float = 0.0, dry_run: bool = False,
                 os.unlink(path)       # as cleaned
             removed.append(path)
         except OSError as e:
-            _log.verbose(1, "clean: could not remove %s: %s", path, e)
+            failed.append(path)
+            msg = f"could NOT remove {path}: {e}"
+            if report:
+                report(msg)           # visible, not verbose-only: the
+            _log.error("clean: %s", msg)   # caller believes it cleaned
+    if failed and not dry_run:
+        raise OSError(f"{len(failed)} artifact(s) could not be removed "
+                      f"(removed {len(removed)}): {failed[:3]}")
     return removed

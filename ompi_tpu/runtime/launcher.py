@@ -237,13 +237,20 @@ class LocalLauncher:
         others under ``--stdin all``.
         """
         def pump() -> None:
+            # raw-fd reads, NOT sys.stdin.buffer: a daemon thread blocked
+            # in BufferedReader.read1 holds the buffer lock, and CPython's
+            # shutdown aborts the whole launcher (_enter_buffered_busy,
+            # SIGABRT masking the job's real exit code) when it cannot
+            # reacquire it — os.read involves no Python-level lock
+            import os as _os
+
             try:
-                src = sys.stdin.buffer
-            except AttributeError:
-                src = None  # stdin replaced (pytest capture) — nothing to do
+                fd = sys.stdin.fileno()
+            except (AttributeError, ValueError, OSError):
+                fd = None   # stdin replaced (pytest capture) — nothing here
             try:
-                while src is not None:
-                    chunk = src.read1(1 << 16)
+                while fd is not None:
+                    chunk = _os.read(fd, 1 << 16)
                     if not chunk:
                         break
                     for w in list(self._stdin_sinks.values()):

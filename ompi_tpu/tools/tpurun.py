@@ -81,10 +81,13 @@ def main(argv: list[str] | None = None) -> int:
     if args.clean:
         from ompi_tpu.runtime import clean as clean_mod
 
-        removed = clean_mod.clean(age=args.clean_age,
-                                  dry_run=args.clean_dry_run,
-                                  report=lambda s: print(f"tpurun: {s}",
-                                                         file=sys.stderr))
+        try:
+            removed = clean_mod.clean(
+                age=args.clean_age, dry_run=args.clean_dry_run,
+                report=lambda s: print(f"tpurun: {s}", file=sys.stderr))
+        except OSError as e:
+            print(f"tpurun: {e}", file=sys.stderr)
+            return 1
         verb = "would remove" if args.clean_dry_run else "removed"
         print(f"tpurun: {verb} {len(removed)} stale artifact(s)",
               file=sys.stderr)

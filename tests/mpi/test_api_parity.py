@@ -203,3 +203,47 @@ def test_win_allocate_shared_heterogeneous():
         return None
 
     run_ranks(3, body)
+
+
+def test_env_inquiry_parity():
+    """MPI_Get_processor_name / Get_version / Get_library_version /
+    Error_string — the environment-inquiry family."""
+    import ompi_tpu
+
+    name = ompi_tpu.get_processor_name()
+    assert name and isinstance(name, str)
+    v, sub = ompi_tpu.get_version()
+    assert (v, sub) == (3, 1)
+    lib = ompi_tpu.get_library_version()
+    assert "ompi_tpu" in lib and "3.1" in lib
+    from ompi_tpu.mpi.constants import ERR_TRUNCATE
+
+    assert "truncated" in ompi_tpu.error_string(ERR_TRUNCATE)
+    assert "unknown" in ompi_tpu.error_string(9999)
+
+
+def test_abort_kills_whole_job(tmp_path):
+    """≈ MPI_Abort: one rank aborting must take the WHOLE launched job
+    down with its exit code, not just itself."""
+    import subprocess
+    import sys
+    import textwrap
+
+    app = tmp_path / "aborter.py"
+    app.write_text(textwrap.dedent("""
+        import sys, time
+        import ompi_tpu
+        comm = ompi_tpu.init()
+        if comm.rank == 1:
+            ompi_tpu.abort(7, "test abort")
+        # other ranks would wait forever without the job teardown
+        time.sleep(30)
+        print("rank", comm.rank, "was not killed", flush=True)
+        sys.exit(0)
+    """))
+    out = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-np", "2",
+         sys.executable, str(app)],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode != 0          # job failed, promptly
+    assert "was not killed" not in out.stdout
