@@ -271,7 +271,11 @@ def bench_flagship_mfu(kind: str) -> dict:
     # sp>1 long-context path — on one chip ulysses+flash IS the
     # degenerate ring with none of its permute scaffolding.
     base = dict(vocab=32_000, d_model=2048, n_heads=16, n_layers=8,
-                d_ff=8192, seq=1024, attention="flash")
+                d_ff=8192, seq=1024, attention="flash",
+                # chunked CE: drops the (B,T,V) f32 logits+log-softmax
+                # pair (~4 GiB at batch 16) to O(chunk·V) — parity-tested
+                # vs the full path (test_chunked_ce_matches_full)
+                ce_chunk=128)
     batch, chain, outer = 16, 8, 2
     if on_cpu:  # fallback mode: keep the gate fast; MFU is 0 here anyway
         base.update(d_model=256, n_heads=8, n_layers=2, d_ff=1024, seq=256)

@@ -52,6 +52,19 @@ _LAZY = {
     "get_library_version": ("ompi_tpu.mpi.runtime",
                             "get_library_version"),
     "error_string": ("ompi_tpu.mpi.constants", "error_string"),
+    "error_class": ("ompi_tpu.mpi.constants", "error_class"),
+    "add_error_class": ("ompi_tpu.mpi.constants", "add_error_class"),
+    "add_error_code": ("ompi_tpu.mpi.constants", "add_error_code"),
+    "add_error_string": ("ompi_tpu.mpi.constants", "add_error_string"),
+    "GeneralizedRequest": ("ompi_tpu.mpi.request", "GeneralizedRequest"),
+    "grequest_start": ("ompi_tpu.mpi.request", "grequest_start"),
+    "get_count": ("ompi_tpu.mpi.request", "get_count"),
+    "get_elements": ("ompi_tpu.mpi.request", "get_elements"),
+    "reduce_local": ("ompi_tpu.mpi.op", "reduce_local"),
+    "op_commutative": ("ompi_tpu.mpi.op", "op_commutative"),
+    "publish_name": ("ompi_tpu.mpi.dpm", "publish_name"),
+    "unpublish_name": ("ompi_tpu.mpi.dpm", "unpublish_name"),
+    "lookup_name": ("ompi_tpu.mpi.dpm", "lookup_name"),
     "COMM_WORLD": ("ompi_tpu.mpi.runtime", "COMM_WORLD"),
     "COMM_SELF": ("ompi_tpu.mpi.runtime", "COMM_SELF"),
     "Communicator": ("ompi_tpu.mpi.comm", "Communicator"),

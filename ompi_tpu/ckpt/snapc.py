@@ -27,7 +27,7 @@ from typing import Any, Callable, Optional
 import numpy as np
 
 from ompi_tpu.ckpt.store import SnapshotStore
-from ompi_tpu.mpi.constants import MPIException
+from ompi_tpu.mpi.constants import ERR_IO, MPIException
 
 __all__ = ["checkpoint", "restart", "CheckpointManager"]
 
@@ -73,7 +73,7 @@ def checkpoint(comm, store: SnapshotStore, state: dict[str, Any],
         raise MPIException(
             f"checkpoint {seq} failed"
             + (f" on this rank: {err}" if err else " on a peer rank"),
-            error_class=38)
+            error_class=ERR_IO)
     # commit success must be agreed too: if rank 0's commit throws (e.g. a
     # peer's file not yet visible on a laggy shared fs), a bare barrier
     # would strand every other rank — broadcast the outcome instead
@@ -95,7 +95,7 @@ def checkpoint(comm, store: SnapshotStore, state: dict[str, Any],
         raise MPIException(
             f"checkpoint {seq} commit failed on rank 0"
             + (f": {commit_err}" if commit_err else ""),
-            error_class=38)
+            error_class=ERR_IO)
     return seq
 
 
@@ -115,7 +115,7 @@ def restart(comm, store: SnapshotStore, seq: Optional[int] = None,
         seq = int(np.asarray(chosen)[0])
         if seq < 0:
             raise MPIException("no committed snapshot to restart from",
-                               error_class=38)
+                               error_class=ERR_IO)
     state = store.load_rank(seq, comm.rank)
     if restore_fn is not None:
         state = {k: restore_fn(k, v) for k, v in state.items()}

@@ -27,7 +27,7 @@ from typing import Any, Optional
 
 import numpy as np
 
-from ompi_tpu.mpi.constants import MPIException
+from ompi_tpu.mpi.constants import ERR_IO, MPIException
 
 __all__ = ["SnapshotStore", "StagedStore", "ShardedSnapshotStore"]
 
@@ -86,7 +86,7 @@ class SnapshotStore:
         if missing:
             raise MPIException(
                 f"commit of snapshot {seq}: rank files missing for "
-                f"{missing}", error_class=38)
+                f"{missing}", error_class=ERR_IO)
         meta = {"seq": seq, "nranks": nranks, "time": time.time(),
                 "status": "committed"}
         if extra:
@@ -130,7 +130,7 @@ class SnapshotStore:
         meta = self.metadata(seq)
         if meta is None:
             raise MPIException(
-                f"snapshot {seq} is not committed", error_class=38)
+                f"snapshot {seq} is not committed", error_class=ERR_IO)
         path = self._rank_file(seq, rank)
         try:
             with np.load(path) as z:
@@ -138,7 +138,7 @@ class SnapshotStore:
         except OSError as e:
             raise MPIException(
                 f"loading snapshot {seq} rank {rank}: {e}",
-                error_class=38) from None
+                error_class=ERR_IO) from None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -328,7 +328,7 @@ class ShardedSnapshotStore(SnapshotStore):
         meta = self.metadata(seq)
         if meta is None:
             raise MPIException(
-                f"snapshot {seq} is not committed", error_class=38)
+                f"snapshot {seq} is not committed", error_class=ERR_IO)
         r = self.comm.rank if rank is None else int(rank)
         out: dict[str, np.ndarray] = {}
         from ompi_tpu.mpi.info import Info

@@ -42,6 +42,13 @@ class TransformerConfig:
     moe_experts: int = 0
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    # chunked cross-entropy: >0 computes the loss over sequence chunks of
+    # this length without materializing the full (B, T, V) logits/log-
+    # softmax pair — at vocab 32k that pair is the single largest HBM
+    # tensor in the train step (f32, ~4 GiB at batch 16 / seq 1024).
+    # Each chunk's logits are recomputed in the backward (jax.checkpoint),
+    # so peak memory drops from O(T·V) to O(chunk·V).  0 = full path.
+    ce_chunk: int = 0
     compute_dtype: Any = "bfloat16"
     # jax.checkpoint policy per layer — HBM ↔ FLOPs trade:
     #   True/"full" = save only layer inputs (max recompute, min HBM);
@@ -130,10 +137,11 @@ def _rope(x, positions):
     return rot.astype(x.dtype)
 
 
-def _local_forward(cfg: TransformerConfig, comm, params, tokens):
-    """Per-device forward inside shard_map.
+def _local_backbone(cfg: TransformerConfig, comm, params, tokens):
+    """Per-device forward through the final rmsnorm (everything except the
+    unembed matmul).
 
-    tokens: (B/dp, S/sp) int32.  Returns (logits (B/dp, S/sp, V) float32,
+    tokens: (B/dp, S/sp) int32.  Returns (h (B/dp, S/sp, D) compute-dtype,
     aux) — aux is the summed MoE load-balancing loss (0.0 for dense).
     """
     import jax
@@ -206,11 +214,58 @@ def _local_forward(cfg: TransformerConfig, comm, params, tokens):
         layer_fn = layer
     h, aux = lax.scan(layer_fn, h, layer_params)
     h = _rmsnorm(h, params["lnf"])
+    return h, aux.sum()
+
+
+def _local_forward(cfg: TransformerConfig, comm, params, tokens):
+    """Per-device forward inside shard_map.
+
+    tokens: (B/dp, S/sp) int32.  Returns (logits (B/dp, S/sp, V) float32,
+    aux) — aux is the summed MoE load-balancing loss (0.0 for dense).
+    """
+    import jax.numpy as jnp
+
+    h, aux = _local_backbone(cfg, comm, params, tokens)
+    cdt = jnp.dtype(cfg.compute_dtype)
     # unembed on the MXU in compute dtype, f32 accumulation — a f32×f32
     # matmul here would run at a fraction of the bf16 rate
     logits = jnp.einsum("btd,vd->btv", h, params["emb"].astype(cdt),
                         preferred_element_type=jnp.float32)
-    return logits, aux.sum()
+    return logits, aux
+
+
+def _chunked_nll_sum(cfg: TransformerConfig, h, emb, labels, weight):
+    """Σ weight·nll over the local shard WITHOUT materializing the full
+    (B, T, V) logits: lax.scan over sequence chunks, each chunk's logits
+    recomputed in the backward (jax.checkpoint around the chunk body).
+
+    h: (B, T, D) compute dtype; emb: (V, D) f32; labels: (B, T) int32;
+    weight: (B, T) f32.  Returns a f32 scalar.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    B, T, D = h.shape
+    c = cfg.ce_chunk
+    n = T // c
+    emb_c = emb.astype(h.dtype)
+
+    def body(acc, inp):
+        h_c, lab_c, w_c = inp  # (B, c, D), (B, c), (B, c)
+        logits = jnp.einsum("btd,vd->btv", h_c, emb_c,
+                            preferred_element_type=jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        lab_logit = jnp.take_along_axis(
+            logits, lab_c[..., None], axis=-1)[..., 0]
+        return acc + ((lse - lab_logit) * w_c).sum(), None
+
+    hs = jnp.moveaxis(h.reshape(B, n, c, D), 1, 0)
+    labs = jnp.moveaxis(labels.reshape(B, n, c), 1, 0)
+    ws = jnp.moveaxis(weight.reshape(B, n, c), 1, 0)
+    total, _ = lax.scan(jax.checkpoint(body), jnp.zeros((), jnp.float32),
+                        (hs, labs, ws))
+    return total
 
 
 def _local_loss(cfg: TransformerConfig, comm, params, tokens):
@@ -223,7 +278,6 @@ def _local_loss(cfg: TransformerConfig, comm, params, tokens):
     sp = int(comm.mesh.shape["sp"])
     T = tokens.shape[1]
     sp_idx = lax.axis_index("sp")
-    logits, aux = _local_forward(cfg, comm, params, tokens)
 
     # labels: tokens shifted left by one *global* position
     first_col = tokens[:, :1]
@@ -235,9 +289,18 @@ def _local_loss(cfg: TransformerConfig, comm, params, tokens):
     positions = sp_idx * T + jnp.arange(T)
     weight = (positions < cfg.seq - 1).astype(jnp.float32)[None, :]
 
-    logprobs = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logprobs, labels[..., None], axis=-1)[..., 0]
-    local_sum = (nll * weight).sum()
+    if cfg.ce_chunk and T % cfg.ce_chunk == 0:
+        h, aux = _local_backbone(cfg, comm, params, tokens)
+        B = tokens.shape[0]
+        local_sum = _chunked_nll_sum(
+            cfg, h, params["emb"], labels,
+            jnp.broadcast_to(weight, (B, T)))
+    else:
+        logits, aux = _local_forward(cfg, comm, params, tokens)
+        logprobs = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(
+            logprobs, labels[..., None], axis=-1)[..., 0]
+        local_sum = (nll * weight).sum()
     local_cnt = weight.sum() * tokens.shape[0]
     total = lax.psum(local_sum, ("dp", "sp"))
     count = lax.psum(local_cnt, ("dp", "sp"))

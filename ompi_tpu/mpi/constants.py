@@ -41,6 +41,9 @@ ERR_OTHER = 16
 ERR_PENDING = 18
 ERR_IN_STATUS = 19
 ERR_INTERN = 13
+ERR_NAME = 33     # MPI_ERR_NAME: service name not published
+ERR_SERVICE = 41  # MPI_ERR_SERVICE: publish/unpublish failure
+ERR_PORT = 27     # MPI_ERR_PORT: invalid/unknown port name
 ERR_IO = 38
 
 _ERROR_STRINGS = {
@@ -56,8 +59,50 @@ _ERROR_STRINGS = {
     ERR_INTERN: "internal error",
     ERR_PENDING: "pending request",
     ERR_IN_STATUS: "error code in status",
+    ERR_NAME: "service name not published",
+    ERR_SERVICE: "name service operation failed",
+    ERR_PORT: "invalid port name",
     ERR_IO: "I/O error",
 }
+
+
+# Dynamic error classes/codes (≈ ompi/errhandler/errcode.c's user space):
+# user classes/codes are allocated above LASTCODE so they never collide
+# with the predefined table.
+LASTUSEDCODE = 100  # ≈ MPI_LASTUSEDCODE attribute's initial value
+_user_next = [LASTUSEDCODE + 1]
+_user_class_of: dict[int, int] = {}   # code → its error class
+
+
+def add_error_class() -> int:
+    """≈ MPI_Add_error_class: allocate a fresh user error class."""
+    cls = _user_next[0]
+    _user_next[0] += 1
+    _user_class_of[cls] = cls
+    return cls
+
+
+def add_error_code(error_class: int) -> int:
+    """≈ MPI_Add_error_code: allocate a fresh code in ``error_class``
+    (predefined or user-added)."""
+    code = _user_next[0]
+    _user_next[0] += 1
+    _user_class_of[code] = int(error_class)
+    return code
+
+
+def add_error_string(code: int, text: str) -> None:
+    """≈ MPI_Add_error_string for a user-added class/code."""
+    if int(code) not in _user_class_of:
+        raise MPIException(
+            f"add_error_string: {code} was not user-added", error_class=3)
+    _ERROR_STRINGS[int(code)] = str(text)
+
+
+def error_class(code: int) -> int:
+    """≈ MPI_Error_class: the class a (possibly user-added) code maps to;
+    predefined codes are their own class here."""
+    return _user_class_of.get(int(code), int(code))
 
 
 def error_string(error_class: int) -> str:

@@ -72,6 +72,8 @@ class Datatype:
     base_np: np.dtype  # element dtype for op/reduction typing
 
     _committed = False
+    combiner: str = "named"          # ≈ MPI_COMBINER_* (envelope)
+    _contents: Optional[dict] = None  # constructor args (get_contents)
 
     def commit(self) -> "Datatype":
         """Compile the layout (≈ MPI_Type_commit → opal_datatype_commit)."""
@@ -81,6 +83,43 @@ class Datatype:
     @property
     def committed(self) -> bool:
         return self._committed
+
+    # -- introspection (≈ type_get_envelope.c / type_get_contents.c) ------
+
+    def get_envelope(self) -> dict:
+        """≈ MPI_Type_get_envelope: the combiner this type was built with
+        plus argument counts (integers / byte-addresses / datatypes)."""
+        if self._contents is None:
+            return {"combiner": "named", "n_integers": 0, "n_addresses": 0,
+                    "n_datatypes": 0}
+        ni = na = nd = 0
+        for k, v in self._contents.items():
+            addr = k in _ADDRESS_KEYS
+            if isinstance(v, Datatype):
+                nd += 1
+            elif isinstance(v, (list, tuple)):
+                if v and all(isinstance(x, Datatype) for x in v):
+                    nd += len(v)
+                elif addr:
+                    na += len(v)
+                else:
+                    ni += len(v)
+            elif addr:
+                na += 1
+            else:
+                ni += 1
+        return {"combiner": self.combiner, "n_integers": ni,
+                "n_addresses": na, "n_datatypes": nd}
+
+    def get_contents(self) -> dict:
+        """≈ MPI_Type_get_contents: the constructor arguments, by name
+        (datatype-valued entries are the live input type objects).
+        Erroneous on predefined types, as in MPI."""
+        if self._contents is None:
+            raise MPIException(
+                "get_contents on a predefined (named) datatype",
+                error_class=3)
+        return dict(self._contents)
 
     # -- layout queries ---------------------------------------------------
 
@@ -224,29 +263,40 @@ class Datatype:
     # -- constructors (≈ ompi_datatype.h:178-197) -------------------------
 
     def contiguous(self, count: int) -> "DerivedDatatype":
-        return DerivedDatatype._mk_contiguous(count, self)
+        return _stamp(DerivedDatatype._mk_contiguous(count, self),
+                      "contiguous", count=count, datatype=self)
 
     def vector(self, count: int, blocklength: int, stride: int) -> "DerivedDatatype":
-        return DerivedDatatype._mk_vector(count, blocklength, stride, self)
+        return _stamp(
+            DerivedDatatype._mk_vector(count, blocklength, stride, self),
+            "vector", count=count, blocklength=blocklength, stride=stride,
+            datatype=self)
 
     def hvector(self, count: int, blocklength: int,
                 byte_stride: int) -> "DerivedDatatype":
         """≈ MPI_Type_create_hvector: stride in BYTES."""
-        return DerivedDatatype(
+        return _stamp(DerivedDatatype(
             self, [(i * byte_stride, blocklength) for i in range(count)],
             pattern_unit="bytes",
-            name=f"hvector({count},{blocklength},{byte_stride}B)")
+            name=f"hvector({count},{blocklength},{byte_stride}B)"),
+            "hvector", count=count, blocklength=blocklength,
+            byte_stride=byte_stride, datatype=self)
 
     def indexed(self, blocklengths: Sequence[int],
                 displacements: Sequence[int]) -> "DerivedDatatype":
-        return DerivedDatatype._mk_indexed(blocklengths, displacements, self)
+        return _stamp(
+            DerivedDatatype._mk_indexed(blocklengths, displacements, self),
+            "indexed", blocklengths=list(blocklengths),
+            displacements=list(displacements), datatype=self)
 
     def indexed_block(self, blocklength: int,
                       displacements: Sequence[int]) -> "DerivedDatatype":
         """≈ MPI_Type_create_indexed_block: one blocklength for all."""
-        return DerivedDatatype(
+        return _stamp(DerivedDatatype(
             self, [(d, blocklength) for d in displacements],
-            name=f"indexed_block({blocklength},{len(displacements)})")
+            name=f"indexed_block({blocklength},{len(displacements)})"),
+            "indexed_block", blocklength=blocklength,
+            displacements=list(displacements), datatype=self)
 
     def hindexed(self, blocklengths: Sequence[int],
                  byte_displacements: Sequence[int]) -> "DerivedDatatype":
@@ -254,25 +304,41 @@ class Datatype:
         if len(blocklengths) != len(byte_displacements):
             raise MPIException(
                 "hindexed: blocklengths/displacements mismatch")
-        return DerivedDatatype(
+        return _stamp(DerivedDatatype(
             self, list(zip(byte_displacements, blocklengths)),
-            pattern_unit="bytes", name=f"hindexed({len(blocklengths)})")
+            pattern_unit="bytes", name=f"hindexed({len(blocklengths)})"),
+            "hindexed", blocklengths=list(blocklengths),
+            byte_displacements=list(byte_displacements), datatype=self)
 
     def hindexed_block(self, blocklength: int,
                        byte_displacements: Sequence[int]) -> "DerivedDatatype":
         """≈ MPI_Type_create_hindexed_block."""
-        return DerivedDatatype(
+        return _stamp(DerivedDatatype(
             self, [(d, blocklength) for d in byte_displacements],
             pattern_unit="bytes",
-            name=f"hindexed_block({blocklength},{len(byte_displacements)})")
+            name=f"hindexed_block({blocklength},{len(byte_displacements)})"),
+            "hindexed_block", blocklength=blocklength,
+            byte_displacements=list(byte_displacements), datatype=self)
 
     def resized(self, extent: int) -> "DerivedDatatype":
-        return DerivedDatatype._mk_resized(self, extent)
+        return _stamp(DerivedDatatype._mk_resized(self, extent),
+                      "resized", extent=extent, datatype=self)
 
     def subarray(self, sizes: Sequence[int], subsizes: Sequence[int],
                  starts: Sequence[int], order: str = "C") -> "DerivedDatatype":
         """≈ MPI_Type_create_subarray (C or Fortran order)."""
         return create_subarray(sizes, subsizes, starts, self, order)
+
+
+# arg names whose values are byte addresses/extents (envelope "addresses")
+_ADDRESS_KEYS = {"byte_displacements", "byte_stride", "extent"}
+
+
+def _stamp(dt: "Datatype", combiner: str, **contents) -> "Datatype":
+    """Record envelope/contents metadata on a freshly built datatype."""
+    dt.combiner = combiner
+    dt._contents = contents
+    return dt
 
 
 def _merge_runs(segs: list[tuple[int, int]]) -> list[tuple[int, int]]:
@@ -482,7 +548,10 @@ def create_struct(blocklengths: Sequence[int],
                   byte_displacements: Sequence[int],
                   datatypes: Sequence[Datatype]) -> StructDatatype:
     """≈ MPI_Type_create_struct."""
-    return StructDatatype(blocklengths, byte_displacements, datatypes)
+    return _stamp(StructDatatype(blocklengths, byte_displacements, datatypes),
+                  "struct", blocklengths=list(blocklengths),
+                  byte_displacements=list(byte_displacements),
+                  datatypes=list(datatypes))
 
 
 def create_subarray(sizes: Sequence[int], subsizes: Sequence[int],
@@ -492,6 +561,8 @@ def create_subarray(sizes: Sequence[int], subsizes: Sequence[int],
     Extent spans the WHOLE array (MPI semantics), so count>1 tiles whole
     arrays."""
     nd = len(sizes)
+    orig_args = dict(sizes=list(sizes), subsizes=list(subsizes),
+                     starts=list(starts), order=order, datatype=base)
     if not (len(subsizes) == len(starts) == nd):
         raise MPIException("subarray: sizes/subsizes/starts rank mismatch")
     for d in range(nd):
@@ -520,7 +591,7 @@ def create_subarray(sizes: Sequence[int], subsizes: Sequence[int],
     dt = DerivedDatatype(
         base, pattern, extent=int(np.prod(sizes)) * base.extent,
         name=f"subarray({tuple(subsizes)}/{tuple(sizes)})")
-    return dt
+    return _stamp(dt, "subarray", **orig_args)
 
 
 # distribution constants (≈ mpi.h MPI_DISTRIBUTE_*)
@@ -563,6 +634,9 @@ def create_darray(size: int, rank: int, gsizes: Sequence[int],
     distributed n-d array (HPF rules).  Process grid is row-major over
     psizes (MPI order)."""
     nd = len(gsizes)
+    orig_args = dict(size=size, rank=rank, gsizes=list(gsizes),
+                     distribs=list(distribs), dargs=list(dargs),
+                     psizes=list(psizes), order=order, datatype=base)
     if not (len(distribs) == len(dargs) == len(psizes) == nd):
         raise MPIException("darray: argument rank mismatch")
     if int(np.prod(psizes)) != size:
@@ -606,9 +680,10 @@ def create_darray(size: int, rank: int, gsizes: Sequence[int],
             pattern[-1] = (pattern[-1][0], pattern[-1][1] + 1)
         else:
             pattern.append((off, 1))
-    return DerivedDatatype(
+    return _stamp(DerivedDatatype(
         base, pattern, extent=int(np.prod(gsizes)) * base.extent,
-        name=f"darray(rank {rank}/{size}, {tuple(gsizes)})")
+        name=f"darray(rank {rank}/{size}, {tuple(gsizes)})"),
+        "darray", **orig_args)
 
 
 # -- external32: the canonical big-endian interchange format ---------------

@@ -38,15 +38,18 @@ import numpy as np
 
 from ompi_tpu.core import dss
 from ompi_tpu.mpi.comm import Communicator, _INTERNAL_TAG_BASE as _ITAG_BASE
-from ompi_tpu.mpi.constants import ANY_TAG, PROC_NULL, MPIException
+from ompi_tpu.mpi.constants import (ANY_TAG, ERR_NAME, ERR_PORT, ERR_SERVICE,
+                                    PROC_NULL, MPIException)
 from ompi_tpu.mpi.group import Group
 from ompi_tpu.mpi import op as op_mod
 from ompi_tpu.mpi.request import Request, Status
 
 __all__ = ["Intercomm", "open_port", "close_port", "accept", "connect",
-           "spawn", "get_parent", "ENV_PARENT_PORT"]
+           "spawn", "get_parent", "ENV_PARENT_PORT",
+           "publish_name", "unpublish_name", "lookup_name"]
 
 ENV_PARENT_PORT = "OMPI_TPU_PARENT_PORT"
+ENV_NAME_DIR = "OMPI_TPU_NAME_DIR"
 
 _DPM_CID_BASE = 1 << 20
 # combined tcp+shm business cards carry a filesystem path; 192B covers the
@@ -97,6 +100,76 @@ def close_port(name: str) -> None:
     p = _ports.pop(name, None)
     if p is not None:
         p.close()
+
+
+# ---------------------------------------------------------------------------
+# name service (≈ MPI_Publish_name / MPI_Lookup_name / MPI_Unpublish_name,
+# ompi/mpi/c/publish_name.c → pmix publish; the ompi-server/orte-data-server
+# role).  Realized as an atomic file registry so independently-launched jobs
+# on a host (or on a shared filesystem) can rendezvous without a standing
+# server — set OMPI_TPU_NAME_DIR to a shared path for cross-host lookup.
+# ---------------------------------------------------------------------------
+
+def _name_dir() -> str:
+    import tempfile
+
+    d = os.environ.get(ENV_NAME_DIR)
+    if not d:
+        d = os.path.join(tempfile.gettempdir(),
+                         f"ompi_tpu_names-{os.getuid()}")
+    os.makedirs(d, mode=0o700, exist_ok=True)
+    return d
+
+
+def _name_path(service_name: str) -> str:
+    # service names are user strings; encode to a safe filename
+    import base64
+
+    enc = base64.urlsafe_b64encode(service_name.encode()).decode()
+    return os.path.join(_name_dir(), enc)
+
+
+def publish_name(service_name: str, port_name: str) -> None:
+    """≈ MPI_Publish_name: bind ``service_name`` → ``port_name``.  Raises
+    ERR_SERVICE if already published.  Publication is atomic (write-then-
+    link): a concurrent lookup_name either sees the complete port or
+    nothing — never a half-written file."""
+    import tempfile
+
+    path = _name_path(service_name)
+    fd, tmp = tempfile.mkstemp(dir=_name_dir(), prefix=".pub-")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(port_name)
+        try:
+            os.link(tmp, path)  # atomic + fails if already published
+        except FileExistsError:
+            raise MPIException(
+                f"publish_name: {service_name!r} is already published",
+                error_class=ERR_SERVICE)
+    finally:
+        os.unlink(tmp)
+
+
+def lookup_name(service_name: str) -> str:
+    """≈ MPI_Lookup_name → the published port name (ERR_NAME if absent)."""
+    try:
+        with open(_name_path(service_name)) as f:
+            return f.read()
+    except FileNotFoundError:
+        raise MPIException(
+            f"lookup_name: {service_name!r} is not published",
+            error_class=ERR_NAME)
+
+
+def unpublish_name(service_name: str) -> None:
+    """≈ MPI_Unpublish_name (ERR_SERVICE if not currently published)."""
+    try:
+        os.unlink(_name_path(service_name))
+    except FileNotFoundError:
+        raise MPIException(
+            f"unpublish_name: {service_name!r} is not published",
+            error_class=ERR_SERVICE)
 
 
 def _send_blob(sock: socket.socket, obj: Any) -> None:
@@ -428,7 +501,8 @@ def accept(comm: Communicator, port_name: Optional[str]) -> Intercomm:
     if comm.rank == 0:
         port = _ports.get(port_name)
         if port is None:
-            raise MPIException(f"unknown port {port_name}", error_class=38)
+            raise MPIException(f"unknown port {port_name}",
+                               error_class=ERR_PORT)
         conn, _ = port.sock.accept()
         sock = conn
     try:

@@ -40,7 +40,7 @@ import numpy as np
 
 from ompi_tpu.core.config import VarType, register_var, var_registry
 from ompi_tpu.mpi import datatype as dt_mod
-from ompi_tpu.mpi.constants import MPIException
+from ompi_tpu.mpi.constants import ERR_IO, MPIException
 from ompi_tpu.mpi.datatype import Datatype
 from ompi_tpu.mpi.request import CompletedRequest, Request
 
@@ -325,7 +325,7 @@ class _IndividualSharedFp:
         return MPIException(
             "sharedfp/individual supports only write_shared and the "
             "ordered collectives; shared-pointer reads/seeks need the "
-            "sm or lockedfile component", error_class=38)
+            "sm or lockedfile component", error_class=ERR_IO)
 
     def load(self) -> int:
         raise self._unsupported()
@@ -480,7 +480,7 @@ class File:
                 raise MPIException(
                     f"MPI_File_open({path}): "
                     f"{err or 'exclusive create failed on rank 0'}",
-                    error_class=38)
+                    error_class=ERR_IO)
             if comm.rank != 0:
                 try:
                     self._fd = os.open(self.path, flags & ~os.O_CREAT)
@@ -503,7 +503,7 @@ class File:
                 self._fd = None
             raise MPIException(
                 f"MPI_File_open({path}): failed on {nfail} rank(s)"
-                + (f": {err}" if err else ""), error_class=38)
+                + (f": {err}" if err else ""), error_class=ERR_IO)
         if amode & MODE_APPEND:
             self._pos = os.fstat(self._fd).st_size // self.view.etype.size
         # shared file pointer: pick a sharedfp component collectively,
@@ -652,7 +652,7 @@ class File:
             os.unlink(path)
         except OSError as e:
             raise MPIException(f"MPI_File_delete({path}): {e}",
-                               error_class=38) from None
+                               error_class=ERR_IO) from None
 
     def set_size(self, size: int) -> None:
         """≈ MPI_File_set_size — collective."""
@@ -716,19 +716,19 @@ class File:
 
     def _check_open(self) -> None:
         if self._closed:
-            self._err(MPIException("file is closed", error_class=38))
+            self._err(MPIException("file is closed", error_class=ERR_IO))
 
     def _check_read(self) -> None:
         self._check_open()
         if self.amode & MODE_WRONLY:
             self._err(MPIException("file opened write-only",
-                                   error_class=38))
+                                   error_class=ERR_IO))
 
     def _check_write(self) -> None:
         self._check_open()
         if not self.amode & (MODE_WRONLY | MODE_RDWR):
             self._err(MPIException("file opened read-only",
-                                   error_class=38))
+                                   error_class=ERR_IO))
 
     def _as_bytes(self, data: Any) -> bytes:
         arr = np.asarray(data)
@@ -1116,7 +1116,7 @@ class File:
             raise MPIException(
                 f"shared file pointer unavailable: the "
                 f"{self._shfp.name} component could not be set up at "
-                f"open ({self._shfp_err})", error_class=38)
+                f"open ({self._shfp_err})", error_class=ERR_IO)
 
     def _shfp_load(self) -> int:
         self._shfp_guard()

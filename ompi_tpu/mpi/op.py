@@ -20,7 +20,7 @@ from ompi_tpu.mpi.constants import MPIException
 
 __all__ = ["Op", "SUM", "PROD", "MAX", "MIN", "LAND", "LOR", "LXOR",
            "BAND", "BOR", "BXOR", "MAXLOC", "MINLOC", "REPLACE", "NO_OP",
-           "create_op"]
+           "create_op", "reduce_local", "op_commutative"]
 
 
 class Op:
@@ -98,3 +98,21 @@ def create_op(fn: Callable, commutative: bool = False,
     """MPI_Op_create: user-defined reduction (host fn mandatory; pass
     device_fn — a jax-traceable function — to use it in device collectives)."""
     return Op(name, fn, device_fn, commutative=commutative)
+
+
+def reduce_local(inbuf: Any, inoutbuf: np.ndarray, op: Op) -> np.ndarray:
+    """≈ MPI_Reduce_local (reduce_local.c): inoutbuf = op(inbuf, inoutbuf),
+    in place, no communication.  MPI argument order: inbuf is the FIRST
+    operand (matters for non-commutative ops)."""
+    a = np.asarray(inbuf)
+    if a.shape != inoutbuf.shape:
+        raise MPIException(
+            f"reduce_local: shape mismatch {a.shape} vs {inoutbuf.shape}",
+            error_class=2)
+    inoutbuf[...] = op.host(a, inoutbuf)
+    return inoutbuf
+
+
+def op_commutative(op: Op) -> bool:
+    """≈ MPI_Op_commutative."""
+    return bool(op.commutative)

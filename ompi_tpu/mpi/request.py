@@ -26,8 +26,10 @@ from ompi_tpu.mpi.constants import MPIException
 # for free-threaded interpreters where the tradeoff flips.
 _SPIN_S = 0.0
 
-__all__ = ["Request", "Status", "PersistentRequest", "wait_all", "wait_any",
-           "wait_some", "test_all", "test_any", "test_some", "start_all"]
+__all__ = ["Request", "Status", "PersistentRequest", "GeneralizedRequest",
+           "grequest_start", "get_elements", "get_count", "wait_all",
+           "wait_any", "wait_some", "test_all", "test_any", "test_some",
+           "start_all"]
 
 
 class Status:
@@ -38,10 +40,48 @@ class Status:
         self.tag: int = -1
         self.error: int = 0
         self.count: int = 0
+        self._cancelled: bool = False
+        self._elements: Optional[int] = None  # set_elements override
+
+    def set_cancelled(self, flag: bool) -> None:
+        """≈ MPI_Status_set_cancelled (for generalized requests)."""
+        self._cancelled = bool(flag)
+
+    def is_cancelled(self) -> bool:
+        """≈ MPI_Test_cancelled."""
+        return self._cancelled
+
+    def set_elements(self, datatype, count: int) -> None:
+        """≈ MPI_Status_set_elements: make a later get_count() report
+        ``count`` items of ``datatype`` (generalized-request plumbing);
+        Status.count itself stays in basic elements."""
+        self._elements = int(count) * datatype.elements_per_item
 
     def __repr__(self) -> str:
         return (f"Status(source={self.source}, tag={self.tag}, "
                 f"count={self.count}, error={self.error})")
+
+
+def get_elements(status: Status, datatype) -> int:
+    """≈ MPI_Get_elements: received count in BASIC elements.  Status.count
+    is already kept in basic elements by the PML; a Status.set_elements
+    override (generalized requests) takes precedence."""
+    if status._elements is not None:
+        return status._elements
+    return int(status.count)
+
+
+def get_count(status: Status, datatype) -> int:
+    """≈ MPI_Get_count: received count in whole ``datatype`` items, or
+    UNDEFINED (-32766) when the byte count isn't a whole number of items
+    (MPI semantics for partial trailing items)."""
+    elems = get_elements(status, datatype)
+    per = datatype.elements_per_item
+    if per == 0:
+        return 0
+    if elems % per:
+        return -32766  # MPI_UNDEFINED
+    return elems // per
 
 
 class Request:
@@ -212,6 +252,65 @@ class CompletedRequest(Request):
     def __init__(self, result: Any = None, kind: str = "null") -> None:
         super().__init__(kind)
         self.complete(result)
+
+
+class GeneralizedRequest(Request):
+    """≈ MPI generalized request (grequest_start.c, ompi/request/grequest.c):
+    a user-defined operation wrapped in MPI request semantics.
+
+    The user signals completion with ``.complete()`` (≈
+    MPI_Grequest_complete).  When a wait/test observes completion, the
+    ``query_fn(extra_state, status)`` runs to fill the status — exactly
+    once per wait that returns it, per the MPI contract.  ``cancel_fn``
+    receives ``complete=`` telling it whether the operation had already
+    completed.  ``free_fn`` runs when the request is freed (after the
+    wait that returns it, or an explicit .free())."""
+
+    def __init__(self, query_fn: Optional[Callable] = None,
+                 free_fn: Optional[Callable] = None,
+                 cancel_fn: Optional[Callable] = None,
+                 extra_state: Any = None) -> None:
+        super().__init__(kind="generalized")
+        self._query_fn = query_fn
+        self._free_fn = free_fn
+        self._cancel_fn = cancel_fn
+        self.extra_state = extra_state
+        self._freed = False
+
+    def wait(self, timeout: Optional[float] = None) -> Any:
+        out = super().wait(timeout=timeout)
+        if self._query_fn is not None:
+            self._query_fn(self.extra_state, self.status)
+        self.free()
+        return out
+
+    def test(self) -> bool:
+        if not self._flag:
+            return False
+        # completed: a successful test has wait semantics for grequests
+        self.wait()
+        return True
+
+    def cancel(self) -> None:
+        if self._cancel_fn is not None:
+            self._cancel_fn(self.extra_state, complete=self._flag)
+        self.cancelled = True
+        self.status.set_cancelled(True)
+
+    def free(self) -> None:
+        """≈ MPI_Request_free on a generalized request."""
+        if not self._freed:
+            self._freed = True
+            if self._free_fn is not None:
+                self._free_fn(self.extra_state)
+
+
+def grequest_start(query_fn: Optional[Callable] = None,
+                   free_fn: Optional[Callable] = None,
+                   cancel_fn: Optional[Callable] = None,
+                   extra_state: Any = None) -> GeneralizedRequest:
+    """≈ MPI_Grequest_start."""
+    return GeneralizedRequest(query_fn, free_fn, cancel_fn, extra_state)
 
 
 def wait_all(requests: Sequence[Request],

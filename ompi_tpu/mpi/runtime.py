@@ -208,9 +208,9 @@ def get_processor_name() -> str:
     """≈ MPI_Get_processor_name — the host identity the transports use
     (honors the sim-plm's fake host, so co-located "hosts" report
     distinct names exactly as reachability sees them)."""
-    import os
+    from ompi_tpu.core.sysinfo import host_identity
 
-    return os.environ.get("OMPI_TPU_FAKE_HOST") or os.uname().nodename
+    return host_identity()
 
 
 #: the MPI standard generation whose semantics this API follows

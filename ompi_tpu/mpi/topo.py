@@ -279,6 +279,36 @@ def cart_create(comm, dims: Sequence[int],
     return new
 
 
+def cart_map(comm, dims: Sequence[int],
+             periods: Optional[Sequence[bool]] = None,
+             mesh_shape: Optional[Sequence[int]] = None) -> int:
+    """≈ MPI_Cart_map: the cart rank this process WOULD get under the
+    reorder mapping (the same fold `cart_create(reorder=True)` applies),
+    or UNDEFINED (-32766) when it doesn't belong to the grid."""
+    dims = [int(d) for d in dims]
+    n = int(np.prod(dims)) if dims else 1
+    if n > comm.size:
+        raise MPIException(
+            f"cart of {n} ranks > comm size {comm.size}", error_class=3)
+    order = _fold_reorder(comm, dims, mesh_shape)
+    # order[cart_rank] = parent rank placed there; invert for my cart rank
+    for cart_rank, parent in enumerate(order):
+        if parent == comm.rank:
+            return cart_rank
+    return -32766  # MPI_UNDEFINED: not part of the grid
+
+
+def graph_map(comm, index: Sequence[int], edges: Sequence[int]) -> int:
+    """≈ MPI_Graph_map: identity placement (the base component's choice —
+    topo_base_graph_map.c does the same), UNDEFINED beyond nnodes."""
+    nnodes = len(index)
+    if nnodes > comm.size:
+        raise MPIException(
+            f"graph of {nnodes} ranks > comm size {comm.size}",
+            error_class=3)
+    return comm.rank if comm.rank < nnodes else -32766
+
+
 def cart_sub(comm, remain_dims: Sequence[bool]):
     """≈ MPI_Cart_sub — split the cart into lower-dim slices (collective)."""
     topo = _topo_of(comm, "cart")

@@ -80,8 +80,8 @@ def _mapped_paths() -> Optional[set]:
                     # never occur (prefix + hex)
                     idx = line.find("/")
                     if idx >= 0:
-                        mapped.add(line[idx:].rstrip("\n").rstrip(
-                            " (deleted)"))
+                        mapped.add(line[idx:].rstrip("\n")
+                                   .removesuffix(" (deleted)"))
         except OSError:
             continue   # other-uid / vanished process
     return mapped

@@ -1,0 +1,281 @@
+"""API parity batch: generalized requests, dynamic error classes, type
+envelope/contents, Reduce_local / Op_commutative, Get_count/Get_elements +
+Status set_* plumbing, Cart_map/Graph_map, and the name service
+(Publish/Lookup/Unpublish_name) — the reference's remaining small MPI-3.1
+surfaces (grequest_start.c, add_error_class.c, type_get_envelope.c,
+reduce_local.c, get_count.c, cart_map.c, publish_name.c)."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ompi_tpu.mpi import constants as C
+from ompi_tpu.mpi import datatype as dtmod
+from ompi_tpu.mpi import dpm
+from ompi_tpu.mpi import op as opmod
+from ompi_tpu.mpi import topo
+from ompi_tpu.mpi.constants import MPIException
+from ompi_tpu.mpi.request import (GeneralizedRequest, Status, get_count,
+                                  get_elements, grequest_start)
+from tests.mpi.harness import run_ranks
+
+
+# ---------------------------------------------------------------------------
+# generalized requests (≈ MPI_Grequest_start/complete)
+# ---------------------------------------------------------------------------
+
+def test_grequest_complete_then_wait_runs_hooks():
+    events = []
+
+    def query(state, status):
+        events.append(("query", state))
+        status.set_elements(dtmod.INT32, 3)
+
+    def free(state):
+        events.append(("free", state))
+
+    req = grequest_start(query_fn=query, free_fn=free, extra_state="s0")
+    assert not req.test()
+    req.complete("payload")
+    assert req.wait() == "payload"
+    assert ("query", "s0") in events and ("free", "s0") in events
+    # status carries what query set: 3 INT32 items
+    assert get_count(req.status, dtmod.INT32) == 3
+
+
+def test_grequest_completed_from_another_thread():
+    req = GeneralizedRequest()
+    threading.Thread(
+        target=lambda: (time.sleep(0.05), req.complete(42)),
+        daemon=True).start()
+    assert req.wait(timeout=5.0) == 42
+
+
+def test_grequest_cancel_reports_completion_state():
+    seen = {}
+
+    def cancel(state, complete):
+        seen["complete"] = complete
+
+    req = grequest_start(cancel_fn=cancel)
+    req.cancel()
+    assert seen["complete"] is False
+    assert req.status.is_cancelled()
+
+
+def test_grequest_free_runs_once():
+    count = [0]
+    req = grequest_start(free_fn=lambda s: count.__setitem__(0, count[0] + 1))
+    req.complete()
+    req.wait()
+    req.free()  # second free: no double-run
+    assert count[0] == 1
+
+
+# ---------------------------------------------------------------------------
+# dynamic error classes (≈ MPI_Add_error_class/code/string)
+# ---------------------------------------------------------------------------
+
+def test_add_error_class_code_string():
+    cls = C.add_error_class()
+    assert cls > C.LASTUSEDCODE
+    code = C.add_error_code(cls)
+    assert code != cls and C.error_class(code) == cls
+    C.add_error_string(code, "flux capacitor misaligned")
+    assert C.error_string(code) == "flux capacitor misaligned"
+    # predefined classes are their own class and keep their strings
+    assert C.error_class(C.ERR_TRUNCATE) == C.ERR_TRUNCATE
+    assert "truncated" in C.error_string(C.ERR_TRUNCATE)
+    with pytest.raises(MPIException):
+        C.add_error_string(C.ERR_COMM, "nope")  # not user-added
+
+
+# ---------------------------------------------------------------------------
+# Reduce_local / Op_commutative
+# ---------------------------------------------------------------------------
+
+def test_reduce_local_inplace_and_order():
+    a = np.array([1, 2, 3], np.int32)
+    b = np.array([10, 20, 30], np.int32)
+    out = opmod.reduce_local(a, b, opmod.SUM)
+    assert out is b and list(b) == [11, 22, 33]
+    # non-commutative user op: inbuf must be the FIRST operand
+    sub = opmod.create_op(lambda x, y: x - y, commutative=False)
+    b2 = np.array([1, 1, 1], np.int32)
+    opmod.reduce_local(np.array([5, 6, 7], np.int32), b2, sub)
+    assert list(b2) == [4, 5, 6]
+    with pytest.raises(MPIException):
+        opmod.reduce_local(np.zeros(2, np.int32), b2, opmod.SUM)
+
+
+def test_op_commutative_query():
+    assert opmod.op_commutative(opmod.SUM)
+    assert not opmod.op_commutative(opmod.REPLACE)
+    assert not opmod.op_commutative(
+        opmod.create_op(lambda x, y: x - y, commutative=False))
+
+
+# ---------------------------------------------------------------------------
+# Get_count / Get_elements on a real receive
+# ---------------------------------------------------------------------------
+
+def test_get_count_and_elements_on_recv_status():
+    def fn(comm):
+        if comm.rank == 0:
+            comm.send(np.arange(6, dtype=np.float64), dest=1, tag=7)
+            return None
+        st = Status()
+        comm.recv(source=0, tag=7, status=st)
+        pair = dtmod.FLOAT64.contiguous(2)  # 2 basic elements per item
+        return (get_elements(st, dtmod.FLOAT64), get_count(st, dtmod.FLOAT64),
+                get_count(st, pair))
+
+    res = run_ranks(2, fn)
+    assert res[1] == (6, 6, 3)
+
+
+def test_get_count_partial_item_is_undefined():
+    st = Status()
+    st.count = 5  # basic elements
+    triple = dtmod.INT32.contiguous(3)
+    assert get_count(st, triple) == C.UNDEFINED
+    assert get_elements(st, triple) == 5
+
+
+# ---------------------------------------------------------------------------
+# Type_get_envelope / Type_get_contents
+# ---------------------------------------------------------------------------
+
+def test_envelope_named_and_vector():
+    env = dtmod.INT32.get_envelope()
+    assert env["combiner"] == "named"
+    with pytest.raises(MPIException):
+        dtmod.INT32.get_contents()
+    v = dtmod.FLOAT32.vector(3, 2, 4)
+    env = v.get_envelope()
+    assert env["combiner"] == "vector"
+    assert env["n_integers"] == 3 and env["n_datatypes"] == 1
+    cont = v.get_contents()
+    assert (cont["count"], cont["blocklength"], cont["stride"]) == (3, 2, 4)
+    assert cont["datatype"] is dtmod.FLOAT32
+
+
+def test_envelope_struct_and_hindexed_addresses():
+    s = dtmod.create_struct([1, 2], [0, 8], [dtmod.INT32, dtmod.FLOAT64])
+    env = s.get_envelope()
+    assert env["combiner"] == "struct"
+    assert env["n_addresses"] == 2 and env["n_datatypes"] == 2
+    assert s.get_contents()["datatypes"][1] is dtmod.FLOAT64
+    h = dtmod.INT32.hindexed([1, 1], [0, 16])
+    assert h.get_envelope()["combiner"] == "hindexed"
+    assert h.get_envelope()["n_addresses"] == 2
+
+
+def test_envelope_subarray_darray_reconstructible():
+    """get_contents must return the ORIGINAL args (pre any internal
+    reordering) — rebuilding from them gives an identical layout."""
+    sub = dtmod.FLOAT32.subarray([4, 6], [2, 3], [1, 2], order="F")
+    cont = sub.get_contents()
+    rebuilt = cont["datatype"].subarray(
+        cont["sizes"], cont["subsizes"], cont["starts"], cont["order"])
+    assert rebuilt.segments() == sub.segments()
+    da = dtmod.create_darray(4, 2, [8], [dtmod.DISTRIBUTE_BLOCK], [-1], [4],
+                             dtmod.INT32)
+    cont = da.get_contents()
+    assert cont["rank"] == 2
+    rebuilt = dtmod.create_darray(
+        cont["size"], cont["rank"], cont["gsizes"], cont["distribs"],
+        cont["dargs"], cont["psizes"], cont["datatype"], cont["order"])
+    assert rebuilt.segments() == da.segments()
+
+
+# ---------------------------------------------------------------------------
+# Cart_map / Graph_map
+# ---------------------------------------------------------------------------
+
+def test_cart_map_identity_and_mesh_fold():
+    def fn(comm):
+        ident = topo.cart_map(comm, [2, 2])
+        folded = topo.cart_map(comm, [2, 2], mesh_shape=[2, 2])
+        return ident, folded
+
+    res = run_ranks(4, fn)
+    assert [r[0] for r in res] == [0, 1, 2, 3]
+    # fold with matching mesh axes is a permutation covering all ranks
+    assert sorted(r[1] for r in res) == [0, 1, 2, 3]
+
+
+def test_cart_map_undefined_beyond_grid():
+    def fn(comm):
+        return topo.cart_map(comm, [3])
+
+    res = run_ranks(4, fn)
+    assert res[3] == C.UNDEFINED and res[:3] == [0, 1, 2]
+
+
+def test_graph_map():
+    def fn(comm):
+        return topo.graph_map(comm, [1, 2], [1, 0])  # 2-node graph
+
+    res = run_ranks(3, fn)
+    assert res == [0, 1, C.UNDEFINED]
+
+
+# ---------------------------------------------------------------------------
+# name service
+# ---------------------------------------------------------------------------
+
+def test_publish_lookup_unpublish(tmp_path, monkeypatch):
+    monkeypatch.setenv(dpm.ENV_NAME_DIR, str(tmp_path))
+    dpm.publish_name("ocean/service", "127.0.0.1:4242")
+    assert dpm.lookup_name("ocean/service") == "127.0.0.1:4242"
+    with pytest.raises(MPIException):
+        dpm.publish_name("ocean/service", "other")  # double publish
+    dpm.unpublish_name("ocean/service")
+    with pytest.raises(MPIException):
+        dpm.lookup_name("ocean/service")
+    with pytest.raises(MPIException):
+        dpm.unpublish_name("ocean/service")
+
+
+def test_name_service_bridges_connect_accept(tmp_path, monkeypatch):
+    """The MPI-2 pattern: server publishes its port under a service name,
+    client looks it up and connects — no out-of-band port exchange."""
+    monkeypatch.setenv(dpm.ENV_NAME_DIR, str(tmp_path))
+
+    def server(comm):
+        port = dpm.open_port()
+        dpm.publish_name("calc", port)
+        inter = dpm.accept(comm, port)
+        got = inter.recv(source=0, tag=5)
+        inter.send(np.asarray(got) * 2, dest=0, tag=6)
+        dpm.unpublish_name("calc")
+        dpm.close_port(port)
+
+    def client(comm):
+        deadline = time.time() + 10
+        while True:
+            try:
+                port = dpm.lookup_name("calc")
+                break
+            except MPIException:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.02)
+        inter = dpm.connect(comm, port)
+        inter.send(np.array([21], np.int64), dest=0, tag=5)
+        return int(np.asarray(inter.recv(source=0, tag=6))[0])
+
+    out = {}
+    ts = threading.Thread(
+        target=lambda: run_ranks(1, server), daemon=True)
+    tc = threading.Thread(
+        target=lambda: out.update(r=run_ranks(1, client)), daemon=True)
+    ts.start(); tc.start()
+    ts.join(timeout=30); tc.join(timeout=30)
+    assert not ts.is_alive() and not tc.is_alive()
+    assert out["r"][0] == 42

@@ -66,6 +66,26 @@ def test_ring_equals_gathered_loss():
     np.testing.assert_allclose(float(l_ring), float(l_gath), rtol=1e-5)
 
 
+def test_chunked_ce_matches_full():
+    """ce_chunk must be numerically invisible: same loss AND same grads
+    as the full-logits path (it only changes memory/scheduling)."""
+    import dataclasses
+
+    mesh = _mesh222()
+    params = tfm.init_params(CFG)
+    toks = _tokens(CFG)
+    cfg_c = dataclasses.replace(CFG, ce_chunk=8)  # 32/sp=16 local → 2 chunks
+    l_full, g_full = jax.jit(
+        jax.value_and_grad(tfm.make_loss_fn(CFG, mesh)))(params, toks)
+    l_chunk, g_chunk = jax.jit(
+        jax.value_and_grad(tfm.make_loss_fn(cfg_c, mesh)))(params, toks)
+    np.testing.assert_allclose(float(l_full), float(l_chunk), rtol=1e-6)
+    for k in g_full:
+        np.testing.assert_allclose(
+            np.asarray(g_full[k]), np.asarray(g_chunk[k]),
+            rtol=2e-5, atol=1e-6, err_msg=k)
+
+
 def test_train_step_decreases_loss():
     mesh = _mesh222()
     params = tfm.init_params(CFG)

@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Flagship MFU config sweep on the live backend.
+
+Runs each (batch, ce_chunk, remat, attention) config in a fresh subprocess
+with its own wall-clock budget — a hung compile (the round-4 tunnel failure
+mode: remote compile helper stalling >500s) costs one config, not the sweep.
+Appends one JSON line per config to MFU_SWEEP.jsonl.
+
+Usage:  python tools/mfu_sweep.py            # full grid
+        python tools/mfu_sweep.py --quick    # the two head-to-head configs
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "MFU_SWEEP.jsonl")
+
+CHILD = r"""
+import json, sys, time
+import numpy as np
+cfg = json.loads(sys.argv[1])
+t0 = time.time()
+import jax
+sys.path.insert(0, {repo!r})
+from ompi_tpu.models.transformer import TransformerConfig
+from ompi_tpu.parallel.mesh import make_mesh
+from bench import _time_train_loop, _peak_flops
+
+kind = jax.devices()[0].platform
+mesh = make_mesh({{"dp": 1, "sp": 1, "tp": 1}}, devices=jax.devices()[:1])
+base = dict(vocab=32_000, d_model=2048, n_heads=16, n_layers=8,
+            d_ff=8192, seq=1024)
+batch = cfg.pop("batch")
+chain = cfg.pop("chain", 8)
+outer = cfg.pop("outer", 2)
+base.update(cfg)
+rng = np.random.default_rng(0)
+tokens = rng.integers(0, base["vocab"], size=(batch, base["seq"])).astype(np.int32)
+t_dev = time.time()
+dt, n_params, loss = _time_train_loop(
+    TransformerConfig(**base, compute_dtype="bfloat16"), mesh, tokens,
+    chain, outer)
+n_tokens = tokens.size
+fpt = 6 * n_params + 12 * base["n_layers"] * base["d_model"] * base["seq"]
+peak = _peak_flops(kind)
+mfu = (fpt * n_tokens / dt / peak) if peak else 0.0
+print("RESULT " + json.dumps({{
+    "batch": batch, **{{k: v for k, v in cfg.items()}},
+    "backend": kind, "mfu_pct": round(mfu * 100, 2),
+    "step_ms": round(dt * 1e3, 2), "tokens_per_s": round(n_tokens / dt, 1),
+    "loss": round(float(loss), 4), "params": n_params,
+    "import_s": round(t_dev - t0, 1), "wall_s": round(time.time() - t0, 1),
+}}))
+""".format(repo=REPO)
+
+GRID = [
+    # (label, config, per-config budget seconds)
+    ("b16-chunk128-dots", {"batch": 16, "ce_chunk": 128, "remat": "dots",
+                           "attention": "flash"}, 1500),
+    ("b16-chunk128-noremat", {"batch": 16, "ce_chunk": 128, "remat": None,
+                              "attention": "flash"}, 1500),
+    ("b32-chunk128-dots", {"batch": 32, "ce_chunk": 128, "remat": "dots",
+                           "attention": "flash", "chain": 4}, 1800),
+    ("b32-chunk128-noremat", {"batch": 32, "ce_chunk": 128, "remat": None,
+                              "attention": "flash", "chain": 4}, 1800),
+    ("b16-full-dots", {"batch": 16, "ce_chunk": 0, "remat": "dots",
+                       "attention": "flash"}, 1500),  # r4 preflight repro
+]
+
+QUICK = [GRID[0], GRID[2]]
+
+
+def run_one(label: str, cfg: dict, budget: float) -> dict:
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", CHILD, json.dumps(cfg)],
+            capture_output=True, text=True, timeout=budget, cwd=REPO)
+        for line in proc.stdout.splitlines():
+            if line.startswith("RESULT "):
+                rec = json.loads(line[len("RESULT "):])
+                rec["label"] = label
+                return rec
+        return {"label": label, "error": "no result",
+                "rc": proc.returncode,
+                "stderr_tail": proc.stderr[-800:],
+                "wall_s": round(time.time() - t0, 1)}
+    except subprocess.TimeoutExpired:
+        return {"label": label, "error": f"timeout after {budget}s",
+                "wall_s": round(time.time() - t0, 1)}
+
+
+def main() -> None:
+    grid = QUICK if "--quick" in sys.argv else GRID
+    for label, cfg, budget in grid:
+        print(f"[sweep] {label} (budget {budget}s) ...", flush=True)
+        rec = run_one(label, dict(cfg), budget)
+        rec["ts"] = time.strftime("%Y-%m-%dT%H:%MZ", time.gmtime())
+        with open(OUT, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        print(f"[sweep] {label}: {json.dumps(rec)}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

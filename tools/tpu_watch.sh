@@ -1,0 +1,16 @@
+#!/bin/bash
+# Watch for the TPU tunnel to come back, then run the MFU sweep once.
+# Detached helper for the round-4 build session; state in /tmp/tpuwatch.
+mkdir -p /tmp/tpuwatch
+cd /root/repo
+while true; do
+  if timeout 300 python -c "import jax; d=jax.devices(); assert d[0].platform=='tpu', d" \
+       >/tmp/tpuwatch/probe.log 2>&1; then
+    echo "$(date -u +%FT%TZ) tpu up — starting sweep" >> /tmp/tpuwatch/status
+    python tools/mfu_sweep.py >> /tmp/tpuwatch/sweep.log 2>&1
+    echo "$(date -u +%FT%TZ) sweep done rc=$?" >> /tmp/tpuwatch/status
+    break
+  fi
+  echo "$(date -u +%FT%TZ) tpu down" >> /tmp/tpuwatch/status
+  sleep 120
+done
