@@ -43,6 +43,7 @@ COLL_FUNCTIONS = (
     "barrier", "bcast", "reduce", "allreduce", "gather", "allgather",
     "scatter", "alltoall", "reduce_scatter", "reduce_scatter_block", "scan",
     "exscan", "gatherv", "scatterv", "allgatherv", "alltoallv",
+    "alltoallw",
 )
 
 # slots whose first argument is a data buffer (everything but barrier)

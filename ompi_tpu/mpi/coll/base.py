@@ -48,6 +48,7 @@ TAG_SCATTERV = 12
 TAG_ALLGATHERV = 13
 TAG_ALLTOALLV = 14
 TAG_EXSCAN = 15
+TAG_ALLTOALLW = 16
 
 
 def _fold(op: Op, lo: np.ndarray, hi: np.ndarray) -> np.ndarray:
@@ -519,6 +520,47 @@ def alltoallv_pairwise(comm, sendparts) -> list:
         out[frm] = comm._coll_irecv(None, frm, TAG_ALLTOALLV).wait()
         sreq.wait()
     return out  # type: ignore[return-value]
+
+
+def pack_spec(spec) -> np.ndarray:
+    """(buf, datatype, count) triple → packed uint8 array (None → empty).
+    The shared half of the Alltoallw-family wire format."""
+    if spec is None:
+        return np.empty(0, np.uint8)
+    buf, dt, count = spec
+    return np.frombuffer(dt.pack(np.asarray(buf), count), np.uint8)
+
+
+def unpack_spec(spec, data) -> None:
+    """Packed bytes → the spec's buffer via its datatype (None → no-op)."""
+    if spec is None:
+        return
+    buf, dt, count = spec
+    dt.unpack(np.asarray(data, np.uint8).tobytes(), buf, count)
+
+
+def alltoallw_pairwise(comm, sendspecs, recvspecs) -> None:
+    """≈ MPI_Alltoallw (the fully general alltoall: per-peer datatype +
+    count on BOTH sides — ompi/mpi/c/alltoallw.c).  ``sendspecs[i]`` /
+    ``recvspecs[i]`` are ``(buf, datatype, count)`` triples (or None for
+    an empty exchange with that peer); each block is packed with its send
+    datatype and unpacked into the receiver's buffer with the receiver's
+    datatype, exercising the full convertor path per pair."""
+    size, rank = comm.size, comm.rank
+    if len(sendspecs) != size or len(recvspecs) != size:
+        from ompi_tpu.mpi.constants import MPIException
+
+        raise MPIException(
+            f"alltoallw: {len(sendspecs)}/{len(recvspecs)} specs for "
+            f"{size} ranks")
+    unpack_spec(recvspecs[rank], pack_spec(sendspecs[rank]))
+    for step in range(1, size):
+        to = (rank + step) % size
+        frm = (rank - step) % size
+        sreq = comm._coll_isend(pack_spec(sendspecs[to]), to, TAG_ALLTOALLW)
+        got = comm._coll_irecv(None, frm, TAG_ALLTOALLW).wait()
+        sreq.wait()
+        unpack_spec(recvspecs[frm], got)
 
 
 # ---------------------------------------------------------------------------

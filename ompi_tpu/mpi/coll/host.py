@@ -193,3 +193,6 @@ class HostColl(HostCollBase):
 
     def coll_alltoallv(self, comm, sendparts):
         return base.alltoallv_pairwise(comm, sendparts)
+
+    def coll_alltoallw(self, comm, sendspecs, recvspecs):
+        return base.alltoallw_pairwise(comm, sendspecs, recvspecs)

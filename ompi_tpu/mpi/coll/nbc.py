@@ -44,8 +44,12 @@ class Round:
                  sends: tuple = (),
                  recvs: tuple = (),
                  compute: Optional[Callable[[dict], None]] = None) -> None:
-        self.sends = sends    # ((buf_fn(state) -> array, peer), ...)
-        self.recvs = recvs    # ((peer, state_key), ...)
+        # sends: ((buf_fn(state) -> array, peer), ...) — an optional third
+        # element is an ABSOLUTE coll tag overriding the schedule's own
+        # (neighbor collectives need the edge-slot tag discipline of
+        # topo._send_slot); recvs: ((peer, state_key[, abs_tag]), ...)
+        self.sends = sends
+        self.recvs = recvs
         self.compute = compute
 
 
@@ -73,12 +77,16 @@ class NbcRequest(Request):
         pending = []
         # post receives first (the reference posts recvs before sends in a
         # round to keep the unexpected queue short)
-        for peer, key in rnd.recvs:
+        for entry in rnd.recvs:
+            peer, key = entry[0], entry[1]
+            tag = entry[2] if len(entry) > 2 else self._tag
             pending.append(
-                (self._comm._coll_irecv(None, peer, self._tag), key))
-        for buf_fn, peer in rnd.sends:
+                (self._comm._coll_irecv(None, peer, tag), key))
+        for entry in rnd.sends:
+            buf_fn, peer = entry[0], entry[1]
+            tag = entry[2] if len(entry) > 2 else self._tag
             buf = np.asarray(buf_fn(self._state))
-            pending.append((self._comm._coll_isend(buf, peer, self._tag),
+            pending.append((self._comm._coll_isend(buf, peer, tag),
                             None))
         self._pending = pending
 
@@ -516,6 +524,110 @@ def iallgatherv(comm, sendbuf) -> NbcRequest:
         return [state[f"b{r}"] if r != rank else mine for r in range(size)]
 
     return _launch(comm, rounds, result, "iallgatherv")
+
+
+def igatherv(comm, sendbuf, root: int = 0) -> NbcRequest:
+    """Linear, variable block shapes: root collects one array per rank."""
+    size, rank = comm.size, comm.rank
+    mine = np.asarray(sendbuf)
+    if size == 1:
+        return _launch(comm, [], _const([mine]), "igatherv")
+    if rank == root:
+        def result(state):
+            return [state[f"p{r}"] if r != root else mine
+                    for r in range(size)]
+
+        rounds = [Round(recvs=tuple((r, f"p{r}") for r in range(size)
+                                    if r != root))]
+        return _launch(comm, rounds, result, "igatherv")
+    return _launch(comm, [Round(sends=((_const(mine), root),))],
+                   _const(None), "igatherv")
+
+
+def iscatterv(comm, sendparts, root: int = 0) -> NbcRequest:
+    """Linear, variable block shapes: root sends sendparts[r] to rank r."""
+    size, rank = comm.size, comm.rank
+    if size == 1:
+        return _launch(comm, [], _const(np.asarray(sendparts[0])),
+                       "iscatterv")
+    if rank == root:
+        if len(sendparts) != size:
+            from ompi_tpu.mpi.constants import MPIException
+
+            raise MPIException(
+                f"iscatterv: {len(sendparts)} blocks for {size} ranks")
+        rounds = [Round(sends=tuple(
+            (_const(np.asarray(sendparts[r])), r)
+            for r in range(size) if r != root))]
+        return _launch(comm, rounds, _const(np.asarray(sendparts[root])),
+                       "iscatterv")
+    return _launch(comm, [Round(recvs=((root, "p"),))], lambda s: s["p"],
+                   "iscatterv")
+
+
+def ireduce_scatter_block(comm, sendbuf, op: Op) -> NbcRequest:
+    """Reduce then scatter equal blocks: ireduce to 0 + iscatter rounds
+    chained (the libnbc composition for the _block variant)."""
+    size, rank = comm.size, comm.rank
+    mine = np.asarray(sendbuf)
+    if mine.shape[0] % size:
+        from ompi_tpu.mpi.constants import MPIException
+
+        raise MPIException(
+            f"ireduce_scatter_block: axis 0 ({mine.shape[0]}) not "
+            f"divisible by {size}")
+    if size == 1:
+        return _launch(comm, [], _const(mine), "ireduce_scatter_block")
+    # stage 1: everyone sends their r-th block to rank r; stage 2 is local
+    blocks = np.split(mine, size, axis=0)
+    rounds = [Round(
+        sends=tuple((_const(blocks[r]), r) for r in range(size)
+                    if r != rank),
+        recvs=tuple((r, f"b{r}") for r in range(size) if r != rank))]
+
+    def result(state):
+        # fold in RANK order — required for non-commutative ops (same
+        # contract as ireduce_scatter's non-commutative branch)
+        acc = None
+        for r in range(size):
+            b = blocks[rank] if r == rank else state[f"b{r}"]
+            b = np.asarray(b).reshape(blocks[rank].shape).astype(
+                blocks[rank].dtype, copy=False)
+            acc = b if acc is None else op.host(acc, b)
+        return acc
+
+    return _launch(comm, rounds, result, "ireduce_scatter_block")
+
+
+def ialltoallw(comm, sendspecs, recvspecs) -> NbcRequest:
+    """Nonblocking Alltoallw: packed per-peer blocks exchanged in one
+    linear round; receive datatypes unpack into the caller's buffers at
+    completion."""
+    from ompi_tpu.mpi.coll.base import pack_spec, unpack_spec
+
+    size, rank = comm.size, comm.rank
+    if len(sendspecs) != size or len(recvspecs) != size:
+        from ompi_tpu.mpi.constants import MPIException
+
+        raise MPIException(
+            f"ialltoallw: {len(sendspecs)}/{len(recvspecs)} specs for "
+            f"{size} ranks")
+    if size == 1:
+        unpack_spec(recvspecs[0], pack_spec(sendspecs[0]))
+        return _launch(comm, [], _const(None), "ialltoallw")
+    rounds = [Round(
+        sends=tuple((_const(pack_spec(sendspecs[r])), r)
+                    for r in range(size) if r != rank),
+        recvs=tuple((r, f"b{r}") for r in range(size) if r != rank))]
+
+    def result(state):
+        unpack_spec(recvspecs[rank], pack_spec(sendspecs[rank]))
+        for r in range(size):
+            if r != rank:
+                unpack_spec(recvspecs[r], state[f"b{r}"])
+        return None
+
+    return _launch(comm, rounds, result, "ialltoallw")
 
 
 def ialltoallv(comm, sendparts) -> NbcRequest:

@@ -72,3 +72,10 @@ class SelfColl(Component):
 
     def coll_alltoallv(self, comm, sendparts):
         return [np.asarray(sendparts[0])]
+
+    def coll_alltoallw(self, comm, sendspecs, recvspecs):
+        from ompi_tpu.mpi.coll.base import pack_spec, unpack_spec
+
+        if sendspecs[0] is not None:
+            unpack_spec(recvspecs[0], pack_spec(sendspecs[0]))
+        return None

@@ -422,6 +422,11 @@ class Communicator:
     def alltoallv(self, sendparts):
         return self.coll.alltoallv(self, sendparts)
 
+    def alltoallw(self, sendspecs, recvspecs) -> None:
+        """≈ MPI_Alltoallw: per-peer (buf, datatype, count) triples on both
+        sides (None = empty exchange); receive buffers filled in place."""
+        return self.coll.alltoallw(self, sendspecs, recvspecs)
+
     # -- nonblocking collectives (libnbc-style schedules) ------------------
 
     def ibarrier(self) -> Request:
@@ -494,6 +499,27 @@ class Communicator:
 
         return nbc.ialltoallv(self, sendparts)
 
+    def igatherv(self, sendbuf, root: int = 0) -> Request:
+        from ompi_tpu.mpi.coll import nbc
+
+        return nbc.igatherv(self, sendbuf, root)
+
+    def iscatterv(self, sendparts, root: int = 0) -> Request:
+        from ompi_tpu.mpi.coll import nbc
+
+        return nbc.iscatterv(self, sendparts, root)
+
+    def ireduce_scatter_block(self, sendbuf, op=None) -> Request:
+        from ompi_tpu.mpi import op as op_mod
+        from ompi_tpu.mpi.coll import nbc
+
+        return nbc.ireduce_scatter_block(self, sendbuf, op or op_mod.SUM)
+
+    def ialltoallw(self, sendspecs, recvspecs) -> Request:
+        from ompi_tpu.mpi.coll import nbc
+
+        return nbc.ialltoallw(self, sendspecs, recvspecs)
+
     # -- device path binding (coll/xla) ------------------------------------
 
     def bind_device(self, device_comm) -> "Communicator":
@@ -513,6 +539,42 @@ class Communicator:
             return next(self._cid_counter)
 
     # -- attribute caching (≈ ompi/attribute: keyvals w/ callbacks) --------
+
+    def get_group(self) -> Group:
+        """≈ MPI_Comm_group."""
+        return self.group
+
+    def get_name(self) -> str:
+        """≈ MPI_Comm_get_name."""
+        return self.name
+
+    def set_name(self, name: str) -> None:
+        """≈ MPI_Comm_set_name."""
+        self.name = str(name)
+
+    def test_inter(self) -> bool:
+        """≈ MPI_Comm_test_inter (Intercomm overrides to True)."""
+        return False
+
+    def set_info(self, info) -> None:
+        """≈ MPI_Comm_set_info: attach hints (stored; consulted by the
+        layers that define comm hints)."""
+        self.info = info
+
+    def get_info(self):
+        """≈ MPI_Comm_get_info."""
+        from ompi_tpu.mpi.info import Info
+
+        return getattr(self, "info", None) or Info()
+
+    def dup_with_info(self, info, name: Optional[str] = None
+                      ) -> "Communicator":
+        """≈ MPI_Comm_dup_with_info: dup, replacing (not inheriting) the
+        info hints."""
+        new = self.dup(name=name)
+        if new is not None:
+            new.info = info
+        return new
 
     def set_attr(self, keyval, value: Any) -> None:
         """≈ MPI_Comm_set_attr."""

@@ -121,6 +121,30 @@ class Datatype:
                 error_class=3)
         return dict(self._contents)
 
+    def get_extent(self) -> tuple[int, int]:
+        """≈ MPI_Type_get_extent → (lb, extent).  This layout model has no
+        negative lower bounds; lb is always 0 and resized() adjusts only
+        the extent."""
+        return 0, self.extent
+
+    def get_true_extent(self) -> tuple[int, int]:
+        """≈ MPI_Type_get_true_extent → (true_lb, true_extent): the span
+        actually touched by the data, ignoring the declared extent."""
+        segs = self.segments()
+        if not segs:
+            return 0, 0
+        lo = min(off for off, _ in segs)
+        hi = max(off + ln for off, ln in segs)
+        return lo, hi - lo
+
+    def get_name(self) -> str:
+        """≈ MPI_Type_get_name."""
+        return getattr(self, "name", type(self).__name__)
+
+    def set_name(self, name: str) -> None:
+        """≈ MPI_Type_set_name."""
+        self.name = str(name)
+
     # -- layout queries ---------------------------------------------------
 
     def segments(self) -> list[tuple[int, int]]:
@@ -720,6 +744,51 @@ def _swap_stream(dt: Datatype, data: bytes, count: int) -> bytes:
         out[pos:pos + nb] = chunk.byteswap().tobytes()
         pos += nb
     return bytes(out)
+
+
+def pack_size(count: int, dt: Datatype) -> int:
+    """≈ MPI_Pack_size: an upper bound on the packed bytes for ``count``
+    items (exact here — this convertor adds no envelope)."""
+    return int(count) * dt.size
+
+
+def pack_external_size(dt: Datatype, count: int = 1) -> int:
+    """≈ MPI_Pack_external_size ("external32"): same payload bytes — the
+    canonical stream only byte-swaps, never pads."""
+    return int(count) * dt.size
+
+
+def type_match_size(typeclass: str, size: int) -> Datatype:
+    """≈ MPI_Type_match_size: the predefined type of ``typeclass``
+    ("integer" | "real" | "complex") with exactly ``size`` bytes."""
+    table = {
+        "integer": {1: "INT8", 2: "INT16", 4: "INT32", 8: "INT64"},
+        "real": {2: "FLOAT16", 4: "FLOAT32", 8: "FLOAT64"},
+        "complex": {8: "COMPLEX64", 16: "COMPLEX128"},
+    }
+    try:
+        return globals()[table[typeclass.lower()][int(size)]]
+    except KeyError:
+        raise MPIException(
+            f"type_match_size: no {typeclass} type of {size} bytes",
+            error_class=3) from None
+
+
+def get_address(buf: np.ndarray) -> int:
+    """≈ MPI_Get_address: the base address of a buffer (useful for
+    computing struct byte displacements between fields)."""
+    return np.asarray(buf).__array_interface__["data"][0]
+
+
+def alloc_mem(nbytes: int) -> np.ndarray:
+    """≈ MPI_Alloc_mem: an aligned byte buffer.  There is no registered-
+    memory fast path on this transport set (SURVEY §2.2 mpool row), so
+    this is an ordinary page-aligned numpy allocation."""
+    return np.zeros(int(nbytes), np.uint8)
+
+
+def free_mem(buf: np.ndarray) -> None:
+    """≈ MPI_Free_mem (allocation is GC-managed; provided for parity)."""
 
 
 def pack_external(dt: Datatype, buf, count: int = 1) -> bytes:

@@ -45,7 +45,8 @@ from ompi_tpu.mpi import op as op_mod
 from ompi_tpu.mpi.request import Request, Status
 
 __all__ = ["Intercomm", "open_port", "close_port", "accept", "connect",
-           "spawn", "get_parent", "ENV_PARENT_PORT",
+           "spawn", "spawn_multiple", "get_parent", "intercomm_create",
+           "join", "ENV_PARENT_PORT",
            "publish_name", "unpublish_name", "lookup_name"]
 
 ENV_PARENT_PORT = "OMPI_TPU_PARENT_PORT"
@@ -381,6 +382,25 @@ class Intercomm:
 
     # -- merge (≈ MPI_Intercomm_merge) -------------------------------------
 
+    def test_inter(self) -> bool:
+        """≈ MPI_Comm_test_inter."""
+        return True
+
+    def remote_group(self) -> Group:
+        """≈ MPI_Comm_remote_group: the remote side's ids as a Group."""
+        return Group(self.remote_ids)
+
+    def get_group(self) -> Group:
+        """≈ MPI_Comm_group: the LOCAL group."""
+        return self.local_comm.group
+
+    def disconnect(self) -> None:
+        """≈ MPI_Comm_disconnect: collective over the local group; waits
+        for pending traffic (p2p requests complete before returning here)
+        then drops the intercomm's local resources."""
+        self.local_comm.barrier()
+        self.remote_ids = []
+
     def merge(self, high: Optional[bool] = None) -> Communicator:
         """Collective on both groups: one intracommunicator, low group's
         ranks first (each process addresses members via its own namespace
@@ -492,6 +512,87 @@ def _finish_side(comm: Communicator, port_sock: Optional[socket.socket],
 
 _spawned: list = []   # Popen handles of spawned launchers (not reaped here)
 
+# intercomm_create cids live in their own window above the connect/accept
+# block so the two families never collide
+_ICC_CID_BASE = 1 << 21
+
+
+def intercomm_create(local_comm: Communicator, local_leader: int,
+                     bridge_comm: Communicator, remote_leader: int,
+                     tag: int = 0) -> Intercomm:
+    """≈ MPI_Intercomm_create: build an intercommunicator from two
+    disjoint groups of ONE world, leaders exchanging group info over
+    ``bridge_comm`` p2p (dpm.c's same-job path — no sockets, no business
+    cards: both groups already share the namespace and transports)."""
+    me_leader = local_comm.rank == local_leader
+    if me_leader:
+        mine = np.array([local_comm.world_rank(r)
+                         for r in range(local_comm.size)], np.int64)
+        seq = _next_dpm_seq()
+        hdr = np.array([seq, len(mine)], np.int64)
+        sreq = bridge_comm.isend(np.concatenate([hdr, mine]),
+                                 dest=remote_leader, tag=tag)
+        got = np.asarray(bridge_comm.recv(source=remote_leader, tag=tag))
+        sreq.wait()
+        their_seq, n = int(got[0]), int(got[1])
+        remote = got[2:2 + n]
+        cid = _ICC_CID_BASE + max(seq, their_seq)
+        blob = np.concatenate([np.array([cid], np.int64), remote])
+        local_comm.bcast(np.array([len(blob)], np.int64),
+                         root=local_leader)
+        local_comm.bcast(blob, root=local_leader)
+    else:
+        n = int(np.asarray(local_comm.bcast(None, root=local_leader))[0])
+        blob = np.asarray(local_comm.bcast(None, root=local_leader))[:n]
+        cid = int(blob[0])
+        remote = blob[1:]
+    # overlapping groups are erroneous in MPI — catch the common mistake
+    local_ids = {local_comm.world_rank(r) for r in range(local_comm.size)}
+    if local_ids & set(int(r) for r in remote):
+        raise MPIException(
+            "intercomm_create: local and remote groups overlap",
+            error_class=5)
+    low = min(local_ids) < min(int(r) for r in remote)
+    ic = Intercomm(local_comm, [int(r) for r in remote], cid, low=low,
+                   name=f"{local_comm.name}.icc")
+    ic.barrier()
+    return ic
+
+
+def join(fd: int, comm: Optional[Communicator] = None) -> Intercomm:
+    """≈ MPI_Comm_join: a 1×1 intercommunicator between the two processes
+    at the ends of a connected socket (comm_join.c).  ``fd`` is the
+    caller-owned socket file descriptor; side ordering derives from the
+    socket's own address pair, so both ends decide consistently."""
+    if comm is None:
+        from ompi_tpu.mpi import runtime as rt
+
+        rt.init()
+        comm = rt._state["self"]
+    sock = socket.socket(fileno=os.dup(fd))  # caller keeps their fd
+    try:
+        # side ordering by explicit nonce exchange: socket addresses are
+        # NOT usable here (AF_UNIX socketpairs report the same empty name
+        # on both ends).  Both sides send 16 random bytes and compare —
+        # exactly one side is "low"; a tie is astronomically unlikely and
+        # rejected rather than mis-merged.
+        mine = os.urandom(16)
+        sock.sendall(mine)
+        theirs = b""
+        while len(theirs) < 16:
+            chunk = sock.recv(16 - len(theirs))
+            if not chunk:
+                raise MPIException("join: peer closed during handshake")
+            theirs += chunk
+        if mine == theirs:
+            raise MPIException("join: nonce tie; retry")
+        low = mine < theirs
+        my_info = _job_info(comm)
+        return _finish_side(comm, sock, my_info, low=low,
+                            name=f"{comm.name}.join")
+    finally:
+        sock.close()
+
 
 def accept(comm: Communicator, port_name: Optional[str]) -> Intercomm:
     """≈ MPI_Comm_accept — collective; leader owns the port (non-leaders
@@ -550,6 +651,49 @@ def spawn(comm: Communicator, argv: Sequence[str], maxprocs: int = 1,
                "-np", str(maxprocs), "--"] + list(argv)
         proc = subprocess.Popen(cmd, env=child_env)
         _spawned.append(proc)   # keep the handle; launcher owns lifetime
+    try:
+        return accept(comm, port_name)
+    finally:
+        if port_name is not None:
+            close_port(port_name)
+
+
+def spawn_multiple(comm: Communicator,
+                   commands: Sequence[Sequence[str]],
+                   maxprocs: Sequence[int],
+                   envs: Optional[Sequence[Optional[dict]]] = None,
+                   timeout: float = 120.0) -> Intercomm:
+    """≈ MPI_Comm_spawn_multiple: MPMD spawn — one child JOB whose world
+    concatenates the command blocks (ranks 0..maxprocs[0]-1 run
+    commands[0], the next maxprocs[1] run commands[1], …).  Realized by
+    launching the job under a dispatch shim that execs each rank's argv
+    from a table in the environment — the child world is a single job
+    exactly as the reference's plm builds it (one orte_job_t, several
+    app contexts)."""
+    import json
+
+    if len(commands) != len(maxprocs):
+        raise MPIException("spawn_multiple: commands/maxprocs mismatch",
+                           error_class=2)
+    total = int(sum(maxprocs))
+    port_name = None
+    if comm.rank == 0:
+        port_name = open_port()
+        child_env = dict(os.environ)
+        child_env[ENV_PARENT_PORT] = port_name
+        # per-COMMAND envs ride in the rank table (applied by the dispatch
+        # shim pre-exec), not the job-wide environment — MPI's
+        # spawn_multiple binds env/info to its command block
+        table = []
+        for i, (argv, n) in enumerate(zip(commands, maxprocs)):
+            e = (envs[i] if envs and i < len(envs) else None) or {}
+            table += [[list(argv), dict(e)]] * int(n)
+        child_env["OMPI_TPU_MPMD_TABLE"] = json.dumps(table)
+        cmd = [sys.executable, "-m", "ompi_tpu.tools.tpurun",
+               "-np", str(total), "--", sys.executable, "-m",
+               "ompi_tpu.mpi._mpmd_dispatch"]
+        proc = subprocess.Popen(cmd, env=child_env)
+        _spawned.append(proc)
     try:
         return accept(comm, port_name)
     finally:

@@ -68,6 +68,28 @@ class Group:
         return Group(tuple(r for i, r in enumerate(self._ranks)
                            if i not in drop))
 
+    def _expand_ranges(self, ranges: Sequence[Sequence[int]]) -> list[int]:
+        out: list[int] = []
+        for first, last, stride in ranges:
+            if stride == 0:
+                raise MPIException("range stride may not be 0")
+            stop = last + (1 if stride > 0 else -1)
+            for r in range(first, stop, stride):
+                if not 0 <= r < self.size:
+                    raise MPIException(
+                        f"range rank {r} outside group of {self.size}")
+                out.append(r)
+        return out
+
+    def range_incl(self, ranges: Sequence[Sequence[int]]) -> "Group":
+        """≈ MPI_Group_range_incl: ranges are (first, last, stride)
+        triples, expanded inclusively in order."""
+        return self.incl(self._expand_ranges(ranges))
+
+    def range_excl(self, ranges: Sequence[Sequence[int]]) -> "Group":
+        """≈ MPI_Group_range_excl."""
+        return self.excl(self._expand_ranges(ranges))
+
     def translate_ranks(self, ranks: Sequence[int],
                         other: "Group") -> list[int]:
         """≈ MPI_Group_translate_ranks: my group ranks → other's group ranks."""

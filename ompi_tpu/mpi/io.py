@@ -117,6 +117,47 @@ _shfp_locks: dict[str, threading.Lock] = {}
 _shfp_registry_lock = threading.Lock()
 
 
+# -- data representations (≈ MPI_Register_datarep, io_ompio datarep) -------
+#
+# name → (read_conv, write_conv); each is f(raw_bytes, etype) -> bytes or
+# None for identity.  Conversions must preserve byte count (the file-view
+# byte-run arithmetic assumes it) — MPI's variable-size datareps are out of
+# scope on this substrate and register_datarep enforces same-size by
+# checking a probe conversion.
+
+def _ext32_swap(raw: bytes, etype) -> bytes:
+    import sys as _sys
+
+    if _sys.byteorder == "big" or etype.size <= 1:
+        return raw
+    n = len(raw) // etype.size
+    tail = raw[n * etype.size:]
+    return dt_mod._swap_stream(etype, raw[:n * etype.size], n) + tail
+
+
+_datareps: dict[str, tuple] = {
+    "native": (None, None),
+    "internal": (None, None),
+    "external32": (_ext32_swap, _ext32_swap),
+}
+
+
+def register_datarep(name: str, read_conv=None, write_conv=None) -> None:
+    """≈ MPI_Register_datarep: a user data representation usable in
+    set_view.  ``read_conv(raw, etype) -> bytes`` converts file→native,
+    ``write_conv`` native→file; byte count must be preserved."""
+    if name in _datareps:
+        raise MPIException(f"datarep {name!r} already registered",
+                           error_class=ERR_IO)
+    probe = bytes(8)
+    for fn in (read_conv, write_conv):
+        if fn is not None and len(fn(probe, dt_mod.BYTE)) != len(probe):
+            raise MPIException(
+                f"datarep {name!r}: conversion changed byte count "
+                f"(unsupported here)", error_class=ERR_IO)
+    _datareps[name] = (read_conv, write_conv)
+
+
 def _shfp_lock(path: str) -> threading.Lock:
     with _shfp_registry_lock:
         return _shfp_locks.setdefault(path, threading.Lock())
@@ -632,6 +673,11 @@ class File:
         """≈ MPI_File_close — collective."""
         if self._closed:
             return
+        q = getattr(self, "_io_queue", None)
+        if q is not None:      # drain + stop the nonblocking-IO worker
+            q.put(None)
+            self._io_thread.join(timeout=60.0)
+            self._io_queue = None
         self.sync()
         self.comm.barrier()
         os.close(self._fd)
@@ -690,11 +736,20 @@ class File:
     # -- view --------------------------------------------------------------
 
     def set_view(self, disp: int = 0, etype: Datatype = dt_mod.BYTE,
-                 filetype: Optional[Datatype] = None) -> None:
-        """≈ MPI_File_set_view — collective; resets both file pointers."""
+                 filetype: Optional[Datatype] = None,
+                 datarep: str = "native") -> None:
+        """≈ MPI_File_set_view — collective; resets both file pointers.
+        ``datarep`` selects the file data representation: "native",
+        "internal", "external32" (canonical big-endian), or a name
+        registered with :func:`register_datarep`."""
         self._check_open()
+        if datarep not in _datareps:
+            self._err(MPIException(
+                f"unknown datarep {datarep!r} (register_datarep first)",
+                error_class=ERR_IO))
         self._shfp_merge()       # pending individual writes use the OLD view
         self.view = FileView(disp, etype, filetype)
+        self._datarep = datarep
         self._pos = 0
         if getattr(self._shfp, "local_log", False):
             self._shfp.merged_end = 0
@@ -735,9 +790,14 @@ class File:
         want = self.view.etype.base_np
         if arr.dtype != want:
             arr = arr.astype(want)
-        return np.ascontiguousarray(arr).tobytes()
+        raw = np.ascontiguousarray(arr).tobytes()
+        wr = _datareps[getattr(self, "_datarep", "native")][1]
+        return raw if wr is None else wr(raw, self.view.etype)
 
     def _from_bytes(self, raw: bytes) -> np.ndarray:
+        rd = _datareps[getattr(self, "_datarep", "native")][0]
+        if rd is not None:
+            raw = rd(raw, self.view.etype)
         et = self.view.etype.base_np
         n = len(raw) // et.itemsize
         return np.frombuffer(bytearray(raw[:n * et.itemsize]),
@@ -813,6 +873,148 @@ class File:
 
     def iwrite(self, data: Any) -> Request:
         return CompletedRequest(self.write(data), kind="iwrite")
+
+    # -- nonblocking collective IO (≈ MPI_File_iread_all & co.) ------------
+    #
+    # The blocking collective runs on a per-file worker thread (one
+    # thread, FIFO — issue order is completion order, the MPI requirement
+    # for multiple outstanding collective IO ops on one handle).  All
+    # ranks' workers meet inside the collective, so the caller's thread
+    # never blocks — true split-phase, unlike the eager individual
+    # i-ops above.
+
+    def _io_async(self, kind: str, fn, *args) -> Request:
+        import queue
+
+        q = getattr(self, "_io_queue", None)
+        if q is None:
+            q = self._io_queue = queue.Queue()
+
+            def worker() -> None:
+                while True:
+                    item = q.get()
+                    if item is None:
+                        return
+                    req, f, a = item
+                    try:
+                        req.complete(f(*a))
+                    except BaseException as e:  # noqa: BLE001 — to waiter
+                        req.fail(e)
+
+            t = threading.Thread(target=worker, daemon=True,
+                                 name=f"io-nbc-{os.path.basename(self.path)}")
+            self._io_thread = t
+            t.start()
+        req = Request(kind=kind)
+        q.put((req, fn, args))
+        return req
+
+    def iread_all(self, count: int) -> Request:
+        return self._io_async("iread_all", self.read_all, count)
+
+    def iwrite_all(self, data: Any) -> Request:
+        return self._io_async("iwrite_all", self.write_all, data)
+
+    def iread_at_all(self, offset: int, count: int) -> Request:
+        return self._io_async("iread_at_all", self.read_at_all, offset,
+                              count)
+
+    def iwrite_at_all(self, offset: int, data: Any) -> Request:
+        return self._io_async("iwrite_at_all", self.write_at_all, offset,
+                              data)
+
+    def iread_shared(self, count: int) -> Request:
+        return self._io_async("iread_shared", self.read_shared, count)
+
+    def iwrite_shared(self, data: Any) -> Request:
+        return self._io_async("iwrite_shared", self.write_shared, data)
+
+    # -- split collectives (≈ MPI_File_read_all_begin/end family) ----------
+    #
+    # begin = issue the nonblocking collective; end = wait.  MPI allows at
+    # most ONE outstanding split collective per file handle, and the end
+    # call must match the begin kind.
+
+    def _split_begin(self, kind: str, fn, *args) -> None:
+        if getattr(self, "_split_req", None) is not None:
+            self._err(MPIException(
+                f"split collective {self._split_kind} already outstanding "
+                f"on this file handle", error_class=ERR_IO))
+        self._split_kind = kind
+        self._split_req = self._io_async(kind, fn, *args)
+
+    def _split_end(self, kind: str):
+        req = getattr(self, "_split_req", None)
+        if req is None or self._split_kind != kind:
+            self._err(MPIException(
+                f"{kind}_end without matching {kind}_begin",
+                error_class=ERR_IO))
+        self._split_req = None
+        return req.wait()
+
+    def read_all_begin(self, count: int) -> None:
+        self._split_begin("read_all", self.read_all, count)
+
+    def read_all_end(self) -> np.ndarray:
+        return self._split_end("read_all")
+
+    def write_all_begin(self, data: Any) -> None:
+        self._split_begin("write_all", self.write_all, data)
+
+    def write_all_end(self) -> int:
+        return self._split_end("write_all")
+
+    def read_at_all_begin(self, offset: int, count: int) -> None:
+        self._split_begin("read_at_all", self.read_at_all, offset, count)
+
+    def read_at_all_end(self) -> np.ndarray:
+        return self._split_end("read_at_all")
+
+    def write_at_all_begin(self, offset: int, data: Any) -> None:
+        self._split_begin("write_at_all", self.write_at_all, offset, data)
+
+    def write_at_all_end(self) -> int:
+        return self._split_end("write_at_all")
+
+    def read_ordered_begin(self, count: int) -> None:
+        self._split_begin("read_ordered", self.read_ordered, count)
+
+    def read_ordered_end(self) -> np.ndarray:
+        return self._split_end("read_ordered")
+
+    def write_ordered_begin(self, data: Any) -> None:
+        self._split_begin("write_ordered", self.write_ordered, data)
+
+    def write_ordered_end(self) -> int:
+        return self._split_end("write_ordered")
+
+    # -- handle inquiries (≈ file_get_amode.c & co.) -----------------------
+
+    def get_amode(self) -> int:
+        """≈ MPI_File_get_amode."""
+        return self.amode
+
+    def get_group(self):
+        """≈ MPI_File_get_group: the group of the comm the file was
+        opened on."""
+        return self.comm.group
+
+    def get_byte_offset(self, offset: int) -> int:
+        """≈ MPI_File_get_byte_offset: view-relative offset (etype units)
+        → absolute byte offset in the file."""
+        runs = self.view.byte_runs(int(offset), self.view.etype.size)
+        if not runs:
+            return self.view.disp
+        return runs[0][0]
+
+    def get_type_extent(self, datatype: Datatype) -> int:
+        """≈ MPI_File_get_type_extent: the datatype's extent in the file's
+        current data representation (same-size representations here)."""
+        return datatype.extent
+
+    def set_info(self, info) -> None:
+        """≈ MPI_File_set_info."""
+        self.info = info
 
     # -- collective IO (the fcoll framework) -------------------------------
     #

@@ -522,6 +522,33 @@ class Window:
             r.wait()
         self._epoch_reqs.clear()
 
+    def flush_local_all(self) -> None:
+        """≈ MPI_Win_flush_local_all (local completion is target-agnostic
+        here — see flush_local)."""
+        self.flush_local(-1)
+
+    def get_group(self):
+        """≈ MPI_Win_get_group."""
+        return self.comm.group
+
+    def get_name(self) -> str:
+        """≈ MPI_Win_get_name."""
+        return self.name
+
+    def set_name(self, name: str) -> None:
+        """≈ MPI_Win_set_name."""
+        self.name = str(name)
+
+    def set_info(self, info) -> None:
+        """≈ MPI_Win_set_info (hints stored; no_locks honored at create)."""
+        self.info = info
+
+    def get_info(self):
+        """≈ MPI_Win_get_info."""
+        from ompi_tpu.mpi.info import Info
+
+        return getattr(self, "info", None) or Info()
+
     def lock(self, target: int, exclusive: bool = True) -> None:
         """≈ MPI_Win_lock (passive target). A local target still goes
         through the service, keeping lock fairness uniform."""

@@ -27,9 +27,9 @@ from ompi_tpu.mpi.constants import MPIException
 _SPIN_S = 0.0
 
 __all__ = ["Request", "Status", "PersistentRequest", "GeneralizedRequest",
-           "grequest_start", "get_elements", "get_count", "wait_all",
-           "wait_any", "wait_some", "test_all", "test_any", "test_some",
-           "start_all"]
+           "grequest_start", "get_elements", "get_count",
+           "request_get_status", "wait_all", "wait_any", "wait_some",
+           "test_all", "test_any", "test_some", "start_all"]
 
 
 class Status:
@@ -69,6 +69,26 @@ def get_elements(status: Status, datatype) -> int:
     if status._elements is not None:
         return status._elements
     return int(status.count)
+
+
+def request_get_status(request: "Request") -> tuple[bool, Status]:
+    """≈ MPI_Request_get_status: (flag, status) WITHOUT completing the
+    request — a done persistent request stays active for wait(), a done
+    generalized request runs its query_fn but is NOT freed."""
+    if isinstance(request, GeneralizedRequest):
+        if not request._flag:
+            return False, request.status
+        if request._query_fn is not None:
+            request._query_fn(request.extra_state, request.status)
+        return True, request.status
+    if isinstance(request, PersistentRequest):
+        inner = request._inner
+        if inner is None:
+            return True, request.status
+        return inner._flag, inner.status
+    # plain requests: test() is side-effect-free; schedule-driven requests
+    # (NbcRequest) NEED it — their rounds only advance inside test()/wait()
+    return request.test(), request.status
 
 
 def get_count(status: Status, datatype) -> int:

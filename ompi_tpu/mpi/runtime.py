@@ -23,19 +23,59 @@ from ompi_tpu.mpi.group import Group
 from ompi_tpu.mpi.pml import pml_framework
 from ompi_tpu.runtime import pmix
 
-__all__ = ["init", "finalize", "initialized", "COMM_WORLD", "COMM_SELF",
-           "get_world", "wtime", "wtick"]
+__all__ = ["init", "finalize", "initialized", "finalized", "COMM_WORLD",
+           "COMM_SELF", "get_world", "wtime", "wtick", "init_thread",
+           "query_thread", "is_thread_main", "pcontrol",
+           "THREAD_SINGLE", "THREAD_FUNNELED", "THREAD_SERIALIZED",
+           "THREAD_MULTIPLE"]
 
 _log = output.get_stream("mpi")
 _lock = threading.Lock()
-_state: dict = {"world": None, "self": None, "client": None, "pml": None}
+_state: dict = {"world": None, "self": None, "client": None, "pml": None,
+                "finalized": False, "main_thread": None}
 
 COMM_WORLD: Optional[Communicator] = None
 COMM_SELF: Optional[Communicator] = None
 
+# MPI thread levels (mpi.h ordering: SINGLE < FUNNELED < SERIALIZED < MULTIPLE)
+THREAD_SINGLE = 0
+THREAD_FUNNELED = 1
+THREAD_SERIALIZED = 2
+THREAD_MULTIPLE = 3
+
 
 def initialized() -> bool:
     return _state["world"] is not None
+
+
+def finalized() -> bool:
+    """≈ MPI_Finalized."""
+    return bool(_state["finalized"])
+
+
+def init_thread(required: int = THREAD_MULTIPLE
+                ) -> tuple[Communicator, int]:
+    """≈ MPI_Init_thread → (COMM_WORLD, provided).  This runtime is
+    thread-safe throughout (per-object locks instead of a global progress
+    lock), so provided is always THREAD_MULTIPLE."""
+    return init(), THREAD_MULTIPLE
+
+
+def query_thread() -> int:
+    """≈ MPI_Query_thread."""
+    return THREAD_MULTIPLE
+
+
+def is_thread_main() -> bool:
+    """≈ MPI_Is_thread_main: is this the thread that called init()?"""
+    return threading.get_ident() == _state["main_thread"]
+
+
+def pcontrol(level: int, *args) -> None:
+    """≈ MPI_Pcontrol: profiling-level hook.  Like the reference's
+    (ompi/mpi/c/pcontrol.c — an empty body), the default library takes no
+    action; monitoring consumers may read the stored level."""
+    _state["pcontrol_level"] = int(level)
 
 
 def init() -> Communicator:
@@ -110,6 +150,8 @@ def init() -> Communicator:
         # the revived rank at the finalize barrier instead).
         if size > 1 and not restarted:
             world.barrier()
+        _state["main_thread"] = threading.get_ident()
+        _state["finalized"] = False
         atexit.register(_atexit_finalize)
         return world
 
@@ -161,7 +203,8 @@ def finalize(_collective: bool = True) -> None:
                     client.finalize()
                 except Exception:
                     pass
-            _state.update(world=None, self=None, client=None, pml=None)
+            _state.update(world=None, self=None, client=None, pml=None,
+                          finalized=True)
             COMM_WORLD = COMM_SELF = None
 
 

@@ -279,6 +279,60 @@ def cart_create(comm, dims: Sequence[int],
     return new
 
 
+def topo_test(comm) -> Optional[str]:
+    """≈ MPI_Topo_test: "cart" | "graph" | "dist_graph" | None (no
+    topology attached — MPI_UNDEFINED's role)."""
+    t = getattr(comm, "topo", None)
+    return t.kind if t is not None else None
+
+
+def cart_get(comm) -> tuple[list[int], list[bool], list[int]]:
+    """≈ MPI_Cart_get → (dims, periods, my coords)."""
+    t = _topo_of(comm, "cart")
+    return list(t.dims), list(t.periods), t.coords(comm.rank)
+
+
+def cartdim_get(comm) -> int:
+    """≈ MPI_Cartdim_get."""
+    return _topo_of(comm, "cart").ndims
+
+
+def graph_get(comm) -> tuple[list[int], list[int]]:
+    """≈ MPI_Graph_get → (index, edges)."""
+    t = _topo_of(comm, "graph")
+    return list(t.index), list(t.edges)
+
+
+def graphdims_get(comm) -> tuple[int, int]:
+    """≈ MPI_Graphdims_get → (nnodes, nedges)."""
+    t = _topo_of(comm, "graph")
+    return t.size, len(t.edges)
+
+
+def graph_neighbors(comm, rank: int) -> list[int]:
+    """≈ MPI_Graph_neighbors."""
+    return _topo_of(comm, "graph").neighbors_of(rank)
+
+
+def graph_neighbors_count(comm, rank: int) -> int:
+    """≈ MPI_Graph_neighbors_count."""
+    return len(_topo_of(comm, "graph").neighbors_of(rank))
+
+
+def dist_graph_neighbors(comm) -> tuple[list[int], list[int]]:
+    """≈ MPI_Dist_graph_neighbors → (sources, destinations)."""
+    return _topo_of(comm, "dist_graph").neighbors(comm.rank)
+
+
+def dist_graph_neighbors_count(comm) -> tuple[int, int, bool]:
+    """≈ MPI_Dist_graph_neighbors_count → (indegree, outdegree, weighted)."""
+    t = _topo_of(comm, "dist_graph")
+    srcs, dsts = t.neighbors(comm.rank)
+    weighted = (t.source_weights is not None
+                or t.dest_weights is not None)
+    return len(srcs), len(dsts), weighted
+
+
 def cart_map(comm, dims: Sequence[int],
              periods: Optional[Sequence[bool]] = None,
              mesh_shape: Optional[Sequence[int]] = None) -> int:
@@ -485,6 +539,93 @@ def neighbor_alltoall(comm, sendparts: Sequence) -> list:
 def neighbor_alltoallv(comm, sendparts: Sequence) -> list:
     """≈ MPI_Neighbor_alltoallv: variable-size blocks per out-neighbor."""
     return _neighbor_exchange(comm, list(sendparts), _TAG_NEIGHBOR + 128)
+
+
+def neighbor_allgatherv(comm, sendbuf) -> list:
+    """≈ MPI_Neighbor_allgatherv: this API is shape-polymorphic already
+    (each in-neighbor entry keeps its own size), so the v-variant is the
+    allgather with per-rank sizes allowed."""
+    return neighbor_allgather(comm, sendbuf)
+
+
+def neighbor_alltoallw(comm, sendspecs: Sequence, recvspecs: Sequence
+                       ) -> None:
+    """≈ MPI_Neighbor_alltoallw: per-neighbor (buf, datatype, count)
+    triples (None = no exchange on that edge); receive buffers are filled
+    in place via each edge's recv datatype."""
+    from ompi_tpu.mpi.coll.base import pack_spec, unpack_spec
+
+    topo = _topo_of(comm)
+    srcs, dsts = topo.neighbors(comm.rank)
+    if len(sendspecs) != len(dsts) or len(recvspecs) != len(srcs):
+        raise MPIException(
+            f"neighbor_alltoallw: need {len(dsts)} send / {len(srcs)} recv "
+            f"specs, got {len(sendspecs)}/{len(recvspecs)}", error_class=2)
+    got = _neighbor_exchange(comm, [pack_spec(s) for s in sendspecs],
+                             _TAG_NEIGHBOR + 192)
+    for spec, data in zip(recvspecs, got):
+        if data is not None:
+            unpack_spec(spec, data)
+
+
+def _ineighbor(comm, send_per_dst: list, tag: int, kind: str):
+    """Nonblocking neighbor exchange as a one-round nbc schedule.
+
+    Reuses the blocking variants' tag windows: MPI requires collectives on
+    a communicator to be issued in the same order on every rank, and the
+    PML matches FIFO per (peer, tag), so concurrent outstanding neighbor
+    ops pair up by posting order exactly like consecutive blocking ones."""
+    from ompi_tpu.mpi.coll.nbc import Round, _const, _launch
+
+    topo = _topo_of(comm)
+    srcs, dsts = topo.neighbors(comm.rank)
+    if len(send_per_dst) != len(dsts):
+        raise MPIException(
+            f"need {len(dsts)} send blocks, got {len(send_per_dst)}",
+            error_class=2)
+    sends = []
+    for j, d in enumerate(dsts):
+        if d == PROC_NULL:
+            continue
+        slot = _send_slot(topo, comm.rank, j, d, dsts)
+        sends.append((_const(np.asarray(send_per_dst[j])), d,
+                      tag + (slot % 64)))
+    recvs = []
+    for i, s in enumerate(srcs):
+        if s != PROC_NULL:
+            recvs.append((s, f"n{i}", _recv_tag(topo, i, s, srcs, tag)))
+    rounds = [Round(sends=tuple(sends), recvs=tuple(recvs))]
+
+    def result(state):
+        return [state.get(f"n{i}") if s != PROC_NULL else None
+                for i, s in enumerate(srcs)]
+
+    return _launch(comm, rounds, result, kind)
+
+
+def ineighbor_allgather(comm, sendbuf):
+    """≈ MPI_Ineighbor_allgather."""
+    topo = _topo_of(comm)
+    _, dsts = topo.neighbors(comm.rank)
+    return _ineighbor(comm, [sendbuf] * len(dsts), _TAG_NEIGHBOR,
+                      "ineighbor_allgather")
+
+
+def ineighbor_allgatherv(comm, sendbuf):
+    """≈ MPI_Ineighbor_allgatherv (see neighbor_allgatherv)."""
+    return ineighbor_allgather(comm, sendbuf)
+
+
+def ineighbor_alltoall(comm, sendparts: Sequence):
+    """≈ MPI_Ineighbor_alltoall."""
+    return _ineighbor(comm, list(sendparts), _TAG_NEIGHBOR + 64,
+                      "ineighbor_alltoall")
+
+
+def ineighbor_alltoallv(comm, sendparts: Sequence):
+    """≈ MPI_Ineighbor_alltoallv."""
+    return _ineighbor(comm, list(sendparts), _TAG_NEIGHBOR + 128,
+                      "ineighbor_alltoallv")
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,485 @@
+"""Second API-parity batch: Alltoallw family, intercomm_create/join/
+spawn_multiple plumbing, thread-level API, split & nonblocking collective
+IO, datareps, and the remaining small accessors (the reference's
+alltoallw.c, intercomm_create.c, comm_join.c, init_thread.c,
+file_read_all_begin.c, register_datarep.c, pack_size.c families)."""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from ompi_tpu.mpi import constants as C
+from ompi_tpu.mpi import datatype as dt
+from ompi_tpu.mpi import dpm
+from ompi_tpu.mpi import io as io_mod
+from ompi_tpu.mpi import topo
+from ompi_tpu.mpi.constants import MPIException
+from ompi_tpu.mpi.request import request_get_status, grequest_start
+from tests.mpi.harness import run_ranks
+
+
+# ---------------------------------------------------------------------------
+# Alltoallw family
+# ---------------------------------------------------------------------------
+
+def test_alltoallw_heterogeneous_datatypes():
+    """Each pair uses a different datatype: rank r sends INT32 triples to
+    even peers and strided FLOAT64 vectors to odd peers."""
+    n = 3
+
+    def fn(comm):
+        rank = comm.rank
+        vec = dt.FLOAT64.vector(2, 1, 2).commit()  # 2 elems, stride 2
+        sendspecs, recvspecs = [], []
+        sbufs, rbufs = [], []
+        for r in range(n):
+            if r % 2 == 0:
+                sb = np.arange(3, dtype=np.int32) + 100 * rank + r
+                sendspecs.append((sb, dt.INT32, 3))
+            else:
+                sb = np.zeros(4, np.float64)
+                sb[0::2] = [rank + 0.5, r + 0.25]
+                sendspecs.append((sb, vec, 1))
+            sbufs.append(sb)
+            if rank % 2 == 0:
+                rb = np.zeros(3, np.int32)
+                recvspecs.append((rb, dt.INT32, 3))
+            else:
+                rb = np.zeros(4, np.float64)
+                recvspecs.append((rb, vec, 1))
+            rbufs.append(rb)
+        comm.alltoallw(sendspecs, recvspecs)
+        return rbufs
+
+    res = run_ranks(n, fn)
+    # even receiver r gets int triples from each sender s
+    for r in range(0, n, 2):
+        for s in range(n):
+            np.testing.assert_array_equal(
+                res[r][s], np.arange(3, dtype=np.int32) + 100 * s + r)
+    # odd receiver r gets the strided doubles (positions 0 and 2)
+    for r in range(1, n, 2):
+        for s in range(n):
+            assert res[r][s][0] == s + 0.5 and res[r][s][2] == r + 0.25
+
+
+def test_ialltoallw_matches_blocking():
+    def fn(comm):
+        size, rank = comm.size, comm.rank
+        sendspecs = [(np.full(2, 10 * rank + r, np.int64), dt.INT64, 2)
+                     for r in range(size)]
+        rbufs = [np.zeros(2, np.int64) for _ in range(size)]
+        recvspecs = [(rbufs[r], dt.INT64, 2) for r in range(size)]
+        comm.ialltoallw(sendspecs, recvspecs).wait(timeout=30)
+        return rbufs
+
+    res = run_ranks(4, fn)
+    for r in range(4):
+        for s in range(4):
+            assert list(res[r][s]) == [10 * s + r] * 2
+
+
+def test_alltoallw_none_spec_skips_pair():
+    def fn(comm):
+        rank = comm.rank
+        sendspecs = [None] * 2
+        recvspecs = [None] * 2
+        other = 1 - rank
+        sendspecs[other] = (np.array([rank + 7], np.int32), dt.INT32, 1)
+        rb = np.full(1, -1, np.int32)
+        recvspecs[other] = (rb, dt.INT32, 1)
+        comm.alltoallw(sendspecs, recvspecs)
+        return int(rb[0])
+
+    assert run_ranks(2, fn) == [8, 7]
+
+
+def test_igatherv_iscatterv_ireduce_scatter_block():
+    def fn(comm):
+        rank, size = comm.rank, comm.size
+        got = comm.igatherv(np.arange(rank + 1, dtype=np.int32),
+                            root=0).wait(timeout=30)
+        if rank == 0:
+            parts = [np.full(r + 2, r, np.int64) for r in range(size)]
+        else:
+            parts = None
+        mine = comm.iscatterv(parts, root=0).wait(timeout=30)
+        rs = comm.ireduce_scatter_block(
+            np.ones(size * 2, np.int32) * (rank + 1)).wait(timeout=30)
+        return got, mine, rs
+
+    res = run_ranks(3, fn)
+    gat = res[0][0]
+    assert [len(p) for p in gat] == [1, 2, 3]
+    for r in range(3):
+        assert list(res[r][1]) == [r] * (r + 2)
+        assert list(res[r][2]) == [6, 6]  # 1+2+3 per slot
+
+
+# ---------------------------------------------------------------------------
+# neighbor w/i variants
+# ---------------------------------------------------------------------------
+
+def test_ineighbor_alltoall_on_cart():
+    def fn(comm):
+        cc = topo.cart_create(comm, [4], periods=[True])
+        t = cc.topo
+        _, dsts = t.neighbors(cc.rank)
+        parts = [np.array([cc.rank * 10 + d], np.int32) for d in dsts]
+        out = topo.ineighbor_alltoall(cc, parts).wait(timeout=30)
+        return [int(np.asarray(o)[0]) for o in out]
+
+    res = run_ranks(4, fn)
+    # neighbors are (-1, +1) per dim; entry i came from srcs[i]
+    for r in range(4):
+        lo, hi = (r - 1) % 4, (r + 1) % 4
+        assert res[r] == [lo * 10 + r, hi * 10 + r]
+
+
+def test_neighbor_alltoallw_on_cart():
+    def fn(comm):
+        cc = topo.cart_create(comm, [3], periods=[True])
+        srcs, dsts = cc.topo.neighbors(cc.rank)
+        sendspecs = [(np.full(2, cc.rank * 10 + d, np.int64), dt.INT64, 2)
+                     for d in dsts]
+        rbufs = [np.zeros(2, np.int64) for _ in srcs]
+        recvspecs = [(rb, dt.INT64, 2) for rb in rbufs]
+        topo.neighbor_alltoallw(cc, sendspecs, recvspecs)
+        return [int(rb[0]) for rb in rbufs]
+
+    res = run_ranks(3, fn)
+    for r in range(3):
+        lo, hi = (r - 1) % 3, (r + 1) % 3
+        assert res[r] == [lo * 10 + r, hi * 10 + r]
+
+
+# ---------------------------------------------------------------------------
+# intercomm_create / comm_join
+# ---------------------------------------------------------------------------
+
+def test_intercomm_create_from_split():
+    def fn(comm):
+        half = comm.split(comm.rank % 2, name="half")
+        inter = dpm.intercomm_create(half, 0, comm,
+                                     remote_leader=(comm.rank + 1) % 2,
+                                     tag=42)
+        assert inter.test_inter()
+        assert inter.remote_size == 2
+        # p2p across: local rank i talks to remote rank i
+        peer = half.rank
+        sreq = inter.isend(np.array([comm.rank], np.int32), dest=peer,
+                           tag=3)
+        got = int(np.asarray(inter.recv(source=peer, tag=3))[0])
+        sreq.wait()
+        return got
+
+    res = run_ranks(4, fn)
+    # evens (0,2) pair with odds (1,3) positionally: 0↔1, 2↔3
+    assert res == [1, 0, 3, 2]
+
+
+def test_comm_join_over_socketpair():
+    a, b = socket.socketpair()
+    out = {}
+
+    def side(comm, sock, key):
+        inter = dpm.join(sock.fileno(), comm)
+        inter.send(np.array([comm.rank + len(key)], np.int64), dest=0,
+                   tag=1)
+        got = int(np.asarray(inter.recv(source=0, tag=1))[0])
+        # the nonce ordering must be CONSISTENT: exactly one side is low,
+        # so the merged ranks are a permutation of {0, 1}
+        merged = inter.merge()
+        out[key] = (got, merged.rank, merged.size)
+
+    ta = threading.Thread(
+        target=lambda: run_ranks(1, lambda c: side(c, a, "aa")),
+        daemon=True)
+    tb = threading.Thread(
+        target=lambda: run_ranks(1, lambda c: side(c, b, "b")), daemon=True)
+    ta.start(); tb.start()
+    ta.join(timeout=30); tb.join(timeout=30)
+    assert not ta.is_alive() and not tb.is_alive()
+    assert out["aa"][0] == 1 and out["b"][0] == 2
+    assert sorted((out["aa"][1], out["b"][1])) == [0, 1]
+    assert out["aa"][2] == out["b"][2] == 2
+    a.close(); b.close()
+
+
+# ---------------------------------------------------------------------------
+# thread-level + misc runtime
+# ---------------------------------------------------------------------------
+
+def test_ireduce_scatter_block_noncommutative_rank_order():
+    from ompi_tpu.mpi.op import create_op
+
+    # op(a,b) = a*10 + b is order-sensitive: rank-ordered fold of blocks
+    # [1,2,3] must give ((1*10)+2)*10+3 = 123 on every slot
+    op = create_op(lambda a, b: a * 10 + b, commutative=False)
+
+    def fn(comm):
+        mine = np.full(comm.size, comm.rank + 1, np.int64)
+        return comm.ireduce_scatter_block(mine, op).wait(timeout=30)
+
+    res = run_ranks(3, fn)
+    for r in range(3):
+        assert list(res[r]) == [123]
+
+
+def test_request_get_status_progresses_nbc():
+    def fn(comm):
+        req = comm.iallreduce(np.array([comm.rank], np.int64))
+        # poll ONLY via request_get_status — it must progress the schedule
+        import time as _t
+
+        deadline = _t.time() + 20
+        while True:
+            flag, _st = request_get_status(req)
+            if flag:
+                break
+            if _t.time() > deadline:
+                raise TimeoutError("get_status never progressed the nbc op")
+            _t.sleep(0.001)
+        return int(np.asarray(req.wait())[0])
+
+    assert run_ranks(3, fn) == [3, 3, 3]
+
+
+def test_dist_graph_weighted_flag():
+    def fn(comm):
+        g1 = topo.dist_graph_create_adjacent(
+            comm, [(comm.rank - 1) % comm.size], [(comm.rank + 1) % comm.size])
+        g2 = topo.dist_graph_create_adjacent(
+            comm, [(comm.rank - 1) % comm.size], [(comm.rank + 1) % comm.size],
+            source_weights=[2], dest_weights=[2])
+        return (topo.dist_graph_neighbors_count(g1),
+                topo.dist_graph_neighbors_count(g2))
+
+    res = run_ranks(2, fn)
+    assert res[0][0] == (1, 1, False)
+    assert res[0][1] == (1, 1, True)
+
+
+def test_mpmd_table_carries_per_command_env(monkeypatch):
+    """The dispatch shim applies its rank's own command env (not a
+    flattened union)."""
+    import json
+    import os
+
+    from ompi_tpu.mpi import _mpmd_dispatch
+
+    table = [[["prog_a"], {"MODE": "a"}], [["prog_b"], {"MODE": "b"}]]
+    monkeypatch.setenv("OMPI_TPU_MPMD_TABLE", json.dumps(table))
+    monkeypatch.setenv("OMPI_TPU_RANK", "1")
+    seen = {}
+    monkeypatch.setattr(
+        "os.execvp", lambda p, a: seen.update(prog=p, mode=os.environ["MODE"]))
+    _mpmd_dispatch.main()
+    assert seen == {"prog": "prog_b", "mode": "b"}
+
+
+def test_thread_level_api():
+    from ompi_tpu.mpi import runtime as rt
+
+    assert rt.query_thread() == rt.THREAD_MULTIPLE
+    assert rt.THREAD_SINGLE < rt.THREAD_FUNNELED < rt.THREAD_SERIALIZED \
+        < rt.THREAD_MULTIPLE
+    rt.pcontrol(2)
+    assert rt._state["pcontrol_level"] == 2
+
+
+def test_request_get_status_does_not_complete():
+    calls = []
+    req = grequest_start(query_fn=lambda s, st: calls.append(1))
+    flag, _ = request_get_status(req)
+    assert not flag and not calls
+    req.complete("v")
+    flag, _ = request_get_status(req)
+    assert flag and calls == [1]
+    assert not req._freed          # get_status must NOT free
+    assert req.wait() == "v"       # wait still works and frees
+    assert req._freed
+
+
+# ---------------------------------------------------------------------------
+# datatype/trivia
+# ---------------------------------------------------------------------------
+
+def test_pack_size_and_address_helpers():
+    v = dt.FLOAT32.vector(3, 2, 4)
+    assert dt.pack_size(2, v) == 2 * v.size
+    assert dt.pack_external_size(v, 2) == 2 * v.size
+    assert dt.type_match_size("real", 8) is dt.FLOAT64
+    assert dt.type_match_size("integer", 2) is dt.INT16
+    with pytest.raises(MPIException):
+        dt.type_match_size("real", 3)
+    buf = dt.alloc_mem(64)
+    assert buf.nbytes == 64
+    a = np.arange(4, dtype=np.float64)
+    assert dt.get_address(a[2:]) - dt.get_address(a) == 16
+    dt.free_mem(buf)
+
+
+def test_type_extents_and_names():
+    v = dt.INT32.vector(2, 1, 4)  # elems at item offsets 0 and 4
+    assert v.get_extent() == (0, v.extent)
+    true_lb, true_ext = v.get_true_extent()
+    assert true_lb == 0 and true_ext == 20  # runs at bytes 0-3 and 16-19
+    v.set_name("stripes")
+    assert v.get_name() == "stripes"
+
+
+def test_group_range_incl_excl():
+    from ompi_tpu.mpi.group import Group
+
+    g = Group(range(10))
+    assert g.range_incl([(0, 6, 2)]).ranks == (0, 2, 4, 6)
+    assert g.range_incl([(8, 6, -2), (0, 0, 1)]).ranks == (8, 6, 0)
+    assert g.range_excl([(1, 9, 1)]).ranks == (0,)
+    with pytest.raises(MPIException):
+        g.range_incl([(0, 4, 0)])
+
+
+def test_comm_accessors_and_topo_test():
+    def fn(comm):
+        assert comm.test_inter() is False
+        assert comm.get_group() is comm.group
+        comm.set_name("renamed")
+        assert comm.get_name() == "renamed"
+        from ompi_tpu.mpi.info import Info
+
+        comm.set_info(Info({"k": "v"}))
+        assert comm.get_info().get("k") == "v"
+        assert topo.topo_test(comm) is None
+        cc = topo.cart_create(comm, [2, 2], periods=[True, False])
+        assert topo.topo_test(cc) == "cart"
+        dims, periods, coords = topo.cart_get(cc)
+        assert dims == [2, 2] and periods == [True, False]
+        assert topo.cartdim_get(cc) == 2
+        assert coords == cc.topo.coords(cc.rank)
+        gc = topo.graph_create(comm, [2, 3, 4, 6], [1, 3, 0, 3, 0, 2])
+        assert topo.graphdims_get(gc) == (4, 6)
+        assert topo.graph_neighbors(gc, 0) == [1, 3]
+        assert topo.graph_neighbors_count(gc, 1) == 1
+        return True
+
+    assert all(run_ranks(4, fn))
+
+
+# ---------------------------------------------------------------------------
+# IO: split collectives, nonblocking collectives, datareps, accessors
+# ---------------------------------------------------------------------------
+
+def test_split_collective_io(tmp_path):
+    path = str(tmp_path / "split.bin")
+
+    def fn(comm):
+        f = io_mod.File.open(
+            comm, path, io_mod.MODE_CREATE | io_mod.MODE_RDWR)
+        f.set_view(etype=dt.INT32)
+        f.write_at_all_begin(comm.rank * 4, np.full(4, comm.rank, np.int32))
+        assert f.write_at_all_end() == 4  # elements written
+        f.read_at_all_begin(0, 4 * comm.size)
+        got = f.read_at_all_end()
+        with pytest.raises(MPIException):
+            f.read_all_end()  # no matching begin
+        f.write_all_begin(np.zeros(0, np.int32))
+        with pytest.raises(MPIException):
+            f.read_all_begin(1)  # second outstanding split op
+        f.write_all_end()
+        f.close()
+        return got
+
+    res = run_ranks(3, fn)
+    expect = sum(([r] * 4 for r in range(3)), [])
+    for r in range(3):
+        assert list(res[r]) == expect
+
+
+def test_nonblocking_collective_io(tmp_path):
+    path = str(tmp_path / "nbc.bin")
+
+    def fn(comm):
+        f = io_mod.File.open(
+            comm, path, io_mod.MODE_CREATE | io_mod.MODE_RDWR)
+        f.set_view(etype=dt.FLOAT64)
+        w = f.iwrite_at_all(comm.rank * 2,
+                            np.array([comm.rank, comm.rank + 0.5]))
+        assert w.wait(timeout=30) == 2  # elements written
+        r = f.iread_at_all(0, 2 * comm.size)
+        got = r.wait(timeout=30)
+        f.close()
+        return got
+
+    res = run_ranks(2, fn)
+    assert list(res[0]) == [0.0, 0.5, 1.0, 1.5]
+
+
+def test_external32_datarep_roundtrip(tmp_path):
+    path = str(tmp_path / "ext32.bin")
+
+    def fn(comm):
+        f = io_mod.File.open(
+            comm, path, io_mod.MODE_CREATE | io_mod.MODE_RDWR)
+        f.set_view(etype=dt.INT32, datarep="external32")
+        f.write_at(0, np.array([0x01020304], np.int32))
+        back = f.read_at(0, 1)
+        f.close()
+        return int(back[0])
+
+    assert run_ranks(1, fn) == [0x01020304]
+    # bytes on disk are big-endian regardless of host order
+    raw = open(path, "rb").read(4)
+    assert raw == b"\x01\x02\x03\x04"
+
+
+def test_register_datarep_user_conversion(tmp_path):
+    name = "xor-55"
+    if name not in io_mod._datareps:
+        io_mod.register_datarep(
+            name,
+            read_conv=lambda raw, et: bytes(b ^ 0x55 for b in raw),
+            write_conv=lambda raw, et: bytes(b ^ 0x55 for b in raw))
+    with pytest.raises(MPIException):
+        io_mod.register_datarep(name)  # duplicate
+    path = str(tmp_path / "xor.bin")
+
+    def fn(comm):
+        f = io_mod.File.open(
+            comm, path, io_mod.MODE_CREATE | io_mod.MODE_RDWR)
+        f.set_view(datarep=name)
+        f.write_at(0, np.frombuffer(b"hello", np.uint8))
+        back = f.read_at(0, 5)
+        f.close()
+        return bytes(back)
+
+    assert run_ranks(1, fn) == [b"hello"]
+    assert open(path, "rb").read(5) == bytes(b ^ 0x55 for b in b"hello")
+
+
+def test_file_accessors(tmp_path):
+    path = str(tmp_path / "acc.bin")
+
+    def fn(comm):
+        amode = io_mod.MODE_CREATE | io_mod.MODE_RDWR
+        f = io_mod.File.open(comm, path, amode)
+        assert f.get_amode() == amode
+        assert f.get_group() is comm.group
+        tile = dt.INT32.vector(2, 1, 2).commit()   # 2 ints per 4-slot tile
+        f.set_view(disp=8, etype=dt.INT32, filetype=tile)
+        # etype offset 1 = second payload elem = file offset 8 + 2*4
+        assert f.get_byte_offset(0) == 8
+        assert f.get_byte_offset(1) == 8 + 2 * 4
+        assert f.get_type_extent(tile) == tile.extent
+        from ompi_tpu.mpi.info import Info
+
+        f.set_info(Info({"cb_nodes": "1"}))
+        assert f.get_info().get("cb_nodes") == "1"
+        f.close()
+        return True
+
+    assert all(run_ranks(1, fn))
