@@ -1,0 +1,1018 @@
+"""mpi4py-compatible facade over ompi_tpu.
+
+The reference's Python users overwhelmingly reach it through mpi4py
+(``from mpi4py import MPI``); this module lets those scripts run on this
+framework with one changed import::
+
+    from ompi_tpu.compat import MPI
+
+    comm = MPI.COMM_WORLD
+    rank = comm.Get_rank()
+    comm.Send(buf, dest=1, tag=7)          # uppercase = buffer API
+    obj = comm.bcast(obj, root=0)          # lowercase = pickled objects
+
+Covered surface (the part real scripts use): Comm point-to-point (both
+case conventions, all send modes, persistent requests, matched probe),
+blocking + nonblocking collectives, communicator management
+(Dup/Split/Split_type/Create/Create_group/Free/group ops), Status,
+Request families (Wait*/Test*), Op including Op.Create, Datatype-as-
+numpy-dtype buffer specs ``[buf, count, MPI.DOUBLE]``, and the
+environment calls (Wtime, Get_processor_name, Init/Finalize).
+
+Out of scope here (use the native API, MIGRATION.md maps every call):
+RMA windows, MPI-IO, topologies, spawn — the native surface is richer
+than mpi4py's for those.
+
+Naming follows mpi4py exactly, hence the non-PEP8 method names.  The
+module references the reference's C API (``/root/reference/ompi/mpi/c``)
+only through the names mpi4py derives from it; everything executes on
+this framework's PML/coll stack.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from ompi_tpu.mpi import constants as _const
+from ompi_tpu.mpi import op as _op_mod
+from ompi_tpu.mpi.request import Status as _NativeStatus
+from ompi_tpu.mpi import request as _req_mod
+
+# ---------------------------------------------------------------------------
+# constants
+# ---------------------------------------------------------------------------
+
+ANY_SOURCE = _const.ANY_SOURCE
+ANY_TAG = _const.ANY_TAG
+PROC_NULL = _const.PROC_NULL
+UNDEFINED = _const.UNDEFINED
+IN_PLACE = _const.IN_PLACE
+COMM_TYPE_SHARED = _const.COMM_TYPE_SHARED
+SUCCESS = 0
+
+THREAD_SINGLE, THREAD_FUNNELED, THREAD_SERIALIZED, THREAD_MULTIPLE = range(4)
+
+ERRORS_ARE_FATAL = "errors_are_fatal"
+ERRORS_RETURN = "errors_return"
+
+
+class Exception(RuntimeError):  # noqa: A001 — mpi4py exports MPI.Exception
+    """mpi4py-shaped MPI exception (wraps the native MPIException)."""
+
+    def __init__(self, native):
+        super().__init__(str(native))
+        self._native = native
+
+    def Get_error_class(self) -> int:
+        return getattr(self._native, "error_class", -1)
+
+    def Get_error_string(self) -> str:
+        return str(self._native)
+
+
+# ---------------------------------------------------------------------------
+# Datatype: numpy dtype in mpi4py clothing
+# ---------------------------------------------------------------------------
+
+class Datatype:
+    """A named numpy dtype — enough for ``[buf, count, MPI.DOUBLE]``
+    specs, ``Status.Get_count``, and dtype checks."""
+
+    def __init__(self, np_dtype, name: str):
+        self.np_dtype = np.dtype(np_dtype)
+        self._name = name
+
+    def Get_size(self) -> int:
+        return self.np_dtype.itemsize
+
+    @property
+    def size(self) -> int:
+        return self.np_dtype.itemsize
+
+    def Get_name(self) -> str:
+        return self._name
+
+    def __repr__(self) -> str:
+        return f"<MPI.Datatype {self._name}>"
+
+
+BYTE = Datatype(np.uint8, "MPI_BYTE")
+CHAR = Datatype(np.int8, "MPI_CHAR")
+SHORT = Datatype(np.int16, "MPI_SHORT")
+INT = Datatype(np.int32, "MPI_INT")
+LONG = Datatype(np.int64, "MPI_LONG")
+LONG_LONG = Datatype(np.int64, "MPI_LONG_LONG")
+UNSIGNED_CHAR = Datatype(np.uint8, "MPI_UNSIGNED_CHAR")
+UNSIGNED_SHORT = Datatype(np.uint16, "MPI_UNSIGNED_SHORT")
+UNSIGNED = Datatype(np.uint32, "MPI_UNSIGNED")
+UNSIGNED_LONG = Datatype(np.uint64, "MPI_UNSIGNED_LONG")
+FLOAT = Datatype(np.float32, "MPI_FLOAT")
+DOUBLE = Datatype(np.float64, "MPI_DOUBLE")
+C_BOOL = Datatype(np.bool_, "MPI_C_BOOL")
+BOOL = C_BOOL
+INT8_T = Datatype(np.int8, "MPI_INT8_T")
+INT16_T = Datatype(np.int16, "MPI_INT16_T")
+INT32_T = Datatype(np.int32, "MPI_INT32_T")
+INT64_T = Datatype(np.int64, "MPI_INT64_T")
+UINT8_T = Datatype(np.uint8, "MPI_UINT8_T")
+UINT16_T = Datatype(np.uint16, "MPI_UINT16_T")
+UINT32_T = Datatype(np.uint32, "MPI_UINT32_T")
+UINT64_T = Datatype(np.uint64, "MPI_UINT64_T")
+COMPLEX = Datatype(np.complex64, "MPI_COMPLEX")
+DOUBLE_COMPLEX = Datatype(np.complex128, "MPI_DOUBLE_COMPLEX")
+
+
+# ---------------------------------------------------------------------------
+# Op
+# ---------------------------------------------------------------------------
+
+class Op:
+    """Wraps a native reduction op; callable like mpi4py's, and carries
+    the Python-object fold used by the lowercase collectives."""
+
+    def __init__(self, native, pyfold=None, name: str = "user"):
+        self._native = native
+        self._py = pyfold
+        self._name = name
+
+    @classmethod
+    def Create(cls, function, commute: bool = False) -> "Op":
+        native = _op_mod.create_op(
+            lambda a, b: function(a, b), commutative=commute)
+        return cls(native, pyfold=function)
+
+    def Free(self) -> None:
+        pass
+
+    def Is_commutative(self) -> bool:
+        return _op_mod.op_commutative(self._native)
+
+    def __call__(self, a, b):
+        if self._py is not None:
+            return self._py(a, b)
+        return self._native(a, b)
+
+    def __repr__(self) -> str:
+        return f"<MPI.Op {self._name}>"
+
+
+SUM = Op(_op_mod.SUM, lambda a, b: a + b, "MPI_SUM")
+PROD = Op(_op_mod.PROD, lambda a, b: a * b, "MPI_PROD")
+MAX = Op(_op_mod.MAX, lambda a, b: max(a, b), "MPI_MAX")
+MIN = Op(_op_mod.MIN, lambda a, b: min(a, b), "MPI_MIN")
+LAND = Op(_op_mod.LAND, lambda a, b: bool(a) and bool(b), "MPI_LAND")
+LOR = Op(_op_mod.LOR, lambda a, b: bool(a) or bool(b), "MPI_LOR")
+LXOR = Op(_op_mod.LXOR, lambda a, b: bool(a) != bool(b), "MPI_LXOR")
+BAND = Op(_op_mod.BAND, lambda a, b: a & b, "MPI_BAND")
+BOR = Op(_op_mod.BOR, lambda a, b: a | b, "MPI_BOR")
+BXOR = Op(_op_mod.BXOR, lambda a, b: a ^ b, "MPI_BXOR")
+MAXLOC = Op(_op_mod.MAXLOC, None, "MPI_MAXLOC")
+MINLOC = Op(_op_mod.MINLOC, None, "MPI_MINLOC")
+REPLACE = Op(_op_mod.REPLACE, lambda a, b: b, "MPI_REPLACE")
+NO_OP = Op(_op_mod.NO_OP, lambda a, b: a, "MPI_NO_OP")
+
+
+def _native_op(op) -> Any:
+    return op._native if isinstance(op, Op) else op
+
+
+# ---------------------------------------------------------------------------
+# Status
+# ---------------------------------------------------------------------------
+
+class Status(_NativeStatus):
+    """Native Status + the mpi4py accessor spelling."""
+
+    def Get_source(self) -> int:
+        return self.source
+
+    def Get_tag(self) -> int:
+        return self.tag
+
+    def Get_error(self) -> int:
+        return getattr(self, "error", 0)
+
+    def Get_count(self, datatype: Datatype = BYTE) -> int:
+        """Count in items of ``datatype`` (mpi4py semantics: converted
+        from the received byte count when the PML recorded it)."""
+        nbytes = getattr(self, "count_bytes", None)
+        if nbytes is None:
+            return self.count
+        item = datatype.Get_size()
+        if item <= 0:
+            return 0
+        if nbytes % item:
+            return UNDEFINED
+        return nbytes // item
+
+    def Get_elements(self, datatype: Datatype = BYTE) -> int:
+        return self.Get_count(datatype)
+
+    def Is_cancelled(self) -> bool:
+        return bool(getattr(self, "cancelled", False))
+
+    def _absorb(self, native: Optional[_NativeStatus]) -> None:
+        if native is not None:
+            self.__dict__.update(native.__dict__)
+
+
+def _fill_status(status: Optional[Status], native) -> None:
+    if status is not None and native is not None:
+        status.__dict__.update(native.__dict__)
+
+
+# ---------------------------------------------------------------------------
+# pickle framing for the lowercase API
+# ---------------------------------------------------------------------------
+
+def _dumps(obj) -> np.ndarray:
+    return np.frombuffer(
+        pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL),
+        dtype=np.uint8).copy()
+
+
+def _loads(arr) -> Any:
+    return pickle.loads(np.ascontiguousarray(arr).view(np.uint8).tobytes())
+
+
+# ---------------------------------------------------------------------------
+# buffer specs: ndarray | [buf] | [buf, type] | [buf, count] |
+#               [buf, count, type] | [buf, (counts, displs), type]
+# ---------------------------------------------------------------------------
+
+def _as_array(spec) -> np.ndarray:
+    if isinstance(spec, (list, tuple)):
+        buf = spec[0]
+        arr = np.asarray(buf)
+        count = None
+        dtype = None
+        for extra in spec[1:]:
+            if isinstance(extra, Datatype):
+                dtype = extra
+            elif isinstance(extra, (int, np.integer)):
+                count = int(extra)
+        if dtype is not None and arr.dtype != dtype.np_dtype:
+            arr = arr.view(dtype.np_dtype)
+        if count is not None:
+            arr = arr.reshape(-1)[:count]
+        return arr
+    return np.asarray(spec)
+
+
+def _copy_into(dst_spec, src) -> None:
+    """Write a collective/receive result into the caller's buffer."""
+    dst = _as_array(dst_spec)
+    src = np.asarray(src)
+    flat = src.reshape(-1)
+    if dst.dtype != flat.dtype:
+        flat = flat.astype(dst.dtype)
+    dst.reshape(-1)[: flat.size] = flat
+
+
+# ---------------------------------------------------------------------------
+# Request / Prequest
+# ---------------------------------------------------------------------------
+
+class Request:
+    """Wraps a native request.  ``wait``/``test`` (lowercase) return the
+    payload (unpickled for object receives); ``Wait``/``Test`` follow the
+    buffer-API convention."""
+
+    def __init__(self, native, transform=None):
+        self._r = native
+        self._transform = transform
+
+    # -- buffer convention -------------------------------------------------
+    def Wait(self, status: Optional[Status] = None) -> bool:
+        self._r.wait()
+        _fill_status(status, getattr(self._r, "status", None))
+        return True
+
+    def Test(self, status: Optional[Status] = None) -> bool:
+        done = self._r.test()
+        if done:
+            _fill_status(status, getattr(self._r, "status", None))
+        return bool(done)
+
+    def Cancel(self) -> None:
+        self._r.cancel()
+
+    def Free(self) -> None:
+        pass
+
+    # -- object convention -------------------------------------------------
+    def wait(self, status: Optional[Status] = None) -> Any:
+        out = self._r.wait()
+        _fill_status(status, getattr(self._r, "status", None))
+        return self._transform(out) if self._transform else out
+
+    def test(self, status: Optional[Status] = None):
+        done = self._r.test()
+        if not done:
+            return (False, None)
+        _fill_status(status, getattr(self._r, "status", None))
+        out = self._r.wait()  # already complete: returns the payload
+        return (True, self._transform(out) if self._transform else out)
+
+    # -- families ----------------------------------------------------------
+    @staticmethod
+    def Waitall(requests: Sequence["Request"], statuses=None) -> bool:
+        outs = _req_mod.wait_all([r._r for r in requests])
+        if statuses is not None:
+            for i, req in enumerate(requests):
+                if i < len(statuses):
+                    _fill_status(statuses[i],
+                                 getattr(req._r, "status", None))
+        del outs
+        return True
+
+    @staticmethod
+    def waitall(requests: Sequence["Request"]) -> list:
+        _req_mod.wait_all([r._r for r in requests])
+        return [r._transform(r._r.wait()) if r._transform else r._r.wait()
+                for r in requests]
+
+    @staticmethod
+    def Waitany(requests: Sequence["Request"],
+                status: Optional[Status] = None) -> int:
+        idx, _ = _req_mod.wait_any([r._r for r in requests])
+        if idx is not None and idx >= 0:
+            _fill_status(status, getattr(requests[idx]._r, "status", None))
+        return UNDEFINED if idx is None else idx
+
+    @staticmethod
+    def Testall(requests: Sequence["Request"], statuses=None) -> bool:
+        if not all(r._r.test() for r in requests):
+            return False
+        if statuses is not None:
+            for i, req in enumerate(requests):
+                if i < len(statuses):
+                    _fill_status(statuses[i],
+                                 getattr(req._r, "status", None))
+        return True
+
+
+class Prequest(Request):
+    """Persistent request (MPI_Send_init/Recv_init → Start)."""
+
+    def Start(self) -> None:
+        self._r.start()
+
+    @staticmethod
+    def Startall(requests: Sequence["Prequest"]) -> None:
+        _req_mod.start_all([r._r for r in requests])
+
+
+class Message:
+    """Matched-probe handle (MPI_Mprobe → MPI_Mrecv)."""
+
+    def __init__(self, comm, native_msg):
+        self._comm = comm
+        self._m = native_msg
+
+    def Recv(self, buf=None, status: Optional[Status] = None):
+        arr = None if buf is None else _as_array(buf)
+        st = _NativeStatus()
+        out = self._comm.mrecv(arr, self._m, status=st)
+        _fill_status(status, st)
+        if buf is not None and out is not None and not np.shares_memory(
+                _as_array(buf), np.asarray(out)):
+            _copy_into(buf, out)
+        return out
+
+    def Irecv(self, buf=None) -> Request:
+        arr = None if buf is None else _as_array(buf)
+        return Request(self._comm.imrecv(arr, self._m))
+
+    def recv(self, status: Optional[Status] = None) -> Any:
+        st = _NativeStatus()
+        out = self._comm.mrecv(None, self._m, status=st)
+        _fill_status(status, st)
+        return _loads(out)
+
+
+# ---------------------------------------------------------------------------
+# Group
+# ---------------------------------------------------------------------------
+
+class Group:
+    def __init__(self, native, my_world_rank: Optional[int] = None):
+        self._g = native
+        self._my_world = my_world_rank
+
+    def Get_size(self) -> int:
+        return self._g.size
+
+    def Get_rank(self) -> int:
+        if self._my_world is None:
+            return UNDEFINED
+        r = self._g.rank_of(self._my_world)
+        return UNDEFINED if r is None or r < 0 else r
+
+    def Incl(self, ranks) -> "Group":
+        return Group(self._g.incl(ranks), self._my_world)
+
+    def Excl(self, ranks) -> "Group":
+        return Group(self._g.excl(ranks), self._my_world)
+
+    def Range_incl(self, ranges) -> "Group":
+        return Group(self._g.range_incl(ranges), self._my_world)
+
+    def Range_excl(self, ranges) -> "Group":
+        return Group(self._g.range_excl(ranges), self._my_world)
+
+    def Union(self, other: "Group") -> "Group":
+        return Group(self._g.union(other._g), self._my_world)
+
+    def Intersection(self, other: "Group") -> "Group":
+        return Group(self._g.intersection(other._g), self._my_world)
+
+    def Difference(self, other: "Group") -> "Group":
+        return Group(self._g.difference(other._g), self._my_world)
+
+    def Translate_ranks(self, ranks, other: "Group"):
+        return self._g.translate_ranks(ranks, other._g)
+
+    def Free(self) -> None:
+        pass
+
+    @property
+    def size(self) -> int:
+        return self.Get_size()
+
+    @property
+    def rank(self) -> int:
+        return self.Get_rank()
+
+
+# ---------------------------------------------------------------------------
+# Comm
+# ---------------------------------------------------------------------------
+
+class Comm:
+    """mpi4py-shaped communicator over a native :class:`Communicator`.
+
+    Uppercase methods take buffers (numpy arrays or ``[buf, count, type]``
+    specs) and write results into caller-provided receive buffers;
+    lowercase methods move arbitrary pickled Python objects.
+    """
+
+    def __init__(self, native):
+        self._comm = native
+
+    @property
+    def _c(self):
+        return self._comm
+
+    # -- identity ----------------------------------------------------------
+
+    def Get_rank(self) -> int:
+        return self._c.rank
+
+    def Get_size(self) -> int:
+        return self._c.size
+
+    def Get_name(self) -> str:
+        return self._c.get_name()
+
+    def Set_name(self, name: str) -> None:
+        self._c.set_name(name)
+
+    def Get_group(self) -> Group:
+        g = self._c.get_group()
+        return Group(g, g.world_rank(self._c.rank))
+
+    def Is_inter(self) -> bool:
+        return self._c.test_inter()
+
+    def Is_intra(self) -> bool:
+        return not self._c.test_inter()
+
+    @property
+    def rank(self) -> int:
+        return self._c.rank
+
+    @property
+    def size(self) -> int:
+        return self._c.size
+
+    @property
+    def name(self) -> str:
+        return self._c.get_name()
+
+    # -- management --------------------------------------------------------
+
+    def Dup(self) -> "Comm":
+        return Comm(self._c.dup())
+
+    def Split(self, color: int = 0, key: int = 0) -> Optional["Comm"]:
+        sub = self._c.split(color, key)
+        return None if sub is None else Comm(sub)
+
+    def Split_type(self, split_type: int = COMM_TYPE_SHARED, key: int = 0,
+                   info=None) -> Optional["Comm"]:
+        sub = self._c.split_type(split_type, key)
+        return None if sub is None else Comm(sub)
+
+    def Create(self, group: Group) -> Optional["Comm"]:
+        sub = self._c.create(group._g)
+        return None if sub is None else Comm(sub)
+
+    def Create_group(self, group: Group, tag: int = 0) -> Optional["Comm"]:
+        sub = self._c.create_group(group._g, tag)
+        return None if sub is None else Comm(sub)
+
+    def Free(self) -> None:
+        self._c.free()
+
+    def Abort(self, errorcode: int = 1):
+        import ompi_tpu
+
+        ompi_tpu.abort(errorcode)
+
+    # -- point-to-point: buffer convention ---------------------------------
+
+    def Send(self, buf, dest: int, tag: int = 0) -> None:
+        self._c.send(_as_array(buf), dest, tag)
+
+    def Ssend(self, buf, dest: int, tag: int = 0) -> None:
+        self._c.ssend(_as_array(buf), dest, tag)
+
+    def Bsend(self, buf, dest: int, tag: int = 0) -> None:
+        self._c.bsend(_as_array(buf), dest, tag)
+
+    def Rsend(self, buf, dest: int, tag: int = 0) -> None:
+        self._c.rsend(_as_array(buf), dest, tag)
+
+    def Recv(self, buf, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+             status: Optional[Status] = None) -> None:
+        arr = _as_array(buf)
+        st = _NativeStatus()
+        out = self._c.recv(arr, source, tag, status=st)
+        _fill_status(status, st)
+        if out is not None and not np.shares_memory(arr, np.asarray(out)):
+            _copy_into(buf, out)
+
+    def Isend(self, buf, dest: int, tag: int = 0) -> Request:
+        return Request(self._c.isend(_as_array(buf), dest, tag))
+
+    def Issend(self, buf, dest: int, tag: int = 0) -> Request:
+        return Request(self._c.issend(_as_array(buf), dest, tag))
+
+    def Ibsend(self, buf, dest: int, tag: int = 0) -> Request:
+        return Request(self._c.ibsend(_as_array(buf), dest, tag))
+
+    def Irsend(self, buf, dest: int, tag: int = 0) -> Request:
+        return Request(self._c.irsend(_as_array(buf), dest, tag))
+
+    def Irecv(self, buf, source: int = ANY_SOURCE,
+              tag: int = ANY_TAG) -> Request:
+        return Request(self._c.irecv(_as_array(buf), source, tag))
+
+    def Sendrecv(self, sendbuf, dest: int, sendtag: int = 0, recvbuf=None,
+                 source: int = ANY_SOURCE, recvtag: int = ANY_TAG,
+                 status: Optional[Status] = None) -> None:
+        st = _NativeStatus()
+        out = self._c.sendrecv(
+            _as_array(sendbuf), dest,
+            None if recvbuf is None else _as_array(recvbuf),
+            source, sendtag, recvtag, status=st)
+        _fill_status(status, st)
+        if recvbuf is not None and out is not None and not np.shares_memory(
+                _as_array(recvbuf), np.asarray(out)):
+            _copy_into(recvbuf, out)
+
+    def Sendrecv_replace(self, buf, dest: int, sendtag: int = 0,
+                         source: int = ANY_SOURCE, recvtag: int = ANY_TAG,
+                         status: Optional[Status] = None) -> None:
+        st = _NativeStatus()
+        self._c.sendrecv_replace(_as_array(buf), dest, source, sendtag,
+                                 recvtag, status=st)
+        _fill_status(status, st)
+
+    def Send_init(self, buf, dest: int, tag: int = 0) -> Prequest:
+        return Prequest(self._c.send_init(_as_array(buf), dest, tag))
+
+    def Recv_init(self, buf, source: int = ANY_SOURCE,
+                  tag: int = ANY_TAG) -> Prequest:
+        return Prequest(self._c.recv_init(_as_array(buf), source, tag))
+
+    # -- probes ------------------------------------------------------------
+
+    def Probe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+              status: Optional[Status] = None) -> bool:
+        st = self._c.probe(source, tag)
+        _fill_status(status, st)
+        return True
+
+    def Iprobe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+               status: Optional[Status] = None) -> bool:
+        st = self._c.iprobe(source, tag)
+        if st is None:
+            return False
+        _fill_status(status, st)
+        return True
+
+    def Mprobe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+               status: Optional[Status] = None) -> Message:
+        msg, st = self._c.mprobe(source, tag)
+        _fill_status(status, st)
+        return Message(self._c, msg)
+
+    def Improbe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+                status: Optional[Status] = None) -> Optional[Message]:
+        out = self._c.improbe(source, tag)
+        if out is None:
+            return None
+        msg, st = out
+        _fill_status(status, st)
+        return Message(self._c, msg)
+
+    # -- collectives: buffer convention ------------------------------------
+
+    def Barrier(self) -> None:
+        self._c.barrier()
+
+    def Bcast(self, buf, root: int = 0) -> None:
+        arr = _as_array(buf)
+        out = self._c.bcast(arr if self._c.rank == root else None, root)
+        if self._c.rank != root:
+            _copy_into(buf, out)
+
+    def Reduce(self, sendbuf, recvbuf, op: Op = SUM, root: int = 0) -> None:
+        send = (_as_array(recvbuf) if sendbuf is IN_PLACE
+                else _as_array(sendbuf))
+        out = self._c.reduce(send, op=_native_op(op), root=root)
+        if self._c.rank == root and recvbuf is not None:
+            _copy_into(recvbuf, out)
+
+    def Allreduce(self, sendbuf, recvbuf, op: Op = SUM) -> None:
+        send = (_as_array(recvbuf) if sendbuf is IN_PLACE
+                else _as_array(sendbuf))
+        out = self._c.allreduce(send, op=_native_op(op))
+        _copy_into(recvbuf, out)
+
+    def Gather(self, sendbuf, recvbuf, root: int = 0) -> None:
+        out = self._c.gather(_as_array(sendbuf), root)
+        if self._c.rank == root and recvbuf is not None:
+            _copy_into(recvbuf, np.concatenate(
+                [np.asarray(p).reshape(-1) for p in out]))
+
+    def Gatherv(self, sendbuf, recvbuf, root: int = 0) -> None:
+        out = self._c.gatherv(_as_array(sendbuf), root)
+        if self._c.rank == root and recvbuf is not None:
+            _copy_into(recvbuf, np.concatenate(
+                [np.asarray(p).reshape(-1) for p in out]))
+
+    def Allgather(self, sendbuf, recvbuf) -> None:
+        out = self._c.allgather(_as_array(sendbuf))
+        _copy_into(recvbuf, np.concatenate(
+            [np.asarray(p).reshape(-1) for p in out]))
+
+    def Allgatherv(self, sendbuf, recvbuf) -> None:
+        out = self._c.allgatherv(_as_array(sendbuf))
+        _copy_into(recvbuf, np.concatenate(
+            [np.asarray(p).reshape(-1) for p in out]))
+
+    def Scatter(self, sendbuf, recvbuf, root: int = 0) -> None:
+        send = None
+        if self._c.rank == root:
+            arr = _as_array(sendbuf)
+            send = arr.reshape(self._c.size, -1)
+        out = self._c.scatter(send, root)
+        if recvbuf is not None:
+            _copy_into(recvbuf, out)
+
+    def Scatterv(self, sendbuf, recvbuf, root: int = 0) -> None:
+        parts = None
+        if self._c.rank == root:
+            arr, counts, displs, dtype = _vspec(sendbuf)
+            parts = [arr.reshape(-1)[d:d + c]
+                     for c, d in zip(counts, displs)]
+        out = self._c.scatterv(parts, root)
+        if recvbuf is not None:
+            _copy_into(recvbuf, out)
+
+    def Alltoall(self, sendbuf, recvbuf) -> None:
+        arr = _as_array(sendbuf).reshape(self._c.size, -1)
+        out = self._c.alltoall(arr)
+        _copy_into(recvbuf, np.concatenate(
+            [np.asarray(p).reshape(-1) for p in out]))
+
+    def Reduce_scatter_block(self, sendbuf, recvbuf, op: Op = SUM) -> None:
+        out = self._c.reduce_scatter_block(_as_array(sendbuf),
+                                           op=_native_op(op))
+        _copy_into(recvbuf, out)
+
+    def Reduce_scatter(self, sendbuf, recvbuf, recvcounts=None,
+                       op: Op = SUM) -> None:
+        arr = _as_array(sendbuf)
+        if recvcounts is not None:
+            # explicit counts: reduce everywhere, keep my segment (the
+            # native reduce_scatter contract is the equal array_split)
+            me = self._c.rank
+            displs = np.concatenate([[0], np.cumsum(recvcounts)[:-1]])
+            reduced = np.asarray(
+                self._c.allreduce(arr, op=_native_op(op))).reshape(-1)
+            out = reduced[displs[me]:displs[me] + recvcounts[me]]
+        else:
+            out = self._c.reduce_scatter(arr, op=_native_op(op))
+        _copy_into(recvbuf, out)
+
+    def Scan(self, sendbuf, recvbuf, op: Op = SUM) -> None:
+        out = self._c.scan(_as_array(sendbuf), op=_native_op(op))
+        _copy_into(recvbuf, out)
+
+    def Exscan(self, sendbuf, recvbuf, op: Op = SUM) -> None:
+        out = self._c.exscan(_as_array(sendbuf), op=_native_op(op))
+        if self._c.rank != 0 and out is not None:
+            _copy_into(recvbuf, out)
+
+    # nonblocking collectives (the libnbc twins)
+    def Ibarrier(self) -> Request:
+        return Request(self._c.ibarrier())
+
+    def Ibcast(self, buf, root: int = 0) -> Request:
+        arr = _as_array(buf)
+        me = self._c.rank
+        req = self._c.ibcast(arr if me == root else None, root)
+        if me == root:
+            return Request(req)
+
+        def land(out, _buf=buf):
+            if out is not None:
+                _copy_into(_buf, out)
+            return out
+
+        return Request(req, transform=land)
+
+    def Iallreduce(self, sendbuf, recvbuf, op: Op = SUM) -> Request:
+        send = (_as_array(recvbuf) if sendbuf is IN_PLACE
+                else _as_array(sendbuf))
+        req = self._c.iallreduce(send, op=_native_op(op))
+
+        def land(out, _buf=recvbuf):
+            _copy_into(_buf, out)
+            return out
+
+        return Request(req, transform=land)
+
+    # -- point-to-point: object convention ---------------------------------
+
+    def send(self, obj, dest: int, tag: int = 0) -> None:
+        self._c.send(_dumps(obj), dest, tag)
+
+    def ssend(self, obj, dest: int, tag: int = 0) -> None:
+        self._c.ssend(_dumps(obj), dest, tag)
+
+    def recv(self, buf=None, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+             status: Optional[Status] = None) -> Any:
+        st = _NativeStatus()
+        out = self._c.recv(None, source, tag, status=st)
+        _fill_status(status, st)
+        return _loads(out)
+
+    def isend(self, obj, dest: int, tag: int = 0) -> Request:
+        return Request(self._c.isend(_dumps(obj), dest, tag))
+
+    def issend(self, obj, dest: int, tag: int = 0) -> Request:
+        return Request(self._c.issend(_dumps(obj), dest, tag))
+
+    def irecv(self, buf=None, source: int = ANY_SOURCE,
+              tag: int = ANY_TAG) -> Request:
+        return Request(self._c.irecv(None, source, tag), transform=_loads)
+
+    def sendrecv(self, sendobj, dest: int, sendtag: int = 0, recvbuf=None,
+                 source: int = ANY_SOURCE, recvtag: int = ANY_TAG,
+                 status: Optional[Status] = None) -> Any:
+        st = _NativeStatus()
+        out = self._c.sendrecv(_dumps(sendobj), dest, None, source,
+                               sendtag, recvtag, status=st)
+        _fill_status(status, st)
+        return _loads(out)
+
+    def probe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+              status: Optional[Status] = None) -> bool:
+        return self.Probe(source, tag, status)
+
+    def iprobe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+               status: Optional[Status] = None) -> bool:
+        return self.Iprobe(source, tag, status)
+
+    def mprobe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+               status: Optional[Status] = None) -> Message:
+        return self.Mprobe(source, tag, status)
+
+    def improbe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+                status: Optional[Status] = None) -> Optional[Message]:
+        return self.Improbe(source, tag, status)
+
+    # -- collectives: object convention ------------------------------------
+
+    def barrier(self) -> None:
+        self._c.barrier()
+
+    def bcast(self, obj, root: int = 0) -> Any:
+        me = self._c.rank
+        out = self._c.bcast(_dumps(obj) if me == root else None, root)
+        return _loads(out)
+
+    def gather(self, sendobj, root: int = 0) -> Optional[list]:
+        out = self._c.gatherv(_dumps(sendobj), root)
+        if self._c.rank != root:
+            return None
+        return [_loads(p) for p in out]
+
+    def allgather(self, sendobj) -> list:
+        out = self._c.allgatherv(_dumps(sendobj))
+        return [_loads(p) for p in out]
+
+    def scatter(self, sendobj, root: int = 0) -> Any:
+        parts = None
+        if self._c.rank == root:
+            if len(sendobj) != self._c.size:
+                raise ValueError(
+                    f"scatter list has {len(sendobj)} entries for "
+                    f"{self._c.size} ranks")
+            parts = [_dumps(o) for o in sendobj]
+        out = self._c.scatterv(parts, root)
+        return _loads(out)
+
+    def alltoall(self, sendobjs) -> list:
+        parts = [_dumps(o) for o in sendobjs]
+        out = self._c.alltoallv(parts)
+        return [_loads(p) for p in out]
+
+    def reduce(self, sendobj, op: Op = SUM, root: int = 0) -> Any:
+        vals = self.allgather(sendobj)
+        if self._c.rank != root:
+            return None
+        return _pyfold(op, vals)
+
+    def allreduce(self, sendobj, op: Op = SUM) -> Any:
+        return _pyfold(op, self.allgather(sendobj))
+
+    def scan(self, sendobj, op: Op = SUM) -> Any:
+        vals = self.allgather(sendobj)
+        return _pyfold(op, vals[: self._c.rank + 1])
+
+    def exscan(self, sendobj, op: Op = SUM) -> Any:
+        vals = self.allgather(sendobj)
+        if self._c.rank == 0:
+            return None
+        return _pyfold(op, vals[: self._c.rank])
+
+    def __repr__(self) -> str:
+        return f"<MPI.Comm {self._c!r}>"
+
+
+Intracomm = Comm  # mpi4py exposes COMM_WORLD as an Intracomm
+
+
+def _pyfold(op: Op, vals: list) -> Any:
+    fold = op._py if isinstance(op, Op) and op._py is not None else op
+    acc = vals[0]
+    for v in vals[1:]:
+        acc = fold(acc, v)
+    return acc
+
+
+def _vspec(spec):
+    """[buf, counts, displs?, datatype?] → (arr, counts, displs, dtype)."""
+    if not isinstance(spec, (list, tuple)):
+        raise ValueError("Scatterv/Gatherv need [buf, counts, ...] specs")
+    buf = np.asarray(spec[0])
+    counts = None
+    displs = None
+    dtype = None
+    seq = []
+    for extra in spec[1:]:
+        if isinstance(extra, Datatype):
+            dtype = extra
+        else:
+            seq.append(extra)
+    if len(seq) == 1:
+        item = seq[0]
+        if (isinstance(item, (list, tuple)) and len(item) == 2
+                and isinstance(item[0], (list, tuple, np.ndarray))):
+            counts, displs = item
+        else:
+            counts = item
+    elif len(seq) >= 2:
+        counts, displs = seq[0], seq[1]
+    counts = [int(c) for c in np.asarray(counts).reshape(-1)]
+    if displs is None:
+        displs = list(np.concatenate([[0], np.cumsum(counts)[:-1]]))
+    else:
+        displs = [int(d) for d in np.asarray(displs).reshape(-1)]
+    if dtype is not None and buf.dtype != dtype.np_dtype:
+        buf = buf.view(dtype.np_dtype)
+    return buf, counts, displs, dtype
+
+
+# ---------------------------------------------------------------------------
+# world / environment
+# ---------------------------------------------------------------------------
+
+class _LazyComm(Comm):
+    """COMM_WORLD/COMM_SELF resolved (and the runtime initialized) on
+    first use — mpi4py initializes at import; deferring to first touch
+    keeps ``import ompi_tpu.compat`` side-effect-free."""
+
+    def __init__(self, which: str):
+        self._which = which
+
+    @property
+    def _c(self):
+        import ompi_tpu
+
+        if not ompi_tpu.initialized():
+            from ompi_tpu.mpi import runtime as _rt
+
+            _rt.init()
+        return getattr(ompi_tpu, self._which)
+
+
+COMM_WORLD = _LazyComm("COMM_WORLD")
+COMM_SELF = _LazyComm("COMM_SELF")
+COMM_NULL = None
+
+
+def Init() -> None:
+    import ompi_tpu
+
+    if not ompi_tpu.initialized():
+        from ompi_tpu.mpi import runtime as _rt
+
+        _rt.init()
+
+
+def Init_thread(required: int = THREAD_MULTIPLE) -> int:
+    Init()
+    return THREAD_MULTIPLE
+
+
+def Finalize() -> None:
+    import ompi_tpu
+
+    if ompi_tpu.initialized():
+        from ompi_tpu.mpi import runtime as _rt
+
+        _rt.finalize()
+
+
+def Is_initialized() -> bool:
+    import ompi_tpu
+
+    return ompi_tpu.initialized()
+
+
+def Is_finalized() -> bool:
+    from ompi_tpu.mpi import runtime as _rt
+
+    return _rt.finalized()
+
+
+def Query_thread() -> int:
+    return THREAD_MULTIPLE
+
+
+def Get_processor_name() -> str:
+    import ompi_tpu
+
+    return ompi_tpu.get_processor_name()
+
+
+def Wtime() -> float:
+    import ompi_tpu
+
+    return ompi_tpu.wtime()
+
+
+def Wtick() -> float:
+    import ompi_tpu
+
+    return ompi_tpu.wtick()
+
+
+def Get_version() -> tuple:
+    import ompi_tpu
+
+    return ompi_tpu.get_version()
+
+
+def Get_library_version() -> str:
+    import ompi_tpu
+
+    return ompi_tpu.get_library_version()
+
+
+def pickle_dumps(obj) -> bytes:  # exposed like mpi4py.MPI.pickle
+    return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def pickle_loads(data: bytes) -> Any:
+    return pickle.loads(data)

@@ -32,9 +32,11 @@ class TransformerConfig:
     n_layers: int = 4
     d_ff: int = 2048
     seq: int = 512
-    attention: str = "ring"  # ring | ulysses | flash | gathered
+    attention: str = "ring"  # ring | ulysses | flash | xla | gathered
     # ("flash" = ulysses resharding + the pallas flash kernel for the
-    # local attention — offsets are static there, so the kernel applies)
+    # local attention — offsets are static there, so the kernel applies;
+    # "xla" = the same ulysses resharding but the jnp/XLA local attention,
+    # the pallas-vs-XLA ablation pair for "flash")
     # MoE model family: >0 replaces every layer's dense FFN with a
     # switch-MoE of this many experts, sharded over the mesh's "ep" axis
     # (experts % ep == 0); the load-balancing aux loss joins the training
@@ -179,6 +181,9 @@ def _local_backbone(cfg: TransformerConfig, comm, params, tokens):
         elif cfg.attention == "flash":
             o = attn_mod.ulysses_attention(comm, q, k, v, axis="sp",
                                            impl="flash")
+        elif cfg.attention == "xla":
+            o = attn_mod.ulysses_attention(comm, q, k, v, axis="sp",
+                                           impl="jnp")
         else:
             o = attn_mod.gathered_attention(comm, q, k, v, axis="sp")
         o = o.reshape(B, t, h_local * hd)

@@ -212,6 +212,7 @@ class Intercomm:
         self.name = name
         self.pml = local_comm.pml
         self.rank = local_comm.rank
+        self._pending: list = []   # outstanding user p2p (disconnect waits)
 
     @property
     def size(self) -> int:
@@ -223,20 +224,28 @@ class Intercomm:
 
     # -- p2p against the remote group -------------------------------------
 
+    def _track(self, req: Request) -> Request:
+        """Remember outstanding user p2p so disconnect() can honor the
+        MPI contract (all pending communication completes first)."""
+        self._pending = [r for r in self._pending if not r.test()]
+        self._pending.append(req)
+        return req
+
     def isend(self, buf: Any, dest: int, tag: int = 0) -> Request:
         if dest == PROC_NULL:
             from ompi_tpu.mpi.request import CompletedRequest
 
             return CompletedRequest()
-        return self.pml.isend(np.asarray(buf), self.remote_ids[dest], tag,
-                              self.cid)
+        return self._track(self.pml.isend(np.asarray(buf),
+                                          self.remote_ids[dest], tag,
+                                          self.cid))
 
     def send(self, buf: Any, dest: int, tag: int = 0) -> None:
         self.isend(buf, dest, tag).wait()
 
     def irecv(self, source: int = 0, tag: int = ANY_TAG) -> Request:
         src = self.remote_ids[source] if source >= 0 else source
-        return self.pml.irecv(None, src, tag, self.cid)
+        return self._track(self.pml.irecv(None, src, tag, self.cid))
 
     def recv(self, source: int = 0, tag: int = ANY_TAG,
              status: Optional[Status] = None) -> np.ndarray:
@@ -395,10 +404,14 @@ class Intercomm:
         return self.local_comm.group
 
     def disconnect(self) -> None:
-        """≈ MPI_Comm_disconnect: collective over the local group; waits
-        for pending traffic (p2p requests complete before returning here)
-        then drops the intercomm's local resources."""
-        self.local_comm.barrier()
+        """≈ MPI_Comm_disconnect: collective over BOTH groups; completes
+        every pending p2p request issued through this intercomm, then
+        synchronizes both sides before dropping the local resources —
+        so no in-flight message can outlive the communicator."""
+        for r in self._pending:
+            r.wait()
+        self._pending = []
+        self.barrier()           # both groups, not just the local one
         self.remote_ids = []
 
     def merge(self, high: Optional[bool] = None) -> Communicator:
@@ -516,6 +529,19 @@ _spawned: list = []   # Popen handles of spawned launchers (not reaped here)
 # block so the two families never collide
 _ICC_CID_BASE = 1 << 21
 
+# per-process next-free icc cid offset, agreed by MAX over every
+# participant at creation (the reference's cid allocation discipline:
+# ompi_comm_nextcid's max-agreement) — a per-pair sequence number would
+# let two leader pairs with disjoint histories mint the same cid while
+# sharing member processes, silently cross-matching traffic.
+_icc_lock = threading.Lock()
+_icc_next = [0]
+
+
+def _icc_bump(cid_off: int) -> None:
+    with _icc_lock:
+        _icc_next[0] = max(_icc_next[0], cid_off + 1)
+
 
 def intercomm_create(local_comm: Communicator, local_leader: int,
                      bridge_comm: Communicator, remote_leader: int,
@@ -525,18 +551,25 @@ def intercomm_create(local_comm: Communicator, local_leader: int,
     ``bridge_comm`` p2p (dpm.c's same-job path — no sockets, no business
     cards: both groups already share the namespace and transports)."""
     me_leader = local_comm.rank == local_leader
+    # collision-free cid: my group's max next-free offset (collective),
+    # then leaders exchange and take the global max — any process that
+    # ever saw offset k has bumped past it, so no member of the new
+    # intercomm can hold an old intercomm with the same cid
+    with _icc_lock:
+        my_next = _icc_next[0]
+    local_next = int(np.asarray(local_comm.allreduce(
+        np.array([my_next], np.int64), op=_max_op()))[0])
     if me_leader:
         mine = np.array([local_comm.world_rank(r)
                          for r in range(local_comm.size)], np.int64)
-        seq = _next_dpm_seq()
-        hdr = np.array([seq, len(mine)], np.int64)
+        hdr = np.array([local_next, len(mine)], np.int64)
         sreq = bridge_comm.isend(np.concatenate([hdr, mine]),
                                  dest=remote_leader, tag=tag)
         got = np.asarray(bridge_comm.recv(source=remote_leader, tag=tag))
         sreq.wait()
-        their_seq, n = int(got[0]), int(got[1])
+        their_next, n = int(got[0]), int(got[1])
         remote = got[2:2 + n]
-        cid = _ICC_CID_BASE + max(seq, their_seq)
+        cid = _ICC_CID_BASE + max(local_next, their_next)
         blob = np.concatenate([np.array([cid], np.int64), remote])
         local_comm.bcast(np.array([len(blob)], np.int64),
                          root=local_leader)
@@ -546,6 +579,7 @@ def intercomm_create(local_comm: Communicator, local_leader: int,
         blob = np.asarray(local_comm.bcast(None, root=local_leader))[:n]
         cid = int(blob[0])
         remote = blob[1:]
+    _icc_bump(cid - _ICC_CID_BASE)
     # overlapping groups are erroneous in MPI — catch the common mistake
     local_ids = {local_comm.world_rank(r) for r in range(local_comm.size)}
     if local_ids & set(int(r) for r in remote):

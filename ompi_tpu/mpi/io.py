@@ -483,7 +483,14 @@ class File:
     """An open MPI file handle (≈ ompi_file_t + the ompio module state)."""
 
     def __init__(self, comm, path: str, amode: int) -> None:
-        self.comm = comm
+        # private communicator for all file-internal traffic (ROMIO dups
+        # for the same reason): the nonblocking-collective worker thread
+        # runs collectives concurrently with the caller's thread, and on
+        # the user's comm those could cross-match the user's same-tag
+        # collectives.  Collective, so it must be the first comm op here.
+        self.comm = comm.dup(name=f"{getattr(comm, 'name', 'comm')}.io")
+        if hasattr(comm, "_io_host_override"):  # test/placement hook
+            self.comm._io_host_override = comm._io_host_override
         self.path = os.path.abspath(path)
         self.amode = amode
         self.view = FileView()
@@ -511,18 +518,18 @@ class File:
             # exclusive create and broadcasts the outcome (a plain barrier
             # would hang the others if rank 0's open fails), then the rest
             # open the now-existing file
-            if comm.rank == 0:
+            if self.comm.rank == 0:
                 try:
                     self._fd = os.open(self.path, flags | os.O_EXCL, 0o644)
                 except OSError as e:
                     err = str(e)
-            ok = comm.bcast(np.array([0 if err else 1], np.int8), root=0)
+            ok = self.comm.bcast(np.array([0 if err else 1], np.int8), root=0)
             if not int(np.asarray(ok)[0]):
                 raise MPIException(
                     f"MPI_File_open({path}): "
                     f"{err or 'exclusive create failed on rank 0'}",
                     error_class=ERR_IO)
-            if comm.rank != 0:
+            if self.comm.rank != 0:
                 try:
                     self._fd = os.open(self.path, flags & ~os.O_CREAT)
                 except OSError as e:
@@ -536,7 +543,7 @@ class File:
         # visible on only some ranks / EXCL non-root open racing a delete)
         # must raise on EVERY rank — otherwise the survivors proceed to the
         # barrier below and the job hangs
-        nfail = int(np.asarray(comm.allreduce(
+        nfail = int(np.asarray(self.comm.allreduce(
             np.array([0 if not err else 1], np.int32)))[0])
         if nfail:
             if self._fd is not None and not err:
@@ -574,11 +581,11 @@ class File:
             if self._shfp.name == "sm":
                 # per-open nonce, rank 0's choice broadcast: concurrent
                 # opens of one path must not collide on the segment name
-                nonce = int(np.asarray(comm.bcast(np.array(
+                nonce = int(np.asarray(self.comm.bcast(np.array(
                     [os.getpid() << 16 | (next(_shfp_nonce) & 0xFFFF)],
                     np.int64), root=0))[0])
                 self._shfp.set_nonce(nonce)
-            if comm.rank == 0:
+            if self.comm.rank == 0:
                 try:
                     self._shfp.create(initial)
                 except OSError as e:
@@ -588,25 +595,25 @@ class File:
             # attach, then agree on the attach outcomes too — a single
             # rank with a broken pointer would otherwise raise
             # mid-collective while its peers block in the matching barrier
-            flag = comm.bcast(np.array(
+            flag = self.comm.bcast(np.array(
                 [1 if not self._shfp_err else 0], np.int8), root=0)
             if not int(np.asarray(flag)[0]):
-                if comm.rank != 0:
+                if self.comm.rank != 0:
                     self._shfp_err = \
                         "shared-pointer creation failed on rank 0"
-            elif comm.rank != 0:
+            elif self.comm.rank != 0:
                 try:
                     self._shfp.attach()
                 except OSError as e:
                     self._shfp_err = str(e)
         from ompi_tpu.mpi import op as op_mod
 
-        ok_everywhere = int(np.asarray(comm.allreduce(np.array(
+        ok_everywhere = int(np.asarray(self.comm.allreduce(np.array(
             [0 if self._shfp_err else 1], np.int32),
             op=op_mod.MIN))[0])
         if not ok_everywhere and not self._shfp_err:
             self._shfp_err = "shared-pointer setup failed on a peer rank"
-        comm.barrier()
+        self.comm.barrier()
 
     def _select_sharedfp(self):
         """Component choice, identical on every rank: forced var > auto
@@ -677,6 +684,17 @@ class File:
         if q is not None:      # drain + stop the nonblocking-IO worker
             q.put(None)
             self._io_thread.join(timeout=60.0)
+            if self._io_thread.is_alive():
+                # a queued collective IO op is stuck (e.g. a peer died
+                # mid-collective).  Closing the fd now would hand the
+                # worker a recycled descriptor — leak it instead and
+                # surface the hang.
+                self._closed = True
+                raise MPIException(
+                    f"MPI_File_close({self.path}): nonblocking-IO worker "
+                    "still running after 60s — outstanding collective op "
+                    "never completed (fd leaked, not closed)",
+                    error_class=ERR_IO)
             self._io_queue = None
         self.sync()
         self.comm.barrier()
@@ -690,6 +708,7 @@ class File:
                 except OSError:
                     pass
         self.comm.barrier()
+        self.comm.free()       # the private dup taken at open
 
     @staticmethod
     def delete(path: str) -> None:
@@ -886,6 +905,8 @@ class File:
     def _io_async(self, kind: str, fn, *args) -> Request:
         import queue
 
+        self._check_open()  # a post-close i-op must raise here, not
+        # spawn a fresh worker that blocks on q.get() forever
         q = getattr(self, "_io_queue", None)
         if q is None:
             q = self._io_queue = queue.Queue()
@@ -909,18 +930,53 @@ class File:
         q.put((req, fn, args))
         return req
 
+    def _ordered_collective(self, kind: str, fn, *args):
+        """Blocking collective ops go through the SAME FIFO as any
+        outstanding nonblocking/split collective: MPI requires collective
+        file ops on one handle to complete in issue order on every rank,
+        and a caller-thread collective racing the worker's can invert
+        order on some ranks only — cross-matching their fixed-tag
+        traffic.  With no worker running, run inline (no queue spawn)."""
+        if getattr(self, "_io_queue", None) is not None:
+            return self._io_async(kind, fn, *args).wait()
+        return fn(*args)
+
+    def write_at_all(self, offset: int, data: Any) -> int:
+        return self._ordered_collective(
+            "write_at_all", self._write_at_all_impl, offset, data)
+
+    def read_at_all(self, offset: int, count: int) -> np.ndarray:
+        return self._ordered_collective(
+            "read_at_all", self._read_at_all_impl, offset, count)
+
+    def write_all(self, data: Any) -> int:
+        return self._ordered_collective(
+            "write_all", self._write_all_impl, data)
+
+    def read_all(self, count: int) -> np.ndarray:
+        return self._ordered_collective(
+            "read_all", self._read_all_impl, count)
+
+    def write_ordered(self, data: Any) -> int:
+        return self._ordered_collective(
+            "write_ordered", self._write_ordered_impl, data)
+
+    def read_ordered(self, count: int) -> np.ndarray:
+        return self._ordered_collective(
+            "read_ordered", self._read_ordered_impl, count)
+
     def iread_all(self, count: int) -> Request:
-        return self._io_async("iread_all", self.read_all, count)
+        return self._io_async("iread_all", self._read_all_impl, count)
 
     def iwrite_all(self, data: Any) -> Request:
-        return self._io_async("iwrite_all", self.write_all, data)
+        return self._io_async("iwrite_all", self._write_all_impl, data)
 
     def iread_at_all(self, offset: int, count: int) -> Request:
-        return self._io_async("iread_at_all", self.read_at_all, offset,
+        return self._io_async("iread_at_all", self._read_at_all_impl, offset,
                               count)
 
     def iwrite_at_all(self, offset: int, data: Any) -> Request:
-        return self._io_async("iwrite_at_all", self.write_at_all, offset,
+        return self._io_async("iwrite_at_all", self._write_at_all_impl, offset,
                               data)
 
     def iread_shared(self, count: int) -> Request:
@@ -953,37 +1009,37 @@ class File:
         return req.wait()
 
     def read_all_begin(self, count: int) -> None:
-        self._split_begin("read_all", self.read_all, count)
+        self._split_begin("read_all", self._read_all_impl, count)
 
     def read_all_end(self) -> np.ndarray:
         return self._split_end("read_all")
 
     def write_all_begin(self, data: Any) -> None:
-        self._split_begin("write_all", self.write_all, data)
+        self._split_begin("write_all", self._write_all_impl, data)
 
     def write_all_end(self) -> int:
         return self._split_end("write_all")
 
     def read_at_all_begin(self, offset: int, count: int) -> None:
-        self._split_begin("read_at_all", self.read_at_all, offset, count)
+        self._split_begin("read_at_all", self._read_at_all_impl, offset, count)
 
     def read_at_all_end(self) -> np.ndarray:
         return self._split_end("read_at_all")
 
     def write_at_all_begin(self, offset: int, data: Any) -> None:
-        self._split_begin("write_at_all", self.write_at_all, offset, data)
+        self._split_begin("write_at_all", self._write_at_all_impl, offset, data)
 
     def write_at_all_end(self) -> int:
         return self._split_end("write_at_all")
 
     def read_ordered_begin(self, count: int) -> None:
-        self._split_begin("read_ordered", self.read_ordered, count)
+        self._split_begin("read_ordered", self._read_ordered_impl, count)
 
     def read_ordered_end(self) -> np.ndarray:
         return self._split_end("read_ordered")
 
     def write_ordered_begin(self, data: Any) -> None:
-        self._split_begin("write_ordered", self.write_ordered, data)
+        self._split_begin("write_ordered", self._write_ordered_impl, data)
 
     def write_ordered_end(self) -> int:
         return self._split_end("write_ordered")
@@ -1202,7 +1258,7 @@ class File:
                 ln -= take
         return meta, payload, order
 
-    def write_at_all(self, offset: int, data: Any) -> int:
+    def _write_at_all_impl(self, offset: int, data: Any) -> int:
         """≈ MPI_File_write_at_all — collective write through the
         selected fcoll component (ref: fcoll/two_phase/
         fcoll_two_phase_file_write_all.c, fcoll/dynamic)."""
@@ -1242,7 +1298,7 @@ class File:
         comm.barrier()
         return len(raw) // self.view.etype.size
 
-    def read_at_all(self, offset: int, count: int) -> np.ndarray:
+    def _read_at_all_impl(self, offset: int, count: int) -> np.ndarray:
         """≈ MPI_File_read_at_all — collective read through the selected
         fcoll component."""
         self._check_read()
@@ -1297,17 +1353,17 @@ class File:
         comm.barrier()
         return self._from_bytes(bytes(out))
 
-    def write_all(self, data: Any) -> int:
+    def _write_all_impl(self, data: Any) -> int:
         """≈ MPI_File_write_all (individual pointer + collective)."""
         with self._io_lock:
-            n = self.write_at_all(self._pos, data)
+            n = self._write_at_all_impl(self._pos, data)
             self._pos += n
         return n
 
-    def read_all(self, count: int) -> np.ndarray:
+    def _read_all_impl(self, count: int) -> np.ndarray:
         """≈ MPI_File_read_all."""
         with self._io_lock:
-            out = self.read_at_all(self._pos, count)
+            out = self._read_at_all_impl(self._pos, count)
             self._pos += self._etypes_of(out)
         return out
 
@@ -1427,7 +1483,7 @@ class File:
             return self._shfp.merged_end, True
         return self._shfp_load(), False
 
-    def write_ordered(self, data: Any) -> int:
+    def _write_ordered_impl(self, data: Any) -> int:
         """≈ MPI_File_write_ordered — collective, rank order in file."""
         self._check_write()
         raw = self._as_bytes(data)
@@ -1444,7 +1500,7 @@ class File:
         self.comm.barrier()
         return n
 
-    def read_ordered(self, count: int) -> np.ndarray:
+    def _read_ordered_impl(self, count: int) -> np.ndarray:
         """≈ MPI_File_read_ordered."""
         self._check_read()
         sizes = np.asarray(self.comm.allgather(np.array([count], np.int64)))

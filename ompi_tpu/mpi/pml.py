@@ -902,6 +902,7 @@ class PmlOb1:
             st.source = peer
             st.tag = hdr["tag"]
             st.count = hdr.get("elems", hdr.get("size", 0))
+            st.count_bytes = hdr.get("size")
             return st
         probe = RecvRequest(None, dt_mod.BYTE, 0, source, tag, cid)
         for peer, hdr, payload in self._matching_for(cid).unexpected:
@@ -910,6 +911,7 @@ class PmlOb1:
                 st.source = peer
                 st.tag = hdr["tag"]
                 st.count = hdr.get("elems", hdr.get("size", len(payload)))
+                st.count_bytes = hdr.get("size", len(payload))
                 return st
         return None
 
@@ -957,6 +959,7 @@ class PmlOb1:
         st.source = peer
         st.tag = hdr["tag"]
         st.count = hdr.get("elems", hdr.get("size", len(payload)))
+        st.count_bytes = hdr.get("size", len(payload))
         return Message(self, peer, hdr, payload), st
 
     def mprobe(self, source: int, tag: int, cid: int,
@@ -1211,6 +1214,7 @@ class PmlOb1:
             req.status.source = peer if ov is None else ov
             req.status.tag = tag
             req.status.count = count
+            req.status.count_bytes = nbytes
             req.complete(req.buf)
         elif kind == "adeliver":
             # fast-lane frame matched an allocate-on-match recv: build
@@ -1319,6 +1323,7 @@ class PmlOb1:
         req.status.source = state.peer
         req.status.tag = hdr["tag"]
         req.status.count = nbytes // req.datatype.base_np.itemsize
+        req.status.count_bytes = nbytes
         req.complete(req.buf)
 
     def _deliver(self, req: RecvRequest, peer: int, hdr: dict,
@@ -1362,6 +1367,7 @@ class PmlOb1:
         elem_size = (datatype.base_np.itemsize if datatype is not None
                      else _wire_to_dtype(hdr["dt"]).itemsize)
         req.status.count = len(payload) // elem_size
+        req.status.count_bytes = len(payload)
         req.complete(out)
 
     # -- send worker (the only thread that writes payloads) ----------------

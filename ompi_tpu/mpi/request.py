@@ -40,6 +40,10 @@ class Status:
         self.tag: int = -1
         self.error: int = 0
         self.count: int = 0
+        # received payload size in BYTES where the PML knows it (None
+        # otherwise) — lets unit-converting count queries (mpi4py's
+        # Get_count(datatype)) divide by a different item width
+        self.count_bytes: Optional[int] = None
         self._cancelled: bool = False
         self._elements: Optional[int] = None  # set_elements override
 

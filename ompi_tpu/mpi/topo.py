@@ -493,11 +493,13 @@ def _recv_tag(topo, i: int, s: int, srcs: list[int], tag: int) -> int:
     return tag + (occurrence % 64)
 
 
-def _neighbor_exchange(comm, send_per_dst: list, tag: int) -> list:
-    """Post irecvs from in-neighbors, isends to out-neighbors, wait all.
+def _edge_plan(comm, send_per_dst: list, tag: int):
+    """The neighbor-collective wire plan — the ONE source of truth for
+    the edge slot/tag discipline (see _send_slot's 2-cycle-torus note),
+    shared by the blocking and nonblocking variants so they always pair.
 
-    PROC_NULL neighbors yield None in the result (MPI leaves the segment
-    untouched; None is the honest Python rendering of that).
+    Returns (srcs, sends, recvs): sends = [(data, dst, tag)] with
+    PROC_NULL edges dropped; recvs = [(in_index, src, tag)] likewise.
     """
     topo = _topo_of(comm)
     srcs, dsts = topo.neighbors(comm.rank)
@@ -505,19 +507,28 @@ def _neighbor_exchange(comm, send_per_dst: list, tag: int) -> list:
         raise MPIException(
             f"need {len(dsts)} send blocks, got {len(send_per_dst)}",
             error_class=2)
-    rreqs = []
-    for i, s in enumerate(srcs):
-        rreqs.append(None if s == PROC_NULL else
-                     comm._coll_irecv(None, s,
-                                      _recv_tag(topo, i, s, srcs, tag)))
-    sreqs = []
+    sends = []
     for j, d in enumerate(dsts):
         if d == PROC_NULL:
             continue
         slot = _send_slot(topo, comm.rank, j, d, dsts)
-        sreqs.append(comm._coll_isend(np.asarray(send_per_dst[j]), d,
-                                      tag + (slot % 64)))
-    out = [r.wait() if r is not None else None for r in rreqs]
+        sends.append((np.asarray(send_per_dst[j]), d, tag + (slot % 64)))
+    recvs = [(i, s, _recv_tag(topo, i, s, srcs, tag))
+             for i, s in enumerate(srcs) if s != PROC_NULL]
+    return srcs, sends, recvs
+
+
+def _neighbor_exchange(comm, send_per_dst: list, tag: int) -> list:
+    """Post irecvs from in-neighbors, isends to out-neighbors, wait all.
+
+    PROC_NULL neighbors yield None in the result (MPI leaves the segment
+    untouched; None is the honest Python rendering of that).
+    """
+    srcs, sends, recvs = _edge_plan(comm, send_per_dst, tag)
+    rreq_by_i = {i: comm._coll_irecv(None, s, t) for i, s, t in recvs}
+    sreqs = [comm._coll_isend(data, d, t) for data, d, t in sends]
+    out = [rreq_by_i[i].wait() if i in rreq_by_i else None
+           for i in range(len(srcs))]
     for s in sreqs:
         s.wait()
     return out
@@ -577,24 +588,10 @@ def _ineighbor(comm, send_per_dst: list, tag: int, kind: str):
     ops pair up by posting order exactly like consecutive blocking ones."""
     from ompi_tpu.mpi.coll.nbc import Round, _const, _launch
 
-    topo = _topo_of(comm)
-    srcs, dsts = topo.neighbors(comm.rank)
-    if len(send_per_dst) != len(dsts):
-        raise MPIException(
-            f"need {len(dsts)} send blocks, got {len(send_per_dst)}",
-            error_class=2)
-    sends = []
-    for j, d in enumerate(dsts):
-        if d == PROC_NULL:
-            continue
-        slot = _send_slot(topo, comm.rank, j, d, dsts)
-        sends.append((_const(np.asarray(send_per_dst[j])), d,
-                      tag + (slot % 64)))
-    recvs = []
-    for i, s in enumerate(srcs):
-        if s != PROC_NULL:
-            recvs.append((s, f"n{i}", _recv_tag(topo, i, s, srcs, tag)))
-    rounds = [Round(sends=tuple(sends), recvs=tuple(recvs))]
+    srcs, sends, recvs = _edge_plan(comm, send_per_dst, tag)
+    rounds = [Round(
+        sends=tuple((_const(data), d, t) for data, d, t in sends),
+        recvs=tuple((s, f"n{i}", t) for i, s, t in recvs))]
 
     def result(state):
         return [state.get(f"n{i}") if s != PROC_NULL else None

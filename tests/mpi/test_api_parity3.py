@@ -182,6 +182,43 @@ def test_intercomm_create_from_split():
     assert res == [1, 0, 3, 2]
 
 
+def test_intercomm_create_distinct_cids_shared_members():
+    """Two intercomms sharing member processes must get distinct cids —
+    the max-agreement allocation (≈ ompi_comm_nextcid): a per-pair
+    sequence would mint the same cid for {0}×{1} and {0,2}×{1,3} (fresh
+    leader counters on both sides) and cross-match their traffic."""
+    def fn(comm):
+        # intercomm 1: {0} × {1} over a sub-bridge, leaders 0 and 1
+        cids = []
+        if comm.rank in (0, 1):
+            pair = comm.split(0 if comm.rank in (0, 1) else C.UNDEFINED)
+        else:
+            pair = comm.split(C.UNDEFINED)
+        if pair is not None:
+            solo = pair.split(pair.rank)     # 1-rank comms {0}, {1}
+            ic1 = dpm.intercomm_create(solo, 0, pair,
+                                       remote_leader=1 - pair.rank, tag=9)
+            cids.append(ic1.cid)
+        comm.barrier()
+        # intercomm 2: evens × odds over the world — every rank a member
+        half = comm.split(comm.rank % 2)
+        ic2 = dpm.intercomm_create(half, 0, comm,
+                                   remote_leader=(comm.rank + 1) % 2,
+                                   tag=9)
+        cids.append(ic2.cid)
+        # traffic must stay separated: exchange on ic2 while ic1 exists
+        peer = half.rank
+        sreq = ic2.isend(np.array([comm.rank], np.int32), dest=peer, tag=5)
+        got = int(np.asarray(ic2.recv(source=peer, tag=5))[0])
+        sreq.wait()
+        return cids, got
+
+    res = run_ranks(4, fn)
+    cids0, got0 = res[0]
+    assert len(cids0) == 2 and cids0[0] != cids0[1], cids0
+    assert [r[1] for r in res] == [1, 0, 3, 2]
+
+
 def test_comm_join_over_socketpair():
     a, b = socket.socketpair()
     out = {}
@@ -417,6 +454,34 @@ def test_nonblocking_collective_io(tmp_path):
 
     res = run_ranks(2, fn)
     assert list(res[0]) == [0.0, 0.5, 1.0, 1.5]
+
+
+def test_nonblocking_io_isolated_from_user_collectives(tmp_path):
+    """The IO worker's internal collectives run on the file's private
+    dup'ed communicator (the ROMIO discipline), so a user collective
+    issued while an iwrite_all is in flight can never cross-match the
+    worker's same-tag traffic."""
+    path = str(tmp_path / "nbc_iso.bin")
+
+    def fn(comm):
+        f = io_mod.File.open(
+            comm, path, io_mod.MODE_CREATE | io_mod.MODE_RDWR)
+        f.set_view(etype=dt.FLOAT64)
+        outs = []
+        for i in range(5):
+            w = f.iwrite_at_all(comm.rank * 2,
+                                np.array([1.0 * i, 2.0 * i]))
+            # user-comm collective racing the worker's internal ones
+            mine = np.array([comm.rank * 100 + i], np.int64)
+            outs.append(np.asarray(comm.allgather(mine)).reshape(-1))
+            assert w.wait(timeout=30) == 2
+        f.close()
+        return outs
+
+    res = run_ranks(2, fn)
+    for r, outs in enumerate(res):
+        for i, got in enumerate(outs):
+            assert list(got) == [i, 100 + i], (r, i, got)
 
 
 def test_external32_datarep_roundtrip(tmp_path):

@@ -1,0 +1,320 @@
+"""mpi4py-compat facade (ompi_tpu.compat.MPI) over the in-process harness.
+
+Each test wraps the harness's native communicators in MPI.Comm and runs
+mpi4py-spelled code — the same lines an mpi4py script would contain."""
+
+import numpy as np
+import pytest
+
+from ompi_tpu.compat import MPI
+from tests.mpi.harness import run_ranks
+
+
+def wrap(fn):
+    return lambda c: fn(MPI.Comm(c))
+
+
+def test_send_recv_buffer_spec_and_status():
+    def fn(comm):
+        rank = comm.Get_rank()
+        if rank == 0:
+            buf = np.arange(8, dtype=np.float64)
+            comm.Send([buf, MPI.DOUBLE], dest=1, tag=7)
+            return None
+        out = np.zeros(8, dtype=np.float64)
+        st = MPI.Status()
+        comm.Recv(out, source=MPI.ANY_SOURCE, tag=MPI.ANY_TAG, status=st)
+        assert st.Get_source() == 0
+        assert st.Get_tag() == 7
+        assert st.Get_count(MPI.DOUBLE) == 8
+        assert st.Get_count(MPI.BYTE) == 64      # unit conversion
+        assert st.Get_count(MPI.INT32_T) == 16
+        return out
+
+    res = run_ranks(2, wrap(fn))
+    np.testing.assert_array_equal(res[1], np.arange(8, dtype=np.float64))
+
+
+def test_lowercase_objects_roundtrip():
+    def fn(comm):
+        if comm.rank == 0:
+            comm.send({"k": [1, 2, 3], "s": "hello"}, dest=1, tag=3)
+            req = comm.irecv(source=1, tag=4)
+            return req.wait()
+        obj = comm.recv(source=0, tag=3)
+        comm.isend(("reply", obj["k"]), dest=0, tag=4).Wait()
+        return obj
+
+    res = run_ranks(2, wrap(fn))
+    assert res[0] == ("reply", [1, 2, 3])
+    assert res[1] == {"k": [1, 2, 3], "s": "hello"}
+
+
+def test_lowercase_collectives():
+    def fn(comm):
+        rank, size = comm.rank, comm.size
+        got = comm.bcast({"root": "payload"} if rank == 0 else None, root=0)
+        assert got == {"root": "payload"}
+        gathered = comm.gather(f"r{rank}", root=0)
+        if rank == 0:
+            assert gathered == [f"r{r}" for r in range(size)]
+        else:
+            assert gathered is None
+        all_objs = comm.allgather(rank * 10)
+        assert all_objs == [r * 10 for r in range(size)]
+        mine = comm.scatter([f"part{r}" for r in range(size)]
+                            if rank == 0 else None, root=0)
+        assert mine == f"part{rank}"
+        swapped = comm.alltoall([(rank, r) for r in range(size)])
+        assert swapped == [(r, rank) for r in range(size)]
+        total = comm.allreduce(rank + 1)
+        assert total == sum(r + 1 for r in range(size))
+        rtot = comm.reduce(rank + 1, op=MPI.SUM, root=0)
+        assert (rtot == total) if rank == 0 else (rtot is None)
+        pre = comm.scan(rank + 1)
+        assert pre == sum(r + 1 for r in range(rank + 1))
+        epre = comm.exscan(rank + 1)
+        if rank == 0:
+            assert epre is None
+        else:
+            assert epre == sum(r + 1 for r in range(rank))
+        return True
+
+    assert all(run_ranks(4, wrap(fn)))
+
+
+def test_uppercase_collectives():
+    def fn(comm):
+        rank, size = comm.rank, comm.size
+        buf = np.full(4, rank, np.float64) if rank == 0 else np.zeros(
+            4, np.float64)
+        comm.Bcast(buf, root=0)
+        np.testing.assert_array_equal(buf, np.zeros(4))
+
+        send = np.full(3, rank + 1.0)
+        recv = np.zeros(3)
+        comm.Allreduce(send, recv, op=MPI.SUM)
+        np.testing.assert_array_equal(
+            recv, np.full(3, sum(r + 1.0 for r in range(size))))
+
+        # IN_PLACE
+        acc = np.full(3, rank + 1.0)
+        comm.Allreduce(MPI.IN_PLACE, acc, op=MPI.MAX)
+        np.testing.assert_array_equal(acc, np.full(3, float(size)))
+
+        out = np.zeros(size, np.int64)
+        comm.Allgather(np.array([rank], np.int64), out)
+        np.testing.assert_array_equal(out, np.arange(size))
+
+        gat = np.zeros(size, np.int64) if rank == 0 else None
+        comm.Gather(np.array([rank], np.int64), gat, root=0)
+        if rank == 0:
+            np.testing.assert_array_equal(gat, np.arange(size))
+
+        part = np.zeros(2, np.int64)
+        comm.Scatter(np.arange(2 * size, dtype=np.int64)
+                     if rank == 0 else None, part, root=0)
+        np.testing.assert_array_equal(part, [2 * rank, 2 * rank + 1])
+
+        a2a = np.zeros(size, np.int64)
+        comm.Alltoall(np.full(size, rank, np.int64), a2a)
+        np.testing.assert_array_equal(a2a, np.arange(size))
+
+        red = np.zeros(2) if rank == 0 else None
+        comm.Reduce(np.array([rank + 1.0, 1.0]), red, op=MPI.PROD, root=0)
+        if rank == 0:
+            want = np.prod([r + 1.0 for r in range(size)])
+            np.testing.assert_allclose(red, [want, 1.0])
+
+        sc = np.zeros(1)
+        comm.Scan(np.array([float(rank + 1)]), sc, op=MPI.SUM)
+        assert sc[0] == sum(r + 1 for r in range(rank + 1))
+        return True
+
+    assert all(run_ranks(4, wrap(fn)))
+
+
+def test_scatterv_gatherv_counts_displs():
+    def fn(comm):
+        rank, size = comm.rank, comm.size
+        counts = [r + 1 for r in range(size)]
+        displs = list(np.concatenate([[0], np.cumsum(counts)[:-1]]))
+        total = sum(counts)
+        recv = np.zeros(counts[rank])
+        comm.Scatterv([np.arange(total, dtype=np.float64)
+                       if rank == 0 else np.zeros(0),
+                       counts, displs, MPI.DOUBLE], recv, root=0)
+        np.testing.assert_array_equal(
+            recv, np.arange(displs[rank], displs[rank] + counts[rank]))
+
+        out = np.zeros(total) if rank == 0 else None
+        comm.Gatherv(recv, out, root=0)
+        if rank == 0:
+            np.testing.assert_array_equal(out, np.arange(total))
+        return True
+
+    assert all(run_ranks(3, wrap(fn)))
+
+
+def test_reduce_scatter_with_counts():
+    def fn(comm):
+        rank, size = comm.rank, comm.size
+        counts = [2] * size
+        send = np.arange(2 * size, dtype=np.float64)
+        recv = np.zeros(2)
+        comm.Reduce_scatter(send, recv, recvcounts=counts, op=MPI.SUM)
+        np.testing.assert_array_equal(
+            recv, size * np.arange(2 * rank, 2 * rank + 2, dtype=np.float64))
+        return True
+
+    assert all(run_ranks(3, wrap(fn)))
+
+
+def test_sendrecv_and_replace():
+    def fn(comm):
+        rank, size = comm.rank, comm.size
+        right, left = (rank + 1) % size, (rank - 1) % size
+        got = comm.sendrecv(f"from{rank}", dest=right, sendtag=1,
+                            source=left, recvtag=1)
+        assert got == f"from{left}"
+        buf = np.full(2, rank, np.int64)
+        comm.Sendrecv_replace(buf, dest=right, sendtag=2, source=left,
+                              recvtag=2)
+        np.testing.assert_array_equal(buf, [left, left])
+        return True
+
+    assert all(run_ranks(3, wrap(fn)))
+
+
+def test_probe_and_matched_probe():
+    def fn(comm):
+        if comm.rank == 0:
+            comm.send([1, 2], dest=1, tag=11)
+            comm.Send(np.arange(3, dtype=np.int32), dest=1, tag=12)
+            return True
+        st = MPI.Status()
+        assert comm.Probe(source=0, tag=11, status=st)
+        assert st.Get_tag() == 11
+        assert comm.recv(source=0, tag=11) == [1, 2]
+        msg = comm.Mprobe(source=0, tag=12, status=st)
+        assert st.Get_tag() == 12
+        buf = np.zeros(3, np.int32)
+        msg.Recv(buf)
+        np.testing.assert_array_equal(buf, np.arange(3))
+        return True
+
+    assert all(run_ranks(2, wrap(fn)))
+
+
+def test_persistent_requests():
+    def fn(comm):
+        rank = comm.rank
+        if rank == 0:
+            buf = np.zeros(4, np.float64)
+            req = comm.Send_init(buf, dest=1, tag=5)
+            for i in range(3):
+                buf[:] = i
+                req.Start()
+                req.Wait()
+            return True
+        buf = np.zeros(4, np.float64)
+        req = comm.Recv_init(buf, source=0, tag=5)
+        seen = []
+        for _ in range(3):
+            req.Start()
+            req.Wait()
+            seen.append(buf.copy())
+        return seen
+
+    res = run_ranks(2, wrap(fn))
+    for i, arr in enumerate(res[1]):
+        np.testing.assert_array_equal(arr, np.full(4, float(i)))
+
+
+def test_comm_management_and_groups():
+    def fn(comm):
+        rank, size = comm.rank, comm.size
+        dup = comm.Dup()
+        assert dup.Get_size() == size
+        dup.Free()
+
+        evens = comm.Split(color=rank % 2, key=rank)
+        assert evens.Get_size() == len(range(rank % 2, size, 2))
+        assert evens.Get_rank() == rank // 2
+        evens.Free()
+
+        g = comm.Get_group()
+        assert g.Get_size() == size
+        assert g.Get_rank() == rank
+        sub_g = g.Incl([0, 1])
+        sub = comm.Create_group(sub_g) if rank in (0, 1) else None
+        if rank in (0, 1):
+            assert sub is not None
+            assert sub.Get_size() == 2
+            total = sub.allreduce(1)
+            assert total == 2
+            sub.Free()
+        return True
+
+    assert all(run_ranks(4, wrap(fn)))
+
+
+def test_user_op_and_waitall():
+    def fn(comm):
+        rank, size = comm.rank, comm.size
+        op = MPI.Op.Create(lambda a, b: a + b, commute=True)
+        assert comm.allreduce([rank], op=op) == list(range(size))
+
+        if rank == 0:
+            reqs = [comm.isend(i * 100, dest=1, tag=20 + i)
+                    for i in range(3)]
+            MPI.Request.Waitall(reqs)
+            return True
+        reqs = [comm.irecv(source=0, tag=20 + i) for i in range(3)]
+        vals = MPI.Request.waitall(reqs)
+        assert vals == [0, 100, 200]
+        return True
+
+    assert all(run_ranks(2, wrap(fn)))
+
+
+def test_nonblocking_collectives():
+    def fn(comm):
+        rank, size = comm.rank, comm.size
+        req = comm.Ibarrier()
+        req.Wait()
+
+        buf = (np.arange(4, dtype=np.float64) if rank == 0
+               else np.zeros(4))
+        comm.Ibcast(buf, root=0).wait()
+        np.testing.assert_array_equal(buf, np.arange(4))
+
+        send = np.full(2, rank + 1.0)
+        recv = np.zeros(2)
+        comm.Iallreduce(send, recv, op=MPI.SUM).wait()
+        np.testing.assert_array_equal(
+            recv, np.full(2, sum(r + 1.0 for r in range(size))))
+        return True
+
+    assert all(run_ranks(3, wrap(fn)))
+
+
+def test_datatype_and_constants_surface():
+    assert MPI.DOUBLE.Get_size() == 8
+    assert MPI.INT32_T.np_dtype == np.int32
+    assert MPI.ANY_SOURCE < 0 and MPI.ANY_TAG < 0
+    assert MPI.SUM(2, 3) == 5
+    assert MPI.MAX(2, 3) == 3
+    assert MPI.LXOR(True, False) is True
+    assert MPI.Op.Create(lambda a, b: a * b)(3, 4) == 12
+    assert MPI.THREAD_MULTIPLE == 3
+
+
+def test_iprobe_negative():
+    def fn(comm):
+        if comm.rank == 1:
+            assert comm.Iprobe(source=0, tag=99) is False
+        comm.barrier()
+        return True
+
+    assert all(run_ranks(2, wrap(fn)))

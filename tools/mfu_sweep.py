@@ -30,13 +30,20 @@ from ompi_tpu.models.transformer import TransformerConfig
 from ompi_tpu.parallel.mesh import make_mesh
 from bench import _time_train_loop, _peak_flops
 
-kind = jax.devices()[0].platform
+kind = jax.devices()[0].device_kind  # "TPU v5 lite" (platform would
+# read "tpu"/"cpu" and never match the per-generation peak table)
 mesh = make_mesh({{"dp": 1, "sp": 1, "tp": 1}}, devices=jax.devices()[:1])
 base = dict(vocab=32_000, d_model=2048, n_heads=16, n_layers=8,
             d_ff=8192, seq=1024)
 batch = cfg.pop("batch")
 chain = cfg.pop("chain", 8)
 outer = cfg.pop("outer", 2)
+mca = cfg.pop("_mca", None)
+if mca:
+    import ompi_tpu.ops.flash_attention  # registers the ops_* vars
+    from ompi_tpu.core.config import var_registry
+    for k, v in mca.items():
+        var_registry.set(k, v)
 base.update(cfg)
 rng = np.random.default_rng(0)
 tokens = rng.integers(0, base["vocab"], size=(batch, base["seq"])).astype(np.int32)
@@ -57,29 +64,129 @@ print("RESULT " + json.dumps({{
 }}))
 """.format(repo=REPO)
 
+MATMUL_PEAK = r"""
+import json, time
+import numpy as np
+import jax, jax.numpy as jnp
+from jax import lax
+# Per-dispatch tunnel round-trip: time a trivial program end to end.
+# On the axon tunnel this measured ~780ms (!) — every number taken from
+# a python-side dispatch loop is dominated by it, so the matmul loop
+# below runs INSIDE one compiled program (fori_loop) and the train-loop
+# rows chain steps in-jit (bench.py's chain) for the same reason.
+tiny = jax.jit(lambda a: a + 1.0)
+z = jax.device_put(np.zeros((8, 128), np.float32))
+z = tiny(z); jax.block_until_ready(z)
+t0 = time.perf_counter(); z = tiny(z); _ = float(z[0, 0])
+dispatch_ms = (time.perf_counter() - t0) * 1e3
+# two-point method: time the SAME program shape at two in-jit iteration
+# counts; the slope cancels the (large, noisy) per-dispatch round trip
+n, lo, hi = 8192, 8, 72
+x = jax.device_put(np.random.default_rng(0).standard_normal(
+    (n, n)).astype(jnp.bfloat16))
+
+
+def make(iters):
+    f = jax.jit(lambda a: lax.fori_loop(
+        0, iters, lambda i, y: (y @ y) * jnp.bfloat16(1e-4), a))
+    y = f(x)
+    jax.block_until_ready(y)  # compile + warm
+
+    def timed():
+        t0 = time.perf_counter()
+        out = f(x)
+        _ = float(jnp.float32(out[0, 0]))
+        return time.perf_counter() - t0
+
+    return min(timed() for _ in range(3))
+
+
+t_lo, t_hi = make(lo), make(hi)
+dt = (t_hi - t_lo) / (hi - lo)
+tf = 2 * n ** 3 / dt / 1e12
+import sys as _s
+_s.path.insert(0, {repo!r})
+from bench import _peak_flops
+peak = _peak_flops(jax.devices()[0].device_kind)
+print("RESULT " + json.dumps({{
+    "n": n, "iters": [lo, hi], "ms": round(dt * 1e3, 3),
+    "dispatch_rt_ms": round(dispatch_ms, 1),
+    "wall_lo_s": round(t_lo, 3), "wall_hi_s": round(t_hi, 3),
+    "tflops": round(tf, 1),
+    "pct_of_peak": round(tf / peak * 100, 1) if peak else None,
+    "peak_tflops": round(peak / 1e12) if peak else None,
+    "backend": jax.devices()[0].platform}}))
+""".format(repo=REPO)
+
 GRID = [
-    # (label, config, per-config budget seconds)
+    # (label, config, per-config budget seconds).  "matmul_peak" is the
+    # calibration row: fraction of the 197TF bf16 peak a plain 8k matmul
+    # hits on this tunnel — the realistic ceiling for every MFU row.
+    ("matmul_peak", None, 600),
     ("b16-chunk128-dots", {"batch": 16, "ce_chunk": 128, "remat": "dots",
                            "attention": "flash"}, 1500),
     ("b16-chunk128-noremat", {"batch": 16, "ce_chunk": 128, "remat": None,
                               "attention": "flash"}, 1500),
+    # plain XLA dot-product attention instead of the pallas flash kernel
+    # (attention is ~7% of model FLOPs; a slow custom kernel could still
+    # dominate wall time)
+    ("b16-chunk128-xla", {"batch": 16, "ce_chunk": 128,
+                          "remat": "dots", "attention": "xla"}, 1500),
+    ("b16-noremat-xla", {"batch": 16, "ce_chunk": 128, "remat": None,
+                         "attention": "xla"}, 1500),
+    # CE chunk size: fewer scan trips, bigger unembed matmuls
+    ("b16-chunk256-dots", {"batch": 16, "ce_chunk": 256, "remat": "dots",
+                           "attention": "flash"}, 1500),
+    ("b16-chunk512-dots", {"batch": 16, "ce_chunk": 512, "remat": "dots",
+                           "attention": "flash"}, 1500),
     ("b32-chunk128-dots", {"batch": 32, "ce_chunk": 128, "remat": "dots",
-                           "attention": "flash", "chain": 4}, 1800),
+                           "attention": "flash", "chain": 4, "outer": 1},
+     1800),
     ("b32-chunk128-noremat", {"batch": 32, "ce_chunk": 128, "remat": None,
-                              "attention": "flash", "chain": 4}, 1800),
+                              "attention": "flash", "chain": 4, "outer": 1},
+     1800),
     ("b16-full-dots", {"batch": 16, "ce_chunk": 0, "remat": "dots",
                        "attention": "flash"}, 1500),  # r4 preflight repro
+    # pallas BACKWARD kernels too (opt-in flag; fwd-only kernel's bwd
+    # otherwise recomputes O(T²) scores through XLA)
+    ("b16-chunk128-dots-pbwd", {"batch": 16, "ce_chunk": 128,
+                                "remat": "dots", "attention": "flash",
+                                "_mca": {"ops_flash_bwd_kernel": 1}}, 1800),
+    # long chain amortizes the ~780ms tunnel dispatch round-trip (the
+    # matmul_peak row measures it) — the honest steady-state number
+    ("b16-chunk128-dots-chain32", {"batch": 16, "ce_chunk": 128,
+                                   "remat": "dots", "attention": "flash",
+                                   "chain": 32, "outer": 1}, 1800),
+    # combos on the measured winner (xla local attention beat the pallas
+    # kernel 909 vs 1014 ms/step at b16)
+    ("b16-xla-ce512", {"batch": 16, "ce_chunk": 512, "remat": "dots",
+                       "attention": "xla"}, 1500),
+    ("b16-xla-chain32", {"batch": 16, "ce_chunk": 128, "remat": "dots",
+                         "attention": "xla", "chain": 32, "outer": 1},
+     1800),
+    ("b32-xla", {"batch": 32, "ce_chunk": 128, "remat": "dots",
+                 "attention": "xla", "chain": 4, "outer": 1}, 1800),
+    ("b16-flash-ce256-chain32", {"batch": 16, "ce_chunk": 256,
+                                 "remat": "dots", "attention": "flash",
+                                 "chain": 32, "outer": 1}, 1800),
+    ("b16-xla-ce256-chain32", {"batch": 16, "ce_chunk": 256,
+                               "remat": "dots", "attention": "xla",
+                               "chain": 32, "outer": 1}, 1800),
 ]
 
-QUICK = [GRID[0], GRID[2]]
+_QUICK_LABELS = ["matmul_peak", "b16-chunk128-dots", "b32-chunk128-dots"]
+QUICK = [row for row in GRID if row[0] in _QUICK_LABELS]
 
 
-def run_one(label: str, cfg: dict, budget: float) -> dict:
+def run_one(label: str, cfg: dict | None, budget: float) -> dict:
     t0 = time.time()
     try:
+        if cfg is None:  # calibration row
+            argv = [sys.executable, "-c", MATMUL_PEAK]
+        else:
+            argv = [sys.executable, "-c", CHILD, json.dumps(cfg)]
         proc = subprocess.run(
-            [sys.executable, "-c", CHILD, json.dumps(cfg)],
-            capture_output=True, text=True, timeout=budget, cwd=REPO)
+            argv, capture_output=True, text=True, timeout=budget, cwd=REPO)
         for line in proc.stdout.splitlines():
             if line.startswith("RESULT "):
                 rec = json.loads(line[len("RESULT "):])
@@ -95,10 +202,20 @@ def run_one(label: str, cfg: dict, budget: float) -> dict:
 
 
 def main() -> None:
-    grid = QUICK if "--quick" in sys.argv else GRID
+    names = [a for a in sys.argv[1:] if not a.startswith("-")]
+    if "--quick" in sys.argv:
+        grid = QUICK
+    elif names:
+        by = {label: (label, cfg, budget) for label, cfg, budget in GRID}
+        unknown = [n for n in names if n not in by]
+        if unknown:
+            sys.exit(f"unknown row(s) {unknown}; known: {sorted(by)}")
+        grid = [by[n] for n in names]
+    else:
+        grid = GRID
     for label, cfg, budget in grid:
         print(f"[sweep] {label} (budget {budget}s) ...", flush=True)
-        rec = run_one(label, dict(cfg), budget)
+        rec = run_one(label, dict(cfg) if cfg else None, budget)
         rec["ts"] = time.strftime("%Y-%m-%dT%H:%MZ", time.gmtime())
         with open(OUT, "a") as f:
             f.write(json.dumps(rec) + "\n")
