@@ -212,7 +212,10 @@ class Status(_NativeStatus):
         return self.Get_count(datatype)
 
     def Is_cancelled(self) -> bool:
-        return bool(getattr(self, "cancelled", False))
+        # the native Status records cancellation as ``_cancelled``
+        # (absorbed via __dict__.update in _fill_status)
+        return bool(getattr(self, "cancelled",
+                            getattr(self, "_cancelled", False)))
 
     def _absorb(self, native: Optional[_NativeStatus]) -> None:
         if native is not None:
@@ -285,15 +288,27 @@ class Request:
         self._r = native
         self._transform = transform
 
+    def _finish(self, out):
+        """Apply the landing transform exactly once.  For the uppercase
+        buffer API the transform is what copies collective results into
+        the caller's receive buffer (Ibcast/Iallreduce), so EVERY
+        completion path — Wait/Test and the families, not just the
+        lowercase object API — must run it."""
+        if self._transform is not None:
+            t, self._transform = self._transform, None
+            return t(out)
+        return out
+
     # -- buffer convention -------------------------------------------------
     def Wait(self, status: Optional[Status] = None) -> bool:
-        self._r.wait()
+        self._finish(self._r.wait())
         _fill_status(status, getattr(self._r, "status", None))
         return True
 
     def Test(self, status: Optional[Status] = None) -> bool:
         done = self._r.test()
         if done:
+            self._finish(self._r.wait())  # complete: returns the payload
             _fill_status(status, getattr(self._r, "status", None))
         return bool(done)
 
@@ -307,7 +322,7 @@ class Request:
     def wait(self, status: Optional[Status] = None) -> Any:
         out = self._r.wait()
         _fill_status(status, getattr(self._r, "status", None))
-        return self._transform(out) if self._transform else out
+        return self._finish(out)
 
     def test(self, status: Optional[Status] = None):
         done = self._r.test()
@@ -315,43 +330,41 @@ class Request:
             return (False, None)
         _fill_status(status, getattr(self._r, "status", None))
         out = self._r.wait()  # already complete: returns the payload
-        return (True, self._transform(out) if self._transform else out)
+        return (True, self._finish(out))
 
     # -- families ----------------------------------------------------------
     @staticmethod
     def Waitall(requests: Sequence["Request"], statuses=None) -> bool:
-        outs = _req_mod.wait_all([r._r for r in requests])
-        if statuses is not None:
-            for i, req in enumerate(requests):
-                if i < len(statuses):
-                    _fill_status(statuses[i],
-                                 getattr(req._r, "status", None))
-        del outs
+        _req_mod.wait_all([r._r for r in requests])
+        for i, req in enumerate(requests):
+            req._finish(req._r.wait())  # complete: landing transforms run
+            if statuses is not None and i < len(statuses):
+                _fill_status(statuses[i], getattr(req._r, "status", None))
         return True
 
     @staticmethod
     def waitall(requests: Sequence["Request"]) -> list:
         _req_mod.wait_all([r._r for r in requests])
-        return [r._transform(r._r.wait()) if r._transform else r._r.wait()
-                for r in requests]
+        return [r._finish(r._r.wait()) for r in requests]
 
     @staticmethod
     def Waitany(requests: Sequence["Request"],
                 status: Optional[Status] = None) -> int:
         idx, _ = _req_mod.wait_any([r._r for r in requests])
         if idx is not None and idx >= 0:
-            _fill_status(status, getattr(requests[idx]._r, "status", None))
+            req = requests[idx]
+            req._finish(req._r.wait())
+            _fill_status(status, getattr(req._r, "status", None))
         return UNDEFINED if idx is None else idx
 
     @staticmethod
     def Testall(requests: Sequence["Request"], statuses=None) -> bool:
         if not all(r._r.test() for r in requests):
             return False
-        if statuses is not None:
-            for i, req in enumerate(requests):
-                if i < len(statuses):
-                    _fill_status(statuses[i],
-                                 getattr(req._r, "status", None))
+        for i, req in enumerate(requests):
+            req._finish(req._r.wait())
+            if statuses is not None and i < len(statuses):
+                _fill_status(statuses[i], getattr(req._r, "status", None))
         return True
 
 
@@ -664,8 +677,7 @@ class Comm:
     def Gatherv(self, sendbuf, recvbuf, root: int = 0) -> None:
         out = self._c.gatherv(_as_array(sendbuf), root)
         if self._c.rank == root and recvbuf is not None:
-            _copy_into(recvbuf, np.concatenate(
-                [np.asarray(p).reshape(-1) for p in out]))
+            _place_v(recvbuf, out)
 
     def Allgather(self, sendbuf, recvbuf) -> None:
         out = self._c.allgather(_as_array(sendbuf))
@@ -674,8 +686,7 @@ class Comm:
 
     def Allgatherv(self, sendbuf, recvbuf) -> None:
         out = self._c.allgatherv(_as_array(sendbuf))
-        _copy_into(recvbuf, np.concatenate(
-            [np.asarray(p).reshape(-1) for p in out]))
+        _place_v(recvbuf, out)
 
     def Scatter(self, sendbuf, recvbuf, root: int = 0) -> None:
         send = None
@@ -878,6 +889,27 @@ def _pyfold(op: Op, vals: list) -> Any:
     for v in vals[1:]:
         acc = fold(acc, v)
     return acc
+
+
+def _place_v(recv_spec, parts) -> None:
+    """Write gathered per-rank pieces into the receive buffer.  With a
+    [buf, counts, displs?, type?] spec each rank's piece lands at its
+    displacement (displs may reorder or leave gaps — MPI Gatherv
+    semantics); a bare buffer packs the pieces contiguously."""
+    parts = [np.asarray(p).reshape(-1) for p in parts]
+    has_layout = (isinstance(recv_spec, (list, tuple))
+                  and any(not isinstance(e, Datatype)
+                          for e in recv_spec[1:]))
+    if not has_layout:
+        _copy_into(recv_spec, np.concatenate(parts))
+        return
+    buf, counts, displs, _ = _vspec(recv_spec)
+    flat = buf.reshape(-1)
+    for p, c, d in zip(parts, counts, displs):
+        seg = p[:c]
+        if flat.dtype != seg.dtype:
+            seg = seg.astype(flat.dtype)
+        flat[d:d + seg.size] = seg
 
 
 def _vspec(spec):

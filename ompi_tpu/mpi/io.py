@@ -525,6 +525,7 @@ class File:
                     err = str(e)
             ok = self.comm.bcast(np.array([0 if err else 1], np.int8), root=0)
             if not int(np.asarray(ok)[0]):
+                self.comm.free()   # uniform raise — don't leak the dup
                 raise MPIException(
                     f"MPI_File_open({path}): "
                     f"{err or 'exclusive create failed on rank 0'}",
@@ -549,6 +550,7 @@ class File:
             if self._fd is not None and not err:
                 os.close(self._fd)
                 self._fd = None
+            self.comm.free()       # uniform raise — don't leak the dup
             raise MPIException(
                 f"MPI_File_open({path}): failed on {nfail} rank(s)"
                 + (f": {err}" if err else ""), error_class=ERR_IO)
@@ -567,6 +569,7 @@ class File:
         except MPIException:
             os.close(self._fd)   # the raise is uniform across ranks
             self._fd = None      # (collectively agreed) — don't leak fd
+            self.comm.free()     # ... or the comm dup'd above
             raise
         initial = int(self._pos if amode & MODE_APPEND else 0)
         if getattr(self._shfp, "local_log", False):

@@ -123,6 +123,7 @@ class RecvRequest(Request):
                 except ValueError:
                     return  # already matched — delivery wins
         self.cancelled = True
+        self.status.set_cancelled(True)  # MPI_Test_cancelled sees it
         self.complete(None)
 
 

@@ -156,6 +156,78 @@ def test_scatterv_gatherv_counts_displs():
     assert all(run_ranks(3, wrap(fn)))
 
 
+def test_uppercase_wait_lands_nonblocking_collectives():
+    """req.Wait() (capital — the mpi4py buffer API) must run the landing
+    copy into the receive buffer, exactly like lowercase .wait()."""
+    def fn(comm):
+        rank = comm.rank
+        buf = np.full(4, float(rank), np.float64)
+        req = comm.Ibcast(buf, root=0)
+        req.Wait()
+        np.testing.assert_array_equal(buf, np.zeros(4))
+
+        send = np.full(2, float(rank + 1), np.float64)
+        recv = np.zeros(2)
+        comm.Iallreduce(send, recv, op=MPI.SUM).Wait()
+        total = sum(r + 1 for r in range(comm.size))
+        np.testing.assert_array_equal(recv, np.full(2, float(total)))
+
+        # Waitall must land every transform too
+        recv2 = np.zeros(2)
+        buf2 = np.full(4, float(rank), np.float64)
+        MPI.Request.Waitall([comm.Iallreduce(send, recv2, op=MPI.SUM),
+                             comm.Ibcast(buf2, root=0)])
+        np.testing.assert_array_equal(recv2, np.full(2, float(total)))
+        np.testing.assert_array_equal(buf2, np.zeros(4))
+        return True
+
+    assert all(run_ranks(3, wrap(fn)))
+
+
+def test_gatherv_respects_displs():
+    """The recv spec's counts/displs place each rank's piece — including
+    gaps (poison must survive in the unwritten bytes)."""
+    def fn(comm):
+        rank, size = comm.rank, comm.size
+        send = np.full(2, float(rank), np.float64)
+        counts = [2] * size
+        displs = [4 * r + 1 for r in range(size)]  # stride 4: gaps of 2
+        out = np.full(4 * size, -1.0) if rank == 0 else None
+        spec = [out, counts, displs, MPI.DOUBLE] if rank == 0 else None
+        comm.Gatherv(send, spec, root=0)
+        if rank == 0:
+            want = np.full(4 * size, -1.0)
+            for r in range(size):
+                want[displs[r]:displs[r] + 2] = float(r)
+            np.testing.assert_array_equal(out, want)
+
+        # Allgatherv with the same layout on every rank
+        all_out = np.full(4 * size, -1.0)
+        comm.Allgatherv(send, [all_out, counts, displs, MPI.DOUBLE])
+        want = np.full(4 * size, -1.0)
+        for r in range(size):
+            want[displs[r]:displs[r] + 2] = float(r)
+        np.testing.assert_array_equal(all_out, want)
+        return True
+
+    assert all(run_ranks(3, wrap(fn)))
+
+
+def test_status_is_cancelled():
+    def fn(comm):
+        if comm.rank == 0:
+            out = np.zeros(4)
+            req = comm.Irecv(out, source=1, tag=99)
+            req.Cancel()
+            st = MPI.Status()
+            req.Wait(st)
+            assert st.Is_cancelled()
+        comm.Barrier()
+        return True
+
+    assert all(run_ranks(2, wrap(fn)))
+
+
 def test_reduce_scatter_with_counts():
     def fn(comm):
         rank, size = comm.rank, comm.size

@@ -100,6 +100,33 @@ def test_train_step_decreases_loss():
     assert losses[-1] < losses[0], losses
 
 
+def test_bf16_adam_moments_track_f32():
+    """adam_mu_dtype="bfloat16" must store the first moment in bf16 and
+    train indistinguishably at tiny scale (the HBM lever for batch 32 on
+    the flagship — see TransformerConfig.adam_mu_dtype)."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    mesh = _mesh222()
+    toks = _tokens(CFG)
+    losses = {}
+    for mu in (None, "bfloat16"):
+        cfg = dataclasses.replace(CFG, adam_mu_dtype=mu)
+        params = tfm.init_params(cfg)
+        step, init_opt = tfm.make_train_step(cfg, mesh, lr=1e-2)
+        opt_state = init_opt(params)
+        for _ in range(4):
+            params, opt_state, loss = step(params, opt_state, toks)
+        losses[mu] = float(loss)
+        mu_leaf = opt_state[0].mu["w1"]
+        want = jnp.bfloat16 if mu == "bfloat16" else jnp.float32
+        assert mu_leaf.dtype == want, (mu, mu_leaf.dtype)
+    assert np.isfinite(losses["bfloat16"])
+    # same trajectory to a loose tolerance (bf16 m rounds each update)
+    assert abs(losses[None] - losses["bfloat16"]) < 0.05 * abs(losses[None])
+
+
 def test_tp_sharding_is_real():
     """The compiled train step must actually shard tp weights (not silently
     replicate): check the output sharding of the updated params."""

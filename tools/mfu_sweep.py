@@ -172,6 +172,34 @@ GRID = [
     ("b16-xla-ce256-chain32", {"batch": 16, "ce_chunk": 256,
                                "remat": "dots", "attention": "xla",
                                "chain": 32, "outer": 1}, 1800),
+    # ---- round-4 continuation: push past 34.6% toward the 40% bar ----
+    # bigger batch between the 16 winner and the 32 OOM
+    ("b24-xla-ce256-chain24", {"batch": 24, "ce_chunk": 256,
+                               "remat": "dots", "attention": "xla",
+                               "chain": 24, "outer": 1}, 1800),
+    # b32 fits if every layer activation is rematerialized (full remat
+    # costs ~33% more FLOPs on paper but bigger matmuls may win it back)
+    ("b32-xla-full-chain16", {"batch": 32, "ce_chunk": 256,
+                              "remat": "full", "attention": "xla",
+                              "chain": 16, "outer": 1}, 1800),
+    ("b32-flash-full-chain16", {"batch": 32, "ce_chunk": 256,
+                                "remat": "full", "attention": "flash",
+                                "chain": 16, "outer": 1}, 1800),
+    # longer chain: dispatch RT (~1.5s) over 32 steps is still ~6% of
+    # wall at 723ms/step; 64 halves it
+    ("b16-xla-ce256-chain64", {"batch": 16, "ce_chunk": 256,
+                               "remat": "dots", "attention": "xla",
+                               "chain": 64, "outer": 1}, 2400),
+    # bf16 first moment frees ~0.9 GiB — the cheap path to batch 32
+    # with the fast "dots" remat (full remat pays ~33% extra FLOPs)
+    ("b32-xla-mubf16-chain16", {"batch": 32, "ce_chunk": 256,
+                                "remat": "dots", "attention": "xla",
+                                "adam_mu_dtype": "bfloat16",
+                                "chain": 16, "outer": 1}, 1800),
+    ("b24-xla-mubf16-chain24", {"batch": 24, "ce_chunk": 256,
+                                "remat": "dots", "attention": "xla",
+                                "adam_mu_dtype": "bfloat16",
+                                "chain": 24, "outer": 1}, 1800),
 ]
 
 _QUICK_LABELS = ["matmul_peak", "b16-chunk128-dots", "b32-chunk128-dots"]
