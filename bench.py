@@ -176,13 +176,8 @@ def bench_allreduce_busbw(devices) -> dict:
     # python-side dispatch loop times the ~1.5s round trip, not ICI
     scale = np.float32(1.0 / n)
 
-    def make(iters):
-        body = jax.shard_map(
-            lambda s: comm.allreduce(s) * scale, mesh=mesh,
-            in_specs=P("world"), out_specs=P("world"), check_vma=False)
-        return jax.jit(lambda a: jax.lax.fori_loop(
-            0, iters, lambda i, y: body(y), a))
-
+    make = _loop_maker(lambda s: comm.allreduce(s) * scale, mesh,
+                       P("world"), P("world"))
     shard_bytes = x.nbytes / n
     row = {
         "metric": f"MPI_Allreduce busbw over ICI ({n} chips, fp32)",
@@ -263,6 +258,21 @@ def _slope_time(make_fn, x, lo: int, hi: int, reps: int = 2):
 _SLOPE_COLLAPSED = ("two-point slope collapsed under timing noise; per-iter "
                     "cost is an upper bound (one dispatch / trip count, "
                     "dispatch overhead included)")
+
+
+def _loop_maker(kernel, mesh, in_specs, out_specs):
+    """make(iters) factory for the slope rows: ONE compiled program
+    running ``iters`` trips of the shard_map'd kernel (carry must keep
+    the input's shape/sharding)."""
+    import jax
+
+    def make(iters):
+        body = jax.shard_map(kernel, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+        return jax.jit(lambda a: jax.lax.fori_loop(
+            0, iters, lambda i, y: body(y), a))
+
+    return make
 
 
 def _slope_or_bound(make_fn, x, lo: int, hi: int):
@@ -445,20 +455,15 @@ def matrix_allreduce_sweep(devices) -> dict:
     comm = device_world(mesh)
     dev_rows = {}
     scale = np.float32(1.0 / n)
-    for label, elems in (("4KiB", 1024), ("1MiB", 1 << 18),
-                         ("64MiB", 1 << 24)):
-        x = _device_put(np.ones((n * elems,), np.float32), mesh, P("world"))
-
-        def make(iters):
-            body = jax.shard_map(
-                lambda s: comm.allreduce(s) * scale, mesh=mesh,
-                in_specs=P("world"), out_specs=P("world"), check_vma=False)
-            return jax.jit(lambda a: jax.lax.fori_loop(
-                0, iters, lambda i, y: body(y), a))
-
-        if n == 1:
+    sizes = (("4KiB", 1024), ("1MiB", 1 << 18), ("64MiB", 1 << 24))
+    if n == 1:
+        for label, _elems in sizes:
             dev_rows[label] = {"us": None, "note": _ONE_CHIP_NOTE}
-            continue
+        sizes = ()
+    for label, elems in sizes:
+        x = _device_put(np.ones((n * elems,), np.float32), mesh, P("world"))
+        make = _loop_maker(lambda s: comm.allreduce(s) * scale, mesh,
+                           P("world"), P("world"))
         lo, hi = _loop_iters(devices)
         if elems <= (1 << 18):  # small payloads: longer loops, less noise
             lo, hi = lo * 4, hi * 4
@@ -537,13 +542,7 @@ def matrix_mesh_bcast_allgather(devices) -> dict:
             return jax.lax.dynamic_slice_in_dim(
                 full, comm.rank() * shard_elems, shard_elems)
 
-        def make(iters):
-            body = jax.shard_map(
-                kernel, mesh=mesh, in_specs=P(("x", "y")),
-                out_specs=P(("x", "y")), check_vma=False)
-            return jax.jit(lambda a: jax.lax.fori_loop(
-                0, iters, lambda i, y: body(y), a))
-
+        make = _loop_maker(kernel, mesh, P(("x", "y")), P(("x", "y")))
         dt, extra = _slope_or_bound(make, x, *_loop_iters(devices))
         total_dt += dt
         nbytes += x.nbytes
@@ -590,12 +589,7 @@ def matrix_grad_reduce_scatter(devices) -> dict:
         scattered = jax.lax.psum_scatter(s, "world", tiled=True) * scale
         return jax.lax.all_gather(scattered, "world", tiled=True)
 
-    def make(iters):
-        body = jax.shard_map(kernel, mesh=mesh, in_specs=P("world"),
-                             out_specs=P("world"), check_vma=False)
-        return jax.jit(lambda a: jax.lax.fori_loop(
-            0, iters, lambda i, y: body(y), a))
-
+    make = _loop_maker(kernel, mesh, P("world"), P("world"))
     row = {
         "metric": f"grad reduce_scatter+allgather ({params/1e9:.2f}B fp32 "
                   f"params, {n} dev)",
@@ -632,12 +626,7 @@ def matrix_oshmem_device(devices) -> dict:
         m = comm.allreduce(s, MAX)       # shmem_float_max_to_all
         return comm.shift(m, 1, axis="world")  # circular shift, 1 ICI hop
 
-    def make(iters):
-        body = jax.shard_map(kernel, mesh=mesh, in_specs=P("world"),
-                             out_specs=P("world"), check_vma=False)
-        return jax.jit(lambda a: jax.lax.fori_loop(
-            0, iters, lambda i, y: body(y), a))
-
+    make = _loop_maker(kernel, mesh, P("world"), P("world"))
     row = {
         "metric": f"oshmem max_to_all + circular shift ({n} dev, "
                   f"{nbytes/n/2**20:.0f}MiB/dev)",
@@ -829,7 +818,7 @@ def matrix_remote_dma(devices) -> dict:
                    f"{f'{nbytes >> 20}MiB' if nbytes >= 1 << 20 else f'{nbytes >> 10}KiB'} "
                    f"{'chip0→chip1 (ICI RDMA)' if n >= 2 else 'self (1 chip)'}"),
         "value": round(nbytes / dt / 2**30, 3), "unit": "GiB/s",
-        "vs_baseline": 1.0, "correct": ok, "n_devices": n,
+        "vs_baseline": 1.0, "correct": ok, "n_devices": n, **rdma_extra,
     }
 
 

@@ -293,9 +293,12 @@ def _local_loss(cfg: TransformerConfig, comm, params, tokens):
 
     # labels: tokens shifted left by one *global* position
     first_col = tokens[:, :1]
-    # neighbor's first token: device r receives from r+1 (shift -1)
-    perm = [((i + 1) % sp, i) for i in range(sp)]
-    from_right = lax.ppermute(first_col, "sp", perm)
+    if sp == 1:
+        from_right = first_col  # self-permute: skip the channel op
+    else:
+        # neighbor's first token: device r receives from r+1 (shift -1)
+        perm = [((i + 1) % sp, i) for i in range(sp)]
+        from_right = lax.ppermute(first_col, "sp", perm)
     labels = jnp.concatenate([tokens[:, 1:], from_right], axis=1)
     # the final global position has no next token
     positions = sp_idx * T + jnp.arange(T)
@@ -314,13 +317,18 @@ def _local_loss(cfg: TransformerConfig, comm, params, tokens):
             logprobs, labels[..., None], axis=-1)[..., 0]
         local_sum = (nll * weight).sum()
     local_cnt = weight.sum() * tokens.shape[0]
-    total = lax.psum(local_sum, ("dp", "sp"))
-    count = lax.psum(local_cnt, ("dp", "sp"))
+    dp = int(comm.mesh.shape["dp"])
+    if dp * sp == 1:  # degenerate data/seq axes: psum is identity
+        total, count = local_sum, local_cnt
+    else:
+        total = lax.psum(local_sum, ("dp", "sp"))
+        count = lax.psum(local_cnt, ("dp", "sp"))
     loss = total / count
     if cfg.moe_experts:
         # average the per-device balance loss over the whole mesh (tp/ep
         # ranks see replicated tokens, so the mean is layout-invariant)
-        aux_mean = lax.psum(aux, comm.axes) / comm.size
+        aux_mean = (aux if comm.size == 1
+                    else lax.psum(aux, comm.axes)) / comm.size
         loss = loss + cfg.moe_aux_weight * aux_mean
     return loss
 

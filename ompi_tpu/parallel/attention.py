@@ -163,6 +163,12 @@ def ulysses_attention(comm, q, k, v, axis: Optional[str] = None,
     H = q.shape[2]
     if H % sp:
         raise ValueError(f"ulysses needs heads ({H}) divisible by sp ({sp})")
+    if sp == 1:
+        # degenerate axis: a single-participant all_to_all still lowers
+        # to a channel op (copy + scheduling barrier, 4 per layer) —
+        # skip the resharding entirely
+        return local_attention(q, k, v, causal=causal, scale=scale,
+                               impl=impl)
     # (B, T/sp, H, D) → (B, T, H/sp, D)
     q2, k2, v2 = (lax.all_to_all(t, ax, split_axis=2, concat_axis=1,
                                  tiled=True) for t in (q, k, v))
@@ -179,6 +185,8 @@ def gathered_attention(comm, q, k, v, axis: Optional[str] = None,
     from jax import lax
 
     ax = axis or comm.axes[-1]
+    if int(comm.mesh.shape[ax]) == 1:
+        return local_attention(q, k, v, causal=causal, scale=scale)
     my = lax.axis_index(ax)
     T = q.shape[1]
     k_all = lax.all_gather(k, ax, axis=1, tiled=True)

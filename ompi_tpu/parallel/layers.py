@@ -28,4 +28,7 @@ def row_parallel(x_shard, w_shard, comm, axis: Optional[str] = None):
     from jax import lax
 
     partial = jnp.einsum("...f,fd->...d", x_shard, w_shard)
-    return lax.psum(partial, axis or comm.axes[-1])
+    ax = axis or comm.axes[-1]
+    if int(comm.mesh.shape[ax]) == 1:
+        return partial  # degenerate tp: psum is identity, skip the channel op
+    return lax.psum(partial, ax)
