@@ -70,8 +70,14 @@ register_var("io", "twophase_min_bytes", VarType.SIZE, 1,
              "minimum total bytes before two-phase aggregation kicks in")
 register_var("io", "fcoll", VarType.STRING, "",
              "force a collective-IO component: individual | two_phase | "
-             "dynamic (empty = auto-decide from the access pattern, like "
-             "the reference's fcoll query/priority selection)")
+             "dynamic | static | dynamic_gen2 (empty = auto-decide from "
+             "the access pattern, like the reference's fcoll "
+             "query/priority selection)")
+register_var("io", "stripe_bytes", VarType.SIZE, 1 << 20,
+             "file stripe width for the static (cyclic stripe->aggregator "
+             "round-robin) and dynamic_gen2 (stripe-aligned payload "
+             "domains) fcoll components; match the filesystem stripe for "
+             "lock-contention-free aggregator writes")
 register_var("io", "cb_aggregators_per_host", VarType.INT, 1,
              "collective-buffering aggregators per host (aggregators are "
              "the lowest ranks of each host in the job mapping, like "
@@ -1085,6 +1091,15 @@ class File:
     # allgathered access pattern (every rank computes the same answer from
     # the same collective data).
 
+    @staticmethod
+    def _stripe_bytes() -> int:
+        """Configured stripe width with the registered default as the
+        single fallback (shared by static routing, dynamic_gen2 bound
+        snapping and the aggregator read coalescer)."""
+        from ompi_tpu.core.config import var_registry
+
+        return int(var_registry.get("io_stripe_bytes")) or (1 << 20)
+
     def _my_host_key(self) -> int:
         """Stable host identity for aggregator grouping — THE single
         source (Communicator._my_host_key: shm BTL / split_type / IO all
@@ -1123,12 +1138,14 @@ class File:
         return aggs
 
     def _fcoll_component(self, my_nbytes: int, my_runs) -> str:
-        """Pick individual | two_phase | dynamic — identically on every
-        rank (decision inputs are allgathered).  Precedence: info hint
-        (collective_buffering/romio_cb_write=disable → individual) >
-        io_fcoll var > auto (≈ OMPIO's fcoll query: small or contiguous
-        per-rank patterns go individual; strided balanced loads use
-        static domains; skewed loads use payload-weighted domains)."""
+        """Pick individual | two_phase | dynamic | static | dynamic_gen2
+        — identically on every rank (decision inputs are allgathered).
+        Precedence: info hint (collective_buffering/romio_cb_write=
+        disable → individual) > io_fcoll var > auto (≈ OMPIO's fcoll
+        query: small or contiguous per-rank patterns go individual;
+        on network filesystems stripe-aligned domains win — static for
+        balanced loads, dynamic_gen2 for skewed; otherwise two_phase
+        for balanced, dynamic for skewed)."""
         from ompi_tpu.core.config import var_registry
 
         hint = ""
@@ -1142,10 +1159,12 @@ class File:
             forced = self.info.get("fcoll") or ""   # per-file pin
         forced = forced or var_registry.get("io_fcoll") or ""
         if forced:
-            if forced not in ("individual", "two_phase", "dynamic"):
+            if forced not in ("individual", "two_phase", "dynamic",
+                              "static", "dynamic_gen2"):
                 raise MPIException(
-                    f"unknown fcoll component {forced!r} "
-                    f"(individual/two_phase/dynamic)", error_class=3)
+                    f"unknown fcoll component {forced!r} (individual/"
+                    f"two_phase/dynamic/static/dynamic_gen2)",
+                    error_class=3)
             return forced
         if not var_registry.get("io_twophase"):
             return "individual"
@@ -1172,7 +1191,13 @@ class File:
         if int(stats[:, 1].min()) == 1:
             return "individual"   # everyone contiguous: direct IO wins
         nz = stats[:, 0][stats[:, 0] > 0]
-        if len(nz) and int(nz.max()) > 4 * int(nz.min()):
+        skewed = len(nz) and int(nz.max()) > 4 * int(nz.min())
+        if adaptive and self.fs_type in _FS_NETWORK:
+            # stripe-aligned domains keep each aggregator inside its own
+            # filesystem stripes (the fcoll/static and dynamic_gen2
+            # rationale: no two aggregators contend for one stripe lock)
+            return "dynamic_gen2" if skewed else "static"
+        if skewed:
             return "dynamic"      # skewed payloads → balance by bytes
         return "two_phase"
 
@@ -1183,7 +1208,11 @@ class File:
         equal spans (fcoll/two_phase's static assignment); dynamic =
         equal *payload* per aggregator, boundaries derived from the
         allgathered run lists (fcoll/dynamic's data-driven domains).
-        None ⇒ empty global extent."""
+        ``static`` routes cyclically by stripe (bounds only signal a
+        non-empty extent); ``dynamic_gen2`` = dynamic's payload balance
+        with every interior boundary snapped DOWN to a stripe multiple,
+        so no two aggregator domains share a filesystem stripe (the
+        fcoll/dynamic_gen2 refinement).  None ⇒ empty global extent."""
         comm = self.comm
         lo = my_runs[0][0] if my_runs else np.iinfo(np.int64).max
         hi = my_runs[-1][0] + my_runs[-1][1] if my_runs else 0
@@ -1191,7 +1220,7 @@ class File:
         glo, ghi = int(ext[:, 0].min()), int(ext[:, 1].max())
         if ghi <= glo:
             return None
-        if mode != "dynamic":
+        if mode not in ("dynamic", "dynamic_gen2"):
             dom = -(-(ghi - glo) // naggs)
             return [glo + i * dom for i in range(naggs)] + [ghi]
         # dynamic: payload-weighted boundaries need every rank's run
@@ -1227,12 +1256,22 @@ class File:
         bounds.append(ghi)
         for i in range(1, len(bounds)):   # keep monotone under overlap
             bounds[i] = max(bounds[i], bounds[i - 1])
+        if mode == "dynamic_gen2":
+            stripe = self._stripe_bytes()
+            for i in range(1, naggs):  # interior boundaries only
+                bounds[i] = max(bounds[i] // stripe * stripe, bounds[0])
+            for i in range(1, len(bounds)):
+                bounds[i] = max(bounds[i], bounds[i - 1])
         return bounds
 
     def _route_to_aggregators(self, my_runs, bounds, aggs,
-                              raw: Optional[bytes]):
+                              raw: Optional[bytes],
+                              mode: str = "two_phase"):
         """Split my runs at domain boundaries and bucket (meta, payload)
         per destination rank.  raw=None ⇒ request-only (read path).
+        ``static`` ignores the bounds partition and routes stripes
+        round-robin: stripe k → aggregator k % naggs (fcoll/static's
+        cyclic file domains).
 
         Also returns the ordered split sequence [(dest, take), …] — the
         read path's reassembly MUST walk the identical splits the
@@ -1241,15 +1280,21 @@ class File:
 
         size = self.comm.size
         naggs = len(aggs)
+        stripe = self._stripe_bytes() if mode == "static" else 0
         meta = [[] for _ in range(size)]
         payload = [[] for _ in range(size)] if raw is not None else None
         order: list[tuple[int, int]] = []
         pos = 0
         for off, ln in my_runs:
             while ln > 0:
-                i = min(max(bisect.bisect_right(bounds, off) - 1, 0),
-                        naggs - 1)
-                dom_end = bounds[i + 1] if i + 1 < len(bounds) else off + ln
+                if mode == "static":
+                    i = (off // stripe) % naggs
+                    dom_end = (off // stripe + 1) * stripe
+                else:
+                    i = min(max(bisect.bisect_right(bounds, off) - 1, 0),
+                            naggs - 1)
+                    dom_end = (bounds[i + 1] if i + 1 < len(bounds)
+                               else off + ln)
                 take = min(ln, max(dom_end - off, 1))
                 dest = aggs[i]
                 meta[dest].append((off, take))
@@ -1281,7 +1326,7 @@ class File:
             comm.barrier()
             return 0
         meta, payload, _order = self._route_to_aggregators(
-            my_runs, bounds, aggs, raw)
+            my_runs, bounds, aggs, raw, mode=comp)
         meta_arrs = [np.array(m, np.int64).reshape(-1, 2).ravel()
                      for m in meta]
         pay_arrs = [np.frombuffer(b"".join(p), np.uint8) for p in payload]
@@ -1320,22 +1365,42 @@ class File:
             comm.barrier()
             return self._from_bytes(b"")
         meta, _pay, order = self._route_to_aggregators(
-            my_runs, bounds, aggs, None)
+            my_runs, bounds, aggs, None, mode=comp)
         meta_arrs = [np.array(m, np.int64).reshape(-1, 2).ravel()
                      for m in meta]
         got_meta = comm.alltoallv(meta_arrs)
         # aggregators read each requested run once (coalesced pread over
         # their domain slice) and reply per requester; a pread can come
         # up short at EOF, so a reply may be shorter than requested
+        import bisect as _bisect
+
+        # bounds-partitioned modes keep the single span pread per
+        # requester (runs inside one contiguous domain — one syscall
+        # beats many tiny ones); static's cyclic domains cap the merge
+        # gap at one stripe so an aggregator doesn't read the whole
+        # extent to serve every naggs-th stripe of it
+        merge_gap = self._stripe_bytes() if comp == "static" else None
         replies = []
         for r in range(size):
             m = np.asarray(got_meta[r]).reshape(-1, 2)
             if len(m):
-                span_lo = int(m[:, 0].min())
-                span_hi = int((m[:, 0] + m[:, 1]).max())
-                blob = os.pread(self._fd, span_hi - span_lo, span_lo)
-                parts = [blob[int(o) - span_lo:int(o) - span_lo + int(l)]
-                         for o, l in m]
+                blocks: list[tuple[int, int]] = []
+                for o, ln in sorted((int(o), int(ln)) for o, ln in m):
+                    if blocks and (merge_gap is None
+                                   or o <= blocks[-1][1] + merge_gap):
+                        blocks[-1] = (blocks[-1][0],
+                                      max(blocks[-1][1], o + ln))
+                    else:
+                        blocks.append((o, o + ln))
+                data = {blo: os.pread(self._fd, bhi - blo, blo)
+                        for blo, bhi in blocks}
+                starts = [b[0] for b in blocks]
+                parts = []
+                for o, ln in m:
+                    blo = blocks[_bisect.bisect_right(starts,
+                                                      int(o)) - 1][0]
+                    blob = data[blo]   # may be EOF-short: slice shortens
+                    parts.append(blob[int(o) - blo:int(o) - blo + int(ln)])
                 replies.append(np.frombuffer(b"".join(parts), np.uint8))
             else:
                 replies.append(np.empty(0, np.uint8))

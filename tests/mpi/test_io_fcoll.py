@@ -50,7 +50,8 @@ def _check(mat, size):
         np.testing.assert_array_equal(mat[:, c], np.full(16, c, np.float32))
 
 
-@pytest.mark.parametrize("comp", ["two_phase", "dynamic", "individual"])
+@pytest.mark.parametrize("comp", ["two_phase", "dynamic", "individual",
+                                  "static", "dynamic_gen2"])
 def test_forced_components_correct(tmp_path, fcoll_var, comp):
     path = str(tmp_path / f"m_{comp}.bin")
     fcoll_var(comp)
@@ -212,13 +213,13 @@ def test_large_strided_roundtrip_all_components(tmp_path, fcoll_var):
     """Write with one component, read back with another — the file is
     component-independent."""
     path = str(tmp_path / "mix.bin")
-    fcoll_var("dynamic")
+    fcoll_var("dynamic_gen2")
 
     def wr(comm):
         return _strided_write(comm, path)
 
     run_ranks(4, wr)
-    fcoll_var("two_phase")
+    fcoll_var("static")
 
     def rd(comm):
         size = comm.size
@@ -302,3 +303,55 @@ def test_sharedfp_auto_lockedfile_cross_host(tmp_path):
         return name
 
     assert run_ranks(2, body) == ["lockedfile", "lockedfile"]
+
+
+def test_static_routes_stripes_round_robin(tmp_path):
+    """fcoll/static's contract: stripe k goes to aggregator k % naggs
+    (cyclic file domains), independent of the bounds partition."""
+    path = str(tmp_path / "static.bin")
+    old = config.var_registry.get("io_stripe_bytes")
+    config.var_registry.set("io_stripe_bytes", 64)
+    try:
+        def body(comm):
+            comm._io_host_override = f"h{comm.rank}"  # every rank an aggregator
+            f = mio.File.open(comm, path, mio.MODE_RDWR | mio.MODE_CREATE)
+            my_runs = [(comm.rank * 256, 256)]  # 4 stripes each
+            aggs = f._aggregators()
+            meta, _pay, order = f._route_to_aggregators(
+                my_runs, [0, 1024], aggs, None, mode="static")
+            f.close()
+            # each of my 4 stripes lands on stripe_idx % naggs
+            for dest, take in order:
+                assert take == 64
+            for agg_rank, m in enumerate(meta):
+                for off, ln in m:
+                    assert (off // 64) % comm.size == agg_rank
+            return True
+
+        assert all(run_ranks(4, body))
+    finally:
+        config.var_registry.set("io_stripe_bytes", old)
+
+
+def test_dynamic_gen2_bounds_stripe_aligned(tmp_path):
+    """dynamic_gen2 = dynamic's payload balance with interior domain
+    boundaries snapped to stripe multiples."""
+    path = str(tmp_path / "gen2.bin")
+    old = config.var_registry.get("io_stripe_bytes")
+    config.var_registry.set("io_stripe_bytes", 128)
+    try:
+        def body(comm):
+            comm._io_host_override = f"h{comm.rank}"
+            f = mio.File.open(comm, path, mio.MODE_RDWR | mio.MODE_CREATE)
+            # skewed payloads: rank r writes (r+1)*100 bytes
+            my_runs = [(comm.rank * 1000, (comm.rank + 1) * 100)]
+            bounds = f._domain_bounds("dynamic_gen2", my_runs, comm.size)
+            f.close()
+            for b in bounds[1:-1]:
+                assert b % 128 == 0 or b == bounds[0], bounds
+            assert bounds == sorted(bounds)
+            return True
+
+        assert all(run_ranks(4, body))
+    finally:
+        config.var_registry.set("io_stripe_bytes", old)
