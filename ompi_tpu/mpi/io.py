@@ -1387,7 +1387,7 @@ class File:
                 blocks: list[tuple[int, int]] = []
                 for o, ln in sorted((int(o), int(ln)) for o, ln in m):
                     if blocks and (merge_gap is None
-                                   or o <= blocks[-1][1] + merge_gap):
+                                   or o < blocks[-1][1] + merge_gap):
                         blocks[-1] = (blocks[-1][0],
                                       max(blocks[-1][1], o + ln))
                     else:

@@ -20,6 +20,13 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# children inherit the shared persistent XLA compile cache (the tunnel's
+# remote compile helper stalls; a disk hit skips it entirely); same
+# resolution order as bench.py: explicit env > OMPI_TPU_JAX_CACHE > repo
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.environ.get("OMPI_TPU_JAX_CACHE",
+                   os.path.join(REPO, ".jax_cache")))
 OUT = os.path.join(REPO, "MFU_SWEEP.jsonl")
 
 CHILD = r"""
