@@ -66,12 +66,11 @@ def _enable_compile_cache() -> None:
     # (parent and children MUST share one cache or the stall-avoidance
     # this exists for does nothing)
     cache = os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _CACHE_DIR)
-    os.makedirs(cache, exist_ok=True)
     try:
+        os.makedirs(cache, exist_ok=True)
         import jax
 
         jax.config.update("jax_compilation_cache_dir", cache)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
     except Exception as e:  # noqa: BLE001 — cache is best-effort
         log(f"compile cache unavailable: {e}")
 

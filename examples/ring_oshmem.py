@@ -1,6 +1,8 @@
 """Ring message pass over the symmetric heap with wait_until
 (≈ examples/ring_oshmem_c.c): a counter circulates the PE ring; PE 0
-decrements it each lap; every PE quits after passing on the 0.
+decrements it each lap; each PE exits after its final put (PE 0's
+closing 0-put lands in an already-exited neighbor's slot, completed by
+finalize's collective teardown — the reference behaves the same way).
 
 Run:  tpurun -np 4 -- python examples/ring_oshmem.py
 """

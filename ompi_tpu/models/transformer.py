@@ -65,6 +65,17 @@ class TransformerConfig:
     # smoothed gradient and tolerates it — standard mixed-precision
     # Adam practice).  None = f32 moments.
     adam_mu_dtype: Any = None
+    # Parameter STORAGE dtype (distinct from compute_dtype, which is the
+    # matmul dtype).  "bfloat16": live params and their gradients are
+    # bf16; the optimizer keeps a float32 master copy and applies
+    # updates there, so small lr·update increments are not lost to
+    # bf16's 8-bit mantissa — the standard mixed-precision
+    # master-weights scheme.  Note this is HBM-NEUTRAL on one chip (the
+    # resident f32 master cancels what bf16 params+grads save); its
+    # value is halved param-read bandwidth per step and, under dp
+    # sharding, a master/optimizer tree that can shard ZeRO-style while
+    # live params stay replicated.  None/float32 = f32, no master.
+    param_dtype: Any = None
 
     @property
     def head_dim(self) -> int:
@@ -97,6 +108,13 @@ def init_params(cfg: TransformerConfig, seed: int = 0) -> dict:
     else:
         params["w1"] = w(L, D, F)
         params["w2"] = w(L, F, D, scale=(F ** -0.5) / max(1, 2 * L) ** 0.5)
+    if cfg.param_dtype not in (None, "float32"):
+        # live params are stored in param_dtype; the optimizer's f32
+        # master copy is created from them at init (one-time rounding)
+        import jax.numpy as jnp
+
+        sd = jnp.dtype(cfg.param_dtype)
+        params = {k: np.asarray(v).astype(sd) for k, v in params.items()}
     return params
 
 
@@ -378,17 +396,46 @@ def _make_step_body(cfg: TransformerConfig, mesh, lr: float):
     import jax
     import optax
 
+    import jax.numpy as jnp
+
     loss_fn = make_loss_fn(cfg, mesh)
     opt = optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=0.01,
                       mu_dtype=cfg.adam_mu_dtype)
+    store = (None if cfg.param_dtype in (None, "float32", jnp.float32)
+             else jnp.dtype(cfg.param_dtype))
+
+    if store is None:
+        def body(params, opt_state, tokens):
+            loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        return body, opt
+
+    # master-weights scheme: live params (and grads) in `store` dtype,
+    # f32 master copy updated by the optimizer, live params re-derived
+    # by casting the master down each step
+    def master_init(params):
+        master = jax.tree_util.tree_map(
+            lambda p: jnp.asarray(p, jnp.float32), params)
+        return {"opt": opt.init(master), "master": master}
 
     def body(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
-        updates, opt_state = opt.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
+        g32 = jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32), grads)
+        updates, inner = opt.update(g32, opt_state["opt"],
+                                    opt_state["master"])
+        master = optax.apply_updates(opt_state["master"], updates)
+        params = jax.tree_util.tree_map(
+            lambda m: m.astype(store), master)
+        return params, {"opt": inner, "master": master}, loss
 
-    return body, opt
+    class _MasterOpt:
+        init = staticmethod(master_init)
+
+    return body, _MasterOpt
 
 
 def make_train_step(cfg: TransformerConfig, mesh, lr: float = 3e-4):

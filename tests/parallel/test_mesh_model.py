@@ -127,6 +127,31 @@ def test_bf16_adam_moments_track_f32():
     assert abs(losses[None] - losses["bfloat16"]) < 0.05 * abs(losses[None])
 
 
+def test_bf16_param_storage_master_weights():
+    """param_dtype="bfloat16": live params/grads in bf16, f32 master in
+    the optimizer state, training still converges (small lr*update
+    increments land in the master, not the bf16 lattice)."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    mesh = _mesh222()
+    cfg = dataclasses.replace(CFG, param_dtype="bfloat16")
+    params = tfm.init_params(cfg)
+    assert np.asarray(params["w1"]).dtype == jnp.bfloat16
+    step, init_opt = tfm.make_train_step(cfg, mesh, lr=1e-2)
+    opt_state = init_opt(params)
+    assert opt_state["master"]["w1"].dtype == jnp.float32
+    toks = _tokens(cfg)
+    losses = []
+    for _ in range(5):
+        params, opt_state, loss = step(params, opt_state, toks)
+        losses.append(float(loss))
+    assert params["w1"].dtype == jnp.bfloat16
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+
+
 def test_tp_sharding_is_real():
     """The compiled train step must actually shard tp weights (not silently
     replicate): check the output sharding of the updated params."""

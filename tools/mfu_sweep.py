@@ -18,12 +18,12 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # children inherit the shared persistent XLA compile cache (the tunnel's
-# remote compile helper stalls; a disk hit skips it entirely); same
-# resolution order as bench.py: explicit env > OMPI_TPU_JAX_CACHE > repo
-os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR",
-    os.environ.get("OMPI_TPU_JAX_CACHE",
-                   os.path.join(REPO, ".jax_cache")))
+# remote compile helper stalls; a disk hit skips it entirely) — one
+# resolution of the cache dir, owned by bench._enable_compile_cache
+sys.path.insert(0, REPO)
+from bench import _enable_compile_cache  # noqa: E402
+
+_enable_compile_cache()
 OUT = os.path.join(REPO, "MFU_SWEEP.jsonl")
 
 CHILD = r"""
@@ -207,6 +207,14 @@ GRID = [
                                 "remat": "dots", "attention": "xla",
                                 "adam_mu_dtype": "bfloat16",
                                 "chain": 24, "outer": 1}, 1800),
+    # bf16 param storage + f32 master (param_dtype): HBM-neutral on one
+    # chip (the master cancels the savings) — this row measures the
+    # halved param-read bandwidth per step, not a memory win
+    ("b16-xla-pbf16-chain32", {"batch": 16, "ce_chunk": 256,
+                               "remat": "dots", "attention": "xla",
+                               "param_dtype": "bfloat16",
+                               "adam_mu_dtype": "bfloat16",
+                               "chain": 32, "outer": 1}, 1800),
 ]
 
 _QUICK_LABELS = ["matmul_peak", "b16-chunk128-dots", "b32-chunk128-dots"]

@@ -21,12 +21,12 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # children inherit the shared persistent XLA compile cache (the tunnel's
-# remote compile helper stalls; a disk hit skips it entirely); same
-# resolution order as bench.py: explicit env > OMPI_TPU_JAX_CACHE > repo
-os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR",
-    os.environ.get("OMPI_TPU_JAX_CACHE",
-                   os.path.join(REPO, ".jax_cache")))
+# remote compile helper stalls; a disk hit skips it entirely) — one
+# resolution of the cache dir, owned by bench._enable_compile_cache
+sys.path.insert(0, REPO)
+from bench import _enable_compile_cache  # noqa: E402
+
+_enable_compile_cache()
 OUT = os.path.join(REPO, "MFU_SWEEP.jsonl")
 
 CHILD = r"""
