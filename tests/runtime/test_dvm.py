@@ -117,12 +117,15 @@ def test_dvm_ps_shows_daemons_and_history(dvm):
 
 def test_dvm_ps_live_job(dvm):
     """orte-ps semantics: querying DURING a run shows running procs."""
+    # generous sleep + window: on a loaded 1-core host each --dvm-ps
+    # poll is a full interpreter start (seconds); a 6s job could finish
+    # between two polls and the test would flake
     slow = _tpurun_bg("--dvm-submit", "-np", "2", "--dvm-uri", dvm, "--",
                       sys.executable, "-c",
                       "import time; print('start', flush=True); "
-                      "time.sleep(6)")
+                      "time.sleep(20)")
     try:
-        deadline = time.monotonic() + 30
+        deadline = time.monotonic() + 60
         live = None
         while time.monotonic() < deadline:
             ps = _tpurun("--dvm-ps", "--dvm-uri", dvm)
