@@ -76,6 +76,12 @@ class TransformerConfig:
     # sharding, a master/optimizer tree that can shard ZeRO-style while
     # live params stay replicated.  None/float32 = f32, no master.
     param_dtype: Any = None
+    # ZeRO-1: name a mesh axis (normally "dp") to shard the optimizer's
+    # persistent tree (f32 master + Adam moments) over it — each rank
+    # stores/updates 1/dp of every leaf and XLA's SPMD partitioner
+    # inserts the one all-gather per leaf that re-replicates updated
+    # params (see parallel/zero.py).  None = replicated optimizer state.
+    zero1_axis: Any = None
 
     @property
     def head_dim(self) -> int:
@@ -403,6 +409,27 @@ def _make_step_body(cfg: TransformerConfig, mesh, lr: float):
                       mu_dtype=cfg.adam_mu_dtype)
     store = (None if cfg.param_dtype in (None, "float32", jnp.float32)
              else jnp.dtype(cfg.param_dtype))
+
+    if cfg.zero1_axis:
+        from jax.sharding import PartitionSpec as _P
+
+        from ompi_tpu.parallel.zero import zero1_wrap
+
+        z_init, z_update = zero1_wrap(
+            opt, mesh, cfg.zero1_axis, param_dtype=store,
+            # updated live params keep their Megatron/MoE shardings —
+            # only the zero1-axis redundancy is re-gathered
+            param_specs=param_specs(_P, cfg, mesh))
+
+        def body(params, opt_state, tokens):
+            loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+            params, opt_state = z_update(grads, opt_state, params)
+            return params, opt_state, loss
+
+        class _ZeroOpt:
+            init = staticmethod(z_init)
+
+        return body, _ZeroOpt
 
     if store is None:
         def body(params, opt_state, tokens):
