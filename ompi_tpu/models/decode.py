@@ -1,0 +1,138 @@
+"""Autoregressive decoding with a KV cache — the inference counterpart
+of the train step, built from the same layer math.
+
+TPU-first shape: ONE compiled program per (prompt_len, max_new) pair —
+prefill runs the training backbone once (``collect_kv`` returns every
+layer's post-rope K/V in a single pass), then a ``lax.scan`` generates
+tokens against a static-shape cache updated with
+``lax.dynamic_update_slice`` (no growing arrays, no recompilation per
+token).  Sharding: batch over dp, heads over tp (the cache is
+head-sharded exactly like the weights); greedy argmax over the full
+vocab.  Sequence parallelism is a training-time layout — decode
+requires sp == 1.
+"""
+
+from __future__ import annotations
+
+from ompi_tpu.models.transformer import (TransformerConfig,
+                                         _dense_ffn_tail, _rmsnorm,
+                                         _rope, param_specs)
+
+__all__ = ["make_decoder"]
+
+
+def _step_layer(cfg: TransformerConfig, comm, lp, h, kc, vc, pos):
+    """One layer for ONE new token position, updating this layer's cache.
+
+    h: (B, 1, D); kc/vc: (B, Tmax, Hl, hd).  Returns (h, kc, vc) with
+    the new token's k/v written at index ``pos``.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ompi_tpu.parallel.layers import column_parallel, row_parallel
+
+    cdt = h.dtype
+    B = h.shape[0]
+    Tmax, hl, hd = kc.shape[1], kc.shape[2], kc.shape[3]
+
+    x = _rmsnorm(h, lp["ln1"])
+    q = column_parallel(x, lp["wq"].astype(cdt)).reshape(B, 1, hl, hd)
+    k = column_parallel(x, lp["wk"].astype(cdt)).reshape(B, 1, hl, hd)
+    v = column_parallel(x, lp["wv"].astype(cdt)).reshape(B, 1, hl, hd)
+    q = _rope(q, pos[None])
+    k = _rope(k, pos[None])
+    kc = lax.dynamic_update_slice(kc, k.astype(kc.dtype), (0, pos, 0, 0))
+    vc = lax.dynamic_update_slice(vc, v.astype(vc.dtype), (0, pos, 0, 0))
+    # scores against every cached position, masked beyond `pos`
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   kc.astype(jnp.float32)) * (hd ** -0.5)
+    live = jnp.arange(Tmax)[None, None, None, :] <= pos
+    s = jnp.where(live, s, -1e30)
+    w = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bqhd", w, vc.astype(jnp.float32))
+    o = o.astype(cdt).reshape(B, 1, hl * hd)
+    h = h + row_parallel(o, lp["wo"].astype(cdt), comm, axis="tp")
+    return _dense_ffn_tail(h, lp, comm, cdt), kc, vc
+
+
+def make_decoder(cfg: TransformerConfig, mesh, max_new: int):
+    """jitted (params, prompt (B, Tp) int32) → (B, Tp + max_new) int32.
+
+    Greedy decode: prefill through the training backbone (one pass,
+    K/V collected per layer), then ``max_new`` single-token steps over
+    the static cache.  Requires sp == 1 and a dense (non-MoE) config.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from ompi_tpu.models import transformer as tfm
+    from ompi_tpu.mpi.device_comm import DeviceCommunicator
+
+    for ax in ("dp", "sp", "tp"):
+        if ax not in mesh.shape:
+            raise ValueError(f"decode needs a mesh with dp/sp/tp axes "
+                             f"(missing {ax!r}; have "
+                             f"{tuple(mesh.shape)})")
+    if int(mesh.shape["sp"]) != 1:
+        raise ValueError("decode requires sp == 1 (sequence parallelism "
+                         "is a training-time layout)")
+    if cfg.moe_experts:
+        raise NotImplementedError("decode currently covers the dense "
+                                  "family only")
+
+    axes = tuple(a for a in ("dp", "sp", "tp", "ep")
+                 if a in mesh.axis_names)
+    comm = DeviceCommunicator(mesh, axes)
+    cdt = jnp.dtype(cfg.compute_dtype)
+    keys = ["wq", "wk", "wv", "wo", "w1", "w2", "ln1", "ln2"]
+
+    def local(params, prompt):
+        B, Tp = prompt.shape
+        emb = params["emb"].astype(cdt)
+        # ---- prefill: one training-backbone pass, K/V collected ----
+        h, (_aux, ks, vs) = tfm._local_backbone(cfg, comm, params, prompt,
+                                                collect_kv=True)
+        pad = [(0, 0), (0, 0), (0, max_new), (0, 0), (0, 0)]
+        kc = jnp.pad(ks, pad)       # (L, B, Tp+max_new, Hl, hd)
+        vc = jnp.pad(vs, pad)
+        logits = jnp.einsum("bd,vd->bv", h[:, -1, :], emb,
+                            preferred_element_type=jnp.float32)
+        tok0 = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B,)
+
+        layer_params = {k: params[k] for k in keys}
+
+        def gen(carry, _):
+            kc, vc, tok, pos = carry
+            h = params["emb"][tok].astype(cdt)[:, None, :]    # (B, 1, D)
+
+            def per_layer(h, inp):
+                lp, kc_l, vc_l = inp
+                h, kc_l, vc_l = _step_layer(cfg, comm, lp, h,
+                                            kc_l, vc_l, pos)
+                return h, (kc_l, vc_l)
+
+            h, (kc, vc) = lax.scan(per_layer, h, (layer_params, kc, vc))
+            h = _rmsnorm(h, params["lnf"])
+            logits = jnp.einsum("bd,vd->bv", h[:, 0, :], emb,
+                                preferred_element_type=jnp.float32)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (kc, vc, nxt, pos + 1), nxt
+
+        # emit the PRODUCED token and scan max_new-1 steps: tok0 is
+        # already known from prefill, so the last single-token pass is
+        # not computed just to be thrown away
+        (_, _, _, _), toks = lax.scan(
+            gen, (kc, vc, tok0, jnp.int32(Tp)), None,
+            length=max_new - 1)
+        gen_toks = jnp.concatenate(
+            [tok0[None], toks], axis=0)       # (max_new, B)
+        return jnp.concatenate([prompt, gen_toks.swapaxes(0, 1)], axis=1)
+
+    return jax.jit(jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(param_specs(P, cfg, mesh), P("dp", None)),
+        out_specs=P("dp", None), check_vma=False))

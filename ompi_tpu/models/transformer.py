@@ -170,12 +170,29 @@ def _rope(x, positions):
     return rot.astype(x.dtype)
 
 
-def _local_backbone(cfg: TransformerConfig, comm, params, tokens):
+def _dense_ffn_tail(h, lp, comm, cdt):
+    """Post-attention half of the dense layer: ln2 → gelu MLP →
+    residual (shared by the training layer and the cached decode step,
+    models/decode.py — one source of truth for this math)."""
+    import jax
+
+    from ompi_tpu.parallel.layers import column_parallel, row_parallel
+
+    x = _rmsnorm(h, lp["ln2"])
+    y = jax.nn.gelu(column_parallel(x, lp["w1"].astype(cdt)))
+    return h + row_parallel(y, lp["w2"].astype(cdt), comm, axis="tp")
+
+
+def _local_backbone(cfg: TransformerConfig, comm, params, tokens,
+                    collect_kv: bool = False):
     """Per-device forward through the final rmsnorm (everything except the
     unembed matmul).
 
     tokens: (B/dp, S/sp) int32.  Returns (h (B/dp, S/sp, D) compute-dtype,
     aux) — aux is the summed MoE load-balancing loss (0.0 for dense).
+    With ``collect_kv`` returns (h, (aux, k, v)) where k/v are the
+    post-rope per-layer attention inputs stacked (L, B, T, H/tp, hd) —
+    the KV-cache prefill (models/decode.py).
     """
     import jax
     import jax.numpy as jnp
@@ -219,11 +236,11 @@ def _local_backbone(cfg: TransformerConfig, comm, params, tokens):
             o = attn_mod.gathered_attention(comm, q, k, v, axis="sp")
         o = o.reshape(B, t, h_local * hd)
         h = h + row_parallel(o, lp["wo"].astype(cdt), comm, axis="tp")
-        x = _rmsnorm(h, lp["ln2"])
         if cfg.moe_experts:
             # MoE family: expert-parallel switch FFN over the "ep" axis
             # (tp ranks replicate the expert compute — activations are
             # identical across tp after the row_parallel psum)
+            x = _rmsnorm(h, lp["ln2"])
             mo, aux = switch_moe(
                 comm, x, {"wg": lp["wg"], "w1": lp["w1"],
                           "w2": lp["w2"]},
@@ -231,10 +248,10 @@ def _local_backbone(cfg: TransformerConfig, comm, params, tokens):
                 with_aux=True)
             h = h + mo
         else:
-            y = column_parallel(x, lp["w1"].astype(cdt))
-            y = jax.nn.gelu(y)
-            h = h + row_parallel(y, lp["w2"].astype(cdt), comm, axis="tp")
+            h = _dense_ffn_tail(h, lp, comm, cdt)
             aux = jnp.zeros((), jnp.float32)
+        if collect_kv:
+            return h, (aux, k, v)
         return h, aux
 
     keys = ["wq", "wk", "wv", "wo", "w1", "w2", "ln1", "ln2"]
@@ -248,9 +265,12 @@ def _local_backbone(cfg: TransformerConfig, comm, params, tokens):
             layer, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
     else:
         layer_fn = layer
-    h, aux = lax.scan(layer_fn, h, layer_params)
+    h, ys = lax.scan(layer_fn, h, layer_params)
     h = _rmsnorm(h, params["lnf"])
-    return h, aux.sum()
+    if collect_kv:
+        aux, ks, vs = ys
+        return h, (aux.sum(), ks, vs)
+    return h, ys.sum()
 
 
 def _local_forward(cfg: TransformerConfig, comm, params, tokens):
