@@ -76,6 +76,14 @@ class TransformerConfig:
     # sharding, a master/optimizer tree that can shard ZeRO-style while
     # live params stay replicated.  None/float32 = f32, no master.
     param_dtype: Any = None
+    # Gradient accumulation: >1 splits the batch into this many
+    # microbatches inside ONE compiled step — a lax.scan accumulates
+    # the (mean) gradients, then the optimizer runs once.  Peak
+    # activation memory scales with the MICRObatch, so effective batch
+    # sizes that would OOM in one pass fit.  Constraints: batch %
+    # grad_accum == 0 AND (batch / grad_accum) % dp == 0 (each
+    # microbatch still shards over dp).
+    grad_accum: int = 1
     # ZeRO-1: name a mesh axis (normally "dp") to shard the optimizer's
     # persistent tree (f32 master + Adam moments) over it — each rank
     # stores/updates 1/dp of every leaf and XLA's SPMD partitioner
@@ -423,10 +431,37 @@ def _make_step_body(cfg: TransformerConfig, mesh, lr: float):
     import optax
 
     import jax.numpy as jnp
+    from jax import lax
 
     loss_fn = make_loss_fn(cfg, mesh)
     opt = optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=0.01,
                       mu_dtype=cfg.adam_mu_dtype)
+
+    accum = max(1, int(cfg.grad_accum))
+
+    def loss_and_grads(params, tokens):
+        """(mean loss, mean grads) — one pass, or a lax.scan over
+        ``grad_accum`` microbatches whose activations never coexist."""
+        if accum == 1:
+            return jax.value_and_grad(loss_fn)(params, tokens)
+        B = tokens.shape[0]
+        if B % accum:
+            raise ValueError(f"batch {B} not divisible by "
+                             f"grad_accum {accum}")
+        micro = tokens.reshape(accum, B // accum, *tokens.shape[1:])
+
+        def body(carry, toks):
+            acc_loss, acc_g = carry
+            loss, g = jax.value_and_grad(loss_fn)(params, toks)
+            acc_g = jax.tree_util.tree_map(jnp.add, acc_g, g)
+            return (acc_loss + loss, acc_g), None
+
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+        (total, g_sum), _ = lax.scan(
+            body, (jnp.zeros((), jnp.float32), zeros), micro)
+        inv = 1.0 / accum
+        return total * inv, jax.tree_util.tree_map(
+            lambda g: (g * inv).astype(g.dtype), g_sum)
     store = (None if cfg.param_dtype in (None, "float32", jnp.float32)
              else jnp.dtype(cfg.param_dtype))
 
@@ -442,7 +477,7 @@ def _make_step_body(cfg: TransformerConfig, mesh, lr: float):
             param_specs=param_specs(_P, cfg, mesh))
 
         def body(params, opt_state, tokens):
-            loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+            loss, grads = loss_and_grads(params, tokens)
             params, opt_state = z_update(grads, opt_state, params)
             return params, opt_state, loss
 
@@ -453,7 +488,7 @@ def _make_step_body(cfg: TransformerConfig, mesh, lr: float):
 
     if store is None:
         def body(params, opt_state, tokens):
-            loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+            loss, grads = loss_and_grads(params, tokens)
             updates, opt_state = opt.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             return params, opt_state, loss
@@ -469,7 +504,7 @@ def _make_step_body(cfg: TransformerConfig, mesh, lr: float):
         return {"opt": opt.init(master), "master": master}
 
     def body(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        loss, grads = loss_and_grads(params, tokens)
         g32 = jax.tree_util.tree_map(
             lambda g: g.astype(jnp.float32), grads)
         updates, inner = opt.update(g32, opt_state["opt"],

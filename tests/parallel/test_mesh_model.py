@@ -152,6 +152,28 @@ def test_bf16_param_storage_master_weights():
     assert losses[-1] < losses[0], losses
 
 
+def test_grad_accum_matches_single_pass():
+    """grad_accum=4 must produce the same trajectory as one full-batch
+    pass (mean of microbatch grads == full-batch grad for a mean loss
+    over equal-sized microbatches)."""
+    import dataclasses
+
+    mesh = _mesh222()
+    toks = _tokens(CFG, batch=8)  # microbatch (8/4=2) must still cover dp=2
+    losses = {}
+    for acc in (1, 4):
+        cfg = dataclasses.replace(CFG, grad_accum=acc)
+        params = tfm.init_params(cfg)
+        step, init_opt = tfm.make_train_step(cfg, mesh, lr=1e-2)
+        opt_state = init_opt(params)
+        for _ in range(3):
+            params, opt_state, loss = step(params, opt_state, toks)
+        losses[acc] = float(loss)
+    assert np.isfinite(losses[4])
+    assert abs(losses[1] - losses[4]) < 2e-3 * max(1.0, abs(losses[1])), \
+        losses
+
+
 def test_zero1_optimizer_state_sharded_and_converges():
     """zero1_axis="dp": optimizer leaves are (dp, n/dp) sharded over dp
     (each rank holds 1/dp), training matches the replicated baseline."""

@@ -215,6 +215,12 @@ GRID = [
                                "param_dtype": "bfloat16",
                                "adam_mu_dtype": "bfloat16",
                                "chain": 32, "outer": 1}, 1800),
+    # effective batch 32 via 2 in-jit microbatches: b16's activation
+    # peak, one optimizer pass per 32-sample step
+    ("b32-accum2-xla-chain16", {"batch": 32, "grad_accum": 2,
+                                "ce_chunk": 256, "remat": "dots",
+                                "attention": "xla",
+                                "chain": 16, "outer": 1}, 1800),
 ]
 
 _QUICK_LABELS = ["matmul_peak", "b16-chunk128-dots", "b32-chunk128-dots"]
