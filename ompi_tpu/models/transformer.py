@@ -437,7 +437,9 @@ def _make_step_body(cfg: TransformerConfig, mesh, lr: float):
     opt = optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=0.01,
                       mu_dtype=cfg.adam_mu_dtype)
 
-    accum = max(1, int(cfg.grad_accum))
+    accum = int(cfg.grad_accum)
+    if accum < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {cfg.grad_accum}")
 
     def loss_and_grads(params, tokens):
         """(mean loss, mean grads) — one pass, or a lax.scan over
@@ -453,15 +455,21 @@ def _make_step_body(cfg: TransformerConfig, mesh, lr: float):
         def body(carry, toks):
             acc_loss, acc_g = carry
             loss, g = jax.value_and_grad(loss_fn)(params, toks)
-            acc_g = jax.tree_util.tree_map(jnp.add, acc_g, g)
+            # accumulate in f32 even when grads arrive in a storage
+            # dtype (param_dtype=bf16): summing K microbatches in bf16
+            # rounds small components away before the optimizer's own
+            # f32 cast ever sees them
+            acc_g = jax.tree_util.tree_map(
+                lambda a, b: a + b.astype(jnp.float32), acc_g, g)
             return (acc_loss + loss, acc_g), None
 
-        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
         (total, g_sum), _ = lax.scan(
             body, (jnp.zeros((), jnp.float32), zeros), micro)
         inv = 1.0 / accum
         return total * inv, jax.tree_util.tree_map(
-            lambda g: (g * inv).astype(g.dtype), g_sum)
+            lambda g: g * inv, g_sum)
     store = (None if cfg.param_dtype in (None, "float32", jnp.float32)
              else jnp.dtype(cfg.param_dtype))
 
