@@ -152,6 +152,26 @@ def test_bf16_param_storage_master_weights():
     assert losses[-1] < losses[0], losses
 
 
+def test_lr_schedule_accepted():
+    """lr may be an optax schedule (callable step -> lr) — warmup/decay
+    flows straight through to adamw."""
+    import optax
+
+    mesh = _mesh222()
+    sched = optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=1e-2, warmup_steps=2,
+        decay_steps=10)
+    params = tfm.init_params(CFG)
+    step, init_opt = tfm.make_train_step(CFG, mesh, lr=sched)
+    opt_state = init_opt(params)
+    toks = _tokens(CFG)
+    losses = []
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, toks)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses)), losses
+
+
 def test_grad_accum_matches_single_pass():
     """grad_accum=4 must produce the same trajectory as one full-batch
     pass (mean of microbatch grads == full-batch grad for a mean loss
