@@ -1,8 +1,9 @@
 #!/bin/bash
-# Wait for the axon tunnel to come back, then (1) validate the
-# degenerate-collective elision on the flagship configs, (2) run the
-# full bench to refresh preflight evidence and populate the persistent
-# compile cache for the driver's end-of-round run.
+# Wait for the axon tunnel to come back, then run the queued TPU work:
+# (1) flagship configs validating the degenerate-collective elision,
+# (2) full bench (refreshes preflight evidence + populates the
+#     persistent compile cache the driver's end-of-round run will hit),
+# (3) step-time breakdown, (4) the new feature rows.
 # State in /tmp/tpurecover/.
 mkdir -p /tmp/tpurecover
 cd /root/repo
@@ -18,7 +19,12 @@ print(float(x[0]))" >/tmp/tpurecover/probe.log 2>&1; then
       >> /tmp/tpurecover/sweep.log 2>&1
     echo "$(date -u +%FT%TZ) sweep rc=$? — bench" >> /tmp/tpurecover/status
     python bench.py > /tmp/tpurecover/bench.out 2> /tmp/tpurecover/bench.err
-    echo "$(date -u +%FT%TZ) bench rc=$?" >> /tmp/tpurecover/status
+    echo "$(date -u +%FT%TZ) bench rc=$? — breakdown" >> /tmp/tpurecover/status
+    python tools/step_breakdown.py >> /tmp/tpurecover/breakdown.log 2>&1
+    echo "$(date -u +%FT%TZ) breakdown rc=$? — feature rows" >> /tmp/tpurecover/status
+    python tools/mfu_sweep.py b16-xla-pbf16-chain32 b32-accum2-xla-chain16 \
+      >> /tmp/tpurecover/sweep.log 2>&1
+    echo "$(date -u +%FT%TZ) all done rc=$?" >> /tmp/tpurecover/status
     break
   fi
   echo "$(date -u +%FT%TZ) tpu down" >> /tmp/tpurecover/status
