@@ -9,7 +9,11 @@ tokens against a static-shape cache updated with
 token).  Sharding: batch over dp, heads over tp (the cache is
 head-sharded exactly like the weights); greedy argmax over the full
 vocab.  Sequence parallelism is a training-time layout — decode
-requires sp == 1.
+requires sp == 1.  MoE configs route each generated token through the
+same ep-sharded switch as training; note the switch capacity is
+computed per single-token step (B tokens), so under a binding capacity
+the drop pattern can differ from a full-sequence forward — cached and
+full paths agree exactly whenever capacity doesn't bind.
 """
 
 from __future__ import annotations
@@ -54,6 +58,11 @@ def _step_layer(cfg: TransformerConfig, comm, lp, h, kc, vc, pos):
     o = jnp.einsum("bhqk,bkhd->bqhd", w, vc.astype(jnp.float32))
     o = o.astype(cdt).reshape(B, 1, hl * hd)
     h = h + row_parallel(o, lp["wo"].astype(cdt), comm, axis="tp")
+    if cfg.moe_experts:
+        from ompi_tpu.models.transformer import _moe_ffn_tail
+
+        h, _aux = _moe_ffn_tail(cfg, h, lp, comm)  # aux: training-only
+        return h, kc, vc
     return _dense_ffn_tail(h, lp, comm, cdt), kc, vc
 
 
@@ -62,7 +71,9 @@ def make_decoder(cfg: TransformerConfig, mesh, max_new: int):
 
     Greedy decode: prefill through the training backbone (one pass,
     K/V collected per layer), then ``max_new`` single-token steps over
-    the static cache.  Requires sp == 1 and a dense (non-MoE) config.
+    the static cache.  Requires sp == 1; dense and switch-MoE configs
+    both supported (MoE routes each token through the same ep-sharded
+    switch as training).
     """
     import jax
     import jax.numpy as jnp
@@ -80,15 +91,13 @@ def make_decoder(cfg: TransformerConfig, mesh, max_new: int):
     if int(mesh.shape["sp"]) != 1:
         raise ValueError("decode requires sp == 1 (sequence parallelism "
                          "is a training-time layout)")
-    if cfg.moe_experts:
-        raise NotImplementedError("decode currently covers the dense "
-                                  "family only")
-
     axes = tuple(a for a in ("dp", "sp", "tp", "ep")
                  if a in mesh.axis_names)
     comm = DeviceCommunicator(mesh, axes)
     cdt = jnp.dtype(cfg.compute_dtype)
     keys = ["wq", "wk", "wv", "wo", "w1", "w2", "ln1", "ln2"]
+    if cfg.moe_experts:
+        keys.append("wg")
 
     def local(params, prompt):
         B, Tp = prompt.shape

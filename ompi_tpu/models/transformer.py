@@ -178,6 +178,20 @@ def _rope(x, positions):
     return rot.astype(x.dtype)
 
 
+def _moe_ffn_tail(cfg, h, lp, comm):
+    """Post-attention half of the MoE layer: ln2 → ep-sharded switch →
+    residual (shared by the training layer and the cached decode step —
+    one source of truth, like _dense_ffn_tail).  Returns (h, aux)."""
+    from ompi_tpu.parallel.moe import switch_moe
+
+    x = _rmsnorm(h, lp["ln2"])
+    mo, aux = switch_moe(
+        comm, x, {"wg": lp["wg"], "w1": lp["w1"], "w2": lp["w2"]},
+        axis="ep", capacity_factor=cfg.moe_capacity_factor,
+        with_aux=True)
+    return h + mo, aux
+
+
 def _dense_ffn_tail(h, lp, comm, cdt):
     """Post-attention half of the dense layer: ln2 → gelu MLP →
     residual (shared by the training layer and the cached decode step,
@@ -248,13 +262,7 @@ def _local_backbone(cfg: TransformerConfig, comm, params, tokens,
             # MoE family: expert-parallel switch FFN over the "ep" axis
             # (tp ranks replicate the expert compute — activations are
             # identical across tp after the row_parallel psum)
-            x = _rmsnorm(h, lp["ln2"])
-            mo, aux = switch_moe(
-                comm, x, {"wg": lp["wg"], "w1": lp["w1"],
-                          "w2": lp["w2"]},
-                axis="ep", capacity_factor=cfg.moe_capacity_factor,
-                with_aux=True)
-            h = h + mo
+            h, aux = _moe_ffn_tail(cfg, h, lp, comm)
         else:
             h = _dense_ffn_tail(h, lp, comm, cdt)
             aux = jnp.zeros((), jnp.float32)
