@@ -66,14 +66,22 @@ def _step_layer(cfg: TransformerConfig, comm, lp, h, kc, vc, pos):
     return _dense_ffn_tail(h, lp, comm, cdt), kc, vc
 
 
-def make_decoder(cfg: TransformerConfig, mesh, max_new: int):
-    """jitted (params, prompt (B, Tp) int32) → (B, Tp + max_new) int32.
+def make_decoder(cfg: TransformerConfig, mesh, max_new: int,
+                 temperature: float = 0.0, top_k: int = 0):
+    """jitted (params, prompt (B, Tp) int32[, seed]) → (B, Tp+max_new).
 
-    Greedy decode: prefill through the training backbone (one pass,
-    K/V collected per layer), then ``max_new`` single-token steps over
-    the static cache.  Requires sp == 1; dense and switch-MoE configs
-    both supported (MoE routes each token through the same ep-sharded
-    switch as training).
+    Greedy decode by default: prefill through the training backbone
+    (one pass, K/V collected per layer), then ``max_new`` single-token
+    steps over the static cache.  Requires sp == 1; dense and
+    switch-MoE configs both supported (MoE routes each token through
+    the same ep-sharded switch as training).
+
+    ``temperature > 0`` switches to sampling (optionally truncated to
+    the ``top_k`` highest logits); the returned callable then takes a
+    third argument ``seed`` (int32 scalar).  Each step folds the
+    position — and the dp coordinate, so data-parallel shards draw
+    independent noise — into the key; tp ranks share the key and hence
+    agree on every sampled token (their logits are identical).
     """
     import jax
     import jax.numpy as jnp
@@ -98,8 +106,26 @@ def make_decoder(cfg: TransformerConfig, mesh, max_new: int):
     keys = ["wq", "wk", "wv", "wo", "w1", "w2", "ln1", "ln2"]
     if cfg.moe_experts:
         keys.append("wg")
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if top_k and not temperature:
+        raise ValueError("top_k needs temperature > 0")
 
-    def local(params, prompt):
+    def pick(logits, pos, seed):
+        """Next token from (B, V) f32 logits."""
+        if not temperature:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        scaled = logits / jnp.float32(temperature)
+        if top_k:
+            kth = lax.top_k(scaled, top_k)[0][..., -1:]
+            scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+        key = jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(seed), pos),
+            lax.axis_index("dp"))
+        return jax.random.categorical(key, scaled,
+                                      axis=-1).astype(jnp.int32)
+
+    def local(params, prompt, seed):
         B, Tp = prompt.shape
         emb = params["emb"].astype(cdt)
         # ---- prefill: one training-backbone pass, K/V collected ----
@@ -110,7 +136,7 @@ def make_decoder(cfg: TransformerConfig, mesh, max_new: int):
         vc = jnp.pad(vs, pad)
         logits = jnp.einsum("bd,vd->bv", h[:, -1, :], emb,
                             preferred_element_type=jnp.float32)
-        tok0 = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B,)
+        tok0 = pick(logits, jnp.int32(Tp - 1), seed)          # (B,)
 
         layer_params = {k: params[k] for k in keys}
 
@@ -128,7 +154,7 @@ def make_decoder(cfg: TransformerConfig, mesh, max_new: int):
             h = _rmsnorm(h, params["lnf"])
             logits = jnp.einsum("bd,vd->bv", h[:, 0, :], emb,
                                 preferred_element_type=jnp.float32)
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            nxt = pick(logits, pos, seed)
             return (kc, vc, nxt, pos + 1), nxt
 
         # emit the PRODUCED token and scan max_new-1 steps: tok0 is
@@ -141,7 +167,15 @@ def make_decoder(cfg: TransformerConfig, mesh, max_new: int):
             [tok0[None], toks], axis=0)       # (max_new, B)
         return jnp.concatenate([prompt, gen_toks.swapaxes(0, 1)], axis=1)
 
-    return jax.jit(jax.shard_map(
+    mapped = jax.shard_map(
         local, mesh=mesh,
-        in_specs=(param_specs(P, cfg, mesh), P("dp", None)),
-        out_specs=P("dp", None), check_vma=False))
+        in_specs=(param_specs(P, cfg, mesh), P("dp", None), P()),
+        out_specs=P("dp", None), check_vma=False)
+    if temperature:
+        return jax.jit(mapped)
+    # greedy keeps its two-argument signature; seed is inert
+    import numpy as _np
+
+    jitted = jax.jit(mapped)
+    return lambda params, prompt: jitted(params, prompt,
+                                         _np.int32(0))

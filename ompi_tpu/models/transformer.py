@@ -222,7 +222,6 @@ def _local_backbone(cfg: TransformerConfig, comm, params, tokens,
 
     from ompi_tpu.parallel import attention as attn_mod
     from ompi_tpu.parallel.layers import column_parallel, row_parallel
-    from ompi_tpu.parallel.moe import switch_moe
 
     cdt = jnp.dtype(cfg.compute_dtype)
     tp = int(comm.mesh.shape["tp"])

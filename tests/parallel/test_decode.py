@@ -21,6 +21,16 @@ def _mesh():
     return make_mesh({"dp": 4, "sp": 1, "tp": 2})
 
 
+def _greedy_reference(fwd, params, prompt, max_new):
+    """Grow the sequence one token at a time via full forwards."""
+    cur = prompt
+    for _ in range(max_new):
+        logits = np.asarray(fwd(params, cur))
+        nxt = logits[:, -1, :].argmax(-1).astype(np.int32)[:, None]
+        cur = np.concatenate([cur, nxt], axis=1)
+    return cur
+
+
 def test_cached_decode_matches_full_forward():
     mesh = _mesh()
     params = tfm.init_params(CFG)
@@ -34,13 +44,28 @@ def test_cached_decode_matches_full_forward():
     assert got.shape == (4, 8 + max_new)
     np.testing.assert_array_equal(got[:, :8], prompt)
 
-    # reference: grow the sequence, full forward each time, greedy pick
-    cur = prompt
-    for _ in range(max_new):
-        logits = np.asarray(fwd(params, cur))
-        nxt = logits[:, -1, :].argmax(-1).astype(np.int32)[:, None]
-        cur = np.concatenate([cur, nxt], axis=1)
-    np.testing.assert_array_equal(got, cur)
+    np.testing.assert_array_equal(
+        got, _greedy_reference(fwd, params, prompt, max_new))
+
+
+def test_sampled_decode_deterministic_and_valid():
+    """temperature>0: same seed → same tokens; different seeds diverge;
+    top_k truncation keeps tokens in-vocab."""
+    mesh = _mesh()
+    params = tfm.init_params(CFG)
+    rng = np.random.default_rng(2)
+    prompt = rng.integers(0, CFG.vocab, size=(4, 8)).astype(np.int32)
+    dec = make_decoder(CFG, mesh, max_new=6, temperature=0.8, top_k=10)
+    a = np.asarray(dec(params, prompt, np.int32(7)))
+    b = np.asarray(dec(params, prompt, np.int32(7)))
+    c = np.asarray(dec(params, prompt, np.int32(8)))
+    np.testing.assert_array_equal(a, b)
+    assert (a != c).any()          # different seed, different draws
+    assert a.min() >= 0 and a.max() < CFG.vocab
+    np.testing.assert_array_equal(a[:, :8], prompt)
+
+    with pytest.raises(ValueError, match="top_k"):
+        make_decoder(CFG, _mesh(), max_new=2, top_k=5)
 
 
 def test_decode_rejects_sp():
@@ -68,9 +93,5 @@ def test_moe_cached_decode_matches_full_forward():
     dec = make_decoder(cfg, mesh, max_new=max_new)
     got = np.asarray(dec(params, prompt))
 
-    cur = prompt
-    for _ in range(max_new):
-        logits = np.asarray(fwd(params, cur))
-        nxt = logits[:, -1, :].argmax(-1).astype(np.int32)[:, None]
-        cur = np.concatenate([cur, nxt], axis=1)
-    np.testing.assert_array_equal(got, cur)
+    np.testing.assert_array_equal(
+        got, _greedy_reference(fwd, params, prompt, max_new))
