@@ -110,6 +110,9 @@ def make_decoder(cfg: TransformerConfig, mesh, max_new: int,
         raise ValueError(f"temperature must be >= 0, got {temperature}")
     if top_k and not temperature:
         raise ValueError("top_k needs temperature > 0")
+    if top_k < 0 or top_k > cfg.vocab:
+        raise ValueError(f"top_k must be in [0, vocab={cfg.vocab}], "
+                         f"got {top_k}")
 
     def pick(logits, pos, seed):
         """Next token from (B, V) f32 logits."""

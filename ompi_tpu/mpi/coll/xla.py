@@ -122,18 +122,26 @@ class XlaColl(Component):
     PRIORITY = 60        # above host (40); the dispatcher routes by buffer
     HANDLES = frozenset({"device", "traced"})
 
+    # "qint8" (EQuARX-style int8 wire format, device_comm.allreduce_qint8)
+    # is in the menu for forcing/tuning but is LOSSY and never chosen by
+    # the auto decision
     ALGORITHMS = {
-        "allreduce": ("psum", "rs_ag", "segmented"),
+        "allreduce": ("psum", "rs_ag", "segmented", "qint8"),
         "allgather": ("all_gather", "ring"),
         "bcast": ("psum_mask", "ring"),
     }
     # collective → algorithm → DeviceCommunicator method
     _IMPL = {
         "allreduce": {"psum": "allreduce", "rs_ag": "allreduce_rs_ag",
-                      "segmented": "allreduce_segmented"},
+                      "segmented": "allreduce_segmented",
+                      "qint8": "allreduce_qint8"},
         "allgather": {"all_gather": "allgather", "ring": "allgather_ring"},
         "bcast": {"psum_mask": "bcast", "ring": "bcast_ring"},
     }
+    # algorithms that change RESULTS, not just schedules: measured and
+    # forceable, but never auto-picked (tools/tune excludes them from
+    # generated crossover rules; _decide never returns them)
+    LOSSY = {"allreduce": frozenset({"qint8"})}
 
     def register_params(self) -> None:
         register_var("coll", "xla_dcn_axes", VarType.STRING, "",
@@ -194,12 +202,20 @@ class XlaColl(Component):
                     alg = rs.lookup(coll, dc.size, nbytes)
                     src = "measured rules (xla_measured_rules.conf)"
         if alg:
-            if alg not in valid:
-                from ompi_tpu.mpi.constants import MPIException
+            from ompi_tpu.mpi.constants import MPIException
 
+            if alg not in valid:
                 raise MPIException(
                     f"unknown device {coll} algorithm {alg!r} (from {src}); "
                     f"valid: {', '.join(valid)}")
+            if (alg in self.LOSSY.get(coll, frozenset())
+                    and not src.startswith("config var")):
+                # a rules FILE must not silently change results; lossy
+                # algorithms are an explicit per-run opt-in only
+                raise MPIException(
+                    f"device {coll} algorithm {alg!r} (from {src}) is "
+                    f"lossy and may only be forced via the "
+                    f"coll_xla_{coll}_algorithm config var")
             return alg
         # fixed decision: neighbor-shaped on DCN axes or huge payloads;
         # XLA-native (fused, ICI-aware) otherwise

@@ -330,6 +330,57 @@ class DeviceCommunicator:
                                      tiled=True)
         return lax.all_gather(scattered, self._ax, axis=axis, tiled=True)
 
+    def allreduce_qint8(self, x, op: Op = SUM, block: int = 256):
+        """Quantized 2-phase allreduce (≈ EQuARX, arxiv 2506.17615):
+        int8 payloads with per-block f32 scales cut wire bytes ~4×.
+
+        Phase 1 is the reduce-scatter expressed as an all_to_all of
+        QUANTIZED chunks — each device dequantizes the n pieces of its
+        chunk locally and sums in f32 (int8 representations under
+        different scales cannot be summed on the wire).  Phase 2
+        re-quantizes the reduced chunk and all_gathers it.  LOSSY
+        (~0.2-0.5% rms for gradient-like data): never auto-selected —
+        opt-in via ``--mca coll xla_allreduce_algorithm qint8``.
+        """
+        import jax.numpy as jnp
+        from jax import lax
+
+        if op is not SUM:
+            return self.allreduce(x, op)
+        n = self.size
+        flat = x.reshape(-1)
+        unit = n * block
+        padded = -(-flat.shape[0] // unit) * unit
+        if padded != flat.shape[0]:
+            flat = jnp.pad(flat, (0, padded - flat.shape[0]))
+        chunk = padded // n                       # my phase-1 ownership
+
+        def quant(v):                             # (..., block) blocks
+            b = v.reshape(*v.shape[:-1], v.shape[-1] // block, block)
+            b32 = b.astype(jnp.float32)
+            scale = jnp.max(jnp.abs(b32), axis=-1, keepdims=True) / 127.0
+            scale = jnp.where(scale == 0, 1.0, scale)
+            q = jnp.clip(jnp.round(b32 / scale), -127, 127).astype(jnp.int8)
+            return q, scale
+
+        def dequant(q, scale):
+            return (q.astype(jnp.float32) * scale).reshape(
+                *q.shape[:-2], q.shape[-2] * block)
+
+        # phase 1: quantized chunks to their owners, local dequant-sum
+        q, s = quant(flat.reshape(n, chunk))
+        q = lax.all_to_all(q, self._ax, split_axis=0, concat_axis=0,
+                           tiled=False)
+        s = lax.all_to_all(s, self._ax, split_axis=0, concat_axis=0,
+                           tiled=False)
+        reduced = dequant(q, s).sum(axis=0)       # (chunk,) f32
+        # phase 2: re-quantize the reduced chunk, gather everywhere
+        q2, s2 = quant(reduced)
+        q2 = lax.all_gather(q2, self._ax, axis=0, tiled=False)
+        s2 = lax.all_gather(s2, self._ax, axis=0, tiled=False)
+        out = dequant(q2, s2).reshape(-1)[: x.size]
+        return out.reshape(x.shape).astype(x.dtype)
+
     def allreduce_segmented(self, x, op: Op = SUM,
                             segment_elems: int = 1 << 20):
         """Segmented 2-phase allreduce (≈ the reference's segmented ring,

@@ -98,11 +98,14 @@ def tune_device_colls(devices=None, sizes: Sequence[int] = DEFAULT_SIZES,
     platform = devices[0].platform
     kind = getattr(devices[0], "device_kind", platform)
 
+    from ompi_tpu.mpi.coll.xla import XlaColl
+
     table: dict[str, dict[str, dict[str, float]]] = {}
     winners: dict[str, list[tuple[int, str]]] = {}
     for coll, impls in _impl_table().items():
         table[coll] = {}
         winners[coll] = []
+        lossy = XlaColl.LOSSY.get(coll, frozenset())
         for elems in sizes:
             nbytes = elems * 4
             label = (f"{nbytes >> 10}KiB" if nbytes < (1 << 20)
@@ -118,8 +121,11 @@ def tune_device_colls(devices=None, sizes: Sequence[int] = DEFAULT_SIZES,
                     continue
                 row[alg] = round(dt * 1e6, 1)
             table[coll][label] = row
-            if row:
-                best = min(row, key=row.get)
+            # lossy algorithms (e.g. qint8): measured for the table, but
+            # a crossover rule must never silently change results
+            exact = {a: t for a, t in row.items() if a not in lossy}
+            if exact:
+                best = min(exact, key=exact.get)
                 winners[coll].append((nbytes, best))
                 _log(f"tune[{coll}@{label}]: {row} → {best}")
 

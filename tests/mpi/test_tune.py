@@ -107,3 +107,15 @@ def test_measured_rules_size_gate(tmp_path, monkeypatch):
     # below the large-message threshold)
     assert comp._decide("allreduce", None, dc, 1024) == "psum"
     xla._measured_cache.clear()
+
+
+def test_tune_never_ships_lossy_rules(tmp_path):
+    """qint8 is measured (it's in the table) but a generated crossover
+    rule must never select a result-changing algorithm."""
+    out = tmp_path / "lossy.conf"
+    text, table = tune_device_colls(
+        jax.devices(), sizes=(1 << 10,), out_path=str(out), iters=1)
+    assert any("qint8" in row for row in table["allreduce"].values())
+    for ln in text.splitlines():
+        if ln.startswith("allreduce"):
+            assert "qint8" not in ln, ln
