@@ -1,0 +1,38 @@
+"""Smoke-run the host-path examples under tpurun — examples are the
+first thing a migrating user executes, so they must not rot.
+
+Device-path examples (generate.py, osc_device_window.py, …) are
+exercised by the parallel/ suites on the virtual mesh instead; spawning
+them here would re-probe the accelerator tunnel per test.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+CASES = [
+    ("ring.py", "3 processes in ring"),
+    ("hello.py", "Hello, world"),
+    ("connectivity.py", "Connectivity test on 3 processes PASSED"),
+    ("ring_oshmem.py", "exiting"),
+    ("oshmem_shmalloc.py", "shmalloc/shfree ok"),
+    ("oshmem_circular_shift.py", "circular shift ok"),
+    ("oshmem_symmetric_data.py", "verified symmetric data"),
+]
+
+
+@pytest.mark.parametrize("script,marker",
+                         CASES, ids=[c[0] for c in CASES])
+def test_example_runs_under_tpurun(script, marker):
+    proc = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-np", "3", "--",
+         sys.executable, os.path.join(REPO, "examples", script)],
+        capture_output=True, text=True, timeout=180, cwd=REPO)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-2000:]
+    assert marker in out, out[-2000:]
