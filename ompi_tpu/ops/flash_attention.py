@@ -31,6 +31,12 @@ from ompi_tpu.core.config import VarType, register_var
 
 __all__ = ["flash_attention", "flash_attention_lse", "flash_tiles"]
 
+register_var("ops", "flash_block_q", VarType.INT, 128,
+             "flash kernel q-block rows per grid cell (tuning knob; "
+             "t_q must tile by it)")
+register_var("ops", "flash_block_k", VarType.INT, 128,
+             "flash kernel k/v streaming block size (tuning knob; "
+             "t_k must tile by it)")
 register_var("ops", "flash_bwd_kernel", VarType.BOOL, False,
              "use the pallas backward kernels for flash attention "
              "(recompute-from-lse, O(T·D) memory) instead of the "

@@ -75,11 +75,19 @@ def local_attention_lse(q, k, v, causal: bool = True,
 
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     if _flash_wanted(impl, q.shape[1], k.shape[1]):
+        from ompi_tpu.core.config import var_registry
         from ompi_tpu.ops.flash_attention import flash_attention_lse
 
+        # tuning knobs (ops_flash_block_q/k); fall back to the kernel's
+        # 128 defaults when a var doesn't tile this shape
+        bq = int(var_registry.get("ops_flash_block_q") or 128)
+        bk = int(var_registry.get("ops_flash_block_k") or 128)
+        if (q.shape[1] % min(bq, q.shape[1])
+                or k.shape[1] % min(bk, k.shape[1])):
+            bq = bk = 128
         return flash_attention_lse(q, k, v, causal=causal,
                                    q_offset=q_offset, k_offset=k_offset,
-                                   scale=scale)
+                                   scale=scale, block_q=bq, block_k=bk)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
     if causal:

@@ -221,6 +221,17 @@ GRID = [
                                 "ce_chunk": 256, "remat": "dots",
                                 "attention": "xla",
                                 "chain": 16, "outer": 1}, 1800),
+    # flash kernel block-size tuning at seq 1024 (the kernel lost to
+    # XLA attention at the 128x128 default; bigger k-streaming blocks
+    # raise arithmetic intensity per grid cell)
+    ("b16-flash-bq256", {"batch": 16, "ce_chunk": 256, "remat": "dots",
+                         "attention": "flash", "chain": 16, "outer": 1,
+                         "_mca": {"ops_flash_block_q": 256,
+                                  "ops_flash_block_k": 256}}, 1800),
+    ("b16-flash-bk512", {"batch": 16, "ce_chunk": 256, "remat": "dots",
+                         "attention": "flash", "chain": 16, "outer": 1,
+                         "_mca": {"ops_flash_block_q": 128,
+                                  "ops_flash_block_k": 512}}, 1800),
 ]
 
 _QUICK_LABELS = ["matmul_peak", "b16-chunk128-dots", "b32-chunk128-dots"]
