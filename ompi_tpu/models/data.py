@@ -1,0 +1,137 @@
+"""Input pipeline: token batches onto the mesh, prefetched.
+
+The counterpart of the train loop's device side — the host side keeps
+the chip fed:
+
+- :class:`TokenSource` readers: an in-memory array, or a memory-mapped
+  token file (the flat uint16/int32 next-token-prediction corpus
+  layout), sliced into (batch, seq+0) windows deterministically by
+  step index, so every dp rank computes ITS slice of every global
+  batch without coordination (rank r takes rows [r·b/dp, (r+1)·b/dp)).
+- :func:`prefetch`: a double-buffered iterator that `device_put`s the
+  NEXT global batch (with its dp sharding) while the current step
+  computes — host→device transfer rides under the train step instead
+  of serializing after it.
+
+Everything is deterministic in (seed, step): resuming from a
+checkpoint's step counter reproduces the exact batch stream, which is
+what ties this to ckpt/ restart (no loader state to snapshot beyond
+the step).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from typing import Iterator, Optional
+
+import numpy as np
+
+__all__ = ["TokenSource", "ArraySource", "MemmapSource", "prefetch",
+           "batches"]
+
+
+class TokenSource:
+    """Deterministic (seed, step) → (batch, seq) int32 token windows."""
+
+    def batch(self, step: int, batch: int, seq: int) -> np.ndarray:
+        raise NotImplementedError
+
+
+class ArraySource(TokenSource):
+    """Windows over an in-memory 1-D token array (wraps around)."""
+
+    def __init__(self, tokens: np.ndarray, seed: int = 0):
+        self.tokens = np.ascontiguousarray(tokens.reshape(-1))
+        if self.tokens.size < 2:
+            raise ValueError("need at least 2 tokens")
+        self.seed = seed
+
+    def batch(self, step: int, batch: int, seq: int) -> np.ndarray:
+        n = self.tokens.size
+        rng = np.random.default_rng((self.seed, step))
+        starts = rng.integers(0, n, size=batch)
+        idx = (starts[:, None] + np.arange(seq)[None, :]) % n
+        return self.tokens[idx].astype(np.int32)
+
+
+class MemmapSource(ArraySource):
+    """Windows over a flat binary token file via np.memmap — the corpus
+    never loads into RAM; page cache serves the hot windows."""
+
+    def __init__(self, path: str, dtype=np.uint16, seed: int = 0):
+        size = os.path.getsize(path) // np.dtype(dtype).itemsize
+        mm = np.memmap(path, dtype=dtype, mode="r", shape=(size,))
+        # note: keep the memmap (no ascontiguousarray copy)
+        self.tokens = mm
+        self.seed = seed
+        if size < 2:
+            raise ValueError(f"{path}: too few tokens ({size})")
+
+
+def batches(source: TokenSource, batch: int, seq: int,
+            start_step: int = 0) -> Iterator[np.ndarray]:
+    """Endless deterministic batch stream from ``start_step``."""
+    step = start_step
+    while True:
+        yield source.batch(step, batch, seq)
+        step += 1
+
+
+def prefetch(it: Iterator[np.ndarray], mesh=None, spec=None,
+             depth: int = 2) -> Iterator:
+    """Double-buffered device prefetch.
+
+    A daemon thread pulls host batches from ``it`` and ``device_put``s
+    them (with ``NamedSharding(mesh, spec)`` when given — normally
+    ``P("dp", None)``), keeping up to ``depth`` batches in flight so
+    the H2D transfer of step k+1 overlaps step k's compute.  Yields
+    device arrays in order.
+    """
+    import jax
+
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+
+        sharding = NamedSharding(mesh, spec)
+    else:
+        sharding = None
+
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    _stop = object()
+
+    def worker() -> None:
+        try:
+            for host_batch in it:
+                dev = (jax.device_put(host_batch, sharding)
+                       if sharding is not None
+                       else jax.device_put(host_batch))
+                q.put(dev)
+        finally:
+            q.put(_stop)
+
+    t = threading.Thread(target=worker, daemon=True,
+                         name="ompi-tpu-prefetch")
+    t.start()
+
+    def gen():
+        while True:
+            item = q.get()
+            if item is _stop:
+                return
+            yield item
+
+    return gen()
+
+
+def train_stream(source: TokenSource, mesh, batch: int, seq: int,
+                 start_step: int = 0, depth: int = 2,
+                 spec: Optional[object] = None) -> Iterator:
+    """The one-call composition: deterministic batches → dp-sharded
+    device prefetch (resume by passing the checkpointed step)."""
+    from jax.sharding import PartitionSpec as P
+
+    return prefetch(batches(source, batch, seq, start_step), mesh,
+                    spec if spec is not None else P("dp", None),
+                    depth=depth)

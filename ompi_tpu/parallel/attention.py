@@ -28,18 +28,44 @@ __all__ = ["local_attention", "local_attention_lse", "ring_attention",
 _NEG = -1e30
 
 
-def _flash_wanted(impl: str, t_q: int, t_k: int) -> bool:
+def _flash_blocks(t_q: int, t_k: int) -> tuple[int, int]:
+    """Resolve the ops_flash_block_q/k tuning vars against this shape:
+    non-positive values and non-tiling combinations fall back (each side
+    independently) to the kernel's 128 default.  flash_tiles stays the
+    single source of the tiling rule."""
+    from ompi_tpu.core.config import var_registry
+    from ompi_tpu.ops.flash_attention import flash_tiles
+
+    bq = int(var_registry.get("ops_flash_block_q") or 128)
+    bk = int(var_registry.get("ops_flash_block_k") or 128)
+    if bq <= 0:
+        bq = 128
+    if bk <= 0:
+        bk = 128
+    if not flash_tiles(t_q, t_k, bq, bk):
+        if flash_tiles(t_q, t_k, bq, 128):
+            bk = 128
+        elif flash_tiles(t_q, t_k, 128, bk):
+            bq = 128
+        else:
+            bq = bk = 128
+    return bq, bk
+
+
+def _flash_wanted(impl: str, t_q: int, t_k: int,
+                  bq: int = 128, bk: int = 128) -> bool:
     """Route to the pallas kernel?  "auto" = yes on TPU when the shape
-    tiles (CPU test meshes keep the cheap jnp path — interpret-mode pallas
-    is orders of magnitude slower and tests cross-check both paths
-    explicitly); "flash" = required, raise if untileable."""
+    tiles AT THE RESOLVED BLOCK SIZES (CPU test meshes keep the cheap
+    jnp path — interpret-mode pallas is orders of magnitude slower and
+    tests cross-check both paths explicitly); "flash" = required, raise
+    if untileable."""
     import jax
 
     from ompi_tpu.ops.flash_attention import flash_tiles
 
     if impl == "jnp":
         return False
-    tiles = flash_tiles(t_q, t_k)
+    tiles = flash_tiles(t_q, t_k, bq, bk)
     if impl == "flash":
         if not tiles:
             raise ValueError("flash impl needs block-tiling shapes")
@@ -74,17 +100,10 @@ def local_attention_lse(q, k, v, causal: bool = True,
     import jax.numpy as jnp
 
     scale = scale if scale is not None else q.shape[-1] ** -0.5
-    if _flash_wanted(impl, q.shape[1], k.shape[1]):
-        from ompi_tpu.core.config import var_registry
+    bq, bk = _flash_blocks(q.shape[1], k.shape[1])
+    if _flash_wanted(impl, q.shape[1], k.shape[1], bq, bk):
         from ompi_tpu.ops.flash_attention import flash_attention_lse
 
-        # tuning knobs (ops_flash_block_q/k); fall back to the kernel's
-        # 128 defaults when a var doesn't tile this shape
-        bq = int(var_registry.get("ops_flash_block_q") or 128)
-        bk = int(var_registry.get("ops_flash_block_k") or 128)
-        if (q.shape[1] % min(bq, q.shape[1])
-                or k.shape[1] % min(bk, k.shape[1])):
-            bq = bk = 128
         return flash_attention_lse(q, k, v, causal=causal,
                                    q_offset=q_offset, k_offset=k_offset,
                                    scale=scale, block_q=bq, block_k=bk)

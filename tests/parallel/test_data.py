@@ -1,0 +1,72 @@
+"""Input pipeline (models/data.py): determinism, memmap windows,
+dp-sharded prefetch feeding a real train step."""
+
+import numpy as np
+
+from ompi_tpu.models import data as data_mod
+from ompi_tpu.models import transformer as tfm
+from ompi_tpu.parallel.mesh import make_mesh
+
+
+def test_array_source_deterministic_and_in_range():
+    toks = np.arange(1000, dtype=np.int32) % 97
+    src = data_mod.ArraySource(toks, seed=3)
+    a = src.batch(step=5, batch=4, seq=16)
+    b = src.batch(step=5, batch=4, seq=16)
+    c = src.batch(step=6, batch=4, seq=16)
+    np.testing.assert_array_equal(a, b)       # same (seed, step)
+    assert (a != c).any()                     # next step differs
+    assert a.shape == (4, 16) and a.dtype == np.int32
+    assert a.min() >= 0 and a.max() < 97
+
+
+def test_memmap_source_matches_array(tmp_path):
+    toks = (np.arange(5000) % 251).astype(np.uint16)
+    path = tmp_path / "corpus.bin"
+    toks.tofile(path)
+    mm = data_mod.MemmapSource(str(path), dtype=np.uint16, seed=1)
+    arr = data_mod.ArraySource(toks, seed=1)
+    np.testing.assert_array_equal(mm.batch(7, 3, 32), arr.batch(7, 3, 32))
+
+
+def test_prefetch_preserves_order_and_shards():
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh({"dp": 4, "sp": 1, "tp": 2})
+    toks = (np.arange(4096) % 128).astype(np.int32)
+    src = data_mod.ArraySource(toks, seed=0)
+    stream = data_mod.train_stream(src, mesh, batch=8, seq=32)
+    got = [next(stream) for _ in range(3)]
+    for step, dev in enumerate(got):
+        want = src.batch(step, 8, 32)
+        np.testing.assert_array_equal(np.asarray(dev), want)
+        # dp-sharded rows: each device holds batch/dp rows
+        assert dev.sharding.shard_shape(dev.shape)[0] == 2
+    assert isinstance(got[0], jax.Array)
+
+
+def test_stream_feeds_train_step():
+    mesh = make_mesh({"dp": 2, "sp": 2, "tp": 2})
+    cfg = tfm.TransformerConfig(
+        vocab=128, d_model=64, n_heads=4, n_layers=2, d_ff=128, seq=32,
+        attention="xla", compute_dtype="float32")
+    params = tfm.init_params(cfg)
+    step, init_opt = tfm.make_train_step(cfg, mesh, lr=1e-2)
+    opt_state = init_opt(params)
+    src = data_mod.ArraySource(
+        (np.arange(2048) % cfg.vocab).astype(np.int32))
+    stream = data_mod.train_stream(src, mesh, batch=4, seq=cfg.seq)
+    losses = []
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, next(stream))
+        losses.append(float(loss))
+    assert all(np.isfinite(losses)), losses
+
+
+def test_resume_reproduces_stream():
+    src = data_mod.ArraySource(np.arange(999, dtype=np.int32), seed=9)
+    first = list(zip(range(5), data_mod.batches(src, 2, 8)))
+    resumed = data_mod.batches(src, 2, 8, start_step=3)
+    np.testing.assert_array_equal(next(resumed), first[3][1])
+    np.testing.assert_array_equal(next(resumed), first[4][1])
