@@ -23,6 +23,9 @@ CASES = [
     ("oshmem_shmalloc.py", "shmalloc/shfree ok"),
     ("oshmem_circular_shift.py", "circular shift ok"),
     ("oshmem_symmetric_data.py", "verified symmetric data"),
+    ("mprobe_task_queue.py", "no duplicates, no losses"),
+    ("mpi4py_ring.py", "exiting"),
+    ("rma_pscw.py", "dynamic window ok"),
 ]
 
 
