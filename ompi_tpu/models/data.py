@@ -108,8 +108,11 @@ def prefetch(it: Iterator[np.ndarray], mesh=None, spec=None,
                        if sharding is not None
                        else jax.device_put(host_batch))
                 q.put(dev)
-        finally:
             q.put(_stop)
+        except BaseException as e:  # noqa: BLE001 — must reach consumer
+            # a swallowed source/transfer error would read as a clean
+            # end-of-stream; re-raise it on the consumer thread instead
+            q.put(e)
 
     t = threading.Thread(target=worker, daemon=True,
                          name="ompi-tpu-prefetch")
@@ -120,6 +123,8 @@ def prefetch(it: Iterator[np.ndarray], mesh=None, spec=None,
             item = q.get()
             if item is _stop:
                 return
+            if isinstance(item, BaseException):
+                raise item
             yield item
 
     return gen()

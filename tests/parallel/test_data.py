@@ -70,3 +70,17 @@ def test_resume_reproduces_stream():
     resumed = data_mod.batches(src, 2, 8, start_step=3)
     np.testing.assert_array_equal(next(resumed), first[3][1])
     np.testing.assert_array_equal(next(resumed), first[4][1])
+
+
+def test_prefetch_propagates_source_errors():
+    """A failing source must raise at the consumer, not end the stream."""
+    import pytest
+
+    def bad():
+        yield np.zeros((2, 4), np.int32)
+        raise RuntimeError("corpus went away")
+
+    stream = data_mod.prefetch(bad())
+    next(stream)
+    with pytest.raises(RuntimeError, match="corpus went away"):
+        next(stream)
