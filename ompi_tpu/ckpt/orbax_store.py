@@ -1,0 +1,62 @@
+"""Orbax interop: the ckpt layer's snapshot contract over
+orbax.checkpoint — for users whose existing JAX stacks already manage
+checkpoints with orbax (the ecosystem-standard store), while keeping
+this framework's sequence/commit semantics.
+
+Unlike :class:`~ompi_tpu.ckpt.store.SnapshotStore` (npz per rank) this
+saves one orbax checkpoint per snapshot sequence, preserving pytree
+structure and restoring arrays with their shardings when a mesh-aware
+``abstract_state`` is given (orbax restores straight to devices —
+sharded optimizer state from :mod:`ompi_tpu.parallel.zero` included).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+__all__ = ["OrbaxStore"]
+
+
+class OrbaxStore:
+    """Snapshot-sequence store backed by orbax.checkpoint."""
+
+    def __init__(self, base_dir: str, job: str = "job") -> None:
+        import orbax.checkpoint as ocp
+
+        self.base = os.path.join(os.path.abspath(base_dir), job)
+        os.makedirs(self.base, exist_ok=True)
+        self._ckptr = ocp.StandardCheckpointer()
+
+    def snapshot_dir(self, seq: int) -> str:
+        return os.path.join(self.base, f"snapshot_{seq}")
+
+    def save(self, seq: int, state: Any, force: bool = True) -> str:
+        """Write one snapshot (blocking; atomic via orbax's tmp+rename)."""
+        path = self.snapshot_dir(seq)
+        self._ckptr.save(path, state, force=force)
+        self._ckptr.wait_until_finished()
+        return path
+
+    def restore(self, seq: int,
+                abstract_state: Optional[Any] = None) -> Any:
+        """Read a snapshot.  With ``abstract_state`` (a pytree of
+        ``jax.ShapeDtypeStruct`` carrying shardings — build it with
+        ``jax.eval_shape`` + ``jax.tree.map`` over live arrays), leaves
+        restore directly onto devices with those shardings."""
+        return self._ckptr.restore(self.snapshot_dir(seq),
+                                   abstract_state)
+
+    def latest(self) -> Optional[int]:
+        """Highest committed snapshot sequence, or None."""
+        seqs = []
+        try:
+            for name in os.listdir(self.base):
+                if name.startswith("snapshot_"):
+                    try:
+                        seqs.append(int(name.split("_", 1)[1]))
+                    except ValueError:
+                        pass
+        except OSError:
+            return None
+        return max(seqs) if seqs else None
