@@ -58,6 +58,27 @@ tot = jax.jit(lambda a: a.sum(),
 expect = 4 * 128 * 2.0        # every element summed over the 2 dcn rows
 assert abs(float(np.asarray(tot)) - expect) < 1e-3, float(np.asarray(tot))
 print(f'rank {comm.rank}: dcn-shaped allreduce across slices ok')
+
+# quantized allreduce over the SAME slow boundary — qint8's actual use
+# case (~4x fewer DCN bytes); forced via the config var (the only path
+# a lossy algorithm may be selected through)
+var_registry.set('coll_xla_allreduce_algorithm', 'qint8')
+assert comp._decide('allreduce', None, dcn_comm, 1 << 20) == 'qint8'
+qfn = jax.jit(jax.shard_map(lambda s: dcn_comm.allreduce_qint8(s),
+                            mesh=mesh, in_specs=P('dcn'),
+                            out_specs=P('dcn'), check_vma=False))
+rngq = np.random.default_rng(0)
+xq = jax.device_put(rngq.normal(size=(8, 256)).astype(np.float32), sh)
+yq = np.asarray(jax.jit(lambda a: a, out_shardings=NamedSharding(
+    mesh, P()))(qfn(xq)))
+want = np.asarray(jax.jit(lambda a: a, out_shardings=NamedSharding(
+    mesh, P()))(xq))
+want = want.reshape(2, 4, 256).sum(axis=0)
+want = np.concatenate([want, want], axis=0)
+rel = np.linalg.norm(yq - want) / np.linalg.norm(want)
+assert rel < 0.02, rel
+var_registry.set('coll_xla_allreduce_algorithm', '')
+print(f'rank {comm.rank}: qint8 allreduce across dcn ok (rel {rel:.4f})')
 ompi_tpu.finalize()
 """
 
@@ -76,3 +97,4 @@ def test_dcn_axis_routing_across_sim_slices():
     assert r.returncode == 0, r.stderr + r.stdout
     assert "rank 0: dcn-shaped allreduce across slices ok" in r.stdout
     assert "rank 1: dcn-shaped allreduce across slices ok" in r.stdout
+    assert "rank 0: qint8 allreduce across dcn ok" in r.stdout
