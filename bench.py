@@ -954,9 +954,47 @@ def run_matrix(devices, backend: str) -> None:
 # ---------------------------------------------------------------------------
 
 
+_FLAGSHIP_BUDGET_S = int(os.environ.get(
+    "OMPI_TPU_BENCH_FLAGSHIP_BUDGET", "2100"))
+
+
+def _flagship_guarded(kind: str) -> dict:
+    """Run the flagship MFU in a SUBPROCESS with a wall budget: a
+    stalled remote compile (the round-3 killer) then costs the headline
+    row, not the whole bench — the final JSON line still prints, with
+    the stall recorded.  --flagship-child is the child entry."""
+    t0 = time.perf_counter()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--flagship-child", kind],
+            capture_output=True, text=True, timeout=_FLAGSHIP_BUDGET_S)
+        for line in (proc.stdout or "").splitlines():
+            if line.startswith("RESULT "):
+                return json.loads(line[len("RESULT "):])
+        return {"metric": "flagship transformer train-step MFU",
+                "value": 0.0, "unit": "% MFU", "vs_baseline": 0.0,
+                "error": f"flagship child rc={proc.returncode}",
+                "stderr_tail": _tail(proc.stderr, 600)}
+    except subprocess.TimeoutExpired as e:
+        return {"metric": "flagship transformer train-step MFU",
+                "value": 0.0, "unit": "% MFU", "vs_baseline": 0.0,
+                "error": (f"flagship timed out after "
+                          f"{_FLAGSHIP_BUDGET_S}s (compile stall)"),
+                "stderr_tail": _tail(e.stderr, 600),
+                "wall_s": round(time.perf_counter() - t0, 1)}
+
+
 def main() -> None:
     t_start = time.perf_counter()
     _enable_compile_cache()
+    if len(sys.argv) >= 2 and sys.argv[1] == "--flagship-child":
+        kind = sys.argv[2] if len(sys.argv) > 2 else "cpu"
+        if kind == "cpu":
+            _force_cpu(8)
+        rec = bench_flagship_mfu(kind)
+        print("RESULT " + json.dumps(rec), flush=True)
+        return
     probe, attempts = _probe_backend()
     if probe is None:
         _force_cpu(8)
@@ -974,7 +1012,7 @@ def main() -> None:
     if probe is not None and len(devices) >= 2:
         result = bench_allreduce_busbw(devices)
     else:
-        result = bench_flagship_mfu(kind)
+        result = _flagship_guarded(kind)
     result["backend"] = backend
     if probe is None:
         # fallback evidence: every probe attempt's outcome + stderr tail
