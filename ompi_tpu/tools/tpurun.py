@@ -39,6 +39,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "'ssh' spawns daemons over ssh (≈ plm/rsh)")
     p.add_argument("--hosts", type=int, default=2,
                    help="number of simulated hosts for --plm sim")
+    p.add_argument("--timeout", type=float, default=None, metavar="SECS",
+                   help="kill the job and exit nonzero after SECS "
+                        "seconds (mpirun --timeout; CI hang guard)")
     p.add_argument("--stdin", default=None, metavar="RANK|all|none",
                    help="forward launcher stdin to this rank (default 0)")
     # persistent DVM (≈ orte-dvm / orte-submit / orte-ps)
@@ -120,6 +123,39 @@ def main(argv: list[str] | None = None) -> int:
     cmd = args.command
     if cmd and cmd[0] == "--":
         cmd = cmd[1:]
+
+    if args.timeout:
+        import os as _os
+        import signal as _signal
+        import threading as _threading
+        import time as _time
+
+        # become our own process-group leader so the expiry kill hits
+        # exactly the launcher + its ranks, not the invoking shell/CI
+        # harness (trade-off: terminal ^C no longer fans out to the job
+        # group — acceptable for the CI hang-guard this flag exists for)
+        try:
+            _os.setpgrp()
+        except OSError:
+            pass
+
+        def _expire() -> None:
+            _time.sleep(args.timeout)
+            print(f"tpurun: job timed out after {args.timeout:g}s — "
+                  f"aborting (mpirun --timeout semantics)",
+                  file=sys.stderr, flush=True)
+            try:
+                # our process group holds the launcher and local ranks;
+                # daemon-tree members notice the HNP's death via their
+                # lifelines and tear down
+                _os.killpg(_os.getpgid(0), _signal.SIGTERM)
+            except OSError:
+                pass
+            _time.sleep(2.0)
+            _os._exit(124)
+
+        _threading.Thread(target=_expire, daemon=True,
+                          name="tpurun-timeout").start()
 
     # CLI --mca pairs get top precedence; framework-selection vars use the
     # bare framework name (e.g. --mca coll xla → synonym of coll_).  They are

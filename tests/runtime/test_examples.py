@@ -39,3 +39,26 @@ def test_example_runs_under_tpurun(script, marker):
     out = proc.stdout + proc.stderr
     assert proc.returncode == 0, out[-2000:]
     assert marker in out, out[-2000:]
+
+
+def test_timeout_flag_kills_hung_job():
+    """tpurun --timeout (mpirun parity): a hung job dies with a message
+    and nonzero status; an unexpired timeout doesn't disturb exit 0."""
+    import time
+
+    t0 = time.time()
+    r = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-np", "2",
+         "--timeout", "5", "--",
+         sys.executable, "-c", "import time; time.sleep(120)"],
+        capture_output=True, text=True, timeout=90)
+    assert r.returncode != 0
+    assert time.time() - t0 < 60
+    assert "timed out after 5" in r.stderr
+
+    ok = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-np", "2",
+         "--timeout", "120", "--",
+         sys.executable, "-c", "print('fast')"],
+        capture_output=True, text=True, timeout=90)
+    assert ok.returncode == 0, ok.stderr[-500:]
