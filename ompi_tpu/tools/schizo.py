@@ -91,6 +91,8 @@ def translate_mpirun(argv: list[str]) -> tuple[list[str], dict[str, str]]:
             out.append("--tag-output")
         elif a in ("--stdin", "-stdin"):
             out += ["--stdin", take_value(a)]
+        elif a in ("--timeout", "-timeout"):
+            out += ["--timeout", take_value(a)]
         elif a in _IGNORED_WITH_VALUE:
             take_value(a)
         elif a in _IGNORED_FLAGS:

@@ -73,12 +73,14 @@ def test_schizo_translates_mpirun_cli():
     targv, env = translate_mpirun(
         ["-np", "4", "--mca", "coll", "host", "-x", "FOO=bar",
          "--machinefile", "hf", "--map-by", "node", "--bind-to", "core",
+         "--timeout", "30",
          "--report-bindings", "./a.out", "arg1"])
     assert targv[:2] == ["-np", "4"]
     assert ["--mca", "coll", "host"] == targv[2:5]
     assert ["--hostfile", "hf"] == targv[5:7]
     assert ["--map-by", "bynode"] == targv[7:9]
-    assert targv[9:] == ["--", "./a.out", "arg1"]
+    assert ["--timeout", "30"] == targv[9:11]
+    assert targv[11:] == ["--", "./a.out", "arg1"]
     assert env == {"FOO": "bar"}
 
 
