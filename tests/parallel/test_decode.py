@@ -95,3 +95,23 @@ def test_moe_cached_decode_matches_full_forward():
 
     np.testing.assert_array_equal(
         got, _greedy_reference(fwd, params, prompt, max_new))
+
+
+def test_decode_odd_prompt_length():
+    """Prompt lengths need no special tiling — seq 7 prefill + decode."""
+    mesh = _mesh()
+    params = tfm.init_params(CFG)
+    fwd = __import__("jax").jit(tfm.make_forward(CFG, mesh))
+    prompt = np.random.default_rng(4).integers(
+        0, CFG.vocab, size=(4, 7)).astype(np.int32)
+    dec = make_decoder(CFG, mesh, max_new=3)
+    got = np.asarray(dec(params, prompt))
+    np.testing.assert_array_equal(
+        got, _greedy_reference(fwd, params, prompt, 3))
+
+
+def test_models_namespace_exports():
+    import ompi_tpu.models as m
+
+    assert m.TransformerConfig is tfm.TransformerConfig
+    assert callable(m.make_decoder) and callable(m.train_stream)
