@@ -9,7 +9,7 @@ mkdir -p /tmp/tpurecover
 cd /root/repo
 export JAX_COMPILATION_CACHE_DIR=/root/repo/.jax_cache
 while true; do
-  if timeout 180 python -c "
+  if timeout 420 python -c "
 import jax, numpy as np
 x = jax.jit(lambda a: a*2)(np.ones(8, np.float32))
 assert jax.devices()[0].platform == 'tpu'
@@ -28,5 +28,5 @@ print(float(x[0]))" >/tmp/tpurecover/probe.log 2>&1; then
     break
   fi
   echo "$(date -u +%FT%TZ) tpu down" >> /tmp/tpurecover/status
-  sleep 180
+  sleep 120
 done
