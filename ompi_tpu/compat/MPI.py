@@ -19,9 +19,13 @@ Request families (Wait*/Test*), Op including Op.Create, Datatype-as-
 numpy-dtype buffer specs ``[buf, count, MPI.DOUBLE]``, and the
 environment calls (Wtime, Get_processor_name, Init/Finalize).
 
-Out of scope here (use the native API, MIGRATION.md maps every call):
-RMA windows, MPI-IO, topologies, spawn — the native surface is richer
-than mpi4py's for those.
+RMA windows (``MPI.Win``: Create/Allocate, Put/Get/Accumulate/
+Get_accumulate/Fetch_and_op/Compare_and_swap, fence / lock / PSCW) and
+MPI-IO (``MPI.File``: explicit-offset, individual, collective, shared
+and ordered reads/writes over file views) are covered too.  Still out
+of scope (use the native API, MIGRATION.md maps every call):
+topologies and spawn — the native surface is richer than mpi4py's for
+those.
 
 Naming follows mpi4py exactly, hence the non-PEP8 method names.  The
 module references the reference's C API (``/root/reference/ompi/mpi/c``)
@@ -943,6 +947,325 @@ def _vspec(spec):
     if dtype is not None and buf.dtype != dtype.np_dtype:
         buf = buf.view(dtype.np_dtype)
     return buf, counts, displs, dtype
+
+
+
+
+# ---------------------------------------------------------------------------
+# Win (one-sided) / File (MPI-IO) facades
+# ---------------------------------------------------------------------------
+
+LOCK_EXCLUSIVE = 1
+LOCK_SHARED = 2
+
+# file amodes re-exported under mpi4py's names
+from ompi_tpu.mpi import io as _io_mod  # noqa: E402
+
+MODE_RDONLY = _io_mod.MODE_RDONLY
+MODE_RDWR = _io_mod.MODE_RDWR
+MODE_WRONLY = _io_mod.MODE_WRONLY
+MODE_CREATE = _io_mod.MODE_CREATE
+MODE_EXCL = _io_mod.MODE_EXCL
+MODE_APPEND = _io_mod.MODE_APPEND
+MODE_DELETE_ON_CLOSE = _io_mod.MODE_DELETE_ON_CLOSE
+SEEK_SET = _io_mod.SEEK_SET
+SEEK_CUR = _io_mod.SEEK_CUR
+SEEK_END = _io_mod.SEEK_END
+
+
+def _target_spec(target, origin_size: int, *, need: str):
+    """mpi4py target spec: None | disp | [disp, count(, datatype)] →
+    (disp, count); the explicit count must fit the origin buffer
+    (``need`` = "origin holds at least count" direction)."""
+    if target is None:
+        return 0, origin_size
+    if isinstance(target, (int, np.integer)):
+        return int(target), origin_size
+    seq = list(target)
+    disp = int(seq[0]) if seq else 0
+    count = origin_size
+    for extra in seq[1:]:
+        if isinstance(extra, (int, np.integer)):
+            count = int(extra)
+    if count > origin_size:
+        raise Exception(
+            f"target count {count} exceeds the {need} buffer size "
+            f"{origin_size}")
+    return disp, count
+
+
+class Win:
+    """mpi4py-style window over the native active-message osc window.
+
+    Displacements count WINDOW ELEMENTS (create with
+    ``disp_unit=memory.itemsize``, mpi4py's common idiom; byte
+    displacements with ``disp_unit=1`` are converted and must align)."""
+
+    def __init__(self, native, disp_unit: int) -> None:
+        self._w = native
+        self._du = disp_unit
+
+    # -- constructors ------------------------------------------------------
+    @classmethod
+    def Create(cls, memory, disp_unit: int = 1, info=None,
+               comm: "Comm" = None) -> "Win":
+        arr = np.asarray(memory)
+        from ompi_tpu.mpi.osc import Window as _NativeWin
+
+        if comm is None:
+            comm = COMM_SELF     # mpi4py's default
+        native = _NativeWin(comm._c, buffer=arr, info=info)
+        return cls(native, disp_unit)
+
+    @classmethod
+    def Allocate(cls, size: int, disp_unit: int = 1, info=None,
+                 comm: "Comm" = None) -> "Win":
+        arr = np.zeros(size, np.uint8)
+        return cls.Create(arr, disp_unit, info, comm)
+
+    def _disp(self, disp: int, itemsize: int) -> int:
+        nbytes = disp * self._du
+        if nbytes % itemsize:
+            raise Exception(  # noqa: B904 — MPI.Exception
+                f"target displacement {disp} (disp_unit {self._du}) is "
+                f"not aligned to the window element size {itemsize}")
+        return nbytes // itemsize
+
+    # -- data movement -----------------------------------------------------
+    def Put(self, origin, target_rank: int, target=None) -> None:
+        arr = _as_array(origin)
+        disp, count = _target_spec(target, arr.size, need="origin")
+        off = self._disp(disp, self._w.buf.itemsize)
+        self._w.put(target_rank, arr.reshape(-1)[:count], offset=off)
+
+    def Get(self, origin, target_rank: int, target=None) -> None:
+        dst = _as_array(origin)
+        disp, count = _target_spec(target, dst.size, need="receive")
+        off = self._disp(disp, self._w.buf.itemsize)
+        out = self._w.get(target_rank, count, offset=off)
+        _copy_into(origin, out)
+
+    def Accumulate(self, origin, target_rank: int, target=None,
+                   op: Op = SUM) -> None:
+        arr = _as_array(origin)
+        disp, count = _target_spec(target, arr.size, need="origin")
+        off = self._disp(disp, self._w.buf.itemsize)
+        self._w.accumulate(target_rank, arr.reshape(-1)[:count],
+                           op=_native_op(op), offset=off)
+
+    def Get_accumulate(self, origin, result, target_rank: int,
+                       target=None, op: Op = SUM) -> None:
+        arr = _as_array(origin)
+        disp, count = _target_spec(target, arr.size, need="origin")
+        off = self._disp(disp, self._w.buf.itemsize)
+        old = self._w.get_accumulate(target_rank,
+                                     arr.reshape(-1)[:count],
+                                     op=_native_op(op), offset=off)
+        _copy_into(result, old)
+
+    def Fetch_and_op(self, origin, result, target_rank: int,
+                     target_disp: int = 0, op: Op = SUM) -> None:
+        val = _as_array(origin).reshape(-1)[0]
+        off = self._disp(int(target_disp), self._w.buf.itemsize)
+        old = self._w.fetch_op(target_rank, val, op=_native_op(op),
+                               offset=off)
+        _copy_into(result, np.asarray(old).reshape(1))
+
+    def Compare_and_swap(self, origin, compare, result,
+                         target_rank: int, target_disp: int = 0) -> None:
+        val = _as_array(origin).reshape(-1)[0]
+        cmp_ = _as_array(compare).reshape(-1)[0]
+        off = self._disp(int(target_disp), self._w.buf.itemsize)
+        old = self._w.compare_swap(target_rank, cmp_, val, offset=off)
+        _copy_into(result, np.asarray(old).reshape(1))
+
+    # -- synchronization ---------------------------------------------------
+    def Fence(self, assertion: int = 0) -> None:
+        self._w.fence()
+
+    def Lock(self, rank: int, lock_type: int = LOCK_EXCLUSIVE,
+             assertion: int = 0) -> None:
+        self._w.lock(rank, exclusive=lock_type == LOCK_EXCLUSIVE)
+
+    def Unlock(self, rank: int) -> None:
+        self._w.unlock(rank)
+
+    def Lock_all(self, assertion: int = 0) -> None:
+        self._w.lock_all()
+
+    def Unlock_all(self) -> None:
+        self._w.unlock_all()
+
+    def Flush(self, rank: int) -> None:
+        self._w.flush(rank)
+
+    def Flush_all(self) -> None:
+        self._w.flush_all()
+
+    def _group_ranks(self, group: Group) -> list:
+        g = self._w.comm.group
+        out = []
+        for w in group._g._ranks:
+            r = g.rank_of(w)
+            if r is None or r < 0:
+                raise Exception(f"group rank {w} not in window comm")
+            out.append(r)
+        return out
+
+    def Start(self, group: Group, assertion: int = 0) -> None:
+        self._w.start(self._group_ranks(group))
+
+    def Complete(self) -> None:
+        self._w.complete()
+
+    def Post(self, group: Group, assertion: int = 0) -> None:
+        self._w.post(self._group_ranks(group))
+
+    def Wait(self) -> None:
+        self._w.wait()
+
+    def Free(self) -> None:
+        self._w.free()
+
+    @property
+    def memory(self):
+        return self._w.buf
+
+
+class File:
+    """mpi4py-style handle over the native MPI-IO file (fcoll/sharedfp
+    engines included)."""
+
+    def __init__(self, native) -> None:
+        self._f = native
+
+    @classmethod
+    def Open(cls, comm: "Comm", filename: str,
+             amode: int = MODE_RDONLY, info=None) -> "File":
+        return cls(_io_mod.File.open(comm._c, filename, amode,
+                                     info=info))
+
+    # -- views / pointers --------------------------------------------------
+    def Set_view(self, disp: int = 0, etype: Datatype = BYTE,
+                 filetype=None, datarep: str = "native",
+                 info=None) -> None:
+        from ompi_tpu.mpi.datatype import from_numpy as _from_np
+
+        native_et = (_from_np(etype.np_dtype)
+                     if isinstance(etype, Datatype) else etype)
+        if isinstance(filetype, Datatype):
+            # a scalar compat Datatype as the filetype = contiguous
+            # elements of that type (native derived types pass through
+            # for strided/vector views)
+            filetype = _from_np(filetype.np_dtype)
+        self._f.set_view(disp=disp, etype=native_et,
+                         filetype=filetype, datarep=datarep)
+
+    def Seek(self, offset: int, whence: int = SEEK_SET) -> None:
+        self._f.seek(offset, whence)
+
+    def Get_position(self) -> int:
+        return self._f.get_position()
+
+    # mpi4py semantics: the BUFFER's numpy dtype is the memory datatype;
+    # the view's etype only sets file offsets/units.  The native layer
+    # instead value-casts data to the etype, so the facade reinterprets
+    # bitwise both ways (a float64 buffer through the default BYTE view
+    # moves its raw bytes, not uint8-casted values).
+
+    def _etype_np(self):
+        return self._f.view.etype.base_np
+
+    def _to_file(self, buf) -> np.ndarray:
+        a = np.ascontiguousarray(_as_array(buf)).reshape(-1)
+        et = self._etype_np()
+        if a.dtype == et:
+            return a
+        if a.nbytes % et.itemsize:
+            raise Exception(
+                f"buffer of {a.nbytes} bytes is not a whole number of "
+                f"file etype elements ({et})")
+        return a.view(et)
+
+    def _count(self, buf) -> int:
+        dst = _as_array(buf)
+        et = self._etype_np()
+        if dst.nbytes % et.itemsize:
+            raise Exception(
+                f"receive buffer of {dst.nbytes} bytes is not a whole "
+                f"number of file etype elements ({et})")
+        return dst.nbytes // et.itemsize
+
+    def _land(self, buf, out) -> None:
+        dst = _as_array(buf)
+        raw = np.ascontiguousarray(np.asarray(out)).reshape(-1)
+        if raw.dtype != dst.dtype:
+            if raw.nbytes % dst.dtype.itemsize:
+                raise Exception(
+                    f"read of {raw.nbytes} bytes does not fill whole "
+                    f"{dst.dtype} elements")
+            raw = raw.view(dst.dtype)
+        _copy_into(buf, raw)
+
+    # -- explicit-offset / individual / shared / ordered -------------------
+    def Read_at(self, offset: int, buf) -> None:
+        self._land(buf, self._f.read_at(offset, self._count(buf)))
+
+    def Write_at(self, offset: int, buf) -> None:
+        self._f.write_at(offset, self._to_file(buf))
+
+    def Read_at_all(self, offset: int, buf) -> None:
+        self._land(buf, self._f.read_at_all(offset, self._count(buf)))
+
+    def Write_at_all(self, offset: int, buf) -> None:
+        self._f.write_at_all(offset, self._to_file(buf))
+
+    def Read(self, buf) -> None:
+        self._land(buf, self._f.read(self._count(buf)))
+
+    def Write(self, buf) -> None:
+        self._f.write(self._to_file(buf))
+
+    def Read_all(self, buf) -> None:
+        self._land(buf, self._f.read_all(self._count(buf)))
+
+    def Write_all(self, buf) -> None:
+        self._f.write_all(self._to_file(buf))
+
+    def Read_shared(self, buf) -> None:
+        self._land(buf, self._f.read_shared(self._count(buf)))
+
+    def Write_shared(self, buf) -> None:
+        self._f.write_shared(self._to_file(buf))
+
+    def Read_ordered(self, buf) -> None:
+        self._land(buf, self._f.read_ordered(self._count(buf)))
+
+    def Write_ordered(self, buf) -> None:
+        self._f.write_ordered(self._to_file(buf))
+
+    # -- management --------------------------------------------------------
+    def Sync(self) -> None:
+        self._f.sync()
+
+    def Preallocate(self, size: int) -> None:
+        self._f.preallocate(size)
+
+    def Get_size(self) -> int:
+        return self._f.get_size()
+
+    def Set_atomicity(self, flag: bool) -> None:
+        self._f.set_atomicity(bool(flag))
+
+    def Get_atomicity(self) -> bool:
+        return self._f.get_atomicity()
+
+    def Close(self) -> None:
+        self._f.close()
+
+    @staticmethod
+    def Delete(filename: str, info=None) -> None:
+        _io_mod.File.delete(filename)
 
 
 # ---------------------------------------------------------------------------

@@ -390,3 +390,116 @@ def test_iprobe_negative():
         return True
 
     assert all(run_ranks(2, wrap(fn)))
+
+
+def test_win_put_get_accumulate_fence():
+    def fn(comm):
+        rank, size = comm.rank, comm.size
+        mem = np.zeros(8, np.float64)
+        win = MPI.Win.Create(mem, disp_unit=mem.itemsize, comm=comm)
+        win.Fence()
+        # everyone puts its rank into slot `rank` of the right neighbor
+        right = (rank + 1) % size
+        win.Put(np.full(1, float(rank)), right, target=rank)
+        win.Fence()
+        left = (rank - 1) % size
+        assert mem[left] == float(left), mem
+        # accumulate into rank 0's slot 7
+        win.Accumulate(np.ones(1), 0, target=7, op=MPI.SUM)
+        win.Fence()
+        if rank == 0:
+            assert mem[7] == float(size), mem
+        got = np.zeros(1)
+        win.Lock(0, MPI.LOCK_SHARED)
+        win.Get(got, 0, target=7)
+        win.Unlock(0)
+        assert got[0] == float(size)
+        # fetch-and-op round
+        old = np.zeros(1)
+        win.Lock(0)
+        win.Fetch_and_op(np.ones(1), old, 0, target_disp=6, op=MPI.SUM)
+        win.Unlock(0)
+        win.Fence()
+        win.Free()
+        return True
+
+    assert all(run_ranks(3, wrap(fn)))
+
+
+def test_file_collective_and_shared(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("compatio") / "f.bin")
+
+    def fn(comm):
+        rank, size = comm.rank, comm.size
+        f = MPI.File.Open(comm, path, MPI.MODE_RDWR | MPI.MODE_CREATE)
+        data = np.full(4, float(rank), np.float64)
+        f.Write_at_all(rank * 4 * 8, data)
+        f.Close()
+        comm.Barrier()
+        f = MPI.File.Open(comm, path, MPI.MODE_RDONLY)
+        back = np.zeros(4, np.float64)
+        f.Read_at((((rank + 1) % size) * 4) * 8, back)
+        np.testing.assert_array_equal(
+            back, np.full(4, float((rank + 1) % size)))
+        assert f.Get_size() == size * 4 * 8
+        f.Close()
+        return True
+
+    assert all(run_ranks(3, wrap(fn)))
+
+
+def test_file_views_seek_shared_ordered(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("compatio2") / "v.bin")
+
+    def fn(comm):
+        rank, size = comm.rank, comm.size
+        f = MPI.File.Open(comm, path, MPI.MODE_RDWR | MPI.MODE_CREATE)
+        # mpi4py idiom: scalar etype + scalar filetype view
+        f.Set_view(disp=8 * rank, etype=MPI.DOUBLE, filetype=MPI.DOUBLE)
+        f.Write_at(0, np.full(2, float(rank)))   # offsets in DOUBLEs
+        f.Seek(0)
+        assert f.Get_position() == 0
+        back = np.zeros(2)
+        f.Read(back)
+        np.testing.assert_array_equal(back, np.full(2, float(rank)))
+        assert f.Get_position() == 2
+        f.Close()
+        comm.Barrier()
+
+        # ordered writes: rank order through the shared pointer
+        f = MPI.File.Open(comm, path, MPI.MODE_RDWR)
+        f.Write_ordered(np.full(2, 100.0 + rank))
+        f.Close()
+        comm.Barrier()
+        if rank == 0:
+            got = np.fromfile(path, np.float64)[:2 * size]
+            want = np.repeat(100.0 + np.arange(size), 2)
+            np.testing.assert_array_equal(got, want)
+        comm.Barrier()
+
+        # shared-pointer writes land without overlap
+        f = MPI.File.Open(comm, path, MPI.MODE_RDWR)
+        f.Write_shared(np.full(1, float(10 + rank)))
+        f.Sync()
+        f.Close()
+        return True
+
+    assert all(run_ranks(3, wrap(fn)))
+
+
+def test_win_count_validation():
+    def fn(comm):
+        mem = np.zeros(4, np.float64)
+        win = MPI.Win.Create(mem, disp_unit=8, comm=comm)
+        win.Fence()
+        try:
+            import pytest
+
+            with pytest.raises(MPI.Exception, match="count"):
+                win.Put(np.ones(2), 0, target=[0, 4])
+        finally:
+            win.Fence()
+            win.Free()
+        return True
+
+    assert all(run_ranks(2, wrap(fn)))
