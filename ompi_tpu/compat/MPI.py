@@ -522,6 +522,17 @@ class Comm:
 
     # -- management --------------------------------------------------------
 
+    def Create_cart(self, dims, periods=None,
+                    reorder: bool = False) -> "Cartcomm":
+        """≈ MPI_Cart_create (collective; None on excluded ranks).
+
+        mpi4py defaults periods to all-False — the native layer's
+        default is all-True (TPU torus), so the facade must pin it."""
+        if periods is None:
+            periods = [False] * len(list(dims))
+        new = self._c.cart_create(dims, periods=periods, reorder=reorder)
+        return Cartcomm(new) if new is not None else None
+
     def Dup(self) -> "Comm":
         return Comm(self._c.dup())
 
@@ -949,6 +960,67 @@ def _vspec(spec):
     return buf, counts, displs, dtype
 
 
+
+
+# ---------------------------------------------------------------------------
+# Cartesian topology facade
+# ---------------------------------------------------------------------------
+
+class Cartcomm(Comm):
+    """Communicator with a Cartesian topology (mpi4py surface over the
+    native topo framework — everything reads the attached CartTopology
+    at ``self._c.topo``; Sendrecv etc. inherit from Comm)."""
+
+    def Get_topo(self):
+        t = self._c.topo
+        return (list(t.dims), [bool(p) for p in t.periods],
+                t.coords(self._c.rank))
+
+    def Get_dim(self) -> int:
+        return self._c.topo.ndims
+
+    @property
+    def dims(self):
+        return list(self._c.topo.dims)
+
+    @property
+    def periods(self):
+        return [bool(p) for p in self._c.topo.periods]
+
+    @property
+    def coords(self):
+        return self._c.topo.coords(self._c.rank)
+
+    @property
+    def dim(self) -> int:
+        return self._c.topo.ndims
+
+    @property
+    def topo(self):
+        return self.Get_topo()
+
+    def Get_coords(self, rank: int):
+        return self._c.topo.coords(rank)
+
+    def Get_cart_rank(self, coords):
+        return self._c.topo.rank(coords)
+
+    def Shift(self, direction: int, disp: int = 1):
+        """→ (source, dest) with PROC_NULL at non-periodic edges."""
+        return self._c.topo.shift(self._c.rank, direction, disp)
+
+    def Sub(self, remain_dims) -> "Cartcomm":
+        sub = self._c.cart_sub(remain_dims)
+        return Cartcomm(sub) if sub is not None else None
+
+
+def Compute_dims(nnodes: int, dims) -> list:
+    """≈ mpi4py MPI.Compute_dims / MPI_Dims_create."""
+    from ompi_tpu.mpi.topo import dims_create
+
+    if isinstance(dims, int):
+        dims = [0] * dims
+    return dims_create(nnodes, len(dims), dims)
 
 
 # ---------------------------------------------------------------------------

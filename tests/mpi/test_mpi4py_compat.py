@@ -503,3 +503,57 @@ def test_win_count_validation():
         return True
 
     assert all(run_ranks(2, wrap(fn)))
+
+
+def test_cartcomm_create_shift_sub():
+    """mpi4py Cartesian topology surface: Create_cart, Get_topo,
+    Get_coords/Get_cart_rank inverses, Shift with PROC_NULL at edges,
+    Sub, Compute_dims."""
+    assert MPI.Compute_dims(6, 2) == [3, 2]
+
+    def fn(comm):
+        rank = comm.rank
+        cart = comm.Create_cart([2, 2], periods=[True, False])
+        assert cart is not None
+        dims, periods, coords = cart.Get_topo()
+        assert dims == [2, 2] and periods == [True, False]
+        assert cart.Get_dim() == 2
+        assert cart.Get_cart_rank(coords) == cart.Get_rank()
+        assert cart.Get_coords(cart.Get_rank()) == coords
+
+        # dim 0 periodic: both directions defined
+        src, dst = cart.Shift(0, 1)
+        assert src != MPI.PROC_NULL and dst != MPI.PROC_NULL
+        # dim 1 non-periodic: the edge sees PROC_NULL
+        src1, dst1 = cart.Shift(1, 1)
+        if coords[1] == 1:
+            assert dst1 == MPI.PROC_NULL
+        if coords[1] == 0:
+            assert src1 == MPI.PROC_NULL
+
+        # ring exchange along the periodic dim through the topology
+        s, d = cart.Shift(0, 1)
+        got = np.zeros(1, np.int64)
+        cart.Sendrecv(np.array([rank], np.int64), d, 0, got, s, 0)
+        row = cart.Sub([True, False])
+        assert row.Get_size() == 2
+        return True
+
+    assert all(run_ranks(4, wrap(fn)))
+
+
+def test_cart_default_periods_is_nonperiodic():
+    """mpi4py's Create_cart defaults periods to all-False (the native
+    layer's torus default must not leak through)."""
+    def fn(comm):
+        cart = comm.Create_cart([comm.size])
+        assert cart.periods == [False]
+        src, dst = cart.Shift(0, 1)
+        if cart.coords[0] == 0:
+            assert src == MPI.PROC_NULL
+        if cart.coords[0] == comm.size - 1:
+            assert dst == MPI.PROC_NULL
+        assert cart.dim == 1 and cart.dims == [comm.size]
+        return True
+
+    assert all(run_ranks(3, wrap(fn)))
