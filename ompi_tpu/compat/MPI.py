@@ -522,6 +522,22 @@ class Comm:
 
     # -- management --------------------------------------------------------
 
+    def Spawn(self, command: str, args=None, maxprocs: int = 1,
+              info=None, root: int = 0) -> "Intercomm":
+        """≈ MPI_Comm_spawn through the real launcher (root semantics:
+        every rank calls; the native layer launches from rank 0)."""
+        from ompi_tpu.mpi import dpm as _dpm
+
+        argv = [command] + list(args or [])
+        return Intercomm(_dpm.spawn(self._c, argv, maxprocs=maxprocs))
+
+    @staticmethod
+    def Get_parent() -> Optional["Intercomm"]:
+        from ompi_tpu.mpi import dpm as _dpm
+
+        native = _dpm.get_parent(COMM_WORLD._c)
+        return Intercomm(native) if native is not None else None
+
     def Create_cart(self, dims, periods=None,
                     reorder: bool = False) -> "Cartcomm":
         """≈ MPI_Cart_create (collective; None on excluded ranks).
@@ -960,6 +976,71 @@ def _vspec(spec):
     return buf, counts, displs, dtype
 
 
+
+
+# ---------------------------------------------------------------------------
+# Intercomm / spawn facade (dynamic process management)
+# ---------------------------------------------------------------------------
+
+class Intercomm:
+    """mpi4py-style intercommunicator over the native DPM intercomm:
+    p2p ranks address the REMOTE group; Merge folds both groups into
+    one intracommunicator."""
+
+    def __init__(self, native) -> None:
+        self._i = native
+
+    def Get_rank(self) -> int:
+        return self._i.rank
+
+    def Get_size(self) -> int:
+        return self._i.size
+
+    def Get_remote_size(self) -> int:
+        return self._i.remote_size
+
+    @property
+    def rank(self) -> int:
+        return self._i.rank
+
+    @property
+    def size(self) -> int:
+        return self._i.size
+
+    @property
+    def remote_size(self) -> int:
+        return self._i.remote_size
+
+    # -- buffer p2p against the remote group -------------------------------
+    def Send(self, buf, dest: int, tag: int = 0) -> None:
+        self._i.send(_as_array(buf), dest, tag)
+
+    def Recv(self, buf, source: int = 0, tag: int = ANY_TAG,
+             status: Optional[Status] = None) -> None:
+        st = _NativeStatus()
+        out = self._i.recv(source=source, tag=tag, status=st)
+        _fill_status(status, st)
+        _copy_into(buf, out)
+
+    # -- object p2p --------------------------------------------------------
+    def send(self, obj, dest: int, tag: int = 0) -> None:
+        self._i.send(_dumps(obj), dest, tag)
+
+    def recv(self, source: int = 0, tag: int = ANY_TAG,
+             status: Optional[Status] = None):
+        st = _NativeStatus()
+        out = self._i.recv(source=source, tag=tag, status=st)
+        _fill_status(status, st)
+        return _loads(out)
+
+    def Merge(self, high: bool = False) -> "Comm":
+        return Comm(self._i.merge(high=high))
+
+    def Disconnect(self) -> None:
+        self._i.disconnect()
+
+    def Free(self) -> None:
+        self.Disconnect()
 
 
 # ---------------------------------------------------------------------------

@@ -557,3 +557,44 @@ def test_cart_default_periods_is_nonperiodic():
         return True
 
     assert all(run_ranks(3, wrap(fn)))
+
+
+def test_spawn_get_parent_merge(tmp_path_factory):
+    """mpi4py DPM surface end to end through the real launcher: Spawn,
+    child-side Comm.Get_parent, pickled + buffer p2p over the
+    intercomm, Disconnect."""
+    import subprocess
+    import sys as _sys
+
+    tmp = tmp_path_factory.mktemp("compatspawn")
+    child = tmp / "child.py"
+    child.write_text(
+        "import numpy as np\n"
+        "from ompi_tpu.compat import MPI\n"
+        "parent = MPI.Comm.Get_parent()\n"
+        "assert parent is not None\n"
+        "obj = parent.recv(source=0, tag=7)\n"
+        "parent.send({'double': obj * 2}, dest=0, tag=8)\n"
+        "buf = np.zeros(2)\n"
+        "parent.Recv(buf, source=0, tag=9)\n"
+        "parent.Send(buf + 1.0, dest=0, tag=10)\n"
+        "parent.Disconnect()\n"
+        "MPI.Finalize()\n")
+
+    def fn(comm):
+        ic = comm.Spawn(_sys.executable, args=[str(child)], maxprocs=2)
+        assert ic.Get_remote_size() == 2
+        for r in range(2):
+            ic.send(10 + r, dest=r, tag=7)
+        got = sorted(ic.recv(source=r, tag=8)["double"] for r in range(2))
+        assert got == [20, 22]
+        for r in range(2):
+            ic.Send(np.array([1.5, 2.5]), r, tag=9)
+        back = np.zeros(2)
+        ic.Recv(back, source=0, tag=10)
+        np.testing.assert_array_equal(back, [2.5, 3.5])
+        ic.Recv(back, source=1, tag=10)
+        ic.Disconnect()
+        return True
+
+    assert all(run_ranks(1, wrap(fn)))
