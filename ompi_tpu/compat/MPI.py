@@ -20,12 +20,13 @@ numpy-dtype buffer specs ``[buf, count, MPI.DOUBLE]``, and the
 environment calls (Wtime, Get_processor_name, Init/Finalize).
 
 RMA windows (``MPI.Win``: Create/Allocate, Put/Get/Accumulate/
-Get_accumulate/Fetch_and_op/Compare_and_swap, fence / lock / PSCW) and
+Get_accumulate/Fetch_and_op/Compare_and_swap, fence / lock / PSCW),
 MPI-IO (``MPI.File``: explicit-offset, individual, collective, shared
-and ordered reads/writes over file views) are covered too.  Still out
-of scope (use the native API, MIGRATION.md maps every call):
-topologies and spawn — the native surface is richer than mpi4py's for
-those.
+and ordered reads/writes over file views), Cartesian topologies
+(``Comm.Create_cart`` → ``Cartcomm``, ``Compute_dims``) and dynamic
+processes (``Comm.Spawn`` / ``Comm.Get_parent`` / ``Intercomm``) are
+covered too.  Graph topologies remain native-API-only (the native
+surface is richer; MIGRATION.md maps every call).
 
 Naming follows mpi4py exactly, hence the non-PEP8 method names.  The
 module references the reference's C API (``/root/reference/ompi/mpi/c``)
