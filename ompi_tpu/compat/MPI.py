@@ -25,8 +25,8 @@ MPI-IO (``MPI.File``: explicit-offset, individual, collective, shared
 and ordered reads/writes over file views), Cartesian topologies
 (``Comm.Create_cart`` → ``Cartcomm``, ``Compute_dims``) and dynamic
 processes (``Comm.Spawn`` / ``Comm.Get_parent`` / ``Intercomm``) are
-covered too.  Graph topologies remain native-API-only (the native
-surface is richer; MIGRATION.md maps every call).
+covered too, as are graph topologies (``Comm.Create_graph`` →
+``Graphcomm``).  MIGRATION.md maps every remaining native-only call.
 
 Naming follows mpi4py exactly, hence the non-PEP8 method names.  The
 module references the reference's C API (``/root/reference/ompi/mpi/c``)
@@ -538,6 +538,12 @@ class Comm:
 
         native = _dpm.get_parent(COMM_WORLD._c)
         return Intercomm(native) if native is not None else None
+
+    def Create_graph(self, index, edges,
+                     reorder: bool = False) -> "Graphcomm":
+        """≈ MPI_Graph_create (collective; None on excluded ranks)."""
+        new = self._c.graph_create(index, edges, reorder=reorder)
+        return Graphcomm(new) if new is not None else None
 
     def Create_cart(self, dims, periods=None,
                     reorder: bool = False) -> "Cartcomm":
@@ -1094,6 +1100,35 @@ class Cartcomm(Comm):
     def Sub(self, remain_dims) -> "Cartcomm":
         sub = self._c.cart_sub(remain_dims)
         return Cartcomm(sub) if sub is not None else None
+
+
+class Graphcomm(Comm):
+    """Communicator with a general graph topology (mpi4py surface over
+    the native topo framework)."""
+
+    def Get_topo(self):
+        from ompi_tpu.mpi import topo as _topo
+
+        return _topo.graph_get(self._c)
+
+    def Get_dims(self):
+        from ompi_tpu.mpi import topo as _topo
+
+        return _topo.graphdims_get(self._c)
+
+    def Get_neighbors(self, rank: int):
+        return self._c.topo.neighbors_of(rank)
+
+    def Get_neighbors_count(self, rank: int) -> int:
+        return len(self._c.topo.neighbors_of(rank))
+
+    @property
+    def nnodes(self) -> int:
+        return self.Get_dims()[0]
+
+    @property
+    def nedges(self) -> int:
+        return self.Get_dims()[1]
 
 
 def Compute_dims(nnodes: int, dims) -> list:

@@ -598,3 +598,26 @@ def test_spawn_get_parent_merge(tmp_path_factory):
         return True
 
     assert all(run_ranks(1, wrap(fn)))
+
+
+def test_graphcomm_create_neighbors():
+    """mpi4py graph topology: ring graph, neighbors per rank."""
+    def fn(comm):
+        size = comm.size
+        # ring: each node connects to (r-1, r+1)
+        index, edges = [], []
+        for r in range(size):
+            edges += [(r - 1) % size, (r + 1) % size]
+            index.append(len(edges))
+        g = comm.Create_graph(index, edges)
+        assert g is not None
+        assert g.Get_dims() == (size, 2 * size)
+        me = g.Get_rank()
+        assert sorted(g.Get_neighbors(me)) == sorted(
+            [(me - 1) % size, (me + 1) % size])
+        assert g.Get_neighbors_count(me) == 2
+        gi, ge = g.Get_topo()
+        assert gi == index and ge == edges
+        return True
+
+    assert all(run_ranks(3, wrap(fn)))
