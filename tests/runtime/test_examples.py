@@ -26,6 +26,7 @@ CASES = [
     ("mprobe_task_queue.py", "no duplicates, no losses"),
     ("mpi4py_ring.py", "exiting"),
     ("rma_pscw.py", "dynamic window ok"),
+    ("mpi4py_cart_halo.py", "halo exchange ok"),
 ]
 
 
