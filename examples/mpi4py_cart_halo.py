@@ -23,12 +23,11 @@ def main() -> None:
 
     for direction in (0, 1):
         src, dst = cart.Shift(direction, 1)
+        recv_lo, recv_hi = np.zeros(n), np.zeros(n)
         if direction == 0:
             send_lo, send_hi = tile[1, 1:-1].copy(), tile[-2, 1:-1].copy()
-            recv_lo, recv_hi = np.zeros(n), np.zeros(n)
         else:
             send_lo, send_hi = tile[1:-1, 1].copy(), tile[1:-1, -2].copy()
-            recv_lo, recv_hi = np.zeros(n), np.zeros(n)
         # exchange both faces (periodic: neighbors always exist)
         cart.Sendrecv(send_hi, dst, 0, recv_lo, src, 0)
         cart.Sendrecv(send_lo, src, 1, recv_hi, dst, 1)
