@@ -35,6 +35,7 @@ _REGISTERING_MODULES = [
     "ompi_tpu.core.memchecker",
     "ompi_tpu.parallel.multihost",
     "ompi_tpu.shmem.api",
+    "ompi_tpu.ops.flash_attention",   # ops_flash_* kernel tuning vars
 ]
 
 
