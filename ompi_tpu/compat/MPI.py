@@ -706,11 +706,19 @@ class Comm:
         out = self._c.allreduce(send, op=_native_op(op))
         _copy_into(recvbuf, out)
 
+    @staticmethod
+    def _stacked(out):
+        """Uniform-count collectives return a stacked ndarray from the
+        native path — pass it straight through (uppercase = zero extra
+        copies); only a non-array per-rank list pays the concatenate."""
+        if isinstance(out, np.ndarray):
+            return out
+        return np.concatenate([np.asarray(p).reshape(-1) for p in out])
+
     def Gather(self, sendbuf, recvbuf, root: int = 0) -> None:
         out = self._c.gather(_as_array(sendbuf), root)
         if self._c.rank == root and recvbuf is not None:
-            _copy_into(recvbuf, np.concatenate(
-                [np.asarray(p).reshape(-1) for p in out]))
+            _copy_into(recvbuf, self._stacked(out))
 
     def Gatherv(self, sendbuf, recvbuf, root: int = 0) -> None:
         out = self._c.gatherv(_as_array(sendbuf), root)
@@ -719,8 +727,7 @@ class Comm:
 
     def Allgather(self, sendbuf, recvbuf) -> None:
         out = self._c.allgather(_as_array(sendbuf))
-        _copy_into(recvbuf, np.concatenate(
-            [np.asarray(p).reshape(-1) for p in out]))
+        _copy_into(recvbuf, self._stacked(out))
 
     def Allgatherv(self, sendbuf, recvbuf) -> None:
         out = self._c.allgatherv(_as_array(sendbuf))
@@ -748,8 +755,7 @@ class Comm:
     def Alltoall(self, sendbuf, recvbuf) -> None:
         arr = _as_array(sendbuf).reshape(self._c.size, -1)
         out = self._c.alltoall(arr)
-        _copy_into(recvbuf, np.concatenate(
-            [np.asarray(p).reshape(-1) for p in out]))
+        _copy_into(recvbuf, self._stacked(out))
 
     def Reduce_scatter_block(self, sendbuf, recvbuf, op: Op = SUM) -> None:
         out = self._c.reduce_scatter_block(_as_array(sendbuf),
@@ -1022,7 +1028,7 @@ class Intercomm:
     def Send(self, buf, dest: int, tag: int = 0) -> None:
         self._i.send(_as_array(buf), dest, tag)
 
-    def Recv(self, buf, source: int = 0, tag: int = ANY_TAG,
+    def Recv(self, buf, source: int = ANY_SOURCE, tag: int = ANY_TAG,
              status: Optional[Status] = None) -> None:
         st = _NativeStatus()
         out = self._i.recv(source=source, tag=tag, status=st)
@@ -1033,7 +1039,7 @@ class Intercomm:
     def send(self, obj, dest: int, tag: int = 0) -> None:
         self._i.send(_dumps(obj), dest, tag)
 
-    def recv(self, source: int = 0, tag: int = ANY_TAG,
+    def recv(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
              status: Optional[Status] = None):
         st = _NativeStatus()
         out = self._i.recv(source=source, tag=tag, status=st)
@@ -1221,17 +1227,47 @@ class Win:
         return nbytes // itemsize
 
     # -- data movement -----------------------------------------------------
+    def _reinterprets(self, operand_dtype) -> bool:
+        """True when an operand of this dtype crosses a byte
+        (``Win.Allocate``) window and must be handled bitwise — the ONE
+        place the reinterpretation rule lives."""
+        return (self._w.buf.dtype == np.uint8
+                and np.dtype(operand_dtype) != np.uint8)
+
+    def _wire(self, data: np.ndarray, what: str, op: Op = None):
+        """Origin data as the window's element type.
+
+        ``Win.Allocate`` windows are raw bytes (uint8); the mpi4py idiom
+        Puts/Gets TYPED buffers through them, which must be a bitwise
+        copy — a value-cast would wrap a float64 into 0..255.  Arithmetic
+        accumulate ops on reinterpreted bytes are meaningless, so those
+        raise instead of corrupting silently."""
+        if not self._reinterprets(data.dtype):
+            return data
+        if op is not None and op not in (REPLACE, NO_OP, BAND, BOR, BXOR):
+            raise Exception(
+                f"{what} with {op._name} on a byte (Win.Allocate) window "
+                f"requires a uint8 origin; arithmetic on reinterpreted "
+                f"bytes would corrupt — use Win.Create with a typed "
+                f"buffer instead")
+        return np.ascontiguousarray(data).view(np.uint8)
+
     def Put(self, origin, target_rank: int, target=None) -> None:
         arr = _as_array(origin)
         disp, count = _target_spec(target, arr.size, need="origin")
         off = self._disp(disp, self._w.buf.itemsize)
-        self._w.put(target_rank, arr.reshape(-1)[:count], offset=off)
+        self._w.put(target_rank,
+                    self._wire(arr.reshape(-1)[:count], "Put"), offset=off)
 
     def Get(self, origin, target_rank: int, target=None) -> None:
         dst = _as_array(origin)
         disp, count = _target_spec(target, dst.size, need="receive")
         off = self._disp(disp, self._w.buf.itemsize)
-        out = self._w.get(target_rank, count, offset=off)
+        if self._reinterprets(dst.dtype):
+            raw = self._w.get(target_rank, count * dst.itemsize, offset=off)
+            out = np.ascontiguousarray(raw).view(dst.dtype)
+        else:
+            out = self._w.get(target_rank, count, offset=off)
         _copy_into(origin, out)
 
     def Accumulate(self, origin, target_rank: int, target=None,
@@ -1239,7 +1275,9 @@ class Win:
         arr = _as_array(origin)
         disp, count = _target_spec(target, arr.size, need="origin")
         off = self._disp(disp, self._w.buf.itemsize)
-        self._w.accumulate(target_rank, arr.reshape(-1)[:count],
+        self._w.accumulate(target_rank,
+                           self._wire(arr.reshape(-1)[:count],
+                                      "Accumulate", op),
                            op=_native_op(op), offset=off)
 
     def Get_accumulate(self, origin, result, target_rank: int,
@@ -1247,14 +1285,29 @@ class Win:
         arr = _as_array(origin)
         disp, count = _target_spec(target, arr.size, need="origin")
         off = self._disp(disp, self._w.buf.itemsize)
-        old = self._w.get_accumulate(target_rank,
-                                     arr.reshape(-1)[:count],
+        data = self._wire(arr.reshape(-1)[:count], "Get_accumulate", op)
+        old = self._w.get_accumulate(target_rank, data,
                                      op=_native_op(op), offset=off)
+        if self._reinterprets(arr.dtype):
+            old = np.ascontiguousarray(old).view(arr.dtype)
         _copy_into(result, old)
+
+    def _scalar_guard(self, arr: np.ndarray, what: str,
+                      operand: str = "origin") -> None:
+        """Single-element atomics target ONE window element; on a byte
+        (Win.Allocate) window a typed operand cannot be reinterpreted
+        into one uint8 — refuse rather than value-cast into 0..255."""
+        if self._reinterprets(arr.dtype):
+            raise Exception(
+                f"{what} on a byte (Win.Allocate) window requires a "
+                f"uint8 {operand}: the target element is a single byte — "
+                f"use Win.Create over a typed buffer for typed atomics")
 
     def Fetch_and_op(self, origin, result, target_rank: int,
                      target_disp: int = 0, op: Op = SUM) -> None:
-        val = _as_array(origin).reshape(-1)[0]
+        arr = _as_array(origin)
+        self._scalar_guard(arr, "Fetch_and_op")
+        val = arr.reshape(-1)[0]
         off = self._disp(int(target_disp), self._w.buf.itemsize)
         old = self._w.fetch_op(target_rank, val, op=_native_op(op),
                                offset=off)
@@ -1262,10 +1315,14 @@ class Win:
 
     def Compare_and_swap(self, origin, compare, result,
                          target_rank: int, target_disp: int = 0) -> None:
-        val = _as_array(origin).reshape(-1)[0]
-        cmp_ = _as_array(compare).reshape(-1)[0]
+        val = _as_array(origin)
+        self._scalar_guard(val, "Compare_and_swap")
+        cmp_arr = _as_array(compare)
+        self._scalar_guard(cmp_arr, "Compare_and_swap", operand="compare")
+        cmp_ = cmp_arr.reshape(-1)[0]
         off = self._disp(int(target_disp), self._w.buf.itemsize)
-        old = self._w.compare_swap(target_rank, cmp_, val, offset=off)
+        old = self._w.compare_swap(target_rank, cmp_,
+                                   val.reshape(-1)[0], offset=off)
         _copy_into(result, np.asarray(old).reshape(1))
 
     # -- synchronization ---------------------------------------------------

@@ -100,6 +100,20 @@ def prefetch(it: Iterator[np.ndarray], mesh=None, spec=None,
 
     q: queue.Queue = queue.Queue(maxsize=depth)
     _stop = object()
+    closed = threading.Event()
+
+    def _put(item) -> bool:
+        # A consumer that abandons the stream early (break/exception)
+        # stops draining; a bare q.put would then block forever and pin
+        # up to ``depth`` device batches in HBM for the process lifetime.
+        # Poll against the closed flag so the worker exits instead.
+        while not closed.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def worker() -> None:
         try:
@@ -107,27 +121,61 @@ def prefetch(it: Iterator[np.ndarray], mesh=None, spec=None,
                 dev = (jax.device_put(host_batch, sharding)
                        if sharding is not None
                        else jax.device_put(host_batch))
-                q.put(dev)
-            q.put(_stop)
+                if not _put(dev):
+                    return
+            _put(_stop)
         except BaseException as e:  # noqa: BLE001 — must reach consumer
             # a swallowed source/transfer error would read as a clean
             # end-of-stream; re-raise it on the consumer thread instead
-            q.put(e)
+            _put(e)
 
     t = threading.Thread(target=worker, daemon=True,
                          name="ompi-tpu-prefetch")
     t.start()
 
-    def gen():
-        while True:
+    class _PrefetchIter:
+        """Iterator (not a generator): ``close`` must release the worker
+        even when called before the first ``next`` or via GC — a
+        generator's finally never runs if it was never started."""
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            if closed.is_set():
+                raise StopIteration
             item = q.get()
             if item is _stop:
-                return
+                self.close()
+                raise StopIteration
             if isinstance(item, BaseException):
+                self.close()
                 raise item
-            yield item
+            return item
 
-    return gen()
+        def close(self, _empty=queue.Empty) -> None:
+            # release the worker and drop any buffered device batches.
+            # queue.Empty is bound at definition time: __del__ may run at
+            # interpreter shutdown after module globals are cleared.
+            closed.set()
+
+            def drain() -> None:
+                try:
+                    while True:
+                        q.get_nowait()
+                except _empty:
+                    pass
+
+            drain()
+            # a worker mid-q.put slips one item past the first drain
+            # (the drain frees the slot its blocked put then fills);
+            # wait for it to observe `closed` and drain again
+            t.join(timeout=2.0)
+            drain()
+
+        __del__ = close
+
+    return _PrefetchIter()
 
 
 def train_stream(source: TokenSource, mesh, batch: int, seq: int,

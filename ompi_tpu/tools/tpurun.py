@@ -124,7 +124,11 @@ def main(argv: list[str] | None = None) -> int:
     if cmd and cmd[0] == "--":
         cmd = cmd[1:]
 
-    if args.timeout:
+    if args.timeout is not None:
+        if args.timeout <= 0:
+            print("tpurun: --timeout must be > 0 seconds "
+                  f"(got {args.timeout:g})", file=sys.stderr)
+            return 2
         import os as _os
         import signal as _signal
         import threading as _threading
@@ -139,8 +143,23 @@ def main(argv: list[str] | None = None) -> int:
         except OSError:
             pass
 
+        # The expiry killpg below hits our own process too; without a
+        # handler the launcher dies of that SIGTERM (status 143) before
+        # reaching _exit(124).  The handler shields exactly the expiry
+        # window — an external SIGTERM before expiry still terminates.
+        _expiring = _threading.Event()
+
+        def _on_term(signum, frame) -> None:
+            if _expiring.is_set():
+                return              # our own group-kill; _exit(124) follows
+            _signal.signal(_signal.SIGTERM, _signal.SIG_DFL)
+            _os.kill(_os.getpid(), _signal.SIGTERM)
+
+        _signal.signal(_signal.SIGTERM, _on_term)   # main thread only
+
         def _expire() -> None:
             _time.sleep(args.timeout)
+            _expiring.set()
             print(f"tpurun: job timed out after {args.timeout:g}s — "
                   f"aborting (mpirun --timeout semantics)",
                   file=sys.stderr, flush=True)

@@ -505,6 +505,95 @@ def test_win_count_validation():
     assert all(run_ranks(2, wrap(fn)))
 
 
+def test_uniform_collectives_skip_list_roundtrip():
+    """Uppercase Allgather/Gather/Alltoall take the stacked-ndarray fast
+    path: the native result lands in the recv buffer without a per-rank
+    python list + concatenate round-trip (mpi4py users' expectation that
+    uppercase = zero-copy)."""
+    # structural guarantee: an ndarray passes through _stacked by identity
+    arr = np.arange(12.0).reshape(3, 4)
+    assert MPI.Comm._stacked(arr) is arr
+    # list fallback still concatenates (intercomm/object paths)
+    out = MPI.Comm._stacked([np.ones(2), np.zeros(2)])
+    np.testing.assert_array_equal(out, [1, 1, 0, 0])
+
+    # and the native collectives really do hand the facade an ndarray
+    def fn(comm):
+        got = np.zeros(comm.size * 4, np.float64)
+        comm.Allgather(np.full(4, float(comm.rank)), got)
+        want = np.repeat(np.arange(comm.size, dtype=np.float64), 4)
+        np.testing.assert_array_equal(got, want)
+        a2a = np.zeros(comm.size * 2, np.float64)
+        comm.Alltoall(np.repeat(np.arange(comm.size, dtype=np.float64), 2),
+                      a2a)
+        np.testing.assert_array_equal(a2a, np.full(comm.size * 2,
+                                                   float(comm.rank)))
+        return True
+
+    assert all(run_ranks(4, wrap(fn)))
+
+
+def test_win_allocate_typed_roundtrip():
+    """The standard mpi4py idiom: Win.Allocate(nbytes) + Put/Get of TYPED
+    buffers must be a bitwise copy, not a value-cast into 0..255."""
+    def fn(comm):
+        rank = comm.rank
+        win = MPI.Win.Allocate(8 * 8, disp_unit=8, comm=comm)
+        win.Fence()
+        vals = np.array([3.25e9, -1.5, 0.125], np.float64)
+        if rank == 0:
+            win.Put(vals, 1, target=2)       # disp 2 doubles into rank 1
+        win.Fence()
+        if rank == 1:
+            mem = np.asarray(win.memory).view(np.float64)
+            np.testing.assert_array_equal(mem[2:5], vals)
+        # typed Get reads the bytes back as float64
+        got = np.zeros(3, np.float64)
+        win.Lock(1, MPI.LOCK_SHARED)
+        win.Get(got, 1, target=2)
+        win.Unlock(1)
+        np.testing.assert_array_equal(got, vals)
+        # REPLACE accumulate is a bitwise put; arithmetic ops must refuse
+        win.Fence()
+        if rank == 0:
+            win.Accumulate(vals * 2, 1, target=2, op=MPI.REPLACE)
+            import pytest
+
+            with pytest.raises(MPI.Exception, match="uint8 origin"):
+                win.Accumulate(vals, 1, target=2, op=MPI.SUM)
+        win.Fence()
+        if rank == 1:
+            mem = np.asarray(win.memory).view(np.float64)
+            np.testing.assert_array_equal(mem[2:5], vals * 2)
+        # Get_accumulate with REPLACE: old typed value comes back
+        old = np.zeros(3, np.float64)
+        if rank == 0:
+            win.Lock(1)
+            win.Get_accumulate(vals, old, 1, target=2, op=MPI.REPLACE)
+            win.Unlock(1)
+            np.testing.assert_array_equal(old, vals * 2)
+        # single-element atomics can't reinterpret a typed operand into
+        # one byte — they refuse instead of value-casting
+        if rank == 0:
+            import pytest
+
+            res = np.zeros(1)
+            with pytest.raises(MPI.Exception, match="uint8 origin"):
+                win.Fetch_and_op(np.array([3.25e9]), res, 1, 0, op=MPI.SUM)
+            with pytest.raises(MPI.Exception, match="uint8 origin"):
+                win.Compare_and_swap(np.array([1.5]), np.zeros(1), res, 1)
+            # uint8 operands still work
+            win.Lock(1)
+            win.Fetch_and_op(np.array([2], np.uint8),
+                             np.zeros(1, np.uint8), 1, 0, op=MPI.SUM)
+            win.Unlock(1)
+        win.Fence()
+        win.Free()
+        return True
+
+    assert all(run_ranks(2, wrap(fn)))
+
+
 def test_cartcomm_create_shift_sub():
     """mpi4py Cartesian topology surface: Create_cart, Get_topo,
     Get_coords/Get_cart_rank inverses, Shift with PROC_NULL at edges,
@@ -578,6 +667,9 @@ def test_spawn_get_parent_merge(tmp_path_factory):
         "buf = np.zeros(2)\n"
         "parent.Recv(buf, source=0, tag=9)\n"
         "parent.Send(buf + 1.0, dest=0, tag=10)\n"
+        "import os\n"
+        "if int(os.environ['OMPI_TPU_RANK']) == 1:\n"
+        "    parent.send('from-one', dest=0, tag=11)\n"
         "parent.Disconnect()\n"
         "MPI.Finalize()\n")
 
@@ -594,6 +686,11 @@ def test_spawn_get_parent_merge(tmp_path_factory):
         ic.Recv(back, source=0, tag=10)
         np.testing.assert_array_equal(back, [2.5, 3.5])
         ic.Recv(back, source=1, tag=10)
+        # mpi4py default source is ANY_SOURCE: a message from a NONZERO
+        # remote rank must match a default-args recv
+        st = MPI.Status()
+        msg = ic.recv(tag=11, status=st)
+        assert msg == "from-one" and st.Get_source() == 1
         ic.Disconnect()
         return True
 

@@ -84,3 +84,58 @@ def test_prefetch_propagates_source_errors():
     next(stream)
     with pytest.raises(RuntimeError, match="corpus went away"):
         next(stream)
+
+
+def test_prefetch_releases_worker_on_early_abandon():
+    """A consumer that breaks out early must not leave the worker thread
+    blocked on a full queue (it would pin `depth` device batches in HBM
+    for the process lifetime)."""
+    import threading
+    import time
+
+    produced = []
+
+    def endless():
+        i = 0
+        while True:
+            produced.append(i)
+            yield np.full((2, 4), i, np.int32)
+            i += 1
+
+    before = threading.active_count()
+    stream = data_mod.prefetch(endless(), depth=2)
+    next(stream)
+    stream.close()          # abandon with batches still queued
+    deadline = time.time() + 5.0
+    while threading.active_count() > before and time.time() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before, (
+        "prefetch worker still alive after consumer closed the stream")
+    # and the worker stopped producing (no unbounded growth after close)
+    n = len(produced)
+    time.sleep(0.3)
+    assert len(produced) == n
+
+
+def test_prefetch_close_before_first_next_releases_worker():
+    """close() before any next() must still release the worker — a plain
+    generator's finally never runs if the generator was never started."""
+    import threading
+    import time
+
+    def endless():
+        while True:
+            yield np.zeros((2, 4), np.int32)
+
+    before = threading.active_count()
+    stream = data_mod.prefetch(endless(), depth=2)
+    stream.close()                      # never consumed
+    deadline = time.time() + 5.0
+    while threading.active_count() > before and time.time() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before
+    # closed stream reads as exhausted, not a hang
+    import pytest
+
+    with pytest.raises(StopIteration):
+        next(stream)

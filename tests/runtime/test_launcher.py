@@ -149,3 +149,20 @@ def test_stdin_all_duplicates():
     assert r.returncode == 0, r.stderr
     assert "rank 0 got 'x\\n'" in r.stdout
     assert "rank 1 got 'x\\n'" in r.stdout
+
+
+def test_timeout_expiry_exits_124():
+    """mpirun --timeout semantics: expiry kills the job group and the
+    launcher itself exits 124 (not 143 from its own group-kill)."""
+    r = tpurun("--timeout", "1", "-np", "2", "--", sys.executable, "-c",
+               "import time; time.sleep(60)", timeout=30)
+    assert r.returncode == 124, (r.returncode, r.stderr)
+    assert "timed out after 1s" in r.stderr
+
+
+def test_timeout_zero_rejected():
+    r = tpurun("--timeout", "0", "-np", "1", "--", sys.executable, "-c",
+               "print('should not run')")
+    assert r.returncode == 2
+    assert "must be > 0" in r.stderr
+    assert "should not run" not in r.stdout

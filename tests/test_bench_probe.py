@@ -1,0 +1,77 @@
+"""The bench backend probe's outage-recovery window.
+
+Round-4 failure mode: the escalating probe budgets total ~9 minutes but
+observed tunnel outages last hours, so the end-of-round bench fell back
+to CPU twice running.  ``bench._probe_backend`` now keeps probing with
+long budgets over a bounded window (``OMPI_TPU_BENCH_RECOVERY_WINDOW``)
+before giving up; these tests drive that loop with a patched
+``_probe_once`` so no real backend is touched.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import bench  # noqa: E402
+
+
+def _fail(n, budget):
+    return {"attempt": n, "budget_s": budget, "outcome": "timeout"}
+
+
+def test_recovery_window_retries_until_success(monkeypatch):
+    calls = []
+
+    def fake_probe(n, budget):
+        calls.append(budget)
+        if len(calls) < 5:  # 3 escalating + 1 recovery failure
+            return _fail(n, budget)
+        return {"attempt": n, "budget_s": budget, "outcome": "ok",
+                "probe": {"n": 1, "platform": "tpu", "kind": "v5 lite"}}
+
+    monkeypatch.setattr(bench, "_probe_once", fake_probe)
+    monkeypatch.setattr(bench, "_PROBE_PAUSE_S", 0)
+    monkeypatch.setattr(bench, "_RECOVERY_WINDOW_S", 60)
+    monkeypatch.setattr(bench, "_RECOVERY_PAUSE_S", 0)
+
+    probe, attempts = bench._probe_backend()
+    assert probe == {"n": 1, "platform": "tpu", "kind": "v5 lite"}
+    assert len(attempts) == 5
+    # the recovery attempts are distinguishable in the JSON record
+    assert attempts[3]["recovery_window"] is True
+    assert attempts[4]["recovery_window"] is True
+    assert "probe" not in attempts[4]  # popped, not duplicated
+
+
+def test_recovery_window_bounded(monkeypatch):
+    """With the window disabled, only the escalating attempts run."""
+    calls = []
+
+    def fake_probe(n, budget):
+        calls.append(n)
+        return _fail(n, budget)
+
+    monkeypatch.setattr(bench, "_probe_once", fake_probe)
+    monkeypatch.setattr(bench, "_PROBE_PAUSE_S", 0)
+    monkeypatch.setattr(bench, "_RECOVERY_WINDOW_S", 0)
+
+    probe, attempts = bench._probe_backend()
+    assert probe is None
+    assert len(attempts) == len(bench._PROBE_BUDGETS_S)
+
+
+def test_recovery_window_expires(monkeypatch):
+    """A dead tunnel exhausts the window and the record proves it."""
+    monkeypatch.setattr(bench, "_probe_once", _fail)
+    monkeypatch.setattr(bench, "_PROBE_PAUSE_S", 0)
+    # tiny window: monotonic moves past the deadline after the first
+    # recovery probe because pause > remaining
+    monkeypatch.setattr(bench, "_RECOVERY_WINDOW_S", 1)
+    monkeypatch.setattr(bench, "_RECOVERY_PAUSE_S", 3600)
+
+    probe, attempts = bench._probe_backend()
+    assert probe is None
+    recovery = [a for a in attempts if a.get("recovery_window")]
+    assert recovery, "window should have produced at least one probe"
+    assert all(a["outcome"] != "ok" for a in attempts)
