@@ -360,7 +360,8 @@ def _loop_iters(devices) -> tuple[int, int]:
 
 _ONE_CHIP_NOTE = ("single device — the collective degenerates to identity; "
                   "busbw is defined over ICI (needs >=2 chips), this row "
-                  "times dispatch only")
+                  "times dispatch only; the hbm_copy row carries the "
+                  "honest single-chip memory-bandwidth record")
 
 
 # Any device-path row below this on real TPU measures overhead, not the
@@ -622,6 +623,35 @@ def matrix_mesh_bcast_allgather(devices) -> dict:
     if suspect:
         row["suspect"] = suspect
     return row
+
+
+def matrix_hbm_copy(devices) -> dict:
+    """HBM-bandwidth calibration (the memory-side twin of matmul_peak's
+    MXU row): slope-timed read+write sweep of one device's HBM.  This is
+    the sanity floor for every bandwidth row — a single-chip self-put or
+    degenerate collective can never beat it, and on one chip it is the
+    honest 'what the memory system can do' record the n=1 matrix rows
+    point at instead of timing dispatch."""
+    import jax
+
+    n_elems = (1 << 26) if devices[0].platform == "tpu" else (1 << 22)
+    x = jax.device_put(np.ones((n_elems,), np.float32), devices[0])
+    nbytes = x.nbytes
+
+    def make(iters):
+        return jax.jit(lambda a: jax.lax.fori_loop(
+            0, iters, lambda i, y: y + np.float32(1.0), a))
+
+    lo, hi = (8, 72) if devices[0].platform == "tpu" else (2, 10)
+    dt, extra = _slope_or_bound(make, x, lo, hi)
+    # each iteration reads the buffer and writes it back
+    gbps = 2 * nbytes / dt / 2**30
+    return {
+        "metric": f"HBM read+write bandwidth ({nbytes >> 20}MiB fp32, "
+                  f"1 device)",
+        "value": round(gbps, 2), "unit": "GiB/s", "vs_baseline": 1.0,
+        "per_iter_ms": round(dt * 1e3, 3), **extra,
+    }
 
 
 def matrix_grad_reduce_scatter(devices) -> dict:
@@ -961,6 +991,7 @@ def run_matrix(devices, backend: str) -> None:
             ("ring_latency", matrix_ring_latency),
             ("shm_pingpong", matrix_shm_pingpong),
             ("shm_msgrate", matrix_shm_msgrate),
+            ("hbm_copy", lambda: matrix_hbm_copy(devices)),
             ("allreduce_sweep", lambda: matrix_allreduce_sweep(devices)),
             ("mesh_bcast_allgather",
              lambda: matrix_mesh_bcast_allgather(devices)),

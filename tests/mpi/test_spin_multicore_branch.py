@@ -1,0 +1,113 @@
+"""Force the >2-core receiver-pull/poller spin branches and soak them
+over REAL processes (VERDICT r4 #4: those branches were tuned blind on a
+1-core box).
+
+On one core the spin branches meet their WORST schedule — every spin
+iteration steals the quantum the sender process needs — so this is a
+liveness stress, not a performance number: the loops must still yield /
+back off enough for the frames to arrive, with zero loss or reordering.
+The expected multi-core performance is documented in COVERAGE.md (the
+branches exist to beat the futex handoff when the sender owns its own
+core, the vader fast-box model —
+opal/mca/btl/vader/btl_vader_component.c:61-69).
+
+In-process harness ranks ride the proc fast lane (no shm rings), so the
+receiver-pull spin only truly engages between processes — hence the
+fork rig (same shape as test_native_match.test_shm_two_process_roundtrip).
+"""
+
+import multiprocessing as mp
+
+import numpy as np
+import pytest
+
+from ompi_tpu.core.config import var_registry
+from ompi_tpu.mpi import btl_shm as btl_shm_mod
+from ompi_tpu.mpi import pml as pml_mod
+from ompi_tpu.mpi.comm import Communicator
+from ompi_tpu.mpi.group import Group
+from ompi_tpu.mpi.pml import PmlOb1
+
+N_ROUNDS = 40
+
+
+_REAL_CPU_COUNT = btl_shm_mod.os.cpu_count   # the stdlib function object
+
+
+def _force_multicore() -> None:
+    """Flip both spin-style switches to their >2-core settings.  Called
+    in parent AND (via fork inheritance) child before PML construction."""
+    pml_mod._SMALL_HOST = False                  # rare-yield pull spin
+    btl_shm_mod.os.cpu_count = lambda: 8         # poller spin window
+    var_registry.set("btl_shm_spin", 256)
+
+
+@pytest.fixture
+def forced_spin():
+    old_spin = var_registry.get("btl_shm_spin")
+    old_small = pml_mod._SMALL_HOST
+    _force_multicore()
+    yield
+    # btl_shm_mod.os IS the stdlib os module — restore the saved
+    # function object, not a recomputation through the patched one
+    btl_shm_mod.os.cpu_count = _REAL_CPU_COUNT
+    pml_mod._SMALL_HOST = old_small
+    var_registry.set("btl_shm_spin", old_spin)
+
+
+def test_two_process_soak_under_forced_spin(forced_spin):
+    """Mixed eager + rendezvous ping-pong between two real processes with
+    the multi-core spin style forced on one core: bounded time, payload
+    integrity, and the receiver-pull loop must actually ENGAGE (non-empty
+    shm reader list observed during a blocked recv)."""
+    sizes = [16, 1 << 12, 1 << 15, 1 << 17]      # eager → rendezvous
+
+    def child(c2p, p2c):
+        _force_multicore()                        # fork re-runs nothing;
+        # inherited state already forced, but be explicit for clarity
+        pml = PmlOb1(1)
+        c2p.put(pml.address)
+        pml.set_peers(p2c.get())
+        comm = Communicator(Group(range(2)), cid=0, pml=pml,
+                            my_world_rank=1)
+        for i in range(N_ROUNDS):
+            n = sizes[i % len(sizes)]
+            got = comm.recv(source=0, tag=1)
+            assert got.size == n and int(got[0]) == i
+            comm.send(np.full(n, i + 1, np.int64), dest=0, tag=2)
+        pml.close()
+
+    engaged = {"n": 0}
+    orig = PmlOb1._progress_wait
+
+    def spy(self, req):
+        shm = self.endpoint.shm_btl
+        if shm is not None and shm.reader_list():
+            engaged["n"] += 1
+        return orig(self, req)
+
+    PmlOb1._progress_wait = spy
+    ctx = mp.get_context("fork")
+    c2p, p2c = ctx.Queue(), ctx.Queue()
+    proc = ctx.Process(target=child, args=(c2p, p2c), daemon=True)
+    proc.start()
+    pml = PmlOb1(0)
+    try:
+        peers = {0: pml.address, 1: c2p.get(timeout=30)}
+        p2c.put(peers)
+        pml.set_peers(peers)
+        comm = Communicator(Group(range(2)), cid=0, pml=pml,
+                            my_world_rank=0)
+        for i in range(N_ROUNDS):
+            n = sizes[i % len(sizes)]
+            comm.send(np.full(n, i, np.int64), dest=1, tag=1)
+            back = comm.recv(source=1, tag=2)
+            assert back.size == n and int(back[0]) == i + 1
+        proc.join(timeout=30)
+        assert proc.exitcode == 0
+    finally:
+        PmlOb1._progress_wait = orig
+        pml.close()
+    # the branch under test must have run, not been skipped: once the
+    # child's rings exist, blocked recvs enter the pull-spin loop
+    assert engaged["n"] > 0, "receiver-pull spin never engaged"

@@ -42,6 +42,20 @@ def test_example_runs_under_tpurun(script, marker):
     assert marker in out, out[-2000:]
 
 
+def test_facade_collectives_bench_runs():
+    """The facade-overhead microbench (examples/facade_collectives_bench)
+    completes and prints per-collective ratios; the ratio VALUES are
+    advisory on a 1-core box, so only the structure is asserted."""
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "examples", "facade_collectives_bench.py")],
+        capture_output=True, text=True, timeout=400, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    for coll in ("allreduce", "allgather", "bcast"):
+        assert coll in proc.stdout
+    assert "ratio" in proc.stdout
+
+
 def test_timeout_flag_kills_hung_job():
     """tpurun --timeout (mpirun parity): a hung job dies with a message
     and nonzero status; an unexpired timeout doesn't disturb exit 0."""
