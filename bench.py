@@ -340,15 +340,24 @@ def _loop_maker(kernel, mesh, in_specs, out_specs):
     return make
 
 
-def _slope_or_bound(make_fn, x, lo: int, hi: int):
-    """(per-iter seconds, extra-row-fields) — slope when clean, else the
-    t_hi/hi upper bound with a ``suspect`` note."""
-    dt, t_lo, t_hi = _slope_time(make_fn, x, lo, hi)
+def _slope_fields(t_lo: float, t_hi: float, lo: int, hi: int):
+    """The shared slope-or-bound POLICY: per-iter seconds + row fields
+    from two wall times.  Collapse threshold and the suspect contract
+    live here only — both the loop-carry rows (via _slope_or_bound) and
+    rows with other call signatures (decode) decide through this."""
     extra = {"wall_lo_s": round(t_lo, 3), "wall_hi_s": round(t_hi, 3)}
-    if dt is None:
+    dt = (t_hi - t_lo) / (hi - lo)
+    if dt <= 0 or (t_hi - t_lo) < 0.02 * t_lo:
         extra["suspect"] = _SLOPE_COLLAPSED
         return t_hi / hi, extra
     return dt, extra
+
+
+def _slope_or_bound(make_fn, x, lo: int, hi: int):
+    """(per-iter seconds, extra-row-fields) — slope when clean, else the
+    t_hi/hi upper bound with a ``suspect`` note."""
+    _dt, t_lo, t_hi = _slope_time(make_fn, x, lo, hi)
+    return _slope_fields(t_lo, t_hi, lo, hi)
 
 
 def _loop_iters(devices) -> tuple[int, int]:
@@ -917,6 +926,65 @@ def matrix_remote_dma(devices) -> dict:
     }
 
 
+def matrix_decode_throughput(devices) -> dict:
+    """Inference headline: greedy KV-cache decode tokens/s on one chip.
+
+    Two decoders compiled at different ``max_new`` trip counts; the
+    slope across them cancels BOTH the prefill pass and the dispatch
+    round trip (the same two-point method as matmul_peak), leaving the
+    steady-state per-token step cost of the cached decode loop."""
+    import jax
+
+    from ompi_tpu.models.decode import make_decoder
+    from ompi_tpu.models.transformer import TransformerConfig
+    from ompi_tpu.parallel.mesh import make_mesh
+
+    on_tpu = devices[0].platform == "tpu"
+    mesh = make_mesh({"dp": 1, "sp": 1, "tp": 1}, devices=devices[:1])
+    if on_tpu:  # flagship dims (468M); generous KV room at batch 16
+        cfg = TransformerConfig(
+            vocab=32_000, d_model=2048, n_heads=16, n_layers=8,
+            d_ff=8192, seq=512 + 256, attention="xla",
+            compute_dtype="bfloat16")
+        batch, prompt_len, lo, hi = 16, 512, 32, 192
+    else:
+        cfg = TransformerConfig(
+            vocab=512, d_model=128, n_heads=8, n_layers=2, d_ff=256,
+            seq=96, attention="xla", compute_dtype="float32")
+        batch, prompt_len, lo, hi = 2, 32, 4, 16
+
+    from ompi_tpu.models import transformer as tfm
+
+    params = tfm.init_params(cfg)
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, cfg.vocab,
+                          size=(batch, prompt_len)).astype(np.int32)
+
+    def timed(max_new: int) -> float:
+        dec = make_decoder(cfg, mesh, max_new=max_new)
+        out = dec(params, prompt)
+        jax.block_until_ready(out)            # compile + warm
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            out = dec(params, prompt)
+            _ = int(np.asarray(out[0, -1]))   # value-readback fence
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_lo, t_hi = timed(lo), timed(hi)
+    dt, extra = _slope_fields(t_lo, t_hi, lo, hi)
+    row = {
+        "metric": f"greedy KV-cache decode ({batch}x{prompt_len} prompt, "
+                  f"1 chip)",
+        "unit": "tokens/s", "vs_baseline": 1.0,
+        "value": round(batch / dt, 1), **extra,
+    }
+    if "suspect" not in extra:
+        row["ms_per_token"] = round(dt * 1e3, 3)
+    return row
+
+
 def matrix_flash_bwd_kernel(devices) -> dict:
     """Pallas flash-attention BACKWARD kernels (opt-in path): compile +
     run fwd+bwd with ops_flash_bwd_kernel=1 on the current backend.  On
@@ -999,6 +1067,8 @@ def run_matrix(devices, backend: str) -> None:
              lambda: matrix_grad_reduce_scatter(devices)),
             ("oshmem_device", lambda: matrix_oshmem_device(devices)),
             ("remote_dma", lambda: matrix_remote_dma(devices)),
+            ("decode_throughput",
+             lambda: matrix_decode_throughput(devices)),
             ("flash_bwd_kernel",
              lambda: matrix_flash_bwd_kernel(devices)),
             ("tuned_crossovers",
