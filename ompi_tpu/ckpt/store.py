@@ -39,6 +39,38 @@ def _to_host(v: Any) -> np.ndarray:
     return np.asarray(v)
 
 
+# npz serializes ml_dtypes arrays (bfloat16, float8_*) as raw void —
+# bytes survive but the dtype name is dropped (loads back as |V2).
+# Tag the dtype in the KEY on write and view it back on load, so bf16
+# training state (param_dtype/adam_mu_dtype) round-trips exactly.
+_DTAG = "__dtype_"
+
+
+def _tag_exotic(arrays: dict) -> dict:
+    out = {}
+    for k, v in arrays.items():
+        if v.dtype.kind == "V" and v.dtype.names is None:
+            out[f"{k}{_DTAG}{v.dtype.name}"] = v
+        else:
+            out[k] = v
+    return out
+
+
+def _untag_exotic(npz) -> dict:
+    out = {}
+    for k in npz.files:
+        v = npz[k]
+        # only tagged VOID arrays untag — a user key that merely contains
+        # the marker must not be reinterpreted
+        if _DTAG in k and v.dtype.kind == "V":
+            import ml_dtypes  # noqa: F401 — registers the dtype names
+
+            k, _, name = k.rpartition(_DTAG)
+            v = v.view(np.dtype(name))
+        out[k] = v
+    return out
+
+
 class SnapshotStore:
     """sstore/central: ranks write directly into the shared root."""
 
@@ -61,7 +93,7 @@ class SnapshotStore:
         """Serialize one rank's state dict (atomic: tmp file + rename)."""
         d = self.snapshot_dir(seq)
         os.makedirs(d, exist_ok=True)
-        arrays = {k: _to_host(v) for k, v in state.items()}
+        arrays = _tag_exotic({k: _to_host(v) for k, v in state.items()})
         fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
         try:
             with os.fdopen(fd, "wb") as f:
@@ -134,7 +166,7 @@ class SnapshotStore:
         path = self._rank_file(seq, rank)
         try:
             with np.load(path) as z:
-                return {k: z[k] for k in z.files}
+                return _untag_exotic(z)
         except OSError as e:
             raise MPIException(
                 f"loading snapshot {seq} rank {rank}: {e}",
@@ -184,7 +216,7 @@ class StagedStore(SnapshotStore):
 
     def write_rank(self, seq: int, rank: int,
                    state: dict[str, Any]) -> str:
-        arrays = {k: _to_host(v) for k, v in state.items()}
+        arrays = _tag_exotic({k: _to_host(v) for k, v in state.items()})
         local_path = os.path.join(self.local,
                                   f"stage_{seq}_rank_{rank}.npz")
         with open(local_path, "wb") as f:

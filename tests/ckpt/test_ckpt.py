@@ -35,6 +35,24 @@ def test_store_roundtrip_and_commit_gate(tmp_path):
     assert int(out["step"]) == 7
 
 
+def test_store_roundtrips_bfloat16_dtype(tmp_path):
+    """npz drops ml_dtypes names (bf16 loads back as raw |V2 without the
+    key-tag scheme) — bf16 training state (param_dtype/adam_mu_dtype)
+    must come back with its dtype intact, for both npz stores."""
+    import ml_dtypes
+
+    vals = np.array([1.5, -2.25, 0.125], dtype=ml_dtypes.bfloat16)
+    for store in (ckpt.SnapshotStore(str(tmp_path / "c")),
+                  ckpt.StagedStore(str(tmp_path / "s"),
+                                   str(tmp_path / "local"))):
+        store.write_rank(0, 0, {"w": vals, "f32": np.arange(2.0)})
+        store.commit(0, nranks=1)
+        out = store.load_rank(0, 0)
+        assert out["w"].dtype == vals.dtype, out["w"].dtype
+        np.testing.assert_array_equal(out["w"], vals)
+        assert out["f32"].dtype == np.arange(2.0).dtype  # natives untouched
+
+
 def test_store_commit_requires_all_ranks(tmp_path):
     st = ckpt.SnapshotStore(str(tmp_path))
     st.write_rank(0, 0, {"x": np.zeros(1)})
