@@ -41,32 +41,55 @@ def _to_host(v: Any) -> np.ndarray:
 
 # npz serializes ml_dtypes arrays (bfloat16, float8_*) as raw void —
 # bytes survive but the dtype name is dropped (loads back as |V2).
-# Tag the dtype in the KEY on write and view it back on load, so bf16
-# training state (param_dtype/adam_mu_dtype) round-trips exactly.
-_DTAG = "__dtype_"
+# Record the true dtype of such arrays in a SIDECAR MANIFEST entry
+# (user keys are never renamed, so no user key can ever be
+# misinterpreted or collide) and view the bytes back on load.
+_DTYPE_MANIFEST = "__ompi_tpu_dtype_manifest__"
 
 
 def _tag_exotic(arrays: dict) -> dict:
-    out = {}
+    if _DTYPE_MANIFEST in arrays:
+        raise MPIException(
+            f"checkpoint key {_DTYPE_MANIFEST!r} is reserved for the "
+            f"store's dtype manifest — rename it", error_class=ERR_IO)
+    mapping = {}
     for k, v in arrays.items():
         if v.dtype.kind == "V" and v.dtype.names is None:
-            out[f"{k}{_DTAG}{v.dtype.name}"] = v
-        else:
-            out[k] = v
+            import ml_dtypes  # noqa: F401 — registers the dtype names
+
+            try:
+                if np.dtype(v.dtype.name) == v.dtype:
+                    mapping[k] = v.dtype.name
+            except TypeError:
+                pass   # plain void ('V4' etc.): np.dtype can't parse
+                # its .name — store raw, exactly as before this scheme
+    if not mapping:
+        return arrays
+    out = dict(arrays)
+    out[_DTYPE_MANIFEST] = np.array(json.dumps(mapping))
     return out
 
 
 def _untag_exotic(npz) -> dict:
-    out = {}
-    for k in npz.files:
-        v = npz[k]
-        # only tagged VOID arrays untag — a user key that merely contains
-        # the marker must not be reinterpreted
-        if _DTAG in k and v.dtype.kind == "V":
-            import ml_dtypes  # noqa: F401 — registers the dtype names
+    files = [k for k in npz.files if k != _DTYPE_MANIFEST]
+    mapping: dict = {}
+    if _DTYPE_MANIFEST in npz.files:
+        import ml_dtypes  # noqa: F401 — registers the dtype names
 
-            k, _, name = k.rpartition(_DTAG)
-            v = v.view(np.dtype(name))
+        mapping = json.loads(str(npz[_DTYPE_MANIFEST][()]))
+    out = {}
+    for k in files:
+        v = npz[k]
+        if k in mapping:
+            try:
+                v = v.view(np.dtype(mapping[k]))
+            except (TypeError, ValueError) as e:
+                # dtype unknown to THIS environment (older ml_dtypes) or
+                # manifest/bytes mismatch: corrupt-snapshot contract, not
+                # a raw numpy error (snapc handles MPIException/ERR_IO)
+                raise MPIException(
+                    f"restoring checkpoint array {k!r} as dtype "
+                    f"{mapping[k]!r}: {e}", error_class=ERR_IO) from None
         out[k] = v
     return out
 

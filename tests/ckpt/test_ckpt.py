@@ -53,6 +53,41 @@ def test_store_roundtrips_bfloat16_dtype(tmp_path):
         assert out["f32"].dtype == np.arange(2.0).dtype  # natives untouched
 
 
+def test_store_exotic_dtype_edge_cases(tmp_path):
+    """The sidecar dtype manifest must not break what round-tripped
+    before, and must be immune to hostile user keys: plain void dtypes
+    stay raw, structured records pass through, keys that look like the
+    old tag suffixes (or collide with same-itemsize dtypes) are never
+    reinterpreted or dropped, and the one reserved manifest key
+    raises."""
+    import ml_dtypes
+
+    st = ckpt.SnapshotStore(str(tmp_path))
+    rec = np.zeros(2, dtype=[("a", "f4"), ("b", "i4")])
+    st.write_rank(0, 0, {
+        "bf": np.array([1.5, -2.0], ml_dtypes.bfloat16),
+        "raw": np.zeros(3, dtype="V4"),            # unregistered void
+        "rec__dtype_tbl": rec,                     # structured + suffix
+        "x": np.arange(3.0),                       # sibling of the next
+        "x__dtype_float32": np.zeros(3, "V4"),     # hostile stem/suffix
+        "g__dtype_float64": np.zeros(3, "V4"),     # itemsize mismatch
+    })
+    st.commit(0, nranks=1)
+    out = st.load_rank(0, 0)
+    assert out["bf"].dtype.name == "bfloat16"
+    assert out["raw"].dtype.itemsize == 4 and out["raw"].dtype.kind == "V"
+    assert out["rec__dtype_tbl"].dtype.names == ("a", "b")
+    assert out["x"].dtype == np.float64            # sibling survives
+    assert out["x__dtype_float32"].dtype.kind == "V"   # NOT viewed
+    assert out["g__dtype_float64"].dtype.kind == "V"
+    assert len(out) == 6
+
+    from ompi_tpu.ckpt.store import _DTYPE_MANIFEST
+
+    with pytest.raises(MPIException, match="reserved"):
+        st.write_rank(1, 0, {_DTYPE_MANIFEST: np.zeros(1)})
+
+
 def test_store_commit_requires_all_ranks(tmp_path):
     st = ckpt.SnapshotStore(str(tmp_path))
     st.write_rank(0, 0, {"x": np.zeros(1)})
