@@ -76,7 +76,12 @@ def _untag_exotic(npz) -> dict:
     if _DTYPE_MANIFEST in npz.files:
         import ml_dtypes  # noqa: F401 — registers the dtype names
 
-        mapping = json.loads(str(npz[_DTYPE_MANIFEST][()]))
+        try:
+            mapping = json.loads(str(npz[_DTYPE_MANIFEST][()]))
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            raise MPIException(
+                f"corrupt checkpoint dtype manifest: {e}",
+                error_class=ERR_IO) from None
     out = {}
     for k in files:
         v = npz[k]

@@ -232,6 +232,19 @@ GRID = [
                          "attention": "flash", "chain": 16, "outer": 1,
                          "_mca": {"ops_flash_block_q": 128,
                                   "ops_flash_block_k": 512}}, 1800),
+    # longer sequence at constant tokens/step: attention FLOPs per token
+    # double (12·L·D·S) while weight-read overhead stays flat, so MFU
+    # usually rises IF the attention backward fits; flash may retake the
+    # lead from XLA attention at 2048 (it lost at 1024)
+    ("b8-s2048-xla-chain16", {"batch": 8, "seq": 2048, "ce_chunk": 256,
+                              "remat": "dots", "attention": "xla",
+                              "chain": 16, "outer": 1}, 1800),
+    ("b8-s2048-flash-chain16", {"batch": 8, "seq": 2048, "ce_chunk": 256,
+                                "remat": "dots", "attention": "flash",
+                                "chain": 16, "outer": 1}, 1800),
+    ("b4-s4096-flash-chain16", {"batch": 4, "seq": 4096, "ce_chunk": 256,
+                                "remat": "dots", "attention": "flash",
+                                "chain": 16, "outer": 1}, 1800),
 ]
 
 _QUICK_LABELS = ["matmul_peak", "b16-chunk128-dots", "b32-chunk128-dots"]

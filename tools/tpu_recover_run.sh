@@ -35,6 +35,7 @@ print(float(x[0]))" >/tmp/tpurecover/probe.log 2>&1; then
     echo "$(date -u +%FT%TZ) xprof rc=$? — feature rows" >> /tmp/tpurecover/status
     python tools/mfu_sweep.py b16-xla-pbf16-chain32 b32-accum2-xla-chain16 \
       b16-flash-bq256 b16-flash-bk512 b16-chunk128-dots-pbwd \
+      b8-s2048-xla-chain16 b8-s2048-flash-chain16 b4-s4096-flash-chain16 \
       >> /tmp/tpurecover/sweep.log 2>&1
     echo "$(date -u +%FT%TZ) features rc=$? — cost" >> /tmp/tpurecover/status
     timeout 900 python tools/cost_analysis.py >> /tmp/tpurecover/cost.log 2>&1
