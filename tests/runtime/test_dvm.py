@@ -222,3 +222,27 @@ def test_clean_sweeps_dead_inboxes(tmp_path, monkeypatch):
         assert mapped in removed and not os.path.exists(mapped)
     finally:
         os.close(rd)
+
+
+def test_dvm_runs_mpi4py_facade_script(dvm):
+    """Launcher × compat composition: an mpi4py-spelled script (the
+    migration on-ramp) submitted through the standing DVM — facade
+    collectives + p2p must work under daemon-tree launch, not just
+    direct tpurun."""
+    prog = (
+        "import numpy as np\n"
+        "from ompi_tpu.compat import MPI\n"
+        "comm = MPI.COMM_WORLD\n"
+        "rank, size = comm.Get_rank(), comm.Get_size()\n"
+        "got = np.zeros(size * 2, np.float64)\n"
+        "comm.Allgather(np.full(2, float(rank)), got)\n"
+        "assert got.tolist() == [float(r) for r in range(size) for _ in (0, 1)], got\n"
+        "obj = comm.bcast({'n': size} if rank == 0 else None, root=0)\n"
+        "assert obj['n'] == size\n"
+        "print(f'facade rank {rank}/{size} ok')\n"
+        "MPI.Finalize()\n")
+    r = _tpurun("--dvm-submit", "-np", "3", "--dvm-uri", dvm, "--",
+                sys.executable, "-c", prog)
+    assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-1500:])
+    for rank in range(3):
+        assert f"facade rank {rank}/3 ok" in r.stdout
