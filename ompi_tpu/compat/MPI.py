@@ -53,6 +53,8 @@ from ompi_tpu.mpi import request as _req_mod
 ANY_SOURCE = _const.ANY_SOURCE
 ANY_TAG = _const.ANY_TAG
 PROC_NULL = _const.PROC_NULL
+ORDER_C = 0
+ORDER_FORTRAN = 1
 UNDEFINED = _const.UNDEFINED
 IN_PLACE = _const.IN_PLACE
 COMM_TYPE_SHARED = _const.COMM_TYPE_SHARED
@@ -84,7 +86,8 @@ class Exception(RuntimeError):  # noqa: A001 — mpi4py exports MPI.Exception
 
 class Datatype:
     """A named numpy dtype — enough for ``[buf, count, MPI.DOUBLE]``
-    specs, ``Status.Get_count``, and dtype checks."""
+    specs, ``Status.Get_count``, dtype checks, and (via the
+    ``Create_*`` family) derived types for file views."""
 
     def __init__(self, np_dtype, name: str):
         self.np_dtype = np.dtype(np_dtype)
@@ -102,6 +105,105 @@ class Datatype:
 
     def __repr__(self) -> str:
         return f"<MPI.Datatype {self._name}>"
+
+    # -- derived-type constructors (mpi4py spelling over the native
+    #    datatype engine; the results drive File.Set_view) --------------
+    def _to_native(self):
+        from ompi_tpu.mpi.datatype import from_numpy
+
+        return from_numpy(self.np_dtype)
+
+    def Create_contiguous(self, count: int) -> "Datatype":
+        return _Derived(self._to_native().contiguous(count), self)
+
+    def Create_vector(self, count: int, blocklength: int,
+                      stride: int) -> "Datatype":
+        return _Derived(
+            self._to_native().vector(count, blocklength, stride), self)
+
+    def Create_hvector(self, count: int, blocklength: int,
+                       stride: int) -> "Datatype":
+        return _Derived(
+            self._to_native().hvector(count, blocklength, stride), self)
+
+    def Create_indexed(self, blocklengths, displacements) -> "Datatype":
+        return _Derived(
+            self._to_native().indexed(list(blocklengths),
+                                      list(displacements)), self)
+
+    def Create_indexed_block(self, blocklength: int,
+                             displacements) -> "Datatype":
+        return _Derived(
+            self._to_native().indexed_block(blocklength,
+                                            list(displacements)), self)
+
+    def Create_hindexed(self, blocklengths, displacements) -> "Datatype":
+        return _Derived(
+            self._to_native().hindexed(list(blocklengths),
+                                       list(displacements)), self)
+
+    def Create_subarray(self, sizes, subsizes, starts,
+                        order=None) -> "Datatype":
+        return _Derived(
+            self._to_native().subarray(list(sizes), list(subsizes),
+                                       list(starts),
+                                       "F" if order == ORDER_FORTRAN
+                                       else "C"), self)
+
+    def Create_resized(self, lb: int, extent: int) -> "Datatype":
+        if lb:
+            raise Exception(
+                "Create_resized: nonzero lower bounds are not "
+                "supported (the native engine keeps lb == 0)")
+        return _Derived(self._to_native().resized(extent), self)
+
+    @staticmethod
+    def Create_struct(blocklengths, displacements,
+                      datatypes) -> "Datatype":
+        from ompi_tpu.mpi.datatype import create_struct
+
+        native = create_struct(
+            list(blocklengths), list(displacements),
+            [d._nat if isinstance(d, _Derived) else d._to_native()
+             for d in datatypes])
+        return _Derived(native, datatypes[0])
+
+    def Commit(self) -> "Datatype":
+        return self            # native types are always ready
+
+    def Free(self) -> None:
+        pass
+
+    def Get_extent(self) -> tuple:
+        return 0, self.size    # scalar: lb 0, extent == size
+
+
+class _Derived(Datatype):
+    """A committed derived type: wraps a native DerivedDatatype (passed
+    through to ``File.Set_view``); the element dtype of the BASE type is
+    kept so count conversions still work."""
+
+    def __init__(self, native, base: "Datatype") -> None:
+        self._nat = native
+        self.np_dtype = base.np_dtype
+        self._name = native.name
+
+    def Get_size(self) -> int:
+        return self._nat.size
+
+    @property
+    def size(self) -> int:
+        return self._nat.size
+
+    def Get_extent(self) -> tuple:
+        return 0, self._nat.extent
+
+    @property
+    def extent(self) -> int:
+        return self._nat.extent
+
+    def _to_native(self):
+        return self._nat
 
 
 BYTE = Datatype(np.uint8, "MPI_BYTE")
@@ -1399,7 +1501,11 @@ class File:
 
         native_et = (_from_np(etype.np_dtype)
                      if isinstance(etype, Datatype) else etype)
-        if isinstance(filetype, Datatype):
+        if isinstance(filetype, _Derived):
+            # a Create_vector/indexed/… facade type: its wrapped native
+            # derived datatype IS the view
+            filetype = filetype._nat
+        elif isinstance(filetype, Datatype):
             # a scalar compat Datatype as the filetype = contiguous
             # elements of that type (native derived types pass through
             # for strided/vector views)
