@@ -130,11 +130,11 @@ class Datatype:
     def get_true_extent(self) -> tuple[int, int]:
         """≈ MPI_Type_get_true_extent → (true_lb, true_extent): the span
         actually touched by the data, ignoring the declared extent."""
-        segs = self.segments()
-        if not segs:
+        offs, lens = self.segment_arrays()
+        if len(offs) == 0:
             return 0, 0
-        lo = min(off for off, _ in segs)
-        hi = max(off + ln for off, ln in segs)
+        lo = int(offs.min())
+        hi = int((offs + lens).max())
         return lo, hi - lo
 
     def get_name(self) -> str:
@@ -151,6 +151,12 @@ class Datatype:
         """Byte (offset, length) runs for ONE item, offsets within extent."""
         raise NotImplementedError
 
+    def segment_arrays(self) -> tuple[np.ndarray, np.ndarray]:
+        """``segments()`` as (offsets, lengths) int64 arrays — the form
+        every hot consumer (convertor, file views) actually wants; a
+        million-run type must not round-trip through a tuple list."""
+        return self._seg_arrays()
+
     def element_indices(self) -> np.ndarray:
         """Flat element positions (in units of base_np) for one item, within
         extent/base_np.itemsize positions — the gather map for device packs."""
@@ -163,10 +169,10 @@ class Datatype:
     # -- pack/unpack (host path; ≈ opal_convertor_pack/unpack) ------------
 
     def _byte_index(self, count: int) -> np.ndarray:
-        idx1 = np.concatenate([
-            np.arange(off, off + ln, dtype=np.int64)
-            for off, ln in self.segments()
-        ]) if self.segments() else np.empty(0, np.int64)
+        offs, lens = self.segment_arrays()
+        if len(offs) == 0:
+            return np.empty(0, np.int64)
+        idx1 = _concat_aranges(offs, lens)
         if count == 1:
             return idx1
         base = np.arange(count, dtype=np.int64)[:, None] * self.extent
@@ -175,8 +181,9 @@ class Datatype:
     @property
     def is_contiguous(self) -> bool:
         """One gap-free run per item, items abutting — memcpy territory."""
-        segs = self.segments()
-        return (len(segs) == 1 and segs[0] == (0, self.size)
+        offs, lens = self.segment_arrays()
+        return (len(offs) == 1 and int(offs[0]) == 0
+                and int(lens[0]) == self.size
                 and self.extent == self.size)
 
     def _seg_arrays(self) -> tuple[np.ndarray, np.ndarray]:
@@ -365,6 +372,15 @@ def _stamp(dt: "Datatype", combiner: str, **contents) -> "Datatype":
     return dt
 
 
+def _concat_aranges(offsets: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+    """``concatenate([arange(o, o + l) for o, l in zip(...)])`` without a
+    python loop (the convertor's flattened gather map)."""
+    total = int(lengths.sum())
+    cum = np.concatenate([[0], np.cumsum(lengths)[:-1]]).astype(np.int64)
+    return (np.arange(total, dtype=np.int64)
+            - np.repeat(cum, lengths) + np.repeat(offsets, lengths))
+
+
 def _merge_runs(segs: list[tuple[int, int]]) -> list[tuple[int, int]]:
     """Merge byte runs that abut in declaration order (order preserved)."""
     merged: list[tuple[int, int]] = []
@@ -381,8 +397,8 @@ def min_span(dt: Datatype, count: int) -> int:
     if count <= 0:
         return 0
     # conservative: full segments of the last item must fit
-    segs = dt.segments()
-    last_end = max((off + ln for off, ln in segs), default=0)
+    offs, lens = dt.segment_arrays()
+    last_end = int((offs + lens).max()) if len(offs) else 0
     return (count - 1) * dt.extent + last_end
 
 
@@ -414,30 +430,35 @@ class DerivedDatatype(Datatype):
     out of the same machinery as the element-offset ones.
     """
 
-    def __init__(self, base: Datatype, pattern: list[tuple[int, int]],
+    def __init__(self, base: Datatype, pattern,
                  extent: Optional[int] = None, name: str = "derived",
                  pattern_unit: str = "items") -> None:
-        # pattern: (offset, item_count) runs; offset is in base items
-        # ("items") or raw bytes ("bytes" — the MPI h* constructors)
+        # pattern: (offset, item_count) runs — a list of tuples or an
+        # (N, 2) int64 array; offset is in base items ("items") or raw
+        # bytes ("bytes" — the MPI h* constructors).  Kept as an array:
+        # a 1M-block vector type must not cost a 1M-tuple python list.
         self.base = base
+        pat = np.asarray(pattern, np.int64).reshape(-1, 2)
         if pattern_unit == "items":
-            self.byte_pattern = [(off * base.extent, cnt)
-                                 for off, cnt in pattern]
-        elif pattern_unit == "bytes":
-            self.byte_pattern = [(int(off), int(cnt))
-                                 for off, cnt in pattern]
-        else:
+            pat = pat * np.array([base.extent, 1], np.int64)
+        elif pattern_unit != "bytes":
             raise MPIException(f"bad pattern_unit {pattern_unit!r}")
+        self._pat = pat
         self.base_np = base.base_np
         self.name = name
-        n_items = sum(c for _, c in self.byte_pattern)
+        n_items = int(pat[:, 1].sum())
         self.size = n_items * base.size
-        natural = max((boff + cnt * base.extent
-                       for boff, cnt in self.byte_pattern), default=0)
+        natural = (int((pat[:, 0] + pat[:, 1] * base.extent).max())
+                   if len(pat) else 0)
         self.extent = extent if extent is not None else natural
         self._lock = threading.RLock()  # element_indices() nests segments()
         self._segs: Optional[list[tuple[int, int]]] = None
         self._elem_idx: Optional[np.ndarray] = None
+
+    @property
+    def byte_pattern(self):
+        """(offset, item_count) byte-granular rows ((N, 2) int64)."""
+        return self._pat
 
     @classmethod
     def _mk_contiguous(cls, count: int, base: Datatype) -> "DerivedDatatype":
@@ -446,7 +467,8 @@ class DerivedDatatype(Datatype):
     @classmethod
     def _mk_vector(cls, count: int, blocklength: int, stride: int,
                base: Datatype) -> "DerivedDatatype":
-        pattern = [(i * stride, blocklength) for i in range(count)]
+        pattern = np.stack([np.arange(count, dtype=np.int64) * stride,
+                            np.full(count, blocklength, np.int64)], axis=1)
         return cls(base, pattern, name=f"vector({count},{blocklength},{stride})")
 
     @classmethod
@@ -466,43 +488,86 @@ class DerivedDatatype(Datatype):
         return dt
 
     def commit(self) -> "DerivedDatatype":
-        self.segments()
+        # warm the ARRAY descriptors only — the tuple list stays lazy
+        # (building it for a 1M-run type costs more than the compile)
+        self._seg_arrays()
         self.element_indices()
         self._committed = True
         return self
 
+    def _seg_arrays(self) -> tuple[np.ndarray, np.ndarray]:
+        with self._lock:
+            arrs = getattr(self, "_seg_arrs", None)
+            if arrs is not None:
+                return arrs
+            if self._segs is not None:   # pre-seeded (resized)
+                segs = self._segs
+                arrs = (np.array([s[0] for s in segs], np.int64),
+                        np.array([s[1] for s in segs], np.int64))
+                self._seg_arrs = arrs
+                return arrs
+            boffs, blens = self.base.segment_arrays()
+            # zero-count runs are legal MPI (indexed blocklength 0) and
+            # contribute nothing — drop them so they can't inflate
+            # min_span/true extent as phantom zero-length segments
+            pat = self._pat[self._pat[:, 1] > 0]
+            bext = self.base.extent
+            if (len(boffs) == 1 and boffs[0] == 0
+                    and blens[0] == bext):
+                # contiguous base (every predefined type): a pattern
+                # run of cnt items IS one segment — no expansion
+                starts = pat[:, 0]
+                lens = pat[:, 1] * bext
+            else:
+                # expand items × base segments, vectorized: item
+                # origins via a concatenated-arange trick, then an
+                # outer sum with the base's segment offsets
+                cnts = pat[:, 1]
+                origins = (_concat_aranges(np.zeros(len(pat), np.int64),
+                                           cnts) * bext
+                           + np.repeat(pat[:, 0], cnts))
+                starts = (origins[:, None] + boffs[None, :]).reshape(-1)
+                lens = np.broadcast_to(
+                    blens[None, :],
+                    (len(origins), len(boffs))).reshape(-1).copy()
+            # merge adjacent-in-declaration-order runs (≈ the
+            # reference's descriptor optimizer). Deliberately NOT
+            # sorted: MPI pack order is declaration order, so an
+            # indexed type with decreasing displacements packs blocks
+            # exactly as declared (the unpack_ooo.c contract).
+            if len(starts) == 0:
+                arrs = (np.empty(0, np.int64), np.empty(0, np.int64))
+            else:
+                brk = np.empty(len(starts), bool)
+                brk[0] = True
+                np.not_equal(starts[1:], starts[:-1] + lens[:-1],
+                             out=brk[1:])
+                gi = np.flatnonzero(brk)
+                arrs = (np.ascontiguousarray(starts[gi]),
+                        np.ascontiguousarray(np.add.reduceat(lens, gi)))
+            self._seg_arrs = arrs
+            return arrs
+
     def segments(self) -> list[tuple[int, int]]:
         with self._lock:
             if self._segs is None:
-                segs: list[tuple[int, int]] = []
-                bsegs = self.base.segments()
-                for boff0, ecount in self.byte_pattern:
-                    for i in range(ecount):
-                        origin = boff0 + i * self.base.extent
-                        for boff, blen in bsegs:
-                            segs.append((origin + boff, blen))
-                # merge adjacent-in-declaration-order runs (≈ the
-                # reference's descriptor optimizer). Deliberately NOT
-                # sorted: MPI pack order is declaration order, so an
-                # indexed type with decreasing displacements packs blocks
-                # exactly as declared (the unpack_ooo.c contract).
-                self._segs = _merge_runs(segs)
+                starts, lens = self._seg_arrays()
+                self._segs = list(zip(starts.tolist(), lens.tolist()))
             return self._segs
 
     def element_indices(self) -> np.ndarray:
         with self._lock:
             if self._elem_idx is None:
                 isz = self.base_np.itemsize
-                idx = []
-                for off, ln in self.segments():
-                    if off % isz or ln % isz:
-                        raise MPIException(
-                            f"datatype {self.name}: segments not aligned to "
-                            f"base dtype {self.base_np}")
-                    idx.append(np.arange(off // isz, (off + ln) // isz,
-                                         dtype=np.int64))
-                self._elem_idx = (np.concatenate(idx) if idx
-                                  else np.empty(0, np.int64))
+                offs, lens = self._seg_arrays()
+                if len(offs) == 0:
+                    self._elem_idx = np.empty(0, np.int64)
+                    return self._elem_idx
+                if (offs % isz).any() or (lens % isz).any():
+                    raise MPIException(
+                        f"datatype {self.name}: segments not aligned to "
+                        f"base dtype {self.base_np}")
+                self._elem_idx = _concat_aranges(offs // isz, lens // isz)
             return self._elem_idx
 
     def __repr__(self) -> str:
@@ -728,7 +793,7 @@ def _packed_elem_dtypes(dt: Datatype) -> list[tuple[np.dtype, int]]:
     if isinstance(dt, DerivedDatatype):
         # recurse: the base may itself be heterogeneous (resized/contiguous
         # struct) — its byteswap map must survive the wrapper
-        n_items = sum(c for _, c in dt.byte_pattern)
+        n_items = int(dt.byte_pattern[:, 1].sum())
         return _packed_elem_dtypes(dt.base) * n_items
     return [(dt.base_np, dt.size // dt.base_np.itemsize)]
 

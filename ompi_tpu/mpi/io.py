@@ -412,18 +412,19 @@ class FileView:
         self.disp = int(disp)
         self.etype = etype
         self.filetype = filetype
-        self._runs = filetype.segments()     # payload runs per tile
+        # payload runs per tile, array-native (a million-run filetype
+        # must not materialize a tuple list here)
+        self._run_starts, self._run_lens = filetype.segment_arrays()
+        self._n_runs = len(self._run_starts)
         self._tile_bytes = filetype.size     # payload bytes per tile
         self._tile_extent = filetype.extent  # file bytes spanned per tile
         # prefix sums of run lengths for payload→file mapping
-        self._run_starts = np.array([r[0] for r in self._runs], np.int64)
-        self._run_lens = np.array([r[1] for r in self._runs], np.int64)
         self._run_cum = np.concatenate(
             [[0], np.cumsum(self._run_lens)]).astype(np.int64)
 
     @property
     def contiguous(self) -> bool:
-        return (len(self._runs) == 1 and self._runs[0][0] == 0
+        return (self._n_runs == 1 and int(self._run_starts[0]) == 0
                 and self._tile_bytes == self._tile_extent)
 
     def payload_bytes_up_to(self, file_size: int) -> int:
@@ -436,10 +437,16 @@ class FileView:
             return avail
         tiles, within = divmod(avail, self._tile_extent)
         pay = tiles * self._tile_bytes
-        for off, ln in self._runs:
-            if within <= off:
-                break
-            pay += min(ln, within - off)
+        # PREFIX of the declaration-ordered runs below `within` (a
+        # non-monotone filetype's later runs may sit below it in the
+        # file but are NOT readable payload prefix — the original
+        # walk-with-break semantics)
+        below = self._run_starts < within
+        k = (len(below) if bool(below.all())
+             else int(np.argmin(below)))
+        pay += int(np.minimum(
+            self._run_lens[:k],
+            within - self._run_starts[:k]).sum())
         return pay
 
     def byte_runs(self, offset_etypes: int, nbytes: int

@@ -125,3 +125,39 @@ def test_collective_read_past_eof_truncates(tmp_path):
             config.var_registry.set("io_fcoll", old or "")
 
     assert all(run_ranks(3, body, timeout=180.0))
+
+
+def test_zero_blocklength_runs_dropped():
+    """Zero blocklengths are legal MPI (indexed with holes): they must
+    not become phantom zero-length segments inflating min_span or the
+    true extent (a regression the array-native fast path introduced and
+    this pins)."""
+    from ompi_tpu.mpi.datatype import INT32, min_span
+
+    t = INT32.indexed([2, 0], [0, 100]).commit()
+    assert t.segments() == [(0, 8)]
+    assert min_span(t, 1) == 8
+    assert t.get_true_extent() == (0, 8)
+    packed = t.pack(np.arange(2, dtype=np.int32), 1)
+    assert len(packed) == 8
+    out = np.zeros(2, np.int32)
+    t.unpack(packed, out, 1)
+    np.testing.assert_array_equal(out, [0, 1])
+
+
+def test_payload_prefix_nonmonotone_filetype():
+    """payload_bytes_up_to is a payload PREFIX length: a declaration-
+    ordered filetype whose later runs sit lower in the file must not
+    count them once an earlier run is past the limit (SEEK_END would
+    otherwise point past readable payload)."""
+    from ompi_tpu.mpi.datatype import BYTE
+
+    ft = BYTE.indexed([4, 4], [100, 0])
+    v = mio.FileView(0, BYTE, ft)
+    # the walk BREAKS at the first run starting at/past the limit —
+    # run (100,4) gates everything when file_size <= 100
+    assert v.payload_bytes_up_to(50) == 0
+    # past that gate, every run below the limit counts (run1's readable
+    # 2 bytes + run2's 4)
+    assert v.payload_bytes_up_to(102) == 6
+    assert v.payload_bytes_up_to(104) == 8
