@@ -55,6 +55,10 @@ ANY_TAG = _const.ANY_TAG
 PROC_NULL = _const.PROC_NULL
 ORDER_C = 0
 ORDER_FORTRAN = 1
+DISTRIBUTE_NONE = 100
+DISTRIBUTE_BLOCK = 101
+DISTRIBUTE_CYCLIC = 102
+DISTRIBUTE_DFLT_DARG = -1
 UNDEFINED = _const.UNDEFINED
 IN_PLACE = _const.IN_PLACE
 COMM_TYPE_SHARED = _const.COMM_TYPE_SHARED
@@ -149,6 +153,26 @@ class Datatype:
                                        list(starts),
                                        "F" if order == ORDER_FORTRAN
                                        else "C"), self)
+
+    def Create_hindexed_block(self, blocklength: int,
+                              displacements) -> "Datatype":
+        return _Derived(
+            self._to_native().hindexed_block(blocklength,
+                                             list(displacements)), self)
+
+    def Create_darray(self, size: int, rank: int, gsizes, distribs,
+                      dargs, psizes, order=None) -> "Datatype":
+        from ompi_tpu.mpi import datatype as _dt
+        from ompi_tpu.mpi.datatype import create_darray
+
+        name_of = {DISTRIBUTE_NONE: _dt.DISTRIBUTE_NONE,
+                   DISTRIBUTE_BLOCK: _dt.DISTRIBUTE_BLOCK,
+                   DISTRIBUTE_CYCLIC: _dt.DISTRIBUTE_CYCLIC}
+        return _Derived(create_darray(
+            size, rank, list(gsizes),
+            [name_of.get(d, d) for d in distribs], list(dargs),
+            list(psizes), self._to_native(),
+            "F" if order == ORDER_FORTRAN else "C"), self)
 
     def Create_resized(self, lb: int, extent: int) -> "Datatype":
         if lb:

@@ -611,6 +611,13 @@ def test_datatype_create_family_file_views(tmp_path_factory):
     stc = MPI.Datatype.Create_struct([1, 1], [0, 8],
                                      [MPI.DOUBLE, MPI.INT32_T])
     assert stc.Get_size() == 12
+    hib = MPI.DOUBLE.Create_hindexed_block(1, [0, 24])
+    assert hib.Get_size() == 16
+    # darray: rank 0's block of an 8x8 block-distributed grid on 2x2
+    da = MPI.DOUBLE.Create_darray(
+        4, 0, [8, 8], [MPI.DISTRIBUTE_BLOCK, MPI.DISTRIBUTE_BLOCK],
+        [MPI.DISTRIBUTE_DFLT_DARG, MPI.DISTRIBUTE_DFLT_DARG], [2, 2])
+    assert da.Get_size() == 4 * 4 * 8        # a 4x4 block of doubles
     vec.Free()                               # no-ops, mpi4py parity
 
     def fn(comm):
