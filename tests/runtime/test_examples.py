@@ -42,6 +42,18 @@ def test_example_runs_under_tpurun(script, marker):
     assert marker in out, out[-2000:]
 
 
+def test_mpiio_darray_example():
+    """The collective-IO example needs a square rank count (block grid)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-np", "4", "--",
+         sys.executable, os.path.join(REPO, "examples",
+                                      "mpiio_darray.py")],
+        capture_output=True, text=True, timeout=180, cwd=REPO)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-2000:]
+    assert "darray collective IO ok" in out
+
+
 def test_facade_collectives_bench_runs():
     """The facade-overhead microbench (examples/facade_collectives_bench)
     completes and prints per-collective ratios; the ratio VALUES are
