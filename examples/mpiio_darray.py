@@ -23,37 +23,51 @@ q = int(math.isqrt(size))
 assert q * q == size, "run with a square process count (1, 4, 9, ...)"
 
 N = 8 * q                      # global matrix side; 8x8 block per rank
-path = os.path.join(tempfile.gettempdir(), "darray_demo.bin")
-
-view = MPI.DOUBLE.Create_darray(
-    size, rank, [N, N],
-    [MPI.DISTRIBUTE_BLOCK, MPI.DISTRIBUTE_BLOCK],
-    [MPI.DISTRIBUTE_DFLT_DARG, MPI.DISTRIBUTE_DFLT_DARG],
-    [q, q]).Commit()
-
-# my block, filled with rank-stamped values
-block = np.full((N // q) * (N // q), float(rank), np.float64)
-block += np.arange(block.size) / 1000.0
-
-f = MPI.File.Open(comm, path, MPI.MODE_RDWR | MPI.MODE_CREATE)
-f.Set_view(disp=0, etype=MPI.DOUBLE, filetype=view)
-f.Write_at_all(0, block)
-
-back = np.zeros_like(block)
-f.Read_at_all(0, back)
-f.Close()
-assert np.array_equal(back, block), "roundtrip mismatch"
-
-# rank 0 checks the assembled global matrix on disk
-comm.Barrier()
+# unique per-run file (a fixed name would collide across or between
+# runs — MODE_CREATE doesn't truncate); rank 0 names it, all agree
 if rank == 0:
-    disk = np.fromfile(path, np.float64).reshape(N, N)
-    b = N // q
-    for r in range(size):
-        pr, pc = divmod(r, q)
-        got = disk[pr * b:(pr + 1) * b, pc * b:(pc + 1) * b]
-        assert abs(got[0, 0] - float(r)) < 1e-9, (r, got[0, 0])
-    os.unlink(path)
-    print(f"darray collective IO ok: {N}x{N} matrix, {size} ranks, "
-          f"one shared file")
+    fd, path = tempfile.mkstemp(suffix=".darray.bin")
+    os.close(fd)
+else:
+    path = None
+path = comm.bcast(path, root=0)
+
+try:
+    view = MPI.DOUBLE.Create_darray(
+        size, rank, [N, N],
+        [MPI.DISTRIBUTE_BLOCK, MPI.DISTRIBUTE_BLOCK],
+        [MPI.DISTRIBUTE_DFLT_DARG, MPI.DISTRIBUTE_DFLT_DARG],
+        [q, q]).Commit()
+
+    # my block, filled with rank-stamped values
+    block = np.full((N // q) * (N // q), float(rank), np.float64)
+    block += np.arange(block.size) / 1000.0
+
+    f = MPI.File.Open(comm, path, MPI.MODE_RDWR | MPI.MODE_CREATE)
+    f.Set_view(disp=0, etype=MPI.DOUBLE, filetype=view)
+    f.Write_at_all(0, block)
+
+    back = np.zeros_like(block)
+    f.Read_at_all(0, back)
+    f.Close()
+    assert np.array_equal(back, block), "roundtrip mismatch"
+
+    # rank 0 checks the assembled global matrix on disk
+    comm.Barrier()
+    if rank == 0:
+        disk = np.fromfile(path, np.float64).reshape(N, N)
+        b = N // q
+        for r in range(size):
+            pr, pc = divmod(r, q)
+            got = disk[pr * b:(pr + 1) * b, pc * b:(pc + 1) * b]
+            assert abs(got[0, 0] - float(r)) < 1e-9, (r, got[0, 0])
+        print(f"darray collective IO ok: {N}x{N} matrix, {size} ranks, "
+              f"one shared file")
+finally:
+    comm.Barrier()
+    if rank == 0:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
 MPI.Finalize()

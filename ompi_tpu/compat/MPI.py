@@ -1620,6 +1620,80 @@ class File:
     def Write_ordered(self, buf) -> None:
         self._f.write_ordered(self._to_file(buf))
 
+    # -- nonblocking IO (requests land into the caller's buffer on
+    #    Wait/Test, the mpi4py convention) ---------------------------------
+    def _iread(self, native_req, buf) -> Request:
+        return Request(native_req,
+                       transform=lambda out: self._land(buf, out))
+
+    def Iread_at(self, offset: int, buf) -> Request:
+        return self._iread(self._f.iread_at(offset, self._count(buf)), buf)
+
+    def Iwrite_at(self, offset: int, buf) -> Request:
+        return Request(self._f.iwrite_at(offset, self._to_file(buf)))
+
+    def Iread(self, buf) -> Request:
+        return self._iread(self._f.iread(self._count(buf)), buf)
+
+    def Iwrite(self, buf) -> Request:
+        return Request(self._f.iwrite(self._to_file(buf)))
+
+    def Iread_all(self, buf) -> Request:
+        return self._iread(self._f.iread_all(self._count(buf)), buf)
+
+    def Iwrite_all(self, buf) -> Request:
+        return Request(self._f.iwrite_all(self._to_file(buf)))
+
+    def Iread_at_all(self, offset: int, buf) -> Request:
+        return self._iread(
+            self._f.iread_at_all(offset, self._count(buf)), buf)
+
+    def Iwrite_at_all(self, offset: int, buf) -> Request:
+        return Request(self._f.iwrite_at_all(offset, self._to_file(buf)))
+
+    def Iread_shared(self, buf) -> Request:
+        return self._iread(self._f.iread_shared(self._count(buf)), buf)
+
+    def Iwrite_shared(self, buf) -> Request:
+        return Request(self._f.iwrite_shared(self._to_file(buf)))
+
+    # -- split collectives (one outstanding per handle, ends must match) --
+    def Read_all_begin(self, buf) -> None:
+        self._f.read_all_begin(self._count(buf))
+
+    def Read_all_end(self, buf) -> None:
+        self._land(buf, self._f.read_all_end())
+
+    def Write_all_begin(self, buf) -> None:
+        self._f.write_all_begin(self._to_file(buf))
+
+    def Write_all_end(self, buf) -> None:
+        self._f.write_all_end()
+
+    def Read_at_all_begin(self, offset: int, buf) -> None:
+        self._f.read_at_all_begin(offset, self._count(buf))
+
+    def Read_at_all_end(self, buf) -> None:
+        self._land(buf, self._f.read_at_all_end())
+
+    def Write_at_all_begin(self, offset: int, buf) -> None:
+        self._f.write_at_all_begin(offset, self._to_file(buf))
+
+    def Write_at_all_end(self, buf) -> None:
+        self._f.write_at_all_end()
+
+    def Read_ordered_begin(self, buf) -> None:
+        self._f.read_ordered_begin(self._count(buf))
+
+    def Read_ordered_end(self, buf) -> None:
+        self._land(buf, self._f.read_ordered_end())
+
+    def Write_ordered_begin(self, buf) -> None:
+        self._f.write_ordered_begin(self._to_file(buf))
+
+    def Write_ordered_end(self, buf) -> None:
+        self._f.write_ordered_end()
+
     # -- management --------------------------------------------------------
     def Sync(self) -> None:
         self._f.sync()

@@ -594,6 +594,38 @@ def test_win_allocate_typed_roundtrip():
     assert all(run_ranks(2, wrap(fn)))
 
 
+def test_file_nonblocking_and_split_collectives(tmp_path_factory):
+    """mpi4py File nonblocking (Iwrite_at/Iread_at land on Wait) and the
+    split collective Begin/End pairs."""
+    tmp = tmp_path_factory.mktemp("ionb")
+    path = str(tmp / "nb.bin")
+
+    def fn(comm):
+        f = MPI.File.Open(comm, path, MPI.MODE_RDWR | MPI.MODE_CREATE)
+        data = np.arange(8, dtype=np.float64) + 10 * comm.rank
+        req = f.Iwrite_at(8 * comm.rank * 8, data)
+        assert req.Wait()
+        comm.Barrier()
+        back = np.zeros(8, np.float64)
+        r2 = f.Iread_at(8 * comm.rank * 8, back)
+        r2.Wait()
+        np.testing.assert_array_equal(back, data)
+        # split collective write + read (MPI requires the SAME buffer at
+        # begin and end)
+        data2 = data * 2
+        f.Write_at_all_begin(8 * comm.rank * 8, data2)
+        f.Write_at_all_end(data2)
+        comm.Barrier()
+        out = np.zeros(8, np.float64)
+        f.Read_at_all_begin(8 * comm.rank * 8, out)
+        f.Read_at_all_end(out)
+        np.testing.assert_array_equal(out, data * 2)
+        f.Close()
+        return True
+
+    assert all(run_ranks(3, wrap(fn)))
+
+
 def test_datatype_create_family_file_views(tmp_path_factory):
     """The mpi4py derived-type idiom drives file views end to end:
     Create_vector(...).Commit() as a filetype interleaves the ranks;

@@ -16,42 +16,33 @@ REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 CASES = [
-    ("ring.py", "3 processes in ring"),
-    ("hello.py", "Hello, world"),
-    ("connectivity.py", "Connectivity test on 3 processes PASSED"),
-    ("ring_oshmem.py", "exiting"),
-    ("oshmem_shmalloc.py", "shmalloc/shfree ok"),
-    ("oshmem_circular_shift.py", "circular shift ok"),
-    ("oshmem_symmetric_data.py", "verified symmetric data"),
-    ("mprobe_task_queue.py", "no duplicates, no losses"),
-    ("mpi4py_ring.py", "exiting"),
-    ("rma_pscw.py", "dynamic window ok"),
-    ("mpi4py_cart_halo.py", "halo exchange ok"),
+    # (script, expected marker, np — darray needs a square rank count)
+    ("ring.py", "3 processes in ring", 3),
+    ("hello.py", "Hello, world", 3),
+    ("connectivity.py", "Connectivity test on 3 processes PASSED", 3),
+    ("ring_oshmem.py", "exiting", 3),
+    ("oshmem_shmalloc.py", "shmalloc/shfree ok", 3),
+    ("oshmem_circular_shift.py", "circular shift ok", 3),
+    ("oshmem_symmetric_data.py", "verified symmetric data", 3),
+    ("mprobe_task_queue.py", "no duplicates, no losses", 3),
+    ("mpi4py_ring.py", "exiting", 3),
+    ("rma_pscw.py", "dynamic window ok", 3),
+    ("mpi4py_cart_halo.py", "halo exchange ok", 3),
+    ("mpiio_darray.py", "darray collective IO ok", 4),
 ]
 
 
-@pytest.mark.parametrize("script,marker",
+@pytest.mark.parametrize("script,marker,np_",
                          CASES, ids=[c[0] for c in CASES])
-def test_example_runs_under_tpurun(script, marker):
+def test_example_runs_under_tpurun(script, marker, np_):
     proc = subprocess.run(
-        [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-np", "3", "--",
+        [sys.executable, "-m", "ompi_tpu.tools.tpurun",
+         "-np", str(np_), "--",
          sys.executable, os.path.join(REPO, "examples", script)],
         capture_output=True, text=True, timeout=180, cwd=REPO)
     out = proc.stdout + proc.stderr
     assert proc.returncode == 0, out[-2000:]
     assert marker in out, out[-2000:]
-
-
-def test_mpiio_darray_example():
-    """The collective-IO example needs a square rank count (block grid)."""
-    proc = subprocess.run(
-        [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-np", "4", "--",
-         sys.executable, os.path.join(REPO, "examples",
-                                      "mpiio_darray.py")],
-        capture_output=True, text=True, timeout=180, cwd=REPO)
-    out = proc.stdout + proc.stderr
-    assert proc.returncode == 0, out[-2000:]
-    assert "darray collective IO ok" in out
 
 
 def test_facade_collectives_bench_runs():
