@@ -68,6 +68,65 @@ THREAD_SINGLE, THREAD_FUNNELED, THREAD_SERIALIZED, THREAD_MULTIPLE = range(4)
 
 ERRORS_ARE_FATAL = "errors_are_fatal"
 ERRORS_RETURN = "errors_return"
+ROOT = _const.ROOT              # intercomm collective root marker
+BOTTOM = 0                      # address-0 buffer sentinel (unused here)
+KEYVAL_INVALID = -1
+MODE_NOCHECK = 1024             # win assertion hint (accepted, advisory)
+# comparison results (≈ MPI_Comm_compare / MPI_Group_compare)
+IDENT, CONGRUENT, SIMILAR, UNEQUAL = 0, 1, 2, 3
+# topology kinds for Get_topology (no topology → the UNDEFINED constant,
+# mpi4py/MPI_Topo_test semantics)
+CART, GRAPH, DIST_GRAPH = 1, 2, 3
+
+from ompi_tpu.mpi.errhandler import (  # noqa: E402
+    Errhandler, create_errhandler,
+)
+from ompi_tpu.mpi.info import (  # noqa: E402
+    Keyval as _Keyval, keyval_create as _keyval_create,
+    keyval_free as _keyval_free,
+)
+from ompi_tpu.mpi.info import Info as _NativeInfo  # noqa: E402
+
+
+class Info(_NativeInfo):
+    """mpi4py-cased Info over the native hint dictionary (the native
+    lowercase API stays available; File/Win/native layers consume it
+    directly)."""
+
+    @classmethod
+    def Create(cls, items=None) -> "Info":
+        return cls(dict(items) if items else None)
+
+    def Set(self, key: str, value: str) -> None:
+        self.set(key, value)
+
+    def Get(self, key: str, default=None):
+        return self.get(key, default)
+
+    def Delete(self, key: str) -> None:
+        self.delete(key)
+
+    def Get_nkeys(self) -> int:
+        return self.nkeys          # native exposes it as a property
+
+    def Get_nthkey(self, n: int) -> str:
+        return self.nthkey(n)
+
+    def Dup(self) -> "Info":
+        return Info(dict(self.items()))
+
+    def Free(self) -> None:
+        pass
+
+
+INFO_NULL = None
+# well-known attribute keyvals (≈ MPI_TAG_UB etc.); queried via
+# comm.Get_attr — the facade answers them itself
+TAG_UB = _keyval_create(extra="TAG_UB")
+WIN_BASE = _keyval_create(extra="WIN_BASE")
+WIN_SIZE = _keyval_create(extra="WIN_SIZE")
+WIN_DISP_UNIT = _keyval_create(extra="WIN_DISP_UNIT")
+_MAX_TAG = (1 << 30) - 1        # user tags below the reserved ranges
 
 
 class Exception(RuntimeError):  # noqa: A001 — mpi4py exports MPI.Exception
@@ -254,6 +313,16 @@ UINT32_T = Datatype(np.uint32, "MPI_UINT32_T")
 UINT64_T = Datatype(np.uint64, "MPI_UINT64_T")
 COMPLEX = Datatype(np.complex64, "MPI_COMPLEX")
 DOUBLE_COMPLEX = Datatype(np.complex128, "MPI_DOUBLE_COMPLEX")
+# (value, location) pair types for MAXLOC/MINLOC reductions — the same
+# structured dtypes the native op layer folds
+FLOAT_INT = Datatype(np.dtype([("val", np.float32), ("loc", np.int32)]),
+                     "MPI_FLOAT_INT")
+DOUBLE_INT = Datatype(np.dtype([("val", np.float64), ("loc", np.int32)]),
+                      "MPI_DOUBLE_INT")
+LONG_INT = Datatype(np.dtype([("val", np.int64), ("loc", np.int32)]),
+                    "MPI_LONG_INT")
+TWOINT = Datatype(np.dtype([("val", np.int32), ("loc", np.int32)]),
+                  "MPI_2INT")
 
 
 # ---------------------------------------------------------------------------
@@ -634,6 +703,179 @@ class Comm:
 
     def Is_intra(self) -> bool:
         return not self._c.test_inter()
+
+    # -- nonblocking collectives (remaining family) ------------------------
+    def Igather(self, sendbuf, recvbuf, root: int = 0) -> Request:
+        me = self._c.rank
+
+        def land(out):
+            if me == root and recvbuf is not None:
+                _copy_into(recvbuf, self._stacked(out))
+
+        return Request(self._c.igather(_as_array(sendbuf), root),
+                       transform=land)
+
+    def Iscatter(self, sendbuf, recvbuf, root: int = 0) -> Request:
+        send = None
+        if self._c.rank == root:
+            send = _as_array(sendbuf).reshape(self._c.size, -1)
+
+        def land(out):
+            if recvbuf is not None:
+                _copy_into(recvbuf, out)
+
+        return Request(self._c.iscatter(send, root), transform=land)
+
+    def Iallgather(self, sendbuf, recvbuf) -> Request:
+        return Request(
+            self._c.iallgather(_as_array(sendbuf)),
+            transform=lambda out: _copy_into(recvbuf,
+                                             self._stacked(out)))
+
+    def Ialltoall(self, sendbuf, recvbuf) -> Request:
+        arr = _as_array(sendbuf).reshape(self._c.size, -1)
+        return Request(
+            self._c.ialltoall(arr),
+            transform=lambda out: _copy_into(recvbuf,
+                                             self._stacked(out)))
+
+    def Iscan(self, sendbuf, recvbuf, op: "Op" = None) -> Request:
+        return Request(
+            self._c.iscan(_as_array(sendbuf),
+                          _native_op(op or SUM)),
+            transform=lambda out: _copy_into(recvbuf, out))
+
+    def Iexscan(self, sendbuf, recvbuf, op: "Op" = None) -> Request:
+        me = self._c.rank
+
+        def land(out):
+            if me != 0 and out is not None:
+                _copy_into(recvbuf, out)
+
+        return Request(self._c.iexscan(_as_array(sendbuf),
+                                       _native_op(op or SUM)),
+                       transform=land)
+
+    # -- v-collectives (remaining uppercase forms) -------------------------
+    def Alltoallv(self, sendbuf, recvbuf) -> None:
+        arr, counts, displs, _dt = _vspec(sendbuf)
+        flat = arr.reshape(-1)
+        parts = [flat[d:d + c] for c, d in zip(counts, displs)]
+        out = self._c.alltoallv(parts)
+        _place_v(recvbuf, out)
+
+    def Alltoallw(self, sendspecs, recvspecs) -> None:
+        """[(buf, count, datatype), …] per peer on both sides (None =
+        empty exchange) — filled in place, the native contract."""
+        def conv(specs):
+            out = []
+            for s in specs:
+                if s is None:
+                    out.append(None)
+                    continue
+                buf, cnt, dt = s
+                nat = (dt._to_native() if isinstance(dt, Datatype)
+                       else dt)
+                out.append((np.asarray(buf), nat, int(cnt)))
+            return out
+
+        self._c.alltoallw(conv(sendspecs), conv(recvspecs))
+
+    # -- attributes (≈ MPI_Comm_{set,get,delete}_attr) ---------------------
+    @staticmethod
+    def Create_keyval(copy_fn=None, delete_fn=None) -> "_Keyval":
+        return _keyval_create(copy_fn, delete_fn)
+
+    @staticmethod
+    def Free_keyval(keyval) -> int:
+        _keyval_free(keyval)
+        return KEYVAL_INVALID
+
+    def Set_attr(self, keyval, value) -> None:
+        self._c.set_attr(keyval, value)
+
+    def Get_attr(self, keyval):
+        if keyval is TAG_UB:
+            return _MAX_TAG
+        return self._c.get_attr(keyval)
+
+    def Delete_attr(self, keyval) -> None:
+        self._c.delete_attr(keyval)
+
+    # -- info / errhandler -------------------------------------------------
+    def Set_info(self, info) -> None:
+        self._c.set_info(info)
+
+    def Get_info(self) -> "Info":
+        native = self._c.get_info()
+        return native if isinstance(native, Info) \
+            else Info(dict(native.items()))
+
+    def Set_errhandler(self, errhandler) -> None:
+        from ompi_tpu.mpi import errhandler as _eh
+
+        named = {ERRORS_RETURN: _eh.ERRORS_RETURN,
+                 ERRORS_ARE_FATAL: _eh.ERRORS_ARE_FATAL}
+        self._c.errhandler = named.get(errhandler, errhandler)
+
+    def Get_errhandler(self):
+        return self._c.errhandler
+
+    # -- structure queries -------------------------------------------------
+    def Compare(self, other: "Comm") -> int:
+        """≈ MPI_Comm_compare (classic group-based definition)."""
+        if self._c is other._c:
+            return IDENT
+        mine = list(self._c.group.ranks)
+        theirs = list(other._c.group.ranks)
+        if mine == theirs:
+            return CONGRUENT
+        if sorted(mine) == sorted(theirs):
+            return SIMILAR
+        return UNEQUAL
+
+    def Get_topology(self) -> int:
+        t = getattr(self._c, "topo", None)
+        if t is None:
+            return UNDEFINED
+        return {"cart": CART, "graph": GRAPH,
+                "dist_graph": DIST_GRAPH}[t.kind]
+
+    def Idup(self) -> tuple["Comm", "Request"]:
+        """mpi4py order: (newcomm, request) — use the comm only after
+        the request completes."""
+        req, new = self._c.idup()
+        return Comm(new), Request(req)
+
+    def Clone(self) -> "Comm":
+        return self.Dup()
+
+    def Create_dist_graph_adjacent(self, sources, destinations,
+                                   sourceweights=None,
+                                   destweights=None,
+                                   info=None,
+                                   reorder: bool = False
+                                   ) -> "Distgraphcomm":
+        new = self._c.dist_graph_create_adjacent(
+            list(sources), list(destinations),
+            list(sourceweights) if sourceweights is not None else None,
+            list(destweights) if destweights is not None else None)
+        return Distgraphcomm(new) if new is not None else None
+
+    def Create_dist_graph(self, sources, degrees, destinations,
+                          weights=None, info=None,
+                          reorder: bool = False) -> "Distgraphcomm":
+        new = self._c.dist_graph_create(
+            list(sources), list(degrees), list(destinations),
+            list(weights) if weights is not None else None)
+        return Distgraphcomm(new) if new is not None else None
+
+    # -- buffered sends (object forms; uppercase Bsend/Ibsend exist) ------
+    def bsend(self, obj, dest: int, tag: int = 0) -> None:
+        self._c.bsend(_dumps(obj), dest, tag)
+
+    def ibsend(self, obj, dest: int, tag: int = 0) -> Request:
+        return Request(self._c.ibsend(_dumps(obj), dest, tag))
 
     @property
     def rank(self) -> int:
@@ -1263,6 +1505,21 @@ class Graphcomm(Comm):
         return self.Get_dims()[1]
 
 
+class Distgraphcomm(Comm):
+    """Communicator with a distributed-graph topology (mpi4py surface
+    over the native topo framework)."""
+
+    def Get_dist_neighbors_count(self) -> tuple:
+        from ompi_tpu.mpi.topo import dist_graph_neighbors_count
+
+        return dist_graph_neighbors_count(self._c)
+
+    def Get_dist_neighbors(self) -> tuple:
+        from ompi_tpu.mpi.topo import dist_graph_neighbors
+
+        return dist_graph_neighbors(self._c)
+
+
 def Compute_dims(nnodes: int, dims) -> list:
     """≈ mpi4py MPI.Compute_dims / MPI_Dims_create."""
     from ompi_tpu.mpi.topo import dims_create
@@ -1270,6 +1527,43 @@ def Compute_dims(nnodes: int, dims) -> list:
     if isinstance(dims, int):
         dims = [0] * dims
     return dims_create(nnodes, len(dims), dims)
+
+
+def Get_address(buf) -> int:
+    """≈ MPI_Get_address."""
+    from ompi_tpu.mpi.datatype import get_address
+
+    return get_address(np.asarray(buf))
+
+
+def Alloc_mem(size: int, info=None):
+    """≈ MPI_Alloc_mem → a uint8 buffer."""
+    from ompi_tpu.mpi.datatype import alloc_mem
+
+    return alloc_mem(int(size))
+
+
+def Free_mem(buf) -> None:
+    from ompi_tpu.mpi.datatype import free_mem
+
+    free_mem(buf)
+
+
+def Attach_buffer(buf) -> None:
+    """≈ MPI_Buffer_attach: back buffered-mode sends.  mpi4py passes a
+    bytearray/array; the pool only needs its SIZE."""
+    from ompi_tpu.mpi.pml import buffer_attach
+
+    nbytes = (buf.nbytes if hasattr(buf, "nbytes")
+              else len(buf))
+    buffer_attach(int(nbytes))
+
+
+def Detach_buffer():
+    """≈ MPI_Buffer_detach (drains pending buffered sends)."""
+    from ompi_tpu.mpi.pml import buffer_detach
+
+    return buffer_detach()
 
 
 # ---------------------------------------------------------------------------
@@ -1450,6 +1744,18 @@ class Win:
         old = self._w.compare_swap(target_rank, cmp_,
                                    val.reshape(-1)[0], offset=off)
         _copy_into(result, np.asarray(old).reshape(1))
+
+    # -- attributes --------------------------------------------------------
+    def Get_attr(self, keyval):
+        if keyval is WIN_BASE:
+            from ompi_tpu.mpi.datatype import get_address
+
+            return get_address(np.asarray(self._w.buf))
+        if keyval is WIN_SIZE:
+            return self._w.buf.nbytes
+        if keyval is WIN_DISP_UNIT:
+            return self._du
+        return None
 
     # -- synchronization ---------------------------------------------------
     def Fence(self, assertion: int = 0) -> None:

@@ -594,6 +594,105 @@ def test_win_allocate_typed_roundtrip():
     assert all(run_ranks(2, wrap(fn)))
 
 
+def test_nonblocking_collective_family_lands_in_buffers():
+    """Igather/Iscatter/Iallgather/Ialltoall/Iscan/Iexscan land their
+    results into the caller's buffer on Wait (transform path)."""
+    def fn(comm):
+        rank, size = comm.rank, comm.size
+        g = np.zeros(size * 2, np.float64)
+        r = comm.Igather(np.full(2, float(rank)), g, root=0)
+        r.Wait()
+        if rank == 0:
+            np.testing.assert_array_equal(
+                g, np.repeat(np.arange(size, dtype=np.float64), 2))
+        sc = np.zeros(2, np.float64)
+        r = comm.Iscatter(
+            np.repeat(np.arange(size, dtype=np.float64), 2)
+            if rank == 0 else None, sc, root=0)
+        r.Wait()
+        np.testing.assert_array_equal(sc, [float(rank)] * 2)
+        ag = np.zeros(size, np.float64)
+        comm.Iallgather(np.full(1, float(rank)), ag).Wait()
+        np.testing.assert_array_equal(ag, np.arange(size))
+        a2a = np.zeros(size, np.float64)
+        comm.Ialltoall(np.full(size, float(rank)), a2a).Wait()
+        np.testing.assert_array_equal(a2a, np.arange(size))
+        sn = np.zeros(1, np.float64)
+        comm.Iscan(np.ones(1), sn).Wait()
+        assert sn[0] == rank + 1
+        ex = np.zeros(1, np.float64)
+        comm.Iexscan(np.ones(1), ex).Wait()
+        if rank:
+            assert ex[0] == rank
+        return True
+
+    assert all(run_ranks(3, wrap(fn)))
+
+
+def test_alltoallv_attrs_info_errhandler_compare():
+    def fn(comm):
+        rank, size = comm.rank, comm.size
+        # Alltoallv: rank r sends k+1 items to rank k
+        counts = [k + 1 for k in range(size)]
+        displs = np.concatenate([[0], np.cumsum(counts)[:-1]]).tolist()
+        send = np.concatenate(
+            [np.full(k + 1, float(rank * 10 + k)) for k in range(size)])
+        rcounts = [rank + 1] * size
+        rdispls = (np.arange(size) * (rank + 1)).tolist()
+        recv = np.zeros(size * (rank + 1), np.float64)
+        comm.Alltoallv([send, counts, displs, MPI.DOUBLE],
+                       [recv, rcounts, rdispls, MPI.DOUBLE])
+        for src in range(size):
+            np.testing.assert_array_equal(
+                recv[src * (rank + 1):(src + 1) * (rank + 1)],
+                np.full(rank + 1, float(src * 10 + rank)))
+        # attributes + TAG_UB + keyvals
+        assert comm.Get_attr(MPI.TAG_UB) > 1 << 20
+        kv = MPI.Comm.Create_keyval()
+        comm.Set_attr(kv, {"x": rank})
+        assert comm.Get_attr(kv)["x"] == rank
+        comm.Delete_attr(kv)
+        assert comm.Get_attr(kv) is None
+        # info
+        info = MPI.Info.Create({"k": "v"})
+        assert info.Get("k") == "v" and info.Get_nkeys() == 1
+        comm.Set_info(info)
+        assert comm.Get_info().Get("k") == "v"
+        # errhandler round-trip
+        old = comm.Get_errhandler()
+        comm.Set_errhandler(MPI.ERRORS_RETURN)
+        assert comm.Get_errhandler() is not None
+        comm.Set_errhandler(old)
+        # compare
+        assert comm.Compare(comm) == MPI.IDENT
+        dup = comm.Dup()
+        assert comm.Compare(dup) == MPI.CONGRUENT
+        dup.Free()
+        assert comm.Get_topology() == MPI.UNDEFINED
+        return True
+
+    assert all(run_ranks(3, wrap(fn)))
+
+
+def test_dist_graph_and_idup():
+    def fn(comm):
+        rank, size = comm.rank, comm.size
+        # ring dist graph: I receive from left, send to right
+        dg = comm.Create_dist_graph_adjacent(
+            [(rank - 1) % size], [(rank + 1) % size])
+        assert dg is not None
+        ns, nd = dg.Get_dist_neighbors()
+        assert list(ns) == [(rank - 1) % size]
+        assert list(nd) == [(rank + 1) % size]
+        assert dg.Get_topology() == MPI.DIST_GRAPH
+        dup, req = comm.Idup()        # mpi4py order: (newcomm, request)
+        req.Wait()
+        assert dup.Get_size() == size
+        return True
+
+    assert all(run_ranks(3, wrap(fn)))
+
+
 def test_file_nonblocking_and_split_collectives(tmp_path_factory):
     """mpi4py File nonblocking (Iwrite_at/Iread_at land on Wait) and the
     split collective Begin/End pairs."""
