@@ -169,6 +169,20 @@ class Datatype:
     def __repr__(self) -> str:
         return f"<MPI.Datatype {self._name}>"
 
+    def __eq__(self, other) -> bool:
+        # plain (predefined) types compare by element dtype, so a
+        # Get_view round-trip satisfies `etype == MPI.DOUBLE`; derived
+        # types keep identity semantics
+        if self is other:
+            return True
+        return (type(self) is Datatype and type(other) is Datatype
+                and self.np_dtype == other.np_dtype)
+
+    def __hash__(self) -> int:
+        if type(self) is Datatype:
+            return hash(("mpi-dt", str(self.np_dtype)))
+        return id(self)
+
     # -- derived-type constructors (mpi4py spelling over the native
     #    datatype engine; the results drive File.Set_view) --------------
     def _to_native(self):
@@ -465,6 +479,17 @@ def _as_array(spec) -> np.ndarray:
     return np.asarray(spec)
 
 
+def _to_native_dt(dt):
+    """Facade (or native) datatype → native datatype — the ONE coercion."""
+    return dt._to_native() if isinstance(dt, Datatype) else dt
+
+
+def _wrap_info(native) -> "Info":
+    """Native Info → facade Info (identity when already wrapped)."""
+    return native if isinstance(native, Info) \
+        else Info(dict(native.items()))
+
+
 def _copy_into(dst_spec, src) -> None:
     """Write a collective/receive result into the caller's buffer."""
     dst = _as_array(dst_spec)
@@ -625,6 +650,15 @@ class Group:
         r = self._g.rank_of(self._my_world)
         return UNDEFINED if r is None or r < 0 else r
 
+    def Compare(self, other: "Group") -> int:
+        """≈ MPI_Group_compare."""
+        mine, theirs = list(self._g.ranks), list(other._g.ranks)
+        if mine == theirs:
+            return IDENT
+        if sorted(mine) == sorted(theirs):
+            return SIMILAR
+        return UNEQUAL
+
     def Incl(self, ranks) -> "Group":
         return Group(self._g.incl(ranks), self._my_world)
 
@@ -764,22 +798,29 @@ class Comm:
         out = self._c.alltoallv(parts)
         _place_v(recvbuf, out)
 
-    def Alltoallw(self, sendspecs, recvspecs) -> None:
-        """[(buf, count, datatype), …] per peer on both sides (None =
-        empty exchange) — filled in place, the native contract."""
-        def conv(specs):
+    def Alltoallw(self, sendmsg, recvmsg) -> None:
+        """mpi4py message format: ``[buf, counts, displs, datatypes]``
+        (displacements in BYTES, one datatype per peer).  Converted to
+        the native per-peer (buf-view, datatype, count) triples; recv
+        views alias the caller's buffer so the fill is in place."""
+        def conv(msg):
+            buf, counts, displs, dts = msg
+            raw = np.asarray(buf).view(np.uint8).reshape(-1)
             out = []
-            for s in specs:
-                if s is None:
+            for r in range(self._c.size):
+                cnt = int(counts[r])
+                if cnt == 0:
                     out.append(None)
                     continue
-                buf, cnt, dt = s
-                nat = (dt._to_native() if isinstance(dt, Datatype)
-                       else dt)
-                out.append((np.asarray(buf), nat, int(cnt)))
+                nat = _to_native_dt(dts[r] if isinstance(dts, (list,
+                                                              tuple))
+                                    else dts)
+                lo = int(displs[r])
+                view = raw[lo:lo + cnt * nat.size].view(nat.base_np)
+                out.append((view, nat, cnt))
             return out
 
-        self._c.alltoallw(conv(sendspecs), conv(recvspecs))
+        self._c.alltoallw(conv(sendmsg), conv(recvmsg))
 
     # -- attributes (≈ MPI_Comm_{set,get,delete}_attr) ---------------------
     @staticmethod
@@ -807,9 +848,7 @@ class Comm:
         self._c.set_info(info)
 
     def Get_info(self) -> "Info":
-        native = self._c.get_info()
-        return native if isinstance(native, Info) \
-            else Info(dict(native.items()))
+        return _wrap_info(self._c.get_info())
 
     def Set_errhandler(self, errhandler) -> None:
         from ompi_tpu.mpi import errhandler as _eh
@@ -1554,9 +1593,9 @@ def Attach_buffer(buf) -> None:
     bytearray/array; the pool only needs its SIZE."""
     from ompi_tpu.mpi.pml import buffer_attach
 
-    nbytes = (buf.nbytes if hasattr(buf, "nbytes")
-              else len(buf))
-    buffer_attach(int(nbytes))
+    # memoryview.nbytes counts BYTES for every buffer protocol object
+    # (array.array's len() would count elements)
+    buffer_attach(int(memoryview(buf).nbytes))
 
 
 def Detach_buffer():
@@ -1680,15 +1719,9 @@ class Win:
                     self._wire(arr.reshape(-1)[:count], "Put"), offset=off)
 
     def Get(self, origin, target_rank: int, target=None) -> None:
-        dst = _as_array(origin)
-        disp, count = _target_spec(target, dst.size, need="receive")
-        off = self._disp(disp, self._w.buf.itemsize)
-        if self._reinterprets(dst.dtype):
-            raw = self._w.get(target_rank, count * dst.itemsize, offset=off)
-            out = np.ascontiguousarray(raw).view(dst.dtype)
-        else:
-            out = self._w.get(target_rank, count, offset=off)
-        _copy_into(origin, out)
+        # one definition of the byte-window read path: Rget's (the
+        # native layer defines get() as rget().wait() the same way)
+        self.Rget(origin, target_rank, target).Wait()
 
     def Accumulate(self, origin, target_rank: int, target=None,
                    op: Op = SUM) -> None:
@@ -1744,6 +1777,58 @@ class Win:
         old = self._w.compare_swap(target_rank, cmp_,
                                    val.reshape(-1)[0], offset=off)
         _copy_into(result, np.asarray(old).reshape(1))
+
+    # -- request-based RMA (results/completion via Request) ----------------
+    def Rput(self, origin, target_rank: int, target=None) -> "Request":
+        arr = _as_array(origin)
+        disp, count = _target_spec(target, arr.size, need="origin")
+        off = self._disp(disp, self._w.buf.itemsize)
+        return Request(self._w.rput(
+            target_rank, self._wire(arr.reshape(-1)[:count], "Rput"),
+            offset=off))
+
+    def Rget(self, origin, target_rank: int, target=None) -> "Request":
+        dst = _as_array(origin)
+        disp, count = _target_spec(target, dst.size, need="receive")
+        off = self._disp(disp, self._w.buf.itemsize)
+        if self._reinterprets(dst.dtype):
+            req = self._w.rget(target_rank, count * dst.itemsize,
+                               offset=off)
+
+            def land(out):
+                _copy_into(origin,
+                           np.ascontiguousarray(out).view(dst.dtype))
+        else:
+            req = self._w.rget(target_rank, count, offset=off)
+
+            def land(out):
+                _copy_into(origin, out)
+
+        return Request(req, transform=land)
+
+    def Raccumulate(self, origin, target_rank: int, target=None,
+                    op: Op = SUM) -> "Request":
+        arr = _as_array(origin)
+        disp, count = _target_spec(target, arr.size, need="origin")
+        off = self._disp(disp, self._w.buf.itemsize)
+        return Request(self._w.raccumulate(
+            target_rank,
+            self._wire(arr.reshape(-1)[:count], "Raccumulate", op),
+            op=_native_op(op), offset=off))
+
+    def Flush_local(self, rank: int) -> None:
+        self._w.flush_local(rank)
+
+    def Flush_local_all(self) -> None:
+        self._w.flush_local_all()
+
+    def Test(self) -> bool:
+        """≈ MPI_Win_test (PSCW exposure-epoch poll)."""
+        return bool(self._w.test_epoch())
+
+    def Get_group(self) -> "Group":
+        g = self._w.get_group()
+        return Group(g, g.world_rank(self._w.comm.rank))
 
     # -- attributes --------------------------------------------------------
     def Get_attr(self, keyval):
@@ -2001,6 +2086,42 @@ class File:
         self._f.write_ordered_end()
 
     # -- management --------------------------------------------------------
+    def Get_view(self) -> tuple:
+        disp, etype, ftype = self._f.get_view()
+
+        def wrap(nat):
+            if getattr(nat, "base_np", None) is not None \
+                    and nat.is_contiguous and nat.size == nat.base_np.itemsize:
+                return Datatype(nat.base_np, nat.get_name())
+            base = Datatype(nat.base_np, str(nat.base_np))
+            return _Derived(nat, base)
+
+        return disp, wrap(etype), wrap(ftype)
+
+    def Get_byte_offset(self, offset: int) -> int:
+        return self._f.get_byte_offset(offset)
+
+    def Get_type_extent(self, datatype) -> int:
+        return self._f.get_type_extent(_to_native_dt(datatype))
+
+    def Set_size(self, size: int) -> None:
+        self._f.set_size(size)
+
+    def Get_amode(self) -> int:
+        return self._f.get_amode()
+
+    def Set_info(self, info) -> None:
+        self._f.set_info(info)
+
+    def Get_info(self) -> "Info":
+        return _wrap_info(self._f.get_info())
+
+    def Seek_shared(self, offset: int, whence: int = SEEK_SET) -> None:
+        self._f.seek_shared(offset, whence)
+
+    def Get_position_shared(self) -> int:
+        return self._f.get_position_shared()
+
     def Sync(self) -> None:
         self._f.sync()
 

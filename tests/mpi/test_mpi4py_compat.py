@@ -594,6 +594,58 @@ def test_win_allocate_typed_roundtrip():
     assert all(run_ranks(2, wrap(fn)))
 
 
+def test_win_request_rma_and_file_management():
+    """Request-based RMA (Rput/Rget land on Wait) + Group/Win/File
+    management accessors."""
+    def fn(comm):
+        rank, size = comm.rank, comm.size
+        mem = np.zeros(4, np.float64)
+        win = MPI.Win.Create(mem, disp_unit=8, comm=comm)
+        win.Lock((rank + 1) % size)
+        r = win.Rput(np.full(1, float(rank + 1)), (rank + 1) % size,
+                     target=0)
+        r.Wait()
+        win.Flush((rank + 1) % size)
+        win.Unlock((rank + 1) % size)
+        comm.Barrier()
+        assert mem[0] == float((rank - 1) % size + 1)
+        got = np.zeros(1)
+        win.Lock((rank + 1) % size, MPI.LOCK_SHARED)
+        win.Rget(got, (rank + 1) % size, target=0).Wait()
+        win.Unlock((rank + 1) % size)
+        assert got[0] == float(rank + 1)
+        g = win.Get_group()
+        assert g.Get_size() == size and g.Get_rank() == rank
+        assert g.Compare(comm.Get_group()) == MPI.IDENT
+        win.Free()
+        return True
+
+    assert all(run_ranks(3, wrap(fn)))
+
+
+def test_file_management_accessors(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("iomgmt")
+    path = str(tmp / "m.bin")
+
+    def fn(comm):
+        f = MPI.File.Open(comm, path, MPI.MODE_RDWR | MPI.MODE_CREATE)
+        assert f.Get_amode() & MPI.MODE_RDWR
+        ft = MPI.DOUBLE.Create_vector(4, 1, comm.size).Commit()
+        f.Set_view(disp=8 * comm.rank, etype=MPI.DOUBLE, filetype=ft)
+        disp, et, ftype = f.Get_view()
+        assert disp == 8 * comm.rank
+        assert et == MPI.DOUBLE                 # facade round-trip
+        assert ftype.Get_size() == ft.Get_size()
+        assert f.Get_byte_offset(2) >= 16       # 2 etypes into the view
+        f.Set_size(0)                            # collective truncate
+        f.Write_at_all(0, np.arange(4, dtype=np.float64) + comm.rank)
+        assert f.Get_size() > 0
+        f.Close()
+        return True
+
+    assert all(run_ranks(3, wrap(fn)))
+
+
 def test_nonblocking_collective_family_lands_in_buffers():
     """Igather/Iscatter/Iallgather/Ialltoall/Iscan/Iexscan land their
     results into the caller's buffer on Wait (transform path)."""
@@ -646,6 +698,19 @@ def test_alltoallv_attrs_info_errhandler_compare():
             np.testing.assert_array_equal(
                 recv[src * (rank + 1):(src + 1) * (rank + 1)],
                 np.full(rank + 1, float(src * 10 + rank)))
+        # Alltoallw, mpi4py message format: [buf, counts, displs, dts]
+        # — every peer exchanges 2 doubles here
+        wsend = np.concatenate(
+            [np.full(2, float(rank * 10 + k)) for k in range(size)])
+        wrecv = np.zeros(size * 2, np.float64)
+        bytes_displs = (np.arange(size) * 16).tolist()
+        comm.Alltoallw(
+            [wsend, [2] * size, bytes_displs, [MPI.DOUBLE] * size],
+            [wrecv, [2] * size, bytes_displs, [MPI.DOUBLE] * size])
+        for src in range(size):
+            np.testing.assert_array_equal(
+                wrecv[src * 2:(src + 1) * 2],
+                np.full(2, float(src * 10 + rank)))
         # attributes + TAG_UB + keyvals
         assert comm.Get_attr(MPI.TAG_UB) > 1 << 20
         kv = MPI.Comm.Create_keyval()
