@@ -351,6 +351,9 @@ EVT_RECV_POST = "recv_post"        # irecv posted
 EVT_MATCH = "match"                # incoming frame matched a posted recv
 EVT_UNEXPECTED = "unexpected"      # incoming frame queued unmatched
 EVT_DELIVER = "deliver"            # payload delivered, request complete
+EVT_PEER_REVIVED = "peer_revived"  # a peer's new incarnation adopted —
+# the hook message-log replay (ckpt/msglog auto_replay) recovers sends
+# that died with the old incarnation's transport
 
 
 class PmlOb1:
@@ -1044,6 +1047,12 @@ class PmlOb1:
         if self._peer_inc.get(peer, 0) >= inc:
             return
         self._peer_inc[peer] = inc
+        # single choke point for "this peer came back as a new life"
+        # (reached from the rebind frame AND the si-stamp fast path);
+        # frames sent into the dead incarnation's ring are gone — the
+        # event lets a sender-side message log replay them (_emit only
+        # enqueues; dispatch happens outside this lock)
+        self._emit(EVT_PEER_REVIVED, peer=peer, incarnation=inc)
         # frames toward the revived peer must carry ep >= its incarnation
         # (its receiver fences lower epochs) — learned here even when the
         # 'si' stamp outran the rebind frame that also updates the card
@@ -1175,6 +1184,12 @@ class PmlOb1:
                 inc = hdr.get("inc", 1)
                 self._peer_epoch[peer] = inc
                 self._adopt_incarnation(peer, inc)
+            # the adopt enqueued EVT_PEER_REVIVED — dispatch NOW (outside
+            # the lock, per the listener contract): a blocked survivor
+            # may never issue another call that would drain, and the
+            # msglog auto-replay hanging off this event is what unblocks
+            # the revived peer
+            self._drain_events()
         elif t == "rnack":  # ready send found no posted recv
             with self._lock:
                 state = self._send_states.pop(hdr["sid"], None)

@@ -185,7 +185,24 @@ def finalize(_collective: bool = True) -> None:
 
         try:
             if world.size > 1 and _collective:
-                world.barrier()
+                client = _state["client"]
+                # Rendezvous on the PMIx CONTROL PLANE, not a p2p
+                # barrier: after a respawn, a barrier frame stamped
+                # before its sender adopted a LATE revival's incarnation
+                # is epoch-fenced (or died in the old incarnation's
+                # inbox) and — being collective-internal — is in no
+                # message log; finalize's barrier is the one collective
+                # that cannot be re-run, so the job hangs.  Ranks can't
+                # even agree on "a respawn happened" (the announce races
+                # finalize entry), so the fence is used UNCONDITIONALLY:
+                # the control plane tracked every death/revival (fences
+                # re-evaluate on death; a revived rank re-ran the init
+                # fence, so epoch counters align) — the reference's
+                # runtime-mediated shutdown shape.
+                if client is not None:
+                    client.fence()
+                else:
+                    world.barrier()
                 # leave the device view while every rank is still alive
                 # (post-barrier). jax.distributed.shutdown() synchronizes
                 # across tasks internally, so all ranks must call it

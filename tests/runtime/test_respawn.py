@@ -116,3 +116,74 @@ def test_respawn_across_daemon_tree(tmp_path):
     assert "rank 1 resumed at step 3 from snapshot 2" in r.stdout
     assert "rank 1 acc=60" in r.stdout
     assert "rank 1 got ack 61" in r.stdout
+
+
+CHAOS_APP = r"""
+import os
+import numpy as np
+import ompi_tpu
+from ompi_tpu.ckpt.msglog import MessageLog
+from ompi_tpu.ckpt.store import SnapshotStore
+
+comm = ompi_tpu.init()
+rank, size = comm.rank, comm.size
+store = SnapshotStore(os.environ["CKPT_DIR"], job=f"rank{rank}")
+restarted = int(os.environ.get("OMPI_TPU_RESTART", "0"))
+
+# the uncoordinated-recovery recipe: sender-side message log with
+# auto-replay on peer revival.  No mid-run mark(): a mark taken while a
+# peer is dead-but-undetected races the failure window and truncates
+# exactly the sends the revived peer needs (marking is safe only at
+# points where delivery is KNOWN, e.g. after an app-level ack).  A
+# replayed message the peer already consumed parks harmlessly in its
+# unexpected queue — per-step tags never re-match.
+log = MessageLog(comm).attach(auto_replay=True)
+
+start, acc = 0, 0.0
+if restarted:
+    seq = store.latest()
+    state = store.load_rank(seq, 0)
+    start, acc = int(state["step"]) + 1, float(state["acc"])
+    print(f"rank {rank} resumed at step {start}", flush=True)
+
+# kill schedule: three DIFFERENT ranks die at three different steps
+# (first life only) — every surviving rank must rebind to each revived
+# peer in turn while the ring keeps moving
+DEATHS = {1: 2, 2: 4, 3: 6}
+
+right, left = (rank + 1) % size, (rank - 1) % size
+for step in range(start, 8):
+    out = np.array([float(rank * 100 + step)])
+    sreq = comm.isend(out, dest=right, tag=step)
+    got = comm.recv(source=left, tag=step)
+    sreq.wait()
+    assert float(got[0]) == left * 100 + step, (step, got)
+    acc += float(got[0])
+    store.write_rank(step, 0, {"step": np.int64(step),
+                               "acc": np.float64(acc)})
+    store.commit(step, 1)
+    if not restarted and DEATHS.get(rank) == step:
+        os._exit(9)
+
+print(f"rank {rank} chaos done acc={acc:.0f}", flush=True)
+ompi_tpu.finalize()
+"""
+
+
+def test_chaos_multiple_sequential_failures(tmp_path):
+    """Three different ranks die at different steps under sustained ring
+    traffic; each revives from its snapshot, peers rebind, and the
+    message log auto-replays the sends that died with the old
+    incarnation's transport.  The single-kill test proves one heal with
+    the revived rank speaking first; this proves repeated failures AND
+    the lost-send window (vprotocol-style sender logging, SURVEY §2.4
+    row 60) recover end to end."""
+    r = tpurun("-np", "4", "--mca", "errmgr", "respawn", "--",
+               sys.executable, "-c", CHAOS_APP,
+               env_extra={"CKPT_DIR": str(tmp_path)}, timeout=240)
+    out = r.stdout + r.stderr
+    assert r.returncode == 0, out[-3000:]
+    for rank in range(4):
+        assert f"rank {rank} chaos done" in out, out[-3000:]
+    for rank in (1, 2, 3):
+        assert f"rank {rank} resumed at step" in out, out[-3000:]
