@@ -1677,6 +1677,62 @@ class Win:
         arr = np.zeros(size, np.uint8)
         return cls.Create(arr, disp_unit, info, comm)
 
+    @classmethod
+    def Allocate_shared(cls, size: int, disp_unit: int = 1, info=None,
+                        comm: "Comm" = None) -> "_SharedWin":
+        """≈ MPI_Win_allocate_shared (osc/sm model): one shm segment,
+        every rank owns a slice; ``Shared_query`` returns zero-copy
+        views and data moves by direct load/store + ``Sync`` — the
+        message-window RMA verbs raise with that explanation.  Requires
+        a single-host communicator (Split_type(COMM_TYPE_SHARED)
+        first).  ``info`` is accepted for parity (osc/sm has no lock
+        service to hint)."""
+        from ompi_tpu.mpi.osc import SharedWindow as _SW
+
+        if comm is None:
+            comm = COMM_SELF
+        return _SharedWin(_SW(comm._c, local_size=int(size)), disp_unit)
+
+    def Shared_query(self, rank: int) -> tuple:
+        raise Exception(
+            "Shared_query is only valid on a Win.Allocate_shared window")
+
+    @classmethod
+    def Create_dynamic(cls, info=None, comm: "Comm" = None) -> "Win":
+        from ompi_tpu.mpi.osc import Window as _NativeWin
+
+        if comm is None:
+            comm = COMM_SELF
+        native = _NativeWin.create_dynamic(comm._c, info=info)
+        return cls(native, 1)
+
+    def Attach(self, memory) -> int:
+        """≈ MPI_Win_attach; returns the region's base WINDOW OFFSET —
+        the value peers use as the target displacement (this facade
+        addresses dynamic windows by offset, not virtual address)."""
+        return self._w.attach(np.asarray(memory))
+
+    def Detach(self, memory_or_base) -> None:
+        """Accepts the buffer passed to Attach (mpi4py convention) or
+        the base offset Attach returned."""
+        if isinstance(memory_or_base, (int, np.integer)):
+            self._w.detach(int(memory_or_base))
+            return
+        arr = np.asarray(memory_or_base).reshape(-1)
+        want = arr.__array_interface__["data"][0]
+        for base, region in list(self._w._regions.items()):
+            if region.__array_interface__["data"][0] == want:
+                self._w.detach(base)
+                return
+        raise Exception(
+            "Detach: this buffer is not attached to the window")
+
+    def Set_name(self, name: str) -> None:
+        self._w.name = str(name)
+
+    def Get_name(self) -> str:
+        return getattr(self._w, "name", "win")
+
     def _disp(self, disp: int, itemsize: int) -> int:
         nbytes = disp * self._du
         if nbytes % itemsize:
@@ -1846,6 +1902,11 @@ class Win:
     def Fence(self, assertion: int = 0) -> None:
         self._w.fence()
 
+    def Sync(self) -> None:
+        """≈ MPI_Win_sync (message windows: no-op — delivery orders
+        stores; the shared-window subclass overrides with the real
+        memory barrier)."""
+
     def Lock(self, rank: int, lock_type: int = LOCK_EXCLUSIVE,
              assertion: int = 0) -> None:
         self._w.lock(rank, exclusive=lock_type == LOCK_EXCLUSIVE)
@@ -1893,6 +1954,180 @@ class Win:
     @property
     def memory(self):
         return self._w.buf
+
+
+class _SharedWin(Win):
+    """A Win over the osc/sm SharedWindow: the RMA verbs are served by
+    direct memcpy/load-store on the shared mapping (the osc/sm model —
+    the memory IS the window).  Lock epochs are consistency points only
+    (the mapping is cache-coherent; there is no lock service), and
+    accumulates are NOT hardware-atomic per element — concurrent
+    conflicting accumulates from different origins may interleave (use
+    ``fetch_add`` for lock-free counters).  PSCW epochs are not defined
+    on this component and raise."""
+
+    def Shared_query(self, rank: int) -> tuple:
+        """(size_bytes, disp_unit, zero-copy buf-view) of rank's slice."""
+        view = self._w.shared_query(rank)
+        return view.nbytes, self._du, view
+
+    # -- data movement: memcpy on the mapping -----------------------------
+    def _bytes_of(self, rank: int) -> np.ndarray:
+        return self._w.shared_query(rank).view(np.uint8)
+
+    def Put(self, origin, target_rank: int, target=None) -> None:
+        arr = _as_array(origin)
+        disp, count = _target_spec(target, arr.size, need="origin")
+        raw = np.ascontiguousarray(
+            arr.reshape(-1)[:count]).view(np.uint8).reshape(-1)
+        dst = self._bytes_of(target_rank)
+        off = disp * self._du
+        dst[off:off + raw.size] = raw
+
+    def Get(self, origin, target_rank: int, target=None) -> None:
+        dst = _as_array(origin)
+        disp, count = _target_spec(target, dst.size, need="receive")
+        src = self._bytes_of(target_rank)
+        off = disp * self._du
+        nbytes = count * dst.itemsize
+        _copy_into(origin, np.ascontiguousarray(
+            src[off:off + nbytes]).view(dst.dtype))
+
+    def _seg(self, target_rank: int, disp: int, count: int, dtype):
+        raw = self._bytes_of(target_rank)
+        off = disp * self._du
+        return raw[off:off + count * dtype.itemsize].view(dtype)
+
+    def Accumulate(self, origin, target_rank: int, target=None,
+                   op: Op = SUM) -> None:
+        arr = _as_array(origin)
+        disp, count = _target_spec(target, arr.size, need="origin")
+        src = arr.reshape(-1)[:count]
+        seg = self._seg(target_rank, disp, count, arr.dtype)
+        nat = _native_op(op)
+        seg[:] = nat.host(seg.copy(), src)
+
+    def Get_accumulate(self, origin, result, target_rank: int,
+                       target=None, op: Op = SUM) -> None:
+        arr = _as_array(origin)
+        disp, count = _target_spec(target, arr.size, need="origin")
+        src = arr.reshape(-1)[:count]
+        seg = self._seg(target_rank, disp, count, arr.dtype)
+        old = seg.copy()
+        seg[:] = _native_op(op).host(old.copy(), src)
+        _copy_into(result, old)
+
+    def Fetch_and_op(self, origin, result, target_rank: int,
+                     target_disp: int = 0, op: Op = SUM) -> None:
+        arr = _as_array(origin)
+        seg = self._seg(target_rank, int(target_disp), 1, arr.dtype)
+        old = seg.copy()
+        seg[:] = _native_op(op).host(old.copy(), arr.reshape(-1)[:1])
+        _copy_into(result, old)
+
+    def Compare_and_swap(self, origin, compare, result,
+                         target_rank: int, target_disp: int = 0) -> None:
+        arr = _as_array(origin)
+        cmp_ = _as_array(compare).reshape(-1)[0]
+        seg = self._seg(target_rank, int(target_disp), 1, arr.dtype)
+        old = seg.copy()
+        if old[0] == cmp_:
+            seg[0] = arr.reshape(-1)[0]
+        _copy_into(result, old)
+
+    def Rput(self, origin, target_rank: int, target=None) -> "Request":
+        from ompi_tpu.mpi.request import CompletedRequest
+
+        self.Put(origin, target_rank, target)
+        return Request(CompletedRequest())
+
+    def Rget(self, origin, target_rank: int, target=None) -> "Request":
+        from ompi_tpu.mpi.request import CompletedRequest
+
+        self.Get(origin, target_rank, target)
+        return Request(CompletedRequest())
+
+    def Raccumulate(self, origin, target_rank: int, target=None,
+                    op: Op = SUM) -> "Request":
+        from ompi_tpu.mpi.request import CompletedRequest
+
+        self.Accumulate(origin, target_rank, target, op)
+        return Request(CompletedRequest())
+
+    # -- synchronization: coherence points, no lock service ---------------
+    def Fence(self, assertion: int = 0) -> None:
+        self._w.sync()              # memory barrier + comm barrier
+
+    def Sync(self) -> None:
+        self._w.sync()
+
+    def Lock(self, rank: int, lock_type: int = LOCK_EXCLUSIVE,
+             assertion: int = 0) -> None:
+        pass                        # coherence only; see class docstring
+
+    def Unlock(self, rank: int) -> None:
+        pass
+
+    def Lock_all(self, assertion: int = 0) -> None:
+        pass
+
+    def Unlock_all(self) -> None:
+        pass
+
+    def Flush(self, rank: int) -> None:
+        pass
+
+    def Flush_all(self) -> None:
+        pass
+
+    def Flush_local(self, rank: int) -> None:
+        pass
+
+    def Flush_local_all(self) -> None:
+        pass
+
+    def _no_pscw(self, what: str):
+        raise Exception(
+            f"{what} is not defined on a Win.Allocate_shared window "
+            f"(osc/sm has no PSCW epochs) — use Fence()/Sync()")
+
+    def Start(self, group, assertion: int = 0) -> None:
+        self._no_pscw("Start")
+
+    def Complete(self) -> None:
+        self._no_pscw("Complete")
+
+    def Post(self, group, assertion: int = 0) -> None:
+        self._no_pscw("Post")
+
+    def Wait(self) -> None:
+        self._no_pscw("Wait")
+
+    def Test(self) -> bool:
+        self._no_pscw("Test")
+
+    def Get_group(self) -> "Group":
+        g = self._w.comm.group
+        return Group(g, g.world_rank(self._w.comm.rank))
+
+    def Get_attr(self, keyval):
+        if keyval is WIN_SIZE:
+            return self._w.shared_query(self._w.comm.rank).nbytes
+        if keyval is WIN_DISP_UNIT:
+            return self._du
+        if keyval is WIN_BASE:
+            from ompi_tpu.mpi.datatype import get_address
+
+            return get_address(self._w.shared_query(self._w.comm.rank))
+        return None
+
+    @property
+    def memory(self):
+        return self._w.shared_query(self._w.comm.rank)
+
+    def fetch_add(self, rank: int, offset8: int, delta: int) -> int:
+        """The osc/sm lock-free counter (native u64 atomics)."""
+        return self._w.fetch_add(rank, offset8, delta)
 
 
 class File:

@@ -142,11 +142,12 @@ class Window:
 
     @classmethod
     def create_dynamic(cls, comm, dtype=np.uint8,
-                       name: str = "dynwin") -> "Window":
+                       name: str = "dynwin", info=None) -> "Window":
         """≈ MPI_Win_create_dynamic: a window with no memory attached;
         expose regions later with :meth:`attach` (collective constructor,
-        local attach)."""
-        return cls(comm, name=name, dtype=dtype, _dynamic=True)
+        local attach).  ``info`` hints (e.g. no_locks) apply as on a
+        created window."""
+        return cls(comm, name=name, dtype=dtype, info=info, _dynamic=True)
 
     def attach(self, array: np.ndarray) -> int:
         """≈ MPI_Win_attach (local): expose ``array`` through this dynamic

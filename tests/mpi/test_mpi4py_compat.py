@@ -594,6 +594,55 @@ def test_win_allocate_typed_roundtrip():
     assert all(run_ranks(2, wrap(fn)))
 
 
+def test_win_allocate_shared_and_dynamic():
+    """Win.Allocate_shared (osc/sm: one segment, zero-copy Shared_query
+    views) and Win.Create_dynamic + Attach/Detach."""
+    def fn(comm):
+        rank, size = comm.rank, comm.size
+        node = comm.Split_type(MPI.COMM_TYPE_SHARED)
+        win = MPI.Win.Allocate_shared(8, disp_unit=1, comm=node)
+        nbytes, du, mine = win.Shared_query(node.Get_rank())
+        assert nbytes == 8 and du == 1
+        mine[:] = node.Get_rank() + 1
+        win.Fence()                     # sync: stores visible to peers
+        for r in range(node.Get_size()):
+            _n, _d, view = win.Shared_query(r)
+            assert view[0] == r + 1, (r, view[:2])
+        assert win.Get_attr(MPI.WIN_SIZE) == 8
+        # the RMA verbs work as memcpy on the mapping (osc/sm): put a
+        # byte into my RIGHT neighbor's slice, fence, check mine
+        nrank, nsize = node.Get_rank(), node.Get_size()
+        win.Lock((nrank + 1) % nsize)   # coherence-only, must not raise
+        win.Put(np.full(1, 200, np.uint8), (nrank + 1) % nsize,
+                target=4)
+        win.Unlock((nrank + 1) % nsize)
+        win.Fence()
+        assert mine[4] == 200
+        got = np.zeros(1, np.uint8)
+        win.Get(got, (nrank + 1) % nsize, target=0)
+        assert got[0] == (nrank + 1) % nsize + 1
+        import pytest
+        with pytest.raises(MPI.Exception, match="PSCW"):
+            win.Start(node.Get_group())
+        win.Fence()
+        win.Free()                      # unlinks the /dev/shm segment
+        # dynamic window: expose a region, peers Put at its base offset
+        dyn = MPI.Win.Create_dynamic(comm=comm)
+        region = np.zeros(4, np.uint8)
+        base = dyn.Attach(region)
+        dyn.Fence()
+        peer = (rank + 1) % size
+        bases = comm.allgather(base)
+        dyn.Put(np.full(2, 7, np.uint8), peer, target=bases[peer])
+        dyn.Fence()
+        assert region[0] == 7 and region[1] == 7, region
+        dyn.Detach(base)
+        dyn.Free()
+        return True
+
+    assert all(run_ranks(3, wrap(fn)))
+
+
 def test_win_request_rma_and_file_management():
     """Request-based RMA (Rput/Rget land on Wait) + Group/Win/File
     management accessors."""
