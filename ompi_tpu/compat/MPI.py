@@ -361,6 +361,14 @@ class Op:
     def Free(self) -> None:
         pass
 
+    def Reduce_local(self, inbuf, inoutbuf) -> None:
+        """≈ MPI_Reduce_local: inoutbuf = op(inbuf, inoutbuf), purely
+        local — delegates to the native helper, which enforces the
+        equal-counts contract (a silent broadcast/truncate would give
+        wrong reductions)."""
+        _op_mod.reduce_local(_as_array(inbuf), _as_array(inoutbuf),
+                             self._native)
+
     def Is_commutative(self) -> bool:
         return _op_mod.op_commutative(self._native)
 
@@ -442,17 +450,47 @@ def _fill_status(status: Optional[Status], native) -> None:
 
 
 # ---------------------------------------------------------------------------
-# pickle framing for the lowercase API
+# pickle framing for the lowercase API (≈ mpi4py's MPI.pickle hook:
+# swap dumps/loads — e.g. for dill or a protocol pin — and every
+# lowercase send/recv/bcast uses it)
 # ---------------------------------------------------------------------------
 
+_STDPICKLE = pickle   # stable stdlib alias: the name `pickle` is
+# re-bound to the serializer INSTANCE at module end (mpi4py spelling)
+
+
+class Pickle:
+    def __init__(self, dumps=None, loads=None, protocol=None):
+        self.PROTOCOL = (_STDPICKLE.HIGHEST_PROTOCOL
+                         if protocol is None else protocol)
+        self._dumps = dumps or (lambda o, p: _STDPICKLE.dumps(o, p))
+        self._loads = loads or _STDPICKLE.loads
+
+    def dumps(self, obj) -> bytes:
+        return self._dumps(obj, self.PROTOCOL)
+
+    def loads(self, data) -> Any:
+        return self._loads(bytes(data))
+
+
+pickle_impl = Pickle()
+
+
+def _serializer() -> "Pickle":
+    """The LIVE serializer: read through the module global so
+    ``MPI.pickle = MPI.Pickle(dumps=..., loads=...)`` (the mpi4py idiom)
+    swaps serialization for the whole lowercase API."""
+    p = globals().get("pickle")
+    return p if isinstance(p, Pickle) else pickle_impl
+
+
 def _dumps(obj) -> np.ndarray:
-    return np.frombuffer(
-        pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL),
-        dtype=np.uint8).copy()
+    return np.frombuffer(_serializer().dumps(obj), dtype=np.uint8).copy()
 
 
 def _loads(arr) -> Any:
-    return pickle.loads(np.ascontiguousarray(arr).view(np.uint8).tobytes())
+    return _serializer().loads(
+        np.ascontiguousarray(arr).view(np.uint8).tobytes())
 
 
 # ---------------------------------------------------------------------------
@@ -2477,9 +2515,15 @@ def Get_library_version() -> str:
     return ompi_tpu.get_library_version()
 
 
-def pickle_dumps(obj) -> bytes:  # exposed like mpi4py.MPI.pickle
-    return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+def pickle_dumps(obj) -> bytes:  # legacy helpers; MPI.pickle is the hook
+    return _serializer().dumps(obj)
 
 
 def pickle_loads(data: bytes) -> Any:
-    return pickle.loads(data)
+    return _serializer().loads(data)
+
+
+# mpi4py spells the serializer instance MPI.pickle (the stdlib module is
+# aliased away above) — assigning .dumps/.loads or a new Pickle swaps
+# serialization for the whole lowercase API
+globals()["pickle"] = pickle_impl

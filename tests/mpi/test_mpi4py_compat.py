@@ -594,6 +594,45 @@ def test_win_allocate_typed_roundtrip():
     assert all(run_ranks(2, wrap(fn)))
 
 
+def test_reduce_local_and_pickle_hook():
+    """Op.Reduce_local (local fold, no communication) and the MPI.pickle
+    serializer hook the lowercase API routes through."""
+    b = np.array([10.0, 20.0])
+    MPI.SUM.Reduce_local(np.array([1.0, 2.0]), b)
+    np.testing.assert_array_equal(b, [11.0, 22.0])
+    MPI.MAX.Reduce_local(np.array([100.0, 1.0]), b)
+    np.testing.assert_array_equal(b, [100.0, 22.0])
+
+    # equal-counts contract enforced (no silent broadcast/truncate);
+    # native-layer errors surface as the native MPIException (a
+    # RuntimeError, like MPI.Exception)
+    import pytest
+    with pytest.raises(RuntimeError, match="shape"):
+        MPI.SUM.Reduce_local(np.ones(1), b)
+
+    assert isinstance(MPI.pickle, MPI.Pickle)
+    calls = []
+
+    def my_dumps(obj, protocol):
+        calls.append(1)
+        import pickle as std
+
+        return std.dumps(obj, protocol)
+
+    orig = MPI.pickle
+    # the PUBLIC swap idiom: replace the whole serializer instance
+    MPI.pickle = MPI.Pickle(dumps=my_dumps)
+    try:
+        def fn(comm):
+            return comm.bcast({"v": 7} if comm.rank == 0 else None,
+                              root=0)
+
+        out = run_ranks(2, wrap(fn))
+        assert out[1]["v"] == 7 and calls
+    finally:
+        MPI.pickle = orig
+
+
 def test_win_allocate_shared_and_dynamic():
     """Win.Allocate_shared (osc/sm: one segment, zero-copy Shared_query
     views) and Win.Create_dynamic + Attach/Detach."""
