@@ -46,6 +46,23 @@ register_var("errmgr", "max_restarts", VarType.SIZE, 2,
              "before falling back to job abort")
 
 
+def apply_host_plane_policy(errmgr, env: dict, *base_envs: dict) -> None:
+    """errmgr/respawn is HOST-plane recovery: a revived rank cannot
+    rejoin the coordination service, and survivors' jax.distributed
+    threads then pin their processes at exit (a post-finalize spin).
+    The policy implies the plane — when respawn is selected, launch app
+    processes device-plane-off unless the user set the var explicitly
+    (in ``env`` or any of ``base_envs``)."""
+    from ompi_tpu.core.config import var_registry
+
+    if getattr(errmgr, "NAME", "") != "respawn":
+        return
+    key = var_registry.ENV_PREFIX + "multihost_auto_init"
+    if any(key in e for e in (env, *base_envs)):
+        return
+    env[key] = "0"
+
+
 @errmgr_framework.component
 class ErrmgrAbort(Component):
     NAME = "abort"

@@ -86,6 +86,7 @@ class LocalLauncher:
         app = job.apps[proc.app_idx]
         env = dict(os.environ)
         env.update(app.env)
+        errmgr_mod.apply_host_plane_policy(self._errmgr, env)
         pypath = env.get("PYTHONPATH", "")
         if root not in pypath.split(os.pathsep):
             env["PYTHONPATH"] = (

@@ -238,6 +238,10 @@ class MultiHostLauncher:
             on_abort=lambda r, s, m: self._on_abort(self._cur_job, r, s, m))
         app = job.apps[0]
         env = dict(app.env)
+        # the xcast env overlays the daemons' os.environ (orted merge
+        # order), so the client's own environ counts as an explicit
+        # user setting here
+        errmgr_mod.apply_host_plane_policy(self._errmgr, env, os.environ)
         env[pmix.ENV_URI] = self.server.uri.replace("0.0.0.0",
                                                     self._my_address())
         env[pmix.ENV_SIZE] = str(job.np)

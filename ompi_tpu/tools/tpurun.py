@@ -205,10 +205,22 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.dvm_submit:
         from ompi_tpu.runtime import dvm
+        from ompi_tpu.runtime import pmix as _pmix
 
-        # --mca (and friends) configure the APP processes, which run
-        # under the DVM server — ship them as per-job env, not local env
-        job_env = {var_registry.ENV_PREFIX + k: v for k, v in args.mca}
+        # ship the CLIENT's environment as the job env (orte-submit /
+        # mpirun semantics: app processes see the submitter's variables,
+        # overlaid on the daemon's own env) — minus the per-rank/per-job
+        # identity vars the launcher owns and the HOST-LOCAL vars whose
+        # client values would break ranks on remote (ssh) daemons.  The
+        # --mca pairs were exported into os.environ above, so they ride
+        # along.
+        _skip = {_pmix.ENV_URI, _pmix.ENV_RANK, _pmix.ENV_SIZE,
+                 _pmix.ENV_JOBID, _pmix.ENV_LOCAL_RANK, _pmix.ENV_CHIP,
+                 "OMPI_TPU_RESTART", "OMPI_TPU_FAKE_HOST",
+                 "PATH", "HOME", "TMPDIR", "TMP", "TEMP", "PWD",
+                 "OLDPWD", "SHLVL", "HOSTNAME", "LD_LIBRARY_PATH",
+                 "LD_PRELOAD", "VIRTUAL_ENV", "PYTHONHOME"}
+        job_env = {k: v for k, v in os.environ.items() if k not in _skip}
         if args.tag is not None:
             job_env[var_registry.ENV_PREFIX + "launcher_tag_output"] = \
                 "1" if args.tag else "0"

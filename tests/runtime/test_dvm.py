@@ -6,6 +6,7 @@ launch because the daemon tree (and on real pods, the TPU runtime
 warm-up) is already up.
 """
 
+import contextlib
 import json
 import os
 import subprocess
@@ -39,17 +40,17 @@ def _tpurun(*args, timeout=120):
         cwd=REPO)
 
 
-@pytest.fixture
-def dvm(tmp_path):
+@contextlib.contextmanager
+def _standing_vm(tmp_path, *extra_args):
+    """Start a DVM, wait for its URI, always stop it."""
     uri = str(tmp_path / "dvm.uri")
     server = _tpurun_bg("--dvm-start", "--hosts", "2", "--slots", "4",
-                        "--dvm-uri", uri)
+                        *extra_args, "--dvm-uri", uri)
     deadline = time.monotonic() + 60
     try:
         while not os.path.exists(uri):
             if server.poll() is not None:
-                raise AssertionError(
-                    f"dvm died: {server.stderr.read()}")
+                raise AssertionError(f"dvm died: {server.stderr.read()}")
             if time.monotonic() > deadline:
                 raise AssertionError("dvm uri never appeared")
             time.sleep(0.1)
@@ -60,6 +61,19 @@ def dvm(tmp_path):
             server.wait(timeout=15)
         except subprocess.TimeoutExpired:
             server.kill()
+
+
+@pytest.fixture
+def dvm(tmp_path):
+    with _standing_vm(tmp_path) as uri:
+        yield uri
+
+
+@pytest.fixture
+def dvm_respawn(tmp_path):
+    """A standing VM whose errmgr policy is respawn (set at start)."""
+    with _standing_vm(tmp_path, "--mca", "errmgr", "respawn") as uri:
+        yield uri
 
 
 def test_two_jobs_one_vm_second_faster(dvm, tmp_path):
@@ -246,3 +260,24 @@ def test_dvm_runs_mpi4py_facade_script(dvm):
     assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-1500:])
     for rank in range(3):
         assert f"facade rank {rank}/3 ok" in r.stdout
+
+
+def test_dvm_respawn_recovers_rank(dvm_respawn, tmp_path):
+    """errmgr/respawn through the STANDING VM: a rank dies mid-job, the
+    daemon revives it from its snapshot, p2p heals — and the job exits
+    cleanly (the launcher runs respawn jobs device-plane-off
+    automatically: a revived rank can't rejoin the coordination
+    service, whose threads would otherwise pin survivors at exit)."""
+    from tests.runtime.test_respawn import RESPAWN_APP
+
+    env = _env()
+    env["CKPT_DIR"] = str(tmp_path)
+    r = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.tpurun",
+         "--dvm-submit", "-np", "3", "--dvm-uri", dvm_respawn, "--",
+         sys.executable, "-c", RESPAWN_APP],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
+    out = r.stdout + r.stderr
+    assert r.returncode == 0, out[-2000:]
+    assert "rank 1 resumed at step 3" in out
+    assert "rank 1 got rndv payload" in out
