@@ -18,7 +18,7 @@ import subprocess
 import time
 from typing import Optional
 
-_ABI = 1
+_ABI = 2
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "convertor.cpp")
 _FASTDSS_SRC = os.path.join(_DIR, "fastdss.c")
@@ -115,11 +115,24 @@ def lib() -> Optional[ctypes.CDLL]:
         u8p = ctypes.POINTER(ctypes.c_uint8)
         i64 = ctypes.c_int64
         i64p = ctypes.POINTER(ctypes.c_int64)
-        cdll.ompi_tpu_pack.argtypes = [u8p, u8p, i64, i64, i64p, i64p, i64]
+        # per-item walk (+ uniform-length hint + packed item size, ABI 2)
+        cdll.ompi_tpu_pack.argtypes = [u8p, u8p, i64, i64, i64p, i64p, i64,
+                                       i64, i64]
         cdll.ompi_tpu_pack.restype = None
         cdll.ompi_tpu_unpack.argtypes = [u8p, u8p, i64, i64, i64p, i64p,
-                                         i64]
+                                         i64, i64, i64]
         cdll.ompi_tpu_unpack.restype = None
+        # coalesced absolute-run plan walk
+        cdll.ompi_tpu_pack_runs.argtypes = [u8p, u8p, i64p, i64p, i64, i64]
+        cdll.ompi_tpu_pack_runs.restype = None
+        cdll.ompi_tpu_unpack_runs.argtypes = [u8p, u8p, i64p, i64p, i64,
+                                              i64]
+        cdll.ompi_tpu_unpack_runs.restype = None
+        # strided progressions (vector-class plans, no run metadata)
+        cdll.ompi_tpu_pack_strided.argtypes = [u8p, u8p, i64, i64, i64]
+        cdll.ompi_tpu_pack_strided.restype = None
+        cdll.ompi_tpu_unpack_strided.argtypes = [u8p, u8p, i64, i64, i64]
+        cdll.ompi_tpu_unpack_strided.restype = None
         _lib = cdll
     except OSError:
         _lib = None

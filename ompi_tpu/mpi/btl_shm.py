@@ -230,7 +230,11 @@ class ShmRingWriter:
             self._publish(body, hdr, payload)
         return True
 
-    def send(self, header: dict, payload: bytes) -> None:
+    def send(self, header: dict, payload) -> None:
+        """Deliver one frame.  ``payload`` is any bytes-like object —
+        a zero-copy memoryview of the sender's user buffer (the PML's
+        plan-collapsed fast path) is published straight into the ring:
+        the ONE copy on the whole send path is the ring write itself."""
         if self._fast is not None:
             self._send_fast(header, payload, block=True)
         else:
@@ -253,10 +257,11 @@ class ShmRingWriter:
         self._ring_doorbell(bool(ring_db))
         return True
 
-    def try_send(self, header: dict, payload: bytes) -> bool:
+    def try_send(self, header: dict, payload) -> bool:
         """Nonblocking send (≈ btl sendi, btl.h:926): publish the frame iff
         the ring has room NOW; False ⇒ the caller takes the queued path.
-        Still raises FrameTooBig for frames no amount of draining fits."""
+        Still raises FrameTooBig for frames no amount of draining fits.
+        ``payload`` may be any bytes-like object (see :meth:`send`)."""
         if self._fast is not None:
             return self._send_fast(header, payload, block=False)
         return self._send_py(header, payload, block=False)
@@ -503,15 +508,15 @@ class ShmBTL:
         if w is not None:
             w.close()
 
-    def send(self, peer: int, header: dict, payload: bytes = b"") -> None:
-        """Deliver one frame; raises FrameTooBig for oversized frames,
+    def send(self, peer: int, header: dict, payload=b"") -> None:
+        """Deliver one frame (``payload``: any bytes-like, zero-copy
+        buffer views included); raises FrameTooBig for oversized frames,
         PeerDeadError for a dead receiver, and KeyError if connect() was
         never called for this peer."""
         self._check_alive(peer)
         self._writers[peer].send(header, payload)
 
-    def try_send(self, peer: int, header: dict,
-                 payload: bytes = b"") -> bool:
+    def try_send(self, peer: int, header: dict, payload=b"") -> bool:
         """Nonblocking delivery on the caller's thread; False when the
         ring is full or unconnected (caller falls back to the send
         worker).  FrameTooBig/PeerDeadError propagate — no queueing fixes

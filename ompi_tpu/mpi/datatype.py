@@ -30,6 +30,11 @@ from ompi_tpu.mpi.constants import MPIException
 # below it, ctypes call overhead beats the numpy gather it would replace
 _NATIVE_MIN_BYTES = 256
 
+# expanded pack plans above this run count keep the per-item walk instead
+# (a count × nruns materialization must not cost more memory than the
+# payload it moves)
+_PLAN_EXPAND_CAP = 1 << 22
+
 _U8P = ctypes.POINTER(ctypes.c_uint8)
 _I64P = ctypes.POINTER(ctypes.c_int64)
 
@@ -40,6 +45,137 @@ def _native_convertor(nbytes: int):
     from ompi_tpu import _native  # cheap after first import (sys.modules)
 
     return _native.lib()
+
+
+class ConvertorStats:
+    """Pack/unpack call counters — the copy-counting hook transport tests
+    use to assert a zero-copy path really took no pack round-trip."""
+
+    __slots__ = ("pack_calls", "unpack_calls", "pack_bytes", "unpack_bytes")
+
+    def __init__(self) -> None:
+        self.reset()
+
+    def reset(self) -> None:
+        self.pack_calls = 0
+        self.unpack_calls = 0
+        self.pack_bytes = 0
+        self.unpack_bytes = 0
+
+
+#: process-wide convertor counters (observability hook, not a hot metric)
+stats = ConvertorStats()
+
+
+class PackPlan:
+    """A compiled pack program for one ``(datatype, count)`` pair —
+    ≈ the reference's optimized dt_elem_desc chain (opal_datatype_optimize).
+
+    ``kind`` selects the executor:
+
+    - ``"empty"``    nothing to move.
+    - ``"single"``   ONE memcpy: ``[start, start + total)`` — the plan
+                     collapsed (contiguous layout, any count).
+    - ``"strided"``  ``nblocks`` blocks of ``blocklen`` bytes, block i at
+                     ``start + i*stride`` — vector-class layouts need no
+                     per-run metadata at all.
+    - ``"runs"``     absolute coalesced ``(offsets, lengths)`` arrays
+                     covering ALL count items (abutting runs merged, across
+                     item boundaries when the extent makes items abut).
+    - ``"items"``    per-item runs walked ``count`` times at ``extent``
+                     stride (plans too large to expand, > _PLAN_EXPAND_CAP).
+
+    ``uniform`` is the shared run length when every run is equal (0
+    otherwise) — the native walk specializes its inner copy on it.
+    ``span`` is the user-buffer bytes the plan touches (validation bound).
+    """
+
+    __slots__ = ("kind", "total", "span", "start", "nblocks", "blocklen",
+                 "stride", "offsets", "lengths", "uniform", "count",
+                 "extent", "item_size")
+
+    def __init__(self, kind: str, total: int, span: int) -> None:
+        self.kind = kind
+        self.total = total
+        self.span = span
+        self.start = 0
+        self.nblocks = 0
+        self.blocklen = 0
+        self.stride = 0
+        self.offsets: Optional[np.ndarray] = None
+        self.lengths: Optional[np.ndarray] = None
+        self.uniform = 0
+        self.count = 0
+        self.extent = 0
+        self.item_size = 0
+
+    @property
+    def single_run(self) -> bool:
+        """Plan collapsed to one memcpy (the zero-copy gate consumers
+        check before sending a buffer view instead of packing)."""
+        return self.kind == "single"
+
+    def __repr__(self) -> str:  # debugging aid
+        return (f"PackPlan({self.kind}, total={self.total}, "
+                f"span={self.span}, uniform={self.uniform})")
+
+
+def _plan_empty() -> PackPlan:
+    return PackPlan("empty", 0, 0)
+
+
+def _plan_single(start: int, total: int) -> PackPlan:
+    p = PackPlan("single", total, start + total)
+    p.start = start
+    return p
+
+
+def _plan_strided(start: int, nblocks: int, blocklen: int,
+                  stride: int) -> PackPlan:
+    if blocklen == stride and nblocks > 1:  # blocks abut: collapse
+        return _plan_single(start, nblocks * blocklen)
+    if nblocks == 1:
+        return _plan_single(start, blocklen)
+    p = PackPlan("strided", nblocks * blocklen,
+                 start + (nblocks - 1) * stride + blocklen)
+    p.start = start
+    p.nblocks = nblocks
+    p.blocklen = blocklen
+    p.stride = stride
+    p.uniform = blocklen
+    return p
+
+
+def _uniform_of(lengths: np.ndarray) -> int:
+    if len(lengths) == 0:
+        return 0
+    first = int(lengths[0])
+    return first if bool((lengths == first).all()) else 0
+
+
+def _plan_runs(offsets: np.ndarray, lengths: np.ndarray) -> PackPlan:
+    if len(offsets) == 1:
+        return _plan_single(int(offsets[0]), int(lengths[0]))
+    p = PackPlan("runs", int(lengths.sum()),
+                 int((offsets + lengths).max()) if len(offsets) else 0)
+    p.offsets = np.ascontiguousarray(offsets)
+    p.lengths = np.ascontiguousarray(lengths)
+    p.uniform = _uniform_of(lengths)
+    return p
+
+
+def _plan_items(offsets: np.ndarray, lengths: np.ndarray, count: int,
+                extent: int, item_size: int) -> PackPlan:
+    item_end = int((offsets + lengths).max())
+    p = PackPlan("items", count * item_size,
+                 (count - 1) * extent + item_end)
+    p.offsets = np.ascontiguousarray(offsets)
+    p.lengths = np.ascontiguousarray(lengths)
+    p.uniform = _uniform_of(lengths)
+    p.count = count
+    p.extent = extent
+    p.item_size = item_size
+    return p
 
 
 def _u8p(arr: np.ndarray):
@@ -197,53 +333,236 @@ class Datatype:
             self._seg_arrs = arrs
         return arrs
 
+    # -- pack plans (the run-coalescing compiled convertor) ---------------
+
+    def pack_plan(self, count: int) -> PackPlan:
+        """The compiled pack program for ``count`` items — cached per
+        ``(datatype, count)`` on this object (benign-race cache: a lost
+        race rebuilds an identical plan)."""
+        count = int(count)
+        cache = getattr(self, "_plan_cache", None)
+        if cache is None:
+            cache = self._plan_cache = {}
+        plan = cache.get(count)
+        if plan is None:
+            plan = self._build_plan(count)
+            if len(cache) >= 16:   # bound: plans are per-count.  Evict
+                # ONE entry, never the commit-warmed count=1 plan — a
+                # full clear would make every 17th distinct count repay
+                # the whole expansion (the cliff commit() exists to
+                # avoid).  Predefined types are process-wide singletons
+                # hit from app AND reader threads, so the lockless
+                # eviction must tolerate concurrent mutation: pop() with
+                # a default, and a racing resize aborts this round's
+                # eviction instead of raising out of send/recv.
+                try:
+                    cache.pop(next(k for k in cache if k != 1), None)
+                except (StopIteration, RuntimeError):
+                    pass
+            cache[count] = plan
+        return plan
+
+    def _build_plan(self, count: int) -> PackPlan:
+        if count <= 0 or self.size == 0:
+            return _plan_empty()
+        ext = self.extent
+        # affine layouts (vector/hvector over a dense base) plan without
+        # ever materializing their per-run descriptor arrays
+        aff = getattr(self, "_affine", None)
+        if aff is not None:
+            start, nblocks, bl, stride = aff
+            per_item = _plan_strided(start, nblocks, bl, stride)
+            if count == 1:
+                return per_item
+            if per_item.kind == "single":
+                return self._plan_repeat_single(per_item, count, ext)
+            if start == 0 and ext == nblocks * stride:
+                # items continue the arithmetic progression seamlessly
+                return _plan_strided(0, count * nblocks, bl, stride)
+            # fall through to the general expansion on materialized runs
+        offs, lens = self.segment_arrays()
+        n = len(offs)
+        if n == 0:
+            return _plan_empty()
+        if n == 1:
+            one = _plan_single(int(offs[0]), int(lens[0]))
+            return (one if count == 1
+                    else self._plan_repeat_single(one, count, ext))
+        if count == 1:
+            return _plan_runs(offs, lens)
+        if count * n <= _PLAN_EXPAND_CAP:
+            base = np.arange(count, dtype=np.int64)[:, None] * ext
+            all_offs = (base + offs[None, :]).reshape(-1)
+            all_lens = np.broadcast_to(
+                lens[None, :], (count, n)).reshape(-1)
+            all_offs, all_lens = _merge_adjacent(all_offs, all_lens)
+            return _plan_runs(all_offs, all_lens)
+        return _plan_items(offs, lens, count, ext, self.size)
+
+    @staticmethod
+    def _plan_repeat_single(one: PackPlan, count: int,
+                            extent: int) -> PackPlan:
+        """count repetitions of a one-run item at ``extent`` stride."""
+        if one.start == 0 and one.total == extent:
+            return _plan_single(0, count * one.total)  # items abut
+        return _plan_strided(one.start, count, one.total, extent)
+
+    def _plan_native(self, plan: PackPlan):
+        if plan.total < _NATIVE_MIN_BYTES:
+            return None
+        return _native_convertor(plan.total)
+
+    def _validate_packing(self, count: int, what: str) -> None:
+        """Shared pack/unpack argument validation — count sign, then
+        commit state (buffer-size checks follow in the caller, in the
+        same order on both paths)."""
+        if count < 0:
+            raise MPIException(
+                f"{what}: negative count {count}", error_class=2)
+        if not self._committed:
+            raise MPIException(
+                f"{what} on an uncommitted datatype "
+                f"{getattr(self, 'name', type(self).__name__)!r} "
+                f"(MPI_Type_commit first)", error_class=3)
+
     def pack(self, buf: np.ndarray, count: int) -> bytes:
         """Gather `count` items from `buf` into contiguous bytes."""
+        self._validate_packing(count, "pack")
         raw = np.ascontiguousarray(buf).view(np.uint8).ravel()
-        if raw.nbytes < min_span(self, count):
+        plan = self.pack_plan(count)
+        if raw.nbytes < plan.span:
             raise MPIException(
                 f"pack: buffer has {raw.nbytes}B, datatype needs "
-                f"{min_span(self, count)}B for count={count}")
-        if count and self.is_contiguous:   # single-memcpy fast path
-            return raw[:count * self.size].tobytes()
-        native = _native_convertor(count * self.size)
-        if native is not None:
-            offs, lens = self._seg_arrays()
-            out = np.empty(count * self.size, np.uint8)
-            native.ompi_tpu_pack(
-                _u8p(out), _u8p(raw), count, self.extent,
-                _i64p(offs), _i64p(lens), len(offs))
-            return out.tobytes()
-        return raw[self._byte_index(count)].tobytes()
+                f"{plan.span}B for count={count}")
+        stats.pack_calls += 1
+        stats.pack_bytes += plan.total
+        if plan.kind == "empty":
+            return b""
+        if plan.kind == "single":   # single-memcpy fast path
+            return raw[plan.start:plan.start + plan.total].tobytes()
+        out = np.empty(plan.total, np.uint8)
+        self._execute_pack(raw, plan, out)
+        return out.tobytes()
 
-    def unpack(self, data: bytes, buf: np.ndarray, count: int) -> None:
-        """Scatter contiguous bytes into `buf` according to the layout."""
+    def pack_into(self, buf: np.ndarray, count: int, out) -> int:
+        """Pack ``count`` items from ``buf`` into a caller-provided
+        writable buffer (ndarray / memoryview / bytearray) and return the
+        packed byte count — the memoryview-based variant that skips the
+        intermediate ``bytes`` object ``pack()`` materializes."""
+        self._validate_packing(count, "pack")
+        raw = np.ascontiguousarray(buf).view(np.uint8).ravel()
+        plan = self.pack_plan(count)
+        if raw.nbytes < plan.span:
+            raise MPIException(
+                f"pack: buffer has {raw.nbytes}B, datatype needs "
+                f"{plan.span}B for count={count}")
+        out_arr = np.frombuffer(out, np.uint8)
+        if not out_arr.flags.writeable:
+            raise MPIException(
+                "pack_into: output buffer is read-only (bytes? pass a "
+                "bytearray/memoryview/ndarray)", error_class=2)
+        if out_arr.nbytes < plan.total:
+            raise MPIException(
+                f"pack_into: output buffer has {out_arr.nbytes}B, plan "
+                f"packs {plan.total}B")
+        stats.pack_calls += 1
+        stats.pack_bytes += plan.total
+        if plan.kind == "empty":
+            return 0
+        if plan.kind == "single":
+            out_arr[:plan.total] = raw[plan.start:plan.start + plan.total]
+            return plan.total
+        self._execute_pack(raw, plan, out_arr[:plan.total])
+        return plan.total
+
+    def _execute_pack(self, raw: np.ndarray, plan: PackPlan,
+                      out: np.ndarray) -> None:
+        """Run a non-trivial plan: native wide-run walk when available,
+        vectorized numpy otherwise."""
+        native = self._plan_native(plan)
+        if plan.kind == "strided":
+            if native is not None:
+                native.ompi_tpu_pack_strided(
+                    _u8p(out), _u8p(raw[plan.start:]), plan.nblocks,
+                    plan.blocklen, plan.stride)
+                return
+            view = np.lib.stride_tricks.as_strided(
+                raw[plan.start:], (plan.nblocks, plan.blocklen),
+                (plan.stride, 1))
+            out.reshape(plan.nblocks, plan.blocklen)[:] = view
+            return
+        if plan.kind == "runs":
+            if native is not None:
+                native.ompi_tpu_pack_runs(
+                    _u8p(out), _u8p(raw), _i64p(plan.offsets),
+                    _i64p(plan.lengths), len(plan.offsets), plan.uniform)
+                return
+            out[:] = raw[_concat_aranges(plan.offsets, plan.lengths)]
+            return
+        # per-item walk (plan too large to expand)
+        if native is not None:
+            native.ompi_tpu_pack(
+                _u8p(out), _u8p(raw), plan.count, plan.extent,
+                _i64p(plan.offsets), _i64p(plan.lengths),
+                len(plan.offsets), plan.uniform, plan.item_size)
+            return
+        out[:] = raw[self._byte_index(plan.count)]
+
+    def unpack(self, data, buf: np.ndarray, count: int) -> None:
+        """Scatter contiguous bytes (any buffer object: bytes, bytearray,
+        memoryview, uint8 ndarray) into `buf` according to the layout."""
+        self._validate_packing(count, "unpack")
         if buf.flags["C_CONTIGUOUS"] is False:
             raise MPIException("unpack requires a C-contiguous target buffer")
         raw = buf.view(np.uint8).reshape(-1)
         src = np.frombuffer(data, dtype=np.uint8)
-        if len(src) < count * self.size:
+        plan = self.pack_plan(count)
+        if len(src) < plan.total:
             raise MPIException(
                 f"unpack: got {len(src)}B, layout expects "
-                f"{count * self.size}B", error_class=15)
-        if raw.nbytes < min_span(self, count):
+                f"{plan.total}B", error_class=15)
+        if raw.nbytes < plan.span:
             raise MPIException(
                 f"unpack: target buffer has {raw.nbytes}B, layout spans "
-                f"{min_span(self, count)}B for count={count}",
-                error_class=15)
-        if count and self.is_contiguous:
-            raw[:count * self.size] = src[:count * self.size]
+                f"{plan.span}B for count={count}", error_class=15)
+        stats.unpack_calls += 1
+        stats.unpack_bytes += plan.total
+        if plan.kind == "empty":
             return
-        native = _native_convertor(count * self.size)
+        if plan.kind == "single":
+            raw[plan.start:plan.start + plan.total] = src[:plan.total]
+            return
+        self._execute_unpack(src[:plan.total], plan, raw)
+
+    def _execute_unpack(self, src: np.ndarray, plan: PackPlan,
+                        raw: np.ndarray) -> None:
+        native = self._plan_native(plan)
+        if plan.kind == "strided":
+            if native is not None:
+                native.ompi_tpu_unpack_strided(
+                    _u8p(src), _u8p(raw[plan.start:]), plan.nblocks,
+                    plan.blocklen, plan.stride)
+                return
+            view = np.lib.stride_tricks.as_strided(
+                raw[plan.start:], (plan.nblocks, plan.blocklen),
+                (plan.stride, 1))
+            view[:] = src.reshape(plan.nblocks, plan.blocklen)
+            return
+        if plan.kind == "runs":
+            if native is not None:
+                native.ompi_tpu_unpack_runs(
+                    _u8p(src), _u8p(raw), _i64p(plan.offsets),
+                    _i64p(plan.lengths), len(plan.offsets), plan.uniform)
+                return
+            raw[_concat_aranges(plan.offsets, plan.lengths)] = src
+            return
         if native is not None:
-            offs, lens = self._seg_arrays()
-            src_c = np.ascontiguousarray(src[:count * self.size])
             native.ompi_tpu_unpack(
-                _u8p(src_c), _u8p(raw), count, self.extent,
-                _i64p(offs), _i64p(lens), len(offs))
+                _u8p(src), _u8p(raw), plan.count, plan.extent,
+                _i64p(plan.offsets), _i64p(plan.lengths),
+                len(plan.offsets), plan.uniform, plan.item_size)
             return
-        idx = self._byte_index(count)
-        raw[idx] = src[:len(idx)]
+        raw[self._byte_index(plan.count)] = src
 
     # -- device path (the jnp.take lowering the module docstring names) ---
 
@@ -306,12 +625,28 @@ class Datatype:
     def hvector(self, count: int, blocklength: int,
                 byte_stride: int) -> "DerivedDatatype":
         """≈ MPI_Type_create_hvector: stride in BYTES."""
-        return _stamp(DerivedDatatype(
-            self, [(i * byte_stride, blocklength) for i in range(count)],
-            pattern_unit="bytes",
-            name=f"hvector({count},{blocklength},{byte_stride}B)"),
-            "hvector", count=count, blocklength=blocklength,
-            byte_stride=byte_stride, datatype=self)
+        count, blocklength = int(count), int(blocklength)
+        byte_stride = int(byte_stride)
+        if count == 0:
+            natural = 0
+        else:
+            natural = (((count - 1) * byte_stride if byte_stride >= 0
+                        else 0) + blocklength * self.extent)
+
+        def lazy(count=count, blocklength=blocklength,
+                 byte_stride=byte_stride):
+            return (np.arange(count, dtype=np.int64) * byte_stride,
+                    np.full(count, blocklength, np.int64))
+
+        dt = DerivedDatatype(
+            self, None, extent=natural,
+            name=f"hvector({count},{blocklength},{byte_stride}B)",
+            lazy_pattern=lazy, n_items=count * blocklength)
+        if count > 0 and blocklength > 0 and byte_stride > 0 \
+                and self.is_contiguous:
+            dt._affine = (0, count, blocklength * self.size, byte_stride)
+        return _stamp(dt, "hvector", count=count, blocklength=blocklength,
+                      byte_stride=byte_stride, datatype=self)
 
     def indexed(self, blocklengths: Sequence[int],
                 displacements: Sequence[int]) -> "DerivedDatatype":
@@ -381,6 +716,23 @@ def _concat_aranges(offsets: np.ndarray, lengths: np.ndarray) -> np.ndarray:
             - np.repeat(cum, lengths) + np.repeat(offsets, lengths))
 
 
+def _merge_adjacent(starts: np.ndarray, lens: np.ndarray
+                    ) -> tuple[np.ndarray, np.ndarray]:
+    """Coalesce abutting byte runs in declaration order, vectorized (the
+    convertor's run-coalescing pass): a run starting exactly where the
+    previous one ended merges into it."""
+    if len(starts) == 0:
+        return (np.empty(0, np.int64), np.empty(0, np.int64))
+    brk = np.empty(len(starts), bool)
+    brk[0] = True
+    np.not_equal(starts[1:], starts[:-1] + lens[:-1], out=brk[1:])
+    gi = np.flatnonzero(brk)
+    if len(gi) == len(starts):          # nothing merged: keep the inputs
+        return (np.ascontiguousarray(starts), np.ascontiguousarray(lens))
+    return (np.ascontiguousarray(starts[gi]),
+            np.ascontiguousarray(np.add.reduceat(lens, gi)))
+
+
 def _merge_runs(segs: list[tuple[int, int]]) -> list[tuple[int, int]]:
     """Merge byte runs that abut in declaration order (order preserved)."""
     merged: list[tuple[int, int]] = []
@@ -432,33 +784,60 @@ class DerivedDatatype(Datatype):
 
     def __init__(self, base: Datatype, pattern,
                  extent: Optional[int] = None, name: str = "derived",
-                 pattern_unit: str = "items") -> None:
-        # pattern: (offset, item_count) runs — a list of tuples or an
-        # (N, 2) int64 array; offset is in base items ("items") or raw
-        # bytes ("bytes" — the MPI h* constructors).  Kept as an array:
-        # a 1M-block vector type must not cost a 1M-tuple python list.
+                 pattern_unit: str = "items", lazy_pattern=None,
+                 n_items: int = 0) -> None:
+        # pattern: (offset, item_count) runs — a list of tuples, an
+        # (N, 2) int64 array, or an already-split (offsets, counts) array
+        # pair; offset is in base items ("items") or raw bytes ("bytes" —
+        # the MPI h* constructors).  Kept as arrays: a 1M-block vector
+        # type must not cost a 1M-tuple python list, and the split form
+        # lets the hot constructors skip the (N, 2) stack entirely.
         self.base = base
-        pat = np.asarray(pattern, np.int64).reshape(-1, 2)
-        if pattern_unit == "items":
-            pat = pat * np.array([base.extent, 1], np.int64)
-        elif pattern_unit != "bytes":
-            raise MPIException(f"bad pattern_unit {pattern_unit!r}")
-        self._pat = pat
+        self._lazy_pat = None
+        if pattern is None:
+            # affine constructors defer materialization: size/extent come
+            # in closed form, the arrays build on first descriptor use
+            self._pat_off = self._pat_cnt = None
+            self._lazy_pat = lazy_pattern
+            n_items = int(n_items)
+        else:
+            if isinstance(pattern, tuple) and len(pattern) == 2 and \
+                    isinstance(pattern[0], np.ndarray):
+                offs = np.ascontiguousarray(pattern[0], np.int64)
+                cnts = np.ascontiguousarray(pattern[1], np.int64)
+            else:
+                pat = np.asarray(pattern, np.int64).reshape(-1, 2)
+                offs = np.ascontiguousarray(pat[:, 0])
+                cnts = np.ascontiguousarray(pat[:, 1])
+            if pattern_unit == "items":
+                if base.extent != 1:
+                    offs = offs * base.extent
+            elif pattern_unit != "bytes":
+                raise MPIException(f"bad pattern_unit {pattern_unit!r}")
+            self._pat_off = offs
+            self._pat_cnt = cnts
+            n_items = int(cnts.sum())
         self.base_np = base.base_np
         self.name = name
-        n_items = int(pat[:, 1].sum())
         self.size = n_items * base.size
-        natural = (int((pat[:, 0] + pat[:, 1] * base.extent).max())
-                   if len(pat) else 0)
-        self.extent = extent if extent is not None else natural
+        if extent is not None:
+            self.extent = extent
+        else:
+            offs, cnts = self._pattern_arrays()
+            self.extent = (int((offs + cnts * base.extent).max())
+                           if len(offs) else 0)
         self._lock = threading.RLock()  # element_indices() nests segments()
         self._segs: Optional[list[tuple[int, int]]] = None
         self._elem_idx: Optional[np.ndarray] = None
 
-    @property
-    def byte_pattern(self):
-        """(offset, item_count) byte-granular rows ((N, 2) int64)."""
-        return self._pat
+    def _pattern_arrays(self) -> tuple[np.ndarray, np.ndarray]:
+        """(byte_offsets, item_counts) — materialized on first use for
+        lazily-constructed (affine) patterns."""
+        if self._pat_off is None:
+            offs, cnts = self._lazy_pat()
+            self._pat_cnt = np.ascontiguousarray(cnts, np.int64)
+            self._pat_off = np.ascontiguousarray(offs, np.int64)
+        return self._pat_off, self._pat_cnt
 
     @classmethod
     def _mk_contiguous(cls, count: int, base: Datatype) -> "DerivedDatatype":
@@ -467,9 +846,31 @@ class DerivedDatatype(Datatype):
     @classmethod
     def _mk_vector(cls, count: int, blocklength: int, stride: int,
                base: Datatype) -> "DerivedDatatype":
-        pattern = np.stack([np.arange(count, dtype=np.int64) * stride,
-                            np.full(count, blocklength, np.int64)], axis=1)
-        return cls(base, pattern, name=f"vector({count},{blocklength},{stride})")
+        count, blocklength = int(count), int(blocklength)
+        stride = int(stride)
+        # natural extent in closed form — the generic rowwise max would
+        # cost two array ops per million-block type
+        if count == 0:
+            natural = 0
+        else:
+            natural = (((count - 1) * stride if stride >= 0 else 0)
+                       + blocklength) * base.extent
+        bext = base.extent
+
+        def lazy(count=count, blocklength=blocklength, stride=stride,
+                 bext=bext):
+            return (np.arange(count, dtype=np.int64) * (stride * bext),
+                    np.full(count, blocklength, np.int64))
+
+        dt = cls(base, None, extent=natural,
+                 name=f"vector({count},{blocklength},{stride})",
+                 lazy_pattern=lazy, n_items=count * blocklength)
+        if count > 0 and blocklength > 0 and stride > 0 \
+                and base.is_contiguous:
+            # affine layout: plans compile without descriptor arrays
+            dt._affine = (0, count, blocklength * base.size,
+                          stride * base.extent)
+        return dt
 
     @classmethod
     def _mk_indexed(cls, blocklengths: Sequence[int], displacements: Sequence[int],
@@ -488,11 +889,14 @@ class DerivedDatatype(Datatype):
         return dt
 
     def commit(self) -> "DerivedDatatype":
-        # warm the ARRAY descriptors only — the tuple list stays lazy
-        # (building it for a 1M-run type costs more than the compile)
-        self._seg_arrays()
-        self.element_indices()
+        # compile the pack plan (≈ opal_datatype_commit running the
+        # descriptor optimizer).  Affine layouts plan without their
+        # segment arrays; everything else warms the ARRAY descriptors
+        # through the plan build.  The tuple list and the device gather
+        # map (element_indices) stay lazy — building either for a 1M-run
+        # type costs more than the compile itself.
         self._committed = True
+        self.pack_plan(1)
         return self
 
     def _seg_arrays(self) -> tuple[np.ndarray, np.ndarray]:
@@ -509,23 +913,27 @@ class DerivedDatatype(Datatype):
             boffs, blens = self.base.segment_arrays()
             # zero-count runs are legal MPI (indexed blocklength 0) and
             # contribute nothing — drop them so they can't inflate
-            # min_span/true extent as phantom zero-length segments
-            pat = self._pat[self._pat[:, 1] > 0]
+            # min_span/true extent as phantom zero-length segments.  The
+            # fancy-index copy only runs when a zero exists: on a clean
+            # million-run pattern it would cost more than the merge.
+            poffs, pcnts = self._pattern_arrays()
+            pos = pcnts > 0
+            if not bool(pos.all()):
+                poffs, pcnts = poffs[pos], pcnts[pos]
             bext = self.base.extent
             if (len(boffs) == 1 and boffs[0] == 0
                     and blens[0] == bext):
                 # contiguous base (every predefined type): a pattern
                 # run of cnt items IS one segment — no expansion
-                starts = pat[:, 0]
-                lens = pat[:, 1] * bext
+                starts = poffs
+                lens = pcnts * bext
             else:
                 # expand items × base segments, vectorized: item
                 # origins via a concatenated-arange trick, then an
                 # outer sum with the base's segment offsets
-                cnts = pat[:, 1]
-                origins = (_concat_aranges(np.zeros(len(pat), np.int64),
-                                           cnts) * bext
-                           + np.repeat(pat[:, 0], cnts))
+                origins = (_concat_aranges(np.zeros(len(poffs), np.int64),
+                                           pcnts) * bext
+                           + np.repeat(poffs, pcnts))
                 starts = (origins[:, None] + boffs[None, :]).reshape(-1)
                 lens = np.broadcast_to(
                     blens[None, :],
@@ -535,16 +943,7 @@ class DerivedDatatype(Datatype):
             # sorted: MPI pack order is declaration order, so an
             # indexed type with decreasing displacements packs blocks
             # exactly as declared (the unpack_ooo.c contract).
-            if len(starts) == 0:
-                arrs = (np.empty(0, np.int64), np.empty(0, np.int64))
-            else:
-                brk = np.empty(len(starts), bool)
-                brk[0] = True
-                np.not_equal(starts[1:], starts[:-1] + lens[:-1],
-                             out=brk[1:])
-                gi = np.flatnonzero(brk)
-                arrs = (np.ascontiguousarray(starts[gi]),
-                        np.ascontiguousarray(np.add.reduceat(lens, gi)))
+            arrs = _merge_adjacent(starts, lens)
             self._seg_arrs = arrs
             return arrs
 
@@ -622,8 +1021,8 @@ class StructDatatype(Datatype):
             f"gather path needs a uniform element type (host path only)")
 
     def commit(self) -> "StructDatatype":
-        self.segments()
         self._committed = True
+        self.pack_plan(1)
         return self
 
     def resized(self, extent: int) -> "DerivedDatatype":
@@ -793,7 +1192,7 @@ def _packed_elem_dtypes(dt: Datatype) -> list[tuple[np.dtype, int]]:
     if isinstance(dt, DerivedDatatype):
         # recurse: the base may itself be heterogeneous (resized/contiguous
         # struct) — its byteswap map must survive the wrapper
-        n_items = int(dt.byte_pattern[:, 1].sum())
+        n_items = dt.size // dt.base.size if dt.base.size else 0
         return _packed_elem_dtypes(dt.base) * n_items
     return [(dt.base_np, dt.size // dt.base_np.itemsize)]
 

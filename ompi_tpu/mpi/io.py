@@ -859,13 +859,21 @@ class File:
             self._err(MPIException("file opened read-only",
                                    error_class=ERR_IO))
 
-    def _as_bytes(self, data: Any) -> bytes:
+    def _as_bytes(self, data: Any):
+        """User data → the byte stream the view consumes.  Returns a
+        bytes-like object: a zero-copy memoryview of the caller's array
+        when no conversion is needed (right dtype, C-contiguous, identity
+        datarep — the plan-collapsed case), else materialized bytes.
+        Callers only slice and hand it to pwrite/alltoallv within the
+        call, so the view never outlives the caller's buffer."""
         arr = np.asarray(data)
         want = self.view.etype.base_np
         if arr.dtype != want:
             arr = arr.astype(want)
-        raw = np.ascontiguousarray(arr).tobytes()
         wr = _datareps[getattr(self, "_datarep", "native")][1]
+        if wr is None and arr.flags["C_CONTIGUOUS"]:
+            return arr.reshape(-1).view(np.uint8).data
+        raw = np.ascontiguousarray(arr).tobytes()
         return raw if wr is None else wr(raw, self.view.etype)
 
     def _from_bytes(self, raw: bytes) -> np.ndarray:
@@ -881,6 +889,19 @@ class File:
         """≈ MPI_File_read_at — offset/count in etype units of the view."""
         self._check_read()
         runs = self.view.byte_runs(offset, count * self.view.etype.size)
+        rd = _datareps[getattr(self, "_datarep", "native")][0]
+        if rd is None and len(runs) == 1 and hasattr(os, "preadv"):
+            # plan-collapsed layout (contiguous view, or a single merged
+            # run): ONE pread straight into the result array — skips the
+            # bytes join + frombuffer + copy staging of the general path.
+            # An EOF-short pread truncates the result, same as the
+            # general path's short chunks.
+            off, ln = runs[0]
+            et = self.view.etype.base_np
+            buf = np.empty(ln, np.uint8)
+            got = os.preadv(self._fd, [memoryview(buf)], off)
+            n = got // et.itemsize
+            return buf[:n * et.itemsize].view(et)
         chunks = [os.pread(self._fd, ln, off) for off, ln in runs]
         return self._from_bytes(b"".join(chunks))
 

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import itertools
 import os
+import struct
 import threading
 from typing import Any, Optional
 
@@ -51,14 +52,61 @@ _TAG_RDYN_BASE = 1000
 _TAG_RDYN_SPAN = 1_000_000
 
 
-def _ctrl_send(comm, dest: int, obj: Any, tag: int) -> Request:
-    payload = np.frombuffer(dss.pack(obj), dtype=np.uint8)
-    return comm._coll_isend(payload, dest, tag)
+# first byte of a raw-payload control frame; dss type tags are 1..10, so
+# the two framings are distinguishable from the first byte
+_RAW_MAGIC = 0xFF
+
+# dtype kinds safe to ship by their ``.str`` descriptor (structured /
+# extension dtypes lose information there and take the dss path instead)
+_RAW_KINDS = frozenset("biufc")
+
+
+def _ctrl_send(comm, dest: int, obj: Any, tag: int,
+               payload: Optional[np.ndarray] = None) -> Request:
+    """Send one control message.  ``payload`` (an ndarray) is appended RAW
+    after the dss header and rehydrated as a zero-copy view on the far
+    side — the plan-collapsed fast path for bulk put/get traffic: ONE
+    staging copy of the data total, where dss-packing the array inside the
+    tuple paid three (tobytes, buffer assembly, unpack copy)."""
+    if payload is not None:
+        pay = np.ascontiguousarray(payload)
+        if pay.dtype.kind in _RAW_KINDS:
+            hdr = dss.pack((obj, pay.dtype.str, list(pay.shape)))
+            frame = np.empty(5 + len(hdr) + pay.nbytes, np.uint8)
+            frame[0] = _RAW_MAGIC
+            frame[1:5] = np.frombuffer(struct.pack("<I", len(hdr)),
+                                       np.uint8)
+            frame[5:5 + len(hdr)] = np.frombuffer(hdr, np.uint8)
+            if pay.nbytes:
+                frame[5 + len(hdr):] = pay.reshape(-1).view(np.uint8)
+            return comm._coll_isend(frame, dest, tag)
+        obj = (*obj, pay)   # exotic dtype: embed in the dss record
+    buf = np.frombuffer(dss.pack(obj), dtype=np.uint8)
+    return comm._coll_isend(buf, dest, tag)
+
+
+def _decode_ctrl(arr: np.ndarray) -> Any:
+    """Decode one received control frame; a raw-appended payload comes
+    back as a zero-copy ndarray view into the frame, appended to the
+    header tuple (so dispatch sees the same shape either way)."""
+    arr = np.ascontiguousarray(arr).view(np.uint8).reshape(-1)
+    if len(arr) and int(arr[0]) == _RAW_MAGIC:
+        (hlen,) = struct.unpack_from("<I", arr, 1)
+        obj, dtspec, shape = dss.unpack(
+            arr[5:5 + hlen].tobytes(), n=1)[0]
+        dtype = np.dtype(dtspec)
+        n = 1
+        for s in shape:
+            n *= s
+        view = np.frombuffer(arr, dtype=dtype, count=n,
+                             offset=5 + hlen).reshape(shape)
+        return (*obj, view)
+    return dss.unpack(arr.tobytes(), n=1)[0]
 
 
 def _ctrl_recv(comm, source: int, tag: int) -> Any:
     arr = comm._coll_irecv(None, source, tag).wait()
-    return dss.unpack(arr.tobytes(), n=1)[0]
+    return _decode_ctrl(arr)
 
 
 def _check_predefined(op) -> None:
@@ -222,7 +270,8 @@ class Window:
             self._track(target)
             return
         req = _ctrl_send(self.comm, target,
-                         ("put", self.comm.rank, offset, data), _TAG_REQ)
+                         ("put", self.comm.rank, offset), _TAG_REQ,
+                         payload=data)
         self._track(target, req)
 
     def put_strided(self, target: int, data: np.ndarray, offset: int = 0,
@@ -240,8 +289,8 @@ class Window:
             self._track(target)
             return
         req = _ctrl_send(self.comm, target,
-                         ("puts", self.comm.rank, offset, stride, data),
-                         _TAG_REQ)
+                         ("puts", self.comm.rank, offset, stride),
+                         _TAG_REQ, payload=data)
         self._track(target, req)
 
     def get(self, target: int, count: int, offset: int = 0) -> np.ndarray:
@@ -264,8 +313,8 @@ class Window:
             self._track(target)
             return
         req = _ctrl_send(self.comm, target,
-                         ("acc", self.comm.rank, offset, data, op.name),
-                         _TAG_REQ)
+                         ("acc", self.comm.rank, offset, op.name),
+                         _TAG_REQ, payload=data)
         self._track(target, req)
 
     def fetch_op(self, target: int, value, op=op_mod.SUM,
@@ -295,7 +344,7 @@ class Window:
 
         def _finish(r: Request) -> None:
             try:
-                status, payload = dss.unpack(r.wait().tobytes(), n=1)[0]
+                status, payload = _decode_ctrl(r.wait())
             except BaseException as e:          # transport failure
                 outer.fail(e)
                 return
@@ -331,7 +380,8 @@ class Window:
             done.complete(None)
             return done
         req = _ctrl_send(self.comm, target,
-                         ("put", self.comm.rank, offset, data), _TAG_REQ)
+                         ("put", self.comm.rank, offset), _TAG_REQ,
+                         payload=data)
         self._track(target, req)
         return req
 
@@ -347,8 +397,8 @@ class Window:
             done.complete(None)
             return done
         req = _ctrl_send(self.comm, target,
-                         ("acc", self.comm.rank, offset, data, op.name),
-                         _TAG_REQ)
+                         ("acc", self.comm.rank, offset, op.name),
+                         _TAG_REQ, payload=data)
         self._track(target, req)
         return req
 
@@ -642,22 +692,23 @@ class Window:
             _, origin, offset, stride, data = msg
             self._apply_put_strided(origin, offset, stride, data)
         elif kind == "acc":
-            _, origin, offset, data, opname = msg
+            _, origin, offset, opname, data = msg
             self._apply_acc(origin, offset, data, opname)
         elif kind == "get":
             _, origin, offset, count = msg
             with self._buf_lock:
                 out = self._locate(offset, count).copy()
-            _ctrl_send(self.comm, origin, ("ok", out), _TAG_REPLY)
+            _ctrl_send(self.comm, origin, ("ok",), _TAG_REPLY,
+                       payload=out)
         elif kind == "get2":
             _, origin, offset, count, rtag = msg
             with self._buf_lock:
                 out = self._locate(offset, count).copy()
-            _ctrl_send(self.comm, origin, ("ok", out), rtag)
+            _ctrl_send(self.comm, origin, ("ok",), rtag, payload=out)
         elif kind == "fetch2":
             _, origin, offset, value, opname, rtag = msg
             old = self._apply_fetch(origin, offset, value, opname)
-            _ctrl_send(self.comm, origin, ("ok", old), rtag)
+            _ctrl_send(self.comm, origin, ("ok",), rtag, payload=old)
         elif kind == "post":
             _, target = msg
             with self._cv:

@@ -537,17 +537,31 @@ class PmlOb1:
             datatype = dt_mod.from_numpy(arr.dtype)
         if count is None:
             count = arr.size // max(1, datatype.elements_per_item)
-        nbytes = count * datatype.size
-        # zero-copy path: a contiguous send of the whole buffer rides a
-        # memoryview of the user's array — no sender-side staging copy (the
-        # MPI contract forbids touching the buffer until completion anyway;
-        # ≈ pml_ob1_sendreq.h:382-413 sending from the user iovec).
+        # validate BEFORE the plan gate: the zero-copy branch must reject
+        # an uncommitted datatype exactly like the staged pack would —
+        # the commit error cannot appear or vanish based on whether the
+        # layout happens to collapse to one run
+        datatype._validate_packing(count, "pack")
+        plan = datatype.pack_plan(count)
+        nbytes = plan.total
+        # zero-copy path: a send whose pack plan collapses to ONE run rides
+        # a memoryview of the user's array — no sender-side staging copy
+        # (the MPI contract forbids touching the buffer until completion
+        # anyway; ≈ pml_ob1_sendreq.h:382-413 sending from the user iovec).
+        # This covers contiguous prefixes (count*size < arr.nbytes) and
+        # single-run derived layouts, not just whole-buffer sends.
         # Buffered mode always copies: the user may reuse immediately.
-        if (mode != "buffered" and datatype.is_contiguous
-                and arr.flags["C_CONTIGUOUS"] and nbytes == arr.nbytes):
-            payload = arr.reshape(-1).view(np.uint8).data
+        if (mode != "buffered" and plan.single_run
+                and arr.flags["C_CONTIGUOUS"]
+                and plan.start + plan.total <= arr.nbytes):
+            payload = arr.reshape(-1).view(np.uint8).data[
+                plan.start:plan.start + plan.total]
         else:
-            payload = datatype.pack(arr, count)
+            # non-contiguous: stage through the compiled plan walk into a
+            # reusable uint8 buffer (pack_into — no intermediate bytes)
+            staged = np.empty(plan.total, np.uint8)
+            datatype.pack_into(arr, count, staged)
+            payload = staged.data
         req = Request(kind="send")
         on_done = None
         if mode == "buffered":
@@ -1290,14 +1304,37 @@ class PmlOb1:
             self._deliver(req, peer, hdr, payload)
         else:  # rndv
             # fragments land directly in the user buffer when it is posted,
-            # contiguous, and large enough (no intermediate staging buffer)
-            direct = (req.buf is not None
-                      and req.datatype is not None
-                      and req.datatype.is_contiguous
-                      and req.buf.flags["C_CONTIGUOUS"]
-                      and req.buf.nbytes >= hdr["size"]
-                      and (req.count is None
-                           or req.count * req.datatype.size >= hdr["size"]))
+            # plan-collapsed (one run from offset 0 — contiguous layouts
+            # and single-run derived types alike), and large enough (no
+            # intermediate staging buffer)
+            direct = False
+            if (req.buf is not None and req.datatype is not None
+                    and req.buf.flags["C_CONTIGUOUS"]
+                    and req.buf.nbytes >= hdr["size"]):
+                if req.datatype.committed:
+                    # Uncommitted types fall to the staged path, whose
+                    # unpack fails the request with the same error the
+                    # send side raises — for ANY count spelling.
+                    # Decide from the commit-warmed count=1 plan (cached,
+                    # O(1)) — building the count-N plan (or touching
+                    # is_contiguous, which materializes the segment
+                    # descriptor of affine types) would run a potentially
+                    # multi-MB expansion on the reader thread UNDER the
+                    # PML lock, only to be discarded when the answer is
+                    # False.  N items collapse iff one item does AND
+                    # items abut (extent == size), or count == 1.
+                    p1 = req.datatype.pack_plan(1)
+                    one_ok = p1.single_run and p1.start == 0
+                    if req.count is not None:
+                        direct = (one_ok
+                                  and (req.count == 1
+                                       or req.datatype.extent
+                                       == req.datatype.size)
+                                  and req.count * req.datatype.size
+                                  >= hdr["size"])
+                    else:
+                        direct = (one_ok and req.datatype.extent
+                                  == req.datatype.size)
             self._recv_states[req.rid] = _RecvState(
                 req, hdr["size"], hdr, peer, direct=direct)
             # CTS is a tiny control frame; safe to enqueue (never inline-send
@@ -1373,7 +1410,16 @@ class PmlOb1:
         else:
             out = req.buf
             items = len(payload) // max(1, datatype.size)
-            datatype.unpack(payload, out, items)
+            try:
+                datatype.unpack(payload, out, items)
+            except MPIException as e:
+                # unpack validation (uncommitted type, bad sizing) runs
+                # on a BTL receive thread — route it to the waiting recv
+                # instead of killing the reader / hanging the request
+                req.status.source = peer
+                req.status.tag = hdr["tag"]
+                req.fail(e)
+                return
         if self._listeners:
             self._emit(EVT_DELIVER, peer=peer, tag=hdr["tag"],
                        cid=hdr["cid"], nbytes=len(payload))

@@ -74,7 +74,9 @@ def test_nested_derived():
 
 
 def test_resized_extent():
-    t = dt.FLOAT32.resized(16)
+    # commit() required before pack — the convertor validates commit
+    # state ahead of buffer sizing on both pack and unpack paths
+    t = dt.FLOAT32.resized(16).commit()
     assert t.extent == 16 and t.size == 4
     src = np.arange(8, dtype=np.float32)
     got = np.frombuffer(t.pack(src, 2), np.float32)

@@ -1,0 +1,288 @@
+"""The run-coalescing pack planner vs a naive per-run reference walk.
+
+The convertor compiles each (datatype, count) into a PackPlan —
+single-memcpy, strided progression, coalesced absolute runs, or the
+per-item fallback — and the C extension walks it with wide/specialized
+copies.  Every plan execution must be byte-identical to the naive
+declaration-order walk over the datatype's segments, on BOTH executors
+(native C and the numpy fallback), over randomized vector / hvector /
+indexed / struct layouts including non-monotone hindexed, overlapping
+extents (resized below the true span) and zero counts.
+
+Also pins: plan-kind selection (collapse across item boundaries when
+the extent makes items abut), the pack/unpack validation order
+(count sign → commit state → buffer size, identical on both paths),
+and the zero-copy contract — a contiguous send through the PML makes
+NO pack round-trip (counted by the ConvertorStats hook).
+"""
+
+import numpy as np
+import pytest
+
+from ompi_tpu.mpi import datatype as dt
+from ompi_tpu.mpi.constants import MPIException
+from tests.mpi.harness import run_ranks
+
+
+def naive_pack(t, buf, count: int) -> bytes:
+    """Declaration-order per-run gather — the ABI-1 reference walk."""
+    raw = np.ascontiguousarray(buf).view(np.uint8).ravel()
+    offs, lens = t.segment_arrays()
+    out = bytearray()
+    for i in range(count):
+        base = i * t.extent
+        for o, ln in zip(offs.tolist(), lens.tolist()):
+            out += raw[base + o:base + o + ln].tobytes()
+    return bytes(out)
+
+
+def naive_unpack(t, data: bytes, buf: np.ndarray, count: int) -> None:
+    raw = buf.view(np.uint8).reshape(-1)
+    offs, lens = t.segment_arrays()
+    src = np.frombuffer(data, np.uint8)
+    pos = 0
+    for i in range(count):
+        base = i * t.extent
+        for o, ln in zip(offs.tolist(), lens.tolist()):
+            raw[base + o:base + o + ln] = src[pos:pos + ln]
+            pos += ln
+
+
+def _random_layout(rng):
+    """One randomized committed datatype from the constructor families."""
+    kind = rng.integers(0, 6)
+    if kind == 0:
+        return dt.FLOAT64.vector(int(rng.integers(1, 9)),
+                                 int(rng.integers(1, 5)),
+                                 int(rng.integers(1, 8))).commit()
+    if kind == 1:
+        return dt.INT32.hvector(int(rng.integers(1, 7)),
+                                int(rng.integers(1, 4)),
+                                int(rng.integers(4, 40))).commit()
+    if kind == 2:
+        n = int(rng.integers(1, 7))
+        bls = rng.integers(0, 4, n).tolist()  # zero blocklengths legal
+        disps = (rng.permutation(n) * int(rng.integers(4, 8))).tolist()
+        return dt.INT32.indexed(bls, disps).commit()
+    if kind == 3:
+        # non-monotone hindexed: byte displacements in shuffled order
+        n = int(rng.integers(2, 6))
+        disps = (rng.permutation(n) * 16).tolist()
+        bls = rng.integers(1, 3, n).tolist()
+        return dt.FLOAT32.hindexed(bls, disps).commit()
+    if kind == 4:
+        t = dt.create_struct([2, 1], [0, int(rng.integers(16, 32))],
+                             [dt.INT32, dt.FLOAT64])
+        return t.commit()
+    # overlapping extents: resized BELOW the true span, so count>1
+    # items interleave (pack order stays declaration order per item)
+    inner = dt.FLOAT32.vector(2, 1, 3).commit()   # span 16, 2 runs
+    return inner.resized(int(rng.integers(4, 13)) & ~3).commit()
+
+
+@pytest.mark.parametrize("force_numpy", [False, True],
+                         ids=["native", "numpy"])
+def test_fuzz_parity_vs_naive_walk(force_numpy, monkeypatch):
+    if force_numpy:
+        monkeypatch.setattr(dt, "_native_convertor", lambda nbytes: None)
+    else:
+        monkeypatch.setattr(dt, "_NATIVE_MIN_BYTES", 0)
+    rng = np.random.default_rng(7)
+    for trial in range(60):
+        t = _random_layout(rng)
+        count = int(rng.integers(0, 5))
+        span = dt.min_span(t, count) if count else 0
+        nbytes = max(span, (count * t.extent if count else 0), 8)
+        src = rng.integers(0, 256, nbytes).astype(np.uint8)
+        want = naive_pack(t, src, count)
+        got = t.pack(src, count)
+        assert got == want, (trial, t, count)
+        # pack_into parity (the memoryview variant)
+        out = bytearray(len(want))
+        n = t.pack_into(src, count, out)
+        assert n == len(want) and bytes(out) == want, (trial, t, count)
+        # unpack parity: both walks scatter into identical buffers
+        dst_a = rng.integers(0, 256, nbytes).astype(np.uint8)
+        dst_b = dst_a.copy()
+        t.unpack(want, dst_a, count)
+        naive_unpack(t, want, dst_b, count)
+        np.testing.assert_array_equal(dst_a, dst_b,
+                                      err_msg=f"{trial} {t} {count}")
+
+
+def test_fuzz_parity_per_item_fallback(monkeypatch):
+    """Plans past the expansion cap keep the per-item walk — same bytes."""
+    monkeypatch.setattr(dt, "_PLAN_EXPAND_CAP", 4)
+    rng = np.random.default_rng(11)
+    t = dt.INT32.indexed([1, 2, 1], [6, 0, 3]).commit()
+    count = 5
+    assert t.pack_plan(count).kind == "items"
+    src = rng.integers(0, 256, dt.min_span(t, count)).astype(np.uint8)
+    assert t.pack(src, count) == naive_pack(t, src, count)
+    packed = naive_pack(t, src, count)
+    dst_a = rng.integers(0, 256, len(src)).astype(np.uint8)
+    dst_b = dst_a.copy()
+    t.unpack(packed, dst_a, count)
+    naive_unpack(t, packed, dst_b, count)
+    np.testing.assert_array_equal(dst_a, dst_b)
+
+
+def test_plan_kinds_and_collapse():
+    # contiguous at any count → ONE memcpy
+    assert dt.FLOAT32.contiguous(7).commit().pack_plan(5).kind == "single"
+    # vector whose blocks abut (bl == stride) collapses
+    assert dt.FLOAT64.vector(8, 3, 3).commit().pack_plan(2).kind == "single"
+    # true strided progression: no per-run metadata
+    p = dt.FLOAT64.vector(8, 1, 2).commit().pack_plan(1)
+    assert p.kind == "strided" and p.uniform == 8
+    # natural extent ends at the last block, so count>1 does NOT
+    # continue the progression — expanded + coalesced runs instead
+    # (the last run of each item abuts the next item's first run and
+    # merges across the boundary, so lengths go non-uniform: 8,…,16,…)
+    p = dt.FLOAT64.vector(8, 1, 2).commit().pack_plan(4)
+    assert p.kind == "runs" and p.total == 4 * 8 * 8
+    assert len(p.offsets) < 32          # the boundary merges happened
+    # runs abutting ACROSS item boundaries merge (extent makes items
+    # abut): one 4B run per 4B extent → single memcpy over all items
+    t = dt.BYTE.hindexed([4], [0]).commit()
+    assert t.extent == 4
+    p = t.pack_plan(6)
+    assert p.kind == "single" and p.total == 24
+    # a gapped hindexed (no run touching an item boundary) stays runs,
+    # with the shared length detected for the fixed-width native copy
+    t = dt.BYTE.hindexed([4, 4], [4, 12]).commit()
+    p = t.pack_plan(3)
+    assert p.kind == "runs" and p.uniform == 4 and len(p.offsets) == 6
+    # empty plans
+    assert dt.INT32.vector(0, 1, 1).commit().pack_plan(3).kind == "empty"
+    assert dt.INT32.contiguous(2).commit().pack_plan(0).kind == "empty"
+
+
+def test_validation_order_pack_unpack_consistent():
+    """count sign → commit state → buffer size, on BOTH paths."""
+    t = dt.FLOAT32.vector(4, 1, 2)          # uncommitted on purpose
+    src = np.zeros(8, np.float32)
+    # 1) negative count wins even on an uncommitted type
+    with pytest.raises(MPIException, match="negative count"):
+        t.pack(src, -1)
+    with pytest.raises(MPIException, match="negative count"):
+        t.pack_into(src, -1, bytearray(16))
+    with pytest.raises(MPIException, match="negative count"):
+        t.unpack(b"", src, -1)
+    # 2) commit state next — before any buffer sizing
+    tiny = np.zeros(1, np.float32)          # too small, but commit first
+    with pytest.raises(MPIException, match="uncommitted"):
+        t.pack(tiny, 1)
+    with pytest.raises(MPIException, match="uncommitted"):
+        t.pack_into(tiny, 1, bytearray(16))
+    with pytest.raises(MPIException, match="uncommitted"):
+        t.unpack(b"", tiny, 1)
+    # 3) buffer size last
+    t.commit()
+    with pytest.raises(MPIException, match="buffer has"):
+        t.pack(tiny, 1)
+    with pytest.raises(MPIException, match="buffer has"):
+        t.pack_into(tiny, 1, bytearray(16))
+    with pytest.raises(MPIException, match="output buffer has"):
+        t.pack_into(src, 1, bytearray(2))   # undersized destination
+    with pytest.raises(MPIException, match="expects"):
+        t.unpack(b"\0" * 4, src, 1)         # short packed stream
+    with pytest.raises(MPIException, match="target buffer has"):
+        t.unpack(b"\0" * 16, tiny, 1)       # undersized target
+    # read-only destination is rejected up front (the native walk would
+    # otherwise memcpy into an immutable bytes object's storage)
+    with pytest.raises(MPIException, match="read-only"):
+        t.pack_into(src, 1, b"\0" * 64)
+
+
+def test_zero_copy_send_validates_like_pack():
+    """The zero-copy branch must reject an uncommitted datatype exactly
+    like the staged pack — the commit error cannot depend on whether the
+    layout collapses to one run."""
+
+    def body(comm):
+        t = dt.FLOAT32.contiguous(4)        # single-run plan, uncommitted
+        with pytest.raises(MPIException, match="uncommitted"):
+            comm.send(np.zeros(4, np.float32), dest=0, tag=9,
+                      count=1, datatype=t)
+        return True
+
+    assert all(run_ranks(1, body, timeout=60.0))
+
+
+def test_uncommitted_recv_fails_instead_of_hanging():
+    """Unpack validation fires on a BTL receive thread — it must land
+    as a failed request the waiting recv raises, never a dead reader
+    thread and a recv blocked forever."""
+
+    def body(comm):
+        t = dt.FLOAT32.vector(4, 1, 2)      # uncommitted on purpose
+        if comm.rank == 0:
+            comm.send(np.arange(4, dtype=np.float32), dest=1, tag=5)
+        else:
+            out = np.zeros(8, np.float32)
+            with pytest.raises(MPIException, match="uncommitted"):
+                comm.recv(buf=out, source=0, tag=5, count=4, datatype=t)
+        comm.barrier()
+        return True
+
+    assert all(run_ranks(2, body, timeout=60.0))
+
+
+def test_plan_cache_keeps_commit_warmed_plan():
+    """Cache eviction drops ONE entry, never the count=1 plan compiled
+    at commit — no every-17th-count rebuild cliff."""
+    t = dt.INT32.indexed([1, 2], [4, 0]).commit()
+    p1 = t.pack_plan(1)
+    for c in range(2, 40):
+        t.pack_plan(c)
+    assert t.pack_plan(1) is p1
+    assert len(t._plan_cache) <= 16
+
+
+def test_contiguous_send_makes_no_pack_copy():
+    """The zero-copy gate: a contiguous-layout send through the PML
+    rides a buffer view — the ConvertorStats hook must count ZERO pack
+    calls for it, and a non-contiguous send must count at least one."""
+
+    def body(comm):
+        big = np.arange(1 << 16, dtype=np.float32)  # rendezvous-sized
+        small = np.arange(64, dtype=np.float32)     # eager-sized
+        # The counters are process-wide; keep collectives OUT of the
+        # measurement window (a barrier's algorithm choice depends on
+        # registry state earlier tests may have left behind) — settle
+        # first, then measure ONLY the p2p traffic, then synchronize.
+        comm.barrier()
+        dt.stats.reset()
+        if comm.rank == 0:
+            comm.send(small, dest=1, tag=1)
+            comm.send(big, dest=1, tag=2)
+        else:
+            out_s = np.empty_like(small)
+            comm.recv(buf=out_s, source=0, tag=1)
+            out_b = np.empty_like(big)
+            comm.recv(buf=out_b, source=0, tag=2)
+            np.testing.assert_array_equal(out_s, small)
+            np.testing.assert_array_equal(out_b, big)
+        packs_contig = dt.stats.pack_calls      # read BEFORE any barrier
+        comm.barrier()
+        # control: a strided (non-collapsing) datatype must stage
+        base = dt.stats.pack_calls
+        t = dt.FLOAT32.vector(64, 1, 2).commit()
+        src = np.arange(128, dtype=np.float32)
+        if comm.rank == 0:
+            comm.send(src, dest=1, tag=3, count=1, datatype=t)
+        else:
+            out = np.zeros(64, np.float32)
+            comm.recv(buf=out, source=0, tag=3)
+            np.testing.assert_array_equal(out, src[::2])
+        packs_strided = dt.stats.pack_calls - base
+        comm.barrier()
+        return packs_contig, packs_strided
+
+    results = run_ranks(2, body, timeout=120.0)
+    for packs_contig, packs_strided in results:
+        assert packs_contig == 0, \
+            "contiguous send took a pack round-trip"
+        assert packs_strided >= 1, \
+            "strided control did not go through the convertor"

@@ -1,13 +1,18 @@
-"""The bench backend probe's outage-recovery window.
+"""The bench backend probe's outage behavior.
 
 Round-4 failure mode: the escalating probe budgets total ~9 minutes but
 observed tunnel outages last hours, so the end-of-round bench fell back
-to CPU twice running.  ``bench._probe_backend`` now keeps probing with
-long budgets over a bounded window (``OMPI_TPU_BENCH_RECOVERY_WINDOW``)
-before giving up; these tests drive that loop with a patched
-``_probe_once`` so no real backend is touched.
+to CPU twice running.  Round-5 failure mode: the recovery window itself
+(45 min of probing) outlasted the driver's patience and the killed run
+carried NO matrix rows.  The order is now inverted — on initial-probe
+failure the CPU-fallback evidence (headline + full matrix) is banked
+FIRST, embedded in the one-line record, and only then do recovery
+probes spend what remains of the driver's budget
+(``BENCH_DRIVER_BUDGET_S``).  These tests drive that flow with a
+patched ``_probe_once`` so no real backend is touched.
 """
 
+import json
 import sys
 from pathlib import Path
 
@@ -20,22 +25,38 @@ def _fail(n, budget):
     return {"attempt": n, "budget_s": budget, "outcome": "timeout"}
 
 
+def test_initial_probe_is_escalating_attempts_only(monkeypatch):
+    """_probe_backend must return after the escalating attempts — the
+    recovery window is the CALLER's move, after the matrix is banked."""
+    calls = []
+
+    def fake_probe(n, budget):
+        calls.append(n)
+        return _fail(n, budget)
+
+    monkeypatch.setattr(bench, "_probe_once", fake_probe)
+    monkeypatch.setattr(bench, "_PROBE_PAUSE_S", 0)
+
+    probe, attempts = bench._probe_backend()
+    assert probe is None
+    assert len(attempts) == len(bench._PROBE_BUDGETS_S)
+
+
 def test_recovery_window_retries_until_success(monkeypatch):
     calls = []
 
     def fake_probe(n, budget):
         calls.append(budget)
-        if len(calls) < 5:  # 3 escalating + 1 recovery failure
+        if len(calls) < 2:
             return _fail(n, budget)
         return {"attempt": n, "budget_s": budget, "outcome": "ok",
                 "probe": {"n": 1, "platform": "tpu", "kind": "v5 lite"}}
 
     monkeypatch.setattr(bench, "_probe_once", fake_probe)
-    monkeypatch.setattr(bench, "_PROBE_PAUSE_S", 0)
-    monkeypatch.setattr(bench, "_RECOVERY_WINDOW_S", 60)
     monkeypatch.setattr(bench, "_RECOVERY_PAUSE_S", 0)
 
-    probe, attempts = bench._probe_backend()
+    attempts = [_fail(i + 1, 90) for i in range(3)]  # banked initial
+    probe = bench._probe_recovery(attempts, 60)
     assert probe == {"n": 1, "platform": "tpu", "kind": "v5 lite"}
     assert len(attempts) == 5
     # the recovery attempts are distinguishable in the JSON record
@@ -45,7 +66,7 @@ def test_recovery_window_retries_until_success(monkeypatch):
 
 
 def test_recovery_window_bounded(monkeypatch):
-    """With the window disabled, only the escalating attempts run."""
+    """With the window disabled, no recovery probes run at all."""
     calls = []
 
     def fake_probe(n, budget):
@@ -53,12 +74,22 @@ def test_recovery_window_bounded(monkeypatch):
         return _fail(n, budget)
 
     monkeypatch.setattr(bench, "_probe_once", fake_probe)
-    monkeypatch.setattr(bench, "_PROBE_PAUSE_S", 0)
-    monkeypatch.setattr(bench, "_RECOVERY_WINDOW_S", 0)
+    assert bench._probe_recovery([], 0) is None
+    assert calls == []
 
-    probe, attempts = bench._probe_backend()
-    assert probe is None
-    assert len(attempts) == len(bench._PROBE_BUDGETS_S)
+
+def test_driver_budget_sizes_recovery_window(monkeypatch):
+    """BENCH_DRIVER_BUDGET_S clips the window to what remains of the
+    driver's total allowance (minus the record-emission margin)."""
+    monkeypatch.setattr(bench, "_RECOVERY_WINDOW_S", 2700)
+    monkeypatch.setattr(bench, "_DRIVER_BUDGET_S", 0)
+    assert bench._recovery_window_s(600) == 2700   # unknown budget
+    monkeypatch.setattr(bench, "_DRIVER_BUDGET_S", 1080)  # ~18min driver
+    monkeypatch.setattr(bench, "_DRIVER_MARGIN_S", 60)
+    assert bench._recovery_window_s(600) == 420    # 1080 - 600 - 60
+    assert bench._recovery_window_s(1080) == 0     # budget exhausted
+    monkeypatch.setattr(bench, "_DRIVER_BUDGET_S", 100_000)
+    assert bench._recovery_window_s(600) == 2700   # window still caps
 
 
 def test_decode_throughput_row_cpu():
@@ -87,14 +118,62 @@ def test_hbm_copy_row_cpu():
 def test_recovery_window_expires(monkeypatch):
     """A dead tunnel exhausts the window and the record proves it."""
     monkeypatch.setattr(bench, "_probe_once", _fail)
-    monkeypatch.setattr(bench, "_PROBE_PAUSE_S", 0)
     # tiny window: monotonic moves past the deadline after the first
     # recovery probe because pause > remaining
-    monkeypatch.setattr(bench, "_RECOVERY_WINDOW_S", 1)
     monkeypatch.setattr(bench, "_RECOVERY_PAUSE_S", 3600)
 
-    probe, attempts = bench._probe_backend()
-    assert probe is None
+    attempts: list = []
+    assert bench._probe_recovery(attempts, 1) is None
     recovery = [a for a in attempts if a.get("recovery_window")]
     assert recovery, "window should have produced at least one probe"
     assert all(a["outcome"] != "ok" for a in attempts)
+
+
+def test_simulated_outage_banks_matrix_before_recovery(monkeypatch,
+                                                       capsys):
+    """Total-outage end-to-end: the one-line record must carry the FULL
+    CPU matrix, produced BEFORE any recovery probing — so a driver kill
+    landing mid-recovery (the round-5 failure) loses nothing.  Probes,
+    the flagship child, and the matrix rows are stubbed; the control
+    flow under test is bench.main()'s fallback ordering."""
+    order = []
+    fake_rows = [{"config": f"cfg{i}", "value": i, "unit": "x",
+                  "vs_baseline": 1.0, "backend": "cpu-fallback"}
+                 for i in range(9)]
+
+    def fake_probe(n, budget):
+        order.append("probe")
+        return _fail(n, budget)
+
+    def fake_matrix(devices, backend):
+        order.append("matrix")
+        bench._partial["matrix"] = fake_rows   # what the real one does
+        return fake_rows
+
+    def fake_recovery(attempts, window_s):
+        order.append("recovery")
+        assert window_s >= 0
+        return None
+
+    monkeypatch.setattr(bench, "_probe_once", fake_probe)
+    monkeypatch.setattr(bench, "_PROBE_PAUSE_S", 0)
+    monkeypatch.setattr(bench, "_force_cpu", lambda n=8: None)
+    monkeypatch.setattr(bench, "_flagship_guarded", lambda kind: {
+        "metric": "flagship", "value": 0.0, "unit": "% MFU",
+        "vs_baseline": 0.0})
+    monkeypatch.setattr(bench, "run_matrix", fake_matrix)
+    monkeypatch.setattr(bench, "_probe_recovery", fake_recovery)
+    monkeypatch.setattr(bench, "_enable_compile_cache", lambda: None)
+    monkeypatch.setattr(bench, "_arm_signal_record", lambda: None)
+    monkeypatch.setattr(bench, "_disarm_signal_record", lambda: None)
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+
+    bench.main()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    # the matrix ran before any recovery probing
+    assert order.index("matrix") < order.index("recovery")
+    # … and the rows ride inside the ONE JSON record (a killed run's
+    # SIGTERM record draws from the same _partial live view)
+    assert rec["matrix"] == fake_rows
+    assert rec["backend"] == "cpu-fallback"
+    assert bench._partial["matrix"] == fake_rows
