@@ -1281,11 +1281,28 @@ def main() -> None:
                 "backend revived AFTER the CPU evidence was banked; "
                 "numbers above are cpu-fallback — rerun for TPU rows")
     result["wall_s"] = round(time.perf_counter() - t_start, 1)
+    # provenance: the transport-stack counter snapshot (pack-plan
+    # classes, zero-copy vs packed sends, shm ring traffic) rides in the
+    # record, so a BENCH_*.json row carries which fast paths its own run
+    # actually exercised
+    result["counters"] = _counters_snapshot()
+    _partial["counters"] = result["counters"]
     # the real record is about to print — a TERM from here on must not
     # add a second JSON line (default action: die without output; the
     # microsecond race loses the record, duplicates never happen)
     _disarm_signal_record()
     print(json.dumps(result), flush=True)
+
+
+def _counters_snapshot() -> dict:
+    """The flight-recorder counter block (never raises — the one-line
+    record contract survives an import problem)."""
+    try:
+        from ompi_tpu.mpi import trace as _trace
+
+        return _trace.counters_snapshot()
+    except Exception as e:  # noqa: BLE001
+        return {"error": f"{type(e).__name__}: {e}"}
 
 
 if __name__ == "__main__":

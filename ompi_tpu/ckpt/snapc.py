@@ -27,6 +27,7 @@ from typing import Any, Callable, Optional
 import numpy as np
 
 from ompi_tpu.ckpt.store import SnapshotStore
+from ompi_tpu.mpi import trace as trace_mod
 from ompi_tpu.mpi.constants import ERR_IO, MPIException
 
 __all__ = ["checkpoint", "restart", "CheckpointManager"]
@@ -41,6 +42,16 @@ def checkpoint(comm, store: SnapshotStore, state: dict[str, Any],
     All-or-nothing: if any rank fails to write, no commit record is
     created and the snapshot is invisible to restart.
     """
+    if trace_mod.active:
+        with trace_mod.span("ckpt", "checkpoint", rank=comm.pml.rank,
+                            seq=-1 if seq is None else int(seq),
+                            arrays=len(state)):
+            return _checkpoint_impl(comm, store, state, seq, keep_last,
+                                    extra_meta)
+    return _checkpoint_impl(comm, store, state, seq, keep_last, extra_meta)
+
+
+def _checkpoint_impl(comm, store, state, seq, keep_last, extra_meta) -> int:
     if seq is None:
         latest = store.latest()
         # all ranks compute the same next seq from the committed history,
@@ -107,6 +118,14 @@ def restart(comm, store: SnapshotStore, seq: Optional[int] = None,
     ``restore_fn(name, host_array)`` re-places each array (device_put with
     a sharding, dtype cast, ...); default returns the host array.
     """
+    if trace_mod.active:
+        with trace_mod.span("ckpt", "restart", rank=comm.pml.rank,
+                            seq=-1 if seq is None else int(seq)):
+            return _restart_impl(comm, store, seq, restore_fn)
+    return _restart_impl(comm, store, seq, restore_fn)
+
+
+def _restart_impl(comm, store, seq, restore_fn):
     if seq is None:
         # rank 0 decides (directory listings may race GC on shared fs)
         mine = store.latest()

@@ -27,6 +27,7 @@ from typing import Callable, Optional
 from ompi_tpu.core import dss, output
 from ompi_tpu.core.config import VarType, register_var, var_registry
 from ompi_tpu.core.mca import Component, Framework
+from ompi_tpu.mpi import trace as trace_mod
 from ompi_tpu.mpi.constants import MPIException
 
 __all__ = ["btl_framework", "TcpBTL", "SelfBTL", "ShmBTLComponent",
@@ -434,6 +435,18 @@ class BtlEndpoint:
         when it cannot block — self loopback always, shm when the ring has
         room.  False ⇒ caller enqueues for the send worker.  Safe to mix
         with queued sends: the PML reorders by per-(peer,cid) sequence."""
+        ok = self._try_send_inline(peer, header, payload)
+        if ok and trace_mod.active:
+            # AFTER success only: a declined inline attempt is re-sent by
+            # the worker (whose endpoint.send emits its own instant) — an
+            # entry-time emit would trace that frame twice
+            trace_mod.instant("btl", "send_inline", rank=self.rank,
+                              peer=peer, nbytes=len(payload),
+                              t=header.get("t"))
+        return ok
+
+    def _try_send_inline(self, peer: int, header: dict,
+                         payload: bytes = b"") -> bool:
         if peer == self.rank:
             self.self_btl.send(peer, header, payload)
             return True
@@ -455,6 +468,9 @@ class BtlEndpoint:
         return False
 
     def send(self, peer: int, header: dict, payload: bytes = b"") -> None:
+        if trace_mod.active:
+            trace_mod.instant("btl", "send", rank=self.rank, peer=peer,
+                              nbytes=len(payload), t=header.get("t"))
         if peer == self.rank:
             self.self_btl.send(peer, header, payload)
             return

@@ -53,6 +53,7 @@ from typing import Callable, Optional
 
 from ompi_tpu.core import dss, output
 from ompi_tpu.core.config import VarType, register_var, var_registry
+from ompi_tpu.mpi import trace as trace_mod
 
 __all__ = ["ShmBTL", "FrameTooBig", "ShmRingWriter", "ShmRingReader"]
 
@@ -508,6 +509,15 @@ class ShmBTL:
         if w is not None:
             w.close()
 
+    def _trace_publish(self, peer: int, payload) -> None:
+        """Counter + instant for a frame that DID enter a ring — called
+        only after a successful publish, so the pvar never counts frames
+        a FrameTooBig/dead-peer failure kept out."""
+        trace_mod.count("btl_shm_publish_total")
+        if trace_mod.active:
+            trace_mod.instant("btl", "shm_publish", rank=self.rank,
+                              peer=peer, nbytes=len(payload))
+
     def send(self, peer: int, header: dict, payload=b"") -> None:
         """Deliver one frame (``payload``: any bytes-like, zero-copy
         buffer views included); raises FrameTooBig for oversized frames,
@@ -515,6 +525,7 @@ class ShmBTL:
         never called for this peer."""
         self._check_alive(peer)
         self._writers[peer].send(header, payload)
+        self._trace_publish(peer, payload)
 
     def try_send(self, peer: int, header: dict, payload=b"") -> bool:
         """Nonblocking delivery on the caller's thread; False when the
@@ -525,7 +536,10 @@ class ShmBTL:
         if w is None:
             return False
         self._check_alive(peer)
-        return w.try_send(header, payload)
+        if not w.try_send(header, payload):
+            return False
+        self._trace_publish(peer, payload)
+        return True
 
     def try_send_eager(self, peer: int, tag: int, cid: int, seq: int,
                       dt: str, elems: int, shp: tuple, payload) -> bool:
@@ -535,7 +549,10 @@ class ShmBTL:
         if w is None or w._fast is None:
             return False
         self._check_alive(peer)
-        return w.try_send_eager(tag, cid, seq, dt, elems, shp, payload)
+        if not w.try_send_eager(tag, cid, seq, dt, elems, shp, payload):
+            return False
+        self._trace_publish(peer, payload)
+        return True
 
     # -- receive side ------------------------------------------------------
 
@@ -587,8 +604,20 @@ class ShmBTL:
                     # (tail already advanced) — same loss semantics as a tcp
                     # reader thread dying mid-delivery; the log below is the
                     # only trace, so keep it loud
-                    n += hook(r) if hook is not None \
-                        else r.poll(self.on_frame)
+                    if hook is not None:
+                        n += hook(r)   # fused drain traces in the PML
+                    else:
+                        _t0 = (trace_mod.begin()
+                               if trace_mod.active else 0)
+                        got = r.poll(self.on_frame)
+                        if got:
+                            trace_mod.count("btl_shm_drained_total", got)
+                            if _t0 and trace_mod.active:
+                                trace_mod.complete(
+                                    "btl", "shm_drain", _t0,
+                                    rank=self.rank, peer=r.peer,
+                                    frames=got)
+                        n += got
                 except Exception as e:   # a bad frame must not kill polling
                     _log.error("btl/shm poll from %d failed: %r", r.peer, e)
             if n:

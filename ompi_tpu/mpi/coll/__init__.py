@@ -30,6 +30,7 @@ from typing import TYPE_CHECKING, Optional
 
 from ompi_tpu.core.buffer import BufferKind, BufferLocationError, classify
 from ompi_tpu.core.mca import Component, Framework
+from ompi_tpu.mpi import trace as trace_mod
 
 if TYPE_CHECKING:
     from ompi_tpu.mpi.comm import Communicator
@@ -75,17 +76,39 @@ def _make_dispatch(slot: str, host_fn, host_name: Optional[str],
                     f"component selected (directive excludes "
                     f"host/self; device path [{dev_name}] needs jax "
                     f"arrays)")
-            return host_fn(comm, buf, *args, **kw)
-        if dev_fn is None:
-            raise BufferLocationError(
-                f"{slot}: device/traced buffer but no device-capable coll "
-                f"component selected (have [{host_name}]; enable coll/xla "
-                f"and comm.bind_device(...) for the device path, or "
-                f"np.asarray() the buffer if host staging is intended)")
-        return dev_fn(comm, buf, *args, **kw)
+            fn, provider = host_fn, host_name
+        else:
+            if dev_fn is None:
+                raise BufferLocationError(
+                    f"{slot}: device/traced buffer but no device-capable "
+                    f"coll component selected (have [{host_name}]; enable "
+                    f"coll/xla and comm.bind_device(...) for the device "
+                    f"path, or np.asarray() the buffer if host staging is "
+                    f"intended)")
+            fn, provider = dev_fn, dev_name
+        if trace_mod.active:   # per-collective span at the ONE choke point
+            with trace_mod.span("coll", slot, rank=comm.pml.rank,
+                                provider=provider, comm=comm.name,
+                                cid=comm.cid, size=comm.size):
+                return fn(comm, buf, *args, **kw)
+        return fn(comm, buf, *args, **kw)
 
     dispatch.__name__ = f"coll_{slot}_dispatch"
     return dispatch
+
+
+def _make_traced_barrier(host_fn):
+    """Barrier has no buffer to classify; wrap the provider directly so
+    the epoch still shows up on the coll timeline."""
+    def barrier(comm, *args, **kw):
+        if trace_mod.active:
+            with trace_mod.span("coll", "barrier", rank=comm.pml.rank,
+                                comm=comm.name, cid=comm.cid,
+                                size=comm.size):
+                return host_fn(comm, *args, **kw)
+        return host_fn(comm, *args, **kw)
+
+    return barrier
 
 
 def install(comm: "Communicator") -> None:
@@ -116,7 +139,7 @@ def install(comm: "Communicator") -> None:
                     _make_dispatch(slot, host_fn, host_name, dev_fn,
                                    dev_name))
         else:  # barrier: no buffer to classify; host provider wins
-            setattr(module, slot, host_fn or dev_fn)
+            setattr(module, slot, _make_traced_barrier(host_fn or dev_fn))
         if host_name:
             module.providers[slot] = host_name
         if dev_name:

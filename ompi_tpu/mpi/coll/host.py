@@ -17,6 +17,7 @@ import numpy as np
 
 from ompi_tpu.core.config import VarType, register_var, var_registry
 from ompi_tpu.core.mca import Component
+from ompi_tpu.mpi import trace as trace_mod
 from ompi_tpu.mpi.coll import base, coll_framework, rules
 from ompi_tpu.mpi.op import Op
 
@@ -39,10 +40,12 @@ class HostCollBase(Component):
         if not alg:
             path = var_registry.get("coll_host_dynamic_rules")
             if not path:
+                self._trace_decision(coll, comm, nbytes, None, "fixed")
                 return None
             alg = rules.load_rules(path).lookup(coll, comm.size, nbytes)
             src = f"rules file {path}"
             if alg is None:
+                self._trace_decision(coll, comm, nbytes, None, "fixed")
                 return None
         valid = self.ALGORITHMS.get(coll, ())
         if alg not in valid:
@@ -51,7 +54,20 @@ class HostCollBase(Component):
             raise MPIException(
                 f"unknown {coll} algorithm {alg!r} (from {src}); "
                 f"valid: {', '.join(valid)}")
+        self._trace_decision(coll, comm, nbytes, alg, src)
         return alg
+
+    @staticmethod
+    def _trace_decision(coll: str, comm, nbytes: int,
+                        alg: Optional[str], src: str) -> None:
+        """Record the selection layer's verdict on the timeline, so the
+        per-algorithm spans carry WHY that algorithm ran (≈ what MPI
+        Advance re-benchmarks offline, captured in-band instead)."""
+        if trace_mod.active:
+            trace_mod.instant(
+                "coll", f"decision:{coll}", rank=comm.pml.rank,
+                algorithm=alg or "fixed-default", source=src,
+                nbytes=nbytes, size=comm.size)
 
 
 @coll_framework.component

@@ -24,6 +24,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from ompi_tpu.mpi import trace as trace_mod
 from ompi_tpu.mpi.constants import MPIException
 
 # native C++ convertor (ompi_tpu/_native): used above this payload size;
@@ -65,6 +66,25 @@ class ConvertorStats:
 
 #: process-wide convertor counters (observability hook, not a hot metric)
 stats = ConvertorStats()
+
+#: plan kinds exported as commit-time counters
+#: (``convertor_plan_<kind>_total`` pvars — see ompi_tpu.mpi.trace)
+_PLAN_COUNTED = frozenset(("single", "strided", "runs", "items"))
+
+
+def _count_commit_plan(dt: "Datatype", first: bool) -> None:
+    """Bump the pack-plan-class counter for a freshly committed datatype
+    (once per datatype: re-commits are MPI-legal no-ops)."""
+    if not first:
+        return
+    kind = dt.pack_plan(1).kind
+    if kind in _PLAN_COUNTED:
+        trace_mod.count(f"convertor_plan_{kind}_total")
+        if trace_mod.active:
+            trace_mod.instant(
+                "datatype", f"commit:{kind}",
+                dtname=getattr(dt, "name", type(dt).__name__),
+                size=dt.size, extent=dt.extent)
 
 
 class PackPlan:
@@ -436,13 +456,19 @@ class Datatype:
                 f"{plan.span}B for count={count}")
         stats.pack_calls += 1
         stats.pack_bytes += plan.total
-        if plan.kind == "empty":
+        if plan.kind == "empty":   # no bytes move: no span (all 3 paths)
             return b""
+        _t0 = trace_mod.begin() if trace_mod.active else 0
         if plan.kind == "single":   # single-memcpy fast path
-            return raw[plan.start:plan.start + plan.total].tobytes()
-        out = np.empty(plan.total, np.uint8)
-        self._execute_pack(raw, plan, out)
-        return out.tobytes()
+            blob = raw[plan.start:plan.start + plan.total].tobytes()
+        else:
+            out = np.empty(plan.total, np.uint8)
+            self._execute_pack(raw, plan, out)
+            blob = out.tobytes()
+        if _t0 and trace_mod.active:
+            trace_mod.complete("datatype", f"pack:{plan.kind}", _t0,
+                               nbytes=plan.total)
+        return blob
 
     def pack_into(self, buf: np.ndarray, count: int, out) -> int:
         """Pack ``count`` items from ``buf`` into a caller-provided
@@ -469,10 +495,14 @@ class Datatype:
         stats.pack_bytes += plan.total
         if plan.kind == "empty":
             return 0
+        _t0 = trace_mod.begin() if trace_mod.active else 0
         if plan.kind == "single":
             out_arr[:plan.total] = raw[plan.start:plan.start + plan.total]
-            return plan.total
-        self._execute_pack(raw, plan, out_arr[:plan.total])
+        else:
+            self._execute_pack(raw, plan, out_arr[:plan.total])
+        if _t0 and trace_mod.active:
+            trace_mod.complete("datatype", f"pack:{plan.kind}", _t0,
+                               nbytes=plan.total)
         return plan.total
 
     def _execute_pack(self, raw: np.ndarray, plan: PackPlan,
@@ -529,10 +559,14 @@ class Datatype:
         stats.unpack_bytes += plan.total
         if plan.kind == "empty":
             return
+        _t0 = trace_mod.begin() if trace_mod.active else 0
         if plan.kind == "single":
             raw[plan.start:plan.start + plan.total] = src[:plan.total]
-            return
-        self._execute_unpack(src[:plan.total], plan, raw)
+        else:
+            self._execute_unpack(src[:plan.total], plan, raw)
+        if _t0 and trace_mod.active:
+            trace_mod.complete("datatype", f"unpack:{plan.kind}", _t0,
+                               nbytes=plan.total)
 
     def _execute_unpack(self, src: np.ndarray, plan: PackPlan,
                         raw: np.ndarray) -> None:
@@ -895,8 +929,10 @@ class DerivedDatatype(Datatype):
         # through the plan build.  The tuple list and the device gather
         # map (element_indices) stay lazy — building either for a 1M-run
         # type costs more than the compile itself.
+        first = not self._committed
         self._committed = True
         self.pack_plan(1)
+        _count_commit_plan(self, first)
         return self
 
     def _seg_arrays(self) -> tuple[np.ndarray, np.ndarray]:
@@ -1021,8 +1057,10 @@ class StructDatatype(Datatype):
             f"gather path needs a uniform element type (host path only)")
 
     def commit(self) -> "StructDatatype":
+        first = not self._committed
         self._committed = True
         self.pack_plan(1)
+        _count_commit_plan(self, first)
         return self
 
     def resized(self, extent: int) -> "DerivedDatatype":

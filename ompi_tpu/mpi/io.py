@@ -40,6 +40,7 @@ import numpy as np
 
 from ompi_tpu.core.config import VarType, register_var, var_registry
 from ompi_tpu.mpi import datatype as dt_mod
+from ompi_tpu.mpi import trace as trace_mod
 from ompi_tpu.mpi.constants import ERR_IO, MPIException
 from ompi_tpu.mpi.datatype import Datatype
 from ompi_tpu.mpi.request import CompletedRequest, Request
@@ -888,6 +889,14 @@ class File:
     def read_at(self, offset: int, count: int) -> np.ndarray:
         """≈ MPI_File_read_at — offset/count in etype units of the view."""
         self._check_read()
+        if trace_mod.active:
+            with trace_mod.span("io", "read_at", rank=self.comm.pml.rank,
+                                offset=offset,
+                                nbytes=count * self.view.etype.size):
+                return self._read_at_impl(offset, count)
+        return self._read_at_impl(offset, count)
+
+    def _read_at_impl(self, offset: int, count: int) -> np.ndarray:
         runs = self.view.byte_runs(offset, count * self.view.etype.size)
         rd = _datareps[getattr(self, "_datarep", "native")][0]
         if rd is None and len(runs) == 1 and hasattr(os, "preadv"):
@@ -908,7 +917,12 @@ class File:
     def write_at(self, offset: int, data: Any) -> int:
         """≈ MPI_File_write_at — returns etypes written."""
         self._check_write()
-        return self._write_raw_at(offset, self._as_bytes(data))
+        raw = self._as_bytes(data)
+        if trace_mod.active:
+            with trace_mod.span("io", "write_at", rank=self.comm.pml.rank,
+                                offset=offset, nbytes=len(raw)):
+                return self._write_raw_at(offset, raw)
+        return self._write_raw_at(offset, raw)
 
     def _write_raw_at(self, offset: int, raw: bytes) -> int:
         runs = self.view.byte_runs(offset, len(raw))
@@ -1442,6 +1456,14 @@ class File:
         fcoll_two_phase_file_write_all.c, fcoll/dynamic)."""
         self._check_write()
         raw = self._as_bytes(data)
+        if trace_mod.active:
+            with trace_mod.span("io", "write_at_all",
+                                rank=self.comm.pml.rank, offset=offset,
+                                nbytes=len(raw)):
+                return self._write_at_all_body(offset, raw)
+        return self._write_at_all_body(offset, raw)
+
+    def _write_at_all_body(self, offset: int, raw: bytes) -> int:
         my_runs = self.view.byte_runs(offset, len(raw))
         comp = self._fcoll_component(len(raw), my_runs)
         if comp == "individual":
@@ -1533,6 +1555,14 @@ class File:
         """≈ MPI_File_read_at_all — collective read through the selected
         fcoll component."""
         self._check_read()
+        if trace_mod.active:
+            with trace_mod.span("io", "read_at_all",
+                                rank=self.comm.pml.rank, offset=offset,
+                                nbytes=count * self.view.etype.size):
+                return self._read_at_all_body(offset, count)
+        return self._read_at_all_body(offset, count)
+
+    def _read_at_all_body(self, offset: int, count: int) -> np.ndarray:
         nbytes = count * self.view.etype.size
         my_runs = self.view.byte_runs(offset, nbytes)
         comp = self._fcoll_component(nbytes, my_runs)

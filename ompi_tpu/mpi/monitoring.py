@@ -130,13 +130,22 @@ class Monitor:
 
     def _register_pvars(self) -> None:
         rank = self.pml.rank
+        # both directions + the matching-engine counters, so the MPI_T
+        # view carries the same information as the matrices (the
+        # reference's common_monitoring exports recv pvars too)
         specs = [
             (f"pml_monitoring_messages_count_{rank}", "messages",
              lambda m: int(sum(a.sum() for a in m.sent_count.values()))),
             (f"pml_monitoring_messages_size_{rank}", "bytes",
              lambda m: int(sum(a.sum() for a in m.sent_bytes.values()))),
+            (f"pml_monitoring_messages_recv_count_{rank}", "messages",
+             lambda m: int(sum(a.sum() for a in m.recv_count.values()))),
+            (f"pml_monitoring_messages_recv_size_{rank}", "bytes",
+             lambda m: int(sum(a.sum() for a in m.recv_bytes.values()))),
             (f"pml_monitoring_unexpected_{rank}", "messages",
              lambda m: m.unexpected),
+            (f"pml_monitoring_matched_{rank}", "messages",
+             lambda m: m.matched),
         ]
         try:
             for name, unit, fn in specs:
@@ -172,6 +181,21 @@ class Monitor:
                 "unexpected": self.unexpected,
                 "matched": self.matched,
             }
+
+    def matrices(self) -> dict:
+        """All four per-peer matrices as one nested dict —
+        ``{what: {class: int64 array of len nranks}}`` plus the scalar
+        engine counters.  Copies, taken under the lock: callers may keep
+        the result across a detach()/attach() cycle."""
+        with self._lock:
+            out: dict = {
+                what: {c: getattr(self, what)[c].copy() for c in CLASSES}
+                for what in ("sent_count", "sent_bytes",
+                             "recv_count", "recv_bytes")
+            }
+            out["unexpected"] = self.unexpected
+            out["matched"] = self.matched
+        return out
 
     def row(self, what: str = "sent_bytes",
             cls: Optional[str] = None) -> np.ndarray:

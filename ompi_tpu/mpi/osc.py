@@ -32,6 +32,7 @@ import numpy as np
 
 from ompi_tpu.core import dss, output
 from ompi_tpu.mpi import op as op_mod
+from ompi_tpu.mpi import trace as trace_mod
 from ompi_tpu.mpi.constants import ANY_SOURCE, MPIException
 from ompi_tpu.mpi.request import Request
 
@@ -455,6 +456,13 @@ class Window:
 
     def fence(self) -> None:
         """Active-target epoch boundary (≈ MPI_Win_fence)."""
+        if trace_mod.active:   # epoch spans on the osc timeline
+            with trace_mod.span("osc", "fence", rank=self.comm.pml.rank,
+                                win=self.name):
+                return self._fence_impl()
+        return self._fence_impl()
+
+    def _fence_impl(self) -> None:
         for r in self._epoch_reqs:
             r.wait()
         self._epoch_reqs.clear()
@@ -487,6 +495,9 @@ class Window:
         self._exposure_group = set(origins)
         for o in origins:
             _ctrl_send(self.comm, o, ("post", self.comm.rank), _TAG_REQ)
+        if trace_mod.active:
+            trace_mod.instant("osc", "post", rank=self.comm.pml.rank,
+                              win=self.name, origins=list(origins))
 
     def start(self, targets: list[int]) -> None:
         """≈ MPI_Win_start: open an access epoch to ``targets``; blocks until
@@ -511,6 +522,7 @@ class Window:
         behind them (FIFO per channel ⇒ ordered after every op)."""
         if self._access_group is None:
             raise MPIException("MPI_Win_complete without MPI_Win_start")
+        _t0 = trace_mod.begin() if trace_mod.active else 0
         for r in self._epoch_reqs:
             r.wait()
         self._epoch_reqs.clear()
@@ -518,6 +530,10 @@ class Window:
             _ctrl_send(self.comm, t,
                        ("pscw_done", self.comm.rank, self._sent_to[t]),
                        _TAG_REQ)
+        if _t0 and trace_mod.active:
+            trace_mod.complete("osc", "pscw_complete", _t0,
+                               rank=self.comm.pml.rank, win=self.name,
+                               targets=list(self._access_group))
         self._access_group = None
 
     def wait(self) -> None:
@@ -525,6 +541,7 @@ class Window:
         in the post group completed (hence all their ops are applied here)."""
         if self._exposure_group is None:
             raise MPIException("MPI_Win_wait without MPI_Win_post")
+        _t0 = trace_mod.begin() if trace_mod.active else 0
         want = self._exposure_group
         with self._cv:
             self._cv.wait_for(lambda: want <= self._pscw_done
@@ -536,6 +553,9 @@ class Window:
             self._pscw_done -= want
             errors, self._errors = self._errors, []
         self._exposure_group = None
+        if _t0 and trace_mod.active:
+            trace_mod.complete("osc", "pscw_wait", _t0,
+                               rank=self.comm.pml.rank, win=self.name)
         if errors:
             raise MPIException(
                 "RMA ops failed at this target during the PSCW epoch: "
@@ -608,19 +628,29 @@ class Window:
                 "MPI_Win_lock on a window created with the no_locks=true "
                 "info hint (the app promised no passive-target sync)",
                 error_class=51)
+        _t0 = trace_mod.begin() if trace_mod.active else 0
         with self._origin_lock:
             _ctrl_send(self.comm, target,
                        ("lock", self.comm.rank, bool(exclusive)),
                        _TAG_REQ).wait()
             self._recv_reply(target)  # grant
+        if _t0 and trace_mod.active:
+            trace_mod.complete("osc", "lock", _t0,
+                               rank=self.comm.pml.rank, win=self.name,
+                               target=target, exclusive=bool(exclusive))
 
     def unlock(self, target: int) -> None:
         """≈ MPI_Win_unlock: flush my ops at target, release the lock."""
+        _t0 = trace_mod.begin() if trace_mod.active else 0
         with self._origin_lock:
             _ctrl_send(self.comm, target,
                        ("unlock", self.comm.rank, self._sent_to[target]),
                        _TAG_REQ).wait()
             self._recv_reply(target)  # flushed + released
+        if _t0 and trace_mod.active:
+            trace_mod.complete("osc", "unlock", _t0,
+                               rank=self.comm.pml.rank, win=self.name,
+                               target=target)
 
     def flush(self, target: int) -> None:
         """≈ MPI_Win_flush: wait until target applied all my ops."""

@@ -35,6 +35,7 @@ from ompi_tpu.core.buffer import BufferKind, BufferLocationError, classify
 from ompi_tpu.core.config import VarType, register_var, var_registry
 from ompi_tpu.core.mca import Component, Framework
 from ompi_tpu.mpi import datatype as dt_mod
+from ompi_tpu.mpi import trace as trace_mod
 from ompi_tpu.mpi.btl import BtlEndpoint
 from ompi_tpu.mpi.constants import (
     ANY_SOURCE, ANY_TAG, ERR_TRUNCATE, PROC_NULL, MPIException,
@@ -231,6 +232,8 @@ class _RecvState:
         self.received = 0
         self.src_hdr = src_hdr
         self.peer = peer
+        # flight-recorder span: CTS sent → last fragment landed
+        self.trace_t0 = trace_mod.begin() if trace_mod.active else 0
 
 
 class BsendPool:
@@ -556,12 +559,14 @@ class PmlOb1:
                 and plan.start + plan.total <= arr.nbytes):
             payload = arr.reshape(-1).view(np.uint8).data[
                 plan.start:plan.start + plan.total]
+            trace_mod.count("pml_zero_copy_sends_total")
         else:
             # non-contiguous: stage through the compiled plan walk into a
             # reusable uint8 buffer (pack_into — no intermediate bytes)
             staged = np.empty(plan.total, np.uint8)
             datatype.pack_into(arr, count, staged)
             payload = staged.data
+            trace_mod.count("pml_packed_sends_total")
         req = Request(kind="send")
         on_done = None
         if mode == "buffered":
@@ -668,6 +673,7 @@ class PmlOb1:
             seq = self._seq.get(seq_key, 0)
             self._seq[seq_key] = seq + 1
         payload = arr.reshape(-1).view(np.uint8).data
+        trace_mod.count("pml_zero_copy_sends_total")
         req = Request(kind="send")
         dt = _dtype_to_wire(arr.dtype)
         if proc_ok and ep.proc_btl.send_fast(peer, tag, cid, seq, payload,
@@ -854,6 +860,7 @@ class PmlOb1:
         cannot be reordered against each other within a stream."""
         eng = self._eng
         punts = None
+        _t0 = trace_mod.begin() if trace_mod.active else 0
         try:
             with self._lock:
                 new_tail, n, acts = eng.drain_ring(
@@ -868,8 +875,17 @@ class PmlOb1:
                         self._apply_action(act)
         except self._fast.Unsupported:
             # a header tag only the python codec knows: drain this batch
-            # through the python framing path instead
-            return reader.poll(self._on_frame)
+            # through the python framing path instead (same counter +
+            # span accounting as the fused path — frames delivered here
+            # must not read as lost in the publish/drain pvar pair)
+            n = reader.poll(self._on_frame)
+            if n:
+                trace_mod.count("btl_shm_drained_total", n)
+                if _t0 and trace_mod.active:
+                    trace_mod.complete("pml", "shm_drain_batch", _t0,
+                                       rank=self.rank, peer=reader.peer,
+                                       frames=n)
+            return n
         except ValueError as e:
             # corrupt stream: same recovery as ShmRingReader.poll —
             # nothing trustworthy to advance by; discard and surface
@@ -884,6 +900,11 @@ class PmlOb1:
             for _k, hdr, payload in punts:
                 self._on_frame(reader.peer, hdr, payload)
         if n:
+            trace_mod.count("btl_shm_drained_total", n)
+            if _t0 and trace_mod.active:
+                trace_mod.complete("pml", "shm_drain_batch", _t0,
+                                   rank=self.rank, peer=reader.peer,
+                                   frames=n)
             self._drain_events()
         return n
 
@@ -1252,8 +1273,14 @@ class PmlOb1:
             _, req, peer, tag, payload, dtspec, shp = act
             if self._listeners:
                 self._emit(EVT_MATCH, peer=peer, tag=tag, cid=req.cid)
+            # the synthetic header must carry cid: _deliver's
+            # EVT_DELIVER emit reads hdr["cid"] when listeners are
+            # attached (a listener-bearing receiver crashed here when a
+            # listenerless same-address-space peer fast-sent to an
+            # allocate-on-match recv)
             self._deliver(req, peer,
-                          {"tag": tag, "dt": dtspec, "shp": list(shp)},
+                          {"tag": tag, "cid": req.cid, "dt": dtspec,
+                           "shp": list(shp)},
                           payload)
         elif kind == "rnack":  # ready-mode send found no posted recv
             _, peer, hdr = act
@@ -1359,6 +1386,11 @@ class PmlOb1:
             if done:
                 del self._recv_states[hdr["rid"]]
         if done:
+            if state.trace_t0 and trace_mod.active:
+                trace_mod.complete(
+                    "pml", "rndv_recv", state.trace_t0, rank=self.rank,
+                    peer=state.peer, nbytes=len(state.data),
+                    direct=state.direct)
             if state.direct:
                 self._complete_direct(state)
             else:
@@ -1461,6 +1493,7 @@ class PmlOb1:
                 elif job[0] == "rndv_data":
                     _, state, rid = job
                     data = state.payload
+                    _t0 = trace_mod.begin() if trace_mod.active else 0
                     offs = list(range(0, len(data), frag))
                     for i, off in enumerate(offs):
                         last = i == len(offs) - 1
@@ -1478,6 +1511,11 @@ class PmlOb1:
                                     "rendezvous fragment could not be "
                                     "delivered"))
                             break
+                    if _t0 and trace_mod.active:
+                        trace_mod.complete(
+                            "pml", "rndv_send", _t0, rank=self.rank,
+                            peer=state.peer, nbytes=len(data),
+                            fragments=len(offs))
             except Exception:  # noqa: BLE001 — the worker must survive
                 _log.error("send worker: unexpected error\n%s",
                            __import__("traceback").format_exc())

@@ -119,6 +119,26 @@ def init() -> Communicator:
 
         pml = pml_framework.select().create(rank)
 
+        # flight recorder (tpurun --trace / OMPI_TPU_TRACE=1): arm the
+        # per-rank ring buffer, bridge the PML's PERUSE hooks onto the
+        # timeline, and install the SIGTERM flush so the errmgr abort
+        # path (kill_job: SIGTERM → grace → SIGKILL) still yields a
+        # readable trace from every rank
+        from ompi_tpu.mpi import trace as _trace
+
+        if _trace.env_enabled() or _trace.active:
+            # enable() is idempotent and stamps rank/jobid onto an
+            # already-armed recorder (an app may have called enable()
+            # before init(), when it could not know its rank); a NEW pml
+            # per init epoch needs its own bridge (finalize detached the
+            # previous epoch's)
+            _trace.enable(
+                rank=rank,
+                jobid=int(os.environ.get(pmix.ENV_JOBID, "0") or 0),
+                install_signal=under_launcher)
+            _trace.attach_pml(pml)
+            _trace.instant("runtime", "init", rank=rank, size=size)
+
         restarted = bool(os.environ.get("OMPI_TPU_RESTART"))
         if size > 1:
             assert client is not None
@@ -212,6 +232,19 @@ def finalize(_collective: bool = True) -> None:
         finally:
             # no-op if already left; atexit path
             multihost.shutdown(graceful=not respawn_seen())
+            from ompi_tpu.mpi import trace as _trace
+
+            if _trace.active:
+                # successful teardown flushes too: the CI smoke job (and
+                # any tpurun --trace run) reads the per-rank dumps after
+                # a clean exit
+                _trace.instant("runtime", "finalize",
+                               rank=getattr(pml, "rank", -1))
+                try:
+                    _trace.flush()
+                except Exception:  # noqa: BLE001 — teardown continues
+                    pass
+                _trace.detach_pml(pml)   # a re-init epoch re-arms fresh
             if _state["pml"] is not None:
                 _state["pml"].close()
             client = _state["client"]
@@ -253,6 +286,12 @@ def abort(errorcode: int = 1, msg: str = "") -> None:
 
     client = _state.get("client")
     _log.error("MPI_Abort(%d)%s", errorcode, f": {msg}" if msg else "")
+    from ompi_tpu.mpi import trace as _trace
+
+    if _trace.active:
+        # flush THIS rank's flight recorder before teardown; peers flush
+        # from the SIGTERM the errmgr's kill_job fans out
+        _trace.crash_dump(reason=f"MPI_Abort({errorcode})")
     if client is not None:
         try:
             client.abort(msg or f"MPI_Abort({errorcode})",

@@ -1,0 +1,465 @@
+"""Flight recorder — per-rank time-resolved tracing + metrics export.
+
+≈ the reference's PERUSE event hooks and the MPI_T pvar discipline, but
+with the time axis the counters lack: a fixed-size, lock-cheap ring
+buffer of timestamped spans/instants (monotonic ns, category, rank, peer,
+tag/cid, nbytes, plan class) that every transport layer feeds —
+PML matching/rendezvous, btl/shm ring publish+drain, coll algorithm
+selection, osc epochs, io read/write, ckpt snapshot/replay, and the
+datatype convertor's pack-plan classes.
+
+Cost discipline:
+
+- disabled (the default): every emit site is ONE module-attribute check
+  (``if trace.active:``) — no recorder object, no clock read, no dict.
+- counters (``trace.count``) are always on, like ``datatype.stats``: a
+  plain dict increment, no lock — they make the zero-copy/pack-plan fast
+  paths observable even when the timeline is off.
+- enabled: one ``monotonic_ns`` read per instant, two per span, and a
+  slot store into a preallocated ring (``itertools.count`` hands out
+  indices atomically under the GIL; the ring wraps, oldest events lost
+  first — a flight recorder, not a log).
+
+Export, three ways:
+
+- :func:`flush` / ``tools/trace_export.py`` — Chrome/Perfetto trace JSON
+  (one pid per rank, one tid per category).
+- :func:`metrics_snapshot` — the whole ``pvar_registry`` as a
+  Prometheus-style text block.
+- crash dump — ``runtime.abort()`` and the SIGTERM the errmgr's abort
+  path fans out both land in :func:`crash_dump`, flushing the buffer to
+  ``${TMPDIR}/ompi_tpu_trace_<jobid>_rank<r>.json`` before teardown, so
+  failed runs are debuggable after the fact.
+
+Enable with ``tpurun --trace`` or ``OMPI_TPU_TRACE=1`` (read at
+``ompi_tpu.init()``), or programmatically via :func:`enable`.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import re
+import tempfile
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Optional
+
+from ompi_tpu.mpi.mpit import Pvar, PvarClass, pvar_registry
+
+__all__ = [
+    "FlightRecorder", "enable", "disable", "enabled", "env_enabled",
+    "instant", "begin", "complete", "span", "count", "counters",
+    "counters_snapshot", "attach_pml", "flush", "crash_dump",
+    "default_path", "metrics_snapshot", "chrome_events", "ENV_FLAG",
+]
+
+ENV_FLAG = "OMPI_TPU_TRACE"
+
+#: the timeline categories (→ one Chrome tid per category at export)
+CATEGORIES = ("pml", "btl", "coll", "osc", "io", "ckpt", "datatype",
+              "runtime")
+
+# ---------------------------------------------------------------------------
+# always-on counters (the pvar-backed fast-path observability)
+# ---------------------------------------------------------------------------
+
+_COUNTER_SPECS = (
+    # pack-plan classes, bumped once per committed derived/struct datatype
+    ("convertor_plan_single_total", "datatypes",
+     "committed datatypes whose pack plan collapsed to one memcpy"),
+    ("convertor_plan_strided_total", "datatypes",
+     "committed datatypes compiling to a strided block walk"),
+    ("convertor_plan_runs_total", "datatypes",
+     "committed datatypes compiling to coalesced absolute runs"),
+    ("convertor_plan_items_total", "datatypes",
+     "committed datatypes too large to expand (per-item walk)"),
+    # PML payload-path split: buffer views vs staged packs
+    ("pml_zero_copy_sends_total", "messages",
+     "sends whose payload rode a zero-copy view of the user buffer"),
+    ("pml_packed_sends_total", "messages",
+     "sends staged through the convertor pack path"),
+    # shm data plane
+    ("btl_shm_publish_total", "frames",
+     "frames published into shared-memory rings"),
+    ("btl_shm_drained_total", "frames",
+     "frames drained from shared-memory rings"),
+)
+
+#: plain-int counter store: dict increments, no lock — losses under
+#: pathological thread races are acceptable for metrics (like the
+#: reference's unlocked monitoring counters)
+counters: dict[str, int] = {name: 0 for name, _u, _d in _COUNTER_SPECS}
+
+
+def count(name: str, delta: int = 1) -> None:
+    """Bump an always-on counter (must be a registered name)."""
+    counters[name] += delta
+
+
+def counters_snapshot() -> dict[str, int]:
+    """Point-in-time copy of every always-on counter plus the convertor
+    call stats — the provenance block bench.py embeds per record."""
+    snap = dict(counters)
+    from ompi_tpu.mpi import datatype as _dt
+
+    snap["convertor_pack_calls_total"] = _dt.stats.pack_calls
+    snap["convertor_unpack_calls_total"] = _dt.stats.unpack_calls
+    snap["convertor_pack_bytes_total"] = _dt.stats.pack_bytes
+    snap["convertor_unpack_bytes_total"] = _dt.stats.unpack_bytes
+    return snap
+
+
+for _name, _unit, _desc in _COUNTER_SPECS:
+    pvar_registry.register_or_get(Pvar(
+        _name, PvarClass.COUNTER, unit=_unit, description=_desc,
+        read_fn=lambda _b, n=_name: counters[n]))
+
+
+# ---------------------------------------------------------------------------
+# the ring buffer
+# ---------------------------------------------------------------------------
+
+class FlightRecorder:
+    """Fixed-size ring of trace events.
+
+    An event is the tuple ``(ts_ns, dur_ns|None, category, name, rank,
+    args|None)``; ``dur_ns is None`` ⇒ instant, else a complete span that
+    STARTED at ``ts_ns``.  ``itertools.count`` hands out slot indices
+    atomically (CPython GIL), so concurrent emitters never fight over a
+    lock on the hot path; a wrapped ring simply forgets the oldest
+    events.
+    """
+
+    def __init__(self, capacity: int = 65536, rank: int = -1,
+                 jobid: int = 0) -> None:
+        self.capacity = max(16, int(capacity))
+        self.rank = rank
+        self.jobid = jobid
+        self._buf: list = [None] * self.capacity
+        self._n = itertools.count()
+        self._hwm = 0           # highest index handed out + 1 (approx.)
+
+    def add(self, ts_ns: int, dur_ns: Optional[int], cat: str, name: str,
+            rank: int, args: Optional[dict]) -> None:
+        i = next(self._n)
+        self._buf[i % self.capacity] = (ts_ns, dur_ns, cat, name, rank,
+                                        args)
+        self._hwm = i + 1
+
+    @property
+    def events_total(self) -> int:
+        return self._hwm
+
+    @property
+    def dropped(self) -> int:
+        return max(0, self._hwm - self.capacity)
+
+    def snapshot(self) -> list[tuple]:
+        """Events in (approximate) emission order, oldest first."""
+        n = self._hwm
+        if n <= self.capacity:
+            out = self._buf[:n]
+        else:
+            cut = n % self.capacity
+            out = self._buf[cut:] + self._buf[:cut]
+        return [e for e in out if e is not None]
+
+
+# module state: `active` is THE flag every emit site checks
+active = False
+recorder: Optional[FlightRecorder] = None
+_lock = threading.Lock()
+_old_sigterm: Any = None
+_sigterm_installed = False
+_pml_listeners: list = []   # (pml, cb) pairs attach_pml registered
+
+
+def env_enabled() -> bool:
+    return os.environ.get(ENV_FLAG, "") not in ("", "0")
+
+
+def enabled() -> bool:
+    return active
+
+
+def enable(capacity: Optional[int] = None, rank: int = -1,
+           jobid: int = 0, install_signal: bool = False) -> FlightRecorder:
+    """Arm the flight recorder (idempotent).  ``install_signal`` chains a
+    SIGTERM handler that flushes the buffer before dying — the errmgr
+    abort path kills ranks with SIGTERM (then a grace, then SIGKILL), so
+    every rank's trace survives a job teardown."""
+    global active, recorder
+    with _lock:
+        if recorder is None:
+            if capacity is None:
+                try:
+                    capacity = int(os.environ.get(
+                        "OMPI_TPU_TRACE_EVENTS", "") or 65536)
+                except ValueError:
+                    # a bad sizing knob must not kill the job at init
+                    capacity = 65536
+            recorder = FlightRecorder(capacity, rank=rank, jobid=jobid)
+        else:
+            # idempotent re-enable must still adopt a LATER-learned
+            # identity (an app that armed tracing before init() would
+            # otherwise flush every rank to the shared rank--1 path,
+            # ranks clobbering each other's dumps)
+            if rank != -1:
+                recorder.rank = rank
+            if jobid:
+                recorder.jobid = jobid
+        active = True
+    if install_signal:
+        _install_sigterm_flush()
+    return recorder
+
+
+def disable() -> Optional[FlightRecorder]:
+    """Disarm; returns the recorder (snapshot/flush still work on it).
+    Also detaches every PML listener :func:`attach_pml` registered —
+    leaving one behind would keep the PML's eager fast lane bypassed
+    (it gates on having no listeners) long after tracing stopped."""
+    global active, recorder
+    with _lock:
+        active = False
+        rec, recorder = recorder, None
+        listeners, _pml_listeners[:] = list(_pml_listeners), []
+    for pml, cb in listeners:
+        try:
+            pml.remove_listener(cb)
+        except ValueError:
+            pass
+    return rec
+
+
+def _install_sigterm_flush() -> None:
+    """Best-effort: only the main thread may install handlers, and a
+    launcher (tpurun --timeout) may own SIGTERM already — chain it.
+    Idempotent: a second enable() must NOT chain the handler onto
+    itself (the self-referential _old_sigterm would recurse forever
+    inside the signal handler)."""
+    global _old_sigterm, _sigterm_installed
+    if _sigterm_installed:
+        return
+    import signal
+
+    def _flush_and_die(signum, frame):
+        try:
+            crash_dump(reason="sigterm")
+        except Exception:  # noqa: BLE001 — dying anyway
+            pass
+        if callable(_old_sigterm):
+            _old_sigterm(signum, frame)
+        elif _old_sigterm is signal.SIG_IGN:
+            return   # the process was ignoring SIGTERM; keep ignoring
+        else:
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    try:
+        _old_sigterm = signal.signal(signal.SIGTERM, _flush_and_die)
+        _sigterm_installed = True
+    except (ValueError, OSError):   # not the main thread
+        pass
+
+
+# ---------------------------------------------------------------------------
+# emit API (call sites gate on `trace.active` FIRST — see module doc)
+# ---------------------------------------------------------------------------
+
+def instant(cat: str, name: str, rank: int = -1, **args: Any) -> None:
+    r = recorder
+    if r is not None:
+        r.add(time.monotonic_ns(), None, cat, name, rank,
+              args or None)
+
+
+def begin() -> int:
+    """Span start timestamp (pair with :func:`complete`)."""
+    return time.monotonic_ns()
+
+
+def complete(cat: str, name: str, t0_ns: int, rank: int = -1,
+             **args: Any) -> None:
+    r = recorder
+    if r is not None:
+        now = time.monotonic_ns()
+        r.add(t0_ns, now - t0_ns, cat, name, rank, args or None)
+
+
+@contextmanager
+def span(cat: str, name: str, rank: int = -1, **args: Any):
+    t0 = time.monotonic_ns()
+    try:
+        yield
+    finally:
+        complete(cat, name, t0, rank=rank, **args)
+
+
+def attach_pml(pml) -> Any:
+    """Bridge the PML's PERUSE-style EVT_* hooks into the timeline: every
+    request-lifecycle event becomes a ``pml`` instant.  Returns the
+    listener so a caller can ``pml.remove_listener`` it.
+
+    Observer effect (same as attaching a monitoring.Monitor): a PML with
+    listeners bypasses its compiled eager fast lane (_isend_fast gates on
+    ``not self._listeners`` — the lane emits no events), so a TIMELINE
+    run routes eligible eager sends down the header path.  The always-on
+    counters (``pml_zero_copy_sends_total`` etc.) need no listener and
+    observe the fast lane undisturbed — use them, not an enabled
+    timeline, when measuring the fast path itself."""
+    prank = pml.rank
+
+    def _on_event(event: str, info: dict) -> None:
+        if active:
+            instant("pml", event, rank=prank, **info)
+
+    pml.add_listener(_on_event)
+    _pml_listeners.append((pml, _on_event))   # detached by disable()
+    return _on_event
+
+
+def detach_pml(pml) -> None:
+    """Remove the listener(s) attach_pml registered on ``pml`` — called
+    from finalize() so a later init() epoch re-arms a FRESH bridge
+    instead of keeping a closed PML in the listener table."""
+    for pair in [p for p in _pml_listeners if p[0] is pml]:
+        _pml_listeners.remove(pair)
+        try:
+            pml.remove_listener(pair[1])
+        except ValueError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# export
+# ---------------------------------------------------------------------------
+
+def chrome_events(rec: Optional[FlightRecorder] = None,
+                  pid: Optional[int] = None) -> list[dict]:
+    """The recorder's events as Chrome trace-event dicts (ts/dur in µs,
+    one pid per rank, one tid per category)."""
+    rec = rec if rec is not None else recorder
+    if rec is None:
+        return []
+    tids = {c: i for i, c in enumerate(CATEGORIES)}
+    out = []
+    for ts_ns, dur_ns, cat, name, rank, args in rec.snapshot():
+        ev_pid = pid if pid is not None else (
+            rank if rank >= 0 else rec.rank)
+        ev = {
+            "name": name, "cat": cat,
+            "ph": "X" if dur_ns is not None else "i",
+            "ts": ts_ns / 1000.0,
+            "pid": ev_pid,
+            "tid": tids.get(cat, len(CATEGORIES)),
+        }
+        if dur_ns is not None:
+            ev["dur"] = dur_ns / 1000.0
+        else:
+            ev["s"] = "t"          # instant scope: thread
+        if args:
+            ev["args"] = args
+        out.append(ev)
+    out.sort(key=lambda e: e["ts"])
+    return out
+
+
+def default_path(jobid: Optional[int] = None,
+                 rank: Optional[int] = None) -> str:
+    rec = recorder
+    if jobid is None:
+        jobid = rec.jobid if rec is not None else 0
+    if rank is None:
+        rank = rec.rank if rec is not None else -1
+    tmp = os.environ.get("TMPDIR") or tempfile.gettempdir()
+    return os.path.join(tmp, f"ompi_tpu_trace_{jobid}_rank{rank}.json")
+
+
+def flush(path: Optional[str] = None,
+          rec: Optional[FlightRecorder] = None) -> Optional[str]:
+    """Write this rank's buffer as a standalone Chrome trace JSON file;
+    returns the path (None when there is nothing to flush)."""
+    rec = rec if rec is not None else recorder
+    if rec is None:
+        return None
+    if path is None:
+        path = default_path(rec.jobid, rec.rank)
+    doc = {
+        "displayTimeUnit": "ns",
+        "otherData": {
+            "rank": rec.rank, "jobid": rec.jobid,
+            "events_total": rec.events_total, "dropped": rec.dropped,
+            # wall-vs-monotonic anchor: event ts are CLOCK_MONOTONIC
+            # (boot-relative, per machine); the exporter uses this
+            # offset to detect dumps whose clocks share no base
+            # (ranks on different hosts)
+            "clock_offset_ns": time.time_ns() - time.monotonic_ns(),
+            "counters": counters_snapshot(),
+        },
+        "traceEvents": chrome_events(rec),
+    }
+    tmp_path = f"{path}.tmp.{os.getpid()}"
+    with open(tmp_path, "w", encoding="utf-8") as f:
+        # span args are recorded verbatim — apps pass numpy scalars and
+        # other non-JSON types; a dump that raised here would break
+        # finalize/abort under tracing, so coerce instead
+        json.dump(doc, f, default=_json_coerce)
+    os.replace(tmp_path, path)     # readers never see a partial dump
+    return path
+
+
+def _json_coerce(obj: Any):
+    """Last-resort encoder for event args (numpy scalars → numbers,
+    everything else → its repr)."""
+    for cast in (int, float):
+        try:
+            return cast(obj)
+        except (TypeError, ValueError):
+            continue
+    return repr(obj)
+
+
+def crash_dump(reason: str = "abort") -> Optional[str]:
+    """The teardown flush: called from ``runtime.abort()`` and the
+    SIGTERM handler the errmgr abort path triggers.  Stamps the reason as
+    a final runtime instant so the timeline shows WHY it ends."""
+    rec = recorder
+    if rec is None:
+        return None
+    rec.add(time.monotonic_ns(), None, "runtime", f"crash_dump:{reason}",
+            rec.rank, None)
+    try:
+        return flush(rec=rec)
+    except Exception:  # noqa: BLE001 — teardown path must not raise
+        return None
+
+
+_METRIC_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def metrics_snapshot() -> str:
+    """Walk ``pvar_registry`` into a Prometheus-style text block
+    (COUNTER → counter, everything else → gauge; non-numeric and
+    binding-required pvars are skipped — a scraper wants scalars)."""
+    lines: list[str] = []
+    for name in pvar_registry.names():
+        pv = pvar_registry.lookup(name)
+        if pv.requires_binding:
+            continue
+        try:
+            v = pv.read()
+        except Exception:  # noqa: BLE001 — unreadable pvar: skip
+            continue
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue
+        metric = "ompi_tpu_" + _METRIC_RE.sub("_", name)
+        kind = "counter" if pv.klass is PvarClass.COUNTER else "gauge"
+        if pv.description:
+            lines.append(f"# HELP {metric} {pv.description}")
+        lines.append(f"# TYPE {metric} {kind}")
+        lines.append(f"{metric} {v}")
+    return "\n".join(lines) + "\n"

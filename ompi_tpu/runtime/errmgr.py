@@ -5,7 +5,13 @@ errmgr_default_hnp.c:351-470: on proc abort / comm failure, terminate the
 job).  Components decide what a proc-failure event does:
 
 - ``abort``    — default: first failure kills every remaining proc and the
-  job exits with the failed proc's status (mpirun's default).
+  job exits with the failed proc's status (mpirun's default).  The
+  teardown is SIGTERM → ``launcher_kill_grace_s`` → SIGKILL; ranks
+  running with the flight recorder armed (``tpurun --trace`` /
+  ``OMPI_TPU_TRACE=1``) flush their trace ring to
+  ``$TMPDIR/ompi_tpu_trace_<jobid>_rank<r>.json`` from that SIGTERM, so
+  an aborted job leaves a per-rank timeline behind for post-mortem
+  (merge with ``tools/trace_export.py``).
 - ``continue`` — log and keep going.
 - ``respawn``  — revive the failed rank in place up to
   ``errmgr_max_restarts`` times (≈ rmaps/resilient + the errmgr restart

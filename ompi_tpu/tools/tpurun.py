@@ -39,6 +39,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "'ssh' spawns daemons over ssh (≈ plm/rsh)")
     p.add_argument("--hosts", type=int, default=2,
                    help="number of simulated hosts for --plm sim")
+    p.add_argument("--trace", action="store_true",
+                   help="arm the per-rank flight recorder "
+                        "(OMPI_TPU_TRACE=1 in every rank); each rank "
+                        "flushes a Chrome-trace JSON to "
+                        "$TMPDIR/ompi_tpu_trace_<jobid>_rank<r>.json at "
+                        "finalize/abort — merge with tools/trace_export.py")
     p.add_argument("--timeout", type=float, default=None, metavar="SECS",
                    help="kill the job and exit nonzero after SECS "
                         "seconds (mpirun --timeout; CI hang guard)")
@@ -182,6 +188,12 @@ def main(argv: list[str] | None = None) -> int:
     # frameworks (pml/coll/...) select inside the app, not the launcher.
     import os
 
+    if args.trace:
+        # local fork/exec and --dvm-submit inherit the launcher's
+        # os.environ; the ssh daemon tree does NOT (env doesn't travel
+        # over ssh), so the flag ALSO rides the job's app env below
+        os.environ["OMPI_TPU_TRACE"] = "1"
+    trace_env = {"OMPI_TPU_TRACE": "1"} if args.trace else {}
     var_registry.load_cli([(k, v) for k, v in args.mca])
     for k, v in args.mca:
         os.environ[var_registry.ENV_PREFIX + k] = v
@@ -257,7 +269,7 @@ def main(argv: list[str] | None = None) -> int:
         from ompi_tpu.runtime.job import AppContext, Job
         from ompi_tpu.runtime.plm import MultiHostLauncher
 
-        job = Job([AppContext(argv=cmd, np=args.np)])
+        job = Job([AppContext(argv=cmd, np=args.np, env=trace_env)])
         return MultiHostLauncher(
             plm_name=args.plm, want_tpu=args.tpu,
             stdin_target=args.stdin if args.stdin is not None else "0",
@@ -266,7 +278,7 @@ def main(argv: list[str] | None = None) -> int:
 
     from ompi_tpu.runtime.launcher import launch
 
-    return launch(cmd, np=args.np, want_tpu=args.tpu,
+    return launch(cmd, np=args.np, want_tpu=args.tpu, env=trace_env,
                   stdin_target=args.stdin)
 
 

@@ -147,6 +147,45 @@ def test_classify_tag():
     assert mon.classify_tag(-1700) == "coll"       # neighbor window
 
 
+def _wire(coll_tag: int) -> int:
+    """comm.py's internal-tag encoding (_INTERNAL_TAG_BASE - coll_tag)."""
+    return -1000 - coll_tag
+
+
+def test_classify_tag_osc_window_edges():
+    """The osc window is EXACTLY coll_tag 500..699: both edges and the
+    tags one inside the neighboring windows."""
+    assert mon.classify_tag(_wire(499)) == "coll"   # last nbc tag
+    assert mon.classify_tag(_wire(500)) == "osc"    # first osc tag
+    assert mon.classify_tag(_wire(699)) == "osc"    # last osc tag
+    assert mon.classify_tag(_wire(700)) == "coll"   # first neighbor tag
+
+
+def test_classify_tag_neighbor_window():
+    """Every neighbor-exchange tag (topo.py's 700 block, per-op 64-tag
+    windows up to 891) counts as coll traffic, not osc."""
+    for coll_tag in range(700, 892):
+        assert mon.classify_tag(_wire(coll_tag)) == "coll"
+
+
+def test_classify_tag_property_every_internal_tag_has_one_class():
+    """Property over the full reserved coll-tag space comm.py can emit
+    (blocking 1..63, nbc 64..499, osc 500..699, neighbor 700..891):
+    classify_tag is total and lands in exactly one of CLASSES, osc iff
+    the tag sits in the osc window."""
+    for coll_tag in range(1, 892):
+        cls = mon.classify_tag(_wire(coll_tag))
+        assert cls in mon.CLASSES
+        assert sum(cls == c for c in mon.CLASSES) == 1
+        if 500 <= coll_tag <= 699:
+            assert cls == "osc", coll_tag
+        else:
+            assert cls == "coll", coll_tag
+    # and every user tag stays pt2pt
+    for user_tag in (0, 1, 63, 500, 10_000):
+        assert mon.classify_tag(user_tag) == "pt2pt"
+
+
 # ---------------------------------------------------------------------------
 # monitoring end-to-end
 # ---------------------------------------------------------------------------
@@ -234,18 +273,98 @@ def test_monitor_detach_stops_counting():
 def test_monitor_reattach_reexports_pvars():
     def body(comm):
         m = mon.Monitor(comm.pml, comm.size, register_pvars=True)
-        name = f"pml_monitoring_messages_count_{comm.pml.rank}"
+        rank = comm.pml.rank
+        names = [f"pml_monitoring_messages_count_{rank}",
+                 f"pml_monitoring_messages_recv_count_{rank}",
+                 f"pml_monitoring_messages_recv_size_{rank}",
+                 f"pml_monitoring_matched_{rank}"]
         m.attach()
+        for n in names:
+            mpit.pvar_registry.lookup(n)
         m.detach()
+        # detach unregisters the WHOLE set
+        import pytest as _pytest
+
+        for n in names:
+            with _pytest.raises(MPIException):
+                mpit.pvar_registry.lookup(n)
         m.attach()                     # pvars must come back
         try:
-            mpit.pvar_registry.lookup(name)
+            for n in names:
+                mpit.pvar_registry.lookup(n)
             comm.barrier()
             return m.totals()["sent_count"]["coll"] > 0
         finally:
             m.detach()
 
     assert all(run_ranks(2, body))
+
+
+def test_monitor_recv_side_pvars_match_matrices():
+    """The recv-count/recv-size/matched pvars read the same numbers the
+    matrices hold — the MPI_T view is no longer send-only."""
+    def body(comm):
+        m = mon.Monitor(comm.pml, comm.size, register_pvars=True).attach()
+        try:
+            peer = (comm.rank + 1) % comm.size
+            comm.send(np.zeros(8), dest=peer, tag=1)
+            comm.recv(source=(comm.rank - 1) % comm.size, tag=1)
+            comm.barrier()
+            rank = comm.pml.rank
+            s = mpit.PvarSession()
+            rc = s.handle_alloc(
+                f"pml_monitoring_messages_recv_count_{rank}", bound=m)
+            rs = s.handle_alloc(
+                f"pml_monitoring_messages_recv_size_{rank}", bound=m)
+            mt = s.handle_alloc(
+                f"pml_monitoring_matched_{rank}", bound=m)
+            t = m.totals()
+            return (rc.read(), rs.read(), mt.read(),
+                    sum(t["recv_count"].values()),
+                    sum(t["recv_bytes"].values()), t["matched"])
+        finally:
+            m.detach()
+
+    for rc, rs, mt, trc, trs, tmt in run_ranks(2, body):
+        assert rc == trc and rc >= 1          # at least the pt2pt recv
+        assert rs == trs and rs >= 64
+        assert mt == tmt
+
+
+def test_monitor_matrices_dict():
+    def body(comm):
+        with mon.Monitor(comm.pml, comm.size) as m:
+            peer = (comm.rank + 1) % comm.size
+            comm.send(np.zeros(10), dest=peer, tag=1)
+            comm.recv(source=(comm.rank - 1) % comm.size, tag=1)
+            comm.barrier()
+            mats = m.matrices()
+        # snapshot survives detach, carries all four matrices + scalars
+        assert set(mats) == {"sent_count", "sent_bytes", "recv_count",
+                             "recv_bytes", "unexpected", "matched"}
+        for what in ("sent_count", "sent_bytes", "recv_count",
+                     "recv_bytes"):
+            assert set(mats[what]) == set(mon.CLASSES)
+            for arr in mats[what].values():
+                assert arr.shape == (comm.size,)
+        return (int(mats["sent_bytes"]["pt2pt"][
+                    (comm.rank + 1) % comm.size]),
+                int(mats["recv_count"]["pt2pt"].sum()))
+
+    for sent_to_peer, recvd in run_ranks(2, body):
+        assert sent_to_peer == 80
+        assert recvd == 1
+
+
+def test_monitor_matrices_are_copies():
+    def body(comm):
+        with mon.Monitor(comm.pml, comm.size) as m:
+            comm.barrier()
+            mats = m.matrices()
+            mats["sent_count"]["coll"][:] = -1     # mutate the snapshot
+            return int(m.totals()["sent_count"]["coll"])
+    for v in run_ranks(2, body):
+        assert v >= 0                              # live state untouched
 
 
 def test_monitor_pvar_export():

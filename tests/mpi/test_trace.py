@@ -1,0 +1,453 @@
+"""Flight-recorder tests: ring-buffer semantics, end-to-end category
+coverage across the transport stack, Chrome-trace export + merge,
+metrics snapshot, crash dump, and the always-on fast-path counters."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from ompi_tpu.mpi import datatype as dt
+from ompi_tpu.mpi import trace
+from tests.mpi.harness import run_ranks
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2] / "tools"))
+
+import trace_export  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _trace_off_after():
+    """Every test leaves the global recorder disarmed."""
+    yield
+    trace.disable()
+
+
+# ---------------------------------------------------------------------------
+# ring buffer
+# ---------------------------------------------------------------------------
+
+def test_ring_buffer_wraps_oldest_first():
+    rec = trace.FlightRecorder(capacity=32, rank=0)
+    for i in range(100):
+        rec.add(i, None, "pml", f"e{i}", 0, None)
+    assert rec.events_total == 100
+    assert rec.dropped == 68
+    snap = rec.snapshot()
+    assert len(snap) == 32
+    # oldest surviving event is #68, newest is #99, in order
+    assert snap[0][3] == "e68" and snap[-1][3] == "e99"
+    assert [e[0] for e in snap] == list(range(68, 100))
+
+
+def test_disabled_emit_is_noop():
+    assert trace.recorder is None and not trace.active
+    trace.instant("pml", "nope")            # no recorder: nothing happens
+    trace.complete("pml", "nope", trace.begin())
+    with trace.span("pml", "nope"):
+        pass
+
+
+def test_enable_disable_cycle():
+    rec = trace.enable(capacity=64, rank=3, jobid=9)
+    assert trace.active and trace.enabled()
+    trace.instant("runtime", "hello", rank=3)
+    got = trace.disable()
+    assert got is rec and not trace.active
+    assert got.snapshot()[-1][3] == "hello"
+
+
+def test_reenable_adopts_later_identity():
+    """enable() before the rank is known, then again with rank/jobid
+    (what runtime.init does): the recorder must adopt the identity so
+    ranks don't all flush to the shared rank--1 path."""
+    rec = trace.enable(capacity=64)
+    assert rec.rank == -1 and rec.jobid == 0
+    assert trace.enable(rank=3, jobid=7) is rec
+    assert rec.rank == 3 and rec.jobid == 7
+    assert trace.default_path().endswith("ompi_tpu_trace_7_rank3.json")
+
+
+def test_disable_detaches_pml_listener():
+    """disable() must remove the attach_pml listener — a leftover one
+    keeps the PML's eager fast lane (gated on no-listeners) bypassed
+    after tracing stops."""
+    trace.enable(capacity=64)
+
+    def body(comm):
+        trace.attach_pml(comm.pml)
+        assert comm.pml._listeners
+        trace.disable()
+        return len(comm.pml._listeners)
+
+    assert run_ranks(2, body) == [0, 0]
+
+
+def test_detach_pml_scoped_to_one_pml():
+    """finalize()'s per-epoch detach removes only that PML's bridge
+    (other PMLs — in-process harness ranks — keep theirs)."""
+    trace.enable(capacity=64)
+
+    def body(comm):
+        trace.attach_pml(comm.pml)
+        comm.barrier()
+        if comm.rank == 0:
+            trace.detach_pml(comm.pml)
+        comm.barrier()
+        return len(comm.pml._listeners)
+
+    assert sorted(run_ranks(2, body)) == [0, 1]
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: the whole stack feeds the timeline
+# ---------------------------------------------------------------------------
+
+def test_stack_categories_end_to_end(tmp_path):
+    trace.enable(capacity=16384)
+
+    def body(comm):
+        from ompi_tpu.mpi import io as mpiio
+
+        trace.attach_pml(comm.pml)
+        peer = (comm.rank + 1) % comm.size
+        # eager p2p
+        r = comm.irecv(source=(comm.rank - 1) % comm.size, tag=1)
+        comm.send(np.arange(32, dtype=np.float64), dest=peer, tag=1)
+        r.wait()
+        # rendezvous p2p (past the 64 KiB eager limit)
+        big = np.ones(128 * 1024, dtype=np.float32)
+        r = comm.irecv(np.empty_like(big),
+                       source=(comm.rank - 1) % comm.size, tag=2)
+        comm.send(big, dest=peer, tag=2)
+        r.wait()
+        # coll
+        comm.allreduce(np.ones(4))
+        comm.barrier()
+        # datatype: derived commit + pack through the wire
+        vec = dt.INT32.vector(count=8, blocklength=2, stride=4).commit()
+        r = comm.irecv(np.empty(16, np.int32),
+                       source=(comm.rank - 1) % comm.size, tag=3,
+                       datatype=dt.INT32, count=16)
+        comm.send(np.arange(32, dtype=np.int32), dest=peer, tag=3,
+                  datatype=vec, count=1)
+        r.wait()
+        # io
+        fh = mpiio.File(comm, str(tmp_path / "trace_io.bin"),
+                        mpiio.MODE_RDWR | mpiio.MODE_CREATE)
+        fh.set_view(etype=dt.FLOAT64)
+        fh.write_at(comm.rank * 8, np.full(8, 1.0 + comm.rank))
+        out = fh.read_at(comm.rank * 8, 8)
+        fh.close()
+        return float(out[0])
+
+    vals = run_ranks(2, body)
+    assert vals == [1.0, 2.0]
+    events = trace.recorder.snapshot()
+    span_cats = {e[2] for e in events if e[1] is not None}
+    # the acceptance bar: spans from ≥ 5 categories
+    assert {"pml", "coll", "io", "datatype"} <= span_cats
+    inst_cats = {e[2] for e in events if e[1] is None}
+    assert "btl" in inst_cats        # endpoint routing instants
+    assert len(span_cats | inst_cats) >= 5
+    # the PERUSE bridge put the request lifecycle on the timeline
+    names = {e[3] for e in events}
+    assert {"send_post", "recv_post", "match", "deliver"} <= names
+    # rendezvous got begin/end spans on both sides
+    assert "rndv_send" in names and "rndv_recv" in names
+
+
+def test_coll_span_records_rules_decision(tmp_path):
+    from ompi_tpu.core.config import var_registry
+
+    rules = tmp_path / "rules.conf"
+    rules.write_text("allreduce 0 0 ring\n")
+    old = var_registry.get("coll_host_dynamic_rules")
+    trace.enable(capacity=4096)
+    try:
+        var_registry.set("coll_host_dynamic_rules", str(rules))
+
+        def body(comm):
+            comm.allreduce(np.ones(8, dtype=np.float64))
+            return True
+
+        assert all(run_ranks(2, body))
+    finally:
+        var_registry.set("coll_host_dynamic_rules", old)
+    events = trace.recorder.snapshot()
+    decisions = [e for e in events if e[3] == "decision:allreduce"]
+    assert decisions, "rules decision never hit the timeline"
+    assert decisions[-1][5]["algorithm"] == "ring"
+    assert "rules.conf" in decisions[-1][5]["source"]
+    assert any(e[3] == "allreduce" and e[1] is not None for e in events)
+
+
+# ---------------------------------------------------------------------------
+# export: per-rank dumps → merged Chrome trace
+# ---------------------------------------------------------------------------
+
+def _fake_rank_dump(tmp_path, rank: int) -> str:
+    rec = trace.FlightRecorder(capacity=128, rank=rank, jobid=7)
+    t0 = 1_000_000 + rank          # deterministic, distinct timestamps
+    rec.add(t0, 500, "pml", "send_post", rank, {"peer": 1 - rank})
+    rec.add(t0 + 1000, None, "btl", "send", rank, None)
+    rec.add(t0 + 2000, 300, "coll", "allreduce", rank, None)
+    path = str(tmp_path / f"ompi_tpu_trace_7_rank{rank}.json")
+    trace.flush(path=path, rec=rec)
+    return path
+
+
+def test_export_merges_ranks_into_chrome_trace(tmp_path):
+    paths = [_fake_rank_dump(tmp_path, r) for r in (0, 1)]
+    doc = trace_export.merge(paths)
+    assert doc["displayTimeUnit"] == "ns"
+    assert trace_export.validate(doc) == []
+    evs = [e for e in doc["traceEvents"] if e.get("ph") != "M"]
+    assert {e["pid"] for e in evs} == {0, 1}          # one pid per rank
+    # one tid per category, named by metadata events
+    meta = [e for e in doc["traceEvents"] if e.get("ph") == "M"]
+    thread_names = {(m["pid"], m["args"]["name"]) for m in meta
+                    if m["name"] == "thread_name"}
+    assert (0, "pml") in thread_names and (1, "coll") in thread_names
+    ts = [e["ts"] for e in evs]
+    assert ts == sorted(ts)
+    # spans kept their duration (ns → µs)
+    spans = [e for e in evs if e["ph"] == "X"]
+    assert spans and all("dur" in e for e in spans)
+
+
+def test_export_cli_writes_and_validates(tmp_path, capsys):
+    for r in (0, 1):
+        _fake_rank_dump(tmp_path, r)
+    out = str(tmp_path / "merged.json")
+    rc = trace_export.main(["--dir", str(tmp_path), "--jobid", "7",
+                            "-o", out])
+    assert rc == 0
+    doc = json.load(open(out))
+    assert trace_export.validate(doc) == []
+    assert trace_export.main([out, "--validate"]) == 0
+    assert trace_export.main(["--dir", str(tmp_path / "empty")]) == 2
+
+
+def test_export_warns_on_mixed_job_dumps(tmp_path, capsys):
+    """Same rank from two different jobids: the merge must warn — their
+    monotonic clocks share no base."""
+    paths = []
+    for jobid in (1, 2):
+        rec = trace.FlightRecorder(capacity=8, rank=0, jobid=jobid)
+        rec.add(1000, None, "pml", "x", 0, None)
+        p = str(tmp_path / f"ompi_tpu_trace_{jobid}_rank0.json")
+        trace.flush(path=p, rec=rec)
+        paths.append(p)
+    trace_export.merge(paths)
+    err = capsys.readouterr().err
+    assert "WARNING" in err and "--jobid" in err
+
+
+def test_sigterm_flush_handler_installs_once():
+    """enable→disable→enable must not chain the flush handler onto
+    itself (a self-referential chain recurses inside the handler)."""
+    import signal
+
+    old = signal.getsignal(signal.SIGTERM)
+    saved_flag, saved_old = trace._sigterm_installed, trace._old_sigterm
+    try:
+        trace._sigterm_installed = False
+        trace._install_sigterm_flush()
+        h1 = signal.getsignal(signal.SIGTERM)
+        assert h1 is not old
+        trace._install_sigterm_flush()      # second arm: no re-chain
+        assert signal.getsignal(signal.SIGTERM) is h1
+        assert trace._old_sigterm is not h1  # never chained onto itself
+    finally:
+        signal.signal(signal.SIGTERM, old)
+        trace._sigterm_installed = saved_flag
+        trace._old_sigterm = saved_old
+
+
+def test_shm_publish_counter_counts_only_successful_publishes():
+    """A FrameTooBig publish must not bump btl_shm_publish_total."""
+    from ompi_tpu.mpi.btl_shm import FrameTooBig, ShmBTL
+
+    got = []
+    a = ShmBTL(0, lambda p, h, b: got.append(b))
+    b = ShmBTL(1, lambda p, h, b: got.append(b))
+    try:
+        assert a.connect(1, b.address)
+        before = trace.counters["btl_shm_publish_total"]
+        a.send(1, {"t": "eager", "tag": 1, "cid": 0, "seq": 0,
+                   "dt": "<u1", "elems": 4, "shp": [4]}, b"\x01" * 4)
+        assert trace.counters["btl_shm_publish_total"] == before + 1
+        with pytest.raises(FrameTooBig):
+            a.send(1, {"t": "eager"}, b"\x00" * (8 << 20))  # > ring/2
+        assert trace.counters["btl_shm_publish_total"] == before + 1
+    finally:
+        a.close()
+        b.close()
+
+
+def test_flush_coerces_non_json_args(tmp_path):
+    """Apps pass numpy scalars into traced calls (e.g. np.int32 ranks to
+    Window.post); flush must coerce, not raise mid-finalize."""
+    rec = trace.FlightRecorder(capacity=16, rank=0, jobid=0)
+    rec.add(10, None, "osc", "post", 0,
+            {"origins": [np.int32(1)], "odd": object()})
+    path = str(tmp_path / "coerce.json")
+    assert trace.flush(path=path, rec=rec) == path
+    doc = json.load(open(path))
+    args = doc["traceEvents"][-1]["args"]
+    assert args["origins"] == [1]
+    assert isinstance(args["odd"], str)
+
+
+def test_sigterm_chain_preserves_sig_ign():
+    """A process that was IGNORING SIGTERM must keep ignoring it after
+    the flush runs — the chain must not reset to SIG_DFL and re-kill."""
+    import signal
+
+    old = signal.getsignal(signal.SIGTERM)
+    saved_flag, saved_old = trace._sigterm_installed, trace._old_sigterm
+    try:
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        trace._sigterm_installed = False
+        trace._install_sigterm_flush()
+        handler = signal.getsignal(signal.SIGTERM)
+        handler(signal.SIGTERM, None)   # must return, not kill us
+    finally:
+        signal.signal(signal.SIGTERM, old)
+        trace._sigterm_installed = saved_flag
+        trace._old_sigterm = saved_old
+
+
+def test_validator_rejects_broken_traces():
+    bad = {"displayTimeUnit": "parsec", "traceEvents": [
+        {"ph": "X", "ts": -5, "pid": 0, "tid": 0, "name": "x"},
+        {"ph": "X", "ts": 1.0, "pid": 0, "tid": 0, "name": "y"},  # no dur
+    ]}
+    problems = trace_export.validate(bad)
+    assert any("displayTimeUnit" in p for p in problems)
+    assert any("bad ts" in p for p in problems)
+    assert any("without dur" in p for p in problems)
+
+
+# ---------------------------------------------------------------------------
+# crash dump + metrics
+# ---------------------------------------------------------------------------
+
+def test_crash_dump_writes_default_path(tmp_path, monkeypatch):
+    monkeypatch.setenv("TMPDIR", str(tmp_path))
+    trace.enable(capacity=256, rank=4, jobid=12)
+    trace.instant("runtime", "before_the_end", rank=4)
+    path = trace.crash_dump(reason="test")
+    assert path == str(tmp_path / "ompi_tpu_trace_12_rank4.json")
+    doc = json.load(open(path))
+    assert doc["displayTimeUnit"] == "ns"
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert "before_the_end" in names
+    assert "crash_dump:test" in names       # the reason is on the timeline
+    assert doc["otherData"]["rank"] == 4
+    assert "counters" in doc["otherData"]
+
+
+def test_metrics_snapshot_prometheus_shape():
+    text = trace.metrics_snapshot()
+    lines = text.strip().splitlines()
+    assert any(ln.startswith("# TYPE ompi_tpu_") for ln in lines)
+    assert any(ln.startswith("# HELP ompi_tpu_") for ln in lines)
+    # every registered always-on counter is scrapable
+    for name, _u, _d in trace._COUNTER_SPECS:
+        assert f"ompi_tpu_{name}" in text
+    # value lines parse as "<metric> <number>"
+    for ln in lines:
+        if not ln.startswith("#"):
+            metric, val = ln.split()
+            assert metric.startswith("ompi_tpu_")
+            float(val)
+
+
+# ---------------------------------------------------------------------------
+# always-on counters (zero-copy vs pack, plan classes)
+# ---------------------------------------------------------------------------
+
+def test_commit_counts_plan_classes():
+    before = dict(trace.counters)
+    dt.FLOAT64.contiguous(4).commit()                      # single
+    dt.INT32.vector(count=8, blocklength=2, stride=4).commit()   # strided
+    dt.INT64.indexed([1, 1], [0, 5]).commit()              # runs
+    d = dict(trace.counters)
+    assert d["convertor_plan_single_total"] == \
+        before["convertor_plan_single_total"] + 1
+    assert d["convertor_plan_strided_total"] == \
+        before["convertor_plan_strided_total"] + 1
+    assert d["convertor_plan_runs_total"] == \
+        before["convertor_plan_runs_total"] + 1
+
+
+def test_recommit_does_not_double_count():
+    before = trace.counters["convertor_plan_strided_total"]
+    v = dt.INT32.vector(count=4, blocklength=1, stride=2).commit()
+    v.commit()
+    v.commit()
+    assert trace.counters["convertor_plan_strided_total"] == before + 1
+
+
+def test_zero_copy_vs_packed_send_counters():
+    before_zc = trace.counters["pml_zero_copy_sends_total"]
+    before_pk = trace.counters["pml_packed_sends_total"]
+
+    def body(comm):
+        peer = (comm.rank + 1) % comm.size
+        # contiguous send: plan collapses → zero-copy view
+        r = comm.irecv(source=(comm.rank - 1) % comm.size, tag=1)
+        comm.send(np.arange(16, dtype=np.float64), dest=peer, tag=1)
+        r.wait()
+        # genuinely strided derived type → staged pack
+        vec = dt.INT32.vector(count=4, blocklength=1, stride=2).commit()
+        r = comm.irecv(np.empty(4, np.int32),
+                       source=(comm.rank - 1) % comm.size, tag=2,
+                       datatype=dt.INT32, count=4)
+        comm.send(np.arange(8, dtype=np.int32), dest=peer, tag=2,
+                  datatype=vec, count=1)
+        r.wait()
+        return True
+
+    assert all(run_ranks(2, body))
+    assert trace.counters["pml_zero_copy_sends_total"] >= before_zc + 2
+    assert trace.counters["pml_packed_sends_total"] >= before_pk + 2
+
+
+def test_counters_snapshot_carries_convertor_stats():
+    snap = trace.counters_snapshot()
+    for key in ("convertor_pack_calls_total", "convertor_unpack_calls_total",
+                "pml_zero_copy_sends_total", "convertor_plan_single_total"):
+        assert key in snap
+    json.dumps(snap)          # one-line-record serializable
+
+
+# ---------------------------------------------------------------------------
+# pvar integration
+# ---------------------------------------------------------------------------
+
+def test_counters_readable_as_pvars():
+    from ompi_tpu.mpi import mpit
+
+    before = trace.counters["pml_zero_copy_sends_total"]
+    pv = mpit.pvar_registry.lookup("pml_zero_copy_sends_total")
+    assert pv.read() == before
+    trace.count("pml_zero_copy_sends_total")
+    assert pv.read() == before + 1
+    trace.counters["pml_zero_copy_sends_total"] = before  # restore
+
+
+def test_default_path_uses_tmpdir(monkeypatch):
+    monkeypatch.setenv("TMPDIR", "/tmp/some-dir")
+    assert trace.default_path(3, 1) == \
+        "/tmp/some-dir/ompi_tpu_trace_3_rank1.json"
+    assert os.path.basename(trace.default_path(0, 0)) == \
+        "ompi_tpu_trace_0_rank0.json"

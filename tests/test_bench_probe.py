@@ -177,3 +177,24 @@ def test_simulated_outage_banks_matrix_before_recovery(monkeypatch,
     assert rec["matrix"] == fake_rows
     assert rec["backend"] == "cpu-fallback"
     assert bench._partial["matrix"] == fake_rows
+    # provenance: the transport-stack counter snapshot rides in the
+    # record (and in _partial, for the terminal-signal path)
+    assert "counters" in rec
+    assert "pml_zero_copy_sends_total" in rec["counters"]
+    assert "convertor_plan_single_total" in rec["counters"]
+    assert bench._partial["counters"] == rec["counters"]
+
+
+def test_counter_snapshot_serializes_one_line():
+    """The per-record counter snapshot must be one-line-JSON safe (ints
+    only — the BENCH_*.json record format PR 1 established)."""
+    snap = bench._counters_snapshot()
+    assert "error" not in snap, snap
+    for key in ("pml_zero_copy_sends_total", "pml_packed_sends_total",
+                "convertor_plan_single_total", "convertor_plan_runs_total",
+                "btl_shm_publish_total", "convertor_pack_calls_total"):
+        assert key in snap
+        assert isinstance(snap[key], int)
+    line = json.dumps(snap)
+    assert "\n" not in line
+    assert json.loads(line) == snap

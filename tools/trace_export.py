@@ -1,0 +1,204 @@
+#!/usr/bin/env python
+"""Merge per-rank flight-recorder dumps into one Chrome/Perfetto trace.
+
+Each rank (``OMPI_TPU_TRACE=1`` / ``tpurun --trace``) flushes a
+standalone JSON file at finalize/abort:
+
+    ${TMPDIR}/ompi_tpu_trace_<jobid>_rank<r>.json
+
+This tool merges any number of them into a single trace JSON that
+chrome://tracing and https://ui.perfetto.dev load directly — one pid per
+rank (named ``rank N``), one tid per category (named after the
+category), events globally sorted by timestamp.
+
+    python tools/trace_export.py -o merged.json $TMPDIR/ompi_tpu_trace_*_rank*.json
+    python tools/trace_export.py -o merged.json --dir $TMPDIR --jobid 7
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+_RANK_RE = re.compile(r"ompi_tpu_trace_(\d+)_rank(-?\d+)\.json$")
+
+# keep in sync with ompi_tpu.mpi.trace.CATEGORIES (the exporter must not
+# import the package: it runs standalone in CI validation steps)
+CATEGORIES = ("pml", "btl", "coll", "osc", "io", "ckpt", "datatype",
+              "runtime")
+
+
+def _load(path: str) -> tuple[int, list[dict], dict]:
+    """→ (rank, events, otherData) from one per-rank dump."""
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    if isinstance(doc, list):          # bare event list: rank from name
+        events, other = doc, {}
+    else:
+        events = doc.get("traceEvents", [])
+        other = doc.get("otherData", {}) or {}
+    rank = other.get("rank")
+    if rank is None:
+        m = _RANK_RE.search(os.path.basename(path))
+        rank = int(m.group(2)) if m else -1
+    if "jobid" not in other:
+        m = _RANK_RE.search(os.path.basename(path))
+        if m:
+            other = dict(other, jobid=int(m.group(1)))
+    return int(rank), events, other
+
+
+def merge(paths: list[str]) -> dict:
+    """Merge per-rank dumps into one Chrome trace document."""
+    all_events: list[dict] = []
+    meta: list[dict] = []
+    per_rank: dict[int, dict] = {}
+    seen_tids: dict[int, set[int]] = {}
+    jobids: set = set()
+    for path in paths:
+        rank, events, other = _load(path)
+        jobids.add(other.get("jobid"))
+        if rank in per_rank:
+            # two dumps claim the same rank — almost certainly dumps of
+            # DIFFERENT jobs in one TMPDIR; their monotonic clocks share
+            # no base, so the merged timeline would be fiction
+            print(f"trace_export: WARNING: rank {rank} appears in more "
+                  f"than one input ({path}); pass --jobid to select one "
+                  f"job's dumps", file=sys.stderr)
+        per_rank[rank] = {k: other.get(k) for k in
+                          ("events_total", "dropped", "counters",
+                           "clock_offset_ns")}
+        meta.append({"ph": "M", "name": "process_name", "pid": rank,
+                     "tid": 0, "args": {"name": f"rank {rank}"}})
+        tids = seen_tids.setdefault(rank, set())
+        for ev in events:
+            ev = dict(ev)
+            ev["pid"] = rank           # one pid per rank, always
+            all_events.append(ev)
+            tids.add(int(ev.get("tid", 0)))
+    if len(jobids - {None}) > 1:
+        print(f"trace_export: WARNING: merging dumps from several jobs "
+              f"{sorted(j for j in jobids if j is not None)} — their "
+              f"timelines are not comparable; pass --jobid",
+              file=sys.stderr)
+    # event ts are per-machine CLOCK_MONOTONIC; widely differing
+    # wall-vs-monotonic anchors mean ranks ran on different hosts (or
+    # across reboots) and the merged ordering is fiction
+    offs = [v.get("clock_offset_ns") for v in per_rank.values()
+            if isinstance(v.get("clock_offset_ns"), (int, float))]
+    if offs and max(offs) - min(offs) > 10_000_000_000:   # >10 s skew
+        print(f"trace_export: WARNING: monotonic clock bases differ by "
+              f"{(max(offs) - min(offs)) / 1e9:.0f}s across dumps "
+              f"(different hosts?) — cross-rank event ordering in the "
+              f"merged timeline is not meaningful", file=sys.stderr)
+    for rank, tids in seen_tids.items():
+        for tid in sorted(tids):
+            name = CATEGORIES[tid] if tid < len(CATEGORIES) else "other"
+            meta.append({"ph": "M", "name": "thread_name", "pid": rank,
+                         "tid": tid, "args": {"name": name}})
+    all_events.sort(key=lambda e: float(e.get("ts", 0.0)))
+    return {
+        "displayTimeUnit": "ns",
+        "otherData": {"ranks": sorted(per_rank),
+                      "per_rank": {str(r): v
+                                   for r, v in sorted(per_rank.items())}},
+        "traceEvents": meta + all_events,
+    }
+
+
+def validate(doc: dict) -> list[str]:
+    """Chrome-trace shape checks; returns a list of problems (empty =
+    valid).  What the CI smoke job runs against the merged artifact."""
+    problems = []
+    if doc.get("displayTimeUnit") not in ("ms", "ns"):
+        problems.append("displayTimeUnit must be 'ms' or 'ns'")
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        return problems + ["traceEvents must be a list"]
+    last_ts = None
+    for i, ev in enumerate(events):
+        ph = ev.get("ph")
+        # full Chrome phase alphabet: duration, complete, instant,
+        # counter, async, flow, sample, object, metadata, memory, mark
+        if ph not in ("B", "E", "X", "i", "I", "C", "b", "e", "n",
+                      "s", "t", "f", "P", "N", "O", "D", "M", "v", "V",
+                      "R"):
+            problems.append(f"event {i}: unknown ph {ph!r}")
+            continue
+        if ph == "M":
+            continue
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)) or ts < 0:
+            problems.append(f"event {i}: bad ts {ts!r}")
+            continue
+        if last_ts is not None and ts < last_ts:
+            problems.append(f"event {i}: ts not monotonic "
+                            f"({ts} < {last_ts})")
+        last_ts = ts
+        if ph == "X" and not isinstance(ev.get("dur"), (int, float)):
+            problems.append(f"event {i}: complete span without dur")
+        for key in ("name", "pid", "tid"):
+            if key not in ev:
+                problems.append(f"event {i}: missing {key}")
+    return problems
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        description="Merge per-rank ompi_tpu flight-recorder dumps into "
+                    "one Chrome/Perfetto trace JSON.")
+    p.add_argument("inputs", nargs="*", help="per-rank trace dump files")
+    p.add_argument("--dir", default=None,
+                   help="scan this directory for ompi_tpu_trace_*.json "
+                        "instead of naming files")
+    p.add_argument("--jobid", type=int, default=None,
+                   help="with --dir: only this job's dumps")
+    p.add_argument("-o", "--output", default="ompi_tpu_trace_merged.json")
+    p.add_argument("--validate", action="store_true",
+                   help="only validate the merged document; nonzero exit "
+                        "on schema problems")
+    args = p.parse_args(argv)
+
+    paths = list(args.inputs)
+    if args.dir:
+        pat = (f"ompi_tpu_trace_{args.jobid}_rank*.json"
+               if args.jobid is not None else "ompi_tpu_trace_*_rank*.json")
+        paths += sorted(glob.glob(os.path.join(args.dir, pat)))
+    # dedupe (order-preserving): positional inputs may overlap --dir's
+    # glob, and a double-loaded rank would double every event
+    paths = list(dict.fromkeys(os.path.abspath(p) for p in paths))
+    if not paths:
+        print("trace_export: no input dumps found", file=sys.stderr)
+        return 2
+
+    doc = merge(paths)
+    problems = validate(doc)
+    if args.validate:
+        for pr in problems:
+            print(f"trace_export: INVALID: {pr}", file=sys.stderr)
+        if problems:
+            return 1
+        print(f"trace_export: {len(paths)} dump(s) valid "
+              f"({len(doc['traceEvents'])} events)")
+        return 0
+    # merge mode: schema problems are warnings — a post-mortem merge
+    # must never throw away a recoverable trace
+    for pr in problems:
+        print(f"trace_export: WARNING: {pr}", file=sys.stderr)
+    with open(args.output, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+    n_spans = sum(1 for e in doc["traceEvents"] if e.get("ph") == "X")
+    cats = sorted({e.get("cat") for e in doc["traceEvents"]
+                   if e.get("cat")})
+    print(f"trace_export: wrote {args.output} — "
+          f"{len(doc['traceEvents'])} events ({n_spans} spans) from "
+          f"{len(paths)} rank(s); categories: {', '.join(cats)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
