@@ -383,6 +383,15 @@ class BtlEndpoint:
         self._shm_ok: set[int] = set()     # peers with a live shm route
         self._proc_ok: set[int] = set()    # peers in my address space
         self._proc_no: set[int] = set()    # known peers that are NOT
+        # deterministic chaos (ompi_tpu.testing.faultinject): when a
+        # fault plan is armed, every header-path frame gets a seeded
+        # drop/delay/dup verdict at this boundary.  None in production —
+        # the hot path pays one attribute check.
+        self._fault = None
+        from ompi_tpu.testing import faultinject
+
+        if faultinject.active():
+            self._fault = faultinject.injector_for(rank)
 
     @property
     def address(self) -> str:
@@ -435,6 +444,14 @@ class BtlEndpoint:
         when it cannot block — self loopback always, shm when the ring has
         room.  False ⇒ caller enqueues for the send worker.  Safe to mix
         with queued sends: the PML reorders by per-(peer,cid) sequence."""
+        if self._fault is not None and peer != self.rank:
+            verdict = self._fault.on_frame(peer, header)
+            if verdict != "send":
+                # the verdict is identity-hashed: the worker path would
+                # draw the SAME verdict, so resolve it here (True = the
+                # frame's fate is sealed; nothing for the worker to do)
+                self._apply_fault(verdict, peer, header, payload)
+                return True
         ok = self._try_send_inline(peer, header, payload)
         if ok and trace_mod.active:
             # AFTER success only: a declined inline attempt is re-sent by
@@ -468,6 +485,50 @@ class BtlEndpoint:
         return False
 
     def send(self, peer: int, header: dict, payload: bytes = b"") -> None:
+        if self._fault is not None and peer != self.rank:
+            verdict = self._fault.on_frame(peer, header)
+            if verdict != "send":
+                self._apply_fault(verdict, peer, header, payload)
+                return
+        self._send_routed(peer, header, payload)
+
+    def _apply_fault(self, verdict, peer: int, header: dict,
+                     payload) -> None:
+        """Execute a non-"send" chaos verdict.  drop: the frame vanishes
+        (the caller believes it was sent — exactly a lossy wire).  dup:
+        delivered twice (the PML's seq gate holds the duplicate).  delay:
+        re-sent later off a timer, payload copied first (zero-copy views
+        alias user buffers the caller is free to reuse at completion).
+
+        Never raises: callers include try_send_inline, whose contract is
+        a non-raising bool — a verdict-sealed frame that then hits a
+        dead route degrades to a drop (the lossy-wire semantics the
+        verdict already committed to), it does not surface a raw
+        ConnectionError into application code."""
+        if verdict == "drop":
+            return
+        if verdict == "dup":
+            try:
+                self._send_routed(peer, header, payload)
+                self._send_routed(peer, header, payload)
+            except Exception:  # noqa: BLE001 — degrade to drop
+                pass
+            return
+        _, ms = verdict
+        data = bytes(payload)
+
+        def later() -> None:
+            try:
+                self._send_routed(peer, header, data)
+            except Exception:  # noqa: BLE001 — a dead route ends the delay
+                pass
+
+        t = threading.Timer(ms / 1000.0, later)
+        t.daemon = True
+        t.start()
+
+    def _send_routed(self, peer: int, header: dict,
+                     payload: bytes = b"") -> None:
         if trace_mod.active:
             trace_mod.instant("btl", "send", rank=self.rank, peer=peer,
                               nbytes=len(payload), t=header.get("t"))

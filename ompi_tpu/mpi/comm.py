@@ -520,6 +520,54 @@ class Communicator:
 
         return nbc.ialltoallw(self, sendspecs, recvspecs)
 
+    # -- fault tolerance (ULFM: ≈ MPIX_Comm_revoke/shrink/agree,
+    #    mpi/ft.py — the extension-style API shipped ahead of
+    #    standardization, MPI-Advance precedent) ---------------------------
+
+    def revoke(self) -> None:
+        """≈ MPIX_Comm_revoke: poison this communicator on every member —
+        in-flight and future operations on it raise MPI_ERR_REVOKED.
+        Not collective (any member may revoke after spotting a failure);
+        propagates by flooding.  ``agree``/``shrink`` still work."""
+        from ompi_tpu.mpi import ft
+
+        ft.comm_revoke(self)
+
+    def is_revoked(self) -> bool:
+        """True once this communicator was revoked (locally known)."""
+        from ompi_tpu.mpi import ft
+
+        return ft.comm_is_revoked(self)
+
+    def agree(self, flag: bool = True) -> bool:
+        """≈ MPIX_Comm_agree: fault-tolerant AND of ``flag`` over the
+        surviving members — every rank that returns gets the same value,
+        retransmitted under message loss."""
+        from ompi_tpu.mpi import ft
+
+        return ft.comm_agree(self, flag)
+
+    def shrink(self, name: Optional[str] = None) -> "Communicator":
+        """≈ MPIX_Comm_shrink: agree on the failed set, return a new
+        communicator over the survivors (same deterministic-cid
+        construction as create_group; the dead need not participate)."""
+        from ompi_tpu.mpi import ft
+
+        return ft.comm_shrink(self, name)
+
+    def get_failed(self) -> Group:
+        """≈ MPIX_Comm_get_failed: group of members this process knows
+        to be dead (local knowledge, monotonic — no agreement)."""
+        from ompi_tpu.mpi import ft
+
+        return ft.comm_get_failed(self)
+
+    def ack_failed(self, num_to_ack: Optional[int] = None) -> int:
+        """≈ MPIX_Comm_ack_failed → how many failures are acknowledged."""
+        from ompi_tpu.mpi import ft
+
+        return ft.comm_ack_failed(self, num_to_ack)
+
     # -- device path binding (coll/xla) ------------------------------------
 
     def bind_device(self, device_comm) -> "Communicator":

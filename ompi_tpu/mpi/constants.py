@@ -46,6 +46,13 @@ ERR_SERVICE = 41  # MPI_ERR_SERVICE: publish/unpublish failure
 ERR_PORT = 27     # MPI_ERR_PORT: invalid/unknown port name
 ERR_IO = 38
 
+# ULFM fault-tolerance error classes (MPI_ERR_PROC_FAILED & friends —
+# the user-level fault tolerance chapter's additions; numbered in the
+# post-standard space the ULFM prototype uses)
+ERR_PROC_FAILED = 75          # target/peer process is dead
+ERR_PROC_FAILED_PENDING = 76  # wildcard recv cannot complete: peer died
+ERR_REVOKED = 77              # the communicator was revoked
+
 _ERROR_STRINGS = {
     SUCCESS: "no error",
     ERR_BUFFER: "invalid buffer",
@@ -63,6 +70,9 @@ _ERROR_STRINGS = {
     ERR_SERVICE: "name service operation failed",
     ERR_PORT: "invalid port name",
     ERR_IO: "I/O error",
+    ERR_PROC_FAILED: "peer process has failed",
+    ERR_PROC_FAILED_PENDING: "operation pending on a failed process",
+    ERR_REVOKED: "communicator has been revoked",
 }
 
 

@@ -1,0 +1,647 @@
+"""ft — user-level fault tolerance (ULFM semantics).
+
+≈ the MPI User-Level Failure Mitigation chapter (MPIX_Comm_revoke /
+_shrink / _agree / _get_failed — the extension-style capability MPI
+Advance ships ahead of standardization): rank death stops being a
+job-level event the errmgr alone decides about and becomes something
+*application code* can observe and recover from:
+
+- :class:`FailureDetector` — the per-process view of which world ranks
+  are dead.  Fed by the runtime control plane (the PMIx server's
+  dead-set, which the launcher's reap loop and the RML heartbeat monitor
+  maintain) via rate-limited polling plus a background watcher, and by
+  local marks (transport evidence, fault injection, tests).
+- ``Comm.revoke()`` — poison a communicator everywhere: in-flight and
+  future operations on its cid fail with MPI_ERR_REVOKED.  Propagated by
+  flooding: every process that learns of the revocation forwards it once
+  to every other member, so a single dropped frame cannot hide it.
+- ``Comm.agree(flag)`` — fault-tolerant agreement: survivors converge on
+  the bitwise AND of their flags and on a common view of the failed set,
+  with retransmission (deterministic fault injection drops frames; the
+  protocol must not care).  Coordinator-based: the lowest live rank
+  gathers and decides; contributors resend until a decision arrives and
+  gossip to every live peer after repeated silence, so any rank holding
+  the decision can answer.  A coordinator that dies *after* delivering
+  the decision to only a subset is the classic early-deciding window —
+  the next agree's coordinator re-derives membership from the detector,
+  and the recipients of the partial decision all hold the SAME value
+  (the decision is computed once), so divergence cannot occur; what can
+  be lost is only progress, repaired by the retry loop.
+- ``Comm.shrink()`` — agree on the failed set, then build a new
+  communicator over the survivors with a deterministically derived cid
+  (the same negative-namespace hash construction comm.create_group
+  uses), so every survivor computes the same handle with no extra
+  traffic.
+- ``Comm.get_failed()`` / ``ack_failed()`` — the local failed-group
+  query + acknowledgement.
+
+Wire format: FT control frames are headers with ``t: "ft"`` riding the
+PML's ordered frame path (``_enqueue_frame``), below MPI matching — they
+are immune to the revoked-cid poison (recovery must run on a revoked
+communicator) and carry an attempt counter ``n`` so the fault injector
+gives every retransmission a fresh drop verdict.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+import weakref
+import zlib
+from typing import Optional, TYPE_CHECKING
+
+from ompi_tpu.core import output
+from ompi_tpu.core.config import VarType, register_var, var_registry
+from ompi_tpu.mpi import trace as trace_mod
+from ompi_tpu.mpi.constants import (
+    ERR_PROC_FAILED, ERR_REVOKED, MPIException,
+)
+
+if TYPE_CHECKING:
+    from ompi_tpu.mpi.comm import Communicator
+    from ompi_tpu.mpi.pml import PmlOb1
+
+__all__ = ["FailureDetector", "PmlFT", "pml_ft", "attach_runtime",
+           "comm_revoke", "comm_is_revoked", "comm_agree", "comm_shrink",
+           "comm_get_failed", "comm_ack_failed"]
+
+_log = output.get_stream("ft")
+
+register_var("ft", "enable", VarType.BOOL, False,
+             "arm the runtime-fed failure detector at init regardless of "
+             "errmgr policy (it arms automatically under --mca errmgr "
+             "notify; under respawn the dead-set is transient while a "
+             "rank revives, so it stays off unless forced)")
+register_var("ft", "poll_period", VarType.DOUBLE, 0.2,
+             "minimum seconds between failure-detector polls of the "
+             "runtime dead-set (the PMIx 'failed' query)")
+register_var("ft", "agree_timeout", VarType.DOUBLE, 60.0,
+             "seconds before Comm.agree()/shrink() gives up and raises "
+             "MPI_ERR_PROC_FAILED (protocol livelock guard)")
+register_var("ft", "agree_retry_interval", VarType.DOUBLE, 0.1,
+             "seconds between agreement retransmissions")
+
+
+class FailureDetector:
+    """Per-process failure knowledge: world rank → dead?
+
+    Two sources merge here: the runtime control plane (polled, and
+    watched by a background thread so blocked receivers learn of deaths
+    without calling anything) and local marks.  Listeners fire once per
+    newly-dead rank — the PML uses that to fail posted recvs and parked
+    sends against the corpse.
+    """
+
+    def __init__(self) -> None:
+        self._dead: set[int] = set()
+        self._runtime_marked: set[int] = set()  # deaths the control
+        # plane reported — reconciled on every poll so an errmgr-respawn
+        # revival (proc_revived clears the server dead-set) un-declares
+        self._reasons: dict[int, str] = {}
+        self._lock = threading.Lock()
+        self._listeners: list = []
+        self._client = None
+        self._last_poll = 0.0
+        self._watch_stop: Optional[threading.Event] = None
+
+    # -- feeding -----------------------------------------------------------
+
+    def attach_client(self, client) -> None:
+        """Connect the runtime control plane (a PMIxClient) and start the
+        background watcher that keeps polling while the app is blocked."""
+        self._client = client
+        if self._watch_stop is None:
+            self._watch_stop = threading.Event()
+            t = threading.Thread(target=self._watch, name="ft-detector",
+                                 daemon=True)
+            t.start()
+
+    def close(self) -> None:
+        if self._watch_stop is not None:
+            self._watch_stop.set()
+
+    def mark_failed(self, world_rank: int, reason: str = "") -> bool:
+        """Record a death (local evidence / injection).  True when new."""
+        with self._lock:
+            if world_rank in self._dead:
+                return False
+            self._dead.add(world_rank)
+            if reason:
+                self._reasons[world_rank] = reason
+            listeners = list(self._listeners)
+        _log.verbose(1, "detector: rank %d declared dead%s", world_rank,
+                     f" ({reason})" if reason else "")
+        trace_mod.count("ft_rank_deaths_total")
+        for cb in listeners:
+            try:
+                cb(world_rank)
+            except Exception as e:  # noqa: BLE001 — detector must survive
+                _log.error("detector listener failed for %d: %r",
+                           world_rank, e)
+        return True
+
+    def add_listener(self, cb) -> None:
+        """cb(world_rank) fires once per newly-discovered death."""
+        with self._lock:
+            self._listeners.append(cb)
+
+    # -- querying ----------------------------------------------------------
+
+    def is_dead(self, world_rank: int, poll: bool = True) -> bool:
+        if world_rank in self._dead:
+            return True
+        if poll:
+            self.poll_runtime()
+            return world_rank in self._dead
+        return False
+
+    def dead_ranks(self) -> set[int]:
+        self.poll_runtime()
+        with self._lock:
+            return set(self._dead)
+
+    def reason(self, world_rank: int) -> str:
+        return self._reasons.get(world_rank, "")
+
+    def poll_runtime(self, force: bool = False) -> None:
+        """Rate-limited pull of the runtime dead-set."""
+        client = self._client
+        if client is None:
+            return
+        now = time.monotonic()
+        period = var_registry.get("ft_poll_period")
+        with self._lock:
+            if not force and now - self._last_poll < period:
+                return
+            self._last_poll = now
+        try:
+            failed = client.failed_ranks()   # rank → reason
+        except Exception:  # noqa: BLE001 — control plane may be tearing down
+            return
+        with self._lock:
+            revived = self._runtime_marked - set(failed)
+            self._runtime_marked = set(failed)
+            self._dead -= revived   # errmgr/respawn brought them back
+            for r in revived:
+                self._reasons.pop(r, None)
+        for r, reason in failed.items():
+            self.mark_failed(r, reason=reason or "runtime-declared")
+
+    def _watch(self) -> None:
+        period = var_registry.get("ft_poll_period")
+        while not self._watch_stop.wait(max(0.05, period)):
+            self.poll_runtime(force=True)
+
+
+class _AgreeState:
+    """One agreement instance (comm cid × sequence number)."""
+
+    __slots__ = ("cv", "contribs", "decision")
+
+    def __init__(self) -> None:
+        self.cv = threading.Condition()
+        self.contribs: dict[int, tuple[int, frozenset]] = {}  # world → ...
+        self.decision: Optional[tuple[int, tuple]] = None
+
+
+class _CommFT:
+    """Per-communicator FT bookkeeping (agree sequencing, acked deaths)."""
+
+    def __init__(self, comm: "Communicator") -> None:
+        self.comm_ref = weakref.ref(comm)
+        self.group_ranks = tuple(comm.group.ranks)  # world ranks, frozen
+        self.agree_seq = itertools.count()
+        self.shrink_seq = itertools.count()
+        self.acked: set[int] = set()
+        self.states: dict[int, _AgreeState] = {}
+        self.lock = threading.Lock()
+
+    def state(self, seq: int) -> _AgreeState:
+        with self.lock:
+            st = self.states.get(seq)
+            if st is None:
+                st = self.states[seq] = _AgreeState()
+            return st
+
+
+class PmlFT:
+    """The PML's fault-tolerance sidecar: revoked cids, posted-recv
+    shadow tracking, FT frame dispatch, and the failure detector.
+
+    Installed lazily (``pml_ft(pml)``): a process that never touches FT
+    pays a single ``pml.ft is None`` check per operation.  Once
+    installed, deaths poison matching posted recvs + parked sends, and
+    revocations poison a cid's present and future operations.
+    """
+
+    def __init__(self, pml: "PmlOb1") -> None:
+        self.pml = pml
+        self.detector = FailureDetector()
+        self.revoked: set[int] = set()
+        self._comms: dict[int, _CommFT] = {}
+        self._pending: dict[int, "weakref.WeakSet"] = {}  # cid → recvs
+        self._lock = threading.Lock()
+        self.detector.add_listener(self._on_rank_dead)
+
+    # -- registration ------------------------------------------------------
+
+    def comm_ft(self, comm: "Communicator") -> _CommFT:
+        with self._lock:
+            cft = self._comms.get(comm.cid)
+            if cft is None or cft.comm_ref() is not comm:
+                cft = self._comms[comm.cid] = _CommFT(comm)
+            return cft
+
+    def track_recv(self, req) -> None:
+        """Shadow-register a posted recv so a revoke / peer death can
+        fail it (the compiled matching engine owns the real queues and
+        has no enumeration API)."""
+        with self._lock:
+            ws = self._pending.get(req.cid)
+            if ws is None:
+                ws = self._pending[req.cid] = weakref.WeakSet()
+            ws.add(req)
+
+    # -- operation gates (called from pml hot paths) -----------------------
+
+    def check_send(self, peer: int, cid: int) -> None:
+        """Raise before a send that can never complete: revoked cid, or
+        a peer the detector already declared dead (fail fast — do not
+        park for the retry window)."""
+        if cid in self.revoked:
+            raise MPIException(
+                f"communicator cid {cid} has been revoked",
+                error_class=ERR_REVOKED)
+        if self.detector.is_dead(peer, poll=False):
+            raise MPIException(
+                f"rank {peer} has failed "
+                f"({self.detector.reason(peer) or 'detector-declared'})",
+                error_class=ERR_PROC_FAILED)
+
+    def check_cid(self, cid: int) -> None:
+        if cid in self.revoked:
+            raise MPIException(
+                f"communicator cid {cid} has been revoked",
+                error_class=ERR_REVOKED)
+
+    # -- death / revocation poisoning --------------------------------------
+
+    def _on_rank_dead(self, world_rank: int) -> None:
+        """Detector listener: fail every posted recv naming the corpse
+        and every frame parked for it — the blocked caller gets
+        MPI_ERR_PROC_FAILED instead of a 30 s park-and-heal stall."""
+        exc = MPIException(
+            f"rank {world_rank} has failed "
+            f"({self.detector.reason(world_rank) or 'detector-declared'})",
+            error_class=ERR_PROC_FAILED)
+        with self._lock:
+            victims = [req for ws in self._pending.values() for req in ws
+                       if req.source == world_rank and not req.done()]
+        for req in victims:
+            self._fail_recv(req, exc)
+        self._fail_parked(world_rank, exc)
+
+    def _fail_recv(self, req, exc: MPIException) -> None:
+        """Dequeue a posted recv (so a late frame cannot double-complete
+        it) and fail it."""
+        pml = self.pml
+        with pml._lock:
+            if pml._eng is not None:
+                pml._eng.cancel(req.cid, req)
+            else:
+                m = pml._matching.get(req.cid)
+                if m is not None:
+                    try:
+                        m.posted.remove(req)
+                    except ValueError:
+                        pass
+        if not req.done():
+            req.fail(exc)
+
+    def _fail_parked(self, peer: int, exc: MPIException,
+                     cid: Optional[int] = None) -> None:
+        """Fail parked frames toward ``peer`` (all of them, or only the
+        user-data frames of one revoked cid — FT control and foreign-cid
+        frames stay parked)."""
+        pml = self.pml
+        with pml._lock:
+            parked = pml._parked.get(peer)
+            if not parked:
+                return
+            if cid is None:
+                dead, parked[:] = list(parked), []
+                pml._parked.pop(peer, None)
+            else:
+                dead = [e for e in parked
+                        if e[0].get("t") in ("eager", "rndv")
+                        and e[0].get("cid") == cid]
+                parked[:] = [e for e in parked if e not in dead]
+        for _h, _p, req in dead:
+            pml._fail_req(req, exc)
+
+    def mark_revoked(self, cid: int) -> bool:
+        """Poison a cid locally; True when newly revoked here."""
+        with self._lock:
+            if cid in self.revoked:
+                return False
+            self.revoked.add(cid)
+            victims = [req for req in self._pending.get(cid, ())
+                       if not req.done()]
+        exc = MPIException(
+            f"communicator cid {cid} has been revoked",
+            error_class=ERR_REVOKED)
+        for req in victims:
+            self._fail_recv(req, exc)
+        # parked user-data frames on the revoked cid will never be
+        # wanted — fail their senders now, toward every parked peer
+        with self.pml._lock:
+            peers = list(self.pml._parked)
+        for peer in peers:
+            self._fail_parked(peer, exc, cid=cid)
+        trace_mod.count("ft_revokes_total")
+        return True
+
+    # -- FT frame plane ----------------------------------------------------
+
+    def _send_ft(self, peer: int, hdr: dict) -> None:
+        """One FT control frame via the PML's ordered worker path (non-
+        blocking; reader-thread safe).  Dead peers are skipped — FT
+        frames must not pile up in the park-and-heal queue."""
+        if peer == self.pml.rank:
+            return
+        if self.detector.is_dead(peer, poll=False):
+            return
+        self.pml._enqueue_frame(peer, hdr, b"", None)
+
+    def on_ft_frame(self, peer: int, hdr: dict) -> None:
+        """Dispatch one incoming FT frame (BTL reader thread: never
+        block, sends only via the worker queue)."""
+        op = hdr.get("op")
+        if op == "revoke":
+            self._recv_revoke(hdr)
+        elif op == "agree_c":
+            self._recv_agree_contrib(peer, hdr)
+        elif op == "agree_d":
+            self._recv_agree_decision(hdr)
+        else:
+            _log.error("unknown ft op %r from %d", op, peer)
+
+    def _recv_revoke(self, hdr: dict) -> None:
+        cid = hdr["cid"]
+        if not self.mark_revoked(cid):
+            return  # already knew — the flood stops here
+        _log.verbose(1, "rank %d: cid %d revoked remotely; flooding",
+                     self.pml.rank, cid)
+        for peer in hdr.get("grp", ()):
+            if peer != self.pml.rank:
+                self._send_ft(peer, {"t": "ft", "op": "revoke", "cid": cid,
+                                     "grp": list(hdr.get("grp", ())),
+                                     "n": int(hdr.get("n", 0)) + 1})
+
+    # -- agreement ---------------------------------------------------------
+
+    def _comm_ft_by_cid(self, cid: int) -> Optional[_CommFT]:
+        with self._lock:
+            return self._comms.get(cid)
+
+    def _recv_agree_contrib(self, peer: int, hdr: dict) -> None:
+        cft = self._comm_ft_by_cid(hdr["cid"])
+        if cft is None:
+            # agreement on a comm this process never FT-touched: that is
+            # fine — contributions retransmit until our agree() call
+            # creates the state.  Drop; the resend finds us ready.
+            return
+        st = cft.state(hdr["aseq"])
+        with st.cv:
+            st.contribs[int(hdr["from"])] = (
+                int(hdr["flag"]), frozenset(int(r) for r in hdr["failed"]))
+            decision = st.decision
+            st.cv.notify_all()
+        if decision is not None:
+            # anyone holding the decision answers — late/confused
+            # contributors converge on the already-computed value
+            flag, failed = decision
+            self._send_ft(peer, {"t": "ft", "op": "agree_d",
+                                 "cid": hdr["cid"], "aseq": hdr["aseq"],
+                                 "flag": flag, "failed": list(failed),
+                                 "n": int(hdr.get("n", 0))})
+
+    def _recv_agree_decision(self, hdr: dict) -> None:
+        cft = self._comm_ft_by_cid(hdr["cid"])
+        if cft is None:
+            return
+        st = cft.state(hdr["aseq"])
+        with st.cv:
+            if st.decision is None:
+                st.decision = (int(hdr["flag"]),
+                               tuple(sorted(int(r)
+                                            for r in hdr["failed"])))
+            st.cv.notify_all()
+
+    def agree(self, comm: "Communicator", flag: bool) -> tuple[bool, tuple]:
+        """Blocking fault-tolerant agreement over ``comm``'s survivors →
+        (AND of flags, agreed failed world-rank tuple)."""
+        cft = self.comm_ft(comm)
+        seq = next(cft.agree_seq)
+        st = cft.state(seq)
+        me = comm._world_rank
+        retry = var_registry.get("ft_agree_retry_interval")
+        deadline = time.monotonic() + var_registry.get("ft_agree_timeout")
+        my_failed = frozenset(r for r in cft.group_ranks
+                              if self.detector.is_dead(r, poll=False))
+        attempt = 0
+        t0 = trace_mod.begin() if trace_mod.active else 0
+        while True:
+            with st.cv:
+                if st.decision is not None:
+                    break
+                st.contribs[me] = (int(bool(flag)), my_failed)
+            self.detector.poll_runtime()
+            known_dead = {r for r in cft.group_ranks
+                          if self.detector.is_dead(r, poll=False)}
+            my_failed = my_failed | frozenset(known_dead)
+            live = [r for r in cft.group_ranks if r not in known_dead]
+            if not live:
+                raise MPIException("agree: no live ranks remain",
+                                   error_class=ERR_PROC_FAILED)
+            coord = live[0]
+            if me == coord:
+                if self._agree_decide(comm.cid, st, seq, live, known_dead):
+                    break
+            else:
+                attempt += 1
+                self._send_ft(coord, {
+                    "t": "ft", "op": "agree_c", "cid": comm.cid,
+                    "aseq": seq, "from": me, "flag": int(bool(flag)),
+                    "failed": sorted(my_failed), "n": attempt})
+                if attempt % 8 == 0:
+                    # sustained coordinator silence: gossip the
+                    # contribution to everyone — any decision-holder
+                    # replies, and a dead coordinator stops mattering
+                    for peer in live[1:]:
+                        if peer != me:
+                            self._send_ft(peer, {
+                                "t": "ft", "op": "agree_c",
+                                "cid": comm.cid, "aseq": seq, "from": me,
+                                "flag": int(bool(flag)),
+                                "failed": sorted(my_failed),
+                                "n": attempt})
+                with st.cv:
+                    st.cv.wait_for(lambda: st.decision is not None,
+                                   timeout=retry)
+                    if st.decision is not None:
+                        break
+            if time.monotonic() > deadline:
+                raise MPIException(
+                    f"agree on cid {comm.cid} (seq {seq}) timed out",
+                    error_class=ERR_PROC_FAILED)
+        with st.cv:
+            dflag, dfailed = st.decision
+        if t0 and trace_mod.active:
+            trace_mod.complete("ft", "agree", t0, rank=self.pml.rank,
+                               cid=comm.cid, aseq=seq,
+                               failed=len(dfailed))
+        trace_mod.count("ft_agrees_total")
+        return bool(dflag), dfailed
+
+    def _agree_decide(self, cid: int, st: _AgreeState, seq: int,
+                      live: list[int], known_dead: set[int]) -> bool:
+        """Coordinator arm of one agree attempt: True once decided."""
+        retry = var_registry.get("ft_agree_retry_interval")
+        with st.cv:
+            missing = [r for r in live
+                       if r != self.pml.rank and r not in st.contribs]
+            if missing:
+                st.cv.wait_for(lambda: st.decision is not None or all(
+                    r in st.contribs for r in live if r != self.pml.rank),
+                    timeout=retry)
+            if st.decision is not None:
+                return True
+            missing = [r for r in live
+                       if r != self.pml.rank and r not in st.contribs]
+            if missing:
+                return False  # re-evaluate liveness, try again
+            flag = 1
+            failed = set(known_dead)
+            for f, fl in st.contribs.values():
+                flag &= f
+                failed |= fl
+            st.decision = (flag, tuple(sorted(failed)))
+            contributors = set(st.contribs) | set(live)
+            decision = st.decision
+        for peer in contributors:
+            if peer != self.pml.rank:
+                self._send_ft(peer, {
+                    "t": "ft", "op": "agree_d", "cid": cid, "aseq": seq,
+                    "flag": decision[0], "failed": list(decision[1]),
+                    "n": 0})
+        return True
+
+
+_pml_fts: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_pml_fts_lock = threading.Lock()
+
+
+def pml_ft(pml: "PmlOb1") -> PmlFT:
+    """The PML's FT sidecar, created on first use and installed as
+    ``pml.ft`` (the attribute the PML hot paths check)."""
+    ft = pml.ft
+    if ft is not None:
+        return ft
+    with _pml_fts_lock:
+        ft = _pml_fts.get(pml)
+        if ft is None:
+            ft = _pml_fts[pml] = PmlFT(pml)
+            pml.ft = ft
+    return ft
+
+
+def attach_runtime(pml: "PmlOb1", client) -> None:
+    """runtime.init wiring: arm the detector against the job's control
+    plane so peer deaths the launcher/heartbeat monitor observed surface
+    as MPI_ERR_PROC_FAILED here."""
+    if client is None:
+        return
+    pml_ft(pml).detector.attach_client(client)
+
+
+# -- Communicator-facing entry points (comm.py delegates here) -------------
+
+
+def comm_revoke(comm: "Communicator") -> None:
+    """≈ MPIX_Comm_revoke: poison the communicator everywhere.  Returns
+    after the local mark + the first propagation wave; the flood (every
+    learner forwards once) carries it to members this rank cannot reach
+    directly."""
+    ft = pml_ft(comm.pml)
+    ft.comm_ft(comm)   # agreement on this comm stays possible
+    grp = list(comm.group.ranks)
+    ft.mark_revoked(comm.cid)
+    _log.verbose(1, "rank %d revokes cid %d", comm.pml.rank, comm.cid)
+    for peer in grp:
+        if peer != comm._world_rank:
+            ft._send_ft(peer, {"t": "ft", "op": "revoke", "cid": comm.cid,
+                               "grp": grp, "n": 0})
+
+
+def comm_is_revoked(comm: "Communicator") -> bool:
+    ft = comm.pml.ft
+    return ft is not None and comm.cid in ft.revoked
+
+
+def comm_agree(comm: "Communicator", flag: bool = True) -> bool:
+    """≈ MPIX_Comm_agree: AND of ``flag`` over the survivors; uniform
+    across every rank that returns."""
+    out, _failed = pml_ft(comm.pml).agree(comm, flag)
+    return out
+
+
+def comm_shrink(comm: "Communicator", name: Optional[str] = None
+                ) -> "Communicator":
+    """≈ MPIX_Comm_shrink: agree on the failed set, then build the
+    survivor communicator.  The cid is hash-derived from (parent cid,
+    failed set, shrink call number) in the negative cid namespace —
+    every survivor computes the same value with zero extra traffic,
+    exactly the create_group construction."""
+    from ompi_tpu.mpi.comm import Communicator
+    from ompi_tpu.mpi.group import Group
+
+    ft = pml_ft(comm.pml)
+    cft = ft.comm_ft(comm)
+    _flag, failed = ft.agree(comm, True)
+    sseq = next(cft.shrink_seq)
+    survivors = [r for r in cft.group_ranks if r not in failed]
+    desc = (f"shrink:{comm.cid}:{','.join(map(str, failed))}:{sseq}")
+    cid = -(1 + (zlib.crc32(desc.encode()) & 0x7FFFFFFF))
+    _log.verbose(1, "rank %d shrinks cid %d -> %d (lost %s)",
+                 comm.pml.rank, comm.cid, cid, list(failed))
+    trace_mod.count("ft_shrinks_total")
+    return Communicator(Group(survivors), cid, comm.pml,
+                        comm._world_rank,
+                        name or f"{comm.name}.shrink")
+
+
+def comm_get_failed(comm: "Communicator"):
+    """≈ MPIX_Comm_get_failed: the group of members this process knows
+    to be dead (monotonic; no agreement implied)."""
+    from ompi_tpu.mpi.group import Group
+
+    ft = pml_ft(comm.pml)
+    ft.detector.poll_runtime()
+    return Group([r for r in comm.group.ranks
+                  if ft.detector.is_dead(r, poll=False)])
+
+
+def comm_ack_failed(comm: "Communicator",
+                    num_to_ack: Optional[int] = None) -> int:
+    """≈ MPIX_Comm_ack_failed: acknowledge (up to ``num_to_ack`` of) the
+    locally-known failures; returns how many are now acknowledged."""
+    ft = pml_ft(comm.pml)
+    cft = ft.comm_ft(comm)
+    failed = sorted(r for r in cft.group_ranks
+                    if ft.detector.is_dead(r, poll=False))
+    limit = len(failed) if num_to_ack is None else min(num_to_ack,
+                                                      len(failed))
+    cft.acked.update(failed[:limit])
+    return len(cft.acked)

@@ -33,7 +33,7 @@ import numpy as np
 from ompi_tpu.core import dss, output
 from ompi_tpu.mpi import op as op_mod
 from ompi_tpu.mpi import trace as trace_mod
-from ompi_tpu.mpi.constants import ANY_SOURCE, MPIException
+from ompi_tpu.mpi.constants import ANY_SOURCE, ERR_REVOKED, MPIException
 from ompi_tpu.mpi.request import Request
 
 __all__ = ["Window", "DeviceWindow", "SharedWindow"]
@@ -165,6 +165,7 @@ class Window:
         # flat VIEW (never a copy, given contiguity): RMA offsets address
         # elements in row-major order and range checks agree with indexing
         self.buf = buffer.reshape(-1)
+        self._parent_comm = comm   # revocation coherence (see _check_ft)
         self.comm = comm.dup(name=f"{name}.osc")
         self.name = name
         self._buf_lock = threading.RLock()
@@ -454,8 +455,27 @@ class Window:
 
     # -- synchronization ---------------------------------------------------
 
+    def _check_ft(self, what: str) -> None:
+        """Epoch-entry ULFM gate: a window whose parent communicator was
+        revoked is itself poisoned (the dup inherits the revocation here,
+        so every member's epochs error coherently), and an already-revoked
+        window refuses new epochs with MPI_ERR_REVOKED."""
+        from ompi_tpu.mpi import ft
+
+        if (self.comm.pml.ft is None
+                and self._parent_comm.pml.ft is None):
+            return   # FT never engaged in this process: zero-cost exit
+        if (ft.comm_is_revoked(self._parent_comm)
+                and not ft.comm_is_revoked(self.comm)):
+            ft.pml_ft(self.comm.pml).mark_revoked(self.comm.cid)
+        if ft.comm_is_revoked(self.comm):
+            raise MPIException(
+                f"window {self.name!r}: {what} on a revoked communicator",
+                error_class=ERR_REVOKED)
+
     def fence(self) -> None:
         """Active-target epoch boundary (≈ MPI_Win_fence)."""
+        self._check_ft("fence")
         if trace_mod.active:   # epoch spans on the osc timeline
             with trace_mod.span("osc", "fence", rank=self.comm.pml.rank,
                                 win=self.name):
@@ -490,6 +510,7 @@ class Window:
     def post(self, origins: list[int]) -> None:
         """≈ MPI_Win_post: expose this window to ``origins`` (nonblocking).
         Matching ``start`` calls at the origins unblock once this arrives."""
+        self._check_ft("post")
         if self._exposure_group is not None:
             raise MPIException("MPI_Win_post while an exposure epoch is open")
         self._exposure_group = set(origins)
@@ -503,6 +524,7 @@ class Window:
         """≈ MPI_Win_start: open an access epoch to ``targets``; blocks until
         every target's post arrived (the reference may defer this wait to the
         first op — blocking here keeps the semantics strict and simple)."""
+        self._check_ft("start")
         if self._access_group is not None:
             raise MPIException("MPI_Win_start while an access epoch is open")
         want = set(targets)
@@ -623,6 +645,7 @@ class Window:
     def lock(self, target: int, exclusive: bool = True) -> None:
         """≈ MPI_Win_lock (passive target). A local target still goes
         through the service, keeping lock fairness uniform."""
+        self._check_ft("lock")
         if self._no_locks:
             raise MPIException(
                 "MPI_Win_lock on a window created with the no_locks=true "

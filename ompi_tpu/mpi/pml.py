@@ -38,7 +38,8 @@ from ompi_tpu.mpi import datatype as dt_mod
 from ompi_tpu.mpi import trace as trace_mod
 from ompi_tpu.mpi.btl import BtlEndpoint
 from ompi_tpu.mpi.constants import (
-    ANY_SOURCE, ANY_TAG, ERR_TRUNCATE, PROC_NULL, MPIException,
+    ANY_SOURCE, ANY_TAG, ERR_PROC_FAILED, ERR_TRUNCATE, PROC_NULL,
+    MPIException,
 )
 from ompi_tpu.mpi.datatype import Datatype
 from ompi_tpu.mpi.request import Request, Status
@@ -77,6 +78,11 @@ register_var("pml", "retry_window", VarType.DOUBLE, 30.0,
              "seconds a transiently-unroutable frame (peer dead or "
              "mid-respawn) is retried before the send fails (0 = fail "
              "fast); ≈ the failover PML's retransmit bound")
+register_var("pml", "heal_max_interval", VarType.DOUBLE, 1.0,
+             "cap on the exponential park-and-heal retry backoff; also "
+             "bounds how stale the dead-peer fast-fail can be (a send "
+             "to a detector-declared-dead rank fails within "
+             "rml_heartbeat_timeout + this, not the full retry window)")
 register_var("pml", "frag_size", VarType.SIZE, 1 << 20,
              "fragment size for rendezvous pipelines")
 register_var("pml", "native_match", VarType.BOOL, True,
@@ -397,7 +403,8 @@ class PmlOb1:
         # an isend issued after the adopt draws a LATER seq than every
         # frame queued before it — queue order and seq order stay aligned
         self._inqueue: dict[int, collections.deque] = {}
-        self._healing: set[int] = set()        # peers with a live healer
+        self._healing: dict[int, float] = {}   # peers with a live healer
+        # → that healer's current backoff interval (seconds)
         self._qlock = threading.Lock()         # _queued has its own lock:
         # _enqueue_frame runs from handlers that already hold self._lock
         from ompi_tpu.mpi.mpit import Pvar, PvarClass, pvar_registry
@@ -411,6 +418,15 @@ class PmlOb1:
         self.pvar_fenced = pvar_registry.register_or_get(Pvar(
             f"pml_fenced_frames_rank{rank}", PvarClass.COUNTER, "frames",
             "pre-restart frames dropped by the incarnation fence"))
+        self.pvar_heal_ticks = pvar_registry.register_or_get(Pvar(
+            "pml_heal_ticks_total", PvarClass.COUNTER, "ticks",
+            "park-and-heal retry attempts across all ranks in this "
+            "process (soak runs read this as retry pressure)"))
+        # user-level fault tolerance sidecar (ompi_tpu.mpi.ft.PmlFT):
+        # revoked cids, failure detector, FT control-frame dispatch.
+        # None until the first FT API call / FT frame / runtime attach —
+        # the hot paths pay one attribute check.
+        self.ft = None
         # memchecker gate read ONCE (off-by-default debug feature — the
         # hot path must not pay a registry lookup per message; toggle it
         # before creating communicators, like the reference's build flag)
@@ -495,6 +511,8 @@ class PmlOb1:
 
     def close(self) -> None:
         self._closed = True
+        if self.ft is not None:
+            self.ft.detector.close()
         self._sendq.put(None)
         self._worker.join(timeout=2.0)
         self.endpoint.close()
@@ -515,6 +533,12 @@ class PmlOb1:
         if mode not in ("standard", "sync", "ready", "buffered"):
             raise MPIException(
                 f"unknown send mode {mode!r} (standard/sync/ready/buffered)")
+        ft = self.ft
+        if ft is not None:
+            # ULFM fail fast: a revoked cid or a detector-declared-dead
+            # peer raises NOW (ERR_REVOKED / ERR_PROC_FAILED), not after
+            # the 30 s park-and-heal retry window expires
+            ft.check_send(peer, cid)
         # compiled fast lane (same-address-space peers): a plain eager
         # contiguous send delivers straight into the peer's posted buffer
         # through its engine — no header object at all on the hot path
@@ -755,6 +779,10 @@ class PmlOb1:
         req = RecvRequest(buf, datatype, count, source, tag, cid)
         req.rid = next(self._ids)
         req._pml = self
+        ft = self.ft
+        if ft is not None:
+            ft.check_cid(cid)   # revoked comm: fail before posting
+            ft.track_recv(req)  # a later revoke/peer-death can poison it
         if self._listeners:
             self._emit(EVT_RECV_POST, peer=source, tag=tag, cid=cid)
         with self._lock:
@@ -790,6 +818,13 @@ class PmlOb1:
                         break
                 else:
                     m.posted.append(req)
+        if (ft is not None and source >= 0 and not req.done()
+                and ft.detector.is_dead(source, poll=False)):
+            # named-source recv from a corpse that left no matching
+            # message behind: it can never complete — ULFM semantics say
+            # ERR_PROC_FAILED now, not a hang
+            ft._fail_recv(req, MPIException(
+                f"rank {source} has failed", error_class=ERR_PROC_FAILED))
         self._drain_events()
         return req
 
@@ -911,11 +946,17 @@ class PmlOb1:
     # -- probe -------------------------------------------------------------
 
     def iprobe(self, source: int, tag: int, cid: int) -> Optional[Status]:
+        ft = self.ft
+        if ft is not None:
+            ft.check_cid(cid)
         with self._lock:
             return self._iprobe_locked(source, tag, cid)
 
     def probe(self, source: int, tag: int, cid: int,
               timeout: Optional[float] = None) -> Status:
+        ft = self.ft
+        if ft is not None:
+            ft.check_cid(cid)
         # deadline computed ONCE: every unexpected frame notifies the cv,
         # so restarting the full timeout per wakeup would never expire
         # under unrelated traffic
@@ -963,6 +1004,9 @@ class PmlOb1:
         another thread can never see it — the race MPI_Mprobe exists to
         close (a plain probe's status can be stolen by another thread's
         wildcard recv before this thread posts its own)."""
+        ft = self.ft
+        if ft is not None:
+            ft.check_cid(cid)
         with self._lock:
             return self._improbe_locked(source, tag, cid)
 
@@ -1003,6 +1047,9 @@ class PmlOb1:
 
     def mprobe(self, source: int, tag: int, cid: int,
                timeout: Optional[float] = None) -> tuple[Message, Status]:
+        ft = self.ft
+        if ft is not None:
+            ft.check_cid(cid)
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cv:
             while True:
@@ -1234,6 +1281,10 @@ class PmlOb1:
                 state.req.fail(MPIException(
                     "rsend: no matching receive was posted at the peer",
                     error_class=4))
+        elif t == "ft":  # ULFM control plane (revoke / agree)
+            from ompi_tpu.mpi import ft as ft_mod
+
+            ft_mod.pml_ft(self).on_ft_frame(peer, hdr)
         else:
             _log.error("unknown frame type %r from %d", t, peer)
 
@@ -1572,6 +1623,16 @@ class PmlOb1:
         try:
             self.endpoint.send(peer, hdr, payload)
         except ConnectionError as e:
+            ft = self.ft
+            if (ft is not None and hdr.get("t") != "ft"
+                    and ft.detector.is_dead(peer, poll=False)):
+                # the detector already declared the peer dead: parking
+                # would only delay the inevitable ERR_PROC_FAILED by the
+                # whole retry window
+                self._fail_req(req, MPIException(
+                    f"rank {peer} has failed ({e})",
+                    error_class=ERR_PROC_FAILED))
+                return "failed"
             window = float(var_registry.get("pml_retry_window") or 0)
             if window <= 0 or self._closed:
                 self._fail_req(req, e)
@@ -1604,6 +1665,8 @@ class PmlOb1:
             _log.error("send-completion callback raised\n%s",
                        __import__("traceback").format_exc())
 
+    _HEAL_BASE_INTERVAL = 0.1
+
     def _schedule_heal(self, peer: int, deadline: float) -> None:
         # singleton healer per peer: two concurrent heal loops would
         # interleave their sends (the receiver's seq reorder absorbs it,
@@ -1611,12 +1674,22 @@ class PmlOb1:
         with self._qlock:
             if peer in self._healing:
                 return
-            self._healing.add(peer)
-        t = threading.Timer(0.1, self._run_heal, args=(peer, deadline))
+            self._healing[peer] = self._HEAL_BASE_INTERVAL
+        self._arm_heal(peer, deadline, self._HEAL_BASE_INTERVAL)
+
+    def _arm_heal(self, peer: int, deadline: float,
+                  interval: float) -> None:
+        """One heal tick after ``interval`` (±15% jitter so a whole
+        job's healers toward one dead rank don't fire in lockstep)."""
+        import random
+
+        delay = interval * random.uniform(0.85, 1.15)
+        t = threading.Timer(delay, self._run_heal, args=(peer, deadline))
         t.daemon = True
         t.start()
 
     def _run_heal(self, peer: int, deadline: float) -> None:
+        self.pvar_heal_ticks.inc()
         try:
             retry = self._heal_peer(peer, deadline)
         except Exception:  # noqa: BLE001 — healer must not die holding the guard
@@ -1628,13 +1701,22 @@ class PmlOb1:
             # one healer chain may exist per peer.  Two concurrent loops
             # would both read parked[0] (duplicate frame on the wire)
             # and each pop one entry, silently dropping a never-sent
-            # frame.
-            t = threading.Timer(0.1, self._run_heal, args=(peer, deadline))
-            t.daemon = True
-            t.start()
+            # frame.  Exponential backoff + jitter, capped at
+            # pml_heal_max_interval: most respawns heal in well under a
+            # second, but a rank that stays down for its whole retry
+            # window must not be probed 300 times.
+            cap = float(var_registry.get("pml_heal_max_interval")
+                        or self._HEAL_BASE_INTERVAL)
+            with self._qlock:
+                interval = self._healing.get(peer,
+                                             self._HEAL_BASE_INTERVAL)
+                nxt = min(max(interval * 2, self._HEAL_BASE_INTERVAL),
+                          cap)
+                self._healing[peer] = nxt
+            self._arm_heal(peer, deadline, nxt)
             return
         with self._qlock:
-            self._healing.discard(peer)
+            self._healing.pop(peer, None)
         # frames parked between the healer draining and the discard
         # need a new healer
         with self._lock:
@@ -1646,6 +1728,21 @@ class PmlOb1:
         """Drain peer's parked frames.  Returns True when the caller
         (_run_heal) should chain another attempt after a backoff — the
         route is still down but the retry window is open."""
+        ft = self.ft
+        if ft is not None and ft.detector.is_dead(peer):
+            # the runtime declared the peer dead mid-park: fail the
+            # user-data frames NOW (ERR_PROC_FAILED), keep nothing —
+            # except under respawn the peer may come back, but then the
+            # detector never declared it (respawn revives before the
+            # errmgr reports a death to the control plane)
+            with self._lock:
+                dead = self._parked.pop(peer, [])
+            for _h, _p, r in dead:
+                self._fail_req(r, MPIException(
+                    f"rank {peer} has failed "
+                    f"({ft.detector.reason(peer) or 'detector-declared'})",
+                    error_class=ERR_PROC_FAILED))
+            return False
         while True:
             with self._lock:
                 parked = self._parked.get(peer)

@@ -155,6 +155,23 @@ def init() -> Communicator:
                 # incarnation's card — re-announce so they re-route and
                 # reset the wire-seq space toward me
                 pml.announce_rebind(peers)
+            # ULFM failure detector: under the notify errmgr policy (or
+            # forced via ft_enable) peer deaths reported by the control
+            # plane surface as MPI_ERR_PROC_FAILED instead of a hang /
+            # full retry-window stall.  Off under respawn by default:
+            # its dead-set is transient while a rank revives.
+            # both modules register their config vars on import — the
+            # launcher has them, this app process may not yet
+            from ompi_tpu.mpi import ft as ft_mod
+            from ompi_tpu.runtime import errmgr as _errmgr_mod  # noqa: F401
+
+            # token match, not substring: the selection var supports
+            # comma lists and ^exclusion ("--mca errmgr ^notify" must
+            # NOT arm the detector)
+            selected = {t.strip()
+                        for t in str(_vars.get("errmgr") or "").split(",")}
+            if _vars.get("ft_enable") or "notify" in selected:
+                ft_mod.attach_runtime(pml, client)
 
         world = Communicator(Group(range(size)), cid=0, pml=pml,
                              my_world_rank=rank, name="WORLD")

@@ -86,6 +86,15 @@ _COUNTER_SPECS = (
      "frames published into shared-memory rings"),
     ("btl_shm_drained_total", "frames",
      "frames drained from shared-memory rings"),
+    # ULFM fault-tolerance plane (mpi/ft.py)
+    ("ft_rank_deaths_total", "ranks",
+     "world ranks this process's failure detector declared dead"),
+    ("ft_revokes_total", "communicators",
+     "communicator cids poisoned by revocation (local or remote)"),
+    ("ft_agrees_total", "agreements",
+     "fault-tolerant agreements completed (Comm.agree / shrink)"),
+    ("ft_shrinks_total", "communicators",
+     "survivor communicators built by Comm.shrink"),
 )
 
 #: plain-int counter store: dict increments, no lock — losses under

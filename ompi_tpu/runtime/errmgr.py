@@ -13,6 +13,10 @@ job).  Components decide what a proc-failure event does:
   an aborted job leaves a per-rank timeline behind for post-mortem
   (merge with ``tools/trace_export.py``).
 - ``continue`` — log and keep going.
+- ``notify``   — keep going AND propagate the failure to the survivors
+  (PMIx dead-set + TAG_PROC_FAILED xcast + notifier event) so they can
+  run user-level recovery: ``Comm.revoke()/shrink()/agree()`` from
+  ``ompi_tpu.mpi.ft`` — the ULFM shrink-and-continue recipe.
 - ``respawn``  — revive the failed rank in place up to
   ``errmgr_max_restarts`` times (≈ rmaps/resilient + the errmgr restart
   paths): same rank and env plus ``OMPI_TPU_RESTART=<n>`` so the app can
@@ -41,7 +45,8 @@ from ompi_tpu.runtime.job import Job, Proc, ProcState
 if TYPE_CHECKING:
     from ompi_tpu.runtime.launcher import LocalLauncher
 
-__all__ = ["errmgr_framework", "ErrmgrAbort", "ErrmgrRespawn"]
+__all__ = ["errmgr_framework", "ErrmgrAbort", "ErrmgrRespawn",
+           "ErrmgrContinue", "ErrmgrNotify"]
 
 _log = output.get_stream("errmgr")
 
@@ -132,3 +137,51 @@ class ErrmgrContinue(Component):
     def proc_failed(self, launcher: "LocalLauncher", job: Job, proc: Proc) -> None:
         _log.verbose(1, "rank %d failed (%s); continuing per policy",
                      proc.rank, proc.state.value)
+
+
+@errmgr_framework.component
+class ErrmgrNotify(Component):
+    """ULFM-enabling policy: a rank death neither kills the job (abort)
+    nor revives the rank (respawn) — it is *propagated* to the survivors
+    so they can run user-level recovery (``Comm.revoke`` / ``shrink`` /
+    ``agree``, mpi/ft.py):
+
+    - the PMIx server's dead-set already holds the rank (the launcher
+      calls ``proc_died`` before any policy runs), so survivors' failure
+      detectors see it on their next poll and pending operations against
+      the dead peer fail fast with MPI_ERR_PROC_FAILED;
+    - on a daemon tree the failure additionally rides a TAG_PROC_FAILED
+      xcast so every orted logs which rank died and why;
+    - an admin notifier event records the death.
+
+    Select with ``--mca errmgr notify``.  This is the policy behind the
+    shrink-and-continue recipe (README "Fault tolerance").
+    """
+
+    NAME = "notify"
+    PRIORITY = 0    # opt-in via --mca errmgr notify
+
+    def proc_failed(self, launcher: "LocalLauncher", job: Job,
+                    proc: Proc) -> None:
+        from ompi_tpu.runtime.notifier import Severity, notify
+
+        reason = (f"rank {proc.rank} {proc.state.value} "
+                  f"(exit code {proc.exit_code})")
+        _log.verbose(1, "notify policy: %s; propagating to survivors",
+                     reason)
+        server = getattr(launcher, "server", None)
+        if server is not None:
+            # idempotent (the reap loop already called proc_died); this
+            # adds the human-readable reason the detector surfaces
+            server.proc_died(proc.rank, reason=reason)
+        node = getattr(launcher, "rml", None)
+        if node is not None:
+            from ompi_tpu.runtime import rml as rml_mod
+
+            try:
+                node.xcast(rml_mod.TAG_PROC_FAILED, (proc.rank, reason))
+            except Exception as e:  # noqa: BLE001 — tree may be tearing down
+                _log.error("notify: TAG_PROC_FAILED xcast failed: %r", e)
+        notify(Severity.WARN, "rank-failed",
+               f"job {job.jobid} {reason}; survivors notified "
+               f"(job continues)")

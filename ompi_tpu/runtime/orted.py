@@ -96,6 +96,7 @@ class Orted:
         self.node.register_recv(rml.TAG_STDIN, self._on_stdin)
         self.node.register_recv(rml.TAG_RESPAWN, self._on_respawn)
         self.node.register_recv(rml.TAG_STATS, self._on_stats)
+        self.node.register_recv(rml.TAG_PROC_FAILED, self._on_proc_failed)
         self._spec: Optional[dict] = None
         self._my_rows: dict[int, tuple[int, Optional[int]]] = {}
         self.node.register_recv(rml.TAG_SHUTDOWN,
@@ -107,6 +108,27 @@ class Orted:
         self._boot = self.node.dial_bootstrap(hnp_uri)
         self.node.send_direct(self._boot, rml.TAG_REGISTER,
                               (vpid, self.node.uri, self.hostname))
+        # liveness beats toward the HNP (no-op when the period var is 0);
+        # beats start only once the tree up-link exists
+        threading.Thread(target=self._start_heartbeats, daemon=True).start()
+        # deterministic chaos: a fault plan naming this daemon arms a
+        # self-SIGKILL (the injected 'host death' the heartbeat detector
+        # and notify policy exist to survive)
+        from ompi_tpu.testing import faultinject
+
+        faultinject.arm_daemon(vpid)
+
+    def _start_heartbeats(self) -> None:
+        if self.node.wait_parent(timeout=60.0) or self.vpid == 0:
+            rml.start_heartbeats(self.node, self._done)
+
+    def _on_proc_failed(self, origin: int, payload) -> None:
+        """errmgr notify propagation: a rank somewhere in the job died and
+        the job is continuing — log it so every host's record shows which
+        peer vanished (app ranks learn through the PMIx dead-set)."""
+        rank, reason = payload
+        _log.verbose(1, "orted %d: peer rank %d failed (%s); job continues",
+                     self.vpid, rank, reason)
 
     # -- tree wiring -------------------------------------------------------
 

@@ -54,6 +54,13 @@ register_var("plm", "ssh_args", VarType.STRING,
 register_var("plm", "ssh_python", VarType.STRING, "",
              "python interpreter to exec on remote hosts (empty = same "
              "path as the HNP's sys.executable)")
+register_var("plm", "exit_report_timeout", VarType.DOUBLE, 3.0,
+             "seconds to wait for straggler rank-exit reports during "
+             "teardown (VM stop mid-job, daemon loss) before accounting "
+             "the job without them")
+register_var("plm", "daemon_drain_timeout", VarType.DOUBLE, 5.0,
+             "seconds the VM teardown waits for orted daemons to exit "
+             "after the SHUTDOWN xcast before killing them")
 
 
 def _orted_argv(hnp_uri: str, vpid: int, ndaemons: int,
@@ -150,6 +157,7 @@ class MultiHostLauncher:
         self._cur_job: Optional[Job] = None
         self._persistent = False          # DVM mode: VM outlives jobs
         self._vm_stop = threading.Event()
+        self._hb_monitor: Optional[rml.HeartbeatMonitor] = None
 
     # -- state handlers ----------------------------------------------------
 
@@ -182,6 +190,14 @@ class MultiHostLauncher:
             rml.TAG_PROC_EXIT,
             lambda o, p: self._on_proc_exit(self._cur_job, p))
         self.rml.on_peer_lost = self._on_daemon_lost
+        # liveness beats (rml_heartbeat_period > 0): any beat — or any
+        # other up-traffic from the daemon — refreshes its clock; silence
+        # past rml_heartbeat_timeout is a daemon death the socket never
+        # reported (hung host, half-open link)
+        self._hb_monitor = rml.HeartbeatMonitor(self._on_daemon_lost)
+        self.rml.register_recv(
+            rml.TAG_HEARTBEAT,
+            lambda o, vpid: self._hb_monitor.beat(vpid))
 
         self._daemon_popen = self.plm.spawn_daemons(job, self.rml.uri)
         threading.Thread(target=self._daemon_monitor, args=(job,),
@@ -226,6 +242,11 @@ class MultiHostLauncher:
             job.aborted_proc = job.procs[0]
             self.kill_job(job)
             return False
+        # daemons are wired: arm the liveness watchdog (no-op when
+        # rml_heartbeat_period is 0)
+        for vpid in self._registered:
+            self._hb_monitor.watch(vpid)
+        self._hb_monitor.start()
         return True
 
     def _launch_apps(self, job: Job) -> None:
@@ -276,13 +297,14 @@ class MultiHostLauncher:
                          or self._vm_stop.is_set()),
                 )
             lost = self._lost_daemon
+        report_wait = var_registry.get("plm_exit_report_timeout")
         if self._vm_stop.is_set() and len(self._exited) < job.np:
             # VM shutdown ordered mid-job (DVM stop): ranks were killed
             # with the daemons; give their exit reports a moment, then
             # account the job as aborted rather than hanging forever
             with self._cv:
                 self._cv.wait_for(lambda: len(self._exited) >= job.np,
-                                  timeout=3.0)
+                                  timeout=report_wait)
             if job.aborted_proc is None and len(self._exited) < job.np:
                 job.abort_reason = "VM shut down while the job was running"
                 job.aborted_proc = job.procs[0]
@@ -305,14 +327,17 @@ class MultiHostLauncher:
             with self._cv:
                 self._cv.wait_for(
                     lambda: all(r in self._exited for r in alive),
-                    timeout=3.0)
+                    timeout=report_wait)
 
     def _teardown_vm(self) -> None:
         with self._cv:
             self._vm_stop.set()
             self._cv.notify_all()   # wake a _wait_ranks blocked mid-job
+        if self._hb_monitor is not None:
+            self._hb_monitor.stop()
         self.rml.xcast(rml.TAG_SHUTDOWN, None)
-        deadline = time.monotonic() + 5.0
+        deadline = (time.monotonic()
+                    + var_registry.get("plm_daemon_drain_timeout"))
         for p in self._daemon_popen:
             try:
                 p.wait(timeout=max(0.1, deadline - time.monotonic()))
@@ -391,12 +416,22 @@ class MultiHostLauncher:
             self._cv.notify_all()
 
     def _on_daemon_lost(self, vpid: int) -> None:
-        """RML link EOF from a daemon (crash/SIGKILL/host death)."""
+        """A daemon vanished: RML link EOF (crash/SIGKILL/host death) or
+        heartbeat silence (hung host, half-open link).  Under the
+        ``notify`` errmgr policy the daemon's ranks become proc-failure
+        events propagated to the survivors and the job continues; every
+        other policy treats a lost daemon as a lost lifeline and aborts."""
         with self._cv:
             if self._killed or self._vm_stop.is_set() or (
                     not self._persistent
                     and len(self._exited) >= self._np_hint):
                 return  # normal teardown, not a failure
+            job = self._cur_job
+            if (getattr(self._errmgr, "NAME", "") == "notify"
+                    and job is not None
+                    and 0 < vpid <= len(job.nodes)):
+                self._fail_daemon_ranks(job, vpid)
+                return
             if self._lost_daemon is None:
                 self._lost_daemon = vpid
             self._cv.notify_all()
@@ -406,9 +441,39 @@ class MultiHostLauncher:
                f"orted vpid {vpid} vanished (host death/crash); "
                f"aborting the job")
 
+    def _fail_daemon_ranks(self, job: Job, vpid: int) -> None:
+        """With self._cv held: a dead daemon's ranks can never report —
+        declare each of them failed NOW (the errmgr notify policy then
+        propagates each death to the survivors) and record synthetic
+        exits so _wait_ranks completes on the survivors alone."""
+        node = job.nodes[vpid - 1]
+        victims = [p for p in job.procs_on(node)
+                   if p.rank not in self._exited]
+        for proc in victims:
+            proc.state = ProcState.ABORTED
+            proc.exit_code = -9
+            if self.server is not None:
+                self.server.proc_died(
+                    proc.rank,
+                    reason=f"daemon vpid {vpid} (host {node.name}) died")
+            self._exited[proc.rank] = -9
+        self._cv.notify_all()
+        # notify's proc_failed is non-blocking (an xcast + a log line)
+        # and takes no plm locks, so running it with self._cv held is
+        # safe — and the synthetic exits above are already visible
+        for proc in victims:
+            self._errmgr.proc_failed(self, job, proc)
+
     def _daemon_monitor(self, job: Job) -> None:
-        """Poll orted Popen handles: a dead daemon before job end = abort.
+        """Poll orted Popen handles: a dead daemon before job end = abort
+        (first loss ends the watch — the job is coming down anyway) —
+        EXCEPT under the notify policy, where the job continues and the
+        monitor must keep watching for further daemon deaths: a
+        non-HNP-child daemon's link EOF lands at its tree parent, not
+        here, so Popen polling is the only detector the HNP always has.
         In DVM mode the monitor runs for the VM's lifetime."""
+        handled: set[int] = set()
+        notify = getattr(self._errmgr, "NAME", "") == "notify"
         while True:
             if self._vm_stop.is_set():
                 return
@@ -419,9 +484,13 @@ class MultiHostLauncher:
                         and (self._killed or len(self._exited) >= job.np)):
                     return
             for i, p in enumerate(self._daemon_popen):
+                if i + 1 in handled:
+                    continue
                 if p.poll() is not None:
+                    handled.add(i + 1)
                     self._on_daemon_lost(i + 1)
-                    return
+                    if not notify:
+                        return
             time.sleep(0.25)
 
     def _on_abort(self, job: Job, rank: int, status: int, msg: str) -> None:

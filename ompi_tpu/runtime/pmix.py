@@ -74,6 +74,7 @@ class PMIxServer:
         self._fence_done: set[int] = set()
         self._client_epoch: dict[int, int] = {}
         self._dead: set[int] = set()
+        self._failed_reasons: dict[int, str] = {}
         self._aborted: Optional[tuple[int, int, str]] = None
         self._listener = socket.create_server((host, 0))
         self._port = self._listener.getsockname()[1]
@@ -106,7 +107,11 @@ class PMIxServer:
     def _serve(self, conn: socket.socket) -> None:
         with conn:
             while True:
-                payload = _recv_frame(conn)
+                try:
+                    payload = _recv_frame(conn)
+                except OSError:
+                    return  # client died mid-frame (SIGKILL/injected
+                    # fault resets the socket) — same as a clean EOF
                 if payload is None:
                     return
                 msg = dss.unpack(payload, n=1)[0]
@@ -161,6 +166,13 @@ class PMIxServer:
             if self.on_abort is not None:
                 self.on_abort(rank, status, msg)
             return ("ok",)
+        if cmd == "failed":
+            # ULFM failure-detector query: the launcher's reap loop /
+            # heartbeat monitor feeds _dead via proc_died; app ranks poll
+            # this to turn silent peer death into MPI_ERR_PROC_FAILED
+            with self._cv:
+                return ("ok", sorted(self._dead),
+                        dict(self._failed_reasons))
         if cmd == "fin":
             return ("ok",)
         raise PMIxError(f"unknown command {cmd!r}")
@@ -172,11 +184,13 @@ class PMIxServer:
             self._fence_done.add(epoch)
             self._cv.notify_all()
 
-    def proc_died(self, rank: int) -> None:
+    def proc_died(self, rank: int, reason: str = "") -> None:
         """Launcher notification: rank exited abnormally. Re-evaluates every
         pending fence so survivors don't block on a dead peer forever."""
         with self._cv:
             self._dead.add(rank)
+            if reason:
+                self._failed_reasons[rank] = reason
             for epoch in list(self._fence_counts):
                 if epoch not in self._fence_done:
                     self._check_fence_done(epoch)
@@ -189,6 +203,7 @@ class PMIxServer:
         through barriers the survivors already passed)."""
         with self._cv:
             self._dead.discard(rank)
+            self._failed_reasons.pop(rank, None)
             self._client_epoch[rank] = 0
             self._cv.notify_all()
 
@@ -259,6 +274,15 @@ class PMIxClient:
 
     def barrier(self) -> None:
         self.fence(collect=False)
+
+    def failed_ranks(self) -> dict[int, str]:
+        """The runtime's current dead-set (ranks the launcher reaped dead
+        or the heartbeat monitor declared silent) → human-readable
+        reason ('' when the runtime recorded none) — the control-plane
+        source the ULFM failure detector (mpi/ft.py) polls."""
+        reply = self._rpc("failed")
+        reasons = reply[2] if len(reply) > 2 else {}
+        return {int(r): str(reasons.get(r, "")) for r in reply[1]}
 
     def abort(self, msg: str = "", status: int = 1) -> None:
         self._rpc("abort", self.rank, int(status), msg)

@@ -36,10 +36,19 @@ import threading
 from typing import Any, Callable, Optional
 
 from ompi_tpu.core import dss, output
+from ompi_tpu.core.config import VarType, register_var, var_registry
 
-__all__ = ["RmlNode", "tree_children", "tree_parent"]
+__all__ = ["RmlNode", "tree_children", "tree_parent", "HeartbeatMonitor",
+           "start_heartbeats"]
 
 _log = output.get_stream("rml")
+
+register_var("rml", "heartbeat_period", VarType.DOUBLE, 0.0,
+             "seconds between daemon liveness heartbeats up the tree "
+             "(0 = disabled; link EOF detection still applies)")
+register_var("rml", "heartbeat_timeout", VarType.DOUBLE, 3.0,
+             "seconds of heartbeat silence before the HNP declares a "
+             "daemon dead (only meaningful with rml_heartbeat_period > 0)")
 
 # well-known tags (≈ orte/mca/rml/rml_types.h:59-69)
 TAG_REGISTER = "register"       # daemon → HNP: (vpid, uri, hostname)
@@ -54,6 +63,10 @@ TAG_DAEMON_READY = "ready"      # up: daemon wired + children connected
 TAG_RESPAWN = "respawn"         # xcast: (rank, restarts) — owner revives
 TAG_STATS = "stats"             # xcast: request per-rank resource usage
 TAG_STATS_REPLY = "stats_reply"  # up: (vpid, [(rank, pid, rss, cpu_s)...])
+TAG_HEARTBEAT = "heartbeat"     # up: vpid — daemon liveness beat
+TAG_PROC_FAILED = "proc_failed"  # xcast: (rank, reason) — errmgr notify
+#                                  propagating a rank death to survivors
+#                                  instead of killing the job
 
 
 def tree_parent(vpid: int) -> Optional[int]:
@@ -306,3 +319,100 @@ class RmlNode:
             links.append(self._parent_link)
         for link in links:
             link.close()
+
+
+class HeartbeatMonitor:
+    """HNP-side liveness watchdog over the daemon heartbeats.
+
+    ≈ the sensor/heartbeat component of the reference: link EOF already
+    catches clean daemon death (TCP RST), but a SIGSTOP'd daemon, a hung
+    host, or a half-open connection across a network partition stays
+    silent with the socket alive.  When ``rml_heartbeat_period`` > 0 each
+    orted beats :data:`TAG_HEARTBEAT` up the tree; this monitor declares
+    any watched vpid dead after ``rml_heartbeat_timeout`` seconds of
+    silence and fires ``on_silent(vpid)`` exactly once per vpid.
+    """
+
+    def __init__(self, on_silent: Callable[[int], None]) -> None:
+        self.on_silent = on_silent
+        self._last: dict[int, float] = {}
+        self._declared: set[int] = set()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def watch(self, vpid: int) -> None:
+        """Start expecting beats from ``vpid`` (clock starts now)."""
+        import time
+
+        with self._lock:
+            self._last[vpid] = time.monotonic()
+
+    def beat(self, vpid: int) -> None:
+        """A heartbeat (or any sign of life) arrived from ``vpid``."""
+        import time
+
+        with self._lock:
+            self._last[vpid] = time.monotonic()
+
+    def start(self) -> None:
+        period = float(var_registry.get("rml_heartbeat_period") or 0)
+        if period <= 0 or self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._run, name="rml-hb-mon",
+                                        daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        import time
+
+        period = float(var_registry.get("rml_heartbeat_period") or 0)
+        timeout = float(var_registry.get("rml_heartbeat_timeout") or 0)
+        if timeout < 2 * period:
+            # a timeout shorter than two beat intervals declares every
+            # HEALTHY daemon dead between beats — clamp rather than
+            # letting a plausible-looking config abort the job
+            _log.verbose(0, "heartbeat: timeout %.2fs < 2x period %.2fs; "
+                         "clamping to %.2fs", timeout, period, 2 * period)
+            timeout = 2 * period
+        # check at the beat cadence; declare at the timeout
+        while not self._stop.wait(max(0.05, period / 2)):
+            now = time.monotonic()
+            silent = []
+            with self._lock:
+                for vpid, last in self._last.items():
+                    if vpid in self._declared:
+                        continue
+                    if now - last > timeout:
+                        self._declared.add(vpid)
+                        silent.append(vpid)
+            for vpid in silent:
+                _log.error("heartbeat: vpid %d silent for >%.1fs; "
+                           "declaring it dead", vpid, timeout)
+                try:
+                    self.on_silent(vpid)
+                except Exception as e:  # noqa: BLE001 — watchdog survives
+                    _log.error("heartbeat: on_silent(%d) failed: %r",
+                               vpid, e)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def start_heartbeats(node: RmlNode, stop: threading.Event) -> None:
+    """Daemon side: beat TAG_HEARTBEAT up the tree every
+    ``rml_heartbeat_period`` seconds until ``stop`` is set (no thread is
+    spawned when the period is 0)."""
+    period = float(var_registry.get("rml_heartbeat_period") or 0)
+    if period <= 0:
+        return
+
+    def beater() -> None:
+        while not stop.wait(period):
+            try:
+                node.send_up(TAG_HEARTBEAT, node.vpid)
+            except ConnectionError:
+                return  # tree torn down; the lifeline path handles it
+
+    threading.Thread(target=beater, name=f"rml-hb-{node.vpid}",
+                     daemon=True).start()

@@ -1,0 +1,375 @@
+"""faultinject — a seeded, schedule-driven chaos layer.
+
+The proof harness for every recovery path the stack ships (errmgr
+respawn / continue / notify, pml park-and-heal, ULFM revoke/shrink/agree
+in mpi/ft.py): instead of hand-scripted ``os._exit`` calls sprinkled
+through test apps, a *fault plan* — one string, replayable byte-for-byte
+from its seed — declares which rank dies when and which messages the
+transport loses, delays, or duplicates.
+
+Plan grammar (entries separated by ``;``)::
+
+    rank=2:kill@step=3        rank 2 exits when its step counter hits 3
+    rank=2:kill@t=0.5         rank 2 exits ~0.5 s after arming
+    daemon=1:kill@t=1.0       orted vpid 1 SIGKILLs itself after 1 s
+    drop=0.01                 drop outgoing FT-control frames with p=0.01
+    drop=0.05@all             drop ANY outgoing frame with p=0.05
+    rank=1:drop=0.1           restrict the action to rank 1
+    delay=0.02,5              delay frames 5 ms with p=0.02
+    dup=0.01                  duplicate frames with p=0.01
+
+Activation: ``OMPI_TPU_FAULT_PLAN`` / ``OMPI_TPU_FAULT_SEED`` in the
+environment, or the registered MCA vars (``--mca faultinject_plan ...``
+— tpurun exports --mca pairs into the job env, so the same plan reaches
+every rank).
+
+Determinism: a frame's verdict is a pure function of
+``(seed, rank, peer, frame identity)`` where the identity is built from
+the header's protocol fields (t/tag/cid/seq/op/attempt...) — no
+wall-clock, no global RNG, no thread-timing or send-path dependence: the
+same logical frame draws the same verdict whether it rides the inline
+fast path, the send worker, or a heal retry (FT control frames carry an
+attempt counter, so each *retransmission* is a fresh identity — a
+dropped revoke cannot be dropped forever).  ``step``-triggered kills
+fire at exactly the same application step on replay.  Every fired fault
+is recorded (and mirrored onto the flight recorder when tracing is
+armed); ``events()`` / the ``OMPI_TPU_FAULT_LOG_DIR`` dump let a driver
+assert replay equality (tools/chaos_soak.py does).
+
+Scope note on drops: the PML assumes a *reliable* transport — an
+unconditionally dropped data frame is a hung collective, by design.
+``drop`` therefore defaults to the FT control plane (``t: "ft"`` frames,
+whose revoke/agree protocols carry their own retransmission) and must be
+widened to ``@all`` explicitly by plans that want to prove timeout
+behavior rather than completion.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+import zlib
+from typing import Any, Optional
+
+from ompi_tpu.core import output
+from ompi_tpu.core.config import VarType, register_var, var_registry
+
+__all__ = ["active", "plan_text", "injector_for", "step", "arm_daemon",
+           "events", "reset", "Injector"]
+
+_log = output.get_stream("faultinject")
+
+register_var("faultinject", "plan", VarType.STRING, "",
+             "fault plan (see ompi_tpu.testing.faultinject grammar); "
+             "empty = chaos disabled.  OMPI_TPU_FAULT_PLAN is a synonym.")
+register_var("faultinject", "seed", VarType.INT, 0,
+             "seed for the deterministic fault decision streams")
+register_var("faultinject", "exit_code", VarType.INT, 9,
+             "exit code an injected rank kill dies with")
+
+ENV_PLAN = "OMPI_TPU_FAULT_PLAN"
+ENV_SEED = "OMPI_TPU_FAULT_SEED"
+ENV_LOG_DIR = "OMPI_TPU_FAULT_LOG_DIR"
+
+
+def plan_text() -> str:
+    """The active plan string ('' when chaos is disabled)."""
+    return (os.environ.get(ENV_PLAN)
+            or var_registry.get("faultinject_plan") or "")
+
+
+def plan_seed() -> int:
+    env = os.environ.get(ENV_SEED)
+    if env is not None:
+        return int(env)
+    return int(var_registry.get("faultinject_seed") or 0)
+
+
+def active() -> bool:
+    return bool(plan_text())
+
+
+class _Action:
+    """One parsed plan entry."""
+
+    __slots__ = ("kind", "rank", "prob", "scope", "delay_ms", "at_step",
+                 "at_time", "vpid")
+
+    def __init__(self) -> None:
+        self.kind = ""            # kill | daemon_kill | drop | delay | dup
+        self.rank: Optional[int] = None   # None = every rank
+        self.vpid: Optional[int] = None
+        self.prob = 0.0
+        self.scope = "ft"         # ft | all
+        self.delay_ms = 0.0
+        self.at_step: Optional[int] = None
+        self.at_time: Optional[float] = None
+
+
+def _parse_entry(entry: str) -> _Action:
+    act = _Action()
+    for part in entry.split(":"):
+        part = part.strip()
+        if not part:
+            continue
+        key, _, val = part.partition("=")
+        key = key.strip()
+        val = val.strip()
+        if key == "rank":
+            act.rank = int(val)
+        elif key == "daemon":
+            act.vpid = int(val)
+        elif key == "kill" or key.startswith("kill@"):
+            act.kind = "daemon_kill" if act.vpid is not None else "kill"
+            # kill@step=N / kill@t=SEC arrive as key "kill@step"/"kill@t"
+            trig = key.partition("@")[2]
+            if trig == "step":
+                act.at_step = int(val)
+            elif trig == "t":
+                act.at_time = float(val)
+            else:
+                raise ValueError(
+                    f"kill needs a trigger: kill@step=N or kill@t=SEC "
+                    f"(got {part!r})")
+        elif key in ("drop", "dup"):
+            act.kind = key
+            prob, _, scope = val.partition("@")
+            act.prob = float(prob)
+            act.scope = scope or ("ft" if key == "drop" else "all")
+        elif key == "delay":
+            act.kind = "delay"
+            prob, _, rest = val.partition(",")
+            act.prob = float(prob)
+            ms, _, scope = rest.partition("@")
+            act.delay_ms = float(ms or 1.0)
+            act.scope = scope or "all"
+        else:
+            raise ValueError(f"unknown fault-plan token {part!r} "
+                             f"in entry {entry!r}")
+    if not act.kind:
+        raise ValueError(f"fault-plan entry {entry!r} names no action")
+    if act.scope not in ("ft", "all"):
+        raise ValueError(f"unknown fault scope {act.scope!r} (ft|all)")
+    return act
+
+
+def parse_plan(text: str) -> list[_Action]:
+    return [_parse_entry(e) for e in text.split(";") if e.strip()]
+
+
+#: header fields that identify a logical frame (+ attempt counters) —
+#: what the deterministic verdict hashes over
+_IDENT_KEYS = ("t", "tag", "cid", "seq", "ep", "op", "aseq", "n", "sid",
+               "rid", "off", "from")
+
+
+def _frame_ident(header: dict) -> str:
+    return ",".join(f"{k}={header[k]}" for k in _IDENT_KEYS if k in header)
+
+
+def _u01(seed: int, rank: int, peer: int, ident: str, salt: str) -> float:
+    """Deterministic uniform [0,1) per logical frame — a pure hash, so
+    the verdict is independent of thread timing and send path."""
+    key = f"{seed}:{rank}:{peer}:{ident}:{salt}".encode()
+    return (zlib.crc32(key) & 0xFFFFFFFF) / 4294967296.0
+
+
+class Injector:
+    """Per-rank chaos engine: frame verdicts + kill triggers + event log."""
+
+    def __init__(self, rank: int, actions: list[_Action], seed: int) -> None:
+        self.rank = rank
+        self.seed = seed
+        self._acts = [a for a in actions
+                      if a.rank is None or a.rank == rank]
+        self._frame_acts = [a for a in self._acts
+                            if a.kind in ("drop", "delay", "dup")]
+        # kills fire in a rank's FIRST life only: an errmgr-respawned
+        # incarnation re-arms the injector and would otherwise die again
+        # at the same step, looping until restarts exhaust
+        self._kills = ([] if os.environ.get("OMPI_TPU_RESTART")
+                       else [a for a in self._acts if a.kind == "kill"])
+        self._step = 0
+        self._lock = threading.Lock()
+        self.events: list[dict] = []
+        self._dead = False
+        for k in self._kills:
+            if k.at_time is not None:
+                t = threading.Timer(k.at_time, self._fire_kill,
+                                    args=("t", k.at_time))
+                t.daemon = True
+                t.start()
+
+    # -- kill triggers -----------------------------------------------------
+
+    def step(self) -> int:
+        """Advance the application step counter; fires any kill@step
+        scheduled for the new step.  Returns the step just entered."""
+        with self._lock:
+            s = self._step
+            self._step += 1
+        for k in self._kills:
+            if k.at_step == s:
+                self._fire_kill("step", s)
+        return s
+
+    def _fire_kill(self, trigger: str, value) -> None:
+        if self._dead:
+            return
+        self._dead = True
+        self._record("kill", trigger=trigger, value=value)
+        _log.emit("faultinject: rank %d injected kill (%s=%s)",
+                  self.rank, trigger, value)
+        _dump_events_now()
+        import sys
+
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(int(var_registry.get("faultinject_exit_code")))
+
+    # -- frame verdicts (BtlEndpoint hook) ---------------------------------
+
+    def on_frame(self, peer: int, header: dict) -> Any:
+        """Verdict for one outgoing frame: "send" | "drop" | "dup" |
+        ("delay", ms).  Called on the BTL send path; must stay cheap."""
+        if not self._frame_acts:
+            return "send"
+        is_ft = header.get("t") == "ft"
+        ident = None
+        for a in self._frame_acts:
+            if a.scope == "ft" and not is_ft:
+                continue
+            if ident is None:
+                ident = _frame_ident(header)
+            if _u01(self.seed, self.rank, peer, ident, a.kind) < a.prob:
+                # p rides along so a replay checker recomputes the
+                # verdict against the action's own threshold
+                self._record(a.kind, peer=peer, frame=ident, p=a.prob)
+                if a.kind == "delay":
+                    return ("delay", a.delay_ms)
+                return a.kind
+        return "send"
+
+    def _record(self, kind: str, **info) -> None:
+        ev = {"kind": kind, "rank": self.rank, **info}
+        with self._lock:
+            self.events.append(ev)
+        from ompi_tpu.mpi import trace as trace_mod
+
+        if trace_mod.active:
+            trace_mod.instant("faultinject", kind, rank=self.rank, **info)
+
+
+_lock = threading.Lock()
+_injectors: dict[int, Injector] = {}
+_parsed: Optional[list[_Action]] = None
+_dump_armed = False
+
+
+def injector_for(rank: int) -> Optional[Injector]:
+    """The rank's injector, or None when no plan is armed.  Safe to call
+    from transport constructors — parsing happens once per process."""
+    text = plan_text()
+    if not text:
+        return None
+    global _parsed, _dump_armed
+    with _lock:
+        inj = _injectors.get(rank)
+        if inj is not None:
+            return inj
+        if _parsed is None:
+            try:
+                _parsed = parse_plan(text)
+            except ValueError as e:
+                _log.error("faultinject: bad plan %r: %s (chaos disabled)",
+                           text, e)
+                _parsed = []
+        inj = Injector(rank, _parsed, plan_seed())
+        _injectors[rank] = inj
+        if not _dump_armed and os.environ.get(ENV_LOG_DIR):
+            _dump_armed = True
+            atexit.register(_dump_events_now)
+        return inj
+
+
+def step(rank: Optional[int] = None) -> None:
+    """Application step marker (soak apps call this once per iteration);
+    fires kill@step triggers.  With rank=None every installed injector
+    in this process advances (single-rank processes have exactly one)."""
+    with _lock:
+        injs = (list(_injectors.values()) if rank is None
+                else [i for i in (_injectors.get(rank),) if i is not None])
+    for inj in injs:
+        inj.step()
+
+
+def arm_daemon(vpid: int) -> None:
+    """orted side: a plan entry ``daemon=<vpid>:kill@t=<sec>`` arms a
+    self-SIGKILL — the injected silent host death."""
+    text = plan_text()
+    if not text:
+        return
+    try:
+        actions = parse_plan(text)
+    except ValueError:
+        return
+    for a in actions:
+        if a.kind == "daemon_kill" and a.vpid == vpid \
+                and a.at_time is not None:
+            import signal
+
+            def die() -> None:
+                _log.emit("faultinject: daemon %d injected SIGKILL", vpid)
+                os.kill(os.getpid(), signal.SIGKILL)
+
+            t = threading.Timer(a.at_time, die)
+            t.daemon = True
+            t.start()
+
+
+def events(rank: Optional[int] = None) -> list[dict]:
+    """Fired-fault log (for replay-determinism assertions)."""
+    with _lock:
+        if rank is not None:
+            inj = _injectors.get(rank)
+            return list(inj.events) if inj is not None else []
+        out: list[dict] = []
+        for inj in _injectors.values():
+            out.extend(inj.events)
+        return out
+
+
+def _dump_events_now() -> None:
+    """Write every injector's fired-event log to OMPI_TPU_FAULT_LOG_DIR
+    (one JSON per rank) — called at exit AND right before an injected
+    kill (atexit does not run under os._exit)."""
+    log_dir = os.environ.get(ENV_LOG_DIR)
+    if not log_dir:
+        return
+    with _lock:
+        injs = list(_injectors.values())
+    # a respawned incarnation gets its own file: overwriting the first
+    # life's log would erase exactly the kill event a replay check needs
+    life = int(os.environ.get("OMPI_TPU_RESTART") or 0)
+    suffix = f"_life{life}" if life else ""
+    for inj in injs:
+        path = os.path.join(log_dir,
+                            f"faults_rank{inj.rank}{suffix}.json")
+        try:
+            with open(path, "w") as fh:
+                json.dump({"rank": inj.rank, "seed": inj.seed,
+                           "plan": plan_text(), "events": inj.events,
+                           "ts": time.time()}, fh)
+        except OSError as e:
+            _log.error("faultinject: event dump to %s failed: %r", path, e)
+
+
+def reset() -> None:
+    """Drop all per-process injector state (tests re-arm with new plans)."""
+    global _parsed
+    with _lock:
+        _injectors.clear()
+        _parsed = None

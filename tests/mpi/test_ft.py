@@ -1,0 +1,243 @@
+"""ULFM fault tolerance (mpi/ft.py): revoke / shrink / agree /
+get_failed + the failure detector's fail-fast paths, exercised on the
+in-process harness (threads-as-ranks, real sockets/proc BTL)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ompi_tpu.mpi import ft
+from ompi_tpu.mpi.comm import Communicator
+from ompi_tpu.mpi.constants import (
+    ERR_PROC_FAILED, ERR_REVOKED, MPIException, error_string,
+)
+from ompi_tpu.mpi.group import Group
+from ompi_tpu.mpi.pml import PmlOb1
+
+
+def make_world(n):
+    pmls = [PmlOb1(r) for r in range(n)]
+    addrs = {r: p.address for r, p in enumerate(pmls)}
+    for p in pmls:
+        p.set_peers(addrs)
+    comms = [Communicator(Group(range(n)), cid=0, pml=pmls[r],
+                          my_world_rank=r, name=f"ftw{n}")
+             for r in range(n)]
+    return pmls, comms
+
+
+def run_on(ranks, fn, timeout=20.0):
+    out, errs = {}, {}
+
+    def runner(r):
+        try:
+            out[r] = fn(r)
+        except BaseException as e:  # noqa: BLE001
+            errs[r] = e
+
+    ts = [threading.Thread(target=runner, args=(r,), daemon=True)
+          for r in ranks]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout)
+    assert not any(t.is_alive() for t in ts), \
+        f"ranks hung (errors so far: {errs})"
+    if errs:
+        r, e = next(iter(errs.items()))
+        raise AssertionError(f"rank {r} failed: {e!r}") from e
+    return out
+
+
+def test_error_classes_have_strings():
+    assert "failed" in error_string(ERR_PROC_FAILED)
+    assert "revoked" in error_string(ERR_REVOKED)
+
+
+def test_agree_all_alive_is_and_of_flags():
+    pmls, comms = make_world(3)
+    try:
+        out = run_on(range(3), lambda r: comms[r].agree(r != 1))
+        assert out == {0: False, 1: False, 2: False}
+        out = run_on(range(3), lambda r: comms[r].agree(True))
+        assert out == {0: True, 1: True, 2: True}
+    finally:
+        for p in pmls:
+            p.close()
+
+
+def test_agree_and_shrink_exclude_dead_rank():
+    pmls, comms = make_world(4)
+    try:
+        for r in (0, 1, 2):
+            ft.pml_ft(pmls[r]).detector.mark_failed(3, "unit kill")
+        shrunk = run_on((0, 1, 2), lambda r: comms[r].shrink())
+        assert {c.cid for c in shrunk.values()} == \
+            {shrunk[0].cid}, "survivors derived different cids"
+        assert all(c.size == 3 for c in shrunk.values())
+        # the survivor communicator is fully functional
+        out = run_on((0, 1, 2),
+                     lambda r: float(shrunk[r].allreduce(
+                         np.array([float(r)]))[0]))
+        assert set(out.values()) == {3.0}
+    finally:
+        for p in pmls:
+            p.close()
+
+
+def test_agree_survives_coordinator_death():
+    """Rank 0 (the would-be coordinator) is dead: the next live rank
+    takes over and the survivors still converge."""
+    pmls, comms = make_world(3)
+    try:
+        for r in (1, 2):
+            ft.pml_ft(pmls[r]).detector.mark_failed(0, "unit kill")
+        out = run_on((1, 2), lambda r: comms[r].agree(True))
+        assert out == {1: True, 2: True}
+    finally:
+        for p in pmls:
+            p.close()
+
+
+def test_send_to_dead_peer_fails_fast():
+    pmls, comms = make_world(2)
+    try:
+        ft.pml_ft(pmls[0]).detector.mark_failed(1, "unit kill")
+        t0 = time.monotonic()
+        with pytest.raises(MPIException) as ei:
+            comms[0].send(np.array([1.0]), dest=1)
+        assert ei.value.error_class == ERR_PROC_FAILED
+        # the whole point: nowhere near the 30 s pml_retry_window
+        assert time.monotonic() - t0 < 2.0
+    finally:
+        for p in pmls:
+            p.close()
+
+
+def test_posted_recv_fails_when_peer_declared_dead():
+    pmls, comms = make_world(2)
+    try:
+        ft.pml_ft(pmls[0])   # install the sidecar so recvs are tracked
+        req = comms[0].irecv(source=1, tag=5)
+        ft.pml_ft(pmls[0]).detector.mark_failed(1, "unit kill")
+        with pytest.raises(MPIException) as ei:
+            req.wait(timeout=5.0)
+        assert ei.value.error_class == ERR_PROC_FAILED
+        # and a recv posted AFTER the death fails too
+        with pytest.raises(MPIException) as ei:
+            comms[0].recv(source=1, tag=6)
+        assert ei.value.error_class == ERR_PROC_FAILED
+    finally:
+        for p in pmls:
+            p.close()
+
+
+def test_revoke_poisons_all_members_and_unblocks_recvs():
+    pmls, comms = make_world(3)
+    try:
+        ft.pml_ft(pmls[1])   # rank 1 tracks its posted recvs
+        blocked = comms[1].irecv(source=2, tag=9)  # never matched
+        comms[0].revoke()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not all(
+                comms[r].is_revoked() for r in range(3)):
+            time.sleep(0.01)
+        assert all(comms[r].is_revoked() for r in range(3))
+        with pytest.raises(MPIException) as ei:
+            blocked.wait(timeout=5.0)
+        assert ei.value.error_class == ERR_REVOKED
+        for r in range(3):
+            with pytest.raises(MPIException) as ei:
+                comms[r].send(np.array([1.0]), dest=(r + 1) % 3)
+            assert ei.value.error_class == ERR_REVOKED
+            with pytest.raises(MPIException):
+                comms[r].irecv(source=(r + 1) % 3)
+        # agree still works on the revoked communicator (ULFM contract)
+        out = run_on(range(3), lambda r: comms[r].agree(True))
+        assert set(out.values()) == {True}
+    finally:
+        for p in pmls:
+            p.close()
+
+
+def test_revoke_does_not_leak_into_other_comms():
+    pmls, comms = make_world(2)
+    try:
+        dups = run_on(range(2), lambda r: comms[r].dup())
+        comms[0].revoke()
+        time.sleep(0.2)
+        # the dup has its own cid: traffic on it still flows
+        out = run_on(range(2), lambda r: (
+            dups[r].send(np.array([float(r)]), dest=1 - r),
+            float(dups[r].recv(source=1 - r)[0]))[1])
+        assert out == {0: 1.0, 1: 0.0}
+    finally:
+        for p in pmls:
+            p.close()
+
+
+def test_get_failed_and_ack_failed():
+    pmls, comms = make_world(3)
+    try:
+        assert comms[0].get_failed().ranks == ()
+        assert comms[0].ack_failed() == 0
+        ft.pml_ft(pmls[0]).detector.mark_failed(2, "unit kill")
+        assert comms[0].get_failed().ranks == (2,)
+        assert comms[0].ack_failed() == 1
+    finally:
+        for p in pmls:
+            p.close()
+
+
+def test_agree_consistent_under_injected_ft_drops():
+    """The acceptance scenario: shrink + agree converge to identical
+    results on every survivor while the fault injector drops 25% of the
+    FT control frames (the protocols' retransmission absorbs it)."""
+    from ompi_tpu.core.config import var_registry
+    from ompi_tpu.testing import faultinject
+
+    faultinject.reset()
+    var_registry.set("faultinject_plan", "drop=0.25")
+    var_registry.set("faultinject_seed", 3)
+    try:
+        pmls, comms = make_world(4)
+        try:
+            assert all(p.endpoint._fault is not None for p in pmls)
+            for r in (0, 2, 3):
+                ft.pml_ft(pmls[r]).detector.mark_failed(1, "injected")
+            shrunk = run_on((0, 2, 3), lambda r: comms[r].shrink(),
+                            timeout=30.0)
+            assert len({c.cid for c in shrunk.values()}) == 1
+            out = run_on((0, 2, 3), lambda r: shrunk[r].agree(True),
+                         timeout=30.0)
+            assert set(out.values()) == {True}
+            drops = [e for e in faultinject.events()
+                     if e["kind"] == "drop"]
+            assert drops, "plan armed but no drops fired"
+        finally:
+            for p in pmls:
+                p.close()
+    finally:
+        var_registry.set("faultinject_plan", "")
+        faultinject.reset()
+
+
+def test_shrink_twice_handles_sequential_failures():
+    pmls, comms = make_world(4)
+    try:
+        for r in (0, 1, 2):
+            ft.pml_ft(pmls[r]).detector.mark_failed(3, "kill 1")
+        first = run_on((0, 1, 2), lambda r: comms[r].shrink())
+        for r in (0, 1):
+            ft.pml_ft(pmls[r]).detector.mark_failed(2, "kill 2")
+        second = run_on((0, 1), lambda r: first[r].shrink())
+        assert all(c.size == 2 for c in second.values())
+        assert len({c.cid for c in second.values()}) == 1
+        out = run_on((0, 1), lambda r: float(second[r].allreduce(
+            np.array([1.0]))[0]))
+        assert set(out.values()) == {2.0}
+    finally:
+        for p in pmls:
+            p.close()

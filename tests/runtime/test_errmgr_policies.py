@@ -1,0 +1,267 @@
+"""errmgr policy coverage: the `continue` policy (previously zero direct
+tests), the new `notify` policy, notifier emission on respawn, and the
+RML heartbeat layer."""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from ompi_tpu.core.config import var_registry
+from ompi_tpu.runtime import notifier as notifier_mod
+from ompi_tpu.runtime.errmgr import (
+    ErrmgrContinue, ErrmgrNotify, ErrmgrRespawn,
+)
+from ompi_tpu.runtime.job import AppContext, Job, Proc, ProcState
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def tpurun(*args, timeout=120, env_extra=None):
+    env = dict(os.environ)
+    env.pop("OMPI_TPU_RANK", None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    if env_extra:
+        env.update(env_extra)
+    return subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.tpurun", *args],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO)
+
+
+class _FakeLauncher:
+    """Just enough launcher surface for unit-driving a policy."""
+
+    def __init__(self):
+        self.killed = False
+        self.respawned = []
+        self.server = None
+        self.rml = None
+
+    def kill_job(self, job, exclude=None):
+        self.killed = True
+
+    def respawn_proc(self, job, proc):
+        self.respawned.append(proc.rank)
+        return True
+
+
+class _RecordingNotifier:
+    NAME = "recorder"
+    PRIORITY = 100
+
+    def __init__(self):
+        self.events = []
+
+    def query(self, **ctx):
+        return self.PRIORITY
+
+    def notify(self, severity, event, detail):
+        self.events.append((severity, event, detail))
+
+
+@pytest.fixture
+def recorder(monkeypatch):
+    rec = _RecordingNotifier()
+    monkeypatch.setattr(notifier_mod.notifier_framework, "select",
+                        lambda **ctx: rec)
+    return rec
+
+
+def _failed_proc(job, rank=1, rc=9):
+    proc = job.procs[rank] if job.procs else Proc(rank=rank)
+    proc.state = ProcState.ABORTED
+    proc.exit_code = rc
+    return proc
+
+
+def _job(np_=3):
+    job = Job([AppContext(argv=["true"], np=np_)])
+    job.procs = [Proc(rank=r) for r in range(np_)]
+    return job
+
+
+# -- continue: direct coverage --------------------------------------------
+
+def test_continue_policy_neither_kills_nor_aborts():
+    launcher, job = _FakeLauncher(), _job()
+    proc = _failed_proc(job)
+    ErrmgrContinue().proc_failed(launcher, job, proc)
+    assert not launcher.killed
+    assert job.aborted_proc is None          # job exit stays 0
+
+
+def test_continue_job_reaps_dead_rank_without_killing_survivors():
+    prog = ("import os, sys, ompi_tpu\n"
+            "comm = ompi_tpu.init()\n"
+            "if comm.rank == 1:\n"
+            "    os._exit(5)\n"
+            "print(f'rank {comm.rank} survived', flush=True)\n"
+            "ompi_tpu.finalize()\n")
+    r = tpurun("-np", "3", "--mca", "errmgr", "continue", "--",
+               sys.executable, "-c", prog)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "rank 0 survived" in r.stdout
+    assert "rank 2 survived" in r.stdout
+    assert "rank 1 survived" not in r.stdout
+
+
+# -- notify ----------------------------------------------------------------
+
+def test_notify_policy_propagates_without_killing(recorder):
+    launcher, job = _FakeLauncher(), _job()
+
+    class _Server:
+        def __init__(self):
+            self.died = []
+
+        def proc_died(self, rank, reason=""):
+            self.died.append((rank, reason))
+
+    launcher.server = _Server()
+    proc = _failed_proc(job)
+    ErrmgrNotify().proc_failed(launcher, job, proc)
+    assert not launcher.killed
+    assert job.aborted_proc is None
+    assert launcher.server.died and launcher.server.died[0][0] == 1
+    assert "exit code 9" in launcher.server.died[0][1]
+    assert any(ev == "rank-failed" for _s, ev, _d in recorder.events)
+
+
+def test_notify_surfaces_err_proc_failed_to_survivors():
+    """Under notify, a survivor's send to the dead rank raises
+    MPI_ERR_PROC_FAILED quickly (control-plane detector), instead of
+    stalling for the full 30 s pml_retry_window."""
+    prog = (
+        "import os, time, numpy as np, ompi_tpu\n"
+        "from ompi_tpu.mpi.constants import MPIException, ERR_PROC_FAILED\n"
+        "comm = ompi_tpu.init()\n"
+        "if comm.rank == 1:\n"
+        "    os._exit(7)\n"
+        "time.sleep(1.0)\n"   # give the launcher time to reap rank 1
+        "t0 = time.monotonic()\n"
+        "try:\n"
+        "    comm.send(np.array([1.0]), dest=1)\n"
+        "    print('send unexpectedly succeeded', flush=True)\n"
+        "except MPIException as e:\n"
+        "    took = time.monotonic() - t0\n"
+        "    ok = e.error_class == ERR_PROC_FAILED and took < 10.0\n"
+        "    print(f'failfast ok={ok} took={took:.1f}', flush=True)\n"
+        "ompi_tpu.finalize()\n")
+    r = tpurun("-np", "2", "--mca", "errmgr", "notify", "--",
+               sys.executable, "-c", prog)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "failfast ok=True" in r.stdout, (r.stdout, r.stderr)
+
+
+# -- respawn notifier emission ---------------------------------------------
+
+def test_respawn_emits_notifier_event(recorder):
+    launcher, job = _FakeLauncher(), _job()
+    proc = _failed_proc(job)
+    ErrmgrRespawn().proc_failed(launcher, job, proc)
+    assert launcher.respawned == [1]
+    assert not launcher.killed
+    events = [ev for _s, ev, _d in recorder.events]
+    assert "rank-respawn" in events
+    sev, _ev, detail = recorder.events[events.index("rank-respawn")]
+    assert sev >= notifier_mod.Severity.WARN
+    assert "rank 1" in detail
+
+
+def test_respawn_exhaustion_aborts_and_notifies(recorder):
+    launcher, job = _FakeLauncher(), _job()
+    proc = _failed_proc(job)
+    proc.restarts = var_registry.get("errmgr_max_restarts")
+    ErrmgrRespawn().proc_failed(launcher, job, proc)
+    assert launcher.killed
+    assert job.aborted_proc is proc
+
+
+def test_notify_daemon_death_fails_its_ranks_job_continues(tmp_path):
+    """Sim daemon tree under notify: an injected daemon SIGKILL (the
+    silent host death) turns into per-rank proc-failure events; the
+    other host's ranks finish and the job exits 0."""
+    # the kill fires well after init's final barrier (a daemon death
+    # mid-init kills the barrier partners too — a different scenario)
+    prog = ("import time, ompi_tpu\n"
+            "comm = ompi_tpu.init()\n"
+            "time.sleep(14.0)\n"
+            "print(f'rank {comm.rank} survived', flush=True)\n"
+            "ompi_tpu.finalize()\n")
+    r = tpurun("-np", "4", "--plm", "sim", "--hosts", "2",
+               "--mca", "errmgr", "notify",
+               "--mca", "multihost_auto_init", "0",
+               "--mca", "rml_heartbeat_period", "0.2",
+               "--mca", "rml_heartbeat_timeout", "2.0",
+               "--mca", "faultinject_plan", "daemon=2:kill@t=6.0", "--",
+               sys.executable, "-c", prog, timeout=180)
+    out = r.stdout + r.stderr
+    assert r.returncode == 0, out[-3000:]
+    assert "rank-failed" in out, out[-3000:]
+    # clean outcome: daemon vpid 2 owned half the ranks and the other
+    # host's ranks finish.  On a loaded machine the t=6 kill can land
+    # while ranks are still inside init's barrier — then the survivors
+    # error out with a propagated MPI_ERR_PROC_FAILED instead, which is
+    # also a defined (non-hanging, exit-0-continuing) notify state.
+    assert "survived" in out or "has failed" in out, out[-3000:]
+
+
+# -- heartbeat layer -------------------------------------------------------
+
+def test_heartbeat_monitor_declares_silent_vpid(monkeypatch):
+    from ompi_tpu.runtime.rml import HeartbeatMonitor
+
+    var_registry.set("rml_heartbeat_period", 0.05)
+    var_registry.set("rml_heartbeat_timeout", 0.25)
+    try:
+        silent = []
+        mon = HeartbeatMonitor(silent.append)
+        mon.watch(1)
+        mon.watch(2)
+        mon.start()
+        # keep vpid 2 alive; let vpid 1 go silent
+        deadline = time.monotonic() + 1.5
+        while time.monotonic() < deadline and not silent:
+            mon.beat(2)
+            time.sleep(0.05)
+        mon.stop()
+        assert silent == [1]
+    finally:
+        var_registry.set("rml_heartbeat_period", 0.0)
+        var_registry.set("rml_heartbeat_timeout", 3.0)
+
+
+def test_daemon_heartbeats_ride_the_tree():
+    from ompi_tpu.runtime import rml
+
+    var_registry.set("rml_heartbeat_period", 0.05)
+    try:
+        hnp, daemon = rml.RmlNode(0), rml.RmlNode(1)
+        got = threading.Event()
+        hnp.register_recv(rml.TAG_HEARTBEAT,
+                          lambda origin, vpid: got.set())
+        try:
+            hnp.dial_children([(1, daemon.uri)])
+            assert daemon.wait_parent(5.0)
+            stop = threading.Event()
+            rml.start_heartbeats(daemon, stop)
+            assert got.wait(5.0), "no heartbeat reached the HNP"
+            stop.set()
+        finally:
+            daemon.close()
+            hnp.close()
+    finally:
+        var_registry.set("rml_heartbeat_period", 0.0)
+
+
+def test_plm_teardown_timeouts_are_registered_vars():
+    import ompi_tpu.mpi.pml      # noqa: F401 — registration on import
+    import ompi_tpu.runtime.plm  # noqa: F401
+
+    assert var_registry.get("plm_exit_report_timeout") == 3.0
+    assert var_registry.get("plm_daemon_drain_timeout") == 5.0
+    assert var_registry.get("pml_heal_max_interval") == 1.0

@@ -1,0 +1,315 @@
+"""chaos_soak — seeded fault plans vs. every recovery policy we ship.
+
+Generates K fault plans from one seed, runs each against the policy it
+targets, and asserts the job lands in that policy's *defined* state:
+
+- ``respawn``       — a rank is killed mid-ring; errmgr revives it, it
+  restores from its ckpt snapshot, the job exits 0 with the exact accs.
+- ``notify-shrink`` — a rank is killed mid-allreduce under ``--mca
+  errmgr notify`` (optionally with seeded FT-frame drops); survivors
+  revoke + agree + shrink + resume, every survivor prints the SAME,
+  recomputable final acc, exit 0.
+- ``continue``      — a rank is killed under ``--mca errmgr continue``;
+  survivors (whose work never depended on it) finish, exit 0.
+- ``abort``         — the default policy: the kill tears the whole job
+  down; exit is nonzero and the abort help text names the dead rank.
+
+No run may hang (every subprocess has a hard timeout — a timeout is a
+soak failure), and no run may print a wrong answer (expected values are
+recomputed by this driver from the plan, never trusted from the app).
+
+Replay determinism: each plan's first run is replayed with the same seed
+and the fault logs are compared — injected kills must reproduce exactly
+(same rank, same trigger step), and every frame verdict in both logs
+must recompute to the same decision through the injector's pure hash
+(``faultinject._u01``), which is the property that makes a plan a
+*schedule* rather than a dice roll.
+
+    python tools/chaos_soak.py --plans 20 --seed 0
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from ompi_tpu.testing import faultinject  # noqa: E402
+
+POLICIES = ("respawn", "notify-shrink", "continue", "abort")
+
+RING_APP = r"""
+import os
+import numpy as np
+import ompi_tpu
+from ompi_tpu.ckpt.msglog import MessageLog
+from ompi_tpu.ckpt.store import SnapshotStore
+from ompi_tpu.testing import faultinject
+
+comm = ompi_tpu.init()
+rank, size = comm.rank, comm.size
+store = SnapshotStore(os.environ["CKPT_DIR"], job=f"rank{rank}")
+restarted = int(os.environ.get("OMPI_TPU_RESTART", "0"))
+log = MessageLog(comm).attach(auto_replay=True)
+
+start, acc = 0, 0.0
+if restarted:
+    seq = store.latest()
+    if seq is not None:
+        state = store.load_rank(seq, 0)
+        start, acc = int(state["step"]) + 1, float(state["acc"])
+    print(f"rank {rank} resumed at step {start}", flush=True)
+
+right, left = (rank + 1) % size, (rank - 1) % size
+steps = int(os.environ["SOAK_STEPS"])
+for step in range(start, steps):
+    faultinject.step()
+    out = np.array([float(rank * 100 + step)])
+    sreq = comm.isend(out, dest=right, tag=step)
+    got = comm.recv(source=left, tag=step)
+    sreq.wait()
+    assert float(got[0]) == left * 100 + step, (step, got)
+    acc += float(got[0])
+    store.write_rank(step, 0, {"step": np.int64(step),
+                               "acc": np.float64(acc)})
+    store.commit(step, 1)
+
+print(f"rank {rank} ring done acc={acc:.0f}", flush=True)
+ompi_tpu.finalize()
+"""
+
+LOCAL_APP = r"""
+import numpy as np
+import ompi_tpu
+from ompi_tpu.testing import faultinject
+
+comm = ompi_tpu.init()
+rank = comm.rank
+import os
+steps = int(os.environ["SOAK_STEPS"])
+acc = 0.0
+for step in range(steps):
+    faultinject.step()
+    acc += float(rank * 10 + step)
+print(f"rank {rank} local done acc={acc:.0f}", flush=True)
+ompi_tpu.finalize()
+"""
+
+
+def tpurun(args, env_extra=None, timeout=150):
+    env = dict(os.environ)
+    env.pop("OMPI_TPU_RANK", None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    if env_extra:
+        env.update(env_extra)
+    return subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.tpurun", *args],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO)
+
+
+def gen_plan(seed: int, idx: int, np_: int, steps: int) -> dict:
+    """Plan idx of the soak: policy + victim + kill step + drop rate,
+    all drawn from the seeded stream."""
+    rng = random.Random(f"{seed}:{idx}")  # str seed: tuples raise on 3.11+
+    policy = POLICIES[idx % len(POLICIES)]
+    victim = rng.randrange(0, np_) if policy == "notify-shrink" \
+        else rng.randrange(1, np_)
+    kill_step = rng.randrange(1, steps - 1)
+    drop = rng.choice((0.0, 0.05, 0.15)) if policy == "notify-shrink" \
+        else 0.0
+    plan = f"rank={victim}:kill@step={kill_step}"
+    if drop:
+        plan += f";drop={drop}"
+    return {"idx": idx, "policy": policy, "victim": victim,
+            "kill_step": kill_step, "drop": drop, "plan": plan,
+            "seed": seed}
+
+
+def expected_shrink_acc(np_: int, steps: int, victim: int,
+                        kill_step: int) -> float:
+    """The acc every shrink_allreduce survivor must print: full-world
+    sums for agreed steps before the kill, survivor sums from it on."""
+    acc = 0.0
+    for s in range(steps):
+        ids = range(np_) if s < kill_step else \
+            [i for i in range(np_) if i != victim]
+        acc += sum(i * 10 + s for i in ids)
+    return acc
+
+
+def run_plan(plan: dict, np_: int, steps: int, log_dir: str,
+             verbose: bool) -> None:
+    policy = plan["policy"]
+    ck = tempfile.mkdtemp(prefix=f"chaos_ck_{plan['idx']}_")
+    env = {"CKPT_DIR": ck, "SOAK_STEPS": str(steps),
+           "SHRINK_DEMO_STEPS": str(steps),
+           "OMPI_TPU_FAULT_LOG_DIR": log_dir}
+    mca = ["--mca", "faultinject_plan", plan["plan"],
+           "--mca", "faultinject_seed", str(plan["seed"])]
+    if policy == "respawn":
+        r = tpurun(["-np", str(np_), "--mca", "errmgr", "respawn", *mca,
+                    "--", sys.executable, "-c", RING_APP], env)
+        out = r.stdout + r.stderr
+        assert r.returncode == 0, f"respawn rc={r.returncode}: {out[-2000:]}"
+        assert f"rank {plan['victim']} resumed at step" in out, out[-2000:]
+        for rank in range(np_):
+            acc = sum(((rank - 1) % np_) * 100 + s for s in range(steps))
+            assert f"rank {rank} ring done acc={acc:.0f}" in out, \
+                (rank, acc, out[-2000:])
+    elif policy == "notify-shrink":
+        r = tpurun(["-np", str(np_), "--mca", "errmgr", "notify", *mca,
+                    "--", sys.executable,
+                    os.path.join(REPO, "examples", "shrink_allreduce.py")],
+                   env)
+        out = r.stdout + r.stderr
+        assert r.returncode == 0, f"shrink rc={r.returncode}: {out[-2000:]}"
+        want = expected_shrink_acc(np_, steps, plan["victim"],
+                                   plan["kill_step"])
+        survivors = [i for i in range(np_) if i != plan["victim"]]
+        for rank in survivors:
+            line = (f"id {rank} final acc={want:.0f} "
+                    f"size={len(survivors)} shrinks=1")
+            assert line in out, (line, out[-2000:])
+    elif policy == "continue":
+        r = tpurun(["-np", str(np_), "--mca", "errmgr", "continue", *mca,
+                    "--", sys.executable, "-c", LOCAL_APP], env)
+        out = r.stdout + r.stderr
+        assert r.returncode == 0, f"continue rc={r.returncode}: {out[-2000:]}"
+        for rank in range(np_):
+            if rank == plan["victim"]:
+                continue
+            acc = sum(rank * 10 + s for s in range(steps))
+            assert f"rank {rank} local done acc={acc:.0f}" in out, \
+                (rank, out[-2000:])
+    elif policy == "abort":
+        r = tpurun(["-np", str(np_), *mca,
+                    "--", sys.executable, "-c", LOCAL_APP], env)
+        out = r.stdout + r.stderr
+        assert r.returncode != 0, \
+            f"abort policy exited 0 despite a kill: {out[-2000:]}"
+        assert "aborted" in out.lower(), out[-2000:]
+    if verbose:
+        print(f"  plan {plan['idx']:>2} [{plan['policy']}] "
+              f"{plan['plan']!r}: ok")
+
+
+def read_fault_logs(log_dir: str) -> dict[int, dict]:
+    """Per-rank fault logs, events merged across incarnations (a
+    respawned rank dumps faults_rank<r>_life<n>.json per life)."""
+    logs: dict[int, dict] = {}
+    for name in sorted(os.listdir(log_dir)):
+        if name.startswith("faults_rank") and name.endswith(".json"):
+            with open(os.path.join(log_dir, name)) as fh:
+                data = json.load(fh)
+            prev = logs.get(data["rank"])
+            if prev is None:
+                logs[data["rank"]] = data
+            else:
+                prev["events"] = prev["events"] + data["events"]
+    return logs
+
+
+def check_replay(plan: dict, first: dict[int, dict],
+                 second: dict[int, dict]) -> None:
+    """Replay determinism, asserted on the parts a threaded run can
+    guarantee:
+
+    - the injected KILL schedule (rank, trigger, step) must reproduce
+      exactly — this is the plan's event sequence;
+    - frame-fault verdicts are a pure hash of (seed, rank, peer, frame
+      identity), so any identity that fired in BOTH runs must have fired
+      with the SAME kind (an impure/timing-dependent verdict function
+      would diverge here);
+    - every logged verdict must recompute through the injector's hash at
+      the acting rank's stream position.
+
+    Full set-equality of frame events is deliberately NOT asserted:
+    WHICH identities get attempted depends on retransmission timing (a
+    decision frame racing a resend timer), even though each identity's
+    verdict does not.
+    """
+    kills_a = sorted((r, e["trigger"], e["value"])
+                     for r, d in first.items() for e in d["events"]
+                     if e["kind"] == "kill")
+    kills_b = sorted((r, e["trigger"], e["value"])
+                     for r, d in second.items() for e in d["events"]
+                     if e["kind"] == "kill")
+    assert kills_a == kills_b, \
+        f"plan {plan['idx']}: kill schedule diverged: {kills_a} vs {kills_b}"
+
+    def frame_faults(logs):
+        return {(r, e["peer"], e["frame"]): e["kind"]
+                for r, d in logs.items() for e in d["events"]
+                if e["kind"] in ("drop", "dup", "delay")}
+
+    fa, fb = frame_faults(first), frame_faults(second)
+    for key in fa.keys() & fb.keys():
+        assert fa[key] == fb[key], \
+            (f"plan {plan['idx']}: frame {key} fired as {fa[key]!r} in "
+             f"one run and {fb[key]!r} in the replay — verdicts are not "
+             f"a pure function of the frame identity")
+    for logs in (first, second):
+        for r, d in logs.items():
+            for e in d["events"]:
+                if e["kind"] not in ("drop", "dup", "delay"):
+                    continue
+                u = faultinject._u01(plan["seed"], r, e["peer"],
+                                     e["frame"], e["kind"])
+                p = e.get("p", plan["drop"])
+                assert u < p, \
+                    (f"plan {plan['idx']}: logged {e['kind']} on "
+                     f"{e['frame']!r} does not recompute "
+                     f"(u={u:.3f} >= p={p})")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--plans", type=int, default=20)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--np", type=int, default=4, dest="np_")
+    ap.add_argument("--steps", type=int, default=6)
+    ap.add_argument("--replay-every", type=int, default=3,
+                    help="replay every Nth plan to assert determinism "
+                         "(0 = no replays; default 3 is co-prime with "
+                         "the 4-policy rotation so every policy — "
+                         "including the drop-carrying notify-shrink "
+                         "plans — gets replayed)")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    failures = []
+    for i in range(args.plans):
+        plan = gen_plan(args.seed, i, args.np_, args.steps)
+        log_a = tempfile.mkdtemp(prefix=f"chaos_log_{i}a_")
+        try:
+            run_plan(plan, args.np_, args.steps, log_a, args.verbose)
+            if args.replay_every and i % args.replay_every == 0:
+                log_b = tempfile.mkdtemp(prefix=f"chaos_log_{i}b_")
+                run_plan(plan, args.np_, args.steps, log_b, False)
+                check_replay(plan, read_fault_logs(log_a),
+                             read_fault_logs(log_b))
+                if args.verbose:
+                    print(f"  plan {i:>2} replay: deterministic")
+        except (AssertionError, subprocess.TimeoutExpired) as e:
+            failures.append((plan, e))
+            print(f"FAIL plan {i} [{plan['policy']}] {plan['plan']!r}: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+    total = args.plans
+    if failures:
+        print(f"chaos_soak: {len(failures)}/{total} plans FAILED",
+              file=sys.stderr)
+        return 1
+    print(f"chaos_soak: {total}/{total} plans ok "
+          f"(seed {args.seed}, np {args.np_}, {args.steps} steps)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
