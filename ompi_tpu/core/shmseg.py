@@ -22,7 +22,8 @@ import struct
 import tempfile
 from typing import Optional
 
-__all__ = ["SharedSegment", "create", "attach", "backing_dir"]
+__all__ = ["SharedSegment", "create", "attach", "attach_retry",
+           "backing_dir"]
 
 _MAGIC = 0x53454731            # "SEG1"
 _HDR = 16                      # magic u32 | pad u32 | size u64
@@ -108,6 +109,24 @@ def create(name: str, size: int, dir: Optional[str] = None,
     else:
         seg._tmp = tmp
     return seg
+
+
+def attach_retry(path: str, timeout: float = 5.0,
+                 interval: float = 0.001) -> SharedSegment:
+    """Attach, waiting out the creator's publish window: a consumer that
+    learned ``path`` out-of-band (a business card, a bootstrap bcast)
+    may look before the atomic rename lands.  Bounded poll, then the
+    last OSError propagates."""
+    import time
+
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            return attach(path)
+        except OSError:
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(interval)
 
 
 def attach(path: str) -> SharedSegment:

@@ -12,6 +12,10 @@ Components here:
   (≈ coll/self).
 - ``host``  — the full algorithm library over host p2p with a tuned-style
   decision layer (≈ coll/base + coll/tuned).
+- ``shm``   — single-copy on-node barrier/bcast/reduce/allreduce/allgather
+  through a per-communicator shared-memory arena, hierarchical
+  (intra-node arena + inter-node host) on mixed-host communicators
+  (≈ coll/sm + the HiCCL decomposition).
 - ``xla``   — the device path (≈ the coll/cuda slot, inverted): collectives
   on jax arrays lower to lax.psum/all_gather/all_to_all/ppermute over the
   communicator's bound DeviceCommunicator — zero host copies.
@@ -116,6 +120,7 @@ def install(comm: "Communicator") -> None:
     # import registers the components
     from ompi_tpu.mpi.coll import host as _host  # noqa: F401
     from ompi_tpu.mpi.coll import selfcoll as _selfcoll  # noqa: F401
+    from ompi_tpu.mpi.coll import shm as _shm  # noqa: F401
     from ompi_tpu.mpi.coll import xla as _xla  # noqa: F401
 
     module = CollModule()

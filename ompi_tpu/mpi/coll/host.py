@@ -11,6 +11,7 @@ coll_tuned_*_algorithm MCA params) or a dynamic rules file
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import numpy as np
@@ -33,6 +34,27 @@ class HostCollBase(Component):
 
     ALGORITHMS: dict[str, tuple[str, ...]] = {}
 
+    def _load_rules(self, path: str) -> rules.RuleSet:
+        """The dynamic-rules RuleSet, parsed once per (path, mtime):
+        repeated collectives pay one stat + dict hit, never a re-parse
+        (``_decide`` runs on EVERY collective invocation when
+        ``coll_host_dynamic_rules`` is set).  The hit path is lock-free;
+        a miss takes a lock so concurrent in-process ranks touching a
+        fresh file parse it exactly once."""
+        cache = self.__dict__.setdefault("_rules_cache", {})
+        mtime = os.stat(path).st_mtime
+        hit = cache.get(path)
+        if hit is not None and hit[0] == mtime:
+            return hit[1]
+        import threading
+
+        lock = self.__dict__.setdefault("_rules_lock", threading.Lock())
+        with lock:
+            hit = cache.get(path)
+            if hit is None or hit[0] != mtime:
+                cache[path] = (mtime, rules.load_rules(path))
+            return cache[path][1]
+
     def _decide(self, coll: str, comm, nbytes: int) -> Optional[str]:
         """forced config var > dynamic rules file > None (fixed decision)."""
         alg = var_registry.get(f"coll_host_{coll}_algorithm")
@@ -42,7 +64,7 @@ class HostCollBase(Component):
             if not path:
                 self._trace_decision(coll, comm, nbytes, None, "fixed")
                 return None
-            alg = rules.load_rules(path).lookup(coll, comm.size, nbytes)
+            alg = self._load_rules(path).lookup(coll, comm.size, nbytes)
             src = f"rules file {path}"
             if alg is None:
                 self._trace_decision(coll, comm, nbytes, None, "fixed")
@@ -93,6 +115,9 @@ class HostColl(HostCollBase):
                      1 << 20,
                      "allreduce: above this pipeline the ring in 1MB "
                      "segments (tuned's segmented-ring crossover)")
+        register_var("coll", "host_bcast_segment", VarType.SIZE, 128 * 1024,
+                     "bcast: pipeline segment size for the chain "
+                     "algorithm (tuned's coll_tuned_bcast_segmentsize)")
         register_var("coll", "host_allgather_small", VarType.SIZE, 64 * 1024,
                      "allgather: below this use bruck, above ring")
         register_var("coll", "host_alltoall_small", VarType.SIZE, 4 * 1024,
@@ -122,7 +147,9 @@ class HostColl(HostCollBase):
         # globally-visible config: forced var or a rules entry at msg size 0
         alg = self._decide("bcast", comm, 0)
         if alg == "pipeline":
-            return base.bcast_pipeline(comm, buf, root)
+            return base.bcast_pipeline(
+                comm, buf, root,
+                segsize=var_registry.get("coll_host_bcast_segment"))
         if alg == "linear":
             return base.bcast_linear(comm, buf, root)
         return base.bcast_binomial(comm, buf, root)
@@ -132,6 +159,7 @@ class HostColl(HostCollBase):
 
     def coll_allreduce(self, comm, sendbuf, op: Op):
         nbytes = _nbytes(sendbuf)
+        segsize = var_registry.get("coll_host_allreduce_segment")
         alg = self._decide("allreduce", comm, nbytes)
         if alg:
             fn = {"recursive_doubling": base.allreduce_recursive_doubling,
@@ -140,13 +168,18 @@ class HostColl(HostCollBase):
                   "linear": base.allreduce_linear}[alg]
             if not op.commutative and fn is not base.allreduce_linear:
                 fn = base.allreduce_recursive_doubling
+            if fn is base.allreduce_segmented_ring:
+                return fn(comm, sendbuf, op, segsize=segsize)
             return fn(comm, sendbuf, op)
         # tuned fixed decision (coll_tuned_decision_fixed.c:65-87)
         if (nbytes < var_registry.get("coll_host_allreduce_small")
                 or not op.commutative):
             return base.allreduce_recursive_doubling(comm, sendbuf, op)
-        if nbytes >= var_registry.get("coll_host_allreduce_segment"):
-            return base.allreduce_segmented_ring(comm, sendbuf, op)
+        if nbytes >= segsize:
+            # the registered crossover var IS the segment size (the two
+            # were decoupled before: the var gated, 1MB rode hard-coded)
+            return base.allreduce_segmented_ring(comm, sendbuf, op,
+                                                 segsize=segsize)
         return base.allreduce_ring(comm, sendbuf, op)
 
     def coll_gather(self, comm, sendbuf, root: int):

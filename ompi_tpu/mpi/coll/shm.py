@@ -1,0 +1,873 @@
+"""coll/shm — single-copy on-node collectives over a shared-memory arena.
+
+≈ ompi/mca/coll/sm (and the HiCCL intra/inter decomposition from
+PAPERS.md): every other component moves collective payloads as
+2(p-1)-ish framed point-to-point messages through the PML matching
+engine — header encode/decode, matching, and a scheduler wakeup per
+hop, the measured ~58 µs/hop floor compounding linearly in p.  Ranks
+that share a host do not need any of that: this component maps ONE
+per-communicator arena (built on ``core.shmseg``, the same framework
+the btl/shm rings ride) and turns barrier/bcast/reduce/allreduce/
+allgather into single-copy fan-in/fan-out through it — zero PML
+frames, zero matching, zero per-hop headers.
+
+Arena layout (one file in ``shmseg.backing_dir()``, unlinked right
+after the attach agreement so crash cleanup is free)::
+
+    [ arrive u64 ×p (cacheline-padded) | depart u64 ×p (padded) ]
+    [ desc 128B ×p ]  [ slot ×(p+1) ]          # slot p = result slot
+
+``arrive[r]``/``depart[r]`` are **monotonic sequence counters** with a
+single writer each (rank r), read by everyone — the sequence-numbered
+generalisation of a sense-reversing barrier (a monotonic seq never
+needs its sense flipped, and one pair of counters serialises every
+collective kind on the communicator).  All counter accesses go through
+``memoryview.cast("Q")`` so each is one native aligned 8-byte memory
+op — the same store-ordering discipline (x86 TSO) the btl/shm ring
+counters use, and the same reason ``struct.pack_into`` must not be
+used here.
+
+Data moves by **one copy per side**: writers publish straight into
+their slot (``np.copyto`` walks strided sources directly into the
+mapped segment — the PR-1 convertor-plan idea with numpy as the run
+engine, no staging buffer), readers copy straight out; the fold rank
+reduces *views of the mapped slots* in rank order without copying them
+at all.  Payloads larger than a slot pipeline through the slot halves
+(double-buffered: ranks publish segment k+1 while the fold rank is
+still folding segment k — the ``allreduce_segmented_ring`` overlap
+idea, fan-in form).
+
+Dispatch ladder per collective:
+
+- all ranks on one host → the flat arena;
+- mixed hosts → hierarchical composition (HiCCL-style): the cached
+  ``split_type(COMM_TYPE_SHARED)`` node communicator runs the intra
+  phases through its arena, the cached leader communicator runs the
+  inter phase through coll/host's tuned algorithms;
+- fall back to coll/host per-collective when the op is non-commutative,
+  the payload exceeds ``coll_shm_arena_size``, an explicit
+  ``coll_host_*_algorithm``/rules-file directive names a host
+  algorithm (user tuning outranks the shortcut), or no usable shm
+  backing dir exists.  Every fallback bumps ``coll_shm_fallback_total``
+  and drops a ``decision:<coll>`` instant on the timeline.
+
+For bcast only the root knows the payload, so the root *communicates*
+its arena-vs-host verdict through the descriptor round — every rank
+takes the same branch without a pre-exchange.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import uuid
+from typing import Optional
+
+import numpy as np
+
+from ompi_tpu.core import output, shmseg
+from ompi_tpu.core.config import VarType, register_var, var_registry
+from ompi_tpu.core.mca import Component
+from ompi_tpu.mpi import trace as trace_mod
+from ompi_tpu.mpi.coll import base, coll_framework
+from ompi_tpu.mpi.constants import COMM_TYPE_SHARED, UNDEFINED, MPIException
+from ompi_tpu.mpi.op import Op
+
+__all__ = ["ShmColl", "Arena"]
+
+_log = output.get_stream("coll")
+
+_CACHELINE = 64
+_DESC = 128                     # per-rank op-descriptor bytes
+_DESC_DATA, _DESC_HOST = 1, 2   # descriptor verdicts (bcast root decides)
+_MAX_DIMS = 8                   # descriptor shape capacity
+_TOKEN = np.zeros(0, np.uint8)  # gate payload for the arena-less intra path
+
+
+def _arena_dtype_ok(dtype: np.dtype) -> bool:
+    """Raw-byte publishable: fixed-size, no python object indirection."""
+    return not dtype.hasobject and dtype.itemsize > 0
+
+
+def _desc_dtype_ok(dtype: np.dtype) -> bool:
+    """Reconstructible from the 32-byte descriptor field: extension
+    dtypes (bfloat16 & co.) stringify to a raw void ('<V2') that would
+    NOT round-trip — bcast must ship those via coll/host, whose wire
+    headers carry the real dtype."""
+    try:
+        return len(dtype.str) <= 32 and np.dtype(dtype.str) == dtype
+    except Exception:  # noqa: BLE001 — unparseable str: not shippable
+        return False
+
+
+class Arena:
+    """One mapped per-communicator arena; ranks are arena slot indices.
+
+    Every wait is ``flags[i] >= v`` on monotonic counters, so the
+    protocol is ABA-free by construction; each collective advances
+    every rank's arrive (and depart, where used) by the same amount,
+    keeping the counters equal at op boundaries — the invariant all
+    thresholds are computed from.
+    """
+
+    def __init__(self, seg: shmseg.SharedSegment, size: int, rank: int,
+                 slot_bytes: int) -> None:
+        self.seg = seg
+        self.size = size
+        self.rank = rank
+        self.slot_bytes = slot_bytes
+        self.half = (slot_bytes // 2) & ~7
+        self._flags = seg.buf[:2 * size * _CACHELINE].cast("Q")
+        self._desc_base = 2 * size * _CACHELINE
+        self._slot_base = self._desc_base + size * _DESC
+        self._arr = 0   # my arrive counter (mirror of the mapped value)
+        self._dep = 0   # my depart counter
+
+    @staticmethod
+    def nbytes_for(size: int, slot_bytes: int) -> int:
+        return (2 * size * _CACHELINE + size * _DESC
+                + (size + 1) * slot_bytes)
+
+    def close(self) -> None:
+        try:
+            self._flags.release()
+        except (BufferError, ValueError):
+            pass
+        self.seg.detach()
+
+    # -- flags -------------------------------------------------------------
+
+    def _set_arrive(self, v: int) -> None:
+        self._flags[self.rank * 8] = v
+        self._arr = v
+
+    def _set_depart(self, v: int) -> None:
+        self._flags[(self.size + self.rank) * 8] = v
+        self._dep = v
+
+    # on a 1-2 core host every spin iteration steals the flag-writer's
+    # quantum (the btl/shm poller disables its spin window there for the
+    # same reason) — escalate to micro-sleeps almost immediately
+    _SPIN_MASK = 0xFF if (os.cpu_count() or 1) > 2 else 0xF
+
+    def _wait(self, idx: int, v: int, comm) -> None:
+        f = self._flags
+        if f[idx] >= v:
+            return
+        timeout = float(var_registry.get("coll_shm_timeout") or 60)
+        deadline = time.monotonic() + timeout
+        spins = 0
+        delay = 2e-5
+        while f[idx] < v:
+            spins += 1
+            if spins & self._SPIN_MASK:
+                time.sleep(0)       # yield (in-process ranks share the GIL)
+                continue
+            time.sleep(delay)       # escalate once the burst window passed
+            delay = min(delay * 2, 1e-3)
+            if comm is not None:
+                self._check_ft(comm)
+            if time.monotonic() > deadline:
+                raise MPIException(
+                    f"coll/shm: arena wait (flag {idx // 8}, want {v}, "
+                    f"have {int(f[idx])}) stuck for {timeout:.0f}s on "
+                    f"{getattr(comm, 'name', '?')} — peer dead or "
+                    f"collective-order mismatch (coll_shm_timeout)")
+
+    @staticmethod
+    def _check_ft(comm) -> None:
+        """Arena waits bypass the PML, so they must reproduce its
+        fail-fast discipline themselves: a revoked communicator or a
+        detector-declared-dead member raises instead of spinning out
+        the full coll_shm_timeout (the ULFM recovery paths depend on
+        collectives failing promptly)."""
+        if comm.is_revoked():
+            from ompi_tpu.mpi.constants import ERR_REVOKED
+
+            raise MPIException(
+                f"coll/shm: {comm.name} revoked mid-collective",
+                error_class=ERR_REVOKED)
+        ft = getattr(comm.pml, "ft", None)
+        if ft is not None:
+            for w in comm.group.ranks:
+                if ft.detector.is_dead(w, poll=False):
+                    from ompi_tpu.mpi.constants import ERR_PROC_FAILED
+
+                    raise MPIException(
+                        f"coll/shm: rank {w} failed mid-collective "
+                        f"({ft.detector.reason(w) or 'detector-declared'})",
+                        error_class=ERR_PROC_FAILED)
+
+    def _wait_arrive(self, r: int, v: int, comm) -> None:
+        self._wait(r * 8, v, comm)
+
+    def _wait_depart(self, r: int, v: int, comm) -> None:
+        self._wait((self.size + r) * 8, v, comm)
+
+    def _wait_all_arrive(self, v: int, comm) -> None:
+        for r in range(self.size):
+            self._wait(r * 8, v, comm)
+
+    def _wait_all_depart(self, v: int, comm) -> None:
+        for r in range(self.size):
+            self._wait((self.size + r) * 8, v, comm)
+
+    # -- slots / descriptors ------------------------------------------------
+
+    def _slot(self, i: int) -> memoryview:
+        off = self._slot_base + i * self.slot_bytes
+        return self.seg.buf[off:off + self.slot_bytes]
+
+    def _write_desc(self, code: int, arr: Optional[np.ndarray],
+                    nseg: int) -> None:
+        off = self._desc_base + self.rank * _DESC
+        head = np.zeros(12, np.uint64)
+        head[0] = code
+        dts = b""
+        if arr is not None:
+            head[1] = arr.nbytes
+            head[2] = nseg
+            head[3] = arr.ndim
+            head[4:4 + arr.ndim] = np.array(arr.shape, np.uint64)
+            dts = arr.dtype.str.encode()
+        self.seg.buf[off:off + 96] = head.tobytes()
+        self.seg.buf[off + 96:off + _DESC] = dts.ljust(32, b"\0")
+
+    def _read_desc(self, r: int):
+        off = self._desc_base + r * _DESC
+        head = np.frombuffer(self.seg.buf[off:off + 96], np.uint64)
+        code, nbytes, nseg, ndim = (int(head[0]), int(head[1]),
+                                    int(head[2]), int(head[3]))
+        shape = tuple(int(x) for x in head[4:4 + ndim])
+        raw = bytes(self.seg.buf[off + 96:off + _DESC]).rstrip(b"\0")
+        dtype = np.dtype(raw.decode()) if raw else np.dtype(np.uint8)
+        return code, nbytes, nseg, shape, dtype
+
+    @staticmethod
+    def _copy_in(dst_mv: memoryview, arr: np.ndarray) -> None:
+        """THE send-side copy: user buffer → mapped slot.  Strided
+        sources walk directly (numpy is the run engine — no staging)."""
+        if arr.nbytes == 0:
+            return
+        dst = np.frombuffer(dst_mv, dtype=arr.dtype, count=arr.size)
+        np.copyto(dst.reshape(arr.shape), arr, casting="no")
+
+    # -- barrier -------------------------------------------------------------
+
+    def barrier(self, comm) -> None:
+        s = self._arr + 1
+        self._set_arrive(s)
+        self._wait_all_arrive(s, comm)
+
+    def gate_in(self, comm, nroot: int = 0) -> None:
+        """Fan-in half of a hierarchical barrier: everyone signals
+        arrival, only the gate root waits for all of them."""
+        s = self._arr + 1
+        self._set_arrive(s)
+        if self.rank == nroot:
+            self._wait_all_arrive(s, comm)
+
+    def gate_out(self, comm, nroot: int = 0) -> None:
+        """Release half: the gate root signals, everyone else waits."""
+        s = self._dep + 1
+        if self.rank == nroot:
+            self._set_depart(s)
+        else:
+            self._wait_depart(nroot, s, comm)
+            self._set_depart(s)
+
+    # -- bcast ---------------------------------------------------------------
+
+    def bcast(self, comm, nroot: int, buf, cap: int) -> Optional[np.ndarray]:
+        """Single-copy fan-out, pipelined through the root slot's halves.
+        Returns None on every rank when the root judged the payload
+        host-bound (oversized/unsupported) — the verdict travels in the
+        descriptor, so non-roots (who cannot see the payload) take the
+        same branch with no extra exchange."""
+        if self.rank == nroot:
+            arr = np.asarray(buf)
+            ok = (_arena_dtype_ok(arr.dtype) and arr.ndim <= _MAX_DIMS
+                  and _desc_dtype_ok(arr.dtype) and arr.nbytes <= cap)
+            nseg = max(1, -(-arr.nbytes // self.half)) if ok else 1
+            self._write_desc(_DESC_DATA if ok else _DESC_HOST,
+                             arr if ok else None, nseg)
+            s0 = self._arr
+            if not ok:
+                self._set_arrive(s0 + 1)
+                self._wait_all_arrive(s0 + 1, comm)
+                return None
+            u8 = (arr if arr.flags.c_contiguous
+                  else np.ascontiguousarray(arr)).reshape(-1).view(np.uint8)
+            slot = self._slot(nroot)
+            for k in range(nseg):
+                if k >= 2:   # readers done with the previous half occupant
+                    self._wait_all_arrive(s0 + k - 1, comm)
+                lo = k * self.half
+                hi = min(lo + self.half, arr.nbytes)
+                hoff = (k % 2) * self.half
+                slot[hoff:hoff + hi - lo] = u8[lo:hi].data
+                self._set_arrive(s0 + k + 1)
+            self._wait_all_arrive(s0 + nseg, comm)
+            return arr
+        s0 = self._arr
+        self._wait_arrive(nroot, s0 + 1, comm)
+        code, nbytes, nseg, shape, dtype = self._read_desc(nroot)
+        if code == _DESC_HOST:
+            self._set_arrive(s0 + 1)
+            return None
+        out = np.empty(nbytes, np.uint8)
+        slot = self._slot(nroot)
+        for k in range(nseg):
+            self._wait_arrive(nroot, s0 + k + 1, comm)
+            lo = k * self.half
+            hi = min(lo + self.half, nbytes)
+            hoff = (k % 2) * self.half
+            out[lo:hi] = np.frombuffer(slot[hoff:hoff + hi - lo], np.uint8)
+            self._set_arrive(s0 + k + 1)
+        return out.view(dtype).reshape(shape)
+
+    # -- reduce / allreduce --------------------------------------------------
+
+    def reduce(self, comm, nroot: int, arr: np.ndarray, op: Op,
+               bcast_result: bool) -> Optional[np.ndarray]:
+        """Rank-ordered fan-in at ``nroot`` folding *views of the mapped
+        slots* (zero read copies), pipelined through slot halves;
+        ``bcast_result`` adds the fan-out phase (allreduce).  The caller
+        pre-validated op commutativity, dtype, and the arena cap — those
+        checks use globally-agreed inputs, so every rank gets here (or
+        not) together."""
+        arr = np.asarray(arr)
+        dtype, itemsize = arr.dtype, arr.dtype.itemsize
+        n = arr.size
+        seg_elems = max(1, self.half // itemsize)
+        nseg = max(1, -(-n // seg_elems))
+        s0a, s0d = self._arr, self._dep
+        me = self.rank
+        myslot = self._slot(me)
+        res = self._slot(self.size)
+        flat = None
+        if nseg > 1:
+            flat = (arr if arr.flags.c_contiguous
+                    else np.ascontiguousarray(arr)).reshape(-1)
+
+        def seg_bounds(k: int):
+            lo = k * seg_elems
+            hi = min(lo + seg_elems, n)
+            return lo, hi, (k % 2) * self.half
+
+        def write_my_seg(k: int) -> None:
+            lo, hi, hoff = seg_bounds(k)
+            dst = myslot[hoff:hoff + (hi - lo) * itemsize]
+            if nseg == 1:
+                self._copy_in(dst, arr)   # strided sources walk directly
+            else:
+                np.copyto(np.frombuffer(dst, dtype, count=hi - lo),
+                          flat[lo:hi], casting="no")
+
+        if me == nroot:
+            parts = []
+            for k in range(nseg):
+                lo, hi, hoff = seg_bounds(k)
+                write_my_seg(k)
+                self._set_arrive(s0a + k + 1)
+                self._wait_all_arrive(s0a + k + 1, comm)
+                # fold straight from the mapped slots, in rank order
+                acc = np.frombuffer(self._slot(0)[hoff:], dtype,
+                                    count=hi - lo)
+                for i in range(1, self.size):
+                    acc = op.host(acc, np.frombuffer(
+                        self._slot(i)[hoff:], dtype, count=hi - lo))
+                acc = np.asarray(acc)
+                parts.append(acc)
+                if bcast_result and k >= 2:
+                    # readers finished with this result half's previous
+                    # occupant (segment k-2)
+                    self._wait_all_depart(s0d + k - 1, comm)
+                if bcast_result:
+                    np.copyto(np.frombuffer(res[hoff:], dtype,
+                                            count=hi - lo), acc,
+                              casting="no")
+                self._set_depart(s0d + k + 1)
+            if bcast_result:
+                self._wait_all_depart(s0d + nseg, comm)
+            out = parts[0] if nseg == 1 else np.concatenate(parts)
+            return out.reshape(arr.shape).astype(dtype, copy=False)
+        # non-root: publish segments one ahead of the root's fold, and
+        # (for allreduce) drain result segments one behind it
+        out = np.empty(n, dtype) if bcast_result else None
+        for k in range(nseg):
+            if not bcast_result and k >= 2:
+                self._wait_depart(nroot, s0d + k - 1, comm)
+            write_my_seg(k)
+            self._set_arrive(s0a + k + 1)
+            if bcast_result and k >= 1:
+                lo, hi, hoff = seg_bounds(k - 1)
+                self._wait_depart(nroot, s0d + k, comm)
+                out[lo:hi] = np.frombuffer(res[hoff:], dtype, count=hi - lo)
+                self._set_depart(s0d + k)
+        self._wait_depart(nroot, s0d + nseg, comm)
+        if bcast_result:
+            lo, hi, hoff = seg_bounds(nseg - 1)
+            out[lo:hi] = np.frombuffer(res[hoff:], dtype, count=hi - lo)
+        self._set_depart(s0d + nseg)
+        return out.reshape(arr.shape) if bcast_result else None
+
+    # -- allgather -----------------------------------------------------------
+
+    def allgather(self, comm, arr: np.ndarray) -> np.ndarray:
+        """Everyone publishes a slot, everyone copies all slots; result
+        indexed by arena rank.  Caller checked nbytes <= slot_bytes."""
+        arr = np.asarray(arr)
+        s0a, s0d = self._arr, self._dep
+        self._copy_in(self._slot(self.rank)[:max(arr.nbytes, 1)], arr)
+        self._set_arrive(s0a + 1)
+        self._wait_all_arrive(s0a + 1, comm)
+        out = np.empty((self.size,) + arr.shape, arr.dtype)
+        for i in range(self.size):
+            src = np.frombuffer(self._slot(i), arr.dtype, count=arr.size)
+            out[i] = src.reshape(arr.shape)
+        self._set_depart(s0d + 1)
+        self._wait_all_depart(s0d + 1, comm)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# bootstrap + per-communicator state
+# ---------------------------------------------------------------------------
+
+def _slot_bytes(size: int) -> int:
+    slot = min(int(var_registry.get("coll_shm_slot_size")),
+               int(var_registry.get("coll_shm_arena_size")) // (size + 1))
+    return max(slot & ~15, 256)
+
+
+def _make_arena(comm) -> Optional[Arena]:
+    """Collective over ``comm`` (whose ranks all share a host): rank 0
+    creates the segment, the path rides a base-algorithm bcast (plain
+    p2p — the arena cannot carry its own bootstrap), everyone attaches,
+    and a MIN-allreduce agrees the arena is usable everywhere before
+    the creator unlinks the name (mappings survive; crash cleanup is
+    free, like the btl/shm rings)."""
+    from ompi_tpu.mpi import op as op_mod
+
+    p = comm.size
+    slot = _slot_bytes(p)
+    seg = None
+    path = ""
+    if comm.rank == 0:
+        try:
+            name = f"otpu-collshm-{os.getpid()}-{uuid.uuid4().hex[:10]}"
+            seg = shmseg.create(name, Arena.nbytes_for(p, slot))
+            path = seg.path
+        except OSError as e:
+            _log.verbose(1, "coll/shm: arena create failed (%s)", e)
+    got = base.bcast_binomial(
+        comm, np.frombuffer(path.encode(), np.uint8)
+        if comm.rank == 0 else None, 0)
+    path = bytes(bytearray(np.asarray(got, np.uint8))).decode()
+    arena = None
+    ok = 0
+    if comm.rank == 0:
+        if seg is not None:
+            arena = Arena(seg, p, 0, slot)
+            ok = 1
+    elif path:
+        try:
+            aseg = shmseg.attach_retry(path, timeout=10.0)
+            arena = Arena(aseg, p, comm.rank, slot)
+            ok = 1
+        except OSError as e:
+            _log.verbose(1, "coll/shm: arena attach failed (%s)", e)
+    allok = base.allreduce_recursive_doubling(
+        comm, np.array([ok], np.int64), op_mod.MIN)
+    if comm.rank == 0 and seg is not None:
+        seg.unlink()   # attach agreement passed (or failed): name done
+    if int(allok[0]) != 1:
+        if arena is not None:
+            arena.close()
+        return None
+    return arena
+
+
+class _HostFallback:
+    """Permanent per-communicator fallback marker (no co-located ranks,
+    no usable shm dir, or arena bootstrap failed)."""
+
+    mode = "host"
+
+    def close(self) -> None:
+        pass
+
+
+_HOST = _HostFallback()
+_SETUP = object()   # reentrancy sentinel: setup's own collectives → host
+
+
+class _State:
+    """Cached per-communicator dispatch state (rides ``comm._coll_shm_state``;
+    ``Communicator.free`` closes it)."""
+
+    def __init__(self, mode: str, node, leader, arena,
+                 c2n=None, node_blocks=None, node_idx_of=None) -> None:
+        self.mode = mode              # "arena" (flat) | "hier"
+        self.node = node              # split_type(COMM_TYPE_SHARED) cache
+        self.leader = leader          # node-rank-0 communicator (or None)
+        self.arena = arena            # this node's Arena (or None)
+        self.c2n = c2n                # flat: comm rank → arena rank
+        self.node_blocks = node_blocks  # hier: per node, comm ranks by node rank
+        self.node_idx_of = node_idx_of  # hier: comm rank → node index
+
+    def close(self) -> None:
+        if self.arena is not None:
+            self.arena.close()
+            self.arena = None
+
+
+# ---------------------------------------------------------------------------
+# the component
+# ---------------------------------------------------------------------------
+
+@coll_framework.component
+class ShmColl(Component):
+    NAME = "shm"
+    PRIORITY = 50    # above host (40): same-host ranks take the arena
+
+    def register_params(self) -> None:
+        register_var("coll", "shm_enable", VarType.BOOL, True,
+                     "use the on-node shared-memory collective arena "
+                     "when ranks share a host (0 = coll/host everywhere)")
+        register_var("coll", "shm_arena_size", VarType.SIZE, 4 << 20,
+                     "max payload routed through the arena; larger "
+                     "collectives fall back to coll/host (whose ring/"
+                     "pipeline algorithms are bandwidth-optimal there)")
+        register_var("coll", "shm_slot_size", VarType.SIZE, 256 << 10,
+                     "per-rank arena slot; payloads above half a slot "
+                     "pipeline through the slot halves (double-buffered)")
+        register_var("coll", "shm_timeout", VarType.SIZE, 60,
+                     "seconds an arena flag wait may stall before raising "
+                     "(a dead peer or collective-order mismatch leaves "
+                     "flags behind forever)")
+
+    def query(self, comm=None, **ctx) -> Optional[int]:
+        if not var_registry.get("coll_shm_enable"):
+            return None
+        if comm is None or comm.size <= 1 or comm.test_inter():
+            return None
+        d = shmseg.backing_dir()
+        if not (os.path.isdir(d) and os.access(d, os.W_OK)):
+            return None
+        return self.PRIORITY
+
+    # -- state -------------------------------------------------------------
+
+    def _host(self):
+        return coll_framework.lookup("host")
+
+    def _state(self, comm):
+        st = getattr(comm, "_coll_shm_state", None)
+        if st is _SETUP:
+            return None          # setup's own collectives ride coll/host
+        if st is None:
+            comm._coll_shm_state = _SETUP
+            built = None
+            try:
+                t0 = trace_mod.begin() if trace_mod.active else 0
+                built = self._build_state(comm)
+                if t0:
+                    trace_mod.complete("coll", "shm_setup", t0,
+                                       rank=comm.pml.rank, cid=comm.cid,
+                                       mode=built.mode, size=comm.size)
+            except MPIException as e:
+                # e.g. a merged intercomm whose per-viewer namespace ids
+                # cannot survive split_type — the raise is deterministic
+                # (every rank computes the same partition), so settling
+                # on coll/host is collectively consistent
+                _log.verbose(1, "coll/shm: setup on %s fell back to host "
+                             "(%s)", comm.name, e)
+            finally:
+                comm._coll_shm_state = built if built is not None else _HOST
+            st = comm._coll_shm_state
+        return st
+
+    def _build_state(self, comm):
+        node = comm.split_type(COMM_TYPE_SHARED,
+                               name=f"{comm.name}.shmnode")
+        leader = comm.split(0 if node.rank == 0 else UNDEFINED,
+                            key=comm.rank, name=f"{comm.name}.shmldr")
+        arena = _make_arena(node) if node.size > 1 else None
+        if node.size == comm.size:                      # one host: flat
+            if arena is None:
+                return _HOST
+            c2n = np.array([node.group.rank_of(comm.world_rank(r))
+                            for r in range(comm.size)], np.int64)
+            return _State("arena", node, leader, arena, c2n=c2n)
+        # mixed hosts: leaders exchange their node's comm-rank blocks
+        # (ordered by node rank — i.e. by leader-comm rank across nodes),
+        # then fan the table out intra-node; base algorithms only (the
+        # arena protocol must not bootstrap itself)
+        if leader is not None:
+            my_block = np.array([comm.group.rank_of(w)
+                                 for w in node.group.ranks], np.int64)
+            blocks = base.allgatherv_ring(leader, my_block)
+            lens = np.array([len(b) for b in blocks], np.int64)
+            meta = np.concatenate(
+                [[len(blocks)], lens] + [np.asarray(b, np.int64)
+                                         for b in blocks])
+        else:
+            meta = None
+        if node.size > 1:
+            meta = base.bcast_binomial(
+                node, meta if node.rank == 0 else None, 0)
+        meta = np.asarray(meta, np.int64)
+        nnodes = int(meta[0])
+        lens = meta[1:1 + nnodes]
+        node_blocks, off = [], 1 + nnodes
+        for ln in lens:
+            node_blocks.append([int(x) for x in meta[off:off + int(ln)]])
+            off += int(ln)
+        if all(len(b) == 1 for b in node_blocks):
+            if arena is not None:
+                arena.close()
+            return _HOST     # nobody shares a host: pure coll/host ground
+        node_idx_of = {r: i for i, blk in enumerate(node_blocks)
+                       for r in blk}
+        return _State("hier", node, leader, arena,
+                      node_blocks=node_blocks, node_idx_of=node_idx_of)
+
+    # -- decision helpers ----------------------------------------------------
+
+    def _cap(self) -> int:
+        return int(var_registry.get("coll_shm_arena_size"))
+
+    def _host_directive(self, coll: str, comm, nbytes: int) -> Optional[str]:
+        """An explicit host-algorithm force or a rules-file hit is user
+        tuning the on-node shortcut must not override."""
+        if coll in ("bcast", "allreduce", "allgather"):
+            if var_registry.get(f"coll_host_{coll}_algorithm"):
+                return f"forced coll_host_{coll}_algorithm"
+            path = var_registry.get("coll_host_dynamic_rules")
+            if path:
+                try:
+                    hit = self._host()._load_rules(path).lookup(
+                        coll, comm.size, nbytes)
+                except Exception:  # noqa: BLE001 — let host surface the error
+                    return f"unreadable rules file {path}"
+                if hit:
+                    return f"rules file {path}"
+        return None
+
+    def _fallback(self, comm, coll: str, reason: str, nbytes: int = 0):
+        trace_mod.count("coll_shm_fallback_total")
+        if trace_mod.active:
+            trace_mod.instant(
+                "coll", f"decision:{coll}", rank=comm.pml.rank,
+                algorithm="fallback:host", source=f"coll/shm: {reason}",
+                nbytes=nbytes, size=comm.size)
+        return self._host()
+
+    def _route(self, comm, coll: str, nbytes: int = 0):
+        """(state, None) to run the arena/hier path, or (None, host
+        component) to fall back — every branch driven by inputs all
+        ranks agree on."""
+        st = self._state(comm)
+        if st is None:
+            return None, self._host()   # setup reentry: silent host
+        if st.mode == "host":
+            return None, self._fallback(comm, coll, "no arena (single-rank "
+                                        "hosts or bootstrap failed)", nbytes)
+        src = self._host_directive(coll, comm, nbytes)
+        if src is not None:
+            return None, self._fallback(comm, coll, src, nbytes)
+        return st, None
+
+    # -- intra-node phase helpers (hier mode) --------------------------------
+
+    def _intra_gate_in(self, st) -> None:
+        if st.node.size == 1:
+            return
+        if st.arena is not None:
+            trace_mod.count("coll_shm_fanin_total")
+            st.arena.gate_in(st.node, 0)
+        else:
+            base.gather_linear(st.node, _TOKEN, 0)
+
+    def _intra_gate_out(self, st) -> None:
+        if st.node.size == 1:
+            return
+        if st.arena is not None:
+            trace_mod.count("coll_shm_fanout_total")
+            st.arena.gate_out(st.node, 0)
+        else:
+            base.bcast_binomial(st.node,
+                                _TOKEN if st.node.rank == 0 else None, 0)
+
+    def _intra_bcast(self, st, buf, nroot: int):
+        node = st.node
+        if node.size == 1:
+            return np.asarray(buf)
+        if st.arena is not None:
+            out = st.arena.bcast(node, nroot, buf, self._cap())
+            if out is not None:
+                trace_mod.count("coll_shm_fanout_total")
+                return out
+            trace_mod.count("coll_shm_fallback_total")
+        return self._host().coll_bcast(node, buf, nroot)
+
+    def _intra_reduce(self, st, arr, op: Op):
+        """Fold to node rank 0; returns the partial there, None elsewhere."""
+        node = st.node
+        if node.size == 1:
+            return np.asarray(arr)
+        if st.arena is not None and self._reducible(arr, op, st.arena):
+            trace_mod.count("coll_shm_fanin_total")
+            return st.arena.reduce(node, 0, arr, op, bcast_result=False)
+        trace_mod.count("coll_shm_fallback_total")
+        return self._host().coll_reduce(node, arr, op, 0)
+
+    def _reducible(self, arr: np.ndarray, op: Op, arena: Arena) -> bool:
+        return (op.commutative and _arena_dtype_ok(arr.dtype)
+                and arr.dtype.itemsize <= arena.half
+                and arr.nbytes <= self._cap())
+
+    # -- table slots ---------------------------------------------------------
+
+    def coll_barrier(self, comm) -> None:
+        st, host = self._route(comm, "barrier")
+        if host is not None:
+            return host.coll_barrier(comm)
+        if st.mode == "arena":
+            trace_mod.count("coll_shm_fanin_total")
+            return st.arena.barrier(comm)
+        self._intra_gate_in(st)
+        if st.leader is not None:
+            self._host().coll_barrier(st.leader)
+        self._intra_gate_out(st)
+
+    def coll_bcast(self, comm, buf, root: int):
+        st, host = self._route(comm, "bcast")
+        if host is not None:
+            return host.coll_bcast(comm, buf, root)
+        if st.mode == "arena":
+            out = st.arena.bcast(comm, int(st.c2n[root]), buf, self._cap())
+            if out is None:   # the root's verdict, learned via the desc
+                return self._fallback(
+                    comm, "bcast", "payload above coll_shm_arena_size or "
+                    "unsupported dtype (root's descriptor verdict)"
+                ).coll_bcast(comm, buf, root)
+            trace_mod.count("coll_shm_fanout_total")
+            return out
+        my_idx = st.node_idx_of[comm.rank]
+        root_idx = st.node_idx_of[root]
+        data = buf
+        if my_idx == root_idx and st.node.size > 1:
+            nroot = st.node.group.rank_of(comm.world_rank(root))
+            data = self._intra_bcast(st, data, nroot)
+        if st.leader is not None:
+            data = self._host().coll_bcast(
+                st.leader, data if my_idx == root_idx else None, root_idx)
+        if my_idx != root_idx:
+            data = self._intra_bcast(st, data, 0)
+        return np.asarray(data)
+
+    def coll_reduce(self, comm, sendbuf, op: Op, root: int):
+        arr = np.asarray(sendbuf)
+        st, host = self._route(comm, "reduce", arr.nbytes)
+        if host is not None:
+            return host.coll_reduce(comm, arr, op, root)
+        if not op.commutative:
+            return self._fallback(comm, "reduce", "non-commutative op",
+                                  arr.nbytes).coll_reduce(comm, arr, op,
+                                                          root)
+        if st.mode == "arena":
+            if not self._reducible(arr, op, st.arena):
+                return self._fallback(
+                    comm, "reduce", "payload above coll_shm_arena_size or "
+                    "unsupported dtype", arr.nbytes
+                ).coll_reduce(comm, arr, op, root)
+            trace_mod.count("coll_shm_fanin_total")
+            return st.arena.reduce(comm, int(st.c2n[root]), arr, op,
+                                   bcast_result=False)
+        root_idx = st.node_idx_of[root]
+        partial = self._intra_reduce(st, arr, op)
+        out = None
+        if st.leader is not None:
+            out = self._host().coll_reduce(st.leader, partial, op, root_idx)
+        root_leader = st.node_blocks[root_idx][0]
+        if root_leader != root:   # root is not its node's leader: one hop
+            if comm.rank == root_leader:
+                comm._coll_isend(out, root, base.TAG_REDUCE).wait()
+                out = None
+            elif comm.rank == root:
+                out = comm._coll_irecv(None, root_leader,
+                                       base.TAG_REDUCE).wait()
+                out = out.reshape(arr.shape).astype(arr.dtype, copy=False)
+        return out if comm.rank == root else None
+
+    def coll_allreduce(self, comm, sendbuf, op: Op):
+        arr = np.asarray(sendbuf)
+        st, host = self._route(comm, "allreduce", arr.nbytes)
+        if host is not None:
+            return host.coll_allreduce(comm, arr, op)
+        if not op.commutative:
+            return self._fallback(comm, "allreduce", "non-commutative op",
+                                  arr.nbytes).coll_allreduce(comm, arr, op)
+        if st.mode == "arena":
+            if not self._reducible(arr, op, st.arena):
+                return self._fallback(
+                    comm, "allreduce", "payload above coll_shm_arena_size "
+                    "or unsupported dtype", arr.nbytes
+                ).coll_allreduce(comm, arr, op)
+            trace_mod.count("coll_shm_fanin_total")
+            trace_mod.count("coll_shm_fanout_total")
+            return st.arena.reduce(comm, 0, arr, op, bcast_result=True)
+        partial = self._intra_reduce(st, arr, op)
+        total = partial
+        if st.leader is not None:
+            total = self._host().coll_allreduce(st.leader, partial, op)
+        out = self._intra_bcast(st, total, 0)
+        return np.asarray(out).reshape(arr.shape).astype(arr.dtype,
+                                                         copy=False)
+
+    def coll_allgather(self, comm, sendbuf):
+        arr = np.asarray(sendbuf)
+        st, host = self._route(comm, "allgather", arr.nbytes)
+        if host is not None:
+            return host.coll_allgather(comm, arr)
+        if st.mode == "arena":
+            if not (_arena_dtype_ok(arr.dtype)
+                    and arr.nbytes <= st.arena.slot_bytes
+                    and arr.nbytes * comm.size <= self._cap()):
+                return self._fallback(
+                    comm, "allgather", "payload above the slot/arena cap "
+                    "or unsupported dtype", arr.nbytes
+                ).coll_allgather(comm, arr)
+            trace_mod.count("coll_shm_fanin_total")
+            trace_mod.count("coll_shm_fanout_total")
+            out = st.arena.allgather(comm, arr)
+            c2n = st.c2n
+            if not np.array_equal(c2n, np.arange(comm.size)):
+                out = out[c2n]
+            return out
+        # hier: node gather → leader allgatherv → reorder → node bcast
+        node = st.node
+        if node.size > 1:
+            if (st.arena is not None and _arena_dtype_ok(arr.dtype)
+                    and arr.nbytes <= st.arena.slot_bytes):
+                trace_mod.count("coll_shm_fanin_total")
+                block = st.arena.allgather(node, arr)
+            else:
+                block = self._host().coll_allgather(node, arr)
+        else:
+            block = arr[None]
+        full = None
+        if st.leader is not None:
+            rows = self._host().coll_allgatherv(
+                st.leader, np.ascontiguousarray(block).reshape(
+                    block.shape[0], -1))
+            full = np.empty((comm.size, max(arr.size, 0)), arr.dtype)
+            for bi, blk in enumerate(rows):
+                full[np.asarray(st.node_blocks[bi])] = np.asarray(
+                    blk, arr.dtype).reshape(len(st.node_blocks[bi]), -1)
+        full = self._intra_bcast(st, full, 0)
+        return np.asarray(full, arr.dtype).reshape(
+            (comm.size,) + arr.shape)

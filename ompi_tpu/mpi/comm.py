@@ -57,6 +57,11 @@ class Communicator:
         self._lock = threading.Lock()
         self.coll = None  # installed by ompi_tpu.mpi.coll.install()
         self.device = None  # bound DeviceCommunicator (coll/xla path)
+        # coll/shm per-communicator cache: the split_type(COMM_TYPE_SHARED)
+        # node communicator, the leader communicator, and the shared-memory
+        # arena — built lazily by ompi_tpu.mpi.coll.shm on the first
+        # collective, closed by free()
+        self._coll_shm_state = None
         self.attrs: dict[Any, Any] = {}  # ≈ MPI attribute caching
         # error policy (≈ ompi_errhandler; default mirrors ERRORS_RETURN —
         # the MPIException propagating IS the returned error code here)
@@ -640,10 +645,15 @@ class Communicator:
                 keyval.delete_fn(self, value)
 
     def free(self) -> None:
-        """≈ MPI_Comm_free: run attribute delete callbacks.  (Transport
+        """≈ MPI_Comm_free: run attribute delete callbacks and release
+        the coll/shm arena mapping, if one was built.  (Transport
         teardown belongs to the runtime, not individual communicators.)"""
         for kv in list(self.attrs):
             self.delete_attr(kv)
+        st = self._coll_shm_state
+        if st is not None and hasattr(st, "close"):
+            st.close()
+        self._coll_shm_state = None
 
     def _copy_attrs(self, new: "Communicator") -> None:
         from ompi_tpu.mpi.info import Keyval

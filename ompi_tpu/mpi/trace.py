@@ -86,6 +86,17 @@ _COUNTER_SPECS = (
      "frames published into shared-memory rings"),
     ("btl_shm_drained_total", "frames",
      "frames drained from shared-memory rings"),
+    # on-node collective arena (coll/shm)
+    ("coll_shm_fanin_total", "phases",
+     "arena fan-in phases run by coll/shm (reduce/allreduce/allgather "
+     "slot publishes + barrier arrivals)"),
+    ("coll_shm_fanout_total", "phases",
+     "arena fan-out phases run by coll/shm (bcast/allreduce result "
+     "distribution + hierarchical releases)"),
+    ("coll_shm_fallback_total", "collectives",
+     "coll/shm invocations delegated to coll/host (non-commutative op, "
+     "payload above the arena cap, host-algorithm directive, or no "
+     "usable arena)"),
     # ULFM fault-tolerance plane (mpi/ft.py)
     ("ft_rank_deaths_total", "ranks",
      "world ranks this process's failure detector declared dead"),

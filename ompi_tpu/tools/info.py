@@ -27,6 +27,7 @@ _REGISTERING_MODULES = [
     "ompi_tpu.mpi.coll",
     "ompi_tpu.mpi.coll.host",
     "ompi_tpu.mpi.coll.selfcoll",
+    "ompi_tpu.mpi.coll.shm",
     "ompi_tpu.mpi.coll.xla",
     "ompi_tpu.mpi.pml",
     "ompi_tpu.mpi.op",

@@ -269,7 +269,11 @@ def test_coll_providers_introspection():
         return dict(comm.coll.providers)
 
     provs = run_ranks(2, fn)[0]
-    assert provs["allreduce"] == "host"
+    # coll/shm stacks above host for the slots it implements; the rest
+    # of the table stays host's — the per-function layering the
+    # reference's comm_select gives coll/sm over tuned
+    assert provs["allreduce"] == "shm"
+    assert provs["alltoall"] == "host"
 
     provs1 = run_ranks(1, fn)[0]
     assert provs1["allreduce"] == "self"

@@ -1,0 +1,354 @@
+"""coll/shm — the on-node shared-memory collective arena.
+
+Correctness parity against coll/host (bit-identical results across a
+fuzzed (op, dtype, shape, comm-size) matrix, including the
+large-payload fallback boundary), the hierarchical mixed-host
+composition, the fallback ladder, and the observability contract
+(pvars + decision instants).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ompi_tpu.core.config import var_registry
+from ompi_tpu.mpi import op as op_mod
+from ompi_tpu.mpi import trace
+from tests.mpi.harness import run_ranks
+
+N = 4
+
+
+def _shm_used(comm) -> bool:
+    st = comm._coll_shm_state
+    return st is not None and getattr(st, "mode", "host") != "host"
+
+
+# ---------------------------------------------------------------------------
+# flat arena basics
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n", [2, 3, 4, 5])
+def test_arena_owns_the_slots_single_host(n):
+    def body(comm):
+        comm.barrier()
+        out = comm.allreduce(np.arange(6.0) + comm.rank)
+        assert comm.coll.providers["allreduce"] == "shm"
+        assert _shm_used(comm) and comm._coll_shm_state.mode == "arena"
+        return out
+
+    for out in run_ranks(n, body):
+        np.testing.assert_allclose(
+            out, np.arange(6.0) * n + sum(range(n)))
+
+
+def test_bcast_root_only_knows_the_payload():
+    def body(comm):
+        buf = np.arange(11.0).reshape(11, 1) * 3 if comm.rank == 2 else None
+        return comm.bcast(buf, root=2)
+
+    for out in run_ranks(N, body):
+        np.testing.assert_array_equal(out,
+                                      np.arange(11.0).reshape(11, 1) * 3)
+
+
+def test_reduce_at_every_root():
+    def body(comm):
+        outs = []
+        for root in range(comm.size):
+            outs.append(comm.reduce(np.full(3, comm.rank + 1.0), root=root))
+        return outs
+
+    res = run_ranks(N, body)
+    for root in range(N):
+        np.testing.assert_allclose(res[root][root],
+                                   np.full(3, sum(range(1, N + 1))))
+        for r in range(N):
+            if r != root:
+                assert res[r][root] is None
+
+
+def test_allgather_orders_by_comm_rank():
+    def body(comm):
+        return comm.allgather(np.array([comm.rank * 5, comm.rank]))
+
+    for out in run_ranks(N, body):
+        np.testing.assert_array_equal(out,
+                                      np.array([[i * 5, i] for i in range(N)]))
+
+
+def test_segmented_pipeline_large_payloads():
+    """Payloads far above a slot stream through the slot halves."""
+    def body(comm):
+        x = np.arange(200_000.0) + comm.rank        # 1.6MB vs 256K slots
+        out = comm.allreduce(x)
+        b = comm.bcast(np.arange(150_000.0)[::-1].copy()
+                       if comm.rank == 1 else None, root=1)
+        return out[::50_000], b[::50_000]
+
+    for out, b in run_ranks(N, body):
+        np.testing.assert_allclose(
+            out, (np.arange(200_000.0) * N + sum(range(N)))[::50_000])
+        np.testing.assert_allclose(b, np.arange(150_000.0)[::-1][::50_000])
+
+
+def test_strided_buffers_publish_without_staging():
+    def body(comm):
+        m = (np.arange(100.0).reshape(10, 10) + comm.rank)[::3, 1::2]
+        return comm.allreduce(m)
+
+    want = sum((np.arange(100.0).reshape(10, 10) + r)[::3, 1::2]
+               for r in range(N))
+    for out in run_ranks(N, body):
+        np.testing.assert_allclose(out, want)
+
+
+# ---------------------------------------------------------------------------
+# fuzzed parity: shm results must be BIT-IDENTICAL to coll/host
+# ---------------------------------------------------------------------------
+
+_OPS = [op_mod.SUM, op_mod.MAX, op_mod.MIN, op_mod.PROD]
+_DTYPES = [np.float64, np.float32, np.int64, np.int32, np.uint8]
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzzed_parity_with_host(seed):
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(2, 6))
+    op = _OPS[int(rng.integers(len(_OPS)))]
+    dtype = _DTYPES[int(rng.integers(len(_DTYPES)))]
+    ndim = int(rng.integers(1, 4))
+    shape = tuple(int(rng.integers(1, 9)) for _ in range(ndim))
+    datas = [(rng.integers(1, 7, size=shape)).astype(dtype)
+             for _ in range(n)]
+
+    def shm_body(comm):
+        a = comm.allreduce(datas[comm.rank], op=op)
+        g = comm.allgather(datas[comm.rank])
+        b = comm.bcast(datas[0] if comm.rank == 0 else None, root=0)
+        assert _shm_used(comm)
+        return a, g, b
+
+    def host_body(comm):
+        a = comm.allreduce(datas[comm.rank], op=op)
+        g = comm.allgather(datas[comm.rank])
+        b = comm.bcast(datas[0] if comm.rank == 0 else None, root=0)
+        assert comm.coll.providers["allreduce"] == "host"
+        return a, g, b
+
+    shm_res = run_ranks(n, shm_body)
+    var_registry.set("coll_shm_enable", False)
+    try:
+        host_res = run_ranks(n, host_body)
+    finally:
+        var_registry.set("coll_shm_enable", True)
+    for (sa, sg, sb), (ha, hg, hb) in zip(shm_res, host_res):
+        assert sa.dtype == ha.dtype and sa.tobytes() == ha.tobytes()
+        assert sg.tobytes() == hg.tobytes()
+        assert sb.tobytes() == hb.tobytes()
+
+
+def test_parity_across_the_fallback_boundary():
+    """Sizes straddling the arena cap: below rides the arena, above
+    falls back — results bit-identical either way."""
+    cap = int(var_registry.get("coll_shm_arena_size"))
+    for nbytes in (cap // 2, cap + 8):
+        x = np.arange(nbytes // 8, dtype=np.float64)
+
+        def body(comm, x=x):
+            return comm.allreduce(x + comm.rank)
+
+        ref = x * N + sum(range(N))
+        for out in run_ranks(N, body):
+            assert out.tobytes() == ref.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# the fallback ladder
+# ---------------------------------------------------------------------------
+
+def test_noncommutative_falls_back_and_counts():
+    matmul = op_mod.create_op(lambda a, b: a @ b, commutative=False)
+    before = trace.counters["coll_shm_fallback_total"]
+
+    def body(comm):
+        return comm.allreduce(np.array([[1.0, comm.rank + 1], [0.0, 1.0]]),
+                              op=matmul)
+
+    want = np.array([[1.0, float(sum(range(1, N + 1)))], [0.0, 1.0]])
+    for out in run_ranks(N, body):
+        np.testing.assert_allclose(out, want)
+    assert trace.counters["coll_shm_fallback_total"] > before
+
+
+def test_oversized_bcast_verdict_travels_in_descriptor():
+    """Only the root can see the payload; non-roots still take the host
+    branch because the verdict rides the arena descriptor round."""
+    before = trace.counters["coll_shm_fallback_total"]
+    cap = int(var_registry.get("coll_shm_arena_size"))
+    big = np.arange(cap // 8 + 16, dtype=np.float64)
+
+    def body(comm):
+        return comm.bcast(big if comm.rank == 0 else None, root=0)
+
+    for out in run_ranks(N, body):
+        np.testing.assert_array_equal(out, big)
+    assert trace.counters["coll_shm_fallback_total"] >= before + N
+
+
+def test_disable_var_reverts_to_host():
+    var_registry.set("coll_shm_enable", False)
+    try:
+        def body(comm):
+            out = comm.allreduce(np.ones(4))
+            return dict(comm.coll.providers)
+
+        provs = run_ranks(2, body)[0]
+        assert provs["allreduce"] == "host"
+    finally:
+        var_registry.set("coll_shm_enable", True)
+
+
+def test_forced_host_algorithm_outranks_the_arena():
+    """An explicit coll_host_*_algorithm force is user tuning the
+    shortcut must not override."""
+    var_registry.set("coll_host_allreduce_algorithm", "ring")
+    before = trace.counters["coll_shm_fallback_total"]
+    try:
+        def body(comm):
+            return comm.allreduce(np.arange(4.0) + comm.rank)
+
+        for out in run_ranks(N, body):
+            np.testing.assert_allclose(out, np.arange(4.0) * N
+                                       + sum(range(N)))
+    finally:
+        var_registry.set("coll_host_allreduce_algorithm", "")
+    assert trace.counters["coll_shm_fallback_total"] > before
+
+
+# ---------------------------------------------------------------------------
+# hierarchical dispatch (mixed-host communicators)
+# ---------------------------------------------------------------------------
+
+def _hier_body(hosts):
+    def body(comm):
+        comm._io_host_override = hosts[comm.rank]
+        comm.barrier()
+        a = comm.allreduce(np.arange(5.0) + comm.rank * 10)
+        b = comm.bcast(np.array([3.0, 1.0, 4.0]) if comm.rank == 1 else None,
+                       root=1)
+        g = comm.allgather(np.array([comm.rank, comm.rank * comm.rank]))
+        r = comm.reduce(np.array([float(comm.rank + 1)]), root=2)
+        st = comm._coll_shm_state
+        return a, b, g, r, st.mode, st.node.size
+    return body
+
+
+@pytest.mark.parametrize("hosts", [
+    ("a", "a", "b", "b"),     # 2+2
+    ("a", "b", "b", "b"),     # 1+3
+    ("a", "b", "a", "b"),     # interleaved node membership
+])
+def test_hierarchical_composition(hosts):
+    n = len(hosts)
+    res = run_ranks(n, _hier_body(list(hosts)))
+    want_a = np.arange(5.0) * n + 10 * sum(range(n))
+    for rank, (a, b, g, r, mode, _) in enumerate(res):
+        assert mode == "hier"
+        np.testing.assert_allclose(a, want_a)
+        np.testing.assert_array_equal(b, np.array([3.0, 1.0, 4.0]))
+        np.testing.assert_array_equal(
+            g, np.array([[i, i * i] for i in range(n)]))
+        if rank == 2:
+            np.testing.assert_allclose(r, [float(sum(range(1, n + 1)))])
+        else:
+            assert r is None
+
+
+def test_hierarchy_cached_on_comm():
+    """The split_type sub-comm and leader comm are built once and ride
+    the communicator."""
+    def body(comm):
+        comm._io_host_override = "h" + str(comm.rank % 2)
+        comm.allreduce(np.ones(2))
+        st1 = comm._coll_shm_state
+        comm.allreduce(np.ones(2))
+        st2 = comm._coll_shm_state
+        assert st1 is st2 and st1.node is st2.node
+        assert (st1.leader is None) == (st1.node.rank != 0)
+        return st1.mode
+
+    assert run_ranks(4, body) == ["hier"] * 4
+
+
+def test_all_singleton_hosts_settle_on_host_mode():
+    def body(comm):
+        comm._io_host_override = f"solo{comm.rank}"
+        out = comm.allreduce(np.array([comm.rank + 1.0]))
+        return float(out[0]), comm._coll_shm_state.mode
+
+    for total, mode in run_ranks(3, body):
+        assert total == 6.0 and mode == "host"
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+
+def test_fanin_fanout_pvars_tick():
+    a0 = trace.counters["coll_shm_fanin_total"]
+    o0 = trace.counters["coll_shm_fanout_total"]
+
+    def body(comm):
+        comm.allreduce(np.ones(4))
+        comm.bcast(np.ones(4) if comm.rank == 0 else None, root=0)
+        comm.barrier()
+
+    run_ranks(2, body)
+    assert trace.counters["coll_shm_fanin_total"] >= a0 + 2 * 2
+    assert trace.counters["coll_shm_fanout_total"] >= o0 + 2 * 2
+
+
+def test_pvars_registered_and_in_metrics_snapshot():
+    from ompi_tpu.mpi.mpit import pvar_registry
+
+    for name in ("coll_shm_fanin_total", "coll_shm_fanout_total",
+                 "coll_shm_fallback_total"):
+        assert pvar_registry.lookup(name).read() >= 0
+    snap = trace.metrics_snapshot()
+    assert "ompi_tpu_coll_shm_fanin_total" in snap
+
+
+def test_free_closes_the_arena():
+    def body(comm):
+        comm.allreduce(np.ones(2))
+        st = comm._coll_shm_state
+        assert st.arena is not None
+        comm.free()
+        assert comm._coll_shm_state is None
+        assert st.arena is None    # state closed its mapping
+        return True
+
+    assert all(run_ranks(2, body))
+
+
+def test_revoked_comm_aborts_arena_wait():
+    """A revoked communicator must not leave peers spinning for the
+    full coll_shm_timeout."""
+    from ompi_tpu.mpi.constants import MPIException
+
+    def body(comm):
+        comm.allreduce(np.ones(2))          # build the arena
+        if comm.rank == 0:
+            comm.revoke()
+            return "revoked"
+        # rank 1 enters a collective rank 0 will never join
+        try:
+            comm.allreduce(np.ones(2))
+        except MPIException as e:
+            return "raised" if "revoked" in str(e).lower() else str(e)
+        return "no-raise"
+
+    res = run_ranks(2, body, timeout=30.0)
+    assert res[0] == "revoked" and res[1] == "raised"

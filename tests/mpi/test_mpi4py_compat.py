@@ -454,8 +454,12 @@ def test_file_views_seek_shared_ordered(tmp_path_factory):
     def fn(comm):
         rank, size = comm.rank, comm.size
         f = MPI.File.Open(comm, path, MPI.MODE_RDWR | MPI.MODE_CREATE)
-        # mpi4py idiom: scalar etype + scalar filetype view
-        f.Set_view(disp=8 * rank, etype=MPI.DOUBLE, filetype=MPI.DOUBLE)
+        # mpi4py idiom: scalar etype + scalar filetype view.  The disp
+        # stride must keep the 2-double windows DISJOINT across ranks:
+        # concurrent overlapping access without atomic mode is undefined
+        # per MPI-IO, and the coll/shm barrier releases ranks close
+        # enough together to surface the race an 8*rank stride had.
+        f.Set_view(disp=16 * rank, etype=MPI.DOUBLE, filetype=MPI.DOUBLE)
         f.Write_at(0, np.full(2, float(rank)))   # offsets in DOUBLEs
         f.Seek(0)
         assert f.Get_position() == 0

@@ -1,0 +1,184 @@
+"""On-node collective microbench: coll/shm arena vs coll/host p2p.
+
+Latency-vs-size for allreduce / bcast / barrier on an in-process
+multi-rank world (the tests/mpi harness topology: one PML per rank,
+real matching, real shm-BTL rings for the host path — the same rig the
+58 µs/hop scheduler-floor number was measured on), run twice per
+config: once with the coll/shm arena enabled and once forced to
+coll/host (``coll_shm_enable 0``).  The per-op number is wall time of
+a synchronized loop divided by iterations, best of ``--reps`` runs —
+the two-point/best-of discipline bench.py uses, collective form.
+
+Rows append to ``COLL_BENCH.jsonl`` next to the repo root (the
+PACK_BENCH.jsonl convention — append-only, one JSON object per line)
+so the shm-vs-host crossover table in PERF.md stays reproducible.
+
+Run: ``python tools/coll_bench.py [--quick] [--ranks 4]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ompi_tpu.core.config import var_registry  # noqa: E402
+from ompi_tpu.mpi.coll import shm as _shm  # noqa: E402,F401 — register vars
+from ompi_tpu.mpi.comm import Communicator  # noqa: E402
+from ompi_tpu.mpi.group import Group  # noqa: E402
+from ompi_tpu.mpi.pml import PmlOb1  # noqa: E402
+
+_OUT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "COLL_BENCH.jsonl")
+
+
+def _run_world(n: int, fn, timeout: float = 300.0) -> list:
+    """In-process n-rank world (tests/mpi/harness.run_ranks, inlined so
+    the tool has no test-tree import)."""
+    pmls = [PmlOb1(r) for r in range(n)]
+    addrs = {r: p.address for r, p in enumerate(pmls)}
+    for p in pmls:
+        p.set_peers(addrs)
+    comms = [Communicator(Group(range(n)), cid=0, pml=pmls[r],
+                          my_world_rank=r, name=f"bench{n}")
+             for r in range(n)]
+    results: list = [None] * n
+    errors: list = []
+
+    def runner(rank: int) -> None:
+        try:
+            results[rank] = fn(comms[rank])
+        except BaseException as e:  # noqa: BLE001
+            errors.append((rank, e))
+
+    threads = [threading.Thread(target=runner, args=(r,), daemon=True)
+               for r in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout)
+    try:
+        if any(t.is_alive() for t in threads):
+            raise TimeoutError(f"bench ranks hung (errors: {errors})")
+        if errors:
+            raise errors[0][1]
+    finally:
+        if not any(t.is_alive() for t in threads):
+            for p in pmls:
+                p.close()
+    return results
+
+
+def _time_coll(n: int, coll: str, nbytes: int, iters: int,
+               reps: int) -> float:
+    """Per-op µs: synchronized loop wall time / iters, best of reps."""
+    elems = max(nbytes // 8, 1) if nbytes else 0
+
+    def body(comm):
+        if nbytes:
+            x = np.arange(elems, dtype=np.float64) + comm.rank
+
+        def one(i: int) -> None:
+            if coll == "allreduce":
+                comm.allreduce(x)
+            elif coll == "bcast":
+                # rotating root (the IMB discipline): iteration i's root
+                # was a receiver in iteration i-1, so a fixed root can't
+                # run ahead enqueueing asynchronous sends — the loop
+                # measures per-op completion, not enqueue throughput
+                root = i % comm.size
+                comm.bcast(x if comm.rank == root else None, root=root)
+            else:
+                comm.barrier()
+
+        best = float("inf")
+        comm.barrier()                       # warm transports + arena
+        one(0)
+        for _ in range(reps):
+            comm.barrier()
+            t0 = time.perf_counter()
+            for i in range(iters):
+                one(i)
+            best = min(best, time.perf_counter() - t0)
+        return best / iters * 1e6
+
+    # the slowest rank's best defines the collective's latency
+    return max(_run_world(n, body))
+
+
+def bench_config(n: int, coll: str, nbytes: int, iters: int, reps: int,
+                 quick: bool) -> list[dict]:
+    rows = []
+    for component, enable in (("shm", True), ("host", False)):
+        var_registry.set("coll_shm_enable", enable)
+        us = _time_coll(n, coll, nbytes, iters, reps)
+        rows.append({
+            "bench": "coll_bench",
+            "coll": coll,
+            "ranks": n,
+            "payload_bytes": nbytes,
+            "component": component,
+            "per_op_us": round(us, 2),
+            "iters": iters,
+            "reps": reps,
+            "n_cores": os.cpu_count(),
+            "quick": quick,
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        })
+    var_registry.set("coll_shm_enable", True)
+    a, b = rows[0]["per_op_us"], rows[1]["per_op_us"]
+    speedup = b / a if a else float("inf")
+    for r in rows:
+        r["shm_speedup"] = round(speedup, 2)
+    print(f"{coll:>9} {nbytes:>9}B x{n}: shm {a:9.1f}us  "
+          f"host {b:9.1f}us  ({speedup:.2f}x)")
+    return rows
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        description="on-node shm-vs-host collective latency")
+    ap.add_argument("--ranks", type=int, default=4)
+    ap.add_argument("--quick", action="store_true",
+                    help="CI smoke sizing: fewer sizes, fewer iters")
+    ap.add_argument("--out", default=_OUT)
+    args = ap.parse_args()
+
+    if args.quick:
+        sizes = [64, 8 << 10, 256 << 10]
+        iters, reps = 30, 2
+    else:
+        sizes = [8, 64, 1 << 10, 8 << 10, 64 << 10, 256 << 10, 1 << 20]
+        iters, reps = 50, 3
+
+    rows = bench_config(args.ranks, "barrier", 0, iters, reps, args.quick)
+    for coll in ("allreduce", "bcast"):
+        for nbytes in sizes:
+            it = max(5, iters // 4) if nbytes >= (256 << 10) else iters
+            rows += bench_config(args.ranks, coll, nbytes, it, reps,
+                                 args.quick)
+
+    with open(args.out, "a", encoding="utf-8") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    print(f"{len(rows)} rows -> {args.out}")
+
+    wins = {(r["coll"], r["payload_bytes"]) for r in rows
+            if r["component"] == "shm" and r["shm_speedup"] > 1.0}
+    for coll in ("allreduce", "bcast"):
+        n_wins = sum(1 for c, _ in wins if c == coll)
+        print(f"{coll}: shm faster at {n_wins} payload size(s)")
+        if n_wins < 2:
+            print(f"WARNING: expected shm to win >=2 sizes for {coll}")
+
+
+if __name__ == "__main__":
+    main()
