@@ -36,6 +36,7 @@ recomputes that expectation and asserts it.
 
 import os
 import sys
+import time
 
 import numpy as np
 
@@ -59,6 +60,7 @@ def main() -> int:
     while step < steps:
         faultinject.step()   # a plan's kill@step fires here (or no-op)
         ok = True
+        t_op = time.monotonic()
         try:
             got = comm.allreduce(np.array([float(my_id * 10 + step)]))
             result = float(got[0])
@@ -66,6 +68,11 @@ def main() -> int:
             if e.error_class not in (ERR_PROC_FAILED, ERR_REVOKED):
                 raise
             ok, result = False, 0.0
+            # time-to-error: how long the collective blocked before the
+            # failure surfaced (chaos_soak asserts this stays in the
+            # detector window, nowhere near the 60 s coll_shm_timeout)
+            print(f"id {my_id} detect_dt={time.monotonic() - t_op:.2f}",
+                  flush=True)
             # revoke BEFORE agreeing: survivors still blocked in the
             # collective are waiting on ranks that already errored out —
             # the revocation is what unblocks them into the agree below

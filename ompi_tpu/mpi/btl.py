@@ -430,6 +430,17 @@ class BtlEndpoint:
         if self.proc_btl is not None:
             self.proc_btl.set_alias(peer, my_id)
 
+    def peer_alive(self, peer: int) -> Optional[bool]:
+        """Same-host pid-liveness: route the question to the shm BTL's
+        shared, rate-limited probe (the pid travels in the peer's shm
+        business-card segment).  None when unknowable — remote peer, shm
+        disabled, or no pid in the card — True/False otherwise."""
+        if self.shm_btl is None or peer == self.rank:
+            return None if self.shm_btl is None else True
+        card = self._cards.get(peer)
+        shm_seg = self._split_card(card)[1] if card else None
+        return self.shm_btl.probe_alive(peer, shm_seg)
+
     def max_peer_id(self) -> int:
         """Highest peer id this endpoint knows (for dpm namespace bases)."""
         if self.tcp_btl is None:

@@ -477,26 +477,47 @@ class ShmBTL:
             self._peer_pid[peer] = pid
             return True
 
-    def _check_alive(self, peer: int) -> None:
-        """Receiver-liveness probe, time-bounded: the kill(2) syscall runs
-        at most once per peer per 50ms, so the inline sendi fast path pays
-        a dict lookup in steady state (death detection is delayed by at
-        most the bound — the park/heal layer absorbs that)."""
+    def probe_alive(self, peer: int,
+                    card: Optional[str] = None) -> Optional[bool]:
+        """Pid-liveness probe, time-bounded and cache-SHARED with the
+        send path (``_check_alive``): the kill(2) syscall runs at most
+        once per peer per 50ms no matter how many layers ask.  ``card``
+        (the peer's shm business-card segment) supplies the pid when no
+        ring was ever connected — the coll/shm arena probes writers it
+        may never have exchanged a PML frame with.  Returns None when the
+        pid is unknowable, True/False otherwise."""
         pid = self._peer_pid.get(peer)
-        if pid is None or pid == os.getpid():
-            return
+        if pid is None and card:
+            host, _inbox, cpid = self._parse_card(card)
+            if host == self.hostname and cpid is not None:
+                # a different host's pid namespace would alias — only a
+                # same-host card's pid is probeable
+                pid = cpid
+                self._peer_pid.setdefault(peer, pid)
+        if pid is None:
+            return None
+        if pid == os.getpid():
+            return True
         now = time.monotonic()
         if now < self._alive_until.get(peer, 0.0):
-            return
+            return True
         try:
             os.kill(pid, 0)
         except ProcessLookupError:
-            raise PeerDeadError(
-                f"btl/shm: rank {peer} (pid {pid}) is gone — dropping the "
-                f"orphaned ring") from None
+            return False
         except PermissionError:
             pass   # alive under another uid
         self._alive_until[peer] = now + 0.05
+        return True
+
+    def _check_alive(self, peer: int) -> None:
+        """Send-path arm of the probe: raise instead of answering (death
+        detection is delayed by at most the cache bound — the park/heal
+        layer absorbs that)."""
+        if self.probe_alive(peer) is False:
+            raise PeerDeadError(
+                f"btl/shm: rank {peer} (pid {self._peer_pid.get(peer)}) "
+                f"is gone — dropping the orphaned ring") from None
 
     def drop_peer(self, peer: int) -> None:
         """Forget a peer's (stale) ring so the next send reconnects from

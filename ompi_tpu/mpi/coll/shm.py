@@ -89,6 +89,29 @@ def _arena_dtype_ok(dtype: np.dtype) -> bool:
     return not dtype.hasobject and dtype.itemsize > 0
 
 
+_grace_warned = False
+
+
+def _probe_grace(timeout: float) -> float:
+    """Validated writer-probe grace: must sit strictly inside the
+    coll_shm_timeout fallback deadline (a grace at or past the timeout
+    would disable the probe exactly when it matters) — clamped to half
+    the timeout with a one-time warning, the same hygiene rule the
+    heartbeat/gossip windows apply."""
+    global _grace_warned
+    grace = float(var_registry.get("coll_shm_probe_grace") or 0)
+    if grace <= 0:
+        return 0.0
+    if grace >= timeout:
+        if not _grace_warned:
+            _grace_warned = True
+            _log.verbose(0, "coll/shm: probe grace %.1fs >= timeout "
+                         "%.1fs; clamping to %.1fs", grace, timeout,
+                         timeout / 2)
+        grace = timeout / 2
+    return grace
+
+
 def _desc_dtype_ok(dtype: np.dtype) -> bool:
     """Reconstructible from the 32-byte descriptor field: extension
     dtypes (bfloat16 & co.) stringify to a raw void ('<V2') that would
@@ -111,11 +134,18 @@ class Arena:
     """
 
     def __init__(self, seg: shmseg.SharedSegment, size: int, rank: int,
-                 slot_bytes: int) -> None:
+                 slot_bytes: int, world=None, pml=None) -> None:
         self.seg = seg
         self.size = size
         self.rank = rank
         self.slot_bytes = slot_bytes
+        # arena rank → world rank, plus the pml whose btl owns the
+        # pid-liveness probe: a writer dying between flag stores leaves
+        # peers nothing to observe but its pid, so the wait loop probes
+        # the expected writer after a short grace instead of spinning out
+        # the full coll_shm_timeout
+        self.world = list(world) if world is not None else None
+        self._pml = pml
         self.half = (slot_bytes // 2) & ~7
         self._flags = seg.buf[:2 * size * _CACHELINE].cast("Q")
         self._desc_base = 2 * size * _CACHELINE
@@ -155,7 +185,11 @@ class Arena:
         if f[idx] >= v:
             return
         timeout = float(var_registry.get("coll_shm_timeout") or 60)
-        deadline = time.monotonic() + timeout
+        grace = _probe_grace(timeout) if (self.world is not None
+                                          and self._pml is not None) else 0.0
+        now = time.monotonic()
+        deadline = now + timeout
+        probe_at = now + grace if grace > 0 else None
         spins = 0
         delay = 2e-5
         while f[idx] < v:
@@ -167,12 +201,43 @@ class Arena:
             delay = min(delay * 2, 1e-3)
             if comm is not None:
                 self._check_ft(comm)
+            if probe_at is not None and time.monotonic() > probe_at:
+                # the probe itself is rate-limited (shared btl cache), so
+                # asking every escalated iteration stays cheap
+                self._probe_writer((idx // 8) % self.size, grace, timeout)
             if time.monotonic() > deadline:
                 raise MPIException(
                     f"coll/shm: arena wait (flag {idx // 8}, want {v}, "
                     f"have {int(f[idx])}) stuck for {timeout:.0f}s on "
                     f"{getattr(comm, 'name', '?')} — peer dead or "
                     f"collective-order mismatch (coll_shm_timeout)")
+
+    def _probe_writer(self, writer: int, grace: float,
+                      timeout: float) -> None:
+        """The expected writer's flag has not moved past the grace: ask
+        the btl pid-liveness probe (cache shared with the send path —
+        one kill(2) per peer per 50ms across all layers) whether the pid
+        still exists, and fail the collective in ~the grace window
+        instead of the full coll_shm_timeout when it does not."""
+        if writer == self.rank:
+            return
+        w = self.world[writer]
+        ep = getattr(self._pml, "endpoint", None)
+        if ep is None or ep.peer_alive(w) is not False:
+            return
+        trace_mod.count("coll_shm_writer_dead_total")
+        reason = "coll/shm: writer pid gone mid-collective (arena probe)"
+        ft = getattr(self._pml, "ft", None)
+        if ft is not None:
+            # same dead-set the PMIx path feeds: posted recvs, parked
+            # sends, and every later arena wait fail fast too
+            ft.detector.mark_failed(w, reason)
+        from ompi_tpu.mpi.constants import ERR_PROC_FAILED
+
+        raise MPIException(
+            f"coll/shm: rank {w} (arena writer) died mid-collective — "
+            f"pid probe after {grace:.1f}s grace, not the "
+            f"{timeout:.0f}s coll_shm_timeout", error_class=ERR_PROC_FAILED)
 
     @staticmethod
     def _check_ft(comm) -> None:
@@ -452,6 +517,7 @@ def _make_arena(comm) -> Optional[Arena]:
 
     p = comm.size
     slot = _slot_bytes(p)
+    world = list(comm.group.ranks)   # arena rank → world rank (probes)
     seg = None
     path = ""
     if comm.rank == 0:
@@ -469,12 +535,13 @@ def _make_arena(comm) -> Optional[Arena]:
     ok = 0
     if comm.rank == 0:
         if seg is not None:
-            arena = Arena(seg, p, 0, slot)
+            arena = Arena(seg, p, 0, slot, world=world, pml=comm.pml)
             ok = 1
     elif path:
         try:
             aseg = shmseg.attach_retry(path, timeout=10.0)
-            arena = Arena(aseg, p, comm.rank, slot)
+            arena = Arena(aseg, p, comm.rank, slot, world=world,
+                          pml=comm.pml)
             ok = 1
         except OSError as e:
             _log.verbose(1, "coll/shm: arena attach failed (%s)", e)
@@ -547,6 +614,12 @@ class ShmColl(Component):
                      "seconds an arena flag wait may stall before raising "
                      "(a dead peer or collective-order mismatch leaves "
                      "flags behind forever)")
+        register_var("coll", "shm_probe_grace", VarType.DOUBLE, 1.0,
+                     "seconds an arena wait stalls before probing the "
+                     "expected writer's pid via the btl liveness probe "
+                     "(0 = disabled); a SIGKILLed writer then fails its "
+                     "peers in ~this window instead of coll_shm_timeout. "
+                     "Validated to stay below coll_shm_timeout")
 
     def query(self, comm=None, **ctx) -> Optional[int]:
         if not var_registry.get("coll_shm_enable"):

@@ -9,8 +9,14 @@ job-level event the errmgr alone decides about and becomes something
 - :class:`FailureDetector` — the per-process view of which world ranks
   are dead.  Fed by the runtime control plane (the PMIx server's
   dead-set, which the launcher's reap loop and the RML heartbeat monitor
-  maintain) via rate-limited polling plus a background watcher, and by
-  local marks (transport evidence, fault injection, tests).
+  maintain) via rate-limited polling plus a background watcher, by
+  local marks (transport evidence, arena pid probes, fault injection,
+  tests), and — with ``ft_gossip_period`` > 0 — by rank-plane gossip
+  heartbeats: epoch beats with piggybacked peer views on the FT control
+  plane, so a hung-but-alive rank (SIGSTOP, wedged host thread) the
+  daemon heartbeat layer cannot see is declared suspect in the gossip
+  window and pushed back to the runtime (``report_failed``) for pid
+  reaping.
 - ``Comm.revoke()`` — poison a communicator everywhere: in-flight and
   future operations on its cid fail with MPI_ERR_REVOKED.  Propagated by
   flooding: every process that learns of the revocation forwards it once
@@ -26,7 +32,14 @@ job-level event the errmgr alone decides about and becomes something
   the next agree's coordinator re-derives membership from the detector,
   and the recipients of the partial decision all hold the SAME value
   (the decision is computed once), so divergence cannot occur; what can
-  be lost is only progress, repaired by the retry loop.
+  be lost is only progress, repaired by the retry loop (and bounded by
+  the detector window once gossip heartbeats are armed).  Memory is
+  bounded by **acked-decision watermarks**: every returned agree acks
+  the decider (``agree_a``), the decider broadcasts the slowest live
+  member's watermark as a GC floor (``agree_g``), and every per-(cid,
+  seq) state at or below the floor is reclaimed
+  (``ft_agree_gc_reclaimed_total``) — dead members are excluded from
+  the minimum so their unacked seqs cannot pin memory forever.
 - ``Comm.shrink()`` — agree on the failed set, then build a new
   communicator over the survivors with a deterministically derived cid
   (the same negative-namespace hash construction comm.create_group
@@ -81,6 +94,31 @@ register_var("ft", "agree_timeout", VarType.DOUBLE, 60.0,
              "MPI_ERR_PROC_FAILED (protocol livelock guard)")
 register_var("ft", "agree_retry_interval", VarType.DOUBLE, 0.1,
              "seconds between agreement retransmissions")
+register_var("ft", "gossip_period", VarType.DOUBLE, 0.0,
+             "seconds between rank-plane gossip liveness beats (0 = "
+             "disabled).  Beats ride the FT control plane and carry the "
+             "sender's view of every peer's epoch, so an in-host hang — "
+             "alive pid, silent rank, invisible to the daemon-level "
+             "heartbeats — is declared suspect by its peers and fed into "
+             "the same failure-detector dead-set the PMIx path feeds")
+register_var("ft", "gossip_timeout", VarType.DOUBLE, 2.0,
+             "seconds a peer's gossip epoch may stand still before the "
+             "peer is declared suspect (clamped to >= 2x "
+             "ft_gossip_period — a shorter window would declare every "
+             "healthy rank dead between beats)")
+
+
+def gossip_window() -> float:
+    """The effective suspect window: ``ft_gossip_timeout`` clamped to at
+    least two beat intervals (the same hygiene rule the daemon heartbeat
+    monitor applies to its own pair of vars)."""
+    period = float(var_registry.get("ft_gossip_period") or 0)
+    timeout = float(var_registry.get("ft_gossip_timeout") or 0)
+    if period > 0 and timeout < 2 * period:
+        _log.verbose(0, "gossip: timeout %.2fs < 2x period %.2fs; "
+                     "clamping to %.2fs", timeout, period, 2 * period)
+        return 2 * period
+    return timeout
 
 
 class FailureDetector:
@@ -101,6 +139,7 @@ class FailureDetector:
         self._reasons: dict[int, str] = {}
         self._lock = threading.Lock()
         self._listeners: list = []
+        self._revive_listeners: list = []
         self._client = None
         self._last_poll = 0.0
         self._watch_stop: Optional[threading.Event] = None
@@ -146,6 +185,12 @@ class FailureDetector:
         with self._lock:
             self._listeners.append(cb)
 
+    def add_revive_listener(self, cb) -> None:
+        """cb(world_rank) fires when a runtime poll un-declares a death
+        (errmgr/respawn brought the rank back)."""
+        with self._lock:
+            self._revive_listeners.append(cb)
+
     # -- querying ----------------------------------------------------------
 
     def is_dead(self, world_rank: int, poll: bool = True) -> bool:
@@ -185,6 +230,13 @@ class FailureDetector:
             self._dead -= revived   # errmgr/respawn brought them back
             for r in revived:
                 self._reasons.pop(r, None)
+            revive_cbs = list(self._revive_listeners) if revived else []
+        for r in revived:
+            for cb in revive_cbs:
+                try:
+                    cb(r)
+                except Exception as e:  # noqa: BLE001 — detector survives
+                    _log.error("revive listener failed for %d: %r", r, e)
         for r, reason in failed.items():
             self.mark_failed(r, reason=reason or "runtime-declared")
 
@@ -197,16 +249,18 @@ class FailureDetector:
 class _AgreeState:
     """One agreement instance (comm cid × sequence number)."""
 
-    __slots__ = ("cv", "contribs", "decision")
+    __slots__ = ("cv", "contribs", "decision", "decider")
 
     def __init__(self) -> None:
         self.cv = threading.Condition()
         self.contribs: dict[int, tuple[int, frozenset]] = {}  # world → ...
         self.decision: Optional[tuple[int, tuple]] = None
+        self.decider: Optional[int] = None   # who computed it (ack target)
 
 
 class _CommFT:
-    """Per-communicator FT bookkeeping (agree sequencing, acked deaths)."""
+    """Per-communicator FT bookkeeping (agree sequencing, acked deaths,
+    and the acked-decision watermarks that bound agreement memory)."""
 
     def __init__(self, comm: "Communicator") -> None:
         self.comm_ref = weakref.ref(comm)
@@ -216,6 +270,15 @@ class _CommFT:
         self.acked: set[int] = set()
         self.states: dict[int, _AgreeState] = {}
         self.lock = threading.Lock()
+        # acked-decision watermarks: my_w = highest agree seq THIS rank
+        # has returned from; peer_w[r] = highest seq rank r confirmed
+        # (via agree_a acks and contrib piggybacks).  A state may be
+        # garbage-collected only once every LIVE member's watermark has
+        # passed it — until then some straggler may still retransmit its
+        # contribution and a decision-holder must be able to answer.
+        self.my_w = -1
+        self.peer_w: dict[int, int] = {}
+        self.gc_floor = -1   # states with seq <= gc_floor are reclaimed
 
     def state(self, seq: int) -> _AgreeState:
         with self.lock:
@@ -243,6 +306,15 @@ class PmlFT:
         self._pending: dict[int, "weakref.WeakSet"] = {}  # cid → recvs
         self._lock = threading.Lock()
         self.detector.add_listener(self._on_rank_dead)
+        # rank-plane gossip: world rank → [epoch, last-advance monotonic]
+        self._beats: dict[int, list] = {}
+        self._beat_epoch = 0
+        self._gossip_stop: Optional[threading.Event] = None
+
+    def close(self) -> None:
+        self.detector.close()
+        if self._gossip_stop is not None:
+            self._gossip_stop.set()
 
     # -- registration ------------------------------------------------------
 
@@ -377,6 +449,7 @@ class PmlFT:
     def on_ft_frame(self, peer: int, hdr: dict) -> None:
         """Dispatch one incoming FT frame (BTL reader thread: never
         block, sends only via the worker queue)."""
+        self._note_alive(peer)   # any FT frame is liveness evidence
         op = hdr.get("op")
         if op == "revoke":
             self._recv_revoke(hdr)
@@ -384,8 +457,135 @@ class PmlFT:
             self._recv_agree_contrib(peer, hdr)
         elif op == "agree_d":
             self._recv_agree_decision(hdr)
+        elif op == "agree_a":
+            self._recv_agree_ack(peer, hdr)
+        elif op == "agree_g":
+            self._recv_agree_gc(hdr)
+        elif op == "beat":
+            self._recv_beat(peer, hdr)
         else:
             _log.error("unknown ft op %r from %d", op, peer)
+
+    # -- rank-plane gossip heartbeats --------------------------------------
+
+    def arm_gossip(self, world) -> None:
+        """Start the low-rate background beat + suspect checker over the
+        given world ranks (no-op when ``ft_gossip_period`` is 0 or the
+        thread already runs).  Every rank's epoch clock starts NOW, so a
+        rank that hangs before ever beating is still caught."""
+        period = float(var_registry.get("ft_gossip_period") or 0)
+        if period <= 0 or self._gossip_stop is not None:
+            return
+        now = time.monotonic()
+        me = self.pml.rank
+        with self._lock:
+            for r in world:
+                self._beats.setdefault(int(r), [0, now])
+        self._gossip_stop = threading.Event()
+        self.detector.add_revive_listener(self._gossip_reset)
+        t = threading.Thread(target=self._gossip_loop,
+                             name=f"ft-gossip-{me}", daemon=True)
+        t.start()
+
+    def _note_alive(self, peer: int, epoch: Optional[int] = None) -> None:
+        """Direct evidence of life from ``peer`` — refreshes its clock
+        regardless of epoch arithmetic (a respawned incarnation restarts
+        at epoch 0 and must not look stalled)."""
+        with self._lock:
+            ent = self._beats.get(peer)
+            if ent is None:
+                self._beats[peer] = [int(epoch or 0), time.monotonic()]
+                return
+            if epoch is not None and epoch > ent[0]:
+                ent[0] = int(epoch)
+            ent[1] = time.monotonic()
+
+    def _gossip_reset(self, world_rank: int) -> None:
+        """A respawned rank restarts its epochs at 0: reset its entry so
+        the old (higher) epoch does not mask the new life as a stall."""
+        with self._lock:
+            if world_rank in self._beats:
+                self._beats[world_rank] = [0, time.monotonic()]
+
+    def _recv_beat(self, peer: int, hdr: dict) -> None:
+        """Merge one gossip beat: the sender's own epoch plus its view of
+        everyone else's — epochs spread transitively, so a rank two hops
+        away still sees progress it never heard directly."""
+        self._note_alive(peer, int(hdr.get("ep", 0)))
+        now = time.monotonic()
+        me = self.pml.rank
+        with self._lock:
+            for r, e in (hdr.get("v") or {}).items():
+                r, e = int(r), int(e)
+                if r in (me, peer):
+                    continue
+                ent = self._beats.get(r)
+                if ent is None:
+                    self._beats[r] = [e, now]
+                elif e > ent[0]:
+                    ent[0] = e
+                    ent[1] = now   # the epoch ADVANCED: that is progress
+
+    def _gossip_targets(self, world: list[int]) -> list[int]:
+        """Recursive-doubling fan-out: peers at distance 2^i in rank
+        order — log2(n) frames per beat, epidemic convergence in log2(n)
+        rounds (the standard gossip dissemination bound)."""
+        me = self.pml.rank
+        if me not in world:
+            return []
+        idx, n = world.index(me), len(world)
+        out, d = [], 1
+        while d < n:
+            peer = world[(idx + d) % n]
+            if peer != me and peer not in out:
+                out.append(peer)
+            d <<= 1
+        return out
+
+    def _gossip_loop(self) -> None:
+        period = float(var_registry.get("ft_gossip_period") or 0)
+        window = gossip_window()
+        me = self.pml.rank
+        stop = self._gossip_stop
+        while not stop.wait(period):
+            self._beat_epoch += 1
+            with self._lock:
+                world = sorted(self._beats)
+                view = {r: ent[0] for r, ent in self._beats.items()}
+            view[me] = self._beat_epoch
+            live = [r for r in world
+                    if not self.detector.is_dead(r, poll=False)]
+            for peer in self._gossip_targets(live):
+                self._send_ft(peer, {"t": "ft", "op": "beat",
+                                     "ep": self._beat_epoch,
+                                     "v": view, "n": 0})
+                trace_mod.count("ft_gossip_beats_total")
+            now = time.monotonic()
+            with self._lock:
+                stalled = [(r, now - ent[1]) for r, ent in
+                           self._beats.items()
+                           if r != me and now - ent[1] > window]
+            for r, silent_for in stalled:
+                if self.detector.is_dead(r, poll=False):
+                    continue
+                self._gossip_declare(r, silent_for)
+
+    def _gossip_declare(self, world_rank: int, silent_for: float) -> None:
+        """A peer's epoch stood still past the window: suspect → the same
+        dead-set the PMIx path feeds (posted recvs fail, arena waits
+        raise), and pushed to the runtime so the control plane can reap
+        the hung pid and every other rank's poll learns it."""
+        reason = (f"gossip: rank silent for {silent_for:.1f}s "
+                  f"(epoch stalled)")
+        if not self.detector.mark_failed(world_rank, reason):
+            return
+        client = self.detector._client
+        if client is not None:
+            try:
+                client.report_failed(world_rank, reason)
+            except Exception as e:  # noqa: BLE001 — control plane optional
+                _log.verbose(1, "gossip: report_failed(%d) failed: %r",
+                             world_rank, e)
 
     def _recv_revoke(self, hdr: dict) -> None:
         cid = hdr["cid"]
@@ -412,7 +612,11 @@ class PmlFT:
             # fine — contributions retransmit until our agree() call
             # creates the state.  Drop; the resend finds us ready.
             return
-        st = cft.state(hdr["aseq"])
+        seq = hdr["aseq"]
+        self._note_watermark(cft, int(hdr["from"]), hdr.get("w"))
+        if seq <= cft.gc_floor:
+            return  # fully-acked round: a stale retransmit, nothing to say
+        st = cft.state(seq)
         with st.cv:
             st.contribs[int(hdr["from"])] = (
                 int(hdr["flag"]), frozenset(int(r) for r in hdr["failed"]))
@@ -423,13 +627,14 @@ class PmlFT:
             # contributors converge on the already-computed value
             flag, failed = decision
             self._send_ft(peer, {"t": "ft", "op": "agree_d",
-                                 "cid": hdr["cid"], "aseq": hdr["aseq"],
+                                 "cid": hdr["cid"], "aseq": seq,
                                  "flag": flag, "failed": list(failed),
+                                 "from": self.pml.rank,
                                  "n": int(hdr.get("n", 0))})
 
     def _recv_agree_decision(self, hdr: dict) -> None:
         cft = self._comm_ft_by_cid(hdr["cid"])
-        if cft is None:
+        if cft is None or hdr["aseq"] <= cft.gc_floor:
             return
         st = cft.state(hdr["aseq"])
         with st.cv:
@@ -437,7 +642,70 @@ class PmlFT:
                 st.decision = (int(hdr["flag"]),
                                tuple(sorted(int(r)
                                             for r in hdr["failed"])))
+            if st.decider is None and "from" in hdr:
+                st.decider = int(hdr["from"])
             st.cv.notify_all()
+
+    # -- acked-decision watermarks + state GC ------------------------------
+
+    def _note_watermark(self, cft: _CommFT, peer: int, w) -> None:
+        if w is None:
+            return
+        with cft.lock:
+            if int(w) > cft.peer_w.get(peer, -1):
+                cft.peer_w[peer] = int(w)
+
+    def _recv_agree_ack(self, peer: int, hdr: dict) -> None:
+        """A member confirms it returned from agree seq <= w: record the
+        watermark; when every live member's watermark passed a seq, that
+        state can never be asked about again — reclaim and tell everyone."""
+        cft = self._comm_ft_by_cid(hdr["cid"])
+        if cft is None:
+            return
+        self._note_watermark(cft, int(hdr["from"]), hdr.get("w"))
+        self._maybe_gc(cft, hdr["cid"])
+
+    def _recv_agree_gc(self, hdr: dict) -> None:
+        cft = self._comm_ft_by_cid(hdr["cid"])
+        if cft is not None:
+            self._apply_gc_floor(cft, int(hdr["f"]))
+
+    def _apply_gc_floor(self, cft: _CommFT, floor: int) -> int:
+        """Reclaim every state at or below ``floor`` (monotonic)."""
+        with cft.lock:
+            if floor <= cft.gc_floor:
+                return 0
+            victims = [s for s in cft.states if s <= floor]
+            for s in victims:
+                del cft.states[s]
+            cft.gc_floor = floor
+        if victims:
+            trace_mod.count("ft_agree_gc_reclaimed_total", len(victims))
+        return len(victims)
+
+    def _maybe_gc(self, cft: _CommFT, cid: int) -> None:
+        """Advance the GC floor to the slowest LIVE member's watermark
+        and broadcast it — dead members are excluded (their unacked seqs
+        would otherwise pin memory forever, the exact leak this bounds)."""
+        me = self.pml.rank
+        with cft.lock:
+            floor = cft.my_w
+            for r in cft.group_ranks:
+                if r == me or self.detector.is_dead(r, poll=False):
+                    continue
+                floor = min(floor, cft.peer_w.get(r, -1))
+            cur = cft.gc_floor
+            live = [r for r in cft.group_ranks if r != me
+                    and not self.detector.is_dead(r, poll=False)]
+        if floor <= cur:
+            return
+        self._apply_gc_floor(cft, floor)
+        for peer in live:
+            # "aseq" carries the floor so every broadcast draws its own
+            # fault-injection verdict (GC floors are monotonic; a lost
+            # one is subsumed by the next)
+            self._send_ft(peer, {"t": "ft", "op": "agree_g", "cid": cid,
+                                 "aseq": floor, "f": floor, "n": 0})
 
     def agree(self, comm: "Communicator", flag: bool) -> tuple[bool, tuple]:
         """Blocking fault-tolerant agreement over ``comm``'s survivors →
@@ -474,11 +742,15 @@ class PmlFT:
                 self._send_ft(coord, {
                     "t": "ft", "op": "agree_c", "cid": comm.cid,
                     "aseq": seq, "from": me, "flag": int(bool(flag)),
-                    "failed": sorted(my_failed), "n": attempt})
+                    "failed": sorted(my_failed), "w": cft.my_w,
+                    "n": attempt})
                 if attempt % 8 == 0:
                     # sustained coordinator silence: gossip the
                     # contribution to everyone — any decision-holder
                     # replies, and a dead coordinator stops mattering
+                    # (with rank-plane gossip armed the detector usually
+                    # declares the corpse first, so re-election is
+                    # bounded by the detector window, not this schedule)
                     for peer in live[1:]:
                         if peer != me:
                             self._send_ft(peer, {
@@ -486,7 +758,7 @@ class PmlFT:
                                 "cid": comm.cid, "aseq": seq, "from": me,
                                 "flag": int(bool(flag)),
                                 "failed": sorted(my_failed),
-                                "n": attempt})
+                                "w": cft.my_w, "n": attempt})
                 with st.cv:
                     st.cv.wait_for(lambda: st.decision is not None,
                                    timeout=retry)
@@ -498,6 +770,18 @@ class PmlFT:
                     error_class=ERR_PROC_FAILED)
         with st.cv:
             dflag, dfailed = st.decision
+            decider = st.decider
+        # acked-decision watermark: this rank has RETURNED from seq — no
+        # frame for any seq <= my_w will ever leave here again, so once
+        # every live member's watermark passes a seq its state is garbage
+        with cft.lock:
+            cft.my_w = max(cft.my_w, seq)
+            my_w = cft.my_w
+        if decider is not None and decider != me:
+            self._send_ft(decider, {"t": "ft", "op": "agree_a",
+                                    "cid": comm.cid, "aseq": seq,
+                                    "from": me, "w": my_w, "n": 0})
+        self._maybe_gc(cft, comm.cid)
         if t0 and trace_mod.active:
             trace_mod.complete("ft", "agree", t0, rank=self.pml.rank,
                                cid=comm.cid, aseq=seq,
@@ -528,6 +812,7 @@ class PmlFT:
                 flag &= f
                 failed |= fl
             st.decision = (flag, tuple(sorted(failed)))
+            st.decider = self.pml.rank
             contributors = set(st.contribs) | set(live)
             decision = st.decision
         for peer in contributors:
@@ -535,7 +820,7 @@ class PmlFT:
                 self._send_ft(peer, {
                     "t": "ft", "op": "agree_d", "cid": cid, "aseq": seq,
                     "flag": decision[0], "failed": list(decision[1]),
-                    "n": 0})
+                    "from": self.pml.rank, "n": 0})
         return True
 
 
@@ -560,10 +845,14 @@ def pml_ft(pml: "PmlOb1") -> PmlFT:
 def attach_runtime(pml: "PmlOb1", client) -> None:
     """runtime.init wiring: arm the detector against the job's control
     plane so peer deaths the launcher/heartbeat monitor observed surface
-    as MPI_ERR_PROC_FAILED here."""
+    as MPI_ERR_PROC_FAILED here, and (when ``ft_gossip_period`` > 0)
+    start the rank-plane gossip heartbeats that catch in-host hangs the
+    daemon-level layer cannot see."""
     if client is None:
         return
-    pml_ft(pml).detector.attach_client(client)
+    ft = pml_ft(pml)
+    ft.detector.attach_client(client)
+    ft.arm_gossip(range(client.size))
 
 
 # -- Communicator-facing entry points (comm.py delegates here) -------------

@@ -512,7 +512,7 @@ class PmlOb1:
     def close(self) -> None:
         self._closed = True
         if self.ft is not None:
-            self.ft.detector.close()
+            self.ft.close()   # detector watcher + gossip beater
         self._sendq.put(None)
         self._worker.join(timeout=2.0)
         self.endpoint.close()

@@ -106,6 +106,17 @@ _COUNTER_SPECS = (
      "fault-tolerant agreements completed (Comm.agree / shrink)"),
     ("ft_shrinks_total", "communicators",
      "survivor communicators built by Comm.shrink"),
+    # failure containment v2 (gossip heartbeats, agree GC, arena probes)
+    ("ft_gossip_beats_total", "frames",
+     "rank-plane gossip liveness beats sent (epoch + peer-view frames "
+     "on the FT control plane; catches in-host hangs)"),
+    ("ft_agree_gc_reclaimed_total", "states",
+     "per-(cid, seq) agreement states reclaimed once every live "
+     "member's acked-decision watermark passed them"),
+    ("coll_shm_writer_dead_total", "ranks",
+     "arena waits that detected a dead writer pid via the shared btl "
+     "liveness probe (failure surfaced in ~coll_shm_probe_grace "
+     "seconds instead of coll_shm_timeout)"),
 )
 
 #: plain-int counter store: dict increments, no lock — losses under

@@ -16,7 +16,12 @@ job).  Components decide what a proc-failure event does:
 - ``notify``   — keep going AND propagate the failure to the survivors
   (PMIx dead-set + TAG_PROC_FAILED xcast + notifier event) so they can
   run user-level recovery: ``Comm.revoke()/shrink()/agree()`` from
-  ``ompi_tpu.mpi.ft`` — the ULFM shrink-and-continue recipe.
+  ``ompi_tpu.mpi.ft`` — the ULFM shrink-and-continue recipe.  On the
+  daemon tree, notify additionally arms mid-tree re-parenting: a
+  non-leaf orted's death no longer tears down its subtree via the
+  lifeline rule — the orphaned child daemons re-wire to the nearest
+  live ancestor (TAG_REPARENT handshake, HNP arbitrating), confining
+  the loss to the dead host's ranks.
 - ``respawn``  — revive the failed rank in place up to
   ``errmgr_max_restarts`` times (≈ rmaps/resilient + the errmgr restart
   paths): same rank and env plus ``OMPI_TPU_RESTART=<n>`` so the app can

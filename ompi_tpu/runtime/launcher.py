@@ -160,6 +160,11 @@ class LocalLauncher:
     def _st_launch(self, sm: StateMachine, job: Job) -> JobState:
         self.server = pmix.PMIxServer(
             size=job.np, on_abort=lambda r, s, m: self._on_abort(job, r, s, m))
+        # rank-plane gossip feedback: a client-reported hung rank (alive
+        # pid, silent to its peers) gets its pid reaped so the reap loop
+        # sees a real exit and the errmgr policy runs
+        self.server.on_failed_report = \
+            lambda r, reason: self._reap_reported(r, reason)
         for proc in job.procs:
             if not self._launch_proc(job, proc):
                 # Failure to start is fatal regardless of errmgr policy —
@@ -262,6 +267,21 @@ class LocalLauncher:
                 w.feed(None)  # EOF
 
         threading.Thread(target=pump, daemon=True).start()
+
+    def _reap_reported(self, rank: int, reason: str) -> None:
+        """SIGKILL one reported-dead rank (it is hung, not exited — a
+        SIGSTOP'd or deadlocked pid never reports on its own).  The reap
+        loop then accounts the exit and the errmgr policy decides."""
+        with self._kill_lock:
+            p = self._popen.get(rank)
+        if p is None or p.poll() is not None:
+            return
+        _log.verbose(1, "reaping reported-dead rank %d (pid %d): %s",
+                     rank, p.pid, reason or "gossip-declared")
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
 
     # -- abort path --------------------------------------------------------
 

@@ -90,6 +90,11 @@ class Orted:
         self._lock = threading.Lock()
         self._done = threading.Event()
         self._wired = threading.Event()
+        # re-parenting: armed by the WIRE payload when the errmgr policy
+        # tolerates daemon loss (notify) — a lost tree parent then opens
+        # a bounded adoption window instead of the lifeline teardown
+        self._reparent_ok = False
+        self._reparented = threading.Event()
         self.node.register_recv(rml.TAG_WIRE, self._on_wire)
         self.node.register_recv(rml.TAG_LAUNCH, self._on_launch)
         self.node.register_recv(rml.TAG_KILL, self._on_kill)
@@ -97,6 +102,9 @@ class Orted:
         self.node.register_recv(rml.TAG_RESPAWN, self._on_respawn)
         self.node.register_recv(rml.TAG_STATS, self._on_stats)
         self.node.register_recv(rml.TAG_PROC_FAILED, self._on_proc_failed)
+        self.node.register_recv(rml.TAG_REPARENT, self._on_reparent)
+        self.node.register_recv(rml.TAG_ADOPT, self._on_adopt)
+        self.node.register_recv(rml.TAG_KILL_RANK, self._on_kill_rank)
         self._spec: Optional[dict] = None
         self._my_rows: dict[int, tuple[int, Optional[int]]] = {}
         self.node.register_recv(rml.TAG_SHUTDOWN,
@@ -106,6 +114,9 @@ class Orted:
         # (≈ orted treating a lost lifeline as job abort, orted_main.c)
         self.node.on_peer_lost = self._on_lifeline_lost
         self._boot = self.node.dial_bootstrap(hnp_uri)
+        # while orphaned (tree parent dead, adoption pending) up-traffic
+        # — exit reports, heartbeats — rides the bootstrap link instead
+        self.node.fallback_up = self._boot
         self.node.send_direct(self._boot, rml.TAG_REGISTER,
                               (vpid, self.node.uri, self.hostname))
         # liveness beats toward the HNP (no-op when the period var is 0);
@@ -133,7 +144,11 @@ class Orted:
     # -- tree wiring -------------------------------------------------------
 
     def _on_wire(self, origin: int, payload) -> None:
-        children = payload  # [(vpid, uri), ...]
+        if isinstance(payload, dict):
+            children = payload["children"]   # [(vpid, uri), ...]
+            self._reparent_ok = bool(payload.get("reparent"))
+        else:
+            children = payload  # legacy list form
         try:
             self.node.dial_children([tuple(c) for c in children])
         except OSError as e:
@@ -150,14 +165,94 @@ class Orted:
         self.node.send_up(rml.TAG_DAEMON_READY, self.vpid)
 
     def _on_lifeline_lost(self, peer: int) -> None:
-        if peer not in (0, rml.tree_parent(self.vpid)):
+        if peer not in (0, self.node.parent_vpid):
             return  # a child daemon died; the HNP handles that
         if self._done.is_set():
             return  # normal teardown: SHUTDOWN already processed
+        if peer != 0 and self._reparent_ok:
+            # mid-tree parent death under the notify policy: do NOT apply
+            # the lifeline rule — report orphanhood on the bootstrap link
+            # and wait (bounded) for the HNP-arbitrated adoption, so loss
+            # stays confined to the dead host's ranks
+            _log.error("orted %d: tree parent %d lost; requesting "
+                       "re-parenting", self.vpid, peer)
+            self._reparented.clear()
+            try:
+                self.node.send_direct(self._boot, rml.TAG_ORPHANED,
+                                      (self.vpid, peer))
+            except OSError:
+                pass  # HNP unreachable too → the watch below tears down
+            threading.Thread(target=self._orphan_watch,
+                             daemon=True).start()
+            return
         _log.error("orted %d: lifeline to %d lost; tearing down", self.vpid,
                    peer)
         self._on_kill(peer, None)
         os._exit(1)
+
+    def _orphan_watch(self) -> None:
+        """Bounded adoption window: no TAG_REPARENT handshake within
+        ``rml_reparent_timeout`` seconds means the job really is coming
+        down — fall back to the lifeline teardown rather than leak."""
+        from ompi_tpu.core.config import var_registry
+
+        timeout = float(var_registry.get("rml_reparent_timeout") or 10.0)
+        if self._reparented.wait(timeout) or self._done.is_set():
+            return
+        _log.error("orted %d: no adoption within %.1fs; tearing down",
+                   self.vpid, timeout)
+        self._on_kill(0, None)
+        os._exit(1)
+
+    def _on_reparent(self, origin: int, payload) -> None:
+        """HNP arbitration reply (bootstrap link): expect ``payload``'s
+        hello as my new tree parent, then ack up the re-wired tree."""
+        new_parent = int(payload)
+        _log.verbose(1, "orted %d: re-parenting to %d", self.vpid,
+                     new_parent)
+        self.node.retarget_parent(new_parent)
+
+        def wire() -> None:
+            if not self.node.wait_parent(timeout=30.0):
+                return  # the orphan watch handles the teardown
+            self._reparented.set()
+            try:
+                self.node.send_up(rml.TAG_REPARENT_ACK,
+                                  (self.vpid, new_parent))
+            except (ConnectionError, OSError):
+                pass
+
+        threading.Thread(target=wire, daemon=True).start()
+
+    def _on_adopt(self, origin: int, payload) -> None:
+        """HNP adoption order (bootstrap link): dial the orphans as my
+        new tree children (the parent side always dials)."""
+        orphans = [tuple(c) for c in payload]
+
+        def dial() -> None:
+            try:
+                self.node.dial_children(orphans)
+            except OSError as e:
+                _log.error("orted %d: adopting %r failed: %r", self.vpid,
+                           [v for v, _u in orphans], e)
+
+        threading.Thread(target=dial, daemon=True).start()
+
+    def _on_kill_rank(self, origin: int, payload) -> None:
+        """Reap exactly one rank (a hung pid the rank-plane gossip
+        detector reported): SIGKILL its process group; the exit report
+        then flows through the normal waiter → errmgr path."""
+        rank = int(payload)
+        with self._lock:
+            p = self._popen.get(rank)
+        if p is None or p.poll() is not None:
+            return
+        _log.verbose(1, "orted %d: reaping reported-dead rank %d (pid %d)",
+                     self.vpid, rank, p.pid)
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
 
     # -- odls: local launch ------------------------------------------------
 

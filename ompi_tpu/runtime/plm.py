@@ -153,6 +153,9 @@ class MultiHostLauncher:
         self._exited: dict[int, int] = {}                  # rank → rc
         self._killed = False
         self._lost_daemon: Optional[int] = None            # vpid, if died
+        self._dead_daemons: set[int] = set()   # every vpid ever declared
+        # dead (link EOF / Popen / heartbeat / orphan report) — the
+        # idempotence guard AND the ancestry map re-parenting skips over
         self._np_hint = 1 << 30                            # set at launch
         self._cur_job: Optional[Job] = None
         self._persistent = False          # DVM mode: VM outlives jobs
@@ -189,6 +192,8 @@ class MultiHostLauncher:
         self.rml.register_recv(
             rml.TAG_PROC_EXIT,
             lambda o, p: self._on_proc_exit(self._cur_job, p))
+        self.rml.register_recv(rml.TAG_ORPHANED, self._on_orphaned)
+        self.rml.register_recv(rml.TAG_REPARENT_ACK, self._on_reparent_ack)
         self.rml.on_peer_lost = self._on_daemon_lost
         # liveness beats (rml_heartbeat_period > 0): any beat — or any
         # other up-traffic from the daemon — refreshes its clock; silence
@@ -226,10 +231,15 @@ class MultiHostLauncher:
         uris.update({v: u for v, (u, _h) in self._registered.items()})
         self.rml.dial_children(
             [(c, uris[c]) for c in rml.tree_children(0, total)])
+        # notify is the only policy that survives a daemon death, so it is
+        # the only one whose orphans should wait for adoption instead of
+        # applying the lifeline teardown — the flag rides the WIRE payload
+        reparent = getattr(self._errmgr, "NAME", "") == "notify"
         for v in range(1, total):
             children = [(c, uris[c]) for c in rml.tree_children(v, total)]
             self.rml.send_direct(self.rml.boot_links[v], rml.TAG_WIRE,
-                                 children)
+                                 {"children": children,
+                                  "reparent": reparent})
         with self._cv:
             ok = self._cv.wait_for(
                 lambda: (len(self._ready) >= n_daemons
@@ -257,6 +267,12 @@ class MultiHostLauncher:
         self.server = pmix.PMIxServer(
             size=job.np, host="0.0.0.0",
             on_abort=lambda r, s, m: self._on_abort(self._cur_job, r, s, m))
+        # rank-plane gossip feedback: a reported hung rank is reaped by
+        # its owning daemon (TAG_KILL_RANK) so the exit report flows and
+        # the errmgr policy runs — without this a SIGSTOP'd pid would
+        # stall _wait_ranks forever
+        self.server.on_failed_report = \
+            lambda r, reason: self._reap_reported(r, reason)
         app = job.apps[0]
         env = dict(app.env)
         # the xcast env overlays the daemons' os.environ (orted merge
@@ -416,30 +432,116 @@ class MultiHostLauncher:
             self._cv.notify_all()
 
     def _on_daemon_lost(self, vpid: int) -> None:
-        """A daemon vanished: RML link EOF (crash/SIGKILL/host death) or
-        heartbeat silence (hung host, half-open link).  Under the
-        ``notify`` errmgr policy the daemon's ranks become proc-failure
-        events propagated to the survivors and the job continues; every
-        other policy treats a lost daemon as a lost lifeline and aborts."""
+        """A daemon vanished: RML link EOF (crash/SIGKILL/host death),
+        heartbeat silence (hung host, half-open link), or an orphan's
+        report.  Under the ``notify`` errmgr policy the daemon's ranks
+        become proc-failure events propagated to the survivors, its
+        orphaned tree children re-wire to the nearest live ancestor, and
+        the job continues; every other policy treats a lost daemon as a
+        lost lifeline and aborts."""
         with self._cv:
+            if vpid in self._dead_daemons:
+                return  # several detectors race to the same corpse
+            self._dead_daemons.add(vpid)
             if self._killed or self._vm_stop.is_set() or (
                     not self._persistent
                     and len(self._exited) >= self._np_hint):
                 return  # normal teardown, not a failure
             job = self._cur_job
-            if (getattr(self._errmgr, "NAME", "") == "notify"
-                    and job is not None
-                    and 0 < vpid <= len(job.nodes)):
+            reparent = (getattr(self._errmgr, "NAME", "") == "notify"
+                        and job is not None
+                        and 0 < vpid <= len(job.nodes))
+            if reparent:
                 self._fail_daemon_ranks(job, vpid)
-                return
-            if self._lost_daemon is None:
-                self._lost_daemon = vpid
-            self._cv.notify_all()
+            else:
+                if self._lost_daemon is None:
+                    self._lost_daemon = vpid
+                self._cv.notify_all()
+        if reparent:
+            # confine the loss: the dead daemon's live children re-wire
+            # to their grandparent instead of applying the lifeline rule
+            self._reparent_orphans(vpid)
+            return
         from ompi_tpu.runtime.notifier import Severity, notify
 
         notify(Severity.CRITICAL, "daemon-lost",
                f"orted vpid {vpid} vanished (host death/crash); "
                f"aborting the job")
+
+    def _on_orphaned(self, origin: int, payload) -> None:
+        """An orphan's bootstrap-link report: its tree parent's link hit
+        EOF before any HNP-side detector fired — the fastest daemon-death
+        signal there is, so feed it into the same (idempotent) path."""
+        orphan, lost_parent = payload
+        _log.verbose(1, "orted %d reports parent %d lost", orphan,
+                     lost_parent)
+        self._on_daemon_lost(int(lost_parent))
+
+    def _reparent_orphans(self, dead_vpid: int) -> None:
+        """Arbitrate the re-wiring for ``dead_vpid``'s live tree
+        children: each orphan is told the adopter (TAG_REPARENT, direct),
+        the adopter is told to dial them (TAG_ADOPT, direct — parents
+        always dial).  Deeper descendants keep their live links; only the
+        severed edge is rebuilt."""
+        total = len(self._daemon_popen) + 1
+        with self._cv:
+            dead = set(self._dead_daemons)
+            registered = dict(self._registered)
+        orphans = [c for c in rml.tree_children(dead_vpid, total)
+                   if c not in dead and c in registered]
+        if not orphans:
+            return
+        adopter = rml.nearest_live_ancestor(dead_vpid, dead)
+        adoptees = []
+        for o in orphans:
+            boot = self.rml.boot_links.get(o)
+            if boot is None:
+                continue
+            try:
+                self.rml.send_direct(boot, rml.TAG_REPARENT, adopter)
+            except OSError as e:
+                _log.error("reparent order to orted %d failed: %r", o, e)
+                continue
+            adoptees.append((o, registered[o][0]))
+        if not adoptees:
+            return
+        _log.verbose(0, "re-parenting orteds %s under %d (vpid %d died)",
+                     [v for v, _u in adoptees], adopter, dead_vpid)
+        from ompi_tpu.mpi import trace as trace_mod
+
+        if trace_mod.active:
+            trace_mod.instant("errmgr", "reparent", rank=-1,
+                              dead_vpid=dead_vpid, adopter=adopter,
+                              orphans=[v for v, _u in adoptees])
+        try:
+            if adopter == 0:
+                self.rml.dial_children(adoptees)
+            else:
+                aboot = self.rml.boot_links.get(adopter)
+                if aboot is not None:
+                    self.rml.send_direct(aboot, rml.TAG_ADOPT, adoptees)
+        except OSError as e:
+            _log.error("adoption order under %d failed: %r", adopter, e)
+            return
+        from ompi_tpu.runtime.notifier import Severity, notify
+
+        notify(Severity.WARN, "daemon-reparent",
+               f"orted vpid {dead_vpid} died mid-tree; orphans "
+               f"{[v for v, _u in adoptees]} re-parented under vpid "
+               f"{adopter} (loss confined to the dead host)")
+
+    def _on_reparent_ack(self, origin: int, payload) -> None:
+        vpid, new_parent = payload
+        _log.verbose(1, "orted %d re-wired under %d", vpid, new_parent)
+
+    def _reap_reported(self, rank: int, reason: str) -> None:
+        """Order the owning daemon to SIGKILL one reported-hung rank."""
+        _log.verbose(1, "reaping reported-dead rank %d via the tree: %s",
+                     rank, reason or "gossip-declared")
+        try:
+            self.rml.xcast(rml.TAG_KILL_RANK, rank)
+        except Exception as e:  # noqa: BLE001 — tree may be tearing down
+            _log.error("kill-rank xcast for %d failed: %r", rank, e)
 
     def _fail_daemon_ranks(self, job: Job, vpid: int) -> None:
         """With self._cv held: a dead daemon's ranks can never report —

@@ -68,6 +68,11 @@ class PMIxServer:
                  host: str = "127.0.0.1") -> None:
         self.size = size
         self.on_abort = on_abort
+        # optional launcher hook for client-reported failures (a rank's
+        # gossip detector declaring a hung-but-alive peer): called once
+        # per newly-reported rank with (rank, reason) so the launcher can
+        # reap the pid — the exit report then drives the errmgr normally
+        self.on_failed_report: Optional[Callable[[int, str], None]] = None
         self._store: dict[str, Any] = {}
         self._cv = threading.Condition()
         self._fence_counts: dict[int, int] = {}
@@ -165,6 +170,34 @@ class PMIxServer:
                 self._cv.notify_all()
             if self.on_abort is not None:
                 self.on_abort(rank, status, msg)
+            return ("ok",)
+        if cmd == "report_failed":
+            # the reverse direction of "failed": an app rank PUSHES a
+            # death its rank-plane gossip detector observed (hung pid —
+            # alive to the daemon heartbeats, silent to its peers).  The
+            # dead-set gains it (so every other detector's poll sees it)
+            # and the launcher hook may reap the pid.
+            reporter, failed_rank, reason = args
+            failed_rank = int(failed_rank)
+            with self._cv:
+                fresh = failed_rank not in self._dead
+                if fresh:
+                    self._dead.add(failed_rank)
+                    if reason:
+                        self._failed_reasons[failed_rank] = str(reason)
+                    for epoch in list(self._fence_counts):
+                        if epoch not in self._fence_done:
+                            self._check_fence_done(epoch)
+                    self._cv.notify_all()
+            if fresh:
+                _log.verbose(1, "rank %s reported rank %d failed (%s)",
+                             reporter, failed_rank, reason)
+                if self.on_failed_report is not None:
+                    try:
+                        self.on_failed_report(failed_rank, str(reason))
+                    except Exception as e:  # noqa: BLE001 — server survives
+                        _log.error("on_failed_report(%d) failed: %r",
+                                   failed_rank, e)
             return ("ok",)
         if cmd == "failed":
             # ULFM failure-detector query: the launcher's reap loop /
@@ -283,6 +316,13 @@ class PMIxClient:
         reply = self._rpc("failed")
         reasons = reply[2] if len(reply) > 2 else {}
         return {int(r): str(reasons.get(r, "")) for r in reply[1]}
+
+    def report_failed(self, failed_rank: int, reason: str = "") -> None:
+        """Push a locally-observed death (gossip suspect, arena pid
+        probe) into the runtime dead-set so the control plane — and
+        every other rank's detector poll — learns it, and the launcher
+        can reap a hung-but-alive pid."""
+        self._rpc("report_failed", self.rank, int(failed_rank), reason)
 
     def abort(self, msg: str = "", status: int = 1) -> None:
         self._rpc("abort", self.rank, int(status), msg)

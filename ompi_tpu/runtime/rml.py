@@ -38,8 +38,8 @@ from typing import Any, Callable, Optional
 from ompi_tpu.core import dss, output
 from ompi_tpu.core.config import VarType, register_var, var_registry
 
-__all__ = ["RmlNode", "tree_children", "tree_parent", "HeartbeatMonitor",
-           "start_heartbeats"]
+__all__ = ["RmlNode", "tree_children", "tree_parent",
+           "nearest_live_ancestor", "HeartbeatMonitor", "start_heartbeats"]
 
 _log = output.get_stream("rml")
 
@@ -49,6 +49,11 @@ register_var("rml", "heartbeat_period", VarType.DOUBLE, 0.0,
 register_var("rml", "heartbeat_timeout", VarType.DOUBLE, 3.0,
              "seconds of heartbeat silence before the HNP declares a "
              "daemon dead (only meaningful with rml_heartbeat_period > 0)")
+register_var("rml", "reparent_timeout", VarType.DOUBLE, 10.0,
+             "seconds an orphaned orted (tree parent lost under the "
+             "notify errmgr policy) waits for the HNP-arbitrated "
+             "re-parenting handshake before falling back to the lifeline "
+             "teardown")
 
 # well-known tags (≈ orte/mca/rml/rml_types.h:59-69)
 TAG_REGISTER = "register"       # daemon → HNP: (vpid, uri, hostname)
@@ -67,6 +72,17 @@ TAG_HEARTBEAT = "heartbeat"     # up: vpid — daemon liveness beat
 TAG_PROC_FAILED = "proc_failed"  # xcast: (rank, reason) — errmgr notify
 #                                  propagating a rank death to survivors
 #                                  instead of killing the job
+TAG_ORPHANED = "orphaned"       # direct (boot link) daemon → HNP:
+#                                 (vpid, lost_parent) — my tree parent
+#                                 vanished; arbitrate a re-parenting
+TAG_REPARENT = "reparent"       # direct HNP → orphan: new parent vpid —
+#                                 expect its hello instead of tearing down
+TAG_ADOPT = "adopt"             # direct HNP → adopter: [(vpid, uri), ...]
+#                                 orphans to dial as tree children
+TAG_REPARENT_ACK = "reparent_ack"  # up: (vpid, new_parent) — re-wired
+TAG_KILL_RANK = "kill_rank"     # xcast: rank — the owning daemon SIGKILLs
+#                                 exactly that rank (reaping a hung pid
+#                                 the gossip detector reported)
 
 
 def tree_parent(vpid: int) -> Optional[int]:
@@ -81,6 +97,16 @@ def tree_children(vpid: int, n: int) -> list[int]:
     from ompi_tpu.core.netpatterns import kary_children
 
     return kary_children(vpid, n, k=2)
+
+
+def nearest_live_ancestor(vpid: int, dead: set[int]) -> int:
+    """The closest ancestor of ``vpid`` not in ``dead`` — the adopter a
+    mid-tree daemon death hands its orphans to (vpid arithmetic on the
+    routing tree; the HNP, vpid 0, is never in ``dead``)."""
+    p = tree_parent(vpid)
+    while p is not None and p in dead:
+        p = tree_parent(p)
+    return 0 if p is None else p
 
 
 class _Link:
@@ -131,6 +157,17 @@ class RmlNode:
         self._stop = threading.Event()
         self._parent_link: Optional[_Link] = None
         self.parent_wired = threading.Event()  # set when the up-link exists
+        # which vpid is allowed to become my parent: tree position by
+        # default, retargeted by the re-parenting handshake (an orphaned
+        # daemon starts expecting its adopter instead)
+        self.parent_vpid: Optional[int] = tree_parent(vpid)
+        self._pending_hellos: dict[int, _Link] = {}  # hellos from peers
+        # that are not (yet) my parent — an adopter's dial can race the
+        # HNP's TAG_REPARENT order, so the link is kept until retargeted
+        # an up-path of last resort (the daemon's bootstrap link to the
+        # HNP): used while orphaned, so exit reports / heartbeats survive
+        # the window between losing a parent and being adopted
+        self.fallback_up: Optional[_Link] = None
         self._child_links: dict[int, _Link] = {}
         self.boot_links: dict[int, _Link] = {}  # HNP: vpid → link
         # Called with the peer vpid when a known link hits EOF — the
@@ -185,6 +222,22 @@ class RmlNode:
         """
         return self.parent_wired.wait(timeout)
 
+    def retarget_parent(self, new_parent: int) -> None:
+        """Re-parenting: expect ``new_parent``'s hello as my new up-link.
+
+        If the adopter already dialed in (its hello raced the HNP's
+        TAG_REPARENT order), the pending link is promoted immediately;
+        otherwise ``parent_wired`` clears until the hello arrives.
+        """
+        with self._lock:
+            self.parent_vpid = new_parent
+            link = self._pending_hellos.pop(new_parent, None)
+            if link is None:
+                self.parent_wired.clear()
+            else:
+                self._parent_link = link
+                self.parent_wired.set()
+
     # -- traffic ----------------------------------------------------------
 
     def xcast(self, tag: str, payload: Any) -> None:
@@ -198,14 +251,29 @@ class RmlNode:
         self._deliver(tag, self.vpid, payload)
 
     def send_up(self, tag: str, payload: Any) -> None:
-        """Deliver at the HNP, relaying through the tree."""
+        """Deliver at the HNP, relaying through the tree (or, while
+        orphaned, over the bootstrap fallback link)."""
         if self.vpid == 0:
             self._deliver(tag, 0, payload)
             return
+        self._send_up_blob(dss.pack(("up", tag, self.vpid, payload)))
+
+    def _send_up_blob(self, blob: bytes) -> None:
+        """One pre-framed "up" message toward the HNP: the tree parent
+        when wired, else the bootstrap fallback (re-parenting window —
+        exit reports and heartbeats must survive an orphaned stretch)."""
         link = self._parent_link
-        if link is None:
-            raise ConnectionError("rml: no parent link (not wired yet)")
-        link.send(dss.pack(("up", tag, self.vpid, payload)))
+        if link is not None and self.parent_wired.is_set():
+            try:
+                link.send(blob)
+                return
+            except OSError:
+                pass  # parent just died — try the fallback below
+        fb = self.fallback_up
+        if fb is not None:
+            fb.send(blob)
+            return
+        raise ConnectionError("rml: no parent link (not wired yet)")
 
     def send_direct(self, link: _Link, tag: str, payload: Any) -> None:
         """Bootstrap-only: a message over an explicit link (HNP replies to
@@ -267,13 +335,17 @@ class RmlNode:
                 kind = msg[0]
                 if kind == "hello":
                     peer = msg[1]
-                    # an accepted hello from my tree parent IS my up-link;
-                    # at the HNP an accepted hello is a bootstrap link
-                    if tree_parent(self.vpid) == peer:
-                        self._parent_link = link
-                        self.parent_wired.set()
-                    if self.vpid == 0:
-                        with self._lock:
+                    # an accepted hello from my expected parent IS my
+                    # up-link; at the HNP an accepted hello is a bootstrap
+                    # link; anything else is kept pending — a racing
+                    # adopter whose TAG_REPARENT order is still in flight
+                    with self._lock:
+                        if self.parent_vpid == peer:
+                            self._parent_link = link
+                            self.parent_wired.set()
+                        elif self.vpid != 0:
+                            self._pending_hellos[peer] = link
+                        if self.vpid == 0:
                             self.boot_links[peer] = link
                     continue
                 _, tag, origin, payload = msg
@@ -285,17 +357,23 @@ class RmlNode:
                     if self.vpid == 0:
                         self._deliver(tag, origin, payload)
                     else:
-                        parent = self._parent_link
-                        if parent is not None:
-                            parent.send(blob)
-                        else:
-                            _log.error("rml %d: up msg with no parent",
-                                       self.vpid)
+                        try:
+                            self._send_up_blob(blob)
+                        except (ConnectionError, OSError) as e:
+                            _log.error("rml %d: up relay failed: %r",
+                                       self.vpid, e)
                 elif kind == "direct":
                     self._deliver(tag, origin, payload)
                 else:
                     _log.error("rml %d: unknown kind %r", self.vpid, kind)
         if peer is not None and not self._stop.is_set():
+            # prune the dead link so xcast relays and adoptions never
+            # write into a corpse (a re-parented tree re-adds live edges)
+            with self._lock:
+                if self._child_links.get(peer) is link:
+                    del self._child_links[peer]
+                if self._pending_hellos.get(peer) is link:
+                    del self._pending_hellos[peer]
             cb = self.on_peer_lost
             if cb is not None:
                 try:
@@ -315,6 +393,8 @@ class RmlNode:
             self._child_links.clear()
             links += list(self.boot_links.values())
             self.boot_links.clear()
+            links += list(self._pending_hellos.values())
+            self._pending_hellos.clear()
         if self._parent_link is not None:
             links.append(self._parent_link)
         for link in links:

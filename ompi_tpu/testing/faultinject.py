@@ -11,6 +11,9 @@ Plan grammar (entries separated by ``;``)::
 
     rank=2:kill@step=3        rank 2 exits when its step counter hits 3
     rank=2:kill@t=0.5         rank 2 exits ~0.5 s after arming
+    rank=2:hang@step=3        rank 2 STALLS (alive pid, silent rank) at
+                              step 3 — SIGSTOP by default, a cooperative
+                              spin with faultinject_hang_mode=spin
     daemon=1:kill@t=1.0       orted vpid 1 SIGKILLs itself after 1 s
     drop=0.01                 drop outgoing FT-control frames with p=0.01
     drop=0.05@all             drop ANY outgoing frame with p=0.05
@@ -69,6 +72,13 @@ register_var("faultinject", "seed", VarType.INT, 0,
              "seed for the deterministic fault decision streams")
 register_var("faultinject", "exit_code", VarType.INT, 9,
              "exit code an injected rank kill dies with")
+register_var("faultinject", "hang_mode", VarType.STRING, "stop",
+             "how an injected hang stalls the rank: 'stop' = SIGSTOP the "
+             "whole process (full-process freeze — the in-host hang the "
+             "rank-plane gossip heartbeats exist to catch); 'spin' = park "
+             "only the calling thread in a sleep loop (an app-thread "
+             "deadlock; background threads keep running)",
+             enumerator=("stop", "spin"))
 
 ENV_PLAN = "OMPI_TPU_FAULT_PLAN"
 ENV_SEED = "OMPI_TPU_FAULT_SEED"
@@ -122,9 +132,12 @@ def _parse_entry(entry: str) -> _Action:
             act.rank = int(val)
         elif key == "daemon":
             act.vpid = int(val)
-        elif key == "kill" or key.startswith("kill@"):
-            act.kind = "daemon_kill" if act.vpid is not None else "kill"
+        elif key in ("kill", "hang") or key.startswith(("kill@", "hang@")):
+            base = "kill" if key.startswith("kill") else "hang"
+            act.kind = ("daemon_kill" if act.vpid is not None
+                        and base == "kill" else base)
             # kill@step=N / kill@t=SEC arrive as key "kill@step"/"kill@t"
+            # (same for hang@)
             trig = key.partition("@")[2]
             if trig == "step":
                 act.at_step = int(val)
@@ -132,8 +145,8 @@ def _parse_entry(entry: str) -> _Action:
                 act.at_time = float(val)
             else:
                 raise ValueError(
-                    f"kill needs a trigger: kill@step=N or kill@t=SEC "
-                    f"(got {part!r})")
+                    f"{base} needs a trigger: {base}@step=N or "
+                    f"{base}@t=SEC (got {part!r})")
         elif key in ("drop", "dup"):
             act.kind = key
             prob, _, scope = val.partition("@")
@@ -153,6 +166,17 @@ def _parse_entry(entry: str) -> _Action:
         raise ValueError(f"fault-plan entry {entry!r} names no action")
     if act.scope not in ("ft", "all"):
         raise ValueError(f"unknown fault scope {act.scope!r} (ft|all)")
+    # whole-entry validation (field order within an entry is free, so
+    # per-field checks can be sidestepped): hangs target ranks only —
+    # a hung DAEMON is the heartbeat layer's job, and a daemon= field
+    # anywhere in a hang entry is a contradiction, not a default
+    if act.kind == "hang" and act.vpid is not None:
+        raise ValueError(
+            f"hang targets ranks, not daemons (entry {entry!r})")
+    # a kill that saw daemon= before the kill key is a daemon_kill; one
+    # that saw it after must settle to the same action
+    if act.kind == "kill" and act.vpid is not None:
+        act.kind = "daemon_kill"
     return act
 
 
@@ -187,19 +211,22 @@ class Injector:
                       if a.rank is None or a.rank == rank]
         self._frame_acts = [a for a in self._acts
                             if a.kind in ("drop", "delay", "dup")]
-        # kills fire in a rank's FIRST life only: an errmgr-respawned
-        # incarnation re-arms the injector and would otherwise die again
-        # at the same step, looping until restarts exhaust
+        # kills AND hangs fire in a rank's FIRST life only: an
+        # errmgr-respawned incarnation re-arms the injector and would
+        # otherwise die again at the same step, looping until restarts
+        # exhaust
         self._kills = ([] if os.environ.get("OMPI_TPU_RESTART")
-                       else [a for a in self._acts if a.kind == "kill"])
+                       else [a for a in self._acts
+                             if a.kind in ("kill", "hang")])
         self._step = 0
         self._lock = threading.Lock()
         self.events: list[dict] = []
         self._dead = False
         for k in self._kills:
             if k.at_time is not None:
-                t = threading.Timer(k.at_time, self._fire_kill,
-                                    args=("t", k.at_time))
+                fire = (self._fire_kill if k.kind == "kill"
+                        else self._fire_hang)
+                t = threading.Timer(k.at_time, fire, args=("t", k.at_time))
                 t.daemon = True
                 t.start()
 
@@ -213,7 +240,10 @@ class Injector:
             self._step += 1
         for k in self._kills:
             if k.at_step == s:
-                self._fire_kill("step", s)
+                if k.kind == "hang":
+                    self._fire_hang("step", s)
+                else:
+                    self._fire_kill("step", s)
         return s
 
     def _fire_kill(self, trigger: str, value) -> None:
@@ -229,6 +259,34 @@ class Injector:
         sys.stdout.flush()
         sys.stderr.flush()
         os._exit(int(var_registry.get("faultinject_exit_code")))
+
+    def _fire_hang(self, trigger: str, value) -> None:
+        """The injected in-host hang: the rank stalls WITHOUT exiting —
+        the pid stays alive (invisible to the daemon heartbeat layer and
+        the launcher reap loop), only its peers' gossip can tell."""
+        if self._dead:
+            return
+        self._dead = True   # one terminal fault per life, like kills
+        self._record("hang", trigger=trigger, value=value,
+                     mode=var_registry.get("faultinject_hang_mode"))
+        _log.emit("faultinject: rank %d injected hang (%s=%s)",
+                  self.rank, trigger, value)
+        _dump_events_now()
+        import sys
+
+        sys.stdout.flush()
+        sys.stderr.flush()
+        self._hang_impl()
+
+    def _hang_impl(self) -> None:
+        """Separated so tests can observe the trigger without actually
+        freezing the test process."""
+        if var_registry.get("faultinject_hang_mode") == "spin":
+            while True:            # cooperative: only this thread parks
+                time.sleep(3600)
+        import signal
+
+        os.kill(os.getpid(), signal.SIGSTOP)
 
     # -- frame verdicts (BtlEndpoint hook) ---------------------------------
 

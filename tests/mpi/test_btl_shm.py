@@ -179,3 +179,47 @@ def test_dead_receiver_detected_not_silently_lost():
     finally:
         a.close()
         b.close()
+
+
+# ---------------------------------------------------------------------------
+# pid-liveness probe (shared cache: send path + coll/shm arena waits)
+# ---------------------------------------------------------------------------
+
+def test_probe_alive_answers_from_card_pid():
+    import subprocess
+    import sys as _sys
+
+    btl = ShmBTL(0, lambda *a: None)
+    try:
+        inbox = tempfile.mkdtemp(prefix="shmprobe-")
+        # a pid that is definitely dead (reaped child)
+        p = subprocess.Popen([_sys.executable, "-c", "pass"])
+        p.wait()
+        dead_card = f"{btl.hostname}|{inbox}|{p.pid}"
+        assert btl.probe_alive(7, dead_card) is False
+        # a pid that is definitely alive (this test's process, via card)
+        live_card = f"{btl.hostname}|{inbox}|{os.getppid() or os.getpid()}"
+        assert btl.probe_alive(8, live_card) is True
+        # unknowable: no card, never connected
+        assert btl.probe_alive(9) is None
+        # wrong host: the pid namespace would alias — unknowable
+        other = f"not-{btl.hostname}|{inbox}|{p.pid}"
+        assert btl.probe_alive(10, other) is None
+        os.rmdir(inbox)
+    finally:
+        btl.close()
+
+
+def test_probe_cache_is_shared_with_send_path():
+    """_check_alive and probe_alive must consult ONE rate-limit cache —
+    a fresh True answer suppresses the syscall for ~50ms on both."""
+    btl = ShmBTL(0, lambda *a: None)
+    try:
+        btl._peer_pid[5] = os.getppid() or os.getpid()
+        assert btl.probe_alive(5) is True
+        t = btl._alive_until.get(5)
+        assert t is not None
+        btl._check_alive(5)             # within the window: no new stamp
+        assert btl._alive_until.get(5) == t
+    finally:
+        btl.close()

@@ -352,3 +352,129 @@ def test_revoked_comm_aborts_arena_wait():
 
     res = run_ranks(2, body, timeout=30.0)
     assert res[0] == "revoked" and res[1] == "raised"
+
+
+# ---------------------------------------------------------------------------
+# arena death probes (writer pid liveness via the shared btl probe)
+# ---------------------------------------------------------------------------
+
+class _DeadWriterEndpoint:
+    """An endpoint whose pid probe says every peer is gone."""
+
+    def peer_alive(self, peer):
+        return False
+
+
+class _UnknowableEndpoint:
+    def peer_alive(self, peer):
+        return None
+
+
+def _bare_arena(pml, p=2):
+    import uuid
+
+    from ompi_tpu.core import shmseg
+    from ompi_tpu.mpi.coll.shm import Arena
+
+    name = f"otpu-probetest-{uuid.uuid4().hex[:8]}"
+    seg = shmseg.create(name, Arena.nbytes_for(p, 4096))
+    arena = Arena(seg, p, 0, 4096, world=list(range(p)), pml=pml)
+    seg.unlink()
+    return arena
+
+
+def test_arena_wait_probe_fails_on_dead_writer():
+    """A SIGKILLed writer must surface MPI_ERR_PROC_FAILED in ~the probe
+    grace, not the 60 s coll_shm_timeout — the acceptance criterion."""
+    import time
+    import types
+
+    from ompi_tpu.mpi import trace as trace_mod
+    from ompi_tpu.mpi.constants import ERR_PROC_FAILED, MPIException
+
+    pml = types.SimpleNamespace(endpoint=_DeadWriterEndpoint(), ft=None,
+                                rank=0)
+    arena = _bare_arena(pml)
+    var_registry.set("coll_shm_probe_grace", 0.2)
+    before = trace_mod.counters["coll_shm_writer_dead_total"]
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(MPIException) as ei:
+            arena._wait(1 * 8, 1, None)   # rank 1's arrive flag: never set
+        took = time.monotonic() - t0
+        assert ei.value.error_class == ERR_PROC_FAILED
+        assert "writer" in str(ei.value)
+        # well inside 2x the detector/probe window, nowhere near 60 s
+        assert took < 5.0, took
+        assert trace_mod.counters["coll_shm_writer_dead_total"] > before
+    finally:
+        var_registry.set("coll_shm_probe_grace", 1.0)
+        arena.close()
+
+
+def test_arena_wait_probe_ignores_unknowable_pids():
+    """peer_alive() == None (remote peer / shm off) must NOT fail the
+    wait — only a definite 'pid gone' answer may."""
+    import types
+
+    from ompi_tpu.mpi.constants import MPIException
+
+    pml = types.SimpleNamespace(endpoint=_UnknowableEndpoint(), ft=None,
+                                rank=0)
+    arena = _bare_arena(pml)
+    var_registry.set("coll_shm_probe_grace", 0.05)
+    var_registry.set("coll_shm_timeout", 1)
+    try:
+        with pytest.raises(MPIException) as ei:
+            arena._wait(1 * 8, 1, None)
+        # it fell through to the ordinary timeout, not the probe raise
+        assert "coll_shm_timeout" in str(ei.value)
+    finally:
+        var_registry.set("coll_shm_probe_grace", 1.0)
+        var_registry.set("coll_shm_timeout", 60)
+        arena.close()
+
+
+def test_probe_grace_validated_against_timeout():
+    """Var hygiene: a grace at/above coll_shm_timeout would disable the
+    probe exactly when it matters — it clamps to half the timeout."""
+    from ompi_tpu.mpi.coll import shm as shm_mod
+
+    var_registry.set("coll_shm_probe_grace", 120.0)
+    try:
+        assert shm_mod._probe_grace(60.0) == 30.0
+        var_registry.set("coll_shm_probe_grace", 0.0)
+        assert shm_mod._probe_grace(60.0) == 0.0
+        var_registry.set("coll_shm_probe_grace", 1.0)
+        assert shm_mod._probe_grace(60.0) == 1.0
+    finally:
+        var_registry.set("coll_shm_probe_grace", 1.0)
+
+
+def test_probe_marks_detector_so_everything_fails_fast():
+    """The probe feeds the SAME dead-set the PMIx path feeds: after one
+    arena detection, the FT sidecar knows the rank is dead."""
+    import types
+
+    from ompi_tpu.mpi.constants import MPIException
+
+    marks = []
+
+    class _Det:
+        def mark_failed(self, w, reason=""):
+            marks.append((w, reason))
+            return True
+
+    pml = types.SimpleNamespace(
+        endpoint=_DeadWriterEndpoint(),
+        ft=types.SimpleNamespace(detector=_Det()), rank=0)
+    arena = _bare_arena(pml)
+    var_registry.set("coll_shm_probe_grace", 0.1)
+    try:
+        with pytest.raises(MPIException):
+            arena._wait(1 * 8, 1, None)
+        assert marks and marks[0][0] == 1
+        assert "writer" in marks[0][1]
+    finally:
+        var_registry.set("coll_shm_probe_grace", 1.0)
+        arena.close()

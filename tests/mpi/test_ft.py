@@ -241,3 +241,164 @@ def test_shrink_twice_handles_sequential_failures():
     finally:
         for p in pmls:
             p.close()
+
+
+# ---------------------------------------------------------------------------
+# early-deciding agreement: acked-decision watermarks + state GC
+# ---------------------------------------------------------------------------
+
+def test_agree_state_gc_is_memory_bounded():
+    """1000 sequential agrees must not accumulate 1000 _AgreeState
+    entries: watermark acks let every fully-returned round be reclaimed
+    (the per-(cid, seq) leak this PR closes)."""
+    import time as _time
+
+    from ompi_tpu.mpi import trace as trace_mod
+
+    rounds = 1000
+    before = trace_mod.counters["ft_agree_gc_reclaimed_total"]
+    pmls, comms = make_world(3)
+    try:
+        def body(r):
+            for _ in range(rounds):
+                assert comms[r].agree(True) is True
+
+        run_on(range(3), body, timeout=240.0)
+        # let the last round's acks + floor broadcast land
+        deadline = _time.monotonic() + 5.0
+        while _time.monotonic() < deadline:
+            sizes = [len(ft.pml_ft(p)._comms[0].states) for p in pmls]
+            if max(sizes) <= 4:
+                break
+            _time.sleep(0.05)
+        for p in pmls:
+            cft = ft.pml_ft(p)._comms[0]
+            assert len(cft.states) <= 4, \
+                (p.rank, len(cft.states), "agree states leaked")
+            assert cft.gc_floor >= rounds - 4, (p.rank, cft.gc_floor)
+        assert trace_mod.counters["ft_agree_gc_reclaimed_total"] > before
+    finally:
+        for p in pmls:
+            p.close()
+
+
+def test_agree_gc_floor_ignores_stale_frames():
+    """A straggler's retransmission for a reclaimed seq must not
+    resurrect state (unbounded re-creation would undo the GC)."""
+    pmls, comms = make_world(2)
+    try:
+        run_on(range(2), lambda r: comms[r].agree(True))
+        sidecar = ft.pml_ft(pmls[0])
+        cft = sidecar._comms[0]
+        sidecar._apply_gc_floor(cft, 0)   # force: seq 0 reclaimed
+        assert 0 not in cft.states
+        sidecar._recv_agree_contrib(1, {
+            "cid": 0, "aseq": 0, "from": 1, "flag": 1, "failed": [],
+            "n": 9})
+        assert 0 not in cft.states, "stale contrib resurrected GC'd state"
+    finally:
+        for p in pmls:
+            p.close()
+
+
+def test_agree_gc_excludes_dead_members():
+    """A dead rank never acks — the floor must advance over it (its
+    unacked seqs would otherwise pin memory forever)."""
+    pmls, comms = make_world(3)
+    try:
+        for r in (0, 1):
+            ft.pml_ft(pmls[r]).detector.mark_failed(2, "unit kill")
+        run_on((0, 1), lambda r: [comms[r].agree(True) for _ in range(5)])
+        import time as _time
+
+        deadline = _time.monotonic() + 5.0
+        while _time.monotonic() < deadline:
+            if all(ft.pml_ft(pmls[r])._comms[0].gc_floor >= 3
+                   for r in (0, 1)):
+                break
+            _time.sleep(0.05)
+        for r in (0, 1):
+            assert ft.pml_ft(pmls[r])._comms[0].gc_floor >= 3, \
+                (r, ft.pml_ft(pmls[r])._comms[0].gc_floor)
+    finally:
+        for p in pmls:
+            p.close()
+
+
+# ---------------------------------------------------------------------------
+# rank-plane gossip heartbeats
+# ---------------------------------------------------------------------------
+
+def test_gossip_window_clamps_to_twice_period():
+    from ompi_tpu.core.config import var_registry
+
+    var_registry.set("ft_gossip_period", 1.0)
+    var_registry.set("ft_gossip_timeout", 0.5)
+    try:
+        assert ft.gossip_window() == 2.0
+        var_registry.set("ft_gossip_timeout", 5.0)
+        assert ft.gossip_window() == 5.0
+    finally:
+        var_registry.set("ft_gossip_period", 0.0)
+        var_registry.set("ft_gossip_timeout", 2.0)
+
+
+def test_gossip_declares_silent_rank():
+    """An in-host hang: rank 2's pid is alive (same process, even) but
+    it never beats — the beating ranks must declare it suspect within
+    the gossip window and fail operations against it fast."""
+    from ompi_tpu.core.config import var_registry
+
+    var_registry.set("ft_gossip_period", 0.1)
+    var_registry.set("ft_gossip_timeout", 0.5)
+    pmls, comms = make_world(3)
+    try:
+        for r in (0, 1):
+            ft.pml_ft(pmls[r]).arm_gossip([0, 1, 2])
+        # rank 2 exists and receives, but never arms → its epoch stalls
+        deadline = time.monotonic() + 6.0
+        while time.monotonic() < deadline:
+            if all(ft.pml_ft(pmls[r]).detector.is_dead(2, poll=False)
+                   for r in (0, 1)):
+                break
+            time.sleep(0.05)
+        for r in (0, 1):
+            det = ft.pml_ft(pmls[r]).detector
+            assert det.is_dead(2, poll=False), f"rank {r} never declared 2"
+            assert "gossip" in det.reason(2)
+        # and ranks 0/1 kept each OTHER alive through their beats
+        assert not ft.pml_ft(pmls[0]).detector.is_dead(1, poll=False)
+        assert not ft.pml_ft(pmls[1]).detector.is_dead(0, poll=False)
+        with pytest.raises(MPIException) as ei:
+            comms[0].send(np.array([1.0]), dest=2)
+        assert ei.value.error_class == ERR_PROC_FAILED
+    finally:
+        for p in pmls:
+            p.close()
+        var_registry.set("ft_gossip_period", 0.0)
+        var_registry.set("ft_gossip_timeout", 2.0)
+
+
+def test_gossip_beats_tick_the_pvar_and_spread_views():
+    from ompi_tpu.core.config import var_registry
+    from ompi_tpu.mpi import trace as trace_mod
+
+    var_registry.set("ft_gossip_period", 0.05)
+    before = trace_mod.counters["ft_gossip_beats_total"]
+    pmls, comms = make_world(2)
+    try:
+        for r in (0, 1):
+            ft.pml_ft(pmls[r]).arm_gossip([0, 1])
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if (trace_mod.counters["ft_gossip_beats_total"] > before
+                    and ft.pml_ft(pmls[0])._beats.get(1, [0])[0] > 0):
+                break
+            time.sleep(0.05)
+        assert trace_mod.counters["ft_gossip_beats_total"] > before
+        # rank 0 learned rank 1's advancing epoch from the beat frames
+        assert ft.pml_ft(pmls[0])._beats[1][0] > 0
+    finally:
+        for p in pmls:
+            p.close()
+        var_registry.set("ft_gossip_period", 0.0)

@@ -281,8 +281,11 @@ def test_contiguous_send_makes_no_pack_copy():
         return packs_contig, packs_strided
 
     results = run_ranks(2, body, timeout=120.0)
-    for packs_contig, packs_strided in results:
+    for packs_contig, _packs_strided in results:
         assert packs_contig == 0, \
             "contiguous send took a pack round-trip"
-        assert packs_strided >= 1, \
-            "strided control did not go through the convertor"
+    # the stats are process-wide and both rank-threads read `base` after
+    # the same barrier: the receiver's read can land AFTER the sender's
+    # pack (delta 0 on one side) — only the cross-rank sum is race-free
+    assert sum(ps for _pc, ps in results) >= 1, \
+        "strided control did not go through the convertor"

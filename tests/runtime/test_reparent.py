@@ -1,0 +1,150 @@
+"""Failure containment v2, runtime plane: mid-tree daemon re-parenting
+(TAG_REPARENT handshake, HNP arbitrating), the orphan's bootstrap
+fallback up-path, and the report_failed control-plane feedback loop."""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from ompi_tpu.runtime import rml
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def tpurun(*args, timeout=180, env_extra=None):
+    env = dict(os.environ)
+    env.pop("OMPI_TPU_RANK", None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    if env_extra:
+        env.update(env_extra)
+    return subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.tpurun", *args],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO)
+
+
+# -- tree arithmetic -------------------------------------------------------
+
+def test_nearest_live_ancestor_walks_over_corpses():
+    # binary tree: parent(4)=1, parent(1)=0
+    assert rml.nearest_live_ancestor(4, set()) == 1
+    assert rml.nearest_live_ancestor(4, {1}) == 0
+    assert rml.nearest_live_ancestor(3, {1}) == 0
+    # chained deaths: 9→4→1→0 with 4 and 1 both gone
+    assert rml.nearest_live_ancestor(9, {4, 1}) == 0
+    assert rml.nearest_live_ancestor(2, set()) == 0
+
+
+# -- RmlNode re-wiring -----------------------------------------------------
+
+def test_retarget_parent_accepts_new_parent_hello():
+    """After retarget_parent(g), g's dial becomes the up-link — in
+    either order (hello-then-retarget or retarget-then-hello)."""
+    # order A: retarget first, hello second
+    child = rml.RmlNode(4)
+    adopter = rml.RmlNode(1)
+    try:
+        child.retarget_parent(1)
+        assert not child.parent_wired.is_set()
+        adopter.dial_children([(4, child.uri)])
+        assert child.wait_parent(5.0), "adopter's hello not adopted"
+    finally:
+        child.close()
+        adopter.close()
+    # order B: the adopter's hello RACES ahead of TAG_REPARENT — the
+    # pending-hello stash must hold it until the retarget promotes it
+    child = rml.RmlNode(4)
+    adopter = rml.RmlNode(0)
+    try:
+        adopter.dial_children([(4, child.uri)])
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with child._lock:
+                if 0 in child._pending_hellos:
+                    break
+            time.sleep(0.01)
+        assert not child.parent_wired.is_set() or \
+            child.parent_vpid == rml.tree_parent(4)
+        child.retarget_parent(0)
+        assert child.wait_parent(5.0), "pending hello not promoted"
+    finally:
+        child.close()
+        adopter.close()
+
+
+def test_send_up_falls_back_to_bootstrap_link():
+    """An orphaned daemon's up-traffic (exit reports, heartbeats) must
+    survive the window between parent loss and adoption."""
+    hnp = rml.RmlNode(0)
+    daemon = rml.RmlNode(3)   # tree parent would be vpid 1 — never wired
+    got = threading.Event()
+    hnp.register_recv("unit-up", lambda origin, p: got.set())
+    try:
+        boot = daemon.dial_bootstrap(hnp.uri)
+        daemon.fallback_up = boot
+        daemon.send_up("unit-up", "payload")   # no parent link exists
+        assert got.wait(5.0), "fallback up-path never delivered"
+    finally:
+        daemon.close()
+        hnp.close()
+
+
+def test_reparent_timeout_var_registered():
+    from ompi_tpu.core.config import var_registry
+
+    assert var_registry.get("rml_reparent_timeout") == 10.0
+
+
+# -- report_failed RPC (gossip → control plane feedback) -------------------
+
+def test_report_failed_reaches_dead_set_and_hook():
+    from ompi_tpu.runtime import pmix
+
+    reported = []
+    server = pmix.PMIxServer(size=3)
+    server.on_failed_report = lambda r, reason: reported.append((r, reason))
+    try:
+        client = pmix.PMIxClient(uri=server.uri, rank=0, size=3)
+        client.report_failed(2, "gossip: rank silent for 2.0s")
+        assert reported == [(2, "gossip: rank silent for 2.0s")]
+        # the dead-set now serves it to every polling detector
+        assert client.failed_ranks() == {2: "gossip: rank silent for 2.0s"}
+        # duplicate reports (several survivors racing) fire the hook once
+        client.report_failed(2, "gossip: rank silent for 2.1s")
+        assert len(reported) == 1
+        client.finalize()
+    finally:
+        server.close()
+
+
+# -- the acceptance scenario, end to end -----------------------------------
+
+def test_midtree_daemon_kill_orphan_ranks_survive():
+    """A NON-LEAF orted (vpid 1 of a 4-host sim tree: children 3 and 4)
+    is SIGKILLed under notify.  Without re-parenting the lifeline rule
+    tears down daemons 3/4 and their ranks; with it, ranks 1, 2, 3 all
+    finish and the job exits 0 — loss confined to the dead host."""
+    prog = ("import time, ompi_tpu\n"
+            "comm = ompi_tpu.init()\n"
+            "time.sleep(14.0)\n"
+            "print(f'rank {comm.rank} survived', flush=True)\n"
+            "ompi_tpu.finalize()\n")
+    r = tpurun("-np", "4", "--plm", "sim", "--hosts", "4",
+               "--mca", "errmgr", "notify",
+               "--mca", "multihost_auto_init", "0",
+               "--mca", "rml_heartbeat_period", "0.2",
+               "--mca", "rml_heartbeat_timeout", "2.0",
+               "--mca", "faultinject_plan", "daemon=1:kill@t=7.0", "--",
+               sys.executable, "-c", prog, timeout=240)
+    out = r.stdout + r.stderr
+    assert r.returncode == 0, out[-3000:]
+    assert "daemon-reparent" in out, out[-3000:]
+    # ranks 2 and 3 live on the ORPHANED daemons (3 and 4) — their
+    # survival is what the lifeline rule used to make impossible
+    for rank in (1, 2, 3):
+        assert f"rank {rank} survived" in out, (rank, out[-3000:])
+    assert "rank 0 survived" not in out, out[-3000:]

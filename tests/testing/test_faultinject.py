@@ -114,3 +114,44 @@ def test_btl_endpoint_arms_injector_under_plan():
     finally:
         var_registry.set("faultinject_plan", "")
         fi.reset()
+
+
+def test_hang_grammar_parses_step_and_time_triggers():
+    acts = fi.parse_plan("rank=2:hang@step=3;rank=1:hang@t=0.5")
+    assert [(a.kind, a.rank, a.at_step, a.at_time) for a in acts] == \
+        [("hang", 2, 3, None), ("hang", 1, None, 0.5)]
+
+
+def test_hang_rejects_daemons_and_missing_trigger():
+    import pytest
+
+    with pytest.raises(ValueError):
+        fi.parse_plan("daemon=1:hang@t=1.0")   # daemons hang via heartbeats
+    with pytest.raises(ValueError):
+        fi.parse_plan("rank=1:hang")           # no trigger
+
+
+def test_hang_fires_at_step_and_records_event(monkeypatch):
+    hung = []
+    monkeypatch.setattr(fi.Injector, "_hang_impl",
+                        lambda self: hung.append(self.rank))
+    acts = fi.parse_plan("rank=0:hang@step=2")
+    inj = fi.Injector(0, acts, seed=0)
+    inj.step(); inj.step()
+    assert hung == []
+    inj.step()                                 # entering step 2
+    assert hung == [0]
+    evs = [e for e in inj.events if e["kind"] == "hang"]
+    assert evs and evs[0]["trigger"] == "step" and evs[0]["value"] == 2
+    assert evs[0]["mode"] in ("stop", "spin")
+    # one terminal fault per life: the next step must not re-fire
+    inj.step()
+    assert hung == [0]
+
+
+def test_hang_first_life_only(monkeypatch):
+    monkeypatch.setenv("OMPI_TPU_RESTART", "1")
+    acts = fi.parse_plan("rank=0:hang@step=0")
+    inj = fi.Injector(0, acts, seed=0)
+    inj.step()                                 # would fire in life 0
+    assert not [e for e in inj.events if e["kind"] == "hang"]

@@ -13,6 +13,21 @@ targets, and asserts the job lands in that policy's *defined* state:
   survivors (whose work never depended on it) finish, exit 0.
 - ``abort``         — the default policy: the kill tears the whole job
   down; exit is nonzero and the abort help text names the dead rank.
+- ``midtree-kill``  — a NON-LEAF orted is SIGKILLed on the sim daemon
+  tree under ``notify``: its orphaned child daemons re-parent to the
+  grandparent (TAG_REPARENT handshake, HNP arbitrating) instead of
+  applying the lifeline teardown, so every other host's ranks finish
+  and the job exits 0 — loss confined to the dead host.
+- ``rank-hang``     — a rank SIGSTOPs mid-run (alive pid, silent rank:
+  invisible to the daemon heartbeat layer); rank-plane gossip
+  heartbeats declare it suspect, survivors shrink and finish with the
+  same recomputed acc a kill would give, and the reported pid is
+  reaped so the job exits 0.
+- ``writer-death``  — a rank dies mid-collective inside the coll/shm
+  arena with runtime dead-set polling crippled: the arena wait's btl
+  pid probe surfaces MPI_ERR_PROC_FAILED in ~the probe grace (the
+  driver asserts the printed time-to-error stays far below the 60 s
+  ``coll_shm_timeout``), then the normal shrink recipe finishes.
 
 No run may hang (every subprocess has a hard timeout — a timeout is a
 soak failure), and no run may print a wrong answer (expected values are
@@ -34,6 +49,7 @@ import argparse
 import json
 import os
 import random
+import re
 import subprocess
 import sys
 import tempfile
@@ -43,7 +59,8 @@ sys.path.insert(0, REPO)
 
 from ompi_tpu.testing import faultinject  # noqa: E402
 
-POLICIES = ("respawn", "notify-shrink", "continue", "abort")
+POLICIES = ("respawn", "notify-shrink", "continue", "abort",
+            "midtree-kill", "rank-hang", "writer-death")
 
 RING_APP = r"""
 import os
@@ -102,6 +119,19 @@ print(f"rank {rank} local done acc={acc:.0f}", flush=True)
 ompi_tpu.finalize()
 """
 
+# the mid-tree plan: one rank per sim host, long enough past init that
+# the injected daemon SIGKILL lands while ranks are quietly working —
+# the orphaned daemons' ranks must keep running through the re-parenting
+MIDTREE_APP = r"""
+import time
+import ompi_tpu
+
+comm = ompi_tpu.init()
+time.sleep(14.0)
+print(f"rank {comm.rank} survived", flush=True)
+ompi_tpu.finalize()
+"""
+
 
 def tpurun(args, env_extra=None, timeout=150):
     env = dict(os.environ)
@@ -119,12 +149,24 @@ def gen_plan(seed: int, idx: int, np_: int, steps: int) -> dict:
     all drawn from the seeded stream."""
     rng = random.Random(f"{seed}:{idx}")  # str seed: tuples raise on 3.11+
     policy = POLICIES[idx % len(POLICIES)]
-    victim = rng.randrange(0, np_) if policy == "notify-shrink" \
+    victim = rng.randrange(0, np_) \
+        if policy in ("notify-shrink", "rank-hang", "writer-death") \
         else rng.randrange(1, np_)
     kill_step = rng.randrange(1, steps - 1)
     drop = rng.choice((0.0, 0.05, 0.15)) if policy == "notify-shrink" \
         else 0.0
-    plan = f"rank={victim}:kill@step={kill_step}"
+    if policy == "midtree-kill":
+        # daemon 1 is the canonical mid-tree node of the 4-host binary
+        # routing tree (children 3 and 4); the kill lands well after the
+        # ranks cleared init's barriers
+        kill_t = round(rng.uniform(6.0, 8.0), 1)
+        return {"idx": idx, "policy": policy, "victim": 1,
+                "kill_step": None, "kill_t": kill_t, "drop": 0.0,
+                "plan": f"daemon=1:kill@t={kill_t}", "seed": seed}
+    if policy == "rank-hang":
+        plan = f"rank={victim}:hang@step={kill_step}"
+    else:
+        plan = f"rank={victim}:kill@step={kill_step}"
     if drop:
         plan += f";drop={drop}"
     return {"idx": idx, "policy": policy, "victim": victim,
@@ -142,6 +184,24 @@ def expected_shrink_acc(np_: int, steps: int, victim: int,
             [i for i in range(np_) if i != victim]
         acc += sum(i * 10 + s for i in ids)
     return acc
+
+
+def _assert_shrink_out(r, plan: dict, np_: int, steps: int) -> str:
+    """Shared shrink-and-continue postcondition: exit 0 and every
+    survivor prints the recomputed acc (a hang at step K and a kill at
+    step K account identically — the victim froze/died BEFORE
+    contributing step K, so agreed steps < K are full-world)."""
+    out = r.stdout + r.stderr
+    assert r.returncode == 0, \
+        f"{plan['policy']} rc={r.returncode}: {out[-2000:]}"
+    want = expected_shrink_acc(np_, steps, plan["victim"],
+                               plan["kill_step"])
+    survivors = [i for i in range(np_) if i != plan["victim"]]
+    for rank in survivors:
+        line = (f"id {rank} final acc={want:.0f} "
+                f"size={len(survivors)} shrinks=1")
+        assert line in out, (line, out[-2000:])
+    return out
 
 
 def run_plan(plan: dict, np_: int, steps: int, log_dir: str,
@@ -168,15 +228,59 @@ def run_plan(plan: dict, np_: int, steps: int, log_dir: str,
                     "--", sys.executable,
                     os.path.join(REPO, "examples", "shrink_allreduce.py")],
                    env)
+        _assert_shrink_out(r, plan, np_, steps)
+    elif policy == "rank-hang":
+        # SIGSTOP'd rank: alive pid, silent peer — only the rank-plane
+        # gossip heartbeats can see it.  Survivors shrink and finish with
+        # the SAME acc a kill at that step gives; the reported pid is
+        # reaped via the control plane so the job still exits 0.
+        r = tpurun(["-np", str(np_), "--mca", "errmgr", "notify",
+                    "--mca", "ft_gossip_period", "0.3",
+                    "--mca", "ft_gossip_timeout", "2.0", *mca,
+                    "--", sys.executable,
+                    os.path.join(REPO, "examples", "shrink_allreduce.py")],
+                   env, timeout=240)
+        _assert_shrink_out(r, plan, np_, steps)
+    elif policy == "writer-death":
+        # the arena writer dies mid-collective while runtime dead-set
+        # polling is crippled (ft_poll_period 30): the btl pid probe in
+        # the arena wait is what must surface the failure — the printed
+        # time-to-error stays in the probe window, not the 60 s timeout
+        r = tpurun(["-np", str(np_), "--mca", "errmgr", "notify",
+                    "--mca", "ft_poll_period", "30",
+                    "--mca", "coll_shm_probe_grace", "1.0", *mca,
+                    "--", sys.executable,
+                    os.path.join(REPO, "examples", "shrink_allreduce.py")],
+                   env, timeout=240)
+        out = _assert_shrink_out(r, plan, np_, steps)
+        detects = [float(m) for m in
+                   re.findall(r"detect_dt=([0-9.]+)", out)]
+        assert detects, f"no detect_dt lines: {out[-2000:]}"
+        assert max(detects) < 15.0, \
+            (f"writer death took {max(detects):.1f}s to surface — "
+             f"the arena probe should beat the 60s coll_shm_timeout")
+    elif policy == "midtree-kill":
+        # a NON-LEAF daemon dies: without re-parenting its whole subtree
+        # (daemons 3 and 4 → ranks 2 and 3) would apply the lifeline
+        # teardown; with it, only the dead host's rank is lost
+        r = tpurun(["-np", "4", "--plm", "sim", "--hosts", "4",
+                    "--mca", "errmgr", "notify",
+                    "--mca", "multihost_auto_init", "0",
+                    "--mca", "rml_heartbeat_period", "0.2",
+                    "--mca", "rml_heartbeat_timeout", "2.0", *mca,
+                    "--", sys.executable, "-c", MIDTREE_APP],
+                   env, timeout=240)
         out = r.stdout + r.stderr
-        assert r.returncode == 0, f"shrink rc={r.returncode}: {out[-2000:]}"
-        want = expected_shrink_acc(np_, steps, plan["victim"],
-                                   plan["kill_step"])
-        survivors = [i for i in range(np_) if i != plan["victim"]]
-        for rank in survivors:
-            line = (f"id {rank} final acc={want:.0f} "
-                    f"size={len(survivors)} shrinks=1")
-            assert line in out, (line, out[-2000:])
+        assert r.returncode == 0, \
+            f"midtree rc={r.returncode}: {out[-3000:]}"
+        assert "daemon-reparent" in out, \
+            f"no re-parenting event: {out[-3000:]}"
+        # ranks 2 and 3 live on the ORPHANED daemons — their survival is
+        # the re-parenting proof (rank 0 died with daemon 1; rank 1's
+        # daemon 2 was never involved)
+        for rank in (1, 2, 3):
+            assert f"rank {rank} survived" in out, (rank, out[-3000:])
+        assert "rank 0 survived" not in out, out[-3000:]
     elif policy == "continue":
         r = tpurun(["-np", str(np_), "--mca", "errmgr", "continue", *mca,
                     "--", sys.executable, "-c", LOCAL_APP], env)
@@ -235,12 +339,12 @@ def check_replay(plan: dict, first: dict[int, dict],
     decision frame racing a resend timer), even though each identity's
     verdict does not.
     """
-    kills_a = sorted((r, e["trigger"], e["value"])
+    kills_a = sorted((r, e["kind"], e["trigger"], e["value"])
                      for r, d in first.items() for e in d["events"]
-                     if e["kind"] == "kill")
-    kills_b = sorted((r, e["trigger"], e["value"])
+                     if e["kind"] in ("kill", "hang"))
+    kills_b = sorted((r, e["kind"], e["trigger"], e["value"])
                      for r, d in second.items() for e in d["events"]
-                     if e["kind"] == "kill")
+                     if e["kind"] in ("kill", "hang"))
     assert kills_a == kills_b, \
         f"plan {plan['idx']}: kill schedule diverged: {kills_a} vs {kills_b}"
 
@@ -281,12 +385,21 @@ def main(argv=None) -> int:
                          "the 4-policy rotation so every policy — "
                          "including the drop-carrying notify-shrink "
                          "plans — gets replayed)")
+    ap.add_argument("--only", default=None, choices=POLICIES,
+                    help="run only plans of one class (the CI smoke "
+                         "jobs pick single scenarios this way)")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
 
     failures = []
-    for i in range(args.plans):
+    plans, i = [], 0
+    while len(plans) < args.plans:
         plan = gen_plan(args.seed, i, args.np_, args.steps)
+        i += 1
+        if args.only and plan["policy"] != args.only:
+            continue
+        plans.append(plan)
+    for i, plan in enumerate(plans):
         log_a = tempfile.mkdtemp(prefix=f"chaos_log_{i}a_")
         try:
             run_plan(plan, args.np_, args.steps, log_a, args.verbose)
