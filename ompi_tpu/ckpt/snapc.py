@@ -21,6 +21,7 @@ shardings, exactly as sstore is ignorant of what's in an image.
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Any, Callable, Optional
 
@@ -30,7 +31,48 @@ from ompi_tpu.ckpt.store import SnapshotStore
 from ompi_tpu.mpi import trace as trace_mod
 from ompi_tpu.mpi.constants import ERR_IO, MPIException
 
-__all__ = ["checkpoint", "restart", "CheckpointManager"]
+__all__ = ["checkpoint", "restart", "restart_incarnation", "auto_restore",
+           "CheckpointManager"]
+
+
+def restart_incarnation() -> int:
+    """The ``OMPI_TPU_RESTART`` life number the errmgr stamped on this
+    process — 0 for a first life, n for the n-th revival (errmgr
+    respawn/selfheal)."""
+    return int(os.environ.get("OMPI_TPU_RESTART") or 0)
+
+
+def auto_restore(comm, store: SnapshotStore,
+                 restore_fn: Optional[Callable[[str, np.ndarray], Any]]
+                 = None, rank: Optional[int] = None
+                 ) -> Optional[tuple[int, dict[str, Any]]]:
+    """``OMPI_TPU_RESTART``-keyed revival restore (the errmgr
+    respawn/selfheal rejoin): when this process is a revived incarnation
+    and a committed snapshot exists, load THIS rank's view of the latest
+    one and return ``(seq, state)``; None on a first life (or when
+    nothing was ever committed — the revived rank recomputes from 0).
+
+    Deliberately NON-collective, unlike :func:`restart`: the survivors
+    are mid-step and cannot pair a collective restore with the revived
+    rank — each life reads only its own committed shard.  The in-flight
+    gap between the snapshot and the failure point is the message log's
+    job (``ckpt.msglog`` auto-replay on the peer-revived event).
+
+    ``rank`` overrides the in-store rank key (apps using one store PER
+    rank pass 0 — they keyed the store path by rank instead).
+    """
+    if not restart_incarnation():
+        return None
+    seq = store.latest()
+    if seq is None:
+        return None
+    if trace_mod.active:
+        trace_mod.instant("ckpt", "auto_restore", rank=comm.pml.rank,
+                          seq=int(seq), life=restart_incarnation())
+    state = store.load_rank(seq, comm.rank if rank is None else rank)
+    if restore_fn is not None:
+        state = {k: restore_fn(k, v) for k, v in state.items()}
+    return seq, state
 
 
 def checkpoint(comm, store: SnapshotStore, state: dict[str, Any],
@@ -207,6 +249,16 @@ class CheckpointManager:
                 ) -> tuple[int, dict[str, Any]]:
         self.wait()
         return restart(self.comm, self.store, seq, restore_fn)
+
+    def auto_restore(self, restore_fn: Optional[Callable] = None,
+                     rank: Optional[int] = None
+                     ) -> Optional[tuple[int, dict[str, Any]]]:
+        """``OMPI_TPU_RESTART``-keyed revival restore (see module-level
+        :func:`auto_restore`): non-collective latest-snapshot load when
+        this process is an errmgr-revived incarnation, else None.
+        ``rank`` overrides the in-store rank key, exactly as on the
+        module function (per-rank stores pass 0)."""
+        return auto_restore(self.comm, self.store, restore_fn, rank)
 
 
 def _MAX():

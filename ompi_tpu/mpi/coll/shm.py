@@ -231,7 +231,22 @@ class Arena:
         if ft is not None:
             # same dead-set the PMIx path feeds: posted recvs, parked
             # sends, and every later arena wait fail fast too
-            ft.detector.mark_failed(w, reason)
+            if ft.detector.mark_failed(w, reason):
+                # ...and the same control-plane push the gossip path
+                # makes: under errmgr selfheal the runtime reaps the
+                # corpse and revives it (the probe is a detection
+                # source of the full recovery cycle, not a local
+                # verdict), and every other rank's poll learns the
+                # death even with its own probes cold.  getattr: test
+                # harnesses install minimal detector stubs.
+                report = getattr(ft.detector, "report_to_runtime", None)
+                if report is not None:
+                    # adopted_inc, not _peer_inc: a transitive adopter's
+                    # stamp must carry the gossip-adopted life too, or
+                    # its report about a wedged life is stale-gated
+                    # forever (getattr: minimal test stubs)
+                    inc = getattr(ft, "adopted_inc", None)
+                    report(w, reason, inc(w) if inc is not None else 0)
         from ompi_tpu.mpi.constants import ERR_PROC_FAILED
 
         raise MPIException(

@@ -53,10 +53,24 @@ PML's ordered frame path (``_enqueue_frame``), below MPI matching — they
 are immune to the revoked-cid poison (recovery must run on a revoked
 communicator) and carry an attempt counter ``n`` so the fault injector
 gives every retransmission a fresh drop verdict.
+
+Incarnation fence (errmgr respawn/selfheal rejoin): FT frames carry the
+sender's own incarnation (``si``) and the incarnation they were stamped
+FOR (``de`` — the destination epoch, distinct from the gossip epoch
+``ep`` beats already use).  A frame from a peer's dead life (``si``
+below its known incarnation) or stamped for THIS rank's dead life
+(``de`` below our incarnation) is dropped and counted
+(``ft_fenced_frames_total``): agree sequence numbers and gossip epochs
+restart at 0 in a revived life, so without the fence a dead life's
+in-flight decision could complete the new life's same-numbered
+agreement with stale membership.  Senders that have not yet learned the
+new incarnation heal through the PML rebind re-announce; FT protocols
+retransmit, so a fenced frame costs a retry, never a hang.
 """
 
 from __future__ import annotations
 
+import inspect
 import itertools
 import threading
 import time
@@ -136,11 +150,24 @@ class FailureDetector:
         self._runtime_marked: set[int] = set()  # deaths the control
         # plane reported — reconciled on every poll so an errmgr-respawn
         # revival (proc_revived clears the server dead-set) un-declares
+        self._stale_reports: set[int] = set()  # pushes the server
+        # stale-gated (a revive was in flight) — retried by the gossip
+        # loop until accepted (wedge escape) or the rank revives
         self._reasons: dict[int, str] = {}
+        self._revived_at: dict[int, float] = {}  # rank → last direct-
+        # evidence revive: poll_runtime skips re-marking a rank whose
+        # revive landed after the poll's snapshot was taken (the RPC
+        # reply would otherwise resurrect the death it just cleared)
         self._lock = threading.Lock()
         self._listeners: list = []
         self._revive_listeners: list = []
+        self._poll_hooks: list = []
         self._client = None
+        self._report_legacy: Optional[bool] = None  # report_failed
+        # lacks the incarnation parameter (older stubs) — probed once
+        # per client from its signature, NOT by catching TypeError per
+        # call (a TypeError raised INSIDE a modern client would then be
+        # misread as a legacy surface and the report double-sent)
         self._last_poll = 0.0
         self._watch_stop: Optional[threading.Event] = None
 
@@ -150,6 +177,7 @@ class FailureDetector:
         """Connect the runtime control plane (a PMIxClient) and start the
         background watcher that keeps polling while the app is blocked."""
         self._client = client
+        self._report_legacy = None   # re-probe the new client's surface
         if self._watch_stop is None:
             self._watch_stop = threading.Event()
             t = threading.Thread(target=self._watch, name="ft-detector",
@@ -191,6 +219,41 @@ class FailureDetector:
         with self._lock:
             self._revive_listeners.append(cb)
 
+    def add_poll_hook(self, cb) -> None:
+        """cb() runs before each actual runtime poll, on the polling
+        thread (the background watcher or an app thread — never a
+        transport reader): deferred control-plane pushes that reader
+        threads queued (e.g. adoption notices) ride it even when the
+        gossip loop is disabled."""
+        with self._lock:
+            self._poll_hooks.append(cb)
+
+    def revive(self, world_rank: int) -> bool:
+        """Un-declare a death on direct evidence (a frame from the
+        peer's NEW incarnation, or the runtime poll diff).  True when
+        the rank was locally dead.  Needed beyond the poll diff: under
+        errmgr selfheal the server-side dead window (reap → revive) can
+        be shorter than a poll period, so a rank whose own report was
+        stale-gated would otherwise hold its local death forever."""
+        with self._lock:
+            was = world_rank in self._dead
+            self._dead.discard(world_rank)
+            self._reasons.pop(world_rank, None)
+            self._runtime_marked.discard(world_rank)
+            self._stale_reports.discard(world_rank)
+            self._revived_at[world_rank] = time.monotonic()
+            cbs = list(self._revive_listeners) if was else []
+        if was:
+            _log.verbose(1, "detector: rank %d revived (new incarnation "
+                         "evidence)", world_rank)
+        for cb in cbs:
+            try:
+                cb(world_rank)
+            except Exception as e:  # noqa: BLE001 — detector survives
+                _log.error("revive listener failed for %d: %r",
+                           world_rank, e)
+        return was
+
     # -- querying ----------------------------------------------------------
 
     def is_dead(self, world_rank: int, poll: bool = True) -> bool:
@@ -209,6 +272,59 @@ class FailureDetector:
     def reason(self, world_rank: int) -> str:
         return self._reasons.get(world_rank, "")
 
+    def report_to_runtime(self, world_rank: int, reason: str = "",
+                          incarnation: int = 0) -> bool:
+        """Push a locally-observed death (gossip suspect, arena writer
+        probe) to the runtime control plane so the launcher can reap the
+        hung pid — under errmgr selfheal that reap IS the start of the
+        revive cycle — and every other rank's poll learns it.
+        ``incarnation`` is the victim's life number as this process
+        knows it (the adopted ``si``): the server drops reports about
+        lives it already reaped, so racing reporters cannot SIGKILL a
+        freshly-revived rank.  A stale-gated push is remembered (see
+        :meth:`stale_reported`) so the gossip loop can retry it — the
+        gated life may itself wedge, and nobody else will ever
+        re-report it.  False when no client is attached or the push
+        failed."""
+        client = self._client
+        if client is None:
+            return False
+        if self._report_legacy is None:
+            # older client surface (tests, external stubs) without the
+            # incarnation parameter — detected ONCE from the signature
+            try:
+                inspect.signature(client.report_failed).bind(
+                    world_rank, reason, incarnation)
+                self._report_legacy = False
+            except TypeError:
+                self._report_legacy = True
+            except ValueError:   # no introspectable signature (C-level)
+                self._report_legacy = False
+        try:
+            verdict = (client.report_failed(world_rank, reason)
+                       if self._report_legacy else
+                       client.report_failed(world_rank, reason, incarnation))
+        except Exception as e:  # noqa: BLE001 — control plane optional
+            _log.verbose(1, "report_failed(%d) failed: %r", world_rank, e)
+            return False
+        with self._lock:
+            if verdict == "stale":
+                self._stale_reports.add(world_rank)
+            else:
+                self._stale_reports.discard(world_rank)
+        return True
+
+    def stale_reported(self) -> set[int]:
+        """Locally-dead ranks whose latest control-plane push was
+        stale-gated: a revive of the victim was in flight when this
+        process reported it.  If that new life wedges before any
+        survivor adopts its incarnation, the one-shot gossip declare
+        has already fired — these are re-pushed (by the gossip loop)
+        until the server's wedge escape accepts one or the new life's
+        evidence revives the rank locally."""
+        with self._lock:
+            return {r for r in self._stale_reports if r in self._dead}
+
     def poll_runtime(self, force: bool = False) -> None:
         """Rate-limited pull of the runtime dead-set."""
         client = self._client
@@ -220,13 +336,28 @@ class FailureDetector:
             if not force and now - self._last_poll < period:
                 return
             self._last_poll = now
+            hooks = list(self._poll_hooks)
+        for cb in hooks:
+            try:
+                cb()
+            except Exception as e:  # noqa: BLE001 — detector survives
+                _log.error("poll hook failed: %r", e)
+        snap_t = now  # the RPC reply reflects server state no older
+        # than this instant — a direct-evidence revive() stamped at or
+        # after it may postdate the server's snapshot, so its rank must
+        # not be re-marked from this (possibly stale) reply: re-marking
+        # would fail pending ops toward the healthy new life for a poll
+        # period and, if it lands mid msglog auto-replay, lose the
+        # one-shot replay of the in-flight gap for good
         try:
             failed = client.failed_ranks()   # rank → reason
         except Exception:  # noqa: BLE001 — control plane may be tearing down
             return
         with self._lock:
+            fresh = {r: reason for r, reason in failed.items()
+                     if self._revived_at.get(r, 0.0) < snap_t}
             revived = self._runtime_marked - set(failed)
-            self._runtime_marked = set(failed)
+            self._runtime_marked = set(fresh)
             self._dead -= revived   # errmgr/respawn brought them back
             for r in revived:
                 self._reasons.pop(r, None)
@@ -237,7 +368,7 @@ class FailureDetector:
                     cb(r)
                 except Exception as e:  # noqa: BLE001 — detector survives
                     _log.error("revive listener failed for %d: %r", r, e)
-        for r, reason in failed.items():
+        for r, reason in fresh.items():
             self.mark_failed(r, reason=reason or "runtime-declared")
 
     def _watch(self) -> None:
@@ -310,6 +441,16 @@ class PmlFT:
         self._beats: dict[int, list] = {}
         self._beat_epoch = 0
         self._gossip_stop: Optional[threading.Event] = None
+        # highest peer incarnation whose gossip entry was reset — the
+        # once-per-life gate of peer_reincarnated (beats from the new
+        # life arrive repeatedly and must not re-reset its clock)
+        self._gossip_inc: dict[int, int] = {}
+        # adopted lives not yet pushed to the control plane ("adopted"
+        # RPC, closes the server's boot-wedge escape): queued on the
+        # adopt transition (reader threads must not block on an RPC)
+        # and drained by the gossip loop / the detector poll thread
+        self._adopt_notify: dict[int, int] = {}
+        self.detector.add_poll_hook(self._flush_adopt_notices)
 
     def close(self) -> None:
         self.detector.close()
@@ -439,16 +580,66 @@ class PmlFT:
     def _send_ft(self, peer: int, hdr: dict) -> None:
         """One FT control frame via the PML's ordered worker path (non-
         blocking; reader-thread safe).  Dead peers are skipped — FT
-        frames must not pile up in the park-and-heal queue."""
+        frames must not pile up in the park-and-heal queue.  Frames are
+        stamped with the sender's incarnation (``si``) and the peer's
+        known incarnation (``de``) so a revived receiver can fence
+        traffic stamped for its dead life."""
         if peer == self.pml.rank:
             return
         if self.detector.is_dead(peer, poll=False):
             return
+        if self.pml.incarnation:
+            hdr.setdefault("si", self.pml.incarnation)
+        de = self.pml._peer_epoch.get(peer, 0)
+        if de:
+            hdr.setdefault("de", de)
         self.pml._enqueue_frame(peer, hdr, b"", None)
 
     def on_ft_frame(self, peer: int, hdr: dict) -> None:
         """Dispatch one incoming FT frame (BTL reader thread: never
         block, sends only via the worker queue)."""
+        # incarnation fence (errmgr respawn/selfheal): a frame stamped
+        # for a previous life of THIS rank, or sent by a previous life
+        # of the PEER, is stale — its seq spaces (agree seqs, gossip
+        # epochs) restarted with the new life, so acting on it could
+        # complete a new-life agreement with dead-life state.  Dropped
+        # like the PML drops pre-restart data frames; the protocols'
+        # retransmission (and the rebind re-announce) heal the gap.
+        # liveness beats are exempt from the destination-epoch fence: a
+        # beat proves the SENDER is alive regardless of which of my
+        # lives it was stamped for, and fencing it would starve a
+        # revived rank's gossip clocks exactly in its rejoin window —
+        # it would then declare every not-yet-adopted survivor stalled
+        # (a kill storm).  The si fence below still applies: a beat
+        # from the peer's own dead life cannot refresh its clock.
+        if (hdr.get("op") != "beat"
+                and int(hdr.get("de", 0)) < self.pml.incarnation):
+            trace_mod.count("ft_fenced_frames_total")
+            _log.verbose(1, "rank %d: fenced ft %r from %d (de %d < "
+                         "inc %d)", self.pml.rank, hdr.get("op"), peer,
+                         int(hdr.get("de", 0)), self.pml.incarnation)
+            # same heal as the PML data fence: the sender is stamping
+            # for our dead life, so its rebind adopt never landed —
+            # re-announce (rate-limited) instead of fencing it forever
+            self.pml._heal_reannounce(peer)
+            return
+        # shared fence/adopt choke point (pml.note_peer_si): the FT
+        # plane may learn a revival before any data frame does — the
+        # adopt resets the wire-seq space and restamps parked frames
+        # under the same lock, exactly like the data path
+        si = int(hdr.get("si", 0))
+        fenced, adopted = self.pml.note_peer_si(peer, si)
+        if fenced:
+            trace_mod.count("ft_fenced_frames_total")
+            _log.verbose(1, "rank %d: fenced ft %r from dead life of %d "
+                         "(si %d)", self.pml.rank, hdr.get("op"), peer, si)
+            return
+        if adopted:
+            # a frame stamped by a NEW life of the peer is direct
+            # revival evidence — un-declare a locally-held death.  Only
+            # on the adopt transition: a revived peer stamps si forever,
+            # and steady-state frames must not pay the extra locks
+            self.peer_reincarnated(peer, si)
         self._note_alive(peer)   # any FT frame is liveness evidence
         op = hdr.get("op")
         if op == "revoke":
@@ -465,6 +656,65 @@ class PmlFT:
             self._recv_beat(peer, hdr)
         else:
             _log.error("unknown ft op %r from %d", op, peer)
+
+    def peer_reincarnated(self, peer: int, inc: int) -> None:
+        """Direct transport evidence that ``peer`` is back as life
+        ``inc`` (its rebind announce, or any si-stamped frame from the
+        new incarnation): un-declare it NOW instead of waiting to
+        observe the runtime dead-set transition — under errmgr selfheal
+        the reap→revive window can be shorter than a detector poll
+        period, so the poll diff alone can miss the revival entirely
+        and the local death would stick forever (starving the revived
+        rank of gossip beats, which then declares the SURVIVORS)."""
+        if not inc:
+            return
+        # reset the gossip clock/epoch for the new life REGARDLESS of
+        # whether this process ever declared the death: a reap→revive
+        # faster than both the poll period and the gossip window leaves
+        # a survivor that never marked the death holding the DEAD
+        # life's high epoch — the new life's restarted epochs would
+        # never pass it transitively, and (if this rank is not one of
+        # the revived rank's direct beat targets) the stalled entry
+        # would re-declare the healthy new life one window later with
+        # the ADOPTED incarnation, sailing through the server's stale
+        # gate and SIGKILLing it.  Once per adopted life, not per
+        # frame: beats from the new life must still be able to advance
+        # its fresh epoch/clock normally.
+        with self._lock:
+            fresh_life = inc > self._gossip_inc.get(peer, 0)
+            if fresh_life:
+                self._gossip_inc[peer] = inc
+                # close the server's boot-wedge escape for the adopted
+                # life — queued, not pushed: this runs on transport
+                # reader threads, which must never block on an RPC
+                self._adopt_notify[peer] = inc
+        if fresh_life:
+            self._gossip_reset(peer)
+        if self.detector.is_dead(peer, poll=False):
+            self.detector.revive(peer)
+
+    def _flush_adopt_notices(self) -> None:
+        """Drain queued adoption notices to the control plane (gossip
+        loop / detector poll thread — safe to RPC here).  A push that
+        fails is re-queued: the notice must eventually land or a stale
+        report after ``pmix_register_grace_s`` could reap the healthy
+        adopted life."""
+        client = self.detector._client
+        notify = getattr(client, "peer_adopted", None)
+        if notify is None:
+            return
+        with self._lock:
+            pending = dict(self._adopt_notify)
+            self._adopt_notify.clear()
+        for peer, inc in pending.items():
+            try:
+                notify(peer, inc)
+            except Exception as e:  # noqa: BLE001 — control plane optional
+                _log.verbose(1, "peer_adopted(%d, %d) failed: %r",
+                             peer, inc, e)
+                with self._lock:
+                    if inc > self._adopt_notify.get(peer, 0):
+                        self._adopt_notify[peer] = inc
 
     # -- rank-plane gossip heartbeats --------------------------------------
 
@@ -502,29 +752,56 @@ class PmlFT:
 
     def _gossip_reset(self, world_rank: int) -> None:
         """A respawned rank restarts its epochs at 0: reset its entry so
-        the old (higher) epoch does not mask the new life as a stall."""
+        the old (higher) epoch does not mask the new life as a stall.
+        The clock is stamped one window INTO THE FUTURE: revival is
+        observed at reap/announce time, but the new life's first beat
+        only comes after its interpreter boots (seconds on a loaded
+        box) — without the boot grace a tight gossip window would
+        re-declare the booting life and the reap→revive cycle would
+        chase its own tail."""
         with self._lock:
             if world_rank in self._beats:
-                self._beats[world_rank] = [0, time.monotonic()]
+                self._beats[world_rank] = [
+                    0, time.monotonic() + gossip_window()]
 
     def _recv_beat(self, peer: int, hdr: dict) -> None:
         """Merge one gossip beat: the sender's own epoch plus its view of
         everyone else's — epochs spread transitively, so a rank two hops
-        away still sees progress it never heard directly."""
+        away still sees progress it never heard directly.  View entries
+        are ``[epoch, incarnation]`` (legacy plain ints read as life 0):
+        epochs only compare within the SAME life — a not-yet-adopted
+        survivor's in-flight view carrying a dead life's high epoch must
+        not re-poison an entry just reset for the new life (pinning it
+        above the restarted epochs and re-declaring the healthy rank),
+        and a view naming a NEWER life than we know is itself revival
+        evidence, spread transitively like the epochs."""
         self._note_alive(peer, int(hdr.get("ep", 0)))
-        now = time.monotonic()
         me = self.pml.rank
+        reincarnated = []
         with self._lock:
-            for r, e in (hdr.get("v") or {}).items():
-                r, e = int(r), int(e)
+            now = time.monotonic()
+            for r, ev in (hdr.get("v") or {}).items():
+                r = int(r)
+                e, vinc = ((int(ev[0]), int(ev[1]))
+                           if isinstance(ev, (list, tuple)) else (int(ev), 0))
                 if r in (me, peer):
                     continue
+                known = self._gossip_inc.get(r, 0)
+                if vinc < known:
+                    continue   # a dead life's epoch: not progress
+                if vinc > known:
+                    reincarnated.append((r, vinc))
+                    continue   # reset first; later views merge normally
                 ent = self._beats.get(r)
                 if ent is None:
                     self._beats[r] = [e, now]
                 elif e > ent[0]:
                     ent[0] = e
-                    ent[1] = now   # the epoch ADVANCED: that is progress
+                    # the epoch ADVANCED: that is progress — but never
+                    # pull the clock BACK over a revive boot grace
+                    ent[1] = max(ent[1], now)
+        for r, vinc in reincarnated:
+            self.peer_reincarnated(r, vinc)
 
     def _gossip_targets(self, world: list[int]) -> list[int]:
         """Recursive-doubling fan-out: peers at distance 2^i in rank
@@ -551,8 +828,11 @@ class PmlFT:
             self._beat_epoch += 1
             with self._lock:
                 world = sorted(self._beats)
-                view = {r: ent[0] for r, ent in self._beats.items()}
-            view[me] = self._beat_epoch
+                # view entries carry [epoch, incarnation]: epochs only
+                # compare within one life of a rank (see _recv_beat)
+                view = {r: [ent[0], self._gossip_inc.get(r, 0)]
+                        for r, ent in self._beats.items()}
+            view[me] = [self._beat_epoch, self.pml.incarnation]
             live = [r for r in world
                     if not self.detector.is_dead(r, poll=False)]
             for peer in self._gossip_targets(live):
@@ -569,6 +849,37 @@ class PmlFT:
                 if self.detector.is_dead(r, poll=False):
                     continue
                 self._gossip_declare(r, silent_for)
+            # pushes the server stale-gated (our declare raced a revive
+            # of the victim) are retried once per beat: if the revived
+            # life wedges before anyone adopts its incarnation, the
+            # one-shot declare above never fires again, and without the
+            # retry the wedge escape server-side would have no report
+            # left to accept — the hung pid would be unreapable
+            for r in self.detector.stale_reported():
+                if r == me:
+                    continue
+                self.detector.report_to_runtime(
+                    r, self.detector.reason(r) or
+                    "gossip: stale-gated report retry",
+                    self.adopted_inc(r))
+            # adoption notices queued by reader threads (close the
+            # server's wedge escape within a beat, not a poll period)
+            self._flush_adopt_notices()
+
+    def adopted_inc(self, world_rank: int) -> int:
+        """The highest incarnation of ``world_rank`` this process has
+        adopted, across BOTH adoption paths: direct transport evidence
+        (``pml._peer_inc``, set by rebind / si-stamped frames) and
+        gossip-transitive adoption (``_gossip_inc``, set by
+        ``peer_reincarnated`` off a third-party beat view).  Failure
+        reports must be stamped with THIS, not ``_peer_inc`` alone: a
+        transitive adopter never hears the new life directly, so its
+        ``_peer_inc`` stays 0 — every report it pushed about a
+        later-wedged life would be stale-gated while its own
+        ``adopted`` push had closed the server's wedge escape, leaving
+        the hung pid unreapable forever."""
+        return max(self._gossip_inc.get(world_rank, 0),
+                   self.pml._peer_inc.get(world_rank, 0))
 
     def _gossip_declare(self, world_rank: int, silent_for: float) -> None:
         """A peer's epoch stood still past the window: suspect → the same
@@ -579,13 +890,12 @@ class PmlFT:
                   f"(epoch stalled)")
         if not self.detector.mark_failed(world_rank, reason):
             return
-        client = self.detector._client
-        if client is not None:
-            try:
-                client.report_failed(world_rank, reason)
-            except Exception as e:  # noqa: BLE001 — control plane optional
-                _log.verbose(1, "gossip: report_failed(%d) failed: %r",
-                             world_rank, e)
+        # the reap this triggers is, under errmgr selfheal, the first
+        # step of the revive cycle (reap → respawn → rejoin); the
+        # incarnation stamp keeps a racing second reporter from killing
+        # the life the first report's revive just started
+        self.detector.report_to_runtime(
+            world_rank, reason, self.adopted_inc(world_rank))
 
     def _recv_revoke(self, hdr: dict) -> None:
         cid = hdr["cid"]
@@ -843,11 +1153,15 @@ def pml_ft(pml: "PmlOb1") -> PmlFT:
 
 
 def attach_runtime(pml: "PmlOb1", client) -> None:
-    """runtime.init wiring: arm the detector against the job's control
-    plane so peer deaths the launcher/heartbeat monitor observed surface
-    as MPI_ERR_PROC_FAILED here, and (when ``ft_gossip_period`` > 0)
-    start the rank-plane gossip heartbeats that catch in-host hangs the
-    daemon-level layer cannot see."""
+    """runtime.init wiring (errmgr notify/selfheal, or ft_enable): arm
+    the detector against the job's control plane so peer deaths the
+    launcher/heartbeat monitor observed surface as MPI_ERR_PROC_FAILED
+    here, and (when ``ft_gossip_period`` > 0) start the rank-plane
+    gossip heartbeats that catch in-host hangs the daemon-level layer
+    cannot see.  Under selfheal the detector's revive listeners are the
+    rejoin half of the cycle: the errmgr's revive clears the runtime
+    dead-set, the next poll un-declares the peer, and gossip epochs
+    reset so the new life is not instantly re-declared."""
     if client is None:
         return
     ft = pml_ft(pml)

@@ -1167,6 +1167,30 @@ class PmlOb1:
             for qhdr in self._inqueue.get(peer, ()):
                 self._restamp_if_stale(peer, qhdr)
 
+    def note_peer_si(self, peer: int, si: int) -> tuple[bool, bool]:
+        """Reader-thread half of the incarnation fence, shared by the
+        PML data path and the FT control path so the two planes cannot
+        drift: fence a frame sent by a DEAD life of ``peer``, adopt a
+        newer life.  Returns ``(fenced, adopted)`` — ``fenced``: drop
+        the frame (stale si, or an unstamped frame from a peer whose
+        reincarnation we already adopted); ``adopted``: ``si`` is a new
+        life (the adopt TRANSITION — the caller should treat the frame
+        as revival evidence via ``ft.peer_reincarnated``, outside this
+        lock).  The lock is taken only when incarnations are in play
+        (an si stamp, or this peer's already-adopted revival): one rank
+        reviving must not put every other peer's frames on the hot PML
+        lock."""
+        if not (si or peer in self._peer_inc):
+            return False, False
+        with self._lock:
+            known = self._peer_inc.get(peer, 0)
+            if si < known:
+                return True, False
+            if si:
+                self._adopt_incarnation(peer, si)
+                return False, si > known
+        return False, False
+
     def _restamp_if_stale(self, peer: int, hdr: dict) -> None:
         """With self._lock held: a seq-carrying frame stamped for an older
         incarnation of ``peer`` gets a fresh seq + the current epoch (its
@@ -1179,6 +1203,21 @@ class PmlOb1:
         hdr["seq"] = self._seq.get(key, 0)
         self._seq[key] = hdr["seq"] + 1
         hdr["ep"] = epoch
+
+    def _heal_reannounce(self, peer: int) -> None:
+        """Fence-heal half of the incarnation protocol, shared by the
+        PML data fence and the FT control fence: a peer stamping frames
+        for our dead life never processed our rebind — re-announce (via
+        the send worker; rate-limited to one per second per peer so a
+        chatty stale sender cannot flood) instead of fencing it out
+        forever."""
+        now = time.monotonic()
+        with self._lock:
+            need = now >= self._reannounce_at.get(peer, 0.0)
+            if need:
+                self._reannounce_at[peer] = now + 1.0
+        if need:
+            self.announce_rebind({peer: ""})
 
     def _on_frame(self, peer: int, hdr: dict, payload: bytes) -> None:
         t = hdr["t"]
@@ -1195,22 +1234,30 @@ class PmlOb1:
                              "(ep %d < %d)", peer, hdr.get("ep", 0),
                              self.incarnation)
                 self.pvar_fenced.inc()
-                import time as _time
-
-                now = _time.monotonic()
-                with self._lock:
-                    need = now >= self._reannounce_at.get(peer, 0.0)
-                    if need:
-                        self._reannounce_at[peer] = now + 1.0
-                if need:
-                    self.announce_rebind({peer: ""})
+                self._heal_reannounce(peer)
                 return
+            si = hdr.get("si", 0)
+            if si:
+                # (si-gated: unstamped data frames ride the seq/ep
+                # machinery instead of the incarnation fence)
+                fenced, adopted = self.note_peer_si(peer, si)
+                if fenced:
+                    return  # residual frame from a dead incarnation
+                # an si-stamped data frame can outrun the rebind frame
+                # across transports: it is the same revival evidence, so
+                # it must also un-declare a locally-held death (and
+                # reset gossip clocks) BEFORE the drain below spawns the
+                # msglog auto-replay — the detector would otherwise fail
+                # the replay's sends, and the one-shot revive event
+                # would be lost for good.  Only on the adopt TRANSITION:
+                # a revived sender stamps si on every frame for the rest
+                # of the job, and paying two more lock acquisitions per
+                # frame on this reader thread would tax steady-state
+                # traffic forever (a same-life false local declare still
+                # heals via the reap / next detector poll)
+                if adopted and self.ft is not None:
+                    self.ft.peer_reincarnated(peer, si)
             with self._lock:
-                si = hdr.get("si", 0)
-                if si:
-                    if si < self._peer_inc.get(peer, 0):
-                        return  # residual frame from a dead incarnation
-                    self._adopt_incarnation(peer, si)
                 if self._eng is not None:
                     # seq gate + matching in the compiled engine; the
                     # protocol actions come back for this thread to run
@@ -1264,8 +1311,22 @@ class PmlOb1:
                 # new incarnation and are DROPPED by its receiver —
                 # without the epoch fence they would park forever.
                 inc = hdr.get("inc", 1)
+                known = self._peer_inc.get(peer, 0)
                 self._peer_epoch[peer] = inc
                 self._adopt_incarnation(peer, inc)
+            # direct revival evidence for the failure detector: under
+            # selfheal the runtime's dead window can be shorter than a
+            # poll period, so the rebind frame itself must un-declare —
+            # and it must do so BEFORE the event dispatch below spawns
+            # the msglog auto-replay, whose sends would otherwise race
+            # a still-held local death mark.  Only on the adopt
+            # TRANSITION, like the si paths: rebind frames are also the
+            # rate-limited fence-heal re-announce of an ESTABLISHED
+            # life, and an in-flight re-announce from a life that has
+            # since been declared hung must not cancel that (newer)
+            # suspicion — nor its stale-gated wedge-escape retry
+            if inc > known and self.ft is not None:
+                self.ft.peer_reincarnated(peer, inc)
             # the adopt enqueued EVT_PEER_REVIVED — dispatch NOW (outside
             # the lock, per the listener contract): a blocked survivor
             # may never issue another call that would drain, and the
@@ -1281,10 +1342,14 @@ class PmlOb1:
                 state.req.fail(MPIException(
                     "rsend: no matching receive was posted at the peer",
                     error_class=4))
-        elif t == "ft":  # ULFM control plane (revoke / agree)
+        elif t == "ft":  # ULFM control plane (revoke / agree / gossip)
             from ompi_tpu.mpi import ft as ft_mod
 
             ft_mod.pml_ft(self).on_ft_frame(peer, hdr)
+            # the FT plane may have adopted a revived peer's incarnation
+            # (si stamp outrunning the rebind frame): dispatch the
+            # enqueued EVT_PEER_REVIVED now so msglog auto-replay runs
+            self._drain_events()
         else:
             _log.error("unknown frame type %r from %d", t, peer)
 

@@ -155,11 +155,14 @@ def init() -> Communicator:
                 # incarnation's card — re-announce so they re-route and
                 # reset the wire-seq space toward me
                 pml.announce_rebind(peers)
-            # ULFM failure detector: under the notify errmgr policy (or
-            # forced via ft_enable) peer deaths reported by the control
-            # plane surface as MPI_ERR_PROC_FAILED instead of a hang /
-            # full retry-window stall.  Off under respawn by default:
-            # its dead-set is transient while a rank revives.
+            # ULFM failure detector: under the notify or selfheal errmgr
+            # policies (or forced via ft_enable) peer deaths reported by
+            # the control plane surface as MPI_ERR_PROC_FAILED instead
+            # of a hang / full retry-window stall — and under selfheal
+            # the same detector's revive listeners flip the peer back
+            # alive when the errmgr's revive lands.  Off under plain
+            # respawn by default: its dead-set is transient while a rank
+            # revives and nothing user-visible consumes it.
             # both modules register their config vars on import — the
             # launcher has them, this app process may not yet
             from ompi_tpu.mpi import ft as ft_mod
@@ -170,7 +173,7 @@ def init() -> Communicator:
             # NOT arm the detector)
             selected = {t.strip()
                         for t in str(_vars.get("errmgr") or "").split(",")}
-            if _vars.get("ft_enable") or "notify" in selected:
+            if _vars.get("ft_enable") or selected & {"notify", "selfheal"}:
                 ft_mod.attach_runtime(pml, client)
 
         world = Communicator(Group(range(size)), cid=0, pml=pml,

@@ -117,6 +117,18 @@ _COUNTER_SPECS = (
      "arena waits that detected a dead writer pid via the shared btl "
      "liveness probe (failure surfaced in ~coll_shm_probe_grace "
      "seconds instead of coll_shm_timeout)"),
+    # self-healing ranks (errmgr selfheal + the rejoin fence)
+    ("errmgr_selfheal_revives_total", "ranks",
+     "ranks the errmgr selfheal policy reaped and revived in place "
+     "(counted on the launcher/HNP process)"),
+    ("errmgr_selfheal_escalations_total", "ranks",
+     "selfheal ladder escalations: the revive arm gave up (budget "
+     "exhausted, unrevivable rank, failed start) and the policy "
+     "degraded to the notify/shrink rung — or to abort when no "
+     "survivors could carry the job"),
+    ("ft_fenced_frames_total", "frames",
+     "stale-incarnation FT control frames dropped by the rejoin fence "
+     "(sent by, or stamped for, a dead life of a revived rank)"),
 )
 
 #: plain-int counter store: dict increments, no lock — losses under

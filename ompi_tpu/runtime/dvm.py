@@ -281,6 +281,11 @@ class DvmHnp(MultiHostLauncher):
                     "rank": p.rank, "state": p.state.value,
                     "host": p.node.name if p.node else "?",
                     "local_rank": p.local_rank,
+                    # lives is the monotone revive count (the announced
+                    # incarnation); restarts is the governor's crash-loop
+                    # BUDGET counter, reset whenever a life earns its
+                    # uptime — it reads 0 for a rank revived many times
+                    "lives": p.lives,
                     "restarts": p.restarts,
                     "exit_code": p.exit_code,
                 }

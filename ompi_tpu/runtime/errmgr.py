@@ -36,10 +36,54 @@ job).  Components decide what a proc-failure event does:
   heartbeat failure poisons every surviving task — so device-plane jobs
   recover by full-job restart from the ``ckpt`` snapshots (run respawn
   jobs with ``--mca multihost_auto_init 0``).
+
+- ``selfheal`` — the fused self-healing policy: respawn's revival and
+  notify's propagation stop being separate worlds.  Every detection
+  source the runtime has — the launcher exit reap, the daemon heartbeat
+  monitor, rank-plane gossip (``report_failed`` → the hung pid is
+  SIGKILLed), the coll/shm arena writer probe — lands here and runs the
+  full cycle: the death is propagated to the survivors FIRST (dead-set
+  reason + ``TAG_PROC_FAILED`` xcast, so their detectors fail pending
+  ops fast instead of stalling), then the rank is revived in place
+  through ``respawn_proc`` with ``OMPI_TPU_RESTART`` (snapshot restore
+  via ``ckpt.snapc.auto_restore`` + msglog replay for the in-flight
+  gap), survivors' detectors flip the peer back alive (the revive
+  listeners), and **incarnation numbers** carried in PML data frames
+  (``ep``/``si``) and FT control frames (``de``/``si``) fence stale
+  traffic from the dead life out of the new one.
+
+  Failure response is a LADDER, not a cliff — the policy degrades in
+  order::
+
+      revive  →  notify/shrink  →  abort
+
+  The revive arm is crash-loop gated (shared with plain respawn): a
+  revive only counts as successful once the rank stays up
+  ``errmgr_min_uptime_s``, measured from the life's PMIx registration
+  (boot excluded) — an instant re-death, or a death before the life
+  ever registered, burns one ``errmgr_max_restarts`` slot *with
+  exponential backoff* (the budget cannot drain in milliseconds),
+  while a later death resets the budget (the revive worked).  The
+  budget reset never touches the incarnation: ``proc.lives`` — the
+  number survivors adopt and the fence compares — is monotone across
+  resets, so a rank whose budget was earned back still announces a
+  strictly higher life than any the survivors have seen.  A revived
+  life that wedges *during* boot (never registers) is re-reapable: the
+  PMIx server accepts failure reports about it regardless of their
+  incarnation stamp after ``pmix_register_grace_s``.  When the budget is exhausted, the rank is
+  unrevivable (no ``respawn_proc`` hook, or its daemon died with its
+  host), or a revive fails to start, the policy degrades to the notify
+  rung: the already-propagated death stands, survivors continue
+  smaller (the ULFM shrink recipe applies).  Only when shrink is
+  impossible — no survivors left to carry the job, or no control plane
+  to propagate through — does it fall to the last rung and abort.
+  ``errmgr_selfheal_{revives,escalations}_total`` count the cycle in
+  the flight recorder.  Select with ``--mca errmgr selfheal``.
 """
 
 from __future__ import annotations
 
+import time
 from typing import TYPE_CHECKING
 
 from ompi_tpu.core import output
@@ -51,32 +95,152 @@ if TYPE_CHECKING:
     from ompi_tpu.runtime.launcher import LocalLauncher
 
 __all__ = ["errmgr_framework", "ErrmgrAbort", "ErrmgrRespawn",
-           "ErrmgrContinue", "ErrmgrNotify"]
+           "ErrmgrContinue", "ErrmgrNotify", "ErrmgrSelfheal"]
 
 _log = output.get_stream("errmgr")
 
 errmgr_framework = Framework("errmgr", "failure response policy")
 
 register_var("errmgr", "max_restarts", VarType.SIZE, 2,
-             "errmgr/respawn: revive a failed rank at most this many times "
-             "before falling back to job abort")
+             "errmgr respawn/selfheal: revive a failed rank at most this "
+             "many times before degrading (respawn: job abort; selfheal: "
+             "the notify/shrink rung).  The budget counts CRASH-LOOP "
+             "revivals: a rank that stays up errmgr_min_uptime_s earns "
+             "its budget back")
+register_var("errmgr", "min_uptime_s", VarType.DOUBLE, 5.0,
+             "crash-loop gate for the reviving policies (respawn, "
+             "selfheal): a revive only counts as successful once the "
+             "rank stays up this long, measured from the life's PMIx "
+             "registration (interpreter+jax boot does not count — a "
+             "rank that crashes deterministically right after boot "
+             "cannot earn its budget back).  An earlier re-death — or a "
+             "death before the life ever registered — burns one "
+             "errmgr_max_restarts slot with exponential backoff before "
+             "the next revive (instant-death loops cannot drain the "
+             "budget in milliseconds); a later death resets the budget. "
+             "0 disables the gate: classic budget semantics — every "
+             "revive counts against errmgr_max_restarts, no reset, no "
+             "backoff")
 
 
 def apply_host_plane_policy(errmgr, env: dict, *base_envs: dict) -> None:
-    """errmgr/respawn is HOST-plane recovery: a revived rank cannot
-    rejoin the coordination service, and survivors' jax.distributed
-    threads then pin their processes at exit (a post-finalize spin).
-    The policy implies the plane — when respawn is selected, launch app
-    processes device-plane-off unless the user set the var explicitly
-    (in ``env`` or any of ``base_envs``)."""
+    """Any REVIVING errmgr policy (``REVIVES`` — respawn, selfheal) is
+    HOST-plane recovery: a revived rank cannot rejoin the coordination
+    service, and survivors' jax.distributed threads then pin their
+    processes at exit (a post-finalize spin).  The policy implies the
+    plane — when a reviving policy is selected, launch app processes
+    device-plane-off unless the user set the var explicitly (in ``env``
+    or any of ``base_envs``)."""
     from ompi_tpu.core.config import var_registry
 
-    if getattr(errmgr, "NAME", "") != "respawn":
+    if not getattr(errmgr, "REVIVES", False):
         return
     key = var_registry.ENV_PREFIX + "multihost_auto_init"
     if any(key in e for e in (env, *base_envs)):
         return
     env[key] = "0"
+
+
+def _propagate_failure(launcher, proc: Proc, reason: str) -> None:
+    """The notify rung shared by ErrmgrNotify and ErrmgrSelfheal: put the
+    human-readable reason on the runtime dead-set (idempotent — the reap
+    loop already called ``proc_died``) and flood a TAG_PROC_FAILED xcast
+    down the daemon tree so every host's record shows which rank died."""
+    server = getattr(launcher, "server", None)
+    if server is not None:
+        server.proc_died(proc.rank, reason=reason)
+    node = getattr(launcher, "rml", None)
+    if node is not None:
+        from ompi_tpu.runtime import rml as rml_mod
+
+        try:
+            node.xcast(rml_mod.TAG_PROC_FAILED, (proc.rank, reason))
+        except Exception as e:  # noqa: BLE001 — tree may be tearing down
+            _log.error("failure propagation: TAG_PROC_FAILED xcast "
+                       "failed: %r", e)
+
+
+#: test seam: the backoff sleep (patched by unit tests).  The sleep runs
+#: INSIDE proc_failed — on the local launcher's reap loop, or the
+#: daemon link's RML reader thread — deliberately: deferring the revive
+#: to a timer would race the reap loop's exit (a job whose last pending
+#: rank is mid-backoff would be accounted done with the revive dropped).
+#: The stall is bounded by _BACKOFF_CAP and only ever paid by a rank
+#: that is actively crash-looping.
+_sleep = time.sleep
+
+#: first crash-loop revive backoff; doubles per instant re-death
+_BACKOFF_BASE = 0.5
+#: cap — a rank stuck in a crash loop is probed at most this often
+_BACKOFF_CAP = 5.0
+
+
+class _RestartGovernor:
+    """Crash-loop gating shared by the reviving policies (respawn,
+    selfheal): min-uptime success accounting + exponential revive
+    backoff.  A revive counts as successful only once the rank stayed up
+    ``errmgr_min_uptime_s`` — then the ``errmgr_max_restarts`` budget
+    resets.  An instant re-death keeps the budget burn and returns a
+    (doubling, capped) delay the policy sleeps before the next revive,
+    so a crash loop drains the budget over seconds, not milliseconds."""
+
+    def __init__(self) -> None:
+        self._backoff: dict[tuple[int, int], float] = {}
+
+    def pre_revive_delay(self, job: Job, proc: Proc) -> float:
+        """Classify this death; returns the backoff (seconds) to sleep
+        before reviving — 0.0 for a first death or an earned-uptime one
+        (which also resets ``proc.restarts``).  Uptime is measured from
+        the life's PMIx registration (``launched_at`` is stamped by the
+        server's ``reg`` hook, not at fork), so a slow interpreter boot
+        cannot earn the budget back; a life that died *before* ever
+        registering (``launched_at is None``) is the crash-loopiest case
+        of all and always burns a slot.  Only the budget counter resets
+        here — ``proc.lives`` (the incarnation survivors adopted) is
+        monotone and untouched."""
+        key = (job.jobid, proc.rank)
+        min_up = float(var_registry.get("errmgr_min_uptime_s") or 0)
+        if min_up <= 0.0:
+            # gate disabled: CLASSIC budget semantics — every revive
+            # counts against errmgr_max_restarts, no reset, no backoff.
+            # (Treating every death as "earned" instead would reset the
+            # budget forever and revive a deterministic crasher in a
+            # tight loop that never reaches the degrade rung.)
+            self._backoff.pop(key, None)
+            return 0.0
+        up = (None if proc.launched_at is None
+              else time.monotonic() - proc.launched_at)
+        earned = up is not None and up >= min_up
+        if proc.restarts == 0 or earned:
+            if proc.restarts:
+                _log.verbose(1, "rank %d ran %.1fs (>= min_uptime %.1fs); "
+                             "restart budget reset", proc.rank,
+                             up if up is not None else -1.0, min_up)
+                proc.restarts = 0
+            self._backoff.pop(key, None)
+            return 0.0
+        delay = self._backoff.get(key, _BACKOFF_BASE)
+        self._backoff[key] = min(delay * 2, _BACKOFF_CAP)
+        return min(delay, self._max_reader_stall())
+
+    @staticmethod
+    def _max_reader_stall() -> float:
+        """On a daemon tree the backoff sleep runs on the RML link
+        reader thread (see the ``_sleep`` note): a stall at or above
+        ``rml_heartbeat_timeout`` would starve TAG_HEARTBEAT delivery
+        queued behind it and the HNP would declare the healthy daemon
+        hosting the crash-looping rank lost — failing every rank on
+        that host.  With heartbeats armed, cap the sleep well below the
+        declare timeout.  ``lookup`` rather than ``get``: a purely
+        local run may never import rml, so the vars may be
+        unregistered."""
+        period = var_registry.lookup("rml_heartbeat_period")
+        if period is None or float(period.value or 0) <= 0:
+            return _BACKOFF_CAP
+        timeout = var_registry.lookup("rml_heartbeat_timeout")
+        if timeout is None or float(timeout.value or 0) <= 0:
+            return _BACKOFF_CAP
+        return min(_BACKOFF_CAP, 0.4 * float(timeout.value))
 
 
 @errmgr_framework.component
@@ -97,10 +261,17 @@ class ErrmgrAbort(Component):
 @errmgr_framework.component
 class ErrmgrRespawn(Component):
     """Revive failed ranks in place (≈ errmgr restart + rmaps/resilient,
-    errmgr_default_hnp.c:351-470's ORTE_PROC_STATE_RESTART arm)."""
+    errmgr_default_hnp.c:351-470's ORTE_PROC_STATE_RESTART arm).  Crash
+    loops are gated by the shared governor: instant re-deaths burn the
+    ``errmgr_max_restarts`` budget with exponential backoff, and a rank
+    that stayed up ``errmgr_min_uptime_s`` earns its budget back."""
 
     NAME = "respawn"
     PRIORITY = 0    # opt-in via --mca errmgr respawn
+    REVIVES = True
+
+    def __init__(self) -> None:
+        self._governor = _RestartGovernor()
 
     def proc_failed(self, launcher: "LocalLauncher", job: Job,
                     proc: Proc) -> None:
@@ -114,18 +285,30 @@ class ErrmgrRespawn(Component):
         if respawn is None:
             _log.error("errmgr/respawn: %s cannot revive ranks; aborting",
                        type(launcher).__name__)
-        elif proc.restarts < limit:
-            _log.verbose(1, "rank %d failed (exit %s); respawn %d/%d",
-                         proc.rank, proc.exit_code, proc.restarts + 1, limit)
-            notify(Severity.WARN, "rank-respawn",
-                   f"job {job.jobid} rank {proc.rank} exit "
-                   f"{proc.exit_code}; restart {proc.restarts + 1}/{limit}")
-            if respawn(job, proc):
-                return
-            _log.error("rank %d respawn failed to start", proc.rank)
         else:
-            _log.verbose(1, "rank %d exhausted %d restarts; aborting job",
-                         proc.rank, limit)
+            # may RESET proc.restarts (the previous revive earned its
+            # min-uptime) — classify before the budget check
+            delay = self._governor.pre_revive_delay(job, proc)
+            if proc.restarts < limit:
+                if delay:
+                    _log.verbose(1, "rank %d re-died within "
+                                 "errmgr_min_uptime_s; %.1fs backoff "
+                                 "before revive %d/%d", proc.rank, delay,
+                                 proc.restarts + 1, limit)
+                    _sleep(delay)
+                _log.verbose(1, "rank %d failed (exit %s); respawn %d/%d",
+                             proc.rank, proc.exit_code, proc.restarts + 1,
+                             limit)
+                notify(Severity.WARN, "rank-respawn",
+                       f"job {job.jobid} rank {proc.rank} exit "
+                       f"{proc.exit_code}; restart "
+                       f"{proc.restarts + 1}/{limit}")
+                if respawn(job, proc):
+                    return
+                _log.error("rank %d respawn failed to start", proc.rank)
+            else:
+                _log.verbose(1, "rank %d exhausted %d restarts; aborting "
+                             "job", proc.rank, limit)
         if job.aborted_proc is None:
             job.aborted_proc = proc
             job.abort_reason = (
@@ -165,6 +348,7 @@ class ErrmgrNotify(Component):
 
     NAME = "notify"
     PRIORITY = 0    # opt-in via --mca errmgr notify
+    TOLERATES_DAEMON_LOSS = True
 
     def proc_failed(self, launcher: "LocalLauncher", job: Job,
                     proc: Proc) -> None:
@@ -174,19 +358,104 @@ class ErrmgrNotify(Component):
                   f"(exit code {proc.exit_code})")
         _log.verbose(1, "notify policy: %s; propagating to survivors",
                      reason)
-        server = getattr(launcher, "server", None)
-        if server is not None:
-            # idempotent (the reap loop already called proc_died); this
-            # adds the human-readable reason the detector surfaces
-            server.proc_died(proc.rank, reason=reason)
-        node = getattr(launcher, "rml", None)
-        if node is not None:
-            from ompi_tpu.runtime import rml as rml_mod
-
-            try:
-                node.xcast(rml_mod.TAG_PROC_FAILED, (proc.rank, reason))
-            except Exception as e:  # noqa: BLE001 — tree may be tearing down
-                _log.error("notify: TAG_PROC_FAILED xcast failed: %r", e)
+        _propagate_failure(launcher, proc, reason)
         notify(Severity.WARN, "rank-failed",
                f"job {job.jobid} {reason}; survivors notified "
                f"(job continues)")
+
+
+@errmgr_framework.component
+class ErrmgrSelfheal(Component):
+    """The fused self-healing policy: every failure runs the full
+    detect → reap → revive → rejoin cycle, degrading down the ladder
+    (revive → notify/shrink → abort) instead of falling off a cliff.
+    See the module docstring for the full contract."""
+
+    NAME = "selfheal"
+    PRIORITY = 0    # opt-in via --mca errmgr selfheal
+    REVIVES = True
+    TOLERATES_DAEMON_LOSS = True
+
+    def __init__(self) -> None:
+        self._governor = _RestartGovernor()
+
+    def proc_failed(self, launcher: "LocalLauncher", job: Job,
+                    proc: Proc) -> None:
+        from ompi_tpu.mpi import trace as trace_mod
+        from ompi_tpu.runtime.notifier import Severity, notify
+
+        reason = (f"rank {proc.rank} {proc.state.value} "
+                  f"(exit code {proc.exit_code})")
+        # rung 1 preamble is ALWAYS the notify propagation: survivors'
+        # detectors learn the death now (pending ops toward the corpse
+        # fail fast instead of stalling for the revive), and flip the
+        # peer back alive when the revive lands (the revive listeners)
+        _propagate_failure(launcher, proc, reason)
+        limit = var_registry.get("errmgr_max_restarts")
+        respawn = getattr(launcher, "respawn_proc", None)
+        if proc.daemon_lost or respawn is None:
+            why = ("its daemon died with its host" if proc.daemon_lost
+                   else f"{type(launcher).__name__} cannot revive ranks")
+            self._escalate(launcher, job, proc,
+                           f"rank {proc.rank} is not revivable ({why})")
+            return
+        # may RESET proc.restarts (min-uptime earned) — before the check
+        delay = self._governor.pre_revive_delay(job, proc)
+        if proc.restarts >= limit:
+            self._escalate(launcher, job, proc,
+                           f"rank {proc.rank} exhausted {limit} revive(s) "
+                           f"within errmgr_min_uptime_s")
+            return
+        if delay:
+            _log.verbose(1, "rank %d crash-looping; %.1fs backoff before "
+                         "revive %d/%d", proc.rank, delay,
+                         proc.restarts + 1, limit)
+            _sleep(delay)
+        t0 = trace_mod.begin() if trace_mod.active else 0
+        notify(Severity.WARN, "rank-respawn",
+               f"job {job.jobid} {reason}; selfheal revive "
+               f"{proc.restarts + 1}/{limit}")
+        if respawn(job, proc):
+            trace_mod.count("errmgr_selfheal_revives_total")
+            if t0 and trace_mod.active:
+                # reap→revive half of the cycle; the revived rank's
+                # runtime/init instant closes the rejoin half
+                trace_mod.complete("errmgr", "selfheal_revive", t0,
+                                   rank=proc.rank, restarts=proc.restarts,
+                                   backoff=delay)
+            return
+        self._escalate(launcher, job, proc,
+                       f"rank {proc.rank} revive failed to start")
+
+    def _escalate(self, launcher, job: Job, proc: Proc, why: str) -> None:
+        """The revive arm is out — degrade to the notify/shrink rung (the
+        propagated death stands, the job continues smaller) whenever any
+        other rank can still carry the job; abort only when shrink is
+        impossible (every other rank also failed, or there is no control
+        plane to propagate through)."""
+        from ompi_tpu.mpi import trace as trace_mod
+        from ompi_tpu.runtime.notifier import Severity, notify
+
+        trace_mod.count("errmgr_selfheal_escalations_total")
+        carriers = [p for p in job.procs if p is not proc and p.state
+                    in (ProcState.RUNNING, ProcState.TERMINATED)]
+        can_shrink = (bool(carriers)
+                      and getattr(launcher, "server", None) is not None)
+        if trace_mod.active:
+            trace_mod.instant("errmgr", "selfheal_escalate", rank=proc.rank,
+                              to="shrink" if can_shrink else "abort")
+        if can_shrink:
+            notify(Severity.ERROR, "selfheal-escalate",
+                   f"job {job.jobid}: {why}; degrading to shrink — "
+                   f"survivors continue without rank {proc.rank}")
+            return
+        notify(Severity.CRITICAL, "selfheal-escalate",
+               f"job {job.jobid}: {why} and no shrinkable survivors; "
+               f"aborting")
+        if job.aborted_proc is None:
+            job.aborted_proc = proc
+            job.abort_reason = (
+                f"rank {proc.rank} {proc.state.value} after "
+                f"{proc.restarts} revive(s); selfheal ladder exhausted "
+                f"(exit code {proc.exit_code})")
+        launcher.kill_job(job, exclude=proc)

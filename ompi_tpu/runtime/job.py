@@ -71,7 +71,25 @@ class Proc:
     pid: Optional[int] = None
     exit_code: Optional[int] = None
     local_rank: int = 0  # rank among procs on the same node
-    restarts: int = 0    # times errmgr/respawn revived this rank
+    # crash-loop BUDGET counter: revives since the rank last earned its
+    # errmgr_min_uptime_s (the governor resets it on an earned-uptime
+    # death) — never use it as an identity
+    restarts: int = 0
+    # monotone incarnation number (OMPI_TPU_RESTART / the PMIx life /
+    # the PML si stamp): total revives over the rank's whole history.
+    # Survivors adopt it and the incarnation fence drops anything lower,
+    # so unlike `restarts` it must NEVER go backwards
+    lives: int = 0
+    # monotonic time of this life's PMIx registration (first client
+    # contact) — the errmgr crash-loop governor measures uptime from it
+    # (errmgr_min_uptime_s), so interpreter+jax boot doesn't count; None
+    # until the life registers (a pre-registration death is the
+    # crash-loopiest case of all)
+    launched_at: Optional[float] = None
+    # set by plm._fail_daemon_ranks: this rank's daemon died with its
+    # host, so no revival order can reach it — a reviving errmgr policy
+    # must skip straight to its degrade rung
+    daemon_lost: bool = False
 
 
 @dataclasses.dataclass

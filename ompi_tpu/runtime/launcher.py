@@ -98,8 +98,8 @@ class LocalLauncher:
         env[pmix.ENV_LOCAL_RANK] = str(proc.local_rank)
         if proc.chip is not None:
             env[pmix.ENV_CHIP] = str(proc.chip)
-        if proc.restarts:
-            env["OMPI_TPU_RESTART"] = str(proc.restarts)
+        if proc.lives:
+            env["OMPI_TPU_RESTART"] = str(proc.lives)
         return env
 
     def _launch_proc(self, job: Job, proc: Proc) -> bool:
@@ -127,6 +127,10 @@ class LocalLauncher:
             return False
         proc.pid = p.pid
         proc.state = ProcState.RUNNING
+        # uptime clock (errmgr crash-loop governor) starts at the rank's
+        # PMIx registration, not here — interpreter+jax boot (seconds on
+        # a loaded box) must not count toward errmgr_min_uptime_s
+        proc.launched_at = None
         bind_child(p.pid, proc.local_rank)
         with self._kill_lock:  # kill_job may iterate concurrently
             self._popen[proc.rank] = p
@@ -147,12 +151,13 @@ class LocalLauncher:
         """errmgr/respawn hook: revive a failed rank in place (same rank,
         same env plus OMPI_TPU_RESTART=<n>).  The running reap loop picks
         the new child up; the PMIx server counts the rank live again."""
-        proc.restarts += 1
+        proc.restarts += 1   # budget burn (governor may reset it)
+        proc.lives += 1      # identity: monotone, survives budget resets
         proc.exit_code = None
         if not self._launch_proc(job, proc):
             return False
         if self.server is not None:
-            self.server.proc_revived(proc.rank)
+            self.server.proc_revived(proc.rank, proc.lives)
         with self._kill_lock:
             self._respawned.add(proc.rank)
         return True
@@ -165,6 +170,10 @@ class LocalLauncher:
         # sees a real exit and the errmgr policy runs
         self.server.on_failed_report = \
             lambda r, reason: self._reap_reported(r, reason)
+        # the rank's first PMIx contact starts its uptime clock — the
+        # crash-loop governor must not count interpreter boot as uptime
+        self.server.on_client_contact = \
+            lambda r: self._mark_contact(job, r)
         for proc in job.procs:
             if not self._launch_proc(job, proc):
                 # Failure to start is fatal regardless of errmgr policy —
@@ -194,6 +203,11 @@ class LocalLauncher:
                     pass  # we killed it during abort
                 elif rc == 0:
                     proc.state = ProcState.TERMINATED
+                    # late gossip suspicions about a clean finisher
+                    # (its beats stopped with its transports) must not
+                    # read as failures — tell the report_failed gate
+                    if self.server is not None:
+                        self.server.proc_finished(rank)
                 else:
                     proc.state = ProcState.ABORTED
                     # wake fence/get waiters so surviving ranks don't hang
@@ -267,6 +281,13 @@ class LocalLauncher:
                 w.feed(None)  # EOF
 
         threading.Thread(target=pump, daemon=True).start()
+
+    def _mark_contact(self, job: Job, rank: int) -> None:
+        """PMIx server hook: the rank's current life registered — start
+        its uptime clock (errmgr_min_uptime_s measures from here, so a
+        slow boot can't earn the crash-loop budget back)."""
+        if 0 <= rank < len(job.procs):
+            job.procs[rank].launched_at = time.monotonic()
 
     def _reap_reported(self, rank: int, reason: str) -> None:
         """SIGKILL one reported-dead rank (it is hung, not exited — a

@@ -231,10 +231,10 @@ class MultiHostLauncher:
         uris.update({v: u for v, (u, _h) in self._registered.items()})
         self.rml.dial_children(
             [(c, uris[c]) for c in rml.tree_children(0, total)])
-        # notify is the only policy that survives a daemon death, so it is
-        # the only one whose orphans should wait for adoption instead of
-        # applying the lifeline teardown — the flag rides the WIRE payload
-        reparent = getattr(self._errmgr, "NAME", "") == "notify"
+        # only the policies that survive a daemon death (notify, selfheal)
+        # should have orphans wait for adoption instead of applying the
+        # lifeline teardown — the flag rides the WIRE payload
+        reparent = getattr(self._errmgr, "TOLERATES_DAEMON_LOSS", False)
         for v in range(1, total):
             children = [(c, uris[c]) for c in rml.tree_children(v, total)]
             self.rml.send_direct(self.rml.boot_links[v], rml.TAG_WIRE,
@@ -273,6 +273,10 @@ class MultiHostLauncher:
         # stall _wait_ranks forever
         self.server.on_failed_report = \
             lambda r, reason: self._reap_reported(r, reason)
+        # uptime clock (errmgr crash-loop governor): starts at each
+        # rank's PMIx registration so boot doesn't count toward
+        # errmgr_min_uptime_s
+        self.server.on_client_contact = self._mark_contact
         app = job.apps[0]
         env = dict(app.env)
         # the xcast env overlays the daemons' os.environ (orted merge
@@ -396,9 +400,10 @@ class MultiHostLauncher:
         the daemon owning the rank relaunches it with OMPI_TPU_RESTART.
         Spawn failure on the daemon surfaces as another TAG_PROC_EXIT
         (exit 127), which re-enters the errmgr until restarts exhaust."""
-        proc.restarts += 1
+        proc.restarts += 1   # budget burn (governor may reset it)
+        proc.lives += 1      # identity: monotone, survives budget resets
         try:
-            self.rml.xcast(rml.TAG_RESPAWN, (proc.rank, proc.restarts))
+            self.rml.xcast(rml.TAG_RESPAWN, (proc.rank, proc.lives))
         except Exception as e:  # noqa: BLE001 — tree may be tearing down
             _log.error("respawn xcast for rank %d failed: %r", proc.rank, e)
             return False
@@ -407,8 +412,9 @@ class MultiHostLauncher:
         # would otherwise wait forever on a rank nobody revived)
         proc.exit_code = None
         proc.state = ProcState.RUNNING
+        proc.launched_at = None  # stamped again at PMIx registration
         if self.server is not None:
-            self.server.proc_revived(proc.rank)
+            self.server.proc_revived(proc.rank, proc.lives)
         return True
 
     def _on_proc_exit(self, job: Job, payload) -> None:
@@ -419,6 +425,10 @@ class MultiHostLauncher:
             pass
         elif rc == 0:
             proc.state = ProcState.TERMINATED
+            # a clean finisher's stopped beats are completion, not a
+            # hang — gate late gossip reports about it
+            if self.server is not None:
+                self.server.proc_finished(rank)
         else:
             proc.state = (ProcState.FAILED_TO_START if errmsg
                           else ProcState.ABORTED)
@@ -434,11 +444,11 @@ class MultiHostLauncher:
     def _on_daemon_lost(self, vpid: int) -> None:
         """A daemon vanished: RML link EOF (crash/SIGKILL/host death),
         heartbeat silence (hung host, half-open link), or an orphan's
-        report.  Under the ``notify`` errmgr policy the daemon's ranks
-        become proc-failure events propagated to the survivors, its
-        orphaned tree children re-wire to the nearest live ancestor, and
-        the job continues; every other policy treats a lost daemon as a
-        lost lifeline and aborts."""
+        report.  Under a daemon-loss-tolerant errmgr policy (notify,
+        selfheal) the daemon's ranks become proc-failure events
+        propagated to the survivors, its orphaned tree children re-wire
+        to the nearest live ancestor, and the job continues; every other
+        policy treats a lost daemon as a lost lifeline and aborts."""
         with self._cv:
             if vpid in self._dead_daemons:
                 return  # several detectors race to the same corpse
@@ -448,7 +458,8 @@ class MultiHostLauncher:
                     and len(self._exited) >= self._np_hint):
                 return  # normal teardown, not a failure
             job = self._cur_job
-            reparent = (getattr(self._errmgr, "NAME", "") == "notify"
+            reparent = (getattr(self._errmgr, "TOLERATES_DAEMON_LOSS",
+                                False)
                         and job is not None
                         and 0 < vpid <= len(job.nodes))
             if reparent:
@@ -534,6 +545,13 @@ class MultiHostLauncher:
         vpid, new_parent = payload
         _log.verbose(1, "orted %d re-wired under %d", vpid, new_parent)
 
+    def _mark_contact(self, rank: int) -> None:
+        """PMIx server hook: the rank's current life registered — start
+        its uptime clock (errmgr_min_uptime_s measures from here)."""
+        job = self._cur_job
+        if job is not None and 0 <= rank < len(job.procs):
+            job.procs[rank].launched_at = time.monotonic()
+
     def _reap_reported(self, rank: int, reason: str) -> None:
         """Order the owning daemon to SIGKILL one reported-hung rank."""
         _log.verbose(1, "reaping reported-dead rank %d via the tree: %s",
@@ -545,24 +563,30 @@ class MultiHostLauncher:
 
     def _fail_daemon_ranks(self, job: Job, vpid: int) -> None:
         """With self._cv held: a dead daemon's ranks can never report —
-        declare each of them failed NOW (the errmgr notify policy then
-        propagates each death to the survivors) and record synthetic
-        exits so _wait_ranks completes on the survivors alone."""
+        declare each of them failed NOW (the errmgr policy propagates
+        each death to the survivors) and record synthetic exits so
+        _wait_ranks completes on the survivors alone."""
         node = job.nodes[vpid - 1]
         victims = [p for p in job.procs_on(node)
                    if p.rank not in self._exited]
         for proc in victims:
             proc.state = ProcState.ABORTED
             proc.exit_code = -9
+            # no revival order can reach a rank whose daemon died with
+            # its host — a reviving policy (selfheal) must degrade to
+            # its shrink rung instead of marking the rank RUNNING and
+            # waiting forever on an exit that cannot come
+            proc.daemon_lost = True
             if self.server is not None:
                 self.server.proc_died(
                     proc.rank,
                     reason=f"daemon vpid {vpid} (host {node.name}) died")
             self._exited[proc.rank] = -9
         self._cv.notify_all()
-        # notify's proc_failed is non-blocking (an xcast + a log line)
-        # and takes no plm locks, so running it with self._cv held is
-        # safe — and the synthetic exits above are already visible
+        # notify's and selfheal's daemon-lost arms are non-blocking (an
+        # xcast + a log line, no revive attempt) and take no plm locks,
+        # so running them with self._cv held is safe — and the synthetic
+        # exits above are already visible
         for proc in victims:
             self._errmgr.proc_failed(self, job, proc)
 
@@ -575,7 +599,7 @@ class MultiHostLauncher:
         here, so Popen polling is the only detector the HNP always has.
         In DVM mode the monitor runs for the VM's lifetime."""
         handled: set[int] = set()
-        notify = getattr(self._errmgr, "NAME", "") == "notify"
+        notify = getattr(self._errmgr, "TOLERATES_DAEMON_LOSS", False)
         while True:
             if self._vm_stop.is_set():
                 return

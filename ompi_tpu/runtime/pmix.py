@@ -19,13 +19,32 @@ import os
 import socket
 import struct
 import threading
+import time
 from typing import Any, Callable, Optional
 
 from ompi_tpu.core import dss, output
+from ompi_tpu.core.config import VarType, register_var, var_registry
 
 __all__ = ["PMIxServer", "PMIxClient", "PMIxError"]
 
 _log = output.get_stream("pmix")
+
+register_var("pmix", "register_grace_s", VarType.DOUBLE, 20.0,
+             "wedge escape for the stale-failure-report gate: a "
+             "revived life normally announces its incarnation, and "
+             "reports stamped with an older one are dropped.  A life "
+             "still silent this long after its revive — never "
+             "registered (SIGSTOP, OOM stall, import deadlock during "
+             "boot), or registered but hung before any survivor "
+             "adopted its incarnation — is presumed wedged: reports "
+             "about it are accepted regardless of incarnation so it "
+             "can be re-reaped instead of stalling the job forever.  "
+             "The escape closes permanently for a life once any "
+             "survivor reports having adopted its incarnation (an "
+             "adopted life provably announced — a later stale report "
+             "is a partitioned reporter or a cached dead-life probe, "
+             "not a wedge).  0 disables the escape (stale reports are "
+             "always dropped)")
 
 ENV_URI = "OMPI_TPU_HNP_URI"
 ENV_RANK = "OMPI_TPU_RANK"
@@ -73,6 +92,11 @@ class PMIxServer:
         # per newly-reported rank with (rank, reason) so the launcher can
         # reap the pid — the exit report then drives the errmgr normally
         self.on_failed_report: Optional[Callable[[int, str], None]] = None
+        # optional launcher hook fired once per life when the rank's
+        # client registers ("reg", sent at PMIxClient construction): the
+        # errmgr crash-loop governor starts the uptime clock here so
+        # interpreter+jax boot never counts toward errmgr_min_uptime_s
+        self.on_client_contact: Optional[Callable[[int], None]] = None
         self._store: dict[str, Any] = {}
         self._cv = threading.Condition()
         self._fence_counts: dict[int, int] = {}
@@ -80,6 +104,17 @@ class PMIxServer:
         self._client_epoch: dict[int, int] = {}
         self._dead: set[int] = set()
         self._failed_reasons: dict[int, str] = {}
+        self._life: dict[int, int] = {}   # rank → current incarnation
+        self._finished: set[int] = set()  # ranks that exited cleanly
+        self._registered: set[int] = set()  # ranks whose CURRENT life reg'd
+        self._revived_at: dict[int, float] = {}  # rank → last revive time
+        self._adopted_life: dict[int, int] = {}  # rank → highest life any
+        # SURVIVOR adopted (the "adopted" RPC, pushed once per life per
+        # survivor on its peer_reincarnated transition): an adopted life
+        # provably announced — it cannot be boot-wedged, so the stale-
+        # report escape below closes for it and a late stale report
+        # (partitioned reporter, cached dead-life pid probe) can no
+        # longer SIGKILL a long-healthy revived rank
         self._aborted: Optional[tuple[int, int, str]] = None
         self._listener = socket.create_server((host, 0))
         self._port = self._listener.getsockname()[1]
@@ -171,15 +206,89 @@ class PMIxServer:
             if self.on_abort is not None:
                 self.on_abort(rank, status, msg)
             return ("ok",)
+        if cmd == "reg":
+            # client registration (sent once at PMIxClient construction):
+            # marks the rank's CURRENT life as having finished booting.
+            # Gates the stale-report escape below and starts the errmgr
+            # governor's uptime clock via on_client_contact.
+            rank = int(args[0])
+            with self._cv:
+                first = rank not in self._registered
+                self._registered.add(rank)
+            if first and self.on_client_contact is not None:
+                try:
+                    self.on_client_contact(rank)
+                except Exception as e:  # noqa: BLE001 — server survives
+                    _log.error("on_client_contact(%d) failed: %r", rank, e)
+            return ("ok",)
+        if cmd == "adopted":
+            # a survivor adopted a peer's new incarnation (its rebind /
+            # first si-stamped frame arrived): the life announced, so it
+            # is not boot-wedged — close the stale-report escape for it
+            rank, inc = int(args[0]), int(args[1])
+            with self._cv:
+                if inc > self._adopted_life.get(rank, 0):
+                    self._adopted_life[rank] = inc
+            return ("ok",)
         if cmd == "report_failed":
             # the reverse direction of "failed": an app rank PUSHES a
             # death its rank-plane gossip detector observed (hung pid —
             # alive to the daemon heartbeats, silent to its peers).  The
             # dead-set gains it (so every other detector's poll sees it)
             # and the launcher hook may reap the pid.
-            reporter, failed_rank, reason = args
+            reporter, failed_rank, reason = args[:3]
+            # optional 4th arg: the incarnation the reporter observed
+            # dead.  Under a reviving errmgr (respawn/selfheal) several
+            # reporters race to declare the same corpse — the first
+            # report reaps and revives it, and a second report about the
+            # DEAD life must not SIGKILL the new one (or re-poison the
+            # dead-set the revive just cleared).
+            inc = int(args[3]) if len(args) > 3 else 0
             failed_rank = int(failed_rank)
             with self._cv:
+                if inc < self._life.get(failed_rank, 0):
+                    # stale — UNLESS the current life is wedged: revived
+                    # a while ago yet either never registered (hung
+                    # during interpreter boot) or registered but hung
+                    # before its announce/beats reached any survivor.
+                    # Either way no reporter can ever have adopted its
+                    # incarnation, so the gate would drop every report
+                    # forever, leaving a hung pid unreapable.  grace 0
+                    # disables the escape: an always-open escape would
+                    # let a racing stale report SIGKILL a legitimately
+                    # booting revived rank.
+                    revived_at = self._revived_at.get(failed_rank)
+                    grace = float(
+                        var_registry.get("pmix_register_grace_s") or 0)
+                    adopted = (self._adopted_life.get(failed_rank, 0)
+                               >= self._life.get(failed_rank, 0))
+                    wedged = (grace > 0
+                              and not adopted
+                              and revived_at is not None
+                              and time.monotonic() - revived_at >= grace)
+                    if not wedged:
+                        _log.verbose(1, "stale failure report for rank %d "
+                                     "(life %d < %d); ignored", failed_rank,
+                                     inc, self._life[failed_rank])
+                        return ("ok", "stale")
+                    _log.verbose(1, "accepting stale-incarnation report "
+                                 "for rank %d: life %d %s within %.1fs "
+                                 "(wedged)", failed_rank,
+                                 self._life[failed_rank],
+                                 ("never registered"
+                                  if failed_rank not in self._registered
+                                  else "registered but never adopted by "
+                                  "any survivor"),
+                                 grace)
+                if failed_rank in self._finished:
+                    # the rank exited CLEANLY: its gossip beats stopped
+                    # with its transports, which peers can misread as a
+                    # hang — poisoning the dead-set (or reaping a pid
+                    # slot) for a rank that finished its work would turn
+                    # a healthy completion into a failure event
+                    _log.verbose(1, "failure report for finished rank "
+                                 "%d; ignored", failed_rank)
+                    return ("ok", "finished")
                 fresh = failed_rank not in self._dead
                 if fresh:
                     self._dead.add(failed_rank)
@@ -217,6 +326,13 @@ class PMIxServer:
             self._fence_done.add(epoch)
             self._cv.notify_all()
 
+    def proc_finished(self, rank: int) -> None:
+        """Launcher notification: the rank exited CLEANLY (rc 0).  Its
+        beats/transports are gone, so late gossip suspicions about it
+        are completion, not failure — ``report_failed`` ignores them."""
+        with self._cv:
+            self._finished.add(rank)
+
     def proc_died(self, rank: int, reason: str = "") -> None:
         """Launcher notification: rank exited abnormally. Re-evaluates every
         pending fence so survivors don't block on a dead peer forever."""
@@ -229,15 +345,26 @@ class PMIxServer:
                     self._check_fence_done(epoch)
             self._cv.notify_all()
 
-    def proc_revived(self, rank: int) -> None:
-        """errmgr/respawn notification: the rank is back.  Future fences
-        count it again; its fence-epoch counter restarts (already-completed
-        epochs return immediately, so a restarted rank fast-forwards
-        through barriers the survivors already passed)."""
+    def proc_revived(self, rank: int,
+                     incarnation: Optional[int] = None) -> None:
+        """errmgr respawn/selfheal notification: the rank is back.
+        Future fences count it again; its fence-epoch counter restarts
+        (already-completed epochs return immediately, so a restarted rank
+        fast-forwards through barriers the survivors already passed).
+        ``incarnation`` (the new life number, = the monotone
+        ``proc.lives`` — NOT the governor-resettable restart budget)
+        fences stale ``report_failed`` pushes about the dead life."""
         with self._cv:
             self._dead.discard(rank)
             self._failed_reasons.pop(rank, None)
+            self._finished.discard(rank)
             self._client_epoch[rank] = 0
+            self._life[rank] = (self._life.get(rank, 0) + 1
+                                if incarnation is None else int(incarnation))
+            # the new life hasn't booted yet: it must "reg" again, and
+            # the boot-wedge escape measures from this revive
+            self._registered.discard(rank)
+            self._revived_at[rank] = time.monotonic()
             self._cv.notify_all()
 
     # -- host-side access (launcher uses these directly) ------------------
@@ -275,6 +402,10 @@ class PMIxClient:
         self._sock = socket.create_connection((host, int(port)))
         self._lock = threading.Lock()
         self._local: dict[str, Any] = {}
+        # register this life: boot is over (interpreter + framework
+        # imports are behind us) — the server starts the crash-loop
+        # governor's uptime clock and lifts the boot-wedge presumption
+        self._rpc("reg", self.rank)
 
     def _rpc(self, *msg: Any) -> tuple:
         with self._lock:
@@ -317,12 +448,31 @@ class PMIxClient:
         reasons = reply[2] if len(reply) > 2 else {}
         return {int(r): str(reasons.get(r, "")) for r in reply[1]}
 
-    def report_failed(self, failed_rank: int, reason: str = "") -> None:
+    def report_failed(self, failed_rank: int, reason: str = "",
+                      incarnation: int = 0) -> Optional[str]:
         """Push a locally-observed death (gossip suspect, arena pid
         probe) into the runtime dead-set so the control plane — and
         every other rank's detector poll — learns it, and the launcher
-        can reap a hung-but-alive pid."""
-        self._rpc("report_failed", self.rank, int(failed_rank), reason)
+        can reap a hung-but-alive pid.  ``incarnation`` is the life of
+        the rank the reporter observed dead (its adopted incarnation
+        number) — the server drops reports about already-reaped lives so
+        racing reporters cannot kill a freshly-revived rank.  Returns
+        the server's gate verdict: ``"stale"`` / ``"finished"`` when the
+        report was dropped, None when it was taken (the caller retries
+        stale-gated pushes — a life that wedges after the drop would
+        otherwise never be re-reported)."""
+        reply = self._rpc("report_failed", self.rank, int(failed_rank),
+                          reason, int(incarnation))
+        return reply[1] if len(reply) > 1 else None
+
+    def peer_adopted(self, rank: int, incarnation: int) -> None:
+        """Tell the control plane this process adopted ``rank``'s new
+        life ``incarnation`` (its rebind / first si-stamped frame
+        arrived): the life provably announced, so the server's
+        boot-wedge escape closes for it and a late stale-incarnation
+        report can no longer reap the healthy rank.  Pushed once per
+        adopted life per survivor (see ``PmlFT.peer_reincarnated``)."""
+        self._rpc("adopted", int(rank), int(incarnation))
 
     def abort(self, msg: str = "", status: int = 1) -> None:
         self._rpc("abort", self.rank, int(status), msg)

@@ -14,6 +14,10 @@ Plan grammar (entries separated by ``;``)::
     rank=2:hang@step=3        rank 2 STALLS (alive pid, silent rank) at
                               step 3 — SIGSTOP by default, a cooperative
                               spin with faultinject_hang_mode=spin
+    rank=2:crash@step=3       like kill, but fires in EVERY life — the
+                              crash loop that proves the errmgr revive
+                              budget/escalation ladder (kill and hang
+                              are first-life-only by design)
     daemon=1:kill@t=1.0       orted vpid 1 SIGKILLs itself after 1 s
     drop=0.01                 drop outgoing FT-control frames with p=0.01
     drop=0.05@all             drop ANY outgoing frame with p=0.05
@@ -132,12 +136,13 @@ def _parse_entry(entry: str) -> _Action:
             act.rank = int(val)
         elif key == "daemon":
             act.vpid = int(val)
-        elif key in ("kill", "hang") or key.startswith(("kill@", "hang@")):
-            base = "kill" if key.startswith("kill") else "hang"
+        elif (key in ("kill", "hang", "crash")
+              or key.startswith(("kill@", "hang@", "crash@"))):
+            base = key.partition("@")[0]
             act.kind = ("daemon_kill" if act.vpid is not None
                         and base == "kill" else base)
             # kill@step=N / kill@t=SEC arrive as key "kill@step"/"kill@t"
-            # (same for hang@)
+            # (same for hang@ / crash@)
             trig = key.partition("@")[2]
             if trig == "step":
                 act.at_step = int(val)
@@ -170,9 +175,9 @@ def _parse_entry(entry: str) -> _Action:
     # per-field checks can be sidestepped): hangs target ranks only —
     # a hung DAEMON is the heartbeat layer's job, and a daemon= field
     # anywhere in a hang entry is a contradiction, not a default
-    if act.kind == "hang" and act.vpid is not None:
+    if act.kind in ("hang", "crash") and act.vpid is not None:
         raise ValueError(
-            f"hang targets ranks, not daemons (entry {entry!r})")
+            f"{act.kind} targets ranks, not daemons (entry {entry!r})")
     # a kill that saw daemon= before the kill key is a daemon_kill; one
     # that saw it after must settle to the same action
     if act.kind == "kill" and act.vpid is not None:
@@ -214,19 +219,24 @@ class Injector:
         # kills AND hangs fire in a rank's FIRST life only: an
         # errmgr-respawned incarnation re-arms the injector and would
         # otherwise die again at the same step, looping until restarts
-        # exhaust
-        self._kills = ([] if os.environ.get("OMPI_TPU_RESTART")
-                       else [a for a in self._acts
-                             if a.kind in ("kill", "hang")])
+        # exhaust.  ``crash`` is that loop ON PURPOSE — it fires in
+        # every life, proving the revive budget / escalation ladder.
+        restarted = bool(os.environ.get("OMPI_TPU_RESTART"))
+        self._kills = [a for a in self._acts
+                       if a.kind == "crash"
+                       or (a.kind in ("kill", "hang") and not restarted)]
         self._step = 0
         self._lock = threading.Lock()
         self.events: list[dict] = []
         self._dead = False
         for k in self._kills:
             if k.at_time is not None:
-                fire = (self._fire_kill if k.kind == "kill"
-                        else self._fire_hang)
-                t = threading.Timer(k.at_time, fire, args=("t", k.at_time))
+                if k.kind == "hang":
+                    t = threading.Timer(k.at_time, self._fire_hang,
+                                        args=("t", k.at_time))
+                else:
+                    t = threading.Timer(k.at_time, self._fire_kill,
+                                        args=("t", k.at_time, k.kind))
                 t.daemon = True
                 t.start()
 
@@ -243,14 +253,14 @@ class Injector:
                 if k.kind == "hang":
                     self._fire_hang("step", s)
                 else:
-                    self._fire_kill("step", s)
+                    self._fire_kill("step", s, kind=k.kind)
         return s
 
-    def _fire_kill(self, trigger: str, value) -> None:
+    def _fire_kill(self, trigger: str, value, kind: str = "kill") -> None:
         if self._dead:
             return
         self._dead = True
-        self._record("kill", trigger=trigger, value=value)
+        self._record(kind, trigger=trigger, value=value)
         _log.emit("faultinject: rank %d injected kill (%s=%s)",
                   self.rank, trigger, value)
         _dump_events_now()

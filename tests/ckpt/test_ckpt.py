@@ -267,6 +267,29 @@ def test_manager_async_save_and_restore(tmp_path):
         np.testing.assert_array_equal(w, np.arange(6.0) + r)
 
 
+def test_manager_auto_restore_rank_override(tmp_path, monkeypatch):
+    """The manager wrapper forwards ``auto_restore``'s per-rank-store
+    rank override: apps keying one store PER rank write their shard
+    under rank key 0 (the selfheal/chaos recipe), so the wrapper must
+    not hard-code ``comm.rank`` for the lookup."""
+    from ompi_tpu.ckpt import snapc
+
+    base = str(tmp_path)
+    monkeypatch.setattr(snapc, "restart_incarnation", lambda: 1)
+
+    def body(comm):
+        st = ckpt.SnapshotStore(os.path.join(base, f"rank{comm.rank}"))
+        mgr = ckpt.CheckpointManager(comm, st, interval=1)
+        st.write_rank(5, 0, {"acc": np.float64(comm.rank + 41.0)})
+        st.commit(5, nranks=1)
+        seq, state = mgr.auto_restore(rank=0)
+        return seq, float(state["acc"])
+
+    for r, (seq, acc) in enumerate(run_ranks(2, body)):
+        assert seq == 5
+        assert acc == r + 41.0
+
+
 def test_checkpoint_jax_device_arrays(tmp_path):
     """Device arrays are pulled to host on save and re-placed on restore."""
     import jax

@@ -210,6 +210,44 @@ def test_notify_daemon_death_fails_its_ranks_job_continues(tmp_path):
     assert "survived" in out or "has failed" in out, out[-3000:]
 
 
+# -- host-plane policy applies to every REVIVING policy ---------------------
+
+def test_host_plane_policy_applies_to_all_reviving_policies():
+    from ompi_tpu.runtime.errmgr import (
+        ErrmgrSelfheal, apply_host_plane_policy,
+    )
+
+    key = var_registry.ENV_PREFIX + "multihost_auto_init"
+    for policy in (ErrmgrRespawn(), ErrmgrSelfheal()):
+        env = {}
+        apply_host_plane_policy(policy, env)
+        assert env.get(key) == "0", policy.NAME
+
+
+def test_host_plane_policy_keeps_user_override():
+    from ompi_tpu.runtime.errmgr import (
+        ErrmgrSelfheal, apply_host_plane_policy,
+    )
+
+    key = var_registry.ENV_PREFIX + "multihost_auto_init"
+    env = {key: "1"}
+    apply_host_plane_policy(ErrmgrSelfheal(), env)
+    assert env[key] == "1"                   # explicit setting wins
+    env = {}
+    apply_host_plane_policy(ErrmgrSelfheal(), env, {key: "1"})
+    assert key not in env                    # set in a base env: respected
+
+
+def test_host_plane_policy_ignores_non_reviving_policies():
+    from ompi_tpu.runtime.errmgr import apply_host_plane_policy
+
+    key = var_registry.ENV_PREFIX + "multihost_auto_init"
+    for policy in (ErrmgrNotify(), ErrmgrContinue()):
+        env = {}
+        apply_host_plane_policy(policy, env)
+        assert key not in env, policy.NAME
+
+
 # -- heartbeat layer -------------------------------------------------------
 
 def test_heartbeat_monitor_declares_silent_vpid(monkeypatch):

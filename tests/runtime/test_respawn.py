@@ -1,12 +1,22 @@
 """errmgr/respawn: kill a rank mid-run, revive it, recover from its ckpt
 snapshot, and keep talking to it (endpoint rebind) — ≈ the reference's
 errmgr restart paths + rmaps/resilient
-(orte/mca/errmgr/default_hnp/errmgr_default_hnp.c:351-470).
+(orte/mca/errmgr/default_hnp/errmgr_default_hnp.c:351-470) — plus the
+degrade-to-abort arms (launcher without the hook, failed start, budget
+exhaustion) and the crash-loop governor's min-uptime/backoff gating.
 """
 
 import os
 import subprocess
 import sys
+import time
+
+import pytest
+
+from ompi_tpu.core.config import var_registry
+from ompi_tpu.runtime import errmgr as errmgr_mod
+from ompi_tpu.runtime.errmgr import ErrmgrRespawn
+from ompi_tpu.runtime.job import AppContext, Job, Proc, ProcState
 
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -168,6 +178,189 @@ for step in range(start, 8):
 print(f"rank {rank} chaos done acc={acc:.0f}", flush=True)
 ompi_tpu.finalize()
 """
+
+
+# -- degrade-to-abort arms (unit: no subprocess) ----------------------------
+
+class _RespawningLauncher:
+    def __init__(self, ok=True):
+        self.killed = False
+        self.respawned = []
+        self.server = None
+        self.rml = None
+        self._ok = ok
+
+    def kill_job(self, job, exclude=None):
+        self.killed = True
+
+    def respawn_proc(self, job, proc):
+        self.respawned.append(proc.rank)
+        if self._ok:
+            proc.restarts += 1   # budget burn (mirrors the real launchers)
+            proc.lives += 1      # identity: monotone across budget resets
+            proc.launched_at = time.monotonic()
+        return self._ok
+
+
+class _HookLessLauncher:
+    """A launcher without respawn_proc (custom integrations)."""
+
+    def __init__(self):
+        self.killed = False
+        self.server = None
+        self.rml = None
+
+    def kill_job(self, job, exclude=None):
+        self.killed = True
+
+
+def _unit_job(np_=3, fail_rank=1):
+    job = Job([AppContext(argv=["true"], np=np_)])
+    job.procs = [Proc(rank=r, state=ProcState.RUNNING) for r in range(np_)]
+    proc = job.procs[fail_rank]
+    proc.state = ProcState.ABORTED
+    proc.exit_code = 9
+    return job, proc
+
+
+def test_respawn_launcher_without_hook_degrades_to_abort():
+    launcher, (job, proc) = _HookLessLauncher(), _unit_job()
+    ErrmgrRespawn().proc_failed(launcher, job, proc)
+    assert launcher.killed
+    assert job.aborted_proc is proc
+    assert "rank 1" in job.abort_reason
+
+
+def test_respawn_start_failure_degrades_to_abort():
+    launcher = _RespawningLauncher(ok=False)
+    job, proc = _unit_job()
+    ErrmgrRespawn().proc_failed(launcher, job, proc)
+    assert launcher.respawned == [1]   # it tried before giving up
+    assert launcher.killed
+    assert job.aborted_proc is proc
+
+
+def test_respawn_budget_exhaustion_degrades_to_abort():
+    launcher = _RespawningLauncher()
+    job, proc = _unit_job()
+    proc.restarts = var_registry.get("errmgr_max_restarts")
+    proc.launched_at = time.monotonic()   # instant re-death: no reset
+    ErrmgrRespawn().proc_failed(launcher, job, proc)
+    assert launcher.respawned == []
+    assert launcher.killed
+    assert job.aborted_proc is proc
+    assert "restart" in job.abort_reason
+
+
+def test_respawn_crash_loop_backoff_and_budget_reset(monkeypatch):
+    """An instant re-death sleeps the (doubling) backoff before its
+    revive; a life that outlived errmgr_min_uptime_s resets the budget
+    so a long-running rank's occasional deaths never exhaust it."""
+    sleeps = []
+    monkeypatch.setattr(errmgr_mod, "_sleep", sleeps.append)
+    launcher = _RespawningLauncher()
+    job, proc = _unit_job()
+    policy = ErrmgrRespawn()
+    # crash-loop death (uptime ~0): burns a slot, sleeps the base backoff
+    proc.restarts = 1
+    proc.launched_at = time.monotonic() - 0.01
+    policy.proc_failed(launcher, job, proc)
+    assert launcher.respawned == [1]
+    assert sleeps == [errmgr_mod._BACKOFF_BASE]
+    assert not launcher.killed
+    # earned-uptime death: budget resets, no backoff, revive proceeds
+    # even though restarts sat AT the limit before the reset
+    launcher2 = _RespawningLauncher()
+    job2, proc2 = _unit_job()
+    proc2.restarts = var_registry.get("errmgr_max_restarts")
+    proc2.launched_at = (time.monotonic()
+                         - var_registry.get("errmgr_min_uptime_s") - 1.0)
+    sleeps.clear()
+    policy.proc_failed(launcher2, job2, proc2)
+    assert launcher2.respawned == [1]
+    assert sleeps == []
+    assert not launcher2.killed
+
+
+def test_backoff_clamped_below_daemon_heartbeat_timeout(monkeypatch):
+    """The backoff sleep runs inside proc_failed — on a daemon tree that
+    is the RML link reader thread, and a stall at or above
+    rml_heartbeat_timeout would starve queued TAG_HEARTBEAT delivery
+    until the HNP declared the healthy daemon hosting the crash-looper
+    lost.  With heartbeats armed the slept delay caps well below the
+    declare timeout (the stored doubling progression still paces the
+    budget burn)."""
+    import ompi_tpu.runtime.rml  # noqa: F401 — registers the hb vars
+
+    sleeps = []
+    monkeypatch.setattr(errmgr_mod, "_sleep", sleeps.append)
+    var_registry.set("rml_heartbeat_period", 0.5)
+    var_registry.set("rml_heartbeat_timeout", 1.0)
+    try:
+        launcher = _RespawningLauncher()
+        job, proc = _unit_job()
+        policy = ErrmgrRespawn()
+        for _ in range(3):   # stored backoff walks 0.5 → 1.0 → 2.0...
+            proc.state = ProcState.ABORTED
+            proc.restarts = 1
+            proc.launched_at = time.monotonic() - 0.01
+            policy.proc_failed(launcher, job, proc)
+        assert launcher.respawned == [1, 1, 1]
+        # ...but every slept delay stays at 0.4 x the declare timeout
+        assert sleeps == pytest.approx([0.4, 0.4, 0.4])
+    finally:
+        var_registry.set("rml_heartbeat_period", 0.0)
+        var_registry.set("rml_heartbeat_timeout", 3.0)
+
+
+def test_respawn_pre_registration_death_burns_budget(monkeypatch):
+    """A life that crashed during boot (never registered with the PMIx
+    server, so launched_at is None) burns a budget slot with backoff —
+    boot time must not earn the crash-loop budget back."""
+    sleeps = []
+    monkeypatch.setattr(errmgr_mod, "_sleep", sleeps.append)
+    launcher = _RespawningLauncher()
+    job, proc = _unit_job()
+    proc.restarts, proc.lives = 1, 1
+    proc.launched_at = None
+    ErrmgrRespawn().proc_failed(launcher, job, proc)
+    assert launcher.respawned == [1]
+    assert proc.restarts == 2          # burned, not reset
+    assert sleeps == [errmgr_mod._BACKOFF_BASE]
+    assert not launcher.killed
+
+
+def test_respawn_budget_reset_keeps_lives_monotone(monkeypatch):
+    """The earned-uptime budget reset must not regress the incarnation
+    the next life announces (survivors fence anything lower)."""
+    monkeypatch.setattr(errmgr_mod, "_sleep", lambda s: None)
+    launcher = _RespawningLauncher()
+    job, proc = _unit_job()
+    proc.restarts, proc.lives = 2, 2
+    proc.launched_at = (time.monotonic()
+                        - var_registry.get("errmgr_min_uptime_s") - 1.0)
+    ErrmgrRespawn().proc_failed(launcher, job, proc)
+    assert launcher.respawned == [1]
+    assert proc.restarts == 1 and proc.lives == 3
+    assert not launcher.killed
+
+
+def test_proc_env_carries_monotone_life_number():
+    """OMPI_TPU_RESTART (the incarnation everything keys on: snapshot
+    restore, PML si stamps, first-life-only fault plans) comes from the
+    monotone proc.lives, not the governor-resettable restart budget."""
+    from ompi_tpu.runtime.launcher import LocalLauncher
+
+    launcher = LocalLauncher()
+
+    class _Uri:
+        uri = "tcp://127.0.0.1:1"
+
+    launcher.server = _Uri()
+    job, proc = _unit_job()
+    proc.lives, proc.restarts = 3, 0   # budget reset; identity keeps 3
+    env = launcher._proc_env(job, proc)
+    assert env["OMPI_TPU_RESTART"] == "3"
 
 
 def test_chaos_multiple_sequential_failures(tmp_path):

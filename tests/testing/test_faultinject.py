@@ -155,3 +155,38 @@ def test_hang_first_life_only(monkeypatch):
     inj = fi.Injector(0, acts, seed=0)
     inj.step()                                 # would fire in life 0
     assert not [e for e in inj.events if e["kind"] == "hang"]
+
+
+def test_crash_grammar_parses_step_and_time_triggers():
+    acts = fi.parse_plan("rank=2:crash@step=3;rank=1:crash@t=0.5")
+    assert [(a.kind, a.rank, a.at_step, a.at_time) for a in acts] == \
+        [("crash", 2, 3, None), ("crash", 1, None, 0.5)]
+
+
+def test_crash_rejects_daemons_and_missing_trigger():
+    import pytest
+
+    with pytest.raises(ValueError):
+        fi.parse_plan("daemon=1:crash@t=1.0")  # daemon revival doesn't exist
+    with pytest.raises(ValueError):
+        fi.parse_plan("rank=1:crash")          # no trigger
+
+
+def test_crash_fires_in_every_life(monkeypatch):
+    """Unlike kill/hang (first-life-only by design), crash re-arms in a
+    respawned incarnation — the crash loop that proves the errmgr revive
+    budget and the selfheal escalation ladder."""
+    died = []
+    monkeypatch.setattr(
+        fi.Injector, "_fire_kill",
+        lambda self, trigger, value, kind="kill":
+            (died.append((self.rank, kind, trigger, value)),
+             self._record(kind, trigger=trigger, value=value))[0])
+    monkeypatch.setenv("OMPI_TPU_RESTART", "2")   # third life
+    acts = fi.parse_plan("rank=0:crash@step=1;rank=0:kill@step=1")
+    inj = fi.Injector(0, acts, seed=0)
+    assert [a.kind for a in inj._kills] == ["crash"]  # kill stays gated
+    inj.step(); inj.step()
+    assert died == [(0, "crash", "step", 1)]
+    evs = [e["kind"] for e in inj.events]
+    assert evs == ["crash"]                     # distinct kind in the log

@@ -28,6 +28,19 @@ targets, and asserts the job lands in that policy's *defined* state:
   pid probe surfaces MPI_ERR_PROC_FAILED in ~the probe grace (the
   driver asserts the printed time-to-error stays far below the 60 s
   ``coll_shm_timeout``), then the normal shrink recipe finishes.
+- ``selfheal-hang`` — a rank SIGSTOPs mid-ring under ``--mca errmgr
+  selfheal``: gossip declares it, the control plane reaps the hung pid,
+  the errmgr revives it in place, it restores from its last snapshot
+  (``snapc.auto_restore``) and the msglog replays the in-flight gap —
+  every rank (victim included) finishes with the full-ring acc, and the
+  survivors' printed failure→success gap (``heal_dt``) bounds the
+  detect→rejoin cycle under 15 s.
+- ``selfheal-crashloop`` — a rank dies at the same step in EVERY life
+  (the ``crash`` fault kind): the revive budget burns with backoff
+  (min-uptime gating forced on via ``errmgr_min_uptime_s``), the policy
+  escalates revive → shrink, survivors finish and the job exits 0
+  smaller — with exactly ``errmgr_max_restarts`` revive events and one
+  escalation event in the notifier stream.
 
 No run may hang (every subprocess has a hard timeout — a timeout is a
 soak failure), and no run may print a wrong answer (expected values are
@@ -60,7 +73,8 @@ sys.path.insert(0, REPO)
 from ompi_tpu.testing import faultinject  # noqa: E402
 
 POLICIES = ("respawn", "notify-shrink", "continue", "abort",
-            "midtree-kill", "rank-hang", "writer-death")
+            "midtree-kill", "rank-hang", "writer-death",
+            "selfheal-hang", "selfheal-crashloop")
 
 RING_APP = r"""
 import os
@@ -132,6 +146,94 @@ print(f"rank {comm.rank} survived", flush=True)
 ompi_tpu.finalize()
 """
 
+# the selfheal ring: same traffic as RING_APP, but under errmgr selfheal
+# a peer's death is TRANSIENT (the errmgr is already reviving it) — ops
+# that fail with PROC_FAILED retry until the revive lands, and the first
+# failure→success gap per rank is printed so the driver can bound the
+# whole detect→reap→revive→rejoin cycle
+SELFHEAL_APP = r"""
+import os, time
+import numpy as np
+import ompi_tpu
+from ompi_tpu.ckpt import snapc
+from ompi_tpu.ckpt.msglog import MessageLog
+from ompi_tpu.ckpt.store import SnapshotStore
+from ompi_tpu.mpi.constants import ERR_PROC_FAILED, MPIException
+from ompi_tpu.testing import faultinject
+
+comm = ompi_tpu.init()
+rank, size = comm.rank, comm.size
+store = SnapshotStore(os.environ["CKPT_DIR"], job=f"rank{rank}")
+log = MessageLog(comm).attach(auto_replay=True)
+
+start, acc = 0, 0.0
+restored = snapc.auto_restore(comm, store, rank=0)
+if restored is not None:
+    seq, state = restored
+    start, acc = int(state["step"]) + 1, float(state["acc"])
+    print(f"rank {rank} resumed at step {start} from snapshot {seq}",
+          flush=True)
+
+_t0 = [None]
+def heal_retry(fn):
+    # retry granularity is ONE operation: a send that died with the old
+    # incarnation is re-sent (a duplicate of a delivered one parks
+    # harmlessly — per-step tags never re-match), while a recv retries
+    # only if it never returned — wrapping a whole send+recv step would
+    # re-post a recv whose message the first attempt already consumed
+    while True:
+        try:
+            out = fn()
+            if _t0[0] is not None:
+                print(f"rank {rank} heal_dt={time.monotonic() - _t0[0]:.2f}",
+                      flush=True)
+                _t0[0] = None
+            return out
+        except MPIException as e:
+            if e.error_class != ERR_PROC_FAILED:
+                raise
+            if _t0[0] is None:
+                _t0[0] = time.monotonic()
+            time.sleep(0.1)
+
+steps = int(os.environ["SOAK_STEPS"])
+right, left = (rank + 1) % size, (rank - 1) % size
+for step in range(start, steps):
+    faultinject.step()
+    out = np.array([float(rank * 100 + step)])
+    heal_retry(lambda: comm.isend(out, dest=right, tag=step).wait())
+    got = heal_retry(lambda: comm.recv(source=left, tag=step))
+    assert float(got[0]) == left * 100 + step, (step, got)
+    acc += float(got[0])
+    store.write_rank(step, 0, {"step": np.int64(step),
+                               "acc": np.float64(acc)})
+    store.commit(step, 1)
+
+print(f"rank {rank} selfheal done acc={acc:.0f}", flush=True)
+ompi_tpu.finalize()
+"""
+
+# the crash-loop prover: the victim dies at the SAME step in every life
+# (fault kind ``crash``), survivors do independent local work — the
+# job's fate rides entirely on the selfheal ladder escalating
+# revive → shrink instead of aborting or reviving forever
+CRASHLOOP_APP = r"""
+import os, time
+import ompi_tpu
+from ompi_tpu.testing import faultinject
+
+comm = ompi_tpu.init()
+rank = comm.rank
+steps = int(os.environ["SOAK_STEPS"])
+acc = 0.0
+for step in range(steps):
+    faultinject.step()
+    acc += float(rank * 10 + step)
+    time.sleep(0.2)
+print(f"rank {rank} crashloop done acc={acc:.0f}", flush=True)
+ompi_tpu.finalize()
+"""
+
 
 def tpurun(args, env_extra=None, timeout=150):
     env = dict(os.environ)
@@ -150,7 +252,8 @@ def gen_plan(seed: int, idx: int, np_: int, steps: int) -> dict:
     rng = random.Random(f"{seed}:{idx}")  # str seed: tuples raise on 3.11+
     policy = POLICIES[idx % len(POLICIES)]
     victim = rng.randrange(0, np_) \
-        if policy in ("notify-shrink", "rank-hang", "writer-death") \
+        if policy in ("notify-shrink", "rank-hang", "writer-death",
+                      "selfheal-hang", "selfheal-crashloop") \
         else rng.randrange(1, np_)
     kill_step = rng.randrange(1, steps - 1)
     drop = rng.choice((0.0, 0.05, 0.15)) if policy == "notify-shrink" \
@@ -163,8 +266,10 @@ def gen_plan(seed: int, idx: int, np_: int, steps: int) -> dict:
         return {"idx": idx, "policy": policy, "victim": 1,
                 "kill_step": None, "kill_t": kill_t, "drop": 0.0,
                 "plan": f"daemon=1:kill@t={kill_t}", "seed": seed}
-    if policy == "rank-hang":
+    if policy in ("rank-hang", "selfheal-hang"):
         plan = f"rank={victim}:hang@step={kill_step}"
+    elif policy == "selfheal-crashloop":
+        plan = f"rank={victim}:crash@step={kill_step}"
     else:
         plan = f"rank={victim}:kill@step={kill_step}"
     if drop:
@@ -281,6 +386,63 @@ def run_plan(plan: dict, np_: int, steps: int, log_dir: str,
         for rank in (1, 2, 3):
             assert f"rank {rank} survived" in out, (rank, out[-3000:])
         assert "rank 0 survived" not in out, out[-3000:]
+    elif policy == "selfheal-hang":
+        # the full self-healing cycle: gossip detects the SIGSTOP, the
+        # control plane reaps the pid, the errmgr revives it in place,
+        # it restores from its snapshot, and the ring CONVERGES to the
+        # full-world answer — nobody shrinks, nobody aborts
+        # window 4 s (vs rank-hang's 2 s): a revived rank's interpreter
+        # start saturates a small box's cores for seconds, and a too-
+        # tight window then false-declares HEALTHY ranks mid-rejoin —
+        # the detect→rejoin bound below still holds with 3x margin
+        r = tpurun(["-np", str(np_), "--mca", "errmgr", "selfheal",
+                    "--mca", "ft_gossip_period", "0.5",
+                    "--mca", "ft_gossip_timeout", "4.0", *mca,
+                    "--", sys.executable, "-c", SELFHEAL_APP],
+                   env, timeout=240)
+        out = r.stdout + r.stderr
+        assert r.returncode == 0, \
+            f"selfheal-hang rc={r.returncode}: {out[-3000:]}"
+        assert f"rank {plan['victim']} resumed at step" in out, out[-3000:]
+        assert "selfheal revive" in out, \
+            f"no selfheal revive event: {out[-3000:]}"
+        for rank in range(np_):
+            acc = sum(((rank - 1) % np_) * 100 + s for s in range(steps))
+            assert f"rank {rank} selfheal done acc={acc:.0f}" in out, \
+                (rank, acc, out[-3000:])
+        heals = [float(m) for m in re.findall(r"heal_dt=([0-9.]+)", out)]
+        assert heals, f"no heal_dt lines: {out[-3000:]}"
+        assert max(heals) < 15.0, \
+            (f"detect→rejoin took {max(heals):.1f}s — the gossip window "
+             f"+ reap + revive + restore cycle must stay under 15s")
+    elif policy == "selfheal-crashloop":
+        # the escalation ladder: the victim dies at the same step every
+        # life; min-uptime gating (forced high) classifies every
+        # re-death as a crash loop, the budget burns with backoff, and
+        # the policy degrades revive → shrink — the job survives
+        # smaller, with a deterministic revive/escalation event count
+        r = tpurun(["-np", str(np_), "--mca", "errmgr", "selfheal",
+                    "--mca", "errmgr_max_restarts", "2",
+                    "--mca", "errmgr_min_uptime_s", "30", *mca,
+                    "--", sys.executable, "-c", CRASHLOOP_APP],
+                   env, timeout=240)
+        out = r.stdout + r.stderr
+        assert r.returncode == 0, \
+            f"selfheal-crashloop rc={r.returncode}: {out[-3000:]}"
+        for rank in range(np_):
+            if rank == plan["victim"]:
+                continue
+            acc = sum(rank * 10 + s for s in range(steps))
+            assert f"rank {rank} crashloop done acc={acc:.0f}" in out, \
+                (rank, out[-3000:])
+        assert f"rank {plan['victim']} crashloop done" not in out, \
+            f"crash-looping victim claims completion: {out[-3000:]}"
+        revives = out.count("selfheal revive")
+        assert revives == 2, \
+            (f"expected exactly 2 revives (errmgr_max_restarts) before "
+             f"escalation, saw {revives}: {out[-3000:]}")
+        assert "selfheal-escalate" in out and "degrading to shrink" in out, \
+            f"no revive→shrink escalation event: {out[-3000:]}"
     elif policy == "continue":
         r = tpurun(["-np", str(np_), "--mca", "errmgr", "continue", *mca,
                     "--", sys.executable, "-c", LOCAL_APP], env)
@@ -341,10 +503,10 @@ def check_replay(plan: dict, first: dict[int, dict],
     """
     kills_a = sorted((r, e["kind"], e["trigger"], e["value"])
                      for r, d in first.items() for e in d["events"]
-                     if e["kind"] in ("kill", "hang"))
+                     if e["kind"] in ("kill", "hang", "crash"))
     kills_b = sorted((r, e["kind"], e["trigger"], e["value"])
                      for r, d in second.items() for e in d["events"]
-                     if e["kind"] in ("kill", "hang"))
+                     if e["kind"] in ("kill", "hang", "crash"))
     assert kills_a == kills_b, \
         f"plan {plan['idx']}: kill schedule diverged: {kills_a} vs {kills_b}"
 
@@ -379,12 +541,12 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--np", type=int, default=4, dest="np_")
     ap.add_argument("--steps", type=int, default=6)
-    ap.add_argument("--replay-every", type=int, default=3,
+    ap.add_argument("--replay-every", type=int, default=4,
                     help="replay every Nth plan to assert determinism "
-                         "(0 = no replays; default 3 is co-prime with "
-                         "the 4-policy rotation so every policy — "
+                         "(0 = no replays; default 4 is co-prime with "
+                         "the 9-policy rotation so every policy — "
                          "including the drop-carrying notify-shrink "
-                         "plans — gets replayed)")
+                         "plans — gets replayed over a long soak)")
     ap.add_argument("--only", default=None, choices=POLICIES,
                     help="run only plans of one class (the CI smoke "
                          "jobs pick single scenarios this way)")
