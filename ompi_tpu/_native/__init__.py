@@ -72,14 +72,19 @@ def _build(so: str, src: str = _SRC,
                 except OSError:
                     pass
                 return _build(so, src, extra_flags)
-            time.sleep(0.1)
+            # one-time memoized compile wait (first use per machine,
+            # during single-threaded bring-up) — not a steady-state
+            # blocking path
+            time.sleep(0.1)   # lint: reader-ok lock-ok
         return os.path.exists(so)
     except OSError:
         return False
     try:
         os.close(fd)
         tmp = so + ".tmp"
-        proc = subprocess.run(
+        # one-time memoized compile (see lib()'s _tried gate) — not a
+        # steady-state blocking path
+        proc = subprocess.run(   # lint: reader-ok lock-ok
             ["g++", "-O3", "-shared", "-fPIC", *extra_flags,
              "-o", tmp, src],
             capture_output=True, timeout=120)
@@ -179,6 +184,7 @@ def fastdss():
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
         # self-check against a known vector before trusting it
+        # a DSS round-trip vector, not a wire frame  # lint: frame-ok
         probe = {"t": "x", "n": 1, "f": 1.5, "l": [1, "a"], "b": b"\x00",
                  "none": None, "tt": (True, False)}
         if mod.unpack(mod.pack((probe,)), 1) != [probe]:

@@ -66,6 +66,21 @@ in-flight decision could complete the new life's same-numbered
 agreement with stale membership.  Senders that have not yet learned the
 new incarnation heal through the PML rebind re-announce; FT protocols
 retransmit, so a fenced frame costs a retry, never a hang.
+
+Thread-context rules (machine-checked by ``tools/lint``):
+``on_ft_frame`` runs on BTL reader threads — it must never block, never
+RPC, and send only via the PML worker queue (``_send_ft`` →
+``_enqueue_frame``).  The ``reader-thread`` checker enforces exactly
+this by call-graph reachability; anything that must reach the control
+plane from frame dispatch is queued and drained by the gossip loop or a
+detector poll hook instead (``_adopt_notify`` → ``_flush_adopt_notices``
+is the pattern).  ``FailureDetector.is_dead(peer, poll=False)`` is the
+reader-safe form — the polling default is a blocking RPC, which is why
+its poll branch carries the linter waiver documenting the contract.
+The ``lock-order`` checker covers the other half: ``self._lock`` and
+the per-comm ``_CommFT.lock`` are reader-shared, so no sleep/RPC may be
+reachable while either is held, and their nesting order must stay
+acyclic against the PML's.
 """
 
 from __future__ import annotations
@@ -260,7 +275,11 @@ class FailureDetector:
         if world_rank in self._dead:
             return True
         if poll:
-            self.poll_runtime()
+            # reader-thread/under-lock callers MUST pass poll=False —
+            # this branch is a blocking control-plane RPC (the linter's
+            # reachability is context-insensitive, hence the waiver;
+            # the contract it can't see is this comment)
+            self.poll_runtime()   # lint: reader-ok lock-ok
             return world_rank in self._dead
         return False
 

@@ -79,6 +79,18 @@ job).  Components decide what a proc-failure event does:
   to propagate through — does it fall to the last rung and abort.
   ``errmgr_selfheal_{revives,escalations}_total`` count the cycle in
   the flight recorder.  Select with ``--mca errmgr selfheal``.
+
+Thread-context rules (machine-checked by ``tools/lint``): errmgr hooks
+fire from rml ``register_recv`` callbacks and the PMIx server's
+``on_failed_report``/``on_client_contact`` — link reader threads and
+server connection threads respectively.  The ``reader-thread`` checker
+classifies everything reachable from those callbacks and fails on
+blocking PMIx RPCs, ``time.sleep``, and ``subprocess`` calls on the
+path; the ``lock-order`` checker additionally fails on lock-acquisition
+cycles and on blocking work under any reader-shared lock.  Keep new
+detection→reaction paths non-blocking (queue + drain from a worker, the
+way ``PmlFT._adopt_notify`` defers its RPC to the gossip loop) or the
+lint gate in CI will name the offending call chain.
 """
 
 from __future__ import annotations

@@ -1,0 +1,873 @@
+"""ompi-lint checker proofs: every checker catches its bad fixture and
+stays silent on a clean one.
+
+Each fixture is a minimal tree written to tmp_path containing exactly
+one violation of the invariant the checker owns, plus the registry /
+dispatcher scaffolding the checker indexes.  The full-tree run at the
+bottom is the acceptance gate: the real tree lints clean with an empty
+baseline (the CI `lint` job re-asserts this on every push).
+"""
+
+import json
+import subprocess
+import sys
+
+from tools.lint.baseline import Baseline
+from tools.lint.checkers import (frame_op, lock_order, pmix_rpc,
+                                 pvar_spec, reader_thread, rml_tag,
+                                 var_registry)
+from tools.lint.finding import Finding
+from tools.lint.index import ProjectIndex
+
+
+def _tree(tmp_path, files: dict):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    return ProjectIndex.build(str(tmp_path))
+
+
+def _rules(findings):
+    return {(f.rule, f.symbol) for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# var-registry
+# ---------------------------------------------------------------------------
+
+_VAR_CLEAN = """
+from config import register_var, var_registry
+
+register_var("pml", "eager_limit", "size", 4096)
+register_var("pml", "greeting", "string", "hi")
+
+def use():
+    var_registry.get("pml_eager_limit")
+    s = var_registry.get("pml_greeting") or ""
+    return s
+"""
+
+_VAR_CONFIG = """
+class _Reg:
+    def get(self, name):
+        return None
+
+def register_var(fw, name, vtype, default, **kw):
+    pass
+
+var_registry = _Reg()
+"""
+
+
+def test_var_registry_unregistered_read(tmp_path):
+    idx = _tree(tmp_path, {
+        "config.py": _VAR_CONFIG,
+        "app.py": _VAR_CLEAN + """
+def broken():
+    return var_registry.get("pml_eager_limti")   # typo'd read
+""",
+    })
+    got = _rules(var_registry.run(idx))
+    assert ("unregistered-read", "pml_eager_limti") in got
+
+
+def test_var_registry_type_mismatch_and_env(tmp_path):
+    idx = _tree(tmp_path, {
+        "config.py": _VAR_CONFIG,
+        "app.py": _VAR_CLEAN + """
+import os
+
+def broken():
+    n = int(var_registry.get("pml_greeting"))    # int() of a string var
+    os.environ.get("OMPI_TPU_TYPOED_KNOB")       # never declared
+    return n
+""",
+    })
+    got = _rules(var_registry.run(idx))
+    assert ("type-mismatch", "pml_greeting") in got
+    assert ("unknown-env-read", "OMPI_TPU_TYPOED_KNOB") in got
+
+
+def test_var_registry_clean(tmp_path):
+    idx = _tree(tmp_path, {
+        "config.py": _VAR_CONFIG,
+        "app.py": _VAR_CLEAN + """
+import os
+
+ENV_KNOB = "OMPI_TPU_DECLARED_KNOB"
+
+def fine():
+    # declared-constant env read + dynamic read against a loop
+    # registration
+    os.environ.get(ENV_KNOB)
+    for coll in ("bcast", "reduce"):
+        register_var("coll", f"host_{coll}_algorithm", "string", "")
+    which = "bcast"
+    return var_registry.get(f"coll_host_{which}_algorithm")
+""",
+    })
+    assert var_registry.run(idx) == []
+
+
+# ---------------------------------------------------------------------------
+# pvar-spec
+# ---------------------------------------------------------------------------
+
+_TRACE_MOD = """
+_COUNTER_SPECS = (
+    ("frames_sent_total", "frames", "sent"),
+    ("frames_lost_total", "frames", "never bumped anywhere"),
+)
+counters = {n: 0 for n, _u, _d in _COUNTER_SPECS}
+
+def count(name, delta=1):
+    counters[name] += delta
+"""
+
+
+def test_pvar_spec_dead_and_undeclared(tmp_path):
+    idx = _tree(tmp_path, {
+        "trace.py": _TRACE_MOD,
+        "app.py": """
+from trace import count as _c  # noqa: F401 — bare import form
+import trace as trace_mod
+
+def hot_path():
+    trace_mod.count("frames_sent_total")
+    trace_mod.count("frames_dropped_total")   # not in _COUNTER_SPECS
+""",
+    })
+    got = _rules(pvar_spec.run(idx))
+    assert ("undeclared-counter", "frames_dropped_total") in got
+    assert ("dead-pvar", "frames_lost_total") in got
+    assert ("dead-pvar", "frames_sent_total") not in got
+
+
+def test_pvar_spec_clean_with_fstring_bump(tmp_path):
+    idx = _tree(tmp_path, {
+        "trace.py": _TRACE_MOD.replace(
+            '"never bumped anywhere"', '"bumped via f-string"'),
+        "app.py": """
+import trace as trace_mod
+
+def hot_path(kind):
+    trace_mod.count(f"frames_{kind}_total")   # matches both specs
+""",
+    })
+    assert pvar_spec.run(idx) == []
+
+
+# ---------------------------------------------------------------------------
+# rml-tag
+# ---------------------------------------------------------------------------
+
+_BUS = """
+TAG_GOOD = "good"
+TAG_ORPHAN_SEND = "orphan_send"
+TAG_DEAD = "dead"
+TAG_UNSENT = "unsent"
+
+class Node:
+    def register_recv(self, tag, cb):
+        pass
+    def xcast(self, tag, payload):
+        pass
+    def send_up(self, tag, payload):
+        pass
+"""
+
+
+def test_rml_tag_findings(tmp_path):
+    idx = _tree(tmp_path, {
+        "rml.py": _BUS,
+        "daemon.py": """
+import rml
+
+def wire(node):
+    node.register_recv(rml.TAG_GOOD, lambda o, p: None)
+    node.register_recv(rml.TAG_UNSENT, lambda o, p: None)
+    node.xcast(rml.TAG_GOOD, 1)
+    node.send_up(rml.TAG_ORPHAN_SEND, 2)        # nobody registers it
+    node.xcast(rml.TAG_TYPO, 3)                 # not defined on the bus
+""",
+    })
+    got = _rules(rml_tag.run(idx))
+    assert ("unhandled-send", "TAG_ORPHAN_SEND") in got
+    assert ("dead-tag", "TAG_DEAD") in got
+    assert ("unsent-handler", "TAG_UNSENT") in got
+    assert ("unknown-tag", "TAG_TYPO") in got
+    assert ("unhandled-send", "TAG_GOOD") not in got
+
+
+def test_rml_tag_ignores_non_bus_tag_namespaces(tmp_path):
+    idx = _tree(tmp_path, {
+        "rml.py": _BUS,
+        "coll.py": "TAG_BARRIER = -4242\nTAG_BCAST = -4243\n",
+        "daemon.py": """
+import rml
+
+def wire(node):
+    node.register_recv(rml.TAG_GOOD, lambda o, p: None)
+    node.register_recv(rml.TAG_UNSENT, lambda o, p: None)
+    node.register_recv(rml.TAG_DEAD, lambda o, p: None)
+    node.xcast(rml.TAG_GOOD, 1)
+    node.xcast(rml.TAG_UNSENT, 1)
+    node.xcast(rml.TAG_ORPHAN_SEND, 1)
+    node.register_recv(rml.TAG_ORPHAN_SEND, lambda o, p: None)
+    node.xcast(rml.TAG_DEAD, 1)
+""",
+    })
+    # the coll p2p tag space must not be reported as dead bus tags
+    assert rml_tag.run(idx) == []
+
+
+# ---------------------------------------------------------------------------
+# frame-op
+# ---------------------------------------------------------------------------
+
+_DISPATCH = """
+class Pml:
+    def _on_frame(self, peer, hdr, payload):
+        t = hdr["t"]
+        if t in ("eager", "rndv"):
+            pass
+        elif t == "ft":
+            FT().on_ft_frame(peer, hdr)
+        elif t == "ghost":
+            pass                      # nothing ever emits this
+        else:
+            pass
+
+class FT:
+    def on_ft_frame(self, peer, hdr):
+        op = hdr.get("op")
+        if op == "beat":
+            pass
+        else:
+            pass
+"""
+
+
+def test_frame_op_unhandled_and_dead(tmp_path):
+    idx = _tree(tmp_path, {
+        "pml.py": _DISPATCH,
+        "send.py": """
+def send(q, big):
+    hdr = {"cid": 0}
+    hdr["t"] = "rndv" if big else "eager"
+    q.append(hdr)
+    q.append({"t": "ft", "op": "beat"})
+    q.append({"t": "ft", "op": "gossip2"})   # no dispatch branch
+    q.append({"t": "mystery"})               # no dispatch branch
+""",
+    })
+    got = _rules(frame_op.run(idx))
+    assert ("unhandled-op", "ft:gossip2") in got
+    assert ("unhandled-op", "pml:mystery") in got
+    assert ("unemitted-branch", "pml:ghost") in got
+    assert ("unhandled-op", "pml:rndv") not in got   # IfExp emission seen
+
+
+def test_frame_op_ft_subscript_and_update_emission(tmp_path):
+    """FT ops emitted as ``hdr["op"] = …`` / ``hdr.update(op=…)`` are
+    ft-plane emissions (the "op" key only exists on t="ft" frames):
+    a dispatched op emitted this way is NOT a dead branch, and an
+    undispatched one IS an unhandled op."""
+    idx = _tree(tmp_path, {
+        "pml.py": _DISPATCH.replace(
+            '        elif t == "ghost":\n            pass'
+            '                      # nothing ever emits this\n', ""),
+        "send.py": """
+def send(q):
+    q.append({"t": "eager"})
+    q.append({"t": "rndv"})
+    hdr = {"t": "ft"}
+    hdr["op"] = "beat"              # subscript-assign emission
+    q.append(hdr)
+    h2 = {"t": "ft"}
+    h2.update(op="gossip2")         # update-kwarg emission, no branch
+    q.append(h2)
+""",
+    })
+    got = _rules(frame_op.run(idx))
+    assert ("unemitted-branch", "ft:beat") not in got
+    assert ("unhandled-op", "ft:gossip2") in got
+
+
+def test_frame_op_clean(tmp_path):
+    idx = _tree(tmp_path, {
+        "pml.py": _DISPATCH.replace(
+            '        elif t == "ghost":\n            pass'
+            '                      # nothing ever emits this\n', ""),
+        "send.py": """
+def send(q, big):
+    hdr = {"cid": 0}
+    hdr.update(t="rndv" if big else "eager")
+    q.append(hdr)
+    q.append({"t": "ft", "op": "beat"})
+""",
+    })
+    assert frame_op.run(idx) == []
+
+
+# ---------------------------------------------------------------------------
+# pmix-rpc
+# ---------------------------------------------------------------------------
+
+_PMIX = """
+class Server:
+    def _handle(self, cmd, args):
+        if cmd == "put":
+            rank, key, value = args
+            return ("ok",)
+        if cmd == "report":
+            reporter, failed = args[:2]
+            inc = int(args[2]) if len(args) > 2 else 0
+            return ("ok", inc)
+        if cmd == "dead_arm":
+            return ("ok",)
+        raise RuntimeError(cmd)
+
+class Client:
+    def _rpc(self, *msg):
+        return ("ok",)
+"""
+
+
+def test_pmix_rpc_findings(tmp_path):
+    idx = _tree(tmp_path, {
+        "pmix.py": _PMIX + """
+class App(Client):
+    def put(self, k, v):
+        self._rpc("put", 0, k, v)
+    def put_legacy(self):
+        self._rpc("put", 0)              # server unpacks three
+    def report(self):
+        self._rpc("report", 1, 2)        # 3rd arg is len-guarded: fine
+    def ping(self):
+        self._rpc("ping")                # no server branch
+""",
+    })
+    got = _rules(pmix_rpc.run(idx))
+    assert ("unknown-rpc", "ping") in got
+    assert ("arity-mismatch", "put") in got
+    assert ("dead-rpc", "dead_arm") in got
+    assert ("arity-mismatch", "report") not in got
+
+
+def test_pmix_rpc_clean(tmp_path):
+    idx = _tree(tmp_path, {
+        "pmix.py": _PMIX.replace(
+            '        if cmd == "dead_arm":\n'
+            '            return ("ok",)\n', "") + """
+class App(Client):
+    def put(self, k, v):
+        self._rpc("put", 0, k, v)
+    def report(self, inc=None):
+        if inc is None:
+            self._rpc("report", 1, 2)
+        else:
+            self._rpc("report", 1, 2, inc)
+""",
+    })
+    assert pmix_rpc.run(idx) == []
+
+
+def test_pmix_rpc_guarded_tuple_unpack_optional(tmp_path):
+    """A tuple-unpack of args under a len(args) guard is the legacy-
+    fallback pattern — a short legacy client call is not a mismatch."""
+    idx = _tree(tmp_path, {"pmix.py": """
+class Server:
+    def _handle(self, cmd, args):
+        if cmd == "hello":
+            if len(args) >= 2:
+                rank, inc = args
+            else:
+                rank, inc = args[0], 0
+            return ("ok", rank, inc)
+        raise RuntimeError(cmd)
+
+class Client:
+    def _rpc(self, *msg):
+        return ("ok",)
+
+class App(Client):
+    def hello_modern(self):
+        self._rpc("hello", 3, 7)
+    def hello_legacy(self):
+        self._rpc("hello", 3)
+"""})
+    assert pmix_rpc.run(idx) == []
+
+
+def test_var_registry_frameworkless_name(tmp_path):
+    """Var.full_name keys on FRAMEWORK truthiness: register_var('',
+    'standalone', …) answers reads of 'standalone', not '_standalone'."""
+    idx = _tree(tmp_path, {
+        "config.py": _VAR_CONFIG,
+        "app.py": """
+from config import register_var, var_registry
+
+register_var("", "standalone", "bool", False)
+
+def use():
+    return var_registry.get("standalone")
+""",
+    })
+    assert var_registry.run(idx) == []
+
+
+# ---------------------------------------------------------------------------
+# reader-thread
+# ---------------------------------------------------------------------------
+
+_READER = """
+import time
+
+class PMIxClient:
+    def _rpc(self, *msg):
+        return ("ok",)
+    def report_failed(self, rank, reason=""):
+        return self._rpc("report_failed", rank, reason)
+
+class Btl:
+    def __init__(self, client):
+        self.client = client
+    def _read_loop(self, sock):
+        while True:
+            self._dispatch(sock)
+    def _dispatch(self, frame):
+        self._declare(1)
+    def _declare(self, peer):
+        self.client.report_failed(peer, "gossip")    # RPC on the reader!
+"""
+
+
+def test_reader_thread_rpc_detected(tmp_path):
+    idx = _tree(tmp_path, {"btl.py": _READER})
+    got = reader_thread.run(idx)
+    assert any(f.rule == "rpc-on-reader" for f in got), got
+
+
+def test_reader_thread_register_recv_callback_and_sleep(tmp_path):
+    idx = _tree(tmp_path, {"node.py": """
+import time
+
+class Node:
+    def register_recv(self, tag, cb):
+        pass
+
+class Daemon:
+    def wire(self, node):
+        node.register_recv("launch", self._on_launch)
+    def _on_launch(self, origin, payload):
+        time.sleep(1.0)        # blocking a link reader thread
+"""})
+    got = reader_thread.run(idx)
+    assert any(f.rule == "sleep-on-reader"
+               and "Daemon._on_launch" in f.message for f in got), got
+
+
+def test_reader_thread_lambda_callback_and_hook_attr(tmp_path):
+    """Lambda-wrapped register_recv callbacks and reader hook
+    attributes (on_peer_lost) are entry points too — the adapter form
+    must not hide a blocking handler from the checker."""
+    idx = _tree(tmp_path, {"node.py": """
+import time
+
+class Node:
+    def register_recv(self, tag, cb):
+        pass
+
+class Daemon:
+    def wire(self, node):
+        node.register_recv("exit", lambda o, p: self._on_exit(o, p))
+        node.on_peer_lost = self._on_lost
+    def _on_exit(self, origin, payload):
+        time.sleep(0.5)              # blocking the link reader
+    def _on_lost(self, peer):
+        import subprocess
+        subprocess.run(["true"])     # blocking the link reader
+"""})
+    got = {f.rule for f in reader_thread.run(idx)}
+    assert "sleep-on-reader" in got and "subprocess-on-reader" in got
+
+
+def test_reader_thread_bare_import_sinks(tmp_path):
+    """`from time import sleep` / `from subprocess import run` must
+    not bypass the sink detection."""
+    idx = _tree(tmp_path, {"node.py": """
+from time import sleep
+from subprocess import run
+
+class Node:
+    def register_recv(self, tag, cb):
+        pass
+
+class Daemon:
+    def wire(self, node):
+        node.register_recv("x", self._on_x)
+        node.register_recv("y", self._on_y)
+    def _on_x(self, origin, payload):
+        sleep(1.0)
+    def _on_y(self, origin, payload):
+        run(["true"])
+"""})
+    got = {f.rule for f in reader_thread.run(idx)}
+    assert "sleep-on-reader" in got and "subprocess-on-reader" in got
+
+
+def test_reader_thread_clean_handoff(tmp_path):
+    idx = _tree(tmp_path, {"btl.py": _READER.replace(
+        'self.client.report_failed(peer, "gossip")    # RPC on the reader!',
+        "self.pending = peer    # queued; the gossip loop drains it")})
+    assert reader_thread.run(idx) == []
+
+
+def test_reader_thread_waiver_comment(tmp_path):
+    idx = _tree(tmp_path, {"btl.py": _READER.replace(
+        'self.client.report_failed(peer, "gossip")    # RPC on the reader!',
+        'self.client.report_failed(peer, "g")  # lint: reader-ok')})
+    assert reader_thread.run(idx) == []
+
+
+# ---------------------------------------------------------------------------
+# lock-order
+# ---------------------------------------------------------------------------
+
+def test_lock_order_ab_ba_cycle(tmp_path):
+    idx = _tree(tmp_path, {"mpi/locks.py": """
+import threading
+
+class A:
+    def __init__(self, b):
+        self._lock = threading.Lock()
+        self.b = b
+    def outer_ab(self):
+        with self._lock:
+            self.b.inner_b()
+    def inner_a(self):
+        with self._lock:
+            return 1
+
+class B:
+    def __init__(self, a):
+        self._block = threading.Lock()
+        self.a = a
+    def outer_ba(self):
+        with self._block:
+            self.a.inner_a()
+    def inner_b(self):
+        with self._block:
+            return 2
+"""})
+    got = lock_order.run(idx)
+    assert any(f.rule == "cycle" for f in got), got
+
+
+def test_lock_order_rpc_under_reader_shared_lock(tmp_path):
+    idx = _tree(tmp_path, {"mpi/pml.py": """
+import threading
+
+class PMIxClient:
+    def _rpc(self, *m):
+        return ("ok",)
+    def report_failed(self, r):
+        return self._rpc("report_failed", r)
+
+class Pml:
+    def __init__(self, client):
+        self._lock = threading.Lock()
+        self.client = client
+    def _read_loop(self, sock):
+        self.on_frame(sock)
+    def on_frame(self, frame):
+        with self._lock:          # reader-shared lock…
+            self.client.report_failed(0)   # …held across an RPC
+"""})
+    got = lock_order.run(idx)
+    assert any(f.rule == "rpc-under-lock" for f in got), got
+
+
+def test_lock_order_three_lock_cycle(tmp_path):
+    """A→B→C→A: the SCC has no edge between its two lowest-sorted
+    members, so the reporter must pick any existing in-SCC edge."""
+    idx = _tree(tmp_path, {"mpi/locks.py": """
+import threading
+
+class A:
+    def __init__(self):
+        self._alock = threading.Lock()
+    def grab_ab(self, b):
+        with self._alock:
+            b.grab_b()
+
+class B:
+    def __init__(self):
+        self._block = threading.Lock()
+    def grab_b(self):
+        with self._block:
+            return 1
+    def grab_bc(self, c):
+        with self._block:
+            c.grab_c()
+
+class C:
+    def __init__(self):
+        self._clock = threading.Lock()
+    def grab_c(self):
+        with self._clock:
+            return 2
+    def grab_ca(self, a):
+        with self._clock:
+            with a._alock:
+                return 3
+"""})
+    got = [f for f in lock_order.run(idx) if f.rule == "cycle"]
+    assert len(got) == 1 and "A._alock" in got[0].symbol, got
+
+
+def test_reader_thread_closure_handoff_not_attributed(tmp_path):
+    """The approved hand-off: a reader handler spawning a thread whose
+    CLOSURE sleeps must not be flagged — the closure runs on the new
+    thread's stack, not the reader's."""
+    idx = _tree(tmp_path, {"node.py": """
+import threading
+import time
+
+class Node:
+    def register_recv(self, tag, cb):
+        pass
+
+class Daemon:
+    def wire(self, node):
+        node.register_recv("launch", self._on_launch)
+    def _on_launch(self, origin, payload):
+        def worker():
+            time.sleep(5.0)     # fine: another thread's stack
+        threading.Thread(target=worker, daemon=True).start()
+"""})
+    assert reader_thread.run(idx) == []
+
+
+def test_lock_order_cycle_through_mutual_recursion(tmp_path):
+    """Locks acquired inside a call CYCLE must still reach the
+    transitive sets (a memoized DFS with a cycle guard used to hide
+    them, reporting a clean tree on a real inversion)."""
+    idx = _tree(tmp_path, {"mpi/locks.py": """
+import threading
+
+class A:
+    def __init__(self, b):
+        self._alock = threading.Lock()
+        self.b = b
+    def hold_a_then_f(self):
+        with self._alock:
+            self.rec_f()
+    def rec_f(self):
+        self.rec_g()
+    def rec_g(self):
+        self.b.take_block()     # cycle member acquires B's lock
+        self.rec_f()
+    def take_alock(self):
+        with self._alock:
+            return 1
+
+class B:
+    def __init__(self, a):
+        self._block = threading.Lock()
+        self.a = a
+    def take_block(self):
+        with self._block:
+            return 1
+    def hold_b_then_a(self):
+        with self._block:
+            self.a.take_alock()
+"""})
+    got = [f for f in lock_order.run(idx) if f.rule == "cycle"]
+    assert len(got) == 1, got
+
+
+def test_lock_order_second_sleep_under_lock_detected(tmp_path):
+    """A sleep OUTSIDE the lock must not shadow a later sleep INSIDE
+    it (the single-site sink map used to compare against the first
+    recorded site only)."""
+    idx = _tree(tmp_path, {"mpi/pml.py": """
+import threading
+import time
+
+class Pml:
+    def __init__(self):
+        self._lock = threading.Lock()
+    def _read_loop(self, sock):
+        self.on_frame(sock)
+    def on_frame(self, frame):
+        time.sleep(0.01)          # fine: lock not held
+        with self._lock:          # reader-shared
+            time.sleep(0.5)       # NOT fine
+"""})
+    got = [f for f in lock_order.run(idx)
+           if f.rule == "sleep-under-lock"]
+    assert len(got) == 1, got
+
+
+def test_baseline_write_merges_justifications(tmp_path):
+    path = str(tmp_path / "bl.json")
+    f1 = Finding("rml-tag", "dead-tag", "TAG_X", "m")
+    f2 = Finding("lock-order", "cycle", "A->B", "m")
+    Baseline.write(path, [f1, f2])
+    # hand-edit a justification
+    doc = json.loads(open(path).read())
+    for ent in doc["findings"]:
+        if ent["fingerprint"] == f2.fingerprint:
+            ent["justification"] = "accepted: bounded by X"
+    open(path, "w").write(json.dumps(doc))
+    # re-write from an rml-tag-only run: the lock-order entry AND the
+    # hand-written justification must both survive
+    Baseline.write(path, [f1], keep=Baseline.load(path).entries)
+    bl = Baseline.load(path)
+    assert bl.entries[f2.fingerprint] == "accepted: bounded by X"
+    assert f1.fingerprint in bl.entries
+
+
+def test_lock_order_closure_with_not_attributed(tmp_path):
+    """A closure's `with` runs on the closure's (spawned) stack — it
+    must not fabricate an acquisition edge from the enclosing with-
+    block, even when a legitimate reverse nesting exists elsewhere."""
+    idx = _tree(tmp_path, {"mpi/locks.py": """
+import threading
+
+class A:
+    def __init__(self, b):
+        self._alock = threading.Lock()
+        self.b = b
+    def spawn_under_a(self):
+        with self._alock:
+            def worker():
+                with self.b._block:     # another thread's stack
+                    pass
+            threading.Thread(target=worker, daemon=True).start()
+
+class B:
+    def __init__(self, a):
+        self._block = threading.Lock()
+        self.a = a
+    def hold_b_then_a(self):
+        with self._block:
+            with self.a._alock:         # the one true order: B -> A
+                pass
+"""})
+    assert [f for f in lock_order.run(idx) if f.rule == "cycle"] == []
+
+
+def test_lock_order_ordered_nesting_clean(tmp_path):
+    idx = _tree(tmp_path, {"mpi/locks.py": """
+import threading
+
+class Outer:
+    def __init__(self, inner):
+        self._lock = threading.Lock()
+        self.inner = inner
+    def work(self):
+        with self._lock:
+            self.inner.poke()
+
+class Inner:
+    def __init__(self):
+        self._ilock = threading.Lock()
+    def poke(self):
+        with self._ilock:
+            return 1
+"""})
+    assert lock_order.run(idx) == []
+
+
+# ---------------------------------------------------------------------------
+# baseline mechanics + the acceptance gate
+# ---------------------------------------------------------------------------
+
+def test_baseline_split(tmp_path):
+    f1 = Finding("rml-tag", "dead-tag", "TAG_X", "m")
+    f2 = Finding("rml-tag", "dead-tag", "TAG_Y", "m")
+    path = tmp_path / "baseline.json"
+    Baseline.write(str(path), [f1])
+    bl = Baseline.load(str(path))
+    new, old, stale = bl.split([f1, f2])
+    assert new == [f2] and old == [f1] and stale == []
+    # a fixed finding leaves a stale entry behind → must fail the run
+    new, old, stale = bl.split([f2])
+    assert stale == [f1.fingerprint]
+    doc = json.loads(path.read_text())
+    assert doc["findings"][0]["fingerprint"] == f1.fingerprint
+
+
+def test_driver_grandfather_and_stale(tmp_path):
+    """End-to-end driver run: a finding grandfathered via
+    --write-baseline stops failing the run; fixing it WITHOUT removing
+    the entry fails again (stale), and staleness is global — other
+    checkers must not re-report the entry as theirs."""
+    from tools.lint.driver import _repo_root
+
+    (tmp_path / "bus.py").write_text(
+        'TAG_LOST = "lost"\n\n'
+        "class Node:\n"
+        "    def register_recv(self, tag, cb):\n"
+        "        pass\n"
+        "    def xcast(self, tag, payload):\n"
+        "        pass\n\n"
+        "def go(n):\n"
+        "    n.xcast(TAG_LOST, 1)\n")
+    bl = str(tmp_path / "bl.json")
+
+    def lint(*extra):
+        return subprocess.run(
+            [sys.executable, "-m", "tools.lint", "--root",
+             str(tmp_path), "--baseline", bl, *extra],
+            capture_output=True, text=True, timeout=120,
+            cwd=_repo_root())
+
+    assert lint().returncode == 4          # rml-tag bit
+    assert lint("--write-baseline").returncode == 0
+    proc = lint()
+    assert proc.returncode == 0, proc.stdout   # grandfathered
+    assert "grandfathered" in proc.stdout
+    # "fix" the finding but leave the baseline entry → stale, fails
+    (tmp_path / "bus.py").write_text(
+        "class Node:\n"
+        "    def register_recv(self, tag, cb):\n"
+        "        pass\n"
+        "    def xcast(self, tag, payload):\n"
+        "        pass\n")
+    proc = lint()
+    assert proc.returncode == 4 and "stale" in proc.stdout
+    assert proc.stdout.count("stale baseline entry") == 1
+
+
+def test_root_run_ignores_repo_baseline(tmp_path):
+    """A --root fixture run without --baseline must see an EMPTY
+    baseline — the repo's entries must neither grandfather fixture
+    findings nor read as stale."""
+    from tools.lint.driver import _repo_root
+
+    (tmp_path / "clean.py").write_text("def f():\n    return 1\n")
+    # repo baseline temporarily non-empty would be needed for the full
+    # repro; here assert the clean fixture exits 0 regardless of the
+    # repo baseline contents and without touching it
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--root", str(tmp_path),
+         "-q"],
+        capture_output=True, text=True, timeout=120, cwd=_repo_root())
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "stale" not in proc.stdout
+
+
+def test_full_tree_lints_clean():
+    """The acceptance gate: the real tree, every checker, empty
+    baseline, exit 0 — run exactly as CI runs it."""
+    from tools.lint.driver import _repo_root
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--no-mypy", "-q"],
+        capture_output=True, text=True, timeout=300, cwd=_repo_root())
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -1,0 +1,128 @@
+"""pvar-spec — always-on counters and their `_COUNTER_SPECS` catalogue
+agree in both directions.
+
+``trace.count(name)`` does ``counters[name] += 1`` — an undeclared name
+is a KeyError on a hot path (the counters dict is seeded from
+``_COUNTER_SPECS`` only), and a spec nobody bumps is a dead pvar that
+exports a forever-zero metric and rots the catalogue.  Checks:
+
+- ``undeclared-counter``: a ``count("x")`` bump (or ``counters["x"]``
+  access) naming no ``_COUNTER_SPECS`` entry.  F-string names must
+  match ≥1 spec.
+- ``dead-pvar``: a ``_COUNTER_SPECS`` entry never bumped anywhere.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional
+
+from tools.lint.finding import Finding
+from tools.lint.index import (ProjectIndex, fstring_regex, iter_calls,
+                              literal_str)
+
+CHECKER = "pvar-spec"
+
+
+def run(index: ProjectIndex) -> list[Finding]:
+    specs = collect_specs(index)
+    if specs is None:
+        return []   # no catalogue in this tree — nothing to check
+    spec_names, spec_mod, spec_line = specs
+    findings: list[Finding] = []
+    bumped: set[str] = set()
+
+    for mod in index.modules.values():
+        for call in iter_calls(mod.tree):
+            arg = _count_arg(mod, call)
+            if arg is None:
+                continue
+            lit = literal_str(arg)
+            if lit is not None:
+                if lit in spec_names:
+                    bumped.add(lit)
+                elif not mod.suppressed(call, "pvar"):
+                    findings.append(Finding(
+                        CHECKER, "undeclared-counter", lit,
+                        f"counter {lit!r} bumped but not declared in "
+                        f"_COUNTER_SPECS", mod.path, call.lineno))
+                continue
+            rx = fstring_regex(arg)
+            if rx is not None:
+                hits = {n for n in spec_names if re.match(rx, n)}
+                if hits:
+                    bumped |= hits
+                elif not mod.suppressed(call, "pvar"):
+                    findings.append(Finding(
+                        CHECKER, "undeclared-counter", rx,
+                        f"dynamic counter bump {rx!r} matches no "
+                        f"_COUNTER_SPECS entry", mod.path, call.lineno))
+        # counters["x"] subscripts also keep a spec alive
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Subscript) \
+                    and _is_counters(node.value):
+                lit = literal_str(node.slice)
+                if lit is not None and lit in spec_names:
+                    bumped.add(lit)
+
+    for name in sorted(set(spec_names) - bumped):
+        findings.append(Finding(
+            CHECKER, "dead-pvar", name,
+            f"_COUNTER_SPECS entry {name!r} is never bumped by any "
+            f"count() call", spec_mod, spec_line.get(name, 0)))
+    return findings
+
+
+def collect_specs(index: ProjectIndex
+                  ) -> Optional[tuple[set[str], str, dict[str, int]]]:
+    """The tree's ``_COUNTER_SPECS`` tuple → (names, path, name→line)."""
+    for mod in index.modules.values():
+        for node in mod.tree.body:
+            if not (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name)
+                            and t.id == "_COUNTER_SPECS"
+                            for t in node.targets)):
+                continue
+            if not isinstance(node.value, (ast.Tuple, ast.List)):
+                continue
+            names: set[str] = set()
+            lines: dict[str, int] = {}
+            for el in node.value.elts:
+                if isinstance(el, (ast.Tuple, ast.List)) and el.elts:
+                    nm = literal_str(el.elts[0])
+                    if nm is not None:
+                        names.add(nm)
+                        lines[nm] = el.lineno
+            return names, mod.path, lines
+    return None
+
+
+def _count_arg(mod, call: ast.Call) -> Optional[ast.expr]:
+    """The name argument of a counter bump: ``trace.count(x)`` /
+    ``trace_mod.count(x)`` / bare ``count(x)`` imported from the trace
+    module.  Plain ``<anything-else>.count(x)`` (str/list methods) is
+    not a bump."""
+    f = call.func
+    if not call.args:
+        return None
+    if isinstance(f, ast.Attribute) and f.attr == "count":
+        recv = f.value
+        if isinstance(recv, ast.Name) and "trace" in recv.id:
+            return call.args[0]
+        return None
+    if isinstance(f, ast.Name) and f.id == "count":
+        src = mod.from_imports.get("count")
+        if src is not None and "trace" in src[0]:
+            return call.args[0]
+        if "count" in mod.functions:   # the trace module itself
+            return call.args[0]
+    return None
+
+
+def _is_counters(node: ast.expr) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id == "counters"
+    if isinstance(node, ast.Attribute):
+        return node.attr == "counters"
+    return False
