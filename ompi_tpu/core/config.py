@@ -37,6 +37,11 @@ __all__ = [
 ]
 
 
+#: external knob: highest-precedence params file (≈ the reference's
+#: OMPI_MCA_mca_param_files)
+ENV_PARAM_FILE = "OMPI_TPU_PARAM_FILE"
+
+
 class VarType(enum.Enum):
     INT = "int"
     UNSIGNED = "unsigned"
@@ -172,8 +177,8 @@ class VarRegistry:
         """
         # First file to define a name wins (setdefault below), so list paths
         # highest precedence first.
-        paths = []
-        envp = os.environ.get("OMPI_TPU_PARAM_FILE")
+        paths: list[str] = []
+        envp = os.environ.get(ENV_PARAM_FILE)
         if envp:
             paths.append(envp)
         paths.append(os.path.join(os.getcwd(), "ompi-tpu-params.conf"))

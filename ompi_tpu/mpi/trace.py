@@ -57,6 +57,8 @@ __all__ = [
 ]
 
 ENV_FLAG = "OMPI_TPU_TRACE"
+#: external knob: ring capacity in events (default 65536)
+ENV_EVENTS = "OMPI_TPU_TRACE_EVENTS"
 
 #: the timeline categories (→ one Chrome tid per category at export)
 CATEGORIES = ("pml", "btl", "coll", "osc", "io", "ckpt", "datatype",
@@ -239,8 +241,8 @@ def enable(capacity: Optional[int] = None, rank: int = -1,
         if recorder is None:
             if capacity is None:
                 try:
-                    capacity = int(os.environ.get(
-                        "OMPI_TPU_TRACE_EVENTS", "") or 65536)
+                    capacity = int(os.environ.get(ENV_EVENTS, "")
+                                   or 65536)
                 except ValueError:
                     # a bad sizing knob must not kill the job at init
                     capacity = 65536
