@@ -125,7 +125,7 @@ class Var:
     info_level: InfoLevel = InfoLevel.USER_ALL
     read_only: bool = False
     deprecated: bool = False
-    enumerator: Optional[tuple] = None  # allowed values, if restricted
+    enumerator: Optional[tuple[Any, ...]] = None  # allowed values
     synonyms: tuple[str, ...] = ()  # alternate full names
     # current state
     value: Any = None
@@ -220,7 +220,7 @@ class VarRegistry:
             # live.  Among canonical name + synonyms, the highest-precedence
             # source wins (a CLI setting under a synonym must beat a file
             # setting under the canonical name).
-            pend = None
+            pend: Optional[tuple[str, VarSource]] = None
             for cand in (var.full_name, *var.synonyms):
                 p = self._pending.get(cand)
                 if p is not None and (pend is None or p[1].value > pend[1].value):
@@ -287,7 +287,7 @@ class VarRegistry:
             return sorted(self._vars.values(), key=lambda v: v.full_name)
 
     def dump(self, max_level: InfoLevel = InfoLevel.DEV_ALL) -> str:
-        lines = []
+        lines: list[str] = []
         for var in self.all_vars():
             if var.info_level > max_level:
                 continue

@@ -151,7 +151,7 @@ class Framework:
 
     def _eligible(self) -> list[Component]:
         names, is_exclude = self._directive()
-        comps = []
+        comps: list[Component] = []
         with self._lock:
             components = dict(self._components)
         for name, comp in components.items():
@@ -187,7 +187,7 @@ class Framework:
         """All accepting components, highest priority first (for stacked
         frameworks like coll where modules layer per-function)."""
         self.open()
-        scored = []
+        scored: list[tuple[int, Component]] = []
         for comp in self._eligible():
             pri = comp.query(**context)
             if pri is None:

@@ -42,7 +42,7 @@ def cvar_num() -> int:
     return len(cvar_names())
 
 
-def cvar_get_info(name: str) -> dict:
+def cvar_get_info(name: str) -> dict[str, Any]:
     """≈ MPI_T_cvar_get_info — type/default/description metadata."""
     var = var_registry.lookup(name)
     if var is None:

@@ -45,7 +45,8 @@ import tempfile
 import threading
 import time
 from contextlib import contextmanager
-from typing import Any, Optional
+from types import FrameType
+from typing import Any, Callable, Iterator, Optional
 
 from ompi_tpu.mpi.mpit import Pvar, PvarClass, pvar_registry
 
@@ -167,6 +168,11 @@ for _name, _unit, _desc in _COUNTER_SPECS:
 # the ring buffer
 # ---------------------------------------------------------------------------
 
+#: one ring slot: (ts_ns, dur_ns|None, category, name, rank, args|None)
+_Event = tuple[int, Optional[int], str, str, int,
+               Optional[dict[str, Any]]]
+
+
 class FlightRecorder:
     """Fixed-size ring of trace events.
 
@@ -183,12 +189,12 @@ class FlightRecorder:
         self.capacity = max(16, int(capacity))
         self.rank = rank
         self.jobid = jobid
-        self._buf: list = [None] * self.capacity
+        self._buf: list[Optional[_Event]] = [None] * self.capacity
         self._n = itertools.count()
         self._hwm = 0           # highest index handed out + 1 (approx.)
 
     def add(self, ts_ns: int, dur_ns: Optional[int], cat: str, name: str,
-            rank: int, args: Optional[dict]) -> None:
+            rank: int, args: Optional[dict[str, Any]]) -> None:
         i = next(self._n)
         self._buf[i % self.capacity] = (ts_ns, dur_ns, cat, name, rank,
                                         args)
@@ -202,7 +208,7 @@ class FlightRecorder:
     def dropped(self) -> int:
         return max(0, self._hwm - self.capacity)
 
-    def snapshot(self) -> list[tuple]:
+    def snapshot(self) -> list[_Event]:
         """Events in (approximate) emission order, oldest first."""
         n = self._hwm
         if n <= self.capacity:
@@ -219,7 +225,8 @@ recorder: Optional[FlightRecorder] = None
 _lock = threading.Lock()
 _old_sigterm: Any = None
 _sigterm_installed = False
-_pml_listeners: list = []   # (pml, cb) pairs attach_pml registered
+#: (pml, cb) pairs attach_pml registered
+_pml_listeners: list[tuple[Any, Callable[[str, Any], None]]] = []
 
 
 def env_enabled() -> bool:
@@ -291,7 +298,7 @@ def _install_sigterm_flush() -> None:
         return
     import signal
 
-    def _flush_and_die(signum, frame):
+    def _flush_and_die(signum: int, frame: Optional[FrameType]) -> None:
         try:
             crash_dump(reason="sigterm")
         except Exception:  # noqa: BLE001 — dying anyway
@@ -336,7 +343,8 @@ def complete(cat: str, name: str, t0_ns: int, rank: int = -1,
 
 
 @contextmanager
-def span(cat: str, name: str, rank: int = -1, **args: Any):
+def span(cat: str, name: str, rank: int = -1,
+         **args: Any) -> Iterator[None]:
     t0 = time.monotonic_ns()
     try:
         yield
@@ -344,7 +352,7 @@ def span(cat: str, name: str, rank: int = -1, **args: Any):
         complete(cat, name, t0, rank=rank, **args)
 
 
-def attach_pml(pml) -> Any:
+def attach_pml(pml: Any) -> Any:
     """Bridge the PML's PERUSE-style EVT_* hooks into the timeline: every
     request-lifecycle event becomes a ``pml`` instant.  Returns the
     listener so a caller can ``pml.remove_listener`` it.
@@ -358,7 +366,7 @@ def attach_pml(pml) -> Any:
     timeline, when measuring the fast path itself."""
     prank = pml.rank
 
-    def _on_event(event: str, info: dict) -> None:
+    def _on_event(event: str, info: dict[str, Any]) -> None:
         if active:
             instant("pml", event, rank=prank, **info)
 
@@ -367,7 +375,7 @@ def attach_pml(pml) -> Any:
     return _on_event
 
 
-def detach_pml(pml) -> None:
+def detach_pml(pml: Any) -> None:
     """Remove the listener(s) attach_pml registered on ``pml`` — called
     from finalize() so a later init() epoch re-arms a FRESH bridge
     instead of keeping a closed PML in the listener table."""
@@ -384,18 +392,18 @@ def detach_pml(pml) -> None:
 # ---------------------------------------------------------------------------
 
 def chrome_events(rec: Optional[FlightRecorder] = None,
-                  pid: Optional[int] = None) -> list[dict]:
+                  pid: Optional[int] = None) -> list[dict[str, Any]]:
     """The recorder's events as Chrome trace-event dicts (ts/dur in µs,
     one pid per rank, one tid per category)."""
     rec = rec if rec is not None else recorder
     if rec is None:
         return []
     tids = {c: i for i, c in enumerate(CATEGORIES)}
-    out = []
+    out: list[dict[str, Any]] = []
     for ts_ns, dur_ns, cat, name, rank, args in rec.snapshot():
         ev_pid = pid if pid is not None else (
             rank if rank >= 0 else rec.rank)
-        ev = {
+        ev: dict[str, Any] = {
             "name": name, "cat": cat,
             "ph": "X" if dur_ns is not None else "i",
             "ts": ts_ns / 1000.0,
@@ -457,7 +465,7 @@ def flush(path: Optional[str] = None,
     return path
 
 
-def _json_coerce(obj: Any):
+def _json_coerce(obj: Any) -> Any:
     """Last-resort encoder for event args (numpy scalars → numbers,
     everything else → its repr)."""
     for cast in (int, float):
