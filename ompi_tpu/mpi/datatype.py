@@ -50,11 +50,22 @@ def _native_convertor(nbytes: int):
 
 class ConvertorStats:
     """Pack/unpack call counters — the copy-counting hook transport tests
-    use to assert a zero-copy path really took no pack round-trip."""
+    use to assert a zero-copy path really took no pack round-trip.
 
-    __slots__ = ("pack_calls", "unpack_calls", "pack_bytes", "unpack_bytes")
+    The counters are process-wide, so a *delta* measured against them is
+    only meaningful while nothing else in the process converts — which a
+    full test suite cannot guarantee (leftover worker threads from
+    earlier jobs).  Tests that need attribution register a *listener*
+    instead: ``add_listener(cb)`` gets ``cb(kind, nbytes)`` per
+    pack/unpack ("pack"/"unpack", plan.total), letting the observer
+    match events to its own traffic (e.g. by a unique payload size)
+    race-free.  ``reset()`` deliberately leaves listeners alone."""
+
+    __slots__ = ("pack_calls", "unpack_calls", "pack_bytes",
+                 "unpack_bytes", "_listeners")
 
     def __init__(self) -> None:
+        self._listeners: list = []
         self.reset()
 
     def reset(self) -> None:
@@ -62,6 +73,28 @@ class ConvertorStats:
         self.unpack_calls = 0
         self.pack_bytes = 0
         self.unpack_bytes = 0
+
+    def add_listener(self, cb) -> None:
+        """Register ``cb(kind, nbytes)``; fired per pack/unpack call."""
+        self._listeners.append(cb)
+
+    def remove_listener(self, cb) -> None:
+        try:
+            self._listeners.remove(cb)
+        except ValueError:
+            pass
+
+    def note(self, kind: str, nbytes: int) -> None:
+        """Count one conversion (call sites; one branch when silent)."""
+        if kind == "pack":
+            self.pack_calls += 1
+            self.pack_bytes += nbytes
+        else:
+            self.unpack_calls += 1
+            self.unpack_bytes += nbytes
+        if self._listeners:
+            for cb in list(self._listeners):
+                cb(kind, nbytes)
 
 
 #: process-wide convertor counters (observability hook, not a hot metric)
@@ -454,8 +487,7 @@ class Datatype:
             raise MPIException(
                 f"pack: buffer has {raw.nbytes}B, datatype needs "
                 f"{plan.span}B for count={count}")
-        stats.pack_calls += 1
-        stats.pack_bytes += plan.total
+        stats.note("pack", plan.total)
         if plan.kind == "empty":   # no bytes move: no span (all 3 paths)
             return b""
         _t0 = trace_mod.begin() if trace_mod.active else 0
@@ -491,8 +523,7 @@ class Datatype:
             raise MPIException(
                 f"pack_into: output buffer has {out_arr.nbytes}B, plan "
                 f"packs {plan.total}B")
-        stats.pack_calls += 1
-        stats.pack_bytes += plan.total
+        stats.note("pack", plan.total)
         if plan.kind == "empty":
             return 0
         _t0 = trace_mod.begin() if trace_mod.active else 0
@@ -555,8 +586,7 @@ class Datatype:
             raise MPIException(
                 f"unpack: target buffer has {raw.nbytes}B, layout spans "
                 f"{plan.span}B for count={count}", error_class=15)
-        stats.unpack_calls += 1
-        stats.unpack_bytes += plan.total
+        stats.note("unpack", plan.total)
         if plan.kind == "empty":
             return
         _t0 = trace_mod.begin() if trace_mod.active else 0
