@@ -1,0 +1,68 @@
+#!/usr/bin/env bash
+# Sanitizer build + test of the native layer (convertor.cpp, fastdss.c).
+#
+# Compiles both native sources with -fsanitize=address,undefined to the
+# exact hash-named paths the lazy loader expects, then runs the
+# convertor/pack/dss test subset with the sanitizer runtimes preloaded
+# (python itself is not ASAN-built, so libasan/libubsan must come in
+# via LD_PRELOAD).  Any heap overflow / UB in the C walks fails the
+# run.  The sanitized .so files are deleted afterwards: they only load
+# under the preload, and leaving them in the hash cache would make a
+# normal run silently fall back to numpy.
+#
+# Usage: tools/asan_native.sh  (from the repo root; CI's asan-native job)
+set -euo pipefail
+
+CXX=${CXX:-g++}
+CC=${CC:-gcc}
+SAN="-fsanitize=address,undefined -fno-sanitize-recover=all -O1 -g"
+
+# hash-named destinations, straight from the loader
+eval "$(python - <<'EOF'
+import sysconfig
+from ompi_tpu import _native as n
+soabi = sysconfig.get_config_var("SOABI") or "abi-unknown"
+print(f"CONV_SO={n._so_path()}")
+print(f"FASTDSS_SO={n._hash_name(n._FASTDSS_SRC, f'_fastdss-{soabi}')}")
+print(f"PYINC={sysconfig.get_paths()['include']}")
+EOF
+)"
+
+cleanup() { rm -f "$CONV_SO" "$FASTDSS_SO"; }
+trap cleanup EXIT
+
+echo "== sanitized build: convertor.cpp -> $CONV_SO"
+$CXX $SAN -shared -fPIC -o "$CONV_SO" ompi_tpu/_native/convertor.cpp
+echo "== sanitized build: fastdss.c -> $FASTDSS_SO"
+$CC $SAN -shared -fPIC -I"$PYINC" -o "$FASTDSS_SO" \
+    ompi_tpu/_native/fastdss.c
+
+LIBASAN=$($CXX -print-file-name=libasan.so)
+LIBUBSAN=$($CXX -print-file-name=libubsan.so)
+
+# leak detection off: CPython "leaks" interned objects by design, and
+# the interceptors see every allocation the interpreter ever makes —
+# the signal here is overflow/UB in OUR walks, not interpreter noise
+export ASAN_OPTIONS="detect_leaks=0:abort_on_error=1"
+export UBSAN_OPTIONS="print_stacktrace=1:halt_on_error=1"
+export LD_PRELOAD="$LIBASAN:$LIBUBSAN"
+
+echo "== native layer self-check under ASan/UBSan"
+python - <<'EOF'
+from ompi_tpu import _native
+lib = _native.lib()
+assert lib is not None, "sanitized convertor failed to load"
+assert lib.ompi_tpu_native_abi() == _native._ABI
+fd = _native.fastdss()
+assert fd is not None, "sanitized fastdss failed to load"
+print("sanitized native layer loaded, ABI", _native._ABI)
+EOF
+
+echo "== convertor/pack/dss tests under ASan/UBSan"
+env JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
+    tests/core/test_dss.py \
+    tests/mpi/test_datatype.py \
+    tests/mpi/test_datatype_ext.py \
+    tests/mpi/test_datatype_fuzz.py \
+    tests/mpi/test_pack_plan.py
+echo "== ASan/UBSan native run clean"
