@@ -215,6 +215,7 @@ class _SendState:
         self.peer = peer
         self.payload = payload   # bytes or zero-copy memoryview of user buf
         self.on_done = on_done   # e.g. bsend-pool release
+        self.fl = 0              # flow id (tracing): rides the rndv_send span
 
 
 class _RecvState:
@@ -329,6 +330,12 @@ class _WireWatch(Request):
             if state.on_done:
                 state.on_done()
             state.req.fail(exc)
+
+
+#: flow-id namespace stride: ids are ``rank * stride + local counter`` —
+#: globally unique without coordination (a rank emitting 2^40 frames in
+#: one trace window would wrap the ring thousands of times over first)
+_FLOW_STRIDE = 1 << 40
 
 
 class _Matching:
@@ -617,6 +624,17 @@ class PmlOb1:
             hdr["ep"] = epoch
         if self.incarnation:  # revived senders stamp their own life number
             hdr["si"] = self.incarnation
+        # cross-rank trace correlation: with the flight recorder armed,
+        # every eager/rndv frame carries a globally-unique flow id — the
+        # send-side span and the matching recv-side span both record it,
+        # and tools/trace_export.py turns each pair into a Perfetto flow
+        # arrow (send→recv), making inter-rank waits visible in the
+        # merged timeline.  Cost when tracing is off: one attribute check.
+        fl = 0
+        _fl_t0 = 0
+        if trace_mod.active:
+            hdr["fl"] = fl = self.rank * _FLOW_STRIDE + next(self._ids)
+            _fl_t0 = trace_mod.begin()
         if self._listeners:
             self._emit(EVT_SEND_POST, peer=peer, tag=tag, cid=cid,
                        nbytes=len(payload))
@@ -633,6 +651,10 @@ class PmlOb1:
                     and self.endpoint.try_send_inline(peer, hdr, payload)):
                 self._enqueue_frame(peer, hdr, payload,
                                     _WireWatch(self, sid))
+            if fl and trace_mod.active:
+                trace_mod.complete("pml", "eager_send", _fl_t0,
+                                   rank=self.rank, peer=peer,
+                                   nbytes=len(payload), fl=fl)
         elif eager:
             hdr["t"] = "eager"
             # sendi fast path (≈ pml_ob1_isend.c:89-119): the frame goes
@@ -650,6 +672,10 @@ class PmlOb1:
                 req.complete(None)  # local completion
             else:
                 self._enqueue_frame(peer, hdr, payload, req)
+            if fl and trace_mod.active:
+                trace_mod.complete("pml", "eager_send", _fl_t0,
+                                   rank=self.rank, peer=peer,
+                                   nbytes=len(payload), fl=fl)
         else:
             sid = next(self._ids)
             hdr.update(t="rndv", size=len(payload), sid=sid)
@@ -662,9 +688,11 @@ class PmlOb1:
                 state_req = wire
                 req.complete(None)  # local completion; pool holds the copy
             with self._lock:
-                self._send_states[sid] = _SendState(
+                state = _SendState(
                     state_req, peer, payload,
                     None if mode == "buffered" else on_done)
+                state.fl = fl  # rndv_send span (send worker) records it
+                self._send_states[sid] = state
             self._enqueue_frame(peer, hdr, b"", _WireWatch(self, sid))
         self._drain_events()
         return req
@@ -1503,10 +1531,12 @@ class PmlOb1:
                 del self._recv_states[hdr["rid"]]
         if done:
             if state.trace_t0 and trace_mod.active:
+                _fl = state.src_hdr.get("fl", 0)
                 trace_mod.complete(
                     "pml", "rndv_recv", state.trace_t0, rank=self.rank,
                     peer=state.peer, nbytes=len(state.data),
-                    direct=state.direct)
+                    direct=state.direct,
+                    **({"fl": _fl} if _fl else {}))
             if state.direct:
                 self._complete_direct(state)
             else:
@@ -1530,6 +1560,11 @@ class PmlOb1:
     def _deliver(self, req: RecvRequest, peer: int, hdr: dict,
                  payload: bytes) -> None:
         """Unpack payload into the request's buffer and complete it."""
+        # flow correlation: the recv half of an eager frame's arrow (the
+        # rndv path records fl on its rndv_recv span instead)
+        _fl = (hdr.get("fl", 0)
+               if trace_mod.active and hdr.get("t") == "eager" else 0)
+        _fl_t0 = trace_mod.begin() if _fl else 0
         datatype = req.datatype
         if datatype is not None and req.count is not None:
             expected = req.count * datatype.size
@@ -1579,6 +1614,10 @@ class PmlOb1:
         req.status.count = len(payload) // elem_size
         req.status.count_bytes = len(payload)
         req.complete(out)
+        if _fl and trace_mod.active:
+            trace_mod.complete("pml", "eager_recv", _fl_t0,
+                               rank=self.rank, peer=peer,
+                               nbytes=len(payload), fl=_fl)
 
     # -- send worker (the only thread that writes payloads) ----------------
 
@@ -1631,7 +1670,8 @@ class PmlOb1:
                         trace_mod.complete(
                             "pml", "rndv_send", _t0, rank=self.rank,
                             peer=state.peer, nbytes=len(data),
-                            fragments=len(offs))
+                            fragments=len(offs),
+                            **({"fl": state.fl} if state.fl else {}))
             except Exception:  # noqa: BLE001 — the worker must survive
                 _log.error("send worker: unexpected error\n%s",
                            __import__("traceback").format_exc())

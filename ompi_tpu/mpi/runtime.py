@@ -139,6 +139,12 @@ def init() -> Communicator:
             _trace.attach_pml(pml)
             _trace.instant("runtime", "init", rank=rank, size=size)
 
+        # metrics uplink (independent of the timeline: the always-on
+        # counters are worth scraping with tracing off) — armed when the
+        # owning orted exported a collector URI and the push period is on
+        _trace.start_metrics_push(
+            int(os.environ.get(pmix.ENV_JOBID, "0") or 0), rank)
+
         restarted = bool(os.environ.get("OMPI_TPU_RESTART"))
         if size > 1:
             assert client is not None
@@ -190,6 +196,17 @@ def init() -> Communicator:
         # the revived rank at the finalize barrier instead).
         if size > 1 and not restarted:
             world.barrier()
+        if client is not None:
+            # one-way init-complete notice: the control plane's ready
+            # count (served by the "regcount" probe) is the only signal
+            # that user code is actually running — registration happens
+            # at client construction and even the modex fence precedes
+            # this barrier.  Chaos schedules (daemon=V:kill@reg=N) and
+            # readiness probes key on it; best-effort, never fatal.
+            try:
+                client.ready()
+            except Exception:  # noqa: BLE001 — observability, not init
+                pass
         _state["main_thread"] = threading.get_ident()
         _state["finalized"] = False
         atexit.register(_atexit_finalize)
@@ -254,6 +271,9 @@ def finalize(_collective: bool = True) -> None:
             multihost.shutdown(graceful=not respawn_seen())
             from ompi_tpu.mpi import trace as _trace
 
+            # final full metrics push: a short job's last counter state
+            # still reaches the DVM aggregate before the rank is gone
+            _trace.stop_metrics_push(flush=True)
             if _trace.active:
                 # successful teardown flushes too: the CI smoke job (and
                 # any tpurun --trace run) reads the per-rank dumps after

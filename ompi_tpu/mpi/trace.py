@@ -48,22 +48,52 @@ from contextlib import contextmanager
 from types import FrameType
 from typing import Any, Callable, Iterator, Optional
 
+from ompi_tpu.core.config import VarType, register_var, var_registry
 from ompi_tpu.mpi.mpit import Pvar, PvarClass, pvar_registry
 
 __all__ = [
     "FlightRecorder", "enable", "disable", "enabled", "env_enabled",
     "instant", "begin", "complete", "span", "count", "counters",
     "counters_snapshot", "attach_pml", "flush", "crash_dump",
-    "default_path", "metrics_snapshot", "chrome_events", "ENV_FLAG",
+    "default_path", "metrics_snapshot", "metrics_values",
+    "chrome_events", "ENV_FLAG", "push_period", "start_metrics_push",
+    "stop_metrics_push",
 ]
 
 ENV_FLAG = "OMPI_TPU_TRACE"
 #: external knob: ring capacity in events (default 65536)
 ENV_EVENTS = "OMPI_TPU_TRACE_EVENTS"
+#: set by the owning orted when the metrics uplink is armed: the UDP
+#: ``host:port`` of the daemon's local collector — each rank's pvar
+#: snapshot rides there, then TAG_METRICS up the orted tree
+ENV_METRICS_URI = "OMPI_TPU_METRICS_URI"
 
 #: the timeline categories (→ one Chrome tid per category at export)
 CATEGORIES = ("pml", "btl", "coll", "osc", "io", "ckpt", "datatype",
-              "runtime")
+              "runtime", "errmgr")
+
+register_var("trace", "metrics_push_period", VarType.DOUBLE, 0.0,
+             "seconds between pvar-snapshot pushes from each rank to its "
+             "owning orted's metrics collector (rides TAG_METRICS up the "
+             "daemon tree to the HNP/DVM aggregate).  0 disables the "
+             "uplink; values below 0.25 s are clamped to 0.25 s — a "
+             "sub-quarter-second period would make the observability "
+             "plane a measurable data-plane tax")
+
+#: floor for trace_metrics_push_period (see the var description)
+PUSH_PERIOD_FLOOR = 0.25
+
+
+def push_period() -> float:
+    """The effective metrics-push period: 0.0 when the uplink is off,
+    else the var clamped to ``PUSH_PERIOD_FLOOR``."""
+    try:
+        period = float(var_registry.get("trace_metrics_push_period") or 0)
+    except (TypeError, ValueError):
+        return 0.0
+    if period <= 0:
+        return 0.0
+    return max(PUSH_PERIOD_FLOOR, period)
 
 # ---------------------------------------------------------------------------
 # always-on counters (the pvar-backed fast-path observability)
@@ -494,11 +524,12 @@ def crash_dump(reason: str = "abort") -> Optional[str]:
 _METRIC_RE = re.compile(r"[^a-zA-Z0-9_]")
 
 
-def metrics_snapshot() -> str:
-    """Walk ``pvar_registry`` into a Prometheus-style text block
-    (COUNTER → counter, everything else → gauge; non-numeric and
-    binding-required pvars are skipped — a scraper wants scalars)."""
-    lines: list[str] = []
+def metrics_values() -> dict[str, float]:
+    """Every scalar pvar's current value by name — the numeric walk
+    behind :func:`metrics_snapshot` and the payload of the metrics
+    uplink (non-numeric and binding-required pvars are skipped — a
+    scraper wants scalars)."""
+    out: dict[str, float] = {}
     for name in pvar_registry.names():
         pv = pvar_registry.lookup(name)
         if pv.requires_binding:
@@ -509,6 +540,16 @@ def metrics_snapshot() -> str:
             continue
         if isinstance(v, bool) or not isinstance(v, (int, float)):
             continue
+        out[name] = v
+    return out
+
+
+def metrics_snapshot() -> str:
+    """Walk ``pvar_registry`` into a Prometheus-style text block
+    (COUNTER → counter, everything else → gauge)."""
+    lines: list[str] = []
+    for name, v in metrics_values().items():
+        pv = pvar_registry.lookup(name)
         metric = "ompi_tpu_" + _METRIC_RE.sub("_", name)
         kind = "counter" if pv.klass is PvarClass.COUNTER else "gauge"
         if pv.description:
@@ -516,3 +557,102 @@ def metrics_snapshot() -> str:
         lines.append(f"# TYPE {metric} {kind}")
         lines.append(f"{metric} {v}")
     return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# metrics uplink (rank side): periodic pvar-snapshot pushes to the
+# owning orted's UDP collector — delta-compressed (only changed values
+# ride; every FULL_EVERY-th push resends the whole snapshot so a lost
+# datagram heals), merged at each tree hop, aggregated at the HNP/DVM
+# ---------------------------------------------------------------------------
+
+#: every Nth push is a full snapshot (UDP loss self-heals within N pushes)
+FULL_EVERY = 8
+
+
+class _MetricsPusher:
+    """Background uplink thread: one small UDP datagram per period."""
+
+    def __init__(self, jobid: int, rank: int, uri: str,
+                 period: float) -> None:
+        import socket
+
+        host, port = uri.rsplit(":", 1)
+        self._addr = (host, int(port))
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.jobid = jobid
+        self.rank = rank
+        self.period = period
+        self._last: dict[str, float] = {}
+        self._n = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name=f"trace-metrics-{rank}", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.period):
+            self.push()
+
+    def push(self) -> None:
+        """One uplink datagram now (delta vs the last push, or a full
+        snapshot on the FULL_EVERY cadence).  Best-effort: metrics must
+        never take a rank down."""
+        from ompi_tpu.core import dss
+
+        try:
+            cur = metrics_values()
+            full = self._n % FULL_EVERY == 0
+            vals = (cur if full else
+                    {k: v for k, v in cur.items()
+                     if self._last.get(k) != v})
+            self._n += 1
+            if not vals and not full:
+                return
+            self._sock.sendto(
+                dss.pack(("m1", self.jobid, self.rank, self._n, vals)),
+                self._addr)
+            self._last = cur
+        except Exception:  # noqa: BLE001 — uplink is best-effort
+            pass
+
+    def stop(self, flush: bool = True) -> None:
+        self._stop.set()
+        if flush:
+            self._n = 0          # final push is always a full snapshot
+            self.push()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+_pusher: Optional[_MetricsPusher] = None
+
+
+def start_metrics_push(jobid: int, rank: int,
+                       uri: Optional[str] = None) -> Optional[_MetricsPusher]:
+    """Arm the metrics uplink (idempotent): no-op unless a collector URI
+    is known (``OMPI_TPU_METRICS_URI``, exported by the owning orted)
+    and ``trace_metrics_push_period`` > 0.  Independent of the timeline
+    (:data:`active`): the always-on counters are worth scraping even
+    when span recording is off."""
+    global _pusher
+    uri = uri if uri is not None else os.environ.get(ENV_METRICS_URI)
+    period = push_period()
+    if not uri or ":" not in uri or period <= 0:
+        return None
+    with _lock:
+        if _pusher is None:
+            _pusher = _MetricsPusher(jobid, rank, uri, period)
+        return _pusher
+
+
+def stop_metrics_push(flush: bool = True) -> None:
+    """Disarm the uplink; ``flush`` sends one last full snapshot so a
+    short job's final counter state still reaches the aggregate."""
+    global _pusher
+    with _lock:
+        pusher, _pusher = _pusher, None
+    if pusher is not None:
+        pusher.stop(flush=flush)

@@ -17,6 +17,19 @@ file, then serves job submissions over a line-JSON TCP control channel:
 Jobs run sequentially (one at a time, like orte-dvm's default): each gets
 a fresh PMIx rendezvous sized to its np, a map over the standing nodes,
 and its IOF streamed back to the submitting client.
+
+Observability plane (``--metrics-port N``): a long-lived HTTP endpoint
+on the DVM serving
+
+- ``/metrics`` — Prometheus text: every rank's pvar snapshot (pushed up
+  the orted tree via TAG_METRICS) labeled ``{job=,rank=}``, per-job
+  ``ompi_tpu_job_*`` sums, and the DVM's own process pvars;
+- ``/status`` — JSON: the daemon table (heartbeat ages), the proc table
+  (``lives``, restarts budget, last-metrics age) and the per-job FT
+  event timeline (detect / reap / revive / shrink / escalate).
+
+``--metrics-port 0`` binds an ephemeral port; the bound address is
+written next to the URI file as ``<uri>.metrics``.
 """
 
 from __future__ import annotations
@@ -26,10 +39,12 @@ import os
 import socket
 import threading
 import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 
 from ompi_tpu.core import output
-from ompi_tpu.runtime import rmaps, rml
+from ompi_tpu.core.config import var_registry
+from ompi_tpu.runtime import ftevents, rmaps, rml
 from ompi_tpu.runtime.job import AppContext, Job, ProcState
 from ompi_tpu.runtime.plm import MultiHostLauncher
 
@@ -53,10 +68,15 @@ class DvmHnp(MultiHostLauncher):
     """The standing-VM HNP: daemon tree up once, jobs on demand."""
 
     def __init__(self, plm_name: str = "sim", want_tpu: bool = False,
-                 uri_path: Optional[str] = None, **select_ctx) -> None:
+                 uri_path: Optional[str] = None,
+                 metrics_port: Optional[int] = None, **select_ctx) -> None:
         super().__init__(plm_name=plm_name, want_tpu=want_tpu,
                          stdin_target="none", **select_ctx)
         self._persistent = True
+        self.metrics_port = metrics_port
+        self._http: Optional[ThreadingHTTPServer] = None
+        self.metrics_uri: Optional[str] = None
+        self._started_at = time.time()
         self.uri_path = uri_path or default_uri_path()
         self._job_lock = threading.Lock()     # one job at a time
         self._stopped = threading.Event()
@@ -90,6 +110,11 @@ class DvmHnp(MultiHostLauncher):
         self.rml.register_recv(rml.TAG_STATS_REPLY, self._on_stats_reply)
         self._ctrl = socket.create_server(("127.0.0.1", 0))
         port = self._ctrl.getsockname()[1]
+        # metrics endpoint BEFORE the uri file: clients poll for the uri
+        # file to detect "DVM up", so everything it implies (including
+        # the recorded <uri>.metrics address) must exist by then
+        if self.metrics_port is not None:
+            self._start_metrics_server(self.metrics_port)
         with open(self.uri_path, "w", encoding="utf-8") as f:
             f.write(f"127.0.0.1:{port}\n")
         threading.Thread(target=self._accept_loop, daemon=True).start()
@@ -107,12 +132,21 @@ class DvmHnp(MultiHostLauncher):
         try:
             self._teardown_vm()
         finally:
+            if self._http is not None:
+                http, self._http = self._http, None
+
+                def _close() -> None:
+                    http.shutdown()       # stop serve_forever ...
+                    http.server_close()   # ... THEN release the socket
+
+                threading.Thread(target=_close, daemon=True).start()
             if self._ctrl is not None:
                 self._ctrl.close()
-            try:
-                os.unlink(self.uri_path)
-            except OSError:
-                pass
+            for path in (self.uri_path, self.uri_path + ".metrics"):
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
 
     # -- control channel ---------------------------------------------------
 
@@ -264,43 +298,205 @@ class DvmHnp(MultiHostLauncher):
                                              float(cpu_s))
             return merged
 
+    def _daemon_rows(self) -> list[dict]:
+        vm = self.vm_job
+        if vm is None:
+            return []
+        # only meaningful with the heartbeat layer armed: without beats
+        # every watched daemon's age grows forever and the column reads
+        # as a fleet of silent daemons
+        hb_on = float(var_registry.get("rml_heartbeat_period") or 0) > 0
+        hb_ages = (self._hb_monitor.ages()
+                   if hb_on and self._hb_monitor is not None else {})
+        rows = []
+        for i, n in enumerate(vm.nodes):
+            row = {"vpid": i + 1, "host": n.name, "slots": n.slots,
+                   "chips": (len(n.chips) if n.chips else 0),
+                   "pid": (self._daemon_popen[i].pid
+                           if i < len(self._daemon_popen) else None)}
+            if i + 1 in hb_ages:
+                row["hb_age_s"] = round(hb_ages[i + 1], 2)
+            rows.append(row)
+        return rows
+
+    def _proc_rows(self, job, usage: dict[int, tuple]) -> list[dict]:
+        metrics_ages = self.metrics_agg.ages(job.jobid)
+        limit = int(var_registry.get("errmgr_max_restarts") or 0)
+        procs = []
+        for p in job.procs:
+            row = {
+                "rank": p.rank, "state": p.state.value,
+                "host": p.node.name if p.node else "?",
+                "local_rank": p.local_rank,
+                # lives is the monotone revive count (the announced
+                # incarnation); restarts is the governor's crash-loop
+                # BUDGET counter, reset whenever a life earns its
+                # uptime — it reads 0 for a rank revived many times
+                "lives": p.lives,
+                "restarts": p.restarts,
+                "restarts_budget_left": max(0, limit - p.restarts),
+                "exit_code": p.exit_code,
+            }
+            if p.rank in metrics_ages:
+                # age of the rank's last pvar push through the uplink —
+                # a live rank whose age keeps growing has a stalled
+                # metrics plane (or a stalled rank)
+                row["metrics_age_s"] = round(metrics_ages[p.rank], 2)
+            if p.rank in usage:      # orte-top columns, live ranks
+                pid, rss, cpu_s = usage[p.rank]
+                row.update(pid=pid, rss_mb=round(rss / 2**20, 1),
+                           cpu_s=round(cpu_s, 2))
+            procs.append(row)
+        return procs
+
     def _ps_table(self) -> dict:
         vm = self.vm_job
         job = self._cur_job
-        nodes = [{"vpid": i + 1, "host": n.name, "slots": n.slots,
-                  "chips": (len(n.chips) if n.chips else 0),
-                  "pid": (self._daemon_popen[i].pid
-                          if i < len(self._daemon_popen) else None)}
-                 for i, n in enumerate(vm.nodes)] if vm else []
         procs = []
         if job is not None and job is not vm:
             usage = self._collect_stats() if any(
                 p.state == ProcState.RUNNING for p in job.procs) else {}
-            for p in job.procs:
-                row = {
-                    "rank": p.rank, "state": p.state.value,
-                    "host": p.node.name if p.node else "?",
-                    "local_rank": p.local_rank,
-                    # lives is the monotone revive count (the announced
-                    # incarnation); restarts is the governor's crash-loop
-                    # BUDGET counter, reset whenever a life earns its
-                    # uptime — it reads 0 for a rank revived many times
-                    "lives": p.lives,
-                    "restarts": p.restarts,
-                    "exit_code": p.exit_code,
-                }
-                if p.rank in usage:      # orte-top columns, live ranks
-                    pid, rss, cpu_s = usage[p.rank]
-                    row.update(pid=pid, rss_mb=round(rss / 2**20, 1),
-                               cpu_s=round(cpu_s, 2))
-                procs.append(row)
-        return {"daemons": nodes,
+            procs = self._proc_rows(job, usage)
+        return {"daemons": self._daemon_rows(),
                 "current_job": (None if job is None or job is vm else {
                     "jobid": job.jobid,
                     "argv": job.apps[0].argv,
                     "np": job.np,
                     "procs": procs}),
                 "history": self._history[-20:]}
+
+    # -- observability plane (≈ a standing Prometheus exporter) ------------
+
+    def _start_metrics_server(self, port: int) -> None:
+        """The long-lived scrape endpoint: /metrics + /status."""
+        hnp = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                if path == "/metrics":
+                    body = hnp._metrics_text().encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif path == "/status":
+                    body = json.dumps(hnp._status_doc()).encode()
+                    ctype = "application/json"
+                elif path == "/":
+                    body = b"ompi_tpu dvm: /metrics /status\n"
+                    ctype = "text/plain"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args) -> None:
+                pass  # scrapes every few seconds must not spam stderr
+
+        self._http = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+        self._http.daemon_threads = True
+        bound = self._http.server_address[1]
+        self.metrics_uri = f"http://127.0.0.1:{bound}"
+        threading.Thread(target=self._http.serve_forever,
+                         name="dvm-metrics-http", daemon=True).start()
+        # --metrics-port 0 binds an ephemeral port: record the actual
+        # address where clients (tests, dashboards) can find it
+        try:
+            with open(self.uri_path + ".metrics", "w",
+                      encoding="utf-8") as f:
+                f.write(self.metrics_uri + "\n")
+        except OSError:
+            pass
+        _log.verbose(0, "metrics endpoint: %s/metrics  %s/status",
+                     self.metrics_uri, self.metrics_uri)
+
+    def _metrics_text(self) -> str:
+        """Prometheus text: the per-job/per-rank aggregate first, then
+        DVM-level gauges, then this process's own pvars (unlabeled).
+
+        The own-pvar section EXCLUDES any metric name the aggregate
+        already emitted: the exposition format forbids a second # TYPE
+        line (and a second, non-contiguous sample group) for a name —
+        a real scraper would reject the whole page, and the HNP's own
+        copies of rank counters are all-zero noise anyway."""
+        from ompi_tpu.mpi import trace as trace_mod
+
+        agg_text = self.metrics_agg.prometheus()
+        agg_names = {line.split("{", 1)[0]
+                     for line in agg_text.splitlines()
+                     if line and not line.startswith("#")}
+        own_lines = []
+        skip_until_next_metric = False
+        for line in trace_mod.metrics_snapshot().splitlines():
+            if line.startswith("#"):
+                name = line.split()[2] if len(line.split()) > 2 else ""
+                skip_until_next_metric = name in agg_names
+            else:
+                skip_until_next_metric = \
+                    line.split("{", 1)[0].split(" ", 1)[0] in agg_names
+            if not skip_until_next_metric:
+                own_lines.append(line)
+        own = "\n".join(own_lines) + ("\n" if own_lines else "")
+        dvm_lines = [
+            "# TYPE ompi_tpu_dvm_jobs_completed_total counter",
+            f"ompi_tpu_dvm_jobs_completed_total {len(self._history)}",
+            "# TYPE ompi_tpu_dvm_daemons gauge",
+            f"ompi_tpu_dvm_daemons "
+            f"{len(self.vm_job.nodes) if self.vm_job else 0}",
+            "# TYPE ompi_tpu_dvm_uptime_seconds gauge",
+            f"ompi_tpu_dvm_uptime_seconds "
+            f"{time.time() - self._started_at:.1f}",
+            "# TYPE ompi_tpu_dvm_ft_events_total counter",
+            f"ompi_tpu_dvm_ft_events_total {ftevents.log.total()}",
+        ]
+        return agg_text + "\n".join(dvm_lines) + "\n" + own
+
+    def _status_doc(self) -> dict:
+        """The /status JSON: daemon table (heartbeat ages), per-job proc
+        table (lives, restarts budget, last-metrics age) and the FT
+        event timeline per job."""
+        vm = self.vm_job
+        job = self._cur_job
+        now = time.time()
+        jobids = set(self.metrics_agg.jobids())
+        jobids.update(h["jobid"] for h in self._history)
+        current = None if job is None or job is vm else job
+        if current is not None:
+            jobids.add(current.jobid)
+        by_jobid = {h["jobid"]: h for h in self._history}
+        jobs = []
+        for jobid in sorted(jobids):
+            entry: dict = {"jobid": jobid}
+            # history wins over _cur_job: the launcher keeps its last
+            # job object after completion, and a finished job must not
+            # read as "running" between submissions
+            if jobid in by_jobid:
+                h = by_jobid[jobid]
+                entry["state"] = "completed"
+                entry["rc"] = h["rc"]
+                entry["np"] = h["np"]
+                entry["argv"] = h["argv"]
+            elif current is not None and jobid == current.jobid:
+                entry["state"] = "running"
+                entry["np"] = current.np
+                entry["argv"] = current.apps[0].argv
+                entry["procs"] = self._proc_rows(current, {})
+            entry["metrics_age_s"] = {
+                str(r): round(a, 2)
+                for r, a in self.metrics_agg.ages(jobid, now=now).items()}
+            entry["ft_events"] = ftevents.log.snapshot(jobid)
+            jobs.append(entry)
+        return {
+            "uptime_s": round(now - self._started_at, 1),
+            "daemons": self._daemon_rows(),
+            "current_jobid": (None if current is None
+                              or current.jobid in by_jobid
+                              else current.jobid),
+            "jobs": jobs,
+            "ft_events_total": ftevents.log.total(),
+        }
 
 
 # -- client side -----------------------------------------------------------

@@ -261,11 +261,15 @@ class ErrmgrAbort(Component):
     PRIORITY = 10
 
     def proc_failed(self, launcher: "LocalLauncher", job: Job, proc: Proc) -> None:
+        from ompi_tpu.runtime import ftevents
+
         if job.aborted_proc is None:
             job.aborted_proc = proc
             job.abort_reason = (
                 f"rank {proc.rank} {proc.state.value} "
                 f"(exit code {proc.exit_code})")
+        ftevents.record("abort", jobid=job.jobid, rank=proc.rank,
+                        lives=proc.lives, exit_code=proc.exit_code)
         _log.verbose(1, "aborting job %d: %s", job.jobid, job.abort_reason)
         launcher.kill_job(job, exclude=proc)
 
@@ -287,8 +291,12 @@ class ErrmgrRespawn(Component):
 
     def proc_failed(self, launcher: "LocalLauncher", job: Job,
                     proc: Proc) -> None:
+        from ompi_tpu.runtime import ftevents
         from ompi_tpu.runtime.notifier import Severity, notify
 
+        ftevents.record("detect", jobid=job.jobid, rank=proc.rank,
+                        lives=proc.lives, rung="respawn",
+                        exit_code=proc.exit_code)
         limit = var_registry.get("errmgr_max_restarts")
         # both shipped launchers revive (local fork/exec + daemon tree via
         # TAG_RESPAWN); a custom launcher without the hook degrades to
@@ -364,12 +372,15 @@ class ErrmgrNotify(Component):
 
     def proc_failed(self, launcher: "LocalLauncher", job: Job,
                     proc: Proc) -> None:
+        from ompi_tpu.runtime import ftevents
         from ompi_tpu.runtime.notifier import Severity, notify
 
         reason = (f"rank {proc.rank} {proc.state.value} "
                   f"(exit code {proc.exit_code})")
         _log.verbose(1, "notify policy: %s; propagating to survivors",
                      reason)
+        ftevents.record("detect", jobid=job.jobid, rank=proc.rank,
+                        lives=proc.lives, rung="notify", reason=reason)
         _propagate_failure(launcher, proc, reason)
         notify(Severity.WARN, "rank-failed",
                f"job {job.jobid} {reason}; survivors notified "
@@ -398,6 +409,10 @@ class ErrmgrSelfheal(Component):
 
         reason = (f"rank {proc.rank} {proc.state.value} "
                   f"(exit code {proc.exit_code})")
+        from ompi_tpu.runtime import ftevents
+
+        ftevents.record("detect", jobid=job.jobid, rank=proc.rank,
+                        lives=proc.lives, rung="selfheal", reason=reason)
         # rung 1 preamble is ALWAYS the notify propagation: survivors'
         # detectors learn the death now (pending ops toward the corpse
         # fail fast instead of stalling for the revive), and flip the
@@ -446,6 +461,7 @@ class ErrmgrSelfheal(Component):
         impossible (every other rank also failed, or there is no control
         plane to propagate through)."""
         from ompi_tpu.mpi import trace as trace_mod
+        from ompi_tpu.runtime import ftevents
         from ompi_tpu.runtime.notifier import Severity, notify
 
         trace_mod.count("errmgr_selfheal_escalations_total")
@@ -453,6 +469,9 @@ class ErrmgrSelfheal(Component):
                     in (ProcState.RUNNING, ProcState.TERMINATED)]
         can_shrink = (bool(carriers)
                       and getattr(launcher, "server", None) is not None)
+        ftevents.record("escalate", jobid=job.jobid, rank=proc.rank,
+                        lives=proc.lives,
+                        to="shrink" if can_shrink else "abort", why=why)
         if trace_mod.active:
             trace_mod.instant("errmgr", "selfheal_escalate", rank=proc.rank,
                               to="shrink" if can_shrink else "abort")

@@ -1,0 +1,100 @@
+"""FT event timeline — the structured record of the failure machinery.
+
+Every rung of the errmgr/selfheal ladder (detect, reap, revive, shrink,
+escalate, abort) and the containment plane (daemon loss, re-parenting)
+records one structured event here, stamped with wall-clock, monotonic
+time, jobid, rank and incarnation — so a kill-storm is readable AFTER
+the fact: the DVM serves the log per job on its ``/status`` endpoint,
+and each event doubles as a flight-recorder instant (category
+``errmgr``) when tracing is armed.
+
+The log is a bounded ring (oldest events fall off first, like the trace
+ring) and lives in the launcher/HNP process — the only place every
+detection source converges.  Recording is lock-cheap (one deque append
+under a lock) and must stay non-blocking: several record sites run on
+RML link reader threads (see the ``reader-thread`` lint checker).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Optional
+
+__all__ = ["FtEventLog", "log", "record", "KINDS"]
+
+#: the event vocabulary — the ladder rungs plus the containment plane
+KINDS = ("detect", "reap", "revive", "shrink", "escalate", "abort",
+         "daemon_lost", "reparent", "finished")
+
+
+class FtEventLog:
+    """Bounded, thread-safe timeline of FT events."""
+
+    def __init__(self, capacity: int = 1024) -> None:
+        self._lock = threading.Lock()
+        self._events: collections.deque = collections.deque(
+            maxlen=max(16, capacity))
+        self._n = 0
+
+    def record(self, kind: str, jobid: int = 0, rank: int = -1,
+               lives: int = 0, **info: Any) -> dict:
+        """Append one event; returns the record (tests/tools read it).
+        Also emits an ``errmgr`` trace instant when tracing is armed, so
+        the merged Perfetto timeline shows the FT plane inline with the
+        transport spans."""
+        ev = {
+            "seq": 0,                     # stamped under the lock below
+            "wall": time.time(),
+            "mono_ns": time.monotonic_ns(),
+            "kind": kind,
+            "jobid": int(jobid),
+            "rank": int(rank),
+            "lives": int(lives),
+        }
+        if info:
+            ev["info"] = {k: v for k, v in info.items() if v is not None}
+        with self._lock:
+            self._n += 1
+            ev["seq"] = self._n
+            self._events.append(ev)
+        from ompi_tpu.mpi import trace as trace_mod
+
+        if trace_mod.active:
+            trace_mod.instant("errmgr", f"ft:{kind}", rank=rank,
+                              jobid=jobid, lives=lives,
+                              **(ev.get("info") or {}))
+        return ev
+
+    def snapshot(self, jobid: Optional[int] = None) -> list[dict]:
+        """Events oldest-first, optionally filtered to one job (events
+        recorded with jobid 0 — pre-job containment noise — ride along
+        with every job filter: a daemon loss belongs to any timeline
+        that overlaps it)."""
+        with self._lock:
+            events = list(self._events)
+        if jobid is None:
+            return events
+        return [e for e in events
+                if e["jobid"] == int(jobid) or e["jobid"] == 0]
+
+    def total(self) -> int:
+        """Events ever recorded (including those the ring forgot)."""
+        with self._lock:
+            return self._n
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+
+#: process-global log (the launcher/HNP is one process; tests may make
+#: their own FtEventLog instances)
+log = FtEventLog()
+
+
+def record(kind: str, jobid: int = 0, rank: int = -1, lives: int = 0,
+           **info: Any) -> dict:
+    """Record one FT event on the process-global timeline."""
+    return log.record(kind, jobid=jobid, rank=rank, lives=lives, **info)

@@ -151,11 +151,15 @@ class LocalLauncher:
         """errmgr/respawn hook: revive a failed rank in place (same rank,
         same env plus OMPI_TPU_RESTART=<n>).  The running reap loop picks
         the new child up; the PMIx server counts the rank live again."""
+        from ompi_tpu.runtime import ftevents
+
         proc.restarts += 1   # budget burn (governor may reset it)
         proc.lives += 1      # identity: monotone, survives budget resets
         proc.exit_code = None
         if not self._launch_proc(job, proc):
             return False
+        ftevents.record("revive", jobid=job.jobid, rank=proc.rank,
+                        lives=proc.lives)
         if self.server is not None:
             self.server.proc_revived(proc.rank, proc.lives)
         with self._kill_lock:
@@ -297,8 +301,12 @@ class LocalLauncher:
             p = self._popen.get(rank)
         if p is None or p.poll() is not None:
             return
+        from ompi_tpu.runtime import ftevents
+
         _log.verbose(1, "reaping reported-dead rank %d (pid %d): %s",
                      rank, p.pid, reason or "gossip-declared")
+        ftevents.record("reap", rank=rank,
+                        reason=reason or "gossip-declared")
         try:
             os.killpg(p.pid, signal.SIGKILL)
         except (ProcessLookupError, PermissionError):

@@ -1,0 +1,277 @@
+"""Metrics uplink — the control-plane half of the live observability
+plane.
+
+Each app rank pushes its pvar snapshot (``trace.metrics_values()``,
+delta-compressed) over UDP to its owning orted's :class:`MetricsCollector`
+every ``trace_metrics_push_period`` seconds.  Each orted merges its local
+ranks with whatever its tree children pushed up (``TAG_METRICS`` is a
+one-hop message delivered at every level, not an HNP-only ``send_up``)
+and forwards ONE merged delta per period toward the root.  The HNP/DVM
+folds the stream into a :class:`MetricsAggregate` keyed by jobid and
+rank — what the DVM's ``/metrics`` scrape endpoint and ``tpurun
+--dvm-ps``'s last-metrics-age column read.
+
+Wire shapes:
+
+- rank → orted (UDP datagram): ``("m1", jobid, rank, push_n, {name: value})``
+  — ``push_n`` fences reordered/stale datagrams; every
+  ``trace.FULL_EVERY``-th push is a full snapshot so UDP loss heals.
+- orted → parent (``TAG_METRICS``, one hop):
+  ``{jobid: {rank: [wall_ts, {name: value}]}}`` — values are cumulative
+  counter readings (NOT increments), so a per-hop merge is a plain
+  ``dict.update`` per rank and double-delivery cannot double-count.
+
+Thread-context rules: the TAG_METRICS handler runs on an RML link
+reader thread — :func:`merge_hop` is dict surgery under one lock, no
+RPC/sleep/subprocess (see the ``reader-thread`` lint checker).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from ompi_tpu.core import dss, output
+
+__all__ = ["merge_hop", "MetricsCollector", "MetricsAggregate",
+           "AGG_METRICS"]
+
+_log = output.get_stream("metrics")
+
+#: the per-job aggregated-metric name family: counters the DVM scrape
+#: endpoint ADDITIONALLY exports summed across a job's ranks as
+#: ``ompi_tpu_job_<name>{job="<jobid>"}``.  Every entry must name a
+#: ``trace._COUNTER_SPECS`` counter — the ompi-lint ``pvar-spec``
+#: checker cross-checks both directions so a renamed counter cannot
+#: silently vanish from the scrape surface.
+AGG_METRICS = (
+    "pml_zero_copy_sends_total",
+    "pml_packed_sends_total",
+    "btl_shm_publish_total",
+    "btl_shm_drained_total",
+    "coll_shm_fanin_total",
+    "coll_shm_fanout_total",
+    "coll_shm_fallback_total",
+    "ft_rank_deaths_total",
+    "ft_gossip_beats_total",
+    "ft_fenced_frames_total",
+    "errmgr_selfheal_revives_total",
+    "errmgr_selfheal_escalations_total",
+)
+
+#: jobs kept in the aggregate before the oldest (by last update) fall off
+MAX_JOBS = 64
+
+#: a per-(job, rank) stale-datagram fence older than this is itself
+#: stale: accept the "regressed" sequence (a revived rank whose first
+#: low-numbered pushes were lost would otherwise be fenced until its
+#: push counter climbed past the dead life's)
+_FENCE_EXPIRE_S = 10.0
+
+#: TAG_METRICS payload / aggregate row: {jobid: {rank: [ts, {name: val}]}}
+HopPayload = dict[int, dict[int, list]]
+
+
+def merge_hop(pending: HopPayload, payload: Any) -> None:
+    """Fold one TAG_METRICS payload (or one rank datagram already in hop
+    shape) into ``pending`` in place — the per-hop merge.  Values are
+    cumulative readings, so the merge is last-writer-wins per counter
+    with the freshest wall timestamp kept per rank."""
+    if not isinstance(payload, dict):
+        return
+    for jobid, ranks in payload.items():
+        if not isinstance(ranks, dict):
+            continue
+        for rank, row in ranks.items():
+            try:
+                key, rkey = int(jobid), int(rank)
+                ts, vals = float(row[0]), dict(row[1])
+            except (TypeError, ValueError, IndexError):
+                continue
+            cur = pending.setdefault(key, {}).setdefault(rkey, [0.0, {}])
+            cur[0] = max(cur[0], ts)
+            cur[1].update(vals)
+
+
+class MetricsCollector:
+    """orted-side uplink stage: local ranks' UDP datagrams + child
+    daemons' TAG_METRICS payloads, merged and drained one hop up per
+    period.
+
+    The caller owns the cadence (``send_fn`` is invoked from an internal
+    timer thread every ``period`` seconds with the drained pending
+    payload) and wires :meth:`on_child_payload` to the TAG_METRICS
+    handler.
+    """
+
+    def __init__(self, period: float,
+                 send_fn: Callable[[HopPayload], None],
+                 host: str = "127.0.0.1") -> None:
+        self.period = period
+        self._send_fn = send_fn
+        self._lock = threading.Lock()
+        self._pending: HopPayload = {}
+        #: per (jobid, rank): (last accepted datagram seq, monotonic
+        #: accept time) — the reorder fence and its expiry clock
+        self._seq: dict[tuple[int, int], tuple[int, float]] = {}
+        self._stop = threading.Event()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.bind((host, 0))
+        self._sock.settimeout(0.5)
+        self.uri = f"{host}:{self._sock.getsockname()[1]}"
+        threading.Thread(target=self._recv_datagrams,
+                         name="metrics-recv", daemon=True).start()
+        threading.Thread(target=self._push_up,
+                         name="metrics-push", daemon=True).start()
+
+    # -- inputs -----------------------------------------------------------
+
+    def _recv_datagrams(self) -> None:
+        while not self._stop.is_set():
+            try:
+                blob, _addr = self._sock.recvfrom(1 << 16)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                msg = dss.unpack(blob, n=1)[0]
+                tag, jobid, rank, push_n, vals = msg
+                if tag != "m1":
+                    continue
+                key = (int(jobid), int(rank))
+                push_n = int(push_n)
+                vals = dict(vals)
+            except Exception:  # noqa: BLE001 — garbage datagram: drop
+                # anything may write to a reused ephemeral UDP port; a
+                # bad-typed field must not kill the collector thread
+                continue
+            now = time.monotonic()
+            with self._lock:
+                last, t_last = self._seq.get(key, (0, 0.0))
+                # reordered/stale datagrams regress cumulative counters —
+                # fence them, EXCEPT: a restarted life's seq starts over
+                # (push_n <= 2), and a fence older than _FENCE_EXPIRE_S
+                # is stale itself (a revived rank whose first datagrams
+                # were lost must not be blacked out until its push_n
+                # climbs past the dead life's)
+                if (push_n <= last and push_n > 2
+                        and now - t_last < _FENCE_EXPIRE_S):
+                    continue
+                self._seq[key] = (push_n, now)
+                merge_hop(self._pending,
+                          {key[0]: {key[1]: [time.time(), vals]}})
+
+    def on_child_payload(self, payload: Any) -> None:
+        """TAG_METRICS from a tree child (RML reader thread — merge
+        only, no blocking work)."""
+        with self._lock:
+            merge_hop(self._pending, payload)
+
+    # -- drain ------------------------------------------------------------
+
+    def _push_up(self) -> None:
+        while not self._stop.wait(self.period):
+            payload = self.drain()
+            if not payload:
+                continue
+            try:
+                self._send_fn(payload)
+            except Exception:  # noqa: BLE001 — keep the merged delta:
+                # an orphaned-window send failure must not lose it
+                with self._lock:
+                    merged = self._pending
+                    self._pending = payload
+                    merge_hop(self._pending, merged)
+
+    def drain(self) -> HopPayload:
+        """Take the pending merged delta (callers push it one hop up)."""
+        with self._lock:
+            payload, self._pending = self._pending, {}
+        return payload
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class MetricsAggregate:
+    """HNP/DVM-side terminal stage: the cumulative per-job, per-rank
+    counter table the scrape endpoint and ``--dvm-ps`` read."""
+
+    def __init__(self, max_jobs: int = MAX_JOBS) -> None:
+        self._lock = threading.Lock()
+        self._jobs: HopPayload = {}
+        self._max_jobs = max_jobs
+
+    def merge(self, payload: Any) -> None:
+        """Fold one TAG_METRICS payload in (RML reader thread safe)."""
+        with self._lock:
+            merge_hop(self._jobs, payload)
+            if len(self._jobs) > self._max_jobs:
+                by_age = sorted(
+                    self._jobs,
+                    key=lambda j: max((r[0] for r in
+                                       self._jobs[j].values()),
+                                      default=0.0))
+                for jobid in by_age[:len(self._jobs) - self._max_jobs]:
+                    del self._jobs[jobid]
+
+    def snapshot(self) -> HopPayload:
+        with self._lock:
+            return {j: {r: [row[0], dict(row[1])]
+                        for r, row in ranks.items()}
+                    for j, ranks in self._jobs.items()}
+
+    def jobids(self) -> list[int]:
+        """Known jobids without copying the counter tables (what a
+        /status render wants — snapshot() deep-copies everything)."""
+        with self._lock:
+            return list(self._jobs)
+
+    def ages(self, jobid: int,
+             now: Optional[float] = None) -> dict[int, float]:
+        """Per-rank seconds since the last metrics update for ``jobid``
+        (the --dvm-ps last-metrics-age column)."""
+        now = time.time() if now is None else now
+        with self._lock:
+            ranks = self._jobs.get(int(jobid), {})
+            return {r: max(0.0, now - row[0]) for r, row in ranks.items()}
+
+    def prometheus(self) -> str:
+        """The aggregate as Prometheus text: one per-rank series per
+        counter (``ompi_tpu_<name>{job=,rank=}``) plus the per-job
+        ``AGG_METRICS`` sums (``ompi_tpu_job_<name>{job=}``)."""
+        snap = self.snapshot()
+        lines: list[str] = []
+        typed: set[str] = set()
+
+        def _type_line(metric: str) -> None:
+            if metric not in typed:
+                typed.add(metric)
+                kind = ("counter" if metric.endswith("_total")
+                        else "gauge")
+                lines.append(f"# TYPE {metric} {kind}")
+
+        for jobid in sorted(snap):
+            for rank in sorted(snap[jobid]):
+                _ts, vals = snap[jobid][rank]
+                for name in sorted(vals):
+                    metric = f"ompi_tpu_{name}"
+                    _type_line(metric)
+                    lines.append(
+                        f'{metric}{{job="{jobid}",rank="{rank}"}} '
+                        f"{vals[name]}")
+        for jobid in sorted(snap):
+            for name in AGG_METRICS:
+                total = sum(row[1].get(name, 0)
+                            for row in snap[jobid].values())
+                metric = f"ompi_tpu_job_{name}"
+                _type_line(metric)
+                lines.append(f'{metric}{{job="{jobid}"}} {total}')
+        return "\n".join(lines) + ("\n" if lines else "")

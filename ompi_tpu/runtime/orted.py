@@ -107,6 +107,25 @@ class Orted:
         self.node.register_recv(rml.TAG_KILL_RANK, self._on_kill_rank)
         self._spec: Optional[dict] = None
         self._my_rows: dict[int, tuple[int, Optional[int]]] = {}
+        # metrics uplink: when trace_metrics_push_period > 0 this daemon
+        # runs a UDP collector its local ranks push pvar snapshots to
+        # (the URI is exported into every rank's env), merges them with
+        # child daemons' TAG_METRICS payloads, and forwards one combined
+        # delta per period ONE hop up — per-level aggregation, exactly
+        # the HiCCL per-level-visibility argument applied to metrics
+        self._metrics = None
+        from ompi_tpu.mpi import trace as trace_mod
+
+        period = trace_mod.push_period()
+        if period > 0:
+            from ompi_tpu.runtime.metrics import MetricsCollector
+
+            self._metrics = MetricsCollector(
+                period, lambda payload: self.node.send_hop(
+                    rml.TAG_METRICS, payload))
+            self.node.register_recv(
+                rml.TAG_METRICS,
+                lambda o, p: self._metrics.on_child_payload(p))
         self.node.register_recv(rml.TAG_SHUTDOWN,
                                 lambda o, p: self._done.set())
         # lifeline: if the HNP or my tree parent vanishes, my ranks'
@@ -285,6 +304,12 @@ class Orted:
             env["OMPI_TPU_FAKE_HOST"] = self.fake_host
         if restarts:
             env["OMPI_TPU_RESTART"] = str(restarts)
+        if self._metrics is not None:
+            # ranks and their orted share a host, so loopback always
+            # reaches the collector — no remote-address discovery needed
+            from ompi_tpu.mpi import trace as trace_mod
+
+            env[trace_mod.ENV_METRICS_URI] = self._metrics.uri
         want_stdin = spec.get("stdin_rank") in ("all", rank)
         try:
             p = subprocess.Popen(
@@ -318,6 +343,14 @@ class Orted:
         with self._lock:
             self._spec = spec
             self._my_rows = {r: (lr, ch) for r, lr, ch in mine}
+        # deterministic chaos, barrier-keyed: a plan entry
+        # ``daemon=<vpid>:kill@reg=N`` arms a self-SIGKILL that fires
+        # only once N ranks have registered with the job's PMIx server
+        # (+ an ``after=`` grace) — the kill cannot land mid-init on a
+        # slow box the way a fixed kill@t could
+        from ompi_tpu.testing import faultinject
+
+        faultinject.arm_daemon_launch(self.vpid, spec.get("env") or {})
         for rank, local_rank, chip in mine:
             self._spawn_rank(spec, rank, local_rank, chip)
         # replay stdin that raced ahead of the launch xcast.  The replay
@@ -445,6 +478,8 @@ class Orted:
     def run(self) -> int:
         self._done.wait()
         self._on_kill(0, None)   # stragglers die with the daemon
+        if self._metrics is not None:
+            self._metrics.close()
         self.node.close()
         return 0
 

@@ -161,6 +161,12 @@ class MultiHostLauncher:
         self._persistent = False          # DVM mode: VM outlives jobs
         self._vm_stop = threading.Event()
         self._hb_monitor: Optional[rml.HeartbeatMonitor] = None
+        # terminal stage of the metrics uplink: TAG_METRICS deltas from
+        # the daemon tree fold in here, keyed by jobid and rank — what
+        # the DVM scrape endpoint and --dvm-ps read
+        from ompi_tpu.runtime.metrics import MetricsAggregate
+
+        self.metrics_agg = MetricsAggregate()
 
     # -- state handlers ----------------------------------------------------
 
@@ -194,6 +200,8 @@ class MultiHostLauncher:
             lambda o, p: self._on_proc_exit(self._cur_job, p))
         self.rml.register_recv(rml.TAG_ORPHANED, self._on_orphaned)
         self.rml.register_recv(rml.TAG_REPARENT_ACK, self._on_reparent_ack)
+        self.rml.register_recv(rml.TAG_METRICS,
+                               lambda o, p: self.metrics_agg.merge(p))
         self.rml.on_peer_lost = self._on_daemon_lost
         # liveness beats (rml_heartbeat_period > 0): any beat — or any
         # other up-traffic from the daemon — refreshes its clock; silence
@@ -400,6 +408,8 @@ class MultiHostLauncher:
         the daemon owning the rank relaunches it with OMPI_TPU_RESTART.
         Spawn failure on the daemon surfaces as another TAG_PROC_EXIT
         (exit 127), which re-enters the errmgr until restarts exhaust."""
+        from ompi_tpu.runtime import ftevents
+
         proc.restarts += 1   # budget burn (governor may reset it)
         proc.lives += 1      # identity: monotone, survives budget resets
         try:
@@ -407,6 +417,8 @@ class MultiHostLauncher:
         except Exception as e:  # noqa: BLE001 — tree may be tearing down
             _log.error("respawn xcast for rank %d failed: %r", proc.rank, e)
             return False
+        ftevents.record("revive", jobid=job.jobid, rank=proc.rank,
+                        lives=proc.lives)
         # only a successful revival order flips the state — a failed xcast
         # must leave ABORTED so _on_proc_exit records the exit (the job
         # would otherwise wait forever on a rank nobody revived)
@@ -468,6 +480,11 @@ class MultiHostLauncher:
                 if self._lost_daemon is None:
                     self._lost_daemon = vpid
                 self._cv.notify_all()
+        from ompi_tpu.runtime import ftevents
+
+        ftevents.record("daemon_lost",
+                        jobid=(job.jobid if reparent and job else 0),
+                        vpid=vpid, contained=bool(reparent))
         if reparent:
             # confine the loss: the dead daemon's live children re-wire
             # to their grandparent instead of applying the lifeline rule
@@ -534,8 +551,14 @@ class MultiHostLauncher:
         except OSError as e:
             _log.error("adoption order under %d failed: %r", adopter, e)
             return
+        from ompi_tpu.runtime import ftevents
         from ompi_tpu.runtime.notifier import Severity, notify
 
+        ftevents.record(
+            "reparent",
+            jobid=(self._cur_job.jobid if self._cur_job else 0),
+            vpid=dead_vpid, adopter=adopter,
+            orphans=[v for v, _u in adoptees])
         notify(Severity.WARN, "daemon-reparent",
                f"orted vpid {dead_vpid} died mid-tree; orphans "
                f"{[v for v, _u in adoptees]} re-parented under vpid "
@@ -554,8 +577,13 @@ class MultiHostLauncher:
 
     def _reap_reported(self, rank: int, reason: str) -> None:
         """Order the owning daemon to SIGKILL one reported-hung rank."""
+        from ompi_tpu.runtime import ftevents
+
         _log.verbose(1, "reaping reported-dead rank %d via the tree: %s",
                      rank, reason or "gossip-declared")
+        ftevents.record(
+            "reap", jobid=(self._cur_job.jobid if self._cur_job else 0),
+            rank=rank, reason=reason or "gossip-declared")
         try:
             self.rml.xcast(rml.TAG_KILL_RANK, rank)
         except Exception as e:  # noqa: BLE001 — tree may be tearing down

@@ -25,7 +25,8 @@ from typing import Any, Callable, Optional
 from ompi_tpu.core import dss, output
 from ompi_tpu.core.config import VarType, register_var, var_registry
 
-__all__ = ["PMIxServer", "PMIxClient", "PMIxError"]
+__all__ = ["PMIxServer", "PMIxClient", "PMIxError", "query_regcount",
+           "query_regstate"]
 
 _log = output.get_stream("pmix")
 
@@ -107,6 +108,8 @@ class PMIxServer:
         self._life: dict[int, int] = {}   # rank → current incarnation
         self._finished: set[int] = set()  # ranks that exited cleanly
         self._registered: set[int] = set()  # ranks whose CURRENT life reg'd
+        self._ready: set[int] = set()   # ranks whose current life LEFT
+        # init (the one-way "ready" notice at the end of ompi_tpu.init)
         self._revived_at: dict[int, float] = {}  # rank → last revive time
         self._adopted_life: dict[int, int] = {}  # rank → highest life any
         # SURVIVOR adopted (the "adopted" RPC, pushed once per life per
@@ -220,6 +223,23 @@ class PMIxServer:
                     self.on_client_contact(rank)
                 except Exception as e:  # noqa: BLE001 — server survives
                     _log.error("on_client_contact(%d) failed: %r", rank, e)
+            return ("ok",)
+        if cmd == "regcount":
+            # introspection: how many ranks' CURRENT lives have
+            # registered (finished booting), how many fence epochs have
+            # completed, and how many ranks are READY (left init — the
+            # one-way notice below).  Chaos schedules key on these
+            # (``daemon=V:kill@reg=N`` fires only once N ranks are
+            # ready, so the kill cannot land mid-init), and together
+            # they make a cheap job-readiness probe.
+            with self._cv:
+                return ("ok", len(self._registered),
+                        len(self._fence_done), len(self._ready))
+        if cmd == "ready":
+            # the rank's current life finished ompi_tpu.init(): user
+            # code is running from here on
+            with self._cv:
+                self._ready.add(int(args[0]))
             return ("ok",)
         if cmd == "adopted":
             # a survivor adopted a peer's new incarnation (its rebind /
@@ -364,6 +384,7 @@ class PMIxServer:
             # the new life hasn't booted yet: it must "reg" again, and
             # the boot-wedge escape measures from this revive
             self._registered.discard(rank)
+            self._ready.discard(rank)
             self._revived_at[rank] = time.monotonic()
             self._cv.notify_all()
 
@@ -385,6 +406,39 @@ class PMIxServer:
             self._listener.close()
         except OSError:
             pass
+
+
+def query_regstate(uri: str, timeout: float = 2.0
+                   ) -> Optional[tuple[int, int, int]]:
+    """One-shot, registration-free probe of the server's readiness
+    state → ``(ranks_registered, fence_epochs_done, ranks_ready)``: a
+    transient connection that does NOT send "reg" (the caller — an
+    orted's chaos arm, a readiness script — is not a rank and must not
+    inflate the barrier it is watching).  None when the server is
+    unreachable."""
+    host, port = uri.removeprefix("tcp://").rsplit(":", 1)
+    try:
+        with socket.create_connection((host, int(port)),
+                                      timeout=timeout) as sock:
+            sock.settimeout(timeout)
+            _send_frame(sock, dss.pack(("regcount",)))
+            payload = _recv_frame(sock)
+        if payload is None:
+            return None
+        reply = dss.unpack(payload, n=1)[0]
+        if reply[0] != "ok":
+            return None
+        return (int(reply[1]),
+                int(reply[2]) if len(reply) > 2 else 0,
+                int(reply[3]) if len(reply) > 3 else 0)
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+def query_regcount(uri: str, timeout: float = 2.0) -> Optional[int]:
+    """The ranks-registered half of :func:`query_regstate`."""
+    state = query_regstate(uri, timeout=timeout)
+    return None if state is None else state[0]
 
 
 class PMIxClient:
@@ -438,6 +492,18 @@ class PMIxClient:
 
     def barrier(self) -> None:
         self.fence(collect=False)
+
+    def regcount(self) -> int:
+        """How many ranks' current lives have registered with the server
+        — the ranks-registered barrier (see :func:`query_regcount` for
+        the registration-free variant non-rank probes must use)."""
+        return int(self._rpc("regcount")[1])
+
+    def ready(self) -> None:
+        """One-way init-complete notice: this life finished
+        ompi_tpu.init() and user code is running (counts toward the
+        readiness probe's third field)."""
+        self._rpc("ready", self.rank)
 
     def failed_ranks(self) -> dict[int, str]:
         """The runtime's current dead-set (ranks the launcher reaped dead

@@ -83,6 +83,15 @@ TAG_REPARENT_ACK = "reparent_ack"  # up: (vpid, new_parent) — re-wired
 TAG_KILL_RANK = "kill_rank"     # xcast: rank — the owning daemon SIGKILLs
 #                                 exactly that rank (reaping a hung pid
 #                                 the gossip detector reported)
+TAG_METRICS = "metrics"         # hop (one tree level, delivered at EVERY
+#                                 hop, not send_up's root-only relay):
+#                                 {jobid: {rank: [wall_ts, {pvar: value}]}}
+#                                 — each orted merges its children's
+#                                 payloads with its local ranks' and
+#                                 forwards one combined delta per
+#                                 trace_metrics_push_period; the HNP/DVM
+#                                 folds the stream into the scrape
+#                                 aggregate
 
 
 def tree_parent(vpid: int) -> Optional[int]:
@@ -280,6 +289,17 @@ class RmlNode:
         a registration before the tree exists)."""
         link.send(dss.pack(("direct", tag, self.vpid, payload)))
 
+    def send_hop(self, tag: str, payload: Any) -> None:
+        """One tree level toward the root, DELIVERED at the receiving
+        hop (unlike ``send_up``, which relays silently until vpid 0).
+        The per-hop aggregation primitive: a mid-tree daemon's handler
+        merges the payload and later forwards its own combined message —
+        how TAG_METRICS folds a subtree's pvar deltas on the way up."""
+        if self.vpid == 0:
+            self._deliver(tag, 0, payload)
+            return
+        self._send_up_blob(dss.pack(("hop", tag, self.vpid, payload)))
+
     def _relay_down(self, tag: str, origin: int, payload: Any) -> None:
         with self._lock:
             links = list(self._child_links.values())
@@ -362,6 +382,10 @@ class RmlNode:
                         except (ConnectionError, OSError) as e:
                             _log.error("rml %d: up relay failed: %r",
                                        self.vpid, e)
+                elif kind == "hop":
+                    # one-level message: deliver HERE (the handler owns
+                    # any further forwarding — per-hop merge semantics)
+                    self._deliver(tag, origin, payload)
                 elif kind == "direct":
                     self._deliver(tag, origin, payload)
                 else:
@@ -434,6 +458,16 @@ class HeartbeatMonitor:
 
         with self._lock:
             self._last[vpid] = time.monotonic()
+
+    def ages(self) -> dict[int, float]:
+        """Seconds since each watched vpid's last beat (the /status
+        last-heartbeat-age column; empty when heartbeats are off)."""
+        import time
+
+        now = time.monotonic()
+        with self._lock:
+            return {vpid: max(0.0, now - last)
+                    for vpid, last in self._last.items()}
 
     def start(self) -> None:
         period = float(var_registry.get("rml_heartbeat_period") or 0)

@@ -19,6 +19,12 @@ Plan grammar (entries separated by ``;``)::
                               budget/escalation ladder (kill and hang
                               are first-life-only by design)
     daemon=1:kill@t=1.0       orted vpid 1 SIGKILLs itself after 1 s
+    daemon=1:kill@reg=4:after=1.5
+                              orted vpid 1 SIGKILLs itself 1.5 s after
+                              4 ranks have REGISTERED with the job's
+                              PMIx server — a barrier-keyed schedule
+                              that cannot land mid-init on a slow box
+                              (``after`` defaults to 1.0 s)
     drop=0.01                 drop outgoing FT-control frames with p=0.01
     drop=0.05@all             drop ANY outgoing frame with p=0.05
     rank=1:drop=0.1           restrict the action to rank 1
@@ -65,7 +71,7 @@ from ompi_tpu.core import output
 from ompi_tpu.core.config import VarType, register_var, var_registry
 
 __all__ = ["active", "plan_text", "injector_for", "step", "arm_daemon",
-           "events", "reset", "Injector"]
+           "arm_daemon_launch", "events", "reset", "Injector"]
 
 _log = output.get_stream("faultinject")
 
@@ -110,7 +116,7 @@ class _Action:
     """One parsed plan entry."""
 
     __slots__ = ("kind", "rank", "prob", "scope", "delay_ms", "at_step",
-                 "at_time", "vpid")
+                 "at_time", "at_reg", "after", "vpid")
 
     def __init__(self) -> None:
         self.kind = ""            # kill | daemon_kill | drop | delay | dup
@@ -121,6 +127,8 @@ class _Action:
         self.delay_ms = 0.0
         self.at_step: Optional[int] = None
         self.at_time: Optional[float] = None
+        self.at_reg: Optional[int] = None   # ranks-registered barrier
+        self.after = 1.0          # grace after the @reg barrier clears
 
 
 def _parse_entry(entry: str) -> _Action:
@@ -148,10 +156,14 @@ def _parse_entry(entry: str) -> _Action:
                 act.at_step = int(val)
             elif trig == "t":
                 act.at_time = float(val)
+            elif trig == "reg":
+                act.at_reg = int(val)
             else:
                 raise ValueError(
-                    f"{base} needs a trigger: {base}@step=N or "
-                    f"{base}@t=SEC (got {part!r})")
+                    f"{base} needs a trigger: {base}@step=N, "
+                    f"{base}@t=SEC or {base}@reg=NRANKS (got {part!r})")
+        elif key == "after":
+            act.after = float(val)
         elif key in ("drop", "dup"):
             act.kind = key
             prob, _, scope = val.partition("@")
@@ -182,6 +194,14 @@ def _parse_entry(entry: str) -> _Action:
     # that saw it after must settle to the same action
     if act.kind == "kill" and act.vpid is not None:
         act.kind = "daemon_kill"
+    # the ranks-registered barrier is a DAEMON schedule: only an orted
+    # can watch the PMIx regcount without being counted by it (a rank's
+    # own registration is part of the barrier it would be waiting on)
+    if act.at_reg is not None and act.kind != "daemon_kill":
+        raise ValueError(
+            f"@reg triggers are daemon-kill only (entry {entry!r})")
+    if act.after < 0:
+        raise ValueError(f"after= must be >= 0 (entry {entry!r})")
     return act
 
 
@@ -374,6 +394,13 @@ def step(rank: Optional[int] = None) -> None:
         inj.step()
 
 
+def _daemon_die(vpid: int) -> None:
+    import signal
+
+    _log.emit("faultinject: daemon %d injected SIGKILL", vpid)
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
 def arm_daemon(vpid: int) -> None:
     """orted side: a plan entry ``daemon=<vpid>:kill@t=<sec>`` arms a
     self-SIGKILL — the injected silent host death."""
@@ -387,15 +414,55 @@ def arm_daemon(vpid: int) -> None:
     for a in actions:
         if a.kind == "daemon_kill" and a.vpid == vpid \
                 and a.at_time is not None:
-            import signal
-
-            def die() -> None:
-                _log.emit("faultinject: daemon %d injected SIGKILL", vpid)
-                os.kill(os.getpid(), signal.SIGKILL)
-
-            t = threading.Timer(a.at_time, die)
+            t = threading.Timer(a.at_time, _daemon_die, args=(vpid,))
             t.daemon = True
             t.start()
+
+
+def arm_daemon_launch(vpid: int, env: dict) -> None:
+    """orted side, at app launch: arm ``daemon=<vpid>:kill@reg=N`` —
+    the barrier-keyed variant of the daemon kill.  A watcher thread
+    polls the job's PMIx server (URI from the launch env) until N
+    ranks' current lives have registered AND are READY (sent the
+    init-complete notice — registration alone says the interpreters
+    are up, but ranks can still be seconds deep in init's modex fence
+    or first barrier on a loaded box), waits the entry's ``after``
+    grace, then SIGKILLs the daemon.  Keyed on runtime barriers
+    instead of wall-clock so the kill cannot land mid-init on a slow
+    box (the midtree-kill chaos class's old t=6–8 s flake)."""
+    text = plan_text()
+    if not text:
+        return
+    try:
+        actions = parse_plan(text)
+    except ValueError:
+        return
+    from ompi_tpu.runtime import pmix as pmix_mod
+
+    uri = (env or {}).get(pmix_mod.ENV_URI)
+    if not uri:
+        return
+    for a in actions:
+        if a.kind != "daemon_kill" or a.vpid != vpid or a.at_reg is None:
+            continue
+
+        def watch(need: int = a.at_reg, grace: float = a.after) -> None:
+            deadline = time.monotonic() + 120.0
+            while time.monotonic() < deadline:
+                state = pmix_mod.query_regstate(uri)
+                if state is not None and state[0] >= need \
+                        and state[2] >= need:
+                    _log.emit("faultinject: daemon %d reg barrier "
+                              "(%d ranks registered + ready) cleared; "
+                              "killing in %.1fs", vpid, need, grace)
+                    time.sleep(grace)
+                    _daemon_die(vpid)
+                    return
+                time.sleep(0.2)
+
+        t = threading.Thread(target=watch, daemon=True,
+                             name=f"faultinject-reg-{vpid}")
+        t.start()
 
 
 def events(rank: Optional[int] = None) -> list[dict]:

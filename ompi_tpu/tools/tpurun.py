@@ -68,6 +68,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--slots", type=int, default=None,
                    help="total rank slots the DVM allocates at start "
                         "(--dvm-start; default: np or hosts*ceil)")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   metavar="PORT",
+                   help="with --dvm-start: serve a long-lived HTTP "
+                        "observability endpoint on 127.0.0.1:PORT — "
+                        "/metrics (Prometheus text, per-job labels) and "
+                        "/status (proc table + FT event timeline).  "
+                        "Arms the per-rank metrics uplink "
+                        "(trace_metrics_push_period, default 1.0 s when "
+                        "this flag is given).  PORT 0 binds an "
+                        "ephemeral port, recorded in <uri>.metrics")
     p.add_argument("--clean", action="store_true",
                    help="remove stale job debris (shm inboxes/segments "
                         "of dead ranks, dead DVM uri) — ≈ orte-clean; "
@@ -250,12 +260,24 @@ def main(argv: list[str] | None = None) -> int:
         plm_name = args.plm or "sim"
         if plm_name == "sim" and not args.hostfile:
             _configure_sim_ras(slots)
+        if args.metrics_port is not None:
+            # the scrape endpoint is only useful with the uplink armed:
+            # default the push period on (daemons inherit it via their
+            # spawn env, ranks via the launch env overlay) unless the
+            # user pinned it with --mca / the environment
+            os.environ.setdefault(
+                var_registry.ENV_PREFIX + "trace_metrics_push_period",
+                "1.0")
         hnp = dvm.DvmHnp(plm_name=plm_name, want_tpu=args.tpu,
                          uri_path=args.dvm_uri,
+                         metrics_port=args.metrics_port,
                          remote_hosts=plm_name == "ssh")
         hnp.start(np_slots=slots)
         print(f"dvm: up ({args.hosts} hosts, {slots} slots); "
               f"uri file {hnp.uri_path}", file=sys.stderr)
+        if hnp.metrics_uri:
+            print(f"dvm: metrics at {hnp.metrics_uri}/metrics and "
+                  f"{hnp.metrics_uri}/status", file=sys.stderr)
         try:
             return hnp.serve_forever()
         except KeyboardInterrupt:

@@ -163,6 +163,133 @@ def test_stack_categories_end_to_end(tmp_path):
     assert "rndv_send" in names and "rndv_recv" in names
 
 
+def test_flow_ids_pair_send_and_recv_spans():
+    """Cross-rank trace correlation: eager and rndv frames carry a flow
+    id (args.fl) recorded on BOTH the send-side span and the matching
+    recv-side span — the raw material for the exporter's Perfetto flow
+    arrows."""
+    trace.enable(capacity=65536)
+
+    def body(comm):
+        trace.attach_pml(comm.pml)   # listeners off the eager fast lane
+        peer = (comm.rank + 1) % comm.size
+        r = comm.irecv(source=(comm.rank - 1) % comm.size, tag=1)
+        comm.send(np.arange(32, dtype=np.float64), dest=peer, tag=1)
+        r.wait()
+        big = np.ones(128 * 1024, dtype=np.float32)
+        r = comm.irecv(np.empty_like(big),
+                       source=(comm.rank - 1) % comm.size, tag=2)
+        comm.send(big, dest=peer, tag=2)
+        r.wait()
+        return 0
+
+    assert run_ranks(2, body) == [0, 0]
+    events = trace.recorder.snapshot()
+    by_name: dict[str, set] = {}
+    for _ts, dur, _cat, name, _rank, args in events:
+        if dur is not None and name in ("eager_send", "eager_recv",
+                                        "rndv_send", "rndv_recv"):
+            fl = (args or {}).get("fl")
+            if fl:
+                by_name.setdefault(name, set()).add(fl)
+    # each send span's fl shows up on a recv span (per protocol class)
+    assert by_name.get("eager_send") and \
+        by_name["eager_send"] & by_name.get("eager_recv", set())
+    assert by_name.get("rndv_send") and \
+        by_name["rndv_send"] & by_name.get("rndv_recv", set())
+    # flow ids are globally unique: rank-strided namespaces don't collide
+    all_fl = [f for s in by_name.values() for f in s]
+    assert any(f >= 1 << 40 for f in all_fl), \
+        "rank 1's flow ids should ride the stride namespace"
+
+
+def test_flow_ids_cost_nothing_when_tracing_off():
+    """With the recorder disarmed, frames carry no fl key at all."""
+    assert not trace.active
+
+    def body(comm):
+        peer = (comm.rank + 1) % comm.size
+        seen = []
+        orig = comm.pml._enqueue_frame
+
+        def spy(p, hdr, payload, req):
+            seen.append(dict(hdr))
+            return orig(p, hdr, payload, req)
+
+        comm.pml._enqueue_frame = spy
+        try:
+            r = comm.irecv(source=(comm.rank - 1) % comm.size, tag=1)
+            comm.send(np.ones(4096, dtype=np.float64), dest=peer, tag=1)
+            r.wait()
+        finally:
+            comm.pml._enqueue_frame = orig
+        return sum(1 for h in seen if "fl" in h)
+
+    assert run_ranks(2, body) == [0, 0]
+
+
+def test_export_flow_events_synthesized():
+    """The exporter turns matching send/recv fl spans into a Perfetto
+    flow pair (ph s → ph f, bind-to-enclosing) anchored inside the
+    spans, and skips unpaired or same-rank flows."""
+    evs = [
+        {"ph": "X", "name": "eager_send", "cat": "pml", "ts": 100.0,
+         "dur": 5.0, "pid": 0, "tid": 0, "args": {"fl": 42}},
+        {"ph": "X", "name": "eager_recv", "cat": "pml", "ts": 110.0,
+         "dur": 3.0, "pid": 1, "tid": 0, "args": {"fl": 42}},
+        # unpaired send: no arrow
+        {"ph": "X", "name": "rndv_send", "cat": "pml", "ts": 200.0,
+         "dur": 5.0, "pid": 0, "tid": 0, "args": {"fl": 7}},
+        # self-send (same pid both halves): no arrow
+        {"ph": "X", "name": "eager_send", "cat": "pml", "ts": 300.0,
+         "dur": 1.0, "pid": 0, "tid": 0, "args": {"fl": 8}},
+        {"ph": "X", "name": "eager_recv", "cat": "pml", "ts": 302.0,
+         "dur": 1.0, "pid": 0, "tid": 0, "args": {"fl": 8}},
+        # cross-host clock skew: recv span ends BEFORE the send span's
+        # end — no binding placement exists, pair skipped
+        {"ph": "X", "name": "eager_send", "cat": "pml", "ts": 400.0,
+         "dur": 10.0, "pid": 0, "tid": 0, "args": {"fl": 9}},
+        {"ph": "X", "name": "eager_recv", "cat": "pml", "ts": 395.0,
+         "dur": 2.0, "pid": 1, "tid": 0, "args": {"fl": 9}},
+    ]
+    flows = trace_export.flow_events(evs)
+    assert len(flows) == 2
+    s, f = flows
+    assert s["ph"] == "s" and f["ph"] == "f" and f["bp"] == "e"
+    assert s["id"] == f["id"] == 42
+    assert s["pid"] == 0 and f["pid"] == 1
+    # endpoints land inside their spans
+    assert 100.0 <= s["ts"] <= 105.0
+    assert 110.0 <= f["ts"] <= 113.0
+    # flow events pass the exporter's own validation
+    doc = {"displayTimeUnit": "ns",
+           "traceEvents": sorted(evs + flows, key=lambda e: e["ts"])}
+    assert trace_export.validate(doc) == []
+
+
+def test_export_merge_emits_flow_arrows(tmp_path):
+    """End-to-end: two per-rank dumps with matching fl spans merge into
+    a trace containing s/f flow events."""
+    def dump(rank, name, ts, fl):
+        doc = {"displayTimeUnit": "ns",
+               "otherData": {"rank": rank, "jobid": 5,
+                             "clock_offset_ns": 0},
+               "traceEvents": [
+                   {"ph": "X", "name": name, "cat": "pml", "ts": ts,
+                    "dur": 4.0, "pid": rank, "tid": 0,
+                    "args": {"fl": fl}}]}
+        p = tmp_path / f"ompi_tpu_trace_5_rank{rank}.json"
+        p.write_text(json.dumps(doc))
+        return str(p)
+
+    paths = [dump(0, "eager_send", 10.0, 99),
+             dump(1, "eager_recv", 20.0, 99)]
+    doc = trace_export.merge(paths)
+    phases = [e["ph"] for e in doc["traceEvents"]]
+    assert "s" in phases and "f" in phases
+    assert trace_export.validate(doc) == []
+
+
 def test_coll_span_records_rules_decision(tmp_path):
     from ompi_tpu.core.config import var_registry
 

@@ -161,6 +161,79 @@ def test_dvm_ps_live_job(dvm):
         slow.wait(timeout=60)
 
 
+def test_dvm_metrics_scrape_end_to_end(tmp_path):
+    """The live observability plane, end to end on a real standing VM:
+    a 2-rank job's pvar snapshots ride the rank→orted UDP uplink and
+    TAG_METRICS up the tree; the DVM's /metrics serves them under the
+    job's label, and /status carries the FT event timeline after a
+    seeded rank death."""
+    import urllib.request
+
+    # errmgr is a VM-level selection on a standing DVM (the policy runs
+    # in the server process): notify lets the seeded-kill job below
+    # continue instead of being torn down by the default abort
+    with _standing_vm(tmp_path, "--metrics-port", "0",
+                      "--mca", "errmgr", "notify") as uri:
+        with open(uri + ".metrics") as f:
+            http = f.read().strip()
+
+        prog = ("import numpy as np, ompi_tpu\n"
+                "comm = ompi_tpu.init()\n"
+                "peer = (comm.rank + 1) % comm.size\n"
+                "r = comm.irecv(source=(comm.rank - 1) % comm.size, tag=1)\n"
+                "comm.send(np.ones(64), dest=peer, tag=1)\n"
+                "r.wait()\n"
+                "import time; time.sleep(1.5)\n"   # one on-period push
+                "ompi_tpu.finalize()\n")
+        # host-plane test: the jax.distributed bootstrap adds nothing
+        # here and its coordinator handshake can flake a loaded 2-core
+        # box (preemption SIGTERM racing job teardown)
+        r = _tpurun("--dvm-submit", "-np", "2", "--dvm-uri", uri,
+                    "--mca", "multihost_auto_init", "0", "--",
+                    sys.executable, "-c", prog)
+        assert r.returncode == 0, r.stderr
+
+        def scrape(path):
+            with urllib.request.urlopen(http + path, timeout=10) as resp:
+                return resp.read().decode()
+
+        metrics = scrape("/metrics")
+        # per-rank series under the job label, both ranks
+        assert 'ompi_tpu_pml_zero_copy_sends_total{job="' in metrics, \
+            metrics[:2000]
+        assert ',rank="0"}' in metrics and ',rank="1"}' in metrics
+        # the per-job aggregated family
+        assert "ompi_tpu_job_pml_zero_copy_sends_total{job=" in metrics
+        # DVM gauges
+        assert "ompi_tpu_dvm_jobs_completed_total 1" in metrics
+
+        # seeded rank death under notify → a detect event on the
+        # timeline (rank 0 exits via os._exit: a finalize barrier with
+        # a dead peer would fail fast by design and muddy the rc)
+        kill = ("import os, time, ompi_tpu\n"
+                "comm = ompi_tpu.init()\n"
+                "if comm.rank == 1:\n"
+                "    os._exit(9)\n"
+                "time.sleep(2.0)\n"
+                "os._exit(0)\n")
+        r = _tpurun("--dvm-submit", "-np", "2", "--dvm-uri", uri,
+                    "--mca", "multihost_auto_init", "0", "--",
+                    sys.executable, "-c", kill)
+        assert r.returncode == 9, (r.returncode, r.stderr)
+
+        status = json.loads(scrape("/status"))
+        assert status["daemons"], status
+        jobs = {j["jobid"]: j for j in status["jobs"]}
+        completed = [j for j in jobs.values()
+                     if j.get("state") == "completed"]
+        assert completed, status
+        kinds = [e["kind"] for j in jobs.values()
+                 for e in j.get("ft_events", [])]
+        assert "detect" in kinds, status
+        # both jobs kept separate label spaces in the aggregate
+        assert len(jobs) >= 2, jobs.keys()
+
+
 def test_dvm_propagates_nonzero_exit(dvm):
     r = _tpurun("--dvm-submit", "-np", "2", "--dvm-uri", dvm, "--",
                 sys.executable, "-c", "import sys; sys.exit(3)")

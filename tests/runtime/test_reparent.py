@@ -138,7 +138,11 @@ def test_midtree_daemon_kill_orphan_ranks_survive():
                "--mca", "multihost_auto_init", "0",
                "--mca", "rml_heartbeat_period", "0.2",
                "--mca", "rml_heartbeat_timeout", "2.0",
-               "--mca", "faultinject_plan", "daemon=1:kill@t=7.0", "--",
+               # reg-keyed kill: fires 1.5 s after all 4 ranks have
+               # registered with the PMIx server — cannot land mid-init
+               # on a slow box (the old t=7.0 schedule's flake)
+               "--mca", "faultinject_plan",
+               "daemon=1:kill@reg=4:after=1.5", "--",
                sys.executable, "-c", prog, timeout=240)
     out = r.stdout + r.stderr
     assert r.returncode == 0, out[-3000:]

@@ -38,6 +38,82 @@ def test_empty_plan_means_inactive():
     assert not fi.active() or fi.plan_text()  # env may arm it externally
 
 
+def test_reg_trigger_parses_with_after_grace():
+    """daemon=V:kill@reg=N[:after=S] — the ranks-registered barrier
+    schedule (the midtree-kill de-flake)."""
+    a = fi.parse_plan("daemon=1:kill@reg=4:after=1.5")[0]
+    assert (a.kind, a.vpid, a.at_reg, a.after) == \
+        ("daemon_kill", 1, 4, 1.5)
+    assert a.at_time is None and a.at_step is None
+    # field order within the entry is free; after defaults to 1.0
+    b = fi.parse_plan("kill@reg=3:daemon=2")[0]
+    assert (b.kind, b.vpid, b.at_reg, b.after) == \
+        ("daemon_kill", 2, 3, 1.0)
+
+
+@pytest.mark.parametrize("bad", [
+    "rank=1:kill@reg=2",          # @reg is daemon-kill only
+    "rank=0:hang@reg=2",          # hangs target ranks, no reg barrier
+    "daemon=1:kill@reg=4:after=-1",   # negative grace
+])
+def test_reg_trigger_rejects_non_daemon_targets(bad):
+    with pytest.raises(ValueError):
+        fi.parse_plan(bad)
+
+
+def test_arm_daemon_launch_waits_for_reg_and_ready_barrier(monkeypatch):
+    """The reg watcher fires the kill only once BOTH counts cleared:
+    every rank registered AND sent its init-complete notice —
+    registration alone still leaves a window inside init (the modex
+    fence and the first barrier can take seconds on a loaded box)."""
+    import time as _time
+
+    from ompi_tpu.runtime import pmix
+
+    server = pmix.PMIxServer(size=2)
+    killed = []
+    monkeypatch.setattr(fi, "_daemon_die", lambda vpid: killed.append(vpid))
+    monkeypatch.setenv(fi.ENV_PLAN, "daemon=1:kill@reg=2:after=0.0")
+    try:
+        fi.arm_daemon_launch(1, {pmix.ENV_URI: server.uri})
+        _time.sleep(0.6)
+        assert killed == [], "kill fired before anyone registered"
+        c0 = pmix.PMIxClient(uri=server.uri, rank=0, size=2)
+        c1 = pmix.PMIxClient(uri=server.uri, rank=1, size=2)
+        assert pmix.query_regstate(server.uri) == (2, 0, 0)
+        _time.sleep(0.6)
+        assert killed == [], \
+            "kill fired between registration and init completion"
+        c0.ready()
+        _time.sleep(0.4)
+        assert killed == [], "kill fired with only 1/2 ranks ready"
+        c1.ready()
+        assert pmix.query_regstate(server.uri)[2] == 2
+        deadline = _time.monotonic() + 10.0
+        while not killed and _time.monotonic() < deadline:
+            _time.sleep(0.05)
+        assert killed == [1], "kill never fired after both barriers"
+        c0.finalize()
+        c1.finalize()
+    finally:
+        server.close()
+
+
+def test_arm_daemon_launch_ignores_other_vpids_and_triggers(monkeypatch):
+    monkeypatch.setattr(fi, "_daemon_die",
+                        lambda vpid: pytest.fail("must not fire"))
+    monkeypatch.setenv(fi.ENV_PLAN, "daemon=1:kill@reg=2")
+    # wrong vpid: nothing armed; missing URI: nothing armed
+    fi.arm_daemon_launch(2, {"OMPI_TPU_HNP_URI": "tcp://127.0.0.1:1"})
+    fi.arm_daemon_launch(1, {})
+    # legacy @t entries are arm_daemon's job, not the launch hook's
+    monkeypatch.setenv(fi.ENV_PLAN, "daemon=1:kill@t=0.01")
+    fi.arm_daemon_launch(1, {"OMPI_TPU_HNP_URI": "tcp://127.0.0.1:1"})
+    import time as _time
+
+    _time.sleep(0.3)
+
+
 def test_verdict_is_pure_function_of_frame_identity():
     hdr = {"t": "ft", "op": "agree_c", "cid": 0, "aseq": 1, "n": 2}
     ident = fi._frame_ident(hdr)

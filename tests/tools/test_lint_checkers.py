@@ -158,6 +158,31 @@ def hot_path(kind):
     assert pvar_spec.run(idx) == []
 
 
+def test_pvar_spec_agg_metrics_must_name_real_counters(tmp_path):
+    """The aggregated-metric family (per-job sums on the DVM scrape
+    endpoint) must stay in sync with _COUNTER_SPECS: a renamed counter
+    still listed in AGG_METRICS is flagged, matching entries are not."""
+    idx = _tree(tmp_path, {
+        "trace.py": _TRACE_MOD,
+        "app.py": """
+import trace as trace_mod
+
+def hot_path():
+    trace_mod.count("frames_sent_total")
+    trace_mod.count("frames_lost_total")
+""",
+        "metrics.py": """
+AGG_METRICS = (
+    "frames_sent_total",          # real counter — clean
+    "frames_renamed_total",       # vanished from _COUNTER_SPECS — flag
+)
+""",
+    })
+    got = _rules(pvar_spec.run(idx))
+    assert ("unknown-agg-metric", "frames_renamed_total") in got
+    assert ("unknown-agg-metric", "frames_sent_total") not in got
+
+
 # ---------------------------------------------------------------------------
 # rml-tag
 # ---------------------------------------------------------------------------

@@ -260,12 +260,16 @@ def gen_plan(seed: int, idx: int, np_: int, steps: int) -> dict:
         else 0.0
     if policy == "midtree-kill":
         # daemon 1 is the canonical mid-tree node of the 4-host binary
-        # routing tree (children 3 and 4); the kill lands well after the
-        # ranks cleared init's barriers
-        kill_t = round(rng.uniform(6.0, 8.0), 1)
+        # routing tree (children 3 and 4).  The kill is keyed on the
+        # ranks-registered barrier (PMIx reg count), not wall-clock: on
+        # a slow box a fixed t=6–8 s could land while 4 jax ranks were
+        # still importing, turning a containment test into an init
+        # abort — @reg=4 cannot fire before every rank finished booting
+        kill_after = round(rng.uniform(1.0, 2.0), 1)
         return {"idx": idx, "policy": policy, "victim": 1,
-                "kill_step": None, "kill_t": kill_t, "drop": 0.0,
-                "plan": f"daemon=1:kill@t={kill_t}", "seed": seed}
+                "kill_step": None, "kill_after": kill_after, "drop": 0.0,
+                "plan": f"daemon=1:kill@reg=4:after={kill_after}",
+                "seed": seed}
     if policy in ("rank-hang", "selfheal-hang"):
         plan = f"rank={victim}:hang@step={kill_step}"
     elif policy == "selfheal-crashloop":

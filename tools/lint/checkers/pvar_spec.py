@@ -10,6 +10,11 @@ exports a forever-zero metric and rots the catalogue.  Checks:
   access) naming no ``_COUNTER_SPECS`` entry.  F-string names must
   match ≥1 spec.
 - ``dead-pvar``: a ``_COUNTER_SPECS`` entry never bumped anywhere.
+- ``unknown-agg-metric``: an ``AGG_METRICS`` entry (the per-job
+  aggregated-metric family the DVM scrape endpoint sums across ranks
+  as ``ompi_tpu_job_*``) naming no ``_COUNTER_SPECS`` counter — a
+  renamed counter would otherwise silently vanish from the scrape
+  surface while the aggregate kept exporting a forever-zero sum.
 """
 
 from __future__ import annotations
@@ -71,6 +76,15 @@ def run(index: ProjectIndex) -> list[Finding]:
             CHECKER, "dead-pvar", name,
             f"_COUNTER_SPECS entry {name!r} is never bumped by any "
             f"count() call", spec_mod, spec_line.get(name, 0)))
+
+    for name, path, line in collect_agg_metrics(index):
+        if name not in spec_names:
+            findings.append(Finding(
+                CHECKER, "unknown-agg-metric", name,
+                f"AGG_METRICS entry {name!r} names no _COUNTER_SPECS "
+                f"counter — the per-job ompi_tpu_job_ sum on the scrape "
+                f"endpoint would export forever-zero (renamed counter?)",
+                path, line))
     return findings
 
 
@@ -96,6 +110,28 @@ def collect_specs(index: ProjectIndex
                         lines[nm] = el.lineno
             return names, mod.path, lines
     return None
+
+
+def collect_agg_metrics(index: ProjectIndex
+                        ) -> list[tuple[str, str, int]]:
+    """Every ``AGG_METRICS`` tuple's string entries →
+    [(name, path, line)] — the aggregated-metric name family the DVM
+    scrape endpoint exports per job."""
+    out: list[tuple[str, str, int]] = []
+    for mod in index.modules.values():
+        for node in mod.tree.body:
+            if not (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name)
+                            and t.id == "AGG_METRICS"
+                            for t in node.targets)):
+                continue
+            if not isinstance(node.value, (ast.Tuple, ast.List)):
+                continue
+            for el in node.value.elts:
+                nm = literal_str(el)
+                if nm is not None:
+                    out.append((nm, mod.path, el.lineno))
+    return out
 
 
 def _count_arg(mod, call: ast.Call) -> Optional[ast.expr]:

@@ -6,8 +6,8 @@ is a protocol message that silently vanishes, and a TAG_* constant
 nobody sends or receives is dead wire protocol.  Checks:
 
 - ``unhandled-send``: a ``TAG_X`` constant passed to
-  ``xcast/send_up/send_direct`` with no ``register_recv(TAG_X, …)``
-  anywhere in the tree.
+  ``xcast/send_up/send_direct/send_hop`` with no
+  ``register_recv(TAG_X, …)`` anywhere in the tree.
 - ``dead-tag``: a ``TAG_X = "…"`` definition neither sent nor
   registered anywhere (wire protocol that can never fire).
 - ``unsent-handler``: a handler registered for a tag nothing ever
@@ -30,7 +30,7 @@ from tools.lint.finding import Finding
 from tools.lint.index import ProjectIndex, iter_calls
 
 CHECKER = "rml-tag"
-_SEND_FUNCS = ("xcast", "send_up", "send_direct")
+_SEND_FUNCS = ("xcast", "send_up", "send_direct", "send_hop")
 
 
 def run(index: ProjectIndex) -> list[Finding]:

@@ -29,7 +29,12 @@ _RANK_RE = re.compile(r"ompi_tpu_trace_(\d+)_rank(-?\d+)\.json$")
 # keep in sync with ompi_tpu.mpi.trace.CATEGORIES (the exporter must not
 # import the package: it runs standalone in CI validation steps)
 CATEGORIES = ("pml", "btl", "coll", "osc", "io", "ckpt", "datatype",
-              "runtime")
+              "runtime", "errmgr")
+
+#: span names that carry a flow id (``args.fl``) — the send/recv halves
+#: of one message; each cross-rank pair becomes a Perfetto flow arrow
+FLOW_SEND_SPANS = ("eager_send", "rndv_send")
+FLOW_RECV_SPANS = ("eager_recv", "rndv_recv")
 
 
 def _load(path: str) -> tuple[int, list[dict], dict]:
@@ -100,6 +105,7 @@ def merge(paths: list[str]) -> dict:
             name = CATEGORIES[tid] if tid < len(CATEGORIES) else "other"
             meta.append({"ph": "M", "name": "thread_name", "pid": rank,
                          "tid": tid, "args": {"name": name}})
+    all_events.extend(flow_events(all_events))
     all_events.sort(key=lambda e: float(e.get("ts", 0.0)))
     return {
         "displayTimeUnit": "ns",
@@ -108,6 +114,53 @@ def merge(paths: list[str]) -> dict:
                                    for r, v in sorted(per_rank.items())}},
         "traceEvents": meta + all_events,
     }
+
+
+def flow_events(events: list[dict]) -> list[dict]:
+    """Cross-rank flow arrows: every ``{eager,rndv}_send`` span whose
+    ``args.fl`` matches an ``{eager,rndv}_recv`` span on another rank
+    yields a Perfetto flow pair (``ph s``/``ph f``) — send→recv arrows
+    that make inter-rank waits visible in the merged timeline.
+
+    Flow endpoints must land INSIDE their span (Chrome binds a flow
+    event to the slice enclosing its ts on that pid/tid), so the start
+    rides just before the send span's end and the finish (``bp: "e"``,
+    bind-to-enclosing) just before the recv span's end — the arrow runs
+    from "payload handed to the wire" to "payload delivered"."""
+    sends: dict = {}
+    recvs: dict = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        fl = (ev.get("args") or {}).get("fl")
+        if fl is None:
+            continue
+        if ev.get("name") in FLOW_SEND_SPANS:
+            sends.setdefault(fl, ev)
+        elif ev.get("name") in FLOW_RECV_SPANS:
+            recvs.setdefault(fl, ev)
+    out: list[dict] = []
+    for fl, sev in sends.items():
+        rev = recvs.get(fl)
+        if rev is None or rev.get("pid") == sev.get("pid"):
+            continue   # no recv half, or a self-send — no arrow to draw
+        s_ts = float(sev["ts"]) + max(0.0, float(sev.get("dur", 0.0)))
+        f_ts = float(rev["ts"]) + max(0.0, float(rev.get("dur", 0.0)))
+        if f_ts < s_ts:
+            # recv span "ends" before the send span: cross-host clock
+            # skew (the merge already warns about it).  Both endpoints
+            # must land INSIDE their spans to bind, so a clamp can only
+            # move f_ts within the recv span — and when even the recv
+            # span's end precedes the send endpoint, no binding
+            # placement exists: skip the pair rather than draw an arrow
+            # anchored to the wrong slice
+            continue
+        common = {"cat": "flow", "name": "msg", "id": fl}
+        out.append({**common, "ph": "s", "ts": s_ts,
+                    "pid": sev["pid"], "tid": sev.get("tid", 0)})
+        out.append({**common, "ph": "f", "bp": "e", "ts": f_ts,
+                    "pid": rev["pid"], "tid": rev.get("tid", 0)})
+    return out
 
 
 def validate(doc: dict) -> list[str]:
@@ -192,10 +245,12 @@ def main(argv: list[str] | None = None) -> int:
     with open(args.output, "w", encoding="utf-8") as f:
         json.dump(doc, f)
     n_spans = sum(1 for e in doc["traceEvents"] if e.get("ph") == "X")
+    n_flows = sum(1 for e in doc["traceEvents"] if e.get("ph") == "s")
     cats = sorted({e.get("cat") for e in doc["traceEvents"]
                    if e.get("cat")})
     print(f"trace_export: wrote {args.output} — "
-          f"{len(doc['traceEvents'])} events ({n_spans} spans) from "
+          f"{len(doc['traceEvents'])} events ({n_spans} spans, "
+          f"{n_flows} flow arrows) from "
           f"{len(paths)} rank(s); categories: {', '.join(cats)}")
     return 0
 
