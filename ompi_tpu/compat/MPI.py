@@ -630,16 +630,47 @@ class Request:
                 _fill_status(statuses[i], getattr(req._r, "status", None))
         return True
 
+    @staticmethod
+    def Startall(requests: Sequence["Prequest"]) -> None:
+        """Passthrough so loops written against ``MPI.Request.Startall``
+        port unchanged (all-or-nothing, like the native start_all)."""
+        _req_mod.start_all([r._r for r in requests])
+
 
 class Prequest(Request):
-    """Persistent request (MPI_Send_init/Recv_init → Start)."""
+    """Persistent request (MPI_Send_init/Recv_init → Start; also the
+    handle type the persistent-collective and partitioned ``*_init``
+    families return)."""
+
+    def _finish(self, out):
+        # persistent: the landing transform re-runs after EVERY
+        # start/wait cycle (the base class clears it after one shot —
+        # a persistent Allreduce_init must refill recvbuf each time)
+        if self._transform is not None:
+            return self._transform(out)
+        return out
 
     def Start(self) -> None:
         self._r.start()
 
-    @staticmethod
-    def Startall(requests: Sequence["Prequest"]) -> None:
-        _req_mod.start_all([r._r for r in requests])
+    # Startall is inherited from Request (the all-or-nothing native
+    # start_all), reachable as both MPI.Request.Startall and the
+    # mpi4py-canonical MPI.Prequest.Startall.
+
+    # -- partitioned operations (MPI-4; valid on Psend/Precv handles) ------
+
+    def Pready(self, partition: int) -> None:
+        self._r.pready(partition)
+
+    def Pready_range(self, partition_low: int,
+                     partition_high: int) -> None:
+        self._r.pready_range(partition_low, partition_high)
+
+    def Pready_list(self, partitions) -> None:
+        self._r.pready_list(partitions)
+
+    def Parrived(self, partition: int) -> bool:
+        return self._r.parrived(partition)
 
 
 class Message:
@@ -1095,6 +1126,34 @@ class Comm:
     def Recv_init(self, buf, source: int = ANY_SOURCE,
                   tag: int = ANY_TAG) -> Prequest:
         return Prequest(self._c.recv_init(_as_array(buf), source, tag))
+
+    # -- persistent collectives + partitioned p2p (MPI-4 *_init) -----------
+
+    def Barrier_init(self) -> Prequest:
+        return Prequest(self._c.barrier_init())
+
+    def Bcast_init(self, buf, root: int = 0) -> Prequest:
+        # one buffer, both roles (the mpi4py shape): the root's payload
+        # is re-read per start, a non-root's is the landing buffer the
+        # native layer fills in place at each wait
+        return Prequest(self._c.bcast_init(_as_array(buf), root=root))
+
+    def Allreduce_init(self, sendbuf, recvbuf, op: "Op" = None
+                       ) -> Prequest:
+        return Prequest(
+            self._c.allreduce_init(_as_array(sendbuf),
+                                   op=_native_op(op or SUM)),
+            transform=lambda out: _copy_into(recvbuf, out))
+
+    def Psend_init(self, buf, partitions: int, dest: int,
+                   tag: int = 0) -> Prequest:
+        return Prequest(self._c.psend_init(
+            _as_array(buf), dest, tag=tag, partitions=partitions))
+
+    def Precv_init(self, buf, partitions: int, source: int,
+                   tag: int = 0) -> Prequest:
+        return Prequest(self._c.precv_init(
+            _as_array(buf), source, tag=tag, partitions=partitions))
 
     # -- probes ------------------------------------------------------------
 

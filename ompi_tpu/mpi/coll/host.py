@@ -245,3 +245,60 @@ class HostColl(HostCollBase):
 
     def coll_alltoallw(self, comm, sendspecs, recvspecs):
         return base.alltoallw_pairwise(comm, sendspecs, recvspecs)
+
+    # -- bind-time freezing (coll/persistent) ------------------------------
+
+    def freeze_decision(self, coll: str, comm, nbytes: int, op=None):
+        """Resolve the selection layer ONCE and return ``(fn, label)`` —
+        the algorithm callable with its tuning (segment sizes, forced
+        var, rules-file hit) baked in, so a persistent plan's Start
+        never re-pays the per-op decision walk.  ``fn`` keeps the
+        per-collective call shape of the ``coll_*`` table slot it
+        freezes (bcast: ``fn(comm, buf, root)``; reduce adds ``op``
+        before ``root``; allreduce: ``fn(comm, sendbuf, op)``)."""
+        if coll == "barrier":
+            return base.barrier_dissemination, "dissemination"
+        if coll == "reduce":
+            return base.reduce_binomial, "binomial"
+        if coll == "bcast":
+            alg = self._decide("bcast", comm, 0)
+            seg = var_registry.get("coll_host_bcast_segment")
+            if alg == "pipeline":
+                return (lambda c, buf, root: base.bcast_pipeline(
+                    c, buf, root, segsize=seg)), f"pipeline(seg={seg})"
+            if alg == "linear":
+                return base.bcast_linear, "linear"
+            return base.bcast_binomial, "binomial"
+        if coll == "allreduce":
+            segsize = var_registry.get("coll_host_allreduce_segment")
+            alg = self._decide("allreduce", comm, nbytes)
+            commutative = op is None or op.commutative
+            if not alg:
+                if (nbytes < var_registry.get("coll_host_allreduce_small")
+                        or not commutative):
+                    alg = "recursive_doubling"
+                elif nbytes >= segsize:
+                    alg = "segmented_ring"
+                else:
+                    alg = "ring"
+            if not commutative and alg != "linear":
+                alg = "recursive_doubling"
+            if alg == "segmented_ring":
+                return (lambda c, sb, o: base.allreduce_segmented_ring(
+                    c, sb, o, segsize=segsize)
+                ), f"segmented_ring(seg={segsize})"
+            return {"recursive_doubling": base.allreduce_recursive_doubling,
+                    "ring": base.allreduce_ring,
+                    "linear": base.allreduce_linear}[alg], alg
+        if coll == "allgather":
+            alg = self._decide("allgather", comm, nbytes)
+            if not alg:
+                alg = ("bruck" if nbytes
+                       < var_registry.get("coll_host_allgather_small")
+                       else "ring")
+            return {"bruck": base.allgather_bruck,
+                    "ring": base.allgather_ring}[alg], alg
+        from ompi_tpu.mpi.constants import MPIException
+
+        raise MPIException(f"freeze_decision: no persistent plan for "
+                           f"{coll!r}")

@@ -28,6 +28,8 @@ __all__ = [
     "NbcRequest", "ibarrier", "ibcast", "ireduce", "iallreduce", "igather",
     "iallgather", "iscatter", "ialltoall", "ireduce_scatter", "iscan",
     "iexscan", "ialltoallv", "iallgatherv",
+    "barrier_schedule", "bcast_schedule", "reduce_schedule",
+    "allreduce_schedule", "allgather_schedule",
 ]
 
 # offset into the reserved collective tag space (blocking collectives use
@@ -164,9 +166,15 @@ def _const(x):
 
 
 # ---------------------------------------------------------------------------
-# schedule builders (one per collective)
+# schedule builders (one per collective).  The *_schedule functions
+# return ``(rounds, make_state, result_fn)`` — a REUSABLE template:
+# the rounds close over the caller's arrays (re-read on every launch,
+# the persistent-request buffer contract) while all per-launch
+# mutability lives in the fresh dict ``make_state()`` returns.  The
+# one-shot i* wrappers launch a template once; coll/persistent
+# pre-materialises a template at *_init time and launches it per Start.
 
-def ibarrier(comm) -> NbcRequest:
+def barrier_schedule(comm):
     """Dissemination barrier, one round per step."""
     size, rank = comm.size, comm.rank
     token = np.zeros(0, dtype=np.uint8)
@@ -178,14 +186,19 @@ def ibarrier(comm) -> NbcRequest:
         rounds.append(Round(sends=((_const(token), to),),
                             recvs=((frm, f"t{step}"),)))
         step <<= 1
-    return _launch(comm, rounds, lambda s: None, "ibarrier")
+    return rounds, dict, lambda s: None
 
 
-def ibcast(comm, buf, root: int = 0) -> NbcRequest:
+def ibarrier(comm) -> NbcRequest:
+    rounds, make_state, result = barrier_schedule(comm)
+    return _launch(comm, rounds, result, "ibarrier", state=make_state())
+
+
+def bcast_schedule(comm, buf, root: int = 0):
     """Binomial tree: one recv round (non-root), one send round."""
     size, rank = comm.size, comm.rank
     if size == 1:
-        return _launch(comm, [], _const(np.asarray(buf)), "ibcast")
+        return [], dict, _const(np.asarray(buf))
     vrank = (rank - root) % size
     recv_mask = 1
     while recv_mask < size and not (vrank & recv_mask):
@@ -211,20 +224,25 @@ def ibcast(comm, buf, root: int = 0) -> NbcRequest:
         send_mask >>= 1
     if sends:
         rounds.append(Round(sends=tuple(sends)))
-    return _launch(comm, rounds, get, "ibcast")
+    return rounds, dict, get
+
+
+def ibcast(comm, buf, root: int = 0) -> NbcRequest:
+    rounds, make_state, result = bcast_schedule(comm, buf, root)
+    return _launch(comm, rounds, result, "ibcast", state=make_state())
 
 
 def _reduce_rounds(comm, mine: np.ndarray, op: Op,
-                   root: int) -> tuple[list[Round], dict]:
+                   root: int) -> tuple[list[Round], Callable[[], dict]]:
     """Binomial-fold rounds leaving the reduction in state['acc'] on `root`.
     Children cover disjoint ascending vrank ranges, so folding in ascending
     mask order preserves rank order (valid for non-commutative when the
     effective root is 0, mirroring reduce_binomial)."""
     size, rank = comm.size, comm.rank
     rounds: list[Round] = []
-    state = {"acc": mine}
+    make_state = lambda: {"acc": mine}  # noqa: E731
     if size == 1:
-        return rounds, state
+        return rounds, make_state
     eff_root = root if op.commutative else 0
     vrank = (rank - eff_root) % size
     children = []
@@ -261,17 +279,22 @@ def _reduce_rounds(comm, mine: np.ndarray, op: Op,
             rounds.append(Round(recvs=((eff_root, "fwd"),),
                                 compute=lambda s: s.__setitem__(
                                     "acc", s["fwd"].reshape(mine.shape))))
-    return rounds, state
+    return rounds, make_state
+
+
+def reduce_schedule(comm, sendbuf, op: Op, root: int = 0):
+    mine = np.asarray(sendbuf)
+    rounds, make_state = _reduce_rounds(comm, mine, op, root)
+    result = (lambda s: s["acc"]) if comm.rank == root else _const(None)
+    return rounds, make_state, result
 
 
 def ireduce(comm, sendbuf, op: Op, root: int = 0) -> NbcRequest:
-    mine = np.asarray(sendbuf)
-    rounds, state = _reduce_rounds(comm, mine, op, root)
-    result = (lambda s: s["acc"]) if comm.rank == root else _const(None)
-    return _launch(comm, rounds, result, "ireduce", state=state)
+    rounds, make_state, result = reduce_schedule(comm, sendbuf, op, root)
+    return _launch(comm, rounds, result, "ireduce", state=make_state())
 
 
-def iallreduce(comm, sendbuf, op: Op) -> NbcRequest:
+def allreduce_schedule(comm, sendbuf, op: Op):
     """Recursive doubling, one round per step.  Non-pof2 folds *adjacent
     pairs* (rank 2r into 2r+1) in pre/post rounds, exactly as the blocking
     allreduce_recursive_doubling, keeping every surviving rank's block
@@ -279,7 +302,7 @@ def iallreduce(comm, sendbuf, op: Op) -> NbcRequest:
     size, rank = comm.size, comm.rank
     mine = np.asarray(sendbuf)
     if size == 1:
-        return _launch(comm, [], _const(mine), "iallreduce")
+        return [], dict, _const(mine)
     shape, dtype = mine.shape, mine.dtype
     pof2 = 1
     while pof2 * 2 <= size:
@@ -326,8 +349,12 @@ def iallreduce(comm, sendbuf, op: Op) -> NbcRequest:
             mask <<= 1
         if rank < 2 * rem:
             rounds.append(Round(sends=(((lambda s: s["acc"]), rank - 1),)))
-    return _launch(comm, rounds, lambda s: s["acc"], "iallreduce",
-                   state={"acc": mine})
+    return rounds, (lambda: {"acc": mine}), lambda s: s["acc"]
+
+
+def iallreduce(comm, sendbuf, op: Op) -> NbcRequest:
+    rounds, make_state, result = allreduce_schedule(comm, sendbuf, op)
+    return _launch(comm, rounds, result, "iallreduce", state=make_state())
 
 
 def igather(comm, sendbuf, root: int = 0) -> NbcRequest:
@@ -369,12 +396,12 @@ def iscatter(comm, sendbuf, root: int = 0) -> NbcRequest:
     return _launch(comm, rounds, lambda s: s["p"], "iscatter")
 
 
-def iallgather(comm, sendbuf) -> NbcRequest:
+def allgather_schedule(comm, sendbuf):
     """Ring: p-1 rounds of neighbor sendrecv."""
     size, rank = comm.size, comm.rank
     mine = np.asarray(sendbuf)
     if size == 1:
-        return _launch(comm, [], _const(mine[None]), "iallgather")
+        return [], dict, _const(mine[None])
     right = (rank + 1) % size
     left = (rank - 1) % size
     rounds = []
@@ -395,8 +422,12 @@ def iallgather(comm, sendbuf) -> NbcRequest:
     def result(state):
         return np.stack([state[f"b{r}"] for r in range(size)])
 
-    return _launch(comm, rounds, result, "iallgather",
-                   state={f"b{rank}": mine})
+    return rounds, (lambda: {f"b{rank}": mine}), result
+
+
+def iallgather(comm, sendbuf) -> NbcRequest:
+    rounds, make_state, result = allgather_schedule(comm, sendbuf)
+    return _launch(comm, rounds, result, "iallgather", state=make_state())
 
 
 def ialltoall(comm, sendbuf) -> NbcRequest:
@@ -440,7 +471,7 @@ def ireduce_scatter(comm, sendbuf, op: Op) -> NbcRequest:
     if not op.commutative:
         # rank order must be preserved (the ring below folds out of order):
         # one schedule = binomial-reduce rounds + a scatter round
-        rounds, state = _reduce_rounds(comm, arr, op, 0)
+        rounds, make_state = _reduce_rounds(comm, arr, op, 0)
         if rank == 0:
             def part(s, r):
                 return np.array_split(s["acc"].reshape(-1), size)[r]
@@ -448,10 +479,10 @@ def ireduce_scatter(comm, sendbuf, op: Op) -> NbcRequest:
             rounds.append(Round(sends=tuple(
                 ((lambda s, r=r: part(s, r)), r) for r in range(1, size))))
             return _launch(comm, rounds, lambda s: part(s, 0),
-                           "ireduce_scatter", state=state)
+                           "ireduce_scatter", state=make_state())
         rounds.append(Round(recvs=((0, "p"),)))
         return _launch(comm, rounds, lambda s: s["p"], "ireduce_scatter",
-                       state=state)
+                       state=make_state())
     flat = arr.reshape(-1)
     chunks = [c.copy() for c in np.array_split(flat, size)]
     right = (rank + 1) % size

@@ -73,7 +73,7 @@ from ompi_tpu.mpi.coll import base, coll_framework
 from ompi_tpu.mpi.constants import COMM_TYPE_SHARED, UNDEFINED, MPIException
 from ompi_tpu.mpi.op import Op
 
-__all__ = ["ShmColl", "Arena"]
+__all__ = ["ShmColl", "Arena", "PersistentSlots", "make_persistent_slots"]
 
 _log = output.get_stream("coll")
 
@@ -511,6 +511,66 @@ class Arena:
         return out
 
 
+class PersistentSlots(Arena):
+    """Pinned, parity-double-buffered slots for ONE bound persistent
+    plan (coll/persistent).
+
+    Layout: the Arena counter block (arrive/depart u64 ×p, cacheline
+    padded) followed by TWO full slot sets — no descriptor region (the
+    descriptor's job, shipping shape/dtype/verdict, was done once at
+    bind time).  Parity q = op-sequence mod 2 indexes the slot set, so
+    op k+1's publish lands in the slots op k is NOT draining: a rank
+    that finished waiting op k may immediately Start op k+1 while
+    slower ranks still read op k's parity — the double-buffered
+    overlap the btl rings and ``allreduce_segmented_ring`` use, lifted
+    to whole-operation granularity.  Slot reuse is guarded by the
+    depart counters two ops back (same-parity predecessor), never by a
+    per-op full barrier.
+
+    The counters keep the Arena semantics (monotonic u64, single
+    writer, ``memoryview.cast("Q")`` aligned stores), so every
+    inherited wait — including the FT fail-fast checks and the dead
+    -writer pid probe — applies unchanged.
+    """
+
+    def __init__(self, seg: shmseg.SharedSegment, size: int, rank: int,
+                 slot_bytes: int, nslots: int, world=None,
+                 pml=None) -> None:
+        super().__init__(seg, size, rank, slot_bytes, world=world, pml=pml)
+        self.nslots = nslots              # slots per parity set
+        self._slot_base = 2 * size * _CACHELINE   # no desc region
+
+    @staticmethod
+    def pnbytes_for(size: int, slot_bytes: int, nslots: int) -> int:
+        return 2 * size * _CACHELINE + 2 * nslots * slot_bytes
+
+    def pslot(self, parity: int, i: int) -> memoryview:
+        off = self._slot_base + (parity * self.nslots + i) * self.slot_bytes
+        return self.seg.buf[off:off + self.slot_bytes]
+
+    # non-blocking peeks (the poll half of a persistent op's test())
+    def arrive_at(self, r: int) -> int:
+        return int(self._flags[r * 8])
+
+    def depart_at(self, r: int) -> int:
+        return int(self._flags[(self.size + r) * 8])
+
+
+def make_persistent_slots(comm, slot_bytes: int,
+                          nslots: int) -> Optional["PersistentSlots"]:
+    """Collectively map a dedicated parity-slot segment for one bound
+    plan (the pinned-slot half of a persistent-collective bind).  None
+    ⇒ mapping failed somewhere — every rank falls back together."""
+    slot_bytes = max(0, (slot_bytes + 63) & ~63)
+    seg = _map_shared(
+        comm, max(PersistentSlots.pnbytes_for(comm.size, slot_bytes,
+                                              nslots), 1))
+    if seg is None:
+        return None
+    return PersistentSlots(seg, comm.size, comm.rank, slot_bytes, nslots,
+                           world=list(comm.group.ranks), pml=comm.pml)
+
+
 # ---------------------------------------------------------------------------
 # bootstrap + per-communicator state
 # ---------------------------------------------------------------------------
@@ -521,54 +581,61 @@ def _slot_bytes(size: int) -> int:
     return max(slot & ~15, 256)
 
 
-def _make_arena(comm) -> Optional[Arena]:
+def _map_shared(comm, nbytes: int) -> Optional[shmseg.SharedSegment]:
     """Collective over ``comm`` (whose ranks all share a host): rank 0
-    creates the segment, the path rides a base-algorithm bcast (plain
-    p2p — the arena cannot carry its own bootstrap), everyone attaches,
-    and a MIN-allreduce agrees the arena is usable everywhere before
-    the creator unlinks the name (mappings survive; crash cleanup is
-    free, like the btl/shm rings)."""
+    creates a segment of ``nbytes``, the path rides a base-algorithm
+    bcast (plain p2p — the arena cannot carry its own bootstrap),
+    everyone attaches, and a MIN-allreduce agrees the mapping is usable
+    everywhere before the creator unlinks the name (mappings survive;
+    crash cleanup is free, like the btl/shm rings).  None ⇒ some rank
+    could not map — every rank gets None together."""
     from ompi_tpu.mpi import op as op_mod
 
-    p = comm.size
-    slot = _slot_bytes(p)
-    world = list(comm.group.ranks)   # arena rank → world rank (probes)
     seg = None
     path = ""
     if comm.rank == 0:
         try:
             name = f"otpu-collshm-{os.getpid()}-{uuid.uuid4().hex[:10]}"
-            seg = shmseg.create(name, Arena.nbytes_for(p, slot))
+            seg = shmseg.create(name, nbytes)
             path = seg.path
         except OSError as e:
-            _log.verbose(1, "coll/shm: arena create failed (%s)", e)
+            _log.verbose(1, "coll/shm: segment create failed (%s)", e)
     got = base.bcast_binomial(
         comm, np.frombuffer(path.encode(), np.uint8)
         if comm.rank == 0 else None, 0)
     path = bytes(bytearray(np.asarray(got, np.uint8))).decode()
-    arena = None
+    mine: Optional[shmseg.SharedSegment] = None
     ok = 0
     if comm.rank == 0:
         if seg is not None:
-            arena = Arena(seg, p, 0, slot, world=world, pml=comm.pml)
-            ok = 1
+            mine, ok = seg, 1
     elif path:
         try:
-            aseg = shmseg.attach_retry(path, timeout=10.0)
-            arena = Arena(aseg, p, comm.rank, slot, world=world,
-                          pml=comm.pml)
+            mine = shmseg.attach_retry(path, timeout=10.0)
             ok = 1
         except OSError as e:
-            _log.verbose(1, "coll/shm: arena attach failed (%s)", e)
+            _log.verbose(1, "coll/shm: segment attach failed (%s)", e)
     allok = base.allreduce_recursive_doubling(
         comm, np.array([ok], np.int64), op_mod.MIN)
     if comm.rank == 0 and seg is not None:
         seg.unlink()   # attach agreement passed (or failed): name done
     if int(allok[0]) != 1:
-        if arena is not None:
-            arena.close()
+        if mine is not None:
+            mine.detach()
         return None
-    return arena
+    return mine
+
+
+def _make_arena(comm) -> Optional[Arena]:
+    """The one-shot dispatch arena: one ``_map_shared`` bootstrap with
+    the classic flags+desc+slots layout."""
+    p = comm.size
+    slot = _slot_bytes(p)
+    seg = _map_shared(comm, Arena.nbytes_for(p, slot))
+    if seg is None:
+        return None
+    return Arena(seg, p, comm.rank, slot,
+                 world=list(comm.group.ranks), pml=comm.pml)
 
 
 class _HostFallback:

@@ -62,6 +62,9 @@ class Communicator:
         # arena — built lazily by ompi_tpu.mpi.coll.shm on the first
         # collective, closed by free()
         self._coll_shm_state = None
+        # bound persistent-collective plans (weakrefs): free() releases
+        # their pinned slots and poisons later Starts
+        self._persistent_colls: list = []
         self.attrs: dict[Any, Any] = {}  # ≈ MPI attribute caching
         # error policy (≈ ompi_errhandler; default mirrors ERRORS_RETURN —
         # the MPIException propagating IS the returned error code here)
@@ -525,6 +528,81 @@ class Communicator:
 
         return nbc.ialltoallw(self, sendspecs, recvspecs)
 
+    # -- persistent collectives (≈ MPI_Barrier_init & friends, MPI-4 §6.12:
+    #    bind once via coll/persistent, Start forever) ----------------------
+
+    def barrier_init(self):
+        """≈ MPI_Barrier_init: inactive persistent barrier; arm with
+        .start() / Startall."""
+        from ompi_tpu.mpi.coll import persistent
+
+        return persistent.barrier_init(self)
+
+    def bcast_init(self, buf=None, root: int = 0):
+        """≈ MPI_Bcast_init: the root's ``buf`` is re-read at each
+        start; a non-root ndarray ``buf`` becomes the landing buffer
+        filled at each wait."""
+        from ompi_tpu.mpi.coll import persistent
+
+        return persistent.bcast_init(self, buf, root)
+
+    def reduce_init(self, sendbuf, op=None, root: int = 0):
+        """≈ MPI_Reduce_init."""
+        from ompi_tpu.mpi import op as op_mod
+        from ompi_tpu.mpi.coll import persistent
+
+        return persistent.reduce_init(self, sendbuf, op or op_mod.SUM,
+                                      root)
+
+    def allreduce_init(self, sendbuf, op=None):
+        """≈ MPI_Allreduce_init."""
+        from ompi_tpu.mpi import op as op_mod
+        from ompi_tpu.mpi.coll import persistent
+
+        return persistent.allreduce_init(self, sendbuf,
+                                         op or op_mod.SUM)
+
+    def allgather_init(self, sendbuf):
+        """≈ MPI_Allgather_init."""
+        from ompi_tpu.mpi.coll import persistent
+
+        return persistent.allgather_init(self, sendbuf)
+
+    # -- partitioned point-to-point (≈ MPI_Psend_init/Precv_init, MPI-4 §4:
+    #    Pready/Parrived ride the PML) -------------------------------------
+
+    def psend_init(self, buf, dest: int, tag: int = 0,
+                   partitions: int = 1):
+        """≈ MPI_Psend_init: partitioned persistent send — start()
+        activates, Pready(i) publishes partition i (a zero-copy view
+        of the bound buffer), wait() completes once every partition
+        was readied and sent."""
+        if not self._send_args_ok(dest, tag):
+            from ompi_tpu.mpi.pml import PartitionedSendRequest
+
+            return PartitionedSendRequest(self.pml, buf, None, tag,
+                                          self.cid, partitions)
+        return self.pml.psend_init(buf, self.world_rank(dest), tag,
+                                   self.cid, partitions)
+
+    def precv_init(self, buf, source: int = 0, tag: int = 0,
+                   partitions: int = 1):
+        """≈ MPI_Precv_init: partitioned persistent recv into ``buf``;
+        Parrived(i) polls partition i, wait() returns the filled
+        buffer."""
+        ok, src = self._recv_args_ok(source)
+        if not ok or source == ANY_SOURCE:
+            if source == ANY_SOURCE:
+                self._raise(MPIException(
+                    "precv_init: ANY_SOURCE is not supported for "
+                    "partitioned receives (matching is per-channel)",
+                    error_class=6))
+            from ompi_tpu.mpi.pml import PartitionedRecvRequest
+
+            return PartitionedRecvRequest(self.pml, buf, None, tag,
+                                          self.cid, partitions)
+        return self.pml.precv_init(buf, src, tag, self.cid, partitions)
+
     # -- fault tolerance (ULFM: ≈ MPIX_Comm_revoke/shrink/agree,
     #    mpi/ft.py — the extension-style API shipped ahead of
     #    standardization, MPI-Advance precedent) ---------------------------
@@ -645,11 +723,18 @@ class Communicator:
                 keyval.delete_fn(self, value)
 
     def free(self) -> None:
-        """≈ MPI_Comm_free: run attribute delete callbacks and release
-        the coll/shm arena mapping, if one was built.  (Transport
-        teardown belongs to the runtime, not individual communicators.)"""
+        """≈ MPI_Comm_free: run attribute delete callbacks, release
+        the coll/shm arena mapping if one was built, and free every
+        bound persistent-collective plan (their pinned slots detach;
+        a later Start on them raises).  (Transport teardown belongs to
+        the runtime, not individual communicators.)"""
         for kv in list(self.attrs):
             self.delete_attr(kv)
+        for ref in getattr(self, "_persistent_colls", ()):
+            req = ref()
+            if req is not None:
+                req.free()
+        self._persistent_colls = []
         st = self._coll_shm_state
         if st is not None and hasattr(st, "close"):
             st.close()

@@ -42,7 +42,8 @@ from ompi_tpu.mpi.constants import (
     MPIException,
 )
 from ompi_tpu.mpi.datatype import Datatype
-from ompi_tpu.mpi.request import Request, Status
+from ompi_tpu.mpi.request import (CompletedRequest, PersistentRequest,
+                                  Request, Status)
 
 __all__ = ["pml_framework", "PmlOb1", "RecvRequest", "Message",
            "MESSAGE_NO_PROC"]
@@ -1904,6 +1905,280 @@ class PmlOb1:
             except Exception:  # noqa: BLE001 — callbacks may raise
                 _log.error("send-failure callback raised\n%s",
                            __import__("traceback").format_exc())
+
+    # -- partitioned point-to-point (≈ MPI_Psend_init/Precv_init, MPI-4
+    #    §4.2: partitions of one bound buffer published independently) ----
+
+    def _part_offset(self, direction: str, peer: int, tag: int,
+                     cid: int, partitions: int) -> int:
+        """The n-th psend_init toward (peer, tag, cid) pairs with the
+        peer's n-th precv_init from me — a local per-endpoint counter
+        realises MPI's init-order matching rule with zero traffic.
+        The counter is CUMULATIVE in partitions, so every init owns a
+        disjoint block of partition slots in the wire-tag space even
+        when channels on the same key use different partition counts
+        (both sides must init in the same order with the same counts —
+        the pairing contract)."""
+        with self._lock:
+            chans = self.__dict__.setdefault("_part_chan", {})
+            key = (direction, peer, tag, cid)
+            off = chans.get(key, 0)
+            chans[key] = off + partitions
+            return off
+
+    def cancel_recv(self, req) -> None:
+        """Dequeue a posted recv so a late frame can no longer complete
+        it (the Startall-rollback analog of the FT poisoning dequeue)."""
+        with self._lock:
+            if self._eng is not None:
+                self._eng.cancel(req.cid, req)
+            else:
+                m = self._matching.get(req.cid)
+                if m is not None:
+                    try:
+                        m.posted.remove(req)
+                    except ValueError:
+                        pass
+        req.cancel()
+
+    def psend_init(self, buf, peer: int, tag: int, cid: int,
+                   partitions: int) -> "PartitionedSendRequest":
+        return PartitionedSendRequest(
+            self, buf, peer, tag, cid, partitions,
+            offset=self._part_offset("send", peer, tag, cid, partitions))
+
+    def precv_init(self, buf, peer: int, tag: int, cid: int,
+                   partitions: int) -> "PartitionedRecvRequest":
+        return PartitionedRecvRequest(
+            self, buf, peer, tag, cid, partitions,
+            offset=self._part_offset("recv", peer, tag, cid,
+                                     partitions))
+
+
+# ---------------------------------------------------------------------------
+# partitioned requests (MPI-4 §4.2)
+# ---------------------------------------------------------------------------
+
+# partition messages ride the reserved internal tag space far below the
+# collective/nbc/OSC/neighbor windows (which bottom out around -1891):
+# wire tag = BASE - tag·STRIDE - (cumulative offset + partition), so
+# distinct user tags own disjoint STRIDE-wide blocks and distinct inits
+# on one (peer, tag, cid) own disjoint partition-slot ranges — no two
+# live partitioned operations can ever share a wire tag, and Pready
+# order never matters
+_PART_WIRE_BASE = -1_000_000
+_PART_TAG_STRIDE = 1 << 24      # partition slots per user tag
+
+
+class _PartitionedBase(PersistentRequest):
+    """Shared half of psend/precv: one bound C-contiguous buffer split
+    into ``partitions`` flat views (``np.array_split`` boundaries — the
+    trailing partitions may be one element shorter), each riding the
+    PML as an ordinary zero-copy message on its own derived wire tag.
+    Sender and receiver must init channels on a (peer, tag) pair in
+    the same order with the same partition counts (the pairing
+    contract).  ``peer is None`` ⇒ the PROC_NULL inert form
+    (everything trivially completes).  Start/wait/Startall compose
+    exactly like any other persistent request."""
+
+    def __init__(self, pml, buf, peer: Optional[int], tag: int, cid: int,
+                 partitions: int, offset: int = 0,
+                 kind: str = "partitioned") -> None:
+        n = int(partitions)
+        if n < 1:
+            raise MPIException(f"{kind}_init: partitions must be >= 1 "
+                               f"(got {partitions})")
+        if offset + n > _PART_TAG_STRIDE:
+            raise MPIException(
+                f"{kind}_init: partition-slot space for tag {tag} "
+                f"exhausted ({_PART_TAG_STRIDE} cumulative partitions "
+                f"per (peer, tag) pair)")
+        arr = np.asarray(buf)
+        if not arr.flags["C_CONTIGUOUS"]:
+            raise MPIException(
+                f"{kind}_init: partitioned operations need a "
+                f"C-contiguous buffer (partitions are zero-copy views)")
+        self._pml = pml
+        self._peer = peer
+        self._tag = tag
+        self._cid = cid
+        self._npart = n
+        self._off = int(offset)
+        self._arr = arr
+        self._parts = np.array_split(arr.reshape(-1), n)
+        self._plock = threading.Lock()
+        self._op: Optional[Request] = None
+        self._preqs: list = [None] * n
+        self._ndone = 0
+        self._fail: Optional[BaseException] = None
+        super().__init__(self._activate, kind=kind)
+
+    def _ptag(self, i: int) -> int:
+        return (_PART_WIRE_BASE - self._tag * _PART_TAG_STRIDE
+                - (self._off + i))
+
+    def _check_started(self) -> None:
+        ft = self._pml.ft
+        if ft is not None:
+            ft.check_cid(self._cid)
+        trace_mod.count("pml_partitioned_starts_total")
+
+    def _part_done(self, r: Request) -> None:
+        op = self._op
+        with self._plock:
+            if getattr(r, "_exc", None) is not None \
+                    and self._fail is None:
+                self._fail = r._exc
+            self._ndone += 1
+            fire = self._ndone == self._npart
+            fail = self._fail
+        if fire and op is not None:
+            if fail is not None:
+                op.fail(fail)
+            else:
+                op.complete(self._result_value())
+
+    def _result_value(self):
+        return None
+
+
+class PartitionedSendRequest(_PartitionedBase):
+    """≈ MPI_Psend_init: start() activates (nothing moves), Pready(i)
+    publishes partition i, wait() completes once every partition was
+    readied AND sent.  Waiting with unready partitions raises (the MPI
+    erroneous case, surfaced instead of hanging)."""
+
+    def __init__(self, pml, buf, peer, tag, cid, partitions,
+                 offset: int = 0) -> None:
+        super().__init__(pml, buf, peer, tag, cid, partitions,
+                         offset=offset, kind="psend")
+
+    def _activate(self) -> Request:
+        self._check_started()
+        with self._plock:
+            self._readied = [False] * self._npart
+            self._preqs = [None] * self._npart
+            self._ndone = 0
+            self._fail = None
+        if self._peer is None:       # PROC_NULL: trivially complete
+            self._op = None
+            return CompletedRequest(None, kind="psend")
+        self._op = Request(kind="psend-op")
+        return self._op
+
+    def pready(self, partition: int) -> None:
+        """≈ MPI_Pready: partition ``partition`` of the bound buffer is
+        final — send it (a zero-copy view rides the PML now)."""
+        i = int(partition)
+        if not 0 <= i < self._npart:
+            raise MPIException(
+                f"Pready: partition {i} out of range [0, {self._npart})")
+        if self._inner is None:
+            raise MPIException(
+                "Pready on an inactive partitioned send (start() first)")
+        with self._plock:
+            if self._readied[i]:
+                raise MPIException(
+                    f"Pready: partition {i} already readied this start")
+            self._readied[i] = True
+        trace_mod.count("pml_partitioned_pready_total")
+        if self._peer is None:
+            return
+        req = self._pml.isend(self._parts[i], self._peer, self._ptag(i),
+                              self._cid)
+        with self._plock:
+            self._preqs[i] = req
+        req.add_completion_callback(self._part_done)
+
+    def pready_range(self, low: int, high: int) -> None:
+        """≈ MPI_Pready_range (inclusive bounds, like the binding)."""
+        for i in range(int(low), int(high) + 1):
+            self.pready(i)
+
+    def pready_list(self, partitions) -> None:
+        """≈ MPI_Pready_list."""
+        for i in partitions:
+            self.pready(i)
+
+    def wait(self, timeout: Optional[float] = None):
+        if self._inner is not None and not self._inner.done():
+            with self._plock:
+                unready = self._npart - sum(self._readied)
+            if unready:
+                raise MPIException(
+                    f"wait on a partitioned send with {unready} unready "
+                    f"partition(s) — Pready them first (erroneous per "
+                    f"MPI-4 §4.2.2, surfaced instead of hanging)")
+        return super().wait(timeout=timeout)
+
+
+class PartitionedRecvRequest(_PartitionedBase):
+    """≈ MPI_Precv_init: start() posts every partition's receive into
+    its zero-copy view of the bound buffer; Parrived(i) polls one
+    partition; wait() returns the filled buffer."""
+
+    def __init__(self, pml, buf, peer, tag, cid, partitions,
+                 offset: int = 0) -> None:
+        super().__init__(pml, buf, peer, tag, cid, partitions,
+                         offset=offset, kind="precv")
+        if not self._arr.flags.writeable:
+            raise MPIException("precv_init: receive buffer is read-only")
+
+    def _result_value(self):
+        return self._arr
+
+    def _activate(self) -> Request:
+        self._check_started()
+        with self._plock:
+            self._preqs = [None] * self._npart
+            self._ndone = 0
+            self._fail = None
+        if self._peer is None:       # PROC_NULL: nothing will arrive
+            self._op = None
+            return CompletedRequest(self._arr, kind="precv")
+        self._op = Request(kind="precv-op")
+        for i in range(self._npart):
+            req = self._pml.irecv(self._parts[i], self._peer,
+                                  self._ptag(i), self._cid)
+            with self._plock:
+                self._preqs[i] = req
+            req.add_completion_callback(self._part_done)
+        return self._op
+
+    def parrived(self, partition: int) -> bool:
+        """≈ MPI_Parrived: has partition ``partition`` of the CURRENT
+        operation landed?  True on an inactive request (the last
+        operation delivered everything)."""
+        i = int(partition)
+        if not 0 <= i < self._npart:
+            raise MPIException(
+                f"Parrived: partition {i} out of range "
+                f"[0, {self._npart})")
+        if self._inner is None or self._peer is None:
+            return True
+        with self._plock:
+            req = self._preqs[i]
+        return req is not None and req.done()
+
+    def cancel(self) -> None:
+        with self._plock:
+            reqs = [r for r in self._preqs if r is not None]
+        for r in reqs:
+            r.cancel()
+        super().cancel()
+
+    def _abandon(self) -> None:
+        # Startall rollback: the posted partition irecvs must be
+        # DEQUEUED, not just flagged — left behind they would be
+        # FIFO-first on their wire tags and swallow the next
+        # activation's partitions (wait() would then hang forever)
+        with self._plock:
+            reqs = [r for r in self._preqs if r is not None]
+            self._preqs = [None] * self._npart
+        for r in reqs:
+            self._pml.cancel_recv(r)
+        self._op = None
+        super()._abandon()
 
 
 @pml_framework.component

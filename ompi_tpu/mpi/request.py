@@ -263,11 +263,45 @@ class PersistentRequest(Request):
         """≈ MPI_Request_free."""
         self._inner = None
 
+    def _abandon(self) -> None:
+        """Deactivate after a failed Startall sibling: cancel whatever
+        the start launched and return to inactive WITHOUT transferring
+        its status — the caller never observed this incarnation, so the
+        request must look exactly as it did before the Startall."""
+        inner, self._inner = self._inner, None
+        if inner is not None:
+            try:
+                inner.cancel()
+            except Exception:  # noqa: BLE001 — best-effort rollback
+                pass
+
 
 def start_all(requests: Sequence[PersistentRequest]) -> None:
-    """≈ MPI_Startall."""
-    for r in requests:
-        r.start()
+    """≈ MPI_Startall — all-or-nothing: when any start() raises (revoked
+    communicator, dead peer, freed plan), the requests already started
+    by THIS call are deactivated again before the error propagates.
+    Without the rollback a failed Startall left a mix of active and
+    inactive requests with no way for the caller to reconcile which
+    were which (restarting the active ones raised, waiting the
+    inactive ones hung).
+
+    Scope: the rollback restores the LOCAL handle state (requests that
+    dequeue their posted receives do so — partitioned recvs; already
+    -sent wire frames cannot be unsent).  For collective plans that is
+    sufficient exactly when the failure is uniform across the
+    communicator — the revoke/free/death conditions the gate checks
+    are comm-wide, and MPI already requires every rank to Startall the
+    same operations in the same order, so all ranks abandon the same
+    op and the residue pairs off symmetrically."""
+    started = []
+    try:
+        for r in requests:
+            r.start()
+            started.append(r)
+    except BaseException:
+        for r in started:
+            r._abandon()
+        raise
 
 
 class CompletedRequest(Request):

@@ -162,6 +162,22 @@ _COUNTER_SPECS = (
     ("ft_fenced_frames_total", "frames",
      "stale-incarnation FT control frames dropped by the rejoin fence "
      "(sent by, or stamped for, a dead life of a revived rank)"),
+    # persistent collectives (coll/persistent: bind-once plans)
+    ("coll_persistent_binds_total", "plans",
+     "persistent-collective plans compiled by *_init — rules decision, "
+     "arena slots, hierarchy splits, and nbc rounds all frozen once"),
+    ("coll_persistent_starts_total", "operations",
+     "Start publishes of bound persistent-collective plans (the "
+     "steady-state path that skips per-op dispatch entirely)"),
+    ("coll_persistent_rebinds_total", "plans",
+     "persistent plans re-compiled by rebind() after invalidation (a "
+     "selfheal-revived member's slot pin went stale)"),
+    # MPI-4 partitioned point-to-point (pml)
+    ("pml_partitioned_starts_total", "operations",
+     "partitioned send/recv activations (Start on a psend_init/"
+     "precv_init request)"),
+    ("pml_partitioned_pready_total", "partitions",
+     "partitions published by Pready on active partitioned sends"),
 )
 
 #: plain-int counter store: dict increments, no lock — losses under

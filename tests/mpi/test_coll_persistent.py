@@ -1,0 +1,621 @@
+"""Persistent collectives (coll/persistent): bind once, Start forever.
+
+Covers the ISSUE-10 satellite matrix: bit-parity fuzz against the
+one-shot path on every provider (shm arena / hier / nbc / host
+directive / self), Start-after-revoke/free/stale poison semantics,
+parity double-buffer overlap correctness (including interleaved with
+the one-shot segmented pipeline on the same communicator), Startall
+composition + the all-or-nothing rollback, and the pvar accounting
+the CI smoke asserts."""
+
+from __future__ import annotations
+
+import random
+import time
+
+import numpy as np
+import pytest
+
+from ompi_tpu.core.config import var_registry
+from ompi_tpu.mpi import op as op_mod
+from ompi_tpu.mpi import trace
+from ompi_tpu.mpi.coll import shm as _shm  # noqa: F401 — register vars
+from ompi_tpu.mpi.constants import ERR_REVOKED, MPIException
+from ompi_tpu.mpi.request import request_get_status, start_all
+from tests.mpi.harness import run_ranks
+
+
+@pytest.fixture
+def host_only():
+    var_registry.set("coll_shm_enable", False)
+    yield
+    var_registry.set("coll_shm_enable", True)
+
+
+def _loop(req, buf, fill, iters):
+    outs = []
+    for k in range(iters):
+        fill(buf, k)
+        req.start()
+        out = req.wait()
+        outs.append(None if out is None else np.copy(out))
+    return outs
+
+
+# ---------------------------------------------------------------------------
+# provider selection + steady-state parity (flat arena)
+# ---------------------------------------------------------------------------
+
+def test_arena_provider_full_kind_sweep():
+    N, iters = 4, 5
+
+    def body(comm):
+        r = comm.rank
+        a = np.zeros(8)
+        ar = comm.allreduce_init(a)
+        all_outs = _loop(ar, a, lambda b, k: b.__setitem__(
+            ..., np.arange(8.0) + r + k), iters)
+        pay = np.zeros(3)
+        land = np.zeros(3)
+        bc = comm.bcast_init(pay if r == 1 else land, root=1)
+        b_outs = _loop(bc, pay, lambda b, k: b.__setitem__(
+            ..., np.array([k, k + 1.0, k + 2.0])) if r == 1 else None,
+            iters)
+        red = comm.reduce_init(np.full(4, r + 1.0), root=2)
+        red.start()
+        red_out = red.wait()
+        ga = comm.allgather_init(np.array([r, 10 * r]))
+        ga.start()
+        g = ga.wait()
+        bar = comm.barrier_init()
+        bar.start()
+        bar.wait()
+        provs = {q.provider for q in (ar, bc, red, ga, bar)}
+        return all_outs, b_outs, red_out, g, provs
+
+    for r, (all_outs, b_outs, red_out, g, provs) in enumerate(
+            run_ranks(N, body)):
+        assert provs == {"shm"}
+        for k, o in enumerate(all_outs):
+            assert np.array_equal(
+                o, np.arange(8.0) * N + sum(range(N)) + N * k), (k, o)
+        for k, o in enumerate(b_outs):
+            assert np.array_equal(o, [k, k + 1.0, k + 2.0]), (k, o)
+        if r == 2:
+            assert np.array_equal(red_out, np.full(4, 10.0))
+        else:
+            assert red_out is None
+        assert np.array_equal(g, [[i, 10 * i] for i in range(N)])
+
+
+def test_bcast_lands_in_bound_recvbuf_every_cycle():
+    def body(comm):
+        pay = np.zeros(4)
+        land = np.full(4, -1.0)
+        req = comm.bcast_init(pay if comm.rank == 0 else land, root=0)
+        hits = []
+        for k in range(4):
+            pay[...] = k + np.arange(4.0)
+            req.start()
+            out = req.wait()
+            if comm.rank != 0:
+                hits.append(out is land and np.array_equal(
+                    land, k + np.arange(4.0)))
+        return hits
+
+    res = run_ranks(3, body)
+    assert all(all(h) for h in res[1:])
+
+
+# ---------------------------------------------------------------------------
+# bit-parity fuzz vs the one-shot path, all providers
+# ---------------------------------------------------------------------------
+
+_FUZZ_DTYPES = (np.float64, np.float32, np.int64, np.int32, np.uint8)
+
+
+def _fuzz_body(seed, iters):
+    def body(comm):
+        rng = np.random.default_rng(seed + comm.rank)
+        shape = tuple(int(x) for x in
+                      np.random.default_rng(seed).integers(1, 7, size=2))
+        dt = _FUZZ_DTYPES[seed % len(_FUZZ_DTYPES)]
+        mine = np.zeros(shape, dt)
+        ar = comm.allreduce_init(mine)
+        ga = comm.allgather_init(mine)
+        pairs = []
+        for k in range(iters):
+            mine[...] = rng.integers(0, 50, size=shape).astype(dt)
+            ar.start()
+            got = ar.wait()
+            want = comm.allreduce(mine)          # one-shot, same data
+            ga.start()
+            g_got = ga.wait()
+            g_want = comm.allgather(mine)
+            pairs.append((np.array_equal(got, want),
+                          np.array_equal(g_got, g_want)))
+        return ar.provider, pairs
+    return body
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_fuzz_parity_vs_oneshot_shm(seed):
+    for prov, pairs in run_ranks(4, _fuzz_body(seed, 6)):
+        assert prov == "shm"
+        assert all(a and g for a, g in pairs)
+
+
+@pytest.mark.parametrize("seed", [0, 2])
+def test_fuzz_parity_vs_oneshot_host(seed, host_only):
+    for prov, pairs in run_ranks(3, _fuzz_body(seed, 4)):
+        assert prov == "nbc"
+        assert all(a and g for a, g in pairs)
+
+
+@pytest.mark.parametrize("hosts", [
+    ("a", "a", "b", "b"),
+    ("a", "b", "b", "b"),
+    ("a", "b", "a", "b"),
+])
+def test_fuzz_parity_vs_oneshot_hier(hosts):
+    def body(comm):
+        comm._io_host_override = hosts[comm.rank]
+        return _fuzz_body(1, 4)(comm)
+
+    for prov, pairs in run_ranks(len(hosts), body):
+        assert prov == "hier"
+        assert all(a and g for a, g in pairs)
+
+
+def test_noncommutative_binds_nbc_and_matches():
+    """Non-commutative ops can't use the arena fold — the bind must
+    land on the rank-ordered nbc schedule and still match one-shot."""
+    def body(comm):
+        mine = np.zeros((2, 2))
+        req = comm.allreduce_init(mine, op=op_mod.REPLACE)
+        mine[...] = comm.rank + 1.0
+        req.start()
+        got = req.wait()
+        want = comm.allreduce(mine, op=op_mod.REPLACE)
+        return req.provider, np.array_equal(got, want)
+
+    for prov, ok in run_ranks(3, body):
+        assert prov == "nbc" and ok
+
+
+def test_payload_above_cap_binds_nbc():
+    def body(comm):
+        big = np.ones(
+            int(var_registry.get("coll_shm_arena_size")) // 8 + 16)
+        req = comm.allreduce_init(big)
+        req.start()
+        out = req.wait()
+        return req.provider, float(out[0])
+
+    for prov, v in run_ranks(2, body):
+        assert prov == "nbc" and v == 2.0
+
+
+def test_host_directive_freezes_named_algorithm():
+    var_registry.set("coll_host_allreduce_algorithm", "ring")
+    try:
+        def body(comm):
+            req = comm.allreduce_init(np.arange(6.0) + comm.rank)
+            req.start()
+            return req.provider, req.wait()
+
+        for prov, out in run_ranks(3, body):
+            assert prov == "host"
+            assert np.array_equal(out, np.arange(6.0) * 3 + 3)
+    finally:
+        var_registry.set("coll_host_allreduce_algorithm", "")
+
+
+def test_size_one_self_provider():
+    def body(comm):
+        ar = comm.allreduce_init(np.arange(3.0))
+        ar.start()
+        a = ar.wait()
+        ga = comm.allgather_init(np.array([7]))
+        ga.start()
+        g = ga.wait()
+        bar = comm.barrier_init()
+        bar.start()
+        bar.wait()
+        return ar.provider, a, g
+
+    prov, a, g = run_ranks(1, body)[0]
+    assert prov == "self"
+    assert np.array_equal(a, np.arange(3.0))
+    assert np.array_equal(g, [[7]])
+
+
+# ---------------------------------------------------------------------------
+# parity double-buffer overlap
+# ---------------------------------------------------------------------------
+
+def test_parity_overlap_staggered_drains():
+    """Fast ranks Start op k+1 (other parity) while slow ranks still
+    drain op k; the depart guard two ops back must keep every value
+    intact under randomized stagger."""
+    N, iters = 3, 25
+
+    def body(comm):
+        rng = random.Random(101 + comm.rank)
+        buf = np.zeros(16)
+        req = comm.allreduce_init(buf)
+        outs = []
+        for k in range(iters):
+            buf[...] = 10.0 * k + comm.rank
+            req.start()
+            if rng.random() < 0.5:
+                time.sleep(rng.random() * 0.002)   # delay my drain
+            outs.append(req.wait().copy())
+            if rng.random() < 0.3:
+                time.sleep(rng.random() * 0.002)   # delay my next start
+        return req.provider, outs
+
+    for prov, outs in run_ranks(N, body):
+        assert prov == "shm"
+        for k, o in enumerate(outs):
+            assert np.array_equal(
+                o, np.full(16, 10.0 * k * N + sum(range(N)))), (k, o)
+
+
+def test_parity_overlap_root_runahead_bcast():
+    """The bcast root's wait is trivial, so it free-runs: without the
+    parity slots + k-2 depart guard its op k+1 publish would clobber
+    the result slow readers are still draining."""
+    N, iters = 4, 20
+
+    def body(comm):
+        pay = np.zeros(8)
+        land = np.zeros(8)
+        req = comm.bcast_init(pay if comm.rank == 0 else land, root=0)
+        outs = []
+        for k in range(iters):
+            if comm.rank == 0:
+                pay[...] = k * 3.0 + np.arange(8.0)
+            req.start()
+            if comm.rank == N - 1:
+                time.sleep(0.001)                  # the slow reader
+            out = req.wait()
+            outs.append(np.copy(out))
+        return outs
+
+    for outs in run_ranks(N, body):
+        for k, o in enumerate(outs):
+            assert np.array_equal(o, k * 3.0 + np.arange(8.0)), (k, o)
+
+
+def test_persistent_interleaves_with_oneshot_segmented_pipeline():
+    """Persistent ops and one-shot collectives (including payloads big
+    enough to ride the one-shot arena's segmented slot-half pipeline)
+    share the communicator; both must stay bit-correct."""
+    def body(comm):
+        r = comm.rank
+        buf = np.zeros(4)
+        req = comm.allreduce_init(buf)
+        big = np.ones(100_000) * (r + 1)           # > half a slot
+        oks = []
+        for k in range(6):
+            buf[...] = k + r
+            req.start()
+            p = req.wait()
+            big_out = comm.allreduce(big)          # segmented one-shot
+            oks.append(
+                np.array_equal(p, np.full(4, 3 * k + 3))
+                and float(big_out[0]) == 6.0)
+        return req.provider, oks
+
+    for prov, oks in run_ranks(3, body):
+        assert prov == "shm" and all(oks)
+
+
+# ---------------------------------------------------------------------------
+# Startall composition + all-or-nothing rollback
+# ---------------------------------------------------------------------------
+
+def test_startall_composes_coll_and_p2p():
+    def body(comm):
+        r = comm.rank
+        bar = comm.barrier_init()
+        a = np.zeros(4)
+        ar = comm.allreduce_init(a)
+        a[...] = r
+        start_all([bar, ar])
+        bar.wait()
+        out = ar.wait()
+        return np.array_equal(out, np.full(4, sum(range(3))))
+
+    assert all(run_ranks(3, body))
+
+
+def test_startall_all_or_nothing_rollback():
+    """A failing start mid-Startall deactivates the already-started
+    requests — the survivor is restartable, not wedged active."""
+    def body(comm):
+        if comm.rank == 0:
+            # a psend start is inert (nothing moves before Pready), so
+            # the failed Startall has no wire side effects to unwind
+            ps = comm.psend_init(np.arange(4.0), dest=1, tag=9,
+                                 partitions=2)
+
+            def boom():
+                raise MPIException("boom")
+
+            from ompi_tpu.mpi.request import PersistentRequest
+
+            dead = PersistentRequest(boom)   # its start() raises
+            try:
+                start_all([ps, dead])
+                return "no-raise"
+            except MPIException:
+                pass
+            if ps.active:
+                return "left-active"
+            # the survivor still works end-to-end afterwards
+            start_all([ps])
+            ps.pready_range(0, 1)
+            ps.wait()
+            return True
+        pr = comm.precv_init(np.zeros(4), source=0, tag=9, partitions=2)
+        start_all([pr])
+        got = pr.wait()
+        return np.array_equal(got, np.arange(4.0))
+
+    assert all(r is True for r in run_ranks(2, body))
+
+
+# ---------------------------------------------------------------------------
+# FT poison semantics
+# ---------------------------------------------------------------------------
+
+def test_start_after_revoke_raises_err_revoked():
+    def body(comm):
+        req = comm.allreduce_init(np.ones(4))
+        req.start()
+        req.wait()
+        comm.barrier()
+        comm.revoke()
+        try:
+            req.start()
+            return None
+        except MPIException as e:
+            return e.error_class
+
+    assert all(c == ERR_REVOKED for c in run_ranks(2, body))
+
+
+def test_init_on_revoked_comm_raises():
+    def body(comm):
+        comm.barrier()
+        comm.revoke()
+        try:
+            comm.barrier_init()
+            return None
+        except MPIException as e:
+            return e.error_class
+
+    assert all(c == ERR_REVOKED for c in run_ranks(2, body))
+
+
+def test_comm_free_releases_pinned_slots_and_poisons():
+    def body(comm):
+        req = comm.allreduce_init(np.ones(2))
+        req.start()
+        req.wait()
+        comm.barrier()
+        comm.free()
+        assert req.provider is None     # plan released
+        try:
+            req.start()
+            return None
+        except MPIException as e:
+            return "freed" in str(e)
+
+    assert all(run_ranks(2, body))
+
+
+def test_request_free_then_start_raises():
+    def body(comm):
+        req = comm.barrier_init()
+        req.start()
+        req.wait()
+        comm.barrier()
+        req.free()
+        try:
+            req.start()
+            return False
+        except MPIException:
+            return True
+
+    assert all(run_ranks(2, body))
+
+
+def test_revived_member_invalidates_then_rebind_recovers():
+    def body(comm):
+        req = comm.allreduce_init(np.ones(3))
+        req.start()
+        req.wait()
+        comm.barrier()
+        # simulate a selfheal revive of my neighbor: its epoch advances
+        comm.pml._peer_epoch[(comm.rank + 1) % comm.size] = 3
+        try:
+            req.start()
+            stale = False
+        except MPIException as e:
+            stale = "stale" in str(e)
+        req.rebind()
+        req.start()
+        out = req.wait()
+        return stale, float(out[0])
+
+    before = trace.counters["coll_persistent_rebinds_total"]
+    res = run_ranks(2, body)
+    assert all(stale and v == 2.0 for stale, v in res)
+    assert trace.counters["coll_persistent_rebinds_total"] == before + 2
+
+
+def test_member_death_fails_start_fast():
+    """A detector-declared-dead member (the rank-kill detection path:
+    launcher reap / gossip / arena probe all feed the same dead-set)
+    fails the next Start immediately with ERR_PROC_FAILED — no spin
+    into the coll_shm_timeout."""
+    from ompi_tpu.mpi import ft as ft_mod
+    from ompi_tpu.mpi.constants import ERR_PROC_FAILED
+
+    def body(comm):
+        req = comm.allreduce_init(np.ones(4))
+        req.start()
+        req.wait()
+        comm.barrier()
+        ft = ft_mod.pml_ft(comm.pml)
+        ft.detector.mark_failed((comm.rank + 1) % comm.size,
+                                "seeded kill (test)")
+        t0 = time.monotonic()
+        try:
+            req.start()
+            return None
+        except MPIException as e:
+            return e.error_class, time.monotonic() - t0 < 5.0
+
+    assert all(r == (ERR_PROC_FAILED, True) for r in run_ranks(2, body))
+
+
+def test_post_shrink_reinit_converges():
+    """The documented recovery: after a shrink, *_init on the survivor
+    communicator compiles a fresh working plan."""
+    def body(comm):
+        comm.barrier()
+        comm.revoke()
+        new = comm.shrink()
+        req = new.allreduce_init(np.full(4, new.rank + 1.0))
+        req.start()
+        return req.wait()
+
+    for out in run_ranks(3, body):
+        assert np.array_equal(out, np.full(4, 6.0))
+
+
+# ---------------------------------------------------------------------------
+# request semantics + accounting
+# ---------------------------------------------------------------------------
+
+def test_inactive_semantics_and_get_status():
+    def body(comm):
+        req = comm.allreduce_init(np.ones(2))
+        assert not req.active
+        assert req.test()                    # inactive: trivially done
+        flag, _st = request_get_status(req)
+        assert flag
+        req.start()
+        assert req.active
+        out = req.wait()
+        assert not req.active
+        req.start()                          # restart after wait
+        return float(req.wait()[0]) + float(out[0])
+
+    assert all(v == 2 * 3.0 for v in run_ranks(3, body))
+
+
+def test_double_start_raises():
+    def body(comm):
+        req = comm.allreduce_init(np.ones(2))
+        req.start()
+        try:
+            req.start()
+            return False
+        except MPIException:
+            pass
+        comm.barrier()   # let both ranks reach the same point
+        req.wait()
+        return True
+
+    assert all(run_ranks(2, body))
+
+
+def test_bind_and_start_pvars_account():
+    binds0 = trace.counters["coll_persistent_binds_total"]
+    starts0 = trace.counters["coll_persistent_starts_total"]
+    N, iters = 2, 7
+
+    def body(comm):
+        req = comm.allreduce_init(np.ones(4))
+        for _ in range(iters):
+            req.start()
+            req.wait()
+        return True
+
+    assert all(run_ranks(N, body))
+    assert trace.counters["coll_persistent_binds_total"] - binds0 == N
+    assert (trace.counters["coll_persistent_starts_total"] - starts0
+            == N * iters)
+
+
+def test_mpi4py_facade_init_family():
+    """Barrier_init/Bcast_init/Allreduce_init/Psend_init/Precv_init +
+    Startall passthrough: the mpi4py-style loop ports unchanged, and
+    the Allreduce_init landing transform refills recvbuf every cycle
+    (not just the first)."""
+    from ompi_tpu.compat import MPI
+
+    def body(native):
+        comm = MPI.Comm(native)
+        r = comm.Get_rank()
+        send, recv = np.zeros(4), np.zeros(4)
+        req = comm.Allreduce_init(send, recv)
+        oks = []
+        for k in range(3):
+            send[...] = np.arange(4.0) + r + k
+            MPI.Prequest.Startall([req])
+            req.Wait()
+            oks.append(np.array_equal(
+                recv, np.arange(4.0) * 2 + 1 + 2 * k))
+        b = np.array([5.0, 6.0]) if r == 0 else np.zeros(2)
+        bq = comm.Bcast_init(b, root=0)
+        MPI.Request.Startall([bq])
+        bq.Wait()
+        oks.append(np.array_equal(b, [5.0, 6.0]))
+        bar = comm.Barrier_init()
+        bar.Start()
+        bar.Wait()
+        if r == 0:
+            pb = np.arange(6.0)
+            ps = comm.Psend_init(pb, 3, 1, tag=8)
+            ps.Start()
+            ps.Pready_range(0, 2)
+            ps.Wait()
+        else:
+            pb = np.zeros(6)
+            pr = comm.Precv_init(pb, 3, 0, tag=8)
+            pr.Start()
+            pr.Wait()
+            oks.append(pr.Parrived(2))
+            oks.append(np.array_equal(pb, np.arange(6.0)))
+        return all(oks)
+
+    assert all(run_ranks(2, body))
+
+
+def test_buffer_shape_change_raises_on_start():
+    def body(comm):
+        holder = {"buf": np.ones(4)}
+
+        class Reader:
+            def __array__(self, dtype=None):
+                return np.asarray(holder["buf"], dtype)
+
+        req = comm.allreduce_init(Reader())
+        req.start()
+        req.wait()
+        comm.barrier()
+        holder["buf"] = np.ones(9)          # signature change
+        try:
+            req.start()
+            return False
+        except MPIException as e:
+            comm.barrier()
+            return "changed" in str(e)
+
+    assert all(run_ranks(2, body))

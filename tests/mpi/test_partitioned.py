@@ -1,0 +1,343 @@
+"""MPI-4 partitioned point-to-point (psend_init/precv_init,
+Pready/Parrived) riding the PML.
+
+Covers the ISSUE-10 satellite: Pready ordering fuzz (partitions
+published in random permutations, trickled across iterations),
+Parrived polling, channel pairing by init order, the erroneous-cases
+surface (wait-before-ready, double Pready, out-of-range, inactive),
+PROC_NULL inertness, FT poisoning, and zero-copy landing into the
+bound receive buffer."""
+
+from __future__ import annotations
+
+import random
+import time
+
+import numpy as np
+import pytest
+
+from ompi_tpu.mpi import trace
+from ompi_tpu.mpi.constants import (
+    ERR_REVOKED, PROC_NULL, MPIException,
+)
+from tests.mpi.harness import run_ranks
+
+
+def _pair(nparts, n, iters, seed, trickle=False):
+    """rank 0 psends to rank 1 with a fuzzed Pready order per iter."""
+    def body(comm):
+        if comm.rank == 0:
+            buf = np.zeros(n)
+            req = comm.psend_init(buf, dest=1, tag=4, partitions=nparts)
+            for it in range(iters):
+                buf[...] = np.arange(float(n)) + 1000.0 * it
+                req.start()
+                order = list(range(nparts))
+                random.Random(seed + it).shuffle(order)
+                for i in order:
+                    req.pready(i)
+                    if trickle:
+                        time.sleep(0.0005)
+                req.wait()
+            return True
+        buf = np.full(n, -1.0)
+        req = comm.precv_init(buf, source=0, tag=4, partitions=nparts)
+        outs = []
+        for it in range(iters):
+            req.start()
+            got = req.wait()
+            assert got is buf                 # zero-copy landing
+            outs.append(buf.copy())
+        return outs
+    return body
+
+
+@pytest.mark.parametrize("nparts,n", [(1, 8), (3, 10), (4, 64), (7, 7)])
+def test_pready_order_fuzz_roundtrip(nparts, n):
+    res = run_ranks(2, _pair(nparts, n, iters=5, seed=nparts))
+    for it, out in enumerate(res[1]):
+        assert np.array_equal(out, np.arange(float(n)) + 1000.0 * it)
+
+
+def test_more_partitions_than_elements():
+    """np.array_split semantics: trailing partitions may be empty."""
+    res = run_ranks(2, _pair(6, 4, iters=3, seed=9))
+    for it, out in enumerate(res[1]):
+        assert np.array_equal(out, np.arange(4.0) + 1000.0 * it)
+
+
+def test_parrived_polls_partitions_independently():
+    """The receiver observes early partitions before the sender has
+    readied the rest — per-partition wire tags make arrival order
+    independent of Pready order."""
+    def body(comm):
+        if comm.rank == 0:
+            buf = np.arange(12.0)
+            req = comm.psend_init(buf, dest=1, tag=2, partitions=3)
+            req.start()
+            req.pready(2)                      # out of order, alone
+            comm.recv(source=1, tag=77)        # wait for the ack
+            req.pready_list([0, 1])
+            req.wait()
+            return True
+        buf = np.zeros(12)
+        req = comm.precv_init(buf, source=0, tag=2, partitions=3)
+        req.start()
+        deadline = time.monotonic() + 30
+        while not req.parrived(2):
+            assert time.monotonic() < deadline
+            time.sleep(0.001)
+        seen_early = (req.parrived(2), req.parrived(0))
+        # partition 2 landed in place before the others were readied
+        third = np.array_split(np.arange(12.0), 3)[2]
+        got_third = np.array_split(buf.reshape(-1), 3)[2].copy()
+        comm.send(np.zeros(0), dest=0, tag=77)
+        req.wait()
+        return seen_early, got_third, buf.copy()
+
+    res = run_ranks(2, body)
+    (arr2, arr0), third, full = res[1]
+    assert arr2 is True and arr0 is False
+    assert np.array_equal(third, np.array_split(np.arange(12.0), 3)[2])
+    assert np.array_equal(full, np.arange(12.0))
+
+
+def test_channel_pairing_by_init_order():
+    """Two psend/precv pairs on the SAME (peer, tag): the n-th init on
+    each side pairs with the n-th on the other, never cross-matching."""
+    def body(comm):
+        if comm.rank == 0:
+            a, b = np.full(6, 1.0), np.full(6, 2.0)
+            s1 = comm.psend_init(a, dest=1, tag=5, partitions=2)
+            s2 = comm.psend_init(b, dest=1, tag=5, partitions=3)
+            # publish the SECOND channel first: pairing must hold
+            s2.start()
+            s2.pready_range(0, 2)
+            s1.start()
+            s1.pready_range(0, 1)
+            s1.wait()
+            s2.wait()
+            return True
+        r1buf, r2buf = np.zeros(6), np.zeros(6)
+        r1 = comm.precv_init(r1buf, source=0, tag=5, partitions=2)
+        r2 = comm.precv_init(r2buf, source=0, tag=5, partitions=3)
+        r1.start()
+        r2.start()
+        r1.wait()
+        r2.wait()
+        return r1buf.copy(), r2buf.copy()
+
+    res = run_ranks(2, body)
+    r1, r2 = res[1]
+    assert np.array_equal(r1, np.full(6, 1.0))
+    assert np.array_equal(r2, np.full(6, 2.0))
+
+
+def test_distinct_tags_never_cross_match():
+    """Two channels to the same peer under DIFFERENT user tags must not
+    share wire tags (the tag rides the derived-tag block)."""
+    def body(comm):
+        if comm.rank == 0:
+            a, b = np.full(8, 1.0), np.full(8, 2.0)
+            s7 = comm.psend_init(a, dest=1, tag=7, partitions=4)
+            s9 = comm.psend_init(b, dest=1, tag=9, partitions=4)
+            # publish tag 9's partitions FIRST: with colliding wire
+            # tags they would complete tag 7's receives
+            s9.start()
+            s9.pready_range(0, 3)
+            s7.start()
+            s7.pready_range(0, 3)
+            s7.wait()
+            s9.wait()
+            return True
+        r7buf, r9buf = np.zeros(8), np.zeros(8)
+        r7 = comm.precv_init(r7buf, source=0, tag=7, partitions=4)
+        r9 = comm.precv_init(r9buf, source=0, tag=9, partitions=4)
+        r7.start()
+        r9.start()
+        r7.wait()
+        r9.wait()
+        return r7buf.copy(), r9buf.copy()
+
+    res = run_ranks(2, body)
+    r7, r9 = res[1]
+    assert np.array_equal(r7, np.full(8, 1.0))
+    assert np.array_equal(r9, np.full(8, 2.0))
+
+
+def test_mixed_partition_counts_same_tag_disjoint_blocks():
+    """Channels on one (peer, tag) with different partition counts own
+    disjoint cumulative slot blocks — no offset overlap."""
+    def body(comm):
+        if comm.rank == 0:
+            a, b = np.arange(8.0), np.arange(8.0) * 10
+            s1 = comm.psend_init(a, dest=1, tag=0, partitions=8)
+            s2 = comm.psend_init(b, dest=1, tag=0, partitions=2)
+            s2.start()
+            s2.pready_range(0, 1)    # would land in s1's slots 2,3
+            s1.start()               # under the old chan*npart scheme
+            s1.pready_range(0, 7)
+            s1.wait()
+            s2.wait()
+            return True
+        b1, b2 = np.zeros(8), np.zeros(8)
+        r1 = comm.precv_init(b1, source=0, tag=0, partitions=8)
+        r2 = comm.precv_init(b2, source=0, tag=0, partitions=2)
+        r1.start()
+        r2.start()
+        r1.wait()
+        r2.wait()
+        return b1.copy(), b2.copy()
+
+    res = run_ranks(2, body)
+    b1, b2 = res[1]
+    assert np.array_equal(b1, np.arange(8.0))
+    assert np.array_equal(b2, np.arange(8.0) * 10)
+
+
+def test_abandoned_precv_dequeues_posted_recvs():
+    """A Startall rollback on the recv side must dequeue the posted
+    partition irecvs — stale FIFO-first recvs would otherwise swallow
+    the retried activation's partitions and hang its wait."""
+    from ompi_tpu.mpi.request import PersistentRequest, start_all
+
+    def body(comm):
+        if comm.rank == 1:
+            buf = np.zeros(6)
+            pr = comm.precv_init(buf, source=0, tag=4, partitions=3)
+
+            def boom():
+                raise MPIException("boom")
+
+            try:
+                start_all([pr, PersistentRequest(boom)])
+                return "no-raise"
+            except MPIException:
+                pass
+            if pr.active:
+                return "left-active"
+            comm.send(np.zeros(0), dest=0, tag=99)   # sender may go
+            pr.start()                                # fresh posts
+            got = pr.wait()
+            return np.array_equal(got, np.arange(6.0))
+        comm.recv(source=1, tag=99)                   # post-rollback
+        ps = comm.psend_init(np.arange(6.0), dest=1, tag=4,
+                             partitions=3)
+        ps.start()
+        ps.pready_range(0, 2)
+        ps.wait()
+        return True
+
+    assert all(r is True for r in run_ranks(2, body))
+
+
+def test_restart_reuses_buffers_across_iterations():
+    res = run_ranks(2, _pair(4, 32, iters=8, seed=3, trickle=True))
+    assert len(res[1]) == 8
+
+
+# ---------------------------------------------------------------------------
+# erroneous-case surface
+# ---------------------------------------------------------------------------
+
+def test_error_surface():
+    def body(comm):
+        hits = {}
+        if comm.rank == 0:
+            buf = np.arange(6.0)
+            req = comm.psend_init(buf, dest=1, tag=1, partitions=3)
+            try:
+                req.pready(0)                     # inactive
+            except MPIException:
+                hits["inactive"] = True
+            req.start()
+            try:
+                req.wait()                        # nothing readied
+            except MPIException as e:
+                hits["unready"] = "unready" in str(e)
+            req.pready(1)
+            try:
+                req.pready(1)                     # double
+            except MPIException:
+                hits["double"] = True
+            try:
+                req.pready(3)                     # out of range
+            except MPIException:
+                hits["range"] = True
+            req.pready_list([0, 2])
+            req.wait()
+            try:
+                comm.psend_init(buf, dest=1, tag=1, partitions=0)
+            except MPIException:
+                hits["zero-parts"] = True
+            try:
+                comm.psend_init(np.arange(16.0).reshape(4, 4).T,
+                                dest=1, tag=1, partitions=2)
+            except MPIException:
+                hits["non-contig"] = True
+            return hits
+        buf = np.zeros(6)
+        req = comm.precv_init(buf, source=0, tag=1, partitions=3)
+        req.start()
+        req.wait()
+        try:
+            req.parrived(5)
+        except MPIException:
+            hits["parrived-range"] = True
+        ro = np.zeros(4)
+        ro.setflags(write=False)
+        try:
+            comm.precv_init(ro, source=0, tag=1, partitions=2)
+        except MPIException:
+            hits["read-only"] = True
+        return hits
+
+    res = run_ranks(2, body)
+    assert res[0] == {"inactive": True, "unready": True, "double": True,
+                      "range": True, "zero-parts": True,
+                      "non-contig": True}
+    assert res[1] == {"parrived-range": True, "read-only": True}
+
+
+def test_proc_null_inert():
+    def body(comm):
+        s = comm.psend_init(np.arange(4.0), dest=PROC_NULL, tag=0,
+                            partitions=2)
+        s.start()
+        s.pready(0)
+        s.pready(1)
+        s.wait()
+        rbuf = np.full(4, -2.0)
+        r = comm.precv_init(rbuf, source=PROC_NULL, tag=0, partitions=2)
+        r.start()
+        out = r.wait()
+        assert r.parrived(0)
+        return np.array_equal(rbuf, np.full(4, -2.0)) and out is not None
+
+    assert all(run_ranks(2, body))
+
+
+def test_start_after_revoke_raises():
+    def body(comm):
+        s = comm.psend_init(np.ones(4), dest=(comm.rank + 1) % 2,
+                            tag=3, partitions=2)
+        comm.barrier()
+        comm.revoke()
+        try:
+            s.start()
+            return None
+        except MPIException as e:
+            return e.error_class
+
+    assert all(c == ERR_REVOKED for c in run_ranks(2, body))
+
+
+def test_partitioned_pvars_account():
+    starts0 = trace.counters["pml_partitioned_starts_total"]
+    pready0 = trace.counters["pml_partitioned_pready_total"]
+    run_ranks(2, _pair(3, 9, iters=4, seed=0))
+    # 4 send starts + 4 recv starts; 4 iters x 3 partitions readied
+    assert (trace.counters["pml_partitioned_starts_total"] - starts0
+            == 8)
+    assert (trace.counters["pml_partitioned_pready_total"] - pready0
+            == 12)
