@@ -629,10 +629,15 @@ class ShmBTL:
                         n += hook(r)   # fused drain traces in the PML
                     else:
                         _t0 = (trace_mod.begin()
-                               if trace_mod.active else 0)
+                               if trace_mod.active
+                               or trace_mod.hist_active else 0)
                         got = r.poll(self.on_frame)
                         if got:
                             trace_mod.count("btl_shm_drained_total", got)
+                            if _t0 and trace_mod.hist_active:
+                                trace_mod.record_hist(
+                                    "btl_shm_drain_ns",
+                                    time.monotonic_ns() - _t0)
                             if _t0 and trace_mod.active:
                                 trace_mod.complete(
                                     "btl", "shm_drain", _t0,

@@ -30,6 +30,7 @@ host-only table raises ``BufferLocationError`` instead of silently staging.
 
 from __future__ import annotations
 
+import time
 from typing import TYPE_CHECKING, Optional
 
 from ompi_tpu.core.buffer import BufferKind, BufferLocationError, classify
@@ -90,26 +91,53 @@ def _make_dispatch(slot: str, host_fn, host_name: Optional[str],
                     f"path, or np.asarray() the buffer if host staging is "
                     f"intended)")
             fn, provider = dev_fn, dev_name
-        if trace_mod.active:   # per-collective span at the ONE choke point
-            with trace_mod.span("coll", slot, rank=comm.pml.rank,
-                                provider=provider, comm=comm.name,
-                                cid=comm.cid, size=comm.size):
+        # the ONE choke point: per-collective span (timeline) and the
+        # dispatch-latency histogram labeled provider + log2 size
+        # bucket (szb) — the distribution the algorithm ladder and the
+        # p50/p99 columns read
+        if trace_mod.hist_active or trace_mod.active:
+            t0 = trace_mod.begin()
+            try:
                 return fn(comm, buf, *args, **kw)
+            finally:
+                now = time.monotonic_ns()
+                if trace_mod.hist_active:
+                    szb = int(getattr(buf, "nbytes", 0)).bit_length()
+                    trace_mod.record_hist(
+                        "coll_dispatch_ns", now - t0,
+                        labels=f'slot="{slot}",provider="{provider}",'
+                               f'szb="{szb}"')
+                if trace_mod.active:
+                    trace_mod.complete(
+                        "coll", slot, t0, rank=comm.pml.rank,
+                        provider=provider, comm=comm.name,
+                        cid=comm.cid, size=comm.size)
         return fn(comm, buf, *args, **kw)
 
     dispatch.__name__ = f"coll_{slot}_dispatch"
     return dispatch
 
 
-def _make_traced_barrier(host_fn):
+def _make_traced_barrier(host_fn, provider):
     """Barrier has no buffer to classify; wrap the provider directly so
-    the epoch still shows up on the coll timeline."""
+    the epoch still shows up on the coll timeline (and in the dispatch
+    histogram — a barrier's latency IS the wait for the last arriver)."""
     def barrier(comm, *args, **kw):
-        if trace_mod.active:
-            with trace_mod.span("coll", "barrier", rank=comm.pml.rank,
-                                comm=comm.name, cid=comm.cid,
-                                size=comm.size):
+        if trace_mod.hist_active or trace_mod.active:
+            t0 = trace_mod.begin()
+            try:
                 return host_fn(comm, *args, **kw)
+            finally:
+                now = time.monotonic_ns()
+                if trace_mod.hist_active:
+                    trace_mod.record_hist(
+                        "coll_dispatch_ns", now - t0,
+                        labels=f'slot="barrier",'
+                               f'provider="{provider}",szb="0"')
+                if trace_mod.active:
+                    trace_mod.complete(
+                        "coll", "barrier", t0, rank=comm.pml.rank,
+                        comm=comm.name, cid=comm.cid, size=comm.size)
         return host_fn(comm, *args, **kw)
 
     return barrier
@@ -144,7 +172,8 @@ def install(comm: "Communicator") -> None:
                     _make_dispatch(slot, host_fn, host_name, dev_fn,
                                    dev_name))
         else:  # barrier: no buffer to classify; host provider wins
-            setattr(module, slot, _make_traced_barrier(host_fn or dev_fn))
+            setattr(module, slot, _make_traced_barrier(
+                host_fn or dev_fn, host_name or dev_name))
         if host_name:
             module.providers[slot] = host_name
         if dev_name:

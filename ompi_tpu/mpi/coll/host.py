@@ -12,6 +12,7 @@ coll_tuned_*_algorithm MCA params) or a dynamic rules file
 from __future__ import annotations
 
 import os
+import time
 from typing import Optional
 
 import numpy as np
@@ -27,6 +28,22 @@ __all__ = ["HostColl"]
 
 def _nbytes(buf) -> int:
     return np.asarray(buf).nbytes
+
+
+def _timed(coll: str, algo: str, fn, *args, **kw):
+    """Run one decided algorithm body, recording its latency into the
+    per-(collective, algorithm) histogram — the measured per-rung
+    behavior the decision ladder (and an MPI-Advance-style offline
+    retune) keys on."""
+    if not trace_mod.hist_active:
+        return fn(*args, **kw)
+    t0 = time.monotonic_ns()
+    try:
+        return fn(*args, **kw)
+    finally:
+        trace_mod.record_hist(
+            "coll_host_algo_ns", time.monotonic_ns() - t0,
+            labels=f'coll="{coll}",algo="{algo}"')
 
 
 class HostCollBase(Component):
@@ -147,12 +164,14 @@ class HostColl(HostCollBase):
         # globally-visible config: forced var or a rules entry at msg size 0
         alg = self._decide("bcast", comm, 0)
         if alg == "pipeline":
-            return base.bcast_pipeline(
-                comm, buf, root,
-                segsize=var_registry.get("coll_host_bcast_segment"))
+            return _timed(
+                "bcast", "pipeline", base.bcast_pipeline, comm, buf,
+                root, segsize=var_registry.get("coll_host_bcast_segment"))
         if alg == "linear":
-            return base.bcast_linear(comm, buf, root)
-        return base.bcast_binomial(comm, buf, root)
+            return _timed("bcast", "linear", base.bcast_linear,
+                          comm, buf, root)
+        return _timed("bcast", "binomial", base.bcast_binomial,
+                      comm, buf, root)
 
     def coll_reduce(self, comm, sendbuf, op: Op, root: int):
         return base.reduce_binomial(comm, sendbuf, op, root)
@@ -167,50 +186,60 @@ class HostColl(HostCollBase):
                   "segmented_ring": base.allreduce_segmented_ring,
                   "linear": base.allreduce_linear}[alg]
             if not op.commutative and fn is not base.allreduce_linear:
-                fn = base.allreduce_recursive_doubling
+                fn, alg = (base.allreduce_recursive_doubling,
+                           "recursive_doubling")
             if fn is base.allreduce_segmented_ring:
-                return fn(comm, sendbuf, op, segsize=segsize)
-            return fn(comm, sendbuf, op)
+                return _timed("allreduce", alg, fn, comm, sendbuf, op,
+                              segsize=segsize)
+            return _timed("allreduce", alg, fn, comm, sendbuf, op)
         # tuned fixed decision (coll_tuned_decision_fixed.c:65-87)
         if (nbytes < var_registry.get("coll_host_allreduce_small")
                 or not op.commutative):
-            return base.allreduce_recursive_doubling(comm, sendbuf, op)
+            return _timed("allreduce", "recursive_doubling",
+                          base.allreduce_recursive_doubling,
+                          comm, sendbuf, op)
         if nbytes >= segsize:
             # the registered crossover var IS the segment size (the two
             # were decoupled before: the var gated, 1MB rode hard-coded)
-            return base.allreduce_segmented_ring(comm, sendbuf, op,
-                                                 segsize=segsize)
-        return base.allreduce_ring(comm, sendbuf, op)
+            return _timed("allreduce", "segmented_ring",
+                          base.allreduce_segmented_ring, comm, sendbuf,
+                          op, segsize=segsize)
+        return _timed("allreduce", "ring", base.allreduce_ring,
+                      comm, sendbuf, op)
 
     def coll_gather(self, comm, sendbuf, root: int):
         return base.gather_linear(comm, sendbuf, root)
 
     def coll_allgather(self, comm, sendbuf):
         alg = self._decide("allgather", comm, _nbytes(sendbuf))
-        if alg:
-            return {"bruck": base.allgather_bruck,
-                    "ring": base.allgather_ring}[alg](comm, sendbuf)
-        if _nbytes(sendbuf) < var_registry.get("coll_host_allgather_small"):
-            return base.allgather_bruck(comm, sendbuf)
-        return base.allgather_ring(comm, sendbuf)
+        if not alg:
+            alg = ("bruck" if _nbytes(sendbuf)
+                   < var_registry.get("coll_host_allgather_small")
+                   else "ring")
+        return _timed("allgather", alg,
+                      {"bruck": base.allgather_bruck,
+                       "ring": base.allgather_ring}[alg], comm, sendbuf)
 
     def coll_scatter(self, comm, sendbuf, root: int):
         return base.scatter_linear(comm, sendbuf, root)
 
     def coll_alltoall(self, comm, sendbuf):
         alg = self._decide("alltoall", comm, _nbytes(sendbuf))
-        if alg:
-            return {"pairwise": base.alltoall_pairwise,
-                    "bruck": base.alltoall_bruck}[alg](comm, sendbuf)
-        if _nbytes(sendbuf) < var_registry.get("coll_host_alltoall_small"):
-            return base.alltoall_bruck(comm, sendbuf)
-        return base.alltoall_pairwise(comm, sendbuf)
+        if not alg:
+            alg = ("bruck" if _nbytes(sendbuf)
+                   < var_registry.get("coll_host_alltoall_small")
+                   else "pairwise")
+        return _timed("alltoall", alg,
+                      {"pairwise": base.alltoall_pairwise,
+                       "bruck": base.alltoall_bruck}[alg], comm, sendbuf)
 
     def coll_reduce_scatter(self, comm, sendbuf, op: Op):
         alg = self._decide("reduce_scatter", comm, _nbytes(sendbuf))
         if alg == "basic" or not op.commutative:
-            return base.reduce_scatter_basic(comm, sendbuf, op)
-        return base.reduce_scatter_ring(comm, sendbuf, op)
+            return _timed("reduce_scatter", "basic",
+                          base.reduce_scatter_basic, comm, sendbuf, op)
+        return _timed("reduce_scatter", "ring",
+                      base.reduce_scatter_ring, comm, sendbuf, op)
 
     def coll_reduce_scatter_block(self, comm, sendbuf, op: Op):
         arr = np.asarray(sendbuf)

@@ -17,10 +17,12 @@ schedule tags), so concurrently-outstanding collectives never cross-match.
 from __future__ import annotations
 
 import threading
+import time as _time
 from typing import Any, Callable, Optional
 
 import numpy as np
 
+from ompi_tpu.mpi import trace as trace_mod
 from ompi_tpu.mpi.op import Op
 from ompi_tpu.mpi.request import Request
 
@@ -70,6 +72,10 @@ class NbcRequest(Request):
         self._ridx = 0
         self._pending: Optional[list] = None  # [(req, key|None), ...]
         self._nbc_lock = threading.Lock()
+        # post→completion latency (the nbc rung of the coll dispatch
+        # histogram family; persistent Starts ride coll_pstart_ns)
+        self._h_t0 = (_time.monotonic_ns()
+                      if trace_mod.hist_active else 0)
         self._progress(block=False)
 
     # -- progress engine --------------------------------------------------
@@ -129,6 +135,10 @@ class NbcRequest(Request):
                     return False
                 self._finish_round()
             self.complete(self._result_fn(self._state))
+            if self._h_t0 and trace_mod.hist_active:
+                trace_mod.record_hist(
+                    "coll_nbc_ns", _time.monotonic_ns() - self._h_t0,
+                    labels=f'kind="{self.kind}"')
             return True
 
     # -- Request interface ------------------------------------------------

@@ -52,6 +52,7 @@ collectively, counted by ``coll_persistent_rebinds_total``.
 from __future__ import annotations
 
 import threading
+import time
 import weakref
 from typing import Any, Callable, Optional
 
@@ -343,10 +344,19 @@ class _ArenaPlan:
                 arr = self._as_bound()
                 if k >= 2:         # readers done with this parity's
                     s._wait_all_depart(k - 1, comm)   # k-2 occupant
+                _h_t0 = (time.monotonic_ns()
+                         if trace_mod.hist_active else 0)
                 np.copyto(self._res[q].reshape(self._shape), arr,
                           casting="no")
                 s._set_arrive(k + 1)
                 s._set_depart(k + 1)
+                if _h_t0:
+                    # publish half of the straggler split: slot copy +
+                    # flag store, no waits (those land in
+                    # coll_arena_wait_ns)
+                    trace_mod.record_hist(
+                        "coll_ppublish_ns",
+                        time.monotonic_ns() - _h_t0)
                 return CompletedRequest(arr, kind="pbcast")
             return _LazyRequest(
                 lambda: self._drain_bcast(k),
@@ -361,9 +371,13 @@ class _ArenaPlan:
             fold = 0 if kind == "allreduce" else self._root
             if k >= 2:
                 s._wait_depart(fold, k - 1, comm)
+        _h_t0 = time.monotonic_ns() if trace_mod.hist_active else 0
         np.copyto(self._in[q][comm.rank].reshape(self._shape), arr,
                   casting="no")
         s._set_arrive(k + 1)
+        if _h_t0:
+            trace_mod.record_hist("coll_ppublish_ns",
+                                  time.monotonic_ns() - _h_t0)
         if kind == "reduce":
             if comm.rank != self._root:
                 # contribution is in the slot: locally complete (the
@@ -799,7 +813,19 @@ class PersistentCollRequest(PersistentRequest):
                 f"collectively, or re-init on a shrunk communicator",
                 error_class=ERR_PROC_FAILED)
         trace_mod.count("coll_persistent_starts_total")
-        return plan.start_op()
+        # Start→completion latency: stamped here, recorded when the
+        # inner request completes (CompletedRequest fires the callback
+        # inline, so a locally-complete publish still lands a sample)
+        _h_t0 = trace_mod.begin() if trace_mod.hist_active else 0
+        req = plan.start_op()
+        if _h_t0:
+            labels = (f'kind="{self._ckind}",'
+                      f'provider="{plan.provider}"')
+            req.add_completion_callback(
+                lambda _r, t0=_h_t0, lb=labels: trace_mod.record_hist(
+                    "coll_pstart_ns", time.monotonic_ns() - t0,
+                    labels=lb))
+        return req
 
     def rebind(self) -> "PersistentCollRequest":
         """Recompile the bound plan on the same communicator —

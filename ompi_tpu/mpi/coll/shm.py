@@ -184,6 +184,11 @@ class Arena:
         f = self._flags
         if f[idx] >= v:
             return
+        # the straggler signal: every ns burnt in here is this rank
+        # waiting on a PEER's flag store — recorded into the arena-wait
+        # histogram on completed waits (an already-satisfied flag never
+        # reaches this point, so the fast path stays one compare)
+        _h_t0 = time.monotonic_ns() if trace_mod.hist_active else 0
         timeout = float(var_registry.get("coll_shm_timeout") or 60)
         grace = _probe_grace(timeout) if (self.world is not None
                                           and self._pml is not None) else 0.0
@@ -211,6 +216,9 @@ class Arena:
                     f"have {int(f[idx])}) stuck for {timeout:.0f}s on "
                     f"{getattr(comm, 'name', '?')} — peer dead or "
                     f"collective-order mismatch (coll_shm_timeout)")
+        if _h_t0 and trace_mod.hist_active:
+            trace_mod.record_hist("coll_arena_wait_ns",
+                                  time.monotonic_ns() - _h_t0)
 
     def _probe_writer(self, writer: int, grace: float,
                       timeout: float) -> None:

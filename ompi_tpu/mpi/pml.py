@@ -636,6 +636,8 @@ class PmlOb1:
         if trace_mod.active:
             hdr["fl"] = fl = self.rank * _FLOW_STRIDE + next(self._ids)
             _fl_t0 = trace_mod.begin()
+        # eager completion latency (histogram plane, timeline-independent)
+        _h_t0 = time.monotonic_ns() if trace_mod.hist_active else 0
         if self._listeners:
             self._emit(EVT_SEND_POST, peer=peer, tag=tag, cid=cid,
                        nbytes=len(payload))
@@ -652,6 +654,9 @@ class PmlOb1:
                     and self.endpoint.try_send_inline(peer, hdr, payload)):
                 self._enqueue_frame(peer, hdr, payload,
                                     _WireWatch(self, sid))
+            if _h_t0 and trace_mod.hist_active:
+                trace_mod.record_hist("pml_eager_send_ns",
+                                      time.monotonic_ns() - _h_t0)
             if fl and trace_mod.active:
                 trace_mod.complete("pml", "eager_send", _fl_t0,
                                    rank=self.rank, peer=peer,
@@ -673,6 +678,9 @@ class PmlOb1:
                 req.complete(None)  # local completion
             else:
                 self._enqueue_frame(peer, hdr, payload, req)
+            if _h_t0 and trace_mod.hist_active:
+                trace_mod.record_hist("pml_eager_send_ns",
+                                      time.monotonic_ns() - _h_t0)
             if fl and trace_mod.active:
                 trace_mod.complete("pml", "eager_send", _fl_t0,
                                    rank=self.rank, peer=peer,
@@ -1649,7 +1657,9 @@ class PmlOb1:
                 elif job[0] == "rndv_data":
                     _, state, rid = job
                     data = state.payload
-                    _t0 = trace_mod.begin() if trace_mod.active else 0
+                    _t0 = (trace_mod.begin()
+                           if trace_mod.active or trace_mod.hist_active
+                           else 0)
                     offs = list(range(0, len(data), frag))
                     for i, off in enumerate(offs):
                         last = i == len(offs) - 1
@@ -1667,6 +1677,10 @@ class PmlOb1:
                                     "rendezvous fragment could not be "
                                     "delivered"))
                             break
+                    if _t0 and trace_mod.hist_active:
+                        trace_mod.record_hist(
+                            "pml_rndv_send_ns",
+                            time.monotonic_ns() - _t0)
                     if _t0 and trace_mod.active:
                         trace_mod.complete(
                             "pml", "rndv_send", _t0, rank=self.rank,

@@ -139,6 +139,11 @@ def init() -> Communicator:
             _trace.attach_pml(pml)
             _trace.instant("runtime", "init", rank=rank, size=size)
 
+        # latency-histogram plane: re-read trace_hist_enable into the
+        # module flag the record sites check (env/CLI -mca settings
+        # land in the registry before init gets here)
+        _trace.refresh_hist_enable()
+
         # metrics uplink (independent of the timeline: the always-on
         # counters are worth scraping with tracing off) — armed when the
         # owning orted exported a collector URI and the push period is on

@@ -57,7 +57,9 @@ __all__ = [
     "counters_snapshot", "attach_pml", "flush", "crash_dump",
     "default_path", "metrics_snapshot", "metrics_values",
     "chrome_events", "ENV_FLAG", "push_period", "start_metrics_push",
-    "stop_metrics_push",
+    "stop_metrics_push", "record_hist", "hists", "hists_snapshot",
+    "hist_values", "hist_bucket_index", "hist_quantile_ns",
+    "refresh_hist_enable", "HIST_NBUCKETS", "HIST_VLEN", "HIST_MIN_EXP",
 ]
 
 ENV_FLAG = "OMPI_TPU_TRACE"
@@ -208,6 +210,165 @@ for _name, _unit, _desc in _COUNTER_SPECS:
     pvar_registry.register_or_get(Pvar(
         _name, PvarClass.COUNTER, unit=_unit, description=_desc,
         read_fn=lambda _b, n=_name: counters[n]))
+
+
+# ---------------------------------------------------------------------------
+# latency histograms (the pvar family the counters lack a time axis for)
+# ---------------------------------------------------------------------------
+#
+# Fixed log2 bucketing, HDR-style: bucket i holds durations whose
+# nanosecond bit_length is MIN_EXP + i, i.e. dur < 2**(MIN_EXP+i) — the
+# finite rungs span ~1 µs (2**10 ns) to ~16 s (2**34 ns), bucket 0
+# absorbs the sub-µs underflow and the last bucket the overflow.  One
+# plain-int vector per series (counts + a trailing observation sum, so
+# the Prometheus render can emit honest ``_sum`` series and the
+# straggler panel real wait-time shares, not midpoint estimates); the
+# record path is one bit_length, one clamp, two list increments under
+# the GIL — same unlocked-loss tolerance as the counters.
+#
+# Labeled series: ``record_hist(name, dur, labels='provider="shm"')``
+# opens the sub-series ``name{provider="shm"}`` — the pvar NAME stays a
+# declared ``_HIST_SPECS`` literal (the pvar-spec lint checker enforces
+# both directions), only the label string is dynamic, and the DVM's
+# scrape render folds the labels into the Prometheus series verbatim.
+
+#: bucket 0 upper bound exponent: 2**10 ns ≈ 1 µs
+HIST_MIN_EXP = 10
+#: counts per series: 25 finite log2 rungs (le 2**10 … 2**34 ns) + overflow
+HIST_NBUCKETS = 26
+#: vector length: the counts plus the trailing observation sum (ns)
+HIST_VLEN = HIST_NBUCKETS + 1
+
+_HIST_SPECS = (
+    ("coll_dispatch_ns", "nanoseconds",
+     "blocking-collective latency at the coll dispatch choke point "
+     "(labels: slot, provider, szb = log2 payload-size bucket)"),
+    ("coll_host_algo_ns", "nanoseconds",
+     "coll/host algorithm-body latency, labeled by collective and the "
+     "algorithm the decision layer picked (the per-rung distribution "
+     "the coll_xla_algorithm ladder wants)"),
+    ("coll_nbc_ns", "nanoseconds",
+     "nonblocking-collective schedule latency: NbcRequest post to "
+     "completion (labels: kind)"),
+    ("coll_pstart_ns", "nanoseconds",
+     "persistent-collective Start-to-completion latency over a bound "
+     "plan (labels: kind, provider)"),
+    ("coll_ppublish_ns", "nanoseconds",
+     "persistent arena publish time: bound-buffer copy into the pinned "
+     "slot plus the arrive flag store (the straggler panel's 'work' "
+     "half)"),
+    ("coll_arena_wait_ns", "nanoseconds",
+     "coll/shm arena flag-wait time (arrive/depart spins, one-shot and "
+     "persistent) — the cross-rank straggler signal: a rank whose wait "
+     "share is LOW is the one everyone else waits for"),
+    ("pml_eager_send_ns", "nanoseconds",
+     "eager-protocol isend latency: entry to local completion/handoff"),
+    ("pml_rndv_send_ns", "nanoseconds",
+     "rendezvous data push latency on the send worker: CTS-released "
+     "fragment stream start to last fragment delivered"),
+    ("btl_shm_drain_ns", "nanoseconds",
+     "btl/shm poller drain-batch latency: one sweep over a peer ring "
+     "that yielded frames"),
+)
+
+_HIST_NAMES = frozenset(n for n, _u, _d in _HIST_SPECS)
+
+#: series key → [count_0 … count_25, sum_ns]; keys are either a bare
+#: declared name or ``name{label="v",…}`` for labeled sub-series
+hists: dict[str, list[int]] = {}
+
+register_var("trace", "hist_enable", VarType.BOOL, True,
+             "arm the always-on latency histogram plane (coll dispatch, "
+             "persistent Start, arena waits, pml eager/rndv, btl drain "
+             "batches).  Independent of the span timeline; the record "
+             "path costs ~one dict hit + two int increments (measured "
+             "in PERF.md).  Re-read by trace.refresh_hist_enable()")
+
+#: THE flag every record site checks first (mirrors ``active`` for the
+#: timeline) — refreshed from the ``trace_hist_enable`` var, not read
+#: through the registry per event
+hist_active = True
+
+
+def refresh_hist_enable() -> bool:
+    """Re-read ``trace_hist_enable`` into the module flag (called at
+    init(); tests and tools call it after flipping the var)."""
+    global hist_active
+    try:
+        hist_active = bool(var_registry.get("trace_hist_enable"))
+    except Exception:  # noqa: BLE001 — a broken knob must not disarm init
+        hist_active = True
+    return hist_active
+
+
+def _new_hist_series(name: str, key: str) -> list[int]:
+    """Open a series vector; an undeclared base name is a KeyError, the
+    same hot-path discipline as an undeclared counter bump."""
+    if name not in _HIST_NAMES:
+        raise KeyError(name)
+    return hists.setdefault(key, [0] * HIST_VLEN)
+
+
+def record_hist(name: str, dur_ns: int, labels: str = "") -> None:
+    """Record one duration into a declared histogram (``labels`` is a
+    preformatted Prometheus label-pair fragment opening a sub-series)."""
+    key = f"{name}{{{labels}}}" if labels else name
+    vec = hists.get(key)
+    if vec is None:
+        vec = _new_hist_series(name, key)
+    i = dur_ns.bit_length() - HIST_MIN_EXP
+    if i < 0:
+        i = 0
+    elif i >= HIST_NBUCKETS:
+        i = HIST_NBUCKETS - 1
+    vec[i] += 1
+    vec[HIST_NBUCKETS] += dur_ns
+
+
+def hist_bucket_index(dur_ns: int) -> int:
+    """The bucket a duration lands in (exposed for tests/tools)."""
+    i = int(dur_ns).bit_length() - HIST_MIN_EXP
+    return 0 if i < 0 else min(i, HIST_NBUCKETS - 1)
+
+
+def hist_quantile_ns(counts: list[int], q: float) -> float:
+    """Estimate the q-quantile (0..1) from a bucket-count vector (the
+    counts only — pass ``vec[:HIST_NBUCKETS]``).  Uses the geometric
+    midpoint of the landing bucket's range; log2 buckets bound the
+    estimate within ~sqrt(2) of the true value."""
+    total = sum(counts[:HIST_NBUCKETS])
+    if total <= 0:
+        return 0.0
+    target = q * total
+    seen = 0
+    for i, c in enumerate(counts[:HIST_NBUCKETS]):
+        seen += c
+        if seen >= target and c:
+            hi = 1 << (HIST_MIN_EXP + i)
+            return float(hi) / 1.4142135623730951   # hi / sqrt(2)
+    return float(1 << (HIST_MIN_EXP + HIST_NBUCKETS - 1))
+
+
+def hist_values() -> dict[str, list[int]]:
+    """Every series vector by key, copied — the vector payload of the
+    metrics uplink (scalar pvars ride :func:`metrics_values`)."""
+    return {k: list(v) for k, v in hists.items()}
+
+
+def hists_snapshot() -> dict[str, list[int]]:
+    """Alias of :func:`hist_values` for symmetry with
+    :func:`counters_snapshot` (benchmarks diff two snapshots)."""
+    return hist_values()
+
+
+for _name, _unit, _desc in _HIST_SPECS:
+    pvar_registry.register_or_get(Pvar(
+        _name, PvarClass.AGGREGATE, unit=_unit, description=_desc,
+        # the read is the series map for this base (bare + labeled) —
+        # a dict, so the scalar metrics walk skips it by design
+        read_fn=lambda _b, n=_name: {
+            k: list(v) for k, v in hists.items()
+            if k == n or k.startswith(n + "{")}))
 
 
 # ---------------------------------------------------------------------------
@@ -498,6 +659,10 @@ def flush(path: Optional[str] = None,
             # (ranks on different hosts)
             "clock_offset_ns": time.time_ns() - time.monotonic_ns(),
             "counters": counters_snapshot(),
+            # latency-histogram vectors ([counts…, sum_ns] per series):
+            # tools/straggler_report.py's offline mode reads these from
+            # merged per-rank dumps when no live aggregate is reachable
+            "hists": hist_values(),
         },
         "traceEvents": chrome_events(rec),
     }
@@ -580,10 +745,23 @@ def metrics_snapshot() -> str:
 # owning orted's UDP collector — delta-compressed (only changed values
 # ride; every FULL_EVERY-th push resends the whole snapshot so a lost
 # datagram heals), merged at each tree hop, aggregated at the HNP/DVM
+#
+# Histogram vectors ride the same datagrams with two wire forms, tagged
+# by a leading marker element (runtime/metrics.py's merge_hop speaks
+# both): ``["d", …ints]`` is the element-wise INCREMENT since the last
+# push (merged by vector add at every hop — including the collector's
+# failed-send re-merge, where add is the only correct fold), and
+# ``["a", …ints]`` is the absolute cumulative vector (every FULL_EVERY-th
+# push and the final flush), which subsumes any pending deltas so UDP
+# loss heals for vectors exactly as it does for scalars.
 # ---------------------------------------------------------------------------
 
 #: every Nth push is a full snapshot (UDP loss self-heals within N pushes)
 FULL_EVERY = 8
+
+#: vector wire markers (see merge_hop): delta-increment / absolute
+VEC_DELTA = "d"
+VEC_ABS = "a"
 
 
 class _MetricsPusher:
@@ -600,6 +778,7 @@ class _MetricsPusher:
         self.rank = rank
         self.period = period
         self._last: dict[str, float] = {}
+        self._last_h: dict[str, list[int]] = {}
         self._n = 0
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -618,10 +797,24 @@ class _MetricsPusher:
 
         try:
             cur = metrics_values()
+            cur_h = hist_values()
             full = self._n % FULL_EVERY == 0
-            vals = (cur if full else
-                    {k: v for k, v in cur.items()
-                     if self._last.get(k) != v})
+            vals: dict[str, Any] = (
+                dict(cur) if full else
+                {k: v for k, v in cur.items()
+                 if self._last.get(k) != v})
+            for key, vec in cur_h.items():
+                if full:
+                    vals[key] = [VEC_ABS, *vec]
+                    continue
+                last = self._last_h.get(key)
+                if last is None:
+                    # a series born between full pushes: its whole
+                    # vector IS the increment since the last push
+                    vals[key] = [VEC_DELTA, *vec]
+                elif last != vec:
+                    vals[key] = [VEC_DELTA,
+                                 *(a - b for a, b in zip(vec, last))]
             self._n += 1
             if not vals and not full:
                 return
@@ -629,6 +822,7 @@ class _MetricsPusher:
                 dss.pack(("m1", self.jobid, self.rank, self._n, vals)),
                 self._addr)
             self._last = cur
+            self._last_h = cur_h
         except Exception:  # noqa: BLE001 — uplink is best-effort
             pass
 

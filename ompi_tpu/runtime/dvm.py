@@ -22,11 +22,16 @@ Observability plane (``--metrics-port N``): a long-lived HTTP endpoint
 on the DVM serving
 
 - ``/metrics`` — Prometheus text: every rank's pvar snapshot (pushed up
-  the orted tree via TAG_METRICS) labeled ``{job=,rank=}``, per-job
-  ``ompi_tpu_job_*`` sums, and the DVM's own process pvars;
+  the orted tree via TAG_METRICS) labeled ``{job=,rank=}``, real
+  histogram families for the latency plane (``_bucket{le=}``/``_sum``/
+  ``_count``), per-job ``ompi_tpu_job_*`` sums, and the DVM's own
+  process pvars;
 - ``/status`` — JSON: the daemon table (heartbeat ages), the proc table
-  (``lives``, restarts budget, last-metrics age) and the per-job FT
-  event timeline (detect / reap / revive / shrink / escalate).
+  (``lives``, restarts budget, last-metrics age, p99 collective
+  latency), the per-job FT event timeline (detect / reap / revive /
+  shrink / escalate) and the per-job straggler panel (per-rank
+  collective wait-time share over the last window, max/median skew,
+  and the current slowest rank).
 
 ``--metrics-port 0`` binds an ephemeral port; the bound address is
 written next to the URI file as ``<uri>.metrics``.
@@ -321,6 +326,8 @@ class DvmHnp(MultiHostLauncher):
 
     def _proc_rows(self, job, usage: dict[int, tuple]) -> list[dict]:
         metrics_ages = self.metrics_agg.ages(job.jobid)
+        p99s = self.metrics_agg.job_hist_quantiles(
+            job.jobid, "coll_dispatch_ns", 0.99)
         limit = int(var_registry.get("errmgr_max_restarts") or 0)
         procs = []
         for p in job.procs:
@@ -342,6 +349,10 @@ class DvmHnp(MultiHostLauncher):
                 # a live rank whose age keeps growing has a stalled
                 # metrics plane (or a stalled rank)
                 row["metrics_age_s"] = round(metrics_ages[p.rank], 2)
+            if p.rank in p99s:
+                # tail collective latency from the rank's pushed
+                # histogram (the --dvm-ps p99 column)
+                row["coll_p99_us"] = round(p99s[p.rank] / 1e3, 1)
             if p.rank in usage:      # orte-top columns, live ranks
                 pid, rss, cpu_s = usage[p.rank]
                 row.update(pid=pid, rss_mb=round(rss / 2**20, 1),
@@ -486,6 +497,12 @@ class DvmHnp(MultiHostLauncher):
             entry["metrics_age_s"] = {
                 str(r): round(a, 2)
                 for r, a in self.metrics_agg.ages(jobid, now=now).items()}
+            # the cross-rank straggler panel: per-rank collective
+            # wait-time share over the last window + the current
+            # slowest rank (None until latency histograms arrive)
+            panel = self.metrics_agg.straggler(jobid)
+            if panel is not None:
+                entry["straggler"] = panel
             entry["ft_events"] = ftevents.log.snapshot(jobid)
             jobs.append(entry)
         return {

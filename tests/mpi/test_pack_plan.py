@@ -245,24 +245,31 @@ def test_contiguous_send_makes_no_pack_copy():
     rides a buffer view — the ConvertorStats hook must record ZERO pack
     events for it, and a non-contiguous send must record at least one.
 
-    Attribution is by UNIQUE payload size through a stats listener, not
-    by delta against the process-wide counters: the counters are shared
-    by every thread in the pytest process, so under full-suite ordering
-    a leftover worker from an earlier job (heal retries, osc service
-    threads) can pack inside any reset→read window and fail the
-    zero-delta assertion — the per-test listener baseline is what makes
-    the control independent of suite order."""
+    Attribution is by UNIQUE payload size through a stats listener AND
+    scoped to this test's own comm world, not by delta against the
+    process-wide counters: the counters are shared by every thread in
+    the pytest process, so under full-suite ordering a leftover worker
+    from an earlier job (heal retries, osc service threads) can pack
+    inside any reset→read window — and can even pack a colliding
+    payload size.  The listener therefore records the emitting thread
+    too, and the assertions only consider events from the two rank
+    threads of THIS world (sender-side packs run on the isend caller's
+    thread), which makes the control independent of suite order."""
+    import threading
+
     # three sizes nothing else in the process converts concurrently
     n_small, n_big, n_strided = 64 + 3, (1 << 16) + 5, 96
     events: list = []
+    world_tids: set = set()
 
     def listener(kind, nbytes):
-        events.append((kind, nbytes))
+        events.append((kind, nbytes, threading.get_ident()))
 
     dt.stats.add_listener(listener)
     try:
 
         def body(comm):
+            world_tids.add(threading.get_ident())
             big = np.arange(n_big, dtype=np.float32)    # rendezvous
             small = np.arange(n_small, dtype=np.float32)  # eager
             if comm.rank == 0:
@@ -291,7 +298,8 @@ def test_contiguous_send_makes_no_pack_copy():
         assert all(run_ranks(2, body, timeout=120.0))
     finally:
         dt.stats.remove_listener(listener)
-    packed = {nb for kind, nb in events if kind == "pack"}
+    packed = {nb for kind, nb, tid in events
+              if kind == "pack" and tid in world_tids}
     assert 4 * n_small not in packed, \
         "contiguous eager send took a pack round-trip"
     assert 4 * n_big not in packed, \

@@ -1,0 +1,112 @@
+"""The latency-histogram pvar family: log2 bucket boundaries, the
+record path's spec discipline (undeclared names raise, labels open
+sub-series), the enable gate, quantile estimation, and the flush dump
+carrying the vectors for offline straggler analysis."""
+
+import json
+
+import pytest
+
+from ompi_tpu.core.config import var_registry
+from ompi_tpu.mpi import trace
+
+
+@pytest.fixture(autouse=True)
+def _clean_series(monkeypatch):
+    """Tests own their series store: swap in a fresh dict so neither
+    suite-order leftovers nor concurrent worker threads perturb the
+    exact-count assertions (and nothing leaks back out)."""
+    monkeypatch.setattr(trace, "hists", {})
+
+
+# -- bucket boundaries --------------------------------------------------------
+
+def test_bucket_index_log2_boundaries():
+    # bucket 0 absorbs everything below 2**MIN_EXP (≈1 µs)
+    assert trace.hist_bucket_index(0) == 0
+    assert trace.hist_bucket_index(1) == 0
+    assert trace.hist_bucket_index((1 << trace.HIST_MIN_EXP) - 1) == 0
+    # each power of two starts the next bucket
+    assert trace.hist_bucket_index(1 << trace.HIST_MIN_EXP) == 1
+    assert trace.hist_bucket_index((1 << (trace.HIST_MIN_EXP + 1)) - 1) == 1
+    assert trace.hist_bucket_index(1 << (trace.HIST_MIN_EXP + 1)) == 2
+    # the top finite rung is ~16 s; beyond that, the overflow bucket
+    assert trace.hist_bucket_index((1 << 34) - 1) == trace.HIST_NBUCKETS - 2
+    assert trace.hist_bucket_index(1 << 34) == trace.HIST_NBUCKETS - 1
+    assert trace.hist_bucket_index(1 << 60) == trace.HIST_NBUCKETS - 1
+
+
+def test_record_accumulates_counts_and_sum():
+    trace.record_hist("coll_arena_wait_ns", 100)        # sub-µs
+    trace.record_hist("coll_arena_wait_ns", 5000)       # 4096..8191
+    trace.record_hist("coll_arena_wait_ns", 5001)
+    vec = trace.hists["coll_arena_wait_ns"]
+    assert len(vec) == trace.HIST_VLEN
+    assert vec[0] == 1
+    assert vec[trace.hist_bucket_index(5000)] == 2
+    assert sum(vec[:trace.HIST_NBUCKETS]) == 3
+    assert vec[trace.HIST_NBUCKETS] == 100 + 5000 + 5001   # the sum slot
+
+
+def test_undeclared_histogram_name_raises():
+    """Same hot-path discipline as an undeclared counter bump: the
+    catalogue (_HIST_SPECS) is the only way to open a series."""
+    with pytest.raises(KeyError):
+        trace.record_hist("made_up_latency_ns", 1000)
+
+
+def test_labels_open_distinct_subseries():
+    trace.record_hist("coll_dispatch_ns", 2000,
+                      labels='slot="bcast",provider="shm",szb="10"')
+    trace.record_hist("coll_dispatch_ns", 4000,
+                      labels='slot="bcast",provider="host",szb="10"')
+    keys = [k for k in trace.hists if k.startswith("coll_dispatch_ns{")]
+    assert len(keys) == 2
+    # the pvar read folds the sub-series under the declared base name
+    from ompi_tpu.mpi.mpit import pvar_registry
+
+    pv = pvar_registry.lookup("coll_dispatch_ns")
+    assert set(pv.read()) == set(keys)
+
+
+def test_hist_enable_gate_follows_var():
+    old = var_registry.get("trace_hist_enable")
+    try:
+        var_registry.set("trace_hist_enable", False)
+        assert trace.refresh_hist_enable() is False
+        assert trace.hist_active is False
+        var_registry.set("trace_hist_enable", True)
+        assert trace.refresh_hist_enable() is True
+        assert trace.hist_active is True
+    finally:
+        var_registry.set("trace_hist_enable", old)
+        trace.refresh_hist_enable()
+
+
+def test_quantile_estimate_within_bucket_factor():
+    """Log2 buckets bound the quantile estimate within ~sqrt(2): 100
+    observations at 10 µs must estimate p50 (and p99) in [10/√2·µs,
+    10·√2 µs]."""
+    for _ in range(100):
+        trace.record_hist("coll_arena_wait_ns", 10_000)
+    counts = trace.hists["coll_arena_wait_ns"][:trace.HIST_NBUCKETS]
+    for q in (0.5, 0.99):
+        est = trace.hist_quantile_ns(counts, q)
+        assert 10_000 / 1.5 <= est <= 10_000 * 1.5, (q, est)
+    assert trace.hist_quantile_ns([0] * trace.HIST_NBUCKETS, 0.5) == 0.0
+
+
+def test_flush_dump_carries_hist_vectors(tmp_path):
+    """Offline straggler analysis reads otherData.hists out of the
+    per-rank dumps — the vectors must survive the JSON round trip."""
+    trace.record_hist("coll_arena_wait_ns", 3000)
+    rec = trace.FlightRecorder(capacity=64, rank=5, jobid=9)
+    path = str(tmp_path / "dump.json")
+    assert trace.flush(path=path, rec=rec) == path
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    hists = doc["otherData"]["hists"]
+    assert "coll_arena_wait_ns" in hists
+    vec = hists["coll_arena_wait_ns"]
+    assert len(vec) == trace.HIST_VLEN
+    assert sum(vec[:trace.HIST_NBUCKETS]) >= 1

@@ -1,7 +1,9 @@
 """Live observability plane: metrics uplink (delta merge at tree hops,
-jobid keying, push-period clamp), the DVM scrape endpoint (/metrics with
-per-job labels, /status with the FT event timeline), the one-hop
-TAG_METRICS delivery semantics, and the FT event log itself."""
+jobid keying, push-period clamp), the histogram vector path (tagged
+delta/absolute wire forms, element-wise merge_hop folds, Prometheus
+histogram render, the straggler panel), the DVM scrape endpoint
+(/metrics with per-job labels, /status with the FT event timeline),
+the one-hop TAG_METRICS delivery semantics, and the FT event log."""
 
 import json
 import socket
@@ -15,8 +17,19 @@ from ompi_tpu.core import dss
 from ompi_tpu.core.config import var_registry
 from ompi_tpu.mpi import trace
 from ompi_tpu.runtime import ftevents, rml
-from ompi_tpu.runtime.metrics import (AGG_METRICS, MetricsAggregate,
-                                      MetricsCollector, merge_hop)
+from ompi_tpu.runtime.metrics import (AGG_HISTS, AGG_METRICS,
+                                      MetricsAggregate, MetricsCollector,
+                                      merge_hop, straggler_panel,
+                                      vec_merge)
+
+
+def _vec(marker: str, *pairs, total: int = 0) -> list:
+    """A tagged test vector: (bucket, count) pairs + the trailing sum."""
+    ints = [0] * trace.HIST_VLEN
+    for bucket, count in pairs:
+        ints[bucket] = count
+    ints[trace.HIST_NBUCKETS] = total
+    return [marker] + ints
 
 
 # -- merge_hop: the per-hop fold -------------------------------------------
@@ -161,6 +174,272 @@ def test_collector_fences_stale_datagrams():
         sock.close()
     finally:
         col.close()
+
+
+# -- the histogram vector path -----------------------------------------------
+
+def test_vec_merge_algebra():
+    """delta∘delta adds, absolute subsumes older deltas, delta stacks
+    onto absolute, absolute∘absolute takes the element-wise max."""
+    d1 = _vec("d", (2, 1), total=100)
+    d2 = _vec("d", (2, 2), (5, 1), total=300)
+    out = vec_merge(d1, d2)
+    assert out[0] == "d" and out[3] == 3 and out[6] == 1
+    assert out[trace.HIST_NBUCKETS + 1] == 400
+    a = _vec("a", (2, 10), total=5000)
+    assert vec_merge(d1, a) == a                  # absolute subsumes
+    out = vec_merge(a, d1)                        # increments stack on
+    assert out[0] == "a" and out[3] == 11
+    assert out[trace.HIST_NBUCKETS + 1] == 5100
+    a2 = _vec("a", (2, 8), (4, 3), total=4000)
+    out = vec_merge(a, a2)                        # reorder-safe max
+    assert out[0] == "a" and out[3] == 10 and out[5] == 3
+    assert out[trace.HIST_NBUCKETS + 1] == 5000
+    # a length-skewed peer resolves to the newer vector, no corruption
+    assert vec_merge(["d", 1, 2], d1) == d1
+
+
+def test_merge_hop_folds_vectors_elementwise():
+    """The per-hop fold a failed-send re-merge depends on: two pending
+    payloads with deltas for the same series must ADD, not last-writer-
+    win (dict.update would silently drop bucket increments)."""
+    pending = {7: {0: [100.0, {"coll_dispatch_ns": _vec("d", (3, 2),
+                                                        total=200),
+                               "x": 5}]}}
+    merge_hop(pending, {7: {0: [200.0, {"coll_dispatch_ns":
+                                        _vec("d", (3, 1), total=90),
+                                        "x": 9}]}})
+    row = pending[7][0]
+    assert row[0] == 200.0
+    assert row[1]["x"] == 9                       # scalars: last writer
+    assert row[1]["coll_dispatch_ns"][4] == 3     # vectors: element add
+    assert row[1]["coll_dispatch_ns"][trace.HIST_NBUCKETS + 1] == 290
+
+
+def test_pusher_rides_vector_deltas_and_full_heals():
+    """First push: absolute vectors.  A record between pushes rides as
+    a tagged delta carrying ONLY the increment; the reorder fence still
+    drops stale datagrams ahead of the vector merge."""
+    col = MetricsCollector(period=30.0, send_fn=lambda p: None)
+    old = var_registry.get("trace_metrics_push_period")
+    key = 'coll_dispatch_ns{slot="t",provider="shm",szb="4"}'
+    try:
+        var_registry.set("trace_metrics_push_period", 30.0)
+        trace.hists.pop(key, None)
+        trace.record_hist("coll_dispatch_ns", 5000,
+                          labels='slot="t",provider="shm",szb="4"')
+        pusher = trace.start_metrics_push(77, 0, uri=col.uri)
+        assert pusher is not None
+        try:
+            pusher.push()                     # push 1: full → absolute
+            deadline = time.monotonic() + 5.0
+            vals = {}
+            while time.monotonic() < deadline:
+                p = col.drain()
+                if p:
+                    vals = p[77][0][1]
+                    break
+                time.sleep(0.02)
+            assert key in vals, vals.keys()
+            assert vals[key][0] == "a"
+            b = trace.hist_bucket_index(5000)
+            assert vals[key][1 + b] == 1
+            # a new observation rides the next delta — increment only
+            trace.record_hist("coll_dispatch_ns", 5000,
+                              labels='slot="t",provider="shm",szb="4"')
+            pusher.push()
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                p = col.drain()
+                if p:
+                    delta = p[77][0][1]
+                    assert delta[key][0] == "d"
+                    assert delta[key][1 + b] == 1, (
+                        "delta must carry the increment, not the "
+                        "cumulative count")
+                    break
+                time.sleep(0.02)
+            else:
+                pytest.fail("vector delta push never arrived")
+        finally:
+            trace.stop_metrics_push(flush=False)
+    finally:
+        var_registry.set("trace_metrics_push_period", old)
+        trace.hists.pop(key, None)
+        col.close()
+
+
+def test_aggregate_renders_prometheus_histograms():
+    """Real histogram exposition: cumulative le buckets ending at +Inf,
+    _sum/_count, per-job element-wise bucket sums for AGG_HISTS, and a
+    single # TYPE line per metric name."""
+    agg = MetricsAggregate()
+    b = trace.hist_bucket_index(5000)
+    key = 'coll_dispatch_ns{slot="bcast",provider="shm",szb="10"}'
+    agg.merge({7: {0: [time.time(), {key: _vec("a", (b, 3), (b + 2, 1),
+                                              total=20000),
+                                     "pml_zero_copy_sends_total": 2}],
+                   1: [time.time(), {key: _vec("a", (b, 1),
+                                               total=5000)}]}})
+    text = agg.prometheus()
+    le = str(1 << (trace.HIST_MIN_EXP + b))
+    le_next = str(1 << (trace.HIST_MIN_EXP + b + 1))
+    pre = 'job="7",rank="0",slot="bcast",provider="shm",szb="10"'
+    assert (f'ompi_tpu_coll_dispatch_ns_bucket{{{pre},le="{le}"}} 3'
+            in text)
+    # cumulative: the next rung includes the lower one's count
+    assert (f'ompi_tpu_coll_dispatch_ns_bucket{{{pre},le="{le_next}"}} 3'
+            in text)
+    assert f'ompi_tpu_coll_dispatch_ns_bucket{{{pre},le="+Inf"}} 4' in text
+    assert f'ompi_tpu_coll_dispatch_ns_sum{{{pre}}} 20000' in text
+    assert f'ompi_tpu_coll_dispatch_ns_count{{{pre}}} 4' in text
+    assert "# TYPE ompi_tpu_coll_dispatch_ns histogram" in text
+    # per-job element-wise sum across ranks, labels preserved
+    jpre = 'job="7",slot="bcast",provider="shm",szb="10"'
+    assert (f'ompi_tpu_job_coll_dispatch_ns_bucket{{{jpre},le="{le}"}} 4'
+            in text)
+    assert f'ompi_tpu_job_coll_dispatch_ns_sum{{{jpre}}} 25000' in text
+    # one # TYPE line per metric name (scrapers reject duplicates)
+    typed = [ln.split()[2] for ln in text.splitlines()
+             if ln.startswith("# TYPE")]
+    assert len(typed) == len(set(typed)), typed
+    # scalars still render beside the vectors
+    assert ('ompi_tpu_pml_zero_copy_sends_total{job="7",rank="0"} 2'
+            in text)
+
+
+def test_agg_hists_family_names_real_histograms():
+    """Every AGG_HISTS entry must be a _HIST_SPECS histogram — the
+    runtime half of the lint pvar-spec cross-check."""
+    spec_names = {name for name, _u, _d in trace._HIST_SPECS}
+    assert set(AGG_HISTS) <= spec_names, set(AGG_HISTS) - spec_names
+
+
+# -- the straggler panel ------------------------------------------------------
+
+def test_straggler_panel_names_the_slowest_rank():
+    """A deliberately skewed 4-rank job: rank 2 is the slow one, so it
+    barely waits while ranks 0/1/3 burn wait time on its flags — the
+    panel must name rank 2 with the lowest wait share."""
+    waits = {0: 9e9, 1: 8e9, 2: 0.4e9, 3: 8.5e9}
+    pubs = {r: 1e8 for r in waits}
+    panel = straggler_panel(waits, pubs, "arena_wait", window_s=30.0)
+    assert panel["suspect"] == 2
+    shares = {int(r): row["wait_share"]
+              for r, row in panel["ranks"].items()}
+    assert shares[2] == min(shares.values())
+    assert abs(sum(shares.values()) - 1.0) < 0.01
+    assert panel["skew"] is not None and panel["skew"] > 1.0
+    assert panel["max_wait_ms"] == pytest.approx(9000.0)
+    # degenerate cases: one rank / no data → no verdict
+    assert straggler_panel({0: 5.0}, {}, "arena_wait", 1.0)["suspect"] \
+        is None
+    assert straggler_panel({}, {}, "arena_wait", 1.0) is None
+
+
+def test_aggregate_straggler_from_synthetic_skewed_job():
+    """End to end through the aggregate: skewed arena-wait vectors in,
+    panel out — and the window baseline rotates instead of growing
+    forever."""
+    agg = MetricsAggregate()
+    rows = {}
+    for rank, wait_ns in ((0, 9_000_000_000), (1, 8_000_000_000),
+                          (2, 400_000_000), (3, 8_500_000_000)):
+        rows[rank] = [time.time(),
+                      {"coll_arena_wait_ns": _vec("a", (20, 5),
+                                                  total=wait_ns),
+                       "coll_ppublish_ns": _vec("a", (5, 5),
+                                                total=1_000_000)}]
+    agg.merge({42: rows})
+    panel = agg.straggler(42)
+    assert panel is not None
+    assert panel["signal"] == "arena_wait"
+    assert panel["suspect"] == 2
+    assert panel["ranks"]["2"]["wait_share"] == min(
+        row["wait_share"] for row in panel["ranks"].values())
+    # unknown job → None; a job with no vectors → None
+    assert agg.straggler(4242) is None
+    agg.merge({43: {0: [time.time(), {"x": 1}]}})
+    assert agg.straggler(43) is None
+
+
+def test_aggregate_straggler_falls_back_to_dispatch_signal():
+    """Cross-host jobs have no arena: the panel keys on total coll
+    dispatch time instead (same inversion — the last arriver spends
+    the least time inside the collective)."""
+    agg = MetricsAggregate()
+    key = 'coll_dispatch_ns{slot="barrier",provider="host",szb="0"}'
+    agg.merge({9: {0: [time.time(), {key: _vec("a", (12, 4),
+                                               total=7_000_000_000)}],
+                   1: [time.time(), {key: _vec("a", (12, 4),
+                                               total=300_000_000)}]}})
+    panel = agg.straggler(9)
+    assert panel is not None
+    assert panel["signal"] == "coll_dispatch"
+    assert panel["suspect"] == 1
+
+
+def test_aggregate_straggler_signal_flip_resets_baseline():
+    """A dispatch-signal baseline must never be subtracted from
+    arena-wait sums: when the signal flips (arena series appear after a
+    cross-host phase), the panel starts a fresh window."""
+    agg = MetricsAggregate()
+    key = 'coll_dispatch_ns{slot="barrier",provider="host",szb="0"}'
+    agg.merge({5: {0: [time.time(), {key: _vec("a", (12, 4),
+                                               total=9_000_000_000)}],
+                   1: [time.time(), {key: _vec("a", (12, 4),
+                                               total=1_000_000_000)}]}})
+    assert agg.straggler(5)["signal"] == "coll_dispatch"
+    # arena series arrive: smaller sums than the dispatch baseline
+    agg.merge({5: {0: [time.time(),
+                       {"coll_arena_wait_ns": _vec("a", (15, 2),
+                                               total=50_000_000)}],
+                   1: [time.time(),
+                       {"coll_arena_wait_ns": _vec("a", (15, 2),
+                                               total=900_000_000)}]}})
+    panel = agg.straggler(5)
+    assert panel["signal"] == "arena_wait"
+    # fresh window off the cumulative arena sums, not garbage deltas
+    assert panel["suspect"] == 0
+    assert panel["ranks"]["1"]["wait_share"] > \
+        panel["ranks"]["0"]["wait_share"]
+
+
+def test_aggregate_short_vector_does_not_break_scrape():
+    """A version-skewed peer's stub vector (marker only / one int) must
+    not 500 the whole /metrics page or crash the panel paths."""
+    agg = MetricsAggregate()
+    agg.merge({5: {0: [time.time(), {"coll_dispatch_ns": ["a"],
+                                     "coll_pstart_ns": ["d", 7]}]}})
+    text = agg.prometheus()          # no IndexError
+    assert "_bucket" not in text     # stubs render nothing
+    assert agg.straggler(5) is None
+    assert agg.job_hist_quantiles(5, "coll_dispatch_ns", 0.99) == {}
+
+
+def test_aggregate_job_eviction_prunes_straggler_baseline():
+    agg = MetricsAggregate(max_jobs=1)
+    now = time.time()
+    agg.merge({1: {0: [now - 5.0,
+                       {"coll_arena_wait_ns": _vec("a", (10, 1),
+                                                   total=100)}]}})
+    assert agg.straggler(1) is not None
+    assert 1 in agg._strag_base
+    agg.merge({2: {0: [now, {"a": 1}]}})     # evicts job 1
+    assert set(agg.snapshot()) == {2}
+    assert 1 not in agg._strag_base
+
+
+def test_aggregate_rank_hist_quantile():
+    agg = MetricsAggregate()
+    b = trace.hist_bucket_index(50_000)
+    key = 'coll_dispatch_ns{slot="allreduce",provider="shm",szb="10"}'
+    agg.merge({7: {0: [time.time(), {key: _vec("a", (b, 100),
+                                               total=5_000_000)}]}})
+    p99 = agg.rank_hist_quantile(7, 0, "coll_dispatch_ns", 0.99)
+    assert p99 is not None and 50_000 / 1.5 <= p99 <= 50_000 * 1.5
+    assert agg.rank_hist_quantile(7, 3, "coll_dispatch_ns", 0.99) is None
+    assert agg.rank_hist_quantile(8, 0, "coll_dispatch_ns", 0.99) is None
 
 
 # -- rank pusher → collector end to end -------------------------------------
@@ -428,6 +707,66 @@ def test_ps_proc_rows_gain_lives_and_metrics_age(scrape_hnp):
     assert rows[0]["restarts_budget_left"] == max(
         0, int(var_registry.get("errmgr_max_restarts")) - 1)
     assert rows[0]["metrics_age_s"] == pytest.approx(4.0, abs=1.0)
+
+
+def test_scrape_status_straggler_panel_names_slowest_rank(scrape_hnp):
+    """The acceptance gate: a deliberately skewed 4-rank job's /status
+    names the slowest rank in the straggler panel."""
+    jobid = 616
+    rows = {}
+    for rank, wait_ns in ((0, 9_000_000_000), (1, 8_000_000_000),
+                          (2, 400_000_000), (3, 8_500_000_000)):
+        rows[rank] = [time.time(),
+                      {"coll_arena_wait_ns": _vec("a", (20, 5),
+                                                  total=wait_ns)}]
+    scrape_hnp.metrics_agg.merge({jobid: rows})
+    _status, body = _get(scrape_hnp.metrics_uri + "/status")
+    doc = json.loads(body)
+    job = {j["jobid"]: j for j in doc["jobs"]}[jobid]
+    panel = job["straggler"]
+    assert panel["suspect"] == 2
+    assert set(panel["ranks"]) == {"0", "1", "2", "3"}
+    assert panel["ranks"]["2"]["wait_share"] == min(
+        r["wait_share"] for r in panel["ranks"].values())
+
+
+def test_scrape_metrics_histogram_series_round_trip(scrape_hnp):
+    """/metrics serves parseable histogram series for pushed vectors
+    (the CI obs-smoke grep, in-process form)."""
+    key = 'coll_pstart_ns{kind="allreduce",provider="shm"}'
+    scrape_hnp.metrics_agg.merge(
+        {7: {0: [time.time(), {key: _vec("a", (8, 2), total=1000)}]}})
+    _status, body = _get(scrape_hnp.metrics_uri + "/metrics")
+    assert "# TYPE ompi_tpu_coll_pstart_ns histogram" in body
+    assert 'ompi_tpu_coll_pstart_ns_bucket{job="7",rank="0",' in body
+    assert 'le="+Inf"} 2' in body
+    assert 'ompi_tpu_coll_pstart_ns_count{job="7",rank="0",' in body
+    # still one # TYPE per name across the whole page (DVM pvars ride
+    # below the aggregate)
+    typed = [ln.split()[2] for ln in body.splitlines()
+             if ln.startswith("# TYPE")]
+    assert len(typed) == len(set(typed))
+
+
+def test_ps_proc_rows_gain_coll_p99_column(scrape_hnp):
+    """--dvm-ps rows carry the p99 collective latency sourced from the
+    rank's pushed dispatch histogram."""
+    from types import SimpleNamespace
+
+    from ompi_tpu.runtime.job import ProcState
+
+    job = SimpleNamespace(jobid=7, procs=[SimpleNamespace(
+        rank=0, state=ProcState.RUNNING,
+        node=SimpleNamespace(name="sim000"), local_rank=0,
+        lives=1, restarts=0, exit_code=None)])
+    b = trace.hist_bucket_index(100_000)
+    key = 'coll_dispatch_ns{slot="allreduce",provider="shm",szb="10"}'
+    scrape_hnp.metrics_agg.merge(
+        {7: {0: [time.time(), {key: _vec("a", (b, 50),
+                                         total=5_000_000)}]}})
+    rows = scrape_hnp._proc_rows(job, {})
+    assert "coll_p99_us" in rows[0]
+    assert 100 / 1.5 <= rows[0]["coll_p99_us"] <= 100 * 1.5
 
 
 # -- PMIx regcount (the barrier the chaos schedule keys on) -----------------

@@ -183,6 +183,79 @@ AGG_METRICS = (
     assert ("unknown-agg-metric", "frames_sent_total") not in got
 
 
+_TRACE_HIST_MOD = """
+_COUNTER_SPECS = (
+    ("frames_sent_total", "frames", "sent"),
+)
+counters = {n: 0 for n, _u, _d in _COUNTER_SPECS}
+
+def count(name, delta=1):
+    counters[name] += delta
+
+_HIST_SPECS = (
+    ("coll_dispatch_ns", "nanoseconds", "dispatch latency"),
+    ("pml_eager_ns", "nanoseconds", "eager latency"),
+    ("io_write_ns", "nanoseconds", "never recorded anywhere"),
+)
+hists = {}
+
+def record_hist(name, dur_ns, labels=""):
+    hists.setdefault(name, [0])[0] += 1
+"""
+
+
+def test_pvar_spec_hist_dead_and_undeclared(tmp_path):
+    """The _HIST_SPECS discipline, both directions: an undeclared
+    record_hist name is flagged, a never-recorded spec is dead, and
+    f-string names expand like counter bumps."""
+    idx = _tree(tmp_path, {
+        "trace.py": _TRACE_HIST_MOD,
+        "app.py": """
+import trace as trace_mod
+
+def hot_path(proto):
+    trace_mod.count("frames_sent_total")
+    trace_mod.record_hist("coll_dispatch_ns", 5, labels='slot="bcast"')
+    trace_mod.record_hist("made_up_ns", 5)        # not in _HIST_SPECS
+    trace_mod.record_hist(f"pml_{proto}_ns", 5)   # matches pml_eager_ns
+""",
+    })
+    got = _rules(pvar_spec.run(idx))
+    assert ("undeclared-hist", "made_up_ns") in got
+    assert ("dead-hist", "io_write_ns") in got
+    assert ("dead-hist", "coll_dispatch_ns") not in got
+    assert ("dead-hist", "pml_eager_ns") not in got   # f-string kept alive
+    # histogram findings never bleed into the counter family
+    assert not any(k == "undeclared-counter" for k, _ in got)
+
+
+def test_pvar_spec_agg_hists_must_name_real_histograms(tmp_path):
+    """AGG_HISTS (the per-job element-wise bucket sums on the scrape
+    endpoint) cross-checks against _HIST_SPECS like AGG_METRICS does
+    against _COUNTER_SPECS."""
+    idx = _tree(tmp_path, {
+        "trace.py": _TRACE_HIST_MOD,
+        "app.py": """
+import trace as trace_mod
+
+def hot_path():
+    trace_mod.count("frames_sent_total")
+    trace_mod.record_hist("coll_dispatch_ns", 5)
+    trace_mod.record_hist("pml_eager_ns", 5)
+    trace_mod.record_hist("io_write_ns", 5)
+""",
+        "metrics.py": """
+AGG_HISTS = (
+    "coll_dispatch_ns",        # real histogram — clean
+    "coll_renamed_ns",         # vanished from _HIST_SPECS — flag
+)
+""",
+    })
+    got = _rules(pvar_spec.run(idx))
+    assert ("unknown-agg-hist", "coll_renamed_ns") in got
+    assert ("unknown-agg-hist", "coll_dispatch_ns") not in got
+
+
 # ---------------------------------------------------------------------------
 # rml-tag
 # ---------------------------------------------------------------------------

@@ -40,6 +40,27 @@ _OUT = os.path.join(
     "COLL_BENCH.jsonl")
 
 
+def _hist_percentiles(before: dict, after: dict, base: str,
+                      label: str = "") -> tuple[float, float]:
+    """(p50 µs, p99 µs) of one histogram family's delta between two
+    ``trace.hists_snapshot()`` snapshots, summed over the series whose
+    key carries ``label`` (e.g. ``slot="allreduce"``) — the per-size-row
+    tail the mean alone hides."""
+    from ompi_tpu.mpi import trace
+
+    counts = [0] * trace.HIST_NBUCKETS
+    for key, vec in after.items():
+        if not (key == base or key.startswith(base + "{")):
+            continue
+        if label and label not in key:
+            continue
+        b = before.get(key)
+        for i in range(trace.HIST_NBUCKETS):
+            counts[i] += vec[i] - (b[i] if b else 0)
+    return (round(trace.hist_quantile_ns(counts, 0.50) / 1e3, 1),
+            round(trace.hist_quantile_ns(counts, 0.99) / 1e3, 1))
+
+
 def _run_world(n: int, fn, timeout: float = 300.0) -> list:
     """In-process n-rank world (tests/mpi/harness.run_ranks, inlined so
     the tool has no test-tree import)."""
@@ -181,7 +202,17 @@ def bench_persistent_config(n: int, coll: str, nbytes: int, iters: int,
 
     b0 = trace.counters["coll_persistent_binds_total"]
     s0 = trace.counters["coll_persistent_starts_total"]
+    h0 = trace.hists_snapshot()
     p_us, o_us, provider = _time_coll_pair(n, coll, nbytes, iters, reps)
+    h1 = trace.hists_snapshot()
+    # per-mode tails: persistent Starts land in coll_pstart_ns, the
+    # one-shot dispatch path in coll_dispatch_ns
+    pcts = {
+        "persistent": _hist_percentiles(h0, h1, "coll_pstart_ns",
+                                        label=f'kind="{coll}"'),
+        "oneshot": _hist_percentiles(h0, h1, "coll_dispatch_ns",
+                                     label=f'slot="{coll}"'),
+    }
     # in-process ranks share the process counters: normalize per rank
     binds_pr = (trace.counters["coll_persistent_binds_total"] - b0) / n
     starts_pr = (trace.counters["coll_persistent_starts_total"] - s0) / n
@@ -189,6 +220,8 @@ def bench_persistent_config(n: int, coll: str, nbytes: int, iters: int,
     rows = []
     for mode, us in (("persistent", p_us), ("oneshot", o_us)):
         rows.append({
+            "p50_us": pcts[mode][0],
+            "p99_us": pcts[mode][1],
             "bench": "coll_bench",
             "coll": coll,
             "ranks": n,
@@ -205,19 +238,29 @@ def bench_persistent_config(n: int, coll: str, nbytes: int, iters: int,
             "quick": quick,
             "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         })
-    print(f"{coll:>9} {nbytes:>9}B x{n}: Start {p_us:9.1f}us  "
-          f"per-op {o_us:9.1f}us  ({speedup:.2f}x)  "
-          f"[{provider}: binds={binds_pr:.0f} "
+    print(f"{coll:>9} {nbytes:>9}B x{n}: Start {p_us:9.1f}us "
+          f"(p99 {pcts['persistent'][1]:.0f})  "
+          f"per-op {o_us:9.1f}us (p99 {pcts['oneshot'][1]:.0f})  "
+          f"({speedup:.2f}x)  [{provider}: binds={binds_pr:.0f} "
           f"starts={starts_pr:.0f}]")
     return rows
 
 
 def bench_config(n: int, coll: str, nbytes: int, iters: int, reps: int,
                  quick: bool) -> list[dict]:
+    from ompi_tpu.mpi import trace
+
     rows = []
     for component, enable in (("shm", True), ("host", False)):
         var_registry.set("coll_shm_enable", enable)
+        h0 = trace.hists_snapshot()
         us = _time_coll(n, coll, nbytes, iters, reps)
+        # per-size tail from the dispatch histogram (the in-process
+        # ranks share the process-wide series; the slot label scopes
+        # the delta to THIS collective, not the sync barriers)
+        p50, p99 = _hist_percentiles(
+            h0, trace.hists_snapshot(), "coll_dispatch_ns",
+            label=f'slot="{coll}"')
         rows.append({
             "bench": "coll_bench",
             "coll": coll,
@@ -225,6 +268,8 @@ def bench_config(n: int, coll: str, nbytes: int, iters: int, reps: int,
             "payload_bytes": nbytes,
             "component": component,
             "per_op_us": round(us, 2),
+            "p50_us": p50,
+            "p99_us": p99,
             "iters": iters,
             "reps": reps,
             "n_cores": os.cpu_count(),
@@ -236,8 +281,11 @@ def bench_config(n: int, coll: str, nbytes: int, iters: int, reps: int,
     speedup = b / a if a else float("inf")
     for r in rows:
         r["shm_speedup"] = round(speedup, 2)
-    print(f"{coll:>9} {nbytes:>9}B x{n}: shm {a:9.1f}us  "
-          f"host {b:9.1f}us  ({speedup:.2f}x)")
+    print(f"{coll:>9} {nbytes:>9}B x{n}: shm {a:9.1f}us "
+          f"(p50 {rows[0]['p50_us']:.0f} p99 {rows[0]['p99_us']:.0f})  "
+          f"host {b:9.1f}us "
+          f"(p50 {rows[1]['p50_us']:.0f} p99 {rows[1]['p99_us']:.0f})  "
+          f"({speedup:.2f}x)")
     return rows
 
 
