@@ -24,14 +24,18 @@ from typing import Optional
 ENV_NO_NATIVE = "OMPI_TPU_NO_NATIVE"
 
 _ABI = 2
+_ARENA_ABI = 1
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "convertor.cpp")
 _FASTDSS_SRC = os.path.join(_DIR, "fastdss.c")
+_ARENA_SRC = os.path.join(_DIR, "arena.c")
 
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 _fastdss = None
 _fastdss_tried = False
+_arena: Optional[ctypes.CDLL] = None
+_arena_tried = False
 
 
 def _hash_name(src: str, stem: str) -> str:
@@ -151,6 +155,83 @@ def lib() -> Optional[ctypes.CDLL]:
 
 def available() -> bool:
     return lib() is not None
+
+
+def arena() -> Optional[ctypes.CDLL]:
+    """The arena/ring executor library, or None (python fallback).
+
+    Plain-C ctypes like the convertor — unlike the per-frame fastdss
+    codec, every call here either parks (waits: the ~1 µs ctypes
+    marshalling cost vanishes into the park) or moves a payload (the
+    copy/fold dominates), so the C-API route's extra complexity buys
+    nothing.  What ctypes DOES buy is the whole point: the GIL is
+    released for the duration of each call, so waits, publishes, and
+    folds stop serializing against the other in-process threads."""
+    global _arena, _arena_tried
+    if _arena is not None or _arena_tried:
+        return _arena
+    _arena_tried = True
+    if os.environ.get(ENV_NO_NATIVE) == "1":
+        return None
+    so = _hash_name(_ARENA_SRC, "_arena")
+    if not os.path.exists(so) and not _build(so, src=_ARENA_SRC):
+        return None
+    try:
+        cdll = ctypes.CDLL(so)
+        cdll.ompi_tpu_arena_abi.restype = ctypes.c_int64
+        if cdll.ompi_tpu_arena_abi() != _ARENA_ABI:
+            return None
+        i64, u64, vp = ctypes.c_int64, ctypes.c_uint64, ctypes.c_void_p
+        # pointers travel as raw integer addresses (c_void_p): every
+        # mapped-segment address is computed Python-side, and arrays of
+        # slot pointers ride (c_void_p * n) blocks
+        cdll.ompi_tpu_arena_wait.argtypes = [vp, i64, u64, i64, i64]
+        cdll.ompi_tpu_arena_wait.restype = i64
+        cdll.ompi_tpu_arena_wait_all.argtypes = [vp, i64, i64, i64, u64,
+                                                 i64, i64]
+        cdll.ompi_tpu_arena_wait_all.restype = i64
+        cdll.ompi_tpu_arena_wait_change.argtypes = [vp, u64, i64, i64]
+        cdll.ompi_tpu_arena_wait_change.restype = i64
+        cdll.ompi_tpu_arena_wake.argtypes = [vp, i64]
+        cdll.ompi_tpu_arena_wake.restype = None
+        cdll.ompi_tpu_ring_wait_any.argtypes = [vp, vp, i64, i64, i64]
+        cdll.ompi_tpu_ring_wait_any.restype = i64
+        cdll.ompi_tpu_arena_publish.argtypes = [vp, vp, i64, vp, i64, u64]
+        cdll.ompi_tpu_arena_publish.restype = None
+        cdll.ompi_tpu_arena_publish_strided.argtypes = [vp, vp, i64, i64,
+                                                        i64, vp, i64, u64]
+        cdll.ompi_tpu_arena_publish_strided.restype = None
+        cdll.ompi_tpu_arena_fold.argtypes = [vp, vp, i64, i64, i64, i64]
+        cdll.ompi_tpu_arena_fold.restype = i64
+        _arena = cdll
+    except OSError:
+        _arena = None
+    return _arena
+
+
+def arena_available() -> bool:
+    return arena() is not None
+
+
+def addr_of(mv) -> Optional[int]:
+    """Raw address of a writable buffer's first byte — the mapped
+    segment base every native arena/ring offset is relative to.  The
+    ctypes object is dropped immediately so the buffer export does not
+    outlive the call (mmap.close() would otherwise raise BufferError)."""
+    try:
+        c = ctypes.c_char.from_buffer(mv)
+    except (TypeError, ValueError, BufferError):
+        return None
+    addr = ctypes.addressof(c)
+    del c     # refcount GC releases the export immediately
+    return addr
+
+
+#: shared spin burst for every native park (arena flag waits, btl ring
+#: parks): on a 1-2 core host even a GIL-free spin steals the
+#: publisher's quantum, so those hosts go straight to the bounded
+#: block (measured: spins=0 beat every burst size on small boxes)
+PARK_SPINS = 4000 if (os.cpu_count() or 1) > 2 else 0
 
 
 def fastdss():

@@ -44,6 +44,7 @@ hosts, where pure spinning loses the core the sender needs.
 
 from __future__ import annotations
 
+import ctypes
 import os
 import struct
 import tempfile
@@ -51,6 +52,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from ompi_tpu import _native
 from ompi_tpu.core import dss, output
 from ompi_tpu.core.config import VarType, register_var, var_registry
 from ompi_tpu.mpi import trace as trace_mod
@@ -86,6 +88,25 @@ def _native_ring():
     from ompi_tpu import _native
 
     return _native.fastdss()
+
+
+def _native_park_lib():
+    """The GIL-released park executor (_native/arena.c), or None.
+    Shares the ``btl_shm_native`` gate with the frame engine: both are
+    halves of the same native data plane."""
+    if not var_registry.get("btl_shm_native"):
+        return None
+    return _native.arena()
+
+
+#: ring-base address helper + park spin burst, shared with the arena
+#: executor (_native.addr_of / _native.PARK_SPINS — small hosts park
+#: with NO spin burst, like the python spin window already did)
+_mv_addr = _native.addr_of
+_PARK_SPINS = _native.PARK_SPINS
+#: one park slice: the cadence at which the poller re-checks stop/pull
+#: state and a blocked writer re-checks its send timeout
+_PARK_SLICE_NS = 1_000_000
 
 _HDR = 64                 # ring header bytes
 _OFF_HEAD, _OFF_TAIL, _OFF_CAP, _OFF_MAGIC = 0, 8, 16, 24
@@ -131,6 +152,7 @@ class ShmRingWriter:
         struct.pack_into("<I", self._mm, _OFF_MAGIC, _MAGIC)
         self._seg.publish()       # ring header complete: now visible
         self._head = 0            # local mirror: we are the only writer
+        self._ctr_addr = _mv_addr(self._mm)   # native backpressure park
         self._lock = threading.Lock()
         self._db_fd: Optional[int] = None   # receiver's doorbell FIFO
         self._first = True
@@ -161,18 +183,40 @@ class ShmRingWriter:
         self._ring_doorbell(bool(self._ctr[_OFF_SLEEP // 8]))
 
     @staticmethod
-    def _backoff(waited: float, delay: float, timeout: float
-                 ) -> tuple[float, float]:
-        """One backpressure tick: the receiver is behind; yield then
-        sleep, bounded.  A receiver that died without close() leaves the
-        ring full forever — the timeout surfaces that as an error (the
-        tcp path gets the equivalent from the kernel via RST)."""
+    def _check_send_timeout(waited: float, timeout: float) -> None:
+        """A receiver that died without close() leaves the ring full
+        forever — the timeout surfaces that as an error (the tcp path
+        gets the equivalent from the kernel via RST)."""
         if timeout and waited > timeout:
             raise ConnectionError(
                 f"btl/shm: ring full for {waited:.0f}s — receiver "
                 f"appears dead (btl_shm_send_timeout)")
+
+    @classmethod
+    def _backoff(cls, waited: float, delay: float, timeout: float
+                 ) -> tuple[float, float]:
+        """One backpressure tick: the receiver is behind; yield then
+        sleep, bounded."""
+        cls._check_send_timeout(waited, timeout)
         time.sleep(delay)
         return waited + delay, min(delay + 2e-5, 1e-3)
+
+    def _wait_space(self, waited: float, delay: float, timeout: float
+                    ) -> tuple[float, float]:
+        """One backpressure park: GIL-released native wait for the
+        receiver's tail counter to move at all (the caller's loop
+        re-checks whether the freed space suffices), falling back to
+        the python yield/sleep tick.  Same timeout contract either
+        way."""
+        ex = _native_park_lib()
+        if ex is None or self._ctr_addr is None:
+            return self._backoff(waited, delay, timeout)
+        self._check_send_timeout(waited, timeout)
+        t0 = time.monotonic()
+        ex.ompi_tpu_arena_wait_change(
+            self._ctr_addr + _OFF_TAIL, int(self._ctr[_OFF_TAIL // 8]),
+            _PARK_SPINS, _PARK_SLICE_NS)
+        return waited + (time.monotonic() - t0), delay
 
     def _ring_doorbell(self, armed: bool) -> None:
         """Wake a sleeping receiver (or announce a brand-new ring: the
@@ -203,7 +247,8 @@ class ShmRingWriter:
                 except fast.RingFull:
                     if not block:
                         return False
-                    waited, delay = self._backoff(waited, delay, timeout)
+                    waited, delay = self._wait_space(waited, delay,
+                                                     timeout)
                     continue
                 except fast.Unsupported:
                     fallback = True   # exotic header: python framing,
@@ -227,7 +272,7 @@ class ShmRingWriter:
                     break
                 if not block:
                     return False
-                waited, delay = self._backoff(waited, delay, timeout)
+                waited, delay = self._wait_space(waited, delay, timeout)
             self._publish(body, hdr, payload)
         return True
 
@@ -307,6 +352,7 @@ class ShmRingReader:
         self._tail = self._ctr[_OFF_TAIL // 8]
         self._seg.unlink()  # mapping survives; crash cleanup is automatic
         self._fast = _native_ring()
+        self._ctr_addr = _mv_addr(self._mm)   # head word the park watches
 
     def poll(self, on_frame: OnFrame, limit: int = 64) -> int:
         """Drain up to ``limit`` frames; returns how many were delivered."""
@@ -655,7 +701,18 @@ class ShmBTL:
                     last_scan = time.monotonic()
                 continue
             idle += 1
-            if idle <= self._spin:   # spin window: drain bursts cheaply
+            parked = self._native_park(readers)
+            if parked is not None:
+                if parked:
+                    # a head moved during the GIL-released park: drain
+                    # immediately (the whole idle window ran without
+                    # touching the interpreter once)
+                    trace_mod.count("btl_shm_native_drains_total")
+                    idle = 0
+                    continue
+                # slice expired with nothing published: fall through to
+                # the doorbell arm (kernel-precise idle, zero CPU)
+            elif idle <= self._spin:   # spin window: drain bursts cheaply
                 time.sleep(0)
                 continue
             # arm the doorbell: set every ring's sleep flag, re-check for
@@ -686,6 +743,29 @@ class ShmBTL:
             for r in readers:
                 r.set_sleeping(False)
             idle = 0
+
+    def _native_park(self, readers) -> Optional[bool]:
+        """One GIL-released park across every attached ring's head
+        counter (a time.sleep(0) spin here fights every other thread
+        for the interpreter — the exact interference ROADMAP item 1
+        measured).  True ⇒ some ring published during the park, False
+        ⇒ slice expired idle, None ⇒ no native executor (python spin
+        window applies)."""
+        ex = _native_park_lib()
+        if ex is None or not readers:
+            return None
+        n = len(readers)
+        ctrs = (ctypes.c_void_p * n)()
+        tails = (ctypes.c_uint64 * n)()
+        for i, r in enumerate(readers):
+            if r._ctr_addr is None:
+                return None
+            ctrs[i] = r._ctr_addr
+            tails[i] = r._tail
+        got = ex.ompi_tpu_ring_wait_any(
+            ctypes.addressof(ctrs), ctypes.addressof(tails), n,
+            _PARK_SPINS, _PARK_SLICE_NS)
+        return got >= 0
 
     def reader_list(self) -> list["ShmRingReader"]:
         """Snapshot of the attached rings (receiver-pull callers)."""

@@ -73,26 +73,16 @@ class HostCollBase(Component):
             return cache[path][1]
 
     def _decide(self, coll: str, comm, nbytes: int) -> Optional[str]:
-        """forced config var > dynamic rules file > None (fixed decision)."""
-        alg = var_registry.get(f"coll_host_{coll}_algorithm")
-        src = f"config var coll_host_{coll}_algorithm"
-        if not alg:
-            path = var_registry.get("coll_host_dynamic_rules")
-            if not path:
-                self._trace_decision(coll, comm, nbytes, None, "fixed")
-                return None
-            alg = self._load_rules(path).lookup(coll, comm.size, nbytes)
-            src = f"rules file {path}"
-            if alg is None:
-                self._trace_decision(coll, comm, nbytes, None, "fixed")
-                return None
-        valid = self.ALGORITHMS.get(coll, ())
-        if alg not in valid:
-            from ompi_tpu.mpi.constants import MPIException
-
-            raise MPIException(
-                f"unknown {coll} algorithm {alg!r} (from {src}); "
-                f"valid: {', '.join(valid)}")
+        """forced config var > dynamic rules file > None (fixed
+        decision) — the shared :func:`rules.decide` ladder, fed by the
+        component's lock-guarded RuleSet cache."""
+        alg, src = rules.decide(
+            coll, comm.size, nbytes,
+            forced=var_registry.get(f"coll_host_{coll}_algorithm") or "",
+            path=var_registry.get("coll_host_dynamic_rules") or "",
+            valid=self.ALGORITHMS.get(coll, ()),
+            forced_src=f"config var coll_host_{coll}_algorithm",
+            load=self._load_rules)
         self._trace_decision(coll, comm, nbytes, alg, src)
         return alg
 

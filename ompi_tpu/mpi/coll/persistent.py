@@ -268,13 +268,33 @@ class _ArenaPlan:
     rank: folded).  Op k uses parity q = k mod 2; reuse of a parity-q
     slot by op k is guarded by the departs of op k-2 — the
     double-buffer overlap window.
+
+    Allreduce binds one of two fold strategies (the
+    ``decide_allreduce_algo`` ladder):
+
+    - ``root_fold`` — rank 0 folds every slot (arrive +1/op);
+    - ``segment_parallel`` — every rank reduce-scatters its 1/p element
+      segment across ALL slots into the result slot, then allgathers by
+      reading the whole result (the PiP/multi-process-per-GPU
+      cooperative shape: O(n) fold work per rank, no single-rank
+      bottleneck).  The arrive counter advances by TWO per op — 2k+1 =
+      "op k published", 2k+2 = "op k's segment folded" — and the
+      publish guard waits ALL departs of op k-2 (every rank reads every
+      input slot and the whole result slot).  NOTE: a rank's completion
+      needs every OTHER rank's fold (which runs on their wait), so
+      outstanding segment-parallel plans must be waited in the same
+      order on every rank — the hier/host providers' existing rule, not
+      the root-fold arena's anything-order.
     """
 
     provider = "shm"
 
     def __init__(self, comm, kind: str, slots, buf, op, root: int,
-                 shape, dtype, recvbuf: Optional[np.ndarray] = None
-                 ) -> None:
+                 shape, dtype, recvbuf: Optional[np.ndarray] = None,
+                 algorithm: Optional[str] = None) -> None:
+        from ompi_tpu.mpi.coll import shm as shm_mod
+
+        self._shm = shm_mod
         self._comm = comm
         self._kind = kind
         self._slots = slots
@@ -286,17 +306,43 @@ class _ArenaPlan:
         self._n = int(np.prod(self._shape)) if self._shape else 1
         self._recvbuf = recvbuf
         self._k = 0
+        self.algorithm = algorithm
+        self._segpar = algorithm == "segment_parallel"
         p = comm.size
-        # prebuilt slot views — the per-op np.frombuffer cost of the
-        # one-shot arena, paid once here
+        # prebuilt slot views AND native offsets — the per-op
+        # np.frombuffer / address arithmetic of the one-shot arena,
+        # paid once here
         if kind in ("reduce", "allreduce", "allgather"):
             self._in = [[np.frombuffer(slots.pslot(q, r), self._dtype,
                                        self._n) for r in range(p)]
                         for q in (0, 1)]
+            self._in_off = [[slots.pslot_off(q, r) for r in range(p)]
+                            for q in (0, 1)]
         if kind in ("allreduce", "bcast"):
             ridx = p if kind == "allreduce" else 0
             self._res = [np.frombuffer(slots.pslot(q, ridx), self._dtype,
                                        self._n) for q in (0, 1)]
+            self._res_off = [slots.pslot_off(q, ridx) for q in (0, 1)]
+        # my reduce-scatter segment (element bounds, segment_parallel)
+        self._seg_lo = comm.rank * self._n // p
+        self._seg_hi = (comm.rank + 1) * self._n // p
+        # native fold eligibility, frozen at bind (the executor handle
+        # itself is re-resolved per call: benches flip coll_shm_native
+        # mid-world for shared-fate comparisons)
+        dc = shm_mod._fold_code(self._dtype)
+        oc = shm_mod._NATIVE_OP_CODES.get(op) if op is not None else None
+        self._fold_codes = ((dc, oc) if dc is not None and oc is not None
+                            else None)
+
+    def _fold_exec(self):
+        """The native executor when this plan's fold can ride it."""
+        s = self._slots
+        if (self._fold_codes is None or s is None
+                or s._base_addr is None
+                or self._n * self._dtype.itemsize
+                < self._shm._NATIVE_PUBLISH_MIN):
+            return None
+        return self._shm._exec()
 
     # -- plumbing ----------------------------------------------------------
 
@@ -346,9 +392,10 @@ class _ArenaPlan:
                     s._wait_all_depart(k - 1, comm)   # k-2 occupant
                 _h_t0 = (time.monotonic_ns()
                          if trace_mod.hist_active else 0)
-                np.copyto(self._res[q].reshape(self._shape), arr,
-                          casting="no")
-                s._set_arrive(k + 1)
+                if not s._publish_arrive(self._res_off[q], arr, k + 1):
+                    np.copyto(self._res[q].reshape(self._shape), arr,
+                              casting="no")
+                    s._set_arrive(k + 1)
                 s._set_depart(k + 1)
                 if _h_t0:
                     # publish half of the straggler split: slot copy +
@@ -364,17 +411,22 @@ class _ArenaPlan:
                 kind="pbcast")
         # data publishers: reduce / allreduce / allgather
         arr = self._as_bound()
-        if kind == "allgather":
-            if k >= 2:       # every rank reads every slot: all departs
+        segpar = kind == "allreduce" and self._segpar
+        if kind == "allgather" or segpar:
+            if k >= 2:   # every rank reads every slot (segment_parallel
+                # additionally reads the whole result): all departs
                 s._wait_all_depart(k - 1, comm)
         else:
             fold = 0 if kind == "allreduce" else self._root
             if k >= 2:
                 s._wait_depart(fold, k - 1, comm)
         _h_t0 = time.monotonic_ns() if trace_mod.hist_active else 0
-        np.copyto(self._in[q][comm.rank].reshape(self._shape), arr,
-                  casting="no")
-        s._set_arrive(k + 1)
+        arrive = 2 * k + 1 if segpar else k + 1
+        if not s._publish_arrive(self._in_off[q][comm.rank], arr,
+                                 arrive):
+            np.copyto(self._in[q][comm.rank].reshape(self._shape), arr,
+                      casting="no")
+            s._set_arrive(arrive)
         if _h_t0:
             trace_mod.record_hist("coll_ppublish_ns",
                                   time.monotonic_ns() - _h_t0)
@@ -390,6 +442,10 @@ class _ArenaPlan:
             return _LazyRequest(lambda: self._drain_allgather(k),
                                 poll=lambda: self._all_arrived(k),
                                 kind="pallgather")
+        if segpar:
+            return _LazyRequest(lambda: self._drain_allreduce_segpar(k),
+                                poll=lambda: self._segpar_ready(k),
+                                kind="pallreduce")
         if comm.rank == 0:
             return _LazyRequest(lambda: self._drain_allreduce(k),
                                 poll=lambda: self._all_arrived(k),
@@ -410,19 +466,33 @@ class _ArenaPlan:
         s._wait_arrive(self._root, k + 1, comm)
         rb = self._recvbuf
         if rb is not None:
-            np.copyto(rb.reshape(-1),
-                      self._res[q].astype(rb.dtype, copy=False))
+            flat = rb.reshape(-1)
+            if not (rb.dtype == self._dtype
+                    and s._copy_out_native(self._res_off[q], flat)):
+                np.copyto(flat, self._res[q].astype(rb.dtype, copy=False))
             out = rb
         else:
             out = np.empty(self._n, self._dtype)
-            np.copyto(out, self._res[q])
+            if not s._copy_out_native(self._res_off[q], out):
+                np.copyto(out, self._res[q])
             out = out.reshape(self._shape)
         s._set_depart(k + 1)
         return out
 
     def _fold(self, k: int) -> np.ndarray:
-        """Rank-ordered fold straight over the parity-q slot views."""
+        """Rank-ordered fold straight over the parity-q slots — one
+        GIL-released native call when the (op, dtype) pair compiled,
+        the numpy view chain otherwise (bit-identical either way)."""
         q = k & 1
+        ex = self._fold_exec()
+        if ex is not None:
+            out = np.empty(self._n, self._dtype)
+            s = self._slots
+            self._shm._native_fold(
+                ex, out.ctypes.data,
+                [s._base_addr + off for off in self._in_off[q]],
+                self._n, *self._fold_codes)
+            return out
         views = self._in[q]
         acc = views[0]
         op = self._op
@@ -446,13 +516,89 @@ class _ArenaPlan:
             s._wait_all_arrive(k + 1, comm)
             if k >= 2:   # readers done with this parity's k-2 result
                 s._wait_all_depart(k - 1, comm)
-            out = self._fold(k)
-            np.copyto(self._res[q], out.reshape(-1), casting="no")
+            ex = self._fold_exec()
+            if ex is not None:
+                # fold straight INTO the mapped result slot (the guard
+                # above cleared it), then copy the root's own result out
+                self._shm._native_fold(
+                    ex, s._base_addr + self._res_off[q],
+                    [s._base_addr + off for off in self._in_off[q]],
+                    self._n, *self._fold_codes)
+                out = np.empty(self._n, self._dtype)
+                if not s._copy_out_native(self._res_off[q], out):
+                    np.copyto(out, self._res[q])
+            else:
+                out = self._fold(k)
+                np.copyto(self._res[q], out.reshape(-1), casting="no")
             s._set_depart(k + 1)
             return out.reshape(self._shape)
         s._wait_depart(0, k + 1, comm)
         out = np.empty(self._n, self._dtype)
-        np.copyto(out, self._res[q])
+        if not s._copy_out_native(self._res_off[q], out):
+            np.copyto(out, self._res[q])
+        s._set_depart(k + 1)
+        return out.reshape(self._shape)
+
+    # -- segment-parallel allreduce (the cooperative every-rank path) ------
+
+    def _segpar_ready(self, k: int) -> bool:
+        """Non-blocking completion poll: every OTHER rank folded its
+        segment (arrive 2k+2 — their drains ran), mine is published
+        (my own fold runs on this thread inside the drain)."""
+        s, me = self._slots, self._comm.rank
+        if s.arrive_at(me) < 2 * k + 1:
+            return False
+        return all(s.arrive_at(r) >= 2 * k + 2
+                   for r in range(s.size) if r != me)
+
+    def _drain_allreduce_segpar(self, k: int):
+        """Reduce-scatter my 1/p segment across all slots into the
+        result slot, then allgather by reading the whole result —
+        O(n) fold work per rank instead of the root's O(p·n), viable
+        because the concurrent folds and parks run GIL-released."""
+        q = k & 1
+        s, comm = self._slots, self._comm
+        s._wait_all_arrive(2 * k + 1, comm)     # everyone published op k
+        lo, hi = self._seg_lo, self._seg_hi
+        if hi > lo:
+            isz = self._dtype.itemsize
+            ex = self._fold_exec()
+            if ex is not None:
+                self._shm._native_fold(
+                    ex, s._base_addr + self._res_off[q] + lo * isz,
+                    [s._base_addr + off + lo * isz
+                     for off in self._in_off[q]], hi - lo,
+                    *self._fold_codes)
+            else:
+                views = self._in[q]
+                acc = views[0][lo:hi]
+                op = self._op
+                for r in range(1, comm.size):
+                    acc = op.host(acc, views[r][lo:hi])
+                np.copyto(self._res[q][lo:hi],
+                          np.asarray(acc, self._dtype), casting="no")
+        s._set_arrive(2 * k + 2)                # my segment is folded
+        try:
+            s._wait_all_arrive(2 * k + 2, comm)  # every segment is
+        except MPIException as e:
+            if "coll_shm_timeout" in str(e):
+                # the fold we are missing runs inside a PEER's drain:
+                # the usual cause is divergent wait order across
+                # outstanding segment_parallel plans — name the
+                # contract in the failure instead of reading as a hang
+                raise MPIException(
+                    f"{e} — outstanding segment_parallel allreduce "
+                    f"plans must be waited in the same order on every "
+                    f"rank (each rank's completion needs every other "
+                    f"rank's fold); wait them in one order, or bind "
+                    f"root_fold via coll_shm_allreduce_algorithm to "
+                    f"restore anything-order waits",
+                    error_class=getattr(e, "error_class", 13)
+                ) from None
+            raise
+        out = np.empty(self._n, self._dtype)
+        if not s._copy_out_native(self._res_off[q], out):
+            np.copyto(out, self._res[q])
         s._set_depart(k + 1)
         return out.reshape(self._shape)
 
@@ -627,6 +773,18 @@ def _bind_arena(comm, kind, buf, op, root, shape, dtype, nbytes,
     from ompi_tpu.mpi.coll import shm as shm_mod
 
     p = comm.size
+    algorithm = None
+    if kind == "allreduce":
+        # fold strategy frozen at bind: root_fold vs segment_parallel,
+        # resolved by the standard ladder (forced var > rules file >
+        # payload crossover) — every rank computes the same verdict
+        # from globally-agreed inputs
+        algorithm, src = shm_mod.decide_allreduce_algo(comm, nbytes)
+        if trace_mod.active:
+            trace_mod.instant(
+                "coll", "decision:shm_allreduce", rank=comm.pml.rank,
+                algorithm=algorithm, source=src, nbytes=nbytes,
+                size=comm.size)
     nslots = {"barrier": 0, "bcast": 1, "allgather": p,
               "reduce": p + 1, "allreduce": p + 1}[kind]
     slots = shm_mod.make_persistent_slots(comm, nbytes, nslots)
@@ -634,7 +792,8 @@ def _bind_arena(comm, kind, buf, op, root, shape, dtype, nbytes,
         return None
     return _ArenaPlan(comm, kind, slots, buf, op, root, shape, dtype,
                       recvbuf=recvbuf if kind == "bcast"
-                      and comm.rank != root else None)
+                      and comm.rank != root else None,
+                      algorithm=algorithm)
 
 
 def _bind_hier(comp, st, host, comm, kind, buf, op, root, nbytes,
@@ -796,6 +955,12 @@ class PersistentCollRequest(PersistentRequest):
         """Which layer the plan bound to: shm | hier | host | nbc | self
         (None once freed)."""
         return self._plan.provider if self._plan is not None else None
+
+    @property
+    def algorithm(self) -> Optional[str]:
+        """The bound fold strategy, where the plan has one (shm
+        allreduce: root_fold | segment_parallel)."""
+        return getattr(self._plan, "algorithm", None)
 
     def _launch(self) -> Request:
         plan = self._plan

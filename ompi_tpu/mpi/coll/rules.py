@@ -22,7 +22,14 @@ from __future__ import annotations
 import os
 from typing import Optional
 
-__all__ = ["RuleSet", "load_rules"]
+__all__ = ["RuleSet", "load_rules", "decide",
+           "SHM_ALLREDUCE", "SHM_ALLREDUCE_ALGORITHMS"]
+
+#: rules-file collective key selecting the coll/shm arena allreduce
+#: fold strategy (coll/shm.decide_allreduce_algo's ladder reads it) —
+#: e.g. ``shm_allreduce 0 1048576 segment_parallel``
+SHM_ALLREDUCE = "shm_allreduce"
+SHM_ALLREDUCE_ALGORITHMS = ("root_fold", "segment_parallel")
 
 
 class RuleSet:
@@ -95,3 +102,37 @@ def load_rules(path: str) -> RuleSet:
         rs = parse(f.read(), source=path)
     _cache[path] = (mtime, rs)
     return rs
+
+
+def decide(coll: str, comm_size: int, msg_bytes: int, forced: str = "",
+           path: str = "", valid: Optional[tuple] = None,
+           forced_src: str = "forced var",
+           load=None) -> tuple[Optional[str], str]:
+    """The selection ladder every decision layer repeats, factored
+    once: forced config var > rules-file hit > ``(None, "fixed")``
+    (the caller applies its fixed default).  ``valid`` is the
+    validation universe (None skips validation; an EMPTY tuple means
+    nothing is valid, so any forced name raises — user tuning must
+    fail loudly, not silently fall through).  ``forced_src`` labels
+    the forced rung in traces/errors; ``load`` substitutes the
+    caller's RuleSet cache for :func:`load_rules` (HostColl keeps its
+    lock-guarded component cache).  Returns
+    ``(algorithm | None, source)``."""
+    if forced:
+        alg: Optional[str] = forced
+        src = forced_src
+    elif path:
+        alg = (load or load_rules)(path).lookup(coll, comm_size,
+                                                msg_bytes)
+        src = f"rules file {path}"
+        if alg is None:
+            return None, "fixed"
+    else:
+        return None, "fixed"
+    if valid is not None and alg not in valid:
+        from ompi_tpu.mpi.constants import MPIException
+
+        raise MPIException(
+            f"unknown {coll} algorithm {alg!r} (from {src}); "
+            f"valid: {', '.join(valid)}")
+    return alg, src

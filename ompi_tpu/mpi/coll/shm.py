@@ -58,6 +58,7 @@ takes the same branch without a pre-exchange.
 
 from __future__ import annotations
 
+import ctypes
 import os
 import time
 import uuid
@@ -65,15 +66,18 @@ from typing import Optional
 
 import numpy as np
 
+from ompi_tpu import _native
 from ompi_tpu.core import output, shmseg
 from ompi_tpu.core.config import VarType, register_var, var_registry
 from ompi_tpu.core.mca import Component
+from ompi_tpu.mpi import op as op_mod
 from ompi_tpu.mpi import trace as trace_mod
-from ompi_tpu.mpi.coll import base, coll_framework
+from ompi_tpu.mpi.coll import base, coll_framework, rules
 from ompi_tpu.mpi.constants import COMM_TYPE_SHARED, UNDEFINED, MPIException
 from ompi_tpu.mpi.op import Op
 
-__all__ = ["ShmColl", "Arena", "PersistentSlots", "make_persistent_slots"]
+__all__ = ["ShmColl", "Arena", "PersistentSlots", "make_persistent_slots",
+           "decide_allreduce_algo"]
 
 _log = output.get_stream("coll")
 
@@ -87,6 +91,136 @@ _TOKEN = np.zeros(0, np.uint8)  # gate payload for the arena-less intra path
 def _arena_dtype_ok(dtype: np.dtype) -> bool:
     """Raw-byte publishable: fixed-size, no python object indirection."""
     return not dtype.hasobject and dtype.itemsize > 0
+
+
+# ---------------------------------------------------------------------------
+# the native executor (_native/arena.c via ctypes — every call runs with
+# the GIL RELEASED, which is the entire point: a rank parked in a flag
+# wait or moving a 64 KiB slot no longer serializes the other in-process
+# threads.  Python keeps every policy decision: FT checks, probes, and
+# deadlines run between bounded native slices)
+# ---------------------------------------------------------------------------
+
+#: spin burst inside one native slice (shared across the native data
+#: plane — see _native.PARK_SPINS for the small-host rationale and the
+#: measured spin sweep)
+_NATIVE_SPINS = _native.PARK_SPINS
+#: one park slice: the cadence at which the Python FT contract
+#: (revocation, detector-dead, writer pid probe, deadline) re-runs
+_NATIVE_SLICE_NS = 2_000_000
+#: below this a ctypes call costs more than the GIL-held numpy copy
+_NATIVE_PUBLISH_MIN = 512
+
+#: physical parallelism available to cooperative folds (tests patch it)
+_NCORES = os.cpu_count() or 1
+
+
+def _exec():
+    """The loaded native arena executor, or None (python data plane).
+    The var read is per-call by design: benchmarks flip
+    ``coll_shm_native`` mid-world for shared-fate comparisons."""
+    if not var_registry.get("coll_shm_native"):
+        return None
+    return _native.arena()
+
+
+#: segment-base address helper, shared with the btl ring park
+_addr_of = _native.addr_of
+
+
+def _strided_desc(arr: np.ndarray) -> Optional[tuple[int, int, int]]:
+    """Describe ``arr``'s memory as ONE strided progression in C order
+    — ``(nblocks, bl, stride)``, the convertor plan ABI's vector-class
+    shape — or None when the layout needs a full run walk (the numpy
+    path handles those)."""
+    if arr.nbytes == 0:
+        return None
+    if arr.flags.c_contiguous:
+        return 1, arr.nbytes, arr.nbytes
+    dims = [(s, st) for s, st in zip(arr.shape, arr.strides) if s != 1]
+    if not dims:
+        return 1, arr.itemsize, arr.itemsize
+    bl = arr.itemsize
+    while dims and dims[-1][1] == bl:     # collapse the contiguous tail
+        bl *= dims[-1][0]
+        dims.pop()
+    if not dims:
+        return 1, bl, bl
+    if len(dims) == 1 and dims[0][1] > 0:
+        return dims[0][0], bl, dims[0][1]
+    return None
+
+
+#: (dtype.kind, itemsize) → arena.c dtype code (native-endian only)
+_FOLD_DTYPE_CODES = {
+    ("i", 1): 0, ("i", 2): 1, ("i", 4): 2, ("i", 8): 3,
+    ("u", 1): 4, ("u", 2): 5, ("u", 4): 6, ("u", 8): 7,
+    ("f", 4): 8, ("f", 8): 9,
+}
+
+#: the exact builtin Op OBJECTS the native fold reproduces bit-for-bit
+#: (identity keyed: a user create_op named "sum" must NOT match)
+_NATIVE_OP_CODES = {op_mod.SUM: 0, op_mod.PROD: 1,
+                    op_mod.MIN: 2, op_mod.MAX: 3}
+
+
+def _fold_code(dtype: np.dtype) -> Optional[int]:
+    if not dtype.isnative:
+        return None
+    return _FOLD_DTYPE_CODES.get((dtype.kind, dtype.itemsize))
+
+
+def _native_fold(ex, dst_addr: int, src_addrs: list, nelems: int,
+                 dtype_code: int, op_code: int) -> None:
+    """One GIL-released rank-ordered elementwise fold; raises on a
+    contract violation (caller pre-validated the codes)."""
+    srcs = (ctypes.c_void_p * len(src_addrs))(*src_addrs)
+    rc = ex.ompi_tpu_arena_fold(dst_addr, ctypes.addressof(srcs),
+                                len(src_addrs), nelems, dtype_code,
+                                op_code)
+    if rc != 0:
+        raise MPIException(
+            f"coll/shm: native fold rejected pre-validated plan "
+            f"(dtype code {dtype_code}, op code {op_code})")
+    trace_mod.count("coll_shm_native_folds_total")
+
+
+def decide_allreduce_algo(comm, nbytes: int) -> tuple[str, str]:
+    """The arena-allreduce fold strategy, resolved by the standard
+    selection ladder (forced var > rules file > fixed crossover):
+
+    - ``root_fold``         — one rank folds every slot (the historic
+      path; optimal while the fold is cheaper than a second rendezvous)
+    - ``segment_parallel``  — every rank reduce-scatters its 1/p
+      segment across all slots, then allgathers through the result
+      slot: O(n) fold work per rank instead of O(p·n) on one rank.
+      The AGGREGATE fold work is unchanged (p·n reads either way), so
+      spreading it only pays when the ranks can actually fold
+      concurrently — the fixed crossover therefore requires BOTH a
+      payload above ``coll_shm_segpar_min`` AND cores >= ranks (PR 10
+      measured the python variant losing from spinner interference;
+      with the native executor the spinners are gone, but a 1-2 core
+      box still has no spare core to fold on, and the measured result
+      there is parity-at-best — PERF.md "Segment-parallel allreduce").
+      A rules-file hit or the forced var overrides the core gate: the
+      operator knows their box.
+
+    Returns ``(algorithm, source)``.
+    """
+    forced = str(var_registry.get("coll_shm_allreduce_algorithm") or "")
+    path = str(var_registry.get("coll_host_dynamic_rules") or "")
+    alg, src = rules.decide(rules.SHM_ALLREDUCE, comm.size, nbytes,
+                            forced=forced, path=path,
+                            valid=rules.SHM_ALLREDUCE_ALGORITHMS)
+    if alg is None:
+        crossover = int(var_registry.get("coll_shm_segpar_min") or 0)
+        alg = ("segment_parallel"
+               if crossover and nbytes >= crossover
+               and 2 <= comm.size <= _NCORES
+               else "root_fold")
+        src = (f"fixed crossover (coll_shm_segpar_min={crossover}, "
+               f"{comm.size} ranks on {_NCORES} cores)")
+    return alg, src
 
 
 _grace_warned = False
@@ -152,6 +286,10 @@ class Arena:
         self._slot_base = self._desc_base + size * _DESC
         self._arr = 0   # my arrive counter (mirror of the mapped value)
         self._dep = 0   # my depart counter
+        # segment base address for the native executor (flag word i of
+        # the mapped u64 view is base + i*8, slot offsets are relative
+        # to the same base); None ⇒ python data plane only
+        self._base_addr = _addr_of(seg.buf)
 
     @staticmethod
     def nbytes_for(size: int, slot_bytes: int) -> int:
@@ -170,10 +308,23 @@ class Arena:
     def _set_arrive(self, v: int) -> None:
         self._flags[self.rank * 8] = v
         self._arr = v
+        self._wake(self.rank * 8)
 
     def _set_depart(self, v: int) -> None:
         self._flags[(self.size + self.rank) * 8] = v
         self._dep = v
+        self._wake((self.size + self.rank) * 8)
+
+    def _wake(self, idx: int) -> None:
+        """Futex-wake any native waiter parked on flag ``idx`` — every
+        python-side flag store pairs with one so the futex park wakes
+        at store time, not at its bounded-timeout backstop.  (Native
+        publishes fuse the wake into the same GIL-released call.)"""
+        if self._base_addr is None:
+            return
+        ex = _exec()
+        if ex is not None:
+            ex.ompi_tpu_arena_wake(self._base_addr, idx)
 
     # on a 1-2 core host every spin iteration steals the flag-writer's
     # quantum (the btl/shm poller disables its spin window there for the
@@ -189,6 +340,18 @@ class Arena:
         # histogram on completed waits (an already-satisfied flag never
         # reaches this point, so the fast path stays one compare)
         _h_t0 = time.monotonic_ns() if trace_mod.hist_active else 0
+        ex = _exec() if self._base_addr is not None else None
+        if ex is not None:
+            self._park_native(ex, v, comm, idx=idx)
+        else:
+            self._wait_py(idx, v, comm)
+        if _h_t0 and trace_mod.hist_active:
+            trace_mod.record_hist("coll_arena_wait_ns",
+                                  time.monotonic_ns() - _h_t0)
+
+    def _wait_py(self, idx: int, v: int, comm) -> None:
+        """The pure-python park (native executor off/unavailable)."""
+        f = self._flags
         timeout = float(var_registry.get("coll_shm_timeout") or 60)
         grace = _probe_grace(timeout) if (self.world is not None
                                           and self._pml is not None) else 0.0
@@ -216,6 +379,76 @@ class Arena:
                     f"have {int(f[idx])}) stuck for {timeout:.0f}s on "
                     f"{getattr(comm, 'name', '?')} — peer dead or "
                     f"collective-order mismatch (coll_shm_timeout)")
+
+    def _park_native(self, ex, v: int, comm, idx: Optional[int] = None,
+                     all_base: Optional[int] = None) -> None:
+        """GIL-released park: bounded native slices (spin burst +
+        escalating naps in C, no interpreter involvement) with the FULL
+        python-loop FT contract re-run between slices — revocation and
+        detector-dead checks, the dead-writer pid probe after the
+        grace, and the coll_shm_timeout deadline, all at the same
+        ~slice cadence the escalated python loop reached them."""
+        trace_mod.count("coll_shm_native_waits_total")
+        timeout = float(var_registry.get("coll_shm_timeout") or 60)
+        grace = _probe_grace(timeout) if (self.world is not None
+                                          and self._pml is not None) else 0.0
+        now = time.monotonic()
+        deadline = now + timeout
+        probe_at = now + grace if grace > 0 else None
+        base = self._base_addr
+        while True:
+            if all_base is None:
+                done = ex.ompi_tpu_arena_wait(
+                    base, idx, v, _NATIVE_SPINS, _NATIVE_SLICE_NS)
+            else:
+                done = ex.ompi_tpu_arena_wait_all(
+                    base, all_base, 8, self.size, v, _NATIVE_SPINS,
+                    _NATIVE_SLICE_NS)
+            if done:
+                return
+            if comm is not None:
+                self._check_ft(comm)
+            lag = self._laggard(v, idx=idx, all_base=all_base)
+            if probe_at is not None and time.monotonic() > probe_at:
+                self._probe_writer(lag % self.size, grace, timeout)
+            if time.monotonic() > deadline:
+                f = self._flags
+                flag = idx if all_base is None else all_base + lag * 8
+                raise MPIException(
+                    f"coll/shm: arena wait (flag {flag // 8}, want {v}, "
+                    f"have {int(f[flag])}) stuck for {timeout:.0f}s on "
+                    f"{getattr(comm, 'name', '?')} — peer dead or "
+                    f"collective-order mismatch (coll_shm_timeout)")
+
+    def _laggard(self, v: int, idx: Optional[int] = None,
+                 all_base: Optional[int] = None) -> int:
+        """Arena rank whose flag a stalled wait is parked on (the pid
+        the probe should ask about)."""
+        if all_base is None:
+            return (idx // 8) % self.size
+        f = self._flags
+        for r in range(self.size):
+            if f[all_base + r * 8] < v:
+                return r
+        return 0
+
+    def _wait_many(self, all_base: int, v: int, comm) -> None:
+        """Wait flag[all_base + r*8] >= v for every arena rank — ONE
+        native call when the executor is live, the per-flag python
+        loop otherwise."""
+        f = self._flags
+        r0 = 0
+        while r0 < self.size and f[all_base + r0 * 8] >= v:
+            r0 += 1
+        if r0 >= self.size:
+            return
+        ex = _exec() if self._base_addr is not None else None
+        if ex is None:
+            for r in range(r0, self.size):
+                self._wait(all_base + r * 8, v, comm)
+            return
+        _h_t0 = time.monotonic_ns() if trace_mod.hist_active else 0
+        self._park_native(ex, v, comm, all_base=all_base)
         if _h_t0 and trace_mod.hist_active:
             trace_mod.record_hist("coll_arena_wait_ns",
                                   time.monotonic_ns() - _h_t0)
@@ -293,18 +526,71 @@ class Arena:
         self._wait((self.size + r) * 8, v, comm)
 
     def _wait_all_arrive(self, v: int, comm) -> None:
-        for r in range(self.size):
-            self._wait(r * 8, v, comm)
+        self._wait_many(0, v, comm)
 
     def _wait_all_depart(self, v: int, comm) -> None:
-        for r in range(self.size):
-            self._wait((self.size + r) * 8, v, comm)
+        self._wait_many(self.size * 8, v, comm)
 
     # -- slots / descriptors ------------------------------------------------
 
+    def _slot_off(self, i: int) -> int:
+        return self._slot_base + i * self.slot_bytes
+
     def _slot(self, i: int) -> memoryview:
-        off = self._slot_base + i * self.slot_bytes
+        off = self._slot_off(i)
         return self.seg.buf[off:off + self.slot_bytes]
+
+    # -- native data movement ------------------------------------------------
+
+    def _publish_native(self, dst_off: int, arr: np.ndarray, fidx: int,
+                        fval: int) -> bool:
+        """Slot copy + release flag store fused into ONE GIL-released
+        call (strided sources ride the convertor plan ABI's vector
+        shape).  False ⇒ the caller runs the numpy copy + python flag
+        store — exotic layouts and sub-threshold payloads, where the
+        ctypes call would cost more than it frees."""
+        if arr.nbytes < _NATIVE_PUBLISH_MIN or self._base_addr is None:
+            return False
+        ex = _exec()
+        if ex is None:
+            return False
+        desc = _strided_desc(arr)
+        if desc is None:
+            return False
+        nblocks, bl, stride = desc
+        dst = self._base_addr + dst_off
+        if nblocks == 1:
+            ex.ompi_tpu_arena_publish(dst, arr.ctypes.data, arr.nbytes,
+                                      self._base_addr, fidx, fval)
+        else:
+            ex.ompi_tpu_arena_publish_strided(
+                dst, arr.ctypes.data, nblocks, bl, stride,
+                self._base_addr, fidx, fval)
+        trace_mod.count("coll_shm_native_publishes_total")
+        return True
+
+    def _publish_arrive(self, dst_off: int, arr: np.ndarray,
+                        v: int) -> bool:
+        """Native publish stamped with MY arrive counter (mirror kept
+        in sync); False ⇒ caller copies + ``_set_arrive`` itself."""
+        if self._publish_native(dst_off, arr, self.rank * 8, v):
+            self._arr = v
+            return True
+        return False
+
+    def _copy_out_native(self, src_off: int, dst: np.ndarray) -> bool:
+        """Mapped slot → caller buffer as one GIL-released copy (the
+        drain-side mirror of ``_publish_native``, no flag store)."""
+        if (dst.nbytes < _NATIVE_PUBLISH_MIN or self._base_addr is None
+                or not dst.flags.c_contiguous):
+            return False
+        ex = _exec()
+        if ex is None:
+            return False
+        ex.ompi_tpu_arena_publish(dst.ctypes.data,
+                                  self._base_addr + src_off, dst.nbytes,
+                                  None, 0, 0)
+        return True
 
     def _write_desc(self, code: int, arr: Optional[np.ndarray],
                     nseg: int) -> None:
@@ -393,8 +679,10 @@ class Arena:
                 lo = k * self.half
                 hi = min(lo + self.half, arr.nbytes)
                 hoff = (k % 2) * self.half
-                slot[hoff:hoff + hi - lo] = u8[lo:hi].data
-                self._set_arrive(s0 + k + 1)
+                if not self._publish_arrive(self._slot_off(nroot) + hoff,
+                                            u8[lo:hi], s0 + k + 1):
+                    slot[hoff:hoff + hi - lo] = u8[lo:hi].data
+                    self._set_arrive(s0 + k + 1)
             self._wait_all_arrive(s0 + nseg, comm)
             return arr
         s0 = self._arr
@@ -410,7 +698,10 @@ class Arena:
             lo = k * self.half
             hi = min(lo + self.half, nbytes)
             hoff = (k % 2) * self.half
-            out[lo:hi] = np.frombuffer(slot[hoff:hoff + hi - lo], np.uint8)
+            if not self._copy_out_native(self._slot_off(nroot) + hoff,
+                                         out[lo:hi]):
+                out[lo:hi] = np.frombuffer(slot[hoff:hoff + hi - lo],
+                                           np.uint8)
             self._set_arrive(s0 + k + 1)
         return out.view(dtype).reshape(shape)
 
@@ -438,65 +729,105 @@ class Arena:
             flat = (arr if arr.flags.c_contiguous
                     else np.ascontiguousarray(arr)).reshape(-1)
 
+        # native fold eligibility, resolved once per op: builtin op
+        # (identity match) + native-endian fixed width + a payload the
+        # ctypes call amortizes over
+        ex = _exec() if self._base_addr is not None else None
+        dc = _fold_code(dtype) if ex is not None else None
+        oc = _NATIVE_OP_CODES.get(op) if ex is not None else None
+        nat_fold = (dc is not None and oc is not None
+                    and arr.nbytes >= _NATIVE_PUBLISH_MIN)
+
         def seg_bounds(k: int):
             lo = k * seg_elems
             hi = min(lo + seg_elems, n)
             return lo, hi, (k % 2) * self.half
 
-        def write_my_seg(k: int) -> None:
+        def publish_my_seg(k: int, v: int) -> None:
             lo, hi, hoff = seg_bounds(k)
+            src = arr if nseg == 1 else flat[lo:hi]
+            if self._publish_arrive(self._slot_off(me) + hoff, src, v):
+                return
             dst = myslot[hoff:hoff + (hi - lo) * itemsize]
             if nseg == 1:
                 self._copy_in(dst, arr)   # strided sources walk directly
             else:
                 np.copyto(np.frombuffer(dst, dtype, count=hi - lo),
                           flat[lo:hi], casting="no")
+            self._set_arrive(v)
 
         if me == nroot:
-            parts = []
+            out = np.empty(n, dtype)
             for k in range(nseg):
                 lo, hi, hoff = seg_bounds(k)
-                write_my_seg(k)
-                self._set_arrive(s0a + k + 1)
+                publish_my_seg(k, s0a + k + 1)
                 self._wait_all_arrive(s0a + k + 1, comm)
-                # fold straight from the mapped slots, in rank order
-                acc = np.frombuffer(self._slot(0)[hoff:], dtype,
-                                    count=hi - lo)
-                for i in range(1, self.size):
-                    acc = op.host(acc, np.frombuffer(
-                        self._slot(i)[hoff:], dtype, count=hi - lo))
-                acc = np.asarray(acc)
-                parts.append(acc)
                 if bcast_result and k >= 2:
                     # readers finished with this result half's previous
-                    # occupant (segment k-2)
+                    # occupant (segment k-2) — must precede the result
+                    # write, which the native fold lands directly
                     self._wait_all_depart(s0d + k - 1, comm)
-                if bcast_result:
-                    np.copyto(np.frombuffer(res[hoff:], dtype,
-                                            count=hi - lo), acc,
-                              casting="no")
+                count = hi - lo
+                if nat_fold:
+                    # rank-ordered fold straight over the mapped slots,
+                    # GIL released — into the result slot (allreduce) or
+                    # the root's output buffer
+                    if bcast_result:
+                        dst_addr = (self._base_addr
+                                    + self._slot_off(self.size) + hoff)
+                    else:
+                        dst_addr = out.ctypes.data + lo * itemsize
+                    _native_fold(
+                        ex, dst_addr,
+                        [self._base_addr + self._slot_off(i) + hoff
+                         for i in range(self.size)], count, dc, oc)
+                    if bcast_result:
+                        # read the root's own copy back GIL-released
+                        # too (same helper as every other drain site)
+                        if not self._copy_out_native(
+                                self._slot_off(self.size) + hoff,
+                                out[lo:hi]):
+                            out[lo:hi] = np.frombuffer(
+                                res[hoff:hoff + count * itemsize],
+                                dtype)
+                else:
+                    # fold straight from the mapped slots, in rank order
+                    acc = np.frombuffer(self._slot(0)[hoff:], dtype,
+                                        count=count)
+                    for i in range(1, self.size):
+                        acc = op.host(acc, np.frombuffer(
+                            self._slot(i)[hoff:], dtype, count=count))
+                    acc = np.asarray(acc)
+                    out[lo:hi] = acc.reshape(-1)
+                    if bcast_result:
+                        np.copyto(np.frombuffer(res[hoff:], dtype,
+                                                count=count), acc,
+                                  casting="no")
                 self._set_depart(s0d + k + 1)
             if bcast_result:
                 self._wait_all_depart(s0d + nseg, comm)
-            out = parts[0] if nseg == 1 else np.concatenate(parts)
             return out.reshape(arr.shape).astype(dtype, copy=False)
         # non-root: publish segments one ahead of the root's fold, and
         # (for allreduce) drain result segments one behind it
         out = np.empty(n, dtype) if bcast_result else None
+        res_off = self._slot_off(self.size)
         for k in range(nseg):
             if not bcast_result and k >= 2:
                 self._wait_depart(nroot, s0d + k - 1, comm)
-            write_my_seg(k)
-            self._set_arrive(s0a + k + 1)
+            publish_my_seg(k, s0a + k + 1)
             if bcast_result and k >= 1:
                 lo, hi, hoff = seg_bounds(k - 1)
                 self._wait_depart(nroot, s0d + k, comm)
-                out[lo:hi] = np.frombuffer(res[hoff:], dtype, count=hi - lo)
+                if not self._copy_out_native(res_off + hoff, out[lo:hi]):
+                    out[lo:hi] = np.frombuffer(res[hoff:], dtype,
+                                               count=hi - lo)
                 self._set_depart(s0d + k)
         self._wait_depart(nroot, s0d + nseg, comm)
         if bcast_result:
             lo, hi, hoff = seg_bounds(nseg - 1)
-            out[lo:hi] = np.frombuffer(res[hoff:], dtype, count=hi - lo)
+            if not self._copy_out_native(res_off + hoff, out[lo:hi]):
+                out[lo:hi] = np.frombuffer(res[hoff:], dtype,
+                                           count=hi - lo)
         self._set_depart(s0d + nseg)
         return out.reshape(arr.shape) if bcast_result else None
 
@@ -507,13 +838,18 @@ class Arena:
         indexed by arena rank.  Caller checked nbytes <= slot_bytes."""
         arr = np.asarray(arr)
         s0a, s0d = self._arr, self._dep
-        self._copy_in(self._slot(self.rank)[:max(arr.nbytes, 1)], arr)
-        self._set_arrive(s0a + 1)
+        if not self._publish_arrive(self._slot_off(self.rank), arr,
+                                    s0a + 1):
+            self._copy_in(self._slot(self.rank)[:max(arr.nbytes, 1)], arr)
+            self._set_arrive(s0a + 1)
         self._wait_all_arrive(s0a + 1, comm)
         out = np.empty((self.size,) + arr.shape, arr.dtype)
+        rows = out.reshape(self.size, -1)
         for i in range(self.size):
-            src = np.frombuffer(self._slot(i), arr.dtype, count=arr.size)
-            out[i] = src.reshape(arr.shape)
+            if not self._copy_out_native(self._slot_off(i), rows[i]):
+                src = np.frombuffer(self._slot(i), arr.dtype,
+                                    count=arr.size)
+                out[i] = src.reshape(arr.shape)
         self._set_depart(s0d + 1)
         self._wait_all_depart(s0d + 1, comm)
         return out
@@ -552,8 +888,11 @@ class PersistentSlots(Arena):
     def pnbytes_for(size: int, slot_bytes: int, nslots: int) -> int:
         return 2 * size * _CACHELINE + 2 * nslots * slot_bytes
 
+    def pslot_off(self, parity: int, i: int) -> int:
+        return self._slot_base + (parity * self.nslots + i) * self.slot_bytes
+
     def pslot(self, parity: int, i: int) -> memoryview:
-        off = self._slot_base + (parity * self.nslots + i) * self.slot_bytes
+        off = self.pslot_off(parity, i)
         return self.seg.buf[off:off + self.slot_bytes]
 
     # non-blocking peeks (the poll half of a persistent op's test())
@@ -710,6 +1049,21 @@ class ShmColl(Component):
                      "(0 = disabled); a SIGKILLed writer then fails its "
                      "peers in ~this window instead of coll_shm_timeout. "
                      "Validated to stay below coll_shm_timeout")
+        register_var("coll", "shm_native", VarType.BOOL, True,
+                     "run the arena steady state (flag waits, slot "
+                     "publishes, segment folds) through the native "
+                     "GIL-released executor (_native/arena.c). Off, a "
+                     "failed build, or OMPI_TPU_NO_NATIVE=1 -> the "
+                     "pure-python data plane (bit-identical results)")
+        register_var("coll", "shm_allreduce_algorithm", VarType.STRING,
+                     "", "force the persistent arena allreduce fold "
+                     "strategy: root_fold | segment_parallel (empty = "
+                     "rules file / payload crossover)")
+        register_var("coll", "shm_segpar_min", VarType.SIZE, 1 << 20,
+                     "payload crossover above which a persistent arena "
+                     "allreduce binds the cooperative segment-parallel "
+                     "reduce-scatter+allgather instead of the "
+                     "single-rank root fold (0 = never)")
 
     def query(self, comm=None, **ctx) -> Optional[int]:
         if not var_registry.get("coll_shm_enable"):

@@ -180,6 +180,19 @@ _COUNTER_SPECS = (
      "precv_init request)"),
     ("pml_partitioned_pready_total", "partitions",
      "partitions published by Pready on active partitioned sends"),
+    # GIL-free native data plane (_native/arena.c via ctypes)
+    ("coll_shm_native_waits_total", "waits",
+     "arena flag waits parked in the native GIL-released executor "
+     "(bounded slices; the python FT contract re-runs between them)"),
+    ("coll_shm_native_publishes_total", "publishes",
+     "arena slot publishes (copy + release flag store, strided sources "
+     "via the convertor plan shape) fused into one native call"),
+    ("coll_shm_native_folds_total", "folds",
+     "width-specialized native segment folds (reduce/allreduce root "
+     "folds and segment-parallel reduce-scatter segments)"),
+    ("btl_shm_native_drains_total", "sweeps",
+     "btl/shm poller drain sweeps woken by the native GIL-released "
+     "ring park instead of the python spin window"),
 )
 
 #: plain-int counter store: dict increments, no lock — losses under

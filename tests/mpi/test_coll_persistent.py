@@ -619,3 +619,271 @@ def test_buffer_shape_change_raises_on_start():
             return "changed" in str(e)
 
     assert all(run_ranks(2, body))
+
+
+# ---------------------------------------------------------------------------
+# segment-parallel allreduce (the cooperative every-rank fold)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def segpar_forced():
+    var_registry.set("coll_shm_allreduce_algorithm", "segment_parallel")
+    yield
+    var_registry.set("coll_shm_allreduce_algorithm", "")
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_segpar_bit_parity_vs_root_fold_and_oneshot(seed, segpar_forced):
+    """Same op order per element ⇒ segment_parallel, root_fold, and
+    the one-shot arena must agree BITWISE, dtype sweep included."""
+    rng = np.random.default_rng(seed)
+    dtype = np.dtype(["f8", "f4", "i8", "i2"][seed % 4])
+    op = [op_mod.SUM, op_mod.MIN, op_mod.MAX][seed % 3]
+    n = int(rng.integers(3, 4000))   # includes n < p (empty segments)
+
+    def body(comm):
+        r = np.random.default_rng(7 + comm.rank)
+        if dtype.kind == "f":
+            x = (r.standard_normal(n) * 2).astype(dtype)
+        else:
+            x = r.integers(1, 4, size=n).astype(dtype)
+        req_seg = comm.allreduce_init(x, op=op)
+        assert req_seg.provider == "shm"
+        assert req_seg.algorithm == "segment_parallel"
+        var_registry.set("coll_shm_allreduce_algorithm", "root_fold")
+        comm.barrier()
+        req_root = comm.allreduce_init(x, op=op)
+        assert req_root.algorithm == "root_fold"
+        comm.barrier()
+        var_registry.set("coll_shm_allreduce_algorithm",
+                         "segment_parallel")
+        outs = []
+        for _ in range(5):
+            req_seg.start()
+            a = req_seg.wait()
+            req_root.start()
+            b = req_root.wait()
+            outs.append((np.copy(a), np.copy(b)))
+        one = comm.allreduce(x, op=op)
+        req_seg.free()
+        req_root.free()
+        return outs, one
+
+    for outs, one in run_ranks(5, body):
+        for a, b in outs:
+            assert a.dtype == one.dtype == b.dtype
+            np.testing.assert_array_equal(a, b)
+            np.testing.assert_array_equal(a, one)
+
+
+def test_segpar_parity_overlap_staggered_drains(segpar_forced):
+    """Cross-op double buffering under rank-staggered wait order: the
+    2-stride arrive protocol and the all-departs publish guard must
+    keep parity-q slots exclusive across op k / k+2."""
+    def body(comm):
+        x = np.empty(512)
+        req = comm.allreduce_init(x)
+        outs = []
+        for k in range(16):
+            x[...] = (k + 1) * (comm.rank + 1)
+            req.start()
+            if comm.rank == 0:
+                time.sleep(0.002)   # rank 0 drags one op behind
+            outs.append(np.copy(req.wait()))
+        req.free()
+        return outs
+
+    p = 4
+    for outs in run_ranks(p, body):
+        for k, out in enumerate(outs):
+            np.testing.assert_array_equal(
+                out, np.full(512, (k + 1) * sum(range(1, p + 1))))
+
+
+def test_segpar_extension_dtype_falls_to_nbc(segpar_forced):
+    """The '<V2' boundary: an extension dtype can't ride the arena at
+    all, so a forced segment_parallel must not hijack the fallback —
+    the plan binds nbc and still matches the one-shot result."""
+    ml_dtypes = pytest.importorskip("ml_dtypes")
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+
+    def body(comm):
+        x = (np.arange(64) * (comm.rank + 1)).astype(bf16)
+        req = comm.allreduce_init(x, op=op_mod.SUM)
+        prov = req.provider
+        req.start()
+        out = np.copy(req.wait())
+        one = comm.allreduce(x, op=op_mod.SUM)
+        req.free()
+        return prov, out, one
+
+    for prov, out, one in run_ranks(3, body):
+        assert prov == "nbc"
+        np.testing.assert_array_equal(out, one)
+
+
+def test_segpar_selection_ladder(tmp_path, monkeypatch):
+    """forced var > rules file > payload crossover, with loud rejection
+    of unknown names (the host _decide contract, shm form).  The fixed
+    crossover's core gate is pinned open (cores >= ranks) so the
+    assertion holds on any box; the gate itself is tested below."""
+    monkeypatch.setattr(_shm, "_NCORES", 8)
+    rules_path = tmp_path / "rules.conf"
+    rules_path.write_text(
+        "shm_allreduce 0 0      root_fold\n"
+        "shm_allreduce 0 4096   segment_parallel\n")
+
+    def _set(comm, name, val):
+        # the registry is process-global in the in-process harness:
+        # only rank 0 flips, fenced by barriers, so no rank can bind
+        # under a half-landed setting (a raced flip strands the other
+        # rank inside the collective bind)
+        comm.barrier()
+        if comm.rank == 0:
+            var_registry.set(name, val)
+        comm.barrier()
+
+    def body(comm):
+        small = comm.allreduce_init(np.zeros(64))          # 512B
+        big = comm.allreduce_init(np.zeros(1 << 18))       # 2MiB
+        got = {"crossover": (small.algorithm, big.algorithm)}
+        small.free()
+        big.free()
+
+        _set(comm, "coll_host_dynamic_rules", str(rules_path))
+        small = comm.allreduce_init(np.zeros(64))
+        big = comm.allreduce_init(np.zeros(1024))          # 8KiB
+        got["rules"] = (small.algorithm, big.algorithm)
+        small.free()
+        big.free()
+
+        _set(comm, "coll_shm_allreduce_algorithm",
+             "segment_parallel")
+        small = comm.allreduce_init(np.zeros(64))
+        got["forced"] = small.algorithm
+        small.free()
+
+        _set(comm, "coll_shm_allreduce_algorithm", "bogus")
+        try:
+            comm.allreduce_init(np.zeros(64))
+            got["bogus"] = "no-raise"
+        except MPIException as e:
+            got["bogus"] = "raised" if "bogus" in str(e) else str(e)
+        _set(comm, "coll_shm_allreduce_algorithm", "")
+        _set(comm, "coll_host_dynamic_rules", "")
+        return got
+
+    try:
+        for got in run_ranks(2, body):
+            assert got["crossover"] == ("root_fold", "segment_parallel")
+            assert got["rules"] == ("root_fold", "segment_parallel")
+            assert got["forced"] == "segment_parallel"
+            assert got["bogus"] == "raised"
+    finally:
+        var_registry.set("coll_shm_allreduce_algorithm", "")
+        var_registry.set("coll_host_dynamic_rules", "")
+
+
+def test_segpar_crossover_core_gate(monkeypatch):
+    """The fixed crossover requires cores >= ranks (aggregate fold work
+    is p*n either way — spreading it without spare cores only adds two
+    sync phases); a rules-file hit or forced var overrides the gate."""
+    def body(comm):
+        big = comm.allreduce_init(np.zeros(1 << 18))   # 2MiB
+        alg = big.algorithm
+        big.free()
+        return alg
+
+    monkeypatch.setattr(_shm, "_NCORES", 1)   # oversubscribed box
+    assert run_ranks(2, body)[0] == "root_fold"
+    monkeypatch.setattr(_shm, "_NCORES", 2)   # cores cover the world
+    assert run_ranks(2, body)[0] == "segment_parallel"
+
+
+def test_segpar_native_folds_on_every_rank(segpar_forced):
+    """The cooperative shape's defining property: ALL ranks fold (vs
+    the root-fold's one) — visible as one native fold per rank per op."""
+    from ompi_tpu import _native
+
+    if not _native.arena_available():
+        pytest.skip("native arena unavailable")
+    var_registry.set("coll_shm_native", True)
+    p, iters = 4, 3
+    f0 = trace.counters["coll_shm_native_folds_total"]
+
+    def body(comm):
+        x = np.arange(4096.0) + comm.rank
+        req = comm.allreduce_init(x)
+        for _ in range(iters):
+            req.start()
+            req.wait()
+        req.free()
+        return True
+
+    run_ranks(p, body)
+    # in-process ranks share the counter: p folds per op
+    assert (trace.counters["coll_shm_native_folds_total"] - f0
+            >= p * iters)
+
+
+def test_segpar_python_plane_parity(segpar_forced):
+    """coll_shm_native off: the segment-parallel protocol runs on the
+    pure-python plane with identical results (the fallback the
+    NO_NATIVE env forces globally)."""
+    def body(comm):
+        x = np.arange(1024.0) * (comm.rank + 1)
+        comm.barrier()
+        if comm.rank == 0:
+            var_registry.set("coll_shm_native", False)
+        comm.barrier()
+        req = comm.allreduce_init(x)
+        outs = [np.copy(_loop(req, x, lambda b, k: None, 1)[0])
+                for _ in range(3)]
+        req.free()
+        comm.barrier()
+        if comm.rank == 0:
+            var_registry.set("coll_shm_native", True)
+        comm.barrier()
+        one = comm.allreduce(x)
+        return outs, one
+
+    for outs, one in run_ranks(4, body):
+        for o in outs:
+            np.testing.assert_array_equal(o, one)
+
+
+def test_segpar_timeout_names_the_wait_order_contract(segpar_forced):
+    """A segpar drain stuck on a missing peer FOLD (the 2k+2 phase)
+    re-raises the arena timeout with the wait-order rule in the
+    message — the deadlock reads as a contract violation, not a
+    mystery hang.  Both ranks inject the timeout so the world never
+    actually wedges."""
+    def body(comm):
+        x = np.arange(256.0)
+        req = comm.allreduce_init(x)
+        assert req.algorithm == "segment_parallel"
+        plan = req._plan
+        orig = plan._slots._wait_all_arrive
+
+        def boom(v, c):
+            if v == 2:   # op 0's all-folded phase (2k+2): peer's drain
+                raise MPIException(
+                    "coll/shm: arena wait (flag 1, want 2, have 1) "
+                    "stuck for 60s on test — peer dead or "
+                    "collective-order mismatch (coll_shm_timeout)")
+            return orig(v, c)
+
+        plan._slots._wait_all_arrive = boom
+        req.start()
+        try:
+            req.wait()
+            got = "no-raise"
+        except MPIException as e:
+            got = str(e)
+        plan._slots._wait_all_arrive = orig
+        req.free()
+        return got
+
+    for got in run_ranks(2, body):
+        assert "same order on every rank" in got, got
+        assert "root_fold" in got

@@ -478,3 +478,194 @@ def test_probe_marks_detector_so_everything_fails_fast():
     finally:
         var_registry.set("coll_shm_probe_grace", 1.0)
         arena.close()
+
+
+# ---------------------------------------------------------------------------
+# the native data plane (GIL-free executor: waits, publishes, folds)
+# ---------------------------------------------------------------------------
+
+def _arena_native_available() -> bool:
+    from ompi_tpu import _native
+
+    return _native.arena_available()
+
+
+requires_native_arena = pytest.mark.skipif(
+    not _arena_native_available(), reason="native arena unavailable")
+
+
+def _toggle_native(comm, native: bool) -> None:
+    """Flip the executor for the whole (in-process) world, fenced by
+    barriers so no rank times/acts across the flip."""
+    comm.barrier()
+    if comm.rank == 0:
+        var_registry.set("coll_shm_native", native)
+    comm.barrier()
+
+
+@requires_native_arena
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_native_vs_python_bit_parity(seed):
+    """The same collectives on the same inputs with the native executor
+    on vs off must be BITWISE identical — the native fold reproduces
+    the numpy rank-ordered chain, not merely an equivalent reduction."""
+    rng = np.random.default_rng(seed)
+    dtype = np.dtype(rng.choice(["f8", "f4", "i4", "i8", "u2", "i1"]))
+    op = [op_mod.SUM, op_mod.MIN, op_mod.MAX, op_mod.PROD][seed % 4]
+    n = int(rng.integers(1, 5000))
+
+    def mk(rank):
+        r = np.random.default_rng(1000 + rank)
+        if dtype.kind == "f":
+            return (r.standard_normal(n) * 3).astype(dtype)
+        return r.integers(1, 5, size=n).astype(dtype)
+
+    def body(comm):
+        x = mk(comm.rank)
+        outs = {}
+        for native in (True, False):
+            _toggle_native(comm, native)
+            outs[native] = (
+                comm.allreduce(x, op=op),
+                comm.allgather(x),
+                comm.bcast(x if comm.rank == 1 else None, root=1),
+                comm.reduce(x, op=op, root=2),
+            )
+        _toggle_native(comm, True)
+        return outs
+
+    for out in run_ranks(4, body):
+        for a, b in zip(out[True], out[False]):
+            if a is None:
+                assert b is None
+            else:
+                assert a.dtype == b.dtype
+                np.testing.assert_array_equal(a, b)
+
+
+@requires_native_arena
+def test_native_pvars_tick_and_python_path_does_not():
+    before = {k: trace.counters[k] for k in
+              ("coll_shm_native_waits_total",
+               "coll_shm_native_publishes_total",
+               "coll_shm_native_folds_total")}
+
+    def body(comm):
+        x = np.arange(1024.0) + comm.rank
+        _toggle_native(comm, True)
+        comm.allreduce(x)
+        return True
+
+    run_ranks(2, body)
+    after = {k: trace.counters[k] for k in before}
+    assert all(after[k] > before[k] for k in before), (before, after)
+
+    # and with the var off the counters must NOT move.  The toggle
+    # fence barriers themselves straddle the flip (a rank can park
+    # natively while rank 0 is still flipping), so the snapshots are
+    # taken INSIDE a quiesced python-only window
+    snap = {}
+
+    def body_off(comm):
+        x = np.arange(1024.0) + comm.rank
+        _toggle_native(comm, False)
+        comm.barrier()            # everyone is past the flip fence
+        if comm.rank == 0:
+            snap["before"] = {k: trace.counters[k] for k in before}
+        comm.barrier()
+        out = comm.allreduce(x)
+        comm.barrier()
+        if comm.rank == 0:
+            snap["after"] = {k: trace.counters[k] for k in before}
+        _toggle_native(comm, True)
+        return out
+
+    run_ranks(2, body_off)
+    assert snap["after"] == snap["before"]
+
+
+@pytest.mark.parametrize("native", [True, False])
+def test_dead_writer_probe_fires_through_both_wait_paths(native):
+    """The FT contract is the same whether the wait parks natively or
+    in the python loop: a dead writer pid surfaces ERR_PROC_FAILED in
+    ~the probe grace either way."""
+    import time as time_mod
+    import types
+
+    from ompi_tpu.mpi.constants import ERR_PROC_FAILED, MPIException
+
+    pml = types.SimpleNamespace(endpoint=_DeadWriterEndpoint(), ft=None,
+                                rank=0)
+    arena = _bare_arena(pml)
+    var_registry.set("coll_shm_probe_grace", 0.2)
+    var_registry.set("coll_shm_native", native)
+    try:
+        t0 = time_mod.monotonic()
+        with pytest.raises(MPIException) as ei:
+            arena._wait(1 * 8, 1, None)
+        assert ei.value.error_class == ERR_PROC_FAILED
+        assert time_mod.monotonic() - t0 < 5.0
+    finally:
+        var_registry.set("coll_shm_probe_grace", 1.0)
+        var_registry.set("coll_shm_native", True)
+        arena.close()
+
+
+@pytest.mark.parametrize("native", [True, False])
+def test_wait_deadline_honored_through_both_paths(native):
+    """coll_shm_timeout fires through the native slice loop exactly as
+    through the python loop (the deadline lives in Python either way)."""
+    import time as time_mod
+    import types
+
+    from ompi_tpu.mpi.constants import MPIException
+
+    pml = types.SimpleNamespace(endpoint=_UnknowableEndpoint(), ft=None,
+                                rank=0)
+    arena = _bare_arena(pml)
+    var_registry.set("coll_shm_timeout", 1)
+    var_registry.set("coll_shm_probe_grace", 0.05)
+    var_registry.set("coll_shm_native", native)
+    try:
+        t0 = time_mod.monotonic()
+        with pytest.raises(MPIException) as ei:
+            arena._wait_many(0, 1, None)   # wait-all sweep, never comes
+        assert "coll_shm_timeout" in str(ei.value)
+        assert time_mod.monotonic() - t0 < 10.0
+    finally:
+        var_registry.set("coll_shm_timeout", 60)
+        var_registry.set("coll_shm_probe_grace", 1.0)
+        var_registry.set("coll_shm_native", True)
+        arena.close()
+
+
+def test_no_native_env_forces_python_fallback_parity(monkeypatch):
+    """OMPI_TPU_NO_NATIVE=1 (fresh loader) must leave the whole arena
+    path functional on the python plane — provider still shm, results
+    identical, zero native counter movement."""
+    import importlib
+
+    from ompi_tpu import _native
+
+    monkeypatch.setenv("OMPI_TPU_NO_NATIVE", "1")
+    mod = importlib.reload(_native)
+    try:
+        assert mod.arena() is None and not mod.arena_available()
+        before = dict(trace.counters)
+
+        def body(comm):
+            out = comm.allreduce(np.arange(2048.0) + comm.rank)
+            assert comm.coll.providers["allreduce"] == "shm"
+            assert _shm_used(comm)
+            return out
+
+        for out in run_ranks(4, body):
+            np.testing.assert_allclose(
+                out, np.arange(2048.0) * 4 + 6.0)
+        for k in ("coll_shm_native_waits_total",
+                  "coll_shm_native_publishes_total",
+                  "coll_shm_native_folds_total"):
+            assert trace.counters[k] == before[k]
+    finally:
+        monkeypatch.delenv("OMPI_TPU_NO_NATIVE")
+        importlib.reload(mod)

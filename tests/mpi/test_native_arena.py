@@ -1,0 +1,249 @@
+"""Native arena executor (_native/arena.c) — the GIL-free data plane.
+
+Direct unit coverage of the ctypes surface: flag waits (satisfied /
+slice expiry / wait-all sweeps), fused publishes (contiguous and
+strided, bit-parity vs numpy), width-specialized folds (bit-parity vs
+the numpy op chain across every supported dtype × op, signed-overflow
+wrap, NaN propagation, unsupported-combo rejection), the futex wake
+no-op contract, and the ring parks the btl/shm poller and writers ride.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ompi_tpu import _native
+
+lib = _native.arena()
+
+requires_arena = pytest.mark.skipif(
+    lib is None, reason="no C toolchain / native arena unavailable")
+
+MS = 1_000_000   # ns
+
+
+def _flags(n=16, value=0):
+    return (ctypes.c_uint64 * n)(*([value] * n))
+
+
+def test_arena_builds_and_loads():
+    # the environment ships a toolchain; the native plane must engage
+    assert _native.arena_available()
+    assert lib.ompi_tpu_arena_abi() == _native._ARENA_ABI
+
+
+# ---------------------------------------------------------------------------
+# waits
+# ---------------------------------------------------------------------------
+
+@requires_arena
+def test_wait_satisfied_and_expiry():
+    f = _flags(value=5)
+    addr = ctypes.addressof(f)
+    assert lib.ompi_tpu_arena_wait(addr, 3, 5, 64, 2 * MS) == 1
+    assert lib.ompi_tpu_arena_wait(addr, 3, 4, 64, 2 * MS) == 1
+    t0 = time.monotonic()
+    assert lib.ompi_tpu_arena_wait(addr, 3, 6, 64, 5 * MS) == 0
+    dt = time.monotonic() - t0
+    # the slice bound is honored: expired near 5ms, not the 60s the
+    # python deadline would allow
+    assert 0.004 < dt < 0.5
+
+
+@requires_arena
+def test_wait_all_stride_sweep():
+    f = _flags(value=7)
+    addr = ctypes.addressof(f)
+    assert lib.ompi_tpu_arena_wait_all(addr, 0, 2, 8, 7, 64, 2 * MS) == 1
+    f[6] = 3   # one laggard (base 0, stride 2 -> index 6 is member 3)
+    assert lib.ompi_tpu_arena_wait_all(addr, 0, 2, 8, 7, 64, 3 * MS) == 0
+
+
+@requires_arena
+def test_wait_sees_cross_thread_store_quickly():
+    """The futex-style park wakes on the publisher's store (wake call),
+    not only at the timeout backstop."""
+    f = _flags()
+    addr = ctypes.addressof(f)
+
+    def publisher():
+        time.sleep(0.02)
+        lib.ompi_tpu_arena_publish(addr, addr, 0, addr, 2, 9)
+
+    t = threading.Thread(target=publisher)
+    t.start()
+    t0 = time.monotonic()
+    done = 0
+    while not done and time.monotonic() - t0 < 5.0:
+        done = lib.ompi_tpu_arena_wait(addr, 2, 9, 64, 50 * MS)
+    t.join()
+    assert done == 1
+    assert time.monotonic() - t0 < 1.0
+
+
+@requires_arena
+def test_wait_change_and_wake_are_safe():
+    f = _flags(value=11)
+    addr = ctypes.addressof(f)
+    assert lib.ompi_tpu_arena_wait_change(addr, 10, 64, 2 * MS) == 1
+    assert lib.ompi_tpu_arena_wait_change(addr, 11, 64, 3 * MS) == 0
+    lib.ompi_tpu_arena_wake(addr, 0)     # no waiter: plain no-op
+
+
+# ---------------------------------------------------------------------------
+# publishes
+# ---------------------------------------------------------------------------
+
+@requires_arena
+def test_publish_contiguous_sets_flag_after_copy():
+    f = _flags()
+    src = np.arange(4096, dtype=np.uint8)
+    dst = np.zeros(4096, dtype=np.uint8)
+    lib.ompi_tpu_arena_publish(dst.ctypes.data, src.ctypes.data,
+                               src.nbytes, ctypes.addressof(f), 5, 3)
+    np.testing.assert_array_equal(dst, src)
+    assert f[5] == 3
+
+
+@requires_arena
+def test_publish_strided_matches_numpy_gather():
+    base = np.arange(240, dtype=np.float64).reshape(12, 20)
+    view = base[::2, 3:11]               # strided rows, contiguous tail
+    dst = np.zeros(view.size, dtype=np.float64)
+    nblocks, bl, stride = view.shape[0], view.shape[1] * 8, view.strides[0]
+    lib.ompi_tpu_arena_publish_strided(
+        dst.ctypes.data, view.ctypes.data, nblocks, bl, stride,
+        None, 0, 0)
+    np.testing.assert_array_equal(dst, np.ascontiguousarray(view).ravel())
+
+
+@requires_arena
+def test_publish_null_flags_is_pure_copy():
+    f = _flags()
+    src = np.arange(64, dtype=np.uint8)
+    dst = np.zeros(64, dtype=np.uint8)
+    lib.ompi_tpu_arena_publish(dst.ctypes.data, src.ctypes.data, 64,
+                               None, 0, 99)
+    assert f[0] == 0
+    np.testing.assert_array_equal(dst, src)
+
+
+# ---------------------------------------------------------------------------
+# folds
+# ---------------------------------------------------------------------------
+
+_DTYPES = [np.int8, np.int16, np.int32, np.int64,
+           np.uint8, np.uint16, np.uint32, np.uint64,
+           np.float32, np.float64]
+_NP_OPS = {0: np.add, 1: np.multiply, 2: np.minimum, 3: np.maximum}
+
+
+def _dtype_code(dtype):
+    from ompi_tpu.mpi.coll import shm
+
+    return shm._fold_code(np.dtype(dtype))
+
+
+def _native_fold(dst, srcs, nelems, dc, oc):
+    ptrs = (ctypes.c_void_p * len(srcs))(*[s.ctypes.data for s in srcs])
+    return lib.ompi_tpu_arena_fold(dst.ctypes.data,
+                                   ctypes.addressof(ptrs), len(srcs),
+                                   nelems, dc, oc)
+
+
+@requires_arena
+@pytest.mark.parametrize("dtype", _DTYPES)
+@pytest.mark.parametrize("opc", [0, 1, 2, 3])
+def test_fold_bit_parity_vs_numpy_chain(dtype, opc):
+    rng = np.random.default_rng(hash((str(dtype), opc)) & 0xFFFF)
+    dtype = np.dtype(dtype)
+    srcs = []
+    for _ in range(4):
+        raw = rng.integers(0, 200, size=257)
+        srcs.append(raw.astype(dtype))
+    dst = np.zeros(257, dtype=dtype)
+    dc = _dtype_code(dtype)
+    assert dc is not None
+    assert _native_fold(dst, srcs, 257, dc, opc) == 0
+    acc = srcs[0]
+    for s in srcs[1:]:
+        acc = _NP_OPS[opc](acc, s)    # the exact python chain order
+    np.testing.assert_array_equal(dst, acc.astype(dtype, copy=False))
+
+
+@requires_arena
+def test_fold_signed_overflow_wraps_like_numpy():
+    srcs = [np.full(8, 120, np.int8) for _ in range(3)]
+    dst = np.zeros(8, np.int8)
+    assert _native_fold(dst, srcs, 8, _dtype_code(np.int8), 0) == 0
+    with np.errstate(over="ignore"):
+        expect = (srcs[0] + srcs[1]) + srcs[2]   # wraps silently
+    np.testing.assert_array_equal(dst, expect)
+
+
+@requires_arena
+@pytest.mark.parametrize("opc", [2, 3])
+def test_fold_min_max_propagate_nan_like_numpy(opc):
+    a = np.array([1.0, np.nan, 3.0, 4.0])
+    b = np.array([2.0, 2.0, np.nan, 1.0])
+    c = np.array([0.5, 5.0, 5.0, np.nan])
+    dst = np.zeros(4)
+    assert _native_fold(dst, [a, b, c], 4, _dtype_code(np.float64),
+                        opc) == 0
+    expect = _NP_OPS[opc](_NP_OPS[opc](a, b), c)
+    np.testing.assert_array_equal(np.isnan(dst), np.isnan(expect))
+    mask = ~np.isnan(expect)
+    np.testing.assert_array_equal(dst[mask], expect[mask])
+
+
+@requires_arena
+def test_fold_rejects_unsupported_combo():
+    src = [np.zeros(4), np.zeros(4)]
+    dst = np.zeros(4)
+    assert _native_fold(dst, src, 4, 99, 0) == -1      # bad dtype
+    assert _native_fold(dst, src, 4, 9, 7) == -1       # bad op
+    assert _native_fold(dst, src, 4, 0, 7) == -1       # int bad op
+
+
+# ---------------------------------------------------------------------------
+# ring parks
+# ---------------------------------------------------------------------------
+
+@requires_arena
+def test_ring_wait_any_returns_ready_index():
+    ctr_a = (ctypes.c_uint64 * 8)()       # head at word 0
+    ctr_b = (ctypes.c_uint64 * 8)()
+    ctr_b[0] = 5                          # ring b has 5 published bytes
+    ctrs = (ctypes.c_void_p * 2)(ctypes.addressof(ctr_a),
+                                 ctypes.addressof(ctr_b))
+    tails = (ctypes.c_uint64 * 2)(0, 0)
+    got = lib.ompi_tpu_ring_wait_any(ctypes.addressof(ctrs),
+                                     ctypes.addressof(tails), 2, 64,
+                                     2 * MS)
+    assert got == 1
+    tails[1] = 5                          # b drained: nothing anywhere
+    got = lib.ompi_tpu_ring_wait_any(ctypes.addressof(ctrs),
+                                     ctypes.addressof(tails), 2, 64,
+                                     3 * MS)
+    assert got == -1
+
+
+@requires_arena
+def test_strided_desc_covers_numpy_layouts():
+    """The python-side plan compiler feeding publish_strided."""
+    from ompi_tpu.mpi.coll import shm
+
+    a = np.arange(24.0).reshape(4, 6)
+    assert shm._strided_desc(a) == (1, a.nbytes, a.nbytes)
+    v = a[:, 1:4]                          # one strided axis
+    nblocks, bl, stride = shm._strided_desc(v)
+    assert (nblocks, bl, stride) == (4, 3 * 8, 6 * 8)
+    w = np.arange(64.0).reshape(4, 4, 4)[::2, ::2, :]   # two strided axes
+    assert shm._strided_desc(w) is None
+    assert shm._strided_desc(a[::-1]) is None           # negative stride
+    assert shm._strided_desc(np.empty(0)) is None

@@ -630,6 +630,41 @@ def test_reader_thread_waiver_comment(tmp_path):
     assert reader_thread.run(idx) == []
 
 
+def test_reader_thread_native_park_approved_in_poll_loop(tmp_path):
+    """A GIL-released native park (arena.c's wait entry points) is THE
+    approved blocking form for a poll/read loop's idle window — even
+    through a helper hop — while a python time.sleep on the same new
+    path stays flagged."""
+    idx = _tree(tmp_path, {"btl.py": """
+import time
+
+class Btl:
+    def _poll_loop(self):
+        while True:
+            if not self._native_park():
+                time.sleep(0)          # loop's own pacing: exempt
+    def _native_park(self):
+        ex = self._lib
+        return ex.ompi_tpu_ring_wait_any(0, 0, 1, 64, 1000000) >= 0
+"""})
+    assert reader_thread.run(idx) == []
+
+
+def test_reader_thread_native_park_flagged_on_frame_dispatch(tmp_path):
+    """The same park reached from a frame-dispatch entry is a finding:
+    blocking _on_frame stalls every peer behind one wait."""
+    idx = _tree(tmp_path, {"pml.py": """
+class Pml:
+    def _on_frame(self, peer, header, payload):
+        self._wait_peer(header)
+    def _wait_peer(self, header):
+        self._lib.ompi_tpu_arena_wait(0, 1, 2, 64, 1000000)
+"""})
+    got = reader_thread.run(idx)
+    assert any(f.rule == "park-on-reader"
+               and "Pml._on_frame" in f.message for f in got), got
+
+
 # ---------------------------------------------------------------------------
 # lock-order
 # ---------------------------------------------------------------------------

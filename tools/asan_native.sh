@@ -1,14 +1,15 @@
 #!/usr/bin/env bash
-# Sanitizer build + test of the native layer (convertor.cpp, fastdss.c).
+# Sanitizer build + test of the native layer (convertor.cpp, fastdss.c,
+# arena.c).
 #
-# Compiles both native sources with -fsanitize=address,undefined to the
+# Compiles the native sources with -fsanitize=address,undefined to the
 # exact hash-named paths the lazy loader expects, then runs the
-# convertor/pack/dss test subset with the sanitizer runtimes preloaded
-# (python itself is not ASAN-built, so libasan/libubsan must come in
-# via LD_PRELOAD).  Any heap overflow / UB in the C walks fails the
-# run.  The sanitized .so files are deleted afterwards: they only load
-# under the preload, and leaving them in the hash cache would make a
-# normal run silently fall back to numpy.
+# convertor/pack/dss/arena test subset with the sanitizer runtimes
+# preloaded (python itself is not ASAN-built, so libasan/libubsan must
+# come in via LD_PRELOAD).  Any heap overflow / UB in the C walks fails
+# the run.  The sanitized .so files are deleted afterwards: they only
+# load under the preload, and leaving them in the hash cache would make
+# a normal run silently fall back to numpy.
 #
 # Usage: tools/asan_native.sh  (from the repo root; CI's asan-native job)
 set -euo pipefail
@@ -24,11 +25,12 @@ from ompi_tpu import _native as n
 soabi = sysconfig.get_config_var("SOABI") or "abi-unknown"
 print(f"CONV_SO={n._so_path()}")
 print(f"FASTDSS_SO={n._hash_name(n._FASTDSS_SRC, f'_fastdss-{soabi}')}")
+print(f"ARENA_SO={n._hash_name(n._ARENA_SRC, '_arena')}")
 print(f"PYINC={sysconfig.get_paths()['include']}")
 EOF
 )"
 
-cleanup() { rm -f "$CONV_SO" "$FASTDSS_SO"; }
+cleanup() { rm -f "$CONV_SO" "$FASTDSS_SO" "$ARENA_SO"; }
 trap cleanup EXIT
 
 echo "== sanitized build: convertor.cpp -> $CONV_SO"
@@ -36,6 +38,8 @@ $CXX $SAN -shared -fPIC -o "$CONV_SO" ompi_tpu/_native/convertor.cpp
 echo "== sanitized build: fastdss.c -> $FASTDSS_SO"
 $CC $SAN -shared -fPIC -I"$PYINC" -o "$FASTDSS_SO" \
     ompi_tpu/_native/fastdss.c
+echo "== sanitized build: arena.c -> $ARENA_SO"
+$CC $SAN -shared -fPIC -o "$ARENA_SO" ompi_tpu/_native/arena.c
 
 LIBASAN=$($CXX -print-file-name=libasan.so)
 LIBUBSAN=$($CXX -print-file-name=libubsan.so)
@@ -55,14 +59,23 @@ assert lib is not None, "sanitized convertor failed to load"
 assert lib.ompi_tpu_native_abi() == _native._ABI
 fd = _native.fastdss()
 assert fd is not None, "sanitized fastdss failed to load"
-print("sanitized native layer loaded, ABI", _native._ABI)
+ar = _native.arena()
+assert ar is not None, "sanitized arena executor failed to load"
+assert ar.ompi_tpu_arena_abi() == _native._ARENA_ABI
+print("sanitized native layer loaded, ABI", _native._ABI,
+      "arena ABI", _native._ARENA_ABI)
 EOF
 
-echo "== convertor/pack/dss tests under ASan/UBSan"
+echo "== convertor/pack/dss/arena tests under ASan/UBSan"
+# test_native_arena drives every arena entry point (waits, publishes,
+# strided walks, every fold width, ring parks); test_coll_shm runs the
+# full collective protocols over the sanitized executor
 env JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
     tests/core/test_dss.py \
     tests/mpi/test_datatype.py \
     tests/mpi/test_datatype_ext.py \
     tests/mpi/test_datatype_fuzz.py \
-    tests/mpi/test_pack_plan.py
+    tests/mpi/test_pack_plan.py \
+    tests/mpi/test_native_arena.py \
+    tests/mpi/test_coll_shm.py
 echo "== ASan/UBSan native run clean"

@@ -555,7 +555,22 @@ def main(argv=None) -> int:
                     help="run only plans of one class (the CI smoke "
                          "jobs pick single scenarios this way)")
     ap.add_argument("-v", "--verbose", action="store_true")
+    ap.add_argument("--guard", action="store_true",
+                    help="preflight: refuse to soak when hours-old "
+                    "PPID-1 orphaned ompi_tpu processes poison the box "
+                    "(their CPU steal turns timing-sensitive chaos "
+                    "windows into flakes)")
+    ap.add_argument("--guard-kill", action="store_true",
+                    help="like --guard but SIGKILL the orphans and "
+                    "proceed")
     args = ap.parse_args(argv)
+
+    if args.guard or args.guard_kill:
+        from tools import killorphans
+
+        if not killorphans.preflight("chaos_soak",
+                                     kill=args.guard_kill):
+            return 2
 
     failures = []
     plans, i = [], 0

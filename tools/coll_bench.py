@@ -246,6 +246,152 @@ def bench_persistent_config(n: int, coll: str, nbytes: int, iters: int,
     return rows
 
 
+def _time_coll_native_pair(n: int, coll: str, nbytes: int, iters: int,
+                           reps: int) -> tuple[float, float]:
+    """(native µs, python µs): the SAME arena path with the native
+    executor on vs off, alternating per rep in the SAME rank world
+    (shared fate — the methodology note from PR 10 applies doubly here
+    because the native side's whole point is scheduler behavior).
+    Rank 0 flips ``coll_shm_native`` between barriers; the arena reads
+    it per call."""
+    elems = max(nbytes // 8, 1) if nbytes else 0
+
+    def body(comm):
+        if nbytes:
+            x = np.arange(elems, dtype=np.float64) + comm.rank
+
+        def one(i: int) -> None:
+            if coll == "allreduce":
+                comm.allreduce(x)
+            elif coll == "bcast":
+                root = i % comm.size
+                comm.bcast(x if comm.rank == root else None, root=root)
+            else:
+                comm.barrier()
+
+        best = {"nat": float("inf"), "py": float("inf")}
+        comm.barrier()
+        one(0)
+        for _ in range(reps):
+            for mode, native in (("nat", True), ("py", False)):
+                comm.barrier()
+                if comm.rank == 0:
+                    var_registry.set("coll_shm_native", native)
+                comm.barrier()   # everyone sees the flip before timing
+                t0 = time.perf_counter()
+                for i in range(iters):
+                    one(i)
+                best[mode] = min(best[mode],
+                                 time.perf_counter() - t0)
+        if comm.rank == 0:
+            var_registry.set("coll_shm_native", True)
+        return best["nat"] / iters * 1e6, best["py"] / iters * 1e6
+
+    results = _run_world(n, body)
+    return (max(r[0] for r in results), max(r[1] for r in results))
+
+
+def bench_native_config(n: int, coll: str, nbytes: int, iters: int,
+                        reps: int, quick: bool) -> list[dict]:
+    """One size row pair: native arena executor vs the python arena
+    path (the GIL-free data plane's acceptance comparison)."""
+    nat_us, py_us = _time_coll_native_pair(n, coll, nbytes, iters, reps)
+    speedup = py_us / nat_us if nat_us else float("inf")
+    rows = []
+    for mode, us in (("native", nat_us), ("python", py_us)):
+        rows.append({
+            "bench": "coll_bench",
+            "coll": coll,
+            "ranks": n,
+            "payload_bytes": nbytes,
+            "component": "shm",
+            "mode": mode,
+            "per_op_us": round(us, 2),
+            "native_speedup": round(speedup, 2),
+            "iters": iters,
+            "reps": reps,
+            "n_cores": os.cpu_count(),
+            "quick": quick,
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        })
+    print(f"{coll:>9} {nbytes:>9}B x{n}: native {nat_us:9.1f}us  "
+          f"python {py_us:9.1f}us  ({speedup:.2f}x)")
+    return rows
+
+
+def _time_segpar_pair(n: int, nbytes: int, iters: int,
+                      reps: int) -> tuple[float, float]:
+    """(segment_parallel µs, root_fold µs) for a persistent arena
+    allreduce — BOTH plans bound in the same world, alternated per
+    rep (shared fate)."""
+    elems = max(nbytes // 8, 1)
+
+    def body(comm):
+        x = np.arange(elems, dtype=np.float64) + comm.rank
+        if comm.rank == 0:
+            var_registry.set("coll_shm_allreduce_algorithm",
+                             "root_fold")
+        comm.barrier()
+        req_root = comm.allreduce_init(x)
+        if comm.rank == 0:
+            var_registry.set("coll_shm_allreduce_algorithm",
+                             "segment_parallel")
+        comm.barrier()
+        req_seg = comm.allreduce_init(x)
+        if comm.rank == 0:
+            var_registry.set("coll_shm_allreduce_algorithm", "")
+        assert req_root.algorithm == "root_fold", req_root.algorithm
+        assert req_seg.algorithm == "segment_parallel", req_seg.algorithm
+        best = {"root": float("inf"), "seg": float("inf")}
+        for req in (req_root, req_seg):
+            req.start()
+            req.wait()
+        for _ in range(reps):
+            for mode, req in (("root", req_root), ("seg", req_seg)):
+                comm.barrier()
+                t0 = time.perf_counter()
+                for _i in range(iters):
+                    req.start()
+                    req.wait()
+                best[mode] = min(best[mode],
+                                 time.perf_counter() - t0)
+        req_root.free()
+        req_seg.free()
+        return best["seg"] / iters * 1e6, best["root"] / iters * 1e6
+
+    results = _run_world(n, body)
+    return (max(r[0] for r in results), max(r[1] for r in results))
+
+
+def bench_segpar_config(n: int, nbytes: int, iters: int, reps: int,
+                        quick: bool) -> list[dict]:
+    """One size row pair: cooperative segment-parallel allreduce vs
+    the single-rank root fold over bound persistent plans."""
+    seg_us, root_us = _time_segpar_pair(n, nbytes, iters, reps)
+    speedup = root_us / seg_us if seg_us else float("inf")
+    rows = []
+    for mode, us in (("segment_parallel", seg_us),
+                     ("root_fold", root_us)):
+        rows.append({
+            "bench": "coll_bench",
+            "coll": "allreduce",
+            "ranks": n,
+            "payload_bytes": nbytes,
+            "component": "shm-persistent",
+            "mode": mode,
+            "per_op_us": round(us, 2),
+            "segpar_speedup": round(speedup, 2),
+            "iters": iters,
+            "reps": reps,
+            "n_cores": os.cpu_count(),
+            "quick": quick,
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        })
+    print(f"allreduce {nbytes:>9}B x{n}: segpar {seg_us:9.1f}us  "
+          f"root_fold {root_us:9.1f}us  ({speedup:.2f}x)")
+    return rows
+
+
 def bench_config(n: int, coll: str, nbytes: int, iters: int, reps: int,
                  quick: bool) -> list[dict]:
     from ompi_tpu.mpi import trace
@@ -298,8 +444,26 @@ def main() -> None:
     ap.add_argument("--persistent", action="store_true",
                     help="bind-once sweep: persistent Start steady "
                     "state vs per-op dispatch (fixed root)")
+    ap.add_argument("--native", action="store_true",
+                    help="GIL-free data-plane sweep: arena with the "
+                    "native executor vs the python arena path, plus "
+                    "segment-parallel vs root-fold persistent "
+                    "allreduce at >=1MiB (all shared-fate)")
+    ap.add_argument("--guard", action="store_true",
+                    help="preflight: refuse to bench when hours-old "
+                    "PPID-1 orphaned ompi_tpu processes poison the box")
+    ap.add_argument("--guard-kill", action="store_true",
+                    help="like --guard but SIGKILL the orphans and "
+                    "proceed")
     ap.add_argument("--out", default=_OUT)
     args = ap.parse_args()
+
+    if args.guard or args.guard_kill:
+        from tools import killorphans
+
+        if not killorphans.preflight("coll_bench",
+                                     kill=args.guard_kill):
+            sys.exit(2)
 
     if args.quick:
         sizes = [64, 8 << 10, 256 << 10]
@@ -307,6 +471,41 @@ def main() -> None:
     else:
         sizes = [8, 64, 1 << 10, 8 << 10, 64 << 10, 256 << 10, 1 << 20]
         iters, reps = 50, 3
+
+    if args.native:
+        # the GIL-bound band the native plane targets, bracketed by one
+        # small and one large size for the honest-crossover table
+        nat_sizes = ([8 << 10, 64 << 10] if args.quick
+                     else [64, 8 << 10, 16 << 10, 32 << 10, 64 << 10,
+                           256 << 10])
+        rows = bench_native_config(args.ranks, "barrier", 0, iters,
+                                   reps, args.quick)
+        for coll in ("allreduce", "bcast"):
+            for nbytes in nat_sizes:
+                it = max(5, iters // 4) if nbytes >= (256 << 10) \
+                    else iters
+                rows += bench_native_config(args.ranks, coll, nbytes,
+                                            it, reps, args.quick)
+        for nbytes in ([1 << 20] if args.quick
+                       else [1 << 20, 2 << 20, 4 << 20]):
+            rows += bench_segpar_config(args.ranks, nbytes,
+                                        max(5, iters // 5), reps,
+                                        args.quick)
+        with open(args.out, "a", encoding="utf-8") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+        print(f"{len(rows)} rows -> {args.out}")
+        wins = {(r["coll"], r["payload_bytes"]) for r in rows
+                if r["mode"] == "native"
+                and (8 << 10) <= r["payload_bytes"] <= (64 << 10)
+                and r["native_speedup"] >= 1.5}
+        print(f"native >=1.5x at {len(wins)} of the 8-64KiB "
+              f"size rows (acceptance wants >=3)")
+        seg_wins = sum(1 for r in rows if r["mode"] == "segment_parallel"
+                       and r["segpar_speedup"] > 1.0)
+        print(f"segment-parallel beats root-fold at {seg_wins} "
+              f">=1MiB size(s)")
+        return
 
     if args.persistent:
         # small payloads get extra reps: both modes are measured as

@@ -10,7 +10,14 @@ every function reachable from a reader-thread entry point and flag
 - ``rpc-on-reader``: a blocking PMIx RPC (``PMIxClient._rpc`` or any
   client method that transitively calls it),
 - ``sleep-on-reader``: ``time.sleep``,
-- ``subprocess-on-reader``: any ``subprocess.*`` call
+- ``subprocess-on-reader``: any ``subprocess.*`` call,
+- ``park-on-reader``: a native GIL-released park
+  (``_native/arena.c``'s ``ompi_tpu_arena_wait*`` /
+  ``ompi_tpu_ring_wait_any`` via ctypes) — the APPROVED blocking form
+  for a read/poll loop's own idle window (those entries are exempt,
+  any depth: parking is the loop's job), but still a block that must
+  not ride a frame-dispatch path (``_on_frame``/``on_ft_frame``/rml
+  callbacks), where it would stall every peer behind one wait
 
 on those paths.  Entry points are (a) the configured transport read
 loops below and (b) every callback registered via ``register_recv``
@@ -53,7 +60,17 @@ _SINK_RULES = {
                      "time.sleep"),
     "<sink:subprocess>": ("subprocess-on-reader",
                           "a subprocess call"),
+    "<sink:native-park>": ("park-on-reader",
+                           "a native GIL-released park"),
 }
+
+#: the ctypes entry points of _native/arena.c that BLOCK (bounded
+#: slices, but blocks nonetheless) — recognized as sinks wherever the
+#: library handle is called through an attribute
+NATIVE_PARK_ATTRS = frozenset({
+    "ompi_tpu_arena_wait", "ompi_tpu_arena_wait_all",
+    "ompi_tpu_arena_wait_change", "ompi_tpu_ring_wait_any",
+})
 
 
 def run(index: ProjectIndex) -> list[Finding]:
@@ -73,6 +90,11 @@ def run(index: ProjectIndex) -> list[Finding]:
             if (sink == "<sink:sleep>" and via == entry
                     and entry.rsplit(".", 1)[-1].endswith("_loop")):
                 continue   # a read/poll loop's own idle pacing sleep
+            if (sink == "<sink:native-park>"
+                    and entry.rsplit(".", 1)[-1].endswith("_loop")):
+                # the GIL-released park IS the approved idle form for a
+                # poll/read loop (any depth: the park helper is one hop)
+                continue
             key = (rule, f"{entry}->{via}")
             if key in reported:
                 continue
@@ -191,6 +213,8 @@ def _augment_with_sinks(index: ProjectIndex, graph: CallGraph,
                         and any(t.qualname.endswith("._rpc")
                                 for t in cs.targets):
                     sink = "<sink:rpc>"
+                elif f.attr in NATIVE_PARK_ATTRS:
+                    sink = "<sink:native-park>"
             elif isinstance(f, ast.Name):
                 # bare-imported forms: `from time import sleep`,
                 # `from subprocess import run/Popen/check_call…`
