@@ -71,6 +71,99 @@ def _handles(comp: Component) -> frozenset:
     return getattr(comp, "HANDLES", frozenset({"host"}))
 
 
+#: per-rank chaos injector for the @coll=N triggers, resolved once per
+#: rank (None = no armed plan / no coll faults — ONE dict hit per
+#: dispatch after the first)
+_fi_cache: dict[int, object] = {}
+
+#: per-rank BLOCKING dispatch depth (maintained only while an injector
+#: with coll faults is armed): distinguishes a composed collective's
+#: nested sub-dispatch from a genuine top-level one without being
+#: confused by outstanding nonblocking schedules
+_depth: dict[int, int] = {}
+
+#: position of the root argument within a dispatcher's ``*args`` (after
+#: the buffer) — mirrors Communicator's positional call shapes so the
+#: recorder signature catches divergent-root mismatches
+_ROOT_ARG = {"bcast": 0, "gather": 0, "scatter": 0, "gatherv": 0,
+             "scatterv": 0, "reduce": 1}
+
+
+def _coll_injector(rank: int):
+    from ompi_tpu.testing import faultinject
+
+    inj = faultinject.injector_for(rank) if faultinject.active() else None
+    if inj is not None and not inj.coll_faults():
+        inj = None
+    _fi_cache[rank] = inj
+    return inj
+
+
+def _run_recorded(comm, slot: str, kind: str, sig: int,
+                  provider: Optional[str], nbytes: int, fn, fargs, fkw):
+    """The ONE choke point: flight-recorder post/done (always-on, the
+    hang doctor's evidence), the injected @coll stall/mismatch triggers,
+    the per-collective span (timeline) and the dispatch-latency
+    histogram labeled provider + log2 size bucket (szb) — the
+    distribution the algorithm ladder and the p50/p99 columns read."""
+    rank = comm.pml.rank
+    inj = (_fi_cache[rank] if rank in _fi_cache
+           else _coll_injector(rank))
+    act = None
+    ordinal = -1
+    depth = _depth.get(rank, 0)
+    if inj is not None and depth == 0:
+        # TOP-LEVEL dispatches only: a composed collective's nested
+        # sub-dispatches must neither advance the @coll ordinal nor
+        # fire inside infrastructure phases (arena build, hierarchy
+        # gates) that no timeout bounds.  The BLOCKING dispatch depth
+        # decides it — an outstanding nonblocking schedule on the side
+        # must not freeze the ordinal
+        act, ordinal = inj.coll_op()
+        if act == "mismatch":
+            # the seeded collective mismatch: this rank records (and
+            # announces up the uplink) a DIVERGENT kind at the same
+            # (cid, op_seq) its peers dispatch the real one — the
+            # MUST-class application error, reproduced on demand
+            kind = "bcast" if slot != "bcast" else "barrier"
+            sig = trace_mod.collrec_sig(kind, None, 0)
+    seq = trace_mod.coll_post(rank, comm.cid, kind, sig, provider,
+                              nbytes)
+    if act is not None:
+        trace_mod.push_now()     # the divergent/stalled head must be
+        # visible to the HNP even though this rank never completes
+        inj.fire_coll(act, ordinal, seq)
+    t0 = (trace_mod.begin()
+          if trace_mod.hist_active or trace_mod.active else 0)
+    if inj is not None:
+        _depth[rank] = depth + 1
+    try:
+        ret = fn(comm, *fargs, **fkw)
+        trace_mod.coll_done(rank, comm.cid, seq, kind)
+        return ret
+    except BaseException as e:
+        trace_mod.coll_err(rank, comm.cid, seq, kind, type(e).__name__)
+        raise
+    finally:
+        if inj is not None:
+            _depth[rank] = depth
+        # span + histogram land on the raise path too: the one
+        # collective that FAILED (arena wait hitting coll_shm_timeout
+        # mid-hang) is exactly the sample the postmortem trace needs
+        if t0:
+            now = time.monotonic_ns()
+            if trace_mod.hist_active:
+                szb = nbytes.bit_length()
+                trace_mod.record_hist(
+                    "coll_dispatch_ns", now - t0,
+                    labels=f'slot="{slot}",provider="{provider}",'
+                           f'szb="{szb}"')
+            if trace_mod.active:
+                trace_mod.complete(
+                    "coll", slot, t0, rank=rank, provider=provider,
+                    comm=comm.name, cid=comm.cid, size=comm.size)
+
+
 def _make_dispatch(slot: str, host_fn, host_name: Optional[str],
                    dev_fn, dev_name: Optional[str]):
     def dispatch(comm, buf, *args, **kw):
@@ -91,54 +184,38 @@ def _make_dispatch(slot: str, host_fn, host_name: Optional[str],
                     f"path, or np.asarray() the buffer if host staging is "
                     f"intended)")
             fn, provider = dev_fn, dev_name
-        # the ONE choke point: per-collective span (timeline) and the
-        # dispatch-latency histogram labeled provider + log2 size
-        # bucket (szb) — the distribution the algorithm ladder and the
-        # p50/p99 columns read
-        if trace_mod.hist_active or trace_mod.active:
-            t0 = trace_mod.begin()
-            try:
-                return fn(comm, buf, *args, **kw)
-            finally:
-                now = time.monotonic_ns()
-                if trace_mod.hist_active:
-                    szb = int(getattr(buf, "nbytes", 0)).bit_length()
-                    trace_mod.record_hist(
-                        "coll_dispatch_ns", now - t0,
-                        labels=f'slot="{slot}",provider="{provider}",'
-                               f'szb="{szb}"')
-                if trace_mod.active:
-                    trace_mod.complete(
-                        "coll", slot, t0, rank=comm.pml.rank,
-                        provider=provider, comm=comm.name,
-                        cid=comm.cid, size=comm.size)
-        return fn(comm, buf, *args, **kw)
+        nbytes = int(getattr(buf, "nbytes", 0))
+        if root_pos is not None:
+            # Communicator passes root positionally (comm.py) — pull it
+            # from its slot-specific position so a divergent-root
+            # collective signs differently across ranks
+            if len(args) > root_pos:
+                root = args[root_pos]
+            else:
+                root = kw.get("root", -1)
+            root = root if isinstance(root, int) else -1
+        else:
+            root = -1
+        sig = trace_mod.collrec_sig(
+            slot, getattr(buf, "dtype", None), nbytes, root)
+        return _run_recorded(comm, slot, slot, sig, provider, nbytes,
+                             fn, (buf, *args), kw)
 
+    root_pos = _ROOT_ARG.get(slot)
     dispatch.__name__ = f"coll_{slot}_dispatch"
     return dispatch
 
 
 def _make_traced_barrier(host_fn, provider):
     """Barrier has no buffer to classify; wrap the provider directly so
-    the epoch still shows up on the coll timeline (and in the dispatch
-    histogram — a barrier's latency IS the wait for the last arriver)."""
+    the epoch still shows up on the recorder, the coll timeline and the
+    dispatch histogram — a barrier's latency IS the wait for the last
+    arriver."""
+    sig = trace_mod.collrec_sig("barrier", None, 0)
+
     def barrier(comm, *args, **kw):
-        if trace_mod.hist_active or trace_mod.active:
-            t0 = trace_mod.begin()
-            try:
-                return host_fn(comm, *args, **kw)
-            finally:
-                now = time.monotonic_ns()
-                if trace_mod.hist_active:
-                    trace_mod.record_hist(
-                        "coll_dispatch_ns", now - t0,
-                        labels=f'slot="barrier",'
-                               f'provider="{provider}",szb="0"')
-                if trace_mod.active:
-                    trace_mod.complete(
-                        "coll", "barrier", t0, rank=comm.pml.rank,
-                        comm=comm.name, cid=comm.cid, size=comm.size)
-        return host_fn(comm, *args, **kw)
+        return _run_recorded(comm, "barrier", "barrier", sig, provider,
+                             0, host_fn, args, kw)
 
     return barrier
 

@@ -76,6 +76,18 @@ class NbcRequest(Request):
         # histogram family; persistent Starts ride coll_pstart_ns)
         self._h_t0 = (_time.monotonic_ns()
                       if trace_mod.hist_active else 0)
+        # collective flight recorder: nbc schedules post under their
+        # "i<kind>" name with their own (rank, cid) op_seq — round
+        # advances and completion ride the same seq so the hang doctor
+        # can see WHICH round of a wedged schedule never finished.  The
+        # signature is kind-only: per-rank schedule shape (round count
+        # differs at tree leaves/interior, chain endpoints) is NOT
+        # cross-rank-comparable and would read as a false mismatch
+        self._rec_rank = comm.pml.rank
+        self._rec_closed = False
+        self._rec_seq = trace_mod.coll_post(
+            self._rec_rank, comm.cid, kind,
+            trace_mod.collrec_sig(kind, None, 0), "nbc", 0)
         self._progress(block=False)
 
     # -- progress engine --------------------------------------------------
@@ -107,6 +119,10 @@ class NbcRequest(Request):
             rnd.compute(self._state)
         self._pending = None
         self._ridx += 1
+        trace_mod.coll_event(
+            self._rec_rank, self._comm.cid, "round",
+            {"r": self._ridx, "of": len(self._rounds)},
+            seq=self._rec_seq, kind=self.kind)
 
     def _progress(self, block: bool,
                   deadline: Optional[float] = None) -> bool:
@@ -116,25 +132,41 @@ class NbcRequest(Request):
         with self._nbc_lock:
             if self.done():
                 return True
-            while self._ridx < len(self._rounds):
-                if self._pending is None:
-                    self._start_round()
-                assert self._pending is not None
-                if block:
-                    for req, _ in self._pending:
-                        if deadline is None:
-                            req.wait()
-                        else:
-                            remaining = deadline - time.monotonic()
-                            if remaining <= 0:
-                                raise TimeoutError(
-                                    f"{self.kind} timed out in round "
-                                    f"{self._ridx}/{len(self._rounds)}")
-                            req.wait(timeout=remaining)
-                elif not all(req.test() for req, _ in self._pending):
-                    return False
-                self._finish_round()
+            try:
+                while self._ridx < len(self._rounds):
+                    if self._pending is None:
+                        self._start_round()
+                    assert self._pending is not None
+                    if block:
+                        for req, _ in self._pending:
+                            if deadline is None:
+                                req.wait()
+                            else:
+                                remaining = deadline - time.monotonic()
+                                if remaining <= 0:
+                                    raise TimeoutError(
+                                        f"{self.kind} timed out in round "
+                                        f"{self._ridx}/{len(self._rounds)}")
+                                req.wait(timeout=remaining)
+                    elif not all(req.test() for req, _ in self._pending):
+                        return False
+                    self._finish_round()
+            except BaseException as e:
+                # a failed round (revoked comm, dead peer, timeout) must
+                # close the recorder entry — a leaked in-flight head
+                # would read as a forever-wedged rank and freeze the
+                # @coll top-level gate (once: test() may re-raise)
+                if not self._rec_closed:
+                    self._rec_closed = True
+                    trace_mod.coll_err(
+                        self._rec_rank, self._comm.cid, self._rec_seq,
+                        self.kind, type(e).__name__)
+                raise
             self.complete(self._result_fn(self._state))
+            if not self._rec_closed:
+                self._rec_closed = True
+                trace_mod.coll_done(self._rec_rank, self._comm.cid,
+                                    self._rec_seq, self.kind)
             if self._h_t0 and trace_mod.hist_active:
                 trace_mod.record_hist(
                     "coll_nbc_ns", _time.monotonic_ns() - self._h_t0,

@@ -404,6 +404,8 @@ class _ArenaPlan:
                     trace_mod.record_hist(
                         "coll_ppublish_ns",
                         time.monotonic_ns() - _h_t0)
+                trace_mod.coll_event(comm.pml.rank, comm.cid, "pub",
+                                     {"k": k})
                 return CompletedRequest(arr, kind="pbcast")
             return _LazyRequest(
                 lambda: self._drain_bcast(k),
@@ -430,6 +432,7 @@ class _ArenaPlan:
         if _h_t0:
             trace_mod.record_hist("coll_ppublish_ns",
                                   time.monotonic_ns() - _h_t0)
+        trace_mod.coll_event(comm.pml.rank, comm.cid, "pub", {"k": k})
         if kind == "reduce":
             if comm.rank != self._root:
                 # contribution is in the slot: locally complete (the
@@ -483,6 +486,8 @@ class _ArenaPlan:
         """Rank-ordered fold straight over the parity-q slots — one
         GIL-released native call when the (op, dtype) pair compiled,
         the numpy view chain otherwise (bit-identical either way)."""
+        trace_mod.coll_event(self._comm.pml.rank, self._comm.cid,
+                             "fold", {"k": k})
         q = k & 1
         ex = self._fold_exec()
         if ex is not None:
@@ -933,6 +938,10 @@ class PersistentCollRequest(PersistentRequest):
         self._binder = binder
         self._plan = None
         self._incs: tuple = ()
+        # recorder signature of this plan's Starts (kind + world size:
+        # a persistent op's shape is frozen at bind, so the signature
+        # cannot drift between Starts)
+        self._rec_sig = trace_mod.collrec_sig(f"p{kind}", None, comm.size)
         super().__init__(self._launch, kind=f"persistent-{kind}")
         self._compile(first=True)
         comm._persistent_colls.append(weakref.ref(self))
@@ -978,11 +987,32 @@ class PersistentCollRequest(PersistentRequest):
                 f"collectively, or re-init on a shrunk communicator",
                 error_class=ERR_PROC_FAILED)
         trace_mod.count("coll_persistent_starts_total")
+        # collective flight recorder: every Start posts under the
+        # "p<kind>" name with its own (rank, cid) op_seq; completion of
+        # the inner request records done — a wedged Start therefore
+        # leaves a post-without-done head the hang doctor reads
+        rank = comm.pml.rank
+        seq = trace_mod.coll_post(
+            rank, comm.cid, f"p{self._ckind}", self._rec_sig,
+            plan.provider, 0)
         # Start→completion latency: stamped here, recorded when the
         # inner request completes (CompletedRequest fires the callback
         # inline, so a locally-complete publish still lands a sample)
         _h_t0 = trace_mod.begin() if trace_mod.hist_active else 0
         req = plan.start_op()
+
+        def _rec_close(_r, r=rank, c=comm.cid, s=seq,
+                       k=f"p{self._ckind}"):
+            # completion callbacks also fire from Request.fail() — a
+            # failed Start must record err, not done (the doctor's
+            # "an err-closed wait keeps its wait-for edge" contract)
+            exc = getattr(_r, "_exc", None)
+            if exc is not None:
+                trace_mod.coll_err(r, c, s, k, type(exc).__name__)
+            else:
+                trace_mod.coll_done(r, c, s, k)
+
+        req.add_completion_callback(_rec_close)
         if _h_t0:
             labels = (f'kind="{self._ckind}",'
                       f'provider="{plan.provider}"')

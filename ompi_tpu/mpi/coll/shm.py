@@ -62,6 +62,7 @@ import ctypes
 import os
 import time
 import uuid
+import weakref
 from typing import Optional
 
 import numpy as np
@@ -93,6 +94,33 @@ def _arena_dtype_ok(dtype: np.dtype) -> bool:
     return not dtype.hasobject and dtype.itemsize > 0
 
 
+#: live arenas of this process — the hang doctor's capture walks them
+#: for the arrive/depart counter snapshots (the "who hasn't arrived"
+#: signal); weak so a closed/garbage-collected arena just disappears
+_live_arenas: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def arena_states() -> list[dict]:
+    """Each live arena's counter block as a plain dict — what a doctor
+    capture embeds.  Best-effort: a concurrently-detached segment
+    contributes nothing rather than raising on a reader thread."""
+    out = []
+    for a in list(_live_arenas):
+        try:
+            f = a._flags
+            out.append({
+                "size": a.size,
+                "rank": a.rank,
+                "world": list(a.world) if a.world is not None else None,
+                "arrive": [int(f[r * 8]) for r in range(a.size)],
+                "depart": [int(f[(a.size + r) * 8])
+                           for r in range(a.size)],
+            })
+        except (ValueError, IndexError, OSError):
+            continue
+    return out
+
+
 # ---------------------------------------------------------------------------
 # the native executor (_native/arena.c via ctypes — every call runs with
 # the GIL RELEASED, which is the entire point: a rank parked in a flag
@@ -110,6 +138,11 @@ _NATIVE_SPINS = _native.PARK_SPINS
 _NATIVE_SLICE_NS = 2_000_000
 #: below this a ctypes call costs more than the GIL-held numpy copy
 _NATIVE_PUBLISH_MIN = 512
+
+#: a wait this old records its flight-recorder wait-for edge (one park
+#: slice: younger waits are normal publish races, and an entry-time
+#: edge could name a laggard that long since arrived)
+_WAIT_REC_AFTER_S = _NATIVE_SLICE_NS / 1e9
 
 #: physical parallelism available to cooperative folds (tests patch it)
 _NCORES = os.cpu_count() or 1
@@ -273,6 +306,11 @@ class Arena:
         self.size = size
         self.rank = rank
         self.slot_bytes = slot_bytes
+        # this rank's WORLD rank (the flight recorder / doctor key; the
+        # arena index is node-local)
+        self._wr = (pml.rank if pml is not None
+                    else (list(world)[rank] if world is not None
+                          else rank))
         # arena rank → world rank, plus the pml whose btl owns the
         # pid-liveness probe: a writer dying between flag stores leaves
         # peers nothing to observe but its pid, so the wait loop probes
@@ -290,6 +328,7 @@ class Arena:
         # the mapped u64 view is base + i*8, slot offsets are relative
         # to the same base); None ⇒ python data plane only
         self._base_addr = _addr_of(seg.buf)
+        _live_arenas.add(self)   # doctor capture reads arrive/depart
 
     @staticmethod
     def nbytes_for(size: int, slot_bytes: int) -> int:
@@ -338,7 +377,12 @@ class Arena:
         # the straggler signal: every ns burnt in here is this rank
         # waiting on a PEER's flag store — recorded into the arena-wait
         # histogram on completed waits (an already-satisfied flag never
-        # reaches this point, so the fast path stays one compare)
+        # reaches this point, so the fast path stays one compare).  The
+        # slow paths below additionally record a flight-recorder
+        # ``wait`` event naming the world rank whose store the wait is
+        # parked on (the hang doctor's wait-for edge) — AFTER the wait
+        # has survived ~one park slice, so transient publish races
+        # cannot fabricate stale mutual edges (a fake deadlock cycle)
         _h_t0 = time.monotonic_ns() if trace_mod.hist_active else 0
         ex = _exec() if self._base_addr is not None else None
         if ex is not None:
@@ -358,6 +402,8 @@ class Arena:
         now = time.monotonic()
         deadline = now + timeout
         probe_at = now + grace if grace > 0 else None
+        stuck_at = self._stuck_at(now)
+        rec_at: Optional[float] = now + _WAIT_REC_AFTER_S
         spins = 0
         delay = 2e-5
         while f[idx] < v:
@@ -367,12 +413,19 @@ class Arena:
                 continue
             time.sleep(delay)       # escalate once the burst window passed
             delay = min(delay * 2, 1e-3)
+            if rec_at is not None and time.monotonic() > rec_at:
+                rec_at = self._record_wait(comm, idx // 8,
+                                           (idx // 8) % self.size, v)
             if comm is not None:
                 self._check_ft(comm)
             if probe_at is not None and time.monotonic() > probe_at:
                 # the probe itself is rate-limited (shared btl cache), so
                 # asking every escalated iteration stays cheap
                 self._probe_writer((idx // 8) % self.size, grace, timeout)
+            if stuck_at is not None and time.monotonic() > stuck_at:
+                stuck_at = self._report_stuck(
+                    comm, time.monotonic() - (deadline - timeout),
+                    (idx // 8) % self.size)
             if time.monotonic() > deadline:
                 raise MPIException(
                     f"coll/shm: arena wait (flag {idx // 8}, want {v}, "
@@ -395,6 +448,8 @@ class Arena:
         now = time.monotonic()
         deadline = now + timeout
         probe_at = now + grace if grace > 0 else None
+        stuck_at = self._stuck_at(now)
+        recorded = False
         base = self._base_addr
         while True:
             if all_base is None:
@@ -409,8 +464,20 @@ class Arena:
             if comm is not None:
                 self._check_ft(comm)
             lag = self._laggard(v, idx=idx, all_base=all_base)
+            if not recorded:
+                # the wait outlived a whole park slice: record the edge
+                # with the laggard as of NOW (not wait entry — the
+                # entry-time laggard may have long since arrived)
+                recorded = True
+                flag = (idx if all_base is None
+                        else all_base + lag * 8) // 8
+                self._record_wait(comm, flag, lag % self.size, v)
             if probe_at is not None and time.monotonic() > probe_at:
                 self._probe_writer(lag % self.size, grace, timeout)
+            if stuck_at is not None and time.monotonic() > stuck_at:
+                stuck_at = self._report_stuck(
+                    comm, time.monotonic() - (deadline - timeout),
+                    lag % self.size)
             if time.monotonic() > deadline:
                 f = self._flags
                 flag = idx if all_base is None else all_base + lag * 8
@@ -431,6 +498,32 @@ class Arena:
             if f[all_base + r * 8] < v:
                 return r
         return 0
+
+    def _record_wait(self, comm, flag: int, lag: int, v: int) -> None:
+        """One flight-recorder ``wait`` edge naming the current laggard
+        (called once per wait, after it survived ~a park slice).
+        Returns None — the caller's record-once sentinel."""
+        trace_mod.coll_event(
+            self._wr, comm.cid if comm is not None else -1, "wait",
+            {"flag": flag, "want": v,
+             "on": self.world[lag] if self.world is not None else lag})
+        return None
+
+    def _stuck_at(self, now: float) -> Optional[float]:
+        """When this wait should push a stuck event up the uplink
+        (None = watchdog disabled via coll_stuck_timeout 0)."""
+        stuck = float(var_registry.get("coll_stuck_timeout") or 0)
+        return now + stuck if stuck > 0 else None
+
+    def _report_stuck(self, comm, waited_s: float,
+                      lag: int) -> Optional[float]:
+        """The watchdog fired: record a stuck event naming the laggard
+        and force a metrics push (once per wait — returns the cleared
+        re-arm sentinel)."""
+        trace_mod.coll_stuck(
+            self._wr, comm.cid if comm is not None else -1, waited_s,
+            self.world[lag] if self.world is not None else lag)
+        return None
 
     def _wait_many(self, all_base: int, v: int, comm) -> None:
         """Wait flag[all_base + r*8] >= v for every arena rank — ONE
@@ -1043,6 +1136,14 @@ class ShmColl(Component):
                      "seconds an arena flag wait may stall before raising "
                      "(a dead peer or collective-order mismatch leaves "
                      "flags behind forever)")
+        register_var("coll", "stuck_timeout", VarType.DOUBLE, 5.0,
+                     "seconds an arena flag wait may stall before the "
+                     "rank records a 'stuck' event on the collective "
+                     "flight recorder and forces an out-of-cadence "
+                     "metrics push (the HNP hang doctor's watchdog "
+                     "trigger for an automatic cross-rank capture).  "
+                     "0 disables the watchdog; the wait itself still "
+                     "fails at coll_shm_timeout")
         register_var("coll", "shm_probe_grace", VarType.DOUBLE, 1.0,
                      "seconds an arena wait stalls before probing the "
                      "expected writer's pid via the btl liveness probe "

@@ -26,6 +26,7 @@ import math
 import queue
 import threading
 import time
+import weakref
 from typing import Any, Optional
 
 import numpy as np
@@ -105,6 +106,8 @@ class RecvRequest(Request):
         self.cid = cid
         self.rid = -1  # receiver-side id for rendezvous
         self._pml = None  # set by PmlOb1.irecv; enables real cancel
+        # post time (monotonic): the hang doctor's pending-recv age
+        self.t_posted = time.monotonic()
         # set BEFORE delivery can complete the request: the status.source
         # value _deliver should report instead of the wire peer (a
         # communicator's group rank when it differs from the world rank).
@@ -217,6 +220,8 @@ class _SendState:
         self.payload = payload   # bytes or zero-copy memoryview of user buf
         self.on_done = on_done   # e.g. bsend-pool release
         self.fl = 0              # flow id (tracing): rides the rndv_send span
+        # creation time (monotonic): the hang doctor's pending-send age
+        self.t_posted = time.monotonic()
 
 
 class _RecvState:
@@ -455,6 +460,11 @@ class PmlOb1:
                 self._eng = fast.Engine()
                 self._fast = fast
         self._sendq: "queue.Queue[Optional[tuple]]" = queue.Queue()
+        # every posted recv, weakly (engine-agnostic: the native matching
+        # engine owns the real posted queue) — what the hang doctor's
+        # pending_summary walks; completed requests filter out on done()
+        self._doctor_recvs: "weakref.WeakSet[RecvRequest]" = \
+            weakref.WeakSet()
         self._listeners: list = []   # peruse/monitoring subscribers
         self._events: "collections.deque[tuple]" = collections.deque()
         self.bsend_pool = BsendPool()  # per-PML, like every other send state
@@ -530,6 +540,54 @@ class PmlOb1:
         if m is None:
             m = self._matching[cid] = _Matching()
         return m
+
+    def pending_summary(self, limit: int = 64) -> dict:
+        """Pending point-to-point state for the hang doctor's capture:
+        posted recvs (peer/tag/cid/age), sends awaiting a peer event
+        (rendezvous CTS, sync ack), in-flight rendezvous receives,
+        unexpected-queue depth and parked/queued frame counts.  Runs on
+        the doctor responder thread — dict walks under the PML lock,
+        no blocking work."""
+        now = time.monotonic()
+        recvs: list[dict] = []
+        sends: list[dict] = []
+        rndv: list[dict] = []
+        with self._lock:
+            for req in list(self._doctor_recvs):
+                if req.done():
+                    continue
+                recvs.append({
+                    "src": req.source, "tag": req.tag, "cid": req.cid,
+                    "age_s": round(now - req.t_posted, 3)})
+                if len(recvs) >= limit:
+                    break
+            for st in list(self._send_states.values()):
+                if st.req is not None and st.req.done():
+                    continue
+                payload = st.payload
+                nbytes = (getattr(payload, "nbytes", None)
+                          or (len(payload) if payload is not None else 0))
+                sends.append({
+                    "peer": st.peer, "bytes": int(nbytes),
+                    "age_s": round(now - st.t_posted, 3)})
+                if len(sends) >= limit:
+                    break
+            for st in list(self._recv_states.values()):
+                if st.req is not None and st.req.done():
+                    continue
+                rndv.append({
+                    "peer": st.peer, "bytes": len(st.data),
+                    "received": st.received})
+                if len(rndv) >= limit:
+                    break
+            unexpected = sum(len(m.unexpected)
+                             for m in self._matching.values())
+            parked = {p: len(v) for p, v in self._parked.items() if v}
+        with self._qlock:
+            queued = {p: n for p, n in self._queued.items() if n}
+        return {"recvs": recvs, "sends": sends, "rndv": rndv,
+                "unexpected": unexpected, "parked": parked,
+                "queued": queued}
 
     # -- send side ---------------------------------------------------------
 
@@ -823,6 +881,10 @@ class PmlOb1:
         if self._listeners:
             self._emit(EVT_RECV_POST, peer=source, tag=tag, cid=cid)
         with self._lock:
+            # under the PML lock: pending_summary() iterates this set
+            # under the same lock, and a WeakSet is not safe against a
+            # concurrent add mid-iteration
+            self._doctor_recvs.add(req)
             if self._eng is not None:
                 barr = None
                 if (buf is not None and datatype is not None

@@ -150,6 +150,18 @@ def init() -> Communicator:
         _trace.start_metrics_push(
             int(os.environ.get(pmix.ENV_JOBID, "0") or 0), rank)
 
+        # hang-doctor responder: the rank-side capture endpoint (UDP,
+        # port registered with the PMIx server via the 'doctor' RPC) the
+        # owning orted queries on TAG_DOCTOR — armed under a launcher
+        # only (a standalone single process has nobody to answer)
+        if under_launcher:
+            from ompi_tpu.runtime import doctor as _doctor
+
+            _doctor.start_responder(
+                rank,
+                jobid=int(os.environ.get(pmix.ENV_JOBID, "0") or 0),
+                pml=pml, client=client)
+
         restarted = bool(os.environ.get("OMPI_TPU_RESTART"))
         if size > 1:
             assert client is not None
@@ -275,7 +287,9 @@ def finalize(_collective: bool = True) -> None:
             # no-op if already left; atexit path
             multihost.shutdown(graceful=not respawn_seen())
             from ompi_tpu.mpi import trace as _trace
+            from ompi_tpu.runtime import doctor as _doctor
 
+            _doctor.stop_responder()   # re-armed by a later init epoch
             # final full metrics push: a short job's last counter state
             # still reaches the DVM aggregate before the rank is gone
             _trace.stop_metrics_push(flush=True)

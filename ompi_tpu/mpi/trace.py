@@ -60,6 +60,10 @@ __all__ = [
     "stop_metrics_push", "record_hist", "hists", "hists_snapshot",
     "hist_values", "hist_bucket_index", "hist_quantile_ns",
     "refresh_hist_enable", "HIST_NBUCKETS", "HIST_VLEN", "HIST_MIN_EXP",
+    "CollRecorder", "collrec", "coll_post", "coll_done", "coll_err",
+    "coll_event", "coll_stuck", "collrec_tail", "collrec_sig",
+    "collrec_kind_id", "collrec_kind_name", "COLLREC_KINDS",
+    "COLLREC_TAIL", "push_now",
 ]
 
 ENV_FLAG = "OMPI_TPU_TRACE"
@@ -193,6 +197,15 @@ _COUNTER_SPECS = (
     ("btl_shm_native_drains_total", "sweeps",
      "btl/shm poller drain sweeps woken by the native GIL-released "
      "ring park instead of the python spin window"),
+    # collective flight recorder + cross-rank hang doctor
+    ("coll_stuck_events_total", "waits",
+     "collective waits that exceeded coll_stuck_timeout and pushed a "
+     "stuck event up the metrics uplink (the HNP doctor's watchdog "
+     "trigger)"),
+    ("coll_doctor_captures_total", "captures",
+     "rank-side doctor state captures served (recorder tail + pending "
+     "p2p + thread stacks, replied to the owning orted's TAG_DOCTOR "
+     "query)"),
 )
 
 #: plain-int counter store: dict increments, no lock — losses under
@@ -382,6 +395,315 @@ for _name, _unit, _desc in _HIST_SPECS:
         read_fn=lambda _b, n=_name: {
             k: list(v) for k, v in hists.items()
             if k == n or k.startswith(n + "{")}))
+
+
+# ---------------------------------------------------------------------------
+# collective flight recorder (always-on, beside the span ring)
+# ---------------------------------------------------------------------------
+#
+# The "which collective is this rank in, and since when" record the hang
+# doctor reads: a bounded ring of fixed-shape tuples fed by the coll
+# dispatch choke point, nbc round advances, persistent Start/completion
+# and the shm arena's slow-path waits.  Unlike the span ring it is NOT
+# gated on ``active`` — it must already hold the evidence when a job
+# wedges (target <1µs/record; measured in PERF.md).  Cross-rank matching
+# key: (cid, op_seq) where op_seq is a per-(rank, cid) dispatch ordinal —
+# ranks of one communicator issue matching collectives in the same order,
+# so divergent kind/signature at one (cid, op_seq) IS the MPI-illegal
+# collective mismatch the doctor's verdict names.
+
+#: external knob: collective-recorder ring capacity in records
+ENV_COLLREC_EVENTS = "OMPI_TPU_COLLREC_EVENTS"
+
+#: how many trailing records ride a doctor capture / crash dump
+COLLREC_TAIL = 256
+
+_COLLREC_BASE = (
+    "barrier", "bcast", "reduce", "allreduce", "gather", "allgather",
+    "scatter", "alltoall", "reduce_scatter", "reduce_scatter_block",
+    "scan", "exscan", "gatherv", "scatterv", "allgatherv", "alltoallv",
+    "alltoallw")
+
+#: the kind vocabulary: blocking dispatch slots, nbc schedules ("i"),
+#: persistent Starts ("p") — indexed so the pushed recorder head can
+#: ride the scalar metrics uplink as ``coll_cur_kind_id``
+COLLREC_KINDS = (_COLLREC_BASE
+                 + tuple("i" + k for k in _COLLREC_BASE)
+                 + tuple("p" + k for k in _COLLREC_BASE))
+
+_KIND_IDS = {k: i for i, k in enumerate(COLLREC_KINDS)}
+
+
+def collrec_kind_id(kind: str) -> int:
+    """The wire id of a collective kind (-1 for an unknown name)."""
+    return _KIND_IDS.get(kind, -1)
+
+
+def collrec_kind_name(kind_id: int) -> str:
+    """Inverse of :func:`collrec_kind_id` ("?" for out-of-range)."""
+    if 0 <= kind_id < len(COLLREC_KINDS):
+        return COLLREC_KINDS[kind_id]
+    return "?"
+
+
+#: per-kind crc32 cache for the signature mix (one encode per kind ever)
+_SIG_KIND: dict[str, int] = {}
+
+
+def collrec_sig(kind: str, dtype: Any, nbytes: int, root: int = -1) -> int:
+    """Deterministic cross-process signature of a collective's shape —
+    crc32-seeded integer mix, NOT hash(): PYTHONHASHSEED randomization
+    would make equal signatures diverge across ranks and every op read
+    as a mismatch.  Pure int math on the dispatch hot path (~0.3 µs);
+    the dtype contributes its stable numpy type code + itemsize."""
+    import zlib
+
+    kc = _SIG_KIND.get(kind)
+    if kc is None:
+        kc = _SIG_KIND[kind] = zlib.crc32(kind.encode())
+    dn = 0
+    if dtype is not None:
+        num = getattr(dtype, "num", None)
+        if num is not None:
+            dn = (int(num) << 8) | int(getattr(dtype, "itemsize", 0))
+        else:
+            dn = zlib.crc32(str(dtype).encode())
+    return (kc ^ (nbytes * 2654435761) ^ ((root + 3) * 2246822519)
+            ^ (dn * 3266489917)) & 0xFFFFFFFF
+
+
+#: one record: (ts_ns, rank, cid, op_seq, kind, phase, sig, info|None);
+#: phases: post / done / err (dispatch), wait / stuck (arena slow path),
+#: pub (persistent slot publish), round (nbc round advance), start
+#: (persistent Start), fold (arena fold), fault (injected chaos)
+_CollRecord = tuple[int, int, int, int, str, str, int,
+                    Optional[dict[str, Any]]]
+
+
+class CollRecorder:
+    """The per-rank collective flight recorder ring (always-on).
+
+    Keyed by (rank, cid) so the in-process multi-rank test harness —
+    several PMLs in one interpreter — keeps each rank's op_seq stream
+    intact; a launched rank process has exactly one rank key."""
+
+    def __init__(self, capacity: int = 1024) -> None:
+        self.capacity = max(64, int(capacity))
+        self._buf: list[Optional[_CollRecord]] = [None] * self.capacity
+        self._n = itertools.count()
+        self._hwm = 0
+        self._seq: dict[tuple[int, int], int] = {}
+        #: (rank, cid) → STACK of (op_seq, kind, sig, t_post_ns,
+        #: wall_post_s) between post and done — a stack because composed
+        #: collectives nest (the shm barrier dispatches host allgathers
+        #: through the same choke point); events attribute to the
+        #: innermost in-flight op and a nested done re-exposes its parent
+        self.current: dict[tuple[int, int],
+                           list[tuple[int, str, int, int, float]]] = {}
+        #: dispatch ordinal across all comms of this process (what
+        #: faultinject's @coll=N triggers count)
+        self.ops_total = 0
+        #: the pushed head: [rank, cid, op_seq, kind_id, t_post_ns,
+        #: done, wall_post_s] — wall_post_s (NOT an age) rides the
+        #: uplink: a stable per-op value keeps the delta compression
+        #: intact, and the DVM computes the age itself
+        self.head: Optional[list[float]] = None
+
+    def _add(self, rec: _CollRecord) -> None:
+        i = next(self._n)
+        self._buf[i % self.capacity] = rec
+        self._hwm = i + 1
+
+    def post(self, rank: int, cid: int, kind: str, sig: int,
+             provider: Optional[str], nbytes: int) -> int:
+        key = (rank, cid)
+        seq = self._seq.get(key, -1) + 1
+        self._seq[key] = seq
+        now = time.monotonic_ns()
+        wall = time.time()
+        self.ops_total += 1
+        self.current.setdefault(key, []).append(
+            (seq, kind, sig, now, wall))
+        self.head = [rank, cid, seq, _KIND_IDS.get(kind, -1), now, 0,
+                     wall]
+        self._add((now, rank, cid, seq, kind, "post", sig,
+                   {"prov": provider, "nb": nbytes}))
+        return seq
+
+    def _pop_current(self, rank: int, cid: int, seq: int) -> None:
+        key = (rank, cid)
+        stack = self.current.get(key)
+        if stack:
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i][0] == seq:
+                    del stack[i]
+                    break
+        if stack:
+            # a nested op closed: the head goes back to its still-open
+            # parent (a wedged outer collective must not read as done)
+            top = stack[-1]
+            self.head = [rank, cid, top[0],
+                         _KIND_IDS.get(top[1], -1), top[3], 0, top[4]]
+        else:
+            self.current.pop(key, None)
+            h = self.head
+            if h is not None and h[0] == rank and h[1] == cid \
+                    and h[2] == seq:
+                h[5] = 1
+
+    def done(self, rank: int, cid: int, seq: int, kind: str) -> None:
+        self._pop_current(rank, cid, seq)
+        self._add((time.monotonic_ns(), rank, cid, seq, kind, "done",
+                   0, None))
+
+    def err(self, rank: int, cid: int, seq: int, kind: str,
+            exc: str) -> None:
+        self._pop_current(rank, cid, seq)
+        self._add((time.monotonic_ns(), rank, cid, seq, kind, "err",
+                   0, {"exc": exc}))
+
+    def event(self, rank: int, cid: int, phase: str,
+              info: Optional[dict[str, Any]] = None,
+              seq: Optional[int] = None,
+              kind: Optional[str] = None) -> tuple[int, str]:
+        """A phase record attributed to the in-flight op on (rank, cid)
+        (or to an explicit seq/kind for nbc/persistent callers)."""
+        if seq is None or kind is None:
+            stack = self.current.get((rank, cid))
+            if stack:
+                top = stack[-1]
+                seq = top[0] if seq is None else seq
+                kind = top[1] if kind is None else kind
+            else:
+                seq = -1 if seq is None else seq
+                kind = "?" if kind is None else kind
+        self._add((time.monotonic_ns(), rank, cid, seq, kind, phase,
+                   0, info))
+        return seq, kind
+
+    @property
+    def records_total(self) -> int:
+        return self._hwm
+
+    def snapshot(self) -> list[_CollRecord]:
+        n = self._hwm
+        if n <= self.capacity:
+            out = self._buf[:n]
+        else:
+            cut = n % self.capacity
+            out = self._buf[cut:] + self._buf[:cut]
+        return [r for r in out if r is not None]
+
+    def tail(self, limit: int = COLLREC_TAIL) -> list[list[Any]]:
+        """The newest ``limit`` records as JSON/DSS-safe lists — the
+        payload of doctor captures and crash dumps."""
+        snap = self.snapshot()[-max(0, int(limit)):]
+        return [list(r) for r in snap]
+
+    def reset(self) -> None:
+        """Tests only: forget every record, seq counter and head."""
+        self._buf = [None] * self.capacity
+        self._n = itertools.count()
+        self._hwm = 0
+        self._seq.clear()
+        self.current.clear()
+        self.ops_total = 0
+        self.head = None
+
+
+def _collrec_capacity() -> int:
+    try:
+        return int(os.environ.get(ENV_COLLREC_EVENTS, "") or 1024)
+    except ValueError:
+        return 1024      # a bad sizing knob must not kill import
+
+
+#: THE process-global recorder (always armed; ~100 KiB at the default
+#: 1024-record capacity)
+collrec = CollRecorder(_collrec_capacity())
+
+
+def coll_post(rank: int, cid: int, kind: str, sig: int,
+              provider: Optional[str], nbytes: int) -> int:
+    """Record a collective dispatch; returns its per-(rank, cid) op_seq."""
+    return collrec.post(rank, cid, kind, sig, provider, nbytes)
+
+
+def coll_done(rank: int, cid: int, seq: int, kind: str) -> None:
+    collrec.done(rank, cid, seq, kind)
+
+
+def coll_err(rank: int, cid: int, seq: int, kind: str, exc: str) -> None:
+    collrec.err(rank, cid, seq, kind, exc)
+
+
+def coll_event(rank: int, cid: int, phase: str,
+               info: Optional[dict[str, Any]] = None,
+               seq: Optional[int] = None,
+               kind: Optional[str] = None) -> tuple[int, str]:
+    return collrec.event(rank, cid, phase, info, seq=seq, kind=kind)
+
+
+def coll_stuck(rank: int, cid: int, waited_s: float,
+               on: Optional[int]) -> None:
+    """An arena wait crossed ``coll_stuck_timeout``: record it, bump the
+    watchdog counter and force an immediate metrics push so the HNP's
+    doctor learns within one uplink hop instead of a push period."""
+    count("coll_stuck_events_total")
+    info: dict[str, Any] = {"s": round(waited_s, 2)}
+    if on is not None:
+        info["on"] = on
+    collrec.event(rank, cid, "stuck", info)
+    push_now()
+
+
+def push_now() -> None:
+    """One out-of-cadence metrics push (no-op when the uplink is off) —
+    how a stuck event beats the push period to the HNP."""
+    pusher = _pusher
+    if pusher is not None:
+        pusher.push()
+
+
+def collrec_tail(limit: int = COLLREC_TAIL) -> list[list[Any]]:
+    return collrec.tail(limit)
+
+
+def _collrec_head(i: int, default: float = -1) -> float:
+    h = collrec.head
+    return float(h[i]) if h is not None else default
+
+
+for _name, _klass, _unit, _desc, _read in (
+    ("coll_recorder_ops", PvarClass.COUNTER, "operations",
+     "collectives recorded by this process's flight recorder (posts "
+     "across blocking dispatch, nbc launches and persistent Starts)",
+     lambda _b: collrec.ops_total),
+    ("coll_cur_seq", PvarClass.LEVEL, "operations",
+     "op_seq of the recorder head (the last collective posted; -1 "
+     "before the first) — with coll_cur_kind_id/cid/done/age_s this is "
+     "the pushed head the --dvm-ps last_coll column and the doctor's "
+     "no-response fallback read",
+     lambda _b: _collrec_head(2)),
+    ("coll_cur_kind_id", PvarClass.LEVEL, "kind",
+     "COLLREC_KINDS index of the recorder head's kind (-1 = none)",
+     lambda _b: _collrec_head(3)),
+    ("coll_cur_cid", PvarClass.LEVEL, "communicator",
+     "cid of the recorder head (-1 = none)",
+     lambda _b: _collrec_head(1)),
+    ("coll_cur_done", PvarClass.LEVEL, "flag",
+     "1 when the recorder head completed, 0 while it is in flight "
+     "(a rank whose head stays 0 with a growing age is wedged)",
+     lambda _b: _collrec_head(5, default=1)),
+    ("coll_cur_posted_ts", PvarClass.LEVEL, "seconds",
+     "wall-clock time the recorder head was posted (0 before the "
+     "first).  A stable per-op value — NOT an age, which would change "
+     "every read and defeat the uplink's delta compression; the DVM "
+     "computes ages against its own clock",
+     lambda _b: _collrec_head(6, default=0.0)),
+):
+    pvar_registry.register_or_get(Pvar(
+        _name, _klass, unit=_unit, description=_desc, read_fn=_read))
 
 
 # ---------------------------------------------------------------------------
@@ -676,6 +998,11 @@ def flush(path: Optional[str] = None,
             # tools/straggler_report.py's offline mode reads these from
             # merged per-rank dumps when no live aggregate is reachable
             "hists": hist_values(),
+            # collective-recorder tail: the postmortem hang doctor
+            # (tools/hang_doctor.py --dir) reads these from crash dumps
+            # when no live control plane is left to capture
+            "collrec": collrec_tail(),
+            "collrec_total": collrec.records_total,
         },
         "traceEvents": chrome_events(rec),
     }
@@ -793,6 +1120,11 @@ class _MetricsPusher:
         self._last: dict[str, float] = {}
         self._last_h: dict[str, list[int]] = {}
         self._n = 0
+        # push() is entered by the periodic thread AND by push_now()
+        # (a stuck wait's out-of-cadence push): without the lock, two
+        # concurrent delta computations against one _last_h baseline
+        # would double-count histogram increments at the aggregate
+        self._push_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name=f"trace-metrics-{rank}", daemon=True)
@@ -809,35 +1141,39 @@ class _MetricsPusher:
         from ompi_tpu.core import dss
 
         try:
-            cur = metrics_values()
-            cur_h = hist_values()
-            full = self._n % FULL_EVERY == 0
-            vals: dict[str, Any] = (
-                dict(cur) if full else
-                {k: v for k, v in cur.items()
-                 if self._last.get(k) != v})
-            for key, vec in cur_h.items():
-                if full:
-                    vals[key] = [VEC_ABS, *vec]
-                    continue
-                last = self._last_h.get(key)
-                if last is None:
-                    # a series born between full pushes: its whole
-                    # vector IS the increment since the last push
-                    vals[key] = [VEC_DELTA, *vec]
-                elif last != vec:
-                    vals[key] = [VEC_DELTA,
-                                 *(a - b for a, b in zip(vec, last))]
-            self._n += 1
-            if not vals and not full:
-                return
-            self._sock.sendto(
-                dss.pack(("m1", self.jobid, self.rank, self._n, vals)),
-                self._addr)
-            self._last = cur
-            self._last_h = cur_h
+            with self._push_lock:
+                self._push_locked(dss)
         except Exception:  # noqa: BLE001 — uplink is best-effort
             pass
+
+    def _push_locked(self, dss: Any) -> None:
+        cur = metrics_values()
+        cur_h = hist_values()
+        full = self._n % FULL_EVERY == 0
+        vals: dict[str, Any] = (
+            dict(cur) if full else
+            {k: v for k, v in cur.items()
+             if self._last.get(k) != v})
+        for key, vec in cur_h.items():
+            if full:
+                vals[key] = [VEC_ABS, *vec]
+                continue
+            last = self._last_h.get(key)
+            if last is None:
+                # a series born between full pushes: its whole
+                # vector IS the increment since the last push
+                vals[key] = [VEC_DELTA, *vec]
+            elif last != vec:
+                vals[key] = [VEC_DELTA,
+                             *(a - b for a, b in zip(vec, last))]
+        self._n += 1
+        if not vals and not full:
+            return
+        self._sock.sendto(
+            dss.pack(("m1", self.jobid, self.rank, self._n, vals)),
+            self._addr)
+        self._last = cur
+        self._last_h = cur_h
 
     def stop(self, flush: bool = True) -> None:
         self._stop.set()

@@ -28,10 +28,16 @@ on the DVM serving
   process pvars;
 - ``/status`` — JSON: the daemon table (heartbeat ages), the proc table
   (``lives``, restarts budget, last-metrics age, p99 collective
-  latency), the per-job FT event timeline (detect / reap / revive /
-  shrink / escalate) and the per-job straggler panel (per-rank
-  collective wait-time share over the last window, max/median skew,
-  and the current slowest rank).
+  latency, ``last_coll`` pushed recorder head), the per-job FT event
+  timeline (detect / reap / revive / shrink / escalate / stuck /
+  doctor) and the per-job straggler panel (per-rank collective
+  wait-time share over the last window, max/median skew, and the
+  current slowest rank);
+- ``/doctor`` — JSON: an on-demand cross-rank hang capture + verdict
+  (TAG_DOCTOR fan-out → per-rank recorder tails, pending p2p, stacks,
+  /proc probes → mismatch / deadlock / straggler analysis).  The same
+  capture fires automatically when the watchdog sees a rank push a
+  stuck event (``coll_stuck_timeout``).
 
 ``--metrics-port 0`` binds an ephemeral port; the bound address is
 written next to the URI file as ``<uri>.metrics``.
@@ -95,6 +101,16 @@ class DvmHnp(MultiHostLauncher):
         self._stats_cv = threading.Condition()
         self._stats_epoch = 0                 # fences late replies
         self._stats_lock = threading.Lock()   # one collection at a time
+        # hang-doctor capture plumbing (mirrors the stats collection:
+        # epoch-fenced TAG_DOCTOR_REPLY fan-in, one capture at a time)
+        self._doctor: dict[int, list] = {}    # vpid → capture rows
+        self._doctor_cv = threading.Condition()
+        self._doctor_epoch = 0
+        self._doctor_lock = threading.Lock()
+        self._last_doctor: Optional[dict] = None
+        #: (jobid, rank) → highest coll_stuck_events_total seen — the
+        #: watchdog's new-stuck-event edge detector
+        self._stuck_seen: dict[tuple, float] = {}
         self.vm_job: Optional[Job] = None
         self._history: list[dict] = []        # completed-job records
 
@@ -113,6 +129,8 @@ class DvmHnp(MultiHostLauncher):
             raise RuntimeError(
                 f"DVM bring-up failed: {vm.abort_reason}")
         self.rml.register_recv(rml.TAG_STATS_REPLY, self._on_stats_reply)
+        self.rml.register_recv(rml.TAG_DOCTOR_REPLY,
+                               self._on_doctor_reply)
         self._ctrl = socket.create_server(("127.0.0.1", 0))
         port = self._ctrl.getsockname()[1]
         # metrics endpoint BEFORE the uri file: clients poll for the uri
@@ -303,6 +321,121 @@ class DvmHnp(MultiHostLauncher):
                                              float(cpu_s))
             return merged
 
+    # -- the cross-rank hang doctor ----------------------------------------
+
+    #: the pushed recorder-head gauges (see trace.py's coll_cur_* pvars)
+    _CUR_NAMES = ("coll_cur_seq", "coll_cur_kind_id", "coll_cur_cid",
+                  "coll_cur_done", "coll_cur_posted_ts")
+
+    def _on_doctor_reply(self, origin: int, payload) -> None:
+        vpid, epoch, rows = payload
+        with self._doctor_cv:
+            if epoch != self._doctor_epoch:
+                return                # late reply from an earlier round
+            self._doctor[vpid] = [dict(r) for r in rows]
+            self._doctor_cv.notify_all()
+
+    def _collect_doctor(self, timeout: float = 4.0) -> list[dict]:
+        """One cross-rank state snapshot: xcast TAG_DOCTOR, gather every
+        daemon's per-rank captures (a silent daemon contributes nothing
+        — its ranks then read as no_response at the analyzer).
+        Serialized + epoch-fenced like the stats collection."""
+        with self._doctor_lock:
+            n = len(self.vm_job.nodes) if self.vm_job else 0
+            with self._doctor_cv:
+                self._doctor.clear()
+                self._doctor_epoch += 1
+                epoch = self._doctor_epoch
+            try:
+                self.rml.xcast(rml.TAG_DOCTOR, epoch)
+            except Exception:  # noqa: BLE001 — tree tearing down
+                return []
+            deadline = time.monotonic() + timeout
+            with self._doctor_cv:
+                self._doctor_cv.wait_for(
+                    lambda: len(self._doctor) >= n,
+                    timeout=max(0.0, deadline - time.monotonic()))
+                captures: list[dict] = []
+                for rows in self._doctor.values():
+                    captures.extend(rows)
+            return captures
+
+    def _doctor_doc(self, trigger: str) -> dict:
+        """The /doctor document: live capture + analyzer verdict while a
+        job runs; the cached last verdict (or idle) otherwise."""
+        from ompi_tpu.runtime import doctor
+
+        vm = self.vm_job
+        job = self._cur_job
+        running = (job is not None and job is not vm
+                   and any(p.state == ProcState.RUNNING
+                           for p in job.procs))
+        if not running:
+            if self._last_doctor is not None:
+                return dict(self._last_doctor, stale=True)
+            return {"trigger": trigger, "ts": time.time(),
+                    "verdict": {"kind": "idle",
+                                "detail": "no job running and no "
+                                          "cached verdict"}}
+        captures = self._collect_doctor()
+        # a frozen rank's last uplink-pushed recorder head stands in for
+        # the capture it can no longer give
+        pushed = self.metrics_agg.rank_values(job.jobid, self._CUR_NAMES)
+        for c in captures:
+            if c.get("no_response") and int(c.get("rank", -1)) in pushed:
+                c["pushed"] = pushed[int(c["rank"])]
+        doc = doctor.analyze(captures, nranks=job.np)
+        doc["trigger"] = trigger
+        doc["jobid"] = job.jobid
+        doc["ts"] = time.time()
+        v = doc.get("verdict") or {}
+        # only verdicts worth remembering reach the FT timeline: a
+        # dashboard polling /doctor every few seconds against a healthy
+        # job must not flush real failure history out of the bounded
+        # event ring (watchdog-triggered captures always record)
+        if trigger == "watchdog" or v.get("kind") not in (
+                "healthy", "idle", "no_data"):
+            ftevents.record(
+                "doctor", jobid=job.jobid, rank=int(v.get("rank", -1)),
+                verdict=v.get("kind"), trigger=trigger,
+                detail=(v.get("detail") or "")[:300])
+        self._last_doctor = doc
+        return doc
+
+    def _doctor_watch(self) -> None:
+        """The watchdog: a rank whose coll_stuck_events_total rose since
+        the last tick pushed a stuck event up the uplink — record it on
+        the FT timeline and auto-capture a verdict (one capture per
+        tick, covering every newly-stuck rank)."""
+        while not self._stopped.wait(1.0):
+            vm = self.vm_job
+            job = self._cur_job
+            if job is None or job is vm:
+                continue
+            try:
+                # a standing DVM serves many jobs: drop dead jobs'
+                # edge-detector keys so the dict stays bounded
+                for key in [k for k in self._stuck_seen
+                            if k[0] != job.jobid]:
+                    del self._stuck_seen[key]
+                rows = self.metrics_agg.rank_values(
+                    job.jobid, ("coll_stuck_events_total",))
+                newly = []
+                for rank, vals in sorted(rows.items()):
+                    v = float(vals.get("coll_stuck_events_total", 0))
+                    key = (job.jobid, rank)
+                    if v > self._stuck_seen.get(key, 0.0):
+                        self._stuck_seen[key] = v
+                        newly.append((rank, int(v)))
+                if not newly:
+                    continue
+                for rank, n in newly:
+                    ftevents.record("stuck", jobid=job.jobid, rank=rank,
+                                    events=n)
+                self._doctor_doc("watchdog")
+            except Exception as e:  # noqa: BLE001 — watchdog survives
+                _log.verbose(1, "doctor watchdog tick failed: %r", e)
+
     def _daemon_rows(self) -> list[dict]:
         vm = self.vm_job
         if vm is None:
@@ -325,9 +458,12 @@ class DvmHnp(MultiHostLauncher):
         return rows
 
     def _proc_rows(self, job, usage: dict[int, tuple]) -> list[dict]:
+        from ompi_tpu.mpi import trace as trace_mod
+
         metrics_ages = self.metrics_agg.ages(job.jobid)
         p99s = self.metrics_agg.job_hist_quantiles(
             job.jobid, "coll_dispatch_ns", 0.99)
+        heads = self.metrics_agg.rank_values(job.jobid, self._CUR_NAMES)
         limit = int(var_registry.get("errmgr_max_restarts") or 0)
         procs = []
         for p in job.procs:
@@ -353,6 +489,21 @@ class DvmHnp(MultiHostLauncher):
                 # tail collective latency from the rank's pushed
                 # histogram (the --dvm-ps p99 column)
                 row["coll_p99_us"] = round(p99s[p.rank] / 1e3, 1)
+            hv = heads.get(p.rank)
+            if hv is not None and hv.get("coll_cur_seq", -1) >= 0:
+                # the pushed recorder head: the rank's last collective
+                # as kind#seq ("!" = still in flight at push time) plus
+                # its age — a wedged rank is visible here without a
+                # full doctor capture
+                kind = trace_mod.collrec_kind_name(
+                    int(hv.get("coll_cur_kind_id", -1)))
+                mark = "" if hv.get("coll_cur_done") else "!"
+                row["last_coll"] = \
+                    f'{kind}#{int(hv["coll_cur_seq"])}{mark}'
+                ts = float(hv.get("coll_cur_posted_ts", 0.0))
+                if ts > 0:
+                    row["last_coll_age_s"] = round(
+                        max(0.0, time.time() - ts), 2)
             if p.rank in usage:      # orte-top columns, live ranks
                 pid, rss, cpu_s = usage[p.rank]
                 row.update(pid=pid, rss_mb=round(rss / 2**20, 1),
@@ -391,8 +542,16 @@ class DvmHnp(MultiHostLauncher):
                 elif path == "/status":
                     body = json.dumps(hnp._status_doc()).encode()
                     ctype = "application/json"
+                elif path == "/doctor":
+                    # on-demand cross-rank hang capture + verdict (a
+                    # live TAG_DOCTOR round while a job runs; blocking
+                    # a handler thread for the collection window is
+                    # fine — the server is threading)
+                    body = json.dumps(
+                        hnp._doctor_doc("scrape")).encode()
+                    ctype = "application/json"
                 elif path == "/":
-                    body = b"ompi_tpu dvm: /metrics /status\n"
+                    body = b"ompi_tpu dvm: /metrics /status /doctor\n"
                     ctype = "text/plain"
                 else:
                     self.send_error(404)
@@ -412,6 +571,10 @@ class DvmHnp(MultiHostLauncher):
         self.metrics_uri = f"http://127.0.0.1:{bound}"
         threading.Thread(target=self._http.serve_forever,
                          name="dvm-metrics-http", daemon=True).start()
+        # the hang-doctor watchdog rides the observability plane: a
+        # pushed stuck event auto-triggers a cross-rank capture
+        threading.Thread(target=self._doctor_watch,
+                         name="dvm-doctor-watch", daemon=True).start()
         # --metrics-port 0 binds an ephemeral port: record the actual
         # address where clients (tests, dashboards) can find it
         try:

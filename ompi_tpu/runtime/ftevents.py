@@ -24,9 +24,12 @@ from typing import Any, Optional
 
 __all__ = ["FtEventLog", "log", "record", "KINDS"]
 
-#: the event vocabulary — the ladder rungs plus the containment plane
+#: the event vocabulary — the ladder rungs, the containment plane, and
+#: the hang-doctor plane ("stuck" = a rank's watchdog crossed
+#: coll_stuck_timeout; "doctor" = a cross-rank capture produced a
+#: verdict)
 KINDS = ("detect", "reap", "revive", "shrink", "escalate", "abort",
-         "daemon_lost", "reparent", "finished")
+         "daemon_lost", "reparent", "finished", "stuck", "doctor")
 
 
 class FtEventLog:

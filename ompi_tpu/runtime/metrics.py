@@ -68,6 +68,7 @@ AGG_METRICS = (
     "ft_fenced_frames_total",
     "errmgr_selfheal_revives_total",
     "errmgr_selfheal_escalations_total",
+    "coll_stuck_events_total",
 )
 
 #: the per-job aggregated-HISTOGRAM name family: latency histograms the
@@ -398,6 +399,22 @@ class MetricsAggregate:
         /status render wants — snapshot() deep-copies everything)."""
         with self._lock:
             return list(self._jobs)
+
+    def rank_values(self, jobid: int,
+                    names: tuple) -> dict[int, dict[str, float]]:
+        """Per-rank current values of the named scalar metrics for one
+        job — the pushed recorder head (``coll_cur_*``) the --dvm-ps
+        last_coll column and the doctor's no-response fallback read.
+        One table scan under the lock; vectors are skipped."""
+        out: dict[int, dict[str, float]] = {}
+        with self._lock:
+            ranks = self._jobs.get(int(jobid), {})
+            for rank, row in ranks.items():
+                vals = {n: row[1][n] for n in names
+                        if n in row[1] and not _is_vec(row[1][n])}
+                if vals:
+                    out[int(rank)] = vals
+        return out
 
     def ages(self, jobid: int,
              now: Optional[float] = None) -> dict[int, float]:

@@ -101,6 +101,7 @@ class Orted:
         self.node.register_recv(rml.TAG_STDIN, self._on_stdin)
         self.node.register_recv(rml.TAG_RESPAWN, self._on_respawn)
         self.node.register_recv(rml.TAG_STATS, self._on_stats)
+        self.node.register_recv(rml.TAG_DOCTOR, self._on_doctor)
         self.node.register_recv(rml.TAG_PROC_FAILED, self._on_proc_failed)
         self.node.register_recv(rml.TAG_REPARENT, self._on_reparent)
         self.node.register_recv(rml.TAG_ADOPT, self._on_adopt)
@@ -456,6 +457,45 @@ class Orted:
             # from an earlier round cannot satisfy a newer collection
             self.node.send_up(rml.TAG_STATS_REPLY,
                               (self.vpid, payload, rows))
+        except ConnectionError:
+            pass
+
+    def _on_doctor(self, origin: int, payload) -> None:
+        """Hang-doctor capture fan-out: query each LIVE local rank's
+        responder (UDP, loopback — ranks share this host), fall back to
+        a /proc probe for a rank that stays silent (a SIGSTOP'd pid
+        cannot answer; its frozen state IS the evidence), reply the
+        captures up the tree.  The UDP waits block up to ~1s per silent
+        rank — handed off a thread, never run on the RML reader."""
+        threading.Thread(target=self._doctor_capture, args=(payload,),
+                         name=f"orted-doctor-{self.vpid}",
+                         daemon=True).start()
+
+    def _doctor_capture(self, epoch) -> None:
+        from ompi_tpu.runtime import doctor
+
+        with self._lock:
+            procs = [(r, p) for r, p in self._popen.items()
+                     if p.poll() is None]
+            spec = self._spec
+        ports: dict[int, int] = {}
+        uri = ((spec or {}).get("env") or {}).get(pmix.ENV_URI)
+        if uri and procs:
+            ports = pmix.query_doctor_ports(uri) or {}
+        rows = []
+        for rank, p in sorted(procs):
+            cap = None
+            port = ports.get(rank)
+            if port:
+                cap = doctor.query_rank(port)
+            if cap is None:
+                cap = {"rank": rank, "no_response": True,
+                       "proc": doctor.proc_probe(p.pid)}
+            cap["pid"] = p.pid
+            rows.append(cap)
+        try:
+            self.node.send_up(rml.TAG_DOCTOR_REPLY,
+                              (self.vpid, epoch, rows))
         except ConnectionError:
             pass
 

@@ -26,7 +26,7 @@ from ompi_tpu.core import dss, output
 from ompi_tpu.core.config import VarType, register_var, var_registry
 
 __all__ = ["PMIxServer", "PMIxClient", "PMIxError", "query_regcount",
-           "query_regstate"]
+           "query_regstate", "query_doctor_ports"]
 
 _log = output.get_stream("pmix")
 
@@ -118,6 +118,9 @@ class PMIxServer:
         # report escape below closes for it and a late stale report
         # (partitioned reporter, cached dead-life pid probe) can no
         # longer SIGKILL a long-healthy revived rank
+        self._doctor_ports: dict[int, int] = {}  # rank → hang-doctor
+        # responder UDP port (current life only; a revive drops it until
+        # the new life re-registers)
         self._aborted: Optional[tuple[int, int, str]] = None
         self._listener = socket.create_server((host, 0))
         self._port = self._listener.getsockname()[1]
@@ -335,6 +338,18 @@ class PMIxServer:
             with self._cv:
                 return ("ok", sorted(self._dead),
                         dict(self._failed_reasons))
+        if cmd == "doctor":
+            # hang-doctor responder registration: the rank's capture
+            # endpoint (UDP port, loopback on the rank's host) — the
+            # owning orted resolves it through "doctor_ports" when a
+            # TAG_DOCTOR capture fans out
+            rank, port = int(args[0]), int(args[1])
+            with self._cv:
+                self._doctor_ports[rank] = port
+            return ("ok",)
+        if cmd == "doctor_ports":
+            with self._cv:
+                return ("ok", dict(self._doctor_ports))
         if cmd == "fin":
             return ("ok",)
         raise PMIxError(f"unknown command {cmd!r}")
@@ -385,6 +400,9 @@ class PMIxServer:
             # the boot-wedge escape measures from this revive
             self._registered.discard(rank)
             self._ready.discard(rank)
+            # the dead life's doctor endpoint is a stale port — a
+            # capture must not read a stranger's socket
+            self._doctor_ports.pop(rank, None)
             self._revived_at[rank] = time.monotonic()
             self._cv.notify_all()
 
@@ -408,30 +426,42 @@ class PMIxServer:
             pass
 
 
-def query_regstate(uri: str, timeout: float = 2.0
-                   ) -> Optional[tuple[int, int, int]]:
-    """One-shot, registration-free probe of the server's readiness
-    state → ``(ranks_registered, fence_epochs_done, ranks_ready)``: a
-    transient connection that does NOT send "reg" (the caller — an
-    orted's chaos arm, a readiness script — is not a rank and must not
-    inflate the barrier it is watching).  None when the server is
-    unreachable."""
+def _oneshot_query(uri: str, cmd: str,
+                   timeout: float) -> Optional[tuple]:
+    """One transient connection, one command, one "ok" reply — the
+    shared skeleton of every registration-free probe (a non-rank caller
+    must NOT send "reg": it would inflate the very barrier it watches).
+    None when the server is unreachable or the reply is not ok."""
     host, port = uri.removeprefix("tcp://").rsplit(":", 1)
     try:
         with socket.create_connection((host, int(port)),
                                       timeout=timeout) as sock:
             sock.settimeout(timeout)
-            _send_frame(sock, dss.pack(("regcount",)))
+            _send_frame(sock, dss.pack((cmd,)))
             payload = _recv_frame(sock)
         if payload is None:
             return None
         reply = dss.unpack(payload, n=1)[0]
         if reply[0] != "ok":
             return None
-        return (int(reply[1]),
-                int(reply[2]) if len(reply) > 2 else 0,
-                int(reply[3]) if len(reply) > 3 else 0)
+        return tuple(reply[1:])
     except (OSError, ValueError, IndexError):
+        return None
+
+
+def query_regstate(uri: str, timeout: float = 2.0
+                   ) -> Optional[tuple[int, int, int]]:
+    """One-shot, registration-free probe of the server's readiness
+    state → ``(ranks_registered, fence_epochs_done, ranks_ready)``.
+    None when the server is unreachable."""
+    reply = _oneshot_query(uri, "regcount", timeout)
+    if reply is None or not reply:
+        return None
+    try:
+        return (int(reply[0]),
+                int(reply[1]) if len(reply) > 1 else 0,
+                int(reply[2]) if len(reply) > 2 else 0)
+    except (TypeError, ValueError):
         return None
 
 
@@ -439,6 +469,21 @@ def query_regcount(uri: str, timeout: float = 2.0) -> Optional[int]:
     """The ranks-registered half of :func:`query_regstate`."""
     state = query_regstate(uri, timeout=timeout)
     return None if state is None else state[0]
+
+
+def query_doctor_ports(uri: str,
+                       timeout: float = 2.0) -> Optional[dict[int, int]]:
+    """One-shot, registration-free probe of the registered hang-doctor
+    responder ports → {rank: udp_port} (the orted's TAG_DOCTOR handler
+    resolves its local ranks through this).  None when the server is
+    unreachable."""
+    reply = _oneshot_query(uri, "doctor_ports", timeout)
+    if reply is None or not reply:
+        return None
+    try:
+        return {int(r): int(p) for r, p in dict(reply[0]).items()}
+    except (TypeError, ValueError):
+        return None
 
 
 class PMIxClient:
@@ -530,6 +575,18 @@ class PMIxClient:
         reply = self._rpc("report_failed", self.rank, int(failed_rank),
                           reason, int(incarnation))
         return reply[1] if len(reply) > 1 else None
+
+    def register_doctor(self, port: int) -> None:
+        """Register this rank's hang-doctor responder UDP port with the
+        control plane (the owning orted queries it on TAG_DOCTOR)."""
+        self._rpc("doctor", self.rank, int(port))
+
+    def doctor_ports(self) -> dict[int, int]:
+        """Every registered hang-doctor responder port by rank (the
+        registration-free probe non-rank callers must use is
+        :func:`query_doctor_ports`)."""
+        return {int(r): int(p)
+                for r, p in dict(self._rpc("doctor_ports")[1]).items()}
 
     def peer_adopted(self, rank: int, incarnation: int) -> None:
         """Tell the control plane this process adopted ``rank``'s new

@@ -83,6 +83,13 @@ TAG_REPARENT_ACK = "reparent_ack"  # up: (vpid, new_parent) — re-wired
 TAG_KILL_RANK = "kill_rank"     # xcast: rank — the owning daemon SIGKILLs
 #                                 exactly that rank (reaping a hung pid
 #                                 the gossip detector reported)
+TAG_DOCTOR = "doctor"           # xcast: epoch — every orted captures its
+#                                 local ranks' hang-doctor state (UDP
+#                                 query of each rank's responder; /proc
+#                                 probe for frozen pids) and replies up
+TAG_DOCTOR_REPLY = "doctor_reply"  # up: (vpid, epoch, [capture, ...]) —
+#                                 the per-rank doctor captures the
+#                                 HNP/DVM analyzer folds into a verdict
 TAG_METRICS = "metrics"         # hop (one tree level, delivered at EVERY
 #                                 hop, not send_up's root-only relay):
 #                                 {jobid: {rank: [wall_ts, {pvar: value}]}}

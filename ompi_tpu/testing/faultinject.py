@@ -18,6 +18,19 @@ Plan grammar (entries separated by ``;``)::
                               crash loop that proves the errmgr revive
                               budget/escalation ladder (kill and hang
                               are first-life-only by design)
+    rank=2:stall@coll=5       rank 2 stalls INSIDE its 5th recorded
+                              collective (counted by the flight
+                              recorder's dispatch ordinal, 0-based):
+                              SIGSTOP by default, a cooperative spin
+                              with faultinject_hang_mode=spin — the
+                              deterministic straggler the hang doctor
+                              must name
+    rank=1:mismatch@coll=5    rank 1 dispatches a DIVERGENT collective
+                              kind at ordinal 5 (recorded at the same
+                              (cid, op_seq) its peers run the real op),
+                              then spin-parks so it stays capturable —
+                              the deterministic collective mismatch
+                              behind the doctor's mismatch verdict
     daemon=1:kill@t=1.0       orted vpid 1 SIGKILLs itself after 1 s
     daemon=1:kill@reg=4:after=1.5
                               orted vpid 1 SIGKILLs itself 1.5 s after
@@ -116,7 +129,7 @@ class _Action:
     """One parsed plan entry."""
 
     __slots__ = ("kind", "rank", "prob", "scope", "delay_ms", "at_step",
-                 "at_time", "at_reg", "after", "vpid")
+                 "at_time", "at_reg", "at_coll", "after", "vpid")
 
     def __init__(self) -> None:
         self.kind = ""            # kill | daemon_kill | drop | delay | dup
@@ -128,6 +141,8 @@ class _Action:
         self.at_step: Optional[int] = None
         self.at_time: Optional[float] = None
         self.at_reg: Optional[int] = None   # ranks-registered barrier
+        self.at_coll: Optional[int] = None  # flight-recorder dispatch
+        # ordinal (stall/mismatch fire inside that collective)
         self.after = 1.0          # grace after the @reg barrier clears
 
 
@@ -144,13 +159,14 @@ def _parse_entry(entry: str) -> _Action:
             act.rank = int(val)
         elif key == "daemon":
             act.vpid = int(val)
-        elif (key in ("kill", "hang", "crash")
-              or key.startswith(("kill@", "hang@", "crash@"))):
+        elif (key in ("kill", "hang", "crash", "stall", "mismatch")
+              or key.startswith(("kill@", "hang@", "crash@", "stall@",
+                                 "mismatch@"))):
             base = key.partition("@")[0]
             act.kind = ("daemon_kill" if act.vpid is not None
                         and base == "kill" else base)
             # kill@step=N / kill@t=SEC arrive as key "kill@step"/"kill@t"
-            # (same for hang@ / crash@)
+            # (same for hang@ / crash@ / stall@ / mismatch@)
             trig = key.partition("@")[2]
             if trig == "step":
                 act.at_step = int(val)
@@ -158,10 +174,13 @@ def _parse_entry(entry: str) -> _Action:
                 act.at_time = float(val)
             elif trig == "reg":
                 act.at_reg = int(val)
+            elif trig == "coll":
+                act.at_coll = int(val)
             else:
                 raise ValueError(
                     f"{base} needs a trigger: {base}@step=N, "
-                    f"{base}@t=SEC or {base}@reg=NRANKS (got {part!r})")
+                    f"{base}@t=SEC, {base}@reg=NRANKS or "
+                    f"{base}@coll=N (got {part!r})")
         elif key == "after":
             act.after = float(val)
         elif key in ("drop", "dup"):
@@ -187,9 +206,20 @@ def _parse_entry(entry: str) -> _Action:
     # per-field checks can be sidestepped): hangs target ranks only —
     # a hung DAEMON is the heartbeat layer's job, and a daemon= field
     # anywhere in a hang entry is a contradiction, not a default
-    if act.kind in ("hang", "crash") and act.vpid is not None:
+    if act.kind in ("hang", "crash", "stall", "mismatch") \
+            and act.vpid is not None:
         raise ValueError(
             f"{act.kind} targets ranks, not daemons (entry {entry!r})")
+    # the collective triggers fire from inside the coll dispatch choke
+    # point — the @coll ordinal is their ONLY trigger (a wall-clock
+    # stall would not be deterministic against the recorder's seq), and
+    # @coll makes no sense for the process-level kill kinds
+    if act.kind in ("stall", "mismatch") and act.at_coll is None:
+        raise ValueError(
+            f"{act.kind} needs an @coll=N trigger (entry {entry!r})")
+    if act.at_coll is not None and act.kind not in ("stall", "mismatch"):
+        raise ValueError(
+            f"@coll triggers are stall/mismatch only (entry {entry!r})")
     # a kill that saw daemon= before the kill key is a daemon_kill; one
     # that saw it after must settle to the same action
     if act.kind == "kill" and act.vpid is not None:
@@ -245,6 +275,16 @@ class Injector:
         self._kills = [a for a in self._acts
                        if a.kind == "crash"
                        or (a.kind in ("kill", "hang") and not restarted)]
+        # collective-choke-point triggers (stall/mismatch@coll=N), first
+        # life only like kills/hangs — a revived victim must not re-wedge
+        self._colls = [a for a in self._acts
+                       if a.kind in ("stall", "mismatch")
+                       and not restarted]
+        # the @coll ordinal: TOP-LEVEL dispatched collectives of this
+        # life (the dispatcher skips nested composed sub-collectives —
+        # firing inside e.g. the init barrier's internal allgather would
+        # wedge peers mid-arena-build, outside every timeout)
+        self._coll_n = 0
         self._step = 0
         self._lock = threading.Lock()
         self.events: list[dict] = []
@@ -312,6 +352,53 @@ class Injector:
         """Separated so tests can observe the trigger without actually
         freezing the test process."""
         if var_registry.get("faultinject_hang_mode") == "spin":
+            while True:            # cooperative: only this thread parks
+                time.sleep(3600)
+        import signal
+
+        os.kill(os.getpid(), signal.SIGSTOP)
+
+    # -- collective triggers (coll dispatch choke-point hook) --------------
+
+    def coll_faults(self) -> bool:
+        """Any armed stall/mismatch@coll actions?  The coll dispatcher
+        caches this so a plan without collective triggers costs one
+        dict hit per dispatch."""
+        return bool(self._colls)
+
+    def coll_op(self) -> tuple[Optional[str], int]:
+        """Advance the top-level collective ordinal (called once per
+        top-level dispatch) → (armed action | None, the ordinal just
+        entered).  :meth:`fire_coll` fires the returned action."""
+        n = self._coll_n
+        self._coll_n += 1
+        if self._dead:
+            return None, n
+        for a in self._colls:
+            if a.at_coll == n:
+                return a.kind, n
+        return None, n
+
+    def fire_coll(self, kind: str, n: int, seq: int) -> None:
+        """Fire a collective trigger from inside the dispatch: record
+        the fault, then park.  ``stall`` follows faultinject_hang_mode
+        (SIGSTOP / spin); ``mismatch`` ALWAYS spin-parks — the divergent
+        rank must stay capturable so the doctor can read its recorder
+        tail with the divergent (cid, op_seq) record."""
+        if self._dead:
+            return
+        self._dead = True
+        mode = ("spin" if kind == "mismatch"
+                else var_registry.get("faultinject_hang_mode"))
+        self._record(kind, trigger="coll", value=n, seq=seq, mode=mode)
+        _log.emit("faultinject: rank %d injected %s (coll=%s, op_seq %s)",
+                  self.rank, kind, n, seq)
+        _dump_events_now()
+        import sys
+
+        sys.stdout.flush()
+        sys.stderr.flush()
+        if mode == "spin":
             while True:            # cooperative: only this thread parks
                 time.sleep(3600)
         import signal
@@ -508,3 +595,11 @@ def reset() -> None:
     with _lock:
         _injectors.clear()
         _parsed = None
+    # the coll dispatcher caches its per-rank injector resolution —
+    # a re-armed plan must be re-resolved, not read through stale Nones
+    try:
+        from ompi_tpu.mpi import coll as _coll
+
+        _coll._fi_cache.clear()
+    except Exception:  # noqa: BLE001 — tests without the coll layer
+        pass

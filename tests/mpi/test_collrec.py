@@ -1,0 +1,319 @@
+"""Collective flight recorder: ring semantics, (cid, op_seq) streams,
+dispatch/nbc/persistent record sites, signature determinism, the pushed
+head gauges, the stuck watchdog, and the injected @coll triggers."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from ompi_tpu.core.config import var_registry
+from ompi_tpu.mpi import trace
+from ompi_tpu.mpi.mpit import pvar_registry
+from ompi_tpu.testing import faultinject
+from tests.mpi.harness import run_ranks
+
+
+@pytest.fixture(autouse=True)
+def _fresh_recorder():
+    trace.collrec.reset()
+    yield
+    trace.collrec.reset()
+    faultinject.reset()
+
+
+# ---------------------------------------------------------------------------
+# ring + bookkeeping
+# ---------------------------------------------------------------------------
+
+def test_ring_wraps_oldest_first():
+    rec = trace.CollRecorder(capacity=64)
+    for i in range(200):
+        rec.post(0, 0, "barrier", 1, "shm", 0)
+    assert rec.records_total == 200
+    snap = rec.snapshot()
+    assert len(snap) == 64
+    assert snap[0][3] == 136 and snap[-1][3] == 199   # op_seq order kept
+
+
+def test_seq_streams_are_per_rank_and_cid():
+    rec = trace.CollRecorder()
+    assert rec.post(0, 0, "barrier", 1, "shm", 0) == 0
+    assert rec.post(0, 0, "bcast", 1, "shm", 8) == 1
+    assert rec.post(0, 5, "bcast", 1, "shm", 8) == 0   # new cid stream
+    assert rec.post(1, 0, "barrier", 1, "shm", 0) == 0  # new rank stream
+    assert rec.ops_total == 4
+
+
+def test_post_done_clears_current_and_marks_head():
+    rec = trace.CollRecorder()
+    seq = rec.post(0, 0, "allreduce", 7, "shm", 64)
+    assert rec.current[(0, 0)][-1][0] == seq
+    assert rec.head[5] == 0
+    rec.done(0, 0, seq, "allreduce")
+    assert (0, 0) not in rec.current
+    assert rec.head[5] == 1
+
+
+def test_nested_dispatch_keeps_parent_attribution():
+    """Composed collectives (shm barrier → host allgather) nest through
+    the choke point: a nested done re-exposes the parent as the
+    in-flight head instead of reading the outer op as completed."""
+    rec = trace.CollRecorder()
+    outer = rec.post(0, 0, "barrier", 1, "shm", 0)
+    inner = rec.post(0, 0, "allgather", 2, "host", 24)
+    rec.done(0, 0, inner, "allgather")
+    assert rec.head[2] == outer and rec.head[5] == 0
+    assert rec.event(0, 0, "wait")[0] == outer
+    rec.done(0, 0, outer, "barrier")
+    assert rec.head[5] == 1 and (0, 0) not in rec.current
+
+
+def test_event_attributes_to_inflight_op():
+    rec = trace.CollRecorder()
+    seq = rec.post(0, 0, "allreduce", 7, "shm", 64)
+    got_seq, got_kind = rec.event(0, 0, "wait", {"on": 2})
+    assert (got_seq, got_kind) == (seq, "allreduce")
+    last = rec.snapshot()[-1]
+    assert last[5] == "wait" and last[7] == {"on": 2}
+
+
+def test_err_records_exception_name():
+    rec = trace.CollRecorder()
+    seq = rec.post(0, 0, "reduce", 7, "host", 64)
+    rec.err(0, 0, seq, "reduce", "MPIException")
+    last = rec.snapshot()[-1]
+    assert last[5] == "err" and last[7] == {"exc": "MPIException"}
+    assert (0, 0) not in rec.current
+
+
+def test_tail_is_wire_safe_lists():
+    rec = trace.CollRecorder()
+    rec.post(0, 0, "barrier", 1, "shm", 0)
+    tail = rec.tail(10)
+    assert isinstance(tail[0], list) and tail[0][4] == "barrier"
+
+
+# ---------------------------------------------------------------------------
+# signature + kind table
+# ---------------------------------------------------------------------------
+
+def test_sig_is_deterministic_and_shape_sensitive():
+    a = trace.collrec_sig("allreduce", np.dtype("f8"), 64)
+    assert a == trace.collrec_sig("allreduce", np.dtype("f8"), 64)
+    assert a != trace.collrec_sig("allreduce", np.dtype("f4"), 64)
+    assert a != trace.collrec_sig("allreduce", np.dtype("f8"), 128)
+    assert a != trace.collrec_sig("bcast", np.dtype("f8"), 64)
+
+
+def test_kind_ids_round_trip():
+    for kind in ("barrier", "allreduce", "iallreduce", "pallreduce"):
+        kid = trace.collrec_kind_id(kind)
+        assert kid >= 0
+        assert trace.collrec_kind_name(kid) == kind
+    assert trace.collrec_kind_id("nope") == -1
+    assert trace.collrec_kind_name(-1) == "?"
+
+
+# ---------------------------------------------------------------------------
+# record sites (dispatch / nbc / persistent / arena waits)
+# ---------------------------------------------------------------------------
+
+def _rank_records(rank):
+    return [r for r in trace.collrec.snapshot() if r[1] == rank]
+
+
+def test_dispatch_records_post_done_across_ranks():
+    def body(comm):
+        comm.barrier()
+        comm.allreduce(np.ones(8))
+        return comm.rank
+
+    run_ranks(2, body)
+    for rank in (0, 1):
+        recs = _rank_records(rank)
+        posts = [(r[2], r[3], r[4]) for r in recs if r[5] == "post"]
+        dones = [(r[2], r[3], r[4]) for r in recs if r[5] == "done"]
+        assert posts and posts[0][2] == "barrier"
+        # every post completed
+        assert {(c, s) for c, s, _k in posts} == \
+            {(c, s) for c, s, _k in dones}
+    # the cross-rank matching invariant: identical (cid, seq) → kind
+    p0 = {(r[2], r[3]): (r[4], r[6]) for r in _rank_records(0)
+          if r[5] == "post"}
+    p1 = {(r[2], r[3]): (r[4], r[6]) for r in _rank_records(1)
+          if r[5] == "post"}
+    assert p0 == p1
+
+
+def _two_arenas(tmp_path):
+    import uuid
+
+    from ompi_tpu.core import shmseg
+    from ompi_tpu.mpi.coll.shm import Arena
+
+    name = f"otpu-collrec-{uuid.uuid4().hex[:8]}"
+    seg0 = shmseg.create(name, Arena.nbytes_for(2, 4096))
+    seg1 = shmseg.attach(seg0.path)
+    seg0.unlink()
+    a0 = Arena(seg0, 2, 0, 4096, world=[0, 1])
+    a1 = Arena(seg1, 2, 1, 4096, world=[0, 1])
+    return a0, a1
+
+
+def test_arena_wait_records_name_the_laggard(tmp_path):
+    import threading
+
+    a0, a1 = _two_arenas(tmp_path)
+    try:
+        def late():
+            time.sleep(0.3)
+            a1._set_arrive(1)
+
+        t = threading.Thread(target=late, daemon=True)
+        t.start()
+        a0._set_arrive(1)
+        a0._wait_all_arrive(1, None)   # parks on rank 1's store
+        t.join()
+    finally:
+        a0.close()
+        a1.close()
+    waits = [r for r in _rank_records(0) if r[5] == "wait"]
+    assert waits, "no wait record on the early arriver"
+    assert any((r[7] or {}).get("on") == 1 for r in waits)
+
+
+def test_nbc_records_rounds_and_done():
+    def body(comm):
+        req = comm.iallreduce(np.ones(4))
+        req.wait()
+        return comm.rank
+
+    run_ranks(2, body)
+    recs = _rank_records(0)
+    assert any(r[4] == "iallreduce" and r[5] == "post" for r in recs)
+    assert any(r[4] == "iallreduce" and r[5] == "round" for r in recs)
+    assert any(r[4] == "iallreduce" and r[5] == "done" for r in recs)
+
+
+def test_persistent_start_records_pstarts():
+    def body(comm):
+        req = comm.allreduce_init(np.ones(8))
+        for _ in range(3):
+            req.start()
+            req.wait()
+        req.free()
+        return comm.rank
+
+    run_ranks(2, body)
+    recs = _rank_records(0)
+    starts = [r for r in recs
+              if r[4] == "pallreduce" and r[5] == "post"]
+    dones = [r for r in recs if r[4] == "pallreduce" and r[5] == "done"]
+    assert len(starts) == 3 and len(dones) == 3
+
+
+def test_stuck_watchdog_records_and_counts(tmp_path):
+    import threading
+
+    before = trace.counters["coll_stuck_events_total"]
+    a0, a1 = _two_arenas(tmp_path)
+    var_registry.set("coll_stuck_timeout", 0.1)
+    try:
+        def late():
+            time.sleep(0.6)
+            a1._set_arrive(1)
+
+        t = threading.Thread(target=late, daemon=True)
+        t.start()
+        a0._set_arrive(1)
+        a0._wait_arrive(1, 1, None)   # stalls past the stuck timeout
+        t.join()
+    finally:
+        var_registry.set("coll_stuck_timeout", 5.0)
+        a0.close()
+        a1.close()
+    assert trace.counters["coll_stuck_events_total"] > before
+    stucks = [r for r in _rank_records(0) if r[5] == "stuck"]
+    assert stucks and (stucks[0][7] or {}).get("on") == 1
+
+
+# ---------------------------------------------------------------------------
+# pushed head gauges
+# ---------------------------------------------------------------------------
+
+def test_head_gauges_ride_the_pvar_registry():
+    def body(comm):
+        comm.allreduce(np.ones(8))
+        return comm.rank
+
+    run_ranks(2, body)
+    vals = trace.metrics_values()
+    assert vals["coll_cur_seq"] >= 0
+    assert trace.collrec_kind_name(int(vals["coll_cur_kind_id"])) in \
+        trace.COLLREC_KINDS
+    assert vals["coll_cur_done"] == 1
+    assert pvar_registry.lookup("coll_recorder_ops").read() == \
+        trace.collrec.ops_total
+
+
+def test_flush_embeds_collrec_tail_and_validates(tmp_path):
+    """Crash/finalize dumps carry the recorder tail (otherData.collrec)
+    — the postmortem doctor's input — and the merged Chrome trace still
+    validates with it aboard."""
+    import json
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parents[2]
+                           / "tools"))
+    import trace_export
+
+    trace.collrec.post(0, 0, "allreduce", 42, "shm", 64)
+    trace.enable(capacity=64, rank=0, jobid=5)
+    trace.instant("runtime", "x", rank=0)
+    path = trace.flush(str(tmp_path / "ompi_tpu_trace_5_rank0.json"))
+    trace.disable()
+    doc = json.load(open(path))
+    tail = doc["otherData"]["collrec"]
+    assert tail and tail[-1][4] == "allreduce" and tail[-1][5] == "post"
+    merged = trace_export.merge([path])
+    assert trace_export.validate(merged) == []
+    assert merged["otherData"]["per_rank"]["0"]["collrec"] == tail
+
+
+# ---------------------------------------------------------------------------
+# injected @coll triggers (the mismatch record path; the park itself is
+# proven by chaos_soak's coll-hang class and the CI obs-smoke job)
+# ---------------------------------------------------------------------------
+
+def test_mismatch_trigger_records_divergent_kind(monkeypatch):
+    class _Fired(BaseException):
+        pass
+
+    var_registry.set("faultinject_plan", "rank=0:mismatch@coll=0")
+    faultinject.reset()
+
+    def no_park(self, kind, n, seq):
+        self._record(kind, trigger="coll", value=n, seq=seq)
+        raise _Fired()
+
+    monkeypatch.setattr(faultinject.Injector, "fire_coll", no_park)
+    try:
+        def body(comm):
+            comm.barrier()
+            return comm.rank
+
+        with pytest.raises(AssertionError):
+            run_ranks(1, body)   # the harness surfaces the rank's park
+        evs = faultinject.events(0)   # read BEFORE reset clears them
+    finally:
+        var_registry.set("faultinject_plan", "")
+        faultinject.reset()
+    posts = [r for r in trace.collrec.snapshot() if r[5] == "post"]
+    # the app asked for barrier; the injected divergence recorded bcast
+    assert posts and posts[0][4] == "bcast"
+    assert evs and evs[0]["kind"] == "mismatch" \
+        and evs[0]["trigger"] == "coll"

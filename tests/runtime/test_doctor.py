@@ -1,0 +1,323 @@
+"""Hang doctor: analyzer verdicts (mismatch / deadlock / straggler),
+the rank-side responder + capture, the PMIx doctor-port registry, and
+the offline (crash-dump) mode of tools/hang_doctor.py."""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+from ompi_tpu.runtime import doctor, pmix
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2] / "tools"))
+
+import hang_doctor  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# synthetic-capture helpers
+# ---------------------------------------------------------------------------
+
+def _cap(rank, posts=(), waits=(), dones=(), cur=None, pending=None,
+         **extra):
+    """One synthetic capture: posts/waits/dones are (cid, seq, kind,
+    sig|on) tuples appended in order."""
+    t = [1000]
+
+    def rec(cid, seq, kind, phase, sig=0, info=None):
+        t[0] += 1
+        return [t[0], rank, cid, seq, kind, phase, sig, info]
+
+    recs = []
+    for cid, seq, kind, sig in posts:
+        recs.append(rec(cid, seq, kind, "post", sig,
+                        {"prov": "shm", "nb": 0}))
+    for cid, seq, kind, on in waits:
+        recs.append(rec(cid, seq, kind, "wait", 0, {"on": on}))
+    for cid, seq, kind in dones:
+        recs.append(rec(cid, seq, kind, "done"))
+    cap = {"rank": rank, "collrec": recs}
+    if cur is not None:
+        cap["cur"] = cur
+    if pending is not None:
+        cap["pending"] = pending
+    cap.update(extra)
+    return cap
+
+
+def _inflight(cid, seq, kind):
+    return {"cid": cid, "seq": seq, "kind": kind, "done": False,
+            "age_s": 3.0}
+
+
+# ---------------------------------------------------------------------------
+# analyzer verdicts
+# ---------------------------------------------------------------------------
+
+def test_analyze_no_data():
+    assert doctor.analyze([])["verdict"]["kind"] == "no_data"
+
+
+def test_analyze_healthy_when_everything_completed():
+    caps = [_cap(r, posts=[(0, 0, "barrier", 5)],
+                 dones=[(0, 0, "barrier")],
+                 cur={"cid": 0, "seq": 0, "kind": "barrier",
+                      "done": True}) for r in range(2)]
+    assert doctor.analyze(caps)["verdict"]["kind"] == "healthy"
+
+
+def test_analyze_mismatch_divergent_kinds():
+    """The MUST-class error: rank 1 dispatched bcast where everyone
+    else ran allreduce at the same (cid, op_seq)."""
+    caps = [
+        _cap(0, posts=[(0, 4, "allreduce", 99)],
+             cur=_inflight(0, 4, "allreduce")),
+        _cap(1, posts=[(0, 4, "bcast", 12)],
+             cur=_inflight(0, 4, "bcast")),
+        _cap(2, posts=[(0, 4, "allreduce", 99)],
+             cur=_inflight(0, 4, "allreduce")),
+    ]
+    v = doctor.analyze(caps, nranks=3)["verdict"]
+    assert v["kind"] == "mismatch"
+    assert v["rank"] == 1 and v["ranks"] == [1]
+    assert (v["cid"], v["op_seq"]) == (0, 4)
+    assert v["kinds"] == {"0": "allreduce", "1": "bcast",
+                          "2": "allreduce"}
+
+
+def test_analyze_mismatch_divergent_signature_on_uniform_kind():
+    caps = [
+        _cap(0, posts=[(0, 2, "allreduce", 111)]),
+        _cap(1, posts=[(0, 2, "allreduce", 222)]),
+        _cap(2, posts=[(0, 2, "allreduce", 111)]),
+    ]
+    v = doctor.analyze(caps)["verdict"]
+    assert v["kind"] == "mismatch" and "signature" in v["detail"]
+    # the MINORITY-signature holder is the named culprit, not rank 0
+    assert v["rank"] == 1 and v["ranks"] == [1]
+
+
+def test_analyze_tolerates_divergent_sig_on_v_collectives():
+    """gatherv legitimately passes per-rank counts — sig divergence
+    alone must not convict it."""
+    caps = [
+        _cap(0, posts=[(0, 2, "gatherv", 111)],
+             dones=[(0, 2, "gatherv")]),
+        _cap(1, posts=[(0, 2, "gatherv", 222)],
+             dones=[(0, 2, "gatherv")]),
+    ]
+    assert doctor.analyze(caps)["verdict"]["kind"] == "healthy"
+
+
+def test_analyze_deadlock_cycle_from_pending_recvs():
+    pend = lambda src: {"recvs": [{"src": src, "tag": 7, "cid": 0,
+                                   "age_s": 2.5}],
+                        "sends": [], "rndv": [], "unexpected": 0,
+                        "parked": {}, "queued": {}}
+    caps = [_cap(0, pending=pend(1)), _cap(1, pending=pend(0))]
+    v = doctor.analyze(caps)["verdict"]
+    assert v["kind"] == "deadlock"
+    cyc = v["cycle"]
+    assert cyc[0] == cyc[-1] and set(cyc) == {0, 1}
+
+
+def test_analyze_straggler_from_arena_waits():
+    caps = [
+        _cap(0, posts=[(0, 7, "allreduce", 5)],
+             waits=[(0, 7, "allreduce", 2)],
+             cur=_inflight(0, 7, "allreduce")),
+        _cap(1, posts=[(0, 7, "allreduce", 5)],
+             waits=[(0, 7, "allreduce", 2)],
+             cur=_inflight(0, 7, "allreduce")),
+        _cap(2, posts=[(0, 7, "allreduce", 5)],
+             cur=_inflight(0, 7, "allreduce"),
+             stacks={"MainThread": "  File 'app.py', line 3\n"}),
+    ]
+    v = doctor.analyze(caps, nranks=3)["verdict"]
+    assert v["kind"] == "straggler" and v["rank"] == 2
+    assert v["op_seq"] == 7 and v["in"] == "allreduce"
+    assert "app.py" in v.get("stack", "")
+
+
+def test_analyze_straggler_frozen_pid_wins():
+    """A SIGSTOP'd rank cannot answer: no_response + /proc state T is
+    the strongest straggler evidence, and its last PUSHED recorder head
+    still names the collective it froze in."""
+    from ompi_tpu.mpi import trace as trace_mod
+
+    kid = trace_mod.collrec_kind_id("allreduce")
+    caps = [
+        _cap(0, posts=[(0, 9, "allreduce", 5)],
+             waits=[(0, 9, "allreduce", 1)],
+             cur=_inflight(0, 9, "allreduce")),
+        {"rank": 1, "no_response": True,
+         "proc": {"pid": 1234, "state": "T"},
+         "pushed": {"coll_cur_seq": 9, "coll_cur_cid": 0,
+                    "coll_cur_kind_id": kid, "coll_cur_done": 0,
+                    "coll_cur_posted_ts": time.time() - 4.0}},
+        _cap(2, posts=[(0, 9, "allreduce", 5)],
+             waits=[(0, 9, "allreduce", 1)],
+             cur=_inflight(0, 9, "allreduce")),
+    ]
+    doc = doctor.analyze(caps, nranks=3)
+    v = doc["verdict"]
+    assert v["kind"] == "straggler" and v["rank"] == 1
+    assert "SIGSTOP" in v["detail"]
+    assert v["in"] == "allreduce" and v["op_seq"] == 9
+    assert doc["no_response"] == [1]
+
+
+# ---------------------------------------------------------------------------
+# rank-side responder + capture
+# ---------------------------------------------------------------------------
+
+def test_responder_capture_round_trip():
+    from ompi_tpu.mpi import trace as trace_mod
+
+    trace_mod.collrec.reset()
+    trace_mod.collrec.post(0, 0, "allreduce", 42, "shm", 64)
+    resp = doctor.DoctorResponder(0, jobid=3)
+    try:
+        cap = doctor.query_rank(resp.port, timeout=2.0)
+    finally:
+        resp.close()
+        trace_mod.collrec.reset()
+    assert cap is not None and cap["rank"] == 0 and cap["jobid"] == 3
+    assert cap["cur"]["kind"] == "allreduce" and not cap["cur"]["done"]
+    assert any(r[5] == "post" for r in cap["collrec"])
+    assert "MainThread" in cap["stacks"]
+
+
+def test_capture_includes_pml_pending():
+    from ompi_tpu.mpi.pml import PmlOb1
+
+    pml = PmlOb1(0)
+    try:
+        req = pml.irecv(np.empty(4), source=1, tag=9, cid=0)
+        time.sleep(0.01)
+        cap = doctor.capture(0, pml=pml)
+        pend = cap["pending"]
+        assert any(rv["src"] == 1 and rv["tag"] == 9
+                   for rv in pend["recvs"])
+        assert pend["unexpected"] == 0
+        req.cancel()
+    finally:
+        pml.close()
+
+
+def test_query_rank_silence_returns_none():
+    import socket
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))        # a port that never answers
+    port = s.getsockname()[1]
+    try:
+        assert doctor.query_rank(port, timeout=0.2) is None
+    finally:
+        s.close()
+
+
+def test_proc_probe_reads_own_state():
+    import os
+
+    st = doctor.proc_probe(os.getpid())
+    assert st["pid"] == os.getpid()
+    assert st["state"] in ("R", "S")
+
+
+# ---------------------------------------------------------------------------
+# PMIx doctor-port registry
+# ---------------------------------------------------------------------------
+
+def test_pmix_doctor_port_registration_and_probe():
+    server = pmix.PMIxServer(size=2)
+    try:
+        client = pmix.PMIxClient(uri=server.uri, rank=0, size=2)
+        client.register_doctor(4242)
+        assert client.doctor_ports() == {0: 4242}
+        assert pmix.query_doctor_ports(server.uri) == {0: 4242}
+        # a revive drops the dead life's port until re-registration
+        server.proc_revived(0)
+        assert pmix.query_doctor_ports(server.uri) == {}
+        client.finalize()
+    finally:
+        server.close()
+
+
+# ---------------------------------------------------------------------------
+# offline mode (tools/hang_doctor.py over crash dumps)
+# ---------------------------------------------------------------------------
+
+def _dump(tmp_path, jobid, rank, recs, stuck=0):
+    doc = {"displayTimeUnit": "ns",
+           "otherData": {"rank": rank, "jobid": jobid,
+                         "collrec": recs,
+                         "counters": {"coll_stuck_events_total": stuck}},
+           "traceEvents": []}
+    path = tmp_path / f"ompi_tpu_trace_{jobid}_rank{rank}.json"
+    path.write_text(json.dumps(doc))
+    return path
+
+
+def test_hang_doctor_offline_names_straggler(tmp_path, capsys):
+    # ranks 0/2 wedged at (0, 5) waiting on rank 1; rank 1 posted the
+    # same op but recorded no wait and never completed — the straggler
+    for r in (0, 2):
+        _dump(tmp_path, 7, r, [
+            [100, r, 0, 5, "allreduce", "post", 9, {}],
+            [101, r, 0, 5, "allreduce", "wait", 0, {"on": 1}],
+        ], stuck=1)
+    _dump(tmp_path, 7, 1, [
+        [100, 1, 0, 5, "allreduce", "post", 9, {}],
+    ])
+    doc = hang_doctor.offline_doc(str(tmp_path), 7)
+    assert doc["verdict"]["kind"] == "straggler"
+    assert doc["verdict"]["rank"] == 1
+    # the assertion flag CI drivers use
+    rc = hang_doctor.main(["--dir", str(tmp_path), "--jobid", "7",
+                           "--expect", "straggler:1"])
+    assert rc == 0
+    rc = hang_doctor.main(["--dir", str(tmp_path), "--jobid", "7",
+                           "--expect", "mismatch"])
+    assert rc == 1
+    capsys.readouterr()
+
+
+def test_hang_doctor_offline_outer_op_wedged_after_nested_done(tmp_path):
+    """The first-collective hang shape: the outer composed op wedges
+    while its nested sub-dispatch (posted LATER, completed) is the
+    newest post — the offline head must still pick the unclosed outer
+    op, not call the rank healthy."""
+    for r in (0, 2):
+        _dump(tmp_path, 9, r, [
+            [100, r, 0, 0, "barrier", "post", 7, {}],
+            [101, r, 0, 1, "allgather", "post", 8, {}],
+            [102, r, 0, 1, "allgather", "done", 0, None],
+            [103, r, 0, 0, "barrier", "wait", 0, {"on": 1}],
+        ])
+    _dump(tmp_path, 9, 1, [
+        [100, 1, 0, 0, "barrier", "post", 7, {}],
+        [101, 1, 0, 1, "allgather", "post", 8, {}],
+        [102, 1, 0, 1, "allgather", "done", 0, None],
+    ])
+    doc = hang_doctor.offline_doc(str(tmp_path), 9)
+    v = doc["verdict"]
+    assert v["kind"] == "straggler" and v["rank"] == 1, v
+    assert v["in"] == "barrier" and v["op_seq"] == 0, v
+
+
+def test_hang_doctor_offline_names_mismatch(tmp_path, capsys):
+    _dump(tmp_path, 8, 0, [[100, 0, 0, 3, "allreduce", "post", 9, {}]])
+    _dump(tmp_path, 8, 1, [[100, 1, 0, 3, "bcast", "post", 2, {}]])
+    doc = hang_doctor.offline_doc(str(tmp_path), 8)
+    v = doc["verdict"]
+    assert v["kind"] == "mismatch" and v["rank"] == 1
+    assert (v["cid"], v["op_seq"]) == (0, 3)
+    rc = hang_doctor.main(["--dir", str(tmp_path), "--jobid", "8",
+                           "--expect", "mismatch:1"])
+    assert rc == 0
+    capsys.readouterr()

@@ -147,7 +147,14 @@ def test_dvm_ps_live_job(dvm):
             cur = table.get("current_job")
             if cur and any(p["state"] == "running" for p in cur["procs"]):
                 live = cur
-                break
+                # a poll can land in the spawn window where the HNP
+                # already marked procs RUNNING but the orteds have not
+                # registered the pids yet (their stats reply is empty):
+                # keep polling until a running snapshot carries usage —
+                # the assertion below still fails if it never does
+                if any("rss_mb" in p for p in cur["procs"]
+                       if p["state"] == "running"):
+                    break
             time.sleep(0.3)
         assert live is not None, "never observed a running job via ps"
         assert live["np"] == 2

@@ -266,3 +266,64 @@ def test_crash_fires_in_every_life(monkeypatch):
     assert died == [(0, "crash", "step", 1)]
     evs = [e["kind"] for e in inj.events]
     assert evs == ["crash"]                     # distinct kind in the log
+
+
+# ---------------------------------------------------------------------------
+# collective triggers (stall/mismatch@coll — the hang-doctor chaos arm)
+# ---------------------------------------------------------------------------
+
+def test_stall_mismatch_grammar_parses_coll_trigger():
+    acts = fi.parse_plan("rank=2:stall@coll=5;rank=1:mismatch@coll=3")
+    assert [(a.kind, a.rank, a.at_coll) for a in acts] == \
+        [("stall", 2, 5), ("mismatch", 1, 3)]
+
+
+@pytest.mark.parametrize("bad", [
+    "daemon=1:stall@coll=2",     # collective triggers target ranks
+    "rank=1:stall",              # no trigger
+    "rank=1:stall@step=2",       # @coll is the only stall trigger
+    "rank=1:mismatch@t=1.0",     # same for mismatch
+    "rank=1:kill@coll=2",        # @coll is stall/mismatch only
+])
+def test_stall_mismatch_reject_bad_entries(bad):
+    with pytest.raises(ValueError):
+        fi.parse_plan(bad)
+
+
+def test_coll_op_advances_ordinal_and_fires_by_position():
+    inj = fi.Injector(1, fi.parse_plan("rank=1:stall@coll=2"), seed=0)
+    assert inj.coll_faults()
+    assert inj.coll_op() == (None, 0)
+    assert inj.coll_op() == (None, 1)
+    assert inj.coll_op() == ("stall", 2)
+    other = fi.Injector(2, fi.parse_plan("rank=1:stall@coll=0"), seed=0)
+    assert not other.coll_faults()
+
+
+def test_coll_triggers_first_life_only(monkeypatch):
+    monkeypatch.setenv("OMPI_TPU_RESTART", "2")
+    inj = fi.Injector(1, fi.parse_plan("rank=1:mismatch@coll=0"), seed=0)
+    assert not inj.coll_faults() and inj.coll_op() == (None, 0)
+
+
+def test_fire_coll_records_then_spin_parks(monkeypatch):
+    """mismatch ALWAYS spin-parks (the divergent rank must stay
+    capturable); the event carries the ordinal + op_seq so replay
+    checks reproduce the schedule."""
+    class _Break(Exception):
+        pass
+
+    def no_sleep(_s):
+        raise _Break()
+
+    monkeypatch.setattr(fi.time, "sleep", no_sleep)
+    inj = fi.Injector(1, fi.parse_plan("rank=1:mismatch@coll=4"), seed=0)
+    with pytest.raises(_Break):
+        inj.fire_coll("mismatch", 4, 7)
+    ev = inj.events[0]
+    assert (ev["kind"], ev["trigger"], ev["value"], ev["seq"],
+            ev["mode"]) == ("mismatch", "coll", 4, 7, "spin")
+    # one terminal fault per life, like kills
+    assert inj.coll_op()[0] is None
+    inj.fire_coll("mismatch", 4, 7)   # dead: no second park, no event
+    assert len(inj.events) == 1

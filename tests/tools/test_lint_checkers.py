@@ -1004,3 +1004,72 @@ def test_full_tree_lints_clean():
         [sys.executable, "-m", "tools.lint", "--no-mypy", "-q"],
         capture_output=True, text=True, timeout=300, cwd=_repo_root())
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# hang-doctor protocol shapes (TAG_DOCTOR wiring + the doctor RPCs):
+# the registries must hold the new protocol in BOTH directions
+# ---------------------------------------------------------------------------
+
+def test_rml_tag_doctor_wiring_clean_and_reply_must_be_handled(tmp_path):
+    bus = _BUS.replace("TAG_ORPHAN_SEND", "TAG_DOCTOR").replace(
+        "TAG_DEAD", "TAG_DOCTOR_REPLY").replace("TAG_UNSENT", "TAG_AUX")
+    wired = """
+import rml
+
+def wire(node):
+    node.register_recv(rml.TAG_GOOD, lambda o, p: None)
+    node.xcast(rml.TAG_GOOD, 1)
+    node.register_recv(rml.TAG_AUX, lambda o, p: None)
+    node.xcast(rml.TAG_AUX, 1)
+    node.xcast(rml.TAG_DOCTOR, 1)                 # HNP capture fan-out
+    node.register_recv(rml.TAG_DOCTOR, lambda o, p: None)   # orted
+    node.send_up(rml.TAG_DOCTOR_REPLY, (0, 1, []))          # orted
+    node.register_recv(rml.TAG_DOCTOR_REPLY, lambda o, p: None)  # HNP
+"""
+    assert rml_tag.run(_tree(tmp_path, {"rml.py": bus,
+                                        "daemon.py": wired})) == []
+    # drop the HNP-side reply handler: the capture silently vanishes —
+    # exactly the class the unhandled-send rule exists for
+    broken = wired.replace(
+        "    node.register_recv(rml.TAG_DOCTOR_REPLY, "
+        "lambda o, p: None)  # HNP\n", "")
+    got = _rules(rml_tag.run(_tree(tmp_path / "b", {"rml.py": bus,
+                                                    "daemon.py": broken})))
+    assert ("unhandled-send", "TAG_DOCTOR_REPLY") in got
+
+
+def test_pmix_rpc_doctor_branches_need_callers_and_arity(tmp_path):
+    pmix_src = _PMIX.replace(
+        '        if cmd == "dead_arm":\n            return ("ok",)\n',
+        '        if cmd == "doctor":\n'
+        '            rank, port = int(args[0]), int(args[1])\n'
+        '            return ("ok",)\n'
+        '        if cmd == "doctor_ports":\n'
+        '            return ("ok", {})\n')
+    clean = pmix_src + """
+class App(Client):
+    def put(self, k, v):
+        self._rpc("put", 0, k, v)
+    def report(self):
+        self._rpc("report", 1, 2)
+    def register_doctor(self, port):
+        self._rpc("doctor", 0, port)
+    def doctor_ports(self):
+        return self._rpc("doctor_ports")
+"""
+    assert pmix_rpc.run(_tree(tmp_path, {"pmix.py": clean})) == []
+    # a client registering with too few args is the per-call ValueError
+    # class; an uncalled branch is dead protocol
+    broken = pmix_src + """
+class App(Client):
+    def put(self, k, v):
+        self._rpc("put", 0, k, v)
+    def report(self):
+        self._rpc("report", 1, 2)
+    def register_doctor(self):
+        self._rpc("doctor", 0)           # server unpacks two
+"""
+    got = _rules(pmix_rpc.run(_tree(tmp_path / "b", {"pmix.py": broken})))
+    assert ("arity-mismatch", "doctor") in got
+    assert ("dead-rpc", "doctor_ports") in got

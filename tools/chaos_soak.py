@@ -35,6 +35,12 @@ targets, and asserts the job lands in that policy's *defined* state:
   every rank (victim included) finishes with the full-ring acc, and the
   survivors' printed failure→success gap (``heal_dt``) bounds the
   detect→rejoin cycle under 15 s.
+- ``coll-hang``     — a rank stalls INSIDE its Kth collective
+  (``stall@coll=K``, spin mode so its crash dump still flushes); the
+  survivors wedge in the arena until ``coll_shm_timeout`` aborts the
+  job, and the OFFLINE hang doctor (``tools/hang_doctor.py --dir``)
+  must name the stalled rank as the straggler from the per-rank crash
+  dumps alone — the postmortem-doctor acceptance class.
 - ``selfheal-crashloop`` — a rank dies at the same step in EVERY life
   (the ``crash`` fault kind): the revive budget burns with backoff
   (min-uptime gating forced on via ``errmgr_min_uptime_s``), the policy
@@ -74,7 +80,7 @@ from ompi_tpu.testing import faultinject  # noqa: E402
 
 POLICIES = ("respawn", "notify-shrink", "continue", "abort",
             "midtree-kill", "rank-hang", "writer-death",
-            "selfheal-hang", "selfheal-crashloop")
+            "selfheal-hang", "selfheal-crashloop", "coll-hang")
 
 RING_APP = r"""
 import os
@@ -213,6 +219,24 @@ print(f"rank {rank} selfheal done acc={acc:.0f}", flush=True)
 ompi_tpu.finalize()
 """
 
+# the coll-hang app: one small allreduce per step — the victim's
+# stall@coll freezes it mid-dispatch, everyone else wedges in the arena
+COLLHANG_APP = r"""
+import os
+import numpy as np
+import ompi_tpu
+from ompi_tpu.testing import faultinject
+
+comm = ompi_tpu.init()
+steps = int(os.environ["SOAK_STEPS"])
+acc = 0.0
+for step in range(steps):
+    faultinject.step()
+    acc += float(comm.allreduce(np.full(32, float(comm.rank + step)))[0])
+print(f"rank {comm.rank} collhang done acc={acc:.0f}", flush=True)
+ompi_tpu.finalize()
+"""
+
 # the crash-loop prover: the victim dies at the SAME step in every life
 # (fault kind ``crash``), survivors do independent local work — the
 # job's fate rides entirely on the selfheal ladder escalating
@@ -269,6 +293,16 @@ def gen_plan(seed: int, idx: int, np_: int, steps: int) -> dict:
         return {"idx": idx, "policy": policy, "victim": 1,
                 "kill_step": None, "kill_after": kill_after, "drop": 0.0,
                 "plan": f"daemon=1:kill@reg=4:after={kill_after}",
+                "seed": seed}
+    if policy == "coll-hang":
+        # the stall ordinal counts RECORDED dispatches (init barrier is
+        # ordinal 0, every app step issues >= 1), so any K in [1, steps]
+        # lands mid-run on every box
+        victim = rng.randrange(0, np_)
+        coll_n = rng.randrange(1, steps)
+        return {"idx": idx, "policy": policy, "victim": victim,
+                "kill_step": coll_n, "drop": 0.0,
+                "plan": f"rank={victim}:stall@coll={coll_n}",
                 "seed": seed}
     if policy in ("rank-hang", "selfheal-hang"):
         plan = f"rank={victim}:hang@step={kill_step}"
@@ -447,6 +481,31 @@ def run_plan(plan: dict, np_: int, steps: int, log_dir: str,
              f"escalation, saw {revives}: {out[-3000:]}")
         assert "selfheal-escalate" in out and "degrading to shrink" in out, \
             f"no revive→shrink escalation event: {out[-3000:]}"
+    elif policy == "coll-hang":
+        # victim stalls inside collective K (spin: its dump flushes at
+        # teardown); peers wedge until coll_shm_timeout aborts the job;
+        # the OFFLINE doctor must then name the victim from dumps alone
+        tdir = tempfile.mkdtemp(prefix=f"chaos_doctor_{plan['idx']}_")
+        r = tpurun(["-np", str(np_), "--timeout", "90",
+                    "--mca", "faultinject_hang_mode", "spin",
+                    "--mca", "coll_shm_timeout", "10",
+                    "--mca", "coll_stuck_timeout", "2", *mca,
+                    "--", sys.executable, "-c", COLLHANG_APP],
+                   dict(env, TMPDIR=tdir, OMPI_TPU_TRACE="1"),
+                   timeout=240)
+        out = r.stdout + r.stderr
+        assert r.returncode != 0, \
+            f"coll-hang exited 0 despite a stalled rank: {out[-2000:]}"
+        assert f"rank {plan['victim']} collhang done" not in out, \
+            f"stalled victim claims completion: {out[-2000:]}"
+        dr = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "hang_doctor.py"),
+             "--dir", tdir, "--expect", f"straggler:{plan['victim']}"],
+            capture_output=True, text=True, timeout=60)
+        assert dr.returncode == 0, \
+            (f"offline doctor missed the stalled rank:\n"
+             f"{dr.stdout}{dr.stderr}\njob tail: {out[-1500:]}")
     elif policy == "continue":
         r = tpurun(["-np", str(np_), "--mca", "errmgr", "continue", *mca,
                     "--", sys.executable, "-c", LOCAL_APP], env)
@@ -507,10 +566,12 @@ def check_replay(plan: dict, first: dict[int, dict],
     """
     kills_a = sorted((r, e["kind"], e["trigger"], e["value"])
                      for r, d in first.items() for e in d["events"]
-                     if e["kind"] in ("kill", "hang", "crash"))
+                     if e["kind"] in ("kill", "hang", "crash",
+                                      "stall", "mismatch"))
     kills_b = sorted((r, e["kind"], e["trigger"], e["value"])
                      for r, d in second.items() for e in d["events"]
-                     if e["kind"] in ("kill", "hang", "crash"))
+                     if e["kind"] in ("kill", "hang", "crash",
+                                      "stall", "mismatch"))
     assert kills_a == kills_b, \
         f"plan {plan['idx']}: kill schedule diverged: {kills_a} vs {kills_b}"
 

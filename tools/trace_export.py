@@ -26,6 +26,14 @@ import sys
 
 _RANK_RE = re.compile(r"ompi_tpu_trace_(\d+)_rank(-?\d+)\.json$")
 
+
+def dump_glob(jobid: "int | None" = None) -> str:
+    """The per-rank dump filename glob (THE place the pattern lives
+    beside _RANK_RE — tools/hang_doctor.py's offline mode imports both
+    instead of re-hardcoding trace.default_path's format)."""
+    return (f"ompi_tpu_trace_{jobid}_rank*.json" if jobid is not None
+            else "ompi_tpu_trace_*_rank*.json")
+
 # keep in sync with ompi_tpu.mpi.trace.CATEGORIES (the exporter must not
 # import the package: it runs standalone in CI validation steps)
 CATEGORIES = ("pml", "btl", "coll", "osc", "io", "ckpt", "datatype",
@@ -76,7 +84,11 @@ def merge(paths: list[str]) -> dict:
                   f"job's dumps", file=sys.stderr)
         per_rank[rank] = {k: other.get(k) for k in
                           ("events_total", "dropped", "counters",
-                           "clock_offset_ns")}
+                           "clock_offset_ns",
+                           # the collective-recorder tail rides the
+                           # merge so one artifact feeds both Perfetto
+                           # and the offline hang doctor
+                           "collrec", "collrec_total")}
         meta.append({"ph": "M", "name": "process_name", "pid": rank,
                      "tid": 0, "args": {"name": f"rank {rank}"}})
         tids = seen_tids.setdefault(rank, set())
@@ -218,9 +230,8 @@ def main(argv: list[str] | None = None) -> int:
 
     paths = list(args.inputs)
     if args.dir:
-        pat = (f"ompi_tpu_trace_{args.jobid}_rank*.json"
-               if args.jobid is not None else "ompi_tpu_trace_*_rank*.json")
-        paths += sorted(glob.glob(os.path.join(args.dir, pat)))
+        paths += sorted(glob.glob(os.path.join(args.dir,
+                                               dump_glob(args.jobid))))
     # dedupe (order-preserving): positional inputs may overlap --dir's
     # glob, and a double-loaded rank would double every event
     paths = list(dict.fromkeys(os.path.abspath(p) for p in paths))
