@@ -130,8 +130,12 @@ def _run_recorded(comm, slot: str, kind: str, sig: int,
     seq = trace_mod.coll_post(rank, comm.cid, kind, sig, provider,
                               nbytes)
     if act is not None:
-        trace_mod.push_now()     # the divergent/stalled head must be
-        # visible to the HNP even though this rank never completes
+        trace_mod.push_now()     # the divergent/stalled/dying head must
+        # be visible to the HNP even though this rank never completes
+        # (kill@coll exits inside fire_coll: the victim dies after the
+        # recorder post, before the collective body publishes — the
+        # deterministic mid-collective death the selfheal-coll rejoin
+        # chaos class keys on)
         inj.fire_coll(act, ordinal, seq)
     t0 = (trace_mod.begin()
           if trace_mod.hist_active or trace_mod.active else 0)

@@ -44,9 +44,12 @@ FT contract: Start on a revoked communicator raises ``ERR_REVOKED``;
 a detector-declared-dead member fails the Start fast
 (``ERR_PROC_FAILED``); ``Comm.free()`` releases the pinned slots and
 poisons every bound plan; a selfheal-revived member invalidates plans
-that pinned its slot (the dead life's mapping is gone) — Start then
-raises and :meth:`PersistentCollRequest.rebind` recompiles the plan
-collectively, counted by ``coll_persistent_rebinds_total``.
+that pinned its slot (the dead life's mapping is gone) — the next
+Start detects the stale (bind-agreed) incarnation snapshot and
+**auto-rebinds**: the plan recompiles collectively (the revived life's
+fresh ``*_init`` pairs with the survivors' rebinds) with no
+user-visible error, counted by ``coll_persistent_rebinds_total``.
+Explicit :meth:`PersistentCollRequest.rebind` remains available.
 """
 
 from __future__ import annotations
@@ -58,6 +61,7 @@ from typing import Any, Callable, Optional
 
 import numpy as np
 
+from ompi_tpu.core import output
 from ompi_tpu.core.config import var_registry
 from ompi_tpu.mpi import trace as trace_mod
 from ompi_tpu.mpi.constants import (
@@ -66,6 +70,8 @@ from ompi_tpu.mpi.constants import (
 from ompi_tpu.mpi.request import (
     CompletedRequest, PersistentRequest, Request,
 )
+
+_log = output.get_stream("coll")
 
 __all__ = ["PersistentCollRequest", "barrier_init", "bcast_init",
            "reduce_init", "allreduce_init", "allgather_init"]
@@ -116,24 +122,50 @@ def _check_start(comm) -> None:
 
 
 def _member_incs(comm) -> tuple:
-    """Per-member incarnation snapshot: a bound plan pins peers' slots,
-    and a selfheal-revived peer's NEW life never mapped them (the
-    segment name was unlinked at bind) — any advance since bind means
-    the plan is stale.  Cheap common case: no FT sidecar and no epochs
-    → empty tuple."""
-    pml = comm.pml
-    ft = getattr(pml, "ft", None)
-    epochs = getattr(pml, "_peer_epoch", None) or {}
-    if ft is None and not epochs:
-        return ()
-    adopted = getattr(ft, "adopted_inc", None) if ft is not None else None
-    out = []
-    for w in comm.group.ranks:
-        inc = int(epochs.get(w, 0))
-        if adopted is not None:
-            inc = max(inc, int(adopted(w)))
-        out.append(inc)
-    return tuple(out)
+    """Per-member incarnation snapshot (``ft.member_incs`` — THE shared
+    adoption-merge): a bound plan pins peers' slots, and a selfheal-
+    revived peer's NEW life never mapped them (the segment name was
+    unlinked at bind) — any advance past the bind's agreed snapshot
+    means the plan is stale.  Shared with ``ft.comm_coll_epoch`` (its
+    sum) so the slots' epoch fence and this staleness gate can never
+    drift."""
+    from ompi_tpu.mpi import ft as ft_mod
+
+    return ft_mod.member_incs(comm)
+
+
+def _agree_incs(comm, incs: tuple) -> tuple:
+    """Element-wise MAX of the per-member incarnation snapshot over the
+    communicator — run once per (re)bind, which is collective anyway.
+    The AGREED snapshot is what Start's staleness gate compares
+    against: without it, a member that had not yet adopted a revived
+    life at bind time would hold a lower snapshot than its peers and
+    later auto-rebind ALONE (a collective call nobody pairs).  Rides
+    the base p2p plane for the same reason the coll/shm epoch prologue
+    does — base tags pair across lives, agree seq numbers do not."""
+    if comm.size <= 1:
+        return incs
+    from ompi_tpu.mpi import op as op_mod
+    from ompi_tpu.mpi.coll import base
+
+    local = np.array(incs if incs else [0] * comm.size, np.int64)
+    agreed = np.asarray(base.allreduce_recursive_doubling(
+        comm, local, op_mod.MAX), np.int64)
+    if not incs and not agreed.any():
+        return ()        # keep the cheap empty form at job start
+    return tuple(int(x) for x in agreed)
+
+
+def _incs_stale(cur: tuple, bound: tuple, size: int) -> bool:
+    """True when a member's CURRENT adopted incarnation exceeds the
+    bind's agreed snapshot — a revive since bind.  ``cur`` below the
+    snapshot is NOT stale: the bind already included a life this
+    process simply has not adopted yet."""
+    if cur == bound:
+        return False
+    c = cur or (0,) * size
+    b = bound or (0,) * size
+    return any(x > y for x, y in zip(c, b))
 
 
 def _land(recvbuf: Optional[np.ndarray], out: Any) -> Any:
@@ -949,7 +981,18 @@ class PersistentCollRequest(PersistentRequest):
     def _compile(self, first: bool) -> None:
         t0 = trace_mod.begin() if trace_mod.active else 0
         self._plan = self._binder()
-        self._incs = _member_incs(self._comm)
+        # the staleness snapshot is AGREED across the members (element-
+        # wise MAX — one base allreduce on a path that is collective
+        # anyway), so every rank's Start reaches the same stale/fresh
+        # verdict and the auto-rebind stays collective
+        self._incs = _agree_incs(self._comm, _member_incs(self._comm))
+        slots = getattr(self._plan, "_slots", None)
+        if slots is not None and getattr(slots, "_fence", None) is not None:
+            # re-stamp the pinned slots' epoch fence with the agreed
+            # snapshot's epoch (sum of agreed incarnations): a member
+            # that bound pre-adoption must not spuriously fence a life
+            # the bind already included
+            slots._fence = (sum(self._incs), slots._fence[1])
         trace_mod.count("coll_persistent_binds_total")
         if not first:
             trace_mod.count("coll_persistent_rebinds_total")
@@ -979,13 +1022,17 @@ class PersistentCollRequest(PersistentRequest):
                 f"(Comm.free() released its pinned slots)")
         comm = self._comm
         _check_start(comm)
-        if _member_incs(comm) != self._incs:
-            raise MPIException(
-                f"Start on a stale persistent {self._ckind} plan: a "
-                f"member of {comm.name} was revived since bind (its "
-                f"pinned slot mapping is gone) — call rebind() "
-                f"collectively, or re-init on a shrunk communicator",
-                error_class=ERR_PROC_FAILED)
+        if _incs_stale(_member_incs(comm), self._incs, comm.size):
+            # a member was revived since bind: its pinned slot mapping
+            # is gone.  AUTO-rebind here instead of raising — Start is
+            # issued on every rank (and the revived life re-inits its
+            # plan, a fresh collective bind that pairs with these
+            # rebinds), so the recompile is collective; the revive
+            # stays invisible to the application
+            _log.verbose(1, "persistent %s on %s: member revived since "
+                         "bind — auto-rebind", self._ckind, comm.name)
+            self.rebind()
+            plan = self._plan
         trace_mod.count("coll_persistent_starts_total")
         # collective flight recorder: every Start posts under the
         # "p<kind>" name with its own (rank, cid) op_seq; completion of
@@ -1022,10 +1069,29 @@ class PersistentCollRequest(PersistentRequest):
                     labels=lb))
         return req
 
+    def _rebind_if_stale(self) -> bool:
+        """Recompile iff a member was revived since bind (the coll/shm
+        rejoin calls this for every plan on the comm, in bind order, so
+        the survivors' rebind collectives pair with the revived life's
+        re-executed prologue ``*_init`` calls).  Active or freed plans
+        are left alone — the Start-gate / wait failure paths own
+        those."""
+        if self._plan is None or self.active:
+            return False
+        comm = self._comm
+        if not _incs_stale(_member_incs(comm), self._incs, comm.size):
+            return False
+        _log.verbose(1, "persistent %s on %s: member revived since bind "
+                     "— rejoin rebind", self._ckind, comm.name)
+        self.rebind()
+        return True
+
     def rebind(self) -> "PersistentCollRequest":
         """Recompile the bound plan on the same communicator —
-        collective over it, like ``*_init``.  The recovery path after
-        a revived member invalidated the pinned slots."""
+        collective over it, like ``*_init``.  Run automatically by the
+        next Start after a revived member invalidated the pinned slots
+        (the stale-snapshot gate in ``_launch``); callable explicitly
+        for eager recompilation."""
         if self.active:
             raise MPIException(
                 "rebind on an active persistent request (wait it first)")

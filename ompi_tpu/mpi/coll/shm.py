@@ -54,11 +54,27 @@ Dispatch ladder per collective:
 For bcast only the root knows the payload, so the root *communicates*
 its arena-vs-host verdict through the descriptor round — every rank
 takes the same branch without a pre-exchange.
+
+Collective-capable rejoin (errmgr selfheal): the cached state is
+stamped with the communicator's **coll epoch**
+(``ft.comm_coll_epoch`` — the sum of the members' adopted
+incarnations).  A revived member's new life never mapped the old arena
+(the segment name was unlinked at build), so the first dispatch at a
+stale epoch — or a wait already parked against the dead life's flags
+(``StaleCollEpoch`` out of the FT check) — tears the state down and
+rebuilds it with the revived rank included.  The rebuild prologue
+MAX-agrees the epoch and the parent's cid/tag counters over the base
+p2p plane (a revived life's fresh counters would otherwise derive
+divergent split cids and the rebuild's own collectives could never
+match).  Counted by ``coll_rejoin_total`` / timed by
+``coll_rejoin_ns``; pushed to the HNP FT timeline via the PMIx
+``coll_rejoin`` RPC.
 """
 
 from __future__ import annotations
 
 import ctypes
+import functools
 import os
 import time
 import uuid
@@ -74,11 +90,13 @@ from ompi_tpu.core.mca import Component
 from ompi_tpu.mpi import op as op_mod
 from ompi_tpu.mpi import trace as trace_mod
 from ompi_tpu.mpi.coll import base, coll_framework, rules
-from ompi_tpu.mpi.constants import COMM_TYPE_SHARED, UNDEFINED, MPIException
+from ompi_tpu.mpi.constants import (
+    COMM_TYPE_SHARED, ERR_PROC_FAILED, UNDEFINED, MPIException,
+)
 from ompi_tpu.mpi.op import Op
 
-__all__ = ["ShmColl", "Arena", "PersistentSlots", "make_persistent_slots",
-           "decide_allreduce_algo"]
+__all__ = ["ShmColl", "Arena", "PersistentSlots", "StaleCollEpoch",
+           "make_persistent_slots", "decide_allreduce_algo"]
 
 _log = output.get_stream("coll")
 
@@ -92,6 +110,60 @@ _TOKEN = np.zeros(0, np.uint8)  # gate payload for the arena-less intra path
 def _arena_dtype_ok(dtype: np.dtype) -> bool:
     """Raw-byte publishable: fixed-size, no python object indirection."""
     return not dtype.hasobject and dtype.itemsize > 0
+
+
+def _coll_epoch(comm) -> int:
+    """The communicator's collective epoch (``ft.comm_coll_epoch``):
+    the monotone generation every cached collective artifact is fenced
+    on.  Lazy import — the FT layer must stay optional at import."""
+    from ompi_tpu.mpi import ft as ft_mod
+
+    return ft_mod.comm_coll_epoch(comm)
+
+
+class StaleCollEpoch(MPIException):
+    """A cached collective artifact (arena, hierarchy split, pinned
+    persistent slots) was built at an older coll epoch than the
+    communicator's current one — a member was revived since, and its
+    new life never mapped the old segment (the name was unlinked at
+    build).  Raised out of arena waits and caught at the coll/shm slot
+    boundary, which tears the state down, rebuilds it with the revived
+    rank included, and re-runs the op (no rank can have completed it —
+    completion needs the life that never arrived).  Carries
+    ``ERR_PROC_FAILED`` so the rare escape (a persistent drain mid-
+    transition) flows through the FT retry handlers apps already
+    have."""
+
+    def __init__(self, msg: str) -> None:
+        super().__init__(msg, error_class=ERR_PROC_FAILED)
+
+
+#: retry bound for the stale-epoch rebuild loop at the slot boundary —
+#: each retry requires an actual epoch advance (another adopted
+#: revive), so hitting the bound means a bug, not a hot loop; the final
+#: attempt runs unguarded so the raise surfaces
+_MAX_REJOIN_RETRIES = 8
+
+
+def _epoch_retries(fn):
+    """Slot-boundary rejoin loop: a mid-op ``StaleCollEpoch`` (an arena
+    wait observed the epoch advance past the arena's build) re-enters
+    the slot, whose ``_route`` → ``_state`` sees the stale epoch, tears
+    down and rebuilds the hierarchy with the revived rank included, and
+    re-runs the op on fresh counters.  Safe to re-run: the raise means
+    a member's publishes can never arrive in the OLD arena, so no rank
+    completed the op; the retried publish lands in the NEW segment
+    (fresh counters), never double-bumps the old one."""
+    @functools.wraps(fn)
+    def run(self, comm, *args, **kw):
+        for _ in range(_MAX_REJOIN_RETRIES):
+            try:
+                return fn(self, comm, *args, **kw)
+            except StaleCollEpoch:
+                continue
+        return fn(self, comm, *args, **kw)
+
+    return run
 
 
 #: live arenas of this process — the hang doctor's capture walks them
@@ -301,11 +373,17 @@ class Arena:
     """
 
     def __init__(self, seg: shmseg.SharedSegment, size: int, rank: int,
-                 slot_bytes: int, world=None, pml=None) -> None:
+                 slot_bytes: int, world=None, pml=None,
+                 fence=None) -> None:
         self.seg = seg
         self.size = size
         self.rank = rank
         self.slot_bytes = slot_bytes
+        # coll-epoch fence: (epoch this arena was built/bound at, weakref
+        # to the comm the epoch is scoped to — the PARENT comm for hier
+        # node arenas, so a revive anywhere in the hierarchy breaks the
+        # wait).  None ⇒ unfenced (bare test arenas, no FT plane).
+        self._fence = fence
         # this rank's WORLD rank (the flight recorder / doctor key; the
         # arena index is node-local)
         self._wr = (pml.rank if pml is not None
@@ -588,13 +666,18 @@ class Arena:
             f"pid probe after {grace:.1f}s grace, not the "
             f"{timeout:.0f}s coll_shm_timeout", error_class=ERR_PROC_FAILED)
 
-    @staticmethod
-    def _check_ft(comm) -> None:
+    def _check_ft(self, comm) -> None:
         """Arena waits bypass the PML, so they must reproduce its
         fail-fast discipline themselves: a revoked communicator or a
         detector-declared-dead member raises instead of spinning out
         the full coll_shm_timeout (the ULFM recovery paths depend on
-        collectives failing promptly)."""
+        collectives failing promptly).  The coll-epoch fence rides the
+        same cadence: a wait parked against a peer that was revived
+        since this arena was built can never be satisfied (the new life
+        never mapped the unlinked segment) — the moment this process
+        adopts the new incarnation, the wait raises StaleCollEpoch and
+        the slot boundary rebuilds the hierarchy instead of spinning
+        out the timeout."""
         if comm.is_revoked():
             from ompi_tpu.mpi.constants import ERR_REVOKED
 
@@ -605,12 +688,21 @@ class Arena:
         if ft is not None:
             for w in comm.group.ranks:
                 if ft.detector.is_dead(w, poll=False):
-                    from ompi_tpu.mpi.constants import ERR_PROC_FAILED
-
                     raise MPIException(
                         f"coll/shm: rank {w} failed mid-collective "
                         f"({ft.detector.reason(w) or 'detector-declared'})",
                         error_class=ERR_PROC_FAILED)
+        fence = self._fence
+        if fence is not None:
+            epoch, cref = fence
+            fc = cref()
+            if fc is not None and _coll_epoch(fc) > epoch:
+                raise StaleCollEpoch(
+                    f"coll/shm: arena wait on "
+                    f"{getattr(comm, 'name', '?')} fenced — a member "
+                    f"was revived since the arena was built (coll "
+                    f"epoch {_coll_epoch(fc)} > built {epoch}); the "
+                    f"hierarchy rebuilds on retry")
 
     def _wait_arrive(self, r: int, v: int, comm) -> None:
         self._wait(r * 8, v, comm)
@@ -972,8 +1064,9 @@ class PersistentSlots(Arena):
 
     def __init__(self, seg: shmseg.SharedSegment, size: int, rank: int,
                  slot_bytes: int, nslots: int, world=None,
-                 pml=None) -> None:
-        super().__init__(seg, size, rank, slot_bytes, world=world, pml=pml)
+                 pml=None, fence=None) -> None:
+        super().__init__(seg, size, rank, slot_bytes, world=world, pml=pml,
+                         fence=fence)
         self.nslots = nslots              # slots per parity set
         self._slot_base = 2 * size * _CACHELINE   # no desc region
 
@@ -1000,7 +1093,9 @@ def make_persistent_slots(comm, slot_bytes: int,
                           nslots: int) -> Optional["PersistentSlots"]:
     """Collectively map a dedicated parity-slot segment for one bound
     plan (the pinned-slot half of a persistent-collective bind).  None
-    ⇒ mapping failed somewhere — every rank falls back together."""
+    ⇒ mapping failed somewhere — every rank falls back together.  The
+    slots are epoch-fenced on the bound comm (the local epoch here; the
+    bind's incarnation agreement re-stamps it with the agreed value)."""
     slot_bytes = max(0, (slot_bytes + 63) & ~63)
     seg = _map_shared(
         comm, max(PersistentSlots.pnbytes_for(comm.size, slot_bytes,
@@ -1008,7 +1103,8 @@ def make_persistent_slots(comm, slot_bytes: int,
     if seg is None:
         return None
     return PersistentSlots(seg, comm.size, comm.rank, slot_bytes, nslots,
-                           world=list(comm.group.ranks), pml=comm.pml)
+                           world=list(comm.group.ranks), pml=comm.pml,
+                           fence=(_coll_epoch(comm), weakref.ref(comm)))
 
 
 # ---------------------------------------------------------------------------
@@ -1066,7 +1162,7 @@ def _map_shared(comm, nbytes: int) -> Optional[shmseg.SharedSegment]:
     return mine
 
 
-def _make_arena(comm) -> Optional[Arena]:
+def _make_arena(comm, fence=None) -> Optional[Arena]:
     """The one-shot dispatch arena: one ``_map_shared`` bootstrap with
     the classic flags+desc+slots layout."""
     p = comm.size
@@ -1075,29 +1171,35 @@ def _make_arena(comm) -> Optional[Arena]:
     if seg is None:
         return None
     return Arena(seg, p, comm.rank, slot,
-                 world=list(comm.group.ranks), pml=comm.pml)
+                 world=list(comm.group.ranks), pml=comm.pml, fence=fence)
 
 
 class _HostFallback:
-    """Permanent per-communicator fallback marker (no co-located ranks,
-    no usable shm dir, or arena bootstrap failed)."""
+    """Per-communicator fallback marker (no co-located ranks, no usable
+    shm dir, or arena bootstrap failed) — epoch-stamped like ``_State``
+    so a comm that settled on host BEFORE a revive re-runs the split
+    with the revived rank included instead of staying host forever."""
 
     mode = "host"
+
+    def __init__(self, epoch: int = 0) -> None:
+        self.epoch = epoch
 
     def close(self) -> None:
         pass
 
 
-_HOST = _HostFallback()
 _SETUP = object()   # reentrancy sentinel: setup's own collectives → host
 
 
 class _State:
     """Cached per-communicator dispatch state (rides ``comm._coll_shm_state``;
-    ``Communicator.free`` closes it)."""
+    ``Communicator.free`` closes it; a coll-epoch advance past ``epoch``
+    — an adopted selfheal revive — invalidates it)."""
 
     def __init__(self, mode: str, node, leader, arena,
-                 c2n=None, node_blocks=None, node_idx_of=None) -> None:
+                 c2n=None, node_blocks=None, node_idx_of=None,
+                 epoch: int = 0) -> None:
         self.mode = mode              # "arena" (flat) | "hier"
         self.node = node              # split_type(COMM_TYPE_SHARED) cache
         self.leader = leader          # node-rank-0 communicator (or None)
@@ -1105,6 +1207,7 @@ class _State:
         self.c2n = c2n                # flat: comm rank → arena rank
         self.node_blocks = node_blocks  # hier: per node, comm ranks by node rank
         self.node_idx_of = node_idx_of  # hier: comm rank → node index
+        self.epoch = epoch            # agreed coll epoch at build
 
     def close(self) -> None:
         if self.arena is not None:
@@ -1185,40 +1288,176 @@ class ShmColl(Component):
         st = getattr(comm, "_coll_shm_state", None)
         if st is _SETUP:
             return None          # setup's own collectives ride coll/host
-        if st is None:
-            comm._coll_shm_state = _SETUP
-            built = None
-            try:
-                t0 = trace_mod.begin() if trace_mod.active else 0
-                built = self._build_state(comm)
-                if t0:
-                    trace_mod.complete("coll", "shm_setup", t0,
-                                       rank=comm.pml.rank, cid=comm.cid,
-                                       mode=built.mode, size=comm.size)
-            except MPIException as e:
-                # e.g. a merged intercomm whose per-viewer namespace ids
-                # cannot survive split_type — the raise is deterministic
-                # (every rank computes the same partition), so settling
-                # on coll/host is collectively consistent
-                _log.verbose(1, "coll/shm: setup on %s fell back to host "
-                             "(%s)", comm.name, e)
-            finally:
-                comm._coll_shm_state = built if built is not None else _HOST
-            st = comm._coll_shm_state
+        if st is not None:
+            cur = _coll_epoch(comm)
+            if getattr(st, "epoch", 0) >= cur:
+                return st
+            # epoch-fenced rejoin: a member was revived (its adopted
+            # incarnation advanced the coll epoch past the build's) —
+            # the cached node/leader splits, arena slot state and
+            # frozen hierarchy decisions are survivors-only artifacts
+            # now.  Tear them down (the failed op already drained: no
+            # rank can complete an op the missing life never published
+            # into) and rebuild with the revived rank included.  The
+            # pending-rejoin marker rides the COMM, not a local: if the
+            # epoch agreement below fails fast (another member dead)
+            # the dispatch retries with no cached state, and the
+            # eventual successful rebuild must still record the rejoin
+            # (first-teardown timestamp kept — honest latency).
+            if getattr(comm, "_coll_rejoin_pending", None) is None:
+                comm._coll_rejoin_pending = (getattr(st, "epoch", 0),
+                                             time.monotonic_ns())
+            st.close()
+            comm._coll_shm_state = st = None
+        # the epoch every rank stamps the rebuilt state with is AGREED
+        # first (MAX-allreduce on the base p2p plane, which is
+        # incarnation-transparent) — run OUTSIDE the fallback guard: a
+        # dead member fails it fast and the dispatch retries, instead
+        # of settling on host with a divergent epoch
+        epoch = self._agree_epoch(comm)
+        comm._coll_shm_state = _SETUP
+        built = None
+        try:
+            t0 = trace_mod.begin() if trace_mod.active else 0
+            built = self._build_state(comm, epoch)
+            if t0:
+                trace_mod.complete("coll", "shm_setup", t0,
+                                   rank=comm.pml.rank, cid=comm.cid,
+                                   mode=built.mode, size=comm.size)
+        except MPIException as e:
+            # e.g. a merged intercomm whose per-viewer namespace ids
+            # cannot survive split_type — the raise is deterministic
+            # (every rank computes the same partition), so settling
+            # on coll/host is collectively consistent
+            _log.verbose(1, "coll/shm: setup on %s fell back to host "
+                         "(%s)", comm.name, e)
+        finally:
+            # the freed check and the cache assignment must be ONE
+            # atomic step against Comm.free() (which sets the flag and
+            # clears the cache under the same comm lock): a check-then-
+            # assign window would let a racing free() run to completion
+            # between them and the freshly-built arena would be cached
+            # onto the freed comm — the exact leak this guards against
+            with comm._lock:
+                freed = getattr(comm, "_coll_freed", False)
+                if not freed:
+                    comm._coll_shm_state = (built if built is not None
+                                            else _HostFallback(epoch))
+            if freed:
+                # Comm.free() ran while this build was in flight (it
+                # saw the _SETUP sentinel and had nothing to close):
+                # close the half-built state instead of caching it
+                if built is not None:
+                    built.close()
+                    built = None
+                comm._coll_shm_state = None
+        st = comm._coll_shm_state
+        pending = getattr(comm, "_coll_rejoin_pending", None)
+        if pending is not None and st is not None:
+            # record FIRST: the hierarchy rebuild itself completed, so
+            # the rejoin must be counted (and coll_rejoin_ns scoped to
+            # the rebuild, not the plan rebinds below) even if an eager
+            # plan rebind then fails fast — the dispatch retry must not
+            # double-record it
+            comm._coll_rejoin_pending = None
+            self._record_rejoin(comm, pending, st)
+            self._rebind_stale_plans(comm)
         return st
 
-    def _build_state(self, comm):
+    def _rebind_stale_plans(self, comm) -> None:
+        """Eagerly recompile every stale, inactive persistent plan
+        bound on this comm as the LAST step of the rejoin, in bind
+        order.  Ordering is the point: the revived life re-executes its
+        prologue ``*_init`` calls BEFORE its first loop collective, so
+        the survivors must pair those binds HERE — inside the rejoin,
+        before the op that triggered it re-runs.  Deferring each rebind
+        to its plan's next Start (the Start-gate backstop, which still
+        covers plans used without any one-shot dispatch in between)
+        would interleave the bind collectives AFTER one-shot ops the
+        revived life has not issued yet: a collective-order divergence
+        that deadlocks mixed one-shot + persistent apps — found driving
+        exactly that app shape end-to-end."""
+        for ref in list(getattr(comm, "_persistent_colls", ())):
+            req = ref()
+            if req is None:
+                continue
+            rebind = getattr(req, "_rebind_if_stale", None)
+            if rebind is not None:
+                rebind()
+
+    def _agree_epoch(self, comm) -> int:
+        """The coll epoch the (re)built state is stamped with, agreed
+        across every member: a MAX-allreduce of the local epochs over
+        the base p2p plane.  Unconditional (epoch 0 at job start agrees
+        instantly) so participation can never diverge — a rank that has
+        not yet adopted a revived life still pairs the prologue, stamps
+        the agreed (higher) epoch, and its later adoption then reads as
+        already-included instead of spuriously re-triggering.  The
+        base-plane allreduce IS the agreement here: ``Comm.agree``'s
+        per-(cid, seq) protocol state restarts at 0 in a revived life,
+        so its sequence numbers cannot pair across lives — p2p tags
+        (incarnation-fenced, msglog-replayed) can.
+
+        The same exchange MAX-agrees the parent's deterministic-cid
+        allocator and persistent-tag counters (``_counter_merge``): a
+        revived life's fresh counters sit at their base while the
+        survivors' advanced with every earlier build, and the rebuilt
+        node/leader splits' counter-derived cids (and a re-bound nbc
+        plan's tags) MUST land identically on every member or the
+        rebuild's own collectives never match."""
+        if comm.size <= 1:
+            return _coll_epoch(comm)
+        cid_next, pseq = comm._counter_snapshot()
+        agreed = np.asarray(base.allreduce_recursive_doubling(
+            comm, np.array([_coll_epoch(comm), cid_next, pseq],
+                           np.int64), op_mod.MAX))
+        comm._counter_merge(int(agreed[1]), int(agreed[2]))
+        return int(agreed[0])
+
+    def _record_rejoin(self, comm, pending, st) -> None:
+        """One completed epoch-fenced rebuild: pvar + latency histogram
+        + flight-recorder event locally, and a best-effort one-way PMIx
+        push so the HNP's FT timeline (and the /status + --dvm-ps
+        rejoins column) shows the rejoin."""
+        old_epoch, t0 = pending
+        dur = time.monotonic_ns() - t0
+        trace_mod.count("coll_rejoin_total")
+        if trace_mod.hist_active:
+            trace_mod.record_hist("coll_rejoin_ns", dur)
+        trace_mod.coll_event(
+            comm.pml.rank, comm.cid, "rejoin",
+            {"oe": old_epoch, "ne": getattr(st, "epoch", 0),
+             "mode": getattr(st, "mode", "?")})
+        _log.verbose(1, "coll/shm: %s rebuilt the coll hierarchy at "
+                     "epoch %d (from %d, %.1f ms, mode %s)", comm.name,
+                     getattr(st, "epoch", 0), old_epoch, dur / 1e6,
+                     getattr(st, "mode", "?"))
+        ft = comm.pml.ft
+        client = ft.detector._client if ft is not None else None
+        rej = getattr(client, "coll_rejoin", None)
+        if rej is not None:
+            try:    # app thread (coll dispatch), RPC allowed; best-effort
+                rej(old_epoch, int(getattr(st, "epoch", 0)),
+                    int(dur // 1_000_000))
+            except Exception:  # noqa: BLE001 — observability, not recovery
+                pass
+
+    def _build_state(self, comm, epoch: int = 0):
         node = comm.split_type(COMM_TYPE_SHARED,
                                name=f"{comm.name}.shmnode")
         leader = comm.split(0 if node.rank == 0 else UNDEFINED,
                             key=comm.rank, name=f"{comm.name}.shmldr")
-        arena = _make_arena(node) if node.size > 1 else None
+        # the fence comm is the PARENT: a revive anywhere in the
+        # hierarchy must break node-arena waits, not just node-local ones
+        fence = (epoch, weakref.ref(comm))
+        arena = _make_arena(node, fence=fence) if node.size > 1 else None
         if node.size == comm.size:                      # one host: flat
             if arena is None:
-                return _HOST
+                return _HostFallback(epoch)
             c2n = np.array([node.group.rank_of(comm.world_rank(r))
                             for r in range(comm.size)], np.int64)
-            return _State("arena", node, leader, arena, c2n=c2n)
+            return _State("arena", node, leader, arena, c2n=c2n,
+                          epoch=epoch)
         # mixed hosts: leaders exchange their node's comm-rank blocks
         # (ordered by node rank — i.e. by leader-comm rank across nodes),
         # then fan the table out intra-node; base algorithms only (the
@@ -1246,11 +1485,14 @@ class ShmColl(Component):
         if all(len(b) == 1 for b in node_blocks):
             if arena is not None:
                 arena.close()
-            return _HOST     # nobody shares a host: pure coll/host ground
+            # nobody shares a host: pure coll/host ground (epoch-stamped
+            # so a later revive still re-evaluates the partition)
+            return _HostFallback(epoch)
         node_idx_of = {r: i for i, blk in enumerate(node_blocks)
                        for r in blk}
         return _State("hier", node, leader, arena,
-                      node_blocks=node_blocks, node_idx_of=node_idx_of)
+                      node_blocks=node_blocks, node_idx_of=node_idx_of,
+                      epoch=epoch)
 
     # -- decision helpers ----------------------------------------------------
 
@@ -1349,6 +1591,7 @@ class ShmColl(Component):
 
     # -- table slots ---------------------------------------------------------
 
+    @_epoch_retries
     def coll_barrier(self, comm) -> None:
         st, host = self._route(comm, "barrier")
         if host is not None:
@@ -1361,6 +1604,7 @@ class ShmColl(Component):
             self._host().coll_barrier(st.leader)
         self._intra_gate_out(st)
 
+    @_epoch_retries
     def coll_bcast(self, comm, buf, root: int):
         st, host = self._route(comm, "bcast")
         if host is not None:
@@ -1387,6 +1631,7 @@ class ShmColl(Component):
             data = self._intra_bcast(st, data, 0)
         return np.asarray(data)
 
+    @_epoch_retries
     def coll_reduce(self, comm, sendbuf, op: Op, root: int):
         arr = np.asarray(sendbuf)
         st, host = self._route(comm, "reduce", arr.nbytes)
@@ -1421,6 +1666,7 @@ class ShmColl(Component):
                 out = out.reshape(arr.shape).astype(arr.dtype, copy=False)
         return out if comm.rank == root else None
 
+    @_epoch_retries
     def coll_allreduce(self, comm, sendbuf, op: Op):
         arr = np.asarray(sendbuf)
         st, host = self._route(comm, "allreduce", arr.nbytes)
@@ -1446,6 +1692,7 @@ class ShmColl(Component):
         return np.asarray(out).reshape(arr.shape).astype(arr.dtype,
                                                          copy=False)
 
+    @_epoch_retries
     def coll_allgather(self, comm, sendbuf):
         arr = np.asarray(sendbuf)
         st, host = self._route(comm, "allgather", arr.nbytes)

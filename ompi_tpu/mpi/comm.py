@@ -19,7 +19,6 @@ coll_base_comm_select.c:107.
 
 from __future__ import annotations
 
-import itertools
 import threading
 from typing import Any, Optional
 
@@ -52,7 +51,12 @@ class Communicator:
         self._world_rank = my_world_rank
         self.name = name
         self.rank = group.rank_of(my_world_rank)
-        self._cid_counter = itertools.count(cid * 1024 + 1)
+        # deterministic-cid allocator position (plain int, not a
+        # consumed iterator: the coll/shm epoch sync MAX-merges it
+        # across members after a selfheal revive — a revived life's
+        # fresh counter sits behind the survivors', and counter-derived
+        # split cids would otherwise diverge across the rebuild)
+        self._cid_next = cid * 1024 + 1
         self._cg_seq: dict = {}   # create_group per-key call sequence
         self._lock = threading.Lock()
         self.coll = None  # installed by ompi_tpu.mpi.coll.install()
@@ -667,7 +671,31 @@ class Communicator:
     def _next_cid(self) -> int:
         """Deterministic collective CID (see module docstring)."""
         with self._lock:
-            return next(self._cid_counter)
+            cid = self._cid_next
+            self._cid_next += 1
+            return cid
+
+    # -- counter agreement (coll/shm epoch-sync prologue) ------------------
+
+    def _counter_snapshot(self) -> tuple[int, int]:
+        """(cid allocator position, persistent-coll tag sequence) — the
+        per-parent counters whose derived values must MATCH across
+        members for collectives to pair.  A selfheal-revived life
+        restarts both at their base; the coll/shm build prologue
+        MAX-agrees them over the members and merges back
+        (:meth:`_counter_merge`), so the rebuilt hierarchy's split cids
+        and a re-bound plan's tags land identically on survivors and
+        the revived rank."""
+        with self._lock:
+            return self._cid_next, getattr(self, "_pcoll_seq", 0)
+
+    def _counter_merge(self, cid_next: int, pcoll_seq: int) -> None:
+        """Adopt the agreed (MAX) counter positions — monotone, so a
+        stale merge can never rewind a counter."""
+        with self._lock:
+            self._cid_next = max(self._cid_next, int(cid_next))
+            self._pcoll_seq = max(getattr(self, "_pcoll_seq", 0),
+                                  int(pcoll_seq))
 
     # -- attribute caching (≈ ompi/attribute: keyvals w/ callbacks) --------
 
@@ -735,10 +763,20 @@ class Communicator:
             if req is not None:
                 req.free()
         self._persistent_colls = []
-        st = self._coll_shm_state
+        # flag + cache-clear under the comm lock, ATOMIC against the
+        # build's completion step: a coll/shm state build in flight on
+        # another thread (the _SETUP sentinel has no close()) decides
+        # cache-vs-close under the same lock, so whichever side runs
+        # second sees the other's effect and the freshly-built arena is
+        # closed exactly once — without this, free() racing a lazy
+        # build (or an epoch-fenced rebuild after a selfheal revive)
+        # leaked the half-built segment mapping forever
+        with self._lock:
+            self._coll_freed = True
+            st = self._coll_shm_state
+            self._coll_shm_state = None
         if st is not None and hasattr(st, "close"):
             st.close()
-        self._coll_shm_state = None
 
     def _copy_attrs(self, new: "Communicator") -> None:
         from ompi_tpu.mpi.info import Keyval

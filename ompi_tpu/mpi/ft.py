@@ -106,7 +106,8 @@ if TYPE_CHECKING:
 
 __all__ = ["FailureDetector", "PmlFT", "pml_ft", "attach_runtime",
            "comm_revoke", "comm_is_revoked", "comm_agree", "comm_shrink",
-           "comm_get_failed", "comm_ack_failed"]
+           "comm_get_failed", "comm_ack_failed", "comm_coll_epoch",
+           "member_incs"]
 
 _log = output.get_stream("ft")
 
@@ -1242,6 +1243,59 @@ def comm_shrink(comm: "Communicator", name: Optional[str] = None
     return Communicator(Group(survivors), cid, comm.pml,
                         comm._world_rank,
                         name or f"{comm.name}.shrink")
+
+
+def member_incs(comm: "Communicator") -> tuple:
+    """Per-member adopted-incarnation snapshot, in group-rank order:
+    this process's own life number for itself, and for peers the merge
+    of BOTH adoption paths — direct transport evidence
+    (``pml._peer_epoch``, set by rebind announces / si stamps) and the
+    gossip-transitive ``PmlFT.adopted_inc``.  THE single source every
+    collective-rejoin fence derives from: ``comm_coll_epoch`` is its
+    sum, and coll/persistent's bind snapshot (whose agreed element-wise
+    MAX re-stamps the pinned-slots fence) is its element-wise form —
+    keeping the two fences arithmetically consistent by construction.
+
+    Cheap common case — no adoption evidence from ANY source (first
+    life, no transport-adopted epochs, no gossip-transitive adoptions):
+    a handful of attribute checks, returns the empty tuple (≡ all
+    zeros).  This is the fast path of the per-dispatch staleness check
+    in coll/shm, so it must stay O(1) even with an armed FT sidecar —
+    the O(members) walk below runs only once a revive has actually
+    been adopted somewhere (every adoption source populates one of the
+    three inputs: ``_adopt_incarnation`` fills ``_peer_epoch``,
+    ``peer_reincarnated`` fills ``_gossip_inc``, a revived life has
+    ``incarnation``)."""
+    pml = comm.pml
+    ft = pml.ft
+    epochs = getattr(pml, "_peer_epoch", None) or {}
+    own = int(getattr(pml, "incarnation", 0) or 0)
+    if not epochs and not own and (
+            ft is None or not getattr(ft, "_gossip_inc", None)):
+        return ()
+    me = pml.rank
+    out = []
+    for w in comm.group.ranks:
+        if w == me:
+            out.append(own)
+            continue
+        inc = int(epochs.get(w, 0))
+        if ft is not None:
+            inc = max(inc, int(ft.adopted_inc(w)))
+        out.append(inc)
+    return tuple(out)
+
+
+def comm_coll_epoch(comm: "Communicator") -> int:
+    """The communicator's **collective epoch**: the sum of
+    :func:`member_incs`.  Incarnations are monotone per rank, so the
+    epoch is a monotone generation counter that advances exactly when a
+    selfheal/respawn revive is adopted — the fence every cached
+    collective artifact (the coll/shm node-comm split + arena, pinned
+    ``PersistentSlots``, persistent-plan bind snapshots) is stamped
+    with and compared against on dispatch.  A shrink needs no bump: it
+    constructs a NEW communicator whose artifacts are built fresh."""
+    return sum(member_incs(comm))
 
 
 def comm_get_failed(comm: "Communicator"):

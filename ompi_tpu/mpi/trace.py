@@ -206,6 +206,13 @@ _COUNTER_SPECS = (
      "rank-side doctor state captures served (recorder tail + pending "
      "p2p + thread stacks, replied to the owning orted's TAG_DOCTOR "
      "query)"),
+    # collective-capable rejoin (epoch-fenced rebuild after selfheal)
+    ("coll_rejoin_total", "rebuilds",
+     "epoch-fenced rebuilds of the coll/shm hierarchy (node/leader "
+     "splits + arena) after a member's selfheal revive was adopted — "
+     "the rejoin half that makes revives transparent to collective "
+     "apps (persistent-plan auto-rebinds count separately under "
+     "coll_persistent_rebinds_total)"),
 )
 
 #: plain-int counter store: dict increments, no lock — losses under
@@ -295,6 +302,11 @@ _HIST_SPECS = (
     ("btl_shm_drain_ns", "nanoseconds",
      "btl/shm poller drain-batch latency: one sweep over a peer ring "
      "that yielded frames"),
+    ("coll_rejoin_ns", "nanoseconds",
+     "epoch-fenced coll-hierarchy rebuild latency after a selfheal "
+     "revive: stale-state teardown through the re-agreed epoch, "
+     "node/leader re-split and arena re-bootstrap (the rejoin half of "
+     "kill -> first-successful-full-world-collective)"),
 )
 
 _HIST_NAMES = frozenset(n for n, _u, _d in _HIST_SPECS)

@@ -464,6 +464,8 @@ class DvmHnp(MultiHostLauncher):
         p99s = self.metrics_agg.job_hist_quantiles(
             job.jobid, "coll_dispatch_ns", 0.99)
         heads = self.metrics_agg.rank_values(job.jobid, self._CUR_NAMES)
+        rejoins = self.metrics_agg.rank_values(job.jobid,
+                                               ("coll_rejoin_total",))
         limit = int(var_registry.get("errmgr_max_restarts") or 0)
         procs = []
         for p in job.procs:
@@ -489,6 +491,13 @@ class DvmHnp(MultiHostLauncher):
                 # tail collective latency from the rank's pushed
                 # histogram (the --dvm-ps p99 column)
                 row["coll_p99_us"] = round(p99s[p.rank] / 1e3, 1)
+            rj = rejoins.get(p.rank, {}).get("coll_rejoin_total")
+            if rj:
+                # epoch-fenced coll-hierarchy rebuilds this rank ran
+                # after adopted revives (the rejoin half of selfheal) —
+                # a rank whose lives grew without peers' rejoins
+                # ticking is p2p-only recovered, not collective-capable
+                row["rejoins"] = int(rj)
             hv = heads.get(p.rank)
             if hv is not None and hv.get("coll_cur_seq", -1) >= 0:
                 # the pushed recorder head: the rank's last collective

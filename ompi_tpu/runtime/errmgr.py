@@ -80,6 +80,18 @@ job).  Components decide what a proc-failure event does:
   ``errmgr_selfheal_{revives,escalations}_total`` count the cycle in
   the flight recorder.  Select with ``--mca errmgr selfheal``.
 
+  The rejoin covers COLLECTIVES, not just the p2p plane: survivors
+  fence every cached collective artifact (coll/shm node splits +
+  arena, pinned persistent slots) on the per-communicator coll epoch
+  (``mpi.ft.comm_coll_epoch`` — the sum of adopted incarnations), so
+  the first dispatch after adopting the revived life tears the old
+  hierarchy down and rebuilds it with the new life included, and
+  persistent plans auto-``rebind()`` on their next Start.  The revived
+  rank's ``coll_rejoin`` FT-timeline events (PMIx ``coll_rejoin`` RPC)
+  and the ``rejoins`` column on ``--dvm-ps`` make the rejoin half
+  observable; ``tools/chaos_soak.py --only selfheal-coll`` proves it
+  end-to-end (kill *inside* a collective via ``kill@coll=N``).
+
 Thread-context rules (machine-checked by ``tools/lint``): errmgr hooks
 fire from rml ``register_recv`` callbacks and the PMIx server's
 ``on_failed_report``/``on_client_contact`` — link reader threads and

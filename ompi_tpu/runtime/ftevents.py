@@ -28,8 +28,12 @@ __all__ = ["FtEventLog", "log", "record", "KINDS"]
 #: the hang-doctor plane ("stuck" = a rank's watchdog crossed
 #: coll_stuck_timeout; "doctor" = a cross-rank capture produced a
 #: verdict)
+#: ``coll_rejoin`` = a rank's epoch-fenced coll-hierarchy rebuild after
+#: a selfheal revive landed (pushed by the rank via the one-way PMIx
+#: "coll_rejoin" RPC — the rejoin half of the revive cycle)
 KINDS = ("detect", "reap", "revive", "shrink", "escalate", "abort",
-         "daemon_lost", "reparent", "finished", "stuck", "doctor")
+         "daemon_lost", "reparent", "finished", "stuck", "doctor",
+         "coll_rejoin")
 
 
 class FtEventLog:

@@ -69,6 +69,7 @@ AGG_METRICS = (
     "errmgr_selfheal_revives_total",
     "errmgr_selfheal_escalations_total",
     "coll_stuck_events_total",
+    "coll_rejoin_total",
 )
 
 #: the per-job aggregated-HISTOGRAM name family: latency histograms the

@@ -253,6 +253,24 @@ class PMIxServer:
                 if inc > self._adopted_life.get(rank, 0):
                     self._adopted_life[rank] = inc
             return ("ok",)
+        if cmd == "coll_rejoin":
+            # one-way notice: the rank finished its epoch-fenced rebuild
+            # of the coll/shm hierarchy after a revive (the rejoin half
+            # of the selfheal cycle) — recorded on the FT timeline so
+            # /status (and the --dvm-ps rejoins column, fed by the
+            # coll_rejoin_total pvar on the metrics uplink) shows it.
+            # jobid 0: the server is per-job, and jobid-0 events ride
+            # every job filter by design (ftevents.snapshot)
+            rank, oe, ne, ms = (int(args[0]), int(args[1]),
+                                int(args[2]), int(args[3]))
+            from ompi_tpu.runtime import ftevents
+
+            with self._cv:
+                lives = self._life.get(rank, 0)
+            ftevents.record("coll_rejoin", jobid=0, rank=rank,
+                            lives=lives, old_epoch=oe, new_epoch=ne,
+                            rebuild_ms=ms)
+            return ("ok",)
         if cmd == "report_failed":
             # the reverse direction of "failed": an app rank PUSHES a
             # death its rank-plane gossip detector observed (hung pid —
@@ -587,6 +605,16 @@ class PMIxClient:
         :func:`query_doctor_ports`)."""
         return {int(r): int(p)
                 for r, p in dict(self._rpc("doctor_ports")[1]).items()}
+
+    def coll_rejoin(self, old_epoch: int, new_epoch: int,
+                    rebuild_ms: int) -> None:
+        """One-way notice that this rank completed an epoch-fenced
+        rebuild of its coll/shm hierarchy after a revive was adopted
+        (old -> new coll epoch, rebuild latency) — lands on the HNP's
+        FT timeline as a ``coll_rejoin`` event.  Best-effort
+        observability; called from the coll dispatch (app) thread."""
+        self._rpc("coll_rejoin", self.rank, int(old_epoch),
+                  int(new_epoch), int(rebuild_ms))
 
     def peer_adopted(self, rank: int, incarnation: int) -> None:
         """Tell the control plane this process adopted ``rank``'s new

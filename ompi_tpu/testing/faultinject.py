@@ -18,6 +18,14 @@ Plan grammar (entries separated by ``;``)::
                               crash loop that proves the errmgr revive
                               budget/escalation ladder (kill and hang
                               are first-life-only by design)
+    rank=2:kill@coll=5        rank 2 exits INSIDE its 5th top-level
+                              collective dispatch (after the recorder
+                              post, before the collective runs — so the
+                              victim never publishes and the revive
+                              lands mid-collective-loop; first-life-
+                              only like every kill): the deterministic
+                              mid-collective death behind the
+                              selfheal-coll rejoin chaos class
     rank=2:stall@coll=5       rank 2 stalls INSIDE its 5th recorded
                               collective (counted by the flight
                               recorder's dispatch ordinal, 0-based):
@@ -212,18 +220,29 @@ def _parse_entry(entry: str) -> _Action:
             f"{act.kind} targets ranks, not daemons (entry {entry!r})")
     # the collective triggers fire from inside the coll dispatch choke
     # point — the @coll ordinal is their ONLY trigger (a wall-clock
-    # stall would not be deterministic against the recorder's seq), and
-    # @coll makes no sense for the process-level kill kinds
+    # stall would not be deterministic against the recorder's seq).
+    # kill@coll=N rides the same ordinal (die at the Nth TOP-LEVEL
+    # dispatch, never inside a nested/infrastructure phase); hang and
+    # crash keep their step/t triggers — a hang inside the dispatch is
+    # spelled stall, and crash must fire in every life, which the
+    # first-life-only _colls arm cannot express
     if act.kind in ("stall", "mismatch") and act.at_coll is None:
         raise ValueError(
             f"{act.kind} needs an @coll=N trigger (entry {entry!r})")
-    if act.at_coll is not None and act.kind not in ("stall", "mismatch"):
+    if act.at_coll is not None and act.kind not in ("stall", "mismatch",
+                                                    "kill"):
         raise ValueError(
-            f"@coll triggers are stall/mismatch only (entry {entry!r})")
+            f"@coll triggers are stall/mismatch/kill only "
+            f"(entry {entry!r})")
     # a kill that saw daemon= before the kill key is a daemon_kill; one
     # that saw it after must settle to the same action
     if act.kind == "kill" and act.vpid is not None:
         act.kind = "daemon_kill"
+    # ...and @coll is a RANK trigger (the ordinal lives in the rank's
+    # coll dispatcher) — a daemon kill keyed on it could never fire
+    if act.kind == "daemon_kill" and act.at_coll is not None:
+        raise ValueError(
+            f"@coll triggers target ranks, not daemons (entry {entry!r})")
     # the ranks-registered barrier is a DAEMON schedule: only an orted
     # can watch the PMIx regcount without being counted by it (a rank's
     # own registration is part of the barrier it would be waiting on)
@@ -275,10 +294,12 @@ class Injector:
         self._kills = [a for a in self._acts
                        if a.kind == "crash"
                        or (a.kind in ("kill", "hang") and not restarted)]
-        # collective-choke-point triggers (stall/mismatch@coll=N), first
-        # life only like kills/hangs — a revived victim must not re-wedge
+        # collective-choke-point triggers (stall/mismatch/kill@coll=N),
+        # first life only like kills/hangs — a revived victim must not
+        # re-wedge/re-die at the same ordinal
         self._colls = [a for a in self._acts
-                       if a.kind in ("stall", "mismatch")
+                       if a.at_coll is not None
+                       and a.kind in ("stall", "mismatch", "kill")
                        and not restarted]
         # the @coll ordinal: TOP-LEVEL dispatched collectives of this
         # life (the dispatcher skips nested composed sub-collectives —
@@ -381,11 +402,17 @@ class Injector:
 
     def fire_coll(self, kind: str, n: int, seq: int) -> None:
         """Fire a collective trigger from inside the dispatch: record
-        the fault, then park.  ``stall`` follows faultinject_hang_mode
+        the fault, then park (or die).  ``kill`` exits immediately —
+        after the recorder post, before the collective body, so the
+        victim never publishes into the arena and its revive lands
+        mid-collective-loop.  ``stall`` follows faultinject_hang_mode
         (SIGSTOP / spin); ``mismatch`` ALWAYS spin-parks — the divergent
         rank must stay capturable so the doctor can read its recorder
         tail with the divergent (cid, op_seq) record."""
         if self._dead:
+            return
+        if kind == "kill":
+            self._fire_kill("coll", n)
             return
         self._dead = True
         mode = ("spin" if kind == "mismatch"

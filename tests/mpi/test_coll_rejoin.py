@@ -1,0 +1,412 @@
+"""Collective-capable rejoin — the epoch-fenced rebuild of the coll/shm
+hierarchy and persistent plans after a selfheal revive.
+
+The in-process half of the story the ``selfheal-coll`` chaos class
+proves end-to-end: a revived member's adopted incarnation advances the
+per-communicator coll epoch (``ft.comm_coll_epoch``), every cached
+collective artifact is fenced on it, and the first dispatch at a stale
+epoch tears the old node/leader splits + arena down and rebuilds them
+with the revived rank included — transparently for one-shot
+collectives, via Start-time auto-rebind for persistent plans.
+
+Revives are SIMULATED the way the transport would adopt them: the
+revived rank's ``pml.incarnation`` advances (``OMPI_TPU_RESTART`` in a
+real revive) and each survivor's ``pml._peer_epoch`` gains the new life
+(the rebind-announce / si-stamp adoption path) — the same seam
+test_coll_persistent has always used.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from ompi_tpu.core.config import var_registry
+from ompi_tpu.mpi import trace
+from ompi_tpu.mpi.coll import shm as shm_mod
+from tests.mpi.harness import run_ranks
+
+N = 4
+
+
+def _simulate_revive(comm, victim: int, bar=None) -> None:
+    """Adopt a (simulated) new life of ``victim`` on this rank — the
+    revived rank itself advances its own incarnation (OMPI_TPU_RESTART
+    in a real revive), survivors adopt it.  ``_peer_inc`` is pre-marked
+    adopted and ``bar`` (a threading.Barrier) orders the marks before
+    any si-stamped frame flows: in a REAL revive the new life's wire
+    seqs start fresh, but this in-process victim keeps its old send
+    seqs — letting the si-stamp adoption machinery fire against live
+    counters would wipe recv-seq gates mid-stream, a seam artifact no
+    real revive has."""
+    if comm.rank == victim:
+        comm.pml.incarnation = 1
+    else:
+        w = comm.world_rank(victim)
+        comm.pml._peer_epoch[w] = 1
+        comm.pml._peer_inc[w] = 1
+    if bar is not None:
+        bar.wait(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# flat arena: stale-epoch dispatch rebuilds, survivors and revived side
+# ---------------------------------------------------------------------------
+
+def test_stale_epoch_dispatch_rebuilds_flat_arena():
+    bar = threading.Barrier(N)
+
+    def body(comm):
+        out0 = comm.allreduce(np.arange(4.0) + comm.rank)
+        st0 = comm._coll_shm_state
+        assert st0.mode == "arena" and st0.epoch == 0
+        old_arena = st0.arena
+        _simulate_revive(comm, victim=1, bar=bar)
+        out1 = comm.allreduce(np.arange(4.0) + comm.rank)
+        st1 = comm._coll_shm_state
+        assert st1 is not st0 and st1.mode == "arena"
+        assert st1.epoch == 1
+        assert st1.arena is not old_arena
+        # the old arena was closed at teardown (views dropped)
+        assert st0.arena is None
+        # steady state again: the third dispatch must NOT rebuild
+        comm.allreduce(np.ones(1))
+        assert comm._coll_shm_state is st1
+        return out0, out1
+
+    before = trace.counters["coll_rejoin_total"]
+    res = run_ranks(N, body)
+    want = np.arange(4.0) * N + sum(range(N))
+    for out0, out1 in res:
+        np.testing.assert_allclose(out0, want)
+        np.testing.assert_allclose(out1, want)
+    # every rank with a cached state rebuilt exactly once (in-process
+    # simulation: the "revived" rank kept a stale state too, so all N
+    # count; a real revived life builds fresh and counts zero — the
+    # chaos selfheal-coll driver asserts that split)
+    assert trace.counters["coll_rejoin_total"] == before + N
+
+
+def test_stale_epoch_dispatch_from_the_revived_side():
+    """The revived life has NO cached state (fresh process): its first
+    dispatch runs a fresh build whose epoch-agreement prologue must
+    pair with the survivors' rebuilds — and it records no rejoin."""
+    bar = threading.Barrier(N)
+
+    def body(comm):
+        comm.allreduce(np.ones(2))
+        _simulate_revive(comm, victim=1, bar=bar)
+        if comm.rank == 1:
+            # the revived life never had the old mapping
+            comm._coll_shm_state.close()
+            comm._coll_shm_state = None
+        out = comm.allreduce(np.full(3, float(comm.rank)))
+        st = comm._coll_shm_state
+        assert st.mode == "arena" and st.epoch == 1
+        return out
+
+    before = trace.counters["coll_rejoin_total"]
+    res = run_ranks(N, body)
+    for out in res:
+        np.testing.assert_allclose(out, np.full(3, float(sum(range(N)))))
+    # rank 1 built fresh — no rejoin; the N-1 survivors rebuilt
+    assert trace.counters["coll_rejoin_total"] == before + (N - 1)
+
+
+def test_mid_wait_adoption_breaks_the_park_and_rebuilds():
+    """A survivor already parked in an old-arena wait when the adoption
+    lands must break out via the epoch fence (StaleCollEpoch) and
+    transparently retry on the rebuilt arena — the un-adopted-survivor
+    window of a real revive."""
+    def body(comm):
+        comm.barrier()                      # build the arena at epoch 0
+        if comm.rank == 0:
+            # dispatch immediately: parks waiting rank 1's publish,
+            # which never comes into THIS arena.  Rank 1 pokes our
+            # epoch view mid-park (the reader-thread adoption seam),
+            # the fence fires, and the retried op lands on the rebuilt
+            # arena.
+            return comm.allreduce(np.full(2, 1.0 + comm.rank))
+        # rank 1: let rank 0 park, then adopt the revive everywhere
+        time_parked = 0.4
+        threading.Event().wait(time_parked)
+        for c in _comms:
+            _simulate_revive(c, victim=1)
+        return comm.allreduce(np.full(2, 1.0 + comm.rank))
+
+    # the bodies need every rank's comm to poke peers' epoch views;
+    # collect them via a shared list the harness fn closes over
+    _comms = []
+
+    def wrapped(comm):
+        _comms.append(comm)
+        while len(_comms) < 2:
+            threading.Event().wait(0.01)
+        return body(comm)
+
+    before = trace.counters["coll_rejoin_total"]
+    res = run_ranks(2, wrapped, timeout=90)
+    for out in res:
+        np.testing.assert_allclose(out, np.full(2, 3.0))
+    assert trace.counters["coll_rejoin_total"] >= before + 1
+
+
+# ---------------------------------------------------------------------------
+# hierarchy rebuild on fake hosts
+# ---------------------------------------------------------------------------
+
+def test_hierarchy_rebuild_on_2plus2_fake_hosts():
+    hosts = ["h0", "h0", "h1", "h1"]
+    bar = threading.Barrier(N)
+
+    def body(comm):
+        comm._io_host_override = hosts[comm.rank]
+        out0 = comm.allreduce(np.arange(3.0) + comm.rank)
+        st0 = comm._coll_shm_state
+        assert st0.mode == "hier" and st0.epoch == 0
+        old_node = st0.node
+        _simulate_revive(comm, victim=3, bar=bar)
+        out1 = comm.allreduce(np.arange(3.0) + comm.rank)
+        st1 = comm._coll_shm_state
+        assert st1 is not st0 and st1.mode == "hier"
+        assert st1.epoch == 1
+        # the node split re-ran: a fresh node communicator (the revived
+        # rank re-enters the on-node block tables)
+        assert st1.node is not old_node
+        return out0, out1
+
+    before = trace.counters["coll_rejoin_total"]
+    res = run_ranks(N, body)
+    want = np.arange(3.0) * N + sum(range(N))
+    for out0, out1 in res:
+        np.testing.assert_allclose(out0, want)
+        np.testing.assert_allclose(out1, want)
+    assert trace.counters["coll_rejoin_total"] == before + N
+
+
+# ---------------------------------------------------------------------------
+# shrink-then-revive interleave
+# ---------------------------------------------------------------------------
+
+def test_shrink_then_revive_interleave():
+    """A shrunk communicator built while the victim was dead must NOT
+    rebuild when the (non-member) victim revives; the parent comm must
+    rebuild and produce full-world answers again."""
+    from ompi_tpu.mpi.ft import pml_ft
+
+    victim = 3
+    gate = threading.Barrier(N)
+
+    def body(comm):
+        comm.allreduce(np.ones(1))          # parent state at epoch 0
+        shrunk_state = []
+        if comm.rank != victim:
+            pml_ft(comm.pml).detector.mark_failed(victim, "test kill")
+            shrunk = comm.shrink()
+            s1 = shrunk.allreduce(np.full(2, 1.0))
+            np.testing.assert_allclose(s1, np.full(2, float(N - 1)))
+            shrunk_state.append((shrunk, shrunk._coll_shm_state))
+        gate.wait(timeout=30)
+        # the revive lands: survivors adopt, the victim's life advances
+        if comm.rank == victim:
+            comm.pml.incarnation = 1
+            comm._coll_shm_state.close()    # new life: no old mapping
+            comm._coll_shm_state = None
+        else:
+            pml_ft(comm.pml).detector.revive(victim)
+            w = comm.world_rank(victim)
+            comm.pml._peer_epoch[w] = 1
+            comm.pml._peer_inc[w] = 1   # pre-adopted (see _simulate_revive)
+        gate.wait(timeout=30)
+        out = comm.allreduce(np.full(2, float(comm.rank)))
+        np.testing.assert_allclose(out, np.full(2, float(sum(range(N)))))
+        if shrunk_state:
+            shrunk, st = shrunk_state[0]
+            # non-member revive: the shrunk comm's epoch is unchanged,
+            # its arena survives untouched
+            s2 = shrunk.allreduce(np.full(2, 2.0))
+            np.testing.assert_allclose(s2, np.full(2, 2.0 * (N - 1)))
+            assert shrunk._coll_shm_state is st
+        return True
+
+    assert all(run_ranks(N, body, timeout=120))
+
+
+# ---------------------------------------------------------------------------
+# native on/off parametrized teardown-rebuild
+# ---------------------------------------------------------------------------
+
+def _native_available() -> bool:
+    from ompi_tpu import _native
+
+    return _native.arena() is not None
+
+
+@pytest.mark.parametrize("native", [False, True])
+def test_rebuild_native_on_off(native):
+    if native and not _native_available():
+        pytest.skip("native arena executor unavailable")
+    old = var_registry.get("coll_shm_native")
+    var_registry.set("coll_shm_native", 1 if native else 0)
+    try:
+        bar = threading.Barrier(2)
+
+        def body(comm):
+            out0 = comm.allreduce(np.arange(8.0) * (comm.rank + 1))
+            _simulate_revive(comm, victim=0, bar=bar)
+            out1 = comm.allreduce(np.arange(8.0) * (comm.rank + 1))
+            assert comm._coll_shm_state.epoch == 1
+            return out0, out1
+
+        res = run_ranks(2, body)
+        want = np.arange(8.0) * 3
+        for out0, out1 in res:
+            np.testing.assert_allclose(out0, want)
+            np.testing.assert_allclose(out1, want)
+    finally:
+        var_registry.set("coll_shm_native", old)
+
+
+# ---------------------------------------------------------------------------
+# persistent plans: Start-time auto-rebind
+# ---------------------------------------------------------------------------
+
+def test_persistent_auto_rebind_bit_parity_vs_fresh_oneshot():
+    """After a simulated revive the next Start auto-rebinds with no
+    user-visible error; the result is bit-identical to a fresh one-shot
+    allreduce of the same buffers (same rank-ordered fold)."""
+    rng = np.random.default_rng(7)
+    data = [rng.standard_normal(33) for _ in range(3)]
+    bar = threading.Barrier(3)
+
+    def body(comm):
+        buf = data[comm.rank].copy()
+        req = comm.allreduce_init(buf)
+        assert req.provider == "shm"
+        req.start()
+        r1 = req.wait()
+        comm.barrier()
+        _simulate_revive(comm, victim=2, bar=bar)
+        req.start()                 # auto-rebind: no raise
+        r2 = req.wait()
+        assert req.provider == "shm"
+        oneshot = comm.allreduce(buf)
+        return r1, r2, oneshot
+
+    binds = trace.counters["coll_persistent_binds_total"]
+    rebinds = trace.counters["coll_persistent_rebinds_total"]
+    res = run_ranks(3, body)
+    for r1, r2, oneshot in res:
+        np.testing.assert_array_equal(r1, r2)     # same fold, same bits
+        np.testing.assert_array_equal(r2, oneshot)
+    # one fresh bind + exactly one auto-rebind per rank
+    assert trace.counters["coll_persistent_binds_total"] == binds + 6
+    assert trace.counters["coll_persistent_rebinds_total"] == rebinds + 3
+
+
+def test_persistent_start_not_stale_when_behind_agreed_snapshot():
+    """A rank whose local adoption is BEHIND the bind's agreed snapshot
+    (bound after everyone else adopted) must not auto-rebind alone:
+    stale means an advance PAST the snapshot, never a lag behind it."""
+    bar = threading.Barrier(2)
+
+    def body(comm):
+        _simulate_revive(comm, victim=1, bar=bar)
+        req = comm.allreduce_init(np.ones(4))
+        req.start()
+        req.wait()
+        if comm.rank == 0:
+            # lag: forget the adoption locally (cur < agreed snapshot)
+            comm.pml._peer_epoch[comm.world_rank(1)] = 0
+        req.start()
+        out = req.wait()
+        return float(out[0])
+
+    rebinds = trace.counters["coll_persistent_rebinds_total"]
+    assert all(v == 2.0 for v in run_ranks(2, body))
+    assert trace.counters["coll_persistent_rebinds_total"] == rebinds
+
+
+# ---------------------------------------------------------------------------
+# Comm.free() racing an in-flight (re)build — the _SETUP leak regression
+# ---------------------------------------------------------------------------
+
+def test_free_during_inflight_build_does_not_leak(monkeypatch):
+    """free() while the state build is mid-flight (the _SETUP sentinel
+    window, e.g. a concurrent epoch-fenced rebuild) must close the
+    freshly-built arena instead of caching it into the freed comm."""
+    orig = shm_mod.ShmColl._build_state
+    built = []
+
+    def slow_build(self, comm, epoch=0):
+        st = orig(self, comm, epoch)
+        gates = getattr(comm, "_test_gates", None)
+        if gates is not None:
+            built.append(st)
+            gates[0].set()              # built — let the body free()
+            assert gates[1].wait(timeout=20)
+        return st
+
+    monkeypatch.setattr(shm_mod.ShmColl, "_build_state", slow_build)
+
+    def body(comm):
+        g0, g1 = threading.Event(), threading.Event()
+        comm._test_gates = (g0, g1)
+        res = []
+        t = threading.Thread(
+            target=lambda: res.append(comm.allreduce(np.ones(4))))
+        t.start()
+        assert g0.wait(timeout=20)
+        comm.free()                     # sees _SETUP: nothing to close
+        g1.set()
+        t.join(timeout=60)
+        assert not t.is_alive()
+        return res[0]
+
+    res = run_ranks(2, body, timeout=120)
+    for out in res:
+        np.testing.assert_allclose(out, np.full(4, 2.0))
+    # every rank's half-built state was closed, not cached/leaked
+    assert len(built) == 2
+    for st in built:
+        assert st.arena is None         # _State.close() ran
+
+
+def test_rejoin_eagerly_rebinds_plans_in_bind_order():
+    """Mixed one-shot + persistent apps: the revived life re-executes
+    its prologue ``*_init`` BEFORE its first loop collective, so the
+    survivors' rejoin must recompile their stale plans AS PART OF the
+    rejoin (bind order), not at each plan's next Start — deferring
+    them interleaves the bind collectives after one-shot ops the
+    revived life has not issued yet and deadlocks (found driving the
+    installed surface end-to-end)."""
+    bar = threading.Barrier(2)
+
+    def body(comm):
+        req = comm.allreduce_init(np.ones(5))
+        req.start()
+        req.wait()
+        comm.barrier()
+        _simulate_revive(comm, victim=1, bar=bar)
+        if comm.rank == 1:
+            # the revived life: fresh state, fresh plan re-created by
+            # its re-executed prologue BEFORE the loop's one-shot
+            comm._coll_shm_state.close()
+            comm._coll_shm_state = None
+            req.free()
+            req = comm.allreduce_init(np.ones(5))
+        rb0 = trace.counters["coll_persistent_rebinds_total"]
+        # the one-shot triggers the survivor's rejoin, whose tail must
+        # pair the plan rebind with rank 1's fresh bind above
+        out = comm.allreduce(np.full(2, float(comm.rank)))
+        if comm.rank == 0:
+            assert trace.counters["coll_persistent_rebinds_total"] > rb0
+        req.start()
+        pout = req.wait()
+        return float(np.asarray(out)[0]), float(np.asarray(pout)[0])
+
+    res = run_ranks(2, body, timeout=90)
+    for o, p in res:
+        assert o == 1.0 and p == 2.0

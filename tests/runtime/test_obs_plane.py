@@ -805,3 +805,30 @@ def test_query_regcount_unreachable_is_none():
     from ompi_tpu.runtime import pmix
 
     assert pmix.query_regcount("tcp://127.0.0.1:1") is None
+
+
+def test_ps_proc_rows_gain_rejoins_column(scrape_hnp):
+    """--dvm-ps rows carry the epoch-fenced coll-rejoin count sourced
+    from the rank's pushed coll_rejoin_total pvar (absent while 0 —
+    steady-state rows stay compact)."""
+    from types import SimpleNamespace
+
+    from ompi_tpu.runtime.job import ProcState
+
+    job = SimpleNamespace(jobid=7, procs=[SimpleNamespace(
+        rank=0, state=ProcState.RUNNING,
+        node=SimpleNamespace(name="sim000"), local_rank=0,
+        lives=2, restarts=0, exit_code=None)])
+    scrape_hnp.metrics_agg.merge(
+        {7: {0: [time.time(), {"coll_rejoin_total": 1}]}})
+    rows = scrape_hnp._proc_rows(job, {})
+    assert rows[0]["rejoins"] == 1
+    # a rank that never rejoined shows no column at all
+    scrape_hnp.metrics_agg.merge(
+        {7: {1: [time.time(), {"coll_shm_fanin_total": 3}]}})
+    job.procs.append(SimpleNamespace(
+        rank=1, state=ProcState.RUNNING,
+        node=SimpleNamespace(name="sim000"), local_rank=1,
+        lives=1, restarts=0, exit_code=None))
+    rows = scrape_hnp._proc_rows(job, {})
+    assert "rejoins" not in rows[1]

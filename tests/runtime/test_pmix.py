@@ -129,3 +129,22 @@ def test_host_side_publish_lookup(server):
     assert c0.get("global_key", rank=-1) == "from-hnp"
     c0.put("k", 9)
     assert server.lookup("k", rank=0) == 9
+
+
+def test_coll_rejoin_rpc_lands_on_ft_timeline(server):
+    """The one-way coll_rejoin notice (a rank finished its epoch-fenced
+    coll-hierarchy rebuild after a revive) records a coll_rejoin FT
+    event with the old/new epoch and rebuild latency."""
+    from ompi_tpu.runtime import ftevents
+
+    c0, *_ = clients(server)
+    before = ftevents.log.total()
+    c0.coll_rejoin(0, 1, 42)
+    events = [e for e in ftevents.log.snapshot()
+              if e["kind"] == "coll_rejoin" and e["seq"] > before]
+    assert len(events) == 1
+    ev = events[0]
+    assert ev["rank"] == 0
+    assert ev["info"]["old_epoch"] == 0
+    assert ev["info"]["new_epoch"] == 1
+    assert ev["info"]["rebuild_ms"] == 42

@@ -283,11 +283,48 @@ def test_stall_mismatch_grammar_parses_coll_trigger():
     "rank=1:stall",              # no trigger
     "rank=1:stall@step=2",       # @coll is the only stall trigger
     "rank=1:mismatch@t=1.0",     # same for mismatch
-    "rank=1:kill@coll=2",        # @coll is stall/mismatch only
+    "rank=1:hang@coll=2",        # a hang inside dispatch is spelled stall
+    "rank=1:crash@coll=2",       # crash is every-life; @coll is first-only
+    "kill@coll=2:daemon=1",      # daemon seen after the kill key: still
+    #                              a daemon kill, and @coll targets ranks
 ])
 def test_stall_mismatch_reject_bad_entries(bad):
     with pytest.raises(ValueError):
         fi.parse_plan(bad)
+
+
+def test_kill_at_coll_grammar_and_first_life_only(monkeypatch):
+    """kill@coll=N parses (the selfheal-coll mid-collective death) and
+    arms the collective choke point in the FIRST life only — a revived
+    victim must not re-die at the same ordinal."""
+    acts = fi.parse_plan("rank=2:kill@coll=5")
+    assert [(a.kind, a.rank, a.at_coll) for a in acts] == [("kill", 2, 5)]
+    inj = fi.Injector(2, acts, seed=0)
+    assert inj.coll_faults()
+    for n in range(5):
+        assert inj.coll_op() == (None, n)
+    assert inj.coll_op() == ("kill", 5)
+    # the revived life (OMPI_TPU_RESTART set) never arms it
+    monkeypatch.setenv("OMPI_TPU_RESTART", "1")
+    revived = fi.Injector(2, acts, seed=0)
+    assert not revived.coll_faults()
+
+
+def test_fire_coll_kill_exits_via_fire_kill(monkeypatch):
+    """fire_coll('kill', ...) routes through _fire_kill (records the
+    fault with trigger=coll, then os._exit in production)."""
+    fired = []
+    monkeypatch.setattr(
+        fi.Injector, "_fire_kill",
+        lambda self, trigger, value, kind="kill":
+        fired.append((kind, trigger, value)))
+    inj = fi.Injector(1, fi.parse_plan("rank=1:kill@coll=2"), seed=0)
+    assert inj.coll_op() == (None, 0)
+    assert inj.coll_op() == (None, 1)
+    kind, n = inj.coll_op()
+    assert (kind, n) == ("kill", 2)
+    inj.fire_coll(kind, n, seq=7)
+    assert fired == [("kill", "coll", 2)]
 
 
 def test_coll_op_advances_ordinal_and_fires_by_position():

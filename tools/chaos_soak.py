@@ -41,6 +41,15 @@ targets, and asserts the job lands in that policy's *defined* state:
   job, and the OFFLINE hang doctor (``tools/hang_doctor.py --dir``)
   must name the stalled rank as the straggler from the per-rank crash
   dumps alone — the postmortem-doctor acceptance class.
+- ``selfheal-coll``  — the collective-capable rejoin prover: a 4-rank
+  allreduce loop under ``--mca errmgr selfheal`` whose victim dies at
+  its Nth top-level collective dispatch (``kill@coll=N`` — inside the
+  dispatch, before publishing).  Survivors' allreduces fail fast, the
+  errmgr revives the victim, it restores from its snapshot, and the
+  survivors' epoch-fenced rebuild re-runs the node split + arena
+  bootstrap with the revived rank included — every rank (victim too)
+  converges to FULL-WORLD answers on the shm arena (``fallback=0``,
+  mode ``arena``) with exactly one rejoin per survivor.
 - ``selfheal-crashloop`` — a rank dies at the same step in EVERY life
   (the ``crash`` fault kind): the revive budget burns with backoff
   (min-uptime gating forced on via ``errmgr_min_uptime_s``), the policy
@@ -80,7 +89,8 @@ from ompi_tpu.testing import faultinject  # noqa: E402
 
 POLICIES = ("respawn", "notify-shrink", "continue", "abort",
             "midtree-kill", "rank-hang", "writer-death",
-            "selfheal-hang", "selfheal-crashloop", "coll-hang")
+            "selfheal-hang", "selfheal-crashloop", "coll-hang",
+            "selfheal-coll")
 
 RING_APP = r"""
 import os
@@ -237,6 +247,66 @@ print(f"rank {comm.rank} collhang done acc={acc:.0f}", flush=True)
 ompi_tpu.finalize()
 """
 
+# the collective-capable rejoin prover: an allreduce loop under errmgr
+# selfheal.  The victim dies INSIDE its Nth top-level collective
+# dispatch (kill@coll — after the recorder post, before publishing into
+# the arena), survivors' in-flight allreduces fail fast, and once the
+# revive is adopted the epoch-fenced rebuild re-runs the node split +
+# arena bootstrap with the revived rank included: every step's answer
+# is FULL-world, the provider stays the shm arena (no host fallback),
+# and each survivor records exactly one coll_rejoin
+SELFHEAL_COLL_APP = r"""
+import os, time
+import numpy as np
+import ompi_tpu
+from ompi_tpu.ckpt import snapc
+from ompi_tpu.ckpt.store import SnapshotStore
+from ompi_tpu.mpi import trace
+from ompi_tpu.mpi.constants import ERR_PROC_FAILED, MPIException
+from ompi_tpu.testing import faultinject
+
+comm = ompi_tpu.init()
+rank, size = comm.rank, comm.size
+store = SnapshotStore(os.environ["CKPT_DIR"], job=f"rank{rank}")
+
+start, acc = 0, 0.0
+restored = snapc.auto_restore(comm, store, rank=0)
+if restored is not None:
+    seq, state = restored
+    start, acc = int(state["step"]) + 1, float(state["acc"])
+    print(f"rank {rank} resumed at step {start}", flush=True)
+
+def heal_retry(fn):
+    # a collective is atomic at the app level: a failed attempt (peer
+    # died / rejoin fence) completed on NO rank, so re-running the
+    # whole op is the retry unit — the epoch-fenced rebuild underneath
+    # guarantees the retried op runs on fresh arena counters
+    while True:
+        try:
+            return fn()
+        except MPIException as e:
+            if e.error_class != ERR_PROC_FAILED:
+                raise
+            time.sleep(0.1)
+
+steps = int(os.environ["SOAK_STEPS"])
+for step in range(start, steps):
+    faultinject.step()
+    out = heal_retry(
+        lambda: comm.allreduce(np.full(8, float(rank * 100 + step))))
+    acc += float(out[0])
+    store.write_rank(step, 0, {"step": np.int64(step),
+                               "acc": np.float64(acc)})
+    store.commit(step, 1)
+
+st = comm._coll_shm_state
+print(f"rank {rank} collrejoin done acc={acc:.0f} "
+      f"mode={getattr(st, 'mode', '?')} "
+      f"fallback={trace.counters['coll_shm_fallback_total']} "
+      f"rejoins={trace.counters['coll_rejoin_total']}", flush=True)
+ompi_tpu.finalize()
+"""
+
 # the crash-loop prover: the victim dies at the SAME step in every life
 # (fault kind ``crash``), survivors do independent local work — the
 # job's fate rides entirely on the selfheal ladder escalating
@@ -303,6 +373,17 @@ def gen_plan(seed: int, idx: int, np_: int, steps: int) -> dict:
         return {"idx": idx, "policy": policy, "victim": victim,
                 "kill_step": coll_n, "drop": 0.0,
                 "plan": f"rank={victim}:stall@coll={coll_n}",
+                "seed": seed}
+    if policy == "selfheal-coll":
+        # the victim's top-level dispatch ordinals: init barrier = 0,
+        # step s allreduce = s + 1 — any N in [2, steps-1] dies at app
+        # step N-1 with at least one committed snapshot behind it and
+        # at least one full-world step after the rejoin
+        victim = rng.randrange(0, np_)
+        coll_n = rng.randrange(2, steps)
+        return {"idx": idx, "policy": policy, "victim": victim,
+                "kill_step": coll_n - 1, "drop": 0.0,
+                "plan": f"rank={victim}:kill@coll={coll_n}",
                 "seed": seed}
     if policy in ("rank-hang", "selfheal-hang"):
         plan = f"rank={victim}:hang@step={kill_step}"
@@ -453,6 +534,31 @@ def run_plan(plan: dict, np_: int, steps: int, log_dir: str,
         assert max(heals) < 15.0, \
             (f"detect→rejoin took {max(heals):.1f}s — the gossip window "
              f"+ reap + revive + restore cycle must stay under 15s")
+    elif policy == "selfheal-coll":
+        # the collective-capable rejoin: victim dies INSIDE a collective,
+        # revives, and the epoch-fenced rebuild lets every rank finish
+        # with FULL-world answers on the shm arena — transparently to
+        # the allreduce loop (only the app-level PROC_FAILED retry the
+        # FT contract already requires)
+        r = tpurun(["-np", str(np_), "--mca", "errmgr", "selfheal", *mca,
+                    "--", sys.executable, "-c", SELFHEAL_COLL_APP],
+                   env, timeout=240)
+        out = r.stdout + r.stderr
+        assert r.returncode == 0, \
+            f"selfheal-coll rc={r.returncode}: {out[-3000:]}"
+        assert f"rank {plan['victim']} resumed at step" in out, out[-3000:]
+        assert "selfheal revive" in out, \
+            f"no selfheal revive event: {out[-3000:]}"
+        total = sum(range(np_)) * 100
+        acc = sum(total + np_ * s for s in range(steps))
+        for rank in range(np_):
+            # full-world answers, the shm arena (not host fallback), and
+            # exactly one epoch-fenced rejoin per survivor (the revived
+            # life builds FRESH state — no rejoin to count)
+            want = (f"rank {rank} collrejoin done acc={acc:.0f} "
+                    f"mode=arena fallback=0 "
+                    f"rejoins={0 if rank == plan['victim'] else 1}")
+            assert want in out, (want, out[-3000:])
     elif policy == "selfheal-crashloop":
         # the escalation ladder: the victim dies at the same step every
         # life; min-uptime gating (forced high) classifies every
