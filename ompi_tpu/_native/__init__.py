@@ -25,10 +25,12 @@ ENV_NO_NATIVE = "OMPI_TPU_NO_NATIVE"
 
 _ABI = 2
 _ARENA_ABI = 1
+_NET_ABI = 2
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "convertor.cpp")
 _FASTDSS_SRC = os.path.join(_DIR, "fastdss.c")
 _ARENA_SRC = os.path.join(_DIR, "arena.c")
+_NET_SRC = os.path.join(_DIR, "net.c")
 
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
@@ -36,6 +38,9 @@ _fastdss = None
 _fastdss_tried = False
 _arena: Optional[ctypes.CDLL] = None
 _arena_tried = False
+_net: Optional[ctypes.CDLL] = None
+_net_tried = False
+_net_py: Optional[ctypes.PyDLL] = None
 
 
 def _hash_name(src: str, stem: str) -> str:
@@ -211,6 +216,91 @@ def arena() -> Optional[ctypes.CDLL]:
 
 def arena_available() -> bool:
     return arena() is not None
+
+
+#: net.c's EOF sentinel (outside the errno range, so every other
+#: negative return is unambiguously -errno)
+NET_EOF = -4096
+
+
+def net() -> Optional[ctypes.CDLL]:
+    """The network executor library, or None (pure-python plane).
+
+    Same plain-C ctypes shape as the arena: every entry either parks
+    (the poll/backpressure waits) or moves a payload (the writev drain,
+    the rndv landing recv), so ctypes' marshalling cost vanishes and
+    the GIL release is the entire point — a writer draining a burst of
+    frames or a poller parked across every connection no longer
+    serializes against the in-process ranks."""
+    global _net, _net_tried
+    if _net is not None or _net_tried:
+        return _net
+    _net_tried = True
+    if os.environ.get(ENV_NO_NATIVE) == "1":
+        return None
+    so = _hash_name(_NET_SRC, "_net")
+    if not os.path.exists(so) and not _build(so, src=_NET_SRC):
+        return None
+    try:
+        cdll = ctypes.CDLL(so)
+        cdll.ompi_tpu_net_abi.restype = ctypes.c_int64
+        if cdll.ompi_tpu_net_abi() != _NET_ABI:
+            return None
+        i64, vp = ctypes.c_int64, ctypes.c_void_p
+        # buffers travel as raw integer addresses, iovec lists as
+        # (c_uint64 * 2n) (addr, len) pair blocks — no ctypes structs
+        cdll.ompi_tpu_net_writev.argtypes = [i64, vp, i64, i64]
+        cdll.ompi_tpu_net_writev.restype = i64
+        # send3: ctypes passes bytes objects straight through vp
+        # params (address extraction happens in C, not Python) — the
+        # single-crossing latency path
+        cdll.ompi_tpu_net_send3.argtypes = [
+            i64, vp, i64, vp, i64, vp, i64, i64]
+        cdll.ompi_tpu_net_send3.restype = i64
+        cdll.ompi_tpu_net_poll.argtypes = [vp, i64, vp, i64, i64]
+        cdll.ompi_tpu_net_poll.restype = i64
+        cdll.ompi_tpu_net_read.argtypes = [i64, vp, i64]
+        cdll.ompi_tpu_net_read.restype = i64
+        cdll.ompi_tpu_net_recv_into.argtypes = [i64, vp, i64, i64]
+        cdll.ompi_tpu_net_recv_into.restype = i64
+        cdll.ompi_tpu_net_scan.argtypes = [vp, i64, vp, i64]
+        cdll.ompi_tpu_net_scan.restype = i64
+        _net = cdll
+    except OSError:
+        _net = None
+    return _net
+
+
+def net_available() -> bool:
+    return net() is not None
+
+
+def net_nogil() -> Optional[ctypes.PyDLL]:
+    """The SAME library through a PyDLL handle: calls keep the GIL.
+
+    For a small-frame sendmsg(MSG_DONTWAIT) that's the faster calling
+    convention on a busy interpreter — releasing the GIL for a ~2us
+    syscall invites another runnable thread (the peer's poller, woken
+    by this very send) to steal the interpreter, and the sender then
+    waits out that thread's whole dispatch pass to get it back.  Safe
+    ONLY for entries that cannot block: callers must pass slice_ns=0
+    so send3 returns on the first EAGAIN instead of parking in poll()
+    while holding the interpreter hostage."""
+    global _net_py
+    if _net_py is not None:
+        return _net_py
+    if net() is None:   # shares the build/ABI gate (and NO_NATIVE)
+        return None
+    try:
+        pdll = ctypes.PyDLL(_hash_name(_NET_SRC, "_net"))
+        i64, vp = ctypes.c_int64, ctypes.c_void_p
+        pdll.ompi_tpu_net_send3.argtypes = [
+            i64, vp, i64, vp, i64, vp, i64, i64]
+        pdll.ompi_tpu_net_send3.restype = i64
+        _net_py = pdll
+    except OSError:
+        _net_py = None
+    return _net_py
 
 
 def addr_of(mv) -> Optional[int]:

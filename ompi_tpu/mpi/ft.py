@@ -471,6 +471,11 @@ class PmlFT:
         # and drained by the gossip loop / the detector poll thread
         self._adopt_notify: dict[int, int] = {}
         self.detector.add_poll_hook(self._flush_adopt_notices)
+        # native tcp plane FT contract: parked ring senders re-run the
+        # same revoked-cid / detector-dead gate between bounded slices
+        tcp = getattr(pml.endpoint, "tcp_btl", None)
+        if tcp is not None:
+            tcp.ft_check = self.check_send
 
     def close(self) -> None:
         self.detector.close()

@@ -479,6 +479,12 @@ class PmlOb1:
             # fused shm drain: ring decode + matching in one C call per
             # batch; also enables receiver-pull progress (_progress_wait)
             self.endpoint.shm_btl.drain_hook = self._drain_shm
+        if self.endpoint.tcp_btl is not None:
+            # zero-copy rndv landing: the tcp poller asks for the
+            # plan-registered destination of an in-flight "data" frame
+            # and lands payload bytes straight into it
+            self.endpoint.tcp_btl.recv_sink = self._rndv_sink
+            self.endpoint.tcp_btl.recv_sink_done = self._rndv_sink_done
 
     # -- event hooks (PERUSE equivalent) -----------------------------------
     #
@@ -947,10 +953,10 @@ class PmlOb1:
         the cycles it is waiting for (measured, see request.py)."""
         shm = self.endpoint.shm_btl
         if self._eng is None or shm is None or req.done():
-            return req.wait()
+            return self._tcp_pull_wait(req)
         readers = shm.reader_list()
         if not readers:
-            return req.wait()
+            return self._tcp_pull_wait(req)
         # spin style by core count: on a 1-2 core host the frame we are
         # waiting for is PRODUCED by the process we'd be starving, so
         # yield every iteration (stay runnable, let the sender run — the
@@ -983,6 +989,34 @@ class PmlOb1:
                         time.sleep(0)
         finally:
             shm.pull_depth -= 1
+        return req.wait()
+
+    def _tcp_pull_wait(self, req: Request):
+        """Receiver-pull over the native tcp plane: while blocked, THIS
+        thread runs the poller's bounded service pass (btl progress()),
+        so the frame that completes the request is parsed, matched and
+        copied here — no poller wake, no completion-event handoff.
+        Each pass is one GIL-released poll slice; request state (FT
+        failure included — fail() flips done()) is re-checked between
+        slices.  Falls back to the event wait the moment the native
+        plane declines (var off, closing, no connections yet): the
+        parked poller thread is always running as the backstop."""
+        ep = self.endpoint
+        tcp = ep.tcp_btl
+        # tcp-only endpoints: with proc or shm lanes present the frame
+        # may arrive off-plane, and a poll slice here would only delay
+        # seeing that completion
+        if (tcp is None or not getattr(tcp, "_native_ok", False)
+                or ep.proc_btl is not None or ep.shm_btl is not None
+                or not var_registry.get("btl_tcp_pull")):
+            return req.wait()
+        tcp.pull_depth += 1
+        try:
+            while not req.done():
+                if not tcp.progress():
+                    break
+        finally:
+            tcp.pull_depth -= 1
         return req.wait()
 
     def _drain_shm(self, reader) -> int:
@@ -1585,18 +1619,42 @@ class PmlOb1:
                              {"t": "cts", "sid": hdr["sid"], "rid": req.rid},
                              b"", None)
 
-    def _on_data(self, hdr: dict, payload: bytes) -> None:
+    def _rndv_sink(self, hdr: dict, nbytes: int):
+        """btl/tcp zero-copy landing hook: hand the poller the
+        destination slice for an in-flight "data" frame's payload, or
+        None (⇒ the btl stages the bytes and delivers normally)."""
+        if hdr.get("t") != "data":
+            return None
+        with self._lock:
+            state = self._recv_states.get(hdr.get("rid"))
+            if state is None or not state.direct:
+                return None
+            off = hdr.get("off", 0)
+            if (not isinstance(off, int) or off < 0
+                    or off + nbytes > len(state.data)):
+                return None   # malformed offset: staged path bounds it
+            return state.data[off:off + nbytes]
+
+    def _rndv_sink_done(self, hdr: dict, nbytes: int) -> None:
+        """Completion half of _rndv_sink: the payload already sits in
+        the user buffer, so account for it without a copy."""
+        self._on_data(hdr, b"", landed=nbytes)
+
+    def _on_data(self, hdr: dict, payload: bytes,
+                 landed: Optional[int] = None) -> None:
+        nbytes = len(payload) if landed is None else landed
         with self._lock:
             state = self._recv_states.get(hdr["rid"])
             if state is None:
                 return
             off = hdr["off"]
-            if state.direct:
-                state.data[off:off + len(payload)] = \
-                    np.frombuffer(payload, np.uint8)
-            else:
-                state.data[off:off + len(payload)] = payload
-            state.received += len(payload)
+            if landed is None:
+                if state.direct:
+                    state.data[off:off + nbytes] = \
+                        np.frombuffer(payload, np.uint8)
+                else:
+                    state.data[off:off + nbytes] = payload
+            state.received += nbytes
             done = state.received >= len(state.data)
             if done:
                 del self._recv_states[hdr["rid"]]

@@ -213,6 +213,20 @@ _COUNTER_SPECS = (
      "the rejoin half that makes revives transparent to collective "
      "apps (persistent-plan auto-rebinds count separately under "
      "coll_persistent_rebinds_total)"),
+    # GIL-free inter-node transport (btl/tcp native plane)
+    ("btl_tcp_native_writes_total", "writes",
+     "GIL-released sendmsg drain calls of the btl/tcp submission-ring "
+     "writer (each pushes a whole per-peer backlog; compare against "
+     "batched_frames for the coalescing ratio)"),
+    ("btl_tcp_native_batched_frames_total", "frames",
+     "frames drained through native submission-ring writes — divided "
+     "by btl_tcp_native_writes_total this is the frames-per-syscall "
+     "batching ratio the msgrate bench asserts on"),
+    ("btl_tcp_native_parks_total", "parks",
+     "GIL-released idle parks of the btl/tcp native plane (writer "
+     "doorbell waits, receive-poller slices that expired empty, and "
+     "sender ring-full backpressure waits — FT checks re-run between "
+     "each)"),
 )
 
 #: plain-int counter store: dict increments, no lock — losses under
@@ -302,6 +316,11 @@ _HIST_SPECS = (
     ("btl_shm_drain_ns", "nanoseconds",
      "btl/shm poller drain-batch latency: one sweep over a peer ring "
      "that yielded frames"),
+    ("btl_tcp_write_ns", "nanoseconds",
+     "btl/tcp submission-ring drain-batch latency: one writer sweep "
+     "over a peer backlog, enqueue-visible to kernel-accepted (the "
+     "straggler panel's inter-node stall signal, the tcp twin of "
+     "btl_shm_drain_ns)"),
     ("coll_rejoin_ns", "nanoseconds",
      "epoch-fenced coll-hierarchy rebuild latency after a selfheal "
      "revive: stale-state teardown through the re-agreed epoch, "

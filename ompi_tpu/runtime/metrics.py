@@ -70,6 +70,9 @@ AGG_METRICS = (
     "errmgr_selfheal_escalations_total",
     "coll_stuck_events_total",
     "coll_rejoin_total",
+    "btl_tcp_native_writes_total",
+    "btl_tcp_native_batched_frames_total",
+    "btl_tcp_native_parks_total",
 )
 
 #: the per-job aggregated-HISTOGRAM name family: latency histograms the
@@ -80,6 +83,7 @@ AGG_METRICS = (
 AGG_HISTS = (
     "coll_dispatch_ns",
     "coll_pstart_ns",
+    "btl_tcp_write_ns",
 )
 
 #: jobs kept in the aggregate before the oldest (by last update) fall off

@@ -665,6 +665,33 @@ class Pml:
                and "Pml._on_frame" in f.message for f in got), got
 
 
+def test_reader_thread_net_park_approved_in_poll_loop(tmp_path):
+    """net.c's bounded network parks (poll / recv_into / writev) carry
+    the same approval as the arena waits: fine on a *_loop thread."""
+    idx = _tree(tmp_path, {"btl.py": """
+class Btl:
+    def _poll_loop(self):
+        while True:
+            n = self._net.ompi_tpu_net_poll(0, 2, 0, 100, 50000000)
+            if n > 0:
+                self._net.ompi_tpu_net_recv_into(3, 0, 4096, 1000000)
+"""})
+    assert reader_thread.run(idx) == []
+
+
+def test_reader_thread_net_park_flagged_on_frame_dispatch(tmp_path):
+    """The same network park reached from a frame-dispatch callback is
+    a finding: one peer's slow socket stalls every other peer."""
+    idx = _tree(tmp_path, {"pml.py": """
+class Pml:
+    def _on_frame(self, peer, header, payload):
+        self._net.ompi_tpu_net_writev(3, 0, 2, 20000000)
+"""})
+    got = reader_thread.run(idx)
+    assert any(f.rule == "park-on-reader"
+               and "Pml._on_frame" in f.message for f in got), got
+
+
 # ---------------------------------------------------------------------------
 # lock-order
 # ---------------------------------------------------------------------------

@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # Sanitizer build + test of the native layer (convertor.cpp, fastdss.c,
-# arena.c).
+# arena.c, net.c).
 #
 # Compiles the native sources with -fsanitize=address,undefined to the
 # exact hash-named paths the lazy loader expects, then runs the
@@ -26,11 +26,12 @@ soabi = sysconfig.get_config_var("SOABI") or "abi-unknown"
 print(f"CONV_SO={n._so_path()}")
 print(f"FASTDSS_SO={n._hash_name(n._FASTDSS_SRC, f'_fastdss-{soabi}')}")
 print(f"ARENA_SO={n._hash_name(n._ARENA_SRC, '_arena')}")
+print(f"NET_SO={n._hash_name(n._NET_SRC, '_net')}")
 print(f"PYINC={sysconfig.get_paths()['include']}")
 EOF
 )"
 
-cleanup() { rm -f "$CONV_SO" "$FASTDSS_SO" "$ARENA_SO"; }
+cleanup() { rm -f "$CONV_SO" "$FASTDSS_SO" "$ARENA_SO" "$NET_SO"; }
 trap cleanup EXIT
 
 echo "== sanitized build: convertor.cpp -> $CONV_SO"
@@ -40,6 +41,8 @@ $CC $SAN -shared -fPIC -I"$PYINC" -o "$FASTDSS_SO" \
     ompi_tpu/_native/fastdss.c
 echo "== sanitized build: arena.c -> $ARENA_SO"
 $CC $SAN -shared -fPIC -o "$ARENA_SO" ompi_tpu/_native/arena.c
+echo "== sanitized build: net.c -> $NET_SO"
+$CC $SAN -shared -fPIC -o "$NET_SO" ompi_tpu/_native/net.c
 
 LIBASAN=$($CXX -print-file-name=libasan.so)
 LIBUBSAN=$($CXX -print-file-name=libubsan.so)
@@ -62,14 +65,19 @@ assert fd is not None, "sanitized fastdss failed to load"
 ar = _native.arena()
 assert ar is not None, "sanitized arena executor failed to load"
 assert ar.ompi_tpu_arena_abi() == _native._ARENA_ABI
+nt = _native.net()
+assert nt is not None, "sanitized net plane failed to load"
+assert nt.ompi_tpu_net_abi() == _native._NET_ABI
 print("sanitized native layer loaded, ABI", _native._ABI,
-      "arena ABI", _native._ARENA_ABI)
+      "arena ABI", _native._ARENA_ABI, "net ABI", _native._NET_ABI)
 EOF
 
-echo "== convertor/pack/dss/arena tests under ASan/UBSan"
+echo "== convertor/pack/dss/arena/net tests under ASan/UBSan"
 # test_native_arena drives every arena entry point (waits, publishes,
 # strided walks, every fold width, ring parks); test_coll_shm runs the
-# full collective protocols over the sanitized executor
+# full collective protocols over the sanitized executor;
+# test_native_net drives the tcp submission rings, send3/writev drains,
+# parked poller and zero-copy landing over real loopback sockets
 env JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
     tests/core/test_dss.py \
     tests/mpi/test_datatype.py \
@@ -77,5 +85,6 @@ env JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
     tests/mpi/test_datatype_fuzz.py \
     tests/mpi/test_pack_plan.py \
     tests/mpi/test_native_arena.py \
+    tests/mpi/test_native_net.py \
     tests/mpi/test_coll_shm.py
 echo "== ASan/UBSan native run clean"

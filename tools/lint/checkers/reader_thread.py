@@ -70,6 +70,9 @@ _SINK_RULES = {
 NATIVE_PARK_ATTRS = frozenset({
     "ompi_tpu_arena_wait", "ompi_tpu_arena_wait_all",
     "ompi_tpu_arena_wait_change", "ompi_tpu_ring_wait_any",
+    # btl/tcp native plane: bounded GIL-released network parks
+    "ompi_tpu_net_poll", "ompi_tpu_net_recv_into", "ompi_tpu_net_writev",
+    "ompi_tpu_net_send3",
 })
 
 
