@@ -24,8 +24,8 @@ from typing import Optional
 ENV_NO_NATIVE = "OMPI_TPU_NO_NATIVE"
 
 _ABI = 2
-_ARENA_ABI = 1
-_NET_ABI = 2
+_ARENA_ABI = 2
+_NET_ABI = 3
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "convertor.cpp")
 _FASTDSS_SRC = os.path.join(_DIR, "fastdss.c")
@@ -208,6 +208,11 @@ def arena() -> Optional[ctypes.CDLL]:
         cdll.ompi_tpu_arena_publish_strided.restype = None
         cdll.ompi_tpu_arena_fold.argtypes = [vp, vp, i64, i64, i64, i64]
         cdll.ompi_tpu_arena_fold.restype = i64
+        cdll.ompi_tpu_arena_spans_enable.argtypes = [i64]
+        cdll.ompi_tpu_arena_spans_enable.restype = None
+        cdll.ompi_tpu_arena_spans_drain.argtypes = [vp, i64]
+        cdll.ompi_tpu_arena_spans_drain.restype = i64
+        cdll.ompi_tpu_arena_spans_enable(_span_min_ns)  # pending arm
         _arena = cdll
     except OSError:
         _arena = None
@@ -265,6 +270,11 @@ def net() -> Optional[ctypes.CDLL]:
         cdll.ompi_tpu_net_recv_into.restype = i64
         cdll.ompi_tpu_net_scan.argtypes = [vp, i64, vp, i64]
         cdll.ompi_tpu_net_scan.restype = i64
+        cdll.ompi_tpu_net_spans_enable.argtypes = [i64]
+        cdll.ompi_tpu_net_spans_enable.restype = None
+        cdll.ompi_tpu_net_spans_drain.argtypes = [vp, i64]
+        cdll.ompi_tpu_net_spans_drain.restype = i64
+        cdll.ompi_tpu_net_spans_enable(_span_min_ns)  # pending arm
         _net = cdll
     except OSError:
         _net = None
@@ -301,6 +311,70 @@ def net_nogil() -> Optional[ctypes.PyDLL]:
     except OSError:
         _net_py = None
     return _net_py
+
+
+# -- native span rings ------------------------------------------------------
+#
+# arena.c and net.c stamp begin–end timestamps of their GIL-released
+# parks into small per-thread rings; trace.py drains them into the
+# flight recorder.  The arm state lives here so trace.enable() can arm
+# BEFORE either library is loaded (the load applies the pending value).
+
+#: current arm threshold: spans shorter than this are dropped in C;
+#: < 0 disarms recording entirely (the default)
+_span_min_ns = -1
+
+#: native kind codes → recorder span names, per library (must mirror
+#: the SPAN_KIND_* constants in each .c file)
+_ARENA_SPAN_NAMES = {1: "arena_wait", 2: "arena_wait_all",
+                     3: "arena_wait_change", 4: "ring_wait"}
+_NET_SPAN_NAMES = {1: "net_writev", 2: "net_send3",
+                   3: "net_poll", 4: "net_recv_into"}
+
+_SPAN_DRAIN_CAP = 4096
+_span_buf = None
+
+
+def spans_enable(min_ns: int) -> None:
+    """Arm (min_ns >= 0: record parks at least that long, in ns) or
+    disarm (min_ns < 0) the native span rings in both executor libs.
+    Safe before either library is loaded — the value is applied at
+    load time — and a no-op when native is unavailable."""
+    global _span_min_ns
+    _span_min_ns = int(min_ns)
+    if _arena is not None:
+        _arena.ompi_tpu_arena_spans_enable(_span_min_ns)
+    if _net is not None:
+        _net.ompi_tpu_net_spans_enable(_span_min_ns)
+
+
+def spans_drain(limit: int = 1024) -> list:
+    """Drain completed native park spans from both libraries.
+
+    Returns [(name, t0_ns, t1_ns), ...] in per-ring order (t0/t1 are
+    CLOCK_MONOTONIC ns, the flight recorder's clock).  Single-drainer
+    contract: callers serialize (trace.py drains under its own lock)."""
+    global _span_buf
+    out: list = []
+    limit = min(int(limit), _SPAN_DRAIN_CAP)
+    if limit <= 0 or (_arena is None and _net is None):
+        return out
+    if _span_buf is None:
+        _span_buf = (ctypes.c_uint64 * (3 * _SPAN_DRAIN_CAP))()
+    buf = _span_buf
+    for cdll, drain, names in (
+            (_arena, "ompi_tpu_arena_spans_drain", _ARENA_SPAN_NAMES),
+            (_net, "ompi_tpu_net_spans_drain", _NET_SPAN_NAMES)):
+        if cdll is None:
+            continue
+        got = int(getattr(cdll, drain)(buf, limit - len(out)))
+        for i in range(got):
+            kind = buf[3 * i]
+            out.append((names.get(kind, f"k{kind}"),
+                        int(buf[3 * i + 1]), int(buf[3 * i + 2])))
+        if len(out) >= limit:
+            break
+    return out
 
 
 def addr_of(mv) -> Optional[int]:

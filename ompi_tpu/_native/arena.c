@@ -107,6 +107,90 @@ static uint64_t load_u64(const uint64_t *p) {
     return __atomic_load_n(p, __ATOMIC_ACQUIRE);
 }
 
+/* -- span rings ----------------------------------------------------------- *
+ *
+ * Begin–end timestamps of the GIL-released parks, recorded into small
+ * per-thread rings the Python side drains into its flight recorder —
+ * without this the timeline shows gaps exactly where the interesting
+ * waits happen.  Threads hash onto SPAN_SLOTS single-writer rings (a
+ * slot collision can tear a triple; span data is metrics, the same
+ * unlocked-loss tolerance as the python counters).  Disarmed (min_ns
+ * < 0, the default) the only cost per entry is one relaxed load.
+ */
+
+#define SPAN_SLOTS 16
+#define SPAN_RING 256
+#define SPAN_KIND_WAIT 1
+#define SPAN_KIND_WAIT_ALL 2
+#define SPAN_KIND_WAIT_CHANGE 3
+#define SPAN_KIND_RING_WAIT 4
+
+typedef struct {
+    uint64_t n;                  /* triples ever recorded (writer-owned) */
+    uint64_t drained;            /* drain cursor (drainer-owned)         */
+    uint64_t buf[SPAN_RING * 3]; /* kind, t0_ns, t1_ns                   */
+} span_ring_t;
+
+static span_ring_t g_spans[SPAN_SLOTS];
+static int64_t g_span_min_ns = -1;   /* < 0 = disarmed */
+static uint64_t g_span_slot_seq = 0;
+static __thread int t_span_slot = -1;
+
+/* begin-of-span stamp: 0 when disarmed (entries skip the end stamp) */
+static int64_t span_t0(void) {
+    if (__atomic_load_n(&g_span_min_ns, __ATOMIC_RELAXED) < 0)
+        return 0;
+    return now_ns();
+}
+
+static void span_record(uint64_t kind, int64_t t0) {
+    span_ring_t *r;
+    uint64_t i;
+    int64_t t1 = now_ns();
+    int64_t min_ns = __atomic_load_n(&g_span_min_ns, __ATOMIC_RELAXED);
+    if (min_ns < 0 || t1 - t0 < min_ns)
+        return;
+    if (t_span_slot < 0)
+        t_span_slot = (int)(__atomic_fetch_add(&g_span_slot_seq, 1,
+                                               __ATOMIC_RELAXED)
+                            % SPAN_SLOTS);
+    r = &g_spans[t_span_slot];
+    i = (r->n % SPAN_RING) * 3;
+    r->buf[i] = kind;
+    r->buf[i + 1] = (uint64_t)t0;
+    r->buf[i + 2] = (uint64_t)t1;
+    __atomic_store_n(&r->n, r->n + 1, __ATOMIC_RELEASE);
+}
+
+/* Arm (min_ns >= 0: record spans at least that long) or disarm (< 0). */
+void ompi_tpu_arena_spans_enable(int64_t min_ns) {
+    __atomic_store_n(&g_span_min_ns, min_ns, __ATOMIC_RELEASE);
+}
+
+/* Copy completed triples (kind, t0_ns, t1_ns) since the last drain into
+ * out (capacity 3*max_triples u64s); returns the triple count.  Single
+ * drainer assumed (Python under the GIL).  A ring that wrapped past the
+ * cursor loses the overwritten spans — bounded memory wins. */
+int64_t ompi_tpu_arena_spans_drain(uint64_t *out, int64_t max_triples) {
+    int64_t got = 0;
+    int s;
+    for (s = 0; s < SPAN_SLOTS && got < max_triples; ++s) {
+        span_ring_t *r = &g_spans[s];
+        uint64_t n = __atomic_load_n(&r->n, __ATOMIC_ACQUIRE);
+        uint64_t from = r->drained;
+        if (n - from > SPAN_RING)
+            from = n - SPAN_RING;
+        for (; from < n && got < max_triples; ++from, ++got) {
+            uint64_t i = (from % SPAN_RING) * 3;
+            out[got * 3] = r->buf[i];
+            out[got * 3 + 1] = r->buf[i + 1];
+            out[got * 3 + 2] = r->buf[i + 2];
+        }
+        r->drained = from;
+    }
+    return got;
+}
+
 /* -- flag waits ----------------------------------------------------------- */
 
 /* One bounded block on a single flag word: futex on the counter's low
@@ -134,9 +218,9 @@ static void block_on(const uint64_t *p, uint64_t cur, int64_t deadline,
  * each iteration one acquire load), then futex-style blocks until the
  * slice expires.  1 = satisfied, 0 = slice expired (caller re-checks
  * FT + deadline and calls again). */
-int64_t ompi_tpu_arena_wait(const uint64_t *flags, int64_t idx,
-                            uint64_t want, int64_t spins,
-                            int64_t slice_ns) {
+static int64_t arena_wait_impl(const uint64_t *flags, int64_t idx,
+                               uint64_t want, int64_t spins,
+                               int64_t slice_ns) {
     const uint64_t *p = flags + idx;
     int64_t s, deadline, nap;
     uint64_t cur;
@@ -160,9 +244,9 @@ int64_t ompi_tpu_arena_wait(const uint64_t *flags, int64_t idx,
 /* Park until flags[base + i*stride] >= want for EVERY i in [0, n) —
  * the _wait_all_arrive/_wait_all_depart sweep as one GIL-released
  * call.  Satisfied prefixes are never re-checked (i only advances). */
-int64_t ompi_tpu_arena_wait_all(const uint64_t *flags, int64_t base,
-                                int64_t stride, int64_t n, uint64_t want,
-                                int64_t spins, int64_t slice_ns) {
+static int64_t arena_wait_all_impl(const uint64_t *flags, int64_t base,
+                                   int64_t stride, int64_t n, uint64_t want,
+                                   int64_t spins, int64_t slice_ns) {
     int64_t i = 0, s, deadline, nap;
     uint64_t cur;
     for (s = 0; s < spins; ++s) {
@@ -192,8 +276,8 @@ int64_t ompi_tpu_arena_wait_all(const uint64_t *flags, int64_t base,
 
 /* Park until *p != seen (a counter moved at all) — the writer-side
  * ring-full backpressure wait, layout-agnostic. */
-int64_t ompi_tpu_arena_wait_change(const uint64_t *p, uint64_t seen,
-                                   int64_t spins, int64_t slice_ns) {
+static int64_t arena_wait_change_impl(const uint64_t *p, uint64_t seen,
+                                      int64_t spins, int64_t slice_ns) {
     int64_t s, deadline, nap;
     for (s = 0; s < spins; ++s) {
         if (load_u64(p) != seen)
@@ -228,9 +312,9 @@ void ompi_tpu_arena_wake(const uint64_t *flags, int64_t idx) {
  * first such index, or -1 on slice expiry.  The btl/shm poller's idle
  * window: one GIL-released call instead of a time.sleep(0) spin that
  * fights every other thread for the interpreter. */
-int64_t ompi_tpu_ring_wait_any(uint64_t **ctrs, const uint64_t *tails,
-                               int64_t n, int64_t spins,
-                               int64_t slice_ns) {
+static int64_t ring_wait_any_impl(uint64_t **ctrs, const uint64_t *tails,
+                                  int64_t n, int64_t spins,
+                                  int64_t slice_ns) {
     int64_t s, i, deadline, nap;
     for (s = 0; s < spins; ++s) {
         for (i = 0; i < n; ++i)
@@ -250,6 +334,48 @@ int64_t ompi_tpu_ring_wait_any(uint64_t **ctrs, const uint64_t *tails,
         if (nap < NAP_MAX_NS)
             nap *= 2;
     }
+}
+
+/* Exported park entries: the impl bracketed by the span stamps.  When
+ * disarmed span_t0() returns 0 and the wrapper adds one relaxed load. */
+int64_t ompi_tpu_arena_wait(const uint64_t *flags, int64_t idx,
+                            uint64_t want, int64_t spins,
+                            int64_t slice_ns) {
+    int64_t t0 = span_t0();
+    int64_t r = arena_wait_impl(flags, idx, want, spins, slice_ns);
+    if (t0)
+        span_record(SPAN_KIND_WAIT, t0);
+    return r;
+}
+
+int64_t ompi_tpu_arena_wait_all(const uint64_t *flags, int64_t base,
+                                int64_t stride, int64_t n, uint64_t want,
+                                int64_t spins, int64_t slice_ns) {
+    int64_t t0 = span_t0();
+    int64_t r = arena_wait_all_impl(flags, base, stride, n, want, spins,
+                                    slice_ns);
+    if (t0)
+        span_record(SPAN_KIND_WAIT_ALL, t0);
+    return r;
+}
+
+int64_t ompi_tpu_arena_wait_change(const uint64_t *p, uint64_t seen,
+                                   int64_t spins, int64_t slice_ns) {
+    int64_t t0 = span_t0();
+    int64_t r = arena_wait_change_impl(p, seen, spins, slice_ns);
+    if (t0)
+        span_record(SPAN_KIND_WAIT_CHANGE, t0);
+    return r;
+}
+
+int64_t ompi_tpu_ring_wait_any(uint64_t **ctrs, const uint64_t *tails,
+                               int64_t n, int64_t spins,
+                               int64_t slice_ns) {
+    int64_t t0 = span_t0();
+    int64_t r = ring_wait_any_impl(ctrs, tails, n, spins, slice_ns);
+    if (t0)
+        span_record(SPAN_KIND_RING_WAIT, t0);
+    return r;
 }
 
 /* -- publishes ------------------------------------------------------------ */
@@ -369,7 +495,7 @@ int64_t ompi_tpu_arena_fold(uint8_t *dst, uint8_t **srcs, int64_t nsrc,
 }
 
 /* version tag so the loader can detect stale cached builds */
-int64_t ompi_tpu_arena_abi(void) { return 1; }
+int64_t ompi_tpu_arena_abi(void) { return 2; }
 
 #ifdef __cplusplus
 }  /* extern "C" */

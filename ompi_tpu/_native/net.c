@@ -80,6 +80,87 @@ static int poll_ms(int64_t remain_ns) {
     return (int)ms;
 }
 
+/* -- span rings ----------------------------------------------------------- *
+ *
+ * Begin–end timestamps of the GIL-released transport parks, drained by
+ * the Python side into its flight recorder (same design as arena.c:
+ * per-thread single-writer rings, slot collisions may tear a triple —
+ * span data is metrics, not control flow).  Disarmed (min_ns < 0, the
+ * default) each entry pays one relaxed load. */
+
+#define SPAN_SLOTS 16
+#define SPAN_RING 256
+#define SPAN_KIND_WRITEV 1
+#define SPAN_KIND_SEND3 2
+#define SPAN_KIND_POLL 3
+#define SPAN_KIND_RECV_INTO 4
+
+typedef struct {
+    uint64_t n;                  /* triples ever recorded (writer-owned) */
+    uint64_t drained;            /* drain cursor (drainer-owned)         */
+    uint64_t buf[SPAN_RING * 3]; /* kind, t0_ns, t1_ns                   */
+} span_ring_t;
+
+static span_ring_t g_spans[SPAN_SLOTS];
+static int64_t g_span_min_ns = -1;   /* < 0 = disarmed */
+static uint64_t g_span_slot_seq = 0;
+static __thread int t_span_slot = -1;
+
+/* begin-of-span stamp: 0 when disarmed (entries skip the end stamp) */
+static int64_t span_t0(void) {
+    if (__atomic_load_n(&g_span_min_ns, __ATOMIC_RELAXED) < 0)
+        return 0;
+    return now_ns();
+}
+
+static void span_record(uint64_t kind, int64_t t0) {
+    span_ring_t *r;
+    uint64_t i;
+    int64_t t1 = now_ns();
+    int64_t min_ns = __atomic_load_n(&g_span_min_ns, __ATOMIC_RELAXED);
+    if (min_ns < 0 || t1 - t0 < min_ns)
+        return;
+    if (t_span_slot < 0)
+        t_span_slot = (int)(__atomic_fetch_add(&g_span_slot_seq, 1,
+                                               __ATOMIC_RELAXED)
+                            % SPAN_SLOTS);
+    r = &g_spans[t_span_slot];
+    i = (r->n % SPAN_RING) * 3;
+    r->buf[i] = kind;
+    r->buf[i + 1] = (uint64_t)t0;
+    r->buf[i + 2] = (uint64_t)t1;
+    __atomic_store_n(&r->n, r->n + 1, __ATOMIC_RELEASE);
+}
+
+/* Arm (min_ns >= 0: record spans at least that long) or disarm (< 0). */
+void ompi_tpu_net_spans_enable(int64_t min_ns) {
+    __atomic_store_n(&g_span_min_ns, min_ns, __ATOMIC_RELEASE);
+}
+
+/* Copy completed triples (kind, t0_ns, t1_ns) since the last drain into
+ * out (capacity 3*max_triples u64s); returns the triple count.  Single
+ * drainer assumed (Python under the GIL); a wrapped ring drops the
+ * overwritten spans. */
+int64_t ompi_tpu_net_spans_drain(uint64_t *out, int64_t max_triples) {
+    int64_t got = 0;
+    int s;
+    for (s = 0; s < SPAN_SLOTS && got < max_triples; ++s) {
+        span_ring_t *r = &g_spans[s];
+        uint64_t n = __atomic_load_n(&r->n, __ATOMIC_ACQUIRE);
+        uint64_t from = r->drained;
+        if (n - from > SPAN_RING)
+            from = n - SPAN_RING;
+        for (; from < n && got < max_triples; ++from, ++got) {
+            uint64_t i = (from % SPAN_RING) * 3;
+            out[got * 3] = r->buf[i];
+            out[got * 3 + 1] = r->buf[i + 1];
+            out[got * 3 + 2] = r->buf[i + 2];
+        }
+        r->drained = from;
+    }
+    return got;
+}
+
 /* -- send side ------------------------------------------------------------ */
 
 /* Drain a scatter-gather backlog: `parts` is niov (addr, len) u64
@@ -91,8 +172,8 @@ static int poll_ms(int64_t remain_ns) {
  * remainder and re-runs its FT checks), or -errno on a hard socket
  * error with no progress (progress-then-error returns the progress;
  * the next call surfaces the error). */
-int64_t ompi_tpu_net_writev(int64_t fd, const uint64_t *parts,
-                            int64_t niov, int64_t slice_ns) {
+static int64_t net_writev_impl(int64_t fd, const uint64_t *parts,
+                               int64_t niov, int64_t slice_ns) {
     struct iovec iov[NET_IOV_BATCH];
     struct msghdr msg;
     int64_t i = 0, written = 0, deadline;
@@ -168,11 +249,11 @@ int64_t ompi_tpu_net_writev(int64_t fd, const uint64_t *parts,
  * slice.  Returns total bytes written this call (the caller resumes a
  * partial frame through writev with adjusted offsets), or -errno on a
  * hard error with no progress. */
-int64_t ompi_tpu_net_send3(int64_t fd,
-                           const uint8_t *p0, int64_t l0,
-                           const uint8_t *p1, int64_t l1,
-                           const uint8_t *p2, int64_t l2,
-                           int64_t slice_ns) {
+static int64_t net_send3_impl(int64_t fd,
+                              const uint8_t *p0, int64_t l0,
+                              const uint8_t *p1, int64_t l1,
+                              const uint8_t *p2, int64_t l2,
+                              int64_t slice_ns) {
     struct iovec iov[3];
     struct msghdr msg;
     int64_t total = l0 + l1 + l2, written = 0, deadline;
@@ -228,9 +309,9 @@ int64_t ompi_tpu_net_send3(int64_t fd,
  * readable: the read surfaces them).  Returns the number of ready
  * fds, 0 on slice expiry, or -errno (-EINVAL when nfds exceeds the
  * stack cap — the caller falls back to select()). */
-int64_t ompi_tpu_net_poll(const int64_t *fds, int64_t nfds,
-                          uint8_t *ready, int64_t spins,
-                          int64_t slice_ns) {
+static int64_t net_poll_impl(const int64_t *fds, int64_t nfds,
+                             uint8_t *ready, int64_t spins,
+                             int64_t slice_ns) {
     struct pollfd pfds[NET_POLL_MAX];
     int64_t i, s, deadline;
     int rc;
@@ -293,8 +374,8 @@ int64_t ompi_tpu_net_read(int64_t fd, uint8_t *buf, int64_t cap) {
  * (>= 0; the caller re-runs FT checks and calls again with the
  * remainder), NET_EOF on orderly shutdown with no progress this call,
  * or -errno. */
-int64_t ompi_tpu_net_recv_into(int64_t fd, uint8_t *dst, int64_t want,
-                               int64_t slice_ns) {
+static int64_t net_recv_into_impl(int64_t fd, uint8_t *dst, int64_t want,
+                                  int64_t slice_ns) {
     int64_t got = 0, deadline;
     ssize_t n;
 
@@ -323,6 +404,49 @@ int64_t ompi_tpu_net_recv_into(int64_t fd, uint8_t *dst, int64_t want,
         return got > 0 ? got : -(int64_t)errno;
     }
     return got;
+}
+
+/* Exported transport parks: the impl bracketed by the span stamps.
+ * When disarmed span_t0() returns 0 and the wrapper adds one relaxed
+ * load. */
+int64_t ompi_tpu_net_writev(int64_t fd, const uint64_t *parts,
+                            int64_t niov, int64_t slice_ns) {
+    int64_t t0 = span_t0();
+    int64_t r = net_writev_impl(fd, parts, niov, slice_ns);
+    if (t0)
+        span_record(SPAN_KIND_WRITEV, t0);
+    return r;
+}
+
+int64_t ompi_tpu_net_send3(int64_t fd,
+                           const uint8_t *p0, int64_t l0,
+                           const uint8_t *p1, int64_t l1,
+                           const uint8_t *p2, int64_t l2,
+                           int64_t slice_ns) {
+    int64_t t0 = span_t0();
+    int64_t r = net_send3_impl(fd, p0, l0, p1, l1, p2, l2, slice_ns);
+    if (t0)
+        span_record(SPAN_KIND_SEND3, t0);
+    return r;
+}
+
+int64_t ompi_tpu_net_poll(const int64_t *fds, int64_t nfds,
+                          uint8_t *ready, int64_t spins,
+                          int64_t slice_ns) {
+    int64_t t0 = span_t0();
+    int64_t r = net_poll_impl(fds, nfds, ready, spins, slice_ns);
+    if (t0)
+        span_record(SPAN_KIND_POLL, t0);
+    return r;
+}
+
+int64_t ompi_tpu_net_recv_into(int64_t fd, uint8_t *dst, int64_t want,
+                               int64_t slice_ns) {
+    int64_t t0 = span_t0();
+    int64_t r = net_recv_into_impl(fd, dst, want, slice_ns);
+    if (t0)
+        span_record(SPAN_KIND_RECV_INTO, t0);
+    return r;
 }
 
 /* Parse the length-prefix framing natively: scan buf[0..len) for
@@ -355,7 +479,7 @@ int64_t ompi_tpu_net_scan(const uint8_t *buf, int64_t len,
 }
 
 /* version tag so the loader can detect stale cached builds */
-int64_t ompi_tpu_net_abi(void) { return 2; }
+int64_t ompi_tpu_net_abi(void) { return 3; }
 
 #ifdef __cplusplus
 }  /* extern "C" */

@@ -163,9 +163,14 @@ def _run_recorded(comm, slot: str, kind: str, sig: int,
                     labels=f'slot="{slot}",provider="{provider}",'
                            f'szb="{szb}"')
             if trace_mod.active:
+                # cid+seq is the cross-rank round key: every rank's
+                # span of one collective records the same pair, and
+                # the timeline merge chains them into one flow arrow
+                # path (the straggler is where the arrow waits)
                 trace_mod.complete(
                     "coll", slot, t0, rank=rank, provider=provider,
-                    comm=comm.name, cid=comm.cid, size=comm.size)
+                    comm=comm.name, cid=comm.cid, size=comm.size,
+                    seq=seq)
 
 
 def _make_dispatch(slot: str, host_fn, host_name: Optional[str],

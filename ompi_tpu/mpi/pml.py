@@ -699,6 +699,12 @@ class PmlOb1:
         _fl_t0 = 0
         if trace_mod.active:
             hdr["fl"] = fl = self.rank * _FLOW_STRIDE + next(self._ids)
+            # the (trace_id, span_id) pair: trace_id scopes the flow id
+            # to ONE job's trace — merged timelines from a shared
+            # TMPDIR (or a DVM serving many jobs) must not stitch
+            # arrows between flows of different jobs that happened to
+            # draw the same fl
+            hdr["tc"] = trace_mod.trace_id()
             _fl_t0 = trace_mod.begin()
         # eager completion latency (histogram plane, timeline-independent)
         _h_t0 = time.monotonic_ns() if trace_mod.hist_active else 0
@@ -724,7 +730,8 @@ class PmlOb1:
             if fl and trace_mod.active:
                 trace_mod.complete("pml", "eager_send", _fl_t0,
                                    rank=self.rank, peer=peer,
-                                   nbytes=len(payload), fl=fl)
+                                   nbytes=len(payload), fl=fl,
+                                   tc=trace_mod.trace_id())
         elif eager:
             hdr["t"] = "eager"
             # sendi fast path (≈ pml_ob1_isend.c:89-119): the frame goes
@@ -748,7 +755,8 @@ class PmlOb1:
             if fl and trace_mod.active:
                 trace_mod.complete("pml", "eager_send", _fl_t0,
                                    rank=self.rank, peer=peer,
-                                   nbytes=len(payload), fl=fl)
+                                   nbytes=len(payload), fl=fl,
+                                   tc=trace_mod.trace_id())
         else:
             sid = next(self._ids)
             hdr.update(t="rndv", size=len(payload), sid=sid)
@@ -1661,11 +1669,13 @@ class PmlOb1:
         if done:
             if state.trace_t0 and trace_mod.active:
                 _fl = state.src_hdr.get("fl", 0)
+                _tc = state.src_hdr.get("tc")
                 trace_mod.complete(
                     "pml", "rndv_recv", state.trace_t0, rank=self.rank,
                     peer=state.peer, nbytes=len(state.data),
                     direct=state.direct,
-                    **({"fl": _fl} if _fl else {}))
+                    **({"fl": _fl} if _fl else {}),
+                    **({"tc": _tc} if _tc is not None else {}))
             if state.direct:
                 self._complete_direct(state)
             else:
@@ -1744,9 +1754,12 @@ class PmlOb1:
         req.status.count_bytes = len(payload)
         req.complete(out)
         if _fl and trace_mod.active:
+            _tc = hdr.get("tc")
             trace_mod.complete("pml", "eager_recv", _fl_t0,
                                rank=self.rank, peer=peer,
-                               nbytes=len(payload), fl=_fl)
+                               nbytes=len(payload), fl=_fl,
+                               **({"tc": _tc} if _tc is not None
+                                  else {}))
 
     # -- send worker (the only thread that writes payloads) ----------------
 
@@ -1806,7 +1819,9 @@ class PmlOb1:
                             "pml", "rndv_send", _t0, rank=self.rank,
                             peer=state.peer, nbytes=len(data),
                             fragments=len(offs),
-                            **({"fl": state.fl} if state.fl else {}))
+                            **({"fl": state.fl, "tc":
+                                trace_mod.trace_id()}
+                               if state.fl else {}))
             except Exception:  # noqa: BLE001 — the worker must survive
                 _log.error("send worker: unexpected error\n%s",
                            __import__("traceback").format_exc())

@@ -63,7 +63,8 @@ __all__ = [
     "CollRecorder", "collrec", "coll_post", "coll_done", "coll_err",
     "coll_event", "coll_stuck", "collrec_tail", "collrec_sig",
     "collrec_kind_id", "collrec_kind_name", "COLLREC_KINDS",
-    "COLLREC_TAIL", "push_now",
+    "COLLREC_TAIL", "push_now", "trace_id", "next_span_id",
+    "drain_native_spans", "timeline_capture",
 ]
 
 ENV_FLAG = "OMPI_TPU_TRACE"
@@ -73,6 +74,10 @@ ENV_EVENTS = "OMPI_TPU_TRACE_EVENTS"
 #: ``host:port`` of the daemon's local collector — each rank's pvar
 #: snapshot rides there, then TAG_METRICS up the orted tree
 ENV_METRICS_URI = "OMPI_TPU_METRICS_URI"
+#: external knob: minimum duration (ns) a native-plane park/batch span
+#: must reach before the C side records it into its span ring (bounds
+#: the drain volume; 0 records everything once the timeline is armed)
+ENV_NATIVE_SPAN_MIN = "OMPI_TPU_TRACE_NATIVE_MIN_NS"
 
 #: the timeline categories (→ one Chrome tid per category at export)
 CATEGORIES = ("pml", "btl", "coll", "osc", "io", "ckpt", "datatype",
@@ -227,6 +232,21 @@ _COUNTER_SPECS = (
      "doorbell waits, receive-poller slices that expired empty, and "
      "sender ring-full backpressure waits — FT checks re-run between "
      "each)"),
+    # telemetry self-metering: the observability plane measured by
+    # itself (the ROADMAP item-6 fan-in data — what does the uplink
+    # cost, and is the recorder silently losing evidence?)
+    ("metrics_push_datagrams_total", "datagrams",
+     "pvar-snapshot datagrams this rank pushed to its owning orted's "
+     "UDP metrics collector (periodic cadence + out-of-cadence "
+     "push_now triggers)"),
+    ("metrics_push_bytes_total", "bytes",
+     "serialized bytes of this rank's metrics-uplink datagrams — with "
+     "metrics_push_datagrams_total this is the rank→orted hop's "
+     "bytes/s, the first rung of the per-hop uplink cost ladder"),
+    ("trace_native_spans_total", "spans",
+     "native-plane park/batch spans drained from the arena/net span "
+     "rings into the flight recorder (GIL-released sections made "
+     "visible; gated on the timeline being armed)"),
 )
 
 #: plain-int counter store: dict increments, no lock — losses under
@@ -737,6 +757,39 @@ for _name, _klass, _unit, _desc, _read in (
         _name, _klass, unit=_unit, description=_desc, read_fn=_read))
 
 
+def _recorder_stat(attr: str) -> float:
+    # late-bound: `recorder` is defined below this registration block
+    rec = globals().get("recorder")
+    return float(getattr(rec, attr)) if rec is not None else 0.0
+
+
+# flight-recorder loss accounting as pushed pvars: silent trace loss
+# (a wrapped ring overwriting evidence) becomes visible on /status and
+# --dvm-ps instead of only inside a postmortem dump's otherData
+for _name, _klass, _unit, _desc, _read in (
+    ("trace_events_total", PvarClass.COUNTER, "events",
+     "events ever emitted into this rank's flight-recorder ring "
+     "(0 while the timeline is disarmed)",
+     lambda _b: _recorder_stat("events_total")),
+    ("trace_dropped_total", PvarClass.COUNTER, "events",
+     "flight-recorder events lost to ring wrap (events_total beyond "
+     "capacity) — a nonzero value means the merged timeline has holes "
+     "and OMPI_TPU_TRACE_EVENTS should grow",
+     lambda _b: _recorder_stat("dropped")),
+    ("trace_ring_occupancy", PvarClass.LEVEL, "events",
+     "events currently held in the flight-recorder ring "
+     "(min(events_total, capacity))",
+     lambda _b: min(_recorder_stat("events_total"),
+                    _recorder_stat("capacity"))),
+    ("trace_ring_capacity", PvarClass.LEVEL, "events",
+     "flight-recorder ring capacity (OMPI_TPU_TRACE_EVENTS; 0 while "
+     "disarmed)",
+     lambda _b: _recorder_stat("capacity")),
+):
+    pvar_registry.register_or_get(Pvar(
+        _name, _klass, unit=_unit, description=_desc, read_fn=_read))
+
+
 # ---------------------------------------------------------------------------
 # the ring buffer
 # ---------------------------------------------------------------------------
@@ -801,6 +854,42 @@ _sigterm_installed = False
 #: (pml, cb) pairs attach_pml registered
 _pml_listeners: list[tuple[Any, Callable[[str, Any], None]]] = []
 
+# ---------------------------------------------------------------------------
+# trace context (trace_id, span_id): the causal-flow pair carried in PML
+# match headers and control-plane envelopes so the exporter can stitch
+# send→recv, collective rounds and capture fan-outs across ranks
+# ---------------------------------------------------------------------------
+
+#: span-id namespace stride (mirrors pml._FLOW_STRIDE): ids are
+#: ``rank * stride + local counter`` — globally unique without any
+#: cross-rank coordination
+SPAN_ID_STRIDE = 1 << 40
+
+_trace_id = 0
+_span_ids = itertools.count(1)
+
+
+def trace_id() -> int:
+    """The job-wide trace id (crc32 of the jobid — DETERMINISTIC across
+    ranks and processes, never hash(): PYTHONHASHSEED randomization
+    would split one job's flow edges into disjoint traces).  0 until
+    :func:`enable` learns a jobid."""
+    return _trace_id
+
+
+def _compute_trace_id(jobid: int) -> int:
+    import zlib
+
+    return zlib.crc32(b"ompi_tpu_trace_%d" % int(jobid)) or 1
+
+
+def next_span_id(rank: int = -1) -> int:
+    """A fresh globally-unique span id for flow correlation (the
+    span_id half of the (trace_id, span_id) context pair)."""
+    r = rank if rank >= 0 else (recorder.rank if recorder is not None
+                                else 0)
+    return max(0, r) * SPAN_ID_STRIDE + next(_span_ids)
+
 
 def env_enabled() -> bool:
     return os.environ.get(ENV_FLAG, "") not in ("", "0")
@@ -816,7 +905,7 @@ def enable(capacity: Optional[int] = None, rank: int = -1,
     SIGTERM handler that flushes the buffer before dying — the errmgr
     abort path kills ranks with SIGTERM (then a grace, then SIGKILL), so
     every rank's trace survives a job teardown."""
-    global active, recorder
+    global active, recorder, _trace_id
     with _lock:
         if recorder is None:
             if capacity is None:
@@ -837,6 +926,8 @@ def enable(capacity: Optional[int] = None, rank: int = -1,
             if jobid:
                 recorder.jobid = jobid
         active = True
+        _trace_id = _compute_trace_id(recorder.jobid)
+    _native_spans_arm(True)
     if install_signal:
         _install_sigterm_flush()
     return recorder
@@ -852,6 +943,7 @@ def disable() -> Optional[FlightRecorder]:
         active = False
         rec, recorder = recorder, None
         listeners, _pml_listeners[:] = list(_pml_listeners), []
+    _native_spans_arm(False)
     for pml, cb in listeners:
         try:
             pml.remove_listener(cb)
@@ -961,6 +1053,80 @@ def detach_pml(pml: Any) -> None:
 
 
 # ---------------------------------------------------------------------------
+# native-plane spans: arena.c / net.c park+batch begin–end pairs drained
+# from the C-side span rings into the flight recorder, so GIL-released
+# sections stop being invisible gaps in the timeline
+# ---------------------------------------------------------------------------
+
+#: below this duration the C side skips the ring store entirely (the
+#: drain must not become its own hot-path tax); overridable via
+#: OMPI_TPU_TRACE_NATIVE_MIN_NS
+_NATIVE_SPAN_MIN_DEFAULT = 10_000
+
+
+def _native_span_min_ns() -> int:
+    try:
+        return int(os.environ.get(ENV_NATIVE_SPAN_MIN, "")
+                   or _NATIVE_SPAN_MIN_DEFAULT)
+    except ValueError:
+        return _NATIVE_SPAN_MIN_DEFAULT
+
+
+def _native_spans_arm(on: bool) -> None:
+    """Best-effort arm/disarm of the C span rings (no-op when the
+    native plane never built — the timeline works without it)."""
+    try:
+        from ompi_tpu import _native
+
+        _native.spans_enable(_native_span_min_ns() if on else -1)
+    except Exception:  # noqa: BLE001 — observability must not break init
+        pass
+
+
+def drain_native_spans(limit: int = 4096) -> int:
+    """Pull completed park/batch spans out of the native rings into the
+    flight recorder (called on the uplink cadence, at flush, and by the
+    live timeline capture).  Returns the number of spans drained."""
+    rec = recorder
+    if rec is None:
+        return 0
+    try:
+        from ompi_tpu import _native
+
+        spans = _native.spans_drain(limit)
+    except Exception:  # noqa: BLE001 — native plane absent: nothing to do
+        return 0
+    for name, t0_ns, t1_ns in spans:
+        rec.add(t0_ns, t1_ns - t0_ns, "runtime", f"native_{name}",
+                rec.rank, None)
+    if spans:
+        count("trace_native_spans_total", len(spans))
+    return len(spans)
+
+
+def timeline_capture(tail: int = 2048) -> dict[str, Any]:
+    """The bounded live-capture payload a TAG_TIMELINE doctor query
+    pulls from a RUNNING rank: the newest ``tail`` chrome events plus
+    the clock anchor and loss accounting the HNP merge needs.  Safe
+    with tracing off (events empty, anchors still valid)."""
+    drain_native_spans()
+    rec = recorder
+    events = chrome_events(rec)[-max(0, int(tail)):] if rec else []
+    return {
+        "rank": rec.rank if rec else -1,
+        "jobid": rec.jobid if rec else 0,
+        "trace_id": _trace_id,
+        "events": events,
+        "events_total": rec.events_total if rec else 0,
+        "dropped": rec.dropped if rec else 0,
+        "capacity": rec.capacity if rec else 0,
+        "clock_offset_ns": time.time_ns() - time.monotonic_ns(),
+        "counters": counters_snapshot(),
+        "collrec": collrec_tail(64),
+    }
+
+
+# ---------------------------------------------------------------------------
 # export
 # ---------------------------------------------------------------------------
 
@@ -1012,12 +1178,15 @@ def flush(path: Optional[str] = None,
     rec = rec if rec is not None else recorder
     if rec is None:
         return None
+    if rec is recorder:
+        drain_native_spans()     # GIL-released sections land in the dump
     if path is None:
         path = default_path(rec.jobid, rec.rank)
     doc = {
         "displayTimeUnit": "ns",
         "otherData": {
             "rank": rec.rank, "jobid": rec.jobid,
+            "trace_id": _trace_id,
             "events_total": rec.events_total, "dropped": rec.dropped,
             # wall-vs-monotonic anchor: event ts are CLOCK_MONOTONIC
             # (boot-relative, per machine); the exporter uses this
@@ -1178,6 +1347,11 @@ class _MetricsPusher:
             pass
 
     def _push_locked(self, dss: Any) -> None:
+        if active:
+            # the uplink cadence doubles as the native span-ring drain
+            # beat: parks complete between pushes, so the rings stay
+            # small and a live timeline capture sees fresh spans
+            drain_native_spans()
         cur = metrics_values()
         cur_h = hist_values()
         full = self._n % FULL_EVERY == 0
@@ -1200,9 +1374,12 @@ class _MetricsPusher:
         self._n += 1
         if not vals and not full:
             return
-        self._sock.sendto(
-            dss.pack(("m1", self.jobid, self.rank, self._n, vals)),
-            self._addr)
+        pkt = dss.pack(("m1", self.jobid, self.rank, self._n, vals))
+        self._sock.sendto(pkt, self._addr)
+        # self-metering AFTER the send: the datagram that carried these
+        # counters doesn't count itself (the next push reports it)
+        count("metrics_push_datagrams_total")
+        count("metrics_push_bytes_total", len(pkt))
         self._last = cur
         self._last_h = cur_h
 

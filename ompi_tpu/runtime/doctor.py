@@ -55,8 +55,8 @@ from ompi_tpu.core import dss, output
 from ompi_tpu.core.config import VarType, register_var, var_registry
 
 __all__ = ["DoctorResponder", "start_responder", "stop_responder",
-           "capture", "query_rank", "proc_probe", "analyze",
-           "thread_stacks"]
+           "capture", "query_rank", "query_timeline", "proc_probe",
+           "analyze", "thread_stacks"]
 
 _log = output.get_stream("doctor")
 
@@ -196,10 +196,27 @@ class DoctorResponder:
                 return
             try:
                 msg = dss.unpack(blob, n=1)[0]
-                if msg[0] != "cap":
+                if msg[0] not in ("cap", "tl"):
                     continue
+                req = msg[0]
                 token = int(msg[1]) if len(msg) > 1 else 0
             except Exception:  # noqa: BLE001 — garbage datagram: drop
+                continue
+            if req == "tl":
+                # live-timeline tail: the flight-recorder slice the
+                # TAG_TIMELINE fan-out merges into the /timeline trace
+                try:
+                    tail = int(msg[2]) if len(msg) > 2 else 2048
+                    from ompi_tpu.mpi import trace as trace_mod
+
+                    doc = trace_mod.timeline_capture(tail)
+                    doc.setdefault("rank", self.rank)
+                except Exception as e:  # noqa: BLE001
+                    doc = {"rank": self.rank, "error": repr(e)}
+                try:
+                    self._sock.sendto(self._shrink_tl(token, doc), addr)
+                except OSError:
+                    pass
                 continue
             try:
                 doc = capture(self.rank, self.jobid, self.pml)
@@ -231,6 +248,23 @@ class DoctorResponder:
         return dss.pack(("cap", token, {
             "rank": doc.get("rank"), "cur": doc.get("cur"),
             "truncated": True}))
+
+    @staticmethod
+    def _shrink_tl(token: int, doc: dict) -> bytes:
+        """Pack a timeline reply under the UDP ceiling by halving the
+        event tail (newest kept) until it fits — a shorter window beats
+        a failed capture."""
+        blob = dss.pack(("tl", token, doc))
+        while len(blob) > _MAX_REPLY:
+            events = doc.get("events") or []
+            if not events:
+                return dss.pack(("tl", token, {
+                    "rank": doc.get("rank"), "truncated": True}))
+            doc = dict(doc)
+            doc["events"] = events[-(len(events) // 2):]
+            doc["truncated"] = True
+            blob = dss.pack(("tl", token, doc))
+        return blob
 
     def close(self) -> None:
         self._stop.set()
@@ -299,6 +333,38 @@ def query_rank(port: int, timeout: float = 0.8) -> Optional[dict]:
             except Exception:  # noqa: BLE001
                 continue
             if msg[0] == "cap" and int(msg[1]) == token:
+                return dict(msg[2])
+        return None
+    except OSError:
+        return None
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+
+def query_timeline(port: int, tail: int = 2048,
+                   timeout: float = 0.8) -> Optional[dict]:
+    """One flight-recorder tail from a local rank's responder (None on
+    silence) — the TAG_TIMELINE analog of :func:`query_rank`."""
+    token = time.monotonic_ns() & 0x7FFFFFFF
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        sock.settimeout(timeout)
+        sock.sendto(dss.pack(("tl", token, int(tail))),
+                    ("127.0.0.1", int(port)))
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                blob, _addr = sock.recvfrom(1 << 16)
+            except socket.timeout:
+                return None
+            try:
+                msg = dss.unpack(blob, n=1)[0]
+            except Exception:  # noqa: BLE001
+                continue
+            if msg[0] == "tl" and int(msg[1]) == token:
                 return dict(msg[2])
         return None
     except OSError:

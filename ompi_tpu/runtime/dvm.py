@@ -108,6 +108,15 @@ class DvmHnp(MultiHostLauncher):
         self._doctor_epoch = 0
         self._doctor_lock = threading.Lock()
         self._last_doctor: Optional[dict] = None
+        # live-timeline capture plumbing (same epoch-fenced fan-in as
+        # the doctor, answering TAG_TIMELINE_REPLY)
+        self._timeline: dict[int, list] = {}  # vpid → capture rows
+        self._timeline_cv = threading.Condition()
+        self._timeline_epoch = 0
+        self._timeline_lock = threading.Lock()
+        self._last_timeline: Optional[dict] = None
+        self._tl_captures = 0                 # self-metering: /timeline
+        self._tl_merge_ns = 0                 # rounds + HNP merge cost
         #: (jobid, rank) → highest coll_stuck_events_total seen — the
         #: watchdog's new-stuck-event edge detector
         self._stuck_seen: dict[tuple, float] = {}
@@ -131,6 +140,8 @@ class DvmHnp(MultiHostLauncher):
         self.rml.register_recv(rml.TAG_STATS_REPLY, self._on_stats_reply)
         self.rml.register_recv(rml.TAG_DOCTOR_REPLY,
                                self._on_doctor_reply)
+        self.rml.register_recv(rml.TAG_TIMELINE_REPLY,
+                               self._on_timeline_reply)
         self._ctrl = socket.create_server(("127.0.0.1", 0))
         port = self._ctrl.getsockname()[1]
         # metrics endpoint BEFORE the uri file: clients poll for the uri
@@ -436,6 +447,75 @@ class DvmHnp(MultiHostLauncher):
             except Exception as e:  # noqa: BLE001 — watchdog survives
                 _log.verbose(1, "doctor watchdog tick failed: %r", e)
 
+    # -- the live cross-rank timeline --------------------------------------
+
+    def _on_timeline_reply(self, origin: int, payload) -> None:
+        vpid, epoch, rows = payload
+        with self._timeline_cv:
+            if epoch != self._timeline_epoch:
+                return                # late reply from an earlier round
+            self._timeline[vpid] = [dict(r) for r in rows]
+            self._timeline_cv.notify_all()
+
+    def _collect_timeline(self, tail: int,
+                          timeout: float = 4.0) -> list[dict]:
+        """One live trace capture: xcast TAG_TIMELINE, gather every
+        daemon's per-rank recorder tails (each stamped with the
+        daemon's measured clock offset-to-root).  Serialized +
+        epoch-fenced like the doctor collection."""
+        with self._timeline_lock:
+            n = len(self.vm_job.nodes) if self.vm_job else 0
+            with self._timeline_cv:
+                self._timeline.clear()
+                self._timeline_epoch += 1
+                epoch = self._timeline_epoch
+            try:
+                self.rml.xcast(rml.TAG_TIMELINE, (epoch, int(tail)))
+            except Exception:  # noqa: BLE001 — tree tearing down
+                return []
+            deadline = time.monotonic() + timeout
+            with self._timeline_cv:
+                self._timeline_cv.wait_for(
+                    lambda: len(self._timeline) >= n,
+                    timeout=max(0.0, deadline - time.monotonic()))
+                captures: list[dict] = []
+                for rows in self._timeline.values():
+                    captures.extend(rows)
+            return captures
+
+    def _timeline_doc(self, tail: int = 2048) -> dict:
+        """The /timeline document: a merged, skew-corrected Chrome
+        trace of the RUNNING job (live TAG_TIMELINE round); the cached
+        last capture (marked stale) otherwise."""
+        from ompi_tpu.runtime import timeline as timeline_mod
+
+        vm = self.vm_job
+        job = self._cur_job
+        running = (job is not None and job is not vm
+                   and any(p.state == ProcState.RUNNING
+                           for p in job.procs))
+        if not running:
+            if self._last_timeline is not None:
+                doc = dict(self._last_timeline)
+                doc["otherData"] = dict(doc.get("otherData") or {},
+                                        stale=True)
+                return doc
+            return {"displayTimeUnit": "ns", "traceEvents": [],
+                    "otherData": {"idle": True,
+                                  "detail": "no job running and no "
+                                            "cached capture"}}
+        captures = self._collect_timeline(tail)
+        t0 = time.monotonic_ns()    # merge cost alone, not the fan-in
+        doc = timeline_mod.merge_captures(captures, jobid=job.jobid)
+        merge_ns = time.monotonic_ns() - t0
+        with self._timeline_cv:
+            self._tl_captures += 1
+            self._tl_merge_ns += merge_ns
+        doc["otherData"]["ts"] = time.time()
+        doc["otherData"]["merge_ms"] = round(merge_ns / 1e6, 2)
+        self._last_timeline = doc
+        return doc
+
     def _daemon_rows(self) -> list[dict]:
         vm = self.vm_job
         if vm is None:
@@ -466,6 +546,9 @@ class DvmHnp(MultiHostLauncher):
         heads = self.metrics_agg.rank_values(job.jobid, self._CUR_NAMES)
         rejoins = self.metrics_agg.rank_values(job.jobid,
                                                ("coll_rejoin_total",))
+        traces = self.metrics_agg.rank_values(
+            job.jobid, ("trace_dropped_total", "trace_ring_occupancy",
+                        "trace_ring_capacity", "rank_clock_to_root_ns"))
         limit = int(var_registry.get("errmgr_max_restarts") or 0)
         procs = []
         for p in job.procs:
@@ -498,6 +581,24 @@ class DvmHnp(MultiHostLauncher):
                 # a rank whose lives grew without peers' rejoins
                 # ticking is p2p-only recovered, not collective-capable
                 row["rejoins"] = int(rj)
+            tv = traces.get(p.rank)
+            if tv is not None:
+                # flight-recorder health from the pushed trace pvars: a
+                # rank whose ring keeps dropping needs a bigger capacity
+                # (or a narrower event set) before its captures lie
+                cap = tv.get("trace_ring_capacity")
+                if cap:
+                    row["trace_ring"] = (
+                        f"{int(tv.get('trace_ring_occupancy', 0))}"
+                        f"/{int(cap)}")
+                dropped = tv.get("trace_dropped_total")
+                if dropped:
+                    row["trace_dropped"] = int(dropped)
+                # measured monotonic offset of the rank's host to the
+                # HNP's clock domain (the skew /timeline corrects by)
+                off = tv.get("rank_clock_to_root_ns")
+                if off is not None:
+                    row["clock_off_us"] = round(float(off) / 1e3, 1)
             hv = heads.get(p.rank)
             if hv is not None and hv.get("coll_cur_seq", -1) >= 0:
                 # the pushed recorder head: the rank's last collective
@@ -544,7 +645,8 @@ class DvmHnp(MultiHostLauncher):
 
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
-                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                path, _, query = self.path.partition("?")
+                path = path.rstrip("/") or "/"
                 if path == "/metrics":
                     body = hnp._metrics_text().encode()
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
@@ -559,8 +661,22 @@ class DvmHnp(MultiHostLauncher):
                     body = json.dumps(
                         hnp._doctor_doc("scrape")).encode()
                     ctype = "application/json"
+                elif path == "/timeline":
+                    # live merged cross-rank trace (TAG_TIMELINE round
+                    # while a job runs); ?tail=N bounds the per-rank
+                    # recorder tail pulled from each rank
+                    tail = 2048
+                    for part in query.split("&"):
+                        if part.startswith("tail="):
+                            try:
+                                tail = max(1, int(part[5:]))
+                            except ValueError:
+                                pass
+                    body = json.dumps(hnp._timeline_doc(tail)).encode()
+                    ctype = "application/json"
                 elif path == "/":
-                    body = b"ompi_tpu dvm: /metrics /status /doctor\n"
+                    body = (b"ompi_tpu dvm: /metrics /status /doctor "
+                            b"/timeline\n")
                     ctype = "text/plain"
                 else:
                     self.send_error(404)
@@ -636,6 +752,34 @@ class DvmHnp(MultiHostLauncher):
         ]
         return agg_text + "\n".join(dvm_lines) + "\n" + own
 
+    def _uplink_stats(self) -> dict:
+        """Telemetry about the telemetry: what the metrics uplink and
+        the timeline plane themselves cost (the /status block that
+        answers "is observability eating my run?")."""
+        stats = getattr(self.metrics_agg, "stats", lambda: {})()
+        doc: dict = {"hnp_merges_total": stats.get("merges_total", 0),
+                     "hnp_merge_ms_total": round(
+                         stats.get("merge_ns_total", 0) / 1e6, 2)}
+        # rank-side push cost, summed from the pushed self-metering
+        # counters (the ranks meter their own uplink datagrams)
+        dgrams = nbytes = 0.0
+        for jobid in self.metrics_agg.jobids():
+            for vals in self.metrics_agg.rank_values(
+                    jobid, ("metrics_push_datagrams_total",
+                            "metrics_push_bytes_total")).values():
+                dgrams += float(
+                    vals.get("metrics_push_datagrams_total", 0))
+                nbytes += float(vals.get("metrics_push_bytes_total", 0))
+        doc["rank_push_datagrams_total"] = int(dgrams)
+        doc["rank_push_bytes_total"] = int(nbytes)
+        up = max(1e-9, time.time() - self._started_at)
+        doc["rank_push_bytes_per_s"] = round(nbytes / up, 1)
+        with self._timeline_cv:
+            doc["timeline_captures_total"] = self._tl_captures
+            doc["timeline_merge_ms_total"] = round(
+                self._tl_merge_ns / 1e6, 2)
+        return doc
+
     def _status_doc(self) -> dict:
         """The /status JSON: daemon table (heartbeat ages), per-job proc
         table (lives, restarts budget, last-metrics age) and the FT
@@ -685,6 +829,7 @@ class DvmHnp(MultiHostLauncher):
                               else current.jobid),
             "jobs": jobs,
             "ft_events_total": ftevents.log.total(),
+            "uplink": self._uplink_stats(),
         }
 
 

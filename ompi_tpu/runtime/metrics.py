@@ -182,6 +182,20 @@ class MetricsCollector:
         self._send_fn = send_fn
         self._lock = threading.Lock()
         self._pending: HopPayload = {}
+        # uplink self-metering (the first real data for ROADMAP item
+        # 6's fan-in sizing): plain counters under the merge lock,
+        # read by /status via stats().  Cumulative, like everything
+        # else on this plane.
+        self.rx_datagrams = 0
+        self.rx_bytes = 0
+        self.child_payloads = 0
+        self.merge_ns_total = 0
+        self.pushes_up = 0
+        self.up_bytes = 0
+        #: optional {name: value} injected into every local rank row at
+        #: drain time — how the measured clock offsets ride the
+        #: existing uplink instead of needing their own message shape
+        self.extra_values_fn: Optional[Callable[[], dict]] = None
         #: per (jobid, rank): (last accepted datagram seq, monotonic
         #: accept time) — the reorder fence and its expiry clock
         self._seq: dict[tuple[int, int], tuple[int, float]] = {}
@@ -230,14 +244,21 @@ class MetricsCollector:
                         and now - t_last < _FENCE_EXPIRE_S):
                     continue
                 self._seq[key] = (push_n, now)
+                t0 = time.monotonic_ns()
                 merge_hop(self._pending,
                           {key[0]: {key[1]: [time.time(), vals]}})
+                self.rx_datagrams += 1
+                self.rx_bytes += len(blob)
+                self.merge_ns_total += time.monotonic_ns() - t0
 
     def on_child_payload(self, payload: Any) -> None:
         """TAG_METRICS from a tree child (RML reader thread — merge
         only, no blocking work)."""
+        t0 = time.monotonic_ns()
         with self._lock:
             merge_hop(self._pending, payload)
+            self.child_payloads += 1
+            self.merge_ns_total += time.monotonic_ns() - t0
 
     # -- drain ------------------------------------------------------------
 
@@ -247,7 +268,14 @@ class MetricsCollector:
             if not payload:
                 continue
             try:
+                # one extra pack per period buys the actual per-hop
+                # byte rate the fan-in sizing needs (payloads are a few
+                # KiB; the RML frame adds a constant it doesn't count)
+                nbytes = len(dss.pack(payload))
                 self._send_fn(payload)
+                with self._lock:
+                    self.pushes_up += 1
+                    self.up_bytes += nbytes
             except Exception:  # noqa: BLE001 — keep the merged delta:
                 # an orphaned-window send failure must not lose it
                 with self._lock:
@@ -256,10 +284,34 @@ class MetricsCollector:
                     merge_hop(self._pending, merged)
 
     def drain(self) -> HopPayload:
-        """Take the pending merged delta (callers push it one hop up)."""
+        """Take the pending merged delta (callers push it one hop up),
+        stamping any ``extra_values_fn`` values into every rank row —
+        scalars are last-writer-wins downstream, so re-stamping each
+        period is idempotent."""
         with self._lock:
             payload, self._pending = self._pending, {}
+        fn = self.extra_values_fn
+        if fn is not None and payload:
+            try:
+                extras = {k: v for k, v in dict(fn()).items()
+                          if v is not None}
+            except Exception:  # noqa: BLE001 — metering must not lose
+                extras = {}    # the real payload to a stats callback
+            if extras:
+                for ranks in payload.values():
+                    for row in ranks.values():
+                        row[1].update(extras)
         return payload
+
+    def stats(self) -> dict:
+        """Uplink self-metrics for /status (cumulative counters)."""
+        with self._lock:
+            return {"rx_datagrams": self.rx_datagrams,
+                    "rx_bytes": self.rx_bytes,
+                    "child_payloads": self.child_payloads,
+                    "merge_ns_total": self.merge_ns_total,
+                    "pushes_up": self.pushes_up,
+                    "up_bytes": self.up_bytes}
 
     def close(self) -> None:
         self._stop.set()
@@ -369,6 +421,10 @@ class MetricsAggregate:
         self._lock = threading.Lock()
         self._jobs: HopPayload = {}
         self._max_jobs = max_jobs
+        # terminal-stage self-metering: what one merge costs the HNP
+        # and how often the stream arrives (ROADMAP item 6's numbers)
+        self.merges_total = 0
+        self.merge_ns_total = 0
         #: straggler baselines: jobid → (monotonic ts, signal, {rank:
         #: (wait, publish)}); rotated once older than the panel window,
         #: discarded on a signal flip (sums from different histograms
@@ -379,8 +435,11 @@ class MetricsAggregate:
 
     def merge(self, payload: Any) -> None:
         """Fold one TAG_METRICS payload in (RML reader thread safe)."""
+        t0 = time.monotonic_ns()
         with self._lock:
             merge_hop(self._jobs, payload)
+            self.merges_total += 1
+            self.merge_ns_total += time.monotonic_ns() - t0
             if len(self._jobs) > self._max_jobs:
                 by_age = sorted(
                     self._jobs,
@@ -392,6 +451,12 @@ class MetricsAggregate:
                     # evicted jobs take their straggler baseline along
                     # (a long-lived DVM must not leak one per dead job)
                     self._strag_base.pop(jobid, None)
+
+    def stats(self) -> dict:
+        """Terminal-stage self-metrics for /status."""
+        with self._lock:
+            return {"merges_total": self.merges_total,
+                    "merge_ns_total": self.merge_ns_total}
 
     def snapshot(self) -> HopPayload:
         with self._lock:

@@ -106,6 +106,16 @@ class Orted:
         self.node.register_recv(rml.TAG_REPARENT, self._on_reparent)
         self.node.register_recv(rml.TAG_ADOPT, self._on_adopt)
         self.node.register_recv(rml.TAG_KILL_RANK, self._on_kill_rank)
+        self.node.register_recv(rml.TAG_TIMELINE, self._on_timeline)
+        # measured clock sync: pingpong my parent edge, compose the
+        # offset to the root, and answer my own children's probes with
+        # it (offsets compose down the tree; ranks share my kernel
+        # clock, so my offset is theirs)
+        from ompi_tpu.runtime import clocksync
+
+        self._clock = clocksync.ClockProber(self.node)
+        clocksync.install_responder(self.node,
+                                    self._clock.offset_to_root_ns)
         self._spec: Optional[dict] = None
         self._my_rows: dict[int, tuple[int, Optional[int]]] = {}
         # metrics uplink: when trace_metrics_push_period > 0 this daemon
@@ -127,6 +137,13 @@ class Orted:
             self.node.register_recv(
                 rml.TAG_METRICS,
                 lambda o, p: self._metrics.on_child_payload(p))
+            # the measured offset rides the existing uplink: every rank
+            # row this daemon forwards carries its host's composed
+            # clock offset (None until the pingpong window fills —
+            # drain() drops None values)
+            self._metrics.extra_values_fn = lambda: {
+                "rank_clock_to_root_ns":
+                    self._clock.offset_to_root_ns()}
         self.node.register_recv(rml.TAG_SHUTDOWN,
                                 lambda o, p: self._done.set())
         # lifeline: if the HNP or my tree parent vanishes, my ranks'
@@ -152,6 +169,7 @@ class Orted:
     def _start_heartbeats(self) -> None:
         if self.node.wait_parent(timeout=60.0) or self.vpid == 0:
             rml.start_heartbeats(self.node, self._done)
+            self._clock.start()   # probes need the up-link to exist
 
     def _on_proc_failed(self, origin: int, payload) -> None:
         """errmgr notify propagation: a rank somewhere in the job died and
@@ -499,6 +517,47 @@ class Orted:
         except ConnectionError:
             pass
 
+    def _on_timeline(self, origin: int, payload) -> None:
+        """Live-timeline fan-out (the TAG_DOCTOR shape): query each
+        live local rank's responder for a bounded flight-recorder tail,
+        reply up.  Handed off a thread — the UDP waits block."""
+        threading.Thread(target=self._timeline_capture, args=(payload,),
+                         name=f"orted-timeline-{self.vpid}",
+                         daemon=True).start()
+
+    def _timeline_capture(self, payload) -> None:
+        from ompi_tpu.runtime import doctor
+
+        try:
+            epoch, tail = payload
+            tail = int(tail)
+        except (TypeError, ValueError):
+            epoch, tail = payload, 2048
+        with self._lock:
+            procs = [(r, p) for r, p in self._popen.items()
+                     if p.poll() is None]
+            spec = self._spec
+        ports: dict[int, int] = {}
+        uri = ((spec or {}).get("env") or {}).get(pmix.ENV_URI)
+        if uri and procs:
+            ports = pmix.query_doctor_ports(uri) or {}
+        off_root = self._clock.offset_to_root_ns()
+        rows = []
+        for rank, p in sorted(procs):
+            port = ports.get(rank)
+            cap = doctor.query_timeline(port, tail) if port else None
+            if cap is None:
+                cap = {"rank": rank, "no_response": True}
+            # stamp the daemon-measured offset: ranks share this host's
+            # kernel clock, so one offset corrects every local rank
+            cap["clock_to_root_ns"] = off_root
+            rows.append(cap)
+        try:
+            self.node.send_up(rml.TAG_TIMELINE_REPLY,
+                              (self.vpid, epoch, rows))
+        except ConnectionError:
+            pass
+
     def _on_stdin(self, origin: int, payload) -> None:
         # Runs on the RML link reader thread: never write the pipe here —
         # a rank that doesn't drain stdin would fill the OS pipe, block
@@ -518,6 +577,7 @@ class Orted:
     def run(self) -> int:
         self._done.wait()
         self._on_kill(0, None)   # stragglers die with the daemon
+        self._clock.stop()
         if self._metrics is not None:
             self._metrics.close()
         self.node.close()

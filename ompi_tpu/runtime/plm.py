@@ -34,6 +34,7 @@ from typing import Optional
 from ompi_tpu.core import output
 from ompi_tpu.core.config import VarType, register_var, var_registry
 from ompi_tpu.core.mca import Component, Framework
+from ompi_tpu.runtime import clocksync
 from ompi_tpu.runtime import errmgr as errmgr_mod
 from ompi_tpu.runtime import launcher as _launcher  # registers launcher_* vars
 from ompi_tpu.runtime import pmix, ras, rmaps, rml
@@ -202,6 +203,9 @@ class MultiHostLauncher:
         self.rml.register_recv(rml.TAG_REPARENT_ACK, self._on_reparent_ack)
         self.rml.register_recv(rml.TAG_METRICS,
                                lambda o, p: self.metrics_agg.merge(p))
+        # answer the daemons' clock-sync pingpongs: the HNP is the root
+        # clock domain, so its offset-to-root is 0 by definition
+        clocksync.install_responder(self.rml, lambda: 0)
         self.rml.on_peer_lost = self._on_daemon_lost
         # liveness beats (rml_heartbeat_period > 0): any beat — or any
         # other up-traffic from the daemon — refreshes its clock; silence

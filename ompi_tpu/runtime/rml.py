@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import socket
 import struct
+import sys
 import threading
 from typing import Any, Callable, Optional
 
@@ -99,6 +100,61 @@ TAG_METRICS = "metrics"         # hop (one tree level, delivered at EVERY
 #                                 trace_metrics_push_period; the HNP/DVM
 #                                 folds the stream into the scrape
 #                                 aggregate
+TAG_CLOCK = "clock"             # hop child → parent: (vpid, seq, t0_ns) —
+#                                 one leg of the min-RTT clock pingpong;
+#                                 the receiving hop answers immediately so
+#                                 each edge of the tree is measured against
+#                                 its OWN parent (offsets compose down)
+TAG_CLOCK_REPLY = "clock_reply"  # direct parent → child:
+#                                 (seq, t0_ns, t_parent_ns) — t0 echoed so
+#                                 the prober needs no outstanding-probe
+#                                 table; the child stamps t3 on delivery
+TAG_TIMELINE = "timeline"       # xcast: (epoch, tail) — every orted
+#                                 gathers bounded flight-recorder tails
+#                                 from its local ranks (UDP query of each
+#                                 responder) and replies up: the live
+#                                 /timeline capture, same shape as
+#                                 TAG_DOCTOR
+TAG_TIMELINE_REPLY = "timeline_reply"  # up: (vpid, epoch, [capture, ...])
+#                                 — per-rank recorder tails the HNP/DVM
+#                                 merges into one skew-corrected trace
+
+
+def _pack_env(kind: str, tag: str, origin: int, payload: Any) -> bytes:
+    """Frame one RML envelope.  With the flight recorder armed in this
+    process the envelope grows a 5th element — the ``(trace_id,
+    span_id)`` pair — and an ``rml_send`` instant lands in the
+    recorder; the receiving side's matching ``rml_recv`` instant lets
+    the timeline merge draw an arrow per OOB edge (control traffic —
+    doctor rounds, rejoin epochs, metrics hops — becomes causally
+    visible next to the data plane).  Readers tolerate both widths, so
+    instrumented and plain processes interoperate.  Cost with tracing
+    off (every daemon's default): one attribute check."""
+    tc = None
+    # sys.modules, not an import: the MPI layer must only be consulted
+    # when something else already loaded it — a bare daemon's OOB sends
+    # must not drag jax/numpy into the orted process
+    trace = sys.modules.get("ompi_tpu.mpi.trace")
+    if trace is not None and trace.active:
+        try:
+            tc = [trace.trace_id(), trace.next_span_id()]
+            trace.instant("runtime", "rml_send", tag=tag, tc=tc)
+        except Exception:  # noqa: BLE001 — tracing never breaks the OOB plane
+            tc = None
+    if tc is None:
+        return dss.pack((kind, tag, origin, payload))
+    return dss.pack((kind, tag, origin, payload, tc))
+
+
+def _note_recv(tag: str, tc: Any) -> None:
+    """The receive half of the envelope trace pair (no-op unless this
+    process has the flight recorder armed)."""
+    trace = sys.modules.get("ompi_tpu.mpi.trace")
+    if trace is not None and trace.active:
+        try:
+            trace.instant("runtime", "rml_recv", tag=tag, tc=list(tc))
+        except Exception:  # noqa: BLE001
+            pass
 
 
 def tree_parent(vpid: int) -> Optional[int]:
@@ -272,7 +328,7 @@ class RmlNode:
         if self.vpid == 0:
             self._deliver(tag, 0, payload)
             return
-        self._send_up_blob(dss.pack(("up", tag, self.vpid, payload)))
+        self._send_up_blob(_pack_env("up", tag, self.vpid, payload))
 
     def _send_up_blob(self, blob: bytes) -> None:
         """One pre-framed "up" message toward the HNP: the tree parent
@@ -294,7 +350,25 @@ class RmlNode:
     def send_direct(self, link: _Link, tag: str, payload: Any) -> None:
         """Bootstrap-only: a message over an explicit link (HNP replies to
         a registration before the tree exists)."""
-        link.send(dss.pack(("direct", tag, self.vpid, payload)))
+        link.send(_pack_env("direct", tag, self.vpid, payload))
+
+    def send_child(self, vpid: int, tag: str, payload: Any) -> bool:
+        """One message DOWN a single tree edge (or, at the HNP, down a
+        bootstrap link) — the reply path for per-hop request/response
+        exchanges like the TAG_CLOCK pingpong, where xcast (every
+        descendant) and send_direct (caller must hold the link) both
+        fit badly.  Returns False when no live link to ``vpid`` exists
+        (the prober times out and retries — clock probes are lossy by
+        design)."""
+        with self._lock:
+            link = self._child_links.get(vpid) or self.boot_links.get(vpid)
+        if link is None:
+            return False
+        try:
+            link.send(_pack_env("direct", tag, self.vpid, payload))
+            return True
+        except OSError:
+            return False
 
     def send_hop(self, tag: str, payload: Any) -> None:
         """One tree level toward the root, DELIVERED at the receiving
@@ -305,19 +379,22 @@ class RmlNode:
         if self.vpid == 0:
             self._deliver(tag, 0, payload)
             return
-        self._send_up_blob(dss.pack(("hop", tag, self.vpid, payload)))
+        self._send_up_blob(_pack_env("hop", tag, self.vpid, payload))
 
     def _relay_down(self, tag: str, origin: int, payload: Any) -> None:
         with self._lock:
             links = list(self._child_links.values())
-        blob = dss.pack(("xcast", tag, origin, payload))
+        blob = _pack_env("xcast", tag, origin, payload)
         for link in links:
             try:
                 link.send(blob)
             except OSError as e:
                 _log.error("rml %d: xcast relay failed: %r", self.vpid, e)
 
-    def _deliver(self, tag: str, origin: int, payload: Any) -> None:
+    def _deliver(self, tag: str, origin: int, payload: Any,
+                 tc: Any = None) -> None:
+        if tc is not None:
+            _note_recv(tag, tc)
         with self._lock:
             cb = self._handlers.get(tag)
         if cb is None:
@@ -375,14 +452,17 @@ class RmlNode:
                         if self.vpid == 0:
                             self.boot_links[peer] = link
                     continue
-                _, tag, origin, payload = msg
+                tag, origin, payload = msg[1], msg[2], msg[3]
+                # instrumented senders append a (trace_id, span_id)
+                # envelope stamp; plain 4-tuples stay the common case
+                tc = msg[4] if len(msg) > 4 else None
                 if kind == "xcast":
                     # relay first — see xcast() on the SHUTDOWN/close race
                     self._relay_down(tag, origin, payload)
-                    self._deliver(tag, origin, payload)
+                    self._deliver(tag, origin, payload, tc)
                 elif kind == "up":
                     if self.vpid == 0:
-                        self._deliver(tag, origin, payload)
+                        self._deliver(tag, origin, payload, tc)
                     else:
                         try:
                             self._send_up_blob(blob)
@@ -392,9 +472,9 @@ class RmlNode:
                 elif kind == "hop":
                     # one-level message: deliver HERE (the handler owns
                     # any further forwarding — per-hop merge semantics)
-                    self._deliver(tag, origin, payload)
+                    self._deliver(tag, origin, payload, tc)
                 elif kind == "direct":
-                    self._deliver(tag, origin, payload)
+                    self._deliver(tag, origin, payload, tc)
                 else:
                     _log.error("rml %d: unknown kind %r", self.vpid, kind)
         if peer is not None and not self._stop.is_set():

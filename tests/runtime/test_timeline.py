@@ -1,0 +1,239 @@
+"""The live timeline plane, piece by piece: the pingpong offset
+estimator's error bound, the deterministic cross-rank flow-edge stitch
+(p2p, collective rounds, RML envelopes), measured-skew correction
+restoring causality, the native span-ring drain parity (timeline works
+identically with the native plane armed or absent), and the record-path
+overhead budget the always-on recorder must hold."""
+
+from __future__ import annotations
+
+import copy
+import json
+import random
+import time
+
+import pytest
+
+from ompi_tpu.mpi import trace
+from ompi_tpu.runtime import timeline
+from ompi_tpu.runtime.clocksync import OffsetEstimator
+
+
+@pytest.fixture(autouse=True)
+def _trace_off_after():
+    yield
+    trace.disable()
+
+
+# ---------------------------------------------------------------------------
+# offset estimator: the error bound that makes "measured" mean something
+# ---------------------------------------------------------------------------
+
+def test_offset_estimator_error_bound():
+    """Synthetic two-clock pingpong: the min-RTT midpoint estimate must
+    land within rtt/2 of the true offset even under heavy asymmetric
+    jitter — the bound the docstring promises and the merge relies on."""
+    rng = random.Random(0xC10C)
+    true_offset = 7_300_000_000        # peer booted 7.3s "later"
+    est = OffsetEstimator(window=16)
+    local = 50_000_000
+    for _ in range(64):
+        up = rng.randrange(40_000, 900_000)      # asymmetric legs
+        down = rng.randrange(40_000, 2_500_000)
+        t0 = local
+        t_peer = t0 + up + true_offset
+        t3 = t0 + up + down
+        est.observe(t0, t_peer, t3)
+        local = t3 + rng.randrange(1_000_000, 3_000_000)
+    off, rtt = est.offset_ns(), est.rtt_ns()
+    assert off is not None and rtt is not None
+    assert abs(off - true_offset) <= rtt // 2
+    # and the retained sample is the window's best, so the bound is
+    # far tighter than the worst round trip we injected
+    assert rtt < 3_400_000
+    assert est.sample_count() == 64
+
+
+def test_offset_estimator_rejects_stale_and_resets():
+    est = OffsetEstimator(window=4)
+    est.observe(100, 1100, 90)       # t3 < t0: reordered — not a sample
+    assert est.offset_ns() is None
+    est.observe(100, 1150, 200)
+    assert est.offset_ns() == 1000
+    est.reset()                      # responder changed: clocks don't mix
+    assert est.offset_ns() is None and est.sample_count() == 1
+
+
+# ---------------------------------------------------------------------------
+# flow-edge stitch: deterministic, and correct across all three planes
+# ---------------------------------------------------------------------------
+
+def _two_rank_captures():
+    """Rank 1's raw clock runs 5ms behind the root: pre-correction its
+    recv appears BEFORE the matching send ended."""
+    return [
+        {"rank": 0, "trace_id": "t-abc", "clock_to_root_ns": 0,
+         "clock_offset_ns": 1_000, "events_total": 3, "dropped": 0,
+         "capacity": 4096, "counters": {}, "collrec": [],
+         "events": [
+             {"ph": "X", "ts": 100.0, "dur": 10.0, "tid": 0,
+              "cat": "pml", "name": "eager_send",
+              "args": {"fl": 123, "tc": 777}},
+             {"ph": "X", "ts": 200.0, "dur": 50.0, "tid": 2,
+              "cat": "coll", "name": "bcast",
+              "args": {"cid": 1, "seq": 5}},
+             {"ph": "i", "ts": 150.0, "tid": 7, "s": "t",
+              "cat": "runtime", "name": "rml_send",
+              "args": {"tc": [777, 9]}},
+         ]},
+        {"rank": 1, "trace_id": "t-abc", "clock_to_root_ns": 5_000_000,
+         "clock_offset_ns": 2_000, "events_total": 3, "dropped": 0,
+         "capacity": 4096, "counters": {}, "collrec": [],
+         "events": [
+             {"ph": "X", "ts": 100.0, "dur": 10.0, "tid": 0,
+              "cat": "pml", "name": "eager_recv",
+              "args": {"fl": 123, "tc": 777}},
+             {"ph": "X", "ts": 150.0, "dur": 60.0, "tid": 2,
+              "cat": "coll", "name": "bcast",
+              "args": {"cid": 1, "seq": 5}},
+             {"ph": "i", "ts": 120.0, "tid": 7, "s": "t",
+              "cat": "runtime", "name": "rml_recv",
+              "args": {"tc": [777, 9]}},
+         ]},
+    ]
+
+
+def test_merge_captures_stitches_all_three_flow_planes():
+    doc = timeline.merge_captures(_two_rank_captures(), jobid=42)
+    other = doc["otherData"]
+    assert other["clock_domain"] == "root_monotonic"
+    assert other["jobid"] == 42 and other["ranks"] == [0, 1]
+    assert other["causality_problems"] == []
+    evs = doc["traceEvents"]
+    flows = [e for e in evs if e.get("cat") == "flow"]
+    by_name = {}
+    for e in flows:
+        by_name.setdefault(e["name"], []).append(e)
+    # p2p: send-end on rank 0 → recv-end on rank 1, one s + one f
+    msg = sorted(by_name["msg"], key=lambda e: e["ts"])
+    assert [e["ph"] for e in msg] == ["s", "f"]
+    assert (msg[0]["pid"], msg[1]["pid"]) == (0, 1)
+    assert msg[1]["bp"] == "e" and msg[0]["id"] == "777:123"
+    # the collective round chains both ranks' spans of (cid=1, seq=5)
+    coll = sorted(by_name["coll_round"], key=lambda e: e["ts"])
+    assert [e["ph"] for e in coll] == ["s", "f"]
+    assert coll[0]["id"] == "coll:1:5"
+    # the RML envelope pair stitched by its (trace_id, span_id)
+    rml = sorted(by_name["rml"], key=lambda e: e["ts"])
+    assert [e["ph"] for e in rml] == ["s", "f"]
+    assert rml[0]["id"] == "rml:777:9"
+    assert other["flow_edges"] == 3
+
+
+def test_merge_captures_is_deterministic():
+    """Same captures in → byte-identical trace out: the stitch must not
+    depend on dict iteration accidents or set ordering."""
+    caps = _two_rank_captures()
+    a = timeline.merge_captures(copy.deepcopy(caps), jobid=7)
+    b = timeline.merge_captures(copy.deepcopy(caps), jobid=7)
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+    # and input order must not matter either
+    c = timeline.merge_captures(copy.deepcopy(caps)[::-1], jobid=7)
+    assert json.dumps(a, sort_keys=True) == json.dumps(c, sort_keys=True)
+
+
+def test_merge_captures_measured_correction_restores_causality():
+    doc = timeline.merge_captures(_two_rank_captures())
+    spans = {(e["pid"], e["name"]): e for e in doc["traceEvents"]
+             if e.get("ph") == "X"}
+    send = spans[(0, "eager_send")]
+    recv = spans[(1, "eager_recv")]
+    # rank 1's raw recv (ts 100) preceded the send end; the measured
+    # +5ms shift puts it back on the causal side
+    assert recv["ts"] + recv["dur"] >= send["ts"] + send["dur"]
+    assert timeline.causality_problems(doc["traceEvents"]) == []
+
+
+def test_merge_captures_falls_back_to_wall_without_full_offsets():
+    """One capture without a measured offset degrades the WHOLE merge
+    to wall anchors — mixing clock domains would fabricate ordering."""
+    caps = _two_rank_captures()
+    caps[1]["clock_to_root_ns"] = None
+    doc = timeline.merge_captures(caps)
+    assert doc["otherData"]["clock_domain"] == "wall"
+    # wall shift: rank 0 moved by its 1µs anchor, rank 1 by 2µs
+    spans = {(e["pid"], e["name"]): e for e in doc["traceEvents"]
+             if e.get("ph") == "X"}
+    assert spans[(1, "eager_recv")]["ts"] == pytest.approx(102.0)
+
+
+def test_merge_captures_no_response_and_negative_rebase():
+    """A dead daemon's placeholder row keeps its slot in per_rank
+    without poisoning the clock domain, and offsets that shift events
+    below zero get rebased onto a non-negative axis."""
+    caps = _two_rank_captures()
+    caps[0]["clock_to_root_ns"] = -1_000_000     # rank 0 shifts to -900µs
+    caps.append({"rank": 2, "no_response": True})
+    doc = timeline.merge_captures(caps)
+    other = doc["otherData"]
+    assert other["clock_domain"] == "root_monotonic"   # live rows only
+    assert other["per_rank"]["2"]["no_response"] is True
+    assert other["ranks"] == [0, 1, 2]
+    assert min(e["ts"] for e in doc["traceEvents"]
+               if e.get("ph") != "M") >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# native span-ring drain parity: same capture shape with the plane
+# armed or absent
+# ---------------------------------------------------------------------------
+
+def test_native_span_drain_parity():
+    from ompi_tpu import _native
+
+    rec = trace.enable(capacity=1024, rank=0)
+    try:
+        if _native.arena() is not None:
+            import ctypes
+
+            # an expired 2ms flag wait is far above the 10µs arm floor
+            flags = (ctypes.c_uint64 * 1)(0)
+            _native.arena().ompi_tpu_arena_wait(
+                ctypes.addressof(flags), 0, 1, 64, 2_000_000)
+            drained = trace.drain_native_spans()
+            assert drained >= 1
+            names = [e[3] for e in rec.snapshot()]
+            assert "native_arena_wait" in names
+            cap = trace.timeline_capture()
+            assert any(e["name"] == "native_arena_wait"
+                       for e in cap["events"])
+            assert cap["counters"].get("trace_native_spans_total", 0) >= 1
+        # disarmed (or plane absent): the same calls are exact no-ops —
+        # the capture path must not care which world it runs in
+        _native.spans_enable(-1)
+        before = len(rec.snapshot())
+        assert trace.drain_native_spans() == 0
+        cap = trace.timeline_capture()
+        assert len(rec.snapshot()) == before
+        assert {"rank", "events", "clock_offset_ns",
+                "dropped"} <= set(cap)
+    finally:
+        _native.spans_enable(-1)
+
+
+# ---------------------------------------------------------------------------
+# the budget: recording one span must stay cheap enough to leave on
+# ---------------------------------------------------------------------------
+
+def test_record_path_overhead_budget():
+    """≤2µs per span on the hot add path (best-of-batches: the bound is
+    about the code, not about scheduler noise on a loaded CI box)."""
+    rec = trace.FlightRecorder(capacity=4096, rank=0)
+    n = 2000
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter_ns()
+        for i in range(n):
+            rec.add(i, 10, "pml", "eager_send", 0, None)
+        best = min(best, (time.perf_counter_ns() - t0) / n)
+    assert best <= 2000, f"record path costs {best:.0f}ns/span (>2us)"

@@ -15,7 +15,7 @@ import sys
 from tools.lint.baseline import Baseline
 from tools.lint.checkers import (frame_op, lock_order, pmix_rpc,
                                  pvar_spec, reader_thread, rml_tag,
-                                 var_registry)
+                                 span_pairing, var_registry)
 from tools.lint.finding import Finding
 from tools.lint.index import ProjectIndex
 
@@ -941,6 +941,195 @@ class Inner:
             return 1
 """})
     assert lock_order.run(idx) == []
+
+
+# ---------------------------------------------------------------------------
+# span-pairing
+# ---------------------------------------------------------------------------
+
+_SPAN_TRACE = """
+def coll_post(rank, cid, kind, sig, provider, nbytes):
+    return 1
+
+def coll_done(rank, cid, seq, kind):
+    pass
+
+def coll_err(rank, cid, seq, kind, err):
+    pass
+
+def begin():
+    return 1
+
+def complete(cat, name, t0, **args):
+    pass
+
+def record_hist(name, dur_ns, labels=""):
+    pass
+"""
+
+
+def test_span_pairing_unpaired_post_and_begin(tmp_path):
+    idx = _tree(tmp_path, {
+        "trace.py": _SPAN_TRACE,
+        "coll.py": """
+import trace as trace_mod
+
+def run(comm):
+    seq = trace_mod.coll_post(0, 1, "bcast", 0, "host", 64)
+    return seq                        # never retired anywhere
+
+def timed():
+    t0 = trace_mod.begin()
+    return t0                         # span never closed
+""",
+    })
+    got = _rules(span_pairing.run(idx))
+    assert ("unpaired-post", "coll.run") in got
+    assert ("unmatched-begin", "coll.timed") in got
+
+
+def test_span_pairing_missing_err_path(tmp_path):
+    idx = _tree(tmp_path, {
+        "trace.py": _SPAN_TRACE,
+        "coll.py": """
+import trace as trace_mod
+
+def run(comm, fn):
+    seq = trace_mod.coll_post(0, 1, "bcast", 0, "host", 64)
+    ret = fn(comm)                    # a raise here leaks the op
+    trace_mod.coll_done(0, 1, seq, "bcast")
+    return ret
+""",
+    })
+    got = _rules(span_pairing.run(idx))
+    assert ("no-err-path", "coll.run") in got
+    assert not any(r == "unpaired-post" for r, _ in got)
+
+
+def test_span_pairing_clean_try_except(tmp_path):
+    """The canonical choke-point shape: post, body in try, done on the
+    success path, err in the except — no findings."""
+    idx = _tree(tmp_path, {
+        "trace.py": _SPAN_TRACE,
+        "coll.py": """
+import trace as trace_mod
+
+def run(comm, fn):
+    seq = trace_mod.coll_post(0, 1, "bcast", 0, "host", 64)
+    t0 = trace_mod.begin()
+    try:
+        ret = fn(comm)
+        trace_mod.coll_done(0, 1, seq, "bcast")
+        return ret
+    except BaseException as e:
+        trace_mod.coll_err(0, 1, seq, "bcast", type(e).__name__)
+        raise
+    finally:
+        trace_mod.complete("coll", "bcast", t0)
+""",
+    })
+    assert span_pairing.run(idx) == []
+
+
+def test_span_pairing_class_scope_pairing(tmp_path):
+    """The nonblocking-request shape: post in __init__, done/err in the
+    completion callbacks of the SAME class — clean.  A second class
+    posting with no retirement anywhere still flags."""
+    idx = _tree(tmp_path, {
+        "trace.py": _SPAN_TRACE,
+        "nbc.py": """
+import trace as trace_mod
+
+class Request:
+    def __init__(self, comm):
+        self.seq = trace_mod.coll_post(0, 1, "ibcast", 0, "host", 64)
+    def _on_complete(self):
+        trace_mod.coll_done(0, 1, self.seq, "ibcast")
+    def _on_error(self, e):
+        trace_mod.coll_err(0, 1, self.seq, "ibcast", type(e).__name__)
+""",
+        "leaky.py": """
+import trace as trace_mod
+
+class Leaky:
+    def start(self):
+        self.seq = trace_mod.coll_post(0, 1, "x", 0, "host", 0)
+""",
+    })
+    got = _rules(span_pairing.run(idx))
+    assert ("unpaired-post", "leaky.start") in got
+    assert not any(sym.startswith("nbc.") for _r, sym in got)
+
+
+def test_span_pairing_module_scope_and_hist_closer(tmp_path):
+    """begin() consumed by a complete() in ANOTHER class of the same
+    module (the pml recv-state shape) is clean, and record_hist counts
+    as a begin closer (pure-histogram timing stamps)."""
+    idx = _tree(tmp_path, {
+        "trace.py": _SPAN_TRACE,
+        "pml.py": """
+import trace as trace_mod
+
+class _RecvState:
+    def __init__(self):
+        self.trace_t0 = trace_mod.begin()
+
+class Pml:
+    def _finish(self, state):
+        trace_mod.complete("pml", "recv", state.trace_t0)
+""",
+        "hist.py": """
+import trace as trace_mod
+
+def timed_write(fn):
+    t0 = trace_mod.begin()
+    fn()
+    trace_mod.record_hist("io_write_ns", t0)
+""",
+    })
+    assert span_pairing.run(idx) == []
+
+
+def test_span_pairing_waiver_and_closure_retirement(tmp_path):
+    """`# lint: span-ok` silences the opener, and a done inside a
+    nested closure is part of the enclosing function's subtree."""
+    idx = _tree(tmp_path, {
+        "trace.py": _SPAN_TRACE,
+        "app.py": """
+import trace as trace_mod
+
+def fire_and_forget():
+    trace_mod.coll_post(0, 1, "probe", 0, None, 0)  # lint: span-ok
+
+def deferred(comm, schedule):
+    seq = trace_mod.coll_post(0, 1, "ibarrier", 0, "host", 0)
+    def on_done(e=None):
+        if e is None:
+            trace_mod.coll_done(0, 1, seq, "ibarrier")
+        else:
+            trace_mod.coll_err(0, 1, seq, "ibarrier", type(e).__name__)
+    schedule(on_done)
+""",
+    })
+    assert span_pairing.run(idx) == []
+
+
+def test_span_pairing_ignores_lookalike_receivers(tmp_path):
+    """str.count-style lookalikes: begin/complete on a non-trace
+    receiver must not register as recorder calls."""
+    idx = _tree(tmp_path, {
+        "trace.py": _SPAN_TRACE,
+        "app.py": """
+import trace as trace_mod
+
+def fine(editor, comm):
+    editor.begin()                    # not the recorder
+    seq = trace_mod.coll_post(0, 1, "bcast", 0, "host", 0)
+    trace_mod.coll_done(0, 1, seq, "bcast")
+    trace_mod.coll_err(0, 1, seq, "bcast", "X")
+""",
+    })
+    assert span_pairing.run(idx) == []
 
 
 # ---------------------------------------------------------------------------

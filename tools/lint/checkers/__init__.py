@@ -15,7 +15,7 @@ from tools.lint.index import ProjectIndex
 
 from tools.lint.checkers import (frame_op, lock_order, pmix_rpc,
                                  pvar_spec, reader_thread, rml_tag,
-                                 var_registry)
+                                 span_pairing, var_registry)
 
 #: name → (exit-code bit, run function)
 ALL: dict[str, tuple[int, Callable[[ProjectIndex], list[Finding]]]] = {
@@ -26,6 +26,7 @@ ALL: dict[str, tuple[int, Callable[[ProjectIndex], list[Finding]]]] = {
     "pmix-rpc": (16, pmix_rpc.run),
     "reader-thread": (32, reader_thread.run),
     "lock-order": (64, lock_order.run),
+    "span-pairing": (256, span_pairing.run),
 }
 
 #: the mypy gate owns the remaining bit (see tools.lint.driver)

@@ -6,7 +6,7 @@ is a protocol message that silently vanishes, and a TAG_* constant
 nobody sends or receives is dead wire protocol.  Checks:
 
 - ``unhandled-send``: a ``TAG_X`` constant passed to
-  ``xcast/send_up/send_direct/send_hop`` with no
+  ``xcast/send_up/send_direct/send_hop/send_child`` with no
   ``register_recv(TAG_X, …)`` anywhere in the tree.
 - ``dead-tag``: a ``TAG_X = "…"`` definition neither sent nor
   registered anywhere (wire protocol that can never fire).
@@ -30,7 +30,8 @@ from tools.lint.finding import Finding
 from tools.lint.index import ProjectIndex, iter_calls
 
 CHECKER = "rml-tag"
-_SEND_FUNCS = ("xcast", "send_up", "send_direct", "send_hop")
+_SEND_FUNCS = ("xcast", "send_up", "send_direct", "send_hop",
+               "send_child")
 
 
 def run(index: ProjectIndex) -> list[Finding]:
