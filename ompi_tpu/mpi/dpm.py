@@ -668,6 +668,18 @@ def connect(comm: Communicator, port_name: str,
 # spawn (≈ MPI_Comm_spawn) + get_parent
 # ---------------------------------------------------------------------------
 
+def _dvm_submit_args(child_env: dict) -> list:
+    """Elastic grow on a standing pool: a job that was itself launched
+    through a multi-tenant DVM carries ``OMPI_TPU_DVM_URI`` in its env —
+    its spawns then go back through the SAME pool's admission queue and
+    gang scheduler (``--dvm-submit``) instead of forking a private
+    single-shot launcher next to it.  Outside a DVM this is a no-op."""
+    uri = child_env.get("OMPI_TPU_DVM_URI")
+    if not uri:
+        return []
+    return ["--dvm-submit", "--dvm-uri", uri]
+
+
 def spawn(comm: Communicator, argv: Sequence[str], maxprocs: int = 1,
           env: Optional[dict] = None, timeout: float = 120.0) -> Intercomm:
     """Launch `maxprocs` child procs running ``argv`` under the tpurun
@@ -682,6 +694,7 @@ def spawn(comm: Communicator, argv: Sequence[str], maxprocs: int = 1,
         if env:
             child_env.update(env)
         cmd = [sys.executable, "-m", "ompi_tpu.tools.tpurun",
+               *_dvm_submit_args(child_env),
                "-np", str(maxprocs), "--"] + list(argv)
         proc = subprocess.Popen(cmd, env=child_env)
         _spawned.append(proc)   # keep the handle; launcher owns lifetime
@@ -724,6 +737,7 @@ def spawn_multiple(comm: Communicator,
             table += [[list(argv), dict(e)]] * int(n)
         child_env["OMPI_TPU_MPMD_TABLE"] = json.dumps(table)
         cmd = [sys.executable, "-m", "ompi_tpu.tools.tpurun",
+               *_dvm_submit_args(child_env),
                "-np", str(total), "--", sys.executable, "-m",
                "ompi_tpu.mpi._mpmd_dispatch"]
         proc = subprocess.Popen(cmd, env=child_env)

@@ -165,12 +165,17 @@ def apply_host_plane_policy(errmgr, env: dict, *base_envs: dict) -> None:
     env[key] = "0"
 
 
-def _propagate_failure(launcher, proc: Proc, reason: str) -> None:
+def _propagate_failure(launcher, job: Job, proc: Proc,
+                       reason: str) -> None:
     """The notify rung shared by ErrmgrNotify and ErrmgrSelfheal: put the
     human-readable reason on the runtime dead-set (idempotent — the reap
     loop already called ``proc_died``) and flood a TAG_PROC_FAILED xcast
-    down the daemon tree so every host's record shows which rank died."""
-    server = getattr(launcher, "server", None)
+    down the daemon tree so every host's record shows which rank died.
+    The dead-set lives on the JOB's rendezvous when the launcher runs
+    per-job PMIx servers (multi-tenant DVM); ``launcher.server`` remains
+    the fallback for single-job and custom launchers."""
+    server = (getattr(job, "pmix_server", None)
+              or getattr(launcher, "server", None))
     if server is not None:
         server.proc_died(proc.rank, reason=reason)
     node = getattr(launcher, "rml", None)
@@ -393,7 +398,7 @@ class ErrmgrNotify(Component):
                      reason)
         ftevents.record("detect", jobid=job.jobid, rank=proc.rank,
                         lives=proc.lives, rung="notify", reason=reason)
-        _propagate_failure(launcher, proc, reason)
+        _propagate_failure(launcher, job, proc, reason)
         notify(Severity.WARN, "rank-failed",
                f"job {job.jobid} {reason}; survivors notified "
                f"(job continues)")
@@ -429,11 +434,12 @@ class ErrmgrSelfheal(Component):
         # detectors learn the death now (pending ops toward the corpse
         # fail fast instead of stalling for the revive), and flip the
         # peer back alive when the revive lands (the revive listeners)
-        _propagate_failure(launcher, proc, reason)
+        _propagate_failure(launcher, job, proc, reason)
         limit = var_registry.get("errmgr_max_restarts")
         respawn = getattr(launcher, "respawn_proc", None)
-        if proc.daemon_lost or respawn is None:
+        if proc.daemon_lost or proc.no_revive or respawn is None:
             why = ("its daemon died with its host" if proc.daemon_lost
+                   else "a planned shrink retired it" if proc.no_revive
                    else f"{type(launcher).__name__} cannot revive ranks")
             self._escalate(launcher, job, proc,
                            f"rank {proc.rank} is not revivable ({why})")
@@ -480,7 +486,9 @@ class ErrmgrSelfheal(Component):
         carriers = [p for p in job.procs if p is not proc and p.state
                     in (ProcState.RUNNING, ProcState.TERMINATED)]
         can_shrink = (bool(carriers)
-                      and getattr(launcher, "server", None) is not None)
+                      and (getattr(job, "pmix_server", None)
+                           or getattr(launcher, "server", None))
+                      is not None)
         ftevents.record("escalate", jobid=job.jobid, rank=proc.rank,
                         lives=proc.lives,
                         to="shrink" if can_shrink else "abort", why=why)

@@ -31,9 +31,13 @@ __all__ = ["FtEventLog", "log", "record", "KINDS"]
 #: ``coll_rejoin`` = a rank's epoch-fenced coll-hierarchy rebuild after
 #: a selfheal revive landed (pushed by the rank via the one-way PMIx
 #: "coll_rejoin" RPC — the rejoin half of the revive cycle)
+#: ``remediate`` = the DVM's doctor-driven remediation actor acted on a
+#: watchdog verdict (SIGCONT probe / reap-and-revive / kill+requeue /
+#: budget-exhausted reject); ``requeue`` = a remediated job went back on
+#: the admission queue for a fresh placement
 KINDS = ("detect", "reap", "revive", "shrink", "escalate", "abort",
          "daemon_lost", "reparent", "finished", "stuck", "doctor",
-         "coll_rejoin")
+         "coll_rejoin", "remediate", "requeue")
 
 
 class FtEventLog:

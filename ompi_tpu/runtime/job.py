@@ -90,6 +90,11 @@ class Proc:
     # host, so no revival order can reach it — a reviving errmgr policy
     # must skip straight to its degrade rung
     daemon_lost: bool = False
+    # planned shrink (elastic jobs): the rank is being retired on
+    # purpose, so a reviving policy must NOT resurrect it — selfheal
+    # degrades straight to its notify/shrink rung and the survivors
+    # continue smaller (the ULFM recipe)
+    no_revive: bool = False
 
 
 @dataclasses.dataclass
@@ -117,6 +122,13 @@ class Job:
         self.aborted_proc: Optional[Proc] = None
         self.abort_reason: Optional[str] = None
         self.abort_status: Optional[int] = None
+        # per-job launcher bookkeeping (a multi-tenant DVM runs several
+        # jobs concurrently, so none of this can live on the launcher):
+        # rank → rc once the exit report landed, the job-scoped kill
+        # latch, and the job's own PMIx rendezvous
+        self.exited: dict[int, int] = {}
+        self.killed: bool = False
+        self.pmix_server: Optional[Any] = None
 
     @property
     def np(self) -> int:

@@ -452,6 +452,18 @@ class MetricsAggregate:
                     # (a long-lived DVM must not leak one per dead job)
                     self._strag_base.pop(jobid, None)
 
+    def prune_job(self, jobid: int) -> None:
+        """Drop one job's per-rank counter tables and straggler baseline
+        NOW instead of waiting for the MAX_JOBS age eviction: the DVM
+        scheduler calls this when a job's record rotates out of its
+        bounded history (and on requeue, so a fresh attempt's counters
+        don't stack on the killed attempt's) — a standing pool serving
+        thousands of short jobs must not hold 64 dead tables between
+        evictions."""
+        with self._lock:
+            self._jobs.pop(int(jobid), None)
+            self._strag_base.pop(int(jobid), None)
+
     def stats(self) -> dict:
         """Terminal-stage self-metrics for /status."""
         with self._lock:

@@ -75,6 +75,23 @@ class _StdinWriter:
                 return
 
 
+class _LocalJob:
+    """One tenant's local state on this daemon: the launch spec, the
+    rows this daemon owns (rank → (local_rank, chip)), and the live
+    Popen/stdin handles.  A multi-tenant DVM runs several jobs at once,
+    so everything that used to be daemon-global lives here, keyed by
+    jobid.  The full spec is stored on EVERY daemon (the launch xcast
+    carries the whole map), which is what lets a TAG_RESPAWN retarget a
+    rank to a daemon that never owned it (migration on revive)."""
+
+    def __init__(self, jobid: int, spec: dict) -> None:
+        self.jobid = jobid
+        self.spec = spec
+        self.rows: dict[int, tuple[int, Optional[str]]] = {}
+        self.popen: dict[int, subprocess.Popen] = {}
+        self.stdin_writers: dict[int, _StdinWriter] = {}
+
+
 class Orted:
     def __init__(self, hnp_uri: str, vpid: int, ndaemons: int,
                  fake_host: Optional[str] = None) -> None:
@@ -83,8 +100,7 @@ class Orted:
         self.fake_host = fake_host
         self.hostname = fake_host or os.uname().nodename
         self.node = rml.RmlNode(vpid)
-        self._popen: dict[int, subprocess.Popen] = {}
-        self._stdin_writers: dict[int, _StdinWriter] = {}
+        self._jobs: dict[int, _LocalJob] = {}
         self._launched = False
         self._pending_stdin: list = []  # stdin xcasts that beat the launch
         self._lock = threading.Lock()
@@ -106,6 +122,7 @@ class Orted:
         self.node.register_recv(rml.TAG_REPARENT, self._on_reparent)
         self.node.register_recv(rml.TAG_ADOPT, self._on_adopt)
         self.node.register_recv(rml.TAG_KILL_RANK, self._on_kill_rank)
+        self.node.register_recv(rml.TAG_SIGNAL_RANK, self._on_signal_rank)
         self.node.register_recv(rml.TAG_TIMELINE, self._on_timeline)
         # measured clock sync: pingpong my parent edge, compose the
         # offset to the root, and answer my own children's probes with
@@ -116,8 +133,6 @@ class Orted:
         self._clock = clocksync.ClockProber(self.node)
         clocksync.install_responder(self.node,
                                     self._clock.offset_to_root_ns)
-        self._spec: Optional[dict] = None
-        self._my_rows: dict[int, tuple[int, Optional[int]]] = {}
         # metrics uplink: when trace_metrics_push_period > 0 this daemon
         # runs a UDP collector its local ranks push pvar snapshots to
         # (the URI is exported into every rank's env), merges them with
@@ -280,15 +295,35 @@ class Orted:
         """Reap exactly one rank (a hung pid the rank-plane gossip
         detector reported): SIGKILL its process group; the exit report
         then flows through the normal waiter → errmgr path."""
-        rank = int(payload)
+        jobid, rank = int(payload[0]), int(payload[1])
         with self._lock:
-            p = self._popen.get(rank)
+            lj = self._jobs.get(jobid)
+            p = lj.popen.get(rank) if lj is not None else None
         if p is None or p.poll() is not None:
             return
         _log.verbose(1, "orted %d: reaping reported-dead rank %d (pid %d)",
                      self.vpid, rank, p.pid)
         try:
             os.killpg(p.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+    def _on_signal_rank(self, origin: int, payload) -> None:
+        """Deliver one signal to one rank's process group — the DVM
+        remediation actor's SIGCONT probe (a SIGSTOP'd straggler may
+        just resume; only if it stays wedged does the actor pay a
+        reap-and-revive)."""
+        jobid, rank, signum = (int(payload[0]), int(payload[1]),
+                               int(payload[2]))
+        with self._lock:
+            lj = self._jobs.get(jobid)
+            p = lj.popen.get(rank) if lj is not None else None
+        if p is None or p.poll() is not None:
+            return
+        _log.verbose(1, "orted %d: signal %d → rank %d (pid %d)",
+                     self.vpid, signum, rank, p.pid)
+        try:
+            os.killpg(p.pid, signum)
         except (ProcessLookupError, PermissionError):
             pass
 
@@ -302,12 +337,13 @@ class Orted:
         threading.Thread(target=self._launch_local, args=(payload,),
                          daemon=True).start()
 
-    def _spawn_rank(self, spec: dict, rank: int, local_rank: int,
+    def _spawn_rank(self, lj: _LocalJob, rank: int, local_rank: int,
                     chip, restarts: int = 0) -> None:
         """Fork/exec one rank (first launch or TAG_RESPAWN revival)."""
         from ompi_tpu.core import pkg_root as _pkg_root
         from ompi_tpu.runtime.rtc import bind_child
 
+        spec = lj.spec
         root = _pkg_root()
         env = dict(os.environ)
         env.update(spec["env"])
@@ -339,29 +375,35 @@ class Orted:
                 start_new_session=True)
         except OSError as e:
             # ≈ odls error-pipe: report the exec failure as an exit
-            self.node.send_up(rml.TAG_PROC_EXIT, (rank, 127, str(e)))
+            self.node.send_up(rml.TAG_PROC_EXIT,
+                              (lj.jobid, rank, 127, str(e)))
             return
         bind_child(p.pid, local_rank)
         with self._lock:
-            self._popen[rank] = p
+            lj.popen[rank] = p
             if want_stdin:
-                old = self._stdin_writers.pop(rank, None)
+                old = lj.stdin_writers.pop(rank, None)
                 if old is not None:
                     old.feed(None)
-                self._stdin_writers[rank] = _StdinWriter(rank, p.stdin)
-        self._start_iof(rank, p)
-        threading.Thread(target=self._waiter, args=(rank, p),
+                lj.stdin_writers[rank] = _StdinWriter(rank, p.stdin)
+        self._start_iof(lj.jobid, rank, p)
+        threading.Thread(target=self._waiter, args=(lj.jobid, rank, p),
                          daemon=True).start()
 
     def _launch_local(self, spec: dict) -> None:
+        jobid = int(spec.get("jobid") or 0)
         mine: list = []
         for vpid, rows in spec["by_daemon"]:
             if vpid == self.vpid:
                 mine = rows
                 break
         with self._lock:
-            self._spec = spec
-            self._my_rows = {r: (lr, ch) for r, lr, ch in mine}
+            lj = self._jobs.get(jobid)
+            if lj is None:
+                lj = self._jobs[jobid] = _LocalJob(jobid, spec)
+            else:
+                lj.spec = spec
+            lj.rows = {r: (lr, ch) for r, lr, ch in mine}
         # deterministic chaos, barrier-keyed: a plan entry
         # ``daemon=<vpid>:kill@reg=N`` arms a self-SIGKILL that fires
         # only once N ranks have registered with the job's PMIx server
@@ -371,7 +413,7 @@ class Orted:
 
         faultinject.arm_daemon_launch(self.vpid, spec.get("env") or {})
         for rank, local_rank, chip in mine:
-            self._spawn_rank(spec, rank, local_rank, chip)
+            self._spawn_rank(lj, rank, local_rank, chip)
         # replay stdin that raced ahead of the launch xcast.  The replay
         # must happen under the lock that gates _launched: otherwise a
         # chunk arriving on the RML thread right after the flag flips
@@ -381,19 +423,27 @@ class Orted:
         with self._lock:
             pending, self._pending_stdin = self._pending_stdin, []
             for rank, chunk in pending:
-                writers = (list(self._stdin_writers.values())
-                           if rank == "all"
-                           else [w for w in (self._stdin_writers.get(rank),)
-                                 if w is not None])
-                for w in writers:
+                for w in self._stdin_targets(rank):
                     w.feed(chunk)
             self._launched = True
 
-    def _start_iof(self, rank: int, p: subprocess.Popen) -> None:
+    def _stdin_targets(self, rank) -> list[_StdinWriter]:
+        """Writers a stdin chunk fans out to (caller holds _lock).
+        stdin forwarding is a non-DVM, single-job feature, but routing
+        across every job keeps it correct if a tenant ever asks."""
+        if rank == "all":
+            return [w for lj in self._jobs.values()
+                    for w in lj.stdin_writers.values()]
+        return [w for lj in self._jobs.values()
+                for r, w in lj.stdin_writers.items() if r == rank]
+
+    def _start_iof(self, jobid: int, rank: int,
+                   p: subprocess.Popen) -> None:
         def reader(pipe, stream: str) -> None:
             for raw in iter(pipe.readline, b""):
                 try:
-                    self.node.send_up(rml.TAG_IOF, (rank, stream, raw))
+                    self.node.send_up(rml.TAG_IOF,
+                                      (jobid, rank, stream, raw))
                 except ConnectionError:
                     return
             pipe.close()
@@ -402,20 +452,32 @@ class Orted:
             threading.Thread(target=reader, args=(pipe, stream),
                              daemon=True).start()
 
-    def _waiter(self, rank: int, p: subprocess.Popen) -> None:
+    def _waiter(self, jobid: int, rank: int, p: subprocess.Popen) -> None:
         rc = p.wait()
         # let IOF readers drain the tail before the exit report races them
         time.sleep(0.05)
         try:
-            self.node.send_up(rml.TAG_PROC_EXIT, (rank, rc, ""))
+            self.node.send_up(rml.TAG_PROC_EXIT, (jobid, rank, rc, ""))
         except ConnectionError:
             pass
 
     # -- control -----------------------------------------------------------
 
     def _on_kill(self, origin: int, payload) -> None:
+        """Tear one job down (payload = jobid: its state is dropped —
+        the DVM sends this when a tenant leaves the pool) or every job
+        (payload None: lifeline teardown / VM shutdown)."""
         with self._lock:
-            victims = list(self._popen.values())
+            if payload is None:
+                doomed = list(self._jobs.values())
+            else:
+                lj = self._jobs.pop(int(payload), None)
+                doomed = [lj] if lj is not None else []
+            victims = [p for lj in doomed for p in lj.popen.values()]
+            writers = [w for lj in doomed
+                       for w in lj.stdin_writers.values()]
+        for w in writers:
+            w.feed(None)
         for p in victims:
             if p.poll() is None:
                 try:
@@ -433,21 +495,41 @@ class Orted:
                     pass
 
     def _on_respawn(self, origin: int, payload) -> None:
-        """errmgr/respawn xcast: the daemon owning the rank revives it
-        (≈ the odls relaunch arm of the errmgr restart path)."""
-        rank, restarts = payload
+        """errmgr/respawn xcast: the TARGET daemon revives the rank
+        (≈ the odls relaunch arm of the errmgr restart path).  The
+        payload names an explicit target vpid: normally the original
+        owner, but the DVM remediation actor may retarget a straggler
+        to a less-loaded host — every daemon holds the job spec, so the
+        adopter just adds the row; the old owner drops it."""
+        jobid = int(payload["jobid"])
+        rank = int(payload["rank"])
+        lives = int(payload["lives"])
+        target = int(payload.get("target") or 0)
         with self._lock:
-            row = self._my_rows.get(rank)
-            spec = self._spec
-        if row is None or spec is None:
-            return  # another daemon's rank
+            lj = self._jobs.get(jobid)
+            if lj is None:
+                return  # this daemon never saw the job's launch
+            if target != self.vpid:
+                # migrated away (or another daemon's rank all along):
+                # make sure no stale row revives it here later
+                lj.rows.pop(rank, None)
+                lj.popen.pop(rank, None)
+                return
+            row = lj.rows.get(rank)
+            if row is None:
+                # adoption: keep the rank's original local_rank/chip —
+                # on a sim pool local_rank only feeds ENV/bind hints,
+                # and a real placement would remap chips at rejoin
+                row = (int(payload.get("local_rank") or 0),
+                       payload.get("chip"))
+                lj.rows[rank] = row
         local_rank, chip = row
         _log.verbose(1, "orted %d: respawning rank %d (restart %d)",
-                     self.vpid, rank, restarts)
+                     self.vpid, rank, lives)
         # spawn off the RML reader thread (fork/exec + iof setup)
         threading.Thread(
-            target=self._spawn_rank, args=(spec, rank, local_rank, chip),
-            kwargs={"restarts": restarts}, daemon=True).start()
+            target=self._spawn_rank, args=(lj, rank, local_rank, chip),
+            kwargs={"restarts": lives}, daemon=True).start()
 
     def _on_stats(self, origin: int, payload) -> None:
         """≈ the sensor/resusage sampling orte-top pulls: per-rank
@@ -457,8 +539,10 @@ class Orted:
         tick = os.sysconf("SC_CLK_TCK")
         rows = []
         with self._lock:
-            procs = list(self._popen.items())
-        for rank, p in procs:
+            procs = [(lj.jobid, rank, p)
+                     for lj in self._jobs.values()
+                     for rank, p in lj.popen.items()]
+        for jobid, rank, p in procs:
             if p.poll() is not None:
                 continue
             try:
@@ -469,7 +553,7 @@ class Orted:
                     cpu_s = (int(parts[11]) + int(parts[12])) / tick
             except (OSError, IndexError, ValueError):
                 continue
-            rows.append((rank, p.pid, rss, cpu_s))
+            rows.append((jobid, rank, p.pid, rss, cpu_s))
         try:
             # payload is the requester's epoch — echoed so a late reply
             # from an earlier round cannot satisfy a newer collection
@@ -493,24 +577,27 @@ class Orted:
         from ompi_tpu.runtime import doctor
 
         with self._lock:
-            procs = [(r, p) for r, p in self._popen.items()
-                     if p.poll() is None]
-            spec = self._spec
-        ports: dict[int, int] = {}
-        uri = ((spec or {}).get("env") or {}).get(pmix.ENV_URI)
-        if uri and procs:
-            ports = pmix.query_doctor_ports(uri) or {}
+            jobs = [(lj.jobid, lj.spec,
+                     [(r, p) for r, p in lj.popen.items()
+                      if p.poll() is None])
+                    for lj in self._jobs.values()]
         rows = []
-        for rank, p in sorted(procs):
-            cap = None
-            port = ports.get(rank)
-            if port:
-                cap = doctor.query_rank(port)
-            if cap is None:
-                cap = {"rank": rank, "no_response": True,
-                       "proc": doctor.proc_probe(p.pid)}
-            cap["pid"] = p.pid
-            rows.append(cap)
+        for jobid, spec, procs in jobs:
+            ports: dict[int, int] = {}
+            uri = ((spec or {}).get("env") or {}).get(pmix.ENV_URI)
+            if uri and procs:
+                ports = pmix.query_doctor_ports(uri) or {}
+            for rank, p in sorted(procs):
+                cap = None
+                port = ports.get(rank)
+                if port:
+                    cap = doctor.query_rank(port)
+                if cap is None:
+                    cap = {"rank": rank, "no_response": True,
+                           "proc": doctor.proc_probe(p.pid)}
+                cap["pid"] = p.pid
+                cap["jobid"] = jobid
+                rows.append(cap)
         try:
             self.node.send_up(rml.TAG_DOCTOR_REPLY,
                               (self.vpid, epoch, rows))
@@ -534,24 +621,28 @@ class Orted:
         except (TypeError, ValueError):
             epoch, tail = payload, 2048
         with self._lock:
-            procs = [(r, p) for r, p in self._popen.items()
-                     if p.poll() is None]
-            spec = self._spec
-        ports: dict[int, int] = {}
-        uri = ((spec or {}).get("env") or {}).get(pmix.ENV_URI)
-        if uri and procs:
-            ports = pmix.query_doctor_ports(uri) or {}
+            jobs = [(lj.jobid, lj.spec,
+                     [(r, p) for r, p in lj.popen.items()
+                      if p.poll() is None])
+                    for lj in self._jobs.values()]
         off_root = self._clock.offset_to_root_ns()
         rows = []
-        for rank, p in sorted(procs):
-            port = ports.get(rank)
-            cap = doctor.query_timeline(port, tail) if port else None
-            if cap is None:
-                cap = {"rank": rank, "no_response": True}
-            # stamp the daemon-measured offset: ranks share this host's
-            # kernel clock, so one offset corrects every local rank
-            cap["clock_to_root_ns"] = off_root
-            rows.append(cap)
+        for jobid, spec, procs in jobs:
+            ports: dict[int, int] = {}
+            uri = ((spec or {}).get("env") or {}).get(pmix.ENV_URI)
+            if uri and procs:
+                ports = pmix.query_doctor_ports(uri) or {}
+            for rank, p in sorted(procs):
+                port = ports.get(rank)
+                cap = doctor.query_timeline(port, tail) if port else None
+                if cap is None:
+                    cap = {"rank": rank, "no_response": True}
+                # stamp the daemon-measured offset: ranks share this
+                # host's kernel clock, so one offset corrects every
+                # local rank
+                cap["clock_to_root_ns"] = off_root
+                cap["jobid"] = jobid
+                rows.append(cap)
         try:
             self.node.send_up(rml.TAG_TIMELINE_REPLY,
                               (self.vpid, epoch, rows))
@@ -568,9 +659,7 @@ class Orted:
             if not self._launched:
                 self._pending_stdin.append(payload)
                 return
-            writers = (list(self._stdin_writers.values()) if rank == "all"
-                       else [w for w in (self._stdin_writers.get(rank),)
-                             if w is not None])
+            writers = self._stdin_targets(rank)
         for w in writers:
             w.feed(chunk)
 

@@ -151,7 +151,6 @@ class MultiHostLauncher:
         self._registered: dict[int, tuple[str, str]] = {}  # vpid→(uri,host)
         self._ready: set[int] = set()
         self._cv = threading.Condition()
-        self._exited: dict[int, int] = {}                  # rank → rc
         self._killed = False
         self._lost_daemon: Optional[int] = None            # vpid, if died
         self._dead_daemons: set[int] = set()   # every vpid ever declared
@@ -159,6 +158,15 @@ class MultiHostLauncher:
         # idempotence guard AND the ancestry map re-parenting skips over
         self._np_hint = 1 << 30                            # set at launch
         self._cur_job: Optional[Job] = None
+        # the standing allocation the daemon vpids index into (vpid =
+        # pool index + 1) — job.nodes may be a gang-placed SUBSET of
+        # these on a multi-tenant DVM, so vpid↔node lookups must never
+        # go through job.nodes
+        self._pool_nodes: list = []
+        # every job with apps launched and not yet retired, keyed by
+        # jobid: the exit/IOF/doctor routers resolve payloads here (a
+        # multi-tenant DVM runs several at once)
+        self._jobs_by_id: dict[int, Job] = {}
         self._persistent = False          # DVM mode: VM outlives jobs
         self._vm_stop = threading.Event()
         self._hb_monitor: Optional[rml.HeartbeatMonitor] = None
@@ -192,13 +200,12 @@ class MultiHostLauncher:
         n_daemons = len(job.nodes)
         self._np_hint = job.np
         self._cur_job = job
+        self._pool_nodes = list(job.nodes)
         self.rml = rml.RmlNode(0)
         self.rml.register_recv(rml.TAG_REGISTER, self._on_register)
         self.rml.register_recv(rml.TAG_DAEMON_READY, self._on_ready)
         self.rml.register_recv(rml.TAG_IOF, self._on_iof)
-        self.rml.register_recv(
-            rml.TAG_PROC_EXIT,
-            lambda o, p: self._on_proc_exit(self._cur_job, p))
+        self.rml.register_recv(rml.TAG_PROC_EXIT, self._route_proc_exit)
         self.rml.register_recv(rml.TAG_ORPHANED, self._on_orphaned)
         self.rml.register_recv(rml.TAG_REPARENT_ACK, self._on_reparent_ack)
         self.rml.register_recv(rml.TAG_METRICS,
@@ -271,47 +278,66 @@ class MultiHostLauncher:
         self._hb_monitor.start()
         return True
 
+    def _node_vpid(self, node) -> int:
+        """The daemon vpid owning a pool node (identity lookup against
+        the STANDING allocation — a gang-placed job's job.nodes is a
+        subset of the pool in arbitrary least-loaded order, so indexing
+        job.nodes would address the wrong daemon)."""
+        for i, n in enumerate(self._pool_nodes):
+            if n is node:
+                return i + 1
+        return 0
+
     def _launch_apps(self, job: Job) -> None:
         """LAUNCH_APPS: fresh pmix rendezvous sized to this job, then one
         xcast with the whole map; daemons pick their rows."""
         self._cur_job = job
         self._np_hint = job.np
-        self.server = pmix.PMIxServer(
+        job.exited = {}
+        job.killed = False
+        server = pmix.PMIxServer(
             size=job.np, host="0.0.0.0",
-            on_abort=lambda r, s, m: self._on_abort(self._cur_job, r, s, m))
+            on_abort=lambda r, s, m: self._on_abort(job, r, s, m))
         # rank-plane gossip feedback: a reported hung rank is reaped by
         # its owning daemon (TAG_KILL_RANK) so the exit report flows and
         # the errmgr policy runs — without this a SIGSTOP'd pid would
         # stall _wait_ranks forever
-        self.server.on_failed_report = \
-            lambda r, reason: self._reap_reported(r, reason)
+        server.on_failed_report = \
+            lambda r, reason: self._reap_reported(job, r, reason)
         # uptime clock (errmgr crash-loop governor): starts at each
         # rank's PMIx registration so boot doesn't count toward
         # errmgr_min_uptime_s
-        self.server.on_client_contact = self._mark_contact
+        server.on_client_contact = \
+            lambda r: self._mark_contact(job, r)
+        # per-job rendezvous: concurrent tenants each get their own
+        # server/port; self.server mirrors the latest for the non-DVM
+        # single-job paths (and custom-launcher compat in errmgr)
+        job.pmix_server = server
+        self.server = server
+        self._jobs_by_id[job.jobid] = job
         app = job.apps[0]
         env = dict(app.env)
         # the xcast env overlays the daemons' os.environ (orted merge
         # order), so the client's own environ counts as an explicit
         # user setting here
         errmgr_mod.apply_host_plane_policy(self._errmgr, env, os.environ)
-        env[pmix.ENV_URI] = self.server.uri.replace("0.0.0.0",
-                                                    self._my_address())
+        env[pmix.ENV_URI] = server.uri.replace("0.0.0.0",
+                                               self._my_address())
         env[pmix.ENV_SIZE] = str(job.np)
         env[pmix.ENV_JOBID] = str(job.jobid)
         env.update(self._jax_coord_env(job))
         by_daemon = []
-        for i, node in enumerate(job.nodes):
+        for node in job.nodes:
             rows = [(p.rank, p.local_rank,
                      None if p.chip is None else str(p.chip))
                     for p in job.procs_on(node)]
-            by_daemon.append((i + 1, rows))
+            by_daemon.append((self._node_vpid(node), rows))
         stdin_rank = (self.stdin_target if self.stdin_target in ("all",)
                       else None if self.stdin_target == "none"
                       else int(self.stdin_target))
         self.rml.xcast(rml.TAG_LAUNCH, {
-            "by_daemon": by_daemon, "argv": app.argv, "env": env,
-            "cwd": app.cwd, "stdin_rank": stdin_rank})
+            "jobid": job.jobid, "by_daemon": by_daemon, "argv": app.argv,
+            "env": env, "cwd": app.cwd, "stdin_rank": stdin_rank})
         for p in job.procs:
             p.state = ProcState.RUNNING
         if stdin_rank is not None:
@@ -324,24 +350,24 @@ class MultiHostLauncher:
         # waiting only on rank exits would hang.
         with self._cv:
             self._cv.wait_for(
-                lambda: (len(self._exited) >= job.np
+                lambda: (len(job.exited) >= job.np
                          or self._lost_daemon is not None
                          or self._vm_stop.is_set()),
                 )
             lost = self._lost_daemon
         report_wait = var_registry.get("plm_exit_report_timeout")
-        if self._vm_stop.is_set() and len(self._exited) < job.np:
+        if self._vm_stop.is_set() and len(job.exited) < job.np:
             # VM shutdown ordered mid-job (DVM stop): ranks were killed
             # with the daemons; give their exit reports a moment, then
             # account the job as aborted rather than hanging forever
             with self._cv:
-                self._cv.wait_for(lambda: len(self._exited) >= job.np,
+                self._cv.wait_for(lambda: len(job.exited) >= job.np,
                                   timeout=report_wait)
-            if job.aborted_proc is None and len(self._exited) < job.np:
+            if job.aborted_proc is None and len(job.exited) < job.np:
                 job.abort_reason = "VM shut down while the job was running"
                 job.aborted_proc = job.procs[0]
             return
-        if lost is not None and len(self._exited) < job.np:
+        if lost is not None and len(job.exited) < job.np:
             if job.aborted_proc is None:
                 job.abort_reason = (
                     f"daemon {lost} (host "
@@ -351,14 +377,14 @@ class MultiHostLauncher:
             self.kill_job(job)
             # best effort: wait only for ranks whose daemon still lives —
             # the dead daemon's ranks can never report
-            lost_node = (job.nodes[lost - 1]
-                         if 0 < lost <= len(job.nodes) else None)
+            lost_node = (self._pool_nodes[lost - 1]
+                         if 0 < lost <= len(self._pool_nodes) else None)
             dead = ({p.rank for p in job.procs_on(lost_node)}
                     if lost_node is not None else set())
             alive = [p.rank for p in job.procs if p.rank not in dead]
             with self._cv:
                 self._cv.wait_for(
-                    lambda: all(r in self._exited for r in alive),
+                    lambda: all(r in job.exited for r in alive),
                     timeout=report_wait)
 
     def _teardown_vm(self) -> None:
@@ -399,13 +425,25 @@ class MultiHostLauncher:
             self._cv.notify_all()
 
     def _on_iof(self, origin: int, payload) -> None:
-        rank, stream, raw = payload
+        _jobid, rank, stream, raw = payload
         sink = sys.stdout if stream == "out" else sys.stderr
         line = bytes(raw).decode(errors="replace")
         if var_registry.get("launcher_tag_output"):
             line = f"[mh,{rank}]{line}"
         sink.write(line)
         sink.flush()
+
+    def _route_proc_exit(self, origin: int, payload) -> None:
+        """TAG_PROC_EXIT router: resolve the owning job by jobid and feed
+        the job-scoped handler.  A report for an already-retired job
+        (raced with a jobid-scoped kill) is dropped — its submission has
+        been accounted."""
+        jobid, rank, rc, errmsg = payload
+        with self._cv:
+            job = self._jobs_by_id.get(int(jobid)) or self._cur_job
+        if job is None or not (0 <= int(rank) < len(job.procs)):
+            return
+        self._on_proc_exit(job, (int(rank), rc, errmsg))
 
     def respawn_proc(self, job: Job, proc) -> bool:
         """errmgr/respawn hook for the daemon tree: xcast a revival order;
@@ -416,8 +454,17 @@ class MultiHostLauncher:
 
         proc.restarts += 1   # budget burn (governor may reset it)
         proc.lives += 1      # identity: monotone, survives budget resets
+        # the revival order carries the rank's CURRENT placement: the
+        # daemon whose vpid matches `target` adopts the row and spawns
+        # (a remediation may have migrated proc.node to a less-loaded
+        # host); every other daemon drops any stale row it still holds
         try:
-            self.rml.xcast(rml.TAG_RESPAWN, (proc.rank, proc.lives))
+            self.rml.xcast(rml.TAG_RESPAWN, {
+                "jobid": job.jobid, "rank": proc.rank, "lives": proc.lives,
+                "target": (self._node_vpid(proc.node)
+                           if proc.node is not None else 0),
+                "local_rank": proc.local_rank,
+                "chip": None if proc.chip is None else str(proc.chip)})
         except Exception as e:  # noqa: BLE001 — tree may be tearing down
             _log.error("respawn xcast for rank %d failed: %r", proc.rank, e)
             return False
@@ -429,32 +476,34 @@ class MultiHostLauncher:
         proc.exit_code = None
         proc.state = ProcState.RUNNING
         proc.launched_at = None  # stamped again at PMIx registration
-        if self.server is not None:
-            self.server.proc_revived(proc.rank, proc.lives)
+        server = getattr(job, "pmix_server", None) or self.server
+        if server is not None:
+            server.proc_revived(proc.rank, proc.lives)
         return True
 
     def _on_proc_exit(self, job: Job, payload) -> None:
         rank, rc, errmsg = payload
         proc = job.procs[rank]
         proc.exit_code = rc
+        server = getattr(job, "pmix_server", None) or self.server
         if proc.state == ProcState.KILLED_BY_CMD:
             pass
         elif rc == 0:
             proc.state = ProcState.TERMINATED
             # a clean finisher's stopped beats are completion, not a
             # hang — gate late gossip reports about it
-            if self.server is not None:
-                self.server.proc_finished(rank)
+            if server is not None:
+                server.proc_finished(rank)
         else:
             proc.state = (ProcState.FAILED_TO_START if errmsg
                           else ProcState.ABORTED)
-            if self.server is not None:
-                self.server.proc_died(rank)
+            if server is not None:
+                server.proc_died(rank)
             self._errmgr.proc_failed(self, job, proc)
             if proc.state == ProcState.RUNNING:
                 return  # errmgr revived the rank; its exit is yet to come
         with self._cv:
-            self._exited[rank] = rc
+            job.exited[rank] = rc
             self._cv.notify_all()
 
     def _on_daemon_lost(self, vpid: int) -> None:
@@ -469,17 +518,25 @@ class MultiHostLauncher:
             if vpid in self._dead_daemons:
                 return  # several detectors race to the same corpse
             self._dead_daemons.add(vpid)
+            cur = self._cur_job
             if self._killed or self._vm_stop.is_set() or (
-                    not self._persistent
-                    and len(self._exited) >= self._np_hint):
+                    not self._persistent and cur is not None
+                    and len(cur.exited) >= self._np_hint):
                 return  # normal teardown, not a failure
-            job = self._cur_job
+            # a multi-tenant pool may have several jobs with ranks on the
+            # dead host — every one of them takes the loss (fall back to
+            # the current job so the single-job path behaves as before)
+            jobs = ([j for j in self._jobs_by_id.values()
+                     if not j.killed] or
+                    ([cur] if cur is not None else []))
+            job = jobs[0] if jobs else None
             reparent = (getattr(self._errmgr, "TOLERATES_DAEMON_LOSS",
                                 False)
                         and job is not None
-                        and 0 < vpid <= len(job.nodes))
+                        and 0 < vpid <= len(self._pool_nodes))
             if reparent:
-                self._fail_daemon_ranks(job, vpid)
+                for j in jobs:
+                    self._fail_daemon_ranks(j, vpid)
             else:
                 if self._lost_daemon is None:
                     self._lost_daemon = vpid
@@ -572,24 +629,23 @@ class MultiHostLauncher:
         vpid, new_parent = payload
         _log.verbose(1, "orted %d re-wired under %d", vpid, new_parent)
 
-    def _mark_contact(self, rank: int) -> None:
+    def _mark_contact(self, job: Job, rank: int) -> None:
         """PMIx server hook: the rank's current life registered — start
         its uptime clock (errmgr_min_uptime_s measures from here)."""
-        job = self._cur_job
         if job is not None and 0 <= rank < len(job.procs):
             job.procs[rank].launched_at = time.monotonic()
 
-    def _reap_reported(self, rank: int, reason: str) -> None:
+    def _reap_reported(self, job: Job, rank: int, reason: str) -> None:
         """Order the owning daemon to SIGKILL one reported-hung rank."""
         from ompi_tpu.runtime import ftevents
 
         _log.verbose(1, "reaping reported-dead rank %d via the tree: %s",
                      rank, reason or "gossip-declared")
         ftevents.record(
-            "reap", jobid=(self._cur_job.jobid if self._cur_job else 0),
+            "reap", jobid=job.jobid,
             rank=rank, reason=reason or "gossip-declared")
         try:
-            self.rml.xcast(rml.TAG_KILL_RANK, rank)
+            self.rml.xcast(rml.TAG_KILL_RANK, (job.jobid, rank))
         except Exception as e:  # noqa: BLE001 — tree may be tearing down
             _log.error("kill-rank xcast for %d failed: %r", rank, e)
 
@@ -598,9 +654,10 @@ class MultiHostLauncher:
         declare each of them failed NOW (the errmgr policy propagates
         each death to the survivors) and record synthetic exits so
         _wait_ranks completes on the survivors alone."""
-        node = job.nodes[vpid - 1]
+        node = self._pool_nodes[vpid - 1]
         victims = [p for p in job.procs_on(node)
-                   if p.rank not in self._exited]
+                   if p.rank not in job.exited]
+        server = getattr(job, "pmix_server", None) or self.server
         for proc in victims:
             proc.state = ProcState.ABORTED
             proc.exit_code = -9
@@ -609,11 +666,11 @@ class MultiHostLauncher:
             # its shrink rung instead of marking the rank RUNNING and
             # waiting forever on an exit that cannot come
             proc.daemon_lost = True
-            if self.server is not None:
-                self.server.proc_died(
+            if server is not None:
+                server.proc_died(
                     proc.rank,
                     reason=f"daemon vpid {vpid} (host {node.name}) died")
-            self._exited[proc.rank] = -9
+            job.exited[proc.rank] = -9
         self._cv.notify_all()
         # notify's and selfheal's daemon-lost arms are non-blocking (an
         # xcast + a log line, no revive attempt) and take no plm locks,
@@ -639,7 +696,7 @@ class MultiHostLauncher:
                 # _killed is job-scoped on a persistent VM (reset per
                 # submission): the monitor must outlive an aborted job
                 if (not self._persistent
-                        and (self._killed or len(self._exited) >= job.np)):
+                        and (self._killed or len(job.exited) >= job.np)):
                     return
             for i, p in enumerate(self._daemon_popen):
                 if i + 1 in handled:
@@ -662,14 +719,21 @@ class MultiHostLauncher:
     # -- control -----------------------------------------------------------
 
     def kill_job(self, job: Job, exclude: Optional[Proc] = None) -> None:
-        """errmgr entry point: xcast a kill; daemons SIGTERM/SIGKILL."""
-        if self._killed or self.rml is None:
+        """errmgr entry point: xcast a jobid-scoped kill; the daemons
+        SIGTERM/SIGKILL that job's ranks and drop its state — co-tenants
+        on the same pool are untouched."""
+        if job.killed or self.rml is None:
             return
-        self._killed = True
+        job.killed = True
+        if not self._persistent:
+            # single-job launch: the job dying means the VM is coming
+            # down — keep the launcher-global latch for the monitor and
+            # the daemon-loss teardown checks
+            self._killed = True
         for p in job.procs:
             if p.state == ProcState.RUNNING and p is not exclude:
                 p.state = ProcState.KILLED_BY_CMD
-        self.rml.xcast(rml.TAG_KILL, None)
+        self.rml.xcast(rml.TAG_KILL, job.jobid)
 
     def _start_stdin_pump(self, target) -> None:
         """IOF stdin forwarding (≈ iof.h:27-43; default target rank 0)."""

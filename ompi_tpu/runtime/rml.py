@@ -60,15 +60,25 @@ register_var("rml", "reparent_timeout", VarType.DOUBLE, 10.0,
 TAG_REGISTER = "register"       # daemon → HNP: (vpid, uri, hostname)
 TAG_WIRE = "wire"               # HNP → daemon: children to dial
 TAG_LAUNCH = "launch"           # xcast: proc table
-TAG_KILL = "kill"               # xcast: tear the job down
+TAG_KILL = "kill"               # xcast: jobid | None — tear ONE job
+#                                 down (daemons drop its spec/procs)
+#                                 or, with None, every job (lifeline
+#                                 teardown / VM shutdown)
 TAG_SHUTDOWN = "shutdown"       # xcast: daemons exit
-TAG_IOF = "iof"                 # up: (rank, stream, chunk)
+TAG_IOF = "iof"                 # up: (jobid, rank, stream, chunk)
 TAG_STDIN = "stdin"             # xcast: (target_rank, chunk | None=EOF)
-TAG_PROC_EXIT = "proc_exit"     # up: (rank, exit_code)
+TAG_PROC_EXIT = "proc_exit"     # up: (jobid, rank, rc, errmsg)
 TAG_DAEMON_READY = "ready"      # up: daemon wired + children connected
-TAG_RESPAWN = "respawn"         # xcast: (rank, restarts) — owner revives
+TAG_RESPAWN = "respawn"         # xcast: {jobid, rank, lives, target,
+#                                 local_rank, chip} — the daemon whose
+#                                 vpid == target adopts the row and
+#                                 revives the rank (migration: every
+#                                 daemon holds the job spec, so the
+#                                 target need not be the original
+#                                 owner); other daemons drop the row
 TAG_STATS = "stats"             # xcast: request per-rank resource usage
-TAG_STATS_REPLY = "stats_reply"  # up: (vpid, [(rank, pid, rss, cpu_s)...])
+TAG_STATS_REPLY = "stats_reply"  # up: (vpid, epoch,
+#                                 [(jobid, rank, pid, rss, cpu_s)...])
 TAG_HEARTBEAT = "heartbeat"     # up: vpid — daemon liveness beat
 TAG_PROC_FAILED = "proc_failed"  # xcast: (rank, reason) — errmgr notify
 #                                  propagating a rank death to survivors
@@ -81,9 +91,16 @@ TAG_REPARENT = "reparent"       # direct HNP → orphan: new parent vpid —
 TAG_ADOPT = "adopt"             # direct HNP → adopter: [(vpid, uri), ...]
 #                                 orphans to dial as tree children
 TAG_REPARENT_ACK = "reparent_ack"  # up: (vpid, new_parent) — re-wired
-TAG_KILL_RANK = "kill_rank"     # xcast: rank — the owning daemon SIGKILLs
+TAG_KILL_RANK = "kill_rank"     # xcast: (jobid, rank) — the owning
+#                                 daemon SIGKILLs
 #                                 exactly that rank (reaping a hung pid
 #                                 the gossip detector reported)
+TAG_SIGNAL_RANK = "signal_rank"  # xcast: (jobid, rank, signum) — the
+#                                 owning daemon signals the rank's
+#                                 process group (the DVM remediation
+#                                 actor's SIGCONT probe: resume a
+#                                 SIGSTOP'd straggler before paying a
+#                                 reap-and-revive)
 TAG_DOCTOR = "doctor"           # xcast: epoch — every orted captures its
 #                                 local ranks' hang-doctor state (UDP
 #                                 query of each rank's responder; /proc

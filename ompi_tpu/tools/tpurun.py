@@ -58,8 +58,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="run the command on a standing DVM (fast: skips "
                         "VM bring-up; ≈ orte-submit)")
     p.add_argument("--dvm-ps", action="store_true",
-                   help="print a standing DVM's daemon/job/proc table "
-                        "(≈ orte-ps)")
+                   help="print a standing DVM's daemon/queue/job/proc "
+                        "table (≈ orte-ps)")
+    p.add_argument("--dvm-shrink", default=None, metavar="JOBID:RANK",
+                   help="planned elastic shrink: retire one rank of a "
+                        "running DVM job (no revive; the survivors "
+                        "continue smaller per the ULFM recipe)")
     p.add_argument("--dvm-stop", action="store_true",
                    help="shut a standing DVM down")
     p.add_argument("--dvm-uri", default=None, metavar="FILE|HOST:PORT",
@@ -121,6 +125,23 @@ def main(argv: list[str] | None = None) -> int:
         except RuntimeError as e:
             print(f"tpurun: {e}", file=sys.stderr)
             return 1
+        return 0
+    if args.dvm_shrink:
+        import json as _json
+
+        from ompi_tpu.runtime import dvm
+
+        try:
+            jobid, _, rank = args.dvm_shrink.partition(":")
+            reply = dvm.shrink(int(jobid), int(rank), uri=args.dvm_uri)
+        except ValueError:
+            print(f"tpurun: --dvm-shrink wants JOBID:RANK "
+                  f"(got {args.dvm_shrink!r})", file=sys.stderr)
+            return 2
+        except RuntimeError as e:
+            print(f"tpurun: {e}", file=sys.stderr)
+            return 1
+        print(_json.dumps(reply))
         return 0
     if args.dvm_stop:
         from ompi_tpu.runtime import dvm
@@ -249,6 +270,15 @@ def main(argv: list[str] | None = None) -> int:
         try:
             return dvm.submit(cmd, np_=args.np, uri=args.dvm_uri,
                               env=job_env)
+        except dvm.DvmRejected as e:
+            # machine-readable admission verdict on stdout + EX_TEMPFAIL
+            # (75): schedulers and scripts can parse-and-retry instead of
+            # hanging against a full pool
+            import json as _json
+
+            print(_json.dumps(e.verdict))
+            print(f"tpurun: dvm rejected the job: {e}", file=sys.stderr)
+            return 75
         except RuntimeError as e:
             print(f"tpurun: {e}", file=sys.stderr)
             return 1

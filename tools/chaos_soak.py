@@ -61,6 +61,21 @@ No run may hang (every subprocess has a hard timeout — a timeout is a
 soak failure), and no run may print a wrong answer (expected values are
 recomputed by this driver from the plan, never trusted from the app).
 
+``--canary`` flips the harness from single-shot launches to a standing
+multi-tenant pool: one DVM (``--mca errmgr selfheal``) serves every
+cycle, and each cycle submits TWO concurrent tenants — a chaos victim
+running a seeded selfheal-class fault (kill@step / hang@step /
+kill@coll, rotating) and a fault-free canary ring.  Both must exit 0
+with their exact recomputed accs: the victim proves in-place recovery
+works through the shared daemon tree, the canary proves ZERO
+interference (its answers never wobble while its co-tenant is being
+healed next door).  errmgr is a VM-level selection on a standing DVM,
+so only selfheal-compatible classes rotate here; the doctor-driven
+remediation ladder (SIGCONT probe / requeue / reject) is exercised by
+the pool-smoke CI job and tests/runtime/test_dvm_sched.py, not this
+mode — the canary pins ``dvm_remediate 0`` so the two recovery layers
+are proven separately, not racing each other.
+
 Replay determinism: each plan's first run is replayed with the same seed
 and the fault logs are compared — injected kills must reproduce exactly
 (same rank, same trigger step), and every frame verdict in both logs
@@ -81,6 +96,8 @@ import re
 import subprocess
 import sys
 import tempfile
+import threading
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -706,6 +723,194 @@ def check_replay(plan: dict, first: dict[int, dict],
                      f"(u={u:.3f} >= p={p})")
 
 
+# ---------------------------------------------------------------------------
+# --canary: chaos tenants vs. a fault-free co-tenant on ONE standing pool
+# ---------------------------------------------------------------------------
+
+# the selfheal-compatible rotation: every class here heals IN PLACE
+# under --mca errmgr selfheal, so the job still exits 0 and the pool
+# keeps serving — exactly the faults a standing multi-tenant VM must
+# absorb without its other tenants noticing
+CANARY_CLASSES = ("kill", "hang", "coll")
+
+
+def _canary_plan(seed: int, cycle: int, np_: int, steps: int) -> dict:
+    cls = CANARY_CLASSES[cycle % len(CANARY_CLASSES)]
+    rng = random.Random(f"canary:{seed}:{cycle}")
+    victim = rng.randrange(0, np_)
+    if cls == "coll":
+        # victim's dispatch ordinals: init barrier = 0, step s = s + 1;
+        # N in [2, steps-1] leaves a snapshot behind and a full-world
+        # step after the rejoin (same window the selfheal-coll soak uses)
+        coll_n = rng.randrange(2, steps)
+        plan = f"rank={victim}:kill@coll={coll_n}"
+    else:
+        step = rng.randrange(1, steps - 1)
+        plan = f"rank={victim}:{cls}@step={step}"
+    return {"cycle": cycle, "cls": cls, "victim": victim, "plan": plan}
+
+
+def _dvm_submit(uri: str, np_: int, mca: list, app: str,
+                env: dict, timeout: int = 240):
+    return tpurun(["--dvm-submit", "--dvm-uri", uri, "-np", str(np_),
+                   *mca, "--", sys.executable, "-c", app],
+                  env, timeout=timeout)
+
+
+def _check_canary_chaos(plan: dict, r, np_: int, steps: int) -> None:
+    out = r.stdout + r.stderr
+    assert r.returncode == 0, \
+        (f"canary chaos [{plan['cls']}] rc={r.returncode}: {out[-3000:]}")
+    v = plan["victim"]
+    # the errmgr's "selfheal revive" log line lands in the DVM SERVER
+    # process, not this client's IOF — the revive is asserted instead
+    # on the pool's /status FT timeline after the cycles (run_canary)
+    assert f"rank {v} resumed at step" in out, out[-3000:]
+    if plan["cls"] == "coll":
+        total = sum(range(np_)) * 100
+        acc = sum(total + np_ * s for s in range(steps))
+        for rank in range(np_):
+            want = (f"rank {rank} collrejoin done acc={acc:.0f} "
+                    f"mode=arena fallback=0 "
+                    f"rejoins={0 if rank == v else 1}")
+            assert want in out, (want, out[-3000:])
+    else:
+        for rank in range(np_):
+            acc = sum(((rank - 1) % np_) * 100 + s for s in range(steps))
+            assert f"rank {rank} selfheal done acc={acc:.0f}" in out, \
+                (rank, acc, out[-3000:])
+
+
+def _check_canary_ring(r, np_: int, steps: int) -> None:
+    """The zero-interference contract: the fault-free co-tenant's accs
+    are recomputed here and must match EXACTLY — a chaos tenant being
+    healed on the same daemons must not perturb a single message."""
+    out = r.stdout + r.stderr
+    assert r.returncode == 0, \
+        f"canary co-tenant rc={r.returncode}: {out[-3000:]}"
+    for rank in range(np_):
+        acc = sum(((rank - 1) % np_) * 100 + s for s in range(steps))
+        assert f"rank {rank} ring done acc={acc:.0f}" in out, \
+            (rank, acc, out[-3000:])
+
+
+def run_canary(args) -> int:
+    np_, steps = args.np_, args.steps
+    pool_dir = tempfile.mkdtemp(prefix="chaos_canary_")
+    uri = os.path.join(pool_dir, "dvm.uri")
+    env = dict(os.environ)
+    env.pop("OMPI_TPU_RANK", None)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    # --slots is the TOTAL pool: 2*np over 2 hosts lets the chaos
+    # tenant and the canary ring gang-place side by side
+    server = subprocess.Popen(
+        [sys.executable, "-m", "ompi_tpu.tools.tpurun", "--dvm-start",
+         "--hosts", "2", "--slots", str(2 * np_),
+         "--metrics-port", "0",
+         "--mca", "errmgr", "selfheal",
+         "--mca", "dvm_remediate", "0",
+         "--dvm-uri", uri],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, cwd=REPO)
+    deadline = time.monotonic() + 60
+    while not os.path.exists(uri):
+        if server.poll() is not None:
+            print(f"canary DVM died: {server.stderr.read()[-2000:]}",
+                  file=sys.stderr)
+            return 1
+        if time.monotonic() > deadline:
+            server.kill()
+            print("canary DVM uri never appeared", file=sys.stderr)
+            return 1
+        time.sleep(0.1)
+
+    failures = []
+    try:
+        for cycle in range(args.plans):
+            plan = _canary_plan(args.seed, cycle, np_, steps)
+            ck_chaos = tempfile.mkdtemp(prefix=f"canary_ck_{cycle}c_")
+            ck_ring = tempfile.mkdtemp(prefix=f"canary_ck_{cycle}r_")
+            mca = ["--mca", "faultinject_plan", plan["plan"],
+                   "--mca", "faultinject_seed", str(args.seed)]
+            if plan["cls"] == "hang":
+                # same gossip window the selfheal-hang soak class uses
+                mca += ["--mca", "ft_gossip_period", "0.5",
+                        "--mca", "ft_gossip_timeout", "4.0"]
+            app = SELFHEAL_COLL_APP if plan["cls"] == "coll" \
+                else SELFHEAL_APP
+            res = {}
+
+            def co_tenant():
+                res["ring"] = _dvm_submit(
+                    uri, np_, [], RING_APP,
+                    {"CKPT_DIR": ck_ring, "SOAK_STEPS": str(steps)})
+
+            t = threading.Thread(target=co_tenant, daemon=True)
+            t.start()
+            try:
+                chaos = _dvm_submit(
+                    uri, np_, mca, app,
+                    {"CKPT_DIR": ck_chaos, "SOAK_STEPS": str(steps)})
+                t.join(timeout=260)
+                assert not t.is_alive(), "co-tenant submission hung"
+                _check_canary_chaos(plan, chaos, np_, steps)
+                _check_canary_ring(res["ring"], np_, steps)
+                if args.verbose:
+                    print(f"  canary cycle {cycle} [{plan['cls']}] "
+                          f"{plan['plan']!r}: chaos healed, "
+                          f"co-tenant clean")
+            except (AssertionError, subprocess.TimeoutExpired) as e:
+                failures.append((plan, e))
+                print(f"FAIL canary cycle {cycle} [{plan['cls']}] "
+                      f"{plan['plan']!r}: {type(e).__name__}: {e}",
+                      file=sys.stderr)
+        # the pool itself must have survived every cycle: all 2*N
+        # tenants in history, every one rc 0, nothing stuck in queue —
+        # and the /status FT timeline must carry one revive per cycle
+        # (the errmgr healed IN the server; client IOF never sees it)
+        ps = tpurun(["--dvm-ps", "--dvm-uri", uri], timeout=60)
+        try:
+            table = json.loads(ps.stdout)
+            done = [h for h in table.get("history", [])
+                    if h.get("rc") == 0]
+            expect = 2 * args.plans
+            assert len(done) >= min(expect, 20), \
+                (f"pool history shows {len(done)} clean jobs, "
+                 f"expected {expect}: {ps.stdout[-2000:]}")
+            assert table.get("queue_depth", 0) == 0, ps.stdout[-2000:]
+            import urllib.request
+            with open(uri + ".metrics") as f:
+                http = f.read().strip()
+            with urllib.request.urlopen(http + "/status",
+                                        timeout=10) as resp:
+                status = json.loads(resp.read().decode())
+            revives = {(e["jobid"], e["rank"])
+                       for j in status.get("jobs", [])
+                       for e in j.get("ft_events", [])
+                       if e["kind"] == "revive"}
+            assert len(revives) >= args.plans, \
+                (f"{len(revives)} revive events on the FT timeline for "
+                 f"{args.plans} chaos cycles: {sorted(revives)}")
+        except (ValueError, AssertionError, OSError) as e:
+            failures.append(({"cls": "pool-state"}, e))
+            print(f"FAIL canary pool-state: {e}", file=sys.stderr)
+    finally:
+        tpurun(["--dvm-stop", "--dvm-uri", uri], timeout=30)
+        try:
+            server.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            server.kill()
+
+    if failures:
+        print(f"chaos_soak --canary: {len(failures)}/{args.plans} "
+              f"cycles FAILED", file=sys.stderr)
+        return 1
+    print(f"chaos_soak --canary: {args.plans}/{args.plans} cycles ok "
+          f"(seed {args.seed}, np {np_}, {steps} steps, "
+          f"one standing pool)")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--plans", type=int, default=20)
@@ -721,6 +926,13 @@ def main(argv=None) -> int:
     ap.add_argument("--only", default=None, choices=POLICIES,
                     help="run only plans of one class (the CI smoke "
                          "jobs pick single scenarios this way)")
+    ap.add_argument("--canary", action="store_true",
+                    help="multi-tenant pool mode: one standing selfheal "
+                         "DVM serves every cycle; each cycle runs a "
+                         "seeded chaos tenant (kill/hang/kill@coll "
+                         "rotation) NEXT TO a fault-free canary ring — "
+                         "both must exit 0 with exact recomputed accs "
+                         "(--plans = cycles)")
     ap.add_argument("-v", "--verbose", action="store_true")
     ap.add_argument("--guard", action="store_true",
                     help="preflight: refuse to soak when hours-old "
@@ -738,6 +950,9 @@ def main(argv=None) -> int:
         if not killorphans.preflight("chaos_soak",
                                      kill=args.guard_kill):
             return 2
+
+    if args.canary:
+        return run_canary(args)
 
     failures = []
     plans, i = [], 0
