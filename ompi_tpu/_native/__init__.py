@@ -24,7 +24,7 @@ from typing import Optional
 ENV_NO_NATIVE = "OMPI_TPU_NO_NATIVE"
 
 _ABI = 2
-_ARENA_ABI = 2
+_ARENA_ABI = 3
 _NET_ABI = 3
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "convertor.cpp")
@@ -206,6 +206,9 @@ def arena() -> Optional[ctypes.CDLL]:
         cdll.ompi_tpu_arena_publish_strided.argtypes = [vp, vp, i64, i64,
                                                         i64, vp, i64, u64]
         cdll.ompi_tpu_arena_publish_strided.restype = None
+        cdll.ompi_tpu_arena_copy_blocks.argtypes = [vp, vp, vp, i64, vp,
+                                                    i64, u64]
+        cdll.ompi_tpu_arena_copy_blocks.restype = None
         cdll.ompi_tpu_arena_fold.argtypes = [vp, vp, i64, i64, i64, i64]
         cdll.ompi_tpu_arena_fold.restype = i64
         cdll.ompi_tpu_arena_spans_enable.argtypes = [i64]

@@ -413,6 +413,28 @@ void ompi_tpu_arena_publish_strided(uint8_t *dst, const uint8_t *src,
     }
 }
 
+/* Scattered-block copy plan + the same optional fused release store:
+ * nblocks independent (dst, src, len) copies, then one flag publish.
+ * This is the dense-exchange workhorse — the alltoall gather side reads
+ * its column out of every peer slot (p copies), and the alltoallv
+ * scatter side lays a length header plus variable blocks into its own
+ * slot (p+1 copies) — as ONE GIL-released call instead of p ctypes
+ * crossings.  NULL flags ⇒ pure copy plan (the gather side, which
+ * signs completion through depart flags separately). */
+void ompi_tpu_arena_copy_blocks(uint8_t **dsts, uint8_t **srcs,
+                                const int64_t *lens, int64_t nblocks,
+                                uint64_t *flags, int64_t fidx,
+                                uint64_t fval) {
+    int64_t i;
+    for (i = 0; i < nblocks; ++i)
+        if (lens[i] > 0)
+            memcpy(dsts[i], srcs[i], (size_t)lens[i]);
+    if (flags) {
+        __atomic_store_n(flags + fidx, fval, __ATOMIC_RELEASE);
+        ompi_tpu_arena_wake(flags, fidx);
+    }
+}
+
 /* -- width-specialized segment folds -------------------------------------- */
 
 /* dtype codes (numpy native-endian fixed widths):
@@ -495,7 +517,7 @@ int64_t ompi_tpu_arena_fold(uint8_t *dst, uint8_t **srcs, int64_t nsrc,
 }
 
 /* version tag so the loader can detect stale cached builds */
-int64_t ompi_tpu_arena_abi(void) { return 2; }
+int64_t ompi_tpu_arena_abi(void) { return 3; }
 
 #ifdef __cplusplus
 }  /* extern "C" */

@@ -504,19 +504,27 @@ def allgatherv_ring(comm, sendbuf) -> list:
 
 
 def alltoallv_pairwise(comm, sendparts) -> list:
-    """sendparts[i] goes to rank i; returns out[i] = block from rank i."""
+    """sendparts[i] goes to rank i (None ⇒ an empty block — MPI's
+    zero-count entry); returns out[i] = block from rank i."""
     size, rank = comm.size, comm.rank
     if len(sendparts) != size:
         from ompi_tpu.mpi.constants import MPIException
 
         raise MPIException(
             f"alltoallv: {len(sendparts)} blocks for {size} ranks")
+    # normalize up front (a None part used to reach np.asarray and ship
+    # an object scalar): every peer still pairs its send/recv, a
+    # zero-count block just travels as an empty frame
+    norm = [np.empty(0, np.uint8) if p is None else np.asarray(p)
+            for p in sendparts]
     out: list[Optional[np.ndarray]] = [None] * size
-    out[rank] = np.asarray(sendparts[rank])
+    out[rank] = norm[rank]
+    if size == 1:
+        return out  # type: ignore[return-value]
     for step in range(1, size):
         to = (rank + step) % size
         frm = (rank - step) % size
-        sreq = comm._coll_isend(np.asarray(sendparts[to]), to, TAG_ALLTOALLV)
+        sreq = comm._coll_isend(norm[to], to, TAG_ALLTOALLV)
         out[frm] = comm._coll_irecv(None, frm, TAG_ALLTOALLV).wait()
         sreq.wait()
     return out  # type: ignore[return-value]
@@ -554,6 +562,8 @@ def alltoallw_pairwise(comm, sendspecs, recvspecs) -> None:
             f"alltoallw: {len(sendspecs)}/{len(recvspecs)} specs for "
             f"{size} ranks")
     unpack_spec(recvspecs[rank], pack_spec(sendspecs[rank]))
+    if size == 1:
+        return
     for step in range(1, size):
         to = (rank + step) % size
         frm = (rank - step) % size

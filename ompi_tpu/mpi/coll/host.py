@@ -130,6 +130,10 @@ class HostColl(HostCollBase):
         register_var("coll", "host_alltoall_small", VarType.SIZE, 4 * 1024,
                      "alltoall: below this use bruck (lg p rounds), "
                      "above pairwise")
+        register_var("coll", "host_alltoall_bruck_ranks", VarType.SIZE, 8,
+                     "alltoall: bruck also needs at least this many "
+                     "ranks (its lg p round count only beats pairwise's "
+                     "p-1 when p is large; tuned's comm-size gate)")
         register_var("coll", "host_dynamic_rules", VarType.STRING, "",
                      "path to a dynamic collective-selection rules file "
                      "(see ompi_tpu.mpi.coll.rules)")
@@ -213,12 +217,22 @@ class HostColl(HostCollBase):
     def coll_scatter(self, comm, sendbuf, root: int):
         return base.scatter_linear(comm, sendbuf, root)
 
+    @staticmethod
+    def _alltoall_fixed(comm, nbytes: int) -> str:
+        """The fixed rung: bruck is the small-message AND
+        high-rank-count pick — lg p rounds moving p/2 blocks each only
+        beat pairwise's p-1 single-block rounds when latency dominates
+        (small payloads) and p is large enough for lg p << p."""
+        return ("bruck"
+                if (nbytes < var_registry.get("coll_host_alltoall_small")
+                    and comm.size
+                    >= var_registry.get("coll_host_alltoall_bruck_ranks"))
+                else "pairwise")
+
     def coll_alltoall(self, comm, sendbuf):
         alg = self._decide("alltoall", comm, _nbytes(sendbuf))
         if not alg:
-            alg = ("bruck" if _nbytes(sendbuf)
-                   < var_registry.get("coll_host_alltoall_small")
-                   else "pairwise")
+            alg = self._alltoall_fixed(comm, _nbytes(sendbuf))
         return _timed("alltoall", alg,
                       {"pairwise": base.alltoall_pairwise,
                        "bruck": base.alltoall_bruck}[alg], comm, sendbuf)
@@ -317,6 +331,23 @@ class HostColl(HostCollBase):
                        else "ring")
             return {"bruck": base.allgather_bruck,
                     "ring": base.allgather_ring}[alg], alg
+        if coll == "alltoall":
+            alg = self._decide("alltoall", comm, nbytes)
+            if not alg:
+                alg = self._alltoall_fixed(comm, nbytes)
+            return {"pairwise": base.alltoall_pairwise,
+                    "bruck": base.alltoall_bruck}[alg], alg
+        if coll == "reduce_scatter":
+            alg = self._decide("reduce_scatter", comm, nbytes)
+            if alg == "basic" or (op is not None and not op.commutative):
+                return base.reduce_scatter_basic, "basic"
+            return base.reduce_scatter_ring, "ring"
+        if coll == "alltoallv":
+            return base.alltoallv_pairwise, "pairwise"
+        if coll == "scan":
+            return base.scan_linear, "linear"
+        if coll == "exscan":
+            return base.exscan_linear, "linear"
         from ompi_tpu.mpi.constants import MPIException
 
         raise MPIException(f"freeze_decision: no persistent plan for "

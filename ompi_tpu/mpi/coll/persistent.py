@@ -74,7 +74,9 @@ from ompi_tpu.mpi.request import (
 _log = output.get_stream("coll")
 
 __all__ = ["PersistentCollRequest", "barrier_init", "bcast_init",
-           "reduce_init", "allreduce_init", "allgather_init"]
+           "reduce_init", "allreduce_init", "allgather_init",
+           "alltoall_init", "alltoallv_init", "reduce_scatter_init",
+           "neighbor_alltoall_init", "neighbor_alltoallv_init"]
 
 # persistent plans draw tags from their own reserved window starting at
 # 10000 — far above the blocking-collective tags (1-16), the nbc
@@ -693,7 +695,8 @@ def _bcast_meta(comm, buf, root: int):
 def _freeze_directive(host, kind: str, comm, nbytes: int) -> Optional[str]:
     """A forced ``coll_host_*_algorithm`` var or rules-file hit — user
     tuning the persistent shortcut must honor, resolved once."""
-    if kind not in ("bcast", "allreduce", "allgather"):
+    if kind not in ("bcast", "allreduce", "allgather",
+                    "alltoall", "reduce_scatter"):
         return None
     return host._decide(kind, comm, 0 if kind == "bcast" else nbytes)
 
@@ -952,6 +955,104 @@ def _bind_hier(comp, st, host, comm, kind, buf, op, root, nbytes,
     return _DrainPlan("hier", run, kind)
 
 
+def _bind_dense(comm, kind: str, buf=None, op=None):
+    """Compile a dense-exchange plan (alltoall / alltoallv /
+    reduce_scatter) — collective over ``comm``.
+
+    Dense kinds carry p× the payload of a fan-in collective, so they
+    never pin private slots: the shm component's cached ``_state``
+    (node/leader splits, arena mapping, reorder tables) IS the
+    precompiled schedule, and it is already epoch-fenced.  The bind
+    therefore freezes the ROUTE (arena vs hier vs host) plus the
+    host-side algorithm pick, and Start is one dispatch against the
+    frozen provider.  A revived member invalidates the agreed
+    incarnation snapshot exactly like the slot-backed kinds
+    (``_incs_stale`` in ``_launch`` → auto-rebind)."""
+    from ompi_tpu.mpi.coll import coll_framework
+
+    if comm.is_revoked():
+        raise MPIException(
+            f"{kind}_init on revoked communicator {comm.name}",
+            error_class=ERR_REVOKED)
+
+    # size-1: ≈ coll/self's dense contracts
+    if comm.size == 1:
+        results = {
+            "alltoall": lambda: np.asarray(buf),
+            "alltoallv": lambda: [np.empty(0, np.uint8)
+                                  if buf[0] is None
+                                  else np.asarray(buf[0])],
+            "reduce_scatter": lambda: np.asarray(buf).reshape(-1),
+        }
+        return _SelfPlan(results[kind])
+
+    if kind == "alltoallv":
+        if len(buf) != comm.size:
+            raise MPIException(
+                f"alltoallv_init: need {comm.size} send parts, got "
+                f"{len(buf)}", error_class=2)
+        nbytes = sum(int(np.asarray(p).nbytes)
+                     for p in buf if p is not None)
+    else:
+        nbytes = int(np.asarray(buf).nbytes)
+
+    host = coll_framework.lookup("host")
+    directive = _freeze_directive(host, kind, comm, nbytes)
+    st, comp = _shm_state(comm)
+
+    if st is not None and directive is None:
+        runs = {
+            "alltoall": lambda: comp.coll_alltoall(
+                comm, np.asarray(buf)),
+            "alltoallv": lambda: comp.coll_alltoallv(comm, list(buf)),
+            "reduce_scatter": lambda: comp.coll_reduce_scatter(
+                comm, np.asarray(buf), op),
+        }
+        return _DrainPlan("shm" if st.mode == "arena" else "hier",
+                          runs[kind], kind)
+
+    fn, _label = host.freeze_decision(kind, comm, nbytes, op)
+    runs = {
+        "alltoall": lambda: fn(comm, np.asarray(buf)),
+        "alltoallv": lambda: fn(comm, list(buf)),
+        "reduce_scatter": lambda: fn(comm, np.asarray(buf), op),
+    }
+    return _DrainPlan("host", runs[kind], kind)
+
+
+def _bind_neighbor(comm, kind: str, parts):
+    """Compile a persistent neighborhood exchange over the comm's
+    attached topology (cart / graph / dist_graph).
+
+    The wire plan — per-edge slot indices and tags, the subtle part of
+    the neighbor discipline (parallel-edge pairing on 2-cycle tori) —
+    is frozen once from ``topo._edge_meta``; only the bound send parts
+    are re-read at each Start.  Topology is immutable state on the
+    communicator, so a revive-triggered rebind reproduces the same
+    plan under a fresh tag window."""
+    from ompi_tpu.mpi import topo as topo_mod
+
+    if comm.is_revoked():
+        raise MPIException(
+            f"{kind}_init on revoked communicator {comm.name}",
+            error_class=ERR_REVOKED)
+    tag = _next_ptag(comm)
+    srcs, send_meta, recvs = topo_mod._edge_meta(comm, len(parts), tag)
+
+    def run():
+        rreq_by_i = {i: comm._coll_irecv(None, s, t)
+                     for i, s, t in recvs}
+        sreqs = [comm._coll_isend(np.asarray(parts[j]), d, t)
+                 for j, d, t in send_meta]
+        out = [rreq_by_i[i].wait() if i in rreq_by_i else None
+               for i in range(len(srcs))]
+        for s in sreqs:
+            s.wait()
+        return out
+
+    return _DrainPlan("topo", run, kind)
+
+
 # ---------------------------------------------------------------------------
 # the public request
 # ---------------------------------------------------------------------------
@@ -1004,8 +1105,8 @@ class PersistentCollRequest(PersistentRequest):
 
     @property
     def provider(self) -> Optional[str]:
-        """Which layer the plan bound to: shm | hier | host | nbc | self
-        (None once freed)."""
+        """Which layer the plan bound to: shm | hier | host | nbc |
+        topo | self (None once freed)."""
         return self._plan.provider if self._plan is not None else None
 
     @property
@@ -1157,3 +1258,47 @@ def allgather_init(comm, sendbuf) -> PersistentCollRequest:
     return PersistentCollRequest(
         comm, "allgather",
         lambda: _bind(comm, "allgather", buf=sendbuf))
+
+
+def alltoall_init(comm, sendbuf) -> PersistentCollRequest:
+    """≈ MPI_Alltoall_init: ``sendbuf`` (re-read at each Start) is the
+    row-per-destination dense block, as in the blocking form."""
+    return PersistentCollRequest(
+        comm, "alltoall",
+        lambda: _bind_dense(comm, "alltoall", buf=sendbuf))
+
+
+def alltoallv_init(comm, sendparts) -> PersistentCollRequest:
+    """≈ MPI_Alltoallv_init: one (possibly None) part per destination;
+    the bound list is re-indexed at each Start."""
+    parts = list(sendparts)
+    return PersistentCollRequest(
+        comm, "alltoallv",
+        lambda: _bind_dense(comm, "alltoallv", buf=parts))
+
+
+def reduce_scatter_init(comm, sendbuf, op) -> PersistentCollRequest:
+    """≈ MPI_Reduce_scatter_init (block-free contiguous split, like the
+    one-shot form: rank r lands ``np.array_split`` chunk r)."""
+    return PersistentCollRequest(
+        comm, "reduce_scatter",
+        lambda: _bind_dense(comm, "reduce_scatter", buf=sendbuf, op=op))
+
+
+def neighbor_alltoall_init(comm, sendparts) -> PersistentCollRequest:
+    """≈ MPI_Neighbor_alltoall_init: one block per out-neighbor over
+    the comm's cart/graph/dist-graph topology; each wait yields one
+    entry per in-neighbor (None on PROC_NULL edges)."""
+    parts = list(sendparts)
+    return PersistentCollRequest(
+        comm, "neighbor_alltoall",
+        lambda: _bind_neighbor(comm, "neighbor_alltoall", parts))
+
+
+def neighbor_alltoallv_init(comm, sendparts) -> PersistentCollRequest:
+    """≈ MPI_Neighbor_alltoallv_init (the exchange is already
+    shape-polymorphic per edge, as in the blocking v-form)."""
+    parts = list(sendparts)
+    return PersistentCollRequest(
+        comm, "neighbor_alltoallv",
+        lambda: _bind_neighbor(comm, "neighbor_alltoallv", parts))

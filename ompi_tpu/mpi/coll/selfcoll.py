@@ -71,6 +71,9 @@ class SelfColl(Component):
         return [np.asarray(sendbuf)]
 
     def coll_alltoallv(self, comm, sendparts):
+        # None is MPI's zero-count entry, here as everywhere else
+        if sendparts[0] is None:
+            return [np.empty(0, np.uint8)]
         return [np.asarray(sendparts[0])]
 
     def coll_alltoallw(self, comm, sendspecs, recvspecs):

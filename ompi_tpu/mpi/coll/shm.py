@@ -1039,6 +1039,240 @@ class Arena:
         self._wait_all_depart(s0d + 1, comm)
         return out
 
+    # -- dense exchange ------------------------------------------------------
+    #
+    # alltoall/v, reduce_scatter and scan/exscan share ONE protocol
+    # round: every rank publishes its whole payload into its own slot
+    # (one copy), waits for all arrivals, then reads/folds exactly the
+    # bytes addressed to it straight out of the mapped peer slots — the
+    # p² small PML frames of the pairwise loops collapse into p slot
+    # publishes plus per-rank strided reads, all through the same
+    # arrive/depart counters (and the same FT fail-fast waits) the
+    # fan-out collectives ride.
+
+    def _publish_slot(self, comm, arr: np.ndarray, v: int) -> None:
+        """Whole-payload publish into MY slot stamped arrive=v — the
+        fused native publish when eligible, numpy copy + python flag
+        store otherwise (the allgather discipline, factored out for the
+        dense family)."""
+        if not self._publish_arrive(self._slot_off(self.rank), arr, v):
+            self._copy_in(self._slot(self.rank), arr)
+            self._set_arrive(v)
+
+    def _copy_blocks_native(self, dsts: list, srcs: list, lens: list,
+                            fidx: Optional[int] = None,
+                            fval: int = 0) -> bool:
+        """N scattered (dst, src, len) copies as ONE GIL-released call,
+        optionally fused with a release arrive store + wake.  False ⇒
+        the caller runs the per-block numpy path (executor off, or a
+        total payload the ctypes crossing would not amortize).  Callers
+        pass absolute addresses (``_base_addr`` pre-checked)."""
+        ex = _exec()
+        if ex is None or sum(lens) < _NATIVE_PUBLISH_MIN:
+            return False
+        n = len(dsts)
+        da = (ctypes.c_void_p * n)(*dsts)
+        sa = (ctypes.c_void_p * n)(*srcs)
+        ln = (ctypes.c_int64 * n)(*lens)
+        ex.ompi_tpu_arena_copy_blocks(
+            ctypes.addressof(da), ctypes.addressof(sa),
+            ctypes.addressof(ln), n,
+            self._base_addr if fidx is not None else None,
+            fidx if fidx is not None else 0, fval)
+        trace_mod.count("coll_shm_native_publishes_total")
+        return True
+
+    def _fold_slots(self, dtype: np.dtype, op: Op, lo: int, hi: int,
+                    order: list) -> np.ndarray:
+        """Chain-fold elements [lo, hi) of the listed slots, in list
+        order — native when eligible (bit-identical chain, GIL
+        released), the rank-ordered numpy chain otherwise.  The order
+        list is the CALLER's (comm-rank chain for reduce_scatter, the
+        0..r prefix for scan), so non-commutative prefix folds stay
+        order-correct."""
+        count = hi - lo
+        if count <= 0:
+            return np.empty(0, dtype)
+        boff = lo * dtype.itemsize
+        ex = _exec() if self._base_addr is not None else None
+        dc = _fold_code(dtype) if ex is not None else None
+        oc = _NATIVE_OP_CODES.get(op) if ex is not None else None
+        if (dc is not None and oc is not None
+                and count * dtype.itemsize >= _NATIVE_PUBLISH_MIN):
+            out = np.empty(count, dtype)
+            _native_fold(ex, out.ctypes.data,
+                         [self._base_addr + self._slot_off(j) + boff
+                          for j in order], count, dc, oc)
+            return out
+        acc = np.frombuffer(self._slot(order[0])[boff:], dtype,
+                            count=count)
+        for j in order[1:]:
+            acc = np.asarray(op.host(acc, np.frombuffer(
+                self._slot(j)[boff:], dtype, count=count)))
+        # a single-source chain aliases the mapped slot — copy before
+        # the depart barrier releases it for reuse
+        return np.array(acc, copy=True).reshape(-1)
+
+    def alltoall(self, comm, arr: np.ndarray) -> np.ndarray:
+        """``arr`` = p equal blocks (C order) keyed by DEST arena rank;
+        returns ``(p, block)`` rows keyed by SRC arena rank.  One
+        publish per rank; the gather side reads its column out of every
+        peer slot as one native block plan.  Caller checked
+        divisibility, dtype and nbytes <= slot_bytes."""
+        arr = np.asarray(arr)
+        p = self.size
+        blk = arr.size // p
+        bb = blk * arr.dtype.itemsize
+        s0a, s0d = self._arr, self._dep
+        self._publish_slot(comm, arr, s0a + 1)
+        self._wait_all_arrive(s0a + 1, comm)
+        out = np.empty((p, blk), arr.dtype)
+        moff = self.rank * bb
+        rows = out.reshape(p, -1)
+        done = False
+        if self._base_addr is not None and bb:
+            done = self._copy_blocks_native(
+                [out.ctypes.data + i * bb for i in range(p)],
+                [self._base_addr + self._slot_off(i) + moff
+                 for i in range(p)], [bb] * p)
+        if not done:
+            for i in range(p):
+                rows[i] = np.frombuffer(self._slot(i)[moff:moff + bb],
+                                        arr.dtype, count=blk)
+        self._set_depart(s0d + 1)
+        self._wait_all_depart(s0d + 1, comm)
+        return out
+
+    def alltoallv(self, comm, parts: list) -> Optional[list]:
+        """``parts``: one array per DEST arena rank (None ⇒ empty).
+        Per-dest header entries (length, offset, shape, dtype) lead the
+        packed blocks in each slot, so readers address exactly their
+        block.  The fits/describable verdict travels in the descriptor
+        round — ANY host verdict makes every rank return None together
+        (the bcast communicated-verdict discipline, generalized to all
+        writers: v-counts are per-rank knowledge, so no local gate is
+        collectively safe).  Returns received arrays keyed by SRC arena
+        rank, dtype/shape preserved like the pairwise wire."""
+        p = self.size
+        parts = [np.empty(0, np.uint8) if a is None else np.asarray(a)
+                 for a in parts]
+        hdr = p * _VHDR
+        offs, off = [], hdr
+        for a in parts:
+            offs.append(off)
+            off += (a.nbytes + 7) & ~7
+        ok = (off <= self.slot_bytes
+              and all(_arena_dtype_ok(a.dtype) and _desc_dtype_ok(a.dtype)
+                      and a.ndim <= _MAX_DIMS for a in parts))
+        s0a, s0d = self._arr, self._dep
+        self._write_desc(_DESC_DATA if ok else _DESC_HOST, None, 0)
+        if not ok:
+            self._set_arrive(s0a + 1)
+        else:
+            head = np.zeros(hdr, np.uint8)
+            hu = head.view(np.uint64).reshape(p, _VHDR // 8)
+            for i, a in enumerate(parts):
+                hu[i, 0] = a.nbytes
+                hu[i, 1] = offs[i]
+                hu[i, 2] = a.ndim
+                if a.ndim:
+                    hu[i, 3:3 + a.ndim] = np.asarray(a.shape, np.uint64)
+                ds = a.dtype.str.encode()
+                head[i * _VHDR + 88:i * _VHDR + 88 + len(ds)] = \
+                    np.frombuffer(ds, np.uint8)
+            srcs = [head] + [np.ascontiguousarray(a) for a in parts]
+            done = False
+            if self._base_addr is not None:
+                dst0 = self._base_addr + self._slot_off(self.rank)
+                done = self._copy_blocks_native(
+                    [dst0] + [dst0 + o for o in offs],
+                    [a.ctypes.data for a in srcs],
+                    [a.nbytes for a in srcs],
+                    fidx=self.rank * 8, fval=s0a + 1)
+                if done:
+                    self._arr = s0a + 1
+            if not done:
+                slot = self._slot(self.rank)
+                self._copy_in(slot[:hdr], head)
+                for a, o in zip(parts, offs):
+                    if a.nbytes:
+                        self._copy_in(slot[o:o + a.nbytes], a)
+                self._set_arrive(s0a + 1)
+        self._wait_all_arrive(s0a + 1, comm)
+        verdict_host = any(self._read_desc(i)[0] == _DESC_HOST
+                           for i in range(p))
+        out: Optional[list] = None
+        if not verdict_host:
+            me = self.rank
+            out = []
+            natd, nats, natl = [], [], []
+            py = []   # (arr, abs slot offset, nbytes) for the numpy path
+            for i in range(p):
+                eoff = self._slot_off(i) + me * _VHDR
+                ent = np.frombuffer(self.seg.buf[eoff:eoff + 88],
+                                    np.uint64)
+                nb, boff, nd = int(ent[0]), int(ent[1]), int(ent[2])
+                shape = tuple(int(x) for x in ent[3:3 + nd])
+                raw = bytes(
+                    self.seg.buf[eoff + 88:eoff + 120]).rstrip(b"\0")
+                dt = np.dtype(raw.decode()) if raw else np.dtype(np.uint8)
+                a = np.empty(shape, dt)
+                out.append(a)
+                if nb:
+                    natd.append(a.ctypes.data)
+                    nats.append(self._base_addr + self._slot_off(i) + boff
+                                if self._base_addr is not None else 0)
+                    natl.append(nb)
+                    py.append((a, self._slot_off(i) + boff, nb))
+            if not (self._base_addr is not None and natl
+                    and self._copy_blocks_native(natd, nats, natl)):
+                for a, aoff, nb in py:
+                    a.reshape(-1)[...] = np.frombuffer(
+                        self.seg.buf[aoff:aoff + nb], a.dtype,
+                        count=a.size)
+        self._set_depart(s0d + 1)
+        self._wait_all_depart(s0d + 1, comm)
+        return out
+
+    def reduce_scatter(self, comm, arr: np.ndarray, op: Op, lo: int,
+                       hi: int, order: list) -> np.ndarray:
+        """Publish the whole payload, fold elements [lo, hi) of every
+        slot in the caller's slot order (its comm-rank chain — native
+        and numpy folds are bit-identical on it); returns the folded
+        1-D segment.  Caller checked dtype and nbytes <= slot_bytes."""
+        arr = np.asarray(arr)
+        s0a, s0d = self._arr, self._dep
+        self._publish_slot(comm, arr, s0a + 1)
+        self._wait_all_arrive(s0a + 1, comm)
+        out = self._fold_slots(arr.dtype, op, lo, hi, order)
+        self._set_depart(s0d + 1)
+        self._wait_all_depart(s0d + 1, comm)
+        return out
+
+    def scan(self, comm, arr: np.ndarray, op: Op,
+             order: list) -> Optional[np.ndarray]:
+        """Prefix fold: publish the whole payload, fold the listed
+        slots (the caller's 0..r comm-rank prefix, so non-commutative
+        ops stay order-correct) over the full element range.  An empty
+        order participates in the round and returns None (exscan rank
+        0's MPI-undefined result)."""
+        arr = np.asarray(arr)
+        s0a, s0d = self._arr, self._dep
+        self._publish_slot(comm, arr, s0a + 1)
+        self._wait_all_arrive(s0a + 1, comm)
+        out = None
+        if order:
+            out = self._fold_slots(arr.dtype, op, 0, arr.size, order)
+            out = out.reshape(arr.shape)
+        self._set_depart(s0d + 1)
+        self._wait_all_depart(s0d + 1, comm)
+        return out
+
+
+#: per-dest header entry bytes in an alltoallv slot: u64 nbytes, u64
+#: offset, u64 ndim, u64 shape[_MAX_DIMS], 32B dtype str, pad to 128
+_VHDR = 128
+
 
 class PersistentSlots(Arena):
     """Pinned, parity-double-buffered slots for ONE bound persistent
@@ -1502,7 +1736,8 @@ class ShmColl(Component):
     def _host_directive(self, coll: str, comm, nbytes: int) -> Optional[str]:
         """An explicit host-algorithm force or a rules-file hit is user
         tuning the on-node shortcut must not override."""
-        if coll in ("bcast", "allreduce", "allgather"):
+        if coll in ("bcast", "allreduce", "allgather", "alltoall",
+                    "reduce_scatter"):
             if var_registry.get(f"coll_host_{coll}_algorithm"):
                 return f"forced coll_host_{coll}_algorithm"
             path = var_registry.get("coll_host_dynamic_rules")
@@ -1736,3 +1971,328 @@ class ShmColl(Component):
         full = self._intra_bcast(st, full, 0)
         return np.asarray(full, arr.dtype).reshape(
             (comm.size,) + arr.shape)
+
+    # -- dense exchange slots ------------------------------------------------
+    #
+    # alltoall/v/w, reduce_scatter and scan/exscan — the last collective
+    # class still PML-bound.  Flat comms run the one-round arena
+    # protocols; hier comms run the MPI-Advance locality split (node
+    # leaders aggregate per-node blocks, exchange O(nodes) large frames
+    # over the btl rings, scatter intra-node over the arena) for the
+    # patterns whose counts every rank can derive (alltoall,
+    # reduce_scatter, contiguous-block scan).  v/w counts are rank-local
+    # knowledge, so multi-node v/w falls back to host rather than guess
+    # a split no rank can verify collectively.
+
+    @_epoch_retries
+    def coll_alltoall(self, comm, sendbuf):
+        arr = np.asarray(sendbuf)
+        st, host = self._route(comm, "alltoall", arr.nbytes)
+        if host is not None:
+            return host.coll_alltoall(comm, arr)
+        p = comm.size
+        if arr.ndim == 0 or arr.shape[0] % p:
+            return self._host().coll_alltoall(comm, arr)  # host's error
+        if st.mode == "arena":
+            if not (_arena_dtype_ok(arr.dtype)
+                    and arr.nbytes <= st.arena.slot_bytes
+                    and arr.nbytes <= self._cap()):
+                return self._fallback(
+                    comm, "alltoall", "payload above the slot/arena cap "
+                    "or unsupported dtype", arr.nbytes
+                ).coll_alltoall(comm, arr)
+            trace_mod.count("coll_shm_fanin_total")
+            trace_mod.count("coll_shm_fanout_total")
+            c2n = st.c2n
+            ident = bool(np.array_equal(c2n, np.arange(p)))
+            a = np.ascontiguousarray(arr)
+            if not ident:
+                inv = np.empty(p, np.int64)
+                inv[c2n] = np.arange(p)
+                a = np.ascontiguousarray(a.reshape(p, -1)[inv])
+            out = st.arena.alltoall(comm, a)
+            if not ident:
+                out = out[c2n]
+            return np.ascontiguousarray(out).reshape(arr.shape)
+        if arr.nbytes > self._cap():
+            return self._fallback(
+                comm, "alltoall", "payload above coll_shm_arena_size",
+                arr.nbytes).coll_alltoall(comm, arr)
+        # locality-aware aggregation: everyone shares its full sendbuf
+        # intra-node, leaders exchange ONE frame per peer node carrying
+        # every (src member, dst member) block for that node pair, then
+        # one intra bcast fans the reassembled table out — O(nodes)
+        # large btl frames instead of O(p²) small ones
+        node = st.node
+        bb = arr.size // p
+        a = np.ascontiguousarray(arr)
+        if node.size > 1:
+            trace_mod.count("coll_shm_fanin_total")
+            if (st.arena is not None and _arena_dtype_ok(a.dtype)
+                    and a.nbytes <= st.arena.slot_bytes):
+                gathered = st.arena.allgather(node, a)
+            else:
+                gathered = self._host().coll_allgather(node, a)
+        else:
+            gathered = a[None]
+        full = None
+        if st.leader is not None:
+            mat = np.ascontiguousarray(gathered).reshape(node.size, p, bb)
+            frames = [np.ascontiguousarray(
+                mat[:, np.asarray(blk)]).reshape(-1)
+                for blk in st.node_blocks]
+            got = self._host().coll_alltoallv(st.leader, frames)
+            full = np.empty((p, node.size, bb), arr.dtype)
+            for i, blk in enumerate(st.node_blocks):
+                full[np.asarray(blk)] = np.asarray(
+                    got[i], arr.dtype).reshape(len(blk), node.size, bb)
+        full = self._intra_bcast(st, full, 0)
+        mine = np.asarray(full, arr.dtype).reshape(
+            p, node.size, bb)[:, st.node.rank]
+        return np.ascontiguousarray(mine).reshape(arr.shape)
+
+    @_epoch_retries
+    def coll_alltoallv(self, comm, sendparts):
+        st, host = self._route(comm, "alltoallv")
+        if host is not None:
+            return host.coll_alltoallv(comm, sendparts)
+        if st.mode != "arena":
+            return self._fallback(
+                comm, "alltoallv", "multi-node: v-counts are rank-local "
+                "(no collectively-derivable aggregation split)"
+            ).coll_alltoallv(comm, sendparts)
+        p = comm.size
+        if len(sendparts) != p:
+            return self._host().coll_alltoallv(comm, sendparts)
+        c2n = st.c2n
+        ident = bool(np.array_equal(c2n, np.arange(p)))
+        send = list(sendparts)
+        if not ident:
+            inv = np.empty(p, np.int64)
+            inv[c2n] = np.arange(p)
+            send = [sendparts[int(inv[j])] for j in range(p)]
+        got = st.arena.alltoallv(comm, send)
+        if got is None:
+            return self._fallback(
+                comm, "alltoallv", "peer verdict: part above the slot "
+                "cap or undescribable dtype (descriptor round)"
+            ).coll_alltoallv(comm, sendparts)
+        trace_mod.count("coll_shm_fanin_total")
+        trace_mod.count("coll_shm_fanout_total")
+        return got if ident else [got[int(c2n[r])] for r in range(p)]
+
+    @_epoch_retries
+    def coll_alltoallw(self, comm, sendspecs, recvspecs):
+        st, host = self._route(comm, "alltoallw")
+        if host is not None:
+            return host.coll_alltoallw(comm, sendspecs, recvspecs)
+        if st.mode != "arena":
+            return self._fallback(
+                comm, "alltoallw", "multi-node: w-specs are rank-local "
+                "(no collectively-derivable aggregation split)"
+            ).coll_alltoallw(comm, sendspecs, recvspecs)
+        p = comm.size
+        if len(sendspecs) != p or len(recvspecs) != p:
+            return self._host().coll_alltoallw(comm, sendspecs, recvspecs)
+        # pack with the send datatypes, ride the byte alltoallv, unpack
+        # with the receive datatypes — the pairwise wire, minus the PML
+        packed = [base.pack_spec(s) for s in sendspecs]
+        c2n = st.c2n
+        ident = bool(np.array_equal(c2n, np.arange(p)))
+        send = packed
+        if not ident:
+            inv = np.empty(p, np.int64)
+            inv[c2n] = np.arange(p)
+            send = [packed[int(inv[j])] for j in range(p)]
+        got = st.arena.alltoallv(comm, send)
+        if got is None:
+            return self._fallback(
+                comm, "alltoallw", "peer verdict: packed part above the "
+                "slot cap (descriptor round)"
+            ).coll_alltoallw(comm, sendspecs, recvspecs)
+        trace_mod.count("coll_shm_fanin_total")
+        trace_mod.count("coll_shm_fanout_total")
+        for r in range(p):
+            base.unpack_spec(recvspecs[r],
+                             got[r] if ident else got[int(c2n[r])])
+        return None
+
+    @staticmethod
+    def _rs_bounds(n: int, p: int) -> list:
+        """np.array_split boundaries over a flat n-element payload —
+        the reduce_scatter chunk contract shared with coll/host."""
+        q, rmd = divmod(n, p)
+        return [r * q + min(r, rmd) for r in range(p + 1)]
+
+    @_epoch_retries
+    def coll_reduce_scatter(self, comm, sendbuf, op: Op):
+        arr = np.asarray(sendbuf)
+        st, host = self._route(comm, "reduce_scatter", arr.nbytes)
+        if host is not None:
+            return host.coll_reduce_scatter(comm, arr, op)
+        p = comm.size
+        if st.mode == "arena":
+            if not (_arena_dtype_ok(arr.dtype)
+                    and arr.nbytes <= st.arena.slot_bytes
+                    and arr.nbytes <= self._cap()):
+                return self._fallback(
+                    comm, "reduce_scatter", "payload above the slot/arena "
+                    "cap or unsupported dtype", arr.nbytes
+                ).coll_reduce_scatter(comm, arr, op)
+            trace_mod.count("coll_shm_fanin_total")
+            trace_mod.count("coll_shm_fanout_total")
+            # comm-rank fold order: canonical for non-commutative ops
+            # too, unlike the host ring
+            bnds = self._rs_bounds(arr.size, p)
+            order = [int(st.c2n[r]) for r in range(p)]
+            return st.arena.reduce_scatter(
+                comm, arr, op, bnds[comm.rank], bnds[comm.rank + 1], order)
+        if not op.commutative:
+            return self._fallback(
+                comm, "reduce_scatter", "non-commutative op (cross-node "
+                "folds reorder)", arr.nbytes
+            ).coll_reduce_scatter(comm, arr, op)
+        # locality split: fold intra-node first, then leaders exchange
+        # ONE frame per peer node (that node's members' chunks,
+        # concatenated), fold across nodes, and one intra bcast + local
+        # slice scatters the result
+        partial = self._intra_reduce(st, arr, op)
+        bnds = self._rs_bounds(arr.size, p)
+        stack = None
+        if st.leader is not None:
+            flatp = np.ascontiguousarray(partial).reshape(-1)
+            frames = [np.concatenate([flatp[bnds[r]:bnds[r + 1]]
+                                      for r in blk])
+                      for blk in st.node_blocks]
+            got = self._host().coll_alltoallv(st.leader, frames)
+            acc = np.asarray(got[0], arr.dtype)
+            for fr in got[1:]:
+                acc = np.asarray(op.host(
+                    acc, np.asarray(fr).astype(acc.dtype, copy=False)))
+            stack = acc
+        stack = self._intra_bcast(st, stack, 0)
+        blk = st.node_blocks[st.node_idx_of[comm.rank]]
+        off = sum(bnds[r + 1] - bnds[r] for r in blk[:st.node.rank])
+        ln = bnds[comm.rank + 1] - bnds[comm.rank]
+        out = np.asarray(stack, arr.dtype).reshape(-1)[off:off + ln]
+        return np.ascontiguousarray(out)
+
+    @_epoch_retries
+    def coll_reduce_scatter_block(self, comm, sendbuf, op: Op):
+        arr = np.asarray(sendbuf)
+        if arr.ndim == 0 or arr.shape[0] % comm.size:
+            return self._host().coll_reduce_scatter_block(comm, arr, op)
+        rows = arr.shape[0] // comm.size
+        out = self.coll_reduce_scatter(
+            comm, arr.reshape(arr.shape[0], -1), op)
+        return np.asarray(out).reshape((rows,) + arr.shape[1:])
+
+    @_epoch_retries
+    def coll_scan(self, comm, sendbuf, op: Op):
+        arr = np.asarray(sendbuf)
+        st, host = self._route(comm, "scan", arr.nbytes)
+        if host is not None:
+            return host.coll_scan(comm, arr, op)
+        if st.mode == "arena":
+            if not (_arena_dtype_ok(arr.dtype)
+                    and arr.nbytes <= st.arena.slot_bytes
+                    and arr.nbytes <= self._cap()):
+                return self._fallback(
+                    comm, "scan", "payload above the slot/arena cap or "
+                    "unsupported dtype", arr.nbytes
+                ).coll_scan(comm, arr, op)
+            trace_mod.count("coll_shm_fanin_total")
+            order = [int(st.c2n[r]) for r in range(comm.rank + 1)]
+            return st.arena.scan(comm, arr, op, order)
+        return self._scan_hier(comm, st, arr, op, exclusive=False)
+
+    @_epoch_retries
+    def coll_exscan(self, comm, sendbuf, op: Op):
+        arr = np.asarray(sendbuf)
+        st, host = self._route(comm, "exscan", arr.nbytes)
+        if host is not None:
+            return host.coll_exscan(comm, arr, op)
+        if st.mode == "arena":
+            if not (_arena_dtype_ok(arr.dtype)
+                    and arr.nbytes <= st.arena.slot_bytes
+                    and arr.nbytes <= self._cap()):
+                return self._fallback(
+                    comm, "exscan", "payload above the slot/arena cap or "
+                    "unsupported dtype", arr.nbytes
+                ).coll_exscan(comm, arr, op)
+            trace_mod.count("coll_shm_fanin_total")
+            order = [int(st.c2n[r]) for r in range(comm.rank)]
+            return st.arena.scan(comm, arr, op, order)
+        return self._scan_hier(comm, st, arr, op, exclusive=True)
+
+    def _scan_hier(self, comm, st, arr: np.ndarray, op: Op,
+                   exclusive: bool):
+        """Hierarchical prefix: intra-node prefixes + the node TOTAL at
+        each leader (one arena round — the leader just folds a longer
+        slot order), an exscan of node totals across the leader chain,
+        one intra bcast of the node base, one local combine.  Valid only
+        when the node blocks tile the comm contiguously (the prefix
+        order must not cross hosts); gates are all derived from inputs
+        every rank agrees on."""
+        kind = "exscan" if exclusive else "scan"
+
+        def _host_run(reason):
+            h = self._fallback(comm, kind, reason, arr.nbytes)
+            return (h.coll_exscan(comm, arr, op) if exclusive
+                    else h.coll_scan(comm, arr, op))
+
+        flat = [r for blk in st.node_blocks for r in blk]
+        if flat != list(range(comm.size)):
+            return _host_run("non-contiguous node blocks (prefix order "
+                            "crosses hosts)")
+        # _slot_bytes is non-increasing in size, so the comm-size floor
+        # bounds every node arena's slot: one globally-uniform gate
+        if not (_arena_dtype_ok(arr.dtype)
+                and arr.nbytes <= _slot_bytes(comm.size)
+                and arr.nbytes <= self._cap()):
+            return _host_run("payload above the slot/arena cap or "
+                            "unsupported dtype")
+        node = st.node
+        nr = node.rank
+        intra = None
+        if node.size > 1:
+            trace_mod.count("coll_shm_fanin_total")
+            if st.arena is not None:
+                # one round, per-rank fold orders: the leader folds ALL
+                # slots (the node total); members fold their prefix
+                order = (list(range(node.size)) if nr == 0 else
+                         list(range(nr + 1) if not exclusive
+                              else range(nr)))
+                intra = st.arena.scan(node, arr, op, order)
+            else:
+                if exclusive:
+                    ex = base.exscan_linear(node, arr, op)
+                    intra = ex
+                    if nr == node.size - 1:
+                        tot = np.asarray(op.host(ex, arr))
+                        node._coll_isend(tot, 0, base.TAG_SCAN).wait()
+                else:
+                    intra = base.scan_linear(node, arr, op)
+                    if nr == node.size - 1:
+                        node._coll_isend(intra, 0, base.TAG_SCAN).wait()
+                if nr == 0:
+                    intra = node._coll_irecv(
+                        None, node.size - 1, base.TAG_SCAN).wait().reshape(
+                            arr.shape).astype(arr.dtype, copy=False)
+        # own intra prefix: leaders carried the node TOTAL in ``intra``,
+        # but their own prefix is trivial (first member of the block)
+        own = ((None if exclusive else np.asarray(arr)) if nr == 0
+               else intra)
+        my_idx = st.node_idx_of[comm.rank]
+        base_pref = None
+        if st.leader is not None:
+            total = intra if node.size > 1 else np.asarray(arr)
+            base_pref = base.exscan_linear(
+                st.leader, np.ascontiguousarray(total), op)
+        if my_idx == 0:
+            return own
+        bp = self._intra_bcast(st, base_pref if nr == 0 else None, 0)
+        bp = np.asarray(bp, arr.dtype).reshape(arr.shape)
+        if own is None:
+            return bp
+        return np.asarray(op.host(bp, own)).reshape(arr.shape)

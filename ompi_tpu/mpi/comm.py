@@ -572,6 +572,38 @@ class Communicator:
 
         return persistent.allgather_init(self, sendbuf)
 
+    def alltoall_init(self, sendbuf):
+        """≈ MPI_Alltoall_init: ``sendbuf`` is re-read at each start."""
+        from ompi_tpu.mpi.coll import persistent
+
+        return persistent.alltoall_init(self, sendbuf)
+
+    def alltoallv_init(self, sendparts):
+        """≈ MPI_Alltoallv_init: one (possibly None) part per rank."""
+        from ompi_tpu.mpi.coll import persistent
+
+        return persistent.alltoallv_init(self, sendparts)
+
+    def reduce_scatter_init(self, sendbuf, op=None):
+        """≈ MPI_Reduce_scatter_init."""
+        from ompi_tpu.mpi import op as op_mod
+        from ompi_tpu.mpi.coll import persistent
+
+        return persistent.reduce_scatter_init(self, sendbuf,
+                                              op or op_mod.SUM)
+
+    def neighbor_alltoall_init(self, sendparts):
+        """≈ MPI_Neighbor_alltoall_init (needs an attached topology)."""
+        from ompi_tpu.mpi.coll import persistent
+
+        return persistent.neighbor_alltoall_init(self, sendparts)
+
+    def neighbor_alltoallv_init(self, sendparts):
+        """≈ MPI_Neighbor_alltoallv_init."""
+        from ompi_tpu.mpi.coll import persistent
+
+        return persistent.neighbor_alltoallv_init(self, sendparts)
+
     # -- partitioned point-to-point (≈ MPI_Psend_init/Precv_init, MPI-4 §4:
     #    Pready/Parrived ride the PML) -------------------------------------
 

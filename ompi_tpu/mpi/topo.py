@@ -493,28 +493,40 @@ def _recv_tag(topo, i: int, s: int, srcs: list[int], tag: int) -> int:
     return tag + (occurrence % 64)
 
 
-def _edge_plan(comm, send_per_dst: list, tag: int):
-    """The neighbor-collective wire plan — the ONE source of truth for
+def _edge_meta(comm, ndst: int, tag: int):
+    """Routing-only neighbor wire plan — the ONE source of truth for
     the edge slot/tag discipline (see _send_slot's 2-cycle-torus note),
-    shared by the blocking and nonblocking variants so they always pair.
+    shared by the blocking, nonblocking, AND persistent variants so
+    they always pair.
 
-    Returns (srcs, sends, recvs): sends = [(data, dst, tag)] with
-    PROC_NULL edges dropped; recvs = [(in_index, src, tag)] likewise.
+    Returns (srcs, send_meta, recvs): send_meta = [(out_index, dst,
+    tag)] with PROC_NULL edges dropped; recvs = [(in_index, src, tag)]
+    likewise.  Pure topology — the persistent neighbor plans freeze
+    this once at bind and re-read only the payload per Start.
     """
     topo = _topo_of(comm)
     srcs, dsts = topo.neighbors(comm.rank)
-    if len(send_per_dst) != len(dsts):
+    if ndst != len(dsts):
         raise MPIException(
-            f"need {len(dsts)} send blocks, got {len(send_per_dst)}",
+            f"need {len(dsts)} send blocks, got {ndst}",
             error_class=2)
-    sends = []
+    send_meta = []
     for j, d in enumerate(dsts):
         if d == PROC_NULL:
             continue
         slot = _send_slot(topo, comm.rank, j, d, dsts)
-        sends.append((np.asarray(send_per_dst[j]), d, tag + (slot % 64)))
+        send_meta.append((j, d, tag + (slot % 64)))
     recvs = [(i, s, _recv_tag(topo, i, s, srcs, tag))
              for i, s in enumerate(srcs) if s != PROC_NULL]
+    return srcs, send_meta, recvs
+
+
+def _edge_plan(comm, send_per_dst: list, tag: int):
+    """:func:`_edge_meta` with the payload attached: sends =
+    [(data, dst, tag)]."""
+    srcs, send_meta, recvs = _edge_meta(comm, len(send_per_dst), tag)
+    sends = [(np.asarray(send_per_dst[j]), d, t)
+             for j, d, t in send_meta]
     return srcs, sends, recvs
 
 

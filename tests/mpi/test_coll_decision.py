@@ -67,11 +67,16 @@ def test_rules_hit_emits_rules_file_source(tmp_path):
 
 
 def test_fixed_default_emits_fixed_source():
-    # alltoall has no shm shortcut, so the host decision layer always
-    # runs and the no-directive path lands on the fixed default
-    evs = _decision_events(
-        lambda c: c.alltoall(np.arange(float(2 * N)).reshape(N, 2)
-                             + c.rank))
+    # with coll/shm off, the host decision layer always runs and the
+    # no-directive path lands on the fixed default (alltoall gained an
+    # shm shortcut, so the shortcut must be disabled to see host's)
+    var_registry.set("coll_shm_enable", False)
+    try:
+        evs = _decision_events(
+            lambda c: c.alltoall(np.arange(float(2 * N)).reshape(N, 2)
+                                 + c.rank))
+    finally:
+        var_registry.set("coll_shm_enable", True)
     hits = [e for e in evs if e[3] == "decision:alltoall"]
     assert hits
     for e in hits:
@@ -141,3 +146,46 @@ def test_rules_cache_refreshes_on_mtime_change(tmp_path):
         assert evs[-1][5]["algorithm"] == "linear"
     finally:
         var_registry.set("coll_host_dynamic_rules", "")
+
+def test_alltoall_bruck_crossover_gate():
+    """Bruck wins only where lg p rounds beat p-1: small payload AND
+    enough ranks — both the fixed rung and its two config knobs."""
+    from types import SimpleNamespace
+
+    from ompi_tpu.mpi.coll.host import HostColl
+
+    fixed = HostColl._alltoall_fixed
+    small = var_registry.get("coll_host_alltoall_small")
+    assert fixed(SimpleNamespace(size=8), small - 1) == "bruck"
+    assert fixed(SimpleNamespace(size=8), small) == "pairwise"      # large
+    assert fixed(SimpleNamespace(size=7), small - 1) == "pairwise"  # few p
+    var_registry.set("coll_host_alltoall_bruck_ranks", 2)
+    try:
+        assert fixed(SimpleNamespace(size=2), small - 1) == "bruck"
+    finally:
+        var_registry.set("coll_host_alltoall_bruck_ranks", 8)
+
+
+def test_alltoall_bruck_forced_parity_with_pairwise():
+    n = 5   # non-power-of-two: both bruck phases' wraparound paths
+
+    def body(comm):
+        send = (np.arange(n * 3, dtype=np.float64).reshape(n, 3)
+                + 100 * comm.rank)
+        return comm.alltoall(send)
+
+    var_registry.set("coll_shm_enable", False)
+    try:
+        ref = run_ranks(n, body)
+        var_registry.set("coll_host_alltoall_algorithm", "bruck")
+        try:
+            evs = _decision_events(body, n=n)
+            got = run_ranks(n, body)
+        finally:
+            var_registry.set("coll_host_alltoall_algorithm", "")
+    finally:
+        var_registry.set("coll_shm_enable", True)
+    for a, b in zip(got, ref):
+        assert a.tobytes() == b.tobytes()
+    hits = [e for e in evs if e[3] == "decision:alltoall"]
+    assert hits and all(e[5]["algorithm"] == "bruck" for e in hits)

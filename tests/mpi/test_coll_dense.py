@@ -1,0 +1,594 @@
+"""The arena dense-exchange plane: alltoall/v/w, reduce_scatter, scan.
+
+Covers the coll/shm dense slots (flat slot-per-peer arena protocol and
+the locality-aware hierarchical aggregation), the alltoallv descriptor
+verdict round (rank-local sizes → collectively-agreed fallback), the
+zero-count edge cases the pairwise base algorithms must survive,
+bit-parity fuzz across the three planes (native arena / pure-python
+arena / coll-host ground truth), persistent dense plans
+(``alltoall_init`` / ``alltoallv_init`` / ``reduce_scatter_init``:
+bind-once Start, bound-buffer re-read, revive auto-rebind), and the
+persistent neighborhood collectives over all three topology kinds.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from ompi_tpu.core.config import var_registry
+from ompi_tpu.mpi import datatype as dt
+from ompi_tpu.mpi import op as op_mod
+from ompi_tpu.mpi import trace
+from tests.mpi.harness import run_ranks
+
+N = 4
+
+_DTYPES = [np.float64, np.float32, np.int64, np.int32, np.uint8]
+
+
+def _alltoall_ref(datas, rank):
+    """Row j of rank s's sendbuf lands as row s of rank j's result."""
+    return np.stack([np.asarray(datas[s]).reshape(
+        len(datas), -1)[rank] for s in range(len(datas))])
+
+
+# ---------------------------------------------------------------------------
+# flat arena slots
+# ---------------------------------------------------------------------------
+
+def test_alltoall_rides_the_arena():
+    def body(comm):
+        send = (np.arange(N * 3, dtype=np.float64).reshape(N, 3)
+                + 100 * comm.rank)
+        out = comm.alltoall(send)
+        return out, dict(comm.coll.providers)["alltoall"]
+
+    fanin = trace.counters["coll_shm_fanin_total"]
+    res = run_ranks(N, body)
+    datas = [np.arange(N * 3).reshape(N, 3) + 100 * r for r in range(N)]
+    for r, (out, prov) in enumerate(res):
+        assert prov == "shm"
+        np.testing.assert_array_equal(
+            out.reshape(N, 3), _alltoall_ref(datas, r))
+    assert trace.counters["coll_shm_fanin_total"] >= fanin + N
+
+
+def test_reduce_scatter_non_divisible_split():
+    """37 elements over 4 ranks: the np.array_split contract (first
+    ``rem`` ranks get the longer chunk), folded in comm-rank order."""
+    def body(comm):
+        return comm.reduce_scatter(
+            np.arange(37, dtype=np.float64) + comm.rank)
+
+    res = run_ranks(N, body)
+    full = sum(np.arange(37, dtype=np.float64) + r for r in range(N))
+    for r, out in enumerate(res):
+        np.testing.assert_allclose(out, np.array_split(full, N)[r])
+
+
+def test_scan_exscan_arena_rank_prefix():
+    # elementwise (the MPI op contract) but order-sensitive: the
+    # arena's prefix chain must fold 0..r in comm-rank order
+    halfsum = op_mod.create_op(lambda a, b: 0.5 * a + b,
+                               commutative=False)
+
+    def _x(r):
+        return np.arange(3, dtype=np.float64) + 10 * (r + 1)
+
+    def _chain(hi):
+        acc = _x(0)
+        for k in range(1, hi):
+            acc = 0.5 * acc + _x(k)
+        return acc
+
+    def body(comm):
+        x = _x(comm.rank)
+        return comm.scan(x, op=halfsum), comm.exscan(x, op=halfsum)
+
+    res = run_ranks(N, body)
+    for r, (sc, ex) in enumerate(res):
+        np.testing.assert_allclose(sc, _chain(r + 1))
+        if r == 0:
+            assert ex is None
+        else:
+            np.testing.assert_allclose(ex, _chain(r))
+
+
+def test_alltoallv_none_parts_and_mixed_shapes():
+    def body(comm):
+        parts = [None if (comm.rank + i) % 3 == 0
+                 else np.arange(i + 1, dtype=np.int32).reshape(
+                     1, i + 1) + 10 * comm.rank
+                 for i in range(N)]
+        return [np.array(p, copy=True) for p in comm.alltoallv(parts)]
+
+    res = run_ranks(N, body)
+    for r, out in enumerate(res):
+        for s in range(N):
+            if (s + r) % 3 == 0:
+                assert out[s].size == 0
+            else:
+                np.testing.assert_array_equal(
+                    out[s], np.arange(r + 1, dtype=np.int32).reshape(
+                        1, r + 1) + 10 * s)
+                assert out[s].dtype == np.int32
+
+
+def test_alltoallw_fills_recvspecs_in_place():
+    def body(comm):
+        sends = [(np.arange(4, dtype=np.float32) + comm.rank * 10 + i,
+                  dt.FLOAT32, 4) for i in range(N)]
+        recvs = [(np.zeros(4, np.float32), dt.FLOAT32, 4)
+                 for _ in range(N)]
+        assert comm.alltoallw(sends, recvs) is None
+        return [np.array(r[0], copy=True) for r in recvs]
+
+    res = run_ranks(N, body)
+    for r, out in enumerate(res):
+        for s in range(N):
+            np.testing.assert_array_equal(
+                out[s], np.arange(4, dtype=np.float32) + s * 10 + r)
+
+
+# ---------------------------------------------------------------------------
+# the collectively-agreed fallback ladder
+# ---------------------------------------------------------------------------
+
+def test_alltoallv_oversized_part_verdict_travels():
+    """ONE rank's parts exceed the slot: its HOST descriptor verdict
+    must move every rank to the host plane together (a local gate
+    would deadlock the arena round), result unchanged."""
+    big = int(var_registry.get("coll_shm_slot_size")) + 64
+    falls = trace.counters["coll_shm_fallback_total"]
+
+    def body(comm):
+        ln = big if comm.rank == 2 else 4
+        parts = [np.full(ln, comm.rank, np.uint8) for _ in range(N)]
+        return comm.alltoallv(parts)
+
+    res = run_ranks(N, body)
+    for r, out in enumerate(res):
+        for s in range(N):
+            want_ln = big if s == 2 else 4
+            assert out[s].size == want_ln
+            assert (np.asarray(out[s]) == s).all()
+    assert trace.counters["coll_shm_fallback_total"] >= falls + N
+
+
+def test_alltoall_above_slot_cap_falls_back_bit_identical():
+    slot = int(var_registry.get("coll_shm_slot_size"))
+    for nbytes in (slot // 2, slot + 1024):
+        elems = max(nbytes // 8 // N, 1)
+
+        def body(comm, elems=elems):
+            send = (np.arange(N * elems, dtype=np.float64)
+                    .reshape(N, elems) + comm.rank)
+            return comm.alltoall(send)
+
+        datas = [np.arange(N * elems).reshape(N, elems) + r
+                 for r in range(N)]
+        for r, out in enumerate(run_ranks(N, body)):
+            ref = _alltoall_ref(datas, r).astype(np.float64)
+            assert out.tobytes() == ref.tobytes()
+
+
+def test_noncommutative_reduce_scatter_flat_stays_on_arena():
+    """The flat arena folds in comm-rank order — the canonical MPI
+    order — so non-commutative ops need no fallback there."""
+    halfsum = op_mod.create_op(lambda a, b: 0.5 * a + b,
+                               commutative=False)
+
+    def body(comm):
+        return comm.reduce_scatter(
+            np.arange(N * 3, dtype=np.float64) + 10 * (comm.rank + 1),
+            op=halfsum)
+
+    acc = np.arange(N * 3, dtype=np.float64) + 10.0
+    for k in range(1, N):
+        acc = 0.5 * acc + (np.arange(N * 3, dtype=np.float64)
+                           + 10 * (k + 1))
+    res = run_ranks(N, body)
+    for r, out in enumerate(res):
+        np.testing.assert_allclose(out, np.array_split(acc, N)[r])
+
+
+# ---------------------------------------------------------------------------
+# zero-count edges in the pairwise base algorithms (host plane)
+# ---------------------------------------------------------------------------
+
+def test_host_alltoallv_zero_counts_and_size1():
+    var_registry.set("coll_shm_enable", False)
+    try:
+        def body(comm):
+            parts = [None if i == comm.rank else
+                     np.empty(0, np.float64) if i == 0 else
+                     np.arange(i, dtype=np.float64) + comm.rank
+                     for i in range(3)]
+            return comm.alltoallv(parts)
+
+        res = run_ranks(3, body)
+        for r, out in enumerate(res):
+            for s in range(3):
+                if r == s or r == 0:
+                    assert out[s].size == 0
+                else:
+                    np.testing.assert_array_equal(
+                        out[s], np.arange(r, dtype=np.float64) + s)
+
+        solo = run_ranks(1, lambda c: c.alltoallv([None]))[0]
+        assert len(solo) == 1 and solo[0].size == 0
+    finally:
+        var_registry.set("coll_shm_enable", True)
+
+
+def test_host_alltoallw_size1_short_circuit():
+    var_registry.set("coll_shm_enable", False)
+    try:
+        def body(comm):
+            recv = [(np.zeros(3, np.int64), dt.INT64, 3)]
+            comm.alltoallw([(np.arange(3, dtype=np.int64), dt.INT64, 3)],
+                           recv)
+            return np.array(recv[0][0], copy=True)
+
+        np.testing.assert_array_equal(run_ranks(1, body)[0],
+                                      np.arange(3))
+    finally:
+        var_registry.set("coll_shm_enable", True)
+
+
+# ---------------------------------------------------------------------------
+# bit-parity fuzz: native arena vs python arena vs host ground truth
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", range(4))
+def test_dense_fuzz_parity_three_planes(seed):
+    rng = np.random.default_rng(1000 + seed)
+    n = int(rng.integers(2, 6))
+    dtype = _DTYPES[int(rng.integers(len(_DTYPES)))]
+    rows, cols = n * int(rng.integers(1, 4)), int(rng.integers(1, 8))
+    # strided sendbufs: a sliced view must publish correctly
+    datas = [np.ascontiguousarray(
+        rng.integers(1, 100, size=(rows, 2 * cols)))[:, ::2].astype(
+        dtype) for _ in range(n)]
+    vparts = [[rng.integers(0, 50, size=int(rng.integers(0, 9)))
+               .astype(dtype) for _ in range(n)] for _ in range(n)]
+
+    def body(comm):
+        a = comm.alltoall(datas[comm.rank])
+        v = comm.alltoallv(vparts[comm.rank])
+        rs = comm.reduce_scatter(datas[comm.rank])
+        sc = comm.scan(datas[comm.rank])
+        return a, v, rs, sc
+
+    planes = {}
+    planes["native"] = run_ranks(n, body)
+    var_registry.set("coll_shm_native", False)
+    try:
+        planes["python"] = run_ranks(n, body)
+    finally:
+        var_registry.set("coll_shm_native", True)
+    var_registry.set("coll_shm_enable", False)
+    try:
+        planes["host"] = run_ranks(n, body)
+    finally:
+        var_registry.set("coll_shm_enable", True)
+
+    ref = planes["host"]
+    for plane in ("native", "python"):
+        for got, want in zip(planes[plane], ref):
+            ga, gv, grs, gsc = got
+            wa, wv, wrs, wsc = want
+            assert ga.dtype == wa.dtype and ga.tobytes() == wa.tobytes()
+            for x, y in zip(gv, wv):
+                assert np.asarray(x).shape == np.asarray(y).shape
+                assert np.asarray(x).tobytes() == np.asarray(y).tobytes()
+            assert grs.tobytes() == wrs.tobytes()
+            assert gsc.tobytes() == wsc.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# hierarchical composition (locality-aware aggregation)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("hosts", [
+    ("a", "a", "b", "b"),
+    ("a", "b", "b", "b"),
+    ("a", "b", "a", "b"),     # non-contiguous node membership
+])
+def test_dense_hier_composition(hosts):
+    n = len(hosts)
+
+    def body(comm):
+        comm._io_host_override = hosts[comm.rank]
+        comm.barrier()
+        send = (np.arange(n * 2, dtype=np.float64).reshape(n, 2)
+                + 10 * comm.rank)
+        a = comm.alltoall(send)
+        rs = comm.reduce_scatter(np.arange(n * 2 + 1, dtype=np.float64)
+                                 + comm.rank)
+        sc = comm.scan(np.array([comm.rank + 1.0]))
+        ex = comm.exscan(np.array([comm.rank + 1.0]))
+        return a, rs, sc, ex, comm._coll_shm_state.mode
+
+    res = run_ranks(n, body)
+    datas = [np.arange(n * 2).reshape(n, 2) + 10 * r for r in range(n)]
+    full = sum(np.arange(n * 2 + 1, dtype=np.float64) + r
+               for r in range(n))
+    for r, (a, rs, sc, ex, mode) in enumerate(res):
+        assert mode == "hier"
+        np.testing.assert_array_equal(a.reshape(n, 2),
+                                      _alltoall_ref(datas, r))
+        np.testing.assert_allclose(rs, np.array_split(full, n)[r])
+        np.testing.assert_allclose(sc, [sum(range(1, r + 2))])
+        if r == 0:
+            assert ex is None
+        else:
+            np.testing.assert_allclose(ex, [sum(range(1, r + 1))])
+
+
+def test_hier_alltoallv_falls_back_collectively():
+    """v-counts are rank-local: no collectively-derivable aggregation
+    split exists, so multi-node comms fall back as one."""
+    falls = trace.counters["coll_shm_fallback_total"]
+
+    def body(comm):
+        comm._io_host_override = "ab"[comm.rank % 2]
+        comm.barrier()
+        parts = [np.arange(i + 1, dtype=np.int64) + comm.rank
+                 for i in range(N)]
+        return comm.alltoallv(parts)
+
+    res = run_ranks(N, body)
+    for r, out in enumerate(res):
+        for s in range(N):
+            np.testing.assert_array_equal(
+                out[s], np.arange(r + 1, dtype=np.int64) + s)
+    assert trace.counters["coll_shm_fallback_total"] >= falls + N
+
+
+# ---------------------------------------------------------------------------
+# persistent dense plans
+# ---------------------------------------------------------------------------
+
+def test_persistent_alltoall_rereads_bound_buffer():
+    def body(comm):
+        send = (np.arange(N * 2, dtype=np.float64).reshape(N, 2)
+                + 100 * comm.rank)
+        req = comm.alltoall_init(send)
+        outs = []
+        for _ in range(2):
+            req.start()
+            outs.append(np.array(req.wait(), copy=True))
+            send += 1000          # in place — the plan must see it
+        prov = req.provider
+        req.free()
+        return outs, prov
+
+    res = run_ranks(N, body)
+    datas = [np.arange(N * 2).reshape(N, 2) + 100 * r for r in range(N)]
+    for r, (outs, prov) in enumerate(res):
+        assert prov == "shm"
+        ref = _alltoall_ref(datas, r).astype(np.float64)
+        np.testing.assert_array_equal(outs[0].reshape(N, 2), ref)
+        np.testing.assert_array_equal(outs[1].reshape(N, 2), ref + 1000)
+
+
+def test_persistent_dense_kind_sweep_matches_oneshot():
+    def body(comm):
+        send = np.arange(N * 3, dtype=np.float64).reshape(N, 3) \
+            + comm.rank
+        parts = [None if i == comm.rank
+                 else np.arange(i + 2, dtype=np.int64) + comm.rank
+                 for i in range(N)]
+        rs_buf = np.arange(N * 2 + 3, dtype=np.float64) + comm.rank
+
+        reqs = {
+            "alltoall": comm.alltoall_init(send),
+            "alltoallv": comm.alltoallv_init(parts),
+            "reduce_scatter": comm.reduce_scatter_init(rs_buf),
+        }
+        got = {}
+        for kind, req in reqs.items():
+            req.start()
+            got[kind] = req.wait()
+            req.free()
+        one = {
+            "alltoall": comm.alltoall(send),
+            "alltoallv": comm.alltoallv(parts),
+            "reduce_scatter": comm.reduce_scatter(rs_buf),
+        }
+        return got, one
+
+    for got, one in run_ranks(N, body):
+        assert got["alltoall"].tobytes() == one["alltoall"].tobytes()
+        for x, y in zip(got["alltoallv"], one["alltoallv"]):
+            assert np.asarray(x).tobytes() == np.asarray(y).tobytes()
+        assert (got["reduce_scatter"].tobytes()
+                == one["reduce_scatter"].tobytes())
+
+
+def test_persistent_dense_revive_auto_rebinds():
+    """A simulated member revive between Starts: the agreed-incs gate
+    detects the stale plan, the next Start rebinds collectively, and
+    the converged world serves the Start from the arena again (zero
+    host-plane involvement)."""
+    from tests.mpi.test_coll_rejoin import _simulate_revive
+
+    bar = threading.Barrier(N)
+
+    def body(comm):
+        send = (np.arange(N, dtype=np.float64).reshape(N, 1)
+                + comm.rank)
+        req = comm.alltoall_init(send)
+        req.start()
+        out0 = np.array(req.wait(), copy=True)
+        _simulate_revive(comm, 1, bar)
+        req.start()               # auto-rebind, not a raise
+        out1 = np.array(req.wait(), copy=True)
+        prov = req.provider
+        req.free()
+        return out0, out1, prov
+
+    rebinds = trace.counters["coll_persistent_rebinds_total"]
+    res = run_ranks(N, body)
+    want = np.arange(N).reshape(N, 1)
+    for r, (out0, out1, prov) in enumerate(res):
+        assert prov == "shm"      # converged world: still the arena
+        np.testing.assert_array_equal(out0.reshape(N, 1), want + r)
+        np.testing.assert_array_equal(out1.reshape(N, 1), want + r)
+    assert trace.counters["coll_persistent_rebinds_total"] == rebinds + N
+
+
+def test_persistent_dense_size1_and_directive():
+    def solo(comm):
+        req = comm.alltoall_init(np.arange(4.0))
+        req.start()
+        x = req.wait()
+        prov = req.provider
+        req.free()
+        return x, prov
+
+    x, prov = run_ranks(1, solo)[0]
+    assert prov == "self"
+    np.testing.assert_array_equal(x, np.arange(4.0))
+
+    # a forced host algorithm is user tuning the bind must freeze
+    var_registry.set("coll_host_alltoall_algorithm", "pairwise")
+    try:
+        def forced(comm):
+            send = np.arange(N * 2, dtype=np.float64).reshape(N, 2) \
+                + comm.rank
+            req = comm.alltoall_init(send)
+            req.start()
+            out = req.wait()
+            prov = req.provider
+            req.free()
+            return out, prov
+
+        res = run_ranks(N, forced)
+        datas = [np.arange(N * 2).reshape(N, 2) + r for r in range(N)]
+        for r, (out, prov) in enumerate(res):
+            assert prov == "host"
+            np.testing.assert_array_equal(out.reshape(N, 2),
+                                          _alltoall_ref(datas, r))
+    finally:
+        var_registry.set("coll_host_alltoall_algorithm", "")
+
+
+# ---------------------------------------------------------------------------
+# persistent neighborhood collectives (cart / graph / dist_graph)
+# ---------------------------------------------------------------------------
+
+def _neighbor_pair_body(make_topo_comm, nparts_of):
+    """Blocking vs persistent parity over one topology; two Starts to
+    prove the plan is reusable."""
+    def body(comm):
+        tcomm = make_topo_comm(comm)
+        if tcomm is None:
+            return None
+        k = nparts_of(tcomm)
+        parts = [np.array([tcomm.rank * 100 + j], np.int64)
+                 for j in range(k)]
+        blocking = tcomm.neighbor_alltoall(parts)
+        req = tcomm.neighbor_alltoall_init(parts)
+        outs = []
+        for _ in range(2):
+            req.start()
+            outs.append([None if x is None else np.array(x, copy=True)
+                         for x in req.wait()])
+        prov = req.provider
+        req.free()
+        return blocking, outs, prov
+    return body
+
+
+def _assert_neighbor_parity(res):
+    seen = 0
+    for r in res:
+        if r is None:
+            continue
+        seen += 1
+        blocking, outs, prov = r
+        assert prov == "topo"
+        for o in outs:
+            assert len(o) == len(blocking)
+            for a, b in zip(o, blocking):
+                assert (a is None) == (b is None)
+                if a is not None:
+                    np.testing.assert_array_equal(a, b)
+    assert seen
+
+
+@pytest.mark.parametrize("periodic", [True, False])
+def test_persistent_neighbor_cart(periodic):
+    from ompi_tpu.mpi import topo
+
+    def make(comm):
+        return topo.cart_create(comm, [2, 2],
+                                periods=[periodic, periodic])
+
+    res = run_ranks(N, _neighbor_pair_body(
+        make, lambda c: 2 * c.topo.ndims))
+    _assert_neighbor_parity(res)
+    if not periodic:
+        # boundary edges really are PROC_NULL → None entries survive
+        # the persistent round-trip too
+        assert any(any(x is None for x in r[1][0])
+                   for r in res if r is not None)
+
+
+def test_persistent_neighbor_graph():
+    from ompi_tpu.mpi import topo
+
+    # 0-1-2-3 path graph: index/edges form
+    index, edges = [1, 3, 5, 6], [1, 0, 2, 1, 3, 2]
+
+    def make(comm):
+        return topo.graph_create(comm, index, edges)
+
+    res = run_ranks(N, _neighbor_pair_body(
+        make, lambda c: len(c.topo.neighbors_of(c.rank))))
+    _assert_neighbor_parity(res)
+
+
+def test_persistent_neighbor_dist_graph_adjacent():
+    from ompi_tpu.mpi import topo
+
+    def make(comm):
+        # directed ring: recv from left, send to right
+        left = (comm.rank - 1) % comm.size
+        right = (comm.rank + 1) % comm.size
+        return topo.dist_graph_create_adjacent(comm, [left], [right])
+
+    res = run_ranks(N, _neighbor_pair_body(make, lambda c: 1))
+    _assert_neighbor_parity(res)
+
+
+def test_persistent_neighbor_revive_auto_rebinds():
+    from ompi_tpu.mpi import topo
+    from tests.mpi.test_coll_rejoin import _simulate_revive
+
+    bar = threading.Barrier(N)
+
+    def body(comm):
+        cart = topo.cart_create(comm, [2, 2], periods=[True, True])
+        parts = [np.array([cart.rank], np.int64) for _ in range(4)]
+        ref = cart.neighbor_alltoall(parts)
+        req = cart.neighbor_alltoall_init(parts)
+        req.start()
+        out0 = req.wait()
+        _simulate_revive(cart, 1, bar)
+        req.start()               # stale incs → collective rebind
+        out1 = req.wait()
+        req.free()
+        return ref, out0, out1
+
+    rebinds = trace.counters["coll_persistent_rebinds_total"]
+    for ref, out0, out1 in run_ranks(N, body):
+        for a, b, c in zip(ref, out0, out1):
+            np.testing.assert_array_equal(a, b)
+            np.testing.assert_array_equal(a, c)
+    assert trace.counters["coll_persistent_rebinds_total"] == rebinds + N

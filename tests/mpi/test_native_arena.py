@@ -247,3 +247,57 @@ def test_strided_desc_covers_numpy_layouts():
     assert shm._strided_desc(w) is None
     assert shm._strided_desc(a[::-1]) is None           # negative stride
     assert shm._strided_desc(np.empty(0)) is None
+
+# ---------------------------------------------------------------------------
+# dense copy_blocks gathers (the alltoall/reduce_scatter scatter phase)
+# ---------------------------------------------------------------------------
+
+def _block_ptrs(bufs, offs=None):
+    offs = offs or [0] * len(bufs)
+    return (ctypes.c_void_p * len(bufs))(
+        *[b.ctypes.data + o for b, o in zip(bufs, offs)])
+
+
+@requires_arena
+def test_copy_blocks_gathers_and_flags():
+    """One call moves every per-peer block AND release-publishes the
+    arrive flag — the fused scatter step of the dense exchange."""
+    srcs = [np.arange(i + 1, dtype=np.uint8) + 50 * i for i in range(4)]
+    dst = np.zeros(sum(s.size for s in srcs), np.uint8)
+    offs, o = [], 0
+    for s in srcs:
+        offs.append(o)
+        o += s.size
+    lens = (ctypes.c_int64 * 4)(*[s.size for s in srcs])
+    f = _flags()
+    lib.ompi_tpu_arena_copy_blocks(
+        _block_ptrs([dst] * 4, offs), _block_ptrs(srcs),
+        ctypes.addressof(lens), 4, ctypes.addressof(f), 3, 77)
+    np.testing.assert_array_equal(dst, np.concatenate(srcs))
+    assert f[3] == 77 and all(f[i] == 0 for i in range(16) if i != 3)
+
+
+@requires_arena
+def test_copy_blocks_skips_zero_and_negative_lens():
+    src = np.full(8, 9, np.uint8)
+    dst = np.zeros(8, np.uint8)
+    lens = (ctypes.c_int64 * 3)(0, -4, 8)
+    lib.ompi_tpu_arena_copy_blocks(
+        _block_ptrs([dst, dst, dst]), _block_ptrs([src, src, src]),
+        ctypes.addressof(lens), 3, None, 0, 0)
+    # only the len=8 block landed; zero/negative were no-ops (and the
+    # NULL flags pointer means no publish either)
+    np.testing.assert_array_equal(dst, src)
+
+
+@requires_arena
+def test_copy_blocks_null_flags_is_pure_copy():
+    src = np.arange(16, dtype=np.uint8)
+    dst = np.zeros(16, np.uint8)
+    lens = (ctypes.c_int64 * 1)(16)
+    f = _flags()
+    lib.ompi_tpu_arena_copy_blocks(
+        _block_ptrs([dst]), _block_ptrs([src]),
+        ctypes.addressof(lens), 1, None, 5, 123)
+    np.testing.assert_array_equal(dst, src)
+    assert all(v == 0 for v in f)

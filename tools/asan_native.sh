@@ -74,8 +74,10 @@ EOF
 
 echo "== convertor/pack/dss/arena/net tests under ASan/UBSan"
 # test_native_arena drives every arena entry point (waits, publishes,
-# strided walks, every fold width, ring parks); test_coll_shm runs the
-# full collective protocols over the sanitized executor;
+# strided walks, every fold width, ring parks, dense copy_blocks
+# gathers); test_coll_shm runs the full collective protocols —
+# including the arena dense-exchange plane (alltoall/v/w,
+# reduce_scatter, scan) — over the sanitized executor;
 # test_native_net drives the tcp submission rings, send3/writev drains,
 # parked poller and zero-copy landing over real loopback sockets
 env JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
@@ -86,5 +88,6 @@ env JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
     tests/mpi/test_pack_plan.py \
     tests/mpi/test_native_arena.py \
     tests/mpi/test_native_net.py \
-    tests/mpi/test_coll_shm.py
+    tests/mpi/test_coll_shm.py \
+    tests/mpi/test_coll_dense.py
 echo "== ASan/UBSan native run clean"

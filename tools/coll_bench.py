@@ -98,27 +98,47 @@ def _run_world(n: int, fn, timeout: float = 300.0) -> list:
     return results
 
 
+def _make_x(comm, coll: str, nbytes: int):
+    """The per-rank send buffer for one size row.  ``payload_bytes``
+    is the TOTAL sendbuf (for alltoall that is p blocks of nbytes/p —
+    the MoE-dispatch accounting, where the row size is what one rank
+    ships, not what one peer receives)."""
+    if not nbytes:
+        return None
+    if coll == "alltoall":
+        per = max(nbytes // 8 // comm.size, 1)
+        return (np.arange(per * comm.size, dtype=np.float64)
+                .reshape(comm.size, per) + comm.rank)
+    return np.arange(max(nbytes // 8, 1), dtype=np.float64) + comm.rank
+
+
+def _coll_op(comm, coll: str, x, i: int) -> None:
+    if coll == "allreduce":
+        comm.allreduce(x)
+    elif coll == "bcast":
+        # rotating root (the IMB discipline): iteration i's root
+        # was a receiver in iteration i-1, so a fixed root can't
+        # run ahead enqueueing asynchronous sends — the loop
+        # measures per-op completion, not enqueue throughput
+        root = i % comm.size
+        comm.bcast(x if comm.rank == root else None, root=root)
+    elif coll == "alltoall":
+        comm.alltoall(x)
+    elif coll == "reduce_scatter":
+        comm.reduce_scatter(x)
+    else:
+        comm.barrier()
+
+
 def _time_coll(n: int, coll: str, nbytes: int, iters: int,
                reps: int) -> float:
     """Per-op µs: synchronized loop wall time / iters, best of reps."""
-    elems = max(nbytes // 8, 1) if nbytes else 0
 
     def body(comm):
-        if nbytes:
-            x = np.arange(elems, dtype=np.float64) + comm.rank
+        x = _make_x(comm, coll, nbytes)
 
         def one(i: int) -> None:
-            if coll == "allreduce":
-                comm.allreduce(x)
-            elif coll == "bcast":
-                # rotating root (the IMB discipline): iteration i's root
-                # was a receiver in iteration i-1, so a fixed root can't
-                # run ahead enqueueing asynchronous sends — the loop
-                # measures per-op completion, not enqueue throughput
-                root = i % comm.size
-                comm.bcast(x if comm.rank == root else None, root=root)
-            else:
-                comm.barrier()
+            _coll_op(comm, coll, x, i)
 
         best = float("inf")
         comm.barrier()                       # warm transports + arena
@@ -254,20 +274,12 @@ def _time_coll_native_pair(n: int, coll: str, nbytes: int, iters: int,
     because the native side's whole point is scheduler behavior).
     Rank 0 flips ``coll_shm_native`` between barriers; the arena reads
     it per call."""
-    elems = max(nbytes // 8, 1) if nbytes else 0
 
     def body(comm):
-        if nbytes:
-            x = np.arange(elems, dtype=np.float64) + comm.rank
+        x = _make_x(comm, coll, nbytes)
 
         def one(i: int) -> None:
-            if coll == "allreduce":
-                comm.allreduce(x)
-            elif coll == "bcast":
-                root = i % comm.size
-                comm.bcast(x if comm.rank == root else None, root=root)
-            else:
-                comm.barrier()
+            _coll_op(comm, coll, x, i)
 
         best = {"nat": float("inf"), "py": float("inf")}
         comm.barrier()
@@ -392,6 +404,75 @@ def bench_segpar_config(n: int, nbytes: int, iters: int, reps: int,
     return rows
 
 
+def _time_neighbor_pair(n: int, nbytes: int, iters: int,
+                        reps: int) -> tuple[float, float]:
+    """(persistent µs, one-shot µs) for a 2-D periodic halo exchange
+    (neighbor_alltoall on a dims_create cart, one ``nbytes`` face per
+    edge) — BOTH modes in the same rank world, alternating per rep
+    (shared fate), the stencil-loop steady state the persistent
+    neighbor plan exists for."""
+    per = max(nbytes // 8, 1)
+
+    def body(comm):
+        from ompi_tpu.mpi import topo
+
+        dims = topo.dims_create(n, 2)
+        cart = topo.cart_create(comm, dims, periods=[True, True])
+        parts = [np.arange(per, dtype=np.float64) + cart.rank
+                 for _ in range(2 * cart.topo.ndims)]
+        req = cart.neighbor_alltoall_init(parts)
+        best = {"p": float("inf"), "o": float("inf")}
+        cart.barrier()
+        req.start()
+        req.wait()
+        cart.neighbor_alltoall(parts)
+        for _ in range(reps):
+            for which in ("p", "o"):
+                cart.barrier()
+                t0 = time.perf_counter()
+                for _i in range(iters):
+                    if which == "p":
+                        req.start()
+                        req.wait()
+                    else:
+                        cart.neighbor_alltoall(parts)
+                best[which] = min(best[which],
+                                  time.perf_counter() - t0)
+        req.free()
+        return best["p"] / iters * 1e6, best["o"] / iters * 1e6
+
+    results = _run_world(n, body)
+    return (max(r[0] for r in results), max(r[1] for r in results))
+
+
+def bench_neighbor_config(n: int, nbytes: int, iters: int, reps: int,
+                          quick: bool) -> list[dict]:
+    """One halo size row pair: persistent neighbor Start vs the
+    per-op neighbor_alltoall dispatch."""
+    p_us, o_us = _time_neighbor_pair(n, nbytes, iters, reps)
+    speedup = o_us / p_us if p_us else float("inf")
+    rows = []
+    for mode, us in (("persistent", p_us), ("oneshot", o_us)):
+        rows.append({
+            "bench": "coll_bench",
+            "coll": "neighbor_alltoall",
+            "ranks": n,
+            "payload_bytes": nbytes,
+            "component": "topo" if mode == "persistent" else "dispatch",
+            "mode": mode,
+            "per_op_us": round(us, 2),
+            "persistent_speedup": round(speedup, 2),
+            "iters": iters,
+            "reps": reps,
+            "n_cores": os.cpu_count(),
+            "quick": quick,
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        })
+    print(f"neighbor2d {nbytes:>8}B x{n}: Start {p_us:9.1f}us  "
+          f"per-op {o_us:9.1f}us  ({speedup:.2f}x)")
+    return rows
+
+
 def bench_config(n: int, coll: str, nbytes: int, iters: int, reps: int,
                  quick: bool) -> list[dict]:
     from ompi_tpu.mpi import trace
@@ -449,6 +530,12 @@ def main() -> None:
                     "native executor vs the python arena path, plus "
                     "segment-parallel vs root-fold persistent "
                     "allreduce at >=1MiB (all shared-fate)")
+    ap.add_argument("--families", default="classic",
+                    help="comma list of sweep families: 'classic' "
+                    "(allreduce/bcast/barrier — the default flow) "
+                    "and/or 'dense' (alltoall + reduce_scatter "
+                    "shm-vs-host and native-on/off, plus the 2-D "
+                    "neighbor halo persistent-vs-dispatch pair)")
     ap.add_argument("--guard", action="store_true",
                     help="preflight: refuse to bench when hours-old "
                     "PPID-1 orphaned ompi_tpu processes poison the box")
@@ -471,6 +558,52 @@ def main() -> None:
     else:
         sizes = [8, 64, 1 << 10, 8 << 10, 64 << 10, 256 << 10, 1 << 20]
         iters, reps = 50, 3
+
+    families = {f.strip() for f in args.families.split(",") if f.strip()}
+
+    if "dense" in families:
+        # alltoall rows are TOTAL sendbuf bytes (p blocks of size/p);
+        # the 4KiB–4MiB sweep crosses the arena slot cap on purpose —
+        # above it coll/shm falls back to host and the speedup column
+        # honestly flattens to ~1x (the crossover the PERF table shows)
+        dense_sizes = ([8 << 10, 64 << 10] if args.quick
+                       else [4 << 10, 16 << 10, 64 << 10, 256 << 10,
+                             1 << 20, 4 << 20])
+        rows = []
+        for coll in ("alltoall", "reduce_scatter"):
+            for nbytes in dense_sizes:
+                it = max(5, iters // 4) if nbytes >= (256 << 10) \
+                    else iters
+                rows += bench_config(args.ranks, coll, nbytes, it,
+                                     reps, args.quick)
+        # shared-fate native on/off over the same arena route
+        nat_sizes = ([16 << 10] if args.quick
+                     else [16 << 10, 64 << 10, 256 << 10])
+        for coll in ("alltoall", "reduce_scatter"):
+            for nbytes in nat_sizes:
+                rows += bench_native_config(args.ranks, coll, nbytes,
+                                            iters, reps, args.quick)
+        for nbytes in ([8 << 10] if args.quick
+                       else [4 << 10, 64 << 10]):
+            rows += bench_neighbor_config(args.ranks, nbytes, iters,
+                                          reps, args.quick)
+        with open(args.out, "a", encoding="utf-8") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+        print(f"{len(rows)} rows -> {args.out}")
+        for coll in ("alltoall", "reduce_scatter"):
+            wins = sum(1 for r in rows
+                       if r["coll"] == coll and r["component"] == "shm"
+                       and "shm_speedup" in r
+                       and r["payload_bytes"] >= (16 << 10)
+                       and r["shm_speedup"] > 1.0)
+            print(f"{coll}: arena beats host pairwise at {wins} "
+                  f">=16KiB size(s)")
+            if not args.quick and wins < 1:
+                print(f"WARNING: expected an arena win >=16KiB "
+                      f"for {coll}")
+        if "classic" not in families:
+            return
 
     if args.native:
         # the GIL-bound band the native plane targets, bracketed by one
