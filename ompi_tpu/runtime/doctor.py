@@ -56,9 +56,19 @@ from ompi_tpu.core.config import VarType, register_var, var_registry
 
 __all__ = ["DoctorResponder", "start_responder", "stop_responder",
            "capture", "query_rank", "query_timeline", "proc_probe",
-           "analyze", "thread_stacks"]
+           "analyze", "thread_stacks", "summarize_rows"]
 
 _log = output.get_stream("doctor")
+
+register_var("doctor", "rows_per_daemon", VarType.INT, 8,
+             "full per-rank capture rows each orted sends up per "
+             "TAG_DOCTOR round.  Beyond the budget the daemon "
+             "pre-aggregates: non-responders, errored ops and the "
+             "op_seq extremes (the divergence evidence the analyzer "
+             "needs) keep full rows; the healthy middle collapses into "
+             "one explicitly-truncated summary row — a 1000-rank "
+             "/doctor document stays O(hosts) at the HNP.  0 = "
+             "unbounded (every rank a full row)")
 
 register_var("coll", "doctor_enable", VarType.BOOL, True,
              "arm the per-rank hang-doctor responder at init(): a UDP "
@@ -235,7 +245,13 @@ class DoctorResponder:
         if len(blob) <= _MAX_REPLY:
             return blob
         doc = dict(doc)
-        doc["collrec"] = (doc.get("collrec") or [])[-64:]
+        full = doc.get("collrec") or []
+        doc["collrec"] = full[-64:]
+        if len(full) > 64:
+            # explicit truncation at EVERY shrink stage: a clipped tail
+            # must say so (and how much fell off), never silently pose
+            # as the whole recorder history
+            doc["collrec_truncated"] = len(full) - 64
         blob = dss.pack(("cap", token, doc))
         if len(blob) <= _MAX_REPLY:
             return blob
@@ -394,6 +410,77 @@ def proc_probe(pid: int) -> dict:
         except OSError:
             continue
     return out
+
+
+def summarize_rows(rows: list[dict],
+                   limit: int) -> tuple[list[dict], Optional[dict]]:
+    """Hierarchical doctor pre-aggregation, the orted half: bound one
+    daemon's TAG_DOCTOR_REPLY to ``limit`` full per-rank rows plus ONE
+    summary row for everyone else — so a fleet-wide capture costs the
+    HNP O(hosts · limit), not O(ranks).
+
+    Which rows keep full fidelity is chosen for the analyzer's benefit:
+    non-responders and errored ops always (they decide deadlock /
+    straggler verdicts), then the op_seq extremes of the rest (the
+    slowest and fastest ranks ARE the divergence evidence a mismatch /
+    straggler verdict needs; the agreeing middle of the distribution is
+    what compresses).  The summary row is explicitly marked
+    (``summary``/``truncated``) and carries the omitted ranks' aggregate
+    shape — count, current-op kind histogram, op_seq min/max, a bounded
+    rank sample — so the /doctor document SAYS what it dropped.
+
+    Returns ``(kept_rows, summary_row_or_None)``; a row set within the
+    budget (or ``limit <= 0`` = unbounded) passes through untouched."""
+    rows = list(rows)
+    if limit <= 0 or len(rows) <= limit:
+        return rows, None
+
+    def cur_of(c: dict) -> dict:
+        return c.get("cur") or _pushed_cur(c) or {}
+
+    def seq_of(c: dict) -> int:
+        try:
+            return int(cur_of(c).get("seq", -1))
+        except (TypeError, ValueError):
+            return -1
+
+    hot = [i for i, c in enumerate(rows)
+           if c.get("no_response") or cur_of(c).get("err")]
+    keep = set(hot[:limit])
+    room = limit - len(keep)
+    if room > 0:
+        cold = sorted((i for i in range(len(rows)) if i not in keep),
+                      key=lambda i: (seq_of(rows[i]), i))
+        n_head = (room + 1) // 2
+        keep.update(cold[:n_head])
+        keep.update(cold[max(n_head, len(cold) - (room - n_head)):])
+    kept = [rows[i] for i in sorted(keep)]
+    omitted = [rows[i] for i in range(len(rows)) if i not in keep]
+    kinds: Counter = Counter()
+    seqs: list[int] = []
+    stuck = 0
+    for c in omitted:
+        cur = cur_of(c)
+        if cur:
+            kinds[str(cur.get("kind", "?"))] += 1
+        s = seq_of(c)
+        if s >= 0:
+            seqs.append(s)
+        try:
+            stuck += int(bool(c.get("stuck")))
+        except (TypeError, ValueError):
+            pass
+    sample = sorted(int(c.get("rank", -1)) for c in omitted)[:32]
+    summary = {
+        "summary": True, "truncated": True,
+        "ranks_omitted": len(omitted),
+        "rank_sample": sample,
+        "cur_kinds": dict(kinds),
+        "op_seq_min": (min(seqs) if seqs else None),
+        "op_seq_max": (max(seqs) if seqs else None),
+        "stuck": stuck,
+    }
+    return kept, summary
 
 
 # ---------------------------------------------------------------------------

@@ -761,8 +761,14 @@ class DvmHnp(MultiHostLauncher):
                     "verdict": {"kind": "idle",
                                 "detail": "no job running and no "
                                           "cached verdict"}}
-        captures = [c for c in self._collect_doctor()
-                    if int(c.get("jobid", job.jobid)) == job.jobid]
+        rows = [c for c in self._collect_doctor()
+                if int(c.get("jobid", job.jobid)) == job.jobid]
+        # hierarchical capture: daemons over their doctor_rows_per_daemon
+        # budget pre-aggregate the healthy middle into explicit summary
+        # rows — split those out (the analyzer wants per-rank rows; the
+        # document still reports what was compressed and says truncated)
+        captures = [c for c in rows if not c.get("summary")]
+        summaries = [c for c in rows if c.get("summary")]
         # a frozen rank's last uplink-pushed recorder head stands in for
         # the capture it can no longer give
         pushed = self.metrics_agg.rank_values(job.jobid, self._CUR_NAMES)
@@ -770,6 +776,11 @@ class DvmHnp(MultiHostLauncher):
             if c.get("no_response") and int(c.get("rank", -1)) in pushed:
                 c["pushed"] = pushed[int(c["rank"])]
         doc = doctor.analyze(captures, nranks=job.np)
+        if summaries:
+            doc["truncated"] = True
+            doc["ranks_summarized"] = sum(
+                int(s.get("ranks_omitted", 0)) for s in summaries)
+            doc["host_summaries"] = summaries
         doc["trigger"] = trigger
         doc["jobid"] = job.jobid
         doc["ts"] = time.time()
@@ -1320,6 +1331,12 @@ class DvmHnp(MultiHostLauncher):
             f"{time.time() - self._started_at:.1f}",
             "# TYPE ompi_tpu_dvm_ft_events_total counter",
             f"ompi_tpu_dvm_ft_events_total {ftevents.log.total()}",
+            "# TYPE ompi_tpu_dvm_metrics_sheds_total counter",
+            f"ompi_tpu_dvm_metrics_sheds_total "
+            f"{getattr(self.metrics_agg, 'sheds_total', 0)}",
+            "# TYPE ompi_tpu_dvm_metrics_shed_rows_total counter",
+            f"ompi_tpu_dvm_metrics_shed_rows_total "
+            f"{getattr(self.metrics_agg, 'shed_rows_total', 0)}",
         ]
         return agg_text + "\n".join(dvm_lines) + "\n" + own
 
@@ -1330,7 +1347,12 @@ class DvmHnp(MultiHostLauncher):
         stats = getattr(self.metrics_agg, "stats", lambda: {})()
         doc: dict = {"hnp_merges_total": stats.get("merges_total", 0),
                      "hnp_merge_ms_total": round(
-                         stats.get("merge_ns_total", 0) / 1e6, 2)}
+                         stats.get("merge_ns_total", 0) / 1e6, 2),
+                     # the shed-and-count fan-in policy's ledger: how
+                     # many payloads (and rank-rows) overload cost
+                     "hnp_sheds_total": stats.get("sheds_total", 0),
+                     "hnp_shed_rows_total": stats.get(
+                         "shed_rows_total", 0)}
         # rank-side push cost, summed from the pushed self-metering
         # counters (the ranks meter their own uplink datagrams)
         dgrams = nbytes = 0.0
@@ -1409,6 +1431,7 @@ class DvmHnp(MultiHostLauncher):
             "remediations_total": remediations,
             "jobs": jobs,
             "ft_events_total": ftevents.log.total(),
+            "ft_events_dropped": ftevents.log.dropped(),
             "uplink": self._uplink_stats(),
         }
 

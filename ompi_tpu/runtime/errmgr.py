@@ -189,6 +189,38 @@ def _propagate_failure(launcher, job: Job, proc: Proc,
                        "failed: %r", e)
 
 
+def _rank_span(ranks: list, head: int = 16) -> str:
+    """A bounded textual rank list for batch events — a 160-rank rack
+    loss must not inline 160 numbers into every log/notifier line."""
+    ranks = sorted(int(r) for r in ranks)
+    if len(ranks) <= head:
+        return ",".join(map(str, ranks))
+    return (",".join(map(str, ranks[:head]))
+            + f",...(+{len(ranks) - head} more)")
+
+
+def _propagate_failure_batch(launcher, job: Job, procs: list,
+                             reason: str) -> None:
+    """The batched twin of :func:`_propagate_failure` for correlated
+    daemon loss: a rack death takes tens of ranks in one tick, and
+    per-rank propagation turns that into N full-tree xcasts — its own
+    control-plane storm.  The dead-set is already updated per rank (the
+    PLM's _fail_daemon_ranks called ``proc_died`` before any policy
+    ran), so ONE xcast carrying the whole rank batch suffices; the
+    orted handler accepts a rank list in the rank slot."""
+    node = getattr(launcher, "rml", None)
+    if node is None or not procs:
+        return
+    from ompi_tpu.runtime import rml as rml_mod
+
+    try:
+        node.xcast(rml_mod.TAG_PROC_FAILED,
+                   ([p.rank for p in procs], reason))
+    except Exception as e:  # noqa: BLE001 — tree may be tearing down
+        _log.error("failure propagation: batched TAG_PROC_FAILED xcast "
+                   "failed: %r", e)
+
+
 #: test seam: the backoff sleep (patched by unit tests).  The sleep runs
 #: INSIDE proc_failed — on the local launcher's reap loop, or the
 #: daemon link's RML reader thread — deliberately: deferring the revive
@@ -403,6 +435,31 @@ class ErrmgrNotify(Component):
                f"job {job.jobid} {reason}; survivors notified "
                f"(job continues)")
 
+    def daemon_ranks_failed(self, launcher: "LocalLauncher", job: Job,
+                            procs: list) -> None:
+        """Correlated daemon loss, batched: ONE xcast / FT event /
+        notifier event for the whole rack's worth of ranks — per-rank
+        propagation would turn a 16-daemon loss into hundreds of
+        full-tree control frames, a reparent-window storm of our own
+        making.  The per-rank dead-set entries are already in place
+        (the PLM recorded them before any policy ran)."""
+        if not procs:
+            return
+        from ompi_tpu.runtime import ftevents
+        from ompi_tpu.runtime.notifier import Severity, notify
+
+        ranks = [p.rank for p in procs]
+        reason = (f"{len(ranks)} rank(s) lost with their daemon(s): "
+                  f"{_rank_span(ranks)}")
+        _log.verbose(1, "notify policy: %s; propagating to survivors "
+                     "(batched)", reason)
+        ftevents.record("detect", jobid=job.jobid, rank=ranks[0],
+                        rung="notify", reason=reason, count=len(ranks))
+        _propagate_failure_batch(launcher, job, procs, reason)
+        notify(Severity.WARN, "rank-failed",
+               f"job {job.jobid} {reason}; survivors notified "
+               f"(job continues)")
+
 
 @errmgr_framework.component
 class ErrmgrSelfheal(Component):
@@ -471,6 +528,56 @@ class ErrmgrSelfheal(Component):
             return
         self._escalate(launcher, job, proc,
                        f"rank {proc.rank} revive failed to start")
+
+    def daemon_ranks_failed(self, launcher: "LocalLauncher", job: Job,
+                            procs: list) -> None:
+        """Correlated daemon loss, batched.  Every victim is unrevivable
+        (its daemon died with its host), so the whole batch takes the
+        escalate-to-shrink rung in ONE decision: one propagation xcast,
+        one FT event, one notifier event — not a per-rank storm of
+        escalations during the exact window the tree is re-wiring."""
+        if not procs:
+            return
+        from ompi_tpu.mpi import trace as trace_mod
+        from ompi_tpu.runtime import ftevents
+        from ompi_tpu.runtime.notifier import Severity, notify
+
+        ranks = [p.rank for p in procs]
+        reason = (f"{len(ranks)} rank(s) lost with their daemon(s): "
+                  f"{_rank_span(ranks)}")
+        ftevents.record("detect", jobid=job.jobid, rank=ranks[0],
+                        rung="selfheal", reason=reason, count=len(ranks))
+        _propagate_failure_batch(launcher, job, procs, reason)
+        trace_mod.count("errmgr_selfheal_escalations_total")
+        # victims are already ABORTED, so the carrier scan naturally
+        # excludes the whole batch
+        carriers = [p for p in job.procs if p.state
+                    in (ProcState.RUNNING, ProcState.TERMINATED)]
+        can_shrink = (bool(carriers)
+                      and (getattr(job, "pmix_server", None)
+                           or getattr(launcher, "server", None))
+                      is not None)
+        why = (f"{len(ranks)} rank(s) are not revivable (their daemon "
+               f"died with its host)")
+        ftevents.record("escalate", jobid=job.jobid, rank=ranks[0],
+                        to="shrink" if can_shrink else "abort", why=why,
+                        count=len(ranks))
+        if trace_mod.active:
+            trace_mod.instant("errmgr", "selfheal_escalate", rank=-1,
+                              to="shrink" if can_shrink else "abort",
+                              count=len(ranks))
+        if can_shrink:
+            notify(Severity.ERROR, "selfheal-escalate",
+                   f"job {job.jobid}: {why}; degrading to shrink — "
+                   f"survivors continue without ranks {_rank_span(ranks)}")
+            return
+        notify(Severity.CRITICAL, "selfheal-escalate",
+               f"job {job.jobid}: {why} and no shrinkable survivors; "
+               f"aborting")
+        if job.aborted_proc is None:
+            job.aborted_proc = procs[0]
+            job.abort_reason = f"{reason}; selfheal ladder exhausted"
+        launcher.kill_job(job, exclude=procs[0])
 
     def _escalate(self, launcher, job: Job, proc: Proc, why: str) -> None:
         """The revive arm is out — degrade to the notify/shrink rung (the

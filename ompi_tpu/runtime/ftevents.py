@@ -35,9 +35,12 @@ __all__ = ["FtEventLog", "log", "record", "KINDS"]
 #: watchdog verdict (SIGCONT probe / reap-and-revive / kill+requeue /
 #: budget-exhausted reject); ``requeue`` = a remediated job went back on
 #: the admission queue for a fresh placement
+#: ``truncated`` = a synthetic marker the ring PREPENDS to snapshots
+#: once capacity eviction has discarded events — truncation is explicit,
+#: never silent (the marker's info.dropped counts the forgotten events)
 KINDS = ("detect", "reap", "revive", "shrink", "escalate", "abort",
          "daemon_lost", "reparent", "finished", "stuck", "doctor",
-         "coll_rejoin", "remediate", "requeue")
+         "coll_rejoin", "remediate", "requeue", "truncated")
 
 
 class FtEventLog:
@@ -48,6 +51,7 @@ class FtEventLog:
         self._events: collections.deque = collections.deque(
             maxlen=max(16, capacity))
         self._n = 0
+        self._dropped = 0   # events the ring evicted (capacity)
 
     def record(self, kind: str, jobid: int = 0, rank: int = -1,
                lives: int = 0, **info: Any) -> dict:
@@ -69,6 +73,8 @@ class FtEventLog:
         with self._lock:
             self._n += 1
             ev["seq"] = self._n
+            if len(self._events) == self._events.maxlen:
+                self._dropped += 1   # the append below evicts the oldest
             self._events.append(ev)
         from ompi_tpu.mpi import trace as trace_mod
 
@@ -82,22 +88,41 @@ class FtEventLog:
         """Events oldest-first, optionally filtered to one job (events
         recorded with jobid 0 — pre-job containment noise — ride along
         with every job filter: a daemon loss belongs to any timeline
-        that overlaps it)."""
+        that overlaps it).  Once capacity eviction has forgotten events,
+        every snapshot leads with an explicit ``truncated`` marker
+        (jobid 0, so it survives any job filter) naming how many — a
+        reader must never mistake a clipped timeline for a complete
+        one."""
         with self._lock:
             events = list(self._events)
-        if jobid is None:
-            return events
-        return [e for e in events
-                if e["jobid"] == int(jobid) or e["jobid"] == 0]
+            dropped = self._dropped
+        if jobid is not None:
+            events = [e for e in events
+                      if e["jobid"] == int(jobid) or e["jobid"] == 0]
+        if dropped:
+            events.insert(0, {
+                "seq": 0, "wall": 0.0, "mono_ns": 0,
+                "kind": "truncated", "jobid": 0, "rank": -1, "lives": 0,
+                "info": {"dropped": dropped,
+                         "detail": f"ring evicted {dropped} older "
+                                   f"event(s); timeline is a tail"}})
+        return events
 
     def total(self) -> int:
         """Events ever recorded (including those the ring forgot)."""
         with self._lock:
             return self._n
 
+    def dropped(self) -> int:
+        """Events the bounded ring evicted (0 = the snapshot is the
+        complete history)."""
+        with self._lock:
+            return self._dropped
+
     def clear(self) -> None:
         with self._lock:
             self._events.clear()
+            self._dropped = 0
 
 
 #: process-global log (the launcher/HNP is one process; tests may make

@@ -42,12 +42,23 @@ import time
 from typing import Any, Callable, Optional
 
 from ompi_tpu.core import dss, output
+from ompi_tpu.core.config import VarType, register_var, var_registry
 
 __all__ = ["merge_hop", "MetricsCollector", "MetricsAggregate",
            "AGG_METRICS", "AGG_HISTS", "vec_merge", "hist_counts",
            "straggler_panel"]
 
 _log = output.get_stream("metrics")
+
+register_var("metrics", "agg_budget_rows", VarType.INT, 200000,
+             "HNP metrics fan-in budget: rank-rows the terminal "
+             "aggregate accepts per second (token bucket, 1s burst). "
+             "Payloads beyond the budget are SHED WHOLE and counted "
+             "(sheds_total / shed_rows_total in /status uplink stats) "
+             "instead of queueing unboundedly when every daemon pushes "
+             "a full snapshot at once — counters are cumulative and "
+             "vector deltas heal on the next full push, so a shed "
+             "costs staleness, never corruption.  0 = unlimited")
 
 #: the per-job aggregated-metric name family: counters the DVM scrape
 #: endpoint ADDITIONALLY exports summed across a job's ranks as
@@ -425,6 +436,21 @@ class MetricsAggregate:
         # and how often the stream arrives (ROADMAP item 6's numbers)
         self.merges_total = 0
         self.merge_ns_total = 0
+        #: the explicit shed-and-count policy: payloads refused by the
+        #: fan-in budget (metrics_agg_budget_rows), and the rank-rows
+        #: they carried — "how much telemetry did overload cost" is
+        #: itself telemetry
+        self.sheds_total = 0
+        self.shed_rows_total = 0
+        # None = bucket not yet primed; the first budgeted merge starts
+        # with the FULL burst, so boot-time pushes are never shed by an
+        # accident of how soon after construction they arrive
+        self._budget_tokens: Optional[float] = None
+        self._budget_ts = time.monotonic()
+        #: jobid → last-merge monotonic ts — the incremental eviction
+        #: index (age eviction picks min() here instead of re-scanning
+        #: every job's every rank row on each overflow)
+        self._job_ts: dict[int, float] = {}
         #: straggler baselines: jobid → (monotonic ts, signal, {rank:
         #: (wait, publish)}); rotated once older than the panel window,
         #: discarded on a signal flip (sums from different histograms
@@ -434,23 +460,52 @@ class MetricsAggregate:
                                                           float]]]] = {}
 
     def merge(self, payload: Any) -> None:
-        """Fold one TAG_METRICS payload in (RML reader thread safe)."""
+        """Fold one TAG_METRICS payload in (RML reader thread safe).
+
+        Admission first: the token bucket (``metrics_agg_budget_rows``
+        rank-rows/s, one-second burst) is the uplink-overload valve.
+        When every daemon pushes a full snapshot at once the excess
+        payloads are dropped WHOLE and counted — bounded merge cost and
+        an honest ``sheds_total``, never an unbounded queue.  Rows are
+        counted before the lock; a shed costs O(payload keys)."""
+        try:
+            rows = sum(len(ranks) for ranks in payload.values()
+                       if isinstance(ranks, dict))
+        except AttributeError:
+            rows = 1   # malformed payload: let merge_hop reject it
         t0 = time.monotonic_ns()
         with self._lock:
+            rate = float(var_registry.get("metrics_agg_budget_rows") or 0)
+            if rate > 0:
+                now = time.monotonic()
+                if self._budget_tokens is None:
+                    self._budget_tokens = rate
+                else:
+                    self._budget_tokens = min(
+                        rate, self._budget_tokens
+                        + (now - self._budget_ts) * rate)
+                self._budget_ts = now
+                if rows > self._budget_tokens:
+                    self.sheds_total += 1
+                    self.shed_rows_total += rows
+                    return
+                self._budget_tokens -= rows
             merge_hop(self._jobs, payload)
+            now_ts = time.monotonic()
+            for jobid in payload:
+                self._job_ts[jobid] = now_ts
             self.merges_total += 1
             self.merge_ns_total += time.monotonic_ns() - t0
-            if len(self._jobs) > self._max_jobs:
-                by_age = sorted(
-                    self._jobs,
-                    key=lambda j: max((r[0] for r in
-                                       self._jobs[j].values()),
-                                      default=0.0))
-                for jobid in by_age[:len(self._jobs) - self._max_jobs]:
-                    del self._jobs[jobid]
-                    # evicted jobs take their straggler baseline along
-                    # (a long-lived DVM must not leak one per dead job)
-                    self._strag_base.pop(jobid, None)
+            while len(self._jobs) > self._max_jobs:
+                # incremental age eviction: min() over the per-job
+                # last-merge index — O(jobs), not O(total rank rows)
+                oldest = min(self._jobs,
+                             key=lambda j: self._job_ts.get(j, 0.0))
+                del self._jobs[oldest]
+                # evicted jobs take their straggler baseline along
+                # (a long-lived DVM must not leak one per dead job)
+                self._strag_base.pop(oldest, None)
+                self._job_ts.pop(oldest, None)
 
     def prune_job(self, jobid: int) -> None:
         """Drop one job's per-rank counter tables and straggler baseline
@@ -463,12 +518,15 @@ class MetricsAggregate:
         with self._lock:
             self._jobs.pop(int(jobid), None)
             self._strag_base.pop(int(jobid), None)
+            self._job_ts.pop(int(jobid), None)
 
     def stats(self) -> dict:
         """Terminal-stage self-metrics for /status."""
         with self._lock:
             return {"merges_total": self.merges_total,
-                    "merge_ns_total": self.merge_ns_total}
+                    "merge_ns_total": self.merge_ns_total,
+                    "sheds_total": self.sheds_total,
+                    "shed_rows_total": self.shed_rows_total}
 
     def snapshot(self) -> HopPayload:
         with self._lock:

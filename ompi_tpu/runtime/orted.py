@@ -189,10 +189,13 @@ class Orted:
     def _on_proc_failed(self, origin: int, payload) -> None:
         """errmgr notify propagation: a rank somewhere in the job died and
         the job is continuing — log it so every host's record shows which
-        peer vanished (app ranks learn through the PMIx dead-set)."""
+        peer vanished (app ranks learn through the PMIx dead-set).  The
+        rank slot carries a LIST for a batched correlated-daemon-loss
+        propagation (one xcast for a whole rack's worth of ranks)."""
         rank, reason = payload
-        _log.verbose(1, "orted %d: peer rank %d failed (%s); job continues",
-                     self.vpid, rank, reason)
+        ranks = list(rank) if isinstance(rank, (list, tuple)) else [rank]
+        _log.verbose(1, "orted %d: peer rank(s) %s failed (%s); job "
+                     "continues", self.vpid, ranks, reason)
 
     # -- tree wiring -------------------------------------------------------
 
@@ -587,6 +590,7 @@ class Orted:
             uri = ((spec or {}).get("env") or {}).get(pmix.ENV_URI)
             if uri and procs:
                 ports = pmix.query_doctor_ports(uri) or {}
+            job_rows = []
             for rank, p in sorted(procs):
                 cap = None
                 port = ports.get(rank)
@@ -597,7 +601,19 @@ class Orted:
                            "proc": doctor.proc_probe(p.pid)}
                 cap["pid"] = p.pid
                 cap["jobid"] = jobid
-                rows.append(cap)
+                job_rows.append(cap)
+            # hierarchical pre-aggregation: bound this daemon's reply to
+            # doctor_rows_per_daemon full rows + one explicit summary
+            # row per job, so the HNP's fan-in is O(hosts), not O(ranks)
+            from ompi_tpu.core.config import var_registry
+
+            limit = int(var_registry.get("doctor_rows_per_daemon") or 0)
+            kept, summary = doctor.summarize_rows(job_rows, limit)
+            if summary is not None:
+                summary["jobid"] = jobid
+                summary["vpid"] = self.vpid
+                kept.append(summary)
+            rows.extend(kept)
         try:
             self.node.send_up(rml.TAG_DOCTOR_REPLY,
                               (self.vpid, epoch, rows))

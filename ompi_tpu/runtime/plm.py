@@ -59,6 +59,12 @@ register_var("plm", "exit_report_timeout", VarType.DOUBLE, 3.0,
              "seconds to wait for straggler rank-exit reports during "
              "teardown (VM stop mid-job, daemon loss) before accounting "
              "the job without them")
+register_var("plm", "loss_epoch_window", VarType.DOUBLE, 0.25,
+             "seconds the HNP's reparent worker waits after a daemon "
+             "death for more deaths to join the same loss epoch — a "
+             "correlated rack loss collapses into ONE batched adoption "
+             "round (O(orphans) frames) instead of a per-dead-vpid "
+             "storm (0 = handle each death immediately)")
 register_var("plm", "daemon_drain_timeout", VarType.DOUBLE, 5.0,
              "seconds the VM teardown waits for orted daemons to exit "
              "after the SHUTDOWN xcast before killing them")
@@ -158,6 +164,30 @@ class MultiHostLauncher:
         # idempotence guard AND the ancestry map re-parenting skips over
         self._np_hint = 1 << 30                            # set at launch
         self._cur_job: Optional[Job] = None
+        self._n_daemons = 0        # world size minus the HNP, set at _vm_up
+        # the EFFECTIVE routing tree: vpid → current parent, seeded from
+        # the static tree at wire time and rewritten by every adoption.
+        # Loss epochs compute orphanhood against THIS map (not the static
+        # tree), so a dead adopter's previously adopted children are
+        # re-orphaned and re-homed — never left holding a child-link to
+        # a corpse — and an already-re-homed orphan is never adopted twice
+        self._eff_parent: dict[int, int] = {}
+        # loss-epoch queue: detectors (link EOF on reader threads, the
+        # heartbeat sweep, Popen polls, orphan reports) only ENQUEUE dead
+        # vpids; one worker thread coalesces deaths within
+        # plm_loss_epoch_window into a single batched adoption round.
+        # Serializing epochs through one worker is also the concurrency
+        # fix: overlapping subtree losses can no longer race two
+        # _reparent_orphans bodies into double adoptions
+        self._loss_cv = threading.Condition()
+        self._loss_q: list[int] = []
+        self._loss_worker: Optional[threading.Thread] = None
+        #: reparent-storm telemetry, asserted by the simfleet tests: one
+        #: epoch per correlated loss, frames bounded by
+        #: orphans + adopter groups (strictly O(orphans))
+        self.reparent_epochs_total = 0
+        self.reparent_orphans_total = 0
+        self.reparent_frames_total = 0
         # the standing allocation the daemon vpids index into (vpid =
         # pool index + 1) — job.nodes may be a gang-placed SUBSET of
         # these on a multi-tenant DVM, so vpid↔node lookups must never
@@ -201,6 +231,7 @@ class MultiHostLauncher:
         self._np_hint = job.np
         self._cur_job = job
         self._pool_nodes = list(job.nodes)
+        self._n_daemons = n_daemons
         self.rml = rml.RmlNode(0)
         self.rml.register_recv(rml.TAG_REGISTER, self._on_register)
         self.rml.register_recv(rml.TAG_DAEMON_READY, self._on_ready)
@@ -246,6 +277,9 @@ class MultiHostLauncher:
         # DAEMON_READY up the tree, so its up-link must exist (orted also
         # gates the reply on wait_parent — belt and suspenders).
         total = n_daemons + 1
+        with self._cv:
+            self._eff_parent = {v: (rml.tree_parent(v) or 0)
+                                for v in range(1, total)}
         uris = {0: self.rml.uri}
         uris.update({v: u for v, (u, _h) in self._registered.items()})
         self.rml.dial_children(
@@ -272,10 +306,18 @@ class MultiHostLauncher:
             self.kill_job(job)
             return False
         # daemons are wired: arm the liveness watchdog (no-op when
-        # rml_heartbeat_period is 0)
+        # rml_heartbeat_period is 0) with its timeout scaled to this
+        # world's tree depth — a 9-daemon timeout on a 1000-daemon world
+        # declares healthy-but-busy daemons dead during a reparent wave
+        self._hb_monitor.set_world(total)
         for vpid in self._registered:
             self._hb_monitor.watch(vpid)
         self._hb_monitor.start()
+        if reparent and self._loss_worker is None:
+            self._loss_worker = threading.Thread(
+                target=self._loss_epoch_worker, name="plm-loss-epoch",
+                daemon=True)
+            self._loss_worker.start()
         return True
 
     def _node_vpid(self, node) -> int:
@@ -391,6 +433,8 @@ class MultiHostLauncher:
         with self._cv:
             self._vm_stop.set()
             self._cv.notify_all()   # wake a _wait_ranks blocked mid-job
+        with self._loss_cv:
+            self._loss_cv.notify_all()  # release the loss-epoch worker
         if self._hb_monitor is not None:
             self._hb_monitor.stop()
         self.rml.xcast(rml.TAG_SHUTDOWN, None)
@@ -548,8 +592,15 @@ class MultiHostLauncher:
                         vpid=vpid, contained=bool(reparent))
         if reparent:
             # confine the loss: the dead daemon's live children re-wire
-            # to their grandparent instead of applying the lifeline rule
-            self._reparent_orphans(vpid)
+            # to their grandparent instead of applying the lifeline rule.
+            # Survivors are busy re-wiring for the next stretch — hold
+            # heartbeat declarations so the wave itself cannot cascade
+            # into false daemon deaths
+            if self._hb_monitor is not None:
+                window = float(
+                    var_registry.get("plm_loss_epoch_window") or 0)
+                self._hb_monitor.grace(1.0 + 2 * window)
+            self._enqueue_loss(vpid)
             return
         from ompi_tpu.runtime.notifier import Severity, notify
 
@@ -566,23 +617,89 @@ class MultiHostLauncher:
                      lost_parent)
         self._on_daemon_lost(int(lost_parent))
 
+    def _enqueue_loss(self, vpid: int) -> None:
+        """Hand a detected death to the loss-epoch worker (or, when no
+        worker runs — direct unit-test drives of _on_daemon_lost — run a
+        one-death epoch inline)."""
+        if self._loss_worker is None:
+            self._reparent_epoch({int(vpid)})
+            return
+        with self._loss_cv:
+            self._loss_q.append(int(vpid))
+            self._loss_cv.notify_all()
+
+    def _loss_epoch_worker(self) -> None:
+        """The single thread every adoption round runs on.  Detectors
+        enqueue; this worker sleeps ``plm_loss_epoch_window`` after the
+        first death of a round so a correlated loss (a rack dying in one
+        tick, detected by N racing link EOFs / heartbeat expiries /
+        orphan reports) collapses into ONE batched epoch.  The window is
+        measured from the first death and is NOT extended by later ones
+        — epoch latency stays bounded under a trickling failure."""
+        while not self._vm_stop.is_set():
+            with self._loss_cv:
+                while not self._loss_q and not self._vm_stop.is_set():
+                    self._loss_cv.wait(0.5)
+                if self._vm_stop.is_set():
+                    return
+            window = float(var_registry.get("plm_loss_epoch_window") or 0)
+            deadline = time.monotonic() + window
+            with self._loss_cv:
+                while True:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or self._vm_stop.is_set():
+                        break
+                    self._loss_cv.wait(remaining)
+                batch = set(self._loss_q)
+                self._loss_q.clear()
+            if batch and not self._vm_stop.is_set():
+                try:
+                    self._reparent_epoch(batch)
+                except Exception as e:  # noqa: BLE001 — worker survives
+                    _log.error("reparent epoch for %s failed: %r",
+                               sorted(batch), e)
+
     def _reparent_orphans(self, dead_vpid: int) -> None:
-        """Arbitrate the re-wiring for ``dead_vpid``'s live tree
-        children: each orphan is told the adopter (TAG_REPARENT, direct),
-        the adopter is told to dial them (TAG_ADOPT, direct — parents
-        always dial).  Deeper descendants keep their live links; only the
-        severed edge is rebuilt."""
-        total = len(self._daemon_popen) + 1
+        """Compat shim: a single-death adoption round."""
+        self._reparent_epoch({int(dead_vpid)})
+
+    def _reparent_epoch(self, new_dead: set[int]) -> None:
+        """One batched adoption round for a loss epoch: every live
+        daemon whose EFFECTIVE parent is now dead gets exactly one
+        TAG_REPARENT naming its new parent (the nearest live ancestor
+        along the effective tree), and each adopter gets ONE TAG_ADOPT
+        listing all its new children — total frames = orphans + adopter
+        groups, O(orphans) regardless of how many daemons died at once.
+        Deeper descendants keep their live links; only severed edges are
+        rebuilt.  Orphanhood is computed against the effective-parent
+        map (updated here on every adoption), so a dead ADOPTER's
+        previously adopted children are re-homed and nobody is adopted
+        twice — all epochs run serialized on the loss worker."""
         with self._cv:
-            dead = set(self._dead_daemons)
+            dead = set(self._dead_daemons) | set(new_dead)
             registered = dict(self._registered)
-        orphans = [c for c in rml.tree_children(dead_vpid, total)
-                   if c not in dead and c in registered]
+            eff = dict(self._eff_parent)
+        orphans = sorted(v for v, p in eff.items()
+                         if p in dead and v not in dead
+                         and v in registered)
         if not orphans:
             return
-        adopter = rml.nearest_live_ancestor(dead_vpid, dead)
-        adoptees = []
+        if self._hb_monitor is not None:
+            # survivors re-wire now: no dead-declarations mid-round
+            self._hb_monitor.grace(2.0)
+
+        def live_ancestor(v: int) -> int:
+            p = eff.get(v, 0)
+            for _hop in range(len(eff) + 1):   # cycle-proof bound
+                if p == 0 or p not in dead:
+                    return p
+                p = eff.get(p, 0)
+            return 0
+
+        by_adopter: dict[int, list[tuple[int, str]]] = {}
+        frames = 0
         for o in orphans:
+            adopter = live_ancestor(o)
             boot = self.rml.boot_links.get(o)
             if boot is None:
                 continue
@@ -591,39 +708,57 @@ class MultiHostLauncher:
             except OSError as e:
                 _log.error("reparent order to orted %d failed: %r", o, e)
                 continue
-            adoptees.append((o, registered[o][0]))
-        if not adoptees:
+            frames += 1
+            by_adopter.setdefault(adopter, []).append(
+                (o, registered[o][0]))
+        if not by_adopter:
             return
-        _log.verbose(0, "re-parenting orteds %s under %d (vpid %d died)",
-                     [v for v, _u in adoptees], adopter, dead_vpid)
+        placed: dict[int, int] = {}   # orphan → adopter, orders sent
+        for adopter, adoptees in sorted(by_adopter.items()):
+            try:
+                if adopter == 0:
+                    self.rml.dial_children(adoptees)
+                else:
+                    aboot = self.rml.boot_links.get(adopter)
+                    if aboot is None:
+                        continue
+                    self.rml.send_direct(aboot, rml.TAG_ADOPT, adoptees)
+                    frames += 1
+            except OSError as e:
+                _log.error("adoption order under %d failed: %r",
+                           adopter, e)
+                continue
+            for o, _u in adoptees:
+                placed[o] = adopter
+        with self._cv:
+            self._eff_parent.update(placed)
+        self.reparent_epochs_total += 1
+        self.reparent_orphans_total += len(placed)
+        self.reparent_frames_total += frames
+        ordered = sorted(placed)
+        adopters = sorted(by_adopter)
+        _log.verbose(0, "re-parenting orteds %s under %s (epoch: vpids "
+                     "%s died)", ordered, adopters, sorted(new_dead))
         from ompi_tpu.mpi import trace as trace_mod
 
         if trace_mod.active:
             trace_mod.instant("errmgr", "reparent", rank=-1,
-                              dead_vpid=dead_vpid, adopter=adopter,
-                              orphans=[v for v, _u in adoptees])
-        try:
-            if adopter == 0:
-                self.rml.dial_children(adoptees)
-            else:
-                aboot = self.rml.boot_links.get(adopter)
-                if aboot is not None:
-                    self.rml.send_direct(aboot, rml.TAG_ADOPT, adoptees)
-        except OSError as e:
-            _log.error("adoption order under %d failed: %r", adopter, e)
-            return
+                              dead_vpid=min(new_dead),
+                              dead=sorted(new_dead),
+                              adopter=adopters[0], orphans=ordered)
         from ompi_tpu.runtime import ftevents
         from ompi_tpu.runtime.notifier import Severity, notify
 
         ftevents.record(
             "reparent",
             jobid=(self._cur_job.jobid if self._cur_job else 0),
-            vpid=dead_vpid, adopter=adopter,
-            orphans=[v for v, _u in adoptees])
+            vpid=min(new_dead), dead=sorted(new_dead),
+            adopter=adopters[0], adopters=adopters,
+            orphans=ordered, frames=frames)
         notify(Severity.WARN, "daemon-reparent",
-               f"orted vpid {dead_vpid} died mid-tree; orphans "
-               f"{[v for v, _u in adoptees]} re-parented under vpid "
-               f"{adopter} (loss confined to the dead host)")
+               f"orted vpid(s) {sorted(new_dead)} died mid-tree; orphans "
+               f"{ordered} re-parented under vpid(s) {adopters} in one "
+               f"batched round ({frames} frames; loss confined)")
 
     def _on_reparent_ack(self, origin: int, payload) -> None:
         vpid, new_parent = payload
@@ -675,9 +810,17 @@ class MultiHostLauncher:
         # notify's and selfheal's daemon-lost arms are non-blocking (an
         # xcast + a log line, no revive attempt) and take no plm locks,
         # so running them with self._cv held is safe — and the synthetic
-        # exits above are already visible
-        for proc in victims:
-            self._errmgr.proc_failed(self, job, proc)
+        # exits above are already visible.  Policies exposing the batched
+        # arm get the whole victim set in ONE call (one propagation
+        # xcast per dead daemon instead of one per dead rank)
+        if not victims:
+            return
+        batch = getattr(self._errmgr, "daemon_ranks_failed", None)
+        if batch is not None:
+            batch(self, job, victims)
+        else:
+            for proc in victims:
+                self._errmgr.proc_failed(self, job, proc)
 
     def _daemon_monitor(self, job: Job) -> None:
         """Poll orted Popen handles: a dead daemon before job end = abort

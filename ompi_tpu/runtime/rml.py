@@ -30,17 +30,20 @@ TcpBTL keeps a per-socket lock).
 
 from __future__ import annotations
 
+import heapq
 import socket
 import struct
 import sys
 import threading
+import time
 from typing import Any, Callable, Optional
 
 from ompi_tpu.core import dss, output
 from ompi_tpu.core.config import VarType, register_var, var_registry
 
 __all__ = ["RmlNode", "tree_children", "tree_parent",
-           "nearest_live_ancestor", "HeartbeatMonitor", "start_heartbeats"]
+           "nearest_live_ancestor", "HeartbeatMonitor", "start_heartbeats",
+           "scaled_timeout"]
 
 _log = output.get_stream("rml")
 
@@ -152,10 +155,16 @@ def _pack_env(kind: str, tag: str, origin: int, payload: Any) -> bytes:
     # when something else already loaded it — a bare daemon's OOB sends
     # must not drag jax/numpy into the orted process
     trace = sys.modules.get("ompi_tpu.mpi.trace")
-    if trace is not None and trace.active:
+    if trace is not None:
+        # the attribute reads live INSIDE the guard: sys.modules holds a
+        # partially-initialized module while another thread runs its
+        # first import, and an AttributeError here must degrade to an
+        # unstamped envelope — not kill the send (an orphan report lost
+        # to a tracing race once stalled a whole reparent epoch)
         try:
-            tc = [trace.trace_id(), trace.next_span_id()]
-            trace.instant("runtime", "rml_send", tag=tag, tc=tc)
+            if trace.active:
+                tc = [trace.trace_id(), trace.next_span_id()]
+                trace.instant("runtime", "rml_send", tag=tag, tc=tc)
         except Exception:  # noqa: BLE001 — tracing never breaks the OOB plane
             tc = None
     if tc is None:
@@ -167,9 +176,11 @@ def _note_recv(tag: str, tc: Any) -> None:
     """The receive half of the envelope trace pair (no-op unless this
     process has the flight recorder armed)."""
     trace = sys.modules.get("ompi_tpu.mpi.trace")
-    if trace is not None and trace.active:
-        try:
-            trace.instant("runtime", "rml_recv", tag=tag, tc=list(tc))
+    if trace is not None:
+        try:  # see _pack_env on the partial-import hazard
+            if trace.active:
+                trace.instant("runtime", "rml_recv", tag=tag,
+                              tc=list(tc))
         except Exception:  # noqa: BLE001
             pass
 
@@ -198,6 +209,26 @@ def nearest_live_ancestor(vpid: int, dead: set[int]) -> int:
     return 0 if p is None else p
 
 
+#: routing-tree depth at which timeout scaling kicks in — depth 4 covers
+#: a 31-node world, so every historical small-world test keeps its exact
+#: configured timeout (factor 1.0) while a 100-daemon world gets 1.5x
+#: and a 1000-daemon world 2.25x
+_SCALE_BASE_DEPTH = 4
+
+
+def scaled_timeout(base: float, world: int) -> float:
+    """A liveness window scaled with world size: beats and reparent acks
+    cross ``tree_depth`` store-and-forward hops, and a correlated loss
+    makes every survivor re-wire at once — a timeout tuned on a 9-rank
+    world declares half a 1000-rank fleet dead during one reparent wave.
+    Scale is the routing-tree depth relative to :data:`_SCALE_BASE_DEPTH`
+    (never below 1.0, so small worlds keep their configured window)."""
+    from ompi_tpu.core.netpatterns import tree_depth
+
+    depth = tree_depth(max(1, int(world)), k=2)
+    return float(base) * max(1.0, depth / _SCALE_BASE_DEPTH)
+
+
 class _Link:
     """One framed TCP link with a serialized writer side."""
 
@@ -213,6 +244,17 @@ class _Link:
             self.sock.sendall(frame)
 
     def close(self) -> None:
+        # shutdown() before close(): a close() alone does NOT tear the
+        # connection down while this node's own reader is blocked in
+        # recv on the fd (the in-flight syscall pins the file, so the
+        # FIN is deferred until it returns — which is never, since the
+        # peer is waiting on us).  A process death releases every ref at
+        # once, but an in-process daemon (simfleet) or any multi-link
+        # teardown needs the explicit half-close to wake both sides
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self.sock.close()
         except OSError:
@@ -262,6 +304,14 @@ class RmlNode:
         # Called with the peer vpid when a known link hits EOF — the
         # lifeline-lost signal (≈ ORTE aborting on a lost daemon lifeline).
         self.on_peer_lost: Optional[Callable[[int], None]] = None
+        # Partition-injection seam: when set, called as gate(direction,
+        # tag) with direction "in"/"out" before any non-hello frame is
+        # delivered or sent; returning False blackholes the frame with
+        # the socket left alive — a true network partition (no EOF, no
+        # RST), unlike close().  Must be non-blocking: the inbound check
+        # runs on the link reader thread.  None (the default) costs one
+        # attribute test per frame.
+        self.frame_gate: Optional[Callable[[str, str], bool]] = None
         self._listener = socket.create_server((host, 0), backlog=32)
         self.uri = f"{host}:{self._listener.getsockname()[1]}"
         self._threads: list[threading.Thread] = []
@@ -336,12 +386,25 @@ class RmlNode:
         (SHUTDOWN sets _done → close()), and relaying first guarantees the
         children got the message before our links can vanish.
         """
+        if not self._gate("out", tag):
+            return
         self._relay_down(tag, self.vpid, payload)
         self._deliver(tag, self.vpid, payload)
+
+    def _gate(self, direction: str, tag: str) -> bool:
+        gate = self.frame_gate
+        if gate is None:
+            return True
+        try:
+            return bool(gate(direction, tag))
+        except Exception:  # noqa: BLE001 — a broken gate must not wedge the bus
+            return True
 
     def send_up(self, tag: str, payload: Any) -> None:
         """Deliver at the HNP, relaying through the tree (or, while
         orphaned, over the bootstrap fallback link)."""
+        if not self._gate("out", tag):
+            return
         if self.vpid == 0:
             self._deliver(tag, 0, payload)
             return
@@ -367,6 +430,8 @@ class RmlNode:
     def send_direct(self, link: _Link, tag: str, payload: Any) -> None:
         """Bootstrap-only: a message over an explicit link (HNP replies to
         a registration before the tree exists)."""
+        if not self._gate("out", tag):
+            return
         link.send(_pack_env("direct", tag, self.vpid, payload))
 
     def send_child(self, vpid: int, tag: str, payload: Any) -> bool:
@@ -377,6 +442,8 @@ class RmlNode:
         fit badly.  Returns False when no live link to ``vpid`` exists
         (the prober times out and retries — clock probes are lossy by
         design)."""
+        if not self._gate("out", tag):
+            return False
         with self._lock:
             link = self._child_links.get(vpid) or self.boot_links.get(vpid)
         if link is None:
@@ -393,6 +460,8 @@ class RmlNode:
         The per-hop aggregation primitive: a mid-tree daemon's handler
         merges the payload and later forwards its own combined message —
         how TAG_METRICS folds a subtree's pvar deltas on the way up."""
+        if not self._gate("out", tag):
+            return
         if self.vpid == 0:
             self._deliver(tag, 0, payload)
             return
@@ -449,7 +518,15 @@ class RmlNode:
         sock = link.sock
         with sock:
             while not self._stop.is_set():
-                blob = _recv_frame(sock)
+                try:
+                    blob = _recv_frame(sock)
+                except OSError:
+                    # an abrupt peer death arrives as an RST
+                    # (ECONNRESET) — or EBADF when the peer's close()
+                    # races this recv — not a clean FIN.  Either way
+                    # the link is gone: take the same EOF path, so
+                    # on_peer_lost fires instead of the reader dying
+                    blob = None
                 if blob is None:
                     break
                 msg = dss.unpack(blob, n=1)[0]
@@ -470,6 +547,8 @@ class RmlNode:
                             self.boot_links[peer] = link
                     continue
                 tag, origin, payload = msg[1], msg[2], msg[3]
+                if not self._gate("in", tag):
+                    continue  # partitioned: the frame never arrived
                 # instrumented senders append a (trace_id, span_id)
                 # envelope stamp; plain 4-tuples stay the common case
                 tc = msg[4] if len(msg) > 4 else None
@@ -512,6 +591,10 @@ class RmlNode:
 
     def close(self) -> None:
         self._stop.set()
+        try:  # wake a blocked accept() so the thread exits (see _Link)
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._listener.close()
         except OSError:
@@ -525,6 +608,12 @@ class RmlNode:
             self._pending_hellos.clear()
         if self._parent_link is not None:
             links.append(self._parent_link)
+        if self.fallback_up is not None:
+            # the daemon-side bootstrap link: closing it is what gives
+            # the HNP a prompt boot-link EOF for a dying daemon — a
+            # LEAF daemon has no live children to report it orphaned,
+            # so without this its death waits on heartbeat silence
+            links.append(self.fallback_up)
         for link in links:
             link.close()
 
@@ -539,52 +628,83 @@ class HeartbeatMonitor:
     orted beats :data:`TAG_HEARTBEAT` up the tree; this monitor declares
     any watched vpid dead after ``rml_heartbeat_timeout`` seconds of
     silence and fires ``on_silent(vpid)`` exactly once per vpid.
+
+    The expiry sweep is incremental: every beat pushes a ``(beat_ts,
+    vpid)`` entry on a min-heap and the tick pops only entries older
+    than the timeout, lazily discarding ones a fresher beat superseded
+    — a tick on a 1000-daemon world costs O(expired), not O(world).
+    Each beat's entry is examined exactly once (when it ages past the
+    timeout), so the heap is bounded by the beats of one timeout window.
+    Two more fleet-survival hooks: :meth:`set_world` scales the
+    effective timeout with world size (see :func:`scaled_timeout`) and
+    :meth:`grace` suspends declarations for a bounded stretch — the PLM
+    arms it around a batched reparent wave so survivors busy re-wiring
+    are not declared dead mid-adoption (deferred entries re-arm with a
+    fresh window; a daemon that stays silent after the grace is still
+    declared).
     """
 
     def __init__(self, on_silent: Callable[[int], None]) -> None:
         self.on_silent = on_silent
         self._last: dict[int, float] = {}
         self._declared: set[int] = set()
+        self._heap: list[tuple[float, int]] = []  # (beat_ts, vpid), lazy
+        self._grace_until = 0.0
+        self._world = 0
+        #: sweep telemetry: heap entries examined / sweeps run — what the
+        #: per-tick-cost unit test asserts against
+        self.scanned_total = 0
+        self.ticks_total = 0
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def watch(self, vpid: int) -> None:
         """Start expecting beats from ``vpid`` (clock starts now)."""
-        import time
-
-        with self._lock:
-            self._last[vpid] = time.monotonic()
+        self.beat(vpid)
 
     def beat(self, vpid: int) -> None:
         """A heartbeat (or any sign of life) arrived from ``vpid``."""
-        import time
-
+        now = time.monotonic()
         with self._lock:
-            self._last[vpid] = time.monotonic()
+            self._last[vpid] = now
+            heapq.heappush(self._heap, (now, vpid))
+
+    def set_world(self, world: int) -> None:
+        """Declare the world size (daemons + HNP) so the effective
+        timeout scales with routing-tree depth."""
+        with self._lock:
+            self._world = int(world)
+
+    def grace(self, seconds: float) -> None:
+        """Suspend dead-declarations until ``seconds`` from now (extends,
+        never shortens, an active grace window)."""
+        until = time.monotonic() + float(seconds)
+        with self._lock:
+            self._grace_until = max(self._grace_until, until)
 
     def ages(self) -> dict[int, float]:
         """Seconds since each watched vpid's last beat (the /status
         last-heartbeat-age column; empty when heartbeats are off)."""
-        import time
-
         now = time.monotonic()
         with self._lock:
             return {vpid: max(0.0, now - last)
                     for vpid, last in self._last.items()}
 
+    def effective_timeout(self) -> float:
+        """The declare threshold actually in force: the configured (and
+        2x-period-clamped) timeout, world-scaled."""
+        period = float(var_registry.get("rml_heartbeat_period") or 0)
+        timeout = float(var_registry.get("rml_heartbeat_timeout") or 0)
+        timeout = max(timeout, 2 * period)
+        with self._lock:
+            world = self._world or (len(self._last) + 1)
+        return scaled_timeout(timeout, world)
+
     def start(self) -> None:
         period = float(var_registry.get("rml_heartbeat_period") or 0)
         if period <= 0 or self._thread is not None:
             return
-        self._thread = threading.Thread(target=self._run, name="rml-hb-mon",
-                                        daemon=True)
-        self._thread.start()
-
-    def _run(self) -> None:
-        import time
-
-        period = float(var_registry.get("rml_heartbeat_period") or 0)
         timeout = float(var_registry.get("rml_heartbeat_timeout") or 0)
         if timeout < 2 * period:
             # a timeout shorter than two beat intervals declares every
@@ -592,19 +712,43 @@ class HeartbeatMonitor:
             # letting a plausible-looking config abort the job
             _log.verbose(0, "heartbeat: timeout %.2fs < 2x period %.2fs; "
                          "clamping to %.2fs", timeout, period, 2 * period)
-            timeout = 2 * period
-        # check at the beat cadence; declare at the timeout
+        self._thread = threading.Thread(target=self._run, name="rml-hb-mon",
+                                        daemon=True)
+        self._thread.start()
+
+    def _sweep(self, now: float, timeout: float) -> list[int]:
+        """One incremental expiry sweep: pop heap entries older than the
+        timeout, declaring the vpids whose NEWEST beat that is.  Returns
+        the newly silent vpids (callers fire ``on_silent`` outside the
+        lock)."""
+        cutoff = now - timeout
+        silent: list[int] = []
+        with self._lock:
+            self.ticks_total += 1
+            grace = self._grace_until
+            while self._heap and self._heap[0][0] <= cutoff:
+                ts, vpid = heapq.heappop(self._heap)
+                self.scanned_total += 1
+                last = self._last.get(vpid)
+                if last is None or vpid in self._declared or last > ts:
+                    continue  # unwatched / already declared / stale entry
+                if now < grace:
+                    # reparent-wave grace: re-arm with a fresh window
+                    # instead of declaring — still-silent daemons expire
+                    # one timeout after the deferral
+                    self._last[vpid] = now
+                    heapq.heappush(self._heap, (now, vpid))
+                    continue
+                self._declared.add(vpid)
+                silent.append(vpid)
+        return silent
+
+    def _run(self) -> None:
+        period = float(var_registry.get("rml_heartbeat_period") or 0)
+        # check at the beat cadence; declare at the (world-scaled) timeout
         while not self._stop.wait(max(0.05, period / 2)):
-            now = time.monotonic()
-            silent = []
-            with self._lock:
-                for vpid, last in self._last.items():
-                    if vpid in self._declared:
-                        continue
-                    if now - last > timeout:
-                        self._declared.add(vpid)
-                        silent.append(vpid)
-            for vpid in silent:
+            timeout = self.effective_timeout()
+            for vpid in self._sweep(time.monotonic(), timeout):
                 _log.error("heartbeat: vpid %d silent for >%.1fs; "
                            "declaring it dead", vpid, timeout)
                 try:

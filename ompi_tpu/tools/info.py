@@ -24,6 +24,8 @@ _REGISTERING_MODULES = [
     "ompi_tpu.runtime.notifier",
     "ompi_tpu.runtime.rtc",
     "ompi_tpu.runtime.plm",
+    "ompi_tpu.runtime.metrics",       # metrics_agg_* fan-in valve vars
+    "ompi_tpu.runtime.doctor",        # doctor_* capture-budget vars
     "ompi_tpu.mpi.coll",
     "ompi_tpu.mpi.coll.host",
     "ompi_tpu.mpi.coll.selfcoll",

@@ -1,0 +1,236 @@
+"""Unit bounds for the fleet-survival mechanisms: incremental
+heartbeat sweeps (O(expired) per tick), world-scaled timeouts,
+metrics shed-and-count, incremental job eviction, bounded ftevents
+snapshots with explicit truncation, hierarchical doctor
+pre-aggregation, and one-xcast batched failure propagation."""
+
+import time
+
+import pytest
+
+from ompi_tpu.core.config import var_registry
+from ompi_tpu.core.netpatterns import tree_depth
+from ompi_tpu.runtime import doctor, rml
+from ompi_tpu.runtime.ftevents import FtEventLog
+from ompi_tpu.runtime.metrics import MetricsAggregate
+
+
+@pytest.fixture
+def hb_vars():
+    old_p = var_registry.get("rml_heartbeat_period")
+    old_t = var_registry.get("rml_heartbeat_timeout")
+    var_registry.set("rml_heartbeat_period", 1.0)
+    var_registry.set("rml_heartbeat_timeout", 10.0)
+    yield
+    var_registry.set("rml_heartbeat_period", old_p)
+    var_registry.set("rml_heartbeat_timeout", old_t)
+
+
+# -- HeartbeatMonitor: incremental sweep ------------------------------
+
+
+def test_heartbeat_sweep_examines_nothing_when_nothing_expired(hb_vars):
+    silent = []
+    mon = rml.HeartbeatMonitor(silent.append)
+    t0 = time.monotonic()
+    for v in range(1, 513):
+        mon.watch(v)
+    # a tick on a healthy 512-daemon world: cutoff precedes every beat,
+    # so the heap is never touched — O(expired), not O(world)
+    assert mon._sweep(t0 + 5.0, timeout=10.0) == []
+    assert mon.scanned_total == 0
+    assert mon.ticks_total == 1
+
+
+def test_heartbeat_sweep_declares_each_silent_vpid_once(hb_vars):
+    silent = []
+    mon = rml.HeartbeatMonitor(silent.append)
+    t0 = time.monotonic()
+    for v in range(1, 513):
+        mon.watch(v)
+    mon.beat(3)   # a duplicate entry: lazy invalidation must dedupe
+    expired = mon._sweep(t0 + 30.0, timeout=10.0)
+    assert sorted(expired) == list(range(1, 513))
+    assert len(expired) == len(set(expired))
+    # every heap entry examined exactly once, then the heap is empty
+    assert mon.scanned_total == 513
+    assert mon._sweep(t0 + 60.0, timeout=10.0) == []
+    assert mon.scanned_total == 513
+
+
+def test_heartbeat_fresh_beat_invalidates_stale_entry(hb_vars):
+    silent = []
+    mon = rml.HeartbeatMonitor(silent.append)
+    t0 = time.monotonic()
+    mon.watch(7)
+    time.sleep(0.05)
+    mon.beat(7)   # fresh beat supersedes the first entry
+    # sweep past the FIRST beat only: the stale entry pops and is
+    # discarded (last > ts), the vpid stays alive
+    assert mon._sweep(t0 + 10.01, timeout=10.0) == []
+    assert 7 not in mon._declared
+
+
+def test_heartbeat_grace_defers_then_declares(hb_vars):
+    silent = []
+    mon = rml.HeartbeatMonitor(silent.append)
+    t0 = time.monotonic()
+    mon.watch(9)
+    mon.grace(25.0)   # covers the first (simulated) sweep time below
+    # inside the grace window: re-armed, not declared
+    assert mon._sweep(t0 + 20.0, timeout=10.0) == []
+    assert 9 not in mon._declared
+    # still silent one timeout after the deferral AND past the grace:
+    # declared now (the re-armed entry expired)
+    assert mon._sweep(t0 + 40.0, timeout=10.0) == [9]
+
+
+def test_scaled_timeout_grows_with_tree_depth():
+    assert rml.scaled_timeout(4.0, 1) == 4.0
+    assert rml.scaled_timeout(4.0, 31) == 4.0       # small worlds exact
+    big = rml.scaled_timeout(4.0, 1001)
+    assert big == 4.0 * tree_depth(1001, k=2) / 4
+    assert big > 4.0
+    assert rml.scaled_timeout(4.0, 101) >= rml.scaled_timeout(4.0, 31)
+
+
+# -- MetricsAggregate: shed-and-count + incremental eviction ----------
+
+
+def _payload(jobid, n_ranks, base=0):
+    return {jobid: {base + r: [time.time(), {"x_total": 1.0}]
+                    for r in range(n_ranks)}}
+
+
+@pytest.fixture
+def small_budget():
+    old = var_registry.get("metrics_agg_budget_rows")
+    var_registry.set("metrics_agg_budget_rows", 10)
+    yield
+    var_registry.set("metrics_agg_budget_rows", old)
+
+
+def test_metrics_agg_sheds_whole_payload_and_counts(small_budget):
+    agg = MetricsAggregate()
+    # the bucket starts with the full burst (10 tokens), so boot-time
+    # pushes within budget always land — but 20 rows still can't fit
+    agg.merge(_payload(1, 20))          # 20 rows > 10/s budget: shed
+    st = agg.stats()
+    assert st["sheds_total"] == 1
+    assert st["shed_rows_total"] == 20
+    assert agg.snapshot() == {}         # dropped WHOLE, not truncated
+    agg.merge(_payload(1, 5))           # within budget: lands
+    st = agg.stats()
+    assert st["sheds_total"] == 1
+    assert st["merges_total"] == 1
+    assert len(agg.snapshot()[1]) == 5
+
+
+def test_metrics_agg_evicts_oldest_job_incrementally():
+    agg = MetricsAggregate(max_jobs=2)
+    agg.merge(_payload(101, 2))
+    agg.merge(_payload(102, 2))
+    agg.merge(_payload(103, 2))
+    snap = agg.snapshot()
+    assert set(snap) == {102, 103}      # oldest-merged evicted
+    assert set(agg._job_ts) == {102, 103}
+
+
+# -- ftevents: explicit truncation markers ----------------------------
+
+
+def test_ftevents_snapshot_leads_with_truncation_marker():
+    log = FtEventLog(capacity=16)
+    for i in range(20):
+        log.record("detect", jobid=1, rank=i)
+    assert log.dropped() == 4
+    snap = log.snapshot()
+    assert snap[0]["kind"] == "truncated"
+    assert snap[0]["info"]["dropped"] == 4
+    assert len(snap) == 17              # marker + the 16-event tail
+    # the marker survives a job filter (jobid 0 rides along)
+    snap1 = log.snapshot(jobid=1)
+    assert snap1[0]["kind"] == "truncated"
+    assert log.total() == 20
+
+
+def test_ftevents_no_marker_until_eviction_and_clear_resets():
+    log = FtEventLog(capacity=16)
+    for i in range(10):
+        log.record("detect", jobid=1, rank=i)
+    assert log.dropped() == 0
+    assert all(e["kind"] != "truncated" for e in log.snapshot())
+    for i in range(10):
+        log.record("detect", jobid=1, rank=i)
+    assert log.dropped() > 0
+    log.clear()
+    assert log.dropped() == 0
+    assert log.snapshot() == []
+
+
+# -- doctor: hierarchical pre-aggregation -----------------------------
+
+
+def _capture(rank, seq, *, no_response=False, err=None, stuck=0):
+    row = {"jobid": 1, "rank": rank, "pid": 0, "stuck": stuck,
+           "cur": {"cid": 0, "seq": seq, "kind": "allreduce",
+                   "age_s": 0.1, "done": False}}
+    if err:
+        row["cur"]["err"] = err
+    if no_response:
+        row["no_response"] = True
+    return row
+
+
+def test_summarize_rows_within_budget_passes_through():
+    rows = [_capture(r, 5) for r in range(4)]
+    kept, summary = doctor.summarize_rows(rows, 8)
+    assert kept == rows
+    assert summary is None
+    kept, summary = doctor.summarize_rows(rows, 0)   # 0 = unbounded
+    assert summary is None
+
+
+def test_summarize_rows_keeps_hot_rows_and_extremes():
+    rows = ([_capture(r, 100 + r) for r in range(16)]
+            + [_capture(16, 3, no_response=True),
+               _capture(17, 200, err="timeout")])
+    kept, summary = doctor.summarize_rows(rows, 6)
+    assert len(kept) == 6
+    kept_ranks = {c["rank"] for c in kept}
+    assert {16, 17} <= kept_ranks       # non-responder + errored op
+    assert 0 in kept_ranks              # slowest survivor (seq extreme)
+    assert 15 in kept_ranks             # fastest survivor
+    assert summary["summary"] and summary["truncated"]
+    assert summary["ranks_omitted"] == len(rows) - 6
+    assert summary["op_seq_min"] >= 100
+    assert summary["op_seq_max"] <= 199
+    assert summary["cur_kinds"] == {"allreduce": summary["ranks_omitted"]}
+    # summary rows carry no "rank" key, so doctor.analyze skips them
+    assert "rank" not in summary
+
+
+# -- errmgr: batched propagation is ONE xcast -------------------------
+
+
+def test_batched_daemon_ranks_failed_sends_one_xcast():
+    from ompi_tpu.runtime.errmgr import ErrmgrNotify
+    from ompi_tpu.runtime.job import AppContext, Job, Proc
+
+    sent = []
+
+    class _Rml:
+        def xcast(self, tag, payload):
+            sent.append((tag, payload))
+
+    class _Launcher:
+        rml = _Rml()
+
+    job = Job([AppContext(argv=["x"], np=4)])
+    job.procs = [Proc(rank=r) for r in range(4)]
+    ErrmgrNotify().daemon_ranks_failed(_Launcher(), job, job.procs[:3])
+    assert len(sent) == 1
+    tag, (ranks, reason) = sent[0]
+    assert tag == rml.TAG_PROC_FAILED
+    assert ranks == [0, 1, 2]
+    assert "3 rank(s)" in reason

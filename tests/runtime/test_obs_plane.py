@@ -607,7 +607,12 @@ def test_ftevents_ring_is_bounded():
         log.record("detect", jobid=1, rank=i)
     assert log.total() == 100
     evs = log.snapshot()
-    assert len(evs) == 16
+    # the 16-event tail + ONE synthetic marker saying what fell off —
+    # truncation is explicit, never silent
+    assert len(evs) == 17
+    assert evs[0]["kind"] == "truncated"
+    assert evs[0]["info"]["dropped"] == 84
+    assert all(e["kind"] != "truncated" for e in evs[1:])
     assert evs[-1]["rank"] == 99      # newest survive, oldest fall off
 
 

@@ -1,0 +1,206 @@
+"""Simulated-fleet survival: correlated daemon loss, bounded reparent
+storms, partition heal, uplink-overload shedding, doctor fan-in.
+
+Every test drives the REAL MultiHostLauncher (loss-epoch reparenter,
+heartbeat sweep, metrics fan-in) over in-process stub daemons — see
+``ompi_tpu.testing.simfleet``.  Worlds are deterministic (fixed seeds,
+fixed victim sets computed from the routing tree), so the message-count
+assertions are exact, not statistical."""
+
+import time
+
+import pytest
+
+from ompi_tpu.core.config import var_registry
+from ompi_tpu.runtime import rml
+from ompi_tpu.testing.simfleet import SimFleet
+
+
+def _expected_reparent(n_daemons: int, victims: list[int]):
+    """(orphans, adopters) a batched epoch must produce for ``victims``
+    dying on the static routing tree — orphans re-home to their nearest
+    live ancestor, deeper descendants keep their links."""
+    dead = set(victims)
+    orphans = sorted(
+        v for v in range(1, n_daemons + 1)
+        if v not in dead and (rml.tree_parent(v) or 0) in dead)
+    adopters = sorted({rml.nearest_live_ancestor(o, dead)
+                       for o in orphans})
+    return orphans, adopters
+
+
+def _fleet(n_daemons, n_ranks, **kw):
+    kw.setdefault("seed", 11)
+    fleet = SimFleet(n_daemons=n_daemons, n_ranks=n_ranks, **kw)
+    fleet.start(timeout=30.0)
+    return fleet
+
+
+# -- boot --------------------------------------------------------------
+
+
+def test_fleet_boots_and_tears_down_32_ranks():
+    fleet = _fleet(4, 32)
+    try:
+        assert fleet.live_daemons() == 4
+        assert fleet.converged()
+        assert fleet.self_failed() == {}
+        rows, seen = fleet.collect_doctor(timeout=8.0)
+        assert seen == {1, 2, 3, 4}
+        # rpd=8 == doctor_rows_per_daemon default: no summarization
+        assert len(rows) == 32
+    finally:
+        fleet.stop()
+
+
+# -- correlated loss: one batched epoch, O(orphans) frames -------------
+
+
+@pytest.mark.parametrize("n_daemons,n_ranks,victims", [
+    (4, 32, [1]),              # mid-tree: 1 owns 3,4
+    (16, 128, [4, 5, 6]),      # three interior daemons in one tick
+    (64, 512, [16, 17, 18, 19, 20, 21, 22, 23]),   # a whole rack band
+])
+def test_rack_kill_converges_in_one_bounded_epoch(n_daemons, n_ranks,
+                                                  victims):
+    orphans, adopters = _expected_reparent(n_daemons, victims)
+    assert orphans, "victim set must orphan someone (test bug)"
+    fleet = _fleet(n_daemons, n_ranks)
+    try:
+        fleet.rack_kill(victims)
+        dt = fleet.wait_adopted(timeout=15.0)
+        assert dt is not None, (
+            f"no convergence: self_failed={fleet.self_failed()}")
+        la = fleet.launcher
+        # ONE batched adoption round for the whole correlated loss
+        assert la.reparent_epochs_total == 1
+        assert la.reparent_orphans_total == len(orphans)
+        # frames = one REPARENT per orphan + one ADOPT per non-HNP
+        # adopter group — O(orphans), never O(world) or O(orphans^2)
+        expected_frames = len(orphans) + len(
+            [a for a in adopters if a != 0])
+        assert la.reparent_frames_total == expected_frames
+        # nobody died who wasn't killed; nobody gave up waiting
+        assert fleet.false_positive_rank_deaths() == []
+        assert fleet.self_failed() == {}
+        # every orphan took exactly one REPARENT order
+        for o in orphans:
+            assert fleet.daemons[o].adoptions_total == 1
+            assert fleet.daemons[o].node.parent_vpid == \
+                rml.nearest_live_ancestor(o, set(victims))
+    finally:
+        fleet.stop()
+
+
+def test_three_simultaneous_midtree_kills_are_idempotent():
+    """Regression (satellite 1): three interior daemons dying in the
+    same tick race three detector families (link EOF at the HNP, orphan
+    reports, heartbeat expiry) into the loss queue — the epoch worker
+    must coalesce every duplicate into ONE round, adopt each orphan
+    exactly once, and leave the effective tree fully live."""
+    victims = [4, 5, 6]
+    orphans, _adopters = _expected_reparent(16, victims)
+    fleet = _fleet(16, 128, hb_period=0.2, hb_timeout=2.0)
+    try:
+        fleet.rack_kill(victims)
+        assert fleet.wait_adopted(timeout=15.0) is not None
+        # let the heartbeat sweep cross its timeout too: its late
+        # declarations of the same corpses must not start a second round
+        time.sleep(2.5)
+        la = fleet.launcher
+        assert la.reparent_epochs_total == 1
+        assert la.reparent_orphans_total == len(orphans)
+        assert sum(d.adoptions_total
+                   for d in fleet.daemons.values()) == len(orphans)
+        dead = set(la._dead_daemons)
+        assert dead == set(victims)
+        with la._cv:
+            eff = dict(la._eff_parent)
+        for v, d in fleet.daemons.items():
+            if d.alive:
+                assert eff.get(v, 0) not in dead
+        assert fleet.false_positive_rank_deaths() == []
+    finally:
+        fleet.stop()
+
+
+# -- partition: fenced frames drain, no kill storm ---------------------
+
+
+def test_partition_heals_without_kill_storm():
+    """A partitioned subtree drops ALL frames for T seconds with its
+    sockets alive.  T < the (world-scaled) heartbeat timeout, so the
+    heal must find every daemon alive: zero deaths, zero reparent
+    epochs, zero failed ranks — and the fenced metrics stream drains
+    (cumulative counters re-land on the next push)."""
+    fleet = _fleet(16, 128, hb_period=0.5, hb_timeout=4.0)
+    try:
+        # vpid 3's subtree: 3, 7, 8, 15, 16
+        fenced = [3, 7, 8, 15, 16]
+        fleet.partition(fenced)
+        # pushes during the fence go nowhere (frames drop, no EOF)
+        fleet.metrics_storm(full=False)
+        time.sleep(1.0)
+        fleet.heal(fenced)
+        # beats resume; give the sweep a tick, then push again
+        time.sleep(1.0)
+        fleet.metrics_storm(full=False)
+        time.sleep(0.5)
+        la = fleet.launcher
+        assert la.reparent_epochs_total == 0
+        assert la._dead_daemons == set()
+        assert fleet.false_positive_rank_deaths() == []
+        assert fleet.self_failed() == {}
+        # the drained stream reached the aggregate: every rank row
+        # present, including the fenced subtree's
+        snap = la.metrics_agg.snapshot()
+        ranks = set(snap.get(fleet.job.jobid, {}))
+        assert len(ranks) == 128
+    finally:
+        fleet.stop()
+
+
+# -- uplink storm: shed-and-count, plane stays serviceable -------------
+
+
+def test_uplink_storm_sheds_whole_payloads_and_counts_them():
+    fleet = _fleet(16, 128, agg_budget_rows=48)
+    try:
+        fleet.metrics_storm(full=True)
+        time.sleep(0.5)
+        st = fleet.launcher.metrics_agg.stats()
+        assert st["sheds_total"] >= 1
+        assert st["shed_rows_total"] > 0
+        # shedding is staleness, not corruption: wait a budget window
+        # and a small follow-up push must land
+        time.sleep(1.1)
+        fleet.daemons[1].push_metrics(full=False)
+        time.sleep(0.3)
+        st2 = fleet.launcher.metrics_agg.stats()
+        assert st2["merges_total"] > st["merges_total"]
+    finally:
+        fleet.stop()
+
+
+# -- doctor: O(hosts) fan-in with explicit truncation ------------------
+
+
+def test_doctor_fan_in_is_bounded_per_daemon():
+    fleet = _fleet(8, 128, doctor_rows=4)   # 16 ranks/daemon, keep 4
+    try:
+        rows, seen = fleet.collect_doctor(timeout=8.0)
+        assert seen == set(range(1, 9))
+        # per daemon: <= limit kept rows + exactly one summary row
+        assert len(rows) <= 8 * (4 + 1)
+        summaries = [r for r in rows if r.get("summary")]
+        assert len(summaries) == 8
+        for s in summaries:
+            assert s["truncated"] is True
+            assert s["ranks_omitted"] == 16 - 4
+            assert s["vpid"] in seen
+        # every stub rank is accounted for: kept rows + omitted counts
+        kept = [r for r in rows if not r.get("summary")]
+        assert len(kept) + sum(s["ranks_omitted"]
+                               for s in summaries) == 128
+    finally:
+        fleet.stop()
